@@ -1,0 +1,38 @@
+"""karpenter_provider_aws_tpu — a TPU-native node-provisioning framework.
+
+A ground-up rebuild of the capabilities of karpenter-provider-aws (the AWS
+provider plugin) *plus* the sigs.k8s.io/karpenter core engine it plugs into
+(provisioning bin-packing, consolidation/disruption, cluster state, node
+lifecycle), re-designed TPU-first: the scheduling hot path is a dense
+constraint tensor (pods x instance-types x topology-domains) evaluated by
+batched jit-compiled JAX/XLA kernels, behind a pluggable ``Solver`` interface
+with a CPU reference oracle (decision-identical by construction).
+
+Layout
+------
+- ``apis``            CRD-shaped user API: NodePool / NodeClaim / EC2NodeClass,
+                      the requirements (label-set) algebra, resources, labels.
+- ``models``          Tensor encodings of the scheduling problem (the
+                      "model" of this framework): constraint-tensor builder.
+- ``ops``             JAX kernels: feasibility, vectorized FFD packing,
+                      scoring, consolidation replacement search.
+- ``parallel``        Mesh/sharding: pods-axis SPMD via shard_map/pjit.
+- ``solver``          Solver interface + CPU oracle + TPU solver.
+- ``state``           In-memory cluster state cache (core `state.Cluster`).
+- ``cloudprovider``   The CloudProvider plugin boundary (Create/Delete/Get/
+                      List/GetInstanceTypes/IsDrifted/RepairPolicies).
+- ``providers``       Resource services: instancetype catalog, instance
+                      launcher, pricing, subnet, securitygroup, amifamily,
+                      launchtemplate, instanceprofile, ssm, sqs, version.
+- ``controllers``     Reconcilers: provisioning, disruption, GC, tagging,
+                      interruption, nodeclass status, catalog/pricing refresh.
+- ``batcher``         Generic request micro-batching engine.
+- ``cache``           TTL caches + UnavailableOfferings (ICE blacklist).
+- ``fake``            In-memory fake cloud + fake kube API for tests.
+- ``sidecar``         Solver RPC service (control plane <-> solver boundary).
+
+Reference parity citations use ``file:line`` against /root/reference
+(karpenter-provider-aws @ 2025-03-03).
+"""
+
+__version__ = "0.1.0"

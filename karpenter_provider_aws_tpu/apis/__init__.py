@@ -1,0 +1,19 @@
+from . import labels, requirements, resources
+from .objects import (BlockDeviceMapping, Condition, DisruptionBudget,
+                      Disruption, EC2NodeClass, KubeletConfiguration,
+                      MetadataOptions, Node, NodeClaim, NodeClassRef,
+                      NodePool, NodePoolTemplate, ObjectMeta, Pod,
+                      PodAffinityTerm, SelectorTerm, Taint, Toleration,
+                      TopologySpreadConstraint, stable_hash)
+from .requirements import Requirement, Requirements
+from .resources import Resources, parse_quantity, sum_resources
+
+__all__ = [
+    "labels", "requirements", "resources",
+    "Requirement", "Requirements", "Resources", "parse_quantity",
+    "sum_resources", "Pod", "Node", "NodeClaim", "NodePool",
+    "NodePoolTemplate", "NodeClassRef", "EC2NodeClass", "Taint", "Toleration",
+    "TopologySpreadConstraint", "PodAffinityTerm", "DisruptionBudget",
+    "Disruption", "SelectorTerm", "MetadataOptions", "BlockDeviceMapping",
+    "KubeletConfiguration", "ObjectMeta", "Condition", "stable_hash",
+]

@@ -1,0 +1,121 @@
+"""Well-known scheduling labels.
+
+Mirrors the label universe the reference registers into the core scheduler:
+core well-known labels (kubernetes.io/*, karpenter.sh/*) plus the 21 AWS
+labels registered at pkg/apis/v1/labels.go:31-54, restricted-label patterns
+(labels.go:56-77), and extended resource names (labels.go:91-98).
+"""
+
+from __future__ import annotations
+
+import re
+
+# --- core (sigs.k8s.io/karpenter + kubernetes) -----------------------------
+ARCH = "kubernetes.io/arch"
+OS = "kubernetes.io/os"
+INSTANCE_TYPE = "node.kubernetes.io/instance-type"
+ZONE = "topology.kubernetes.io/zone"
+REGION = "topology.kubernetes.io/region"
+HOSTNAME = "kubernetes.io/hostname"
+CAPACITY_TYPE = "karpenter.sh/capacity-type"
+NODEPOOL = "karpenter.sh/nodepool"
+NODE_INITIALIZED = "karpenter.sh/initialized"
+NODE_REGISTERED = "karpenter.sh/registered"
+DO_NOT_DISRUPT_ANNOTATION = "karpenter.sh/do-not-disrupt"
+NODEPOOL_HASH_ANNOTATION = "karpenter.sh/nodepool-hash"
+NODEPOOL_HASH_VERSION_ANNOTATION = "karpenter.sh/nodepool-hash-version"
+
+CAPACITY_TYPE_SPOT = "spot"
+CAPACITY_TYPE_ON_DEMAND = "on-demand"
+CAPACITY_TYPE_RESERVED = "reserved"
+
+ARCH_AMD64 = "amd64"
+ARCH_ARM64 = "arm64"
+OS_LINUX = "linux"
+OS_WINDOWS = "windows"
+
+# --- AWS provider labels (pkg/apis/v1/labels.go:31-54) ---------------------
+_G = "karpenter.k8s.aws"
+INSTANCE_HYPERVISOR = f"{_G}/instance-hypervisor"
+INSTANCE_ENCRYPTION_IN_TRANSIT = f"{_G}/instance-encryption-in-transit-supported"
+INSTANCE_CATEGORY = f"{_G}/instance-category"
+INSTANCE_FAMILY = f"{_G}/instance-family"
+INSTANCE_GENERATION = f"{_G}/instance-generation"
+INSTANCE_LOCAL_NVME = f"{_G}/instance-local-nvme"
+INSTANCE_SIZE = f"{_G}/instance-size"
+INSTANCE_CPU = f"{_G}/instance-cpu"
+INSTANCE_CPU_MANUFACTURER = f"{_G}/instance-cpu-manufacturer"
+INSTANCE_CPU_SUSTAINED_CLOCK = f"{_G}/instance-cpu-sustained-clock-speed-mhz"
+INSTANCE_MEMORY = f"{_G}/instance-memory"
+INSTANCE_EBS_BANDWIDTH = f"{_G}/instance-ebs-bandwidth"
+INSTANCE_NETWORK_BANDWIDTH = f"{_G}/instance-network-bandwidth"
+INSTANCE_GPU_NAME = f"{_G}/instance-gpu-name"
+INSTANCE_GPU_MANUFACTURER = f"{_G}/instance-gpu-manufacturer"
+INSTANCE_GPU_COUNT = f"{_G}/instance-gpu-count"
+INSTANCE_GPU_MEMORY = f"{_G}/instance-gpu-memory"
+INSTANCE_ACCELERATOR_NAME = f"{_G}/instance-accelerator-name"
+INSTANCE_ACCELERATOR_MANUFACTURER = f"{_G}/instance-accelerator-manufacturer"
+INSTANCE_ACCELERATOR_COUNT = f"{_G}/instance-accelerator-count"
+ZONE_ID = "topology.k8s.aws/zone-id"
+
+EC2NODECLASS_LABEL = f"{_G}/ec2nodeclass"
+EC2NODECLASS_HASH_ANNOTATION = f"{_G}/ec2nodeclass-hash"
+EC2NODECLASS_HASH_VERSION_ANNOTATION = f"{_G}/ec2nodeclass-hash-version"
+EC2NODECLASS_HASH_VERSION = "v4"  # pkg/apis/v1/ec2nodeclass.go (v4)
+
+#: Labels whose values are integers, supporting Gt/Lt requirement operators.
+NUMERIC_LABELS = frozenset({
+    INSTANCE_CPU, INSTANCE_MEMORY, INSTANCE_GPU_COUNT, INSTANCE_GPU_MEMORY,
+    INSTANCE_ACCELERATOR_COUNT, INSTANCE_GENERATION, INSTANCE_EBS_BANDWIDTH,
+    INSTANCE_NETWORK_BANDWIDTH, INSTANCE_LOCAL_NVME,
+    INSTANCE_CPU_SUSTAINED_CLOCK,
+})
+
+#: The full well-known set: pods may constrain these even when a nodepool
+#: leaves them undefined (the instance types define them).
+WELL_KNOWN_LABELS = frozenset({
+    ARCH, OS, INSTANCE_TYPE, ZONE, REGION, CAPACITY_TYPE, NODEPOOL,
+    HOSTNAME, ZONE_ID,
+    INSTANCE_HYPERVISOR, INSTANCE_ENCRYPTION_IN_TRANSIT, INSTANCE_CATEGORY,
+    INSTANCE_FAMILY, INSTANCE_GENERATION, INSTANCE_LOCAL_NVME, INSTANCE_SIZE,
+    INSTANCE_CPU, INSTANCE_CPU_MANUFACTURER, INSTANCE_CPU_SUSTAINED_CLOCK,
+    INSTANCE_MEMORY, INSTANCE_EBS_BANDWIDTH, INSTANCE_NETWORK_BANDWIDTH,
+    INSTANCE_GPU_NAME, INSTANCE_GPU_MANUFACTURER, INSTANCE_GPU_COUNT,
+    INSTANCE_GPU_MEMORY, INSTANCE_ACCELERATOR_NAME,
+    INSTANCE_ACCELERATOR_MANUFACTURER, INSTANCE_ACCELERATOR_COUNT,
+})
+
+# --- restricted tags/labels (labels.go:56-77) ------------------------------
+RESTRICTED_TAG_PATTERNS = (
+    re.compile(r"^karpenter\.sh/nodepool$"),
+    re.compile(r"^karpenter\.sh/nodeclaim$"),
+    re.compile(r"^kubernetes\.io/cluster/[0-9A-Za-z][A-Za-z0-9\-_]*$"),
+    re.compile(r"^karpenter\.k8s\.aws/ec2nodeclass$"),
+    re.compile(r"^eks:eks-cluster-name$"),
+)
+
+RESTRICTED_LABEL_DOMAINS = ("kubernetes.io", "k8s.io", "karpenter.sh")
+#: subdomains users MAY label under despite the restricted domains above
+ALLOWED_LABEL_DOMAINS = (
+    "kops.k8s.io", "node.kubernetes.io", "node-restriction.kubernetes.io",
+    "karpenter.k8s.aws", "topology.k8s.aws",
+)
+
+
+def is_restricted_label(key: str) -> bool:
+    """True if users may not set this label on a NodePool template."""
+    if key in WELL_KNOWN_LABELS:
+        return False
+    domain = key.split("/", 1)[0] if "/" in key else ""
+    for allowed in ALLOWED_LABEL_DOMAINS:
+        if domain == allowed or domain.endswith("." + allowed):
+            return False
+    for restricted in RESTRICTED_LABEL_DOMAINS:
+        if domain == restricted or domain.endswith("." + restricted):
+            return True
+    return False
+
+
+def is_restricted_tag(key: str) -> bool:
+    """True if users may not set this cloud tag (cloudprovider.go:232-250)."""
+    return any(p.match(key) for p in RESTRICTED_TAG_PATTERNS)

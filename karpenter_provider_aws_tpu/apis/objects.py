@@ -1,0 +1,507 @@
+"""Kubernetes-shaped object model: the user API surface.
+
+NodePool / NodeClaim / EC2NodeClass are the *entire* user API of the
+reference (SURVEY §2.2); plus the workload-side objects the scheduler
+consumes (Pod with scheduling constraints, Node). These are plain dataclasses
+— the in-memory kube API in ``fake/kube.py`` stores and watches them.
+
+Parity cites: EC2NodeClassSpec pkg/apis/v1/ec2nodeclass.go:30 (selector
+terms :141,156,174, KubeletConfiguration :212, MetadataOptions :278,
+BlockDeviceMapping :326, alias parsing :494-548); NodePool disruption policy
+pkg/apis/crds/karpenter.sh_nodepools.yaml:78-141; NodeClaim reconstruction
+pkg/cloudprovider/cloudprovider.go:352-378.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import itertools
+import json
+import time
+from dataclasses import dataclass, field, replace
+from typing import Any, Dict, FrozenSet, List, Mapping, Optional, Sequence, Tuple
+
+from . import labels as L
+from .requirements import (DOES_NOT_EXIST, EXISTS, GT, IN, LT, NOT_IN,
+                           Requirement, Requirements)
+from .resources import Resources
+
+_uid_counter = itertools.count(1)
+
+
+def _new_uid(prefix: str) -> str:
+    return f"{prefix}-{next(_uid_counter):08x}"
+
+
+@dataclass
+class Condition:
+    type: str
+    status: str  # "True" | "False" | "Unknown"
+    reason: str = ""
+    message: str = ""
+    last_transition: float = 0.0
+
+
+@dataclass
+class ObjectMeta:
+    name: str
+    namespace: str = ""
+    uid: str = ""
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    creation_timestamp: float = 0.0
+    deletion_timestamp: Optional[float] = None
+    finalizers: List[str] = field(default_factory=list)
+    resource_version: int = 0
+    owner_refs: List[str] = field(default_factory=list)  # "kind/name" strings
+
+    def __post_init__(self):
+        if not self.uid:
+            self.uid = _new_uid(self.name or "obj")
+
+
+class KubeObject:
+    """Base for objects stored in the (fake) kube API."""
+    kind: str = "Object"
+    metadata: ObjectMeta
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def uid(self) -> str:
+        return self.metadata.uid
+
+    def key(self) -> Tuple[str, str, str]:
+        return (self.kind, self.metadata.namespace, self.metadata.name)
+
+
+# ---------------------------------------------------------------------------
+# Taints / tolerations (k8s semantics)
+# ---------------------------------------------------------------------------
+
+TAINT_NO_SCHEDULE = "NoSchedule"
+TAINT_PREFER_NO_SCHEDULE = "PreferNoSchedule"
+TAINT_NO_EXECUTE = "NoExecute"
+
+#: Karpenter's own taints, tolerated implicitly by nothing — the unregistered
+#: taint gates pods until the node initializes (core semantics).
+UNREGISTERED_TAINT = "karpenter.sh/unregistered"
+DISRUPTED_TAINT = "karpenter.sh/disrupted"
+
+
+@dataclass(frozen=True)
+class Taint:
+    key: str
+    effect: str = TAINT_NO_SCHEDULE
+    value: str = ""
+
+    def tolerated_by(self, tolerations: Sequence["Toleration"]) -> bool:
+        if self.effect == TAINT_PREFER_NO_SCHEDULE:
+            return True  # preference, not a hard constraint
+        return any(t.tolerates(self) for t in tolerations)
+
+
+@dataclass(frozen=True)
+class Toleration:
+    key: str = ""               # empty key + Exists tolerates everything
+    operator: str = "Equal"     # "Equal" | "Exists"
+    value: str = ""
+    effect: str = ""            # empty matches all effects
+
+    def tolerates(self, taint: Taint) -> bool:
+        if self.effect and self.effect != taint.effect:
+            return False
+        if not self.key:
+            return self.operator == "Exists"
+        if self.key != taint.key:
+            return False
+        if self.operator == "Exists":
+            return True
+        return self.value == taint.value
+
+
+# ---------------------------------------------------------------------------
+# Pod (the scheduler's input)
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class TopologySpreadConstraint:
+    max_skew: int
+    topology_key: str            # e.g. topology.kubernetes.io/zone, hostname
+    when_unsatisfiable: str = "DoNotSchedule"  # or ScheduleAnyway
+    # label selector is simplified to "same spread group key" — pods carry a
+    # precomputed group identity (the common case: selector == own labels).
+    group: str = ""
+
+
+@dataclass(frozen=True)
+class PodAffinityTerm:
+    topology_key: str
+    group: str                   # label-selector group identity
+    anti: bool = False           # True => anti-affinity
+    required: bool = True
+
+
+class Pod(KubeObject):
+    kind = "Pod"
+
+    def __init__(self, name: str, namespace: str = "default",
+                 requests: Optional[Resources] = None,
+                 node_selector: Optional[Mapping[str, str]] = None,
+                 required_affinity_terms: Sequence[Mapping[str, Any]] = (),
+                 tolerations: Sequence[Toleration] = (),
+                 topology_spread: Sequence[TopologySpreadConstraint] = (),
+                 pod_affinity: Sequence[PodAffinityTerm] = (),
+                 labels: Optional[Dict[str, str]] = None,
+                 node_name: str = "",
+                 phase: str = "Pending",
+                 owner_kind: str = "",
+                 scheduling_group: str = ""):
+        self.metadata = ObjectMeta(name=name, namespace=namespace,
+                                   labels=dict(labels or {}))
+        self.requests = requests if requests is not None else Resources()
+        self.node_selector = dict(node_selector or {})
+        self.required_affinity_terms = list(required_affinity_terms)
+        self.tolerations = list(tolerations)
+        self.topology_spread = list(topology_spread)
+        self.pod_affinity = list(pod_affinity)
+        self.node_name = node_name
+        self.phase = phase
+        self.owner_kind = owner_kind
+        self.scheduling_group = scheduling_group  # identity for spread/affinity
+
+    def scheduling_requirements(self) -> Requirements:
+        """nodeSelector ∧ required nodeAffinity terms -> Requirements.
+        Memoized — pods are not mutated while a solve is in flight."""
+        cached = getattr(self, "_reqs_cache", None)
+        if cached is None:
+            cached = Requirements.from_labels(self.node_selector)
+            if self.required_affinity_terms:
+                cached = cached.union(
+                    Requirements.from_terms(self.required_affinity_terms))
+            self._reqs_cache = cached
+        return cached
+
+    def full_name(self) -> str:
+        """namespace/name — the identity used in solver decisions (pod names
+        alone collide across namespaces)."""
+        return f"{self.metadata.namespace}/{self.metadata.name}"
+
+    def effective_requests(self) -> Resources:
+        """requests + the implicit 1-pod slot."""
+        if self.requests["pods"] == 0:
+            return self.requests + Resources({"pods": 1})
+        return self.requests
+
+    def is_pending_unscheduled(self) -> bool:
+        return self.phase == "Pending" and not self.node_name \
+            and self.metadata.deletion_timestamp is None
+
+
+# ---------------------------------------------------------------------------
+# NodePool
+# ---------------------------------------------------------------------------
+
+@dataclass
+class DisruptionBudget:
+    nodes: str = "10%"           # count or percentage
+    reasons: Optional[List[str]] = None  # None => all reasons
+    schedule: Optional[str] = None       # cron, unsupported-for-now -> always
+    duration: Optional[float] = None
+
+    def allows(self, reason: str) -> bool:
+        return self.reasons is None or reason in self.reasons
+
+    def max_disruptions(self, total_nodes: int) -> int:
+        s = self.nodes.strip()
+        if s.endswith("%"):
+            pct = int(s[:-1])
+            return (total_nodes * pct) // 100
+        return int(s)
+
+
+@dataclass
+class Disruption:
+    consolidation_policy: str = "WhenEmptyOrUnderutilized"  # | WhenEmpty
+    consolidate_after: float = 0.0   # seconds; 0 => immediately
+    budgets: List[DisruptionBudget] = field(default_factory=lambda: [DisruptionBudget()])
+
+
+@dataclass
+class NodeClassRef:
+    name: str
+    kind: str = "EC2NodeClass"
+    group: str = "karpenter.k8s.aws"
+
+
+@dataclass
+class NodePoolTemplate:
+    node_class_ref: NodeClassRef
+    requirements: Requirements = field(default_factory=Requirements)
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    taints: List[Taint] = field(default_factory=list)
+    startup_taints: List[Taint] = field(default_factory=list)
+    expire_after: Optional[float] = None  # seconds
+    termination_grace_period: Optional[float] = None
+
+
+class NodePool(KubeObject):
+    kind = "NodePool"
+
+    def __init__(self, name: str,
+                 template: NodePoolTemplate,
+                 disruption: Optional[Disruption] = None,
+                 limits: Optional[Resources] = None,
+                 weight: int = 0,
+                 labels: Optional[Dict[str, str]] = None):
+        self.metadata = ObjectMeta(name=name, labels=dict(labels or {}))
+        self.template = template
+        self.disruption = disruption or Disruption()
+        self.limits = limits  # None => unlimited
+        self.weight = weight
+        self.status_resources = Resources()  # aggregated in-use resources
+
+    def scheduling_requirements(self) -> Requirements:
+        """Template requirements ∧ template labels ∧ the nodepool label."""
+        reqs = self.template.requirements
+        reqs = reqs.union(Requirements.from_labels(self.template.labels))
+        return reqs.add(Requirement.new(L.NODEPOOL, IN, [self.name]))
+
+    def hash(self) -> str:
+        return stable_hash({
+            "labels": self.template.labels,
+            "annotations": self.template.annotations,
+            "taints": [(t.key, t.effect, t.value) for t in self.template.taints],
+            "startupTaints": [(t.key, t.effect, t.value) for t in self.template.startup_taints],
+            "expireAfter": self.template.expire_after,
+        })
+
+
+# ---------------------------------------------------------------------------
+# NodeClaim
+# ---------------------------------------------------------------------------
+
+class NodeClaim(KubeObject):
+    kind = "NodeClaim"
+
+    def __init__(self, name: str,
+                 requirements: Requirements,
+                 node_class_ref: NodeClassRef,
+                 resources_requested: Resources = Resources(),
+                 taints: Sequence[Taint] = (),
+                 startup_taints: Sequence[Taint] = (),
+                 labels: Optional[Dict[str, str]] = None,
+                 annotations: Optional[Dict[str, str]] = None,
+                 expire_after: Optional[float] = None):
+        self.metadata = ObjectMeta(name=name, labels=dict(labels or {}),
+                                   annotations=dict(annotations or {}))
+        self.requirements = requirements
+        self.node_class_ref = node_class_ref
+        self.resources_requested = resources_requested
+        self.taints = list(taints)
+        self.startup_taints = list(startup_taints)
+        self.expire_after = expire_after
+        # status
+        self.provider_id: str = ""
+        self.image_id: str = ""
+        self.capacity: Resources = Resources()
+        self.allocatable: Resources = Resources()
+        self.node_name: str = ""
+        self.conditions: Dict[str, Condition] = {}
+        self.last_pod_event: float = 0.0
+
+    @property
+    def nodepool(self) -> Optional[str]:
+        return self.metadata.labels.get(L.NODEPOOL)
+
+    def set_condition(self, ctype: str, status: str, reason: str = "",
+                      message: str = "", now: float = 0.0) -> None:
+        self.conditions[ctype] = Condition(ctype, status, reason, message, now)
+
+    def condition_is(self, ctype: str, status: str = "True") -> bool:
+        c = self.conditions.get(ctype)
+        return c is not None and c.status == status
+
+    @property
+    def launched(self) -> bool:
+        return self.condition_is("Launched")
+
+    @property
+    def registered(self) -> bool:
+        return self.condition_is("Registered")
+
+    @property
+    def initialized(self) -> bool:
+        return self.condition_is("Initialized")
+
+
+# ---------------------------------------------------------------------------
+# Node
+# ---------------------------------------------------------------------------
+
+class Node(KubeObject):
+    kind = "Node"
+
+    def __init__(self, name: str,
+                 labels: Optional[Dict[str, str]] = None,
+                 capacity: Resources = Resources(),
+                 allocatable: Resources = Resources(),
+                 taints: Sequence[Taint] = (),
+                 provider_id: str = ""):
+        self.metadata = ObjectMeta(name=name, labels=dict(labels or {}))
+        self.capacity = capacity
+        self.allocatable = allocatable
+        self.taints = list(taints)
+        self.provider_id = provider_id
+        self.ready = False
+        self.conditions: Dict[str, Condition] = {}
+
+
+# ---------------------------------------------------------------------------
+# EC2NodeClass (infra template)
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class SelectorTerm:
+    """Subnet/SG/AMI selector term: tags and/or id/name match
+    (ec2nodeclass.go:141,156,174)."""
+    tags: Tuple[Tuple[str, str], ...] = ()
+    id: str = ""
+    name: str = ""
+    alias: str = ""   # AMI only: e.g. "al2023@latest" (ec2nodeclass.go:494-548)
+    owner: str = ""
+
+    @staticmethod
+    def of(tags: Optional[Mapping[str, str]] = None, **kw) -> "SelectorTerm":
+        return SelectorTerm(tags=tuple(sorted((tags or {}).items())), **kw)
+
+
+@dataclass
+class MetadataOptions:
+    http_endpoint: str = "enabled"
+    http_protocol_ipv6: str = "disabled"
+    http_put_response_hop_limit: int = 1
+    http_tokens: str = "required"  # IMDSv2
+
+
+@dataclass
+class BlockDeviceMapping:
+    device_name: str = "/dev/xvda"
+    volume_size: str = "20Gi"
+    volume_type: str = "gp3"
+    iops: Optional[int] = None
+    throughput: Optional[int] = None
+    encrypted: bool = True
+    delete_on_termination: bool = True
+    root_volume: bool = False
+
+
+@dataclass
+class KubeletConfiguration:
+    """kubelet config subset (ec2nodeclass.go:212)."""
+    max_pods: Optional[int] = None
+    pods_per_core: Optional[int] = None
+    kube_reserved: Dict[str, str] = field(default_factory=dict)
+    system_reserved: Dict[str, str] = field(default_factory=dict)
+    eviction_hard: Dict[str, str] = field(default_factory=dict)
+    eviction_soft: Dict[str, str] = field(default_factory=dict)
+    cluster_dns: List[str] = field(default_factory=list)
+    image_gc_high_threshold_percent: Optional[int] = None
+    image_gc_low_threshold_percent: Optional[int] = None
+    cpu_cfs_quota: Optional[bool] = None
+
+
+class EC2NodeClass(KubeObject):
+    kind = "EC2NodeClass"
+
+    def __init__(self, name: str,
+                 ami_selector_terms: Sequence[SelectorTerm] = (SelectorTerm(alias="al2023@latest"),),
+                 subnet_selector_terms: Sequence[SelectorTerm] = (),
+                 security_group_selector_terms: Sequence[SelectorTerm] = (),
+                 role: str = "KarpenterNodeRole",
+                 instance_profile: str = "",
+                 user_data: str = "",
+                 tags: Optional[Dict[str, str]] = None,
+                 block_device_mappings: Sequence[BlockDeviceMapping] = (),
+                 instance_store_policy: str = "",   # "" | "RAID0"
+                 metadata_options: Optional[MetadataOptions] = None,
+                 kubelet: Optional[KubeletConfiguration] = None,
+                 detailed_monitoring: bool = False,
+                 associate_public_ip: Optional[bool] = None):
+        self.metadata = ObjectMeta(name=name)
+        self.ami_selector_terms = list(ami_selector_terms)
+        self.subnet_selector_terms = list(subnet_selector_terms)
+        self.security_group_selector_terms = list(security_group_selector_terms)
+        self.role = role
+        self.instance_profile = instance_profile
+        self.user_data = user_data
+        self.tags = dict(tags or {})
+        self.block_device_mappings = list(block_device_mappings)
+        self.instance_store_policy = instance_store_policy
+        self.metadata_options = metadata_options or MetadataOptions()
+        self.kubelet = kubelet or KubeletConfiguration()
+        self.detailed_monitoring = detailed_monitoring
+        self.associate_public_ip = associate_public_ip
+        # status (nodeclass controller fills these; ec2nodeclass_status.go:22-70)
+        self.status_subnets: List[Dict[str, str]] = []       # {id, zone, zoneID}
+        self.status_security_groups: List[Dict[str, str]] = []
+        self.status_amis: List[Dict[str, Any]] = []          # {id, name, requirements}
+        self.status_instance_profile: str = ""
+        self.conditions: Dict[str, Condition] = {}
+
+    @property
+    def ami_family(self) -> str:
+        """Resolve the AMI family from alias terms (ec2nodeclass.go:494-548)."""
+        for t in self.ami_selector_terms:
+            if t.alias:
+                return t.alias.split("@", 1)[0]
+        return "custom"
+
+    @property
+    def ami_version(self) -> str:
+        for t in self.ami_selector_terms:
+            if t.alias and "@" in t.alias:
+                return t.alias.split("@", 1)[1]
+        return "latest"
+
+    def set_condition(self, ctype: str, status: str, reason: str = "",
+                      message: str = "", now: float = 0.0) -> None:
+        self.conditions[ctype] = Condition(ctype, status, reason, message, now)
+
+    def condition_is(self, ctype: str, status: str = "True") -> bool:
+        c = self.conditions.get(ctype)
+        return c is not None and c.status == status
+
+    @property
+    def ready(self) -> bool:
+        return self.condition_is("Ready")
+
+    def hash(self) -> str:
+        """Static-field hash for drift detection (ec2nodeclass.go:446-460,
+        hash version v4)."""
+        return stable_hash({
+            "role": self.role,
+            "instanceProfile": self.instance_profile,
+            "userData": self.user_data,
+            "tags": self.tags,
+            "blockDeviceMappings": [vars(b) for b in self.block_device_mappings],
+            "instanceStorePolicy": self.instance_store_policy,
+            "metadataOptions": vars(self.metadata_options),
+            "detailedMonitoring": self.detailed_monitoring,
+            "associatePublicIP": self.associate_public_ip,
+        })
+
+
+def stable_hash(obj: Any) -> str:
+    """Deterministic structure hash (stands in for hashstructure v2 ZeroNil)."""
+    def _canon(o: Any) -> Any:
+        if isinstance(o, Mapping):
+            return {str(k): _canon(v) for k, v in sorted(o.items()) if v not in (None, {}, [], "")}
+        if isinstance(o, (list, tuple)):
+            return [_canon(v) for v in o]
+        return o
+    blob = json.dumps(_canon(obj), sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]

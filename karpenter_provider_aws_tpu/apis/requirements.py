@@ -1,0 +1,379 @@
+"""The requirements (label-set) algebra.
+
+This is the constraint language the whole scheduler runs on: every NodePool
+template requirement, pod nodeSelector / nodeAffinity term, and instance-type
+label set compiles into :class:`Requirements`, and scheduling feasibility is
+``Requirements.intersects`` / ``compatible``.
+
+Semantics mirror the core library's ``scheduling.Requirements`` exactly as the
+reference consumes it (pkg/providers/instancetype/types.go:183-287 constructs
+~40 per-type requirements; pkg/cloudprovider/cloudprovider.go:329 checks
+``reqs.Compatible(other, AllowUndefinedWellKnownLabels)``;
+pkg/providers/instance/instance.go:101 uses
+``NewNodeSelectorRequirementsWithMinValues``):
+
+- A :class:`Requirement` is a (possibly complemented) value set with optional
+  integer bounds: ``In`` {a,b}, ``NotIn`` ~{a,b}, ``Exists`` ~{},
+  ``DoesNotExist`` {}, ``Gt n`` ~{} with lower bound, ``Lt n`` ~{} with upper
+  bound; plus ``minValues`` (the NodePool flexibility floor, CRD rule at
+  pkg/apis/crds/karpenter.sh_nodepools.yaml:284,327-328).
+- Intersection is exact set algebra over the four complement combinations,
+  with bounds tightened to the max lower / min upper and, for concrete sets,
+  values filtered against bounds.
+- ``compatible(incoming, allow_undefined)``: every incoming requirement must
+  intersect ours; keys we leave undefined pass only if well-known
+  (``allow_undefined``) or the incoming operator is satisfied by label
+  absence (NotIn / DoesNotExist — k8s nodeAffinity semantics).
+
+The TPU encoding in ``models/encoding.py`` lowers this algebra to bitmask
+tensors; this module is the semantic source of truth it is tested against.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, Iterable, Iterator, List, Mapping, Optional, Sequence, Tuple
+
+from . import labels as L
+
+# Operators (k8s NodeSelectorOperator)
+IN = "In"
+NOT_IN = "NotIn"
+EXISTS = "Exists"
+DOES_NOT_EXIST = "DoesNotExist"
+GT = "Gt"
+LT = "Lt"
+
+_OPERATORS = (IN, NOT_IN, EXISTS, DOES_NOT_EXIST, GT, LT)
+
+
+def _as_int(value: str) -> Optional[int]:
+    try:
+        return int(value)
+    except (TypeError, ValueError):
+        return None
+
+
+@dataclass(frozen=True)
+class Requirement:
+    """One key's constraint: a (complemented) value set plus integer bounds.
+
+    ``complement=False`` means "value must be in ``values``";
+    ``complement=True`` means "value must NOT be in ``values``" (and must
+    satisfy the bounds, which only numeric strings can).
+    """
+
+    key: str
+    complement: bool = False
+    values: FrozenSet[str] = frozenset()
+    greater_than: Optional[int] = None  # exclusive lower bound
+    less_than: Optional[int] = None     # exclusive upper bound
+    min_values: Optional[int] = None
+
+    # -- constructors ------------------------------------------------------
+    @staticmethod
+    def new(key: str, operator: str, values: Sequence[str] = (),
+            min_values: Optional[int] = None) -> "Requirement":
+        values = tuple(str(v) for v in values)
+        if operator == IN:
+            return Requirement(key, False, frozenset(values), None, None, min_values)
+        if operator == NOT_IN:
+            return Requirement(key, True, frozenset(values), None, None, min_values)
+        if operator == EXISTS:
+            return Requirement(key, True, frozenset(), None, None, min_values)
+        if operator == DOES_NOT_EXIST:
+            return Requirement(key, False, frozenset(), None, None, min_values)
+        if operator == GT:
+            if len(values) != 1 or _as_int(values[0]) is None:
+                raise ValueError(f"Gt requires one integer value, got {values!r}")
+            return Requirement(key, True, frozenset(), _as_int(values[0]), None, min_values)
+        if operator == LT:
+            if len(values) != 1 or _as_int(values[0]) is None:
+                raise ValueError(f"Lt requires one integer value, got {values!r}")
+            return Requirement(key, True, frozenset(), None, _as_int(values[0]), min_values)
+        raise ValueError(f"unknown operator {operator!r}; expected one of {_OPERATORS}")
+
+    @property
+    def operator(self) -> str:
+        """Best-effort canonical operator for serialization."""
+        if self.greater_than is not None and self.less_than is None and not self.values:
+            return GT
+        if self.less_than is not None and self.greater_than is None and not self.values:
+            return LT
+        if self.complement:
+            return EXISTS if not self.values and self._unbounded else NOT_IN
+        return IN if self.values else DOES_NOT_EXIST
+
+    @property
+    def _unbounded(self) -> bool:
+        return self.greater_than is None and self.less_than is None
+
+    # -- membership --------------------------------------------------------
+    def _in_bounds(self, value: str) -> bool:
+        if self._unbounded:
+            return True
+        n = _as_int(value)
+        if n is None:
+            return False
+        if self.greater_than is not None and n <= self.greater_than:
+            return False
+        if self.less_than is not None and n >= self.less_than:
+            return False
+        return True
+
+    def has(self, value: str) -> bool:
+        value = str(value)
+        if self.complement:
+            return value not in self.values and self._in_bounds(value)
+        return value in self.values and self._in_bounds(value)
+
+    def satisfied_by_absence(self) -> bool:
+        """Does a node *without* this label satisfy the requirement?
+
+        k8s nodeAffinity: NotIn and DoesNotExist match absent labels;
+        In/Exists/Gt/Lt require the label present.
+        """
+        if self.complement:
+            return self._unbounded and bool(self.values)  # NotIn
+        return not self.values  # DoesNotExist
+
+    # -- set algebra -------------------------------------------------------
+    def intersection(self, other: "Requirement") -> "Requirement":
+        assert self.key == other.key, (self.key, other.key)
+        gt = self.greater_than
+        if other.greater_than is not None:
+            gt = other.greater_than if gt is None else max(gt, other.greater_than)
+        lt = self.less_than
+        if other.less_than is not None:
+            lt = other.less_than if lt is None else min(lt, other.less_than)
+        if self.complement and other.complement:
+            comp, vals = True, self.values | other.values
+        elif self.complement:
+            comp, vals = False, other.values - self.values
+        elif other.complement:
+            comp, vals = False, self.values - other.values
+        else:
+            comp, vals = False, self.values & other.values
+        mv = self.min_values
+        if other.min_values is not None:
+            mv = other.min_values if mv is None else max(mv, other.min_values)
+        r = Requirement(self.key, comp, frozenset(vals), gt, lt, mv)
+        if not comp and not r._unbounded:
+            r = Requirement(self.key, False,
+                            frozenset(v for v in vals if r._in_bounds(v)),
+                            gt, lt, mv)
+        return r
+
+    def is_empty(self) -> bool:
+        """True iff no value can satisfy this requirement."""
+        if not self.complement:
+            return not self.values
+        # Complement set: infinitely many strings unless both bounds close
+        # the numeric range (bounded complements only admit numeric values).
+        if self.greater_than is not None and self.less_than is not None:
+            lo, hi = self.greater_than + 1, self.less_than - 1
+            if lo > hi:
+                return True
+            count = hi - lo + 1
+            excluded = sum(1 for v in self.values
+                           if (n := _as_int(v)) is not None and lo <= n <= hi)
+            return excluded >= count
+        return False
+
+    def intersects(self, other: "Requirement") -> bool:
+        return not self.intersection(other).is_empty()
+
+    def any_value(self) -> Optional[str]:
+        """A deterministic representative value, if one is nameable."""
+        if not self.complement:
+            for v in sorted(self.values):
+                if self._in_bounds(v):
+                    return v
+            return None
+        if self.greater_than is not None or self.less_than is not None:
+            lo = (self.greater_than + 1) if self.greater_than is not None else 0
+            hi = (self.less_than - 1) if self.less_than is not None else lo + len(self.values) + 1
+            for n in range(lo, hi + 1):
+                if str(n) not in self.values:
+                    return str(n)
+            return None
+        return None  # unbounded complement: no canonical representative
+
+    def with_min_values(self, min_values: Optional[int]) -> "Requirement":
+        return Requirement(self.key, self.complement, self.values,
+                           self.greater_than, self.less_than, min_values)
+
+    def __len__(self) -> int:
+        if self.complement:
+            return 1 << 30  # "infinite"
+        return sum(1 for v in self.values if self._in_bounds(v))
+
+    def __repr__(self) -> str:
+        op = self.operator
+        if op in (GT, LT):
+            bound = self.greater_than if op == GT else self.less_than
+            return f"{self.key} {op} {bound}"
+        if op in (EXISTS, DOES_NOT_EXIST):
+            return f"{self.key} {op}"
+        return f"{self.key} {op} {sorted(self.values)}"
+
+
+class Requirements:
+    """An immutable conjunction of per-key requirements.
+
+    Constructing from multiple requirements on one key intersects them
+    (mirrors core ``NewRequirements``).
+    """
+
+    __slots__ = ("_by_key",)
+
+    def __init__(self, reqs: Iterable[Requirement] = ()):
+        by_key: Dict[str, Requirement] = {}
+        for r in reqs:
+            cur = by_key.get(r.key)
+            by_key[r.key] = r if cur is None else cur.intersection(r)
+        self._by_key = by_key
+
+    # -- constructors ------------------------------------------------------
+    @classmethod
+    def from_labels(cls, lbls: Mapping[str, str]) -> "Requirements":
+        return cls(Requirement.new(k, IN, [v]) for k, v in lbls.items())
+
+    @classmethod
+    def from_node_selector(cls, selector: Mapping[str, str]) -> "Requirements":
+        return cls.from_labels(selector)
+
+    @classmethod
+    def from_terms(cls, terms: Sequence[Mapping[str, object]]) -> "Requirements":
+        """Parse k8s-shaped ``[{key, operator, values, minValues?}, ...]``."""
+        return cls(
+            Requirement.new(
+                str(t["key"]), str(t.get("operator", IN)),
+                [str(v) for v in t.get("values", []) or []],
+                t.get("minValues"))  # type: ignore[arg-type]
+            for t in terms)
+
+    # -- accessors ---------------------------------------------------------
+    def get(self, key: str) -> Optional[Requirement]:
+        return self._by_key.get(key)
+
+    def __getitem__(self, key: str) -> Requirement:
+        return self._by_key[key]
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._by_key
+
+    def keys(self) -> Tuple[str, ...]:
+        return tuple(sorted(self._by_key))
+
+    def __iter__(self) -> Iterator[Requirement]:
+        for k in sorted(self._by_key):
+            yield self._by_key[k]
+
+    def __len__(self) -> int:
+        return len(self._by_key)
+
+    def __eq__(self, other: object) -> bool:
+        return isinstance(other, Requirements) and self._by_key == other._by_key
+
+    def __hash__(self) -> int:
+        return hash(tuple(sorted(self._by_key.items(), key=lambda kv: kv[0])))
+
+    def __repr__(self) -> str:
+        return "Requirements(" + ", ".join(repr(r) for r in self) + ")"
+
+    # -- algebra -----------------------------------------------------------
+    def add(self, *reqs: Requirement) -> "Requirements":
+        return Requirements(list(self._by_key.values()) + list(reqs))
+
+    def union(self, other: "Requirements") -> "Requirements":
+        """Conjunction (core ``Add``): same-key requirements intersect."""
+        return Requirements(list(self._by_key.values()) + list(other._by_key.values()))
+
+    def conflicts(self, other: "Requirements") -> List[str]:
+        """Keys defined on BOTH sides whose intersection is empty.
+
+        Empty list => the two requirement sets can coexist on one node.
+        (Named ``conflicts`` deliberately: truthy means they canNOT coexist,
+        the opposite polarity of ``Requirement.intersects``.)
+        """
+        conflicts = []
+        for key, mine in self._by_key.items():
+            theirs = other._by_key.get(key)
+            if theirs is not None and not mine.intersects(theirs):
+                conflicts.append(key)
+        return sorted(conflicts)
+
+    def compatible(self, incoming: "Requirements",
+                   allow_undefined: FrozenSet[str] = L.WELL_KNOWN_LABELS,
+                   ) -> List[str]:
+        """Can a node shaped by *self* satisfy *incoming* (pod) requirements?
+
+        Returns the list of offending keys (empty => compatible). Mirrors
+        core ``Requirements.Compatible(other, AllowUndefinedWellKnownLabels)``
+        as consumed at pkg/cloudprovider/cloudprovider.go:329.
+        """
+        offending = []
+        for key, req in incoming._by_key.items():
+            mine = self._by_key.get(key)
+            if mine is not None:
+                if not mine.intersects(req):
+                    offending.append(key)
+            else:
+                if key not in allow_undefined and not req.satisfied_by_absence():
+                    offending.append(key)
+        return sorted(offending)
+
+    def is_compatible(self, incoming: "Requirements",
+                      allow_undefined: FrozenSet[str] = L.WELL_KNOWN_LABELS,
+                      ) -> bool:
+        return not self.compatible(incoming, allow_undefined)
+
+    def satisfied_by_labels(self, lbls: Mapping[str, str]) -> bool:
+        """Do concrete node labels satisfy every requirement?"""
+        for key, req in self._by_key.items():
+            if key in lbls:
+                if not req.has(lbls[key]):
+                    return False
+            elif not req.satisfied_by_absence():
+                return False
+        return True
+
+    def single_values(self) -> Dict[str, str]:
+        """Keys constrained to exactly one value -> that value.
+
+        Used to back-fill NodeClaim labels from the chosen instance type
+        (cloudprovider.go:381-400).
+        """
+        out = {}
+        for key, req in self._by_key.items():
+            if not req.complement and len(req) == 1:
+                out[key] = next(v for v in sorted(req.values) if req._in_bounds(v))
+        return out
+
+    def min_values_violations(self, key_cardinality: Mapping[str, int]) -> List[str]:
+        """Keys whose minValues floor exceeds the available cardinality."""
+        out = []
+        for key, req in self._by_key.items():
+            if req.min_values is not None:
+                if key_cardinality.get(key, 0) < req.min_values:
+                    out.append(key)
+        return sorted(out)
+
+    def to_terms(self) -> List[Dict[str, object]]:
+        out: List[Dict[str, object]] = []
+        for req in self:
+            term: Dict[str, object] = {"key": req.key, "operator": req.operator}
+            if req.operator in (IN, NOT_IN):
+                term["values"] = sorted(req.values)
+            elif req.operator == GT:
+                term["values"] = [str(req.greater_than)]
+            elif req.operator == LT:
+                term["values"] = [str(req.less_than)]
+            if req.min_values is not None:
+                term["minValues"] = req.min_values
+            out.append(term)
+        return out
+
+
+EMPTY = Requirements()

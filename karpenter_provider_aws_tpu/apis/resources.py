@@ -1,0 +1,227 @@
+"""Resource quantities and resource-list arithmetic.
+
+Everything is fixed-point integers from the moment of parsing:
+
+- ``cpu``               millicores (1 core == 1000)
+- ``memory``            bytes
+- ``ephemeral-storage`` bytes
+- everything else       integer counts (pods, nvidia.com/gpu,
+                        aws.amazon.com/neuron, vpc.amazonaws.com/pod-eni, ...)
+
+Integer fixed-point is a hard design requirement, not a convenience: the TPU
+solver must make decisions bit-identical to the CPU oracle, so no float enters
+any quantity or score anywhere in the scheduling path.
+
+Reference parity: resource handling in the reference flows through
+k8s resource.Quantity; capacity/overhead construction at
+pkg/providers/instancetype/types.go:307-478 (Capacity) and :480-565
+(kubeReserved/systemReserved/evictionThreshold), Allocatable() consumed at
+pkg/cloudprovider/cloudprovider.go:331.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, Iterable, Mapping, Optional, Tuple
+
+# Canonical resource names (subset of well-known + AWS extended resources,
+# reference: pkg/apis/v1/labels.go:91-98).
+CPU = "cpu"
+MEMORY = "memory"
+EPHEMERAL_STORAGE = "ephemeral-storage"
+PODS = "pods"
+NVIDIA_GPU = "nvidia.com/gpu"
+AMD_GPU = "amd.com/gpu"
+AWS_NEURON = "aws.amazon.com/neuron"
+AWS_NEURON_CORE = "aws.amazon.com/neuroncore"
+HABANA_GAUDI = "habana.ai/gaudi"
+AWS_POD_ENI = "vpc.amazonaws.com/pod-eni"
+AWS_PRIVATE_IPV4 = "vpc.amazonaws.com/PrivateIPv4Address"
+AWS_EFA = "vpc.amazonaws.com/efa"
+
+# Resources measured in millicores vs bytes vs counts.
+_MILLI_RESOURCES = frozenset({CPU})
+_BYTE_RESOURCES = frozenset({MEMORY, EPHEMERAL_STORAGE})
+
+_QUANTITY_RE = re.compile(
+    r"^(?P<sign>[+-]?)(?P<num>\d+(?:\.\d+)?)(?P<suffix>m|k|M|G|T|P|E|Ki|Mi|Gi|Ti|Pi|Ei)?$"
+)
+
+_BINARY = {"Ki": 1024, "Mi": 1024**2, "Gi": 1024**3, "Ti": 1024**4,
+           "Pi": 1024**5, "Ei": 1024**6}
+_DECIMAL = {"k": 10**3, "M": 10**6, "G": 10**9, "T": 10**12,
+            "P": 10**15, "E": 10**18}
+
+
+def parse_quantity(value: object, resource: str = MEMORY) -> int:
+    """Parse a k8s-style quantity into this module's fixed-point integer.
+
+    ``parse_quantity("1", "cpu") == 1000`` (millicores);
+    ``parse_quantity("1Gi", "memory") == 1073741824`` (bytes);
+    ``parse_quantity("2", "pods") == 2``.
+    """
+    if isinstance(value, bool):  # bool is an int subclass; reject explicitly
+        raise ValueError(f"invalid quantity {value!r}")
+    if isinstance(value, int):
+        return value * 1000 if resource in _MILLI_RESOURCES else value
+    if isinstance(value, float):
+        base = value * 1000 if resource in _MILLI_RESOURCES else value
+        return int(round(base))
+    s = str(value).strip()
+    m = _QUANTITY_RE.match(s)
+    if not m:
+        raise ValueError(f"invalid quantity {value!r}")
+    # Exact integer arithmetic throughout — float's 53-bit mantissa would
+    # silently corrupt large byte counts, violating the fixed-point invariant.
+    num_str = m.group("num")
+    if "." in num_str:
+        int_part, frac_part = num_str.split(".", 1)
+    else:
+        int_part, frac_part = num_str, ""
+    whole = int(int_part or "0")
+    frac = int(frac_part or "0")
+    frac_scale = 10 ** len(frac_part)
+    sign = -1 if m.group("sign") == "-" else 1
+    suffix = m.group("suffix")
+    if suffix == "m":
+        # "m" means milli. For cpu this is already our unit; for bytes it is
+        # a fractional byte which we round.
+        if resource in _MILLI_RESOURCES:
+            return sign * (whole + _round_div(frac, frac_scale))
+        return sign * _round_div(whole * frac_scale + frac, 1000 * frac_scale)
+    mult = 1
+    if suffix:
+        mult = _BINARY.get(suffix) or _DECIMAL[suffix]
+    if resource in _MILLI_RESOURCES:
+        mult *= 1000
+    return sign * _round_div((whole * frac_scale + frac) * mult, frac_scale)
+
+
+def _round_div(num: int, den: int) -> int:
+    """Round-half-up integer division (matches round() for our quantities)."""
+    return (num * 2 + den) // (den * 2)
+
+
+def format_quantity(amount: int, resource: str) -> str:
+    if resource in _MILLI_RESOURCES:
+        if amount % 1000 == 0:
+            return str(amount // 1000)
+        return f"{amount}m"
+    if resource in _BYTE_RESOURCES:
+        for suffix in ("Ei", "Pi", "Ti", "Gi", "Mi", "Ki"):
+            unit = _BINARY[suffix]
+            if amount % unit == 0 and amount != 0:
+                return f"{amount // unit}{suffix}"
+        return str(amount)
+    return str(amount)
+
+
+class Resources(Mapping[str, int]):
+    """An immutable resource list with integer quantities.
+
+    Supports +, -, comparison via :meth:`fits`, and max-merge. Missing keys
+    read as 0. Zero-valued entries are dropped on construction so equality
+    and iteration are canonical.
+    """
+
+    __slots__ = ("_q",)
+
+    def __init__(self, quantities: Optional[Mapping[str, int]] = None, **kw: int):
+        q: Dict[str, int] = {}
+        for src in (quantities or {}), kw:
+            for k, v in src.items():
+                if not isinstance(v, int):
+                    raise TypeError(
+                        f"Resources values must be int (got {k}={v!r}); "
+                        "use Resources.parse for quantity strings")
+                if v != 0:
+                    q[k] = q.get(k, 0) + v
+                    if q[k] == 0:
+                        del q[k]
+        self._q = q
+
+    @classmethod
+    def parse(cls, spec: Mapping[str, object]) -> "Resources":
+        """Parse a {resource: quantity-string} mapping, e.g.
+        ``{"cpu": "100m", "memory": "1Gi", "pods": 1}``. Negative
+        quantities are rejected — a negative request/capacity would
+        silently corrupt packing arithmetic."""
+        out = {}
+        for k, v in spec.items():
+            q = parse_quantity(v, k)
+            if q < 0:
+                raise ValueError(f"negative quantity {v!r} for {k}")
+            out[k] = q
+        return cls(out)
+
+    # Mapping protocol -----------------------------------------------------
+    def __getitem__(self, key: str) -> int:
+        return self._q.get(key, 0)
+
+    def __iter__(self):
+        return iter(self._q)
+
+    def __len__(self) -> int:
+        return len(self._q)
+
+    def __contains__(self, key: object) -> bool:
+        return key in self._q
+
+    # Arithmetic -----------------------------------------------------------
+    def __add__(self, other: "Resources") -> "Resources":
+        q = dict(self._q)
+        for k, v in other.items():
+            q[k] = q.get(k, 0) + v
+        return Resources(q)
+
+    def __sub__(self, other: "Resources") -> "Resources":
+        q = dict(self._q)
+        for k, v in other.items():
+            q[k] = q.get(k, 0) - v
+        return Resources(q)
+
+    def clamp_nonnegative(self) -> "Resources":
+        return Resources({k: v for k, v in self._q.items() if v > 0})
+
+    def fits(self, capacity: "Resources") -> bool:
+        """True iff every requested quantity is <= the capacity's quantity."""
+        return all(v <= capacity[k] for k, v in self._q.items())
+
+    def exceeds_any(self, other: "Resources") -> bool:
+        return not self.fits(other)
+
+    def merge_max(self, other: "Resources") -> "Resources":
+        keys = set(self._q) | set(other._q)
+        return Resources({k: max(self[k], other[k]) for k in keys})
+
+    def nonzero_keys(self) -> Tuple[str, ...]:
+        return tuple(sorted(self._q))
+
+    # Identity -------------------------------------------------------------
+    def __eq__(self, other: object) -> bool:
+        if isinstance(other, Resources):
+            return self._q == other._q
+        if isinstance(other, Mapping):
+            return self._q == {k: v for k, v in other.items() if v != 0}
+        return NotImplemented
+
+    def __hash__(self) -> int:
+        return hash(tuple(sorted(self._q.items())))
+
+    def __repr__(self) -> str:
+        inner = ", ".join(
+            f"{k}={format_quantity(v, k)}" for k, v in sorted(self._q.items()))
+        return f"Resources({inner})"
+
+    def is_zero(self) -> bool:
+        return not self._q
+
+
+ZERO = Resources()
+
+
+def sum_resources(items: Iterable[Resources]) -> Resources:
+    total = Resources()
+    for r in items:
+        total = total + r
+    return total

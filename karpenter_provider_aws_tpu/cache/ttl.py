@@ -1,0 +1,114 @@
+"""TTL caches + the UnavailableOfferings ICE blacklist.
+
+TTL constants mirror pkg/cache/cache.go:19-55; UnavailableOfferings mirrors
+pkg/cache/unavailableofferings.go:33-86 — keyed (capacityType:instanceType:
+zone), 3-minute TTL, with a seqnum so it participates in the instance-type
+provider's cache key (a blacklist change must invalidate resolved catalogs).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, Hashable, Optional, Tuple
+
+# cache.go:19-55
+DEFAULT_TTL = 60.0
+UNAVAILABLE_OFFERINGS_TTL = 3 * 60.0
+INSTANCE_TYPES_ZONES_TTL = 5 * 60.0
+INSTANCE_PROFILE_TTL = 15 * 60.0
+AVAILABLE_IPS_TTL = 5 * 60.0
+SSM_TTL = 24 * 3600.0
+DISCOVERED_CAPACITY_TTL = 60 * 24 * 3600.0
+
+
+class TTLCache:
+    """A thread-safe TTL cache with injectable clock (tests control time)."""
+
+    def __init__(self, ttl: float = DEFAULT_TTL,
+                 clock: Optional[Callable[[], float]] = None):
+        self.ttl = ttl
+        self._clock = clock or time.monotonic
+        self._mu = threading.RLock()
+        self._data: Dict[Hashable, Tuple[float, Any]] = {}
+
+    def get(self, key: Hashable) -> Optional[Any]:
+        with self._mu:
+            hit = self._data.get(key)
+            if hit is None:
+                return None
+            expiry, value = hit
+            if self._clock() >= expiry:
+                del self._data[key]
+                return None
+            return value
+
+    def put(self, key: Hashable, value: Any, ttl: Optional[float] = None) -> None:
+        with self._mu:
+            self._data[key] = (self._clock() + (ttl if ttl is not None else self.ttl), value)
+
+    def delete(self, key: Hashable) -> None:
+        with self._mu:
+            self._data.pop(key, None)
+
+    def clear(self) -> None:
+        with self._mu:
+            self._data.clear()
+
+    def keys(self):
+        with self._mu:
+            now = self._clock()
+            return [k for k, (exp, _) in self._data.items() if now < exp]
+
+    def flush_expired(self) -> int:
+        with self._mu:
+            now = self._clock()
+            dead = [k for k, (exp, _) in self._data.items() if now >= exp]
+            for k in dead:
+                del self._data[k]
+            return len(dead)
+
+    def __len__(self) -> int:
+        return len(self.keys())
+
+
+class UnavailableOfferings:
+    """ICE-aware offering blacklist (unavailableofferings.go:33-86).
+
+    The launcher marks (capacityType, instanceType, zone) pools here on
+    InsufficientInstanceCapacity; the instance-type provider consults it when
+    building offerings so the next Solve round avoids the pools; entries
+    expire after 3 minutes. ``seqnum`` bumps on every change for cache-key
+    participation.
+    """
+
+    def __init__(self, clock: Optional[Callable[[], float]] = None,
+                 ttl: float = UNAVAILABLE_OFFERINGS_TTL):
+        self._cache = TTLCache(ttl=ttl, clock=clock)
+        self._mu = threading.Lock()
+        self.seqnum = 0
+
+    @staticmethod
+    def _key(capacity_type: str, instance_type: str, zone: str) -> str:
+        return f"{capacity_type}:{instance_type}:{zone}"
+
+    def mark_unavailable(self, capacity_type: str, instance_type: str,
+                         zone: str, reason: str = "InsufficientInstanceCapacity") -> None:
+        with self._mu:
+            self._cache.put(self._key(capacity_type, instance_type, zone), reason)
+            self.seqnum += 1
+
+    def mark_available_after_expiry(self) -> None:
+        """Expiry is lazy (reads check the clock); bump seqnum when anything
+        lapsed so dependent caches rebuild."""
+        with self._mu:
+            if self._cache.flush_expired():
+                self.seqnum += 1
+
+    def is_unavailable(self, capacity_type: str, instance_type: str, zone: str) -> bool:
+        return self._cache.get(self._key(capacity_type, instance_type, zone)) is not None
+
+    def delete(self, capacity_type: str, instance_type: str, zone: str) -> None:
+        with self._mu:
+            self._cache.delete(self._key(capacity_type, instance_type, zone))
+            self.seqnum += 1

@@ -1,0 +1,12 @@
+from .types import (DEFAULT_REPAIR_POLICIES, MICRO, CloudProviderError,
+                    CreateError, InstanceType, InstanceTypes,
+                    InsufficientCapacityError, NodeClaimNotFoundError,
+                    NodeClassNotReadyError, Offering, Offerings, Overhead,
+                    RepairPolicy, usd)
+
+__all__ = [
+    "InstanceType", "InstanceTypes", "Offering", "Offerings", "Overhead",
+    "CloudProviderError", "InsufficientCapacityError", "NodeClassNotReadyError",
+    "CreateError", "NodeClaimNotFoundError", "RepairPolicy",
+    "DEFAULT_REPAIR_POLICIES", "MICRO", "usd",
+]

@@ -1,0 +1,225 @@
+"""Core cloud-provider data model: InstanceType, Offering, and the
+CloudProvider plugin interface.
+
+Mirrors the core library contract exactly as the reference consumes it
+(SURVEY §1/L5): ``cloudprovider.InstanceType{Name, Requirements, Offerings,
+Capacity, Overhead}`` constructed at pkg/providers/instancetype/types.go:159-180,
+``Allocatable()`` used at pkg/cloudprovider/cloudprovider.go:331,
+``Offerings.Compatible(reqs).Available()`` at cloudprovider.go:330,
+``InstanceTypes.Truncate(reqs, 60)`` at pkg/providers/instance/instance.go:106.
+
+All prices are fixed-point **micro-USD per hour** (int). No float touches
+the scheduling path (decision determinism, see apis/resources.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, FrozenSet, Iterable, List, Mapping, Optional, Sequence, Tuple
+
+from ..apis import labels as L
+from ..apis.requirements import IN, Requirement, Requirements
+from ..apis.resources import Resources
+
+MICRO = 1_000_000  # 1 USD in price units
+
+
+def usd(amount: float) -> int:
+    """Convert a float dollar amount to fixed-point micro-USD (catalog
+    construction only — never called in the scheduling path)."""
+    return int(round(amount * MICRO))
+
+
+@dataclass(frozen=True)
+class Offering:
+    """One purchasable (capacity-type, zone) combination of an instance type.
+
+    ``requirements`` carries capacity-type + zone + zone-id, exactly like
+    types.go:120-157 builds them.
+    """
+    capacity_type: str          # spot | on-demand | reserved
+    zone: str
+    zone_id: str
+    price: int                  # micro-USD/hour
+    available: bool = True
+
+    @property
+    def requirements(self) -> Requirements:
+        return Requirements([
+            Requirement.new(L.CAPACITY_TYPE, IN, [self.capacity_type]),
+            Requirement.new(L.ZONE, IN, [self.zone]),
+            Requirement.new(L.ZONE_ID, IN, [self.zone_id]),
+        ])
+
+    def compatible_with(self, reqs: Requirements) -> bool:
+        ct = reqs.get(L.CAPACITY_TYPE)
+        if ct is not None and not ct.has(self.capacity_type):
+            return False
+        z = reqs.get(L.ZONE)
+        if z is not None and not z.has(self.zone):
+            return False
+        zid = reqs.get(L.ZONE_ID)
+        if zid is not None and not zid.has(self.zone_id):
+            return False
+        return True
+
+
+class Offerings(List[Offering]):
+    def available(self) -> "Offerings":
+        return Offerings(o for o in self if o.available)
+
+    def compatible(self, reqs: Requirements) -> "Offerings":
+        return Offerings(o for o in self if o.compatible_with(reqs))
+
+    def cheapest(self) -> Optional[Offering]:
+        if not self:
+            return None
+        return min(self, key=lambda o: (o.price, o.capacity_type, o.zone))
+
+    def worst_price(self) -> Optional[int]:
+        if not self:
+            return None
+        return max(o.price for o in self)
+
+
+@dataclass
+class Overhead:
+    """Allocatable = Capacity - kube_reserved - system_reserved -
+    eviction_threshold (types.go:480-565)."""
+    kube_reserved: Resources = field(default_factory=Resources)
+    system_reserved: Resources = field(default_factory=Resources)
+    eviction_threshold: Resources = field(default_factory=Resources)
+
+    def total(self) -> Resources:
+        return self.kube_reserved + self.system_reserved + self.eviction_threshold
+
+
+@dataclass
+class InstanceType:
+    name: str
+    requirements: Requirements
+    capacity: Resources
+    overhead: Overhead = field(default_factory=Overhead)
+    offerings: Offerings = field(default_factory=Offerings)
+
+    def allocatable(self) -> Resources:
+        return (self.capacity - self.overhead.total()).clamp_nonnegative()
+
+    def cheapest_price(self, reqs: Optional[Requirements] = None) -> Optional[int]:
+        offs = self.offerings.available()
+        if reqs is not None:
+            offs = offs.compatible(reqs)
+        o = offs.cheapest()
+        return None if o is None else o.price
+
+    def __repr__(self) -> str:
+        return f"InstanceType({self.name})"
+
+
+class InstanceTypes(List[InstanceType]):
+    def compatible(self, reqs: Requirements) -> "InstanceTypes":
+        """Types whose requirements are compatible with ``reqs`` AND that
+        still have a compatible offering (cloudprovider.go:322-333)."""
+        out = InstanceTypes()
+        for it in self:
+            if it.requirements.conflicts(reqs):
+                continue
+            if not it.offerings.available().compatible(reqs):
+                continue
+            out.append(it)
+        return out
+
+    def order_by_price(self, reqs: Optional[Requirements] = None) -> "InstanceTypes":
+        def key(it: InstanceType) -> Tuple[int, str]:
+            p = it.cheapest_price(reqs)
+            return (p if p is not None else 1 << 62, it.name)
+        return InstanceTypes(sorted(self, key=key))
+
+    def truncate(self, reqs: Requirements, max_items: int = 60) -> "InstanceTypes":
+        """Cheapest-first truncation honoring minValues flexibility floors
+        (instance.go:55,106; core InstanceTypes.Truncate)."""
+        ordered = self.order_by_price(reqs)
+        truncated = InstanceTypes(ordered[:max_items])
+        violations = self._min_values_violations(truncated, reqs)
+        if not violations:
+            return truncated
+        # greedily extend with types that add a NEW value for a violated key
+        seen_values: Dict[str, set] = {}
+        for it in truncated:
+            for r in it.requirements:
+                if not r.complement:
+                    seen_values.setdefault(r.key, set()).update(r.values)
+        for it in ordered[max_items:]:
+            if not violations:
+                break
+            adds = False
+            for key in violations:
+                req = it.requirements.get(key)
+                if req is not None and not req.complement \
+                        and req.values - seen_values.get(key, set()):
+                    adds = True
+            if adds:
+                truncated.append(it)
+                for r in it.requirements:
+                    if not r.complement:
+                        seen_values.setdefault(r.key, set()).update(r.values)
+                violations = self._min_values_violations(truncated, reqs)
+        if violations:
+            raise ValueError(
+                f"minValues unsatisfiable for keys {violations} within "
+                f"{max_items}-type truncation")
+        return truncated
+
+    @staticmethod
+    def _min_values_violations(types: "InstanceTypes", reqs: Requirements) -> List[str]:
+        cardinality: Dict[str, set] = {}
+        for it in types:
+            for r in it.requirements:
+                if not r.complement:
+                    cardinality.setdefault(r.key, set()).update(r.values)
+        return reqs.min_values_violations(
+            {k: len(v) for k, v in cardinality.items()})
+
+
+# ---------------------------------------------------------------------------
+# Error taxonomy (cloudprovider.go:89-101, instance.go:129; drives retry)
+# ---------------------------------------------------------------------------
+
+class CloudProviderError(Exception):
+    pass
+
+
+class InsufficientCapacityError(CloudProviderError):
+    """ICE — no offering could be fulfilled (cloudprovider.go:89,101)."""
+
+
+class NodeClassNotReadyError(CloudProviderError):
+    """NodeClass status not Ready (cloudprovider.go:94)."""
+
+
+class CreateError(CloudProviderError):
+    """Launch failed for a non-capacity reason (cloudprovider.go:98)."""
+
+
+class NodeClaimNotFoundError(CloudProviderError):
+    """Instance backing the NodeClaim is gone (instance.go:129)."""
+
+
+@dataclass(frozen=True)
+class RepairPolicy:
+    """Node-condition -> toleration-duration auto-repair table entry
+    (cloudprovider.go:252-293)."""
+    condition_type: str
+    condition_status: str
+    toleration_duration: float  # seconds
+
+
+DEFAULT_REPAIR_POLICIES = (
+    RepairPolicy("Ready", "False", 30 * 60),
+    RepairPolicy("Ready", "Unknown", 30 * 60),
+    RepairPolicy("AcceleratedHardwareReady", "False", 10 * 60),
+    RepairPolicy("StorageReady", "False", 30 * 60),
+    RepairPolicy("NetworkingReady", "False", 30 * 60),
+    RepairPolicy("KernelReady", "False", 30 * 60),
+    RepairPolicy("ContainerRuntimeReady", "False", 30 * 60),
+)

@@ -1,0 +1,14 @@
+from .catalog import (DEFAULT_REGION, DEFAULT_ZONES, FAMILIES,
+                      InstanceTypeInfo, ZoneInfo, build_catalog,
+                      catalog_by_name, spot_price)
+from .ec2 import (FakeEC2, FakeImage, FakeInstance, FakeLaunchTemplate,
+                  FakeSecurityGroup, FakeSubnet)
+from .kube import Conflict, Event, FakeKube, NotFound
+
+__all__ = [
+    "DEFAULT_REGION", "DEFAULT_ZONES", "FAMILIES", "InstanceTypeInfo",
+    "ZoneInfo", "build_catalog", "catalog_by_name", "spot_price",
+    "FakeEC2", "FakeImage", "FakeInstance", "FakeLaunchTemplate",
+    "FakeSecurityGroup", "FakeSubnet", "FakeKube", "Event", "Conflict",
+    "NotFound",
+]

@@ -1,0 +1,281 @@
+"""Parametric EC2-like instance catalog for the fake cloud.
+
+The reference ships generated data tables for the real EC2 catalog
+(zz_generated.describe_instance_types.go — 885 LoC of 5 sample types for
+tests; zz_generated.vpclimits.go — 13k LoC of ENI limits;
+zz_generated.bandwidth.go; zz_generated.pricing_aws*.go). We generate an
+equivalent-scale catalog parametrically: families x generations x sizes with
+realistic vCPU/memory ratios, GPU/accelerator models, ENI-formula pod limits,
+and deterministic on-demand + per-zone spot pricing (fixed-point micro-USD).
+
+Determinism: every number derives from the type/zone names via stable
+hashing — two processes always build the identical catalog.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple
+
+from ..cloudprovider.types import MICRO, usd
+
+GIB = 1024**3
+
+
+@dataclass(frozen=True)
+class ZoneInfo:
+    name: str      # us-west-2a
+    zone_id: str   # usw2-az1
+    zone_type: str = "availability-zone"  # | local-zone
+
+
+DEFAULT_REGION = "us-west-2"
+DEFAULT_ZONES = (
+    ZoneInfo("us-west-2a", "usw2-az1"),
+    ZoneInfo("us-west-2b", "usw2-az2"),
+    ZoneInfo("us-west-2c", "usw2-az3"),
+    ZoneInfo("us-west-2d", "usw2-az4"),
+)
+
+
+@dataclass(frozen=True)
+class InstanceTypeInfo:
+    """Raw catalog row (the DescribeInstanceTypes analog)."""
+    name: str                      # m6i.2xlarge
+    family: str                    # m6i
+    category: str                  # m
+    generation: int                # 6
+    size: str                      # 2xlarge
+    arch: str                      # amd64 | arm64
+    vcpus: int
+    memory_bytes: int
+    cpu_manufacturer: str          # intel | amd | aws
+    hypervisor: str                # nitro | xen | "" (metal)
+    bare_metal: bool
+    enis: int
+    ipv4_per_eni: int
+    network_bandwidth_mbps: int
+    ebs_bandwidth_mbps: int
+    local_nvme_bytes: int = 0
+    gpu_name: str = ""
+    gpu_manufacturer: str = ""
+    gpu_count: int = 0
+    gpu_memory_bytes: int = 0
+    accelerator_name: str = ""
+    accelerator_manufacturer: str = ""
+    accelerator_count: int = 0
+    efa_count: int = 0
+    encryption_in_transit: bool = True
+    od_price: int = 0              # micro-USD/hour
+
+    @property
+    def eni_pod_limit(self) -> int:
+        """ENI-formula max pods: enis*(ips-1)+2 (vpclimits analog)."""
+        return self.enis * (self.ipv4_per_eni - 1) + 2
+
+
+# (size -> vcpus) ladder
+_SIZES: Dict[str, int] = {
+    "medium": 1, "large": 2, "xlarge": 4, "2xlarge": 8, "4xlarge": 16,
+    "8xlarge": 32, "12xlarge": 48, "16xlarge": 64, "24xlarge": 96,
+    "32xlarge": 128, "48xlarge": 192, "metal": 96,
+}
+
+# vcpus -> (enis, ipv4 per eni): the shape of the real vpclimits table
+_ENI_LIMITS: Sequence[Tuple[int, int, int]] = (
+    (1, 2, 4), (2, 3, 10), (4, 4, 15), (8, 4, 15), (16, 8, 30),
+    (32, 8, 30), (48, 15, 50), (64, 15, 50), (96, 15, 50),
+    (128, 15, 50), (192, 15, 50),
+)
+
+
+def _eni(vcpus: int) -> Tuple[int, int]:
+    for v, enis, ips in _ENI_LIMITS:
+        if vcpus <= v:
+            return enis, ips
+    return 15, 50
+
+
+@dataclass(frozen=True)
+class FamilySpec:
+    family: str
+    category: str
+    generation: int
+    arch: str
+    cpu_manufacturer: str
+    gib_per_vcpu: int
+    sizes: Tuple[str, ...]
+    od_price_per_vcpu: float        # USD/hour
+    local_nvme_gib_per_vcpu: int = 0
+    gpu: Tuple[str, str, int] = ("", "", 0)      # (name, mfr, GiB mem/gpu)
+    gpus_by_size: Mapping[str, int] = field(default_factory=dict)
+    accel: Tuple[str, str] = ("", "")
+    accels_by_size: Mapping[str, int] = field(default_factory=dict)
+    efa_sizes: Tuple[str, ...] = ()
+    network_gbps_per_vcpu: float = 0.4
+
+
+_STD = ("large", "xlarge", "2xlarge", "4xlarge", "8xlarge", "12xlarge",
+        "16xlarge", "24xlarge", "metal")
+_STD_NO_METAL = _STD[:-1]
+_BURST = ("medium", "large", "xlarge", "2xlarge")
+
+
+def _f(family, category, gen, arch, mfr, ratio, price, sizes=_STD_NO_METAL, **kw):
+    return FamilySpec(family, category, gen, arch, mfr, ratio, tuple(sizes), price, **kw)
+
+
+FAMILIES: Tuple[FamilySpec, ...] = (
+    # compute optimized (2 GiB/vCPU)
+    _f("c4", "c", 4, "amd64", "intel", 2, 0.0500, sizes=("large", "xlarge", "2xlarge", "4xlarge", "8xlarge")),
+    _f("c5", "c", 5, "amd64", "intel", 2, 0.0425, sizes=_STD),
+    _f("c5a", "c", 5, "amd64", "amd", 2, 0.0385),
+    _f("c5d", "c", 5, "amd64", "intel", 2, 0.0480, local_nvme_gib_per_vcpu=25, sizes=_STD),
+    _f("c6g", "c", 6, "arm64", "aws", 2, 0.0340, sizes=_STD),
+    _f("c6gd", "c", 6, "arm64", "aws", 2, 0.0384, local_nvme_gib_per_vcpu=25),
+    _f("c6i", "c", 6, "amd64", "intel", 2, 0.0425, sizes=_STD),
+    _f("c6a", "c", 6, "amd64", "amd", 2, 0.0383, sizes=_STD),
+    _f("c7g", "c", 7, "arm64", "aws", 2, 0.0363, sizes=_STD),
+    _f("c7i", "c", 7, "amd64", "intel", 2, 0.0446, sizes=_STD),
+    _f("c7a", "c", 7, "amd64", "amd", 2, 0.0513),
+    # general purpose (4 GiB/vCPU)
+    _f("m4", "m", 4, "amd64", "intel", 4, 0.0575, sizes=("large", "xlarge", "2xlarge", "4xlarge", "16xlarge")),
+    _f("m5", "m", 5, "amd64", "intel", 4, 0.0480, sizes=_STD),
+    _f("m5a", "m", 5, "amd64", "amd", 4, 0.0430),
+    _f("m5d", "m", 5, "amd64", "intel", 4, 0.0565, local_nvme_gib_per_vcpu=37, sizes=_STD),
+    _f("m6g", "m", 6, "arm64", "aws", 4, 0.0385, sizes=_STD),
+    _f("m6gd", "m", 6, "arm64", "aws", 4, 0.0452, local_nvme_gib_per_vcpu=59),
+    _f("m6i", "m", 6, "amd64", "intel", 4, 0.0480, sizes=_STD),
+    _f("m6a", "m", 6, "amd64", "amd", 4, 0.0432, sizes=_STD),
+    _f("m7g", "m", 7, "arm64", "aws", 4, 0.0408, sizes=_STD),
+    _f("m7i", "m", 7, "amd64", "intel", 4, 0.0504, sizes=_STD),
+    _f("m7a", "m", 7, "amd64", "amd", 4, 0.0580),
+    # memory optimized (8 GiB/vCPU)
+    _f("r4", "r", 4, "amd64", "intel", 7, 0.0665, sizes=("large", "xlarge", "2xlarge", "4xlarge", "8xlarge", "16xlarge")),
+    _f("r5", "r", 5, "amd64", "intel", 8, 0.0630, sizes=_STD),
+    _f("r5a", "r", 5, "amd64", "amd", 8, 0.0565),
+    _f("r5d", "r", 5, "amd64", "intel", 8, 0.0720, local_nvme_gib_per_vcpu=75, sizes=_STD),
+    _f("r6g", "r", 6, "arm64", "aws", 8, 0.0504, sizes=_STD),
+    _f("r6gd", "r", 6, "arm64", "aws", 8, 0.0576, local_nvme_gib_per_vcpu=118),
+    _f("r6i", "r", 6, "amd64", "intel", 8, 0.0630, sizes=_STD),
+    _f("r6a", "r", 6, "amd64", "amd", 8, 0.0567, sizes=_STD),
+    _f("r7g", "r", 7, "arm64", "aws", 8, 0.0536, sizes=_STD),
+    _f("r7i", "r", 7, "amd64", "intel", 8, 0.0661, sizes=_STD),
+    # high memory (16 GiB/vCPU)
+    _f("x2gd", "x", 2, "arm64", "aws", 16, 0.0835, local_nvme_gib_per_vcpu=59,
+       sizes=("medium", "large", "xlarge", "2xlarge", "4xlarge", "8xlarge", "12xlarge", "16xlarge", "metal")),
+    _f("x2idn", "x", 2, "amd64", "intel", 16, 0.1668, sizes=("16xlarge", "24xlarge", "32xlarge", "metal")),
+    # burstable (t) — 2-4 GiB/vCPU
+    _f("t2", "t", 2, "amd64", "intel", 4, 0.0464, sizes=_BURST, network_gbps_per_vcpu=0.1),
+    _f("t3", "t", 3, "amd64", "intel", 4, 0.0416, sizes=_BURST, network_gbps_per_vcpu=0.1),
+    _f("t3a", "t", 3, "amd64", "amd", 4, 0.0376, sizes=_BURST, network_gbps_per_vcpu=0.1),
+    _f("t4g", "t", 4, "arm64", "aws", 4, 0.0336, sizes=_BURST, network_gbps_per_vcpu=0.1),
+    # storage optimized
+    _f("i3", "i", 3, "amd64", "intel", 7, 0.0780, local_nvme_gib_per_vcpu=232,
+       sizes=("large", "xlarge", "2xlarge", "4xlarge", "8xlarge", "16xlarge", "metal")),
+    _f("i3en", "i", 3, "amd64", "intel", 8, 0.1130, local_nvme_gib_per_vcpu=312,
+       sizes=("large", "xlarge", "2xlarge", "4xlarge", "8xlarge", "12xlarge", "24xlarge", "metal")),
+    _f("i4i", "i", 4, "amd64", "intel", 8, 0.0858, local_nvme_gib_per_vcpu=234,
+       sizes=("large", "xlarge", "2xlarge", "4xlarge", "8xlarge", "16xlarge", "32xlarge", "metal")),
+    _f("d3", "d", 3, "amd64", "intel", 8, 0.1248, local_nvme_gib_per_vcpu=0,
+       sizes=("xlarge", "2xlarge", "4xlarge", "8xlarge")),
+    # GPU — inference
+    _f("g4dn", "g", 4, "amd64", "intel", 4, 0.1315, local_nvme_gib_per_vcpu=28,
+       sizes=("xlarge", "2xlarge", "4xlarge", "8xlarge", "12xlarge", "16xlarge", "metal"),
+       gpu=("t4", "nvidia", 16),
+       gpus_by_size={"xlarge": 1, "2xlarge": 1, "4xlarge": 1, "8xlarge": 1,
+                     "12xlarge": 4, "16xlarge": 1, "metal": 8}),
+    _f("g5", "g", 5, "amd64", "amd", 4, 0.2518, local_nvme_gib_per_vcpu=58,
+       sizes=("xlarge", "2xlarge", "4xlarge", "8xlarge", "12xlarge", "16xlarge", "24xlarge", "48xlarge"),
+       gpu=("a10g", "nvidia", 24),
+       gpus_by_size={"xlarge": 1, "2xlarge": 1, "4xlarge": 1, "8xlarge": 1,
+                     "12xlarge": 4, "16xlarge": 1, "24xlarge": 4, "48xlarge": 8}),
+    _f("g6", "g", 6, "amd64", "amd", 4, 0.2012, local_nvme_gib_per_vcpu=58,
+       sizes=("xlarge", "2xlarge", "4xlarge", "8xlarge", "12xlarge", "16xlarge", "24xlarge", "48xlarge"),
+       gpu=("l4", "nvidia", 24),
+       gpus_by_size={"xlarge": 1, "2xlarge": 1, "4xlarge": 1, "8xlarge": 1,
+                     "12xlarge": 4, "16xlarge": 1, "24xlarge": 4, "48xlarge": 8}),
+    # GPU — training
+    _f("p3", "p", 3, "amd64", "intel", 7, 0.3825, sizes=("2xlarge", "8xlarge", "16xlarge"),
+       gpu=("v100", "nvidia", 16),
+       gpus_by_size={"2xlarge": 1, "8xlarge": 4, "16xlarge": 8}),
+    _f("p4d", "p", 4, "amd64", "intel", 12, 0.3414, local_nvme_gib_per_vcpu=83,
+       sizes=("24xlarge",), gpu=("a100", "nvidia", 40),
+       gpus_by_size={"24xlarge": 8}, efa_sizes=("24xlarge",)),
+    _f("p5", "p", 5, "amd64", "amd", 10, 0.5120, local_nvme_gib_per_vcpu=158,
+       sizes=("48xlarge",), gpu=("h100", "nvidia", 80),
+       gpus_by_size={"48xlarge": 8}, efa_sizes=("48xlarge",)),
+    # accelerators — inferentia / trainium
+    _f("inf1", "inf", 1, "amd64", "intel", 2, 0.0570,
+       sizes=("xlarge", "2xlarge", "6xlarge", "24xlarge"),
+       accel=("inferentia", "aws"),
+       accels_by_size={"xlarge": 1, "2xlarge": 1, "6xlarge": 4, "24xlarge": 16}),
+    _f("inf2", "inf", 2, "amd64", "amd", 4, 0.0947,
+       sizes=("xlarge", "8xlarge", "24xlarge", "48xlarge"),
+       accel=("inferentia2", "aws"),
+       accels_by_size={"xlarge": 1, "8xlarge": 1, "24xlarge": 6, "48xlarge": 12}),
+    _f("trn1", "trn", 1, "amd64", "intel", 4, 0.4163,
+       sizes=("2xlarge", "32xlarge"), accel=("trainium", "aws"),
+       accels_by_size={"2xlarge": 1, "32xlarge": 16}, efa_sizes=("32xlarge",)),
+)
+
+# irregular sizes used by a few families
+_SIZES["6xlarge"] = 24
+_SIZES["9xlarge"] = 36
+
+
+def _stable_fraction(seed: str) -> float:
+    """Deterministic [0,1) fraction from a string."""
+    h = hashlib.md5(seed.encode()).digest()
+    return int.from_bytes(h[:8], "big") / 2**64
+
+
+def build_catalog(families: Sequence[FamilySpec] = FAMILIES) -> List[InstanceTypeInfo]:
+    out: List[InstanceTypeInfo] = []
+    for f in families:
+        for size in f.sizes:
+            vcpus = _SIZES[size]
+            if size == "metal":
+                vcpus = max(_SIZES[s] for s in f.sizes if s != "metal")
+            name = f"{f.family}.{size}"
+            enis, ips = _eni(vcpus)
+            gpus = f.gpus_by_size.get(size, 0)
+            accels = f.accels_by_size.get(size, 0)
+            gpu_name, gpu_mfr, gpu_mem_gib = f.gpu
+            price = f.od_price_per_vcpu * vcpus \
+                + gpus * (0.35 if gpu_name in ("t4", "l4") else 0.9 if gpu_name == "a10g" else 2.3) \
+                + accels * 0.16
+            out.append(InstanceTypeInfo(
+                name=name, family=f.family, category=f.category,
+                generation=f.generation, size=size, arch=f.arch,
+                vcpus=vcpus, memory_bytes=vcpus * f.gib_per_vcpu * GIB,
+                cpu_manufacturer=f.cpu_manufacturer,
+                hypervisor="" if size == "metal" else ("nitro" if f.generation >= 5 or f.category in ("g", "p", "inf", "trn", "x", "i") else "xen"),
+                bare_metal=size == "metal",
+                enis=enis, ipv4_per_eni=ips,
+                network_bandwidth_mbps=int(vcpus * f.network_gbps_per_vcpu * 1000),
+                ebs_bandwidth_mbps=min(80_000, 650 * vcpus),
+                local_nvme_bytes=vcpus * f.local_nvme_gib_per_vcpu * GIB,
+                gpu_name=gpu_name if gpus else "",
+                gpu_manufacturer=gpu_mfr if gpus else "",
+                gpu_count=gpus,
+                gpu_memory_bytes=gpus * gpu_mem_gib * GIB if gpus else 0,
+                accelerator_name=f.accel[0] if accels else "",
+                accelerator_manufacturer=f.accel[1] if accels else "",
+                accelerator_count=accels,
+                efa_count=(2 if f.family == "p5" else 1) if size in f.efa_sizes else 0,
+                encryption_in_transit=f.generation >= 5,
+                od_price=usd(price),
+            ))
+    return out
+
+
+def spot_price(info: InstanceTypeInfo, zone: str) -> int:
+    """Deterministic per-zone spot price: 25-45% of on-demand."""
+    frac = 0.25 + 0.20 * _stable_fraction(f"{info.name}/{zone}")
+    return int(info.od_price * frac)
+
+
+def catalog_by_name(catalog: Sequence[InstanceTypeInfo]) -> Dict[str, InstanceTypeInfo]:
+    return {i.name: i for i in catalog}

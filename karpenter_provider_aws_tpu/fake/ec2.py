@@ -1,0 +1,410 @@
+"""In-memory fake EC2-like cloud.
+
+Mirrors the reference's fake AWS layer (pkg/fake/ec2api.go:40-112): an
+in-memory instance/launch-template store, CreateFleet that actually
+"launches" fake instances, ``insufficient_capacity_pools`` to simulate ICE
+per (instanceType, zone, capacityType), ``next_error`` single-shot error
+injection, output overrides, and call capture — plus subnet/SG/AMI stores
+with tag-filter queries, spot price history, and instance-type offerings.
+
+Thread-safe: every public method takes the store lock (the control plane's
+batchers call from worker tasks).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence, Set, Tuple
+
+from ..cloudprovider.types import InsufficientCapacityError
+from .catalog import (DEFAULT_ZONES, FAMILIES, InstanceTypeInfo, ZoneInfo,
+                      build_catalog, catalog_by_name, spot_price)
+
+_id_counter = itertools.count(1)
+
+
+def _new_id(prefix: str) -> str:
+    return f"{prefix}-{next(_id_counter):017x}"
+
+
+@dataclass
+class FakeSubnet:
+    id: str
+    zone: str
+    zone_id: str
+    available_ips: int = 8000
+    tags: Dict[str, str] = field(default_factory=dict)
+    zone_type: str = "availability-zone"
+
+
+@dataclass
+class FakeSecurityGroup:
+    id: str
+    name: str
+    tags: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class FakeImage:
+    id: str
+    name: str
+    arch: str                      # amd64 | arm64
+    creation_date: float
+    deprecated: bool = False
+    tags: Dict[str, str] = field(default_factory=dict)
+    ssm_alias: str = ""            # e.g. "al2023@latest/amd64"
+
+
+@dataclass
+class FakeLaunchTemplate:
+    id: str
+    name: str
+    image_id: str
+    security_group_ids: List[str]
+    user_data: str
+    tags: Dict[str, str] = field(default_factory=dict)
+    metadata_options: Optional[dict] = None
+    block_device_mappings: Optional[list] = None
+    instance_profile: str = ""
+
+
+@dataclass
+class FakeInstance:
+    id: str
+    instance_type: str
+    zone: str
+    zone_id: str
+    capacity_type: str             # spot | on-demand
+    image_id: str
+    launch_template_name: str
+    subnet_id: str
+    state: str = "running"         # pending|running|shutting-down|terminated
+    launch_time: float = 0.0
+    tags: Dict[str, str] = field(default_factory=dict)
+    provider_id: str = ""
+
+    def __post_init__(self):
+        if not self.provider_id:
+            self.provider_id = f"aws:///{self.zone}/{self.id}"
+
+
+class CallLog:
+    """MockedFunction analog (fake/ec2api.go:48-68): capture calls, inject
+    one-shot errors, count successes."""
+
+    def __init__(self):
+        self.calls: List[Any] = []
+        self.error: Optional[Exception] = None
+        self.output_override: Optional[Any] = None
+
+    def record(self, inp: Any) -> None:
+        self.calls.append(inp)
+
+    def maybe_raise(self) -> None:
+        if self.error is not None:
+            err, self.error = self.error, None
+            raise err
+
+    @property
+    def called_times(self) -> int:
+        return len(self.calls)
+
+    def reset(self) -> None:
+        self.calls.clear()
+        self.error = None
+        self.output_override = None
+
+
+class FakeEC2:
+    """The fake cloud. All state mutations lock ``self._mu``."""
+
+    def __init__(self,
+                 zones: Sequence[ZoneInfo] = DEFAULT_ZONES,
+                 catalog: Optional[Sequence[InstanceTypeInfo]] = None,
+                 region: str = "us-west-2",
+                 now: Callable[[], float] = time.time):
+        self._mu = threading.RLock()
+        self.region = region
+        self.zones = list(zones)
+        self.catalog: List[InstanceTypeInfo] = list(catalog if catalog is not None else build_catalog())
+        self.by_name = catalog_by_name(self.catalog)
+        self.now = now
+
+        self.instances: Dict[str, FakeInstance] = {}
+        self.launch_templates: Dict[str, FakeLaunchTemplate] = {}
+        self.subnets: Dict[str, FakeSubnet] = {}
+        self.security_groups: Dict[str, FakeSecurityGroup] = {}
+        self.images: Dict[str, FakeImage] = {}
+        self.ssm_parameters: Dict[str, str] = {}
+
+        # Behavior injection (fake/ec2api.go:40-44,66)
+        #: {(instance_type, zone, capacity_type)} that raise ICE on launch
+        self.insufficient_capacity_pools: Set[Tuple[str, str, str]] = set()
+        #: offerings removed from DescribeInstanceTypeOfferings
+        self.removed_offerings: Set[Tuple[str, str]] = set()
+
+        self.create_fleet_log = CallLog()
+        self.describe_instances_log = CallLog()
+        self.terminate_instances_log = CallLog()
+        self.create_launch_template_log = CallLog()
+        self.create_tags_log = CallLog()
+        self.describe_instance_types_log = CallLog()
+
+        self._seed_default_network()
+        self._seed_default_images()
+
+    # -- seeding -----------------------------------------------------------
+    def _seed_default_network(self) -> None:
+        for i, z in enumerate(self.zones):
+            sn = FakeSubnet(id=f"subnet-{z.zone_id}", zone=z.name, zone_id=z.zone_id,
+                            available_ips=8000 - i,  # deterministic tie-break
+                            tags={"karpenter.sh/discovery": "cluster", "Name": f"private-{z.name}"},
+                            zone_type=z.zone_type)
+            self.subnets[sn.id] = sn
+        sg = FakeSecurityGroup(id="sg-nodes", name="karpenter-nodes",
+                               tags={"karpenter.sh/discovery": "cluster"})
+        self.security_groups[sg.id] = sg
+
+    def _seed_default_images(self) -> None:
+        t = 1_700_000_000.0
+        for fam in ("al2023", "al2", "bottlerocket"):
+            for arch in ("amd64", "arm64"):
+                img = FakeImage(id=_new_id("ami"), name=f"{fam}-{arch}-v2024",
+                                arch=arch, creation_date=t,
+                                ssm_alias=f"{fam}@latest/{arch}")
+                self.images[img.id] = img
+                self.ssm_parameters[_ssm_path(fam, arch)] = img.id
+            t += 1000
+
+    # -- catalog APIs ------------------------------------------------------
+    def describe_instance_types(self) -> List[InstanceTypeInfo]:
+        with self._mu:
+            self.describe_instance_types_log.record(None)
+            self.describe_instance_types_log.maybe_raise()
+            if self.describe_instance_types_log.output_override is not None:
+                return list(self.describe_instance_types_log.output_override)
+            return list(self.catalog)
+
+    def describe_instance_type_offerings(self) -> List[Tuple[str, str]]:
+        """(instance_type, zone) pairs. Deterministically: newest-generation
+        families are absent from the last zone (mirrors real-world partial
+        zonal rollout), plus any injected removals."""
+        with self._mu:
+            out = []
+            last_zone = self.zones[-1].name if self.zones else ""
+            for info in self.catalog:
+                for z in self.zones:
+                    if z.name == last_zone and info.generation >= 7:
+                        continue
+                    if (info.name, z.name) in self.removed_offerings:
+                        continue
+                    out.append((info.name, z.name))
+            return out
+
+    def describe_spot_price_history(self) -> List[Tuple[str, str, int]]:
+        """(instance_type, zone, micro_usd) triples."""
+        with self._mu:
+            return [(i.name, z.name, spot_price(i, z.name))
+                    for i in self.catalog for z in self.zones]
+
+    def on_demand_prices(self) -> Dict[str, int]:
+        with self._mu:
+            return {i.name: i.od_price for i in self.catalog}
+
+    # -- network discovery -------------------------------------------------
+    def describe_subnets(self, tag_filters: Mapping[str, str] = (),
+                         ids: Sequence[str] = ()) -> List[FakeSubnet]:
+        with self._mu:
+            return [s for s in self.subnets.values()
+                    if _match(s.tags, tag_filters, s.id, ids)]
+
+    def describe_security_groups(self, tag_filters: Mapping[str, str] = (),
+                                 ids: Sequence[str] = (),
+                                 names: Sequence[str] = ()) -> List[FakeSecurityGroup]:
+        with self._mu:
+            out = []
+            for g in self.security_groups.values():
+                if names and g.name not in names:
+                    continue
+                if _match(g.tags, tag_filters, g.id, ids):
+                    out.append(g)
+            return out
+
+    def describe_images(self, tag_filters: Mapping[str, str] = (),
+                        ids: Sequence[str] = (),
+                        names: Sequence[str] = ()) -> List[FakeImage]:
+        with self._mu:
+            out = []
+            for img in self.images.values():
+                if names and img.name not in names:
+                    continue
+                if _match(img.tags, tag_filters, img.id, ids):
+                    out.append(img)
+            return out
+
+    def ssm_get_parameter(self, path: str) -> str:
+        with self._mu:
+            if path not in self.ssm_parameters:
+                raise KeyError(f"ParameterNotFound: {path}")
+            return self.ssm_parameters[path]
+
+    # -- launch templates --------------------------------------------------
+    def create_launch_template(self, lt: FakeLaunchTemplate) -> FakeLaunchTemplate:
+        with self._mu:
+            self.create_launch_template_log.record(lt)
+            self.create_launch_template_log.maybe_raise()
+            if not lt.id:
+                lt.id = _new_id("lt")
+            self.launch_templates[lt.name] = lt
+            return lt
+
+    def describe_launch_templates(self, names: Sequence[str] = ()) -> List[FakeLaunchTemplate]:
+        with self._mu:
+            if not names:
+                return list(self.launch_templates.values())
+            return [self.launch_templates[n] for n in names if n in self.launch_templates]
+
+    def delete_launch_templates(self, names: Sequence[str]) -> None:
+        with self._mu:
+            for n in names:
+                self.launch_templates.pop(n, None)
+
+    # -- the launcher ------------------------------------------------------
+    def create_fleet(self,
+                     launch_template_configs: Sequence[Mapping[str, Any]],
+                     target_capacity: int,
+                     capacity_type: str) -> Tuple[List[FakeInstance], List[dict]]:
+        """Instant-fleet semantics: each config is {"launch_template_name",
+        "overrides": [{"instance_type","zone","subnet_id","image_id","priority"?}]}.
+
+        Returns (instances, errors): ICE pools produce per-override errors and
+        the fleet falls through to the next-cheapest override, exactly like
+        CreateFleet's price-capacity-optimized behavior the launcher relies on
+        (instance.go:227-245, 357-363).
+        """
+        with self._mu:
+            req = {"configs": launch_template_configs,
+                   "target_capacity": target_capacity,
+                   "capacity_type": capacity_type}
+            self.create_fleet_log.record(req)
+            self.create_fleet_log.maybe_raise()
+
+            overrides: List[dict] = []
+            for cfg in launch_template_configs:
+                lt_name = cfg["launch_template_name"]
+                for o in cfg.get("overrides", []):
+                    overrides.append({**o, "launch_template_name": lt_name})
+            # price-capacity-optimized: ascending priority (we set priority =
+            # price rank on the client side, matching the reference's use of
+            # lowest-price/price-capacity-optimized allocation)
+            overrides.sort(key=lambda o: (o.get("priority", 0), o["instance_type"], o["zone"]))
+
+            instances: List[FakeInstance] = []
+            errors: List[dict] = []
+            remaining = target_capacity
+            for o in overrides:
+                if remaining <= 0:
+                    break
+                pool = (o["instance_type"], o["zone"], capacity_type)
+                if pool in self.insufficient_capacity_pools:
+                    errors.append({
+                        "code": "InsufficientInstanceCapacity",
+                        "instance_type": o["instance_type"],
+                        "zone": o["zone"],
+                        "capacity_type": capacity_type,
+                    })
+                    continue
+                lt = self.launch_templates.get(o["launch_template_name"])
+                image_id = o.get("image_id") or (lt.image_id if lt else "")
+                zone_id = next((z.zone_id for z in self.zones if z.name == o["zone"]), "")
+                while remaining > 0:
+                    inst = FakeInstance(
+                        id=_new_id("i"), instance_type=o["instance_type"],
+                        zone=o["zone"], zone_id=zone_id,
+                        capacity_type=capacity_type, image_id=image_id,
+                        launch_template_name=o["launch_template_name"],
+                        subnet_id=o.get("subnet_id", ""),
+                        launch_time=self.now(),
+                        tags=dict(lt.tags) if lt else {})
+                    self.instances[inst.id] = inst
+                    instances.append(inst)
+                    remaining -= 1
+                break  # one pool satisfies the whole batch (instant fleet)
+            return instances, errors
+
+    # -- instance lifecycle ------------------------------------------------
+    def describe_instances(self, ids: Sequence[str] = (),
+                           tag_filters: Mapping[str, str] = (),
+                           states: Sequence[str] = ("pending", "running",
+                                                    "shutting-down", "stopped")
+                           ) -> List[FakeInstance]:
+        with self._mu:
+            self.describe_instances_log.record({"ids": list(ids), "filters": dict(tag_filters)})
+            self.describe_instances_log.maybe_raise()
+            out = []
+            for inst in self.instances.values():
+                if ids and inst.id not in ids:
+                    continue
+                if inst.state not in states:
+                    continue
+                if tag_filters and not _match(inst.tags, tag_filters, inst.id, ()):
+                    continue
+                out.append(inst)
+            return out
+
+    def terminate_instances(self, ids: Sequence[str]) -> List[str]:
+        with self._mu:
+            self.terminate_instances_log.record(list(ids))
+            self.terminate_instances_log.maybe_raise()
+            done = []
+            for iid in ids:
+                inst = self.instances.get(iid)
+                if inst and inst.state != "terminated":
+                    inst.state = "terminated"
+                    done.append(iid)
+            return done
+
+    def create_tags(self, ids: Sequence[str], tags: Mapping[str, str]) -> None:
+        with self._mu:
+            self.create_tags_log.record({"ids": list(ids), "tags": dict(tags)})
+            self.create_tags_log.maybe_raise()
+            for iid in ids:
+                inst = self.instances.get(iid)
+                if inst is None:
+                    raise KeyError(f"InvalidInstanceID.NotFound: {iid}")
+                inst.tags.update(tags)
+
+    # -- test hygiene ------------------------------------------------------
+    def reset(self) -> None:
+        """Between-spec reset (fake/ec2api.go:84-110)."""
+        with self._mu:
+            self.instances.clear()
+            self.launch_templates.clear()
+            self.insufficient_capacity_pools.clear()
+            self.removed_offerings.clear()
+            for log in (self.create_fleet_log, self.describe_instances_log,
+                        self.terminate_instances_log, self.create_launch_template_log,
+                        self.create_tags_log, self.describe_instance_types_log):
+                log.reset()
+
+
+def _ssm_path(family: str, arch: str) -> str:
+    return f"/aws/service/{family}/{arch}/latest/image_id"
+
+
+def _match(tags: Mapping[str, str], tag_filters: Mapping[str, str],
+           obj_id: str, ids: Sequence[str]) -> bool:
+    if ids:
+        return obj_id in ids
+    if not tag_filters:
+        return True
+    for k, v in dict(tag_filters).items():
+        if v == "*":
+            if k not in tags:
+                return False
+        elif tags.get(k) != v:
+            return False
+    return True

@@ -1,0 +1,132 @@
+"""Test environment: every provider wired to fakes (pkg/test/environment.go
+analog) plus fixture builders for NodePools/Pods."""
+
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple
+
+from ..apis import labels as L
+from ..apis.objects import (EC2NodeClass, NodeClassRef, NodePool,
+                            NodePoolTemplate, Pod, Taint, Toleration,
+                            TopologySpreadConstraint)
+from ..apis.requirements import IN, Requirement, Requirements
+from ..apis.resources import Resources
+from ..cache.ttl import UnavailableOfferings
+from ..providers.instancetype import InstanceTypeProvider, OfferingsSnapshot
+from ..solver.types import NodePoolSpec, SchedulingSnapshot
+from .ec2 import FakeEC2
+from .kube import FakeKube
+
+_pod_counter = itertools.count()
+
+
+class Environment:
+    """FakeEC2 + FakeKube + instancetype provider, hydrated."""
+
+    def __init__(self, ec2: Optional[FakeEC2] = None, clock=None):
+        self.ec2 = ec2 or FakeEC2()
+        self.kube = FakeKube()
+        self.unavailable_offerings = UnavailableOfferings(clock=clock)
+        self.instance_types = InstanceTypeProvider(
+            unavailable_offerings=self.unavailable_offerings, clock=clock)
+        self.refresh_catalog()
+
+    def refresh_catalog(self) -> None:
+        """What the 12h catalog/pricing controllers do (SURVEY §3.3)."""
+        self.instance_types.update_instance_types(self.ec2.describe_instance_types())
+        type_zones: Dict[str, set] = {}
+        for t, z in self.ec2.describe_instance_type_offerings():
+            type_zones.setdefault(t, set()).add(z)
+        self.instance_types.update_offerings(OfferingsSnapshot(
+            zones={z.name: z for z in self.ec2.zones},
+            type_zones=type_zones,
+            od_prices=self.ec2.on_demand_prices(),
+            spot_prices={(t, z): p for t, z, p in self.ec2.describe_spot_price_history()},
+        ))
+
+    def nodeclass(self, name: str = "default", **kw) -> EC2NodeClass:
+        """A ready EC2NodeClass with resolved status (what the nodeclass
+        status controller produces)."""
+        nc = EC2NodeClass(name, **kw)
+        nc.status_subnets = [
+            {"id": s.id, "zone": s.zone, "zoneID": s.zone_id}
+            for s in self.ec2.describe_subnets(
+                tag_filters={"karpenter.sh/discovery": "cluster"})]
+        nc.status_security_groups = [
+            {"id": g.id, "name": g.name}
+            for g in self.ec2.describe_security_groups(
+                tag_filters={"karpenter.sh/discovery": "cluster"})]
+        family = nc.ami_family
+        nc.status_amis = [
+            {"id": i.id, "name": i.name, "arch": i.arch}
+            for i in self.ec2.describe_images()
+            if family == "custom" or i.ssm_alias.startswith(family + "@")]
+        nc.status_instance_profile = f"{name}-profile"
+        nc.set_condition("Ready", "True")
+        return nc
+
+    def nodepool(self, name: str = "default",
+                 nodeclass: Optional[EC2NodeClass] = None,
+                 requirements: Sequence[Mapping] = (),
+                 taints: Sequence[Taint] = (),
+                 limits: Optional[Mapping] = None,
+                 weight: int = 0,
+                 labels: Optional[Dict[str, str]] = None) -> Tuple[NodePool, EC2NodeClass]:
+        nc = nodeclass or self.nodeclass(name + "-class")
+        np = NodePool(
+            name,
+            template=NodePoolTemplate(
+                node_class_ref=NodeClassRef(nc.metadata.name),
+                requirements=Requirements.from_terms(list(requirements)),
+                labels=dict(labels or {}),
+                taints=list(taints),
+            ),
+            limits=Resources.parse(limits) if limits else None,
+            weight=weight)
+        return np, nc
+
+    def pool_spec(self, np: NodePool, nc: EC2NodeClass) -> NodePoolSpec:
+        return NodePoolSpec(nodepool=np,
+                            instance_types=self.instance_types.list(nc))
+
+    def snapshot(self, pods: Sequence[Pod],
+                 pools: Sequence[Tuple[NodePool, EC2NodeClass]],
+                 existing_nodes=(), daemon_overheads=()) -> SchedulingSnapshot:
+        return SchedulingSnapshot(
+            pods=pods,
+            nodepools=[self.pool_spec(np, nc) for np, nc in pools],
+            existing_nodes=list(existing_nodes),
+            daemon_overheads=list(daemon_overheads),
+            zones={z.name: z.zone_id for z in self.ec2.zones},
+        )
+
+    def reset(self) -> None:
+        self.ec2.reset()
+        self.kube.reset()
+
+
+def make_pods(count: int, cpu: str = "100m", memory: str = "128Mi",
+              prefix: str = "pod", group: str = "",
+              node_selector: Optional[Mapping[str, str]] = None,
+              tolerations: Sequence[Toleration] = (),
+              topology_spread: Sequence[TopologySpreadConstraint] = (),
+              pod_affinity=(), affinity_terms: Sequence[Mapping] = (),
+              **extra_resources) -> List[Pod]:
+    """Fixture builder: ``count`` identical pods."""
+    spec = {"cpu": cpu, "memory": memory}
+    spec.update(extra_resources)
+    out = []
+    for _ in range(count):
+        i = next(_pod_counter)
+        out.append(Pod(
+            name=f"{prefix}-{i:06d}",
+            requests=Resources.parse(spec),
+            node_selector=node_selector,
+            required_affinity_terms=list(affinity_terms),
+            tolerations=list(tolerations),
+            topology_spread=list(topology_spread),
+            pod_affinity=list(pod_affinity),
+            scheduling_group=group or prefix,
+        ))
+    return out

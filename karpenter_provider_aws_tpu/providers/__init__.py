@@ -1,0 +1,5 @@
+from .instancetype import (DEFAULT_VM_MEMORY_OVERHEAD_PERCENT,
+                           InstanceTypeProvider, OfferingsSnapshot)
+
+__all__ = ["InstanceTypeProvider", "OfferingsSnapshot",
+           "DEFAULT_VM_MEMORY_OVERHEAD_PERCENT"]

@@ -1,0 +1,318 @@
+"""Instance-type catalog provider: the solver's warm input.
+
+Mirrors pkg/providers/instancetype: holds raw catalog rows + offerings
+refreshed by a controller, and ``list()`` assembles ``InstanceType`` objects
+per NodeClass under a seqnum-keyed cache (instancetype.go:119-130). Resolve
+builds requirements (~20 labels, types.go:183-287), offerings with live
+spot/OD prices x zones x capacity types (types.go:120-157), capacity with the
+VM-memory-overhead haircut (types.go:307-478), and kubelet overhead
+(kubeReserved / systemReserved / evictionThreshold, types.go:480-565).
+A discovered-capacity cache corrects memory from real nodes
+(instancetype.go:169-171,273-297).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Dict, List, Mapping, Optional, Sequence, Set, Tuple
+
+from ..apis import labels as L
+from ..apis.objects import EC2NodeClass, KubeletConfiguration
+from ..apis.requirements import IN, Requirement, Requirements
+from ..apis.resources import (AWS_EFA, AWS_NEURON, AWS_POD_ENI, NVIDIA_GPU,
+                              Resources, parse_quantity)
+from ..cache.ttl import TTLCache
+from ..cloudprovider.types import (InstanceType, InstanceTypes, Offering,
+                                   Offerings, Overhead)
+from ..fake.catalog import GIB, InstanceTypeInfo, ZoneInfo
+
+#: default VM memory overhead (options.go: vm-memory-overhead-percent=0.075)
+DEFAULT_VM_MEMORY_OVERHEAD_PERCENT = 0.075
+MIB = 1024**2
+
+
+@dataclass
+class OfferingsSnapshot:
+    """(type -> zones available) + prices, maintained by the catalog
+    controller (instancetype.go:190-271)."""
+    zones: Mapping[str, ZoneInfo]                  # zone name -> info
+    type_zones: Mapping[str, Set[str]]             # type -> {zone}
+    od_prices: Mapping[str, int]                   # type -> micro-USD
+    spot_prices: Mapping[Tuple[str, str], int]     # (type, zone) -> micro-USD
+
+
+class InstanceTypeProvider:
+    """Thread-safe catalog with seqnum-invalidated resolution cache."""
+
+    def __init__(self, vm_memory_overhead_percent: float = DEFAULT_VM_MEMORY_OVERHEAD_PERCENT,
+                 unavailable_offerings=None, clock=None):
+        self._mu = threading.RLock()
+        self._raw: List[InstanceTypeInfo] = []
+        self._offerings: Optional[OfferingsSnapshot] = None
+        self.instance_types_seqnum = 0
+        self.offerings_seqnum = 0
+        self._overhead_pct = vm_memory_overhead_percent
+        self._cache = TTLCache(ttl=5 * 60, clock=clock)  # InstanceTypesAndZones TTL (cache.go)
+        self._discovered_memory: Dict[Tuple[str, str], int] = {}  # (type, ami) -> bytes
+        self.unavailable_offerings = unavailable_offerings
+
+    # -- controller-facing updates (instancetype controller, 12h) ---------
+    def update_instance_types(self, raw: Sequence[InstanceTypeInfo]) -> bool:
+        with self._mu:
+            new = sorted(raw, key=lambda r: r.name)
+            if new != self._raw:
+                self._raw = new
+                self.instance_types_seqnum += 1
+                return True
+            return False
+
+    def update_offerings(self, snapshot: OfferingsSnapshot) -> bool:
+        with self._mu:
+            changed = (self._offerings is None
+                       or snapshot.type_zones != self._offerings.type_zones
+                       or snapshot.od_prices != self._offerings.od_prices
+                       or snapshot.spot_prices != self._offerings.spot_prices)
+            self._offerings = snapshot
+            if changed:
+                self.offerings_seqnum += 1
+            return changed
+
+    def update_discovered_capacity(self, instance_type: str, ami_id: str,
+                                   memory_bytes: int) -> None:
+        """Real-node memory correction (capacity/controller.go:54-73)."""
+        with self._mu:
+            self._discovered_memory[(instance_type, ami_id)] = memory_bytes
+            self._cache.clear()
+
+    # -- the hot read ------------------------------------------------------
+    def list(self, nodeclass: EC2NodeClass) -> InstanceTypes:
+        """Assemble per-NodeClass InstanceTypes, cache-keyed on
+        (both seqnums, AMI hash, subnet-zone hash, kubelet/blockdev config)
+        — instancetype.go:119-130's 5-ary key."""
+        with self._mu:
+            if self._offerings is None:
+                return InstanceTypes()
+            subnet_zones = frozenset(
+                (s["zone"], s.get("zoneID", "")) for s in nodeclass.status_subnets)
+            amis = tuple(sorted(a["id"] for a in nodeclass.status_amis))
+            key = (self.instance_types_seqnum, self.offerings_seqnum,
+                   getattr(self.unavailable_offerings, "seqnum", 0),
+                   amis, subnet_zones, _kubelet_key(nodeclass.kubelet),
+                   _storage_key(nodeclass))
+            cached = self._cache.get(key)
+            if cached is not None:
+                return cached
+            out = self._resolve_all(nodeclass, subnet_zones)
+            self._cache.put(key, out)
+            return out
+
+    def _resolve_all(self, nodeclass: EC2NodeClass,
+                     subnet_zones: frozenset) -> InstanceTypes:
+        assert self._offerings is not None
+        ami_archs = {a.get("arch", "amd64") for a in nodeclass.status_amis} or {"amd64", "arm64"}
+        zone_filter = {z for z, _ in subnet_zones} if subnet_zones else None
+        primary_ami = {a.get("arch", "amd64"): a["id"] for a in nodeclass.status_amis}
+        out = InstanceTypes()
+        for info in self._raw:
+            if info.arch not in ami_archs:
+                continue
+            offerings = self._build_offerings(info, zone_filter)
+            if not offerings:
+                continue
+            out.append(self._resolve(info, nodeclass, offerings,
+                                     primary_ami.get(info.arch, "")))
+        return out
+
+    def _build_offerings(self, info: InstanceTypeInfo,
+                         zone_filter: Optional[Set[str]]) -> Offerings:
+        snap = self._offerings
+        assert snap is not None
+        offs = Offerings()
+        for zone in sorted(snap.type_zones.get(info.name, ())):
+            if zone_filter is not None and zone not in zone_filter:
+                continue
+            zinfo = snap.zones.get(zone)
+            zone_id = zinfo.zone_id if zinfo else ""
+            od = snap.od_prices.get(info.name)
+            if od is not None:
+                offs.append(Offering(
+                    L.CAPACITY_TYPE_ON_DEMAND, zone, zone_id, od,
+                    available=self._available(L.CAPACITY_TYPE_ON_DEMAND, info.name, zone)))
+            sp = snap.spot_prices.get((info.name, zone))
+            if sp is not None:
+                offs.append(Offering(
+                    L.CAPACITY_TYPE_SPOT, zone, zone_id, sp,
+                    available=self._available(L.CAPACITY_TYPE_SPOT, info.name, zone)))
+        return offs
+
+    def _available(self, capacity_type: str, name: str, zone: str) -> bool:
+        uo = self.unavailable_offerings
+        return uo is None or not uo.is_unavailable(capacity_type, name, zone)
+
+    # -- resolution (types.go:98-118) -------------------------------------
+    def _resolve(self, info: InstanceTypeInfo, nodeclass: EC2NodeClass,
+                 offerings: Offerings, ami_id: str) -> InstanceType:
+        capacity = self._capacity(info, nodeclass, ami_id)
+        overhead = self._overhead(info, nodeclass, capacity)
+        return InstanceType(
+            name=info.name,
+            requirements=self._requirements(info, offerings),
+            capacity=capacity,
+            overhead=overhead,
+            offerings=offerings,
+        )
+
+    def _requirements(self, info: InstanceTypeInfo, offerings: Offerings) -> Requirements:
+        """The ~20-label requirement set (types.go:183-287)."""
+        zones = sorted({o.zone for o in offerings})
+        zone_ids = sorted({o.zone_id for o in offerings if o.zone_id})
+        cts = sorted({o.capacity_type for o in offerings})
+        reqs = [
+            Requirement.new(L.INSTANCE_TYPE, IN, [info.name]),
+            Requirement.new(L.ARCH, IN, [info.arch]),
+            Requirement.new(L.OS, IN, [L.OS_LINUX]),
+            Requirement.new(L.ZONE, IN, zones),
+            Requirement.new(L.ZONE_ID, IN, zone_ids),
+            Requirement.new(L.CAPACITY_TYPE, IN, cts),
+            Requirement.new(L.INSTANCE_CATEGORY, IN, [info.category]),
+            Requirement.new(L.INSTANCE_FAMILY, IN, [info.family]),
+            Requirement.new(L.INSTANCE_GENERATION, IN, [str(info.generation)]),
+            Requirement.new(L.INSTANCE_SIZE, IN, [info.size]),
+            Requirement.new(L.INSTANCE_CPU, IN, [str(info.vcpus)]),
+            Requirement.new(L.INSTANCE_CPU_MANUFACTURER, IN, [info.cpu_manufacturer]),
+            Requirement.new(L.INSTANCE_MEMORY, IN, [str(info.memory_bytes // MIB)]),
+            Requirement.new(L.INSTANCE_NETWORK_BANDWIDTH, IN,
+                            [str(info.network_bandwidth_mbps)]),
+            Requirement.new(L.INSTANCE_EBS_BANDWIDTH, IN,
+                            [str(info.ebs_bandwidth_mbps)]),
+            Requirement.new(L.INSTANCE_ENCRYPTION_IN_TRANSIT, IN,
+                            [str(info.encryption_in_transit).lower()]),
+        ]
+        if info.hypervisor:
+            reqs.append(Requirement.new(L.INSTANCE_HYPERVISOR, IN, [info.hypervisor]))
+        if info.local_nvme_bytes:
+            reqs.append(Requirement.new(L.INSTANCE_LOCAL_NVME, IN,
+                                        [str(info.local_nvme_bytes // GIB)]))
+        if info.gpu_count:
+            reqs += [
+                Requirement.new(L.INSTANCE_GPU_NAME, IN, [info.gpu_name]),
+                Requirement.new(L.INSTANCE_GPU_MANUFACTURER, IN, [info.gpu_manufacturer]),
+                Requirement.new(L.INSTANCE_GPU_COUNT, IN, [str(info.gpu_count)]),
+                Requirement.new(L.INSTANCE_GPU_MEMORY, IN,
+                                [str(info.gpu_memory_bytes // MIB)]),
+            ]
+        if info.accelerator_count:
+            reqs += [
+                Requirement.new(L.INSTANCE_ACCELERATOR_NAME, IN, [info.accelerator_name]),
+                Requirement.new(L.INSTANCE_ACCELERATOR_MANUFACTURER, IN,
+                                [info.accelerator_manufacturer]),
+                Requirement.new(L.INSTANCE_ACCELERATOR_COUNT, IN,
+                                [str(info.accelerator_count)]),
+            ]
+        return Requirements(reqs)
+
+    def _capacity(self, info: InstanceTypeInfo, nodeclass: EC2NodeClass,
+                  ami_id: str) -> Resources:
+        """types.go:307-478: memory gets the VM-overhead haircut unless a
+        real node taught us the true value (discovered-capacity cache)."""
+        discovered = self._discovered_memory.get((info.name, ami_id))
+        if discovered is not None:
+            memory = discovered
+        else:
+            memory = int(info.memory_bytes * (1 - self._overhead_pct))
+        pods = self._max_pods(info, nodeclass.kubelet)
+        cap = {
+            "cpu": info.vcpus * 1000,
+            "memory": memory,
+            "pods": pods,
+            "ephemeral-storage": _ephemeral_storage(info, nodeclass),
+        }
+        if info.gpu_count:
+            cap[NVIDIA_GPU if info.gpu_manufacturer == "nvidia" else "amd.com/gpu"] = info.gpu_count
+        if info.accelerator_count:
+            cap[AWS_NEURON] = info.accelerator_count
+        if info.efa_count:
+            cap[AWS_EFA] = info.efa_count
+        # pod-ENI trunking capacity on nitro (types.go: pod-eni)
+        if info.hypervisor == "nitro":
+            cap[AWS_POD_ENI] = min(info.enis * 9, 107)
+        return Resources(cap)
+
+    @staticmethod
+    def _max_pods(info: InstanceTypeInfo, kubelet: KubeletConfiguration) -> int:
+        if kubelet.max_pods is not None:
+            return kubelet.max_pods
+        pods = info.eni_pod_limit
+        if kubelet.pods_per_core is not None:
+            pods = min(pods, kubelet.pods_per_core * info.vcpus)
+        return pods
+
+    def _overhead(self, info: InstanceTypeInfo, nodeclass: EC2NodeClass,
+                  capacity: Resources) -> Overhead:
+        """EKS kubelet-overhead formulas (types.go:480-565)."""
+        kubelet = nodeclass.kubelet
+        pods = capacity["pods"]
+        if kubelet.kube_reserved:
+            kube = Resources.parse(kubelet.kube_reserved)
+        else:
+            kube = Resources({
+                "cpu": _reserved_cpu_millis(info.vcpus),
+                "memory": 255 * MIB + 11 * MIB * pods,
+            })
+        system = Resources.parse(kubelet.system_reserved) if kubelet.system_reserved else Resources({
+            "cpu": 100, "memory": 100 * MIB})
+        if kubelet.eviction_hard or kubelet.eviction_soft:
+            ev_mem = 0
+            for spec in (kubelet.eviction_hard, kubelet.eviction_soft):
+                v = spec.get("memory.available")
+                if v:
+                    if v.endswith("%"):
+                        # kubelet accepts fractional percentages (e.g. "7.5%")
+                        ev_mem = max(ev_mem, int(capacity["memory"] * float(v[:-1]) / 100))
+                    else:
+                        ev_mem = max(ev_mem, parse_quantity(v, "memory"))
+            eviction = Resources({"memory": ev_mem})
+        else:
+            eviction = Resources({"memory": 100 * MIB})
+        return Overhead(kube_reserved=kube, system_reserved=system,
+                        eviction_threshold=eviction)
+
+
+def _reserved_cpu_millis(vcpus: int) -> int:
+    """The kubelet CPU-reservation staircase: 6% of the first core, 1% of the
+    next, 0.5% of the next two, 0.25% of the rest."""
+    millis = 0
+    for core in range(vcpus):
+        if core == 0:
+            millis += 60
+        elif core == 1:
+            millis += 10
+        elif core < 4:
+            millis += 5
+        else:
+            millis += 2  # 0.25% of 1000, floor'd to stay integral
+    return millis
+
+
+def _ephemeral_storage(info: InstanceTypeInfo, nodeclass: EC2NodeClass) -> int:
+    if nodeclass.instance_store_policy == "RAID0" and info.local_nvme_bytes:
+        return info.local_nvme_bytes
+    for bdm in nodeclass.block_device_mappings:
+        if bdm.root_volume or len(nodeclass.block_device_mappings) == 1:
+            return parse_quantity(bdm.volume_size, "ephemeral-storage")
+    return 20 * GIB  # default root volume
+
+
+def _kubelet_key(k: KubeletConfiguration) -> tuple:
+    return (k.max_pods, k.pods_per_core,
+            tuple(sorted(k.kube_reserved.items())),
+            tuple(sorted(k.system_reserved.items())),
+            tuple(sorted(k.eviction_hard.items())),
+            tuple(sorted(k.eviction_soft.items())))
+
+
+def _storage_key(nc: EC2NodeClass) -> tuple:
+    return (nc.instance_store_policy,
+            tuple((b.device_name, b.volume_size, b.root_volume)
+                  for b in nc.block_device_mappings))

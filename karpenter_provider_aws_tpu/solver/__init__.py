@@ -1,0 +1,7 @@
+from .cpu import CPUSolver, pod_group_signature, pod_sort_key
+from .types import (DaemonOverhead, ExistingNode, NewNodeClaim, NodePoolSpec,
+                    SchedulingSnapshot, SolveResult, Solver)
+
+__all__ = ["Solver", "CPUSolver", "SchedulingSnapshot", "SolveResult",
+           "NewNodeClaim", "NodePoolSpec", "ExistingNode", "DaemonOverhead",
+           "pod_sort_key", "pod_group_signature"]

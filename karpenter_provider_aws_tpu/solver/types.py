@@ -1,0 +1,119 @@
+"""Solver contract: snapshot in, decisions out.
+
+This is the pluggable boundary the north star demands (BASELINE.json): the
+provisioning controller and the consolidation controller build a
+:class:`SchedulingSnapshot` and call ``Solver.solve``; implementations are
+``cpu`` (the reference-equivalent FFD oracle) and ``tpu`` (batched jit'd
+kernels). Decisions must be identical between the two — the equivalence
+harness in tests/test_solver_equivalence.py enforces it.
+
+The solve semantics mirror the core scheduler the reference drives
+(designs/bin-packing.md:17-42): sort pending pods by descending size,
+first-fit onto open in-flight nodes (whose candidate instance-type sets
+narrow as pods land), open a new node from the highest-weight admitting
+NodePool otherwise, honoring requirements, taints/tolerations, topology
+spread, pod (anti-)affinity, and NodePool resource limits.
+"""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass, field
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple
+
+from ..apis.objects import NodePool, Pod, Taint
+from ..apis.requirements import Requirements
+from ..apis.resources import Resources
+from ..cloudprovider.types import InstanceType, InstanceTypes
+
+
+@dataclass
+class ExistingNode:
+    """A live node (or in-flight NodeClaim from a previous round) the solver
+    may keep packing onto."""
+    name: str
+    labels: Mapping[str, str]
+    allocatable: Resources
+    taints: Sequence[Taint] = ()
+    #: resources already committed (pods bound + daemonsets)
+    used: Resources = field(default_factory=Resources)
+    #: scheduling-group identities of pods already on the node (for topology
+    #: spread / anti-affinity bookkeeping)
+    pod_groups: Sequence[str] = ()
+    nodepool: str = ""
+    instance_type: str = ""
+
+    def remaining(self) -> Resources:
+        return (self.allocatable - self.used).clamp_nonnegative()
+
+
+@dataclass
+class NodePoolSpec:
+    """A NodePool plus its resolved instance-type catalog."""
+    nodepool: NodePool
+    instance_types: InstanceTypes
+    #: resources already provisioned under this pool (for limits)
+    in_use: Resources = field(default_factory=Resources)
+
+
+@dataclass
+class DaemonOverhead:
+    """Aggregate daemonset requests that land on every new node whose
+    requirements admit the daemonset's pods."""
+    requests: Resources = field(default_factory=Resources)
+    requirements: Requirements = field(default_factory=Requirements)
+
+
+@dataclass
+class SchedulingSnapshot:
+    pods: Sequence[Pod]
+    nodepools: Sequence[NodePoolSpec]
+    existing_nodes: Sequence[ExistingNode] = ()
+    daemon_overheads: Sequence[DaemonOverhead] = ()
+    #: zone -> zone_id for topology bookkeeping
+    zones: Mapping[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class NewNodeClaim:
+    """A node the solver decided to create."""
+    nodepool: str
+    requirements: Requirements
+    pod_names: List[str]
+    #: candidate types, cheapest-first; launcher truncates to 60
+    instance_type_names: List[str]
+    requests: Resources
+    taints: Sequence[Taint] = ()
+
+
+@dataclass
+class SolveResult:
+    new_nodes: List[NewNodeClaim]
+    #: pod name -> existing node name
+    existing_assignments: Dict[str, str]
+    #: pod name -> human-readable reason
+    unschedulable: Dict[str, str]
+
+    def summary(self) -> str:
+        return (f"{len(self.new_nodes)} new nodes, "
+                f"{len(self.existing_assignments)} pods onto existing, "
+                f"{len(self.unschedulable)} unschedulable")
+
+    def decision_fingerprint(self) -> Tuple:
+        """A canonical, order-independent encoding of every decision — two
+        solvers are 'identical' iff fingerprints match."""
+        new = tuple(sorted(
+            (n.nodepool, tuple(sorted(n.pod_names)),
+             tuple(n.instance_type_names))
+            for n in self.new_nodes))
+        existing = tuple(sorted(self.existing_assignments.items()))
+        unsched = tuple(sorted(self.unschedulable))
+        return (new, existing, unsched)
+
+
+class Solver(abc.ABC):
+    name: str = "abstract"
+
+    @abc.abstractmethod
+    def solve(self, snapshot: SchedulingSnapshot) -> SolveResult:
+        ...

@@ -1,0 +1,251 @@
+"""CPU solver (FFD oracle) behavior across the BASELINE.json config shapes
+at small scale (designs/bin-packing.md semantics)."""
+
+import pytest
+
+from karpenter_provider_aws_tpu.apis import labels as L
+from karpenter_provider_aws_tpu.apis.objects import (PodAffinityTerm, Taint,
+                                                     Toleration,
+                                                     TopologySpreadConstraint)
+from karpenter_provider_aws_tpu.apis.resources import Resources
+from karpenter_provider_aws_tpu.fake.environment import Environment, make_pods
+from karpenter_provider_aws_tpu.solver import CPUSolver
+from karpenter_provider_aws_tpu.solver.types import ExistingNode
+
+
+@pytest.fixture(scope="module")
+def env():
+    return Environment()
+
+
+@pytest.fixture
+def solver():
+    return CPUSolver()
+
+
+class TestBasicPacking:
+    def test_single_pod(self, env, solver):
+        snap = env.snapshot(make_pods(1, cpu="1", memory="1Gi"),
+                            [env.nodepool("default")])
+        res = solver.solve(snap)
+        assert len(res.new_nodes) == 1
+        assert not res.unschedulable
+        node = res.new_nodes[0]
+        assert node.nodepool == "default"
+        assert len(node.pod_names) == 1
+        # cheapest-first candidates; every candidate fits the pod
+        assert len(node.instance_type_names) > 10
+
+    def test_bin_packs_many_small_pods(self, env, solver):
+        # 50 pods x 500m CPU pack at ~7/node onto cheapest 2-vCPU types
+        # (allocatable ≈ 2000 - reserved ≈ 1720m) — not 50 nodes.
+        pods = make_pods(50, cpu="500m", memory="256Mi")
+        res = solver.solve(env.snapshot(pods, [env.nodepool("default")]))
+        assert not res.unschedulable
+        total = sum(len(n.pod_names) for n in res.new_nodes)
+        assert total == 50
+        assert len(res.new_nodes) < 20
+        # FFD: pods spread so each node has >1 pod
+        assert all(len(n.pod_names) >= 2 for n in res.new_nodes)
+
+    def test_big_pod_gets_big_node(self, env, solver):
+        pods = make_pods(1, cpu="100", memory="200Gi")
+        res = solver.solve(env.snapshot(pods, [env.nodepool("default")]))
+        assert not res.unschedulable
+        for name in res.new_nodes[0].instance_type_names:
+            assert env.instance_types  # types exist
+        # all candidates have >= 100 vCPU
+        cat = {c.name: c for c in env.ec2.catalog}
+        assert all(cat[n].vcpus >= 100 for n in res.new_nodes[0].instance_type_names)
+
+    def test_unschedulable_impossible_pod(self, env, solver):
+        pods = make_pods(1, cpu="10000")  # 10k cores fits nothing
+        res = solver.solve(env.snapshot(pods, [env.nodepool("default")]))
+        assert len(res.unschedulable) == 1
+
+    def test_deterministic(self, env, solver):
+        pods = make_pods(40, cpu="500m", memory="512Mi")
+        snap = env.snapshot(pods, [env.nodepool("default")])
+        a = solver.solve(snap).decision_fingerprint()
+        b = solver.solve(snap).decision_fingerprint()
+        assert a == b
+
+
+class TestRequirements:
+    def test_node_selector_arch(self, env, solver):
+        pods = make_pods(2, node_selector={L.ARCH: "arm64"})
+        res = solver.solve(env.snapshot(pods, [env.nodepool("default")]))
+        assert not res.unschedulable
+        cat = {c.name: c for c in env.ec2.catalog}
+        for n in res.new_nodes:
+            assert all(cat[t].arch == "arm64" for t in n.instance_type_names)
+
+    def test_nodepool_requirements_constrain(self, env, solver):
+        pool = env.nodepool("c-only", requirements=[
+            {"key": L.INSTANCE_CATEGORY, "operator": "In", "values": ["c"]}])
+        res = solver.solve(env.snapshot(make_pods(1), [pool]))
+        cat = {c.name: c for c in env.ec2.catalog}
+        assert all(cat[t].category == "c"
+                   for t in res.new_nodes[0].instance_type_names)
+
+    def test_gt_requirement(self, env, solver):
+        pods = make_pods(1, affinity_terms=[
+            {"key": L.INSTANCE_CPU, "operator": "Gt", "values": ["63"]}])
+        res = solver.solve(env.snapshot(pods, [env.nodepool("default")]))
+        cat = {c.name: c for c in env.ec2.catalog}
+        assert res.new_nodes and all(
+            cat[t].vcpus > 63 for t in res.new_nodes[0].instance_type_names)
+
+    def test_incompatible_zone_unschedulable(self, env, solver):
+        pods = make_pods(1, node_selector={L.ZONE: "eu-central-1a"})
+        res = solver.solve(env.snapshot(pods, [env.nodepool("default")]))
+        assert len(res.unschedulable) == 1
+
+    def test_custom_label_needs_nodepool(self, env, solver):
+        pods = make_pods(1, node_selector={"team": "ml"})
+        res = solver.solve(env.snapshot(pods, [env.nodepool("default")]))
+        assert len(res.unschedulable) == 1
+        pool = env.nodepool("ml", labels={"team": "ml"})
+        res2 = solver.solve(env.snapshot(make_pods(1, node_selector={"team": "ml"}),
+                                         [env.nodepool("default"), pool]))
+        assert not res2.unschedulable
+        assert res2.new_nodes[0].nodepool == "ml"
+
+
+class TestTaints:
+    def test_tainted_pool_needs_toleration(self, env, solver):
+        pool = env.nodepool("gpu", taints=[Taint("gpu", "NoSchedule", "true")])
+        res = solver.solve(env.snapshot(make_pods(1), [pool]))
+        assert len(res.unschedulable) == 1
+        tolerating = make_pods(1, tolerations=[
+            Toleration(key="gpu", operator="Equal", value="true", effect="NoSchedule")])
+        res2 = solver.solve(env.snapshot(tolerating, [pool]))
+        assert not res2.unschedulable
+
+    def test_separate_pools_by_taint(self, env, solver):
+        plain = env.nodepool("plain")
+        tainted = env.nodepool("tainted", taints=[Taint("dedicated", "NoSchedule", "a")],
+                               weight=10)
+        pods = make_pods(3)  # no tolerations -> must land on plain despite weight
+        res = solver.solve(env.snapshot(pods, [tainted, plain]))
+        assert not res.unschedulable
+        assert {n.nodepool for n in res.new_nodes} == {"plain"}
+
+
+class TestWeightAndLimits:
+    def test_weight_preference(self, env, solver):
+        low = env.nodepool("low", weight=1)
+        high = env.nodepool("high", weight=100)
+        res = solver.solve(env.snapshot(make_pods(5), [low, high]))
+        assert {n.nodepool for n in res.new_nodes} == {"high"}
+
+    def test_limits_overflow_to_next_pool(self, env, solver):
+        first = env.nodepool("first", weight=100, limits={"cpu": "2"})
+        second = env.nodepool("second", weight=1)
+        pods = make_pods(30, cpu="1")  # 30 cores >> 2-core limit on first
+        res = solver.solve(env.snapshot(pods, [first, second]))
+        assert not res.unschedulable
+        pools = {n.nodepool for n in res.new_nodes}
+        assert "second" in pools and "first" in pools
+        first_cpu = sum(n.requests["cpu"] for n in res.new_nodes
+                        if n.nodepool == "first")
+        assert first_cpu <= 3000  # limit + at most one in-flight pod over
+
+
+class TestExistingNodes:
+    def test_prefers_existing_capacity(self, env, solver):
+        node = ExistingNode(
+            name="node-a",
+            labels={L.ARCH: "amd64", L.OS: "linux", L.ZONE: "us-west-2a",
+                    L.NODEPOOL: "default", L.INSTANCE_TYPE: "m5.xlarge"},
+            allocatable=Resources.parse({"cpu": "3500m", "memory": "14Gi", "pods": 58}),
+        )
+        pods = make_pods(3, cpu="500m")
+        res = solver.solve(env.snapshot(pods, [env.nodepool("default")],
+                                        existing_nodes=[node]))
+        assert len(res.existing_assignments) == 3
+        assert not res.new_nodes
+
+    def test_existing_full_overflows_to_new(self, env, solver):
+        node = ExistingNode(
+            name="node-a",
+            labels={L.ARCH: "amd64", L.OS: "linux", L.ZONE: "us-west-2a"},
+            allocatable=Resources.parse({"cpu": "1", "memory": "2Gi", "pods": 10}),
+        )
+        pods = make_pods(4, cpu="500m")
+        res = solver.solve(env.snapshot(pods, [env.nodepool("default")],
+                                        existing_nodes=[node]))
+        assert len(res.existing_assignments) == 2
+        assert sum(len(n.pod_names) for n in res.new_nodes) == 2
+
+    def test_existing_taint_respected(self, env, solver):
+        node = ExistingNode(
+            name="node-t", labels={L.ARCH: "amd64", L.OS: "linux"},
+            allocatable=Resources.parse({"cpu": "4", "memory": "8Gi", "pods": 50}),
+            taints=[Taint("dedicated", "NoSchedule", "x")])
+        res = solver.solve(env.snapshot(make_pods(1), [env.nodepool("default")],
+                                        existing_nodes=[node]))
+        assert not res.existing_assignments
+        assert len(res.new_nodes) == 1
+
+
+class TestTopologySpread:
+    def test_zone_spread(self, env, solver):
+        spread = [TopologySpreadConstraint(max_skew=1, topology_key=L.ZONE)]
+        pods = make_pods(6, cpu="1", topology_spread=spread, group="web")
+        res = solver.solve(env.snapshot(pods, [env.nodepool("default")]))
+        assert not res.unschedulable
+        zones = {}
+        for n in res.new_nodes:
+            z = n.requirements[L.ZONE]
+            assert len(z) == 1  # zone got pinned by the spread
+            zv = z.any_value()
+            zones[zv] = zones.get(zv, 0) + len(n.pod_names)
+        assert max(zones.values()) - min(zones.values()) <= 1
+        assert len(zones) >= 3
+
+    def test_hostname_spread_forces_one_per_node(self, env, solver):
+        spread = [TopologySpreadConstraint(max_skew=1, topology_key=L.HOSTNAME)]
+        pods = make_pods(5, cpu="100m", topology_spread=spread, group="api")
+        res = solver.solve(env.snapshot(pods, [env.nodepool("default")]))
+        assert not res.unschedulable
+        assert len(res.new_nodes) == 5
+        assert all(len(n.pod_names) == 1 for n in res.new_nodes)
+
+
+class TestAntiAffinity:
+    def test_hostname_anti_affinity(self, env, solver):
+        anti = [PodAffinityTerm(topology_key=L.HOSTNAME, group="db", anti=True)]
+        pods = make_pods(4, cpu="100m", pod_affinity=anti, group="db")
+        res = solver.solve(env.snapshot(pods, [env.nodepool("default")]))
+        assert not res.unschedulable
+        assert len(res.new_nodes) == 4
+
+    def test_zone_anti_affinity_limited_by_zones(self, env, solver):
+        anti = [PodAffinityTerm(topology_key=L.ZONE, group="zk", anti=True)]
+        pods = make_pods(6, cpu="100m", pod_affinity=anti, group="zk")
+        res = solver.solve(env.snapshot(pods, [env.nodepool("default")]))
+        # only 4 zones -> only 4 can schedule
+        assert len(res.unschedulable) == 2
+        assert len(res.new_nodes) == 4
+
+    def test_affinity_coschedule(self, env, solver):
+        affinity = [PodAffinityTerm(topology_key=L.ZONE, group="cache", anti=False)]
+        pods = make_pods(4, cpu="100m", pod_affinity=affinity, group="cache")
+        res = solver.solve(env.snapshot(pods, [env.nodepool("default")]))
+        assert not res.unschedulable
+        zones = set()
+        for n in res.new_nodes:
+            z = n.requirements.get(L.ZONE)
+            if z is not None and len(z) == 1:
+                zones.add(z.any_value())
+        assert len(zones) <= 1  # all co-located in one zone
+
+
+class TestSpotOnDemand:
+    def test_spot_requirement_filters_offerings(self, env, solver):
+        pods = make_pods(1, node_selector={L.CAPACITY_TYPE: "spot"})
+        res = solver.solve(env.snapshot(pods, [env.nodepool("default")]))
+        assert not res.unschedulable
+        ct = res.new_nodes[0].requirements[L.CAPACITY_TYPE]
+        assert ct.has("spot") and not ct.has("on-demand")

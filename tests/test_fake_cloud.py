@@ -1,0 +1,146 @@
+"""Fake catalog + fake EC2 behavior (models pkg/fake/ec2api.go semantics)."""
+
+import pytest
+
+from karpenter_provider_aws_tpu.fake import (FakeEC2, FakeLaunchTemplate,
+                                             build_catalog, spot_price)
+
+
+@pytest.fixture
+def ec2():
+    return FakeEC2()
+
+
+class TestCatalog:
+    def test_scale(self):
+        cat = build_catalog()
+        # same order of magnitude as the ~850-type real catalog
+        assert len(cat) > 300
+        names = [c.name for c in cat]
+        assert len(names) == len(set(names))
+
+    def test_deterministic(self):
+        a, b = build_catalog(), build_catalog()
+        assert a == b
+
+    def test_shapes(self):
+        cat = {c.name: c for c in build_catalog()}
+        m = cat["m6i.2xlarge"]
+        assert m.vcpus == 8 and m.memory_bytes == 32 * 1024**3
+        assert m.arch == "amd64" and m.hypervisor == "nitro"
+        assert m.eni_pod_limit == 58
+        g = cat["g5.12xlarge"]
+        assert g.gpu_count == 4 and g.gpu_name == "a10g"
+        t = cat["trn1.32xlarge"]
+        assert t.accelerator_count == 16 and t.accelerator_name == "trainium"
+        arm = cat["c7g.xlarge"]
+        assert arm.arch == "arm64" and arm.cpu_manufacturer == "aws"
+        metal = cat["c5.metal"]
+        assert metal.bare_metal and metal.hypervisor == ""
+
+    def test_pricing(self):
+        cat = {c.name: c for c in build_catalog()}
+        assert cat["m5.large"].od_price == pytest.approx(96_000, abs=1000)
+        # spot is 25-45% of OD, deterministic, zone-dependent
+        sp_a = spot_price(cat["m5.large"], "us-west-2a")
+        sp_b = spot_price(cat["m5.large"], "us-west-2b")
+        assert 0.25 * cat["m5.large"].od_price <= sp_a <= 0.45 * cat["m5.large"].od_price
+        assert sp_a == spot_price(cat["m5.large"], "us-west-2a")
+        assert sp_a != sp_b
+        # larger is proportionally pricier
+        assert cat["m5.xlarge"].od_price == 2 * cat["m5.large"].od_price
+
+
+class TestFakeEC2:
+    def test_offerings_partial_rollout(self, ec2):
+        offs = set(ec2.describe_instance_type_offerings())
+        assert ("m5.large", "us-west-2a") in offs
+        assert ("m7i.large", "us-west-2a") in offs
+        assert ("m7i.large", "us-west-2d") not in offs  # gen7 not in last zone
+
+    def test_network_discovery(self, ec2):
+        subnets = ec2.describe_subnets(tag_filters={"karpenter.sh/discovery": "cluster"})
+        assert len(subnets) == 4
+        assert ec2.describe_subnets(tag_filters={"nope": "x"}) == []
+        sgs = ec2.describe_security_groups(tag_filters={"karpenter.sh/discovery": "cluster"})
+        assert [g.id for g in sgs] == ["sg-nodes"]
+
+    def test_images_and_ssm(self, ec2):
+        amis = ec2.describe_images()
+        assert len(amis) == 6
+        img_id = ec2.ssm_get_parameter("/aws/service/al2023/amd64/latest/image_id")
+        assert any(i.id == img_id and i.arch == "amd64" for i in amis)
+
+    def test_create_fleet_launches(self, ec2):
+        ec2.create_launch_template(FakeLaunchTemplate(
+            id="", name="lt-a", image_id="ami-1", security_group_ids=["sg-nodes"],
+            user_data="", tags={"karpenter.sh/nodepool": "default"}))
+        instances, errors = ec2.create_fleet(
+            [{"launch_template_name": "lt-a", "overrides": [
+                {"instance_type": "m5.large", "zone": "us-west-2a",
+                 "subnet_id": "subnet-usw2-az1", "priority": 0},
+                {"instance_type": "m5.xlarge", "zone": "us-west-2b",
+                 "subnet_id": "subnet-usw2-az2", "priority": 1},
+            ]}],
+            target_capacity=2, capacity_type="on-demand")
+        assert errors == []
+        assert len(instances) == 2
+        assert all(i.instance_type == "m5.large" for i in instances)  # best priority
+        assert instances[0].provider_id.startswith("aws:///us-west-2a/i-")
+        assert instances[0].tags["karpenter.sh/nodepool"] == "default"
+
+    def test_create_fleet_ice_falls_through(self, ec2):
+        ec2.create_launch_template(FakeLaunchTemplate(
+            id="", name="lt-a", image_id="ami-1", security_group_ids=[], user_data=""))
+        ec2.insufficient_capacity_pools.add(("m5.large", "us-west-2a", "spot"))
+        instances, errors = ec2.create_fleet(
+            [{"launch_template_name": "lt-a", "overrides": [
+                {"instance_type": "m5.large", "zone": "us-west-2a", "priority": 0},
+                {"instance_type": "m5.xlarge", "zone": "us-west-2b", "priority": 1},
+            ]}],
+            target_capacity=1, capacity_type="spot")
+        assert len(errors) == 1 and errors[0]["code"] == "InsufficientInstanceCapacity"
+        assert len(instances) == 1 and instances[0].instance_type == "m5.xlarge"
+
+    def test_terminate_and_describe(self, ec2):
+        ec2.create_launch_template(FakeLaunchTemplate(
+            id="", name="lt", image_id="ami-1", security_group_ids=[], user_data=""))
+        instances, _ = ec2.create_fleet(
+            [{"launch_template_name": "lt", "overrides": [
+                {"instance_type": "c5.large", "zone": "us-west-2a"}]}],
+            target_capacity=3, capacity_type="on-demand")
+        ids = [i.id for i in instances]
+        assert len(ec2.describe_instances()) == 3
+        ec2.terminate_instances(ids[:1])
+        live = ec2.describe_instances()
+        assert len(live) == 2
+        assert len(ec2.describe_instances(states=("terminated",))) == 1
+
+    def test_tags_and_call_capture(self, ec2):
+        ec2.create_launch_template(FakeLaunchTemplate(
+            id="", name="lt", image_id="ami-1", security_group_ids=[], user_data=""))
+        instances, _ = ec2.create_fleet(
+            [{"launch_template_name": "lt", "overrides": [
+                {"instance_type": "c5.large", "zone": "us-west-2a"}]}],
+            target_capacity=1, capacity_type="on-demand")
+        ec2.create_tags([instances[0].id], {"Name": "node-1"})
+        assert ec2.instances[instances[0].id].tags["Name"] == "node-1"
+        assert ec2.create_fleet_log.called_times == 1
+        assert ec2.create_tags_log.called_times == 1
+        with pytest.raises(KeyError):
+            ec2.create_tags(["i-nonexistent"], {"a": "b"})
+
+    def test_error_injection_one_shot(self, ec2):
+        ec2.describe_instances_log.error = RuntimeError("throttled")
+        with pytest.raises(RuntimeError):
+            ec2.describe_instances()
+        assert ec2.describe_instances() == []  # error consumed
+
+    def test_reset(self, ec2):
+        ec2.insufficient_capacity_pools.add(("a", "b", "c"))
+        ec2.create_launch_template(FakeLaunchTemplate(
+            id="", name="lt", image_id="x", security_group_ids=[], user_data=""))
+        ec2.reset()
+        assert not ec2.insufficient_capacity_pools
+        assert not ec2.launch_templates
+        assert ec2.create_launch_template_log.called_times == 0

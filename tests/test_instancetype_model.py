@@ -1,0 +1,111 @@
+"""InstanceType/Offering model semantics (core contract, SURVEY §1/L5)."""
+
+import pytest
+
+from karpenter_provider_aws_tpu.apis import labels as L
+from karpenter_provider_aws_tpu.apis.requirements import (IN, NOT_IN,
+                                                          Requirement,
+                                                          Requirements)
+from karpenter_provider_aws_tpu.apis.resources import Resources
+from karpenter_provider_aws_tpu.cloudprovider import (InstanceType,
+                                                      InstanceTypes, Offering,
+                                                      Offerings, Overhead, usd)
+
+
+def mk_type(name, cpu_m, mem_gib, zones=("us-west-2a",), price=1_000_000,
+            arch="amd64", family=None, spot_price=None):
+    family = family or name.split(".")[0]
+    offs = Offerings()
+    for z in zones:
+        offs.append(Offering("on-demand", z, z + "-id", price))
+        if spot_price is not None:
+            offs.append(Offering("spot", z, z + "-id", spot_price))
+    return InstanceType(
+        name=name,
+        requirements=Requirements([
+            Requirement.new(L.INSTANCE_TYPE, IN, [name]),
+            Requirement.new(L.ARCH, IN, [arch]),
+            Requirement.new(L.INSTANCE_FAMILY, IN, [family]),
+            Requirement.new(L.ZONE, IN, list(zones)),
+            Requirement.new(L.CAPACITY_TYPE, IN,
+                            ["on-demand"] + (["spot"] if spot_price else [])),
+        ]),
+        capacity=Resources({"cpu": cpu_m, "memory": mem_gib * 1024**3, "pods": 110}),
+        overhead=Overhead(kube_reserved=Resources({"cpu": 80, "memory": 500 * 1024**2})),
+        offerings=offs,
+    )
+
+
+def test_allocatable():
+    it = mk_type("m5.large", 2000, 8)
+    alloc = it.allocatable()
+    assert alloc["cpu"] == 1920
+    assert alloc["memory"] == 8 * 1024**3 - 500 * 1024**2
+    assert alloc["pods"] == 110
+
+
+def test_offerings_filtering():
+    it = mk_type("m5.large", 2000, 8, zones=("us-west-2a", "us-west-2b"),
+                 spot_price=300_000)
+    reqs = Requirements([Requirement.new(L.CAPACITY_TYPE, IN, ["spot"])])
+    offs = it.offerings.available().compatible(reqs)
+    assert len(offs) == 2 and all(o.capacity_type == "spot" for o in offs)
+    assert it.cheapest_price() == 300_000
+    assert it.cheapest_price(Requirements([
+        Requirement.new(L.CAPACITY_TYPE, IN, ["on-demand"])])) == 1_000_000
+
+
+def test_compatible_requires_available_offering():
+    it = mk_type("m5.large", 2000, 8, zones=("us-west-2a",))
+    its = InstanceTypes([it])
+    ok = its.compatible(Requirements([Requirement.new(L.ZONE, IN, ["us-west-2a"])]))
+    assert len(ok) == 1
+    none = its.compatible(Requirements([Requirement.new(L.ZONE, IN, ["us-west-2z"])]))
+    assert len(none) == 0
+    # mark sole offering unavailable -> incompatible even though reqs match
+    it.offerings[0] = Offering("on-demand", "us-west-2a", "us-west-2a-id",
+                               1_000_000, available=False)
+    assert len(its.compatible(Requirements([]))) == 0
+
+
+def test_order_by_price_and_truncate():
+    types = InstanceTypes([
+        mk_type("a.large", 2000, 4, price=300_000),
+        mk_type("b.large", 2000, 4, price=100_000),
+        mk_type("c.large", 2000, 4, price=200_000),
+    ])
+    ordered = types.order_by_price()
+    assert [t.name for t in ordered] == ["b.large", "c.large", "a.large"]
+    trunc = types.truncate(Requirements([]), max_items=2)
+    assert [t.name for t in trunc] == ["b.large", "c.large"]
+
+
+def test_truncate_honors_min_values():
+    # 5 families, cheapest 2 are both family "a" — minValues=3 on family must
+    # pull in extra types beyond the truncation limit.
+    types = InstanceTypes([
+        mk_type("a.small", 1000, 2, price=100_000, family="a"),
+        mk_type("a.large", 2000, 4, price=110_000, family="a"),
+        mk_type("b.large", 2000, 4, price=200_000, family="b"),
+        mk_type("c.large", 2000, 4, price=300_000, family="c"),
+    ])
+    reqs = Requirements([
+        Requirement.new(L.INSTANCE_FAMILY, IN, ["a", "b", "c"], min_values=3)])
+    trunc = types.truncate(reqs, max_items=2)
+    families = {t.requirements[L.INSTANCE_FAMILY].any_value() for t in trunc}
+    assert families == {"a", "b", "c"}
+    with pytest.raises(ValueError):
+        InstanceTypes(types[:2]).truncate(
+            Requirements([Requirement.new(L.INSTANCE_FAMILY, IN,
+                                          ["a", "b", "c"], min_values=3)]),
+            max_items=2)
+
+
+def test_worst_and_cheapest():
+    offs = Offerings([
+        Offering("spot", "z1", "z1i", 100),
+        Offering("on-demand", "z1", "z1i", 300),
+        Offering("spot", "z2", "z2i", 200, available=False),
+    ])
+    assert offs.cheapest().price == 100
+    assert offs.available().worst_price() == 300

@@ -1,0 +1,183 @@
+"""Requirements-algebra semantics, mirroring the core library's behavior the
+reference relies on (SURVEY §2.4; types.go:183-287, cloudprovider.go:329)."""
+
+import pytest
+
+from karpenter_provider_aws_tpu.apis import labels as L
+from karpenter_provider_aws_tpu.apis.requirements import (
+    DOES_NOT_EXIST, EXISTS, GT, IN, LT, NOT_IN, Requirement, Requirements)
+
+
+class TestRequirement:
+    def test_in(self):
+        r = Requirement.new("k", IN, ["a", "b"])
+        assert r.has("a") and r.has("b") and not r.has("c")
+        assert len(r) == 2
+        assert not r.satisfied_by_absence()
+
+    def test_not_in(self):
+        r = Requirement.new("k", NOT_IN, ["a"])
+        assert not r.has("a") and r.has("b")
+        assert r.satisfied_by_absence()
+
+    def test_exists(self):
+        r = Requirement.new("k", EXISTS)
+        assert r.has("anything")
+        assert not r.satisfied_by_absence()
+
+    def test_does_not_exist(self):
+        r = Requirement.new("k", DOES_NOT_EXIST)
+        assert not r.has("x")
+        assert r.satisfied_by_absence()
+        assert r.is_empty()
+
+    def test_gt_lt(self):
+        gt = Requirement.new("cpu", GT, ["4"])
+        assert gt.has("5") and gt.has("100")
+        assert not gt.has("4") and not gt.has("3") and not gt.has("abc")
+        lt = Requirement.new("cpu", LT, ["8"])
+        assert lt.has("7") and not lt.has("8")
+        both = gt.intersection(lt)
+        assert both.has("5") and both.has("7")
+        assert not both.has("4") and not both.has("8")
+        assert not both.is_empty()
+
+    def test_gt_lt_empty_range(self):
+        gt = Requirement.new("cpu", GT, ["4"])
+        lt = Requirement.new("cpu", LT, ["5"])
+        assert gt.intersection(lt).is_empty()
+
+    def test_in_intersect_in(self):
+        a = Requirement.new("k", IN, ["a", "b", "c"])
+        b = Requirement.new("k", IN, ["b", "c", "d"])
+        i = a.intersection(b)
+        assert sorted(i.values) == ["b", "c"] and not i.complement
+
+    def test_in_intersect_notin(self):
+        a = Requirement.new("k", IN, ["a", "b"])
+        b = Requirement.new("k", NOT_IN, ["b"])
+        i = a.intersection(b)
+        assert i.has("a") and not i.has("b")
+        assert a.intersects(b)
+
+    def test_in_intersect_disjoint(self):
+        a = Requirement.new("k", IN, ["a"])
+        b = Requirement.new("k", IN, ["b"])
+        assert not a.intersects(b)
+
+    def test_notin_intersect_notin(self):
+        a = Requirement.new("k", NOT_IN, ["a"])
+        b = Requirement.new("k", NOT_IN, ["b"])
+        i = a.intersection(b)
+        assert i.complement and not i.has("a") and not i.has("b") and i.has("c")
+
+    def test_in_intersect_gt_filters_values(self):
+        a = Requirement.new("cpu", IN, ["2", "4", "8"])
+        b = Requirement.new("cpu", GT, ["3"])
+        i = a.intersection(b)
+        assert i.has("4") and i.has("8") and not i.has("2")
+        assert len(i) == 2
+
+    def test_exists_intersect_in(self):
+        a = Requirement.new("k", EXISTS)
+        b = Requirement.new("k", IN, ["x"])
+        i = a.intersection(b)
+        assert i.has("x") and len(i) == 1
+
+    def test_min_values_propagates_max(self):
+        a = Requirement.new("k", IN, ["a", "b"], min_values=2)
+        b = Requirement.new("k", EXISTS, min_values=3)
+        assert a.intersection(b).min_values == 3
+
+    def test_any_value_deterministic(self):
+        r = Requirement.new("k", IN, ["z", "a", "m"])
+        assert r.any_value() == "a"
+
+
+class TestRequirements:
+    def test_same_key_intersects_on_construction(self):
+        reqs = Requirements([
+            Requirement.new("k", IN, ["a", "b"]),
+            Requirement.new("k", NOT_IN, ["b"]),
+        ])
+        assert reqs["k"].has("a") and not reqs["k"].has("b")
+
+    def test_compatible_basic(self):
+        node = Requirements([
+            Requirement.new(L.ARCH, IN, ["amd64"]),
+            Requirement.new(L.ZONE, IN, ["us-west-2a", "us-west-2b"]),
+        ])
+        pod = Requirements([Requirement.new(L.ZONE, IN, ["us-west-2b"])])
+        assert node.is_compatible(pod)
+        pod2 = Requirements([Requirement.new(L.ZONE, IN, ["us-west-2c"])])
+        assert node.compatible(pod2) == [L.ZONE]
+
+    def test_compatible_undefined_well_known_allowed(self):
+        node = Requirements([Requirement.new(L.ARCH, IN, ["amd64"])])
+        pod = Requirements([Requirement.new(L.INSTANCE_CPU, GT, ["4"])])
+        # instance-cpu is well-known: instance types will define it later.
+        assert node.is_compatible(pod)
+
+    def test_compatible_undefined_custom_label_rejected(self):
+        node = Requirements([Requirement.new(L.ARCH, IN, ["amd64"])])
+        pod = Requirements([Requirement.new("team", IN, ["ml"])])
+        assert node.compatible(pod) == ["team"]
+        # ...but NotIn / DoesNotExist on an undefined label is satisfied by absence
+        pod2 = Requirements([Requirement.new("team", NOT_IN, ["web"])])
+        assert node.is_compatible(pod2)
+        pod3 = Requirements([Requirement.new("team", DOES_NOT_EXIST)])
+        assert node.is_compatible(pod3)
+
+    def test_satisfied_by_labels(self):
+        reqs = Requirements([
+            Requirement.new(L.ARCH, IN, ["arm64"]),
+            Requirement.new("team", NOT_IN, ["web"]),
+        ])
+        assert reqs.satisfied_by_labels({L.ARCH: "arm64"})
+        assert reqs.satisfied_by_labels({L.ARCH: "arm64", "team": "ml"})
+        assert not reqs.satisfied_by_labels({L.ARCH: "arm64", "team": "web"})
+        assert not reqs.satisfied_by_labels({L.ARCH: "amd64"})
+
+    def test_single_values(self):
+        reqs = Requirements([
+            Requirement.new(L.INSTANCE_TYPE, IN, ["m5.large"]),
+            Requirement.new(L.ZONE, IN, ["a", "b"]),
+            Requirement.new("x", EXISTS),
+        ])
+        assert reqs.single_values() == {L.INSTANCE_TYPE: "m5.large"}
+
+    def test_min_values_violations(self):
+        reqs = Requirements([
+            Requirement.new(L.INSTANCE_FAMILY, EXISTS, min_values=3),
+        ])
+        assert reqs.min_values_violations({L.INSTANCE_FAMILY: 2}) == [L.INSTANCE_FAMILY]
+        assert reqs.min_values_violations({L.INSTANCE_FAMILY: 3}) == []
+
+    def test_round_trip_terms(self):
+        terms = [
+            {"key": L.ARCH, "operator": "In", "values": ["amd64"]},
+            {"key": L.INSTANCE_CPU, "operator": "Gt", "values": ["8"]},
+            {"key": "team", "operator": "NotIn", "values": ["web"]},
+            {"key": L.INSTANCE_FAMILY, "operator": "Exists", "minValues": 5},
+        ]
+        reqs = Requirements.from_terms(terms)
+        back = Requirements.from_terms(reqs.to_terms())
+        assert back == reqs
+
+    def test_conflicts_reports_conflicts(self):
+        a = Requirements([Requirement.new(L.ZONE, IN, ["a"])])
+        b = Requirements([Requirement.new(L.ZONE, IN, ["b"]),
+                          Requirement.new(L.ARCH, IN, ["amd64"])])
+        assert a.conflicts(b) == [L.ZONE]
+        assert b.conflicts(a) == [L.ZONE]
+
+
+class TestLabels:
+    def test_restricted(self):
+        assert L.is_restricted_label("karpenter.sh/custom")
+        assert not L.is_restricted_label(L.NODEPOOL)  # well-known
+        assert not L.is_restricted_label("karpenter.k8s.aws/whatever")
+        assert not L.is_restricted_label("myteam.io/app")
+        assert L.is_restricted_tag("karpenter.sh/nodepool")
+        assert L.is_restricted_tag("kubernetes.io/cluster/my-cluster")
+        assert not L.is_restricted_tag("team")

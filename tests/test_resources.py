@@ -1,0 +1,79 @@
+import pytest
+
+from karpenter_provider_aws_tpu.apis.resources import (
+    Resources, format_quantity, parse_quantity, sum_resources)
+
+
+def test_parse_cpu():
+    assert parse_quantity("1", "cpu") == 1000
+    assert parse_quantity("100m", "cpu") == 100
+    assert parse_quantity("2.5", "cpu") == 2500
+    assert parse_quantity(2, "cpu") == 2000
+
+
+def test_parse_memory():
+    assert parse_quantity("1Gi", "memory") == 1024**3
+    assert parse_quantity("512Mi", "memory") == 512 * 1024**2
+    assert parse_quantity("1G", "memory") == 10**9
+    assert parse_quantity("128", "memory") == 128
+
+
+def test_parse_counts():
+    assert parse_quantity("4", "nvidia.com/gpu") == 4
+    assert parse_quantity(110, "pods") == 110
+
+
+def test_parse_invalid():
+    with pytest.raises(ValueError):
+        parse_quantity("abc", "cpu")
+
+
+def test_arithmetic():
+    a = Resources.parse({"cpu": "1", "memory": "1Gi"})
+    b = Resources.parse({"cpu": "500m", "memory": "512Mi", "pods": 3})
+    s = a + b
+    assert s["cpu"] == 1500 and s["memory"] == 1024**3 + 512 * 1024**2 and s["pods"] == 3
+    d = a - b
+    assert d["cpu"] == 500 and d["pods"] == -3
+    assert d.clamp_nonnegative()["pods"] == 0
+
+
+def test_fits():
+    cap = Resources.parse({"cpu": "4", "memory": "8Gi", "pods": 110})
+    req = Resources.parse({"cpu": "3500m", "memory": "6Gi"})
+    assert req.fits(cap)
+    too_big = Resources.parse({"cpu": "5"})
+    assert not too_big.fits(cap)
+    # extended resource not present in capacity
+    gpu = Resources.parse({"nvidia.com/gpu": 1})
+    assert not gpu.fits(cap)
+
+
+def test_zero_canonicalization():
+    assert Resources({"cpu": 0}) == Resources()
+    assert len(Resources({"cpu": 0, "memory": 5})) == 1
+    assert Resources({"cpu": 1}) - Resources({"cpu": 1}) == Resources()
+
+
+def test_merge_max_and_sum():
+    a = Resources({"cpu": 100, "memory": 10})
+    b = Resources({"cpu": 50, "memory": 20})
+    m = a.merge_max(b)
+    assert m["cpu"] == 100 and m["memory"] == 20
+    assert sum_resources([a, b])["cpu"] == 150
+
+
+def test_format():
+    assert format_quantity(1500, "cpu") == "1500m"
+    assert format_quantity(2000, "cpu") == "2"
+    assert format_quantity(1024**3, "memory") == "1Gi"
+    assert format_quantity(7, "pods") == "7"
+
+
+def test_hashable():
+    assert hash(Resources({"cpu": 1})) == hash(Resources({"cpu": 1, "memory": 0}))
+
+
+def test_parse_rejects_negative():
+    with pytest.raises(ValueError):
+        Resources.parse({"cpu": "-1"})
