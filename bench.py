@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""Headline benchmark: 50k pending pods vs the full instance catalog.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "ms", "vs_baseline": N}
+
+- metric: p99 wall-clock of a full TPU-solver solve (encode -> device
+  kernel -> decode) over BASELINE.json config-2-shaped input (50k mixed
+  pods, full catalog, spot+OD), steady-state (warm jit cache, like the
+  production loop where the catalog seqnum is stable between refreshes).
+- vs_baseline: CPU-oracle latency / TPU latency on the identical snapshot
+  (how much faster the TPU path is than the reference-equivalent
+  single-threaded FFD), decisions verified identical first.
+
+Usage: python bench.py [--pods N] [--rounds N] [--backend jax|numpy]
+"""
+
+import argparse
+import json
+import statistics
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+
+def build_snapshot(env, n_pods):
+    from karpenter_provider_aws_tpu.apis import labels as L
+    from karpenter_provider_aws_tpu.fake.environment import make_pods
+
+    # BASELINE config-2 shape: mixed pods, selectors, spot/OD, full catalog
+    n_small = int(n_pods * 0.60)
+    n_med = int(n_pods * 0.25)
+    n_spot = int(n_pods * 0.10)
+    n_arm = n_pods - n_small - n_med - n_spot
+    pods = (
+        make_pods(n_small, cpu="250m", memory="512Mi", prefix="small")
+        + make_pods(n_med, cpu="1", memory="2Gi", prefix="med")
+        + make_pods(n_spot, cpu="2", memory="4Gi", prefix="spot",
+                    node_selector={L.CAPACITY_TYPE: "spot"})
+        + make_pods(n_arm, cpu="500m", memory="1Gi", prefix="arm",
+                    node_selector={L.ARCH: "arm64"})
+    )
+    return env.snapshot(pods, [env.nodepool("bench-pool")])
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--pods", type=int, default=50_000)
+    ap.add_argument("--rounds", type=int, default=12)
+    ap.add_argument("--backend", default="jax", choices=["jax", "numpy"])
+    args = ap.parse_args()
+
+    from karpenter_provider_aws_tpu.fake.environment import Environment
+    from karpenter_provider_aws_tpu.solver import CPUSolver
+    from karpenter_provider_aws_tpu.solver.tpu import TPUSolver
+
+    env = Environment()
+    snap = build_snapshot(env, args.pods)
+    tpu = TPUSolver(backend=args.backend)
+    cpu = CPUSolver()
+
+    # correctness gate: decisions must be identical before timing means anything
+    t0 = time.perf_counter()
+    ref = cpu.solve(snap)
+    cpu_ms = (time.perf_counter() - t0) * 1000
+    got = tpu.solve(snap)  # also warms the jit cache
+    if ref.decision_fingerprint() != got.decision_fingerprint():
+        print(json.dumps({"metric": "EQUIVALENCE FAILURE", "value": -1,
+                          "unit": "ms", "vs_baseline": 0}))
+        sys.exit(1)
+
+    times = []
+    for _ in range(args.rounds):
+        t0 = time.perf_counter()
+        tpu.solve(snap)
+        times.append((time.perf_counter() - t0) * 1000)
+    times.sort()
+    p99 = times[min(len(times) - 1, int(len(times) * 0.99))]
+
+    print(json.dumps({
+        "metric": f"solve p99 @ {args.pods} pods x {len(snap.nodepools[0].instance_types)} types ({args.backend})",
+        "value": round(p99, 2),
+        "unit": "ms",
+        "vs_baseline": round(cpu_ms / p99, 2),
+        "extra": {
+            "median_ms": round(statistics.median(times), 2),
+            "cpu_oracle_ms": round(cpu_ms, 1),
+            "decisions": ref.summary(),
+            "identical_decisions": True,
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -190,10 +190,13 @@ class Pod(KubeObject):
         return f"{self.metadata.namespace}/{self.metadata.name}"
 
     def effective_requests(self) -> Resources:
-        """requests + the implicit 1-pod slot."""
-        if self.requests["pods"] == 0:
-            return self.requests + Resources({"pods": 1})
-        return self.requests
+        """requests + the implicit 1-pod slot. Memoized (hot path)."""
+        cached = getattr(self, "_eff_requests", None)
+        if cached is None:
+            cached = self.requests + Resources({"pods": 1}) \
+                if self.requests["pods"] == 0 else self.requests
+            self._eff_requests = cached
+        return cached
 
     def is_pending_unscheduled(self) -> bool:
         return self.phase == "Pending" and not self.node_name \

@@ -69,6 +69,12 @@ class Requirement:
     greater_than: Optional[int] = None  # exclusive lower bound
     less_than: Optional[int] = None     # exclusive upper bound
     min_values: Optional[int] = None
+    #: True only for intersection results proven unsatisfiable even by label
+    #: absence (e.g. In{a} ∩ In{b}). Distinguishes "empty In" from
+    #: DoesNotExist, which absence satisfies — the distinction upstream
+    #: karpenter keeps by special-casing NotIn/DoesNotExist operators in
+    #: Requirements.Intersects.
+    impossible: bool = False
 
     # -- constructors ------------------------------------------------------
     @staticmethod
@@ -122,6 +128,8 @@ class Requirement:
         return True
 
     def has(self, value: str) -> bool:
+        if self.impossible:
+            return False
         value = str(value)
         if self.complement:
             return value not in self.values and self._in_bounds(value)
@@ -133,6 +141,8 @@ class Requirement:
         k8s nodeAffinity: NotIn and DoesNotExist match absent labels;
         In/Exists/Gt/Lt require the label present.
         """
+        if self.impossible:
+            return False
         if self.complement:
             return self._unbounded and bool(self.values)  # NotIn
         return not self.values  # DoesNotExist
@@ -162,10 +172,25 @@ class Requirement:
             r = Requirement(self.key, False,
                             frozenset(v for v in vals if r._in_bounds(v)),
                             gt, lt, mv)
+        if r.is_empty() and not (self.satisfied_by_absence()
+                                 and other.satisfied_by_absence()):
+            # no value works and absence doesn't either: mark the result
+            # impossible so it can't masquerade as DoesNotExist
+            r = Requirement(self.key, r.complement, r.values, gt, lt, mv,
+                            impossible=True)
+        if self.impossible or other.impossible:
+            r = Requirement(self.key, r.complement, r.values, gt, lt, mv,
+                            impossible=True)
         return r
 
+    def unsatisfiable(self) -> bool:
+        """True iff neither any value nor label absence satisfies this."""
+        return self.impossible or (self.is_empty()
+                                   and not self.satisfied_by_absence())
+
     def is_empty(self) -> bool:
-        """True iff no value can satisfy this requirement."""
+        """True iff no value can satisfy this requirement (absence might
+        still — see unsatisfiable())."""
         if not self.complement:
             return not self.values
         # Complement set: infinitely many strings unless both bounds close
@@ -181,7 +206,11 @@ class Requirement:
         return False
 
     def intersects(self, other: "Requirement") -> bool:
-        return not self.intersection(other).is_empty()
+        """Can some node satisfy both? Mirrors upstream karpenter's
+        Requirements.Intersects: an empty value intersection is still
+        compatible when BOTH sides are satisfied by label absence
+        (NotIn/DoesNotExist)."""
+        return not self.intersection(other).unsatisfiable()
 
     def any_value(self) -> Optional[str]:
         """A deterministic representative value, if one is nameable."""

@@ -1,0 +1,5 @@
+from .encoding import (CAPACITY_TYPES, PRICE_INF, LabelUniverse, PodGroup,
+                       PoolEncoding, SnapshotEncoding, encode_snapshot)
+
+__all__ = ["encode_snapshot", "SnapshotEncoding", "LabelUniverse", "PodGroup",
+           "PoolEncoding", "CAPACITY_TYPES", "PRICE_INF"]

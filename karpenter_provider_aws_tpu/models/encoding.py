@@ -1,0 +1,352 @@
+"""Constraint-tensor encoding: SchedulingSnapshot -> dense arrays.
+
+This is the lowering from the requirements algebra (apis/requirements.py)
+to the tensors the TPU kernels consume — the "model" of this framework.
+
+Encoding scheme
+---------------
+- Pods dedup to **groups** (equal ``pod_group_signature``), ordered by the
+  canonical FFD order (solver/cpu.py::pod_sort_key). Group-batched FFD is
+  exactly per-pod FFD because the canonical order keeps groups contiguous
+  within a size class.
+- The **label universe** interns every (key, value) pair appearing in
+  instance-type requirements (minus zone / zone-id / capacity-type, which
+  ride the offerings tensors). Each type stores one value id per key
+  (ABSENT when undefined); each group stores a boolean allow-mask per key
+  (complement sets and Gt/Lt bounds evaluated against the interned values
+  at encode time). Type-level feasibility is then K gathered mask lookups:
+      F[g, t] = AND_k mask[g, k, type_val[t, k]]
+- Zones and capacity types are tiny enumerations: offering availability is
+  ``avail[T, Z, C]`` with fixed-point prices ``price[T, Z, C]`` (int64
+  micro-USD; unavailable = PRICE_INF). Group/pool zone and capacity-type
+  requirements become allow-vectors ``agz[*, Z]`` / ``agc[*, C]``.
+- Resources are exact int64 (millicores / bytes) — the fit comparison is
+  bit-identical to the CPU oracle's, by construction.
+
+Everything host-side here is numpy; jax arrays are produced at the boundary
+by solver/tpu.py.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Mapping, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from ..apis import labels as L
+from ..apis.objects import Pod
+from ..apis.requirements import Requirement, Requirements
+from ..apis.resources import Resources
+from ..cloudprovider.types import InstanceType
+from ..solver.cpu import pod_group_signature, pod_sort_key
+from ..solver.types import NodePoolSpec, SchedulingSnapshot
+
+PRICE_INF = np.int64(1) << 60
+ABSENT = 0  # value id 0 of every key means "label absent on the type"
+
+CAPACITY_TYPES = (L.CAPACITY_TYPE_ON_DEMAND, L.CAPACITY_TYPE_SPOT,
+                  L.CAPACITY_TYPE_RESERVED)
+
+#: keys that ride the offerings tensors instead of the label universe
+_OFFERING_KEYS = frozenset({L.ZONE, L.ZONE_ID, L.CAPACITY_TYPE})
+
+
+class LabelUniverse:
+    """Interns (key, value) pairs from instance-type requirement sets."""
+
+    def __init__(self, types: Sequence[InstanceType]):
+        keys: Set[str] = set()
+        for t in types:
+            for r in t.requirements:
+                if r.key not in _OFFERING_KEYS:
+                    keys.add(r.key)
+        self.keys: List[str] = sorted(keys)
+        self.key_pos = {k: i for i, k in enumerate(self.keys)}
+        # value id 0 reserved for ABSENT
+        self.values: List[Dict[str, int]] = [dict() for _ in self.keys]
+        self.value_names: List[List[str]] = [["<absent>"] for _ in self.keys]
+        for t in types:
+            for r in t.requirements:
+                ki = self.key_pos.get(r.key)
+                if ki is None:
+                    continue
+                for v in r.values:
+                    self._intern(ki, v)
+        # numeric value per (key, id) for Gt/Lt evaluation (None -> NaN)
+        self.numeric: List[np.ndarray] = []
+        for ki in range(len(self.keys)):
+            arr = np.full(len(self.value_names[ki]), np.nan)
+            for v, vid in self.values[ki].items():
+                try:
+                    arr[vid] = int(v)
+                except ValueError:
+                    pass
+            self.numeric.append(arr)
+
+    def _intern(self, ki: int, v: str) -> int:
+        vid = self.values[ki].get(v)
+        if vid is None:
+            vid = len(self.value_names[ki])
+            self.values[ki][v] = vid
+            self.value_names[ki].append(v)
+        return vid
+
+    def n_values(self, ki: int) -> int:
+        return len(self.value_names[ki])
+
+    def type_value_ids(self, types: Sequence[InstanceType]) -> np.ndarray:
+        """[T, K] int32 — each type's value id per key (ABSENT if undefined
+        or if the type's requirement on the key isn't a single concrete
+        value; DoesNotExist maps to ABSENT)."""
+        out = np.zeros((len(types), len(self.keys)), dtype=np.int32)
+        for ti, t in enumerate(types):
+            for r in t.requirements:
+                ki = self.key_pos.get(r.key)
+                if ki is None:
+                    continue
+                if not r.complement and len(r.values) == 1:
+                    out[ti, ki] = self.values[ki][next(iter(r.values))]
+                # DoesNotExist (empty, non-complement) stays ABSENT
+        return out
+
+    def requirement_mask(self, req: Requirement) -> np.ndarray:
+        """Allow-mask over the key's value ids (index 0 = ABSENT)."""
+        ki = self.key_pos[req.key]
+        n = self.n_values(ki)
+        mask = np.zeros(n, dtype=bool)
+        for vid in range(1, n):
+            if req.has(self.value_names[ki][vid]):
+                mask[vid] = True
+        mask[ABSENT] = req.satisfied_by_absence()
+        return mask
+
+    def group_masks(self, reqs: Requirements) -> Dict[int, np.ndarray]:
+        """key index -> allow-mask, only for keys the reqs constrain."""
+        out = {}
+        for r in reqs:
+            if r.key in _OFFERING_KEYS:
+                continue
+            ki = self.key_pos.get(r.key)
+            if ki is not None:
+                out[ki] = self.requirement_mask(r)
+        return out
+
+
+@dataclass
+class PodGroup:
+    index: int
+    sig: Tuple
+    pods: List[Pod]                      # canonical order
+    reqs: Requirements
+    requests: Resources
+    #: ki -> allow mask over interned values (only constrained keys)
+    masks: Dict[int, np.ndarray] = field(default_factory=dict)
+
+    @property
+    def count(self) -> int:
+        return len(self.pods)
+
+
+@dataclass
+class PoolEncoding:
+    index: int
+    spec: NodePoolSpec
+    type_rows: np.ndarray        # [T] bool — types in this pool's catalog
+    agz: np.ndarray              # [Z] bool — allowed zones
+    agc: np.ndarray              # [C] bool — allowed capacity types
+    masks: Dict[int, np.ndarray]  # label-universe constraints of the pool
+    limit_vec: Optional[np.ndarray]  # [D] int64, -1 = unlimited dim
+    in_use_vec: np.ndarray       # [D] int64
+
+
+@dataclass
+class SnapshotEncoding:
+    """Everything the kernels need, all numpy, all deterministic."""
+    universe: LabelUniverse
+    dims: List[str]                      # resource dimension names
+    zones: List[str]                     # zone names (sorted)
+    zone_ids: Dict[str, str]
+    types: List[InstanceType]            # the union catalog, name-sorted
+    type_names: List[str]
+    # tensors
+    type_val: np.ndarray                 # [T, K] int32
+    A: np.ndarray                        # [T, D] int64 allocatable
+    avail: np.ndarray                    # [T, Z, C] bool
+    price: np.ndarray                    # [T, Z, C] int64 (PRICE_INF = n/a)
+    groups: List[PodGroup]
+    R: np.ndarray                        # [G, D] int64 per-pod requests
+    n: np.ndarray                        # [G] int64 pod counts
+    F: np.ndarray                        # [G, T] bool type-level feasibility
+    agz: np.ndarray                      # [G, Z] bool
+    agc: np.ndarray                      # [G, C] bool
+    pools: List[PoolEncoding]
+    admit: np.ndarray                    # [G, P] bool (reqs ∧ taints ∧ residual)
+    daemon: np.ndarray                   # [G, P, D] int64 daemon overhead
+
+
+def encode_snapshot(snapshot: SchedulingSnapshot) -> SnapshotEncoding:
+    pods = sorted(snapshot.pods, key=pod_sort_key)
+
+    # --- groups ---------------------------------------------------------
+    groups: List[PodGroup] = []
+    by_sig: Dict[Tuple, PodGroup] = {}
+    for p in pods:
+        sig = pod_group_signature(p)
+        g = by_sig.get(sig)
+        if g is None:
+            g = PodGroup(index=len(groups), sig=sig, pods=[],
+                         reqs=p.scheduling_requirements(),
+                         requests=p.effective_requests())
+            by_sig[sig] = g
+            groups.append(g)
+        g.pods.append(p)
+
+    # --- union catalog --------------------------------------------------
+    seen: Dict[str, InstanceType] = {}
+    for spec in snapshot.nodepools:
+        for t in spec.instance_types:
+            seen.setdefault(t.name, t)
+    types = [seen[k] for k in sorted(seen)]
+    type_pos = {t.name: i for i, t in enumerate(types)}
+    universe = LabelUniverse(types)
+    type_val = universe.type_value_ids(types)
+
+    # --- dims -----------------------------------------------------------
+    dims_set = {"cpu", "memory", "pods"}
+    for g in groups:
+        dims_set.update(g.requests.nonzero_keys())
+    for d in snapshot.daemon_overheads:
+        dims_set.update(d.requests.nonzero_keys())
+    for spec in snapshot.nodepools:
+        if spec.nodepool.limits is not None:
+            dims_set.update(spec.nodepool.limits.nonzero_keys())
+    dims = sorted(dims_set)
+    dpos = {d: i for i, d in enumerate(dims)}
+
+    def vec(r: Resources) -> np.ndarray:
+        v = np.zeros(len(dims), dtype=np.int64)
+        for k, q in r.items():
+            i = dpos.get(k)
+            if i is not None:
+                v[i] = q
+        return v
+
+    # --- zones / offerings ---------------------------------------------
+    zone_set: Set[str] = set(snapshot.zones)
+    zid_of: Dict[str, str] = dict(snapshot.zones)
+    for t in types:
+        for o in t.offerings:
+            zone_set.add(o.zone)
+            if o.zone_id:
+                zid_of.setdefault(o.zone, o.zone_id)
+    zones = sorted(zone_set)
+    zpos = {z: i for i, z in enumerate(zones)}
+    Z, C, T, D = len(zones), len(CAPACITY_TYPES), len(types), len(dims)
+    cpos = {c: i for i, c in enumerate(CAPACITY_TYPES)}
+
+    avail = np.zeros((T, Z, C), dtype=bool)
+    price = np.full((T, Z, C), PRICE_INF, dtype=np.int64)
+    A = np.zeros((T, D), dtype=np.int64)
+    for ti, t in enumerate(types):
+        A[ti] = vec(t.allocatable())
+        for o in t.offerings:
+            zi, ci = zpos[o.zone], cpos[o.capacity_type]
+            price[ti, zi, ci] = o.price
+            if o.available:
+                avail[ti, zi, ci] = True
+
+    # --- group tensors --------------------------------------------------
+    G = len(groups)
+    R = np.zeros((G, D), dtype=np.int64)
+    n = np.zeros(G, dtype=np.int64)
+    F = np.ones((G, T), dtype=bool)
+    agz = np.ones((G, Z), dtype=bool)
+    agc = np.ones((G, C), dtype=bool)
+    for g in groups:
+        R[g.index] = vec(g.requests)
+        n[g.index] = g.count
+        g.masks = universe.group_masks(g.reqs)
+        for ki, mask in g.masks.items():
+            F[g.index] &= mask[type_val[:, ki]]
+        agz[g.index] = _zone_allow(g.reqs, zones, zid_of)
+        agc[g.index] = _ct_allow(g.reqs)
+
+    # --- pools ----------------------------------------------------------
+    pools: List[PoolEncoding] = []
+    ordered_specs = sorted(
+        snapshot.nodepools,
+        key=lambda s: (-s.nodepool.weight, s.nodepool.metadata.name))
+    for pi, spec in enumerate(ordered_specs):
+        rows = np.zeros(T, dtype=bool)
+        for t in spec.instance_types:
+            rows[type_pos[t.name]] = True
+        preqs = spec.nodepool.scheduling_requirements()
+        # the pool's own label requirements restrict the type axis, exactly
+        # like the oracle's merged-requirement conflict check does
+        for ki, mask in universe.group_masks(preqs).items():
+            rows &= mask[type_val[:, ki]]
+        limits = spec.nodepool.limits
+        lim_vec = None
+        if limits is not None:
+            lim_vec = np.full(D, -1, dtype=np.int64)
+            for k, q in limits.items():
+                if k in dpos:
+                    lim_vec[dpos[k]] = q
+        pools.append(PoolEncoding(
+            index=pi, spec=spec, type_rows=rows,
+            agz=_zone_allow(preqs, zones, zid_of),
+            agc=_ct_allow(preqs),
+            masks=universe.group_masks(preqs),
+            limit_vec=lim_vec,
+            in_use_vec=vec(spec.in_use)))
+
+    P = len(pools)
+    admit = np.zeros((G, P), dtype=bool)
+    daemon = np.zeros((G, P, D), dtype=np.int64)
+    for g in groups:
+        pod = g.pods[0]
+        for pe in pools:
+            np_obj = pe.spec.nodepool
+            base = np_obj.scheduling_requirements()
+            if base.compatible(g.reqs):
+                continue
+            if not all(t.tolerated_by(pod.tolerations)
+                       for t in np_obj.template.taints):
+                continue
+            merged = base.union(g.reqs)
+            if any(r.unsatisfiable() for r in merged):
+                continue
+            admit[g.index, pe.index] = True
+            total = Resources()
+            for d in snapshot.daemon_overheads:
+                if not merged.compatible(d.requirements):
+                    total = total + d.requests
+            daemon[g.index, pe.index] = vec(total)
+
+    return SnapshotEncoding(
+        universe=universe, dims=dims, zones=zones, zone_ids=zid_of,
+        types=types, type_names=[t.name for t in types],
+        type_val=type_val, A=A, avail=avail, price=price,
+        groups=groups, R=R, n=n, F=F, agz=agz, agc=agc,
+        pools=pools, admit=admit, daemon=daemon)
+
+
+def _zone_allow(reqs: Requirements, zones: List[str],
+                zid_of: Mapping[str, str]) -> np.ndarray:
+    mask = np.ones(len(zones), dtype=bool)
+    zr = reqs.get(L.ZONE)
+    if zr is not None:
+        mask &= np.array([zr.has(z) for z in zones])
+    zir = reqs.get(L.ZONE_ID)
+    if zir is not None:
+        mask &= np.array([zir.has(zid_of.get(z, "")) for z in zones])
+    return mask
+
+
+def _ct_allow(reqs: Requirements) -> np.ndarray:
+    mask = np.ones(len(CAPACITY_TYPES), dtype=bool)
+    ctr = reqs.get(L.CAPACITY_TYPE)
+    if ctr is not None:
+        mask &= np.array([ctr.has(c) for c in CAPACITY_TYPES])
+    return mask

@@ -1,0 +1,3 @@
+from . import ffd
+
+__all__ = ["ffd"]

@@ -1,0 +1,267 @@
+"""Vectorized FFD packing kernels.
+
+Two engines over the same math, decision-identical by construction:
+
+- :func:`fill_group_closed_form` — the host (numpy) engine: one call per
+  pod group in canonical order, mutating :class:`NodeState`.
+- :func:`ops.ffd_jax.solve_scan` — the pure-device engine: one ``lax.scan`` over
+  pod groups; the carry is the open-node state (candidate-type masks,
+  zone/capacity-type masks, int64 request vectors, pool budgets); each step
+  does the vectorized headroom + prefix-sum greedy fill + closed-form
+  new-node creation. Compiled once per (G, N, T, Z, C, D, P) shape class.
+
+The group fill math (identical in both engines)
+-----------------------------------------------
+For group g with per-pod request vector R and n pods:
+
+1. slot admission: alive ∧ (existing-node compat OR pool-level admission of
+   the group by the slot's pool)
+2. candidate types per open slot: node_types ∧ F[g] ∧ "has an available
+   offering inside the slot's merged (zone × capacity-type) allow-masks"
+3. headroom k[slot] = max over candidate types of
+   min_d floor((A[t,d] − used[slot,d]) / R[d])   (dims with R[d]=0 ignored),
+   capped by the slot's pool limit budget
+4. greedy FFD prefix fill: take[slot] = clip(n − cumsum_excl(k), 0, k)
+5. leftovers open new nodes pool-by-pool (weight order): capacity per new
+   node = max over admitted types of floor((A − daemon)/R); the final type
+   mask of a node holding m pods is {t : headroom_t ≥ m} — exactly the
+   narrowing the per-pod oracle produces.
+
+Equivalence to the per-pod CPU oracle holds because the canonical pod order
+keeps groups contiguous (solver/cpu.py::pod_sort_key) and all the above
+counters are the closed forms of the oracle's per-pod loop.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..models.encoding import PRICE_INF, SnapshotEncoding
+
+BIG = np.int64(1) << 60
+
+
+@dataclass
+class NodeState:
+    """Mutable open-node state for the numpy engine. Slots [0, E) are
+    existing cluster nodes; slots [E, N) are (potential) new nodes."""
+    E: int
+    N: int
+    T: int
+    D: int
+    Z: int
+    C: int
+    used: np.ndarray          # [N, D] int64
+    types: np.ndarray         # [N, T] bool (all-False rows for existing/free)
+    zones: np.ndarray         # [N, Z] bool
+    ct: np.ndarray            # [N, C] bool
+    pool: np.ndarray          # [N] int32, -1 free, -2 existing
+    alive: np.ndarray         # [N] bool
+    num_nodes: int = 0        # new nodes created (slots E..E+num_nodes)
+    ex_alloc: Optional[np.ndarray] = None   # [E, D]
+    ex_compat: Optional[np.ndarray] = None  # [G, E] bool
+    #: pods-per-slot per group: filled by the engines
+    takes: List[np.ndarray] = field(default_factory=list)
+    leftover: List[int] = field(default_factory=list)
+    #: per-slot count of pods of the currently-processed scheduling group
+    #: (topology bookkeeping, host engine only)
+    pool_used: Optional[np.ndarray] = None  # [P, D]
+
+    @staticmethod
+    def create(enc: SnapshotEncoding, n_max: int,
+               ex_alloc: np.ndarray, ex_used: np.ndarray,
+               ex_compat: np.ndarray) -> "NodeState":
+        E = ex_alloc.shape[0]
+        T, D = enc.A.shape
+        Z, C = len(enc.zones), enc.avail.shape[2]
+        N = E + n_max
+        st = NodeState(
+            E=E, N=N, T=T, D=D, Z=Z, C=C,
+            used=np.zeros((N, D), dtype=np.int64),
+            types=np.zeros((N, T), dtype=bool),
+            zones=np.zeros((N, Z), dtype=bool),
+            ct=np.zeros((N, C), dtype=bool),
+            pool=np.full(N, -1, dtype=np.int32),
+            alive=np.zeros(N, dtype=bool),
+            ex_alloc=ex_alloc, ex_compat=ex_compat,
+            pool_used=np.stack([p.in_use_vec for p in enc.pools])
+            if enc.pools else np.zeros((0, D), dtype=np.int64),
+        )
+        st.used[:E] = ex_used
+        st.pool[:E] = -2
+        st.alive[:E] = True
+        return st
+
+
+def _headroom(A_eff: np.ndarray, used: np.ndarray, R: np.ndarray) -> np.ndarray:
+    """min_d floor((A_eff - used)/R) over dims with R>0; shapes broadcast.
+    Result clipped at 0."""
+    sel = R > 0
+    if not sel.any():
+        return np.full(np.broadcast_shapes(A_eff.shape[:-1], used.shape[:-1]),
+                       BIG, dtype=np.int64)
+    diff = A_eff[..., sel] - used[..., sel]
+    q = np.floor_divide(diff, R[sel])
+    return np.clip(q.min(axis=-1), 0, BIG)
+
+
+def _pool_budget(enc: SnapshotEncoding, pool_used: np.ndarray,
+                 pi: int, R: np.ndarray) -> int:
+    """Max additional pods of per-pod vector R pool pi's limits allow."""
+    lim = enc.pools[pi].limit_vec
+    if lim is None:
+        return int(BIG)
+    budget = int(BIG)
+    for d in range(len(R)):
+        if lim[d] >= 0 and R[d] > 0:
+            budget = min(budget, max(0, (lim[d] - pool_used[pi, d])) // R[d])
+    return budget
+
+
+def slot_candidates(st: NodeState, enc: SnapshotEncoding, g: int,
+                    agz: np.ndarray) -> np.ndarray:
+    """[N, T] candidate types per open slot for group g (steps 1-2)."""
+    cand = st.types & enc.F[g][None, :]
+    zc = (st.zones & agz[None, :])[:, :, None] \
+        & (st.ct & enc.agc[g][None, :])[:, None, :]          # [N, Z, C]
+    off = np.tensordot(zc.reshape(st.N, -1),
+                       enc.avail.reshape(enc.avail.shape[0], -1).T, axes=1) > 0
+    return cand & off
+
+
+def slot_headroom(st: NodeState, enc: SnapshotEncoding, g: int,
+                  cand: np.ndarray) -> np.ndarray:
+    """[N] max pods each slot can still absorb (step 3, before budgets)."""
+    R = enc.R[g]
+    k = np.zeros(st.N, dtype=np.int64)
+    # open slots: max over candidate types
+    open_rows = cand.any(axis=1)
+    if open_rows.any():
+        hr = _headroom(enc.A[None, :, :], st.used[open_rows][:, None, :], R)
+        hr = np.where(cand[open_rows], hr, 0)
+        k[open_rows] = hr.max(axis=1)
+    # existing slots: concrete allocatable + compat
+    E = st.E
+    if E:
+        ex_ok = st.alive[:E] & st.ex_compat[g]
+        if ex_ok.any():
+            he = _headroom(st.ex_alloc[ex_ok], st.used[:E][ex_ok], R)
+            k[:E][ex_ok] = he
+    return k
+
+
+def admission(st: NodeState, enc: SnapshotEncoding, g: int) -> np.ndarray:
+    """[N] bool — slot-level admission of group g (step 1)."""
+    adm = st.alive.copy()
+    E = st.E
+    if E:
+        adm[:E] &= st.ex_compat[g]
+    open_sel = st.pool >= 0
+    adm[open_sel] &= enc.admit[g][st.pool[open_sel]]
+    return adm
+
+
+def greedy_fill(k: np.ndarray, n: int) -> Tuple[np.ndarray, int]:
+    """FFD prefix fill (step 4)."""
+    cum = np.cumsum(k) - k
+    take = np.clip(n - cum, 0, k)
+    return take.astype(np.int64), int(n - take.sum())
+
+
+def fill_group_closed_form(st: NodeState, enc: SnapshotEncoding, g: int,
+                           n_override: Optional[int] = None,
+                           agz_override: Optional[np.ndarray] = None,
+                           slot_cap: Optional[np.ndarray] = None,
+                           forbid_slots: Optional[np.ndarray] = None,
+                           ) -> Tuple[np.ndarray, int]:
+    """Steps 1-5 for one topology-free (sub)group. Mutates ``st``; returns
+    (take[N], leftover). Overrides support the topology pre-pass: zone-
+    restricted subgroups, per-slot pod caps (hostname spread), forbidden
+    slots (hostname anti-affinity)."""
+    n_rem = int(enc.n[g]) if n_override is None else n_override
+    R = enc.R[g]
+    agz_g = enc.agz[g] if agz_override is None else agz_override
+
+    # ---- fill open + existing slots -----------------------------------
+    cand = slot_candidates(st, enc, g, agz_g)
+    adm = admission(st, enc, g)
+    cand &= adm[:, None]
+    k = slot_headroom(st, enc, g, cand)
+    k = np.where(adm, k, 0)
+    # pool limit budgets cap fills pool-by-pool (node order preserved)
+    for pi, pe in enumerate(enc.pools):
+        if pe.limit_vec is None:
+            continue
+        rows = st.pool == pi
+        if not rows.any():
+            continue
+        budget = _pool_budget(enc, st.pool_used, pi, R)
+        kp = k[rows]
+        cum = np.cumsum(kp) - kp
+        k[rows] = np.clip(np.minimum(kp, budget - cum), 0, None)
+    if slot_cap is not None:
+        k = np.minimum(k, slot_cap)
+    if forbid_slots is not None:
+        k = np.where(forbid_slots, 0, k)
+    take, n_rem = greedy_fill(k, n_rem)
+
+    # commit fills
+    filled = take > 0
+    if filled.any():
+        st.used[filled] += take[filled, None] * R[None, :]
+        rows = np.where(filled & (st.pool >= 0))[0]
+        for i in rows:
+            # narrow: requirement intersection (cand) + refit vs new aggregate
+            fit = (st.used[i][None, :] <= enc.A).all(axis=1)
+            st.types[i] = cand[i] & fit
+            st.zones[i] &= agz_g
+            st.ct[i] &= enc.agc[g]
+            pi = int(st.pool[i])
+            st.pool_used[pi] += int(take[i]) * R
+    if n_rem == 0 or not enc.pools:
+        return take, n_rem
+
+    # ---- new nodes pool-by-pool ---------------------------------------
+    for pe in enc.pools:
+        if n_rem == 0:
+            break
+        pi = pe.index
+        if not enc.admit[g, pi]:
+            continue
+        daemon = enc.daemon[g, pi]
+        agz_p = agz_g & pe.agz
+        agc_p = enc.agc[g] & pe.agc
+        if not agz_p.any() or not agc_p.any():
+            continue
+        off_p = (enc.avail & agz_p[None, :, None]
+                 & agc_p[None, None, :]).any(axis=(1, 2))
+        cand_new = enc.F[g] & pe.type_rows & off_p
+        if not cand_new.any():
+            continue
+        hr = _headroom(enc.A, daemon[None, :], R)
+        hr = np.where(cand_new, hr, 0)
+        cap = int(hr.max())
+        if cap < 1:
+            continue
+        budget = _pool_budget(enc, st.pool_used, pi, R)
+        can_place = min(n_rem, budget)
+        if can_place < 1:
+            continue
+        while can_place > 0 and st.num_nodes < st.N - st.E:
+            slot = st.E + st.num_nodes
+            m = min(cap, can_place)
+            st.num_nodes += 1
+            st.alive[slot] = True
+            st.pool[slot] = pi
+            st.used[slot] = daemon + m * R
+            st.types[slot] = cand_new & (hr >= m)
+            st.zones[slot] = agz_p
+            st.ct[slot] = agc_p
+            take[slot] = m
+            st.pool_used[pi] += m * R
+            can_place -= m
+            n_rem -= m
+    return take, n_rem

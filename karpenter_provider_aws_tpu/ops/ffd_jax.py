@@ -1,0 +1,214 @@
+"""The jitted FFD group-scan kernel.
+
+One ``lax.scan`` over pod groups; the carry is the whole open-node state as
+dense device arrays. Every step runs the group-fill math of ops/ffd.py
+(identical closed forms) fully vectorized:
+
+- headroom tensor  [N, T] = min_d floor((A - used) / R)  (masked dims → BIG)
+- prefix-sum greedy fill across node slots
+- closed-form new-node creation per pool (vectorized slot writes — no
+  data-dependent Python control flow; the pool loop is static)
+
+Shapes (N, T, Z, C, D, P, E) are static per snapshot class, so the kernel
+compiles once and is reused across solve rounds while the catalog seqnum is
+stable — the same cache-warmness discipline the reference applies to its
+instance-type cache (instancetype.go:119-130).
+
+Exactness: all quantities are int64 (``jax_enable_x64``); comparisons and
+floor-divisions are bit-identical to the numpy engine, so decisions match
+the CPU oracle exactly.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+jax.config.update("jax_enable_x64", True)
+
+BIG = jnp.int64(1) << 60
+
+
+class KernelInputs(NamedTuple):
+    """Static-shape device arrays for one solve."""
+    # catalog
+    A: jax.Array          # [T, D] int64 allocatable
+    avail_zc: jax.Array   # [T, Z*C] bool (flattened offerings availability)
+    # groups (scanned)
+    R: jax.Array          # [G, D] int64
+    n: jax.Array          # [G] int64
+    F: jax.Array          # [G, T] bool
+    agz: jax.Array        # [G, Z] bool
+    agc: jax.Array        # [G, C] bool
+    admit: jax.Array      # [G, P] bool
+    daemon: jax.Array     # [G, P, D] int64
+    # pools
+    pool_types: jax.Array  # [P, T] bool
+    pool_agz: jax.Array    # [P, Z] bool
+    pool_agc: jax.Array    # [P, C] bool
+    pool_limit: jax.Array  # [P, D] int64 (-1 = unlimited)
+    pool_used0: jax.Array  # [P, D] int64
+    # existing nodes
+    ex_alloc: jax.Array    # [E, D] int64
+    ex_used0: jax.Array    # [E, D] int64
+    ex_compat: jax.Array   # [G, E] bool
+
+
+class Carry(NamedTuple):
+    used: jax.Array       # [N, D]
+    types: jax.Array      # [N, T]
+    zones: jax.Array      # [N, Z]
+    ct: jax.Array         # [N, C]
+    pool: jax.Array       # [N] int32 (-1 free, -2 existing)
+    alive: jax.Array      # [N] bool
+    num_nodes: jax.Array  # scalar int32
+    pool_used: jax.Array  # [P, D]
+
+
+def _headroom_slots(A: jax.Array, used: jax.Array, R: jax.Array,
+                    cand: jax.Array) -> jax.Array:
+    """[N] max pods per slot over candidate types."""
+    Rsafe = jnp.where(R > 0, R, 1)
+    q = (A[None, :, :] - used[:, None, :]) // Rsafe[None, None, :]   # [N,T,D]
+    q = jnp.where((R > 0)[None, None, :], q, BIG)
+    hr = jnp.clip(q.min(axis=-1), 0, BIG)                            # [N,T]
+    return jnp.where(cand, hr, 0).max(axis=1)
+
+
+def _headroom_vec(A_eff: jax.Array, base: jax.Array, R: jax.Array) -> jax.Array:
+    """[rows] headroom of concrete capacity rows (existing nodes / new-node
+    capacity): min_d floor((A_eff - base)/R)."""
+    Rsafe = jnp.where(R > 0, R, 1)
+    q = (A_eff - base) // Rsafe[None, :]
+    q = jnp.where((R > 0)[None, :], q, BIG)
+    return jnp.clip(q.min(axis=-1), 0, BIG)
+
+
+@partial(jax.jit, static_argnames=("n_max", "E", "P"))
+def solve_scan(inp: KernelInputs, n_max: int, E: int, P: int
+               ) -> Tuple[jax.Array, jax.Array, Carry]:
+    """Returns (takes[G, N], leftover[G], final carry)."""
+    T, D = inp.A.shape
+    Z = inp.agz.shape[1]
+    C = inp.agc.shape[1]
+    N = E + n_max
+
+    carry0 = Carry(
+        used=jnp.zeros((N, D), jnp.int64).at[:E].set(inp.ex_used0),
+        types=jnp.zeros((N, T), bool),
+        zones=jnp.zeros((N, Z), bool),
+        ct=jnp.zeros((N, C), bool),
+        pool=jnp.full((N,), -1, jnp.int32).at[:E].set(-2),
+        alive=jnp.zeros((N,), bool).at[:E].set(True),
+        num_nodes=jnp.int32(0),
+        pool_used=inp.pool_used0,
+    )
+
+    slot_idx = jnp.arange(N)
+
+    def step(carry: Carry, xs):
+        R, n, F, agz, agc, admit, daemon, ex_compat = xs
+        n_rem = n
+
+        # ---- candidate types per open slot (steps 1-2) ----------------
+        zc = ((carry.zones & agz[None, :])[:, :, None]
+              & (carry.ct & agc[None, :])[:, None, :]).reshape(N, Z * C)
+        off_ok = (zc.astype(jnp.int32) @ inp.avail_zc.T.astype(jnp.int32)) > 0
+        pool_clipped = jnp.clip(carry.pool, 0, P - 1)
+        adm_open = jnp.where(carry.pool >= 0, admit[pool_clipped], False)
+        cand = carry.types & F[None, :] & off_ok & adm_open[:, None]
+
+        # ---- headroom (step 3) ---------------------------------------
+        k = _headroom_slots(inp.A, carry.used, R, cand)
+        if E:
+            ex_ok = carry.alive[:E] & ex_compat
+            k_ex = jnp.where(ex_ok, _headroom_vec(inp.ex_alloc, carry.used[:E], R), 0)
+            k = k.at[:E].set(k_ex)
+        # pool limit budgets: cap per-pool prefix fills
+        pool_used = carry.pool_used
+        for pi in range(P):
+            has_limit = (inp.pool_limit[pi] >= 0).any()
+            budget = _pool_budget_jax(inp.pool_limit[pi], pool_used[pi], R)
+            rows = carry.pool == pi
+            kp = jnp.where(rows, k, 0)
+            cum = jnp.cumsum(kp) - kp
+            capped = jnp.clip(jnp.minimum(kp, budget - cum), 0, None)
+            k = jnp.where(rows & has_limit, capped, k)
+
+        # ---- greedy prefix fill (step 4) ------------------------------
+        cum = jnp.cumsum(k) - k
+        take = jnp.clip(n_rem - cum, 0, k)
+        n_rem = n_rem - take.sum()
+
+        used = carry.used + take[:, None] * R[None, :]
+        filled_open = (take > 0) & (carry.pool >= 0)
+        fit_all = (used[:, None, :] <= inp.A[None, :, :]).all(axis=-1)
+        types = jnp.where(filled_open[:, None], cand & fit_all, carry.types)
+        zones = jnp.where(filled_open[:, None], carry.zones & agz[None, :], carry.zones)
+        ct = jnp.where(filled_open[:, None], carry.ct & agc[None, :], carry.ct)
+        take_by_pool = jax.ops.segment_sum(
+            take, pool_clipped * (carry.pool >= 0) + (carry.pool < 0) * P,
+            num_segments=P + 1)[:P]
+        pool_used = pool_used + take_by_pool[:, None] * R[None, :]
+
+        # ---- new nodes pool-by-pool (step 5) --------------------------
+        pool_arr = carry.pool
+        alive = carry.alive
+        num_nodes = carry.num_nodes
+        for pi in range(P):
+            agz_p = agz & inp.pool_agz[pi]
+            agc_p = agc & inp.pool_agc[pi]
+            zc_p = (agz_p[:, None] & agc_p[None, :]).reshape(Z * C)
+            off_p = (inp.avail_zc & zc_p[None, :]).any(axis=1)
+            cand_new = F & inp.pool_types[pi] & off_p
+            hr = _headroom_vec(inp.A, daemon[pi][None, :], R)
+            hr = jnp.where(cand_new, hr, 0)
+            cap = hr.max()
+            budget = _pool_budget_jax(inp.pool_limit[pi], pool_used[pi], R)
+            can_place = jnp.where(
+                admit[pi] & (cap >= 1), jnp.minimum(n_rem, budget), 0)
+            # q new nodes: full nodes of `cap` + one partial
+            q = jnp.where(can_place > 0, -(-can_place // jnp.maximum(cap, 1)), 0)
+            free_slots = N - E - num_nodes
+            q = jnp.minimum(q, free_slots)
+            placed = jnp.minimum(can_place, q * cap)
+            start = E + num_nodes
+            is_new = (slot_idx >= start) & (slot_idx < start + q)
+            # pods per new slot: cap, except the last gets the remainder
+            offset = slot_idx - start
+            m_slot = jnp.where(
+                is_new,
+                jnp.where(offset == q - 1, placed - cap * (q - 1), cap), 0)
+            take = take + m_slot
+            used = used + m_slot[:, None] * R[None, :] \
+                + is_new[:, None] * daemon[pi][None, :]
+            hr_fit = (hr[None, :] >= m_slot[:, None]) & cand_new[None, :]
+            types = jnp.where(is_new[:, None], hr_fit, types)
+            zones = jnp.where(is_new[:, None], agz_p[None, :], zones)
+            ct = jnp.where(is_new[:, None], agc_p[None, :], ct)
+            pool_arr = jnp.where(is_new, pi, pool_arr)
+            alive = alive | is_new
+            num_nodes = num_nodes + q.astype(jnp.int32)
+            pool_used = pool_used.at[pi].add(placed * R)
+            n_rem = n_rem - placed
+
+        new_carry = Carry(used=used, types=types, zones=zones, ct=ct,
+                          pool=pool_arr, alive=alive, num_nodes=num_nodes,
+                          pool_used=pool_used)
+        return new_carry, (take, n_rem)
+
+    xs = (inp.R, inp.n, inp.F, inp.agz, inp.agc, inp.admit, inp.daemon,
+          inp.ex_compat)
+    final, (takes, leftover) = jax.lax.scan(step, carry0, xs)
+    return takes, leftover, final
+
+
+def _pool_budget_jax(limit: jax.Array, used: jax.Array, R: jax.Array) -> jax.Array:
+    """Max additional pods the pool's limits allow (BIG if unlimited)."""
+    active = (limit >= 0) & (R > 0)
+    Rsafe = jnp.where(R > 0, R, 1)
+    per_dim = jnp.where(active, jnp.clip(limit - used, 0, None) // Rsafe, BIG)
+    return per_dim.min()

@@ -189,11 +189,19 @@ class InstanceTypeProvider:
             Requirement.new(L.INSTANCE_ENCRYPTION_IN_TRANSIT, IN,
                             [str(info.encryption_in_transit).lower()]),
         ]
+        # Optional labels get explicit DoesNotExist when absent (the reference
+        # seeds these so a pod requiring e.g. instance-gpu-name can never land
+        # on a non-GPU type, types.go:183-287).
+        from ..apis.requirements import DOES_NOT_EXIST
         if info.hypervisor:
             reqs.append(Requirement.new(L.INSTANCE_HYPERVISOR, IN, [info.hypervisor]))
+        else:
+            reqs.append(Requirement.new(L.INSTANCE_HYPERVISOR, DOES_NOT_EXIST))
         if info.local_nvme_bytes:
             reqs.append(Requirement.new(L.INSTANCE_LOCAL_NVME, IN,
                                         [str(info.local_nvme_bytes // GIB)]))
+        else:
+            reqs.append(Requirement.new(L.INSTANCE_LOCAL_NVME, DOES_NOT_EXIST))
         if info.gpu_count:
             reqs += [
                 Requirement.new(L.INSTANCE_GPU_NAME, IN, [info.gpu_name]),
@@ -202,6 +210,10 @@ class InstanceTypeProvider:
                 Requirement.new(L.INSTANCE_GPU_MEMORY, IN,
                                 [str(info.gpu_memory_bytes // MIB)]),
             ]
+        else:
+            reqs += [Requirement.new(k, DOES_NOT_EXIST) for k in
+                     (L.INSTANCE_GPU_NAME, L.INSTANCE_GPU_MANUFACTURER,
+                      L.INSTANCE_GPU_COUNT, L.INSTANCE_GPU_MEMORY)]
         if info.accelerator_count:
             reqs += [
                 Requirement.new(L.INSTANCE_ACCELERATOR_NAME, IN, [info.accelerator_name]),
@@ -210,6 +222,11 @@ class InstanceTypeProvider:
                 Requirement.new(L.INSTANCE_ACCELERATOR_COUNT, IN,
                                 [str(info.accelerator_count)]),
             ]
+        else:
+            reqs += [Requirement.new(k, DOES_NOT_EXIST) for k in
+                     (L.INSTANCE_ACCELERATOR_NAME,
+                      L.INSTANCE_ACCELERATOR_MANUFACTURER,
+                      L.INSTANCE_ACCELERATOR_COUNT)]
         return Requirements(reqs)
 
     def _capacity(self, info: InstanceTypeInfo, nodeclass: EC2NodeClass,

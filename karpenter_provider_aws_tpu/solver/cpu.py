@@ -35,6 +35,7 @@ Performance machinery (none of it changes any decision):
 
 from __future__ import annotations
 
+import hashlib
 from dataclasses import dataclass, field
 from typing import Dict, List, Mapping, Optional, Sequence, Set, Tuple
 
@@ -50,13 +51,26 @@ from .types import (DaemonOverhead, ExistingNode, NewNodeClaim, NodePoolSpec,
 
 
 def pod_sort_key(pod: Pod) -> Tuple:
+    """Canonical FFD order, shared verbatim by CPU and TPU solvers:
+    descending (cpu, memory), then *pod-group signature digest* so identical
+    pods are contiguous within a size class (group-batched processing is then
+    exactly per-pod FFD), then namespace/name."""
     r = pod.effective_requests()
-    return (-r["cpu"], -r["memory"], pod.metadata.namespace, pod.metadata.name)
+    sig = getattr(pod, "_sig_digest", None)
+    if sig is None:
+        sig = hashlib.md5(repr(pod_group_signature(pod)).encode()).hexdigest()
+        pod._sig_digest = sig
+    return (-r["cpu"], -r["memory"], sig,
+            pod.metadata.namespace, pod.metadata.name)
 
 
 def pod_group_signature(pod: Pod) -> Tuple:
-    """Pods with equal signatures make identical scheduling demands."""
-    return (
+    """Pods with equal signatures make identical scheduling demands.
+    Memoized per pod (hot path: called in sort keys and group dedup)."""
+    cached = getattr(pod, "_sig_cache", None)
+    if cached is not None:
+        return cached
+    pod._sig_cache = sig = (
         tuple(sorted(pod.node_selector.items())),
         tuple(tuple(sorted(_term_items(t).items())) for t in pod.required_affinity_terms),
         tuple(sorted(pod.effective_requests().items())),
@@ -66,6 +80,7 @@ def pod_group_signature(pod: Pod) -> Tuple:
         tuple((a.topology_key, a.group, a.anti, a.required) for a in pod.pod_affinity),
         pod.scheduling_group,
     )
+    return sig
 
 
 def _term_items(term: Mapping) -> Dict:
@@ -329,7 +344,7 @@ class CPUSolver(Solver):
             types, alloc = node.types, node.alloc
         else:
             merged = node.requirements.union(ctx.reqs)
-            if any(r.is_empty() for r in merged):
+            if any(r.unsatisfiable() for r in merged):
                 return None
             if node.requirements.compatible(ctx.reqs):
                 return None
@@ -403,7 +418,7 @@ class CPUSolver(Solver):
                    for t in np_obj.template.taints):
             return "untolerated taints"
         merged = base.union(ctx.reqs)
-        if any(r.is_empty() for r in merged):
+        if any(r.unsatisfiable() for r in merged):
             return "empty requirement intersection"
         types = [t for t in spec.instance_types
                  if not t.requirements.conflicts(merged)

@@ -1,0 +1,243 @@
+"""The TPU solver: constraint-tensor FFD behind the Solver interface.
+
+Pipeline: ``encode_snapshot`` (models/encoding.py) → group-scan kernel
+(ops/ffd_jax.py on device, or the numpy twin ops/ffd.py) → decode back to
+``SolveResult``. Decisions are identical to the CPU oracle
+(tests/test_solver_equivalence.py enforces fingerprint equality).
+
+Topology-constrained snapshots (spread / pod-affinity) currently fall back
+to the CPU oracle — the tensorized topology path (per-domain subgrouping)
+is the next milestone; the no-topology path covers BASELINE configs 1, 2
+and 5 (homogeneous FFD, mixed selectors/taints over the full catalog,
+spot/on-demand with weights & limits).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..apis import labels as L
+from ..apis.requirements import Requirements
+from ..apis.resources import Resources
+from ..models.encoding import SnapshotEncoding, encode_snapshot
+from ..ops import ffd
+from .cpu import CPUSolver
+from .types import (ExistingNode, NewNodeClaim, SchedulingSnapshot,
+                    SolveResult, Solver)
+
+
+def _slotmap(E: int, Ep: int, N: int) -> np.ndarray:
+    """Row indices that drop the dead padded existing slots [E, Ep)."""
+    return np.concatenate([np.arange(E), np.arange(Ep, N)])
+
+
+class TPUSolver(Solver):
+    name = "tpu"
+
+    def __init__(self, backend: str = "jax", n_max: int = 2048):
+        """backend: 'jax' (device scan kernel) or 'numpy' (host twin —
+        same math, useful for debugging and tiny snapshots).
+
+        n_max bounds new-node slots per solve. If a solve would need more
+        nodes than n_max, overflow pods come back unschedulable (the oracle
+        would keep opening nodes) — size n_max well above the expected node
+        count (default 2048 vs the 500-node scale envelope, SURVEY §6)."""
+        assert backend in ("jax", "numpy")
+        self.backend = backend
+        self.n_max = n_max
+        self._cpu_fallback = CPUSolver()
+
+    # ------------------------------------------------------------------
+    def solve(self, snapshot: SchedulingSnapshot) -> SolveResult:
+        if self._needs_topology(snapshot):
+            return self._cpu_fallback.solve(snapshot)
+        enc = encode_snapshot(snapshot)
+        existing = sorted(snapshot.existing_nodes, key=lambda n: n.name)
+        ex_alloc, ex_used, ex_compat = self._encode_existing(enc, existing)
+        if self.backend == "jax":
+            takes, leftover, final = self._run_jax(enc, ex_alloc, ex_used, ex_compat)
+        else:
+            takes, leftover, final = self._run_numpy(enc, ex_alloc, ex_used, ex_compat)
+        return self._decode(enc, existing, takes, leftover, final)
+
+    @staticmethod
+    def _needs_topology(snapshot: SchedulingSnapshot) -> bool:
+        return any(p.topology_spread or p.pod_affinity for p in snapshot.pods)
+
+    # ------------------------------------------------------------------
+    def _encode_existing(self, enc: SnapshotEncoding,
+                         existing: Sequence[ExistingNode]):
+        E, D, G = len(existing), len(enc.dims), len(enc.groups)
+        dpos = {d: i for i, d in enumerate(enc.dims)}
+        ex_alloc = np.zeros((E, D), dtype=np.int64)
+        ex_used = np.zeros((E, D), dtype=np.int64)
+        ex_compat = np.zeros((G, E), dtype=bool)
+        for ei, node in enumerate(existing):
+            for k, q in node.allocatable.items():
+                if k in dpos:
+                    ex_alloc[ei, dpos[k]] = q
+            for k, q in node.used.items():
+                if k in dpos:
+                    ex_used[ei, dpos[k]] = q
+            for g in enc.groups:
+                pod = g.pods[0]
+                ex_compat[g.index, ei] = (
+                    g.reqs.satisfied_by_labels(node.labels)
+                    and all(t.tolerated_by(pod.tolerations)
+                            for t in node.taints))
+        return ex_alloc, ex_used, ex_compat
+
+    # ------------------------------------------------------------------
+    def _run_numpy(self, enc, ex_alloc, ex_used, ex_compat):
+        st = ffd.NodeState.create(enc, self.n_max, ex_alloc, ex_used, ex_compat)
+        takes = np.zeros((len(enc.groups), st.N), dtype=np.int64)
+        leftover = np.zeros(len(enc.groups), dtype=np.int64)
+        for g in enc.groups:
+            take, rem = ffd.fill_group_closed_form(st, enc, g.index)
+            takes[g.index] = take
+            leftover[g.index] = rem
+        final = dict(types=st.types, zones=st.zones, ct=st.ct, pool=st.pool,
+                     alive=st.alive, used=st.used, E=st.E)
+        return takes, leftover, final
+
+    def _run_jax(self, enc, ex_alloc, ex_used, ex_compat):
+        import jax.numpy as jnp
+
+        from ..ops.ffd_jax import KernelInputs, solve_scan
+        T, D = enc.A.shape
+        Z, C = len(enc.zones), enc.avail.shape[2]
+        P = len(enc.pools)
+        E = ex_alloc.shape[0]
+        # --- shape bucketing: avoid a fresh XLA compile per snapshot -----
+        # G -> next pow2 (padded groups have n=0: provably no-op steps);
+        # E/P -> pow2 buckets (padded existing rows are dead, padded pools
+        # admit nothing); D -> 8.
+        G = len(enc.groups)
+        Gp = max(1, 1 << (G - 1).bit_length())
+        Ep = 1 << (E - 1).bit_length() if E else 0
+        Pp = max(1, 1 << (P - 1).bit_length())
+        Dp = max(8, D)
+
+        def padG(a):
+            return np.pad(a, [(0, Gp - G)] + [(0, 0)] * (a.ndim - 1))
+
+        def padD(a):
+            return np.pad(a, [(0, 0)] * (a.ndim - 1) + [(0, Dp - D)])
+
+        enc_R = padG(padD(enc.R))
+        enc_n = padG(enc.n)
+        enc_F = padG(enc.F)
+        enc_agz = padG(enc.agz)
+        enc_agc = padG(enc.agc)
+        enc_admit = np.pad(padG(enc.admit), [(0, 0), (0, Pp - P)])
+        enc_daemon = np.pad(padG(padD(enc.daemon)), [(0, 0), (0, Pp - P), (0, 0)])
+        pool_types = np.zeros((Pp, T), bool)
+        pool_agz = np.zeros((Pp, Z), bool)
+        pool_agc = np.zeros((Pp, C), bool)
+        pool_limit = np.zeros((Pp, Dp), np.int64)  # limit 0 => padded pools inert
+        pool_used0 = np.zeros((Pp, Dp), np.int64)
+        for p in enc.pools:
+            pool_types[p.index] = p.type_rows
+            pool_agz[p.index] = p.agz
+            pool_agc[p.index] = p.agc
+            lim = p.limit_vec if p.limit_vec is not None \
+                else np.full(D, -1, dtype=np.int64)
+            pool_limit[p.index, :D] = lim
+            pool_limit[p.index, D:] = -1
+            pool_used0[p.index, :D] = p.in_use_vec
+        ex_alloc_p = np.zeros((Ep, Dp), np.int64)
+        ex_used_p = np.zeros((Ep, Dp), np.int64)
+        ex_compat_p = np.zeros((Gp, Ep), bool)
+        if E:
+            ex_alloc_p[:E, :D] = ex_alloc
+            ex_used_p[:E, :D] = ex_used
+            # dead padded rows: zero allocatable, incompatible with everyone
+            ex_compat_p[:G, :E] = ex_compat
+        A_p = padD(enc.A)
+        inp = KernelInputs(
+            A=jnp.asarray(A_p),
+            avail_zc=jnp.asarray(enc.avail.reshape(T, Z * C)),
+            R=jnp.asarray(enc_R), n=jnp.asarray(enc_n),
+            F=jnp.asarray(enc_F), agz=jnp.asarray(enc_agz),
+            agc=jnp.asarray(enc_agc), admit=jnp.asarray(enc_admit),
+            daemon=jnp.asarray(enc_daemon),
+            pool_types=jnp.asarray(pool_types),
+            pool_agz=jnp.asarray(pool_agz),
+            pool_agc=jnp.asarray(pool_agc),
+            pool_limit=jnp.asarray(pool_limit),
+            pool_used0=jnp.asarray(pool_used0),
+            ex_alloc=jnp.asarray(ex_alloc_p), ex_used0=jnp.asarray(ex_used_p),
+            ex_compat=jnp.asarray(ex_compat_p),
+        )
+        takes, leftover, carry = solve_scan(inp, n_max=self.n_max, E=Ep, P=Pp)
+        takes = np.asarray(takes)[:G]
+        # slot axis: drop padded existing rows (E..Ep) — they are dead
+        takes = np.concatenate([takes[:, :E], takes[:, Ep:]], axis=1)
+        final = dict(
+            types=np.asarray(carry.types)[_slotmap(E, Ep, carry.types.shape[0])],
+            zones=np.asarray(carry.zones)[_slotmap(E, Ep, carry.types.shape[0])],
+            ct=np.asarray(carry.ct)[_slotmap(E, Ep, carry.types.shape[0])],
+            pool=np.asarray(carry.pool)[_slotmap(E, Ep, carry.types.shape[0])],
+            alive=np.asarray(carry.alive)[_slotmap(E, Ep, carry.types.shape[0])],
+            used=np.asarray(carry.used)[_slotmap(E, Ep, carry.types.shape[0]), :D],
+            E=E)
+        return takes, np.asarray(leftover)[:G], final
+
+    # ------------------------------------------------------------------
+    def _decode(self, enc: SnapshotEncoding,
+                existing: Sequence[ExistingNode],
+                takes: np.ndarray, leftover: np.ndarray,
+                final: dict) -> SolveResult:
+        E = final["E"]
+        N = takes.shape[1]
+        assignments: Dict[str, str] = {}
+        unschedulable: Dict[str, str] = {}
+        #: slot -> list of pods (in canonical order)
+        slot_pods: Dict[int, List] = {}
+        slot_groups: Dict[int, List[int]] = {}
+
+        for g in enc.groups:
+            pods = iter(g.pods)
+            for slot in np.nonzero(takes[g.index])[0]:
+                cnt = int(takes[g.index, slot])
+                chunk = [next(pods) for _ in range(cnt)]
+                if slot < E:
+                    for p in chunk:
+                        assignments[p.full_name()] = existing[slot].name
+                else:
+                    slot_pods.setdefault(int(slot), []).extend(chunk)
+                    slot_groups.setdefault(int(slot), []).append(g.index)
+            for p in pods:  # leftovers — could not be scheduled
+                unschedulable[p.full_name()] = "no capacity in any nodepool"
+
+        new_nodes: List[NewNodeClaim] = []
+        for slot in sorted(slot_pods):
+            pods = slot_pods[slot]
+            pool = enc.pools[int(final["pool"][slot])]
+            tmask = final["types"][slot]
+            zmask = final["zones"][slot]
+            cmask = final["ct"][slot]
+            # price per candidate type under the node's (zone, ct) masks
+            pz = np.where(enc.avail & zmask[None, :, None] & cmask[None, None, :],
+                          enc.price, np.int64(1) << 62)
+            best = pz.min(axis=(1, 2))
+            order = [i for i in np.nonzero(tmask)[0]]
+            order.sort(key=lambda i: (int(best[i]), enc.type_names[i]))
+            reqs = pool.spec.nodepool.scheduling_requirements()
+            for gi in slot_groups[slot]:
+                reqs = reqs.union(enc.groups[gi].reqs)
+            used_vec = final["used"][slot]
+            new_nodes.append(NewNodeClaim(
+                nodepool=pool.spec.nodepool.metadata.name,
+                requirements=reqs,
+                pod_names=sorted(p.full_name() for p in pods),
+                instance_type_names=[enc.type_names[i] for i in order],
+                requests=Resources({d: int(used_vec[i])
+                                    for i, d in enumerate(enc.dims)}),
+                taints=list(pool.spec.nodepool.template.taints),
+            ))
+        return SolveResult(new_nodes=new_nodes,
+                           existing_assignments=assignments,
+                           unschedulable=unschedulable)

@@ -249,3 +249,37 @@ class TestSpotOnDemand:
         assert not res.unschedulable
         ct = res.new_nodes[0].requirements[L.CAPACITY_TYPE]
         assert ct.has("spot") and not ct.has("on-demand")
+
+
+class TestOptionalLabelAbsence:
+    """Regression: NotIn/DoesNotExist on optional labels must match types
+    WITHOUT the label (k8s semantics; types seed DoesNotExist like the
+    reference's computeRequirements, types.go:193-216)."""
+
+    def test_notin_gpu_name_prefers_non_gpu(self, env, solver):
+        pods = make_pods(1, affinity_terms=[
+            {"key": L.INSTANCE_GPU_NAME, "operator": "NotIn", "values": ["a100"]}])
+        res = solver.solve(env.snapshot(pods, [env.nodepool("default")]))
+        assert not res.unschedulable
+        cat = {c.name: c for c in env.ec2.catalog}
+        names = res.new_nodes[0].instance_type_names
+        assert any(cat[t].gpu_count == 0 for t in names)  # non-GPU types kept
+        assert all(cat[t].gpu_name != "a100" for t in names)
+
+    def test_dne_gpu_name_schedulable(self, env, solver):
+        pods = make_pods(1, affinity_terms=[
+            {"key": L.INSTANCE_GPU_NAME, "operator": "DoesNotExist"}])
+        res = solver.solve(env.snapshot(pods, [env.nodepool("default")]))
+        assert not res.unschedulable
+        cat = {c.name: c for c in env.ec2.catalog}
+        assert all(cat[t].gpu_count == 0
+                   for t in res.new_nodes[0].instance_type_names)
+
+    def test_in_gpu_name_excludes_non_gpu(self, env, solver):
+        pods = make_pods(1, affinity_terms=[
+            {"key": L.INSTANCE_GPU_NAME, "operator": "In", "values": ["t4"]}])
+        res = solver.solve(env.snapshot(pods, [env.nodepool("default")]))
+        assert not res.unschedulable
+        cat = {c.name: c for c in env.ec2.catalog}
+        assert all(cat[t].gpu_name == "t4"
+                   for t in res.new_nodes[0].instance_type_names)

@@ -181,3 +181,43 @@ class TestLabels:
         assert L.is_restricted_tag("karpenter.sh/nodepool")
         assert L.is_restricted_tag("kubernetes.io/cluster/my-cluster")
         assert not L.is_restricted_tag("team")
+
+
+class TestAbsenceSatisfiability:
+    """DoesNotExist/NotIn interplay — upstream karpenter's Intersects
+    special case: empty value-intersection is still compatible when both
+    sides are satisfied by label absence."""
+
+    def test_dne_intersects_notin(self):
+        dne = Requirement.new("gpu", DOES_NOT_EXIST)
+        notin = Requirement.new("gpu", NOT_IN, ["a100"])
+        assert dne.intersects(notin)
+        assert notin.intersects(dne)
+        merged = dne.intersection(notin)
+        assert not merged.unsatisfiable()
+        assert merged.satisfied_by_absence()
+
+    def test_dne_intersects_dne(self):
+        a = Requirement.new("gpu", DOES_NOT_EXIST)
+        assert a.intersects(Requirement.new("gpu", DOES_NOT_EXIST))
+
+    def test_dne_conflicts_in_and_exists(self):
+        dne = Requirement.new("gpu", DOES_NOT_EXIST)
+        assert not dne.intersects(Requirement.new("gpu", IN, ["t4"]))
+        assert not dne.intersects(Requirement.new("gpu", EXISTS))
+        assert dne.intersection(Requirement.new("gpu", IN, ["t4"])).unsatisfiable()
+
+    def test_disjoint_in_is_impossible_not_dne(self):
+        a = Requirement.new("k", IN, ["a"])
+        b = Requirement.new("k", IN, ["b"])
+        merged = a.intersection(b)
+        assert merged.unsatisfiable()
+        assert not merged.satisfied_by_absence()
+        # ...even though a real DNE with the same empty value set is fine
+        assert not Requirement.new("k", DOES_NOT_EXIST).unsatisfiable()
+
+    def test_impossible_propagates(self):
+        a = Requirement.new("k", IN, ["a"])
+        b = Requirement.new("k", IN, ["b"])
+        poisoned = a.intersection(b).intersection(Requirement.new("k", EXISTS))
+        assert poisoned.unsatisfiable() and not poisoned.has("a")

@@ -1,0 +1,182 @@
+"""CPU-oracle vs TPU-solver decision equivalence (the north star: identical
+node decisions, BASELINE.json). Randomized property tests over pods x
+catalogs x pools; fingerprints must match exactly."""
+
+import random
+
+import pytest
+
+from karpenter_provider_aws_tpu.apis import labels as L
+from karpenter_provider_aws_tpu.apis.objects import Taint, Toleration
+from karpenter_provider_aws_tpu.apis.resources import Resources
+from karpenter_provider_aws_tpu.fake.environment import Environment, make_pods
+from karpenter_provider_aws_tpu.solver import CPUSolver
+from karpenter_provider_aws_tpu.solver.tpu import TPUSolver
+from karpenter_provider_aws_tpu.solver.types import ExistingNode
+
+
+@pytest.fixture(scope="module")
+def env():
+    return Environment()
+
+
+@pytest.fixture(scope="module")
+def solvers():
+    # small n_max keeps the CPU-device kernels fast in CI; decisions are
+    # unaffected as long as a solve creates fewer nodes than n_max
+    return (CPUSolver(), TPUSolver(backend="numpy", n_max=192),
+            TPUSolver(backend="jax", n_max=192))
+
+
+def assert_equivalent(snap, solvers):
+    cpu, tnp, tjax = solvers
+    a = cpu.solve(snap)
+    b = tnp.solve(snap)
+    c = tjax.solve(snap)
+    assert a.decision_fingerprint() == b.decision_fingerprint(), \
+        f"numpy engine diverged: {a.summary()} vs {b.summary()}"
+    assert a.decision_fingerprint() == c.decision_fingerprint(), \
+        f"jax engine diverged: {a.summary()} vs {c.summary()}"
+    return a
+
+
+class TestBaselineConfigs:
+    def test_config1_homogeneous(self, env, solvers):
+        snap = env.snapshot(make_pods(1000, cpu="500m", memory="512Mi"),
+                            [env.nodepool("default")])
+        res = assert_equivalent(snap, solvers)
+        assert not res.unschedulable
+
+    def test_config2_mixed_selectors_taints(self, env, solvers):
+        tainted = env.nodepool("gpu-pool", taints=[Taint("nvidia.com/gpu", "NoSchedule", "true")])
+        plain = env.nodepool("default")
+        pods = (
+            make_pods(300, cpu="250m", memory="512Mi", prefix="small")
+            + make_pods(100, cpu="2", memory="4Gi", prefix="arm",
+                        node_selector={L.ARCH: "arm64"})
+            + make_pods(20, cpu="4", memory="16Gi", prefix="gpu",
+                        tolerations=[Toleration(key="nvidia.com/gpu",
+                                                operator="Exists")],
+                        **{"nvidia.com/gpu": 1})
+            + make_pods(50, cpu="1", memory="2Gi", prefix="zoned",
+                        node_selector={L.ZONE: "us-west-2b"})
+        )
+        res = assert_equivalent(env.snapshot(pods, [tainted, plain]), solvers)
+        assert not res.unschedulable
+
+    def test_config5_spot_od_weights_limits(self, env, solvers):
+        spot_pool = env.nodepool("spot", weight=100, limits={"cpu": "40"},
+                                 requirements=[{"key": L.CAPACITY_TYPE,
+                                                "operator": "In",
+                                                "values": ["spot"]}])
+        od_pool = env.nodepool("od", weight=1)
+        pods = make_pods(100, cpu="1", memory="2Gi")
+        res = assert_equivalent(env.snapshot(pods, [spot_pool, od_pool]), solvers)
+        assert not res.unschedulable
+        pools = {n.nodepool for n in res.new_nodes}
+        assert pools == {"spot", "od"}
+
+
+class TestExistingNodes:
+    def test_pack_onto_existing_then_overflow(self, env, solvers):
+        nodes = [ExistingNode(
+            name=f"node-{i}",
+            labels={L.ARCH: "amd64", L.OS: "linux", L.ZONE: "us-west-2a",
+                    L.INSTANCE_TYPE: "m5.xlarge"},
+            allocatable=Resources.parse({"cpu": "3500m", "memory": "14Gi",
+                                         "pods": 58}),
+            used=Resources.parse({"cpu": "500m"}),
+        ) for i in range(3)]
+        pods = make_pods(40, cpu="500m", memory="512Mi")
+        res = assert_equivalent(
+            env.snapshot(pods, [env.nodepool("default")], existing_nodes=nodes),
+            solvers)
+        assert len(res.existing_assignments) == 18  # 6 per node (3000m free)
+
+    def test_existing_label_mismatch(self, env, solvers):
+        nodes = [ExistingNode(
+            name="arm-node", labels={L.ARCH: "arm64", L.OS: "linux"},
+            allocatable=Resources.parse({"cpu": "8", "memory": "16Gi", "pods": 58}))]
+        pods = make_pods(5, node_selector={L.ARCH: "amd64"})
+        res = assert_equivalent(
+            env.snapshot(pods, [env.nodepool("default")], existing_nodes=nodes),
+            solvers)
+        assert not res.existing_assignments
+
+
+class TestICEFeedback:
+    def test_unavailable_offerings_respected(self, solvers):
+        env2 = Environment()
+        pods = make_pods(4, cpu="1",
+                         node_selector={L.CAPACITY_TYPE: "spot",
+                                        L.ZONE: "us-west-2a"})
+        snap = env2.snapshot(pods, [env2.nodepool("default")])
+        first = assert_equivalent(snap, solvers)
+        target = first.new_nodes[0].instance_type_names[0]
+        env2.unavailable_offerings.mark_unavailable("spot", target, "us-west-2a")
+        snap2 = env2.snapshot(
+            make_pods(4, cpu="1", node_selector={L.CAPACITY_TYPE: "spot",
+                                                 L.ZONE: "us-west-2a"}),
+            [env2.nodepool("default")])
+        second = assert_equivalent(snap2, solvers)
+        assert target not in second.new_nodes[0].instance_type_names
+
+
+class TestRandomized:
+    """Seeded fuzzing across the no-topology feature space."""
+
+    @pytest.mark.parametrize("seed", range(12))
+    def test_random_scenarios(self, env, solvers, seed):
+        rng = random.Random(seed)
+        pools = []
+        for i in range(rng.randint(1, 3)):
+            reqs = []
+            if rng.random() < 0.4:
+                reqs.append({"key": L.INSTANCE_CATEGORY, "operator": "In",
+                             "values": rng.sample(["c", "m", "r", "t"], 2)})
+            if rng.random() < 0.3:
+                reqs.append({"key": L.CAPACITY_TYPE, "operator": "In",
+                             "values": [rng.choice(["spot", "on-demand"])]})
+            taints = [Taint("dedicated", "NoSchedule", "x")] if rng.random() < 0.3 else []
+            limits = {"cpu": str(rng.randint(8, 64))} if rng.random() < 0.3 else None
+            pools.append(env.nodepool(
+                f"pool-{seed}-{i}", requirements=reqs, taints=taints,
+                limits=limits, weight=rng.randint(0, 100)))
+        pods = []
+        for j in range(rng.randint(1, 5)):
+            kw = {}
+            if rng.random() < 0.4:
+                kw["node_selector"] = rng.choice([
+                    {L.ARCH: "arm64"}, {L.ARCH: "amd64"},
+                    {L.ZONE: "us-west-2b"},
+                    {L.CAPACITY_TYPE: "spot"},
+                    {L.INSTANCE_SIZE: "2xlarge"},
+                ])
+            if rng.random() < 0.3:
+                kw["tolerations"] = [Toleration(key="dedicated", operator="Exists")]
+            pods += make_pods(
+                rng.randint(1, 60),
+                cpu=rng.choice(["100m", "250m", "500m", "1", "2", "7"]),
+                memory=rng.choice(["128Mi", "1Gi", "4Gi", "30Gi"]),
+                prefix=f"r{seed}-{j}", **kw)
+        existing = []
+        for e in range(rng.randint(0, 3)):
+            existing.append(ExistingNode(
+                name=f"ex-{seed}-{e}",
+                labels={L.ARCH: rng.choice(["amd64", "arm64"]), L.OS: "linux",
+                        L.ZONE: rng.choice(env.ec2.zones).name},
+                allocatable=Resources.parse({
+                    "cpu": str(rng.randint(2, 16)),
+                    "memory": f"{rng.randint(4, 64)}Gi", "pods": 58})))
+        snap = env.snapshot(pods, pools, existing_nodes=existing)
+        assert_equivalent(snap, solvers)
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_random_capacity_pressure(self, env, solvers, seed):
+        """Pods big enough that some are unschedulable."""
+        rng = random.Random(1000 + seed)
+        pool = env.nodepool(f"tight-{seed}", limits={"cpu": str(rng.randint(4, 30))})
+        pods = make_pods(rng.randint(20, 80), cpu="2", memory="2Gi",
+                         prefix=f"p{seed}")
+        res = assert_equivalent(env.snapshot(pods, [pool]), solvers)
+        assert res.unschedulable  # limit guarantees leftovers
