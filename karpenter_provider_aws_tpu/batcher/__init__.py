@@ -1,0 +1,7 @@
+from .core import (Batcher, CreateFleetBatcher, CreateFleetRequest,
+                   DescribeInstancesBatcher, TerminateInstancesBatcher,
+                   to_hashable)
+
+__all__ = ["Batcher", "CreateFleetBatcher", "CreateFleetRequest",
+           "DescribeInstancesBatcher", "TerminateInstancesBatcher",
+           "to_hashable"]

@@ -1,0 +1,213 @@
+"""Generic request micro-batching engine.
+
+Mirrors pkg/batcher/batcher.go:32-100,131-200: the first request opens a
+window; the batch flushes when the window quiesces (``idle_timeout`` with no
+new requests), hits ``max_timeout``, or reaches ``max_items``. Requests
+hash into buckets (same-shaped requests merge); results fan back to each
+caller. Thread-based (the control plane runs reconcilers in threads).
+
+Tuning constants from the reference:
+- CreateFleet:        35ms idle / 1s max / 1000 items (createfleet.go:38-40)
+- DescribeInstances: 100ms idle / 1s max /  500 items (describeinstances.go:40-42)
+- TerminateInstances:100ms idle / 1s max /  500 items (terminateinstances.go:39-41)
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Generic, Hashable, List, Optional, Sequence, Tuple, TypeVar
+
+T = TypeVar("T")  # request
+U = TypeVar("U")  # response
+
+
+@dataclass
+class _Bucket(Generic[T, U]):
+    requests: List[T] = field(default_factory=list)
+    futures: List["Future[U]"] = field(default_factory=list)
+    opened: float = 0.0
+    last_add: float = 0.0
+
+
+class Batcher(Generic[T, U]):
+    """``exec_fn(requests) -> responses`` is called once per flushed batch;
+    it must return one response per request (same order)."""
+
+    def __init__(self,
+                 exec_fn: Callable[[Sequence[T]], Sequence[U]],
+                 idle_timeout: float = 0.100,
+                 max_timeout: float = 1.0,
+                 max_items: int = 500,
+                 hash_fn: Optional[Callable[[T], Hashable]] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.exec_fn = exec_fn
+        self.idle_timeout = idle_timeout
+        self.max_timeout = max_timeout
+        self.max_items = max_items
+        self.hash_fn = hash_fn or (lambda _: 0)
+        self.clock = clock
+        self._mu = threading.Lock()
+        self._buckets: Dict[Hashable, _Bucket[T, U]] = {}
+        self._wake = threading.Condition(self._mu)
+        self._stopped = False
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def add(self, request: T) -> "Future[U]":
+        """Enqueue a request; the future resolves when its batch executes."""
+        fut: "Future[U]" = Future()
+        with self._mu:
+            if self._stopped:
+                raise RuntimeError("batcher stopped")
+            key = self.hash_fn(request)
+            bucket = self._buckets.get(key)
+            now = self.clock()
+            if bucket is None:
+                bucket = _Bucket(opened=now)
+                self._buckets[key] = bucket
+            bucket.requests.append(request)
+            bucket.futures.append(fut)
+            bucket.last_add = now
+            if len(bucket.requests) >= self.max_items:
+                self._flush_locked(key, bucket)
+            self._wake.notify()
+        return fut
+
+    def add_sync(self, request: T, timeout: float = 30.0) -> U:
+        return self.add(request).result(timeout=timeout)
+
+    def _loop(self) -> None:
+        while True:
+            with self._mu:
+                if self._stopped and not self._buckets:
+                    return
+                now = self.clock()
+                due: List[Tuple[Hashable, _Bucket]] = []
+                deadline = None
+                for key, b in list(self._buckets.items()):
+                    idle_at = b.last_add + self.idle_timeout
+                    max_at = b.opened + self.max_timeout
+                    fire_at = min(idle_at, max_at)
+                    if now >= fire_at or self._stopped:
+                        due.append((key, b))
+                    elif deadline is None or fire_at < deadline:
+                        deadline = fire_at
+                for key, b in due:
+                    self._flush_locked(key, b)
+                if not due:
+                    self._wake.wait(timeout=None if deadline is None
+                                    else max(0.001, deadline - now))
+
+    def _flush_locked(self, key: Hashable, bucket: _Bucket) -> None:
+        self._buckets.pop(key, None)
+        requests, futures = bucket.requests, bucket.futures
+        threading.Thread(target=self._execute, args=(requests, futures),
+                         daemon=True).start()
+
+    def _execute(self, requests: List[T], futures: List["Future[U]"]) -> None:
+        try:
+            responses = self.exec_fn(requests)
+            if len(responses) != len(requests):
+                raise RuntimeError(
+                    f"batch exec returned {len(responses)} responses for "
+                    f"{len(requests)} requests")
+            for fut, resp in zip(futures, responses):
+                fut.set_result(resp)
+        except Exception as e:  # fan the failure to every caller
+            for fut in futures:
+                if not fut.done():
+                    fut.set_exception(e)
+
+    def stop(self) -> None:
+        with self._mu:
+            self._stopped = True
+            self._wake.notify()
+        self._thread.join(timeout=5)
+
+
+# ---------------------------------------------------------------------------
+# Concrete batchers over the fake cloud (createfleet.go / describeinstances.go
+# / terminateinstances.go shapes)
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class CreateFleetRequest:
+    launch_template_configs: Tuple    # hashable nested tuples
+    capacity_type: str
+    #: fleet-level instance tags (nodepool/cluster-scoped, so same-shaped
+    #: requests still merge; the per-claim tag comes from the Tagger later)
+    tags: Tuple = ()
+    #: each caller asks for exactly one instance (the provisioner creates one
+    #: NodeClaim per request); the batcher rewrites TotalTargetCapacity=N
+    target_capacity: int = 1
+
+
+class CreateFleetBatcher(Batcher):
+    """Merges same-shaped CreateFleet calls, rewrites target capacity to the
+    batch size, and hands each caller exactly one instance back
+    (createfleet.go:36-100)."""
+
+    def __init__(self, ec2, clock: Callable[[], float] = time.monotonic):
+        self.ec2 = ec2
+        super().__init__(self._run, idle_timeout=0.035, max_timeout=1.0,
+                         max_items=1000, hash_fn=lambda r: r, clock=clock)
+
+    def _run(self, requests: Sequence[CreateFleetRequest]):
+        req = requests[0]
+        configs = _untuple(req.launch_template_configs)
+        total = sum(r.target_capacity for r in requests)
+        instances, errors = self.ec2.create_fleet(
+            configs, target_capacity=total, capacity_type=req.capacity_type,
+            tags=_untuple(req.tags) if req.tags else {})
+        out = []
+        for i, _ in enumerate(requests):
+            if i < len(instances):
+                out.append((instances[i], errors))
+            else:
+                out.append((None, errors))  # deficit -> caller sees ICE
+        return out
+
+
+class DescribeInstancesBatcher(Batcher):
+    """Merges instance-ID lookups with identical filters
+    (describeinstances.go:38-63)."""
+
+    def __init__(self, ec2, clock: Callable[[], float] = time.monotonic):
+        self.ec2 = ec2
+        super().__init__(self._run, idle_timeout=0.100, max_timeout=1.0,
+                         max_items=500, hash_fn=lambda r: 0, clock=clock)
+
+    def _run(self, instance_ids: Sequence[str]):
+        found = {i.id: i for i in self.ec2.describe_instances(ids=list(instance_ids))}
+        return [found.get(iid) for iid in instance_ids]
+
+
+class TerminateInstancesBatcher(Batcher):
+    def __init__(self, ec2, clock: Callable[[], float] = time.monotonic):
+        self.ec2 = ec2
+        super().__init__(self._run, idle_timeout=0.100, max_timeout=1.0,
+                         max_items=500, hash_fn=lambda r: 0, clock=clock)
+
+    def _run(self, instance_ids: Sequence[str]):
+        done = set(self.ec2.terminate_instances(list(instance_ids)))
+        return [iid in done for iid in instance_ids]
+
+
+def _untuple(obj):
+    """Inverse of the hashable-tuple encoding used for request hashing."""
+    if isinstance(obj, tuple) and obj and obj[0] == "__dict__":
+        return {k: _untuple(v) for k, v in obj[1]}
+    if isinstance(obj, tuple):
+        return [_untuple(v) for v in obj]
+    return obj
+
+
+def to_hashable(obj):
+    if isinstance(obj, dict):
+        return ("__dict__", tuple(sorted((k, to_hashable(v)) for k, v in obj.items())))
+    if isinstance(obj, (list, tuple)):
+        return tuple(to_hashable(v) for v in obj)
+    return obj

@@ -1,0 +1,201 @@
+"""The CloudProvider implementation — the plugin boundary.
+
+Mirrors pkg/cloudprovider/cloudprovider.go: Create (:82-120) with NodeClass
+resolution + readiness gate + instance-type filtering (:322-333) + label
+back-fill from single-valued requirements (:381-400); List/Get (:122-161);
+GetInstanceTypes (:164-181); Delete (:183-190); IsDrifted (:196-221 +
+drift.go:41-136); RepairPolicies (:252-293); restricted-tag validation +
+static tags (getTags, :232-250).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+from ..apis import labels as L
+from ..apis.objects import EC2NodeClass, NodeClaim, NodePool
+from ..apis.requirements import IN, Requirement, Requirements
+from ..apis.resources import Resources
+from ..fake.kube import FakeKube, NotFound
+from ..providers.instance import InstanceProvider, LaunchedInstance
+from ..providers.instancetype import InstanceTypeProvider
+from .types import (DEFAULT_REPAIR_POLICIES, CloudProviderError,
+                    InstanceTypes, InsufficientCapacityError,
+                    NodeClaimNotFoundError, NodeClassNotReadyError,
+                    RepairPolicy)
+
+
+class CloudProvider:
+    def __init__(self, kube: FakeKube,
+                 instance_types: InstanceTypeProvider,
+                 instances: InstanceProvider,
+                 cluster_name: str = "cluster",
+                 clock=time.time):
+        self.kube = kube
+        self.instance_types = instance_types
+        self.instances = instances
+        self.cluster_name = cluster_name
+        self.clock = clock
+
+    # -- Create (cloudprovider.go:82-120) ------------------------------
+    def create(self, nodeclaim: NodeClaim) -> NodeClaim:
+        nodeclass = self._resolve_nodeclass(nodeclaim)
+        if not nodeclass.ready:
+            raise NodeClassNotReadyError(
+                f"EC2NodeClass {nodeclass.name} is not ready")
+        types = self._resolve_instance_types(nodeclaim, nodeclass)
+        if not types:
+            raise InsufficientCapacityError(
+                f"all requested instance types were unavailable during launch "
+                f"for {nodeclaim.name}")
+        tags = self.get_tags(nodeclass, nodeclaim)
+        instance = self.instances.create(nodeclass, nodeclaim, types, tags=tags)
+        # stamp the NodeClass static-field hash for drift detection
+        # (instanceToNodeClaim annotations, cloudprovider.go:381-446)
+        nodeclaim.metadata.annotations[L.EC2NODECLASS_HASH_ANNOTATION] = nodeclass.hash()
+        nodeclaim.metadata.annotations[L.EC2NODECLASS_HASH_VERSION_ANNOTATION] = \
+            L.EC2NODECLASS_HASH_VERSION
+        return self._instance_to_nodeclaim(instance, nodeclaim, types)
+
+    def _resolve_nodeclass(self, nodeclaim: NodeClaim) -> EC2NodeClass:
+        try:
+            nc = self.kube.get("EC2NodeClass", nodeclaim.node_class_ref.name)
+        except NotFound:
+            # NodeClass gone => treat as ICE so core retries elsewhere
+            # (cloudprovider.go:83-89)
+            raise InsufficientCapacityError(
+                f"EC2NodeClass {nodeclaim.node_class_ref.name} not found")
+        return nc  # type: ignore[return-value]
+
+    def _resolve_instance_types(self, nodeclaim: NodeClaim,
+                                nodeclass: EC2NodeClass) -> InstanceTypes:
+        """compatible ∧ offering-available ∧ resources fit
+        (cloudprovider.go:322-333)."""
+        reqs = nodeclaim.requirements
+        requested = nodeclaim.resources_requested
+        out = InstanceTypes()
+        for it in self.instance_types.list(nodeclass):
+            if it.requirements.conflicts(reqs):
+                continue
+            if not it.offerings.available().compatible(reqs):
+                continue
+            if not requested.fits(it.allocatable()):
+                continue
+            out.append(it)
+        return out
+
+    # -- Get / List (cloudprovider.go:122-161) -------------------------
+    def get(self, provider_id: str) -> NodeClaim:
+        instance = self.instances.get(parse_instance_id(provider_id))
+        return self._instance_to_nodeclaim(instance)
+
+    def list(self) -> List[NodeClaim]:
+        return [self._instance_to_nodeclaim(i) for i in self.instances.list()]
+
+    # -- GetInstanceTypes (cloudprovider.go:164-181) -------------------
+    def get_instance_types(self, nodepool: NodePool) -> InstanceTypes:
+        nodeclass = self.kube.get("EC2NodeClass",
+                                  nodepool.template.node_class_ref.name)
+        return self.instance_types.list(nodeclass)  # type: ignore[arg-type]
+
+    # -- Delete (cloudprovider.go:183-190) -----------------------------
+    def delete(self, nodeclaim: NodeClaim) -> None:
+        self.instances.delete(parse_instance_id(nodeclaim.provider_id))
+
+    # -- IsDrifted (cloudprovider.go:196-221, drift.go:41-136) ---------
+    DRIFT_NONE = ""
+    DRIFT_AMI = "AMIDrift"
+    DRIFT_SUBNET = "SubnetDrift"
+    DRIFT_SECURITY_GROUP = "SecurityGroupDrift"
+    DRIFT_NODECLASS = "NodeClassDrift"
+
+    def is_drifted(self, nodeclaim: NodeClaim) -> str:
+        if not nodeclaim.provider_id:
+            return self.DRIFT_NONE
+        try:
+            nodeclass = self._resolve_nodeclass(nodeclaim)
+        except CloudProviderError:
+            return self.DRIFT_NONE
+        instance = self.instances.get(parse_instance_id(nodeclaim.provider_id))
+        # AMI drift: the running image is no longer among resolved AMIs
+        amis = {a["id"] for a in nodeclass.status_amis}
+        if amis and instance.image_id not in amis:
+            return self.DRIFT_AMI
+        # Subnet drift: instance subnet no longer selected
+        subnet_ids = {s["id"] for s in nodeclass.status_subnets}
+        if subnet_ids and instance.subnet_id \
+                and instance.subnet_id not in subnet_ids:
+            return self.DRIFT_SUBNET
+        # Static-field drift: hash annotation mismatch (versioned)
+        ann = nodeclaim.metadata.annotations
+        if ann.get(L.EC2NODECLASS_HASH_VERSION_ANNOTATION) == L.EC2NODECLASS_HASH_VERSION \
+                and ann.get(L.EC2NODECLASS_HASH_ANNOTATION, nodeclass.hash()) != nodeclass.hash():
+            return self.DRIFT_NODECLASS
+        return self.DRIFT_NONE
+
+    # -- RepairPolicies (cloudprovider.go:252-293) ---------------------
+    def repair_policies(self) -> List[RepairPolicy]:
+        return list(DEFAULT_REPAIR_POLICIES)
+
+    # -- tags (cloudprovider.go:232-250) -------------------------------
+    def get_tags(self, nodeclass: EC2NodeClass,
+                 nodeclaim: NodeClaim) -> Dict[str, str]:
+        for key in nodeclass.tags:
+            if L.is_restricted_tag(key):
+                raise CloudProviderError(f"tag {key!r} is restricted")
+        tags = dict(nodeclass.tags)
+        tags.update({
+            "eks:eks-cluster-name": self.cluster_name,
+            f"kubernetes.io/cluster/{self.cluster_name}": "owned",
+            L.NODEPOOL: nodeclaim.metadata.labels.get(L.NODEPOOL, ""),
+            L.EC2NODECLASS_LABEL: nodeclass.name,
+        })
+        return tags
+
+    # -- reconstruction (cloudprovider.go:352-446) ---------------------
+    def _instance_to_nodeclaim(self, instance: LaunchedInstance,
+                               nodeclaim: Optional[NodeClaim] = None,
+                               types: Optional[InstanceTypes] = None,
+                               ) -> NodeClaim:
+        labels = {
+            L.INSTANCE_TYPE: instance.instance_type,
+            L.ZONE: instance.zone,
+            L.ZONE_ID: instance.zone_id,
+            L.CAPACITY_TYPE: instance.capacity_type,
+        }
+        chosen = None
+        if types is not None:
+            chosen = next((t for t in types
+                           if t.name == instance.instance_type), None)
+        if chosen is not None:
+            # back-fill labels from single-valued requirements (:381-400)
+            for k, v in chosen.requirements.single_values().items():
+                labels.setdefault(k, v)
+        if nodeclaim is None:
+            # reconstruct from tags (List/Get path, instance.go:147-163)
+            name = instance.tags.get("karpenter.sh/nodeclaim", instance.id)
+            from ..apis.objects import NodeClassRef
+            nodeclaim = NodeClaim(
+                name=name,
+                requirements=Requirements([]),
+                node_class_ref=NodeClassRef(
+                    instance.tags.get(L.EC2NODECLASS_LABEL, "")),
+                labels={L.NODEPOOL: instance.tags.get(L.NODEPOOL, "")})
+        nodeclaim.metadata.labels.update(labels)
+        nodeclaim.provider_id = instance.provider_id
+        nodeclaim.image_id = instance.image_id
+        if chosen is not None:
+            nodeclaim.capacity = chosen.capacity
+            nodeclaim.allocatable = chosen.allocatable()
+        return nodeclaim
+
+
+def parse_instance_id(provider_id: str) -> str:
+    """``aws:///us-west-2a/i-0123...`` -> ``i-0123...`` (utils.go:36-75)."""
+    if not provider_id.startswith("aws:///"):
+        raise ValueError(f"invalid provider id {provider_id!r}")
+    parts = provider_id.split("/")
+    if len(parts) < 5 or not parts[-1]:
+        raise ValueError(f"invalid provider id {provider_id!r}")
+    return parts[-1]

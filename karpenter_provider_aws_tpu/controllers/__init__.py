@@ -1,0 +1,10 @@
+from .lifecycle import NodeClaimLifecycle, Terminator
+from .provisioning import Provisioner, ProvisioningResult
+from .steady_state import (CatalogController, GarbageCollector,
+                           InterruptionController, NodeClassStatusController,
+                           PricingController, Tagger)
+
+__all__ = ["Provisioner", "ProvisioningResult", "NodeClaimLifecycle",
+           "Terminator", "NodeClassStatusController", "GarbageCollector",
+           "Tagger", "InterruptionController", "CatalogController",
+           "PricingController"]

@@ -1,0 +1,151 @@
+"""NodeClaim lifecycle + termination controllers.
+
+Lifecycle mirrors the core nodeclaim lifecycle controller driven through
+``CloudProvider.Create`` (SURVEY §3.2): launch (ICE -> delete claim so the
+next solve round retries elsewhere), register (Node with matching provider
+id joined), initialize (node Ready + capacity known -> discovered-capacity
+feedback, capacity/controller.go:54-73). Termination mirrors the core
+terminator: cloud instance deleted, then the finalizer clears.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import List, Optional
+
+from ..apis import labels as L
+from ..apis.objects import NodeClaim
+from ..cloudprovider.provider import CloudProvider
+from ..cloudprovider.types import (CloudProviderError,
+                                   InsufficientCapacityError,
+                                   NodeClaimNotFoundError)
+from ..fake.kube import FakeKube, NotFound
+from ..providers.instancetype import InstanceTypeProvider
+
+log = logging.getLogger(__name__)
+
+REGISTRATION_TTL = 15 * 60  # core: claims that never register are reaped
+
+
+class NodeClaimLifecycle:
+    def __init__(self, kube: FakeKube, cloudprovider: CloudProvider,
+                 instance_types: Optional[InstanceTypeProvider] = None,
+                 clock=time.time):
+        self.kube = kube
+        self.cloudprovider = cloudprovider
+        self.instance_types = instance_types
+        self.clock = clock
+
+    def reconcile(self) -> dict:
+        stats = {"launched": 0, "registered": 0, "initialized": 0,
+                 "failed": 0, "reaped": 0}
+        for claim in self.kube.list("NodeClaim"):
+            if claim.metadata.deletion_timestamp is not None:
+                continue
+            try:
+                if not claim.launched:
+                    self._launch(claim)
+                    stats["launched"] += 1
+                elif not claim.registered:
+                    if self._register(claim):
+                        stats["registered"] += 1
+                    elif self.clock() - claim.metadata.creation_timestamp > REGISTRATION_TTL:
+                        self.kube.delete("NodeClaim", claim.name)
+                        stats["reaped"] += 1
+                elif not claim.initialized:
+                    if self._initialize(claim):
+                        stats["initialized"] += 1
+            except InsufficientCapacityError as e:
+                # ICE: delete the claim; the offending offerings are already
+                # blacklisted so the next solve avoids them (SURVEY §5)
+                log.info("nodeclaim %s ICE: %s", claim.name, e)
+                claim.set_condition("Launched", "False", "InsufficientCapacity",
+                                    str(e), self.clock())
+                self._force_delete_claim(claim)
+                stats["failed"] += 1
+            except CloudProviderError as e:
+                log.warning("nodeclaim %s launch error: %s", claim.name, e)
+                claim.set_condition("Launched", "False", "Error", str(e),
+                                    self.clock())
+                self.kube.update(claim)
+                stats["failed"] += 1
+        return stats
+
+    def _launch(self, claim: NodeClaim) -> None:
+        launched = self.cloudprovider.create(claim)
+        claim.provider_id = launched.provider_id
+        claim.image_id = launched.image_id
+        claim.capacity = launched.capacity
+        claim.allocatable = launched.allocatable
+        claim.set_condition("Launched", "True", now=self.clock())
+        self.kube.update(claim)
+
+    def _register(self, claim: NodeClaim) -> bool:
+        for node in self.kube.list("Node"):
+            if node.provider_id == claim.provider_id:
+                claim.node_name = node.name
+                claim.set_condition("Registered", "True", now=self.clock())
+                self.kube.update(claim)
+                return True
+        return False
+
+    def _initialize(self, claim: NodeClaim) -> bool:
+        try:
+            node = self.kube.get("Node", claim.node_name)
+        except NotFound:
+            return False
+        if not node.ready:
+            return False
+        claim.set_condition("Initialized", "True", now=self.clock())
+        self.kube.update(claim)
+        # discovered real capacity refines the catalog (SURVEY §2.5 capacity)
+        if self.instance_types is not None and node.capacity["memory"]:
+            itype = node.metadata.labels.get(L.INSTANCE_TYPE, "")
+            if itype and claim.image_id:
+                self.instance_types.update_discovered_capacity(
+                    itype, claim.image_id, node.capacity["memory"])
+        return True
+
+    def _force_delete_claim(self, claim: NodeClaim) -> None:
+        self.kube.delete("NodeClaim", claim.name)
+        obj = self.kube.try_get("NodeClaim", claim.name)
+        if obj is not None:
+            self.kube.remove_finalizer(obj, "karpenter.sh/termination")
+
+
+class Terminator:
+    """NodeClaim deletion: drain semantics are approximated by unbinding
+    pods; instance terminated; node deleted; finalizer cleared."""
+
+    def __init__(self, kube: FakeKube, cloudprovider: CloudProvider,
+                 clock=time.time):
+        self.kube = kube
+        self.cloudprovider = cloudprovider
+        self.clock = clock
+
+    def reconcile(self) -> int:
+        done = 0
+        for claim in self.kube.list("NodeClaim"):
+            if claim.metadata.deletion_timestamp is None:
+                continue
+            # 1) drain: release this node's pods back to pending
+            if claim.node_name:
+                for pod in self.kube.list("Pod"):
+                    if pod.node_name == claim.node_name:
+                        pod.node_name = ""
+                        pod.phase = "Pending"
+                        self.kube.update(pod)
+            # 2) terminate the instance
+            if claim.provider_id:
+                try:
+                    self.cloudprovider.delete(claim)
+                except NodeClaimNotFoundError:
+                    pass
+            # 3) delete the Node object
+            if claim.node_name and self.kube.try_get("Node", claim.node_name):
+                self.kube.delete("Node", claim.node_name)
+            # 4) clear the finalizer -> object goes away
+            self.kube.remove_finalizer(claim, "karpenter.sh/termination")
+            done += 1
+        return done

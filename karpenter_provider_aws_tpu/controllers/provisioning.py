@@ -1,0 +1,140 @@
+"""Provisioning controller: pending pods -> Solver -> NodeClaims.
+
+The core ``provisioning.Provisioner`` (SURVEY §3.2): batch pending pods,
+build the scheduling snapshot (nodepool specs with resolved instance types,
+existing capacity from cluster state, daemonset overheads), run the
+pluggable Solver, create NodeClaim CRs, and nominate pods to their planned
+nodes so the next round doesn't double-provision.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+from ..apis import labels as L
+from ..apis.objects import NodeClaim, NodePool, Pod
+from ..apis.requirements import Requirements
+from ..apis.resources import Resources, sum_resources
+from ..cloudprovider.provider import CloudProvider
+from ..fake.kube import FakeKube
+from ..solver.types import (DaemonOverhead, NewNodeClaim, NodePoolSpec,
+                            SchedulingSnapshot, Solver, SolveResult)
+from ..state.cluster import ClusterState
+
+log = logging.getLogger(__name__)
+_claim_seq = itertools.count(1)
+
+
+@dataclass
+class ProvisioningResult:
+    created_claims: List[NodeClaim] = field(default_factory=list)
+    nominated: Dict[str, str] = field(default_factory=dict)
+    unschedulable: Dict[str, str] = field(default_factory=dict)
+    solve_duration_s: float = 0.0
+
+
+class Provisioner:
+    def __init__(self, kube: FakeKube, state: ClusterState,
+                 cloudprovider: CloudProvider, solver: Solver,
+                 metrics=None, clock=time.time):
+        self.kube = kube
+        self.state = state
+        self.cloudprovider = cloudprovider
+        self.solver = solver
+        self.metrics = metrics
+        self.clock = clock
+
+    def reconcile(self) -> ProvisioningResult:
+        """One provisioning round (core Provisioner.Schedule)."""
+        pods = self.state.pending_pods()
+        result = ProvisioningResult()
+        if not pods:
+            return result
+        snapshot = self.build_snapshot(pods)
+        t0 = time.perf_counter()
+        solved = self.solver.solve(snapshot)
+        result.solve_duration_s = time.perf_counter() - t0
+        if self.metrics is not None:
+            self.metrics.observe("karpenter_scheduler_scheduling_duration_seconds",
+                                 result.solve_duration_s)
+            self.metrics.set_gauge("karpenter_scheduler_queue_depth", 0)
+        result.unschedulable = solved.unschedulable
+
+        pods_by_name = {p.full_name(): p for p in pods}
+        # pods onto existing capacity -> nominate
+        for pod_name, node_name in solved.existing_assignments.items():
+            self.state.nominate(pod_name, node_name)
+            result.nominated[pod_name] = node_name
+        # new nodes -> NodeClaim CRs
+        for plan in solved.new_nodes:
+            claim = self._create_nodeclaim(plan, pods_by_name)
+            result.created_claims.append(claim)
+            for pod_name in plan.pod_names:
+                self.state.nominate(pod_name, claim.name)
+                result.nominated[pod_name] = claim.name
+        return result
+
+    def build_snapshot(self, pods: Sequence[Pod]) -> SchedulingSnapshot:
+        usage = self.state.nodepool_usage()
+        specs: List[NodePoolSpec] = []
+        for np in self.kube.list("NodePool"):
+            try:
+                types = self.cloudprovider.get_instance_types(np)
+            except Exception as e:  # NodeClass missing/not ready
+                log.warning("nodepool %s skipped: %s", np.name, e)
+                continue
+            if not types:
+                continue
+            specs.append(NodePoolSpec(
+                nodepool=np, instance_types=types,
+                in_use=usage.get(np.name, Resources())))
+        daemons = self._daemon_overheads()
+        zones = {}
+        for spec in specs:
+            for it in spec.instance_types:
+                for o in it.offerings:
+                    zones.setdefault(o.zone, o.zone_id)
+        return SchedulingSnapshot(
+            pods=list(pods), nodepools=specs,
+            existing_nodes=self.state.existing_nodes(),
+            daemon_overheads=daemons, zones=zones)
+
+    def _daemon_overheads(self) -> List[DaemonOverhead]:
+        """Daemonset pods: every new node admitting them pays their requests."""
+        out = []
+        for pod in self.kube.list("Pod"):
+            if pod.owner_kind == "DaemonSet":
+                out.append(DaemonOverhead(
+                    requests=pod.effective_requests(),
+                    requirements=pod.scheduling_requirements()))
+        return out
+
+    def _create_nodeclaim(self, plan: NewNodeClaim,
+                          pods_by_name: Dict[str, Pod]) -> NodeClaim:
+        nodepool = self.kube.get("NodePool", plan.nodepool)
+        labels = dict(nodepool.template.labels)
+        labels[L.NODEPOOL] = plan.nodepool
+        # single-valued requirements become labels (core nodeclaim template)
+        for k, v in plan.requirements.single_values().items():
+            labels.setdefault(k, v)
+        claim = NodeClaim(
+            name=f"{plan.nodepool}-{next(_claim_seq):05d}",
+            requirements=plan.requirements,
+            node_class_ref=nodepool.template.node_class_ref,
+            resources_requested=plan.requests,
+            taints=plan.taints,
+            startup_taints=nodepool.template.startup_taints,
+            labels=labels,
+            annotations={
+                L.NODEPOOL_HASH_ANNOTATION: nodepool.hash(),
+                L.NODEPOOL_HASH_VERSION_ANNOTATION: "v3",
+            },
+            expire_after=nodepool.template.expire_after)
+        claim.metadata.finalizers.append("karpenter.sh/termination")
+        claim.instance_type_options = list(plan.instance_type_names)
+        self.kube.create(claim)
+        return claim

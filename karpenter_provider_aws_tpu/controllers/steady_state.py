@@ -1,0 +1,234 @@
+"""Steady-state controllers: NodeClass status, GC, tagging, interruption,
+catalog/pricing refresh (SURVEY §2.5).
+
+- NodeClassStatus: sequential sub-reconcilers ami -> subnet -> securitygroup
+  -> instanceprofile -> validation -> readiness (nodeclass/controller.go:91-140).
+- GarbageCollector: CloudProvider.List vs cluster NodeClaims; terminate
+  instances with no NodeClaim after a 30s grace (garbagecollection/
+  controller.go:55-90).
+- Tagger: stamp Name/cluster/nodeclaim tags post-registration
+  (tagging/controller.go:61-89).
+- InterruptionController: SQS long-poll; spot interruption / rebalance /
+  scheduled change / state change -> CordonAndDrain (delete NodeClaim) and
+  blacklist the spot offering (interruption/controller.go:94-134,299+).
+- CatalogController / PricingController: the 12h refresh loops
+  (providers/instancetype/controller.go:43-60, providers/pricing/controller.go:43-60).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Dict, List, Optional, Set
+
+from ..apis import labels as L
+from ..apis.objects import EC2NodeClass
+from ..cloudprovider.provider import CloudProvider, parse_instance_id
+from ..cloudprovider.types import NodeClaimNotFoundError
+from ..fake.kube import FakeKube, NotFound
+from ..providers.amifamily import AMIProvider
+from ..providers.instance import InstanceProvider
+from ..providers.instancetype import InstanceTypeProvider, OfferingsSnapshot
+from ..providers.network import SecurityGroupProvider, SubnetProvider
+from ..providers.pricing import (InstanceProfileProvider, InterruptionMessage,
+                                 PricingProvider, SQSProvider)
+
+log = logging.getLogger(__name__)
+
+GC_GRACE_SECONDS = 30.0
+
+
+class NodeClassStatusController:
+    def __init__(self, kube: FakeKube, subnet: SubnetProvider,
+                 sg: SecurityGroupProvider, ami: AMIProvider,
+                 profiles: InstanceProfileProvider, clock=time.time):
+        self.kube = kube
+        self.subnet = subnet
+        self.sg = sg
+        self.ami = ami
+        self.profiles = profiles
+        self.clock = clock
+
+    def reconcile(self) -> int:
+        n = 0
+        for nc in self.kube.list("EC2NodeClass"):
+            if nc.metadata.deletion_timestamp is not None:
+                continue
+            if "karpenter.k8s.aws/termination" not in nc.metadata.finalizers:
+                nc.metadata.finalizers.append("karpenter.k8s.aws/termination")
+            now = self.clock()
+            ok = True
+            # ami -> subnet -> securitygroup -> instanceprofile -> validation
+            amis = self.ami.list(nc)
+            nc.status_amis = [{"id": a.id, "name": a.name, "arch": a.arch}
+                              for a in amis]
+            nc.set_condition("AMIsReady", "True" if amis else "False",
+                             "" if amis else "NoAMIs", now=now)
+            ok &= bool(amis)
+            subnets = self.subnet.list(nc)
+            nc.status_subnets = [{"id": s.id, "zone": s.zone,
+                                  "zoneID": s.zone_id} for s in subnets]
+            nc.set_condition("SubnetsReady", "True" if subnets else "False",
+                             "" if subnets else "NoSubnets", now=now)
+            ok &= bool(subnets)
+            sgs = self.sg.list(nc)
+            nc.status_security_groups = [{"id": g} for g in sgs]
+            nc.set_condition("SecurityGroupsReady",
+                             "True" if sgs else "False",
+                             "" if sgs else "NoSecurityGroups", now=now)
+            ok &= bool(sgs)
+            nc.status_instance_profile = self.profiles.create(nc)
+            nc.set_condition("InstanceProfileReady", "True", now=now)
+            nc.set_condition("ValidationSucceeded", "True", now=now)
+            nc.set_condition("Ready", "True" if ok else "False", now=now)
+            self.kube.update(nc)
+            n += 1
+        return n
+
+
+class GarbageCollector:
+    def __init__(self, kube: FakeKube, cloudprovider: CloudProvider,
+                 clock=time.time):
+        self.kube = kube
+        self.cloudprovider = cloudprovider
+        self.clock = clock
+
+    def reconcile(self) -> int:
+        """Terminate cloud instances with no NodeClaim (>30s old)."""
+        claimed = {c.provider_id for c in self.kube.list("NodeClaim")
+                   if c.provider_id}
+        reaped = 0
+        now = self.clock()
+        for claim in self.cloudprovider.list():
+            pid = claim.provider_id
+            if pid in claimed:
+                continue
+            instance = self.cloudprovider.instances.get(parse_instance_id(pid))
+            if now - instance.launch_time < GC_GRACE_SECONDS:
+                continue
+            try:
+                self.cloudprovider.instances.delete(instance.id)
+                reaped += 1
+            except NodeClaimNotFoundError:
+                pass
+        # also reap Node objects whose instance is gone
+        live = {i.provider_id for i in self.cloudprovider.instances.list()}
+        for node in self.kube.list("Node"):
+            if node.provider_id and node.provider_id not in live \
+                    and not node.ready:
+                self.kube.delete("Node", node.metadata.name)
+        return reaped
+
+
+class Tagger:
+    def __init__(self, kube: FakeKube, instances: InstanceProvider,
+                 cluster_name: str = "cluster"):
+        self.kube = kube
+        self.instances = instances
+        self.cluster_name = cluster_name
+        self._done: Set[str] = set()
+
+    def reconcile(self) -> int:
+        n = 0
+        for claim in self.kube.list("NodeClaim"):
+            if not claim.registered or claim.uid in self._done \
+                    or not claim.provider_id:
+                continue
+            instance_id = parse_instance_id(claim.provider_id)
+            try:
+                self.instances.create_tags(instance_id, {
+                    "Name": f"{claim.nodepool}/{claim.name}",
+                    "karpenter.sh/nodeclaim": claim.name,
+                    "eks:eks-cluster-name": self.cluster_name,
+                })
+                self._done.add(claim.uid)
+                n += 1
+            except NodeClaimNotFoundError:
+                pass
+        return n
+
+
+ACTIONABLE_KINDS = {"spot_interruption", "rebalance_recommendation",
+                    "scheduled_change", "state_change"}
+
+
+class InterruptionController:
+    def __init__(self, kube: FakeKube, sqs: SQSProvider,
+                 unavailable_offerings, metrics=None, clock=time.time):
+        self.kube = kube
+        self.sqs = sqs
+        self.unavailable = unavailable_offerings
+        self.metrics = metrics
+        self.clock = clock
+
+    def reconcile(self) -> Dict[str, int]:
+        stats = {"handled": 0, "cordoned": 0, "noop": 0}
+        claims_by_instance = {}
+        for c in self.kube.list("NodeClaim"):
+            if c.provider_id:
+                claims_by_instance[parse_instance_id(c.provider_id)] = c
+        while True:
+            messages = self.sqs.receive(max_messages=10)
+            if not messages:
+                break
+            for msg in messages:
+                self._handle(msg, claims_by_instance, stats)
+                self.sqs.delete(msg)
+                stats["handled"] += 1
+                if self.metrics is not None:
+                    self.metrics.inc("karpenter_interruption_received_messages_total",
+                                     labels={"message_type": msg.kind})
+        return stats
+
+    def _handle(self, msg: InterruptionMessage, claims, stats) -> None:
+        if msg.kind not in ACTIONABLE_KINDS:
+            stats["noop"] += 1
+            return
+        claim = claims.get(msg.instance_id)
+        if claim is None:
+            stats["noop"] += 1
+            return
+        if msg.kind == "spot_interruption":
+            # blacklist the offering so the replacement avoids the pool
+            itype = claim.metadata.labels.get(L.INSTANCE_TYPE, "")
+            zone = claim.metadata.labels.get(L.ZONE, "")
+            if itype and zone:
+                self.unavailable.mark_unavailable(
+                    L.CAPACITY_TYPE_SPOT, itype, zone, reason="SpotInterruption")
+        if msg.kind in ACTIONABLE_KINDS:
+            # CordonAndDrain: delete the claim; termination drains + replaces
+            self.kube.delete("NodeClaim", claim.metadata.name)
+            stats["cordoned"] += 1
+
+
+class CatalogController:
+    """12h instance-type + offerings refresh (controller.go:43-60)."""
+
+    def __init__(self, ec2, provider: InstanceTypeProvider):
+        self.ec2 = ec2
+        self.provider = provider
+
+    def reconcile(self) -> bool:
+        changed = self.provider.update_instance_types(
+            self.ec2.describe_instance_types())
+        type_zones: Dict[str, set] = {}
+        for t, z in self.ec2.describe_instance_type_offerings():
+            type_zones.setdefault(t, set()).add(z)
+        changed |= self.provider.update_offerings(OfferingsSnapshot(
+            zones={z.name: z for z in self.ec2.zones},
+            type_zones=type_zones,
+            od_prices=self.ec2.on_demand_prices(),
+            spot_prices={(t, z): p
+                         for t, z, p in self.ec2.describe_spot_price_history()},
+        ))
+        return changed
+
+
+class PricingController:
+    def __init__(self, pricing: PricingProvider):
+        self.pricing = pricing
+
+    def reconcile(self) -> bool:
+        a = self.pricing.update_on_demand_pricing()
+        b = self.pricing.update_spot_pricing()
+        return a or b

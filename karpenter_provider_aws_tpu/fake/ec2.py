@@ -276,7 +276,9 @@ class FakeEC2:
     def create_fleet(self,
                      launch_template_configs: Sequence[Mapping[str, Any]],
                      target_capacity: int,
-                     capacity_type: str) -> Tuple[List[FakeInstance], List[dict]]:
+                     capacity_type: str,
+                     tags: Optional[Mapping[str, str]] = None,
+                     ) -> Tuple[List[FakeInstance], List[dict]]:
         """Instant-fleet semantics: each config is {"launch_template_name",
         "overrides": [{"instance_type","zone","subnet_id","image_id","priority"?}]}.
 
@@ -328,7 +330,8 @@ class FakeEC2:
                         launch_template_name=o["launch_template_name"],
                         subnet_id=o.get("subnet_id", ""),
                         launch_time=self.now(),
-                        tags=dict(lt.tags) if lt else {})
+                        tags={**(dict(lt.tags) if lt else {}),
+                              **dict(tags or {})})
                     self.instances[inst.id] = inst
                     instances.append(inst)
                     remaining -= 1

@@ -1,0 +1,101 @@
+"""Fake kubelet + kube-scheduler: turns running cloud instances into Ready
+Nodes and binds nominated pods.
+
+The E2E analog of real nodes joining the cluster (the reference tests this
+against live EKS; we simulate the join so the control-plane loop closes:
+launch -> register -> initialize -> pods bound).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+from ..apis import labels as L
+from ..apis.objects import Node, Taint
+from ..fake.ec2 import FakeEC2
+from ..fake.kube import FakeKube
+from ..state.cluster import ClusterState
+
+
+class FakeKubelet:
+    def __init__(self, kube: FakeKube, ec2: FakeEC2, catalog_by_name,
+                 state: ClusterState, clock=time.time,
+                 vm_overhead_percent: float = 0.075):
+        self.kube = kube
+        self.ec2 = ec2
+        self.catalog = catalog_by_name
+        self.state = state
+        self.clock = clock
+        self.overhead = vm_overhead_percent
+
+    def tick(self) -> int:
+        """Join running instances that have a NodeClaim; bind nominated pods
+        on ready nodes. Returns number of nodes joined."""
+        joined = 0
+        claims = {c.provider_id: c for c in self.kube.list("NodeClaim")
+                  if c.provider_id}
+        nodes_by_pid = {n.provider_id: n for n in self.kube.list("Node")}
+        for inst in self.ec2.describe_instances():
+            if inst.state != "running" or inst.provider_id in nodes_by_pid:
+                continue
+            claim = claims.get(inst.provider_id)
+            if claim is None:
+                continue
+            node = self._make_node(inst, claim)
+            self.kube.create(node)
+            joined += 1
+        self._bind_nominated_pods()
+        self._reap_terminated(nodes_by_pid)
+        return joined
+
+    def _make_node(self, inst, claim) -> Node:
+        from ..apis.resources import Resources
+        info = self.catalog.get(inst.instance_type)
+        labels = dict(claim.metadata.labels)
+        labels.update({
+            L.INSTANCE_TYPE: inst.instance_type,
+            L.ZONE: inst.zone, L.ZONE_ID: inst.zone_id,
+            L.CAPACITY_TYPE: inst.capacity_type,
+            L.HOSTNAME: claim.name,
+            L.OS: L.OS_LINUX,
+        })
+        if info is not None:
+            labels[L.ARCH] = info.arch
+            capacity = Resources({
+                "cpu": info.vcpus * 1000,
+                # real nodes report true memory (discovered-capacity source)
+                "memory": int(info.memory_bytes * (1 - self.overhead * 0.9)),
+                "pods": info.eni_pod_limit,
+                "ephemeral-storage": 20 * 1024**3,
+            })
+        else:
+            capacity = claim.capacity
+        allocatable = claim.allocatable if not claim.allocatable.is_zero() \
+            else capacity
+        node = Node(name=claim.name, labels=labels, capacity=capacity,
+                    allocatable=allocatable,
+                    taints=[t for t in claim.taints],
+                    provider_id=inst.provider_id)
+        node.ready = True
+        return node
+
+    def _bind_nominated_pods(self) -> None:
+        ready = {n.name for n in self.kube.list("Node") if n.ready}
+        for pod in self.kube.list("Pod"):
+            if pod.node_name:
+                continue
+            target = self.state.nomination_for(pod.full_name())
+            if target and target in ready:
+                pod.node_name = target
+                pod.phase = "Running"
+                self.state.clear_nomination(pod.full_name())
+                self.kube.update(pod)
+
+    def _reap_terminated(self, nodes_by_pid: Dict[str, Node]) -> None:
+        """Instance terminated out from under a node -> node NotReady."""
+        live = {i.provider_id for i in self.ec2.describe_instances()}
+        for pid, node in nodes_by_pid.items():
+            if pid not in live and node.ready:
+                node.ready = False
+                self.kube.update(node)

@@ -1,0 +1,156 @@
+"""The operator: constructs every provider/controller and wires the manager.
+
+Mirrors cmd/controller/main.go:28-74 + pkg/operator/operator.go:76-205: the
+operator builds clients (here: the fake cloud), discovers cluster facts,
+constructs every provider singleton with its cache, then registers core +
+provider controllers on one manager. ``step()`` runs one reconcile round of
+every controller in dependency order; ``run_until_settled()`` drives the
+loop to a fixed point (the envtest-style test harness).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .cache.ttl import UnavailableOfferings
+from .cloudprovider.provider import CloudProvider
+from .controllers.lifecycle import NodeClaimLifecycle, Terminator
+from .controllers.provisioning import Provisioner
+from .controllers.steady_state import (CatalogController, GarbageCollector,
+                                       InterruptionController,
+                                       NodeClassStatusController,
+                                       PricingController, Tagger)
+from .fake.catalog import catalog_by_name
+from .fake.ec2 import FakeEC2
+from .fake.kube import FakeKube
+from .fake.kubelet import FakeKubelet
+from .providers.amifamily import AMIProvider
+from .providers.instance import InstanceProvider
+from .providers.instancetype import InstanceTypeProvider
+from .providers.launchtemplate import LaunchTemplateProvider
+from .providers.network import SecurityGroupProvider, SubnetProvider
+from .providers.pricing import (InstanceProfileProvider, PricingProvider,
+                                SQSProvider, VersionProvider)
+from .solver.cpu import CPUSolver
+from .solver.types import Solver
+from .state.cluster import ClusterState
+from .utils.metrics import Metrics
+
+
+@dataclass
+class Options:
+    """The 8 AWS flags (options.go:36-85)."""
+    cluster_name: str = "cluster"
+    cluster_endpoint: str = "https://cluster.local"
+    cluster_ca_bundle: str = ""
+    isolated_vpc: bool = False
+    eks_control_plane: bool = True
+    vm_memory_overhead_percent: float = 0.075
+    interruption_queue: str = "karpenter-interruption"
+    reserved_enis: int = 0
+
+
+class Operator:
+    def __init__(self, options: Optional[Options] = None,
+                 ec2: Optional[FakeEC2] = None,
+                 solver: Optional[Solver] = None,
+                 clock=time.time):
+        self.options = options or Options()
+        self.clock = clock
+        self.ec2 = ec2 or FakeEC2()
+        self.kube = FakeKube(now=clock)
+        self.metrics = Metrics()
+
+        # providers (operator.go:139-186)
+        self.unavailable_offerings = UnavailableOfferings()
+        self.instance_types = InstanceTypeProvider(
+            vm_memory_overhead_percent=self.options.vm_memory_overhead_percent,
+            unavailable_offerings=self.unavailable_offerings)
+        self.pricing = PricingProvider(self.ec2)
+        self.subnets = SubnetProvider(self.ec2)
+        self.security_groups = SecurityGroupProvider(self.ec2)
+        self.amis = AMIProvider(self.ec2)
+        self.instance_profiles = InstanceProfileProvider(
+            self.options.cluster_name)
+        self.version = VersionProvider()
+        self.sqs = SQSProvider(self.options.interruption_queue)
+        self.launch_templates = LaunchTemplateProvider(
+            self.ec2, self.amis, self.security_groups,
+            cluster_name=self.options.cluster_name,
+            cluster_endpoint=self.options.cluster_endpoint,
+            ca_bundle=self.options.cluster_ca_bundle)
+        self.instances = InstanceProvider(
+            self.ec2, self.subnets, self.launch_templates,
+            self.unavailable_offerings,
+            cluster_name=self.options.cluster_name)
+
+        # the plugin boundary + core state (main.go:31-40)
+        self.cloudprovider = CloudProvider(
+            self.kube, self.instance_types, self.instances,
+            cluster_name=self.options.cluster_name, clock=clock)
+        self.state = ClusterState(self.kube, clock=clock)
+
+        # controllers (controllers.go:63-101 + core)
+        self.solver = solver or CPUSolver()
+        self.provisioner = Provisioner(self.kube, self.state,
+                                       self.cloudprovider, self.solver,
+                                       metrics=self.metrics, clock=clock)
+        self.lifecycle = NodeClaimLifecycle(self.kube, self.cloudprovider,
+                                            self.instance_types, clock=clock)
+        self.terminator = Terminator(self.kube, self.cloudprovider, clock=clock)
+        self.nodeclass_status = NodeClassStatusController(
+            self.kube, self.subnets, self.security_groups, self.amis,
+            self.instance_profiles, clock=clock)
+        self.gc = GarbageCollector(self.kube, self.cloudprovider, clock=clock)
+        self.tagger = Tagger(self.kube, self.instances,
+                             cluster_name=self.options.cluster_name)
+        self.interruption = InterruptionController(
+            self.kube, self.sqs, self.unavailable_offerings,
+            metrics=self.metrics, clock=clock)
+        self.catalog_controller = CatalogController(self.ec2, self.instance_types)
+        self.pricing_controller = PricingController(self.pricing)
+
+        # node-join simulation (the E2E "real cluster" analog)
+        self.kubelet = FakeKubelet(self.kube, self.ec2,
+                                   catalog_by_name(self.ec2.catalog),
+                                   self.state, clock=clock,
+                                   vm_overhead_percent=self.options.vm_memory_overhead_percent)
+
+        # boot-blocking hydration (operator.go:152-155): catalog + pricing
+        self.catalog_controller.reconcile()
+        self.pricing_controller.reconcile()
+
+    # ------------------------------------------------------------------
+    def step(self) -> dict:
+        """One reconcile round of every controller, dependency order."""
+        out = {}
+        out["nodeclass"] = self.nodeclass_status.reconcile()
+        out["interruption"] = self.interruption.reconcile()
+        out["terminated"] = self.terminator.reconcile()
+        prov = self.provisioner.reconcile()
+        out["provisioned"] = len(prov.created_claims)
+        out["unschedulable"] = len(prov.unschedulable)
+        out["lifecycle"] = self.lifecycle.reconcile()
+        out["joined"] = self.kubelet.tick()
+        out["lifecycle2"] = self.lifecycle.reconcile()
+        out["tagged"] = self.tagger.reconcile()
+        out["gc"] = self.gc.reconcile()
+        return out
+
+    def run_until_settled(self, max_steps: int = 20) -> int:
+        """Step until a fixed point: no pending pods, no mid-lifecycle
+        claims, nothing terminated/GC'd this round."""
+        for i in range(max_steps):
+            out = self.step()
+            quiet = (not self.state.pending_pods()
+                     and out["provisioned"] == 0
+                     and out["terminated"] == 0
+                     and out["joined"] == 0
+                     and out["gc"] == 0
+                     and all(v == 0 for v in out["lifecycle"].values())
+                     and all(v == 0 for v in out["lifecycle2"].values()))
+            if quiet:
+                return i + 1
+        return max_steps

@@ -1,5 +1,16 @@
+from .amifamily import AMI, AMIProvider, BootstrapConfig, generate_user_data
+from .instance import InstanceProvider, LaunchedInstance
 from .instancetype import (DEFAULT_VM_MEMORY_OVERHEAD_PERCENT,
                            InstanceTypeProvider, OfferingsSnapshot)
+from .launchtemplate import LaunchTemplateProvider, ResolvedLaunchTemplate
+from .network import SecurityGroupProvider, SubnetInfo, SubnetProvider
+from .pricing import (InstanceProfileProvider, InterruptionMessage,
+                      PricingProvider, SQSProvider, VersionProvider)
 
 __all__ = ["InstanceTypeProvider", "OfferingsSnapshot",
-           "DEFAULT_VM_MEMORY_OVERHEAD_PERCENT"]
+           "DEFAULT_VM_MEMORY_OVERHEAD_PERCENT", "InstanceProvider",
+           "LaunchedInstance", "LaunchTemplateProvider",
+           "ResolvedLaunchTemplate", "SubnetProvider", "SubnetInfo",
+           "SecurityGroupProvider", "AMIProvider", "AMI", "BootstrapConfig",
+           "generate_user_data", "PricingProvider", "SQSProvider",
+           "InterruptionMessage", "InstanceProfileProvider", "VersionProvider"]

@@ -1,0 +1,248 @@
+"""AMI family resolution + userdata bootstrap generation.
+
+Mirrors pkg/providers/amifamily: AMI discovery per family via SSM public
+parameters + DescribeImages (ami.go:89-198), deprecation handling,
+newest-first sort (types.go:46), ``map_to_instance_types`` by arch /
+requirements (ami.go:200-222). Families: AL2 (al2.go), AL2023/nodeadm
+(al2023.go), Bottlerocket TOML (bottlerocket.go), Windows (windows.go),
+Custom (custom.go). Userdata generation mirrors amifamily/bootstrap: the
+eksbootstrap.sh arg line, nodeadm NodeConfig YAML, Bottlerocket settings
+TOML, and MIME-multipart merge of custom userdata (bootstrap/mime/).
+"""
+
+from __future__ import annotations
+
+import base64
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+from ..apis import labels as L
+from ..apis.objects import EC2NodeClass, KubeletConfiguration, SelectorTerm, Taint
+from ..cache.ttl import SSM_TTL, TTLCache
+
+FAMILIES = ("al2", "al2023", "bottlerocket", "windows2019", "windows2022",
+            "custom")
+
+
+@dataclass(frozen=True)
+class AMI:
+    id: str
+    name: str
+    arch: str           # amd64 | arm64
+    creation_date: float
+    deprecated: bool = False
+
+    @property
+    def requirements(self):
+        from ..apis.requirements import IN, Requirement, Requirements
+        return Requirements([Requirement.new(L.ARCH, IN, [self.arch])])
+
+
+class AMIProvider:
+    def __init__(self, ec2, clock=None):
+        self.ec2 = ec2
+        self._ssm_cache = TTLCache(ttl=SSM_TTL, clock=clock)
+
+    def list(self, nodeclass: EC2NodeClass) -> List[AMI]:
+        """Resolve the nodeclass's AMI selector terms to concrete AMIs,
+        newest-first then name (deterministic; types.go:46)."""
+        amis: Dict[str, AMI] = {}
+        for term in nodeclass.ami_selector_terms:
+            if term.alias:
+                family, _ = (term.alias.split("@", 1) + ["latest"])[:2]
+                for arch in ("amd64", "arm64"):
+                    ami = self._resolve_ssm(family, arch)
+                    if ami is not None:
+                        amis[ami.id] = ami
+            else:
+                for img in self.ec2.describe_images(
+                        tag_filters=dict(term.tags),
+                        ids=[term.id] if term.id else (),
+                        names=[term.name] if term.name else ()):
+                    if not img.deprecated:
+                        amis[img.id] = AMI(img.id, img.name, img.arch,
+                                           img.creation_date, img.deprecated)
+        return sorted(amis.values(),
+                      key=lambda a: (-a.creation_date, a.name))
+
+    def _resolve_ssm(self, family: str, arch: str) -> Optional[AMI]:
+        path = f"/aws/service/{family}/{arch}/latest/image_id"
+        ami_id = self._ssm_cache.get(path)
+        if ami_id is None:
+            try:
+                ami_id = self.ec2.ssm_get_parameter(path)
+            except KeyError:
+                return None
+            self._ssm_cache.put(path, ami_id)
+        imgs = self.ec2.describe_images(ids=[ami_id])
+        if not imgs:
+            return None
+        img = imgs[0]
+        return AMI(img.id, img.name, img.arch, img.creation_date, img.deprecated)
+
+    def invalidate_deprecated(self) -> int:
+        """SSM cache invalidation for params resolving to deprecated AMIs
+        (ssm/invalidation/controller.go:55-88)."""
+        evicted = 0
+        for path in list(self._ssm_cache.keys()):
+            ami_id = self._ssm_cache.get(path)
+            imgs = self.ec2.describe_images(ids=[ami_id]) if ami_id else []
+            if not imgs or imgs[0].deprecated:
+                self._ssm_cache.delete(path)
+                evicted += 1
+        return evicted
+
+
+def map_to_instance_types(amis: Sequence[AMI], instance_types) -> Dict[str, List]:
+    """ami id -> instance types whose requirements the AMI satisfies
+    (ami.go:200-222). First (newest) AMI compatible with a type wins."""
+    out: Dict[str, List] = {a.id: [] for a in amis}
+    for it in instance_types:
+        for ami in amis:
+            if not it.requirements.conflicts(ami.requirements):
+                out[ami.id].append(it)
+                break
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Bootstrap userdata (amifamily/bootstrap)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class BootstrapConfig:
+    cluster_name: str
+    cluster_endpoint: str
+    ca_bundle: str = ""
+    cluster_cidr: str = "10.100.0.0/16"
+    labels: Dict[str, str] = field(default_factory=dict)
+    taints: Sequence[Taint] = ()
+    kubelet: KubeletConfiguration = field(default_factory=KubeletConfiguration)
+    custom_user_data: str = ""
+
+
+def generate_user_data(family: str, cfg: BootstrapConfig) -> str:
+    """Family-specific node bootstrap userdata."""
+    if family == "al2":
+        return _al2(cfg)
+    if family == "al2023":
+        return _al2023(cfg)
+    if family == "bottlerocket":
+        return _bottlerocket(cfg)
+    if family.startswith("windows"):
+        return _windows(cfg)
+    return cfg.custom_user_data  # custom family: verbatim (custom.go)
+
+
+def _kubelet_args(cfg: BootstrapConfig) -> str:
+    args = []
+    if cfg.labels:
+        args.append("--node-labels=" + ",".join(
+            f"{k}={v}" for k, v in sorted(cfg.labels.items())))
+    if cfg.taints:
+        args.append("--register-with-taints=" + ",".join(
+            f"{t.key}={t.value}:{t.effect}" for t in cfg.taints))
+    if cfg.kubelet.max_pods is not None:
+        args.append(f"--max-pods={cfg.kubelet.max_pods}")
+    return " ".join(args)
+
+
+def _al2(cfg: BootstrapConfig) -> str:
+    """eksbootstrap.sh line (al2.go; bootstrap/eksbootstrap.go)."""
+    script = (
+        "#!/bin/bash -xe\n"
+        f"/etc/eks/bootstrap.sh '{cfg.cluster_name}'"
+        f" --apiserver-endpoint '{cfg.cluster_endpoint}'"
+    )
+    if cfg.ca_bundle:
+        script += f" --b64-cluster-ca '{cfg.ca_bundle}'"
+    kargs = _kubelet_args(cfg)
+    if kargs:
+        script += f" --kubelet-extra-args '{kargs}'"
+    script += "\n"
+    if cfg.custom_user_data:
+        return _mime_merge([cfg.custom_user_data, script])
+    return script
+
+
+def _al2023(cfg: BootstrapConfig) -> str:
+    """nodeadm NodeConfig YAML (al2023.go; bootstrap/nodeadm.go)."""
+    lines = [
+        "apiVersion: node.eks.aws/v1alpha1",
+        "kind: NodeConfig",
+        "spec:",
+        "  cluster:",
+        f"    name: {cfg.cluster_name}",
+        f"    apiServerEndpoint: {cfg.cluster_endpoint}",
+        f"    certificateAuthority: {cfg.ca_bundle}",
+        f"    cidr: {cfg.cluster_cidr}",
+        "  kubelet:",
+        "    config:",
+    ]
+    if cfg.kubelet.max_pods is not None:
+        lines.append(f"      maxPods: {cfg.kubelet.max_pods}")
+    if cfg.kubelet.cluster_dns:
+        lines.append(f"      clusterDNS: [{', '.join(cfg.kubelet.cluster_dns)}]")
+    lines.append("    flags:")
+    for flag in _kubelet_args(cfg).split():
+        lines.append(f"      - {flag}")
+    body = "\n".join(lines) + "\n"
+    parts = [body] + ([cfg.custom_user_data] if cfg.custom_user_data else [])
+    return _mime_merge(parts, content_type="application/node.eks.aws")
+
+
+def _bottlerocket(cfg: BootstrapConfig) -> str:
+    """settings TOML (bottlerocket.go)."""
+    lines = [
+        "[settings.kubernetes]",
+        f'cluster-name = "{cfg.cluster_name}"',
+        f'api-server = "{cfg.cluster_endpoint}"',
+    ]
+    if cfg.ca_bundle:
+        lines.append(f'cluster-certificate = "{cfg.ca_bundle}"')
+    if cfg.kubelet.max_pods is not None:
+        lines.append(f"max-pods = {cfg.kubelet.max_pods}")
+    if cfg.labels:
+        lines.append("[settings.kubernetes.node-labels]")
+        for k, v in sorted(cfg.labels.items()):
+            lines.append(f'"{k}" = "{v}"')
+    if cfg.taints:
+        lines.append("[settings.kubernetes.node-taints]")
+        for t in cfg.taints:
+            lines.append(f'"{t.key}" = "{t.value}:{t.effect}"')
+    body = "\n".join(lines) + "\n"
+    if cfg.custom_user_data:
+        # bottlerocket: custom settings TOML merges after ours (bottlerocket.go)
+        body += cfg.custom_user_data.rstrip() + "\n"
+    return body
+
+
+def _windows(cfg: BootstrapConfig) -> str:
+    """PowerShell EKS bootstrap (windows.go)."""
+    kargs = _kubelet_args(cfg)
+    return (
+        "<powershell>\n"
+        "[string]$EKSBinDir = \"$env:ProgramFiles\\Amazon\\EKS\"\n"
+        f"& $EKSBinDir\\Start-EKSBootstrap.ps1 -EKSClusterName '{cfg.cluster_name}'"
+        f" -APIServerEndpoint '{cfg.cluster_endpoint}'"
+        + (f" -KubeletExtraArgs '{kargs}'" if kargs else "")
+        + "\n</powershell>\n"
+    )
+
+
+def _mime_merge(parts: Sequence[str],
+                content_type: str = "text/x-shellscript; charset=\"us-ascii\"") -> str:
+    """MIME multipart merge (bootstrap/mime/mime.go)."""
+    boundary = "//"
+    out = [f'MIME-Version: 1.0\nContent-Type: multipart/mixed; boundary="{boundary}"\n']
+    for p in parts:
+        ct = content_type
+        if p.lstrip().startswith("MIME-Version"):
+            p = p.split("\n\n", 1)[-1]
+        elif p.lstrip().startswith("apiVersion: node.eks.aws"):
+            ct = "application/node.eks.aws"
+        elif p.lstrip().startswith("#!"):
+            ct = 'text/x-shellscript; charset="us-ascii"'
+        out.append(f"--{boundary}\nContent-Type: {ct}\n\n{p}")
+    out.append(f"--{boundary}--\n")
+    return "\n".join(out)

@@ -1,0 +1,273 @@
+"""Instance provider — the launcher.
+
+Mirrors pkg/providers/instance: filter exotic/expensive-spot types
+(instance.go:385-452), truncate to 60 types (:55,106), spot-vs-OD capacity
+type selection (:365-381), CreateFleet request construction (instant fleet,
+price-capacity-optimized spot / lowest-price OD :227-245), overrides =
+instance-type x zonal-subnet cross product (:317-355), ICE errors →
+UnavailableOfferings (:357-363), OD-fallback flexibility warning at <5
+types (:270-288), and instance → NodeClaim reconstruction (:147-163).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..apis import labels as L
+from ..apis.objects import EC2NodeClass, NodeClaim
+from ..apis.requirements import IN, Requirement, Requirements
+from ..cache.ttl import UnavailableOfferings
+from ..cloudprovider.types import (InstanceType, InstanceTypes,
+                                   InsufficientCapacityError,
+                                   NodeClaimNotFoundError)
+from ..batcher.core import (CreateFleetBatcher, CreateFleetRequest,
+                            DescribeInstancesBatcher,
+                            TerminateInstancesBatcher, to_hashable)
+from .launchtemplate import LaunchTemplateProvider
+from .network import SubnetProvider
+
+log = logging.getLogger(__name__)
+
+MAX_INSTANCE_TYPES = 60   # instance.go:55
+MIN_FLEXIBLE_TYPES = 5    # instance.go:270-288 (OD fallback warning)
+
+
+@dataclass
+class LaunchedInstance:
+    id: str
+    instance_type: str
+    zone: str
+    zone_id: str
+    capacity_type: str
+    image_id: str
+    provider_id: str
+    subnet_id: str
+    tags: Dict[str, str]
+    state: str = "running"
+    launch_time: float = 0.0
+
+
+class InstanceProvider:
+    def __init__(self, ec2, subnet_provider: SubnetProvider,
+                 launch_template_provider: LaunchTemplateProvider,
+                 unavailable_offerings: UnavailableOfferings,
+                 cluster_name: str = "cluster", clock=None):
+        self.ec2 = ec2
+        self.subnets = subnet_provider
+        self.launch_templates = launch_template_provider
+        self.unavailable = unavailable_offerings
+        self.cluster_name = cluster_name
+        clock = clock or time.monotonic
+        self.create_fleet = CreateFleetBatcher(ec2, clock=clock)
+        self.describe = DescribeInstancesBatcher(ec2, clock=clock)
+        self.terminate_batcher = TerminateInstancesBatcher(ec2, clock=clock)
+
+    # -- create --------------------------------------------------------
+    def create(self, nodeclass: EC2NodeClass, nodeclaim: NodeClaim,
+               instance_types: InstanceTypes,
+               tags: Optional[Dict[str, str]] = None) -> LaunchedInstance:
+        """Launch one instance for the NodeClaim (instance.go:100-128)."""
+        reqs = nodeclaim.requirements
+        types = self._filter_instance_types(
+            instance_types, reqs, nodeclaim.resources_requested)
+        types = InstanceTypes(types).truncate(reqs, MAX_INSTANCE_TYPES)
+        if not types:
+            raise InsufficientCapacityError(
+                f"no viable instance types for {nodeclaim.name}")
+        capacity_type = self._capacity_type(reqs, types)
+        if capacity_type == L.CAPACITY_TYPE_ON_DEMAND and len(types) < MIN_FLEXIBLE_TYPES:
+            log.warning("launching with only %d instance type options (<%d): "
+                        "flexibility is degraded", len(types), MIN_FLEXIBLE_TYPES)
+        zonal_subnets = self.subnets.zonal_subnets_for_launch(nodeclass)
+        lts = self.launch_templates.ensure_all(
+            nodeclass, types,
+            labels=dict(nodeclaim.metadata.labels),
+            taints=nodeclaim.taints)
+        overrides = self._overrides(types, reqs, capacity_type, zonal_subnets, lts)
+        if not overrides:
+            raise InsufficientCapacityError(
+                f"no (type x zone x subnet) overrides for {nodeclaim.name}")
+        configs = _group_overrides(overrides)
+        fut = self.create_fleet.add(CreateFleetRequest(
+            launch_template_configs=to_hashable(configs),
+            capacity_type=capacity_type,
+            tags=to_hashable(tags or {})))
+        instance, errors = fut.result(timeout=30)
+        for err in errors:
+            # ICE -> blacklist the offering for 3m; feeds the next Solve
+            self.unavailable.mark_unavailable(
+                err["capacity_type"], err["instance_type"], err["zone"],
+                reason=err["code"])
+        if instance is None:
+            raise InsufficientCapacityError(
+                "CreateFleet returned no instance: "
+                + "; ".join(e["code"] for e in errors))
+        self.subnets.update_inflight_ips(instance.subnet_id)
+        return LaunchedInstance(
+            id=instance.id, instance_type=instance.instance_type,
+            zone=instance.zone, zone_id=instance.zone_id,
+            capacity_type=instance.capacity_type, image_id=instance.image_id,
+            provider_id=instance.provider_id, subnet_id=instance.subnet_id,
+            tags=dict(instance.tags), state=instance.state,
+            launch_time=instance.launch_time)
+
+    # -- read/delete ---------------------------------------------------
+    def get(self, instance_id: str) -> LaunchedInstance:
+        inst = self.describe.add_sync(instance_id)
+        if inst is None or inst.state in ("terminated", "shutting-down"):
+            raise NodeClaimNotFoundError(instance_id)
+        return _to_launched(inst)
+
+    def list(self) -> List[LaunchedInstance]:
+        """All karpenter-owned instances (tag-scoped; instance.go List)."""
+        out = []
+        for inst in self.ec2.describe_instances(
+                tag_filters={"karpenter.sh/nodepool": "*"}):
+            if f"kubernetes.io/cluster/{self.cluster_name}" in inst.tags \
+                    or inst.tags.get("eks:eks-cluster-name") == self.cluster_name:
+                out.append(_to_launched(inst))
+        return out
+
+    def delete(self, instance_id: str) -> None:
+        ok = self.terminate_batcher.add_sync(instance_id)
+        if not ok:
+            raise NodeClaimNotFoundError(instance_id)
+
+    def create_tags(self, instance_id: str, tags: Dict[str, str]) -> None:
+        try:
+            self.ec2.create_tags([instance_id], tags)
+        except KeyError as e:
+            raise NodeClaimNotFoundError(str(e)) from e
+
+    # -- internals -----------------------------------------------------
+    def _filter_instance_types(self, types: InstanceTypes,
+                               reqs: Requirements,
+                               requested) -> InstanceTypes:
+        """filterInstanceTypes (instance.go:385-392): drop exotic types when
+        generic alternatives exist; for mixed spot/OD launches, drop spot
+        types priced above the cheapest on-demand."""
+        types = _filter_exotic(types)
+        if self._is_mixed_capacity(reqs, types):
+            types = _filter_unwanted_spot(types)
+        return types
+
+    @staticmethod
+    def _is_mixed_capacity(reqs: Requirements, types: InstanceTypes) -> bool:
+        """instance.go:397-421: both capacity types allowed AND both kinds of
+        offerings available among compatible types."""
+        ct = reqs.get(L.CAPACITY_TYPE)
+        if ct is not None and not (ct.has(L.CAPACITY_TYPE_SPOT)
+                                   and ct.has(L.CAPACITY_TYPE_ON_DEMAND)):
+            return False
+        has_spot = has_od = False
+        for t in types:
+            for o in t.offerings.available():
+                if not o.compatible_with(reqs):
+                    continue
+                if o.capacity_type == L.CAPACITY_TYPE_SPOT:
+                    has_spot = True
+                else:
+                    has_od = True
+        return has_spot and has_od
+
+    @staticmethod
+    def _capacity_type(reqs: Requirements, types: InstanceTypes) -> str:
+        """Spot if allowed and any spot offering remains available, else
+        on-demand (instance.go:365-381)."""
+        ct = reqs.get(L.CAPACITY_TYPE)
+        if ct is None or ct.has(L.CAPACITY_TYPE_SPOT):
+            spot_req = Requirements([Requirement.new(
+                L.CAPACITY_TYPE, IN, [L.CAPACITY_TYPE_SPOT])])
+            for t in types:
+                if t.offerings.available().compatible(spot_req):
+                    if ct is None or ct.has(L.CAPACITY_TYPE_SPOT):
+                        return L.CAPACITY_TYPE_SPOT
+        return L.CAPACITY_TYPE_ON_DEMAND
+
+    def _overrides(self, types: InstanceTypes, reqs: Requirements,
+                   capacity_type: str, zonal_subnets, lts) -> List[dict]:
+        """type x zone cross product with price priorities
+        (instance.go:317-355)."""
+        lt_by_type: Dict[str, str] = {}
+        image_by_type: Dict[str, str] = {}
+        for lt in lts:
+            for tn in lt.instance_type_names:
+                lt_by_type.setdefault(tn, lt.name)
+                image_by_type.setdefault(tn, lt.image_id)
+        ct_req = Requirements([Requirement.new(L.CAPACITY_TYPE, IN, [capacity_type])])
+        overrides = []
+        for t in types:
+            lt_name = lt_by_type.get(t.name)
+            if lt_name is None:
+                continue
+            for o in t.offerings.available().compatible(reqs.union(ct_req)):
+                sn = zonal_subnets.get(o.zone)
+                if sn is None:
+                    continue
+                overrides.append({
+                    "instance_type": t.name, "zone": o.zone,
+                    "subnet_id": sn.id, "image_id": image_by_type[t.name],
+                    "launch_template_name": lt_name,
+                    "priority": o.price,  # price-capacity-optimized proxy
+                })
+        return overrides
+
+
+def _filter_exotic(types: InstanceTypes) -> InstanceTypes:
+    """filterExoticInstanceTypes (instance.go:452-474): prefer non-metal,
+    non-accelerator types; fall back to the ORIGINAL list when nothing
+    generic remains (a GPU-requiring claim has only GPU candidates)."""
+    from ..apis.resources import (AMD_GPU, AWS_NEURON, AWS_NEURON_CORE,
+                                  HABANA_GAUDI, NVIDIA_GPU)
+    generic = InstanceTypes()
+    for it in types:
+        size = it.requirements.get(L.INSTANCE_SIZE)
+        if size is not None and any("metal" in v for v in size.values):
+            continue
+        if any(it.capacity[r] > 0 for r in
+               (NVIDIA_GPU, AMD_GPU, AWS_NEURON, AWS_NEURON_CORE, HABANA_GAUDI)):
+            continue
+        generic.append(it)
+    return generic if generic else types
+
+
+def _filter_unwanted_spot(types: InstanceTypes) -> InstanceTypes:
+    """filterUnwantedSpot (instance.go:425-449): drop types whose cheapest
+    available offering exceeds the cheapest on-demand price."""
+    cheapest_od = None
+    for it in types:
+        for o in it.offerings.available():
+            if o.capacity_type == L.CAPACITY_TYPE_ON_DEMAND:
+                if cheapest_od is None or o.price < cheapest_od:
+                    cheapest_od = o.price
+    if cheapest_od is None:
+        return types
+    out = InstanceTypes()
+    for it in types:
+        avail = it.offerings.available()
+        if not avail:
+            continue
+        if avail.cheapest().price <= cheapest_od:
+            out.append(it)
+    return out
+
+
+def _group_overrides(overrides: List[dict]) -> List[dict]:
+    by_lt: Dict[str, List[dict]] = {}
+    for o in overrides:
+        by_lt.setdefault(o["launch_template_name"], []).append(
+            {k: v for k, v in o.items() if k != "launch_template_name"})
+    return [{"launch_template_name": name, "overrides": ovs}
+            for name, ovs in sorted(by_lt.items())]
+
+
+def _to_launched(inst) -> LaunchedInstance:
+    return LaunchedInstance(
+        id=inst.id, instance_type=inst.instance_type, zone=inst.zone,
+        zone_id=inst.zone_id, capacity_type=inst.capacity_type,
+        image_id=inst.image_id, provider_id=inst.provider_id,
+        subnet_id=inst.subnet_id, tags=dict(inst.tags), state=inst.state,
+        launch_time=inst.launch_time)

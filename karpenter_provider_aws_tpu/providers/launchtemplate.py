@@ -1,0 +1,115 @@
+"""Launch-template provider.
+
+Mirrors pkg/providers/launchtemplate: resolve per-(AMI x arch) launch
+templates — ``ensure_all`` (launchtemplate.go:112-135), name = hash of the
+resolved options (:146), create with network interfaces / block device
+mappings (:275-343), cache hydration on start (:345-371), eviction →
+DeleteLaunchTemplates (:373-390).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence
+
+from ..apis.objects import EC2NodeClass, Taint, stable_hash
+from ..cache.ttl import TTLCache
+from ..fake.ec2 import FakeLaunchTemplate
+from .amifamily import AMI, AMIProvider, BootstrapConfig, generate_user_data, map_to_instance_types
+from .network import SecurityGroupProvider
+
+LT_NAME_PREFIX = "karpenter.k8s.aws"
+
+
+@dataclass(frozen=True)
+class ResolvedLaunchTemplate:
+    name: str
+    image_id: str
+    arch: str
+    #: instance type names this template serves (same AMI mapping bucket)
+    instance_type_names: tuple
+
+
+class LaunchTemplateProvider:
+    def __init__(self, ec2, ami_provider: AMIProvider,
+                 sg_provider: SecurityGroupProvider,
+                 cluster_name: str = "cluster",
+                 cluster_endpoint: str = "https://cluster.local",
+                 ca_bundle: str = "", clock=None):
+        self.ec2 = ec2
+        self.ami = ami_provider
+        self.sg = sg_provider
+        self.cluster_name = cluster_name
+        self.cluster_endpoint = cluster_endpoint
+        self.ca_bundle = ca_bundle
+        self._cache = TTLCache(ttl=600, clock=clock)
+        self._mu = threading.Lock()
+        self.hydrate()
+
+    def hydrate(self) -> None:
+        """Re-learn existing templates on restart (launchtemplate.go:345-371)."""
+        for lt in self.ec2.describe_launch_templates():
+            if lt.name.startswith(LT_NAME_PREFIX):
+                self._cache.put(lt.name, lt)
+
+    def ensure_all(self, nodeclass: EC2NodeClass, instance_types,
+                   labels: Optional[Dict[str, str]] = None,
+                   taints: Sequence[Taint] = (),
+                   ) -> List[ResolvedLaunchTemplate]:
+        """One launch template per (AMI bucket) covering the given types
+        (launchtemplate.go:112-135)."""
+        amis = self.ami.list(nodeclass)
+        buckets = map_to_instance_types(amis, instance_types)
+        sgs = self.sg.list(nodeclass)
+        out: List[ResolvedLaunchTemplate] = []
+        with self._mu:
+            for ami in amis:
+                types = buckets.get(ami.id, [])
+                if not types:
+                    continue
+                user_data = generate_user_data(
+                    nodeclass.ami_family, BootstrapConfig(
+                        cluster_name=self.cluster_name,
+                        cluster_endpoint=self.cluster_endpoint,
+                        ca_bundle=self.ca_bundle,
+                        labels=dict(labels or {}), taints=tuple(taints),
+                        kubelet=nodeclass.kubelet,
+                        custom_user_data=nodeclass.user_data))
+                name = self._lt_name(nodeclass, ami, sgs, user_data)
+                if self._cache.get(name) is None:
+                    lt = FakeLaunchTemplate(
+                        id="", name=name, image_id=ami.id,
+                        security_group_ids=list(sgs), user_data=user_data,
+                        tags=dict(nodeclass.tags),
+                        metadata_options=vars(nodeclass.metadata_options),
+                        block_device_mappings=[vars(b) for b in
+                                               nodeclass.block_device_mappings],
+                        instance_profile=nodeclass.status_instance_profile
+                        or nodeclass.instance_profile)
+                    self.ec2.create_launch_template(lt)
+                    self._cache.put(name, lt)
+                out.append(ResolvedLaunchTemplate(
+                    name=name, image_id=ami.id, arch=ami.arch,
+                    instance_type_names=tuple(t.name for t in types)))
+        return out
+
+    def _lt_name(self, nodeclass: EC2NodeClass, ami: AMI,
+                 sgs: Sequence[str], user_data: str) -> str:
+        """Deterministic name from the resolved options (launchtemplate.go:146)."""
+        h = stable_hash({
+            "ami": ami.id, "sgs": list(sgs), "userData": user_data,
+            "nodeClassHash": nodeclass.hash(),
+            "instanceProfile": nodeclass.status_instance_profile,
+        })
+        return f"{LT_NAME_PREFIX}/{nodeclass.metadata.name}/{h}"
+
+    def delete_for_nodeclass(self, nodeclass: EC2NodeClass) -> int:
+        """NodeClass deletion -> drop its templates (launchtemplate.go:373-390)."""
+        prefix = f"{LT_NAME_PREFIX}/{nodeclass.metadata.name}/"
+        doomed = [lt.name for lt in self.ec2.describe_launch_templates()
+                  if lt.name.startswith(prefix)]
+        self.ec2.delete_launch_templates(doomed)
+        for n in doomed:
+            self._cache.delete(n)
+        return len(doomed)

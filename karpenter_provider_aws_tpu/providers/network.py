@@ -1,0 +1,100 @@
+"""Subnet + security-group discovery providers.
+
+Subnet provider mirrors pkg/providers/subnet: discovery by selector terms
+(subnet.go:81-126), zonal subnet choice for launch = most available IPs
+(subnet.go:128-175), and in-flight IP accounting after CreateFleet
+(subnet.go:177-233). Security-group provider mirrors
+pkg/providers/securitygroup (securitygroup.go:36-38).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence
+
+from ..apis.objects import EC2NodeClass, SelectorTerm
+from ..cache.ttl import AVAILABLE_IPS_TTL, DEFAULT_TTL, TTLCache
+
+
+@dataclass(frozen=True)
+class SubnetInfo:
+    id: str
+    zone: str
+    zone_id: str
+    available_ips: int
+
+
+class SubnetProvider:
+    def __init__(self, ec2, clock=None):
+        self.ec2 = ec2
+        self._cache = TTLCache(ttl=DEFAULT_TTL, clock=clock)
+        self._mu = threading.Lock()
+        #: in-flight IPs not yet visible in DescribeSubnets (subnet.go:177)
+        self._inflight: Dict[str, int] = {}
+
+    def list(self, nodeclass: EC2NodeClass) -> List[SubnetInfo]:
+        key = tuple(nodeclass.subnet_selector_terms)
+        cached = self._cache.get(key)
+        if cached is not None:
+            return cached
+        found: Dict[str, SubnetInfo] = {}
+        terms = nodeclass.subnet_selector_terms or [SelectorTerm()]
+        for term in terms:
+            for s in self.ec2.describe_subnets(
+                    tag_filters=dict(term.tags),
+                    ids=[term.id] if term.id else ()):
+                found[s.id] = SubnetInfo(s.id, s.zone, s.zone_id, s.available_ips)
+        out = sorted(found.values(), key=lambda s: s.id)
+        self._cache.put(key, out)
+        return out
+
+    def zonal_subnets_for_launch(self, nodeclass: EC2NodeClass
+                                 ) -> Dict[str, SubnetInfo]:
+        """zone -> best subnet (most available IPs, accounting in-flight);
+        ties break on subnet id (deterministic) — subnet.go:128-175."""
+        with self._mu:
+            best: Dict[str, SubnetInfo] = {}
+            for s in self.list(nodeclass):
+                avail = s.available_ips - self._inflight.get(s.id, 0)
+                cur = best.get(s.zone)
+                if cur is None:
+                    best[s.zone] = SubnetInfo(s.id, s.zone, s.zone_id, avail)
+                else:
+                    if (avail, s.id) > (cur.available_ips, cur.id):
+                        best[s.zone] = SubnetInfo(s.id, s.zone, s.zone_id, avail)
+            return best
+
+    def update_inflight_ips(self, subnet_id: str, count: int = 1) -> None:
+        """Called post-CreateFleet for each launched instance
+        (subnet.go:177-233)."""
+        with self._mu:
+            self._inflight[subnet_id] = self._inflight.get(subnet_id, 0) + count
+
+    def clear_inflight(self) -> None:
+        with self._mu:
+            self._inflight.clear()
+            self._cache.clear()
+
+
+class SecurityGroupProvider:
+    def __init__(self, ec2, clock=None):
+        self.ec2 = ec2
+        self._cache = TTLCache(ttl=DEFAULT_TTL, clock=clock)
+
+    def list(self, nodeclass: EC2NodeClass) -> List[str]:
+        key = tuple(nodeclass.security_group_selector_terms)
+        cached = self._cache.get(key)
+        if cached is not None:
+            return cached
+        found = set()
+        terms = nodeclass.security_group_selector_terms or [SelectorTerm()]
+        for term in terms:
+            for g in self.ec2.describe_security_groups(
+                    tag_filters=dict(term.tags),
+                    ids=[term.id] if term.id else (),
+                    names=[term.name] if term.name else ()):
+                found.add(g.id)
+        out = sorted(found)
+        self._cache.put(key, out)
+        return out

@@ -1,0 +1,161 @@
+"""Pricing + small providers (instance profile, version, SQS interruption
+queue).
+
+Pricing mirrors pkg/providers/pricing: on-demand prices via the pricing API
+pages (pricing.go:228-354), spot via DescribeSpotPriceHistory into a
+per-zone map (:281-309,356-399), a static fallback snapshot per partition
+(zz_generated.pricing_aws*.go), 12h refresh cadence driven by the pricing
+controller. All prices fixed-point micro-USD.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple
+
+from ..fake.catalog import build_catalog, spot_price
+
+#: static fallback (the zz_generated.pricing table analog): derived from the
+#: deterministic catalog so a cold control plane prices sanely before the
+#: first refresh.
+_STATIC_OD: Dict[str, int] = {i.name: i.od_price for i in build_catalog()}
+
+
+class PricingProvider:
+    def __init__(self, ec2, clock=None):
+        self.ec2 = ec2
+        self._mu = threading.RLock()
+        self._od: Dict[str, int] = dict(_STATIC_OD)
+        self._spot: Dict[Tuple[str, str], int] = {}
+        self._clock = clock or time.monotonic
+        self.od_updated: float = 0.0
+        self.spot_updated: float = 0.0
+
+    def instance_types(self) -> List[str]:
+        with self._mu:
+            return sorted(self._od)
+
+    def on_demand_price(self, instance_type: str) -> Optional[int]:
+        with self._mu:
+            return self._od.get(instance_type)
+
+    def spot_price(self, instance_type: str, zone: str) -> Optional[int]:
+        with self._mu:
+            return self._spot.get((instance_type, zone))
+
+    def spot_prices(self) -> Dict[Tuple[str, str], int]:
+        with self._mu:
+            return dict(self._spot)
+
+    def on_demand_prices(self) -> Dict[str, int]:
+        with self._mu:
+            return dict(self._od)
+
+    # controller-driven refreshes (providers/pricing/controller.go:43-60)
+    def update_on_demand_pricing(self) -> bool:
+        fresh = self.ec2.on_demand_prices()
+        with self._mu:
+            changed = fresh != self._od
+            self._od = dict(fresh)
+            self.od_updated = self._clock()
+            return changed
+
+    def update_spot_pricing(self) -> bool:
+        fresh = {(t, z): p for t, z, p in self.ec2.describe_spot_price_history()}
+        with self._mu:
+            changed = fresh != self._spot
+            self._spot = fresh
+            self.spot_updated = self._clock()
+            return changed
+
+
+class InstanceProfileProvider:
+    """IAM instance-profile CRUD for the NodeClass role
+    (pkg/providers/instanceprofile, instanceprofile.go:43-46)."""
+
+    def __init__(self, cluster_name: str = "cluster", region: str = "us-west-2"):
+        self.cluster_name = cluster_name
+        self.region = region
+        self._mu = threading.Lock()
+        self._profiles: Dict[str, str] = {}   # profile name -> role
+
+    def create(self, nodeclass) -> str:
+        if nodeclass.instance_profile:
+            return nodeclass.instance_profile
+        name = (f"{self.cluster_name}_{nodeclass.metadata.name}_"
+                f"{self.region}_profile")
+        with self._mu:
+            self._profiles[name] = nodeclass.role
+        return name
+
+    def get(self, name: str) -> Optional[str]:
+        with self._mu:
+            return self._profiles.get(name)
+
+    def delete(self, name: str) -> None:
+        with self._mu:
+            self._profiles.pop(name, None)
+
+
+class VersionProvider:
+    """Kubernetes version discovery, hydrated synchronously at boot
+    (pkg/providers/version, version.go:46-50; operator.go:155)."""
+
+    SUPPORTED = ("1.28", "1.29", "1.30", "1.31", "1.32")
+
+    def __init__(self, version: str = "1.31"):
+        self._version = version
+
+    def get(self) -> str:
+        return self._version
+
+    def update(self, version: str) -> bool:
+        major_minor = ".".join(version.split(".")[:2])
+        if major_minor not in self.SUPPORTED:
+            raise ValueError(f"unsupported kubernetes version {version}")
+        changed = self._version != major_minor
+        self._version = major_minor
+        return changed
+
+
+@dataclass
+class InterruptionMessage:
+    """Parsed SQS interruption message (interruption/messages/types.go:21-57).
+    kinds: spot_interruption | rebalance_recommendation | scheduled_change |
+    state_change | noop"""
+    kind: str
+    instance_id: str
+    detail: str = ""
+    receipt: str = ""
+
+
+class SQSProvider:
+    """Interruption queue (pkg/providers/sqs, sqs.go:31-36): receive/delete
+    plus send for tests."""
+
+    def __init__(self, queue_name: str = "karpenter-interruption"):
+        self.queue_name = queue_name
+        self._mu = threading.Lock()
+        self._messages: List[InterruptionMessage] = []
+        self._receipt = 0
+
+    def send(self, message: InterruptionMessage) -> None:
+        with self._mu:
+            self._receipt += 1
+            message.receipt = str(self._receipt)
+            self._messages.append(message)
+
+    def receive(self, max_messages: int = 10) -> List[InterruptionMessage]:
+        with self._mu:
+            return list(self._messages[:max_messages])
+
+    def delete(self, message: InterruptionMessage) -> None:
+        with self._mu:
+            self._messages = [m for m in self._messages
+                              if m.receipt != message.receipt]
+
+    def __len__(self) -> int:
+        with self._mu:
+            return len(self._messages)

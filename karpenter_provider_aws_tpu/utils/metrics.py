@@ -1,0 +1,73 @@
+"""Minimal Prometheus-shaped metrics registry.
+
+The reference emits 101 documented metrics in 20 groups
+(website docs/reference/metrics.md); this registry backs the subset the
+rebuilt controllers emit (scheduling duration/queue depth, interruption
+counters, batcher sizes, provider gauges) with the same names.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Mapping, Optional, Tuple
+
+
+def _lk(labels: Optional[Mapping[str, str]]) -> Tuple:
+    return tuple(sorted((labels or {}).items()))
+
+
+class Metrics:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self.counters: Dict[Tuple[str, Tuple], float] = {}
+        self.gauges: Dict[Tuple[str, Tuple], float] = {}
+        self.histograms: Dict[Tuple[str, Tuple], List[float]] = {}
+
+    def inc(self, name: str, value: float = 1.0,
+            labels: Optional[Mapping[str, str]] = None) -> None:
+        with self._mu:
+            key = (name, _lk(labels))
+            self.counters[key] = self.counters.get(key, 0.0) + value
+
+    def set_gauge(self, name: str, value: float,
+                  labels: Optional[Mapping[str, str]] = None) -> None:
+        with self._mu:
+            self.gauges[(name, _lk(labels))] = value
+
+    def observe(self, name: str, value: float,
+                labels: Optional[Mapping[str, str]] = None) -> None:
+        with self._mu:
+            self.histograms.setdefault((name, _lk(labels)), []).append(value)
+
+    # -- reads -----------------------------------------------------------
+    def counter(self, name: str, labels: Optional[Mapping[str, str]] = None) -> float:
+        return self.counters.get((name, _lk(labels)), 0.0)
+
+    def gauge(self, name: str, labels: Optional[Mapping[str, str]] = None) -> float:
+        return self.gauges.get((name, _lk(labels)), 0.0)
+
+    def percentile(self, name: str, q: float,
+                   labels: Optional[Mapping[str, str]] = None) -> float:
+        vals = sorted(self.histograms.get((name, _lk(labels)), []))
+        if not vals:
+            return 0.0
+        return vals[min(len(vals) - 1, int(len(vals) * q))]
+
+    def render(self) -> str:
+        """Prometheus exposition-format-ish dump."""
+        lines = []
+        for (name, labels), v in sorted(self.counters.items()):
+            lines.append(f"{name}{_fmt(labels)} {v}")
+        for (name, labels), v in sorted(self.gauges.items()):
+            lines.append(f"{name}{_fmt(labels)} {v}")
+        for (name, labels), vals in sorted(self.histograms.items()):
+            lines.append(f"{name}_count{_fmt(labels)} {len(vals)}")
+            lines.append(f"{name}_sum{_fmt(labels)} {sum(vals)}")
+        return "\n".join(lines) + "\n"
+
+
+def _fmt(labels: Tuple) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in labels)
+    return "{" + inner + "}"
