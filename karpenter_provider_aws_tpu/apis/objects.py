@@ -221,7 +221,9 @@ class DisruptionBudget:
         s = self.nodes.strip()
         if s.endswith("%"):
             pct = int(s[:-1])
-            return (total_nodes * pct) // 100
+            # ceiling: the default 10% budget must not freeze small
+            # clusters (a 2-node pool still allows 1 disruption)
+            return -((-total_nodes * pct) // 100)
         return int(s)
 
 

@@ -1,0 +1,580 @@
+"""Disruption controller: drift, emptiness, consolidation, expiration.
+
+Mirrors the core disruption controller the reference drives (SURVEY §3.5,
+designs/consolidation.md):
+
+- **Candidates** are initialized nodes ordered by ascending disruption cost
+  (pods weighted by remaining lifetime, designs/consolidation.md:21-33);
+  pods with the ``karpenter.sh/do-not-disrupt`` annotation block voluntary
+  disruption of their node.
+- **Graceful methods** run replacement-first: simulate scheduling of the
+  candidate's pods against the remaining cluster (± replacement nodes),
+  taint the candidates, launch replacements, and only terminate once every
+  replacement is initialized.
+- **Consolidation** = node deletion (pods fit on remaining capacity) or
+  single-node replacement (remaining capacity + ONE cheaper node); the
+  replacement catalog is price-filtered below the candidate's price so any
+  solver answer is a strict saving. Spot→spot replacement additionally
+  requires >=15 cheaper spot-capable types (flexibility floor, mirroring
+  aws/karpenter-core's MinInstanceTypesForSpotToSpotConsolidation).
+- **Multi-node consolidation** binary-searches the largest
+  ascending-cost candidate prefix replaceable by <=1 cheaper node.
+- **Expiration** is forceful (v1 semantics): expired NodeClaims are
+  terminated without simulation and without budget gating.
+- **Budgets** (NodePool.spec.disruption.budgets,
+  crds/karpenter.sh_nodepools.yaml:78-141) cap concurrently-disrupting
+  nodes per nodepool per reason.
+
+The expensive inner loop — "can these pods be absorbed by the remaining
+nodes?" per candidate — is delegated to a pluggable
+:class:`ConsolidationEvaluator` so the TPU batched kernel
+(ops/consolidation_jax.py) can pre-screen all candidates at once; decisions
+remain identical to the sequential oracle (tests/test_disruption.py,
+tests/test_consolidation_equivalence.py enforce it).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Mapping, Optional, Sequence, Set, Tuple
+
+from ..apis import labels as L
+from ..apis.objects import (DISRUPTED_TAINT, Node, NodeClaim, NodePool, Pod,
+                            Taint)
+from ..apis.resources import Resources
+from ..cloudprovider.provider import CloudProvider
+from ..cloudprovider.types import InstanceTypes
+from ..fake.kube import FakeKube, NotFound
+from ..solver.types import (ExistingNode, NewNodeClaim, NodePoolSpec,
+                            SchedulingSnapshot, Solver, SolveResult)
+from ..state.cluster import ClusterState
+
+log = logging.getLogger(__name__)
+
+DO_NOT_DISRUPT_ANNOTATION = "karpenter.sh/do-not-disrupt"
+POD_DELETION_COST_ANNOTATION = "controller.kubernetes.io/pod-deletion-cost"
+
+#: spot→spot single-node replacement needs this much cheaper-type
+#: flexibility, or consolidation would chase churn for pennies.
+MIN_SPOT_FLEXIBILITY = 15
+
+REASON_DRIFTED = "drifted"
+REASON_EMPTY = "empty"
+REASON_UNDERUTILIZED = "underutilized"
+REASON_EXPIRED = "expired"
+
+_GRACEFUL_ORDER = (REASON_DRIFTED, REASON_EMPTY, REASON_UNDERUTILIZED)
+
+
+@dataclass
+class Candidate:
+    claim: NodeClaim
+    node: Node
+    nodepool: NodePool
+    #: reschedulable (non-daemonset, non-terminal) pods bound to the node
+    pods: List[Pod]
+    #: current offering price, micro-USD/hour (0 if unknown)
+    price: int
+    disruption_cost: float
+    capacity_type: str = ""
+    instance_type: str = ""
+    zone: str = ""
+    blocked_by: str = ""  # non-empty => ineligible for voluntary disruption
+
+    @property
+    def name(self) -> str:
+        return self.claim.name
+
+
+@dataclass
+class Command:
+    reason: str
+    candidates: List[Candidate]
+    replacements: List[NewNodeClaim] = field(default_factory=list)
+
+    def summary(self) -> str:
+        return (f"{self.reason}: delete {[c.name for c in self.candidates]}"
+                + (f" -> {len(self.replacements)} replacement(s)"
+                   if self.replacements else ""))
+
+
+@dataclass
+class _InFlight:
+    command: Command
+    candidate_claims: List[str]
+    replacement_claims: List[str]
+    started: float
+
+
+class ConsolidationEvaluator:
+    """Answers "can these pods be absorbed by existing capacity alone?" for a
+    batch of deletion candidates. The base implementation runs the solver
+    sequentially (the oracle); the TPU evaluator batches all candidates into
+    one device call."""
+
+    def __init__(self, solver: Solver):
+        self.solver = solver
+
+    def deletions_feasible(
+            self, snapshots: Sequence[SchedulingSnapshot]) -> List[bool]:
+        out = []
+        for snap in snapshots:
+            res = self.solver.solve(snap)
+            out.append(not res.new_nodes and not res.unschedulable)
+        return out
+
+
+class DisruptionController:
+    def __init__(self, kube: FakeKube, state: ClusterState,
+                 cloudprovider: CloudProvider, solver: Solver,
+                 provisioner,  # controllers.provisioning.Provisioner
+                 evaluator: Optional[ConsolidationEvaluator] = None,
+                 metrics=None, clock=time.time,
+                 consolidation_min_lifetime: float = 0.0):
+        self.kube = kube
+        self.state = state
+        self.cloudprovider = cloudprovider
+        self.solver = solver
+        self.provisioner = provisioner
+        self.evaluator = evaluator or ConsolidationEvaluator(solver)
+        self.metrics = metrics
+        self.clock = clock
+        self.consolidation_min_lifetime = consolidation_min_lifetime
+        self._in_flight: List[_InFlight] = []
+        #: claim name -> (frozenset of pod names, when it last changed);
+        #: anchors consolidate_after to the last pod-set change
+        self._pod_epoch: Dict[str, Tuple[frozenset, float]] = {}
+        #: per-reconcile cached base snapshot (specs/existing/daemons/zones)
+        self._round_base: Optional[SchedulingSnapshot] = None
+
+    # ------------------------------------------------------------------
+    # reconcile
+    # ------------------------------------------------------------------
+    def reconcile(self) -> Optional[Command]:
+        """Progress in-flight commands; then issue at most ONE new command
+        (the core loop also executes one command per pass)."""
+        if self._progress_in_flight():
+            # terminations just happened; candidate pods are still bound to
+            # the dying nodes, so the replacement looks empty until the
+            # drain + re-nomination settle — don't compute against that view
+            self._expire()
+            return None
+        self._expire()  # forceful, not budgeted
+        if self._in_flight:
+            # replacement-first discipline: wait for in-flight replacements
+            # before computing further voluntary disruption
+            return None
+        self._round_base = self.provisioner.build_snapshot([])
+        candidates = self._build_candidates()
+        if self.metrics is not None:
+            self.metrics.set_gauge(
+                "karpenter_voluntary_disruption_eligible_nodes",
+                float(len([c for c in candidates if not c.blocked_by])))
+        for reason in _GRACEFUL_ORDER:
+            cmd = self._compute(reason, candidates)
+            if cmd is not None:
+                self._execute(cmd)
+                return cmd
+        return None
+
+    # ------------------------------------------------------------------
+    # candidate construction
+    # ------------------------------------------------------------------
+    def _build_candidates(self) -> List[Candidate]:
+        pods_by_node: Dict[str, List[Pod]] = {}
+        for pod in self.kube.list("Pod"):
+            if pod.node_name and pod.phase not in ("Succeeded", "Failed"):
+                pods_by_node.setdefault(pod.node_name, []).append(pod)
+        nodepools = {np.name: np for np in self.kube.list("NodePool")}
+        type_prices = self._price_index()
+        now = self.clock()
+        #: nodes that pods are nominated onto are off-limits — a nominated
+        #: (in-flight) pod is invisible to pods_by_node, so the node would
+        #: otherwise look empty and be consolidated out from under it
+        nominated_nodes = self.state.nomination_targets()
+
+        out: List[Candidate] = []
+        for claim in self.kube.list("NodeClaim"):
+            if claim.metadata.deletion_timestamp is not None:
+                continue
+            if not (claim.registered and claim.initialized and claim.node_name):
+                continue
+            pool = nodepools.get(claim.nodepool or "")
+            if pool is None:
+                continue
+            try:
+                node = self.kube.get("Node", claim.node_name)
+            except NotFound:
+                continue
+            if any(t.key == DISRUPTED_TAINT for t in node.taints):
+                continue  # already being disrupted
+            if node.name in nominated_nodes or claim.name in nominated_nodes:
+                continue  # pods are in flight toward this node
+            pods = [p for p in pods_by_node.get(node.name, [])
+                    if p.owner_kind != "DaemonSet"]
+            pod_set = frozenset(p.full_name() for p in pods)
+            prev = self._pod_epoch.get(claim.name)
+            if prev is None or prev[0] != pod_set:
+                self._pod_epoch[claim.name] = (pod_set, now)
+            blocked = ""
+            for p in pods:
+                if p.metadata.annotations.get(DO_NOT_DISRUPT_ANNOTATION) == "true":
+                    blocked = f"pod {p.full_name()} has do-not-disrupt"
+                    break
+            itype = claim.metadata.labels.get(L.INSTANCE_TYPE, "")
+            ct = claim.metadata.labels.get(L.CAPACITY_TYPE, "")
+            zone = claim.metadata.labels.get(L.ZONE, "")
+            out.append(Candidate(
+                claim=claim, node=node, nodepool=pool, pods=pods,
+                price=type_prices.get((pool.name, itype, ct, zone), 0),
+                disruption_cost=self._disruption_cost(claim, pods, now),
+                capacity_type=ct, instance_type=itype, zone=zone,
+                blocked_by=blocked))
+        # ascending disruption cost; stable deterministic tie-break
+        out.sort(key=lambda c: (c.disruption_cost, c.name))
+        return out
+
+    def _price_index(self) -> Dict[Tuple[str, str, str, str], int]:
+        """(nodepool, type, capacity_type, zone) -> current price, from the
+        per-round base snapshot's already-resolved catalogs."""
+        idx: Dict[Tuple[str, str, str, str], int] = {}
+        for spec in self._round_base.nodepools:
+            for it in spec.instance_types:
+                for o in it.offerings:
+                    idx[(spec.nodepool.name, it.name,
+                         o.capacity_type, o.zone)] = o.price
+        return idx
+
+    def _disruption_cost(self, claim: NodeClaim, pods: Sequence[Pod],
+                         now: float) -> float:
+        """Pods weighted by remaining node lifetime
+        (designs/consolidation.md:21-33): 1.0 at creation -> 0.0 at expiry."""
+        cost = 0.0
+        for p in pods:
+            cost += 1.0
+            dc = p.metadata.annotations.get(POD_DELETION_COST_ANNOTATION)
+            if dc is not None:
+                try:
+                    cost += float(dc) * 1e-6
+                except ValueError:
+                    pass
+        if claim.expire_after:
+            age = now - claim.metadata.creation_timestamp
+            remaining = max(0.0, 1.0 - age / claim.expire_after)
+            cost *= remaining
+        return cost
+
+    # ------------------------------------------------------------------
+    # method computation
+    # ------------------------------------------------------------------
+    def _compute(self, reason: str,
+                 candidates: List[Candidate]) -> Optional[Command]:
+        if reason == REASON_DRIFTED:
+            return self._drift(candidates)
+        if reason == REASON_EMPTY:
+            return self._emptiness(candidates)
+        if reason == REASON_UNDERUTILIZED:
+            return (self._multi_consolidation(candidates)
+                    or self._single_consolidation(candidates))
+        return None
+
+    # -- drift ----------------------------------------------------------
+    def _drifted_reason(self, cand: Candidate) -> str:
+        if self.cloudprovider.is_drifted(cand.claim):
+            return "CloudProviderDrifted"
+        ann = cand.claim.metadata.annotations
+        if ann.get(L.NODEPOOL_HASH_VERSION_ANNOTATION) == "v3" and \
+                ann.get(L.NODEPOOL_HASH_ANNOTATION,
+                        cand.nodepool.hash()) != cand.nodepool.hash():
+            return "NodePoolDrifted"
+        return ""
+
+    def _drift(self, candidates: List[Candidate]) -> Optional[Command]:
+        for cand in candidates:
+            if cand.blocked_by:
+                continue
+            if not self._drifted_reason(cand):
+                continue
+            if not self._budget_allows([cand], REASON_DRIFTED):
+                continue
+            # replacement-first: any price, any number of replacements
+            result = self._simulate([cand], price_cap=None)
+            if result is None:
+                continue
+            return Command(REASON_DRIFTED, [cand], result.new_nodes)
+        return None
+
+    # -- emptiness ------------------------------------------------------
+    def _consolidatable_since(self, cand: Candidate) -> float:
+        """When the node last changed pod-wise (consolidate_after anchor)."""
+        epoch = self._pod_epoch.get(cand.name)
+        if epoch is not None:
+            return epoch[1]
+        cond = cand.claim.conditions.get("Initialized")
+        return cond.last_transition if cond else 0.0
+
+    def _past_consolidate_after(self, cand: Candidate) -> bool:
+        wait = cand.nodepool.disruption.consolidate_after
+        return self.clock() - self._consolidatable_since(cand) >= wait
+
+    def _emptiness(self, candidates: List[Candidate]) -> Optional[Command]:
+        empties = [c for c in candidates
+                   if not c.pods and not c.blocked_by
+                   and c.nodepool.disruption.consolidation_policy in
+                   ("WhenEmpty", "WhenEmptyOrUnderutilized")
+                   and self._past_consolidate_after(c)]
+        picked: List[Candidate] = []
+        for cand in empties:
+            if self._budget_allows(picked + [cand], REASON_EMPTY):
+                picked.append(cand)
+        if not picked:
+            return None
+        return Command(REASON_EMPTY, picked)
+
+    # -- consolidation --------------------------------------------------
+    def _consolidatable(self, candidates: List[Candidate]) -> List[Candidate]:
+        now = self.clock()
+        out = []
+        for c in candidates:
+            if c.blocked_by or not c.pods:
+                continue
+            if c.nodepool.disruption.consolidation_policy != "WhenEmptyOrUnderutilized":
+                continue
+            if not self._past_consolidate_after(c):
+                continue
+            cond = c.claim.conditions.get("Initialized")
+            if cond and now - cond.last_transition < self.consolidation_min_lifetime:
+                continue
+            out.append(c)
+        return out
+
+    def _single_consolidation(
+            self, candidates: List[Candidate]) -> Optional[Command]:
+        cands = [c for c in self._consolidatable(candidates)
+                 if self._budget_allows([c], REASON_UNDERUTILIZED)]
+        if not cands:
+            return None
+        # batched pre-screen: deletion feasibility for every candidate at once
+        delete_ok = self.evaluator.deletions_feasible(
+            [self._snapshot([c], price_cap=0) for c in cands])
+        for cand, ok in zip(cands, delete_ok):
+            if ok:
+                return Command(REASON_UNDERUTILIZED, [cand])
+        for cand in cands:
+            result = self._simulate([cand], price_cap=cand.price)
+            if result is None or len(result.new_nodes) != 1:
+                continue
+            if not self._spot_flexibility_ok([cand], result.new_nodes[0]):
+                continue
+            return Command(REASON_UNDERUTILIZED, [cand], result.new_nodes)
+        return None
+
+    def _multi_consolidation(
+            self, candidates: List[Candidate]) -> Optional[Command]:
+        cands = self._consolidatable(candidates)
+        # largest prefix the budgets allow
+        while cands and not self._budget_allows(cands, REASON_UNDERUTILIZED):
+            cands = cands[:-1]
+        if len(cands) < 2:
+            return None
+
+        # binary-search the largest workable ascending-cost prefix
+        # (core firstNConsolidationOption)
+        best: Optional[Command] = None
+        lo, hi = 2, len(cands)
+        while lo <= hi:
+            mid = (lo + hi) // 2
+            cmd = self._try_prefix(cands[:mid])
+            if cmd is not None:
+                best, lo = cmd, mid + 1
+            else:
+                hi = mid - 1
+        return best
+
+    def _try_prefix(self, cands: List[Candidate]) -> Optional[Command]:
+        total_price = sum(c.price for c in cands)
+        result = self._simulate(cands, price_cap=total_price)
+        if result is None or len(result.new_nodes) > 1:
+            return None
+        if result.new_nodes and all(
+                c.capacity_type == L.CAPACITY_TYPE_SPOT for c in cands):
+            # spot→spot replacement is single-node-only (the flexibility
+            # floor can't be meaningfully enforced across a merged prefix)
+            ct = result.new_nodes[0].requirements.get(L.CAPACITY_TYPE)
+            if ct is None or ct.has(L.CAPACITY_TYPE_SPOT):
+                return None
+        return Command(REASON_UNDERUTILIZED, list(cands), result.new_nodes)
+
+    def _spot_flexibility_ok(self, cands: List[Candidate],
+                             plan: NewNodeClaim) -> bool:
+        """Spot→spot single-node replacement needs >=15 cheaper types."""
+        if not all(c.capacity_type == L.CAPACITY_TYPE_SPOT for c in cands):
+            return True
+        ct = plan.requirements.get(L.CAPACITY_TYPE)
+        if ct is not None and not ct.has(L.CAPACITY_TYPE_SPOT):
+            return True  # replacing spot with on-demand: no floor
+        return len(plan.instance_type_names) >= MIN_SPOT_FLEXIBILITY
+
+    # ------------------------------------------------------------------
+    # simulation
+    # ------------------------------------------------------------------
+    def _snapshot(self, cands: List[Candidate],
+                  price_cap: Optional[int]) -> SchedulingSnapshot:
+        """The would-be cluster: candidates gone, their pods pending.
+
+        price_cap semantics: None => full catalog (drift); 0 => no new nodes
+        allowed (deletion check); >0 => only types strictly cheaper (the
+        filterByPrice discipline that makes any replacement a saving)."""
+        gone = {c.node.name for c in cands} | {c.name for c in cands}
+        base = self._round_base
+        existing = [n for n in base.existing_nodes if n.name not in gone]
+        pods = [p for c in cands for p in c.pods]
+        pools = base.nodepools
+        if price_cap is not None:
+            pools = []
+            if price_cap > 0:
+                for spec in base.nodepools:
+                    kept = InstanceTypes()
+                    for it in spec.instance_types:
+                        p = it.cheapest_price()
+                        if p is not None and p < price_cap:
+                            kept.append(it)
+                    if kept:
+                        pools.append(NodePoolSpec(
+                            nodepool=spec.nodepool, instance_types=kept,
+                            in_use=spec.in_use))
+        return SchedulingSnapshot(
+            pods=pods, nodepools=pools, existing_nodes=existing,
+            daemon_overheads=base.daemon_overheads, zones=base.zones)
+
+    def _simulate(self, cands: List[Candidate],
+                  price_cap: Optional[int]) -> Optional[SolveResult]:
+        result = self.solver.solve(self._snapshot(cands, price_cap))
+        if result.unschedulable:
+            return None
+        return result
+
+    # ------------------------------------------------------------------
+    # budgets
+    # ------------------------------------------------------------------
+    def _budget_allows(self, cands: List[Candidate], reason: str) -> bool:
+        by_pool: Dict[str, int] = {}
+        for c in cands:
+            by_pool[c.nodepool.name] = by_pool.get(c.nodepool.name, 0) + 1
+        for pool_name, want in by_pool.items():
+            pool = next(c.nodepool for c in cands
+                        if c.nodepool.name == pool_name)
+            total, disrupting = self._pool_counts(pool_name)
+            allowed = total  # no budgets => everything allowed
+            for budget in pool.disruption.budgets:
+                if not budget.allows(reason):
+                    continue
+                allowed = min(allowed, budget.max_disruptions(total))
+            if disrupting + want > allowed:
+                return False
+        return True
+
+    def _pool_counts(self, pool_name: str) -> Tuple[int, int]:
+        total = disrupting = 0
+        for claim in self.kube.list("NodeClaim"):
+            if claim.nodepool != pool_name:
+                continue
+            if not claim.registered:
+                continue
+            total += 1
+            node = self.kube.try_get("Node", claim.node_name) \
+                if claim.node_name else None
+            if claim.metadata.deletion_timestamp is not None or (
+                    node is not None and
+                    any(t.key == DISRUPTED_TAINT for t in node.taints)):
+                disrupting += 1
+        return total, disrupting
+
+    # ------------------------------------------------------------------
+    # execution
+    # ------------------------------------------------------------------
+    def _execute(self, cmd: Command) -> None:
+        if self.metrics is not None:
+            self.metrics.inc(
+                "karpenter_voluntary_disruption_decisions_total",
+                labels={"decision": "replace" if cmd.replacements else "delete",
+                        "reason": cmd.reason})
+        for cand in cmd.candidates:
+            cand.node.taints.append(Taint(DISRUPTED_TAINT, "NoSchedule"))
+            self.kube.update(cand.node)
+        replacement_claims = []
+        pods_by_name = {p.full_name(): p
+                        for c in cmd.candidates for p in c.pods}
+        for plan in cmd.replacements:
+            claim = self.provisioner._create_nodeclaim(plan, pods_by_name)
+            replacement_claims.append(claim.name)
+        if not replacement_claims:
+            self._terminate(cmd)
+            return
+        self._in_flight.append(_InFlight(
+            command=cmd,
+            candidate_claims=[c.name for c in cmd.candidates],
+            replacement_claims=replacement_claims,
+            started=self.clock()))
+
+    def _progress_in_flight(self) -> bool:
+        acted = False
+        still: List[_InFlight] = []
+        for inf in self._in_flight:
+            states = []
+            for name in inf.replacement_claims:
+                claim = self.kube.try_get("NodeClaim", name)
+                states.append(claim is not None and claim.initialized)
+                if claim is None:
+                    states[-1] = None  # replacement failed (ICE etc.)
+            if any(s is None for s in states):
+                # roll back: untaint candidates, reap surviving
+                # replacements, abandon the command
+                for name in inf.candidate_claims:
+                    claim = self.kube.try_get("NodeClaim", name)
+                    if claim and claim.node_name:
+                        node = self.kube.try_get("Node", claim.node_name)
+                        if node:
+                            node.taints = [t for t in node.taints
+                                           if t.key != DISRUPTED_TAINT]
+                            self.kube.update(node)
+                for name in inf.replacement_claims:
+                    if self.kube.try_get("NodeClaim", name) is not None:
+                        self.kube.delete("NodeClaim", name)
+                log.info("disruption rolled back: %s", inf.command.summary())
+                acted = True
+                continue
+            if all(states):
+                self._terminate(inf.command)
+                acted = True
+            else:
+                still.append(inf)
+        self._in_flight = still
+        return acted
+
+    def _terminate(self, cmd: Command) -> None:
+        for cand in cmd.candidates:
+            if self.kube.try_get("NodeClaim", cand.name) is not None:
+                self.kube.delete("NodeClaim", cand.name)
+
+    # ------------------------------------------------------------------
+    # expiration (forceful, v1 semantics)
+    # ------------------------------------------------------------------
+    def _expire(self) -> int:
+        n = 0
+        now = self.clock()
+        for claim in self.kube.list("NodeClaim"):
+            if claim.metadata.deletion_timestamp is not None:
+                continue
+            if not claim.expire_after:
+                continue
+            if now - claim.metadata.creation_timestamp >= claim.expire_after:
+                self.kube.delete("NodeClaim", claim.name)
+                if self.metrics is not None:
+                    self.metrics.inc(
+                        "karpenter_nodeclaims_disrupted_total",
+                        labels={"reason": REASON_EXPIRED})
+                n += 1
+        return n

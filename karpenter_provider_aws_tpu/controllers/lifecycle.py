@@ -134,7 +134,9 @@ class Terminator:
                 for pod in self.kube.list("Pod"):
                     if pod.node_name == claim.node_name:
                         pod.node_name = ""
-                        pod.phase = "Pending"
+                        # terminal pods are released, not resurrected
+                        if pod.phase not in ("Succeeded", "Failed"):
+                            pod.phase = "Pending"
                         self.kube.update(pod)
             # 2) terminate the instance
             if claim.provider_id:

@@ -16,6 +16,7 @@ from typing import Optional
 
 from .cache.ttl import UnavailableOfferings
 from .cloudprovider.provider import CloudProvider
+from .controllers.disruption import DisruptionController
 from .controllers.lifecycle import NodeClaimLifecycle, Terminator
 from .controllers.provisioning import Provisioner
 from .controllers.steady_state import (CatalogController, GarbageCollector,
@@ -111,6 +112,9 @@ class Operator:
             metrics=self.metrics, clock=clock)
         self.catalog_controller = CatalogController(self.ec2, self.instance_types)
         self.pricing_controller = PricingController(self.pricing)
+        self.disruption = DisruptionController(
+            self.kube, self.state, self.cloudprovider, self.solver,
+            self.provisioner, metrics=self.metrics, clock=clock)
 
         # node-join simulation (the E2E "real cluster" analog)
         self.kubelet = FakeKubelet(self.kube, self.ec2,
@@ -123,11 +127,13 @@ class Operator:
         self.pricing_controller.reconcile()
 
     # ------------------------------------------------------------------
-    def step(self) -> dict:
+    def step(self, disrupt: bool = True) -> dict:
         """One reconcile round of every controller, dependency order."""
         out = {}
         out["nodeclass"] = self.nodeclass_status.reconcile()
         out["interruption"] = self.interruption.reconcile()
+        out["disrupted"] = (self.disruption.reconcile() is not None) \
+            if disrupt else False
         out["terminated"] = self.terminator.reconcile()
         prov = self.provisioner.reconcile()
         out["provisioned"] = len(prov.created_claims)
@@ -139,16 +145,19 @@ class Operator:
         out["gc"] = self.gc.reconcile()
         return out
 
-    def run_until_settled(self, max_steps: int = 20) -> int:
+    def run_until_settled(self, max_steps: int = 20,
+                          disrupt: bool = True) -> int:
         """Step until a fixed point: no pending pods, no mid-lifecycle
-        claims, nothing terminated/GC'd this round."""
+        claims, nothing terminated/GC'd/disrupted this round."""
         for i in range(max_steps):
-            out = self.step()
+            out = self.step(disrupt=disrupt)
             quiet = (not self.state.pending_pods()
                      and out["provisioned"] == 0
                      and out["terminated"] == 0
                      and out["joined"] == 0
                      and out["gc"] == 0
+                     and not out["disrupted"]
+                     and not (disrupt and self.disruption._in_flight)
                      and all(v == 0 for v in out["lifecycle"].values())
                      and all(v == 0 for v in out["lifecycle2"].values()))
             if quiet:

@@ -11,7 +11,7 @@ from __future__ import annotations
 import threading
 import time
 from dataclasses import dataclass
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Set
 
 from ..apis import labels as L
 from ..apis.objects import Node, NodeClaim, Pod
@@ -55,6 +55,14 @@ class ClusterState:
         with self._mu:
             self._nominations.pop(pod_full_name, None)
 
+    def nomination_targets(self) -> Set[str]:
+        """Node/claim names with pods in flight toward them — such nodes are
+        off-limits to disruption (core's nominated-node protection)."""
+        now = self.clock()
+        with self._mu:
+            return {n.node_name for n in self._nominations.values()
+                    if now < n.expires}
+
     # -- views ---------------------------------------------------------
     def pending_pods(self) -> List[Pod]:
         """Unscheduled pods with no live nomination."""
@@ -70,6 +78,8 @@ class ClusterState:
     def bound_pods_by_node(self) -> Dict[str, List[Pod]]:
         out: Dict[str, List[Pod]] = {}
         for pod in self.kube.list("Pod"):
+            if pod.phase in ("Succeeded", "Failed"):
+                continue  # terminal pods hold no resources
             target = pod.node_name or self.nomination_for(pod.full_name())
             if target:
                 out.setdefault(target, []).append(pod)

@@ -1,0 +1,296 @@
+"""Disruption controller: emptiness, consolidation (delete / replace /
+multi-node), drift, expiration, budgets, do-not-disrupt
+(designs/consolidation.md; SURVEY §3.5)."""
+
+import pytest
+
+from karpenter_provider_aws_tpu.apis import labels as L
+from karpenter_provider_aws_tpu.apis.objects import (DISRUPTED_TAINT,
+                                                     Disruption,
+                                                     DisruptionBudget,
+                                                     EC2NodeClass,
+                                                     NodeClassRef, NodePool,
+                                                     NodePoolTemplate)
+from karpenter_provider_aws_tpu.apis.requirements import Requirements
+from karpenter_provider_aws_tpu.controllers.disruption import (
+    DO_NOT_DISRUPT_ANNOTATION, REASON_EMPTY, REASON_UNDERUTILIZED)
+from karpenter_provider_aws_tpu.fake.environment import make_pods
+from karpenter_provider_aws_tpu.operator import Operator
+
+
+class FakeClock:
+    def __init__(self, t=1_000_000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def mk_cluster(op, pool_name="default", requirements=(), disruption=None,
+               expire_after=None):
+    nc = EC2NodeClass(pool_name + "-class")
+    op.kube.create(nc)
+    np = NodePool(pool_name, template=NodePoolTemplate(
+        node_class_ref=NodeClassRef(nc.name),
+        requirements=Requirements.from_terms(list(requirements)),
+        expire_after=expire_after),
+        disruption=disruption)
+    op.kube.create(np)
+    return np, nc
+
+
+@pytest.fixture
+def clock():
+    return FakeClock()
+
+
+@pytest.fixture
+def op(clock):
+    return Operator(clock=clock)
+
+
+def settle(op, clock, rounds=6):
+    """Alternate settling and time advancement so TTL-ish logic fires."""
+    for _ in range(rounds):
+        op.run_until_settled()
+        clock.advance(10)
+
+
+class TestEmptiness:
+    def test_empty_node_removed(self, op, clock):
+        mk_cluster(op)
+        pods = make_pods(4, cpu="2", memory="4Gi", prefix="empty")
+        for p in pods:
+            op.kube.create(p)
+        op.run_until_settled()
+        n0 = len(op.kube.list("Node"))
+        assert n0 >= 1
+        # all pods finish -> nodes become empty -> consolidated away
+        for p in op.kube.list("Pod"):
+            p.phase = "Succeeded"
+            op.kube.update(p)
+        settle(op, clock)
+        assert len(op.kube.list("Node")) == 0
+        assert len(op.kube.list("NodeClaim")) == 0
+
+    def test_when_empty_policy_ignores_utilized(self, op, clock):
+        mk_cluster(op, disruption=Disruption(consolidation_policy="WhenEmpty"))
+        for p in make_pods(6, cpu="250m", memory="512Mi", prefix="we"):
+            op.kube.create(p)
+        op.run_until_settled()
+        n0 = len(op.kube.list("Node"))
+        settle(op, clock)
+        # utilized nodes are never consolidated under WhenEmpty
+        assert len(op.kube.list("Node")) == n0
+
+    def test_consolidate_after_delays_emptiness(self, op, clock):
+        mk_cluster(op, disruption=Disruption(consolidate_after=300.0))
+        for p in make_pods(2, cpu="2", memory="4Gi", prefix="ca"):
+            op.kube.create(p)
+        op.run_until_settled()
+        for p in op.kube.list("Pod"):
+            p.phase = "Succeeded"
+            op.kube.update(p)
+        clock.advance(30)
+        op.run_until_settled()
+        assert len(op.kube.list("Node")) >= 1  # too early
+        clock.advance(300)
+        op.run_until_settled()
+        assert len(op.kube.list("Node")) == 0
+
+
+CPU4 = [{"key": L.INSTANCE_CPU, "operator": "In", "values": ["4"]}]
+CPU48 = [{"key": L.INSTANCE_CPU, "operator": "In", "values": ["4", "8"]}]
+CPU248 = [{"key": L.INSTANCE_CPU, "operator": "In", "values": ["2", "4", "8"]}]
+
+
+class TestConsolidationDelete:
+    def test_underutilized_node_drains_onto_peers(self, op, clock):
+        """Every node half-drains; survivors' pods fit on peers -> delete."""
+        mk_cluster(op, requirements=CPU4)  # 2x 1750m pods per 4-vCPU node
+        pods = make_pods(8, cpu="1750m", memory="3Gi", prefix="cd")
+        for p in pods:
+            op.kube.create(p)
+        op.run_until_settled(disrupt=False)
+        n0 = len(op.kube.list("Node"))
+        assert n0 >= 3
+        # one pod per node completes -> every node is half empty
+        by_node = {}
+        for p in op.kube.list("Pod"):
+            if by_node.setdefault(p.node_name, p) is not p:
+                continue
+            p.phase = "Succeeded"
+            op.kube.update(p)
+        settle(op, clock, rounds=10)
+        assert len(op.kube.list("Node")) < n0
+        live = [p for p in op.kube.list("Pod") if p.phase != "Succeeded"]
+        assert all(p.node_name for p in live)
+
+    def test_do_not_disrupt_blocks(self, op, clock):
+        mk_cluster(op, requirements=CPU4)
+        pods = make_pods(4, cpu="1750m", memory="3Gi", prefix="dnd")
+        for p in pods:
+            p.metadata.annotations[DO_NOT_DISRUPT_ANNOTATION] = "true"
+            op.kube.create(p)
+        op.run_until_settled(disrupt=False)
+        n0 = len(op.kube.list("Node"))
+        by_node = {}
+        for p in op.kube.list("Pod"):
+            if by_node.setdefault(p.node_name, p) is not p:
+                continue
+            p.phase = "Succeeded"
+            op.kube.update(p)
+        settle(op, clock)
+        assert len(op.kube.list("Node")) == n0  # nothing disrupted
+
+
+class TestConsolidationReplace:
+    def test_replacement_is_cheaper(self, op, clock):
+        """A big node whose pods shrank gets replaced by a cheaper one."""
+        mk_cluster(op, requirements=CPU248)
+        pods = make_pods(6, cpu="1", memory="2Gi", prefix="cr")
+        for p in pods:
+            op.kube.create(p)
+        op.run_until_settled(disrupt=False)
+        claims0 = op.kube.list("NodeClaim")
+        prices0 = _total_price(op)
+        # most pods complete -> the node is oversized for what remains
+        for p in op.kube.list("Pod")[:5]:
+            p.phase = "Succeeded"
+            op.kube.update(p)
+        settle(op, clock, rounds=8)
+        live = [p for p in op.kube.list("Pod") if p.phase != "Succeeded"]
+        assert all(p.node_name for p in live)
+        assert _total_price(op) < prices0
+        # replacement happened: at least one original claim is gone
+        names = {c.name for c in op.kube.list("NodeClaim")}
+        assert any(c.name not in names for c in claims0)
+
+    def test_replacement_waits_for_readiness(self, op, clock):
+        mk_cluster(op, requirements=CPU248)
+        for p in make_pods(6, cpu="1", memory="2Gi", prefix="rw"):
+            op.kube.create(p)
+        op.run_until_settled(disrupt=False)
+        for p in op.kube.list("Pod")[:5]:
+            p.phase = "Succeeded"
+            op.kube.update(p)
+        # run ONLY the disruption controller: candidates get tainted but
+        # nothing is terminated until the replacement initializes
+        cmd = op.disruption.reconcile()
+        assert cmd is not None and cmd.replacements
+        assert op.disruption._in_flight
+        victim = cmd.candidates[0]
+        assert any(t.key == DISRUPTED_TAINT for t in victim.node.taints)
+        # the victim's claim still exists (not yet terminated)
+        assert op.kube.try_get("NodeClaim", victim.name) is not None
+
+
+class TestMultiNodeConsolidation:
+    def test_two_nodes_collapse_into_one_replacement(self, op, clock):
+        mk_cluster(op, requirements=CPU48)
+        # 5 pods x 1750m: FFD -> one 8-vCPU node (4 pods) + one 4-vCPU (1)
+        pods = make_pods(5, cpu="1750m", memory="3Gi", prefix="mn")
+        for p in pods:
+            op.kube.create(p)
+        op.run_until_settled(disrupt=False)
+        n0 = len(op.kube.list("Node"))
+        assert n0 == 2
+        # 2 pods on the big node complete: 3 pods remain across 2 nodes;
+        # one fresh 8-vCPU node (alloc ~7.x) holds all 3 and costs less
+        # than the 8+4 pair -> multi-node consolidation replaces BOTH
+        done = 0
+        for p in op.kube.list("Pod"):
+            big = [q for q in op.kube.list("Pod")
+                   if q.node_name == p.node_name]
+            if len(big) >= 3 and done < 2:
+                p.phase = "Succeeded"
+                op.kube.update(p)
+                done += 1
+        assert done == 2
+        settle(op, clock, rounds=10)
+        assert len(op.kube.list("Node")) == 1
+        live = [p for p in op.kube.list("Pod") if p.phase != "Succeeded"]
+        assert len(live) == 3 and all(p.node_name for p in live)
+
+
+class TestBudgets:
+    def test_zero_budget_blocks_voluntary_disruption(self, op, clock):
+        mk_cluster(op, disruption=Disruption(
+            budgets=[DisruptionBudget(nodes="0")]))
+        for p in make_pods(4, cpu="2", memory="4Gi", prefix="zb"):
+            op.kube.create(p)
+        op.run_until_settled()
+        n0 = len(op.kube.list("Node"))
+        for p in op.kube.list("Pod"):
+            p.phase = "Succeeded"
+            op.kube.update(p)
+        settle(op, clock)
+        assert len(op.kube.list("Node")) == n0  # budget "0" freezes pool
+
+    def test_budget_reason_scoping(self, op, clock):
+        # underutilized frozen, empty allowed
+        mk_cluster(op, disruption=Disruption(budgets=[
+            DisruptionBudget(nodes="0", reasons=[REASON_UNDERUTILIZED]),
+            DisruptionBudget(nodes="100%", reasons=[REASON_EMPTY]),
+        ]))
+        for p in make_pods(3, cpu="2", memory="4Gi", prefix="rs"):
+            op.kube.create(p)
+        op.run_until_settled()
+        for p in op.kube.list("Pod"):
+            p.phase = "Succeeded"
+            op.kube.update(p)
+        settle(op, clock)
+        assert len(op.kube.list("Node")) == 0  # emptiness still allowed
+
+
+class TestExpiration:
+    def test_expired_claims_are_replaced(self, op, clock):
+        mk_cluster(op, expire_after=3600.0)
+        for p in make_pods(3, cpu="500m", memory="1Gi", prefix="exp"):
+            op.kube.create(p)
+        op.run_until_settled()
+        old = {c.name for c in op.kube.list("NodeClaim")}
+        assert old
+        clock.advance(4000)  # past expireAfter
+        settle(op, clock)
+        new = {c.name for c in op.kube.list("NodeClaim")}
+        assert not (old & new)  # every expired claim replaced
+        live = [p for p in op.kube.list("Pod") if p.phase != "Succeeded"]
+        assert all(p.node_name for p in live)
+
+
+class TestDriftDisruption:
+    def test_nodepool_hash_drift_rolls_nodes(self, op, clock):
+        np, _ = mk_cluster(op)
+        for p in make_pods(2, cpu="500m", memory="1Gi", prefix="dr"):
+            op.kube.create(p)
+        op.run_until_settled()
+        old = {c.name for c in op.kube.list("NodeClaim")}
+        # mutate the NodePool template -> hash changes -> nodes drift
+        np.template.labels["rolled"] = "yes"
+        op.kube.update(np)
+        settle(op, clock, rounds=10)
+        new = {c.name for c in op.kube.list("NodeClaim")}
+        assert not (old & new)
+        assert all(p.node_name for p in op.kube.list("Pod"))
+        # replacements carry the new hash
+        for c in op.kube.list("NodeClaim"):
+            assert c.metadata.annotations[L.NODEPOOL_HASH_ANNOTATION] == np.hash()
+
+
+def _total_price(op):
+    total = 0
+    for claim in op.kube.list("NodeClaim"):
+        itype = claim.metadata.labels.get(L.INSTANCE_TYPE, "")
+        ct = claim.metadata.labels.get(L.CAPACITY_TYPE, "")
+        zone = claim.metadata.labels.get(L.ZONE, "")
+        for pool in op.kube.list("NodePool"):
+            for it in op.cloudprovider.get_instance_types(pool):
+                if it.name == itype:
+                    for o in it.offerings:
+                        if o.capacity_type == ct and o.zone == zone:
+                            total += o.price
+    return total
