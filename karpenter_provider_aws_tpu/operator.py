@@ -57,6 +57,7 @@ class Operator:
     def __init__(self, options: Optional[Options] = None,
                  ec2: Optional[FakeEC2] = None,
                  solver: Optional[Solver] = None,
+                 consolidation_evaluator=None,
                  clock=time.time):
         self.options = options or Options()
         self.clock = clock
@@ -114,7 +115,8 @@ class Operator:
         self.pricing_controller = PricingController(self.pricing)
         self.disruption = DisruptionController(
             self.kube, self.state, self.cloudprovider, self.solver,
-            self.provisioner, metrics=self.metrics, clock=clock)
+            self.provisioner, evaluator=consolidation_evaluator,
+            metrics=self.metrics, clock=clock)
 
         # node-join simulation (the E2E "real cluster" analog)
         self.kubelet = FakeKubelet(self.kube, self.ec2,

@@ -1,0 +1,98 @@
+"""Batched consolidation kernel: deletion feasibility for many candidates
+in ONE device call.
+
+The disruption controller's hot inner loop asks, per candidate node c:
+"do c's pods first-fit onto the remaining nodes?" (designs/
+consolidation.md "Node Deletion": a simulated scheduling run against the
+existing cluster). Sequentially that is O(candidates) solver calls; here
+the candidate axis is just a batch dimension — one ``lax.scan`` over the
+candidate's pod groups, ``vmap``-ed over candidates.
+
+Transfer discipline (the Go↔sidecar serialization concern of SURVEY §7
+"hard parts" #4, applied to host↔device): candidates share the cluster, so
+the node axis is sent ONCE — shared ``ex_alloc/ex_used/compat_tab`` tables
+— and each candidate carries only index vectors: which unique pod-group
+signatures it moves (``gid``), how many pods (``n``), and which node rows
+are dead for it (``alive``). Per-candidate payload is O(G + E) bytes, not
+O(E·D) tensors; a 256-candidate × 300-node batch ships ~200KB instead of
+~17MB.
+
+Semantics per group: headroom per node = min_d floor((alloc - used)/R),
+prefix-sum greedy fill in name-sorted node order — bit-identical to the
+CPU oracle's first-fit over existing nodes (solver/cpu.py:243-258).
+Feasible ⇔ every group's leftover is 0. All int64 (jax_enable_x64):
+decisions match the oracle exactly
+(tests/test_consolidation_equivalence.py).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+jax.config.update("jax_enable_x64", True)
+
+BIG = jnp.int64(1) << 60
+
+
+@jax.jit
+def deletions_feasible_kernel(ex_alloc: jax.Array,    # [E, D] int64 shared
+                              ex_used0: jax.Array,    # [E, D] int64 shared
+                              compat_tab: jax.Array,  # [Sc, E] bool per
+                              #                         constraint profile
+                              R_tab: jax.Array,       # [S, D] int64 per sig
+                              gid: jax.Array,         # [B, G] int32 -> S
+                              cid: jax.Array,         # [B, G] int32 -> Sc
+                              n: jax.Array,           # [B, G] int64
+                              alive: jax.Array,       # [B, E] bool
+                              ) -> jax.Array:         # [B] bool
+    def one_candidate(gids, cids, nb, alv):
+        def step(used, xs):
+            gi, ci, ng = xs
+            Rg = R_tab[gi]                                   # [D]
+            cg = compat_tab[ci] & alv                        # [E]
+            Rsafe = jnp.where(Rg > 0, Rg, 1)
+            q = (ex_alloc - used) // Rsafe[None, :]          # [E, D]
+            q = jnp.where((Rg > 0)[None, :], q, BIG)
+            k = jnp.clip(q.min(axis=-1), 0, BIG)             # [E]
+            k = jnp.where(cg, k, 0)
+            cum = jnp.cumsum(k) - k
+            take = jnp.clip(ng - cum, 0, k)
+            used = used + take[:, None] * Rg[None, :]
+            return used, ng - take.sum()
+
+        _, leftover = jax.lax.scan(step, ex_used0, (gids, cids, nb))
+        return (leftover == 0).all()
+
+    return jax.vmap(one_candidate)(gid, cid, n, alive)
+
+
+@jax.jit
+def deletions_feasible_dense(ex_alloc: jax.Array,   # [B, E, D] int64
+                             ex_used0: jax.Array,   # [B, E, D] int64
+                             ex_compat: jax.Array,  # [B, G, E] bool
+                             R: jax.Array,          # [B, G, D] int64
+                             n: jax.Array,          # [B, G] int64
+                             ) -> jax.Array:        # [B] bool
+    """General fallback: fully per-candidate tensors (used when candidates
+    do not share a common node table — e.g. ad-hoc snapshots in tests)."""
+    def one_candidate(alloc, used0, compat, Rb, nb):
+        def step(used, xs):
+            Rg, ng, cg = xs
+            Rsafe = jnp.where(Rg > 0, Rg, 1)
+            q = (alloc - used) // Rsafe[None, :]
+            q = jnp.where((Rg > 0)[None, :], q, BIG)
+            k = jnp.clip(q.min(axis=-1), 0, BIG)
+            k = jnp.where(cg, k, 0)
+            cum = jnp.cumsum(k) - k
+            take = jnp.clip(ng - cum, 0, k)
+            used = used + take[:, None] * Rg[None, :]
+            return used, ng - take.sum()
+
+        _, leftover = jax.lax.scan(step, used0, (Rb, nb, compat))
+        return (leftover == 0).all()
+
+    return jax.vmap(one_candidate)(ex_alloc, ex_used0, ex_compat, R, n)

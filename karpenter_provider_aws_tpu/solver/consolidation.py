@@ -1,0 +1,305 @@
+"""TPU-backed consolidation evaluator: batch all deletion candidates into
+one device call.
+
+Plugs into :class:`controllers.disruption.DisruptionController` in place of
+the sequential oracle. The controller hands one deletion-check snapshot per
+candidate (pools price-filtered to nothing, existing = cluster minus the
+candidate); this evaluator encodes the batch and answers every candidate
+with one ``ops.consolidation_jax`` kernel call.
+
+Two encodings:
+
+- **shared-table fast path** (the production shape): all candidates come
+  from the same cluster view, differing only by which nodes are masked
+  out. Node tensors and per-signature compatibility rows are built ONCE;
+  each candidate carries only index vectors. Host encode is O(E + S·E +
+  B·G) instead of O(B·E) Python work.
+- **dense fallback** for heterogeneous batches (same-named nodes with
+  different capacities etc. — never produced by the controller, but the
+  evaluator stays correct for any input).
+
+Exactness discipline (same as solver/tpu.py): snapshots whose pods carry
+topology spread / pod-affinity constraints fall back to the sequential
+oracle; everything else is evaluated with int64 math bit-identical to the
+oracle's, so decisions never diverge
+(tests/test_consolidation_equivalence.py enforces equality).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..controllers.disruption import ConsolidationEvaluator
+from ..solver.types import ExistingNode
+from .cpu import CPUSolver, pod_group_signature, pod_sort_key
+from .types import SchedulingSnapshot, Solver
+
+
+def _pow2(x: int) -> int:
+    return max(1, 1 << (x - 1).bit_length())
+
+
+class TPUConsolidationEvaluator(ConsolidationEvaluator):
+    def __init__(self, solver: Optional[Solver] = None, backend: str = "jax"):
+        super().__init__(solver or CPUSolver())
+        assert backend in ("jax", "numpy")
+        self.backend = backend
+
+    # ------------------------------------------------------------------
+    def deletions_feasible(
+            self, snapshots: Sequence[SchedulingSnapshot]) -> List[bool]:
+        if not snapshots:
+            return []
+        out: List[Optional[bool]] = [None] * len(snapshots)
+        batch_idx: List[int] = []
+        for i, snap in enumerate(snapshots):
+            if any(p.topology_spread or p.pod_affinity for p in snap.pods):
+                # oracle fallback (same discipline as TPUSolver)
+                res = self.solver.solve(snap)
+                out[i] = not res.new_nodes and not res.unschedulable
+            elif not snap.pods:
+                out[i] = True
+            elif not snap.existing_nodes:
+                out[i] = False
+            else:
+                batch_idx.append(i)
+        if batch_idx:
+            batch = [snapshots[i] for i in batch_idx]
+            flags = self._evaluate_shared(batch)
+            if flags is None:
+                flags = self._evaluate_dense(batch)
+            for i, ok in zip(batch_idx, flags):
+                out[i] = bool(ok)
+        return out  # type: ignore[return-value]
+
+    # ------------------------------------------------------------------
+    # shared-table fast path
+    # ------------------------------------------------------------------
+    def _evaluate_shared(
+            self, snaps: List[SchedulingSnapshot]) -> Optional[np.ndarray]:
+        """Encode against one shared node table; None if the batch is not
+        table-shaped (same node name, different node object contents)."""
+        by_name: Dict[str, ExistingNode] = {}
+        for snap in snaps:
+            for node in snap.existing_nodes:
+                prev = by_name.setdefault(node.name, node)
+                if prev is not node:
+                    return None  # heterogeneous batch -> dense fallback
+        node_names = sorted(by_name)
+        npos = {name: i for i, name in enumerate(node_names)}
+        E = len(node_names)
+
+        dims_set = {"cpu", "memory", "pods"}
+        sig_of: Dict[Tuple, int] = {}
+        sig_groups: List[Tuple] = []          # rep pod per full signature
+        #: compatibility depends only on (selector, affinity, tolerations)
+        #: — the constraint profile — and real batches have FEW of those
+        #: even when every candidate's pods carry distinct signatures
+        ckey_of: Dict[Tuple, int] = {}
+        ckey_groups: List[Tuple] = []         # rep pod per profile
+        sig_ckey: List[int] = []              # S -> Sc
+        per_snap: List[List[Tuple[int, int]]] = []  # [(sig idx, count)]
+        G = 1
+        for snap in snaps:
+            pods = sorted(snap.pods, key=pod_sort_key)
+            rows: List[Tuple[int, int]] = []
+            for p in pods:
+                dims_set.update(p.effective_requests().nonzero_keys())
+                sig = pod_group_signature(p)
+                si = sig_of.get(sig)
+                if si is None:
+                    si = sig_of[sig] = len(sig_groups)
+                    sig_groups.append(p)
+                    ck = (sig[0], sig[1], sig[3])
+                    ci = ckey_of.get(ck)
+                    if ci is None:
+                        ci = ckey_of[ck] = len(ckey_groups)
+                        ckey_groups.append(p)
+                    sig_ckey.append(ci)
+                if rows and rows[-1][0] == si:
+                    rows[-1] = (si, rows[-1][1] + 1)
+                else:
+                    rows.append((si, 1))
+            per_snap.append(rows)
+            G = max(G, len(rows))
+        dims = sorted(dims_set)
+        dpos = {d: i for i, d in enumerate(dims)}
+        D = len(dims)
+        S = len(sig_groups)
+        Sc = len(ckey_groups)
+
+        def vec(r) -> np.ndarray:
+            v = np.zeros(D, dtype=np.int64)
+            for k, q in r.items():
+                i = dpos.get(k)
+                if i is not None:
+                    v[i] = q
+            return v
+
+        B = len(snaps)
+        Bp, Gp, Ep = _pow2(B), _pow2(G), _pow2(E)
+        Sp, Scp, Dp = _pow2(S), _pow2(Sc), max(8, D)
+
+        ex_alloc = np.zeros((Ep, Dp), dtype=np.int64)
+        ex_used = np.zeros((Ep, Dp), dtype=np.int64)
+        for name, node in by_name.items():
+            ei = npos[name]
+            ex_alloc[ei, :D] = vec(node.allocatable)
+            ex_used[ei, :D] = vec(node.used)
+
+        compat_tab = np.zeros((Scp, Ep), dtype=bool)
+        for ci, rep in enumerate(ckey_groups):
+            reqs = rep.scheduling_requirements()
+            for name, node in by_name.items():
+                compat_tab[ci, npos[name]] = (
+                    reqs.satisfied_by_labels(node.labels)
+                    and all(t.tolerated_by(rep.tolerations)
+                            for t in node.taints))
+        R_tab = np.zeros((Sp, Dp), dtype=np.int64)
+        for si, rep in enumerate(sig_groups):
+            R_tab[si, :D] = vec(rep.effective_requests())
+
+        gid = np.zeros((Bp, Gp), dtype=np.int32)
+        cid = np.zeros((Bp, Gp), dtype=np.int32)
+        n = np.zeros((Bp, Gp), dtype=np.int64)
+        alive = np.zeros((Bp, Ep), dtype=bool)
+        for bi, snap in enumerate(snaps):
+            for gi, (si, cnt) in enumerate(per_snap[bi]):
+                gid[bi, gi] = si
+                cid[bi, gi] = sig_ckey[si]
+                n[bi, gi] = cnt
+            for node in snap.existing_nodes:
+                alive[bi, npos[node.name]] = True
+
+        if self.backend == "numpy":
+            return self._numpy_shared(
+                ex_alloc, ex_used, compat_tab, R_tab, gid, cid, n,
+                alive)[:B]
+
+        import jax.numpy as jnp
+
+        from ..ops.consolidation_jax import deletions_feasible_kernel
+        ok = deletions_feasible_kernel(
+            jnp.asarray(ex_alloc), jnp.asarray(ex_used),
+            jnp.asarray(compat_tab), jnp.asarray(R_tab),
+            jnp.asarray(gid), jnp.asarray(cid), jnp.asarray(n),
+            jnp.asarray(alive))
+        return np.asarray(ok)[:B]
+
+    @staticmethod
+    def _numpy_shared(ex_alloc, ex_used, compat_tab, R_tab, gid, cid, n,
+                      alive) -> np.ndarray:
+        BIG = np.int64(1) << 60
+        Bp, Gp = n.shape
+        ok = np.ones(Bp, dtype=bool)
+        for b in range(Bp):
+            used = ex_used.copy()
+            for g in range(Gp):
+                Rg, ng = R_tab[gid[b, g]], n[b, g]
+                cg = compat_tab[cid[b, g]] & alive[b]
+                Rsafe = np.where(Rg > 0, Rg, 1)
+                q = (ex_alloc - used) // Rsafe[None, :]
+                q = np.where((Rg > 0)[None, :], q, BIG)
+                k = np.clip(q.min(axis=-1), 0, BIG)
+                k = np.where(cg, k, 0)
+                cum = np.cumsum(k) - k
+                take = np.clip(ng - cum, 0, k)
+                used = used + take[:, None] * Rg[None, :]
+                if ng - take.sum() > 0:
+                    ok[b] = False
+        return ok
+
+    # ------------------------------------------------------------------
+    # dense fallback (heterogeneous batches)
+    # ------------------------------------------------------------------
+    def _evaluate_dense(self, snaps: List[SchedulingSnapshot]) -> np.ndarray:
+        B = len(snaps)
+        dims_set = {"cpu", "memory", "pods"}
+        for snap in snaps:
+            for p in snap.pods:
+                dims_set.update(p.effective_requests().nonzero_keys())
+        dims = sorted(dims_set)
+        dpos = {d: i for i, d in enumerate(dims)}
+        D = len(dims)
+        E = max(len(snap.existing_nodes) for snap in snaps)
+
+        def vec(r) -> np.ndarray:
+            v = np.zeros(D, dtype=np.int64)
+            for k, q in r.items():
+                i = dpos.get(k)
+                if i is not None:
+                    v[i] = q
+            return v
+
+        per_snap_groups = []
+        G = 1
+        for snap in snaps:
+            pods = sorted(snap.pods, key=pod_sort_key)
+            groups: List[Tuple] = []
+            by_sig: Dict[Tuple, int] = {}
+            for p in pods:
+                sig = pod_group_signature(p)
+                gi = by_sig.get(sig)
+                if gi is None:
+                    by_sig[sig] = len(groups)
+                    groups.append((p, [p]))
+                else:
+                    groups[gi][1].append(p)
+            per_snap_groups.append(groups)
+            G = max(G, len(groups))
+
+        Bp, Gp, Ep, Dp = _pow2(B), _pow2(G), _pow2(E), max(8, D)
+        ex_alloc = np.zeros((Bp, Ep, Dp), dtype=np.int64)
+        ex_used = np.zeros((Bp, Ep, Dp), dtype=np.int64)
+        ex_compat = np.zeros((Bp, Gp, Ep), dtype=bool)
+        R = np.zeros((Bp, Gp, Dp), dtype=np.int64)
+        n = np.zeros((Bp, Gp), dtype=np.int64)
+
+        for bi, snap in enumerate(snaps):
+            nodes = sorted(snap.existing_nodes, key=lambda x: x.name)
+            for ei, node in enumerate(nodes):
+                ex_alloc[bi, ei, :D] = vec(node.allocatable)
+                ex_used[bi, ei, :D] = vec(node.used)
+            for gi, (rep, pods) in enumerate(per_snap_groups[bi]):
+                R[bi, gi, :D] = vec(rep.effective_requests())
+                n[bi, gi] = len(pods)
+                reqs = rep.scheduling_requirements()
+                for ei, node in enumerate(nodes):
+                    ex_compat[bi, gi, ei] = (
+                        reqs.satisfied_by_labels(node.labels)
+                        and all(t.tolerated_by(rep.tolerations)
+                                for t in node.taints))
+
+        if self.backend == "numpy":
+            return self._numpy_dense(ex_alloc, ex_used, ex_compat, R, n)[:B]
+
+        import jax.numpy as jnp
+
+        from ..ops.consolidation_jax import deletions_feasible_dense
+        ok = deletions_feasible_dense(
+            jnp.asarray(ex_alloc), jnp.asarray(ex_used),
+            jnp.asarray(ex_compat), jnp.asarray(R), jnp.asarray(n))
+        return np.asarray(ok)[:B]
+
+    @staticmethod
+    def _numpy_dense(ex_alloc, ex_used, ex_compat, R, n) -> np.ndarray:
+        BIG = np.int64(1) << 60
+        Bp, Gp = n.shape
+        ok = np.ones(Bp, dtype=bool)
+        for b in range(Bp):
+            used = ex_used[b].copy()
+            for g in range(Gp):
+                Rg, ng = R[b, g], n[b, g]
+                Rsafe = np.where(Rg > 0, Rg, 1)
+                q = (ex_alloc[b] - used) // Rsafe[None, :]
+                q = np.where((Rg > 0)[None, :], q, BIG)
+                k = np.clip(q.min(axis=-1), 0, BIG)
+                k = np.where(ex_compat[b, g], k, 0)
+                cum = np.cumsum(k) - k
+                take = np.clip(ng - cum, 0, k)
+                used = used + take[:, None] * Rg[None, :]
+                if ng - take.sum() > 0:
+                    ok[b] = False
+        return ok
