@@ -27,6 +27,7 @@ from .fake.catalog import catalog_by_name
 from .fake.ec2 import FakeEC2
 from .fake.kube import FakeKube
 from .fake.kubelet import FakeKubelet
+from .options import Options
 from .providers.amifamily import AMIProvider
 from .providers.instance import InstanceProvider
 from .providers.instancetype import InstanceTypeProvider
@@ -38,19 +39,6 @@ from .solver.cpu import CPUSolver
 from .solver.types import Solver
 from .state.cluster import ClusterState
 from .utils.metrics import Metrics
-
-
-@dataclass
-class Options:
-    """The 8 AWS flags (options.go:36-85)."""
-    cluster_name: str = "cluster"
-    cluster_endpoint: str = "https://cluster.local"
-    cluster_ca_bundle: str = ""
-    isolated_vpc: bool = False
-    eks_control_plane: bool = True
-    vm_memory_overhead_percent: float = 0.075
-    interruption_queue: str = "karpenter-interruption"
-    reserved_enis: int = 0
 
 
 class Operator:

@@ -1,0 +1,157 @@
+"""The flag/option system (pkg/operator/options/options.go:36-85).
+
+The reference's 8 AWS flags with the same precedence chain — command-line
+flag > environment variable > default (options.go:47-56) — plus validation
+and context injection: options are registered as an *injectable* and carried
+on a context object rather than as globals (coreoptions.Injectables,
+options.go:30-32; FromContext/ToContext options.go:79-85).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+from dataclasses import dataclass, field, fields
+from typing import Any, Dict, List, Optional, Sequence
+
+
+class OptionsError(ValueError):
+    pass
+
+
+#: (flag, env var, type, default, help) — options.go:36-45
+_FLAGS = (
+    ("cluster-name", "CLUSTER_NAME", str, "",
+     "[REQUIRED] The kubernetes cluster name for resource discovery."),
+    ("cluster-endpoint", "CLUSTER_ENDPOINT", str, "",
+     "The external kubernetes cluster endpoint for new nodes to connect to. "
+     "If not specified, will be discovered."),
+    ("cluster-ca-bundle", "CLUSTER_CA_BUNDLE", str, "",
+     "Cluster CA bundle for nodes to use for TLS connections with the API "
+     "server. If not set, this is taken from the controller's TLS config."),
+    ("isolated-vpc", "ISOLATED_VPC", bool, False,
+     "If true, assume we can't reach AWS services which don't have a VPC "
+     "endpoint. This also disables pricing lookups."),
+    ("eks-control-plane", "EKS_CONTROL_PLANE", bool, False,
+     "Marking this true means the cluster has an EKS control plane."),
+    ("vm-memory-overhead-percent", "VM_MEMORY_OVERHEAD_PERCENT", float, 0.075,
+     "The VM memory overhead as a percent that will be subtracted from the "
+     "instance type's memory."),
+    ("interruption-queue", "INTERRUPTION_QUEUE", str, "",
+     "Interruption queue is the name of the SQS queue used for processing "
+     "interruption events from EC2. Interruption handling is disabled if "
+     "not specified."),
+    ("reserved-enis", "RESERVED_ENIS", int, 0,
+     "The number of ENIs reserved for system components (subtracted from "
+     "the ENI-based max-pods calculation)."),
+)
+
+
+def _flag_attr(flag: str) -> str:
+    return flag.replace("-", "_")
+
+
+@dataclass
+class Options:
+    """The 8 AWS flags (options.go:36-85)."""
+    cluster_name: str = "cluster"
+    cluster_endpoint: str = "https://cluster.local"
+    cluster_ca_bundle: str = ""
+    isolated_vpc: bool = False
+    eks_control_plane: bool = True
+    vm_memory_overhead_percent: float = 0.075
+    interruption_queue: str = "karpenter-interruption"
+    reserved_enis: int = 0
+
+    # -- flag binding (AddFlags + Parse, options.go:47-66) --------------
+    @classmethod
+    def add_flags(cls, parser: argparse.ArgumentParser) -> None:
+        for flag, env, typ, default, help_ in _FLAGS:
+            kwargs: Dict[str, Any] = {"help": f"{help_} (env {env})"}
+            if typ is bool:
+                kwargs["type"] = _parse_bool
+                kwargs["nargs"] = "?"
+                kwargs["const"] = True
+            else:
+                kwargs["type"] = typ
+            parser.add_argument(f"--{flag}", dest=_flag_attr(flag),
+                                default=None, **kwargs)
+
+    @classmethod
+    def parse(cls, argv: Sequence[str] = (),
+              env: Optional[Dict[str, str]] = None) -> "Options":
+        """flag > env var > default (options.go:47-56), then validate."""
+        env = dict(os.environ if env is None else env)
+        parser = argparse.ArgumentParser(add_help=False)
+        cls.add_flags(parser)
+        ns, _ = parser.parse_known_args(list(argv))
+        out = cls()
+        for flag, env_key, typ, default, _ in _FLAGS:
+            attr = _flag_attr(flag)
+            val = getattr(ns, attr)
+            if val is None and env_key in env:
+                raw = env[env_key]
+                val = _parse_bool(raw) if typ is bool else typ(raw)
+            if val is not None:
+                setattr(out, attr, val)
+        out.validate()
+        return out
+
+    # -- validation (options.go Validate) -------------------------------
+    def validate(self) -> None:
+        if not self.cluster_name:
+            raise OptionsError("missing field, cluster-name")
+        if self.cluster_endpoint and not re.match(
+                r"^https?://", self.cluster_endpoint):
+            raise OptionsError(
+                f"not a valid clusterEndpoint URL: {self.cluster_endpoint!r}")
+        if not (0.0 <= self.vm_memory_overhead_percent < 1.0):
+            raise OptionsError(
+                "vm-memory-overhead-percent cannot be negative or >= 1")
+        if self.reserved_enis < 0:
+            raise OptionsError("reserved-enis cannot be negative")
+
+
+# ---------------------------------------------------------------------------
+# context injection (coreoptions.Injectables / FromContext / ToContext)
+# ---------------------------------------------------------------------------
+
+class Context:
+    """A context carrying injected values (the Go context.Context shape the
+    reference threads options through; options.go:79-85)."""
+
+    def __init__(self, parent: Optional["Context"] = None):
+        self._values: Dict[type, Any] = dict(parent._values) if parent else {}
+
+    def with_value(self, value: Any) -> "Context":
+        child = Context(self)
+        child._values[type(value)] = value
+        return child
+
+    def value(self, typ: type) -> Optional[Any]:
+        return self._values.get(typ)
+
+
+#: the injectables registry (options.go:30-32): everything injected into
+#: the context at operator start
+INJECTABLES: List[type] = [Options]
+
+
+def to_context(ctx: Context, options: Options) -> Context:
+    return ctx.with_value(options)
+
+
+def from_context(ctx: Context) -> Options:
+    opts = ctx.value(Options)
+    if opts is None:
+        raise OptionsError(
+            "attempting to retrieve options from context, but options "
+            "doesn't exist in context")
+    return opts
+
+
+def _parse_bool(s) -> bool:
+    if isinstance(s, bool):
+        return s
+    return str(s).lower() in ("1", "true", "yes", "on")

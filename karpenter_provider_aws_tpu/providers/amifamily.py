@@ -135,6 +135,9 @@ def generate_user_data(family: str, cfg: BootstrapConfig) -> str:
 
 
 def _kubelet_args(cfg: BootstrapConfig) -> str:
+    """The --kubelet-extra-args line (bootstrap/eksbootstrap.go kubelet
+    flag assembly; deterministic ordering)."""
+    kl = cfg.kubelet
     args = []
     if cfg.labels:
         args.append("--node-labels=" + ",".join(
@@ -142,8 +145,30 @@ def _kubelet_args(cfg: BootstrapConfig) -> str:
     if cfg.taints:
         args.append("--register-with-taints=" + ",".join(
             f"{t.key}={t.value}:{t.effect}" for t in cfg.taints))
-    if cfg.kubelet.max_pods is not None:
-        args.append(f"--max-pods={cfg.kubelet.max_pods}")
+    if kl.max_pods is not None:
+        args.append(f"--max-pods={kl.max_pods}")
+    if kl.pods_per_core is not None:
+        args.append(f"--pods-per-core={kl.pods_per_core}")
+    if kl.kube_reserved:
+        args.append("--kube-reserved=" + ",".join(
+            f"{k}={v}" for k, v in sorted(kl.kube_reserved.items())))
+    if kl.system_reserved:
+        args.append("--system-reserved=" + ",".join(
+            f"{k}={v}" for k, v in sorted(kl.system_reserved.items())))
+    if kl.eviction_hard:
+        args.append("--eviction-hard=" + ",".join(
+            f"{k}<{v}" for k, v in sorted(kl.eviction_hard.items())))
+    if kl.eviction_soft:
+        args.append("--eviction-soft=" + ",".join(
+            f"{k}<{v}" for k, v in sorted(kl.eviction_soft.items())))
+    if kl.cluster_dns:
+        args.append("--cluster-dns=" + ",".join(kl.cluster_dns))
+    if kl.image_gc_high_threshold_percent is not None:
+        args.append(f"--image-gc-high-threshold={kl.image_gc_high_threshold_percent}")
+    if kl.image_gc_low_threshold_percent is not None:
+        args.append(f"--image-gc-low-threshold={kl.image_gc_low_threshold_percent}")
+    if kl.cpu_cfs_quota is not None:
+        args.append(f"--cpu-cfs-quota={str(kl.cpu_cfs_quota).lower()}")
     return " ".join(args)
 
 

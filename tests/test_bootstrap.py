@@ -1,0 +1,163 @@
+"""Userdata bootstrap generation per AMI family
+(pkg/providers/amifamily/bootstrap): eksbootstrap.sh args, nodeadm YAML,
+Bottlerocket TOML, Windows PS1, custom passthrough, MIME multipart merge,
+and the launch-template integration."""
+
+import pytest
+
+from karpenter_provider_aws_tpu.apis.objects import (EC2NodeClass,
+                                                     KubeletConfiguration,
+                                                     SelectorTerm, Taint)
+from karpenter_provider_aws_tpu.providers.amifamily import (BootstrapConfig,
+                                                            generate_user_data)
+
+
+def cfg(**kw):
+    base = dict(cluster_name="prod", cluster_endpoint="https://eks.local",
+                ca_bundle="Q0E=")
+    base.update(kw)
+    return BootstrapConfig(**base)
+
+
+class TestAL2:
+    def test_bootstrap_line(self):
+        ud = generate_user_data("al2", cfg(
+            labels={"team": "ml"}, taints=[Taint("gpu", "NoSchedule", "yes")],
+            kubelet=KubeletConfiguration(max_pods=58)))
+        assert ud.startswith("#!/bin/bash -xe")
+        assert "/etc/eks/bootstrap.sh 'prod'" in ud
+        assert "--apiserver-endpoint 'https://eks.local'" in ud
+        assert "--b64-cluster-ca 'Q0E='" in ud
+        assert "--node-labels=team=ml" in ud
+        assert "--register-with-taints=gpu=yes:NoSchedule" in ud
+        assert "--max-pods=58" in ud
+
+    def test_kubelet_flag_completeness(self):
+        ud = generate_user_data("al2", cfg(kubelet=KubeletConfiguration(
+            pods_per_core=8,
+            kube_reserved={"cpu": "100m", "memory": "500Mi"},
+            system_reserved={"memory": "200Mi"},
+            eviction_hard={"memory.available": "5%"},
+            eviction_soft={"memory.available": "10%"},
+            cluster_dns=["10.100.0.10"],
+            image_gc_high_threshold_percent=80,
+            image_gc_low_threshold_percent=50,
+            cpu_cfs_quota=False)))
+        assert "--pods-per-core=8" in ud
+        assert "--kube-reserved=cpu=100m,memory=500Mi" in ud
+        assert "--system-reserved=memory=200Mi" in ud
+        assert "--eviction-hard=memory.available<5%" in ud
+        assert "--eviction-soft=memory.available<10%" in ud
+        assert "--cluster-dns=10.100.0.10" in ud
+        assert "--image-gc-high-threshold=80" in ud
+        assert "--image-gc-low-threshold=50" in ud
+        assert "--cpu-cfs-quota=false" in ud
+
+    def test_custom_userdata_mime_merged_first(self):
+        ud = generate_user_data("al2", cfg(
+            custom_user_data="#!/bin/bash\necho hello\n"))
+        assert ud.startswith("MIME-Version: 1.0")
+        # custom part comes BEFORE the bootstrap part (mime merge order)
+        assert ud.index("echo hello") < ud.index("/etc/eks/bootstrap.sh")
+        assert ud.count("--//") >= 3  # two parts + terminator
+
+
+class TestAL2023:
+    def test_nodeconfig_yaml(self):
+        ud = generate_user_data("al2023", cfg(
+            labels={"a": "b"}, kubelet=KubeletConfiguration(
+                max_pods=29, cluster_dns=["10.100.0.10"])))
+        assert "apiVersion: node.eks.aws/v1alpha1" in ud
+        assert "kind: NodeConfig" in ud
+        assert "name: prod" in ud
+        assert "apiServerEndpoint: https://eks.local" in ud
+        assert "maxPods: 29" in ud
+        assert "clusterDNS: [10.100.0.10]" in ud
+        assert "- --node-labels=a=b" in ud
+        assert "Content-Type: application/node.eks.aws" in ud
+
+    def test_custom_part_appended(self):
+        ud = generate_user_data("al2023", cfg(
+            custom_user_data="#!/bin/bash\necho post\n"))
+        assert ud.index("kind: NodeConfig") < ud.index("echo post")
+        assert 'Content-Type: text/x-shellscript; charset="us-ascii"' in ud
+
+
+class TestBottlerocket:
+    def test_settings_toml(self):
+        ud = generate_user_data("bottlerocket", cfg(
+            labels={"x": "y"}, taints=[Taint("t", "NoExecute", "v")],
+            kubelet=KubeletConfiguration(max_pods=10)))
+        assert "[settings.kubernetes]" in ud
+        assert 'cluster-name = "prod"' in ud
+        assert 'api-server = "https://eks.local"' in ud
+        assert 'cluster-certificate = "Q0E="' in ud
+        assert "max-pods = 10" in ud
+        assert "[settings.kubernetes.node-labels]" in ud
+        assert '"x" = "y"' in ud
+        assert "[settings.kubernetes.node-taints]" in ud
+        assert '"t" = "v:NoExecute"' in ud
+
+    def test_custom_toml_appended(self):
+        ud = generate_user_data("bottlerocket", cfg(
+            custom_user_data='[settings.host-containers.admin]\nenabled = true'))
+        assert ud.index("[settings.kubernetes]") < \
+            ud.index("[settings.host-containers.admin]")
+
+
+class TestWindowsAndCustom:
+    def test_windows_powershell(self):
+        ud = generate_user_data("windows2022", cfg(
+            labels={"os-pool": "win"}))
+        assert ud.startswith("<powershell>")
+        assert "Start-EKSBootstrap.ps1 -EKSClusterName 'prod'" in ud
+        assert "-APIServerEndpoint 'https://eks.local'" in ud
+        assert "--node-labels=os-pool=win" in ud
+        assert ud.rstrip().endswith("</powershell>")
+
+    def test_custom_family_passthrough(self):
+        raw = "#cloud-config\nruncmd: [echo hi]\n"
+        assert generate_user_data("custom", cfg(custom_user_data=raw)) == raw
+
+
+class TestLaunchTemplateIntegration:
+    def test_userdata_flows_into_launch_template(self):
+        from karpenter_provider_aws_tpu.operator import Operator
+        from karpenter_provider_aws_tpu.fake.environment import make_pods
+        from karpenter_provider_aws_tpu.apis.objects import (NodeClassRef,
+                                                             NodePool,
+                                                             NodePoolTemplate)
+        op = Operator()
+        nc = EC2NodeClass("bd", kubelet=KubeletConfiguration(max_pods=42),
+                          user_data="#!/bin/bash\necho custom\n")
+        op.kube.create(nc)
+        op.kube.create(NodePool("bd-pool", template=NodePoolTemplate(
+            node_class_ref=NodeClassRef("bd"))))
+        for p in make_pods(1, cpu="500m", prefix="lt"):
+            op.kube.create(p)
+        op.run_until_settled()
+        lts = [lt for lt in op.ec2.describe_launch_templates()
+               if "/bd/" in lt.name]
+        assert lts
+        assert any("--max-pods=42" in lt.user_data for lt in lts)
+        assert any("echo custom" in lt.user_data for lt in lts)
+
+    def test_lt_name_changes_with_userdata(self):
+        """Userdata participates in the LT hash -> new template on change
+        (drift correctness; launchtemplate.go:146)."""
+        from karpenter_provider_aws_tpu.fake.ec2 import FakeEC2
+        from karpenter_provider_aws_tpu.providers.amifamily import AMIProvider
+        from karpenter_provider_aws_tpu.providers.launchtemplate import \
+            LaunchTemplateProvider
+        from karpenter_provider_aws_tpu.providers.network import \
+            SecurityGroupProvider
+        from karpenter_provider_aws_tpu.fake.environment import Environment
+        env = Environment()
+        ltp = LaunchTemplateProvider(
+            env.ec2, AMIProvider(env.ec2), SecurityGroupProvider(env.ec2))
+        nc1 = env.nodeclass("same")
+        types = env.instance_types.list(nc1)[:3]
+        a = ltp.ensure_all(nc1, types)
+        nc2 = env.nodeclass("same", user_data="#!/bin/bash\nextra\n")
+        b = ltp.ensure_all(nc2, types)
+        assert {t.name for t in a}.isdisjoint({t.name for t in b})

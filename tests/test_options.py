@@ -1,0 +1,89 @@
+"""Flag system: flag > env > default precedence, validation, context
+injection (pkg/operator/options/options.go:36-85)."""
+
+import pytest
+
+from karpenter_provider_aws_tpu.options import (Context, Options,
+                                                OptionsError, from_context,
+                                                to_context)
+
+
+class TestPrecedence:
+    def test_defaults(self):
+        o = Options.parse([], env={})
+        assert o.cluster_name == "cluster"
+        assert o.vm_memory_overhead_percent == 0.075
+        assert o.reserved_enis == 0
+
+    def test_env_overrides_default(self):
+        o = Options.parse([], env={"CLUSTER_NAME": "from-env",
+                                   "VM_MEMORY_OVERHEAD_PERCENT": "0.1",
+                                   "ISOLATED_VPC": "true",
+                                   "RESERVED_ENIS": "2"})
+        assert o.cluster_name == "from-env"
+        assert o.vm_memory_overhead_percent == 0.1
+        assert o.isolated_vpc is True
+        assert o.reserved_enis == 2
+
+    def test_flag_overrides_env(self):
+        o = Options.parse(
+            ["--cluster-name", "from-flag", "--reserved-enis", "3"],
+            env={"CLUSTER_NAME": "from-env", "RESERVED_ENIS": "9"})
+        assert o.cluster_name == "from-flag"
+        assert o.reserved_enis == 3
+
+    def test_all_eight_flags_bind(self):
+        o = Options.parse([
+            "--cluster-name", "c", "--cluster-endpoint", "https://x",
+            "--cluster-ca-bundle", "Q0E=", "--isolated-vpc",
+            "--eks-control-plane", "--vm-memory-overhead-percent", "0.05",
+            "--interruption-queue", "q", "--reserved-enis", "1"], env={})
+        assert (o.cluster_name, o.cluster_endpoint, o.cluster_ca_bundle,
+                o.isolated_vpc, o.eks_control_plane,
+                o.vm_memory_overhead_percent, o.interruption_queue,
+                o.reserved_enis) == (
+            "c", "https://x", "Q0E=", True, True, 0.05, "q", 1)
+
+
+class TestValidation:
+    def test_missing_cluster_name(self):
+        with pytest.raises(OptionsError, match="cluster-name"):
+            Options.parse(["--cluster-name", ""], env={})
+
+    def test_bad_endpoint(self):
+        with pytest.raises(OptionsError, match="clusterEndpoint"):
+            Options.parse(["--cluster-endpoint", "not-a-url"], env={})
+
+    def test_overhead_bounds(self):
+        with pytest.raises(OptionsError, match="overhead"):
+            Options.parse(["--vm-memory-overhead-percent", "1.5"], env={})
+        with pytest.raises(OptionsError, match="overhead"):
+            Options.parse(["--vm-memory-overhead-percent", "-0.1"], env={})
+
+    def test_negative_enis(self):
+        with pytest.raises(OptionsError, match="reserved-enis"):
+            Options.parse(["--reserved-enis", "-1"], env={})
+
+
+class TestContextInjection:
+    def test_round_trip(self):
+        ctx = to_context(Context(), Options(cluster_name="ctx-cluster"))
+        assert from_context(ctx).cluster_name == "ctx-cluster"
+
+    def test_missing_raises(self):
+        with pytest.raises(OptionsError, match="doesn't exist in context"):
+            from_context(Context())
+
+    def test_child_contexts_inherit(self):
+        ctx = to_context(Context(), Options(cluster_name="parent"))
+        child = ctx.with_value(object())
+        assert from_context(child).cluster_name == "parent"
+
+
+class TestOperatorIntegration:
+    def test_operator_accepts_parsed_options(self):
+        from karpenter_provider_aws_tpu.operator import Operator
+        op = Operator(options=Options.parse(
+            ["--cluster-name", "flagged"], env={}))
+        assert op.options.cluster_name == "flagged"
+        assert op.cloudprovider.cluster_name == "flagged"
