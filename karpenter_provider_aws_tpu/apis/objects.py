@@ -413,6 +413,8 @@ class KubeletConfiguration:
     system_reserved: Dict[str, str] = field(default_factory=dict)
     eviction_hard: Dict[str, str] = field(default_factory=dict)
     eviction_soft: Dict[str, str] = field(default_factory=dict)
+    #: signal -> grace period; kubelet requires one per eviction_soft signal
+    eviction_soft_grace_period: Dict[str, str] = field(default_factory=dict)
     cluster_dns: List[str] = field(default_factory=list)
     image_gc_high_threshold_percent: Optional[int] = None
     image_gc_low_threshold_percent: Optional[int] = None

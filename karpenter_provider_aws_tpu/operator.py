@@ -11,7 +11,7 @@ loop to a fixed point (the envtest-style test harness).
 from __future__ import annotations
 
 import time
-from dataclasses import dataclass, field
+
 from typing import Optional
 
 from .cache.ttl import UnavailableOfferings
@@ -47,7 +47,11 @@ class Operator:
                  solver: Optional[Solver] = None,
                  consolidation_evaluator=None,
                  clock=time.time):
-        self.options = options or Options()
+        self.options = options or Options(
+            cluster_name="cluster",
+            cluster_endpoint="https://cluster.local",
+            eks_control_plane=True,
+            interruption_queue="karpenter-interruption")
         self.clock = clock
         self.ec2 = ec2 or FakeEC2()
         self.kube = FakeKube(now=clock)

@@ -32,6 +32,14 @@ jax.config.update("jax_enable_x64", True)
 BIG = jnp.int64(1) << 60
 
 
+def _cumsum(x: jax.Array) -> jax.Array:
+    """Exclusive-free prefix sum via associative_scan. Bit-identical to
+    jnp.cumsum for integers, but lowers to log-depth slices instead of a
+    reduce-window — the reduce-window lowering of emulated int64 blows the
+    TPU scoped-vmem budget in this kernel's fusion context."""
+    return jax.lax.associative_scan(jnp.add, x)
+
+
 class KernelInputs(NamedTuple):
     """Static-shape device arrays for one solve."""
     # catalog
@@ -91,6 +99,11 @@ def _headroom_vec(A_eff: jax.Array, base: jax.Array, R: jax.Array) -> jax.Array:
 def solve_scan(inp: KernelInputs, n_max: int, E: int, P: int
                ) -> Tuple[jax.Array, jax.Array, Carry]:
     """Returns (takes[G, N], leftover[G], final carry)."""
+    return _solve(inp, n_max, E, P)
+
+
+def _solve(inp: KernelInputs, n_max: int, E: int, P: int
+           ) -> Tuple[jax.Array, jax.Array, Carry]:
     T, D = inp.A.shape
     Z = inp.agz.shape[1]
     C = inp.agc.shape[1]
@@ -134,12 +147,12 @@ def solve_scan(inp: KernelInputs, n_max: int, E: int, P: int
             budget = _pool_budget_jax(inp.pool_limit[pi], pool_used[pi], R)
             rows = carry.pool == pi
             kp = jnp.where(rows, k, 0)
-            cum = jnp.cumsum(kp) - kp
+            cum = _cumsum(kp) - kp
             capped = jnp.clip(jnp.minimum(kp, budget - cum), 0, None)
             k = jnp.where(rows & has_limit, capped, k)
 
         # ---- greedy prefix fill (step 4) ------------------------------
-        cum = jnp.cumsum(k) - k
+        cum = _cumsum(k) - k
         take = jnp.clip(n_rem - cum, 0, k)
         n_rem = n_rem - take.sum()
 
@@ -212,3 +225,160 @@ def _pool_budget_jax(limit: jax.Array, used: jax.Array, R: jax.Array) -> jax.Arr
     Rsafe = jnp.where(R > 0, R, 1)
     per_dim = jnp.where(active, jnp.clip(limit - used, 0, None) // Rsafe, BIG)
     return per_dim.min()
+
+
+# ---------------------------------------------------------------------------
+# Packed I/O path: the TPU sits behind a network tunnel, so PER-TRANSFER
+# round-trip latency dominates end-to-end solve time (measured ~5ms h2d and
+# far worse d2h per array vs ~30KB of actual payload). All 17 inputs ride
+# ONE int64 + ONE bool buffer; the outputs ride one of each back. The
+# layout lists below are the single source of truth for both sides.
+# ---------------------------------------------------------------------------
+
+def _in_layout_i64(T, D, Z, C, G, E, P):
+    """(name, shape) of every int64 input, in buffer order."""
+    return [("A", (T, D)), ("R", (G, D)), ("n", (G,)),
+            ("daemon", (G, P, D)), ("pool_limit", (P, D)),
+            ("pool_used0", (P, D)), ("ex_alloc", (E, D)),
+            ("ex_used0", (E, D))]
+
+
+def _in_layout_bool(T, D, Z, C, G, E, P):
+    return [("avail_zc", (T, Z * C)), ("F", (G, T)), ("agz", (G, Z)),
+            ("agc", (G, C)), ("admit", (G, P)),
+            ("pool_types", (P, T)), ("pool_agz", (P, Z)),
+            ("pool_agc", (P, C)), ("ex_compat", (G, E))]
+
+
+def _split(buf, layout) -> dict:
+    """Walk a flat buffer by a (name, shape) layout list. Works on both
+    numpy and jax arrays; the ONLY buffer walker — host pack and device
+    unpack share it so the layouts can never drift apart."""
+    vals = {}
+    off = 0
+    for nm, shp in layout:
+        sz = 1
+        for s in shp:
+            sz *= s
+        vals[nm] = buf[off:off + sz].reshape(shp)
+        off += sz
+    return vals
+
+
+def _unpack_inputs(buf_i64: jax.Array, buf_bool: jax.Array,
+                   T, D, Z, C, G, E, P) -> KernelInputs:
+    vals = _split(buf_i64, _in_layout_i64(T, D, Z, C, G, E, P))
+    vals.update(_split(buf_bool, _in_layout_bool(T, D, Z, C, G, E, P)))
+    return KernelInputs(**vals)
+
+
+def out_layout(T, D, Z, C, G, E, P, n_max):
+    """((i64 name, shape)…), ((bool name, shape)…) of the packed outputs."""
+    N = E + n_max
+    i64 = [("takes", (G, N)), ("leftover", (G,)), ("used", (N, D)),
+           ("pool", (N,)), ("num_nodes", (1,)), ("pool_used", (P, D))]
+    bl = [("types", (N, T)), ("zones", (N, Z)), ("ct", (N, C)),
+          ("alive", (N,))]
+    return i64, bl
+
+
+# ---------------------------------------------------------------------------
+# Single-buffer path. Each device round trip costs ~30-65ms of tunnel
+# latency regardless of payload, and enqueues pipeline without acks — so
+# the optimal shape is ONE int64 h2d buffer (bools bitpacked into words),
+# an async dispatch, and ONE synchronous d2h fetch that rides the same
+# wait as the execution. Bit packing is little-endian on both sides
+# (host: np.packbits(bitorder='little'); device: arithmetic shifts), so
+# no memory-layout assumptions cross the wire.
+# ---------------------------------------------------------------------------
+
+def _nwords(nbits: int) -> int:
+    return (nbits + 63) // 64
+
+
+def pack_bits_host(bits) -> "np.ndarray":
+    """Host: flat bool array -> uint64 words viewed as int64."""
+    import numpy as np
+    nb = bits.size
+    padded = np.zeros(_nwords(nb) * 64, dtype=bool)
+    padded[:nb] = bits.reshape(-1)
+    return np.packbits(padded, bitorder="little").view(np.int64)
+
+
+def unpack_bits_host(words, nbits: int) -> "np.ndarray":
+    """Host: int64 words -> flat bool array of length nbits."""
+    import numpy as np
+    return np.unpackbits(words.view(np.uint8),
+                         bitorder="little")[:nbits].astype(bool)
+
+
+def _bits_to_words(bits: jax.Array) -> jax.Array:
+    """Device: flat bool [n*64] -> int64 words via arithmetic packing."""
+    w = bits.reshape(-1, 64).astype(jnp.uint64)
+    weights = jnp.left_shift(jnp.uint64(1), jnp.arange(64, dtype=jnp.uint64))
+    packed = (w * weights[None, :]).sum(axis=1, dtype=jnp.uint64)
+    return jax.lax.bitcast_convert_type(packed, jnp.int64)
+
+
+def _words_to_bits(words: jax.Array, nbits: int) -> jax.Array:
+    """Device: int64 words -> flat bool [nbits]."""
+    w = jax.lax.bitcast_convert_type(words, jnp.uint64)
+    shifts = jnp.arange(64, dtype=jnp.uint64)
+    bits = jnp.right_shift(w[:, None], shifts[None, :]) & jnp.uint64(1)
+    return bits.reshape(-1)[:nbits].astype(bool)
+
+
+def _layout_sizes(layout):
+    total = 0
+    for _, shp in layout:
+        sz = 1
+        for s in shp:
+            sz *= s
+        total += sz
+    return total
+
+
+def pack_inputs1(arrays: dict, T, D, Z, C, G, E, P):
+    """Host: all inputs -> ONE int64 buffer [i64 fields | bitpacked bools]."""
+    import numpy as np
+    i64 = np.concatenate([arrays[nm].reshape(-1).astype(np.int64)
+                          for nm, _ in _in_layout_i64(T, D, Z, C, G, E, P)])
+    bl = np.concatenate([arrays[nm].reshape(-1).astype(bool)
+                         for nm, _ in _in_layout_bool(T, D, Z, C, G, E, P)])
+    return np.concatenate([i64, pack_bits_host(bl)])
+
+
+@partial(jax.jit, static_argnames=("T", "D", "Z", "C", "G", "E", "P", "n_max"))
+def solve_scan_packed1(buf: jax.Array, *, T: int, D: int, Z: int, C: int,
+                       G: int, E: int, P: int, n_max: int) -> jax.Array:
+    """One buffer in, one buffer out — a solve is a single round trip."""
+    n_i64 = _layout_sizes(_in_layout_i64(T, D, Z, C, G, E, P))
+    n_bits = _layout_sizes(_in_layout_bool(T, D, Z, C, G, E, P))
+    bool_flat = _words_to_bits(buf[n_i64:n_i64 + _nwords(n_bits)], n_bits)
+    inp = _unpack_inputs(buf[:n_i64], bool_flat, T, D, Z, C, G, E, P)
+    takes, leftover, carry = _solve(inp, n_max, E, P)
+    out_i64 = jnp.concatenate([
+        takes.reshape(-1), leftover.reshape(-1),
+        carry.used.reshape(-1), carry.pool.astype(jnp.int64),
+        carry.num_nodes.reshape(1).astype(jnp.int64),
+        carry.pool_used.reshape(-1)])
+    out_bool = jnp.concatenate([
+        carry.types.reshape(-1), carry.zones.reshape(-1),
+        carry.ct.reshape(-1), carry.alive])
+    nb = out_bool.shape[0]
+    pad = _nwords(nb) * 64 - nb
+    out_words = _bits_to_words(jnp.concatenate(
+        [out_bool, jnp.zeros(pad, bool)]))
+    return jnp.concatenate([out_i64, out_words])
+
+
+def unpack_outputs1(buf, T, D, Z, C, G, E, P, n_max) -> dict:
+    """Host: the single fetched buffer -> dict of arrays."""
+    import numpy as np
+    li, lb = out_layout(T, D, Z, C, G, E, P, n_max)
+    n_i64 = _layout_sizes(li)
+    n_bits = _layout_sizes(lb)
+    bool_flat = unpack_bits_host(np.ascontiguousarray(buf[n_i64:]), n_bits)
+    vals = _split(buf[:n_i64], li)
+    vals.update(_split(bool_flat, lb))
+    return vals

@@ -12,7 +12,7 @@ from __future__ import annotations
 import argparse
 import os
 import re
-from dataclasses import dataclass, field, fields
+from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Sequence
 
 
@@ -20,29 +20,30 @@ class OptionsError(ValueError):
     pass
 
 
-#: (flag, env var, type, default, help) — options.go:36-45
+#: (flag, env var, type, help) — options.go:36-45. Defaults live on the
+#: Options dataclass (the single source of truth; parse() falls back to it).
 _FLAGS = (
-    ("cluster-name", "CLUSTER_NAME", str, "",
+    ("cluster-name", "CLUSTER_NAME", str,
      "[REQUIRED] The kubernetes cluster name for resource discovery."),
-    ("cluster-endpoint", "CLUSTER_ENDPOINT", str, "",
+    ("cluster-endpoint", "CLUSTER_ENDPOINT", str,
      "The external kubernetes cluster endpoint for new nodes to connect to. "
      "If not specified, will be discovered."),
-    ("cluster-ca-bundle", "CLUSTER_CA_BUNDLE", str, "",
+    ("cluster-ca-bundle", "CLUSTER_CA_BUNDLE", str,
      "Cluster CA bundle for nodes to use for TLS connections with the API "
      "server. If not set, this is taken from the controller's TLS config."),
-    ("isolated-vpc", "ISOLATED_VPC", bool, False,
+    ("isolated-vpc", "ISOLATED_VPC", bool,
      "If true, assume we can't reach AWS services which don't have a VPC "
      "endpoint. This also disables pricing lookups."),
-    ("eks-control-plane", "EKS_CONTROL_PLANE", bool, False,
+    ("eks-control-plane", "EKS_CONTROL_PLANE", bool,
      "Marking this true means the cluster has an EKS control plane."),
-    ("vm-memory-overhead-percent", "VM_MEMORY_OVERHEAD_PERCENT", float, 0.075,
+    ("vm-memory-overhead-percent", "VM_MEMORY_OVERHEAD_PERCENT", float,
      "The VM memory overhead as a percent that will be subtracted from the "
      "instance type's memory."),
-    ("interruption-queue", "INTERRUPTION_QUEUE", str, "",
+    ("interruption-queue", "INTERRUPTION_QUEUE", str,
      "Interruption queue is the name of the SQS queue used for processing "
      "interruption events from EC2. Interruption handling is disabled if "
      "not specified."),
-    ("reserved-enis", "RESERVED_ENIS", int, 0,
+    ("reserved-enis", "RESERVED_ENIS", int,
      "The number of ENIs reserved for system components (subtracted from "
      "the ENI-based max-pods calculation)."),
 )
@@ -54,20 +55,22 @@ def _flag_attr(flag: str) -> str:
 
 @dataclass
 class Options:
-    """The 8 AWS flags (options.go:36-85)."""
-    cluster_name: str = "cluster"
-    cluster_endpoint: str = "https://cluster.local"
+    """The 8 AWS flags (options.go:36-85). Defaults match the reference:
+    cluster-name is required (validate() rejects empty), interruption
+    handling is off unless a queue is named."""
+    cluster_name: str = ""
+    cluster_endpoint: str = ""
     cluster_ca_bundle: str = ""
     isolated_vpc: bool = False
-    eks_control_plane: bool = True
+    eks_control_plane: bool = False
     vm_memory_overhead_percent: float = 0.075
-    interruption_queue: str = "karpenter-interruption"
+    interruption_queue: str = ""
     reserved_enis: int = 0
 
     # -- flag binding (AddFlags + Parse, options.go:47-66) --------------
     @classmethod
     def add_flags(cls, parser: argparse.ArgumentParser) -> None:
-        for flag, env, typ, default, help_ in _FLAGS:
+        for flag, env, typ, help_ in _FLAGS:
             kwargs: Dict[str, Any] = {"help": f"{help_} (env {env})"}
             if typ is bool:
                 kwargs["type"] = _parse_bool
@@ -87,7 +90,7 @@ class Options:
         cls.add_flags(parser)
         ns, _ = parser.parse_known_args(list(argv))
         out = cls()
-        for flag, env_key, typ, default, _ in _FLAGS:
+        for flag, env_key, typ, _ in _FLAGS:
             attr = _flag_attr(flag)
             val = getattr(ns, attr)
             if val is None and env_key in env:
@@ -152,6 +155,13 @@ def from_context(ctx: Context) -> Options:
 
 
 def _parse_bool(s) -> bool:
+    """strconv.ParseBool semantics: unrecognized values are errors, not
+    False (a typo'd ISOLATED_VPC must not silently invert behavior)."""
     if isinstance(s, bool):
         return s
-    return str(s).lower() in ("1", "true", "yes", "on")
+    v = str(s).strip().lower()
+    if v in ("1", "t", "true", "yes", "on"):
+        return True
+    if v in ("0", "f", "false", "no", "off"):
+        return False
+    raise OptionsError(f"invalid boolean value {s!r}")

@@ -159,8 +159,21 @@ def _kubelet_args(cfg: BootstrapConfig) -> str:
         args.append("--eviction-hard=" + ",".join(
             f"{k}<{v}" for k, v in sorted(kl.eviction_hard.items())))
     if kl.eviction_soft:
+        # kubelet refuses a soft threshold without a grace period; the
+        # reference rejects this at NodeClass validation, so surface the
+        # misconfiguration instead of inventing a zero grace period
+        missing = sorted(set(kl.eviction_soft) -
+                         set(kl.eviction_soft_grace_period))
+        if missing:
+            raise ValueError(
+                "evictionSoft signals missing a matching "
+                f"evictionSoftGracePeriod: {missing}")
         args.append("--eviction-soft=" + ",".join(
             f"{k}<{v}" for k, v in sorted(kl.eviction_soft.items())))
+        args.append("--eviction-soft-grace-period=" + ",".join(
+            f"{k}={v}" for k, v in
+            sorted(kl.eviction_soft_grace_period.items())
+            if k in kl.eviction_soft))
     if kl.cluster_dns:
         args.append("--cluster-dns=" + ",".join(kl.cluster_dns))
     if kl.image_gc_high_threshold_percent is not None:
@@ -209,8 +222,12 @@ def _al2023(cfg: BootstrapConfig) -> str:
     if cfg.kubelet.cluster_dns:
         lines.append(f"      clusterDNS: [{', '.join(cfg.kubelet.cluster_dns)}]")
     lines.append("    flags:")
+    # settings already rendered into the config section above must not be
+    # repeated as flags (nodeadm maps them into config only)
+    _in_config = ("--max-pods=", "--cluster-dns=")
     for flag in _kubelet_args(cfg).split():
-        lines.append(f"      - {flag}")
+        if not flag.startswith(_in_config):
+            lines.append(f"      - {flag}")
     body = "\n".join(lines) + "\n"
     parts = [body] + ([cfg.custom_user_data] if cfg.custom_user_data else [])
     return _mime_merge(parts, content_type="application/node.eks.aws")

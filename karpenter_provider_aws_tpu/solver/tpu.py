@@ -47,6 +47,9 @@ class TPUSolver(Solver):
         assert backend in ("jax", "numpy")
         self.backend = backend
         self.n_max = n_max
+        #: current new-node slot bucket; grows on overflow, sticky across
+        #: solves (steady-state clusters reuse the same compiled kernel)
+        self._bucket = min(256, n_max)
         self._cpu_fallback = CPUSolver()
 
     # ------------------------------------------------------------------
@@ -105,7 +108,8 @@ class TPUSolver(Solver):
     def _run_jax(self, enc, ex_alloc, ex_used, ex_compat):
         import jax.numpy as jnp
 
-        from ..ops.ffd_jax import KernelInputs, solve_scan
+        from ..ops.ffd_jax import (pack_inputs1, solve_scan_packed1,
+                                   unpack_outputs1)
         T, D = enc.A.shape
         Z, C = len(enc.zones), enc.avail.shape[2]
         P = len(enc.pools)
@@ -126,13 +130,15 @@ class TPUSolver(Solver):
         def padD(a):
             return np.pad(a, [(0, 0)] * (a.ndim - 1) + [(0, Dp - D)])
 
-        enc_R = padG(padD(enc.R))
-        enc_n = padG(enc.n)
-        enc_F = padG(enc.F)
-        enc_agz = padG(enc.agz)
-        enc_agc = padG(enc.agc)
-        enc_admit = np.pad(padG(enc.admit), [(0, 0), (0, Pp - P)])
-        enc_daemon = np.pad(padG(padD(enc.daemon)), [(0, 0), (0, Pp - P), (0, 0)])
+        arrays = dict(
+            A=padD(enc.A),
+            avail_zc=enc.avail.reshape(T, Z * C),
+            R=padG(padD(enc.R)), n=padG(enc.n), F=padG(enc.F),
+            agz=padG(enc.agz), agc=padG(enc.agc),
+            admit=np.pad(padG(enc.admit), [(0, 0), (0, Pp - P)]),
+            daemon=np.pad(padG(padD(enc.daemon)),
+                          [(0, 0), (0, Pp - P), (0, 0)]),
+        )
         pool_types = np.zeros((Pp, T), bool)
         pool_agz = np.zeros((Pp, Z), bool)
         pool_agc = np.zeros((Pp, C), bool)
@@ -147,6 +153,9 @@ class TPUSolver(Solver):
             pool_limit[p.index, :D] = lim
             pool_limit[p.index, D:] = -1
             pool_used0[p.index, :D] = p.in_use_vec
+        arrays.update(pool_types=pool_types, pool_agz=pool_agz,
+                      pool_agc=pool_agc, pool_limit=pool_limit,
+                      pool_used0=pool_used0)
         ex_alloc_p = np.zeros((Ep, Dp), np.int64)
         ex_used_p = np.zeros((Ep, Dp), np.int64)
         ex_compat_p = np.zeros((Gp, Ep), bool)
@@ -155,35 +164,42 @@ class TPUSolver(Solver):
             ex_used_p[:E, :D] = ex_used
             # dead padded rows: zero allocatable, incompatible with everyone
             ex_compat_p[:G, :E] = ex_compat
-        A_p = padD(enc.A)
-        inp = KernelInputs(
-            A=jnp.asarray(A_p),
-            avail_zc=jnp.asarray(enc.avail.reshape(T, Z * C)),
-            R=jnp.asarray(enc_R), n=jnp.asarray(enc_n),
-            F=jnp.asarray(enc_F), agz=jnp.asarray(enc_agz),
-            agc=jnp.asarray(enc_agc), admit=jnp.asarray(enc_admit),
-            daemon=jnp.asarray(enc_daemon),
-            pool_types=jnp.asarray(pool_types),
-            pool_agz=jnp.asarray(pool_agz),
-            pool_agc=jnp.asarray(pool_agc),
-            pool_limit=jnp.asarray(pool_limit),
-            pool_used0=jnp.asarray(pool_used0),
-            ex_alloc=jnp.asarray(ex_alloc_p), ex_used0=jnp.asarray(ex_used_p),
-            ex_compat=jnp.asarray(ex_compat_p),
-        )
-        takes, leftover, carry = solve_scan(inp, n_max=self.n_max, E=Ep, P=Pp)
-        takes = np.asarray(takes)[:G]
+        arrays.update(ex_alloc=ex_alloc_p, ex_used0=ex_used_p,
+                      ex_compat=ex_compat_p)
+
+        buf = pack_inputs1(arrays, T, Dp, Z, C, Gp, Ep, Pp)
+        d_buf = jnp.asarray(buf)  # async enqueue; no sync before dispatch
+
+        # --- bucketed new-node slots with overflow retry ------------------
+        # Steady state needs far fewer than n_max slots; a small N keeps the
+        # carry (and the d2h payload) small. If the solve exhausts every
+        # slot with pods left over, rerun with 4x slots (decisions are
+        # invariant to N once N is large enough: spare slots never fill).
+        n_bucket = self._bucket
+        while True:
+            o_buf = solve_scan_packed1(
+                d_buf, T=T, D=Dp, Z=Z, C=C, G=Gp, E=Ep, P=Pp,
+                n_max=n_bucket)
+            # np.asarray is the only sync: it waits for exec + fetch at once
+            out = unpack_outputs1(np.asarray(o_buf),
+                                  T, Dp, Z, C, Gp, Ep, Pp, n_bucket)
+            exhausted = (out["leftover"].sum() > 0
+                         and int(out["num_nodes"][0]) >= n_bucket)
+            if not exhausted or n_bucket >= self.n_max:
+                break
+            n_bucket = min(n_bucket * 4, self.n_max)
+        self._bucket = n_bucket
+
+        takes = out["takes"][:G]
         # slot axis: drop padded existing rows (E..Ep) — they are dead
         takes = np.concatenate([takes[:, :E], takes[:, Ep:]], axis=1)
+        sm = _slotmap(E, Ep, Ep + n_bucket)
         final = dict(
-            types=np.asarray(carry.types)[_slotmap(E, Ep, carry.types.shape[0])],
-            zones=np.asarray(carry.zones)[_slotmap(E, Ep, carry.types.shape[0])],
-            ct=np.asarray(carry.ct)[_slotmap(E, Ep, carry.types.shape[0])],
-            pool=np.asarray(carry.pool)[_slotmap(E, Ep, carry.types.shape[0])],
-            alive=np.asarray(carry.alive)[_slotmap(E, Ep, carry.types.shape[0])],
-            used=np.asarray(carry.used)[_slotmap(E, Ep, carry.types.shape[0]), :D],
+            types=out["types"][sm], zones=out["zones"][sm],
+            ct=out["ct"][sm], pool=out["pool"][sm],
+            alive=out["alive"][sm], used=out["used"][sm][:, :D],
             E=E)
-        return takes, np.asarray(leftover)[:G], final
+        return takes, out["leftover"][:G], final
 
     # ------------------------------------------------------------------
     def _decode(self, enc: SnapshotEncoding,

@@ -39,6 +39,7 @@ class TestAL2:
             system_reserved={"memory": "200Mi"},
             eviction_hard={"memory.available": "5%"},
             eviction_soft={"memory.available": "10%"},
+            eviction_soft_grace_period={"memory.available": "1m0s"},
             cluster_dns=["10.100.0.10"],
             image_gc_high_threshold_percent=80,
             image_gc_low_threshold_percent=50,
@@ -48,6 +49,7 @@ class TestAL2:
         assert "--system-reserved=memory=200Mi" in ud
         assert "--eviction-hard=memory.available<5%" in ud
         assert "--eviction-soft=memory.available<10%" in ud
+        assert "--eviction-soft-grace-period=memory.available=1m0s" in ud
         assert "--cluster-dns=10.100.0.10" in ud
         assert "--image-gc-high-threshold=80" in ud
         assert "--image-gc-low-threshold=50" in ud
@@ -139,7 +141,8 @@ class TestLaunchTemplateIntegration:
         lts = [lt for lt in op.ec2.describe_launch_templates()
                if "/bd/" in lt.name]
         assert lts
-        assert any("--max-pods=42" in lt.user_data for lt in lts)
+        assert any("maxPods: 42" in lt.user_data or "--max-pods=42" in lt.user_data
+                   for lt in lts)
         assert any("echo custom" in lt.user_data for lt in lts)
 
     def test_lt_name_changes_with_userdata(self):

@@ -10,10 +10,16 @@ from karpenter_provider_aws_tpu.options import (Context, Options,
 
 class TestPrecedence:
     def test_defaults(self):
-        o = Options.parse([], env={})
-        assert o.cluster_name == "cluster"
+        o = Options.parse(["--cluster-name", "c"], env={})
+        assert o.cluster_name == "c"
         assert o.vm_memory_overhead_percent == 0.075
         assert o.reserved_enis == 0
+        assert o.eks_control_plane is False
+        assert o.interruption_queue == ""
+
+    def test_cluster_name_required(self):
+        with pytest.raises(OptionsError, match="cluster-name"):
+            Options.parse([], env={})
 
     def test_env_overrides_default(self):
         o = Options.parse([], env={"CLUSTER_NAME": "from-env",
@@ -52,17 +58,17 @@ class TestValidation:
 
     def test_bad_endpoint(self):
         with pytest.raises(OptionsError, match="clusterEndpoint"):
-            Options.parse(["--cluster-endpoint", "not-a-url"], env={})
+            Options.parse(["--cluster-name", "c", "--cluster-endpoint", "not-a-url"], env={})
 
     def test_overhead_bounds(self):
         with pytest.raises(OptionsError, match="overhead"):
-            Options.parse(["--vm-memory-overhead-percent", "1.5"], env={})
+            Options.parse(["--cluster-name", "c", "--vm-memory-overhead-percent", "1.5"], env={})
         with pytest.raises(OptionsError, match="overhead"):
-            Options.parse(["--vm-memory-overhead-percent", "-0.1"], env={})
+            Options.parse(["--cluster-name", "c", "--vm-memory-overhead-percent", "-0.1"], env={})
 
     def test_negative_enis(self):
         with pytest.raises(OptionsError, match="reserved-enis"):
-            Options.parse(["--reserved-enis", "-1"], env={})
+            Options.parse(["--cluster-name", "c", "--reserved-enis", "-1"], env={})
 
 
 class TestContextInjection:

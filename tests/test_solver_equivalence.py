@@ -180,3 +180,51 @@ class TestRandomized:
                          prefix=f"p{seed}")
         res = assert_equivalent(env.snapshot(pods, [pool]), solvers)
         assert res.unschedulable  # limit guarantees leftovers
+
+
+class TestPackedBuffers:
+    """The single-buffer device round trip (ops/ffd_jax.py packed path)."""
+
+    def test_bit_roundtrip_host(self):
+        import numpy as np
+
+        from karpenter_provider_aws_tpu.ops.ffd_jax import (pack_bits_host,
+                                                            unpack_bits_host)
+        rng = np.random.RandomState(7)
+        for n in (1, 63, 64, 65, 1000, 4096):
+            bits = rng.rand(n) < 0.5
+            words = pack_bits_host(bits)
+            assert words.dtype == np.int64
+            got = unpack_bits_host(words, n)
+            assert (got == bits).all()
+
+    def test_bit_roundtrip_device(self):
+        """Host pack -> device unpack -> device pack -> host unpack."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        from karpenter_provider_aws_tpu.ops import ffd_jax
+        rng = np.random.RandomState(8)
+        n = 777
+        bits = rng.rand(n) < 0.5
+        words = ffd_jax.pack_bits_host(bits)
+        dbits = ffd_jax._words_to_bits(jnp.asarray(words), n)
+        assert (np.asarray(dbits) == bits).all()
+        pad = ffd_jax._nwords(n) * 64 - n
+        dwords = ffd_jax._bits_to_words(
+            jnp.concatenate([dbits, jnp.zeros(pad, bool)]))
+        assert (ffd_jax.unpack_bits_host(np.asarray(dwords), n) == bits).all()
+
+    def test_bucket_overflow_retry(self, env):
+        """A solve needing more new nodes than the current bucket must
+        grow the bucket and still match the oracle exactly."""
+        pods = make_pods(600, cpu="7", memory="14Gi", prefix="big")
+        snap = env.snapshot(pods, [env.nodepool("overflow-pool")])
+        ref = CPUSolver().solve(snap)
+        assert len(ref.new_nodes) > 8  # must overflow a tiny bucket
+
+        s = TPUSolver(backend="jax", n_max=512)
+        s._bucket = 8
+        got = s.solve(snap)
+        assert ref.decision_fingerprint() == got.decision_fingerprint()
+        assert s._bucket > 8  # sticky growth for the next solve
