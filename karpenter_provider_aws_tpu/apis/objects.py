@@ -186,8 +186,12 @@ class Pod(KubeObject):
 
     def full_name(self) -> str:
         """namespace/name — the identity used in solver decisions (pod names
-        alone collide across namespaces)."""
-        return f"{self.metadata.namespace}/{self.metadata.name}"
+        alone collide across namespaces). Memoized (hot in decode)."""
+        fn = self.__dict__.get("_full_name")
+        if fn is None:
+            self.__dict__["_full_name"] = fn = \
+                f"{self.metadata.namespace}/{self.metadata.name}"
+        return fn
 
     def effective_requests(self) -> Resources:
         """requests + the implicit 1-pod slot. Memoized (hot path)."""

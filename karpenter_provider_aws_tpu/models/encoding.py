@@ -34,6 +34,9 @@ from typing import Dict, List, Mapping, Optional, Sequence, Set, Tuple
 
 import numpy as np
 
+import hashlib
+import threading
+
 from ..apis import labels as L
 from ..apis.objects import Pod
 from ..apis.requirements import Requirement, Requirements
@@ -185,22 +188,107 @@ class SnapshotEncoding:
     daemon: np.ndarray                   # [G, P, D] int64 daemon overhead
 
 
-def encode_snapshot(snapshot: SchedulingSnapshot) -> SnapshotEncoding:
-    pods = sorted(snapshot.pods, key=pod_sort_key)
+def _ns_name(p: Pod) -> Tuple[str, str]:
+    k = p.__dict__.get("_nskey")
+    if k is None:
+        p.__dict__["_nskey"] = k = (p.metadata.namespace, p.metadata.name)
+    return k
 
-    # --- groups ---------------------------------------------------------
+
+#: process-wide signature intern table: sig tuple -> (small id, sig).
+#: Grouping then hashes one cached int per pod instead of a deep tuple.
+#: Bounded: past _SIG_CAP distinct signatures the table resets and the
+#: epoch bumps, invalidating ids cached on pods (a long-lived operator
+#: watching churning workloads must not grow memory monotonically).
+_SIG_IDS: Dict[Tuple, int] = {}
+_SIG_BY_ID: List[Tuple] = []
+_SIG_EPOCH = 0
+_SIG_CAP = 1 << 16
+_SIG_MU = threading.Lock()  # two unlocked misses could hand one id to two sigs
+
+
+def _sig_id(pod: Pod) -> int:
+    global _SIG_EPOCH
+    ent = pod.__dict__.get("_sig_id")
+    if ent is not None and ent[0] == _SIG_EPOCH:
+        return ent[1]
+    sig = pod_group_signature(pod)
+    with _SIG_MU:
+        sid = _SIG_IDS.get(sig)
+        if sid is None:
+            if len(_SIG_BY_ID) >= _SIG_CAP:
+                _SIG_IDS.clear()
+                _SIG_BY_ID.clear()
+                _SIG_EPOCH += 1
+            sid = len(_SIG_BY_ID)
+            _SIG_IDS[sig] = sid
+            _SIG_BY_ID.append(sig)
+        epoch = _SIG_EPOCH
+    pod.__dict__["_sig_id"] = (epoch, sid)
+    return sid
+
+
+def canonical_pod_groups(pods: Sequence[Pod]) -> List[Tuple[Tuple, List[Pod]]]:
+    """Group pods by scheduling signature in canonical FFD order.
+
+    Equivalent to ``sorted(pods, key=pod_sort_key)`` followed by dedup —
+    but O(n) grouping plus small sorts instead of one n·log(n) sort with
+    expensive tuple keys (the 50k-pod sort dominated encode time). Valid
+    because pod_sort_key = (-cpu, -mem, sig_digest, ns, name): all members
+    of a group share the first three components, so sorting groups by the
+    representative's key prefix and members by (ns, name) reproduces the
+    exact canonical order.
+    """
+    sig_groups: Optional[List[Tuple[Tuple, List[Pod]]]] = None
+    for _attempt in range(3):
+        by_sid: Dict[int, List[Pod]] = {}
+        epoch = _SIG_EPOCH
+        for p in pods:
+            ent = p.__dict__.get("_sig_id")
+            sid = ent[1] if (ent is not None and ent[0] == epoch) \
+                else _sig_id(p)
+            bucket = by_sid.get(sid)
+            if bucket is None:
+                by_sid[sid] = bucket = []
+            bucket.append(p)
+        # ids assigned before an intern-table reset collide with ids after
+        # it; resolve ids back to sig tuples under the lock, and only if
+        # the epoch never moved mid-loop — otherwise the grouping is
+        # suspect and we retry (the fresh table now holds this snapshot's
+        # sigs, so one retry suffices unless the snapshot alone overflows)
+        with _SIG_MU:
+            if _SIG_EPOCH == epoch:
+                sig_groups = [(_SIG_BY_ID[sid], plist)
+                              for sid, plist in by_sid.items()]
+        if sig_groups is not None:
+            break
+    if sig_groups is None:
+        raw: Dict[Tuple, List[Pod]] = {}
+        for p in pods:  # degenerate fallback: group by the raw sig tuple
+            raw.setdefault(pod_group_signature(p), []).append(p)
+        sig_groups = list(raw.items())
+    entries = []
+    for sig, plist in sig_groups:
+        rep = plist[0]
+        r = rep.effective_requests()
+        dig = getattr(rep, "_sig_digest", None)
+        if dig is None:
+            dig = hashlib.md5(repr(sig).encode()).hexdigest()
+            rep._sig_digest = dig
+        plist.sort(key=_ns_name)
+        entries.append(((-r["cpu"], -r["memory"], dig), sig, plist))
+    entries.sort(key=lambda e: e[0])
+    return [(sig, plist) for _, sig, plist in entries]
+
+
+def encode_snapshot(snapshot: SchedulingSnapshot) -> SnapshotEncoding:
+    # --- groups (canonical FFD order, O(n) grouping) ----------------------
     groups: List[PodGroup] = []
-    by_sig: Dict[Tuple, PodGroup] = {}
-    for p in pods:
-        sig = pod_group_signature(p)
-        g = by_sig.get(sig)
-        if g is None:
-            g = PodGroup(index=len(groups), sig=sig, pods=[],
-                         reqs=p.scheduling_requirements(),
-                         requests=p.effective_requests())
-            by_sig[sig] = g
-            groups.append(g)
-        g.pods.append(p)
+    for sig, plist in canonical_pod_groups(snapshot.pods):
+        rep = plist[0]
+        groups.append(PodGroup(index=len(groups), sig=sig, pods=plist,
+                               reqs=rep.scheduling_requirements(),
+                               requests=rep.effective_requests()))
 
     # --- union catalog --------------------------------------------------
     seen: Dict[str, InstanceType] = {}

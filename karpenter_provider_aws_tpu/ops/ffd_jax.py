@@ -231,8 +231,10 @@ def _pool_budget_jax(limit: jax.Array, used: jax.Array, R: jax.Array) -> jax.Arr
 # Packed I/O path: the TPU sits behind a network tunnel, so PER-TRANSFER
 # round-trip latency dominates end-to-end solve time (measured ~5ms h2d and
 # far worse d2h per array vs ~30KB of actual payload). All 17 inputs ride
-# ONE int64 + ONE bool buffer; the outputs ride one of each back. The
-# layout lists below are the single source of truth for both sides.
+# ONE int64 buffer (bool tensors bitpacked into words — see the
+# single-buffer section below), and all outputs ride ONE int64 buffer
+# back. The layout lists below are the single source of truth for both
+# sides; ``_split`` is the only buffer walker.
 # ---------------------------------------------------------------------------
 
 def _in_layout_i64(T, D, Z, C, G, E, P):

@@ -20,6 +20,15 @@ class OptionsError(ValueError):
     pass
 
 
+class _Parser(argparse.ArgumentParser):
+    """argparse converts type= exceptions into error() -> sys.exit(2);
+    keep the parse() error contract uniform (OptionsError for both the
+    flag and the env path) by raising instead of exiting."""
+
+    def error(self, message: str):
+        raise OptionsError(message)
+
+
 #: (flag, env var, type, help) — options.go:36-45. Defaults live on the
 #: Options dataclass (the single source of truth; parse() falls back to it).
 _FLAGS = (
@@ -86,7 +95,7 @@ class Options:
               env: Optional[Dict[str, str]] = None) -> "Options":
         """flag > env var > default (options.go:47-56), then validate."""
         env = dict(os.environ if env is None else env)
-        parser = argparse.ArgumentParser(add_help=False)
+        parser = _Parser(add_help=False)
         cls.add_flags(parser)
         ns, _ = parser.parse_known_args(list(argv))
         out = cls()
@@ -95,7 +104,15 @@ class Options:
             val = getattr(ns, attr)
             if val is None and env_key in env:
                 raw = env[env_key]
-                val = _parse_bool(raw) if typ is bool else typ(raw)
+                if typ is bool:
+                    val = _parse_bool(raw)
+                else:
+                    try:
+                        val = typ(raw)
+                    except ValueError:
+                        raise OptionsError(
+                            f"invalid value for {env_key}: {raw!r} "
+                            f"(expected {typ.__name__})") from None
             if val is not None:
                 setattr(out, attr, val)
         out.validate()

@@ -158,22 +158,25 @@ def _kubelet_args(cfg: BootstrapConfig) -> str:
     if kl.eviction_hard:
         args.append("--eviction-hard=" + ",".join(
             f"{k}<{v}" for k, v in sorted(kl.eviction_hard.items())))
-    if kl.eviction_soft:
-        # kubelet refuses a soft threshold without a grace period; the
-        # reference rejects this at NodeClass validation, so surface the
-        # misconfiguration instead of inventing a zero grace period
+    if kl.eviction_soft or kl.eviction_soft_grace_period:
+        # kubelet refuses a soft threshold without a grace period (and a
+        # grace period without a threshold is a typo'd signal name); the
+        # reference rejects both at NodeClass validation, so surface the
+        # misconfiguration instead of silently dropping entries
         missing = sorted(set(kl.eviction_soft) -
                          set(kl.eviction_soft_grace_period))
-        if missing:
+        extra = sorted(set(kl.eviction_soft_grace_period) -
+                       set(kl.eviction_soft))
+        if missing or extra:
             raise ValueError(
-                "evictionSoft signals missing a matching "
-                f"evictionSoftGracePeriod: {missing}")
+                "evictionSoft/evictionSoftGracePeriod signals must match: "
+                f"missing grace period for {missing}, "
+                f"grace period without threshold for {extra}")
         args.append("--eviction-soft=" + ",".join(
             f"{k}<{v}" for k, v in sorted(kl.eviction_soft.items())))
         args.append("--eviction-soft-grace-period=" + ",".join(
             f"{k}={v}" for k, v in
-            sorted(kl.eviction_soft_grace_period.items())
-            if k in kl.eviction_soft))
+            sorted(kl.eviction_soft_grace_period.items())))
     if kl.cluster_dns:
         args.append("--cluster-dns=" + ",".join(kl.cluster_dns))
     if kl.image_gc_high_threshold_percent is not None:

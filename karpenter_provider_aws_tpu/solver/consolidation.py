@@ -32,8 +32,9 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..controllers.disruption import ConsolidationEvaluator
+from ..models.encoding import canonical_pod_groups
 from ..solver.types import ExistingNode
-from .cpu import CPUSolver, pod_group_signature, pod_sort_key
+from .cpu import CPUSolver
 from .types import SchedulingSnapshot, Solver
 
 
@@ -103,11 +104,10 @@ class TPUConsolidationEvaluator(ConsolidationEvaluator):
         per_snap: List[List[Tuple[int, int]]] = []  # [(sig idx, count)]
         G = 1
         for snap in snaps:
-            pods = sorted(snap.pods, key=pod_sort_key)
             rows: List[Tuple[int, int]] = []
-            for p in pods:
+            for sig, plist in canonical_pod_groups(snap.pods):
+                p = plist[0]
                 dims_set.update(p.effective_requests().nonzero_keys())
-                sig = pod_group_signature(p)
                 si = sig_of.get(sig)
                 if si is None:
                     si = sig_of[sig] = len(sig_groups)
@@ -118,10 +118,7 @@ class TPUConsolidationEvaluator(ConsolidationEvaluator):
                         ci = ckey_of[ck] = len(ckey_groups)
                         ckey_groups.append(p)
                     sig_ckey.append(ci)
-                if rows and rows[-1][0] == si:
-                    rows[-1] = (si, rows[-1][1] + 1)
-                else:
-                    rows.append((si, 1))
+                rows.append((si, len(plist)))
             per_snap.append(rows)
             G = max(G, len(rows))
         dims = sorted(dims_set)
@@ -236,17 +233,8 @@ class TPUConsolidationEvaluator(ConsolidationEvaluator):
         per_snap_groups = []
         G = 1
         for snap in snaps:
-            pods = sorted(snap.pods, key=pod_sort_key)
-            groups: List[Tuple] = []
-            by_sig: Dict[Tuple, int] = {}
-            for p in pods:
-                sig = pod_group_signature(p)
-                gi = by_sig.get(sig)
-                if gi is None:
-                    by_sig[sig] = len(groups)
-                    groups.append((p, [p]))
-                else:
-                    groups[gi][1].append(p)
+            groups = [(plist[0], plist)
+                      for _sig, plist in canonical_pod_groups(snap.pods)]
             per_snap_groups.append(groups)
             G = max(G, len(groups))
 

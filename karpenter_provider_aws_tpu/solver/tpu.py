@@ -215,17 +215,18 @@ class TPUSolver(Solver):
         slot_groups: Dict[int, List[int]] = {}
 
         for g in enc.groups:
-            pods = iter(g.pods)
+            off = 0
             for slot in np.nonzero(takes[g.index])[0]:
                 cnt = int(takes[g.index, slot])
-                chunk = [next(pods) for _ in range(cnt)]
+                chunk = g.pods[off:off + cnt]
+                off += cnt
                 if slot < E:
                     for p in chunk:
                         assignments[p.full_name()] = existing[slot].name
                 else:
                     slot_pods.setdefault(int(slot), []).extend(chunk)
                     slot_groups.setdefault(int(slot), []).append(g.index)
-            for p in pods:  # leftovers — could not be scheduled
+            for p in g.pods[off:]:  # leftovers — could not be scheduled
                 unschedulable[p.full_name()] = "no capacity in any nodepool"
 
         new_nodes: List[NewNodeClaim] = []
