@@ -34,7 +34,6 @@ from typing import Dict, List, Mapping, Optional, Sequence, Set, Tuple
 
 import numpy as np
 
-import hashlib
 import threading
 
 from ..apis import labels as L
@@ -42,7 +41,7 @@ from ..apis.objects import Pod
 from ..apis.requirements import Requirement, Requirements
 from ..apis.resources import Resources
 from ..cloudprovider.types import InstanceType
-from ..solver.cpu import pod_group_signature, pod_sort_key
+from ..solver.cpu import pod_group_signature, pod_sig_digest, pod_sort_key
 from ..solver.types import NodePoolSpec, SchedulingSnapshot
 
 PRICE_INF = np.int64(1) << 60
@@ -271,10 +270,7 @@ def canonical_pod_groups(pods: Sequence[Pod]) -> List[Tuple[Tuple, List[Pod]]]:
     for sig, plist in sig_groups:
         rep = plist[0]
         r = rep.effective_requests()
-        dig = getattr(rep, "_sig_digest", None)
-        if dig is None:
-            dig = hashlib.md5(repr(sig).encode()).hexdigest()
-            rep._sig_digest = dig
+        dig = pod_sig_digest(rep)
         plist.sort(key=_ns_name)
         entries.append(((-r["cpu"], -r["memory"], dig), sig, plist))
     entries.sort(key=lambda e: e[0])

@@ -123,13 +123,22 @@ def _pool_budget(enc: SnapshotEncoding, pool_used: np.ndarray,
 
 def slot_candidates(st: NodeState, enc: SnapshotEncoding, g: int,
                     agz: np.ndarray) -> np.ndarray:
-    """[N, T] candidate types per open slot for group g (steps 1-2)."""
-    cand = st.types & enc.F[g][None, :]
-    zc = (st.zones & agz[None, :])[:, :, None] \
-        & (st.ct & enc.agc[g][None, :])[:, None, :]          # [N, Z, C]
-    off = np.tensordot(zc.reshape(st.N, -1),
+    """[N, T] candidate types per open slot for group g (steps 1-2).
+    Computed on the alive prefix only — slots beyond E+num_nodes have
+    all-False type rows, and a solve with many groups would otherwise pay
+    O(G * N * T) for dead slots."""
+    n_act = st.E + st.num_nodes
+    cand = np.zeros((st.N, enc.A.shape[0]), dtype=bool)
+    if n_act == 0:
+        return cand
+    act = slice(0, n_act)
+    c = st.types[act] & enc.F[g][None, :]
+    zc = (st.zones[act] & agz[None, :])[:, :, None] \
+        & (st.ct[act] & enc.agc[g][None, :])[:, None, :]     # [act, Z, C]
+    off = np.tensordot(zc.reshape(n_act, -1),
                        enc.avail.reshape(enc.avail.shape[0], -1).T, axes=1) > 0
-    return cand & off
+    cand[act] = c & off
+    return cand
 
 
 def slot_headroom(st: NodeState, enc: SnapshotEncoding, g: int,

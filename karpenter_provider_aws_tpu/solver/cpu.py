@@ -50,17 +50,24 @@ from .types import (DaemonOverhead, ExistingNode, NewNodeClaim, NodePoolSpec,
                     SchedulingSnapshot, SolveResult, Solver)
 
 
+def pod_sig_digest(pod: Pod) -> str:
+    """Digest of the pod-group signature — THE canonical tie-break shared
+    by pod_sort_key and models.encoding.canonical_pod_groups. Both solvers'
+    decision-identity depends on this being the single implementation."""
+    dig = getattr(pod, "_sig_digest", None)
+    if dig is None:
+        dig = hashlib.md5(repr(pod_group_signature(pod)).encode()).hexdigest()
+        pod._sig_digest = dig
+    return dig
+
+
 def pod_sort_key(pod: Pod) -> Tuple:
     """Canonical FFD order, shared verbatim by CPU and TPU solvers:
     descending (cpu, memory), then *pod-group signature digest* so identical
     pods are contiguous within a size class (group-batched processing is then
     exactly per-pod FFD), then namespace/name."""
     r = pod.effective_requests()
-    sig = getattr(pod, "_sig_digest", None)
-    if sig is None:
-        sig = hashlib.md5(repr(pod_group_signature(pod)).encode()).hexdigest()
-        pod._sig_digest = sig
-    return (-r["cpu"], -r["memory"], sig,
+    return (-r["cpu"], -r["memory"], pod_sig_digest(pod),
             pod.metadata.namespace, pod.metadata.name)
 
 

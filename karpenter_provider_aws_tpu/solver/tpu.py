@@ -19,7 +19,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..apis import labels as L
-from ..apis.requirements import Requirements
+from ..apis.requirements import IN, Requirement, Requirements
 from ..apis.resources import Resources
 from ..models.encoding import SnapshotEncoding, encode_snapshot
 from ..ops import ffd
@@ -54,10 +54,22 @@ class TPUSolver(Solver):
 
     # ------------------------------------------------------------------
     def solve(self, snapshot: SchedulingSnapshot) -> SolveResult:
-        if self._needs_topology(snapshot):
+        topo = self._needs_topology(snapshot)
+        if topo and self._topology_unsupported(snapshot):
+            # cheap pre-scan: don't pay a full encode only to fall back
             return self._cpu_fallback.solve(snapshot)
         enc = encode_snapshot(snapshot)
         existing = sorted(snapshot.existing_nodes, key=lambda n: n.name)
+        if topo:
+            from ..ops.topo import build_topo_encoding
+            tenc = build_topo_encoding(enc, snapshot, existing)
+            if not tenc.supported:
+                return self._cpu_fallback.solve(snapshot)
+            ex_alloc, ex_used, ex_compat = self._encode_existing(enc, existing)
+            takes, leftover, final = self._run_numpy(
+                enc, ex_alloc, ex_used, ex_compat,
+                tenc=tenc, existing=existing)
+            return self._decode(enc, existing, takes, leftover, final)
         ex_alloc, ex_used, ex_compat = self._encode_existing(enc, existing)
         if self.backend == "jax":
             takes, leftover, final = self._run_jax(enc, ex_alloc, ex_used, ex_compat)
@@ -67,7 +79,31 @@ class TPUSolver(Solver):
 
     @staticmethod
     def _needs_topology(snapshot: SchedulingSnapshot) -> bool:
-        return any(p.topology_spread or p.pod_affinity for p in snapshot.pods)
+        """Topology machinery is needed when any pod carries a spread /
+        (anti-)affinity constraint. Pods with only a scheduling_group record
+        membership, but with no constrained pod present nothing reads the
+        counters, so the plain path is exact."""
+        return any(p.topology_spread or any(a.required for a in p.pod_affinity)
+                   for p in snapshot.pods)
+
+    @staticmethod
+    def _topology_unsupported(snapshot: SchedulingSnapshot) -> bool:
+        """Mirror of ops.topo.build_topo_encoding's supported checks on the
+        raw pods, so unsupported snapshots skip encoding entirely."""
+        for p in snapshot.pods:
+            constrained = bool(p.topology_spread) or any(
+                a.required for a in p.pod_affinity)
+            if not constrained:
+                continue
+            for c in p.topology_spread:
+                if c.topology_key not in (L.ZONE, L.HOSTNAME):
+                    return True
+            for a in p.pod_affinity:
+                if a.required and a.topology_key not in (L.ZONE, L.HOSTNAME):
+                    return True
+            if p.scheduling_requirements().get(L.ZONE_ID) is not None:
+                return True
+        return False
 
     # ------------------------------------------------------------------
     def _encode_existing(self, enc: SnapshotEncoding,
@@ -93,16 +129,31 @@ class TPUSolver(Solver):
         return ex_alloc, ex_used, ex_compat
 
     # ------------------------------------------------------------------
-    def _run_numpy(self, enc, ex_alloc, ex_used, ex_compat):
+    def _run_numpy(self, enc, ex_alloc, ex_used, ex_compat,
+                   tenc=None, existing=()):
         st = ffd.NodeState.create(enc, self.n_max, ex_alloc, ex_used, ex_compat)
+        ts = None
+        if tenc is not None:
+            from ..ops.topo import TopoState, fill_group_topo, \
+                record_plain_fill
+            ts = TopoState.create(tenc, st.Z, st.N, st.E, existing)
         takes = np.zeros((len(enc.groups), st.N), dtype=np.int64)
         leftover = np.zeros(len(enc.groups), dtype=np.int64)
+        run_log = {}
         for g in enc.groups:
-            take, rem = ffd.fill_group_closed_form(st, enc, g.index)
+            if ts is not None and tenc.has_topo[g.index]:
+                take, rem, runs = fill_group_topo(st, enc, tenc, ts, g.index)
+                run_log[g.index] = runs
+            else:
+                take, rem = ffd.fill_group_closed_form(st, enc, g.index)
+                if ts is not None:
+                    record_plain_fill(tenc, ts, st, g.index, take)
             takes[g.index] = take
             leftover[g.index] = rem
         final = dict(types=st.types, zones=st.zones, ct=st.ct, pool=st.pool,
-                     alive=st.alive, used=st.used, E=st.E)
+                     alive=st.alive, used=st.used, E=st.E,
+                     run_log=run_log,
+                     zfix=(ts.zfix if ts is not None else None))
         return takes, leftover, final
 
     def _run_jax(self, enc, ex_alloc, ex_used, ex_compat):
@@ -214,10 +265,16 @@ class TPUSolver(Solver):
         slot_pods: Dict[int, List] = {}
         slot_groups: Dict[int, List[int]] = {}
 
+        run_log = final.get("run_log") or {}
         for g in enc.groups:
             off = 0
-            for slot in np.nonzero(takes[g.index])[0]:
-                cnt = int(takes[g.index, slot])
+            # topology pours stripe pods across slots; replay their
+            # placement order. Plain fills are slot-order chunks.
+            placement = run_log.get(g.index)
+            if placement is None:
+                placement = [(int(s), int(takes[g.index, s]))
+                             for s in np.nonzero(takes[g.index])[0]]
+            for slot, cnt in placement:
                 chunk = g.pods[off:off + cnt]
                 off += cnt
                 if slot < E:
@@ -225,7 +282,8 @@ class TPUSolver(Solver):
                         assignments[p.full_name()] = existing[slot].name
                 else:
                     slot_pods.setdefault(int(slot), []).extend(chunk)
-                    slot_groups.setdefault(int(slot), []).append(g.index)
+                    if g.index not in slot_groups.setdefault(int(slot), []):
+                        slot_groups[int(slot)].append(g.index)
             for p in g.pods[off:]:  # leftovers — could not be scheduled
                 unschedulable[p.full_name()] = "no capacity in any nodepool"
 
@@ -245,6 +303,12 @@ class TPUSolver(Solver):
             reqs = pool.spec.nodepool.scheduling_requirements()
             for gi in slot_groups[slot]:
                 reqs = reqs.union(enc.groups[gi].reqs)
+            zfix = final.get("zfix")
+            if zfix is not None and zfix[slot] >= 0:
+                # topology pinned this node's zone (_choose_zone); the
+                # oracle narrows node requirements with ZONE IN [chosen]
+                reqs = reqs.add(Requirement.new(
+                    L.ZONE, IN, [enc.zones[int(zfix[slot])]]))
             used_vec = final["used"][slot]
             new_nodes.append(NewNodeClaim(
                 nodepool=pool.spec.nodepool.metadata.name,

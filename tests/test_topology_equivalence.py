@@ -1,0 +1,221 @@
+"""Topology-spread + pod (anti-)affinity decision equivalence: the tensor
+pour (ops/topo.py) must match the CPU oracle fingerprint-for-fingerprint
+(BASELINE config 3). Scenarios cover zone/hostname spread at several skews,
+(anti-)affinity, cross-group constraints, existing-node counter seeding,
+ScheduleAnyway recording, and randomized fuzz."""
+
+import random
+
+import pytest
+
+from karpenter_provider_aws_tpu.apis import labels as L
+from karpenter_provider_aws_tpu.apis.objects import (PodAffinityTerm,
+                                                     TopologySpreadConstraint)
+from karpenter_provider_aws_tpu.apis.resources import Resources
+from karpenter_provider_aws_tpu.fake.environment import Environment, make_pods
+from karpenter_provider_aws_tpu.solver import CPUSolver
+from karpenter_provider_aws_tpu.solver.tpu import TPUSolver
+from karpenter_provider_aws_tpu.solver.types import ExistingNode
+
+
+@pytest.fixture(scope="module")
+def env():
+    return Environment()
+
+
+@pytest.fixture(scope="module")
+def solvers():
+    return (CPUSolver(), TPUSolver(backend="numpy", n_max=192))
+
+
+def zspread(skew=1, group=""):
+    return TopologySpreadConstraint(max_skew=skew, topology_key=L.ZONE,
+                                    group=group)
+
+
+def hspread(skew=1, group=""):
+    return TopologySpreadConstraint(max_skew=skew, topology_key=L.HOSTNAME,
+                                    group=group)
+
+
+def assert_equivalent(snap, solvers):
+    cpu, tnp = solvers
+    a = cpu.solve(snap)
+    b = tnp.solve(snap)
+    assert a.decision_fingerprint() == b.decision_fingerprint(), (
+        f"pour diverged: oracle [{a.summary()}] vs tensor [{b.summary()}]")
+    return a
+
+
+class TestZoneSpread:
+    def test_skew1_balanced(self, env, solvers):
+        pods = make_pods(30, cpu="1", memory="2Gi", prefix="web",
+                         topology_spread=[zspread(1)])
+        res = assert_equivalent(env.snapshot(pods, [env.nodepool("d")]),
+                                solvers)
+        assert not res.unschedulable
+
+    def test_skew2(self, env, solvers):
+        pods = make_pods(25, cpu="1", memory="2Gi", prefix="w2",
+                         topology_spread=[zspread(2)])
+        res = assert_equivalent(env.snapshot(pods, [env.nodepool("d")]),
+                                solvers)
+        assert not res.unschedulable
+
+    def test_two_deployments(self, env, solvers):
+        pods = (make_pods(20, cpu="1", memory="2Gi", prefix="a",
+                          topology_spread=[zspread(1)])
+                + make_pods(15, cpu="2", memory="4Gi", prefix="b",
+                            topology_spread=[zspread(1)]))
+        assert_equivalent(env.snapshot(pods, [env.nodepool("d")]), solvers)
+
+    def test_zone_selector_interaction(self, env, solvers):
+        pods = make_pods(12, cpu="1", memory="2Gi", prefix="zsel",
+                         node_selector={L.ZONE: "us-west-2a"},
+                         topology_spread=[zspread(1)])
+        assert_equivalent(env.snapshot(pods, [env.nodepool("d")]), solvers)
+
+    def test_schedule_anyway_records_only(self, env, solvers):
+        anyway = TopologySpreadConstraint(
+            max_skew=1, topology_key=L.ZONE,
+            when_unsatisfiable="ScheduleAnyway")
+        pods = (make_pods(9, cpu="1", memory="2Gi", prefix="sa",
+                          topology_spread=[anyway])
+                + make_pods(9, cpu="1", memory="2Gi", prefix="sa2",
+                            topology_spread=[zspread(1, group="sa")]))
+        assert_equivalent(env.snapshot(pods, [env.nodepool("d")]), solvers)
+
+
+class TestHostnameSpread:
+    def test_per_node_cap(self, env, solvers):
+        pods = make_pods(12, cpu="250m", memory="512Mi", prefix="hcap",
+                         topology_spread=[hspread(2)])
+        res = assert_equivalent(env.snapshot(pods, [env.nodepool("d")]),
+                                solvers)
+        assert not res.unschedulable
+        # cap of 2 pods per node -> at least 6 nodes
+        assert len(res.new_nodes) >= 6
+
+    def test_zone_plus_hostname(self, env, solvers):
+        pods = make_pods(18, cpu="500m", memory="1Gi", prefix="zh",
+                         topology_spread=[zspread(1), hspread(3)])
+        assert_equivalent(env.snapshot(pods, [env.nodepool("d")]), solvers)
+
+
+class TestAffinity:
+    def test_hostname_anti_affinity(self, env, solvers):
+        pods = make_pods(8, cpu="1", memory="2Gi", prefix="ha",
+                         pod_affinity=[PodAffinityTerm(
+                             topology_key=L.HOSTNAME, group="ha", anti=True)])
+        res = assert_equivalent(env.snapshot(pods, [env.nodepool("d")]),
+                                solvers)
+        assert len(res.new_nodes) == 8  # one per node
+
+    def test_zone_anti_affinity(self, env, solvers):
+        pods = make_pods(6, cpu="1", memory="2Gi", prefix="za",
+                         pod_affinity=[PodAffinityTerm(
+                             topology_key=L.ZONE, group="za", anti=True)])
+        res = assert_equivalent(env.snapshot(pods, [env.nodepool("d")]),
+                                solvers)
+        # at most one pod per zone; the rest are unschedulable
+        assert len(res.unschedulable) >= 2
+
+    def test_zone_self_affinity_colocates(self, env, solvers):
+        pods = make_pods(10, cpu="1", memory="2Gi", prefix="co",
+                         pod_affinity=[PodAffinityTerm(
+                             topology_key=L.ZONE, group="co", anti=False)])
+        res = assert_equivalent(env.snapshot(pods, [env.nodepool("d")]),
+                                solvers)
+        assert not res.unschedulable
+
+    def test_cross_group_zone_anti(self, env, solvers):
+        pods = (make_pods(4, cpu="1", memory="2Gi", prefix="lead",
+                          topology_spread=[zspread(1)])
+                + make_pods(6, cpu="1", memory="2Gi", prefix="avoid",
+                            pod_affinity=[PodAffinityTerm(
+                                topology_key=L.ZONE, group="lead",
+                                anti=True)]))
+        assert_equivalent(env.snapshot(pods, [env.nodepool("d")]), solvers)
+
+    def test_cross_group_zone_affinity(self, env, solvers):
+        pods = (make_pods(3, cpu="2", memory="4Gi", prefix="anchor")
+                + make_pods(6, cpu="1", memory="2Gi", prefix="follow",
+                            pod_affinity=[PodAffinityTerm(
+                                topology_key=L.ZONE, group="anchor",
+                                anti=False)]))
+        assert_equivalent(env.snapshot(pods, [env.nodepool("d")]), solvers)
+
+
+class TestNodeRequirements:
+    def test_topology_nodes_are_zone_pinned(self, env, solvers):
+        """A node whose zone was decided by topology must carry the
+        narrowed ZONE IN [chosen] requirement, exactly like the oracle
+        (the launcher constrains the CreateFleet overrides with it)."""
+        pods = make_pods(12, cpu="1", memory="2Gi", prefix="pin",
+                         topology_spread=[zspread(1)])
+        snap = env.snapshot(pods, [env.nodepool("d")])
+        cpu, tnp = solvers
+        a, b = cpu.solve(snap), tnp.solve(snap)
+        assert a.decision_fingerprint() == b.decision_fingerprint()
+        by_pods = {tuple(sorted(n.pod_names)): n for n in a.new_nodes}
+        for n in b.new_nodes:
+            zr = n.requirements.get(L.ZONE)
+            assert zr is not None and len(zr) == 1
+            oracle_zr = by_pods[tuple(sorted(n.pod_names))].requirements.get(
+                L.ZONE)
+            assert zr.any_value() == oracle_zr.any_value()
+
+
+class TestExistingNodesSeeding:
+    def test_counters_seeded_from_existing(self, env, solvers):
+        existing = [
+            ExistingNode(
+                name=f"node-{z}", labels={L.ZONE: z, L.ARCH: "amd64"},
+                allocatable=Resources.parse({"cpu": "16", "memory": "64Gi",
+                                             "pods": "110"}),
+                used=Resources.parse({"cpu": "1", "memory": "1Gi"}),
+                pod_groups=["web"] * cnt)
+            for z, cnt in [("us-west-2a", 3), ("us-west-2b", 1)]]
+        pods = make_pods(10, cpu="1", memory="2Gi", prefix="web",
+                         topology_spread=[zspread(1)])
+        assert_equivalent(
+            env.snapshot(pods, [env.nodepool("d")], existing_nodes=existing),
+            solvers)
+
+    def test_mixed_topo_and_plain(self, env, solvers):
+        pods = (make_pods(40, cpu="500m", memory="1Gi", prefix="plain")
+                + make_pods(12, cpu="1", memory="2Gi", prefix="spreader",
+                            topology_spread=[zspread(1), hspread(4)])
+                + make_pods(20, cpu="250m", memory="512Mi", prefix="tiny"))
+        assert_equivalent(env.snapshot(pods, [env.nodepool("d")]), solvers)
+
+
+class TestTopologyFuzz:
+    @pytest.mark.parametrize("seed", range(8))
+    def test_random_scenarios(self, env, solvers, seed):
+        rng = random.Random(seed)
+        pods = []
+        n_groups = rng.randint(1, 5)
+        for gi in range(n_groups):
+            spread = []
+            aff = []
+            if rng.random() < 0.7:
+                spread.append(zspread(rng.randint(1, 3)))
+            if rng.random() < 0.4:
+                spread.append(hspread(rng.randint(1, 4)))
+            if rng.random() < 0.3:
+                aff.append(PodAffinityTerm(
+                    topology_key=rng.choice([L.ZONE, L.HOSTNAME]),
+                    group=f"fz{seed}g{rng.randint(0, gi)}",
+                    anti=rng.random() < 0.6))
+            pods += make_pods(
+                rng.randint(1, 25),
+                cpu=rng.choice(["250m", "500m", "1", "2"]),
+                memory=rng.choice(["512Mi", "1Gi", "4Gi"]),
+                prefix=f"fz{seed}g{gi}",
+                topology_spread=spread, pod_affinity=aff)
+        pools = [env.nodepool(f"fzp{seed}")]
+        if rng.random() < 0.3:
+            pools.append(env.nodepool(f"fzp{seed}b", weight=10,
+                                      limits={"cpu": "30"}))
+        assert_equivalent(env.snapshot(pods, pools), solvers)
