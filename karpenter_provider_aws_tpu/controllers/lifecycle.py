@@ -99,12 +99,8 @@ class NodeClaimLifecycle:
             return False
         claim.set_condition("Initialized", "True", now=self.clock())
         self.kube.update(claim)
-        # discovered real capacity refines the catalog (SURVEY §2.5 capacity)
-        if self.instance_types is not None and node.capacity["memory"]:
-            itype = node.metadata.labels.get(L.INSTANCE_TYPE, "")
-            if itype and claim.image_id:
-                self.instance_types.update_discovered_capacity(
-                    itype, claim.image_id, node.capacity["memory"])
+        # discovered-capacity reporting is owned by
+        # DiscoveredCapacityController (capacity/controller.go:54-73)
         return True
 
     def _force_delete_claim(self, claim: NodeClaim) -> None:

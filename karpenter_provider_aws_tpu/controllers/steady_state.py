@@ -232,3 +232,113 @@ class PricingController:
         a = self.pricing.update_on_demand_pricing()
         b = self.pricing.update_spot_pricing()
         return a or b
+
+
+class NodeClassHashController:
+    """Re-stamp NodeClaim hash annotations when the hash VERSION bumps
+    (nodeclass/hash/controller.go:41-47): a framework upgrade that changes
+    how the static-field hash is computed must not report every node as
+    drifted — claims on the old version get the freshly computed hash and
+    the new version stamped, so only real spec changes drift."""
+
+    def __init__(self, kube: FakeKube):
+        self.kube = kube
+
+    def reconcile(self) -> int:
+        n = 0
+        nodeclasses = {nc.metadata.name: nc
+                       for nc in self.kube.list("EC2NodeClass")}
+        for claim in self.kube.list("NodeClaim"):
+            ann = claim.metadata.annotations
+            if ann.get(L.EC2NODECLASS_HASH_VERSION_ANNOTATION) \
+                    == L.EC2NODECLASS_HASH_VERSION:
+                continue
+            nc = nodeclasses.get(claim.node_class_ref.name)
+            if nc is None:
+                continue
+            ann[L.EC2NODECLASS_HASH_ANNOTATION] = nc.hash()
+            ann[L.EC2NODECLASS_HASH_VERSION_ANNOTATION] = \
+                L.EC2NODECLASS_HASH_VERSION
+            self.kube.update(claim)
+            n += 1
+        return n
+
+
+class DiscoveredCapacityController:
+    """Teach the catalog real memory from live nodes
+    (providers/instancetype/capacity/controller.go:54-73): the first node
+    of each (instance type, AMI) reports its true capacity, which the
+    instance-type provider then prefers over the vm-overhead estimate for
+    future solves (60-day cache)."""
+
+    def __init__(self, kube: FakeKube, instance_types: InstanceTypeProvider):
+        self.kube = kube
+        self.instance_types = instance_types
+        self._seen: Set[str] = set()
+
+    def reconcile(self) -> int:
+        n = 0
+        claims = {c.metadata.name: c for c in self.kube.list("NodeClaim")}
+        for node in self.kube.list("Node"):
+            name = node.metadata.name
+            if not node.ready or name in self._seen:
+                continue
+            itype = node.metadata.labels.get(L.INSTANCE_TYPE, "")
+            claim = claims.get(name)
+            ami = claim.image_id if claim is not None else ""
+            mem = node.capacity["memory"]
+            if itype and mem:
+                self.instance_types.update_discovered_capacity(
+                    itype, ami, int(mem))
+                self._seen.add(name)
+                n += 1
+        return n
+
+
+class SSMInvalidationController:
+    """Every 30m, evict mutable SSM entries whose AMIs were deprecated
+    (ssm/invalidation/controller.go:55-88) so the next AMI resolve sees
+    the replacement image instead of a poisoned cache."""
+
+    INTERVAL = 30 * 60.0
+
+    def __init__(self, ec2, ami_provider: AMIProvider, ssm=None,
+                 clock=time.time):
+        self.ec2 = ec2
+        self.ami = ami_provider
+        self.ssm = ssm
+        self.clock = clock
+        self._last = 0.0
+
+    def reconcile(self, force: bool = False) -> int:
+        now = self.clock()
+        if not force and now - self._last < self.INTERVAL:
+            return 0
+        self._last = now
+        evicted = self.ami.invalidate_deprecated()
+        if self.ssm is not None:
+            deprecated = {img.id for img in self.ec2.describe_images()
+                          if img.deprecated}
+            evicted += self.ssm.invalidate_deprecated(deprecated)
+        return evicted
+
+
+class VersionController:
+    """Periodic kubernetes-version refresh with validation
+    (providers/version/controller.go:45-53). The source callable stands in
+    for EKS DescribeCluster / the /version endpoint."""
+
+    def __init__(self, provider, source, clock=time.time,
+                 interval: float = 5 * 60.0):
+        self.provider = provider
+        self.source = source
+        self.clock = clock
+        self.interval = interval
+        self._last = 0.0
+
+    def reconcile(self, force: bool = False) -> bool:
+        now = self.clock()
+        if not force and now - self._last < self.interval:
+            return False
+        self._last = now
+        return self.provider.update(self.source())

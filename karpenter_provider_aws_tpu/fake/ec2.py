@@ -152,6 +152,9 @@ class FakeEC2:
         self.create_launch_template_log = CallLog()
         self.create_tags_log = CallLog()
         self.describe_instance_types_log = CallLog()
+        self.ssm_get_parameter_log = CallLog()
+        #: EKS DescribeCluster version (the version controller's source)
+        self.eks_cluster_version = "1.31"
 
         self._seed_default_network()
         self._seed_default_images()
@@ -245,7 +248,13 @@ class FakeEC2:
                     out.append(img)
             return out
 
+    def eks_describe_cluster_version(self) -> str:
+        """EKS DescribeCluster's cluster version (version.go source)."""
+        with self._mu:
+            return self.eks_cluster_version
+
     def ssm_get_parameter(self, path: str) -> str:
+        self.ssm_get_parameter_log.record(path)
         with self._mu:
             if path not in self.ssm_parameters:
                 raise KeyError(f"ParameterNotFound: {path}")

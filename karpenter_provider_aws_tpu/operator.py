@@ -19,10 +19,15 @@ from .cloudprovider.provider import CloudProvider
 from .controllers.disruption import DisruptionController
 from .controllers.lifecycle import NodeClaimLifecycle, Terminator
 from .controllers.provisioning import Provisioner
-from .controllers.steady_state import (CatalogController, GarbageCollector,
+from .controllers.steady_state import (CatalogController,
+                                       DiscoveredCapacityController,
+                                       GarbageCollector,
                                        InterruptionController,
+                                       NodeClassHashController,
                                        NodeClassStatusController,
-                                       PricingController, Tagger)
+                                       PricingController,
+                                       SSMInvalidationController, Tagger,
+                                       VersionController)
 from .fake.catalog import catalog_by_name
 from .fake.ec2 import FakeEC2
 from .fake.kube import FakeKube
@@ -35,6 +40,7 @@ from .providers.launchtemplate import LaunchTemplateProvider
 from .providers.network import SecurityGroupProvider, SubnetProvider
 from .providers.pricing import (InstanceProfileProvider, PricingProvider,
                                 SQSProvider, VersionProvider)
+from .providers.ssm import SSMProvider
 from .solver.cpu import CPUSolver
 from .solver.types import Solver
 from .state.cluster import ClusterState
@@ -65,7 +71,8 @@ class Operator:
         self.pricing = PricingProvider(self.ec2)
         self.subnets = SubnetProvider(self.ec2)
         self.security_groups = SecurityGroupProvider(self.ec2)
-        self.amis = AMIProvider(self.ec2)
+        self.ssm = SSMProvider(self.ec2)
+        self.amis = AMIProvider(self.ec2, ssm=self.ssm)
         self.instance_profiles = InstanceProfileProvider(
             self.options.cluster_name)
         self.version = VersionProvider()
@@ -105,6 +112,14 @@ class Operator:
             metrics=self.metrics, clock=clock)
         self.catalog_controller = CatalogController(self.ec2, self.instance_types)
         self.pricing_controller = PricingController(self.pricing)
+        self.nodeclass_hash = NodeClassHashController(self.kube)
+        self.discovered_capacity = DiscoveredCapacityController(
+            self.kube, self.instance_types)
+        self.ssm_invalidation = SSMInvalidationController(
+            self.ec2, self.amis, ssm=self.ssm, clock=clock)
+        self.version_controller = VersionController(
+            self.version, source=self.ec2.eks_describe_cluster_version,
+            clock=clock)
         self.disruption = DisruptionController(
             self.kube, self.state, self.cloudprovider, self.solver,
             self.provisioner, evaluator=consolidation_evaluator,
@@ -137,6 +152,10 @@ class Operator:
         out["lifecycle2"] = self.lifecycle.reconcile()
         out["tagged"] = self.tagger.reconcile()
         out["gc"] = self.gc.reconcile()
+        out["hash_restamped"] = self.nodeclass_hash.reconcile()
+        out["capacity_discovered"] = self.discovered_capacity.reconcile()
+        out["ssm_evicted"] = self.ssm_invalidation.reconcile()
+        out["version_changed"] = self.version_controller.reconcile()
         return out
 
     def run_until_settled(self, max_steps: int = 20,

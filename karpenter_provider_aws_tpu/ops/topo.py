@@ -235,9 +235,8 @@ class _Pour:
         self.member_h = tenc.member_h[g]
         self.zone_needed = tenc.zone_needed[g]
         self.min_mask = tenc.min_mask[g]
-        #: offerings available at (type, zone) under the group's ct mask —
-        #: for headroom/zone caps; any-ct variant for _choose_zone
-        self.avail_ct = (enc.avail & self.agc[None, None, :])  # [T, Z, C]
+        #: zones with any available offering per type (_choose_zone scans
+        #: zones of available offerings regardless of capacity type)
         self.avail_anyct = enc.avail.any(axis=2)               # [T, Z]
 
         # Slot admission is eager (cheap); candidate types and headroom per

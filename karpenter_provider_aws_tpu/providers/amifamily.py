@@ -18,7 +18,6 @@ from typing import Dict, List, Optional, Sequence
 
 from ..apis import labels as L
 from ..apis.objects import EC2NodeClass, KubeletConfiguration, SelectorTerm, Taint
-from ..cache.ttl import SSM_TTL, TTLCache
 
 FAMILIES = ("al2", "al2023", "bottlerocket", "windows2019", "windows2022",
             "custom")
@@ -39,9 +38,12 @@ class AMI:
 
 
 class AMIProvider:
-    def __init__(self, ec2, clock=None):
+    def __init__(self, ec2, clock=None, ssm=None):
         self.ec2 = ec2
-        self._ssm_cache = TTLCache(ttl=SSM_TTL, clock=clock)
+        if ssm is None:
+            from .ssm import SSMProvider
+            ssm = SSMProvider(ec2, clock=clock)
+        self.ssm = ssm
 
     def list(self, nodeclass: EC2NodeClass) -> List[AMI]:
         """Resolve the nodeclass's AMI selector terms to concrete AMIs,
@@ -67,13 +69,10 @@ class AMIProvider:
 
     def _resolve_ssm(self, family: str, arch: str) -> Optional[AMI]:
         path = f"/aws/service/{family}/{arch}/latest/image_id"
-        ami_id = self._ssm_cache.get(path)
-        if ami_id is None:
-            try:
-                ami_id = self.ec2.ssm_get_parameter(path)
-            except KeyError:
-                return None
-            self._ssm_cache.put(path, ami_id)
+        try:
+            ami_id = self.ssm.get(path)
+        except KeyError:
+            return None
         imgs = self.ec2.describe_images(ids=[ami_id])
         if not imgs:
             return None
@@ -82,15 +81,14 @@ class AMIProvider:
 
     def invalidate_deprecated(self) -> int:
         """SSM cache invalidation for params resolving to deprecated AMIs
-        (ssm/invalidation/controller.go:55-88)."""
-        evicted = 0
-        for path in list(self._ssm_cache.keys()):
-            ami_id = self._ssm_cache.get(path)
-            imgs = self.ec2.describe_images(ids=[ami_id]) if ami_id else []
+        (ssm/invalidation/controller.go:55-88): evict the shared SSM
+        provider's mutable entries whose AMI is deprecated or gone."""
+        bad = set()
+        for param in self.ssm.cached().values():
+            imgs = self.ec2.describe_images(ids=[param.value])
             if not imgs or imgs[0].deprecated:
-                self._ssm_cache.delete(path)
-                evicted += 1
-        return evicted
+                bad.add(param.value)
+        return self.ssm.invalidate_deprecated(bad)
 
 
 def map_to_instance_types(amis: Sequence[AMI], instance_types) -> Dict[str, List]:

@@ -1,0 +1,75 @@
+"""SSM parameter provider (pkg/providers/ssm, provider.go:29-31).
+
+Cached GetParameter with *mutable* vs *immutable* entries: a parameter
+whose path pins an exact version (e.g. ``...@v20240807``) can never change
+and caches forever; a floating path (``@latest``/``@recommended``) is
+mutable and subject to the 24h TTL *and* to deprecation-driven eviction by
+the SSM invalidation controller (ssm/invalidation/controller.go:55-88) —
+when the AMI a cached parameter resolves to is deprecated, the entry is
+evicted so the next resolve re-reads the source of truth.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Callable, Dict, Iterable, Optional
+
+from ..cache.ttl import SSM_TTL, TTLCache
+
+#: floating selectors that make a parameter mutable
+_MUTABLE_MARKERS = ("latest", "recommended")
+
+
+@dataclass
+class Parameter:
+    """A cached SSM parameter (value + mutability)."""
+    path: str
+    value: str
+    mutable: bool
+
+
+def is_mutable(path: str) -> bool:
+    return any(m in path for m in _MUTABLE_MARKERS)
+
+
+class SSMProvider:
+    def __init__(self, ec2, clock: Optional[Callable[[], float]] = None):
+        self.ec2 = ec2
+        self._mu = threading.Lock()
+        self._cache: TTLCache = TTLCache(ttl=SSM_TTL, clock=clock)
+
+    def get(self, path: str) -> str:
+        """Cached GetParameter; immutable entries never expire logically
+        (their value cannot change at the source), mutable entries are
+        TTL'd and deprecation-evicted."""
+        with self._mu:
+            ent: Optional[Parameter] = self._cache.get(path)
+            if ent is not None:
+                return ent.value
+        value = self.ec2.ssm_get_parameter(path)
+        mutable = is_mutable(path)
+        with self._mu:
+            # version-pinned parameters can never change at the source:
+            # cache them forever; floating ones get the standard TTL
+            self._cache.put(path, Parameter(path, value, mutable),
+                            ttl=None if mutable else float("inf"))
+        return value
+
+    def cached(self) -> Dict[str, Parameter]:
+        with self._mu:
+            return {k: self._cache.get(k) for k in self._cache.keys()
+                    if self._cache.get(k) is not None}
+
+    def invalidate_deprecated(self, deprecated_values: Iterable[str]) -> int:
+        """Evict mutable entries whose resolved value became deprecated;
+        returns the eviction count (the invalidation controller's work)."""
+        bad = set(deprecated_values)
+        evicted = 0
+        with self._mu:
+            for path in list(self._cache.keys()):
+                ent: Optional[Parameter] = self._cache.get(path)
+                if ent is not None and ent.mutable and ent.value in bad:
+                    self._cache.delete(path)
+                    evicted += 1
+        return evicted

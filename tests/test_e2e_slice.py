@@ -232,6 +232,6 @@ class TestDriftDetection:
                         ssm_alias="al2023@latest/amd64")
         op.ec2.images[new.id] = new
         op.ec2.ssm_parameters["/aws/service/al2023/amd64/latest/image_id"] = new.id
-        op.amis._ssm_cache.clear()
+        op.ssm_invalidation.reconcile(force=True)  # evict deprecated AMI params
         op.nodeclass_status.reconcile()
         assert op.cloudprovider.is_drifted(claim) == "AMIDrift"

@@ -1,0 +1,153 @@
+"""SSM provider (mutable/immutable cache, deprecation eviction) and the
+steady-state metadata controllers: hash re-stamp, discovered capacity,
+SSM invalidation, version refresh (SURVEY §2.4/§2.5 parity)."""
+
+import pytest
+
+from karpenter_provider_aws_tpu.apis import labels as L
+from karpenter_provider_aws_tpu.apis.objects import EC2NodeClass
+from karpenter_provider_aws_tpu.apis.resources import Resources
+from karpenter_provider_aws_tpu.controllers.steady_state import (
+    DiscoveredCapacityController, NodeClassHashController,
+    SSMInvalidationController, VersionController)
+from karpenter_provider_aws_tpu.fake.ec2 import FakeEC2
+from karpenter_provider_aws_tpu.fake.environment import Environment, make_pods
+from karpenter_provider_aws_tpu.operator import Operator
+from karpenter_provider_aws_tpu.providers.pricing import VersionProvider
+from karpenter_provider_aws_tpu.providers.ssm import SSMProvider, is_mutable
+
+
+class TestSSMProvider:
+    def test_cached_get(self):
+        ec2 = FakeEC2()
+        ssm = SSMProvider(ec2)
+        path = "/aws/service/al2023/amd64/latest/image_id"
+        v1 = ssm.get(path)
+        calls_before = ec2.ssm_get_parameter_log.called_times
+        v2 = ssm.get(path)
+        assert v1 == v2
+        assert ec2.ssm_get_parameter_log.called_times == calls_before
+
+    def test_mutability_classification(self):
+        assert is_mutable("/eks/al2023/x86_64/latest")
+        assert is_mutable("/eks/bottlerocket/recommended/image_id")
+        assert not is_mutable("/eks/al2023/x86_64/v20240807")
+
+    def test_deprecation_evicts_only_mutable(self):
+        ec2 = FakeEC2()
+        ssm = SSMProvider(ec2)
+        mut = "/aws/service/al2023/amd64/latest/image_id"
+        val = ssm.get(mut)
+        assert ssm.invalidate_deprecated([val]) == 1
+        assert ssm.invalidate_deprecated([val]) == 0  # already evicted
+
+    def test_unrelated_deprecations_keep_cache(self):
+        ec2 = FakeEC2()
+        ssm = SSMProvider(ec2)
+        path = "/aws/service/al2023/amd64/latest/image_id"
+        ssm.get(path)
+        assert ssm.invalidate_deprecated(["ami-does-not-match"]) == 0
+        assert len(ssm.cached()) == 1
+
+
+class TestNodeClassHashController:
+    def test_restamps_old_version(self):
+        op = Operator()
+        nc = EC2NodeClass("nc1")
+        op.kube.create(nc)
+        from karpenter_provider_aws_tpu.apis.objects import (NodeClaim,
+                                                             NodeClassRef)
+        from karpenter_provider_aws_tpu.apis.requirements import Requirements
+        claim = NodeClaim("c1", requirements=Requirements(),
+                          node_class_ref=NodeClassRef("nc1"))
+        claim.metadata.annotations[L.EC2NODECLASS_HASH_ANNOTATION] = "stale"
+        claim.metadata.annotations[
+            L.EC2NODECLASS_HASH_VERSION_ANNOTATION] = "v3"
+        op.kube.create(claim)
+        assert NodeClassHashController(op.kube).reconcile() == 1
+        got = op.kube.get("NodeClaim", "c1")
+        ann = got.metadata.annotations
+        assert ann[L.EC2NODECLASS_HASH_ANNOTATION] == nc.hash()
+        assert ann[L.EC2NODECLASS_HASH_VERSION_ANNOTATION] == \
+            L.EC2NODECLASS_HASH_VERSION
+        # second pass is a no-op
+        assert NodeClassHashController(op.kube).reconcile() == 0
+
+    def test_current_version_untouched(self):
+        op = Operator()
+        nc = EC2NodeClass("nc2")
+        op.kube.create(nc)
+        from karpenter_provider_aws_tpu.apis.objects import (NodeClaim,
+                                                             NodeClassRef)
+        from karpenter_provider_aws_tpu.apis.requirements import Requirements
+        claim = NodeClaim("c2", requirements=Requirements(),
+                          node_class_ref=NodeClassRef("nc2"))
+        claim.metadata.annotations[L.EC2NODECLASS_HASH_ANNOTATION] = "keep"
+        claim.metadata.annotations[L.EC2NODECLASS_HASH_VERSION_ANNOTATION] = \
+            L.EC2NODECLASS_HASH_VERSION
+        op.kube.create(claim)
+        assert NodeClassHashController(op.kube).reconcile() == 0
+        assert op.kube.get("NodeClaim", "c2").metadata.annotations[
+            L.EC2NODECLASS_HASH_ANNOTATION] == "keep"
+
+
+class TestDiscoveredCapacity:
+    def test_real_node_memory_feeds_catalog(self):
+        from karpenter_provider_aws_tpu.apis.objects import (NodeClassRef,
+                                                             NodePool,
+                                                             NodePoolTemplate)
+        from karpenter_provider_aws_tpu.apis.requirements import Requirements
+        op = Operator()
+        op.kube.create(EC2NodeClass("dc-class"))
+        op.kube.create(NodePool("dc-pool", template=NodePoolTemplate(
+            node_class_ref=NodeClassRef("dc-class"),
+            requirements=Requirements())))
+        env_pods = make_pods(3, cpu="1", memory="2Gi", prefix="dc")
+        for p in env_pods:
+            op.kube.create(p)
+        op.run_until_settled(disrupt=False)
+        nodes = op.kube.list("Node")
+        assert nodes, "expected a provisioned node"
+        # the operator's step() already drove the controller once
+        node = nodes[0]
+        itype = node.metadata.labels[L.INSTANCE_TYPE]
+        claim = op.kube.get("NodeClaim", node.name)
+        key = (itype, claim.image_id)
+        assert op.instance_types._discovered_memory[key] == \
+            node.capacity["memory"]
+        # idempotent per node
+        assert op.discovered_capacity.reconcile() == 0
+
+
+class TestSSMInvalidationController:
+    def test_interval_gating_and_force(self):
+        clk = [0.0]
+        ec2 = FakeEC2()
+        from karpenter_provider_aws_tpu.providers.amifamily import AMIProvider
+        ami = AMIProvider(ec2)
+        ssm = SSMProvider(ec2)
+        c = SSMInvalidationController(ec2, ami, ssm=ssm,
+                                      clock=lambda: clk[0])
+        assert c.reconcile() == 0  # nothing cached yet; stamps _last
+        path = "/aws/service/al2023/amd64/latest/image_id"
+        val = ssm.get(path)
+        for img in ec2.images.values():
+            if img.id == val:
+                img.deprecated = True
+        assert c.reconcile() == 0          # interval not elapsed
+        clk[0] += 31 * 60
+        assert c.reconcile() >= 1          # evicted the poisoned entry
+
+
+class TestVersionController:
+    def test_validated_update(self):
+        vp = VersionProvider("1.30")
+        src = ["1.31.4"]
+        clk = [0.0]
+        c = VersionController(vp, source=lambda: src[0],
+                              clock=lambda: clk[0])
+        assert c.reconcile(force=True) is True
+        assert vp.get() == "1.31"
+        src[0] = "1.99.0"
+        with pytest.raises(ValueError):
+            c.reconcile(force=True)
