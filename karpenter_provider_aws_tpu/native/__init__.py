@@ -1,0 +1,2 @@
+from .codec import (arena_pack, arena_unpack, native_available,  # noqa: F401
+                    pack_bits, unpack_bits)

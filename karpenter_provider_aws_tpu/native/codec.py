@@ -1,0 +1,267 @@
+"""ctypes binding for the C++ arena codec (native/codec.cpp), with a pure
+Python twin used when the shared library hasn't been built.
+
+The arena is the sidecar wire format: named, 64-byte-aligned array
+sections in one contiguous buffer, FNV-1a checksummed. ``arena_unpack``
+returns ZERO-COPY numpy views into the source buffer.
+
+Build the native library with ``make -C native`` (the wrapper also
+attempts one silent build on first import when g++ is available).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+import subprocess
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+_MAGIC = 0x314E524150524B41
+_ALIGN = 64
+_DTYPES = {np.dtype(np.int64): 0, np.dtype(np.uint8): 1,
+           np.dtype(bool): 1, np.dtype(np.int32): 2,
+           np.dtype(np.float64): 3}
+_DTYPE_NP = {0: np.dtype(np.int64), 1: np.dtype(np.uint8),
+             2: np.dtype(np.int32), 3: np.dtype(np.float64)}
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_SO_PATH = os.path.join(_REPO_ROOT, "native", "libkarpcodec.so")
+
+
+def _load() -> "ctypes.CDLL | None":
+    if not os.path.exists(_SO_PATH):
+        src_dir = os.path.join(_REPO_ROOT, "native")
+        if os.path.exists(os.path.join(src_dir, "codec.cpp")):
+            try:
+                subprocess.run(["make", "-C", src_dir], check=True,
+                               capture_output=True, timeout=60)
+            except Exception:
+                return None
+    try:
+        lib = ctypes.CDLL(_SO_PATH)
+    except OSError:
+        return None
+    lib.karp_arena_size.restype = ctypes.c_uint64
+    lib.karp_arena_pack.restype = ctypes.c_uint64
+    lib.karp_arena_parse.restype = ctypes.c_int64
+    lib.karp_checksum.restype = ctypes.c_uint64
+    return lib
+
+
+_LIB = _load()
+
+
+def native_available() -> bool:
+    return _LIB is not None
+
+
+def _align_up(x: int) -> int:
+    return (x + _ALIGN - 1) & ~(_ALIGN - 1)
+
+
+# ---------------------------------------------------------------------------
+# pack
+# ---------------------------------------------------------------------------
+
+def arena_pack(arrays: Dict[str, np.ndarray]) -> bytes:
+    """Named arrays -> one contiguous arena buffer."""
+    items: List[Tuple[str, np.ndarray]] = []
+    for name, a in arrays.items():
+        a = np.ascontiguousarray(a)
+        if a.dtype == bool:
+            a = a.view(np.uint8)
+        if a.dtype not in _DTYPES:
+            raise TypeError(f"unsupported dtype {a.dtype} for {name!r}")
+        items.append((name, a))
+    if _LIB is not None:
+        return _arena_pack_native(items)
+    return _arena_pack_py(items)
+
+
+def _arena_pack_native(items) -> bytes:
+    n = len(items)
+    names = (ctypes.c_char_p * n)(*[nm.encode() for nm, _ in items])
+    name_lens = (ctypes.c_uint32 * n)(*[len(nm.encode())
+                                        for nm, _ in items])
+    dtypes = (ctypes.c_uint32 * n)(*[_DTYPES[a.dtype] for _, a in items])
+    ndims = (ctypes.c_uint32 * n)(*[a.ndim for _, a in items])
+    shapes_flat: List[int] = []
+    for _, a in items:
+        shapes_flat.extend(a.shape)
+    shapes = (ctypes.c_uint64 * max(1, len(shapes_flat)))(*shapes_flat)
+    payloads = (ctypes.c_void_p * n)(
+        *[a.ctypes.data_as(ctypes.c_void_p).value for _, a in items])
+    size = _LIB.karp_arena_size(name_lens, dtypes, ndims, shapes, n)
+    buf = ctypes.create_string_buffer(size)
+    written = _LIB.karp_arena_pack(
+        ctypes.cast(names, ctypes.POINTER(ctypes.c_char_p)), name_lens,
+        dtypes, ndims, shapes,
+        ctypes.cast(payloads, ctypes.POINTER(ctypes.c_void_p)),
+        n, ctypes.cast(buf, ctypes.POINTER(ctypes.c_uint8)), size)
+    if written == 0:
+        raise RuntimeError("arena pack overflow")
+    return buf.raw[:written]
+
+
+def _fnv1a(data: bytes) -> int:
+    h = 1469598103934665603
+    for b in data:
+        h = ((h ^ b) * 1099511628211) & 0xFFFFFFFFFFFFFFFF
+    return h
+
+
+def _arena_pack_py(items) -> bytes:
+    head = struct.pack("<QII", _MAGIC, len(items), 0)
+    # first pass: header size
+    hsz = len(head) - 0
+    for nm, a in items:
+        nb = nm.encode()
+        hsz += 4 + len(nb) + 4 + 4 + 8 * a.ndim + 8 + 8
+    hsz = _align_up(hsz)
+    parts = [struct.pack("<QII", _MAGIC, len(items), hsz)]
+    off = hsz
+    payload_spans = []
+    for nm, a in items:
+        nb = nm.encode()
+        off = _align_up(off)
+        nbytes = a.nbytes
+        parts.append(struct.pack("<I", len(nb)) + nb
+                     + struct.pack("<II", _DTYPES[a.dtype], a.ndim)
+                     + b"".join(struct.pack("<Q", s) for s in a.shape)
+                     + struct.pack("<QQ", off, nbytes))
+        payload_spans.append((off, a))
+        off += nbytes
+    header = b"".join(parts)
+    body = bytearray(_align_up(off))
+    body[:len(header)] = header
+    for o, a in payload_spans:
+        body[o:o + a.nbytes] = a.tobytes()
+    csum = _fnv1a(bytes(body))
+    return bytes(body) + struct.pack("<Q", csum)
+
+
+# ---------------------------------------------------------------------------
+# unpack
+# ---------------------------------------------------------------------------
+
+def arena_unpack(buf: bytes) -> Dict[str, np.ndarray]:
+    """Arena buffer -> {name: zero-copy numpy view}."""
+    if _LIB is not None:
+        return _arena_unpack_native(buf)
+    return _arena_unpack_py(buf)
+
+
+_MAX_ARRAYS = 128
+_MAX_SHAPE_SLOTS = 512
+
+
+def _arena_unpack_native(buf: bytes) -> Dict[str, np.ndarray]:
+    src = np.frombuffer(buf, dtype=np.uint8)
+    names_buf = ctypes.create_string_buffer(_MAX_ARRAYS * 256)
+    name_lens = (ctypes.c_uint32 * _MAX_ARRAYS)()
+    dtypes = (ctypes.c_uint32 * _MAX_ARRAYS)()
+    ndims = (ctypes.c_uint32 * _MAX_ARRAYS)()
+    shapes = (ctypes.c_uint64 * _MAX_SHAPE_SLOTS)()
+    offsets = (ctypes.c_uint64 * _MAX_ARRAYS)()
+    nbytes = (ctypes.c_uint64 * _MAX_ARRAYS)()
+    n = _LIB.karp_arena_parse(
+        src.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), len(buf),
+        names_buf, name_lens, dtypes, ndims, shapes, offsets, nbytes,
+        _MAX_ARRAYS, _MAX_SHAPE_SLOTS)
+    if n == -1:
+        raise ValueError("bad arena magic")
+    if n == -2:
+        raise ValueError("arena checksum mismatch")
+    if n < 0:
+        raise ValueError(f"arena parse error {n}")
+    out: Dict[str, np.ndarray] = {}
+    si = 0
+    for i in range(n):
+        name = names_buf.raw[i * 256:i * 256 + name_lens[i]].decode()
+        shape = tuple(shapes[si:si + ndims[i]])
+        si += ndims[i]
+        dt = _DTYPE_NP.get(dtypes[i])
+        if dt is None:
+            raise ValueError(f"arena: unknown dtype {dtypes[i]}")
+        try:
+            view = np.frombuffer(buf, dtype=dt,
+                                 count=(nbytes[i] // dt.itemsize),
+                                 offset=offsets[i]).reshape(shape)
+        except ValueError as e:
+            raise ValueError(f"arena: malformed array {name!r}: {e}") from None
+        out[name] = view
+    return out
+
+
+def _arena_unpack_py(buf: bytes) -> Dict[str, np.ndarray]:
+    magic, n, _hsz = struct.unpack_from("<QII", buf, 0)
+    if magic != _MAGIC:
+        raise ValueError("bad arena magic")
+    csum = struct.unpack_from("<Q", buf, len(buf) - 8)[0]
+    if _fnv1a(buf[:-8]) != csum:
+        raise ValueError("arena checksum mismatch")
+    r = 16
+    out: Dict[str, np.ndarray] = {}
+    for _ in range(n):
+        nl = struct.unpack_from("<I", buf, r)[0]
+        r += 4
+        name = buf[r:r + nl].decode()
+        r += nl
+        dt, nd = struct.unpack_from("<II", buf, r)
+        r += 8
+        shape = struct.unpack_from(f"<{nd}Q", buf, r) if nd else ()
+        r += 8 * nd
+        off, nbytes = struct.unpack_from("<QQ", buf, r)
+        r += 16
+        dtype = _DTYPE_NP.get(dt)
+        if dtype is None:
+            raise ValueError(f"arena: unknown dtype {dt}")
+        try:
+            out[name] = np.frombuffer(buf, dtype=dtype,
+                                      count=nbytes // dtype.itemsize,
+                                      offset=off).reshape(shape)
+        except ValueError as e:
+            raise ValueError(f"arena: malformed array {name!r}: {e}") from None
+    return out
+
+
+# ---------------------------------------------------------------------------
+# bitpack (the single-buffer device path's host side)
+# ---------------------------------------------------------------------------
+
+def pack_bits(bits: np.ndarray) -> np.ndarray:
+    """flat bool -> little-endian uint64 words viewed as int64."""
+    # force bool: the native path reads raw bytes, so a wider input dtype
+    # would be reinterpreted instead of cast
+    bits = np.ascontiguousarray(np.asarray(bits).reshape(-1), dtype=bool)
+    nbits = bits.size
+    nw = (nbits + 63) // 64
+    if _LIB is not None:
+        words = np.zeros(nw, dtype=np.uint64)
+        _LIB.karp_pack_bits(
+            bits.view(np.uint8).ctypes.data_as(
+                ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.c_uint64(nbits),
+            words.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)))
+        return words.view(np.int64)
+    padded = np.zeros(nw * 64, dtype=bool)
+    padded[:nbits] = bits
+    return np.packbits(padded, bitorder="little").view(np.int64)
+
+
+def unpack_bits(words: np.ndarray, nbits: int) -> np.ndarray:
+    words = np.ascontiguousarray(words)
+    if _LIB is not None:
+        bits = np.zeros(nbits, dtype=np.uint8)
+        _LIB.karp_unpack_bits(
+            words.view(np.uint64).ctypes.data_as(
+                ctypes.POINTER(ctypes.c_uint64)),
+            ctypes.c_uint64(nbits),
+            bits.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+        return bits.astype(bool)
+    return np.unpackbits(words.view(np.uint8),
+                         bitorder="little")[:nbits].astype(bool)

@@ -237,34 +237,11 @@ def _pool_budget_jax(limit: jax.Array, used: jax.Array, R: jax.Array) -> jax.Arr
 # sides; ``_split`` is the only buffer walker.
 # ---------------------------------------------------------------------------
 
-def _in_layout_i64(T, D, Z, C, G, E, P):
-    """(name, shape) of every int64 input, in buffer order."""
-    return [("A", (T, D)), ("R", (G, D)), ("n", (G,)),
-            ("daemon", (G, P, D)), ("pool_limit", (P, D)),
-            ("pool_used0", (P, D)), ("ex_alloc", (E, D)),
-            ("ex_used0", (E, D))]
-
-
-def _in_layout_bool(T, D, Z, C, G, E, P):
-    return [("avail_zc", (T, Z * C)), ("F", (G, T)), ("agz", (G, Z)),
-            ("agc", (G, C)), ("admit", (G, P)),
-            ("pool_types", (P, T)), ("pool_agz", (P, Z)),
-            ("pool_agc", (P, C)), ("ex_compat", (G, E))]
-
-
-def _split(buf, layout) -> dict:
-    """Walk a flat buffer by a (name, shape) layout list. Works on both
-    numpy and jax arrays; the ONLY buffer walker — host pack and device
-    unpack share it so the layouts can never drift apart."""
-    vals = {}
-    off = 0
-    for nm, shp in layout:
-        sz = 1
-        for s in shp:
-            sz *= s
-        vals[nm] = buf[off:off + sz].reshape(shp)
-        off += sz
-    return vals
+from .hostpack import (in_layout_bool as _in_layout_bool,  # noqa: E402
+                       in_layout_i64 as _in_layout_i64,
+                       layout_sizes as _layout_sizes,
+                       nwords as _nwords, out_layout, pack_inputs1,
+                       split as _split, unpack_outputs1)
 
 
 def _unpack_inputs(buf_i64: jax.Array, buf_bool: jax.Array,
@@ -274,45 +251,17 @@ def _unpack_inputs(buf_i64: jax.Array, buf_bool: jax.Array,
     return KernelInputs(**vals)
 
 
-def out_layout(T, D, Z, C, G, E, P, n_max):
-    """((i64 name, shape)…), ((bool name, shape)…) of the packed outputs."""
-    N = E + n_max
-    i64 = [("takes", (G, N)), ("leftover", (G,)), ("used", (N, D)),
-           ("pool", (N,)), ("num_nodes", (1,)), ("pool_used", (P, D))]
-    bl = [("types", (N, T)), ("zones", (N, Z)), ("ct", (N, C)),
-          ("alive", (N,))]
-    return i64, bl
-
-
 # ---------------------------------------------------------------------------
 # Single-buffer path. Each device round trip costs ~30-65ms of tunnel
 # latency regardless of payload, and enqueues pipeline without acks — so
 # the optimal shape is ONE int64 h2d buffer (bools bitpacked into words),
 # an async dispatch, and ONE synchronous d2h fetch that rides the same
 # wait as the execution. Bit packing is little-endian on both sides
-# (host: np.packbits(bitorder='little'); device: arithmetic shifts), so
-# no memory-layout assumptions cross the wire.
+# (host: native codec / np.packbits(bitorder='little'); device:
+# arithmetic shifts), so no memory-layout assumptions cross the wire.
+# The host half lives in ops/hostpack.py (numpy-only, jax-free) so the
+# sidecar's control-plane side never imports jax.
 # ---------------------------------------------------------------------------
-
-def _nwords(nbits: int) -> int:
-    return (nbits + 63) // 64
-
-
-def pack_bits_host(bits) -> "np.ndarray":
-    """Host: flat bool array -> uint64 words viewed as int64."""
-    import numpy as np
-    nb = bits.size
-    padded = np.zeros(_nwords(nb) * 64, dtype=bool)
-    padded[:nb] = bits.reshape(-1)
-    return np.packbits(padded, bitorder="little").view(np.int64)
-
-
-def unpack_bits_host(words, nbits: int) -> "np.ndarray":
-    """Host: int64 words -> flat bool array of length nbits."""
-    import numpy as np
-    return np.unpackbits(words.view(np.uint8),
-                         bitorder="little")[:nbits].astype(bool)
-
 
 def _bits_to_words(bits: jax.Array) -> jax.Array:
     """Device: flat bool [n*64] -> int64 words via arithmetic packing."""
@@ -328,26 +277,6 @@ def _words_to_bits(words: jax.Array, nbits: int) -> jax.Array:
     shifts = jnp.arange(64, dtype=jnp.uint64)
     bits = jnp.right_shift(w[:, None], shifts[None, :]) & jnp.uint64(1)
     return bits.reshape(-1)[:nbits].astype(bool)
-
-
-def _layout_sizes(layout):
-    total = 0
-    for _, shp in layout:
-        sz = 1
-        for s in shp:
-            sz *= s
-        total += sz
-    return total
-
-
-def pack_inputs1(arrays: dict, T, D, Z, C, G, E, P):
-    """Host: all inputs -> ONE int64 buffer [i64 fields | bitpacked bools]."""
-    import numpy as np
-    i64 = np.concatenate([arrays[nm].reshape(-1).astype(np.int64)
-                          for nm, _ in _in_layout_i64(T, D, Z, C, G, E, P)])
-    bl = np.concatenate([arrays[nm].reshape(-1).astype(bool)
-                         for nm, _ in _in_layout_bool(T, D, Z, C, G, E, P)])
-    return np.concatenate([i64, pack_bits_host(bl)])
 
 
 @partial(jax.jit, static_argnames=("T", "D", "Z", "C", "G", "E", "P", "n_max"))
@@ -372,15 +301,3 @@ def solve_scan_packed1(buf: jax.Array, *, T: int, D: int, Z: int, C: int,
     out_words = _bits_to_words(jnp.concatenate(
         [out_bool, jnp.zeros(pad, bool)]))
     return jnp.concatenate([out_i64, out_words])
-
-
-def unpack_outputs1(buf, T, D, Z, C, G, E, P, n_max) -> dict:
-    """Host: the single fetched buffer -> dict of arrays."""
-    import numpy as np
-    li, lb = out_layout(T, D, Z, C, G, E, P, n_max)
-    n_i64 = _layout_sizes(li)
-    n_bits = _layout_sizes(lb)
-    bool_flat = unpack_bits_host(np.ascontiguousarray(buf[n_i64:]), n_bits)
-    vals = _split(buf[:n_i64], li)
-    vals.update(_split(bool_flat, lb))
-    return vals

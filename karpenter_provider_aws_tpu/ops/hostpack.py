@@ -1,0 +1,89 @@
+"""Host-side (numpy-only, jax-free) packing for the single-buffer solve.
+
+The layout lists here are the single source of truth for BOTH sides of
+the device boundary: the host packs kernel inputs into ONE int64 buffer
+(bools bitpacked little-endian via the native codec) and unpacks the ONE
+int64 output buffer; ops/ffd_jax.py walks the same layouts on device.
+Living apart from ffd_jax keeps the control-plane side of the sidecar
+(sidecar/client.py) free of any jax import — dispatch rides the wire.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..native import pack_bits, unpack_bits
+
+
+def in_layout_i64(T, D, Z, C, G, E, P):
+    """(name, shape) of every int64 input, in buffer order."""
+    return [("A", (T, D)), ("R", (G, D)), ("n", (G,)),
+            ("daemon", (G, P, D)), ("pool_limit", (P, D)),
+            ("pool_used0", (P, D)), ("ex_alloc", (E, D)),
+            ("ex_used0", (E, D))]
+
+
+def in_layout_bool(T, D, Z, C, G, E, P):
+    return [("avail_zc", (T, Z * C)), ("F", (G, T)), ("agz", (G, Z)),
+            ("agc", (G, C)), ("admit", (G, P)),
+            ("pool_types", (P, T)), ("pool_agz", (P, Z)),
+            ("pool_agc", (P, C)), ("ex_compat", (G, E))]
+
+
+def out_layout(T, D, Z, C, G, E, P, n_max):
+    """((i64 name, shape)…), ((bool name, shape)…) of the packed outputs."""
+    N = E + n_max
+    i64 = [("takes", (G, N)), ("leftover", (G,)), ("used", (N, D)),
+           ("pool", (N,)), ("num_nodes", (1,)), ("pool_used", (P, D))]
+    bl = [("types", (N, T)), ("zones", (N, Z)), ("ct", (N, C)),
+          ("alive", (N,))]
+    return i64, bl
+
+
+def split(buf, layout) -> dict:
+    """Walk a flat buffer by a (name, shape) layout list. Works on both
+    numpy and jax arrays; the ONLY buffer walker — host pack and device
+    unpack share it so the layouts can never drift apart."""
+    vals = {}
+    off = 0
+    for nm, shp in layout:
+        sz = 1
+        for s in shp:
+            sz *= s
+        vals[nm] = buf[off:off + sz].reshape(shp)
+        off += sz
+    return vals
+
+
+def layout_sizes(layout) -> int:
+    total = 0
+    for _, shp in layout:
+        sz = 1
+        for s in shp:
+            sz *= s
+        total += sz
+    return total
+
+
+def nwords(nbits: int) -> int:
+    return (nbits + 63) // 64
+
+
+def pack_inputs1(arrays: dict, T, D, Z, C, G, E, P) -> np.ndarray:
+    """Host: all inputs -> ONE int64 buffer [i64 fields | bitpacked bools]."""
+    i64 = np.concatenate([arrays[nm].reshape(-1).astype(np.int64)
+                          for nm, _ in in_layout_i64(T, D, Z, C, G, E, P)])
+    bl = np.concatenate([arrays[nm].reshape(-1).astype(bool)
+                         for nm, _ in in_layout_bool(T, D, Z, C, G, E, P)])
+    return np.concatenate([i64, pack_bits(bl)])
+
+
+def unpack_outputs1(buf, T, D, Z, C, G, E, P, n_max) -> dict:
+    """Host: the single fetched buffer -> dict of arrays."""
+    li, lb = out_layout(T, D, Z, C, G, E, P, n_max)
+    n_i64 = layout_sizes(li)
+    n_bits = layout_sizes(lb)
+    bool_flat = unpack_bits(np.ascontiguousarray(buf[n_i64:]), n_bits)
+    vals = split(buf[:n_i64], li)
+    vals.update(split(bool_flat, lb))
+    return vals

@@ -1,0 +1,2 @@
+from .client import RemoteSolver, SolverClient  # noqa: F401
+from .server import SolverServer, serve  # noqa: F401

@@ -1,0 +1,65 @@
+"""Control-plane side of the solver sidecar.
+
+``SolverClient`` speaks the raw-bytes gRPC methods; ``RemoteSolver`` is a
+drop-in :class:`solver.types.Solver` whose device dispatch rides the wire
+(everything else — requirements compilation, canonical ordering, decode —
+is identical to the local TPU solver, so decisions are identical by
+construction). Topology-constrained snapshots run the host pour locally,
+exactly as TPUSolver does.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..native import arena_pack, arena_unpack
+from ..solver.tpu import TPUSolver
+
+_SOLVE = "/karpenter.solver.v1.Solver/Solve"
+_INFO = "/karpenter.solver.v1.Solver/Info"
+
+
+class SolverClient:
+    def __init__(self, address: str, timeout: float = 30.0):
+        import grpc
+        self.address = address
+        self.timeout = timeout
+        self._channel = grpc.insecure_channel(
+            address,
+            options=[("grpc.max_receive_message_length", 256 * 1024 * 1024),
+                     ("grpc.max_send_message_length", 256 * 1024 * 1024)])
+        self._solve = self._channel.unary_unary(_SOLVE)
+        self._info = self._channel.unary_unary(_INFO)
+
+    def solve_buffer(self, buf: np.ndarray, statics: Dict[str, int]) -> np.ndarray:
+        req = arena_pack({
+            "buf": np.ascontiguousarray(buf, dtype=np.int64),
+            "statics": np.array([statics[k] for k in
+                                 ("T", "D", "Z", "C", "G", "E", "P",
+                                  "n_max")], dtype=np.int64),
+        })
+        resp = self._solve(req, timeout=self.timeout)
+        return np.array(arena_unpack(resp)["out"])  # own the memory
+
+    def info(self) -> Dict[str, int]:
+        out = arena_unpack(self._info(b"", timeout=self.timeout))
+        return {k: int(v[0]) for k, v in out.items()}
+
+    def close(self) -> None:
+        self._channel.close()
+
+
+class RemoteSolver(TPUSolver):
+    """TPUSolver whose packed-buffer dispatch is a sidecar round trip."""
+
+    name = "tpu-sidecar"
+
+    def __init__(self, address: str, n_max: int = 2048,
+                 client: Optional[SolverClient] = None):
+        super().__init__(backend="jax", n_max=n_max)
+        self.client = client or SolverClient(address)
+
+    def _dispatch(self, buf: np.ndarray, **statics) -> np.ndarray:
+        return self.client.solve_buffer(buf, statics)

@@ -1,0 +1,109 @@
+"""The JAX solver sidecar: a gRPC service the (Go-shaped) control plane
+calls with one constraint-tensor arena per solve.
+
+North star (BASELINE.json): "both provisioning bin-packing and
+consolidation's multi-node replacement search run as batched jit'd
+kernels called from Go via a gRPC sidecar under pkg/operator". The
+service is stateless per request (SURVEY §2.9) — all solve state rides
+the request arena; the only cross-request state is the XLA compilation
+cache, which stays warm across solves of the same shape class exactly
+like the reference's instance-type cache discipline
+(instancetype.go:119-130).
+
+Wire: raw-bytes gRPC methods (no generated stubs — the arena IS the
+schema; native/codec.cpp packs/parses it on both sides):
+
+- /karpenter.solver.v1.Solver/Solve
+    request  arena: {"buf": int64[...] packed kernel inputs,
+                     "statics": int64[8] = T D Z C G E P n_max}
+    response arena: {"out": int64[...] packed kernel outputs}
+- /karpenter.solver.v1.Solver/Info
+    response arena: {"devices": int64[1], "x64": int64[1]}
+"""
+
+from __future__ import annotations
+
+import logging
+from concurrent import futures
+from typing import Optional
+
+import numpy as np
+
+from ..native import arena_pack, arena_unpack
+
+log = logging.getLogger(__name__)
+
+_SOLVE = "/karpenter.solver.v1.Solver/Solve"
+_INFO = "/karpenter.solver.v1.Solver/Info"
+
+
+class _Handler:
+    """Method implementations (bytes in, bytes out)."""
+
+    def solve(self, request: bytes, context) -> bytes:
+        import jax.numpy as jnp
+
+        from ..ops.ffd_jax import solve_scan_packed1
+        arrays = arena_unpack(request)
+        buf = arrays["buf"]
+        T, D, Z, C, G, E, P, n_max = (int(x) for x in arrays["statics"])
+        o_buf = solve_scan_packed1(jnp.asarray(buf), T=T, D=D, Z=Z, C=C,
+                                   G=G, E=E, P=P, n_max=n_max)
+        return arena_pack({"out": np.asarray(o_buf)})
+
+    def info(self, request: bytes, context) -> bytes:
+        import jax
+        return arena_pack({
+            "devices": np.array([len(jax.devices())], dtype=np.int64),
+            "x64": np.array([1], dtype=np.int64),
+        })
+
+
+def _generic_handler(handler: _Handler):
+    import grpc
+
+    class Svc(grpc.GenericRpcHandler):
+        def service(self, call_details):
+            if call_details.method == _SOLVE:
+                return grpc.unary_unary_rpc_method_handler(handler.solve)
+            if call_details.method == _INFO:
+                return grpc.unary_unary_rpc_method_handler(handler.info)
+            return None
+
+    return Svc()
+
+
+class SolverServer:
+    """Owns the grpc.Server; bind with port=0 for an ephemeral port."""
+
+    def __init__(self, address: str = "127.0.0.1", port: int = 0,
+                 max_workers: int = 4):
+        import grpc
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=max_workers),
+            options=[("grpc.max_receive_message_length", 256 * 1024 * 1024),
+                     ("grpc.max_send_message_length", 256 * 1024 * 1024)])
+        self._server.add_generic_rpc_handlers((_generic_handler(_Handler()),))
+        self.port = self._server.add_insecure_port(f"{address}:{port}")
+        self.address = f"{address}:{self.port}"
+
+    def start(self) -> "SolverServer":
+        self._server.start()
+        log.info("solver sidecar listening on %s", self.address)
+        return self
+
+    def stop(self, grace: Optional[float] = 0.5) -> None:
+        self._server.stop(grace)
+
+
+def serve(address: str = "0.0.0.0", port: int = 50151) -> SolverServer:
+    """Production entry: start and return the sidecar server."""
+    return SolverServer(address, port).start()
+
+
+if __name__ == "__main__":  # pragma: no cover
+    import time
+    logging.basicConfig(level=logging.INFO)
+    s = serve()
+    while True:
+        time.sleep(3600)

@@ -5,11 +5,13 @@ Pipeline: ``encode_snapshot`` (models/encoding.py) → group-scan kernel
 ``SolveResult``. Decisions are identical to the CPU oracle
 (tests/test_solver_equivalence.py enforces fingerprint equality).
 
-Topology-constrained snapshots (spread / pod-affinity) currently fall back
-to the CPU oracle — the tensorized topology path (per-domain subgrouping)
-is the next milestone; the no-topology path covers BASELINE configs 1, 2
-and 5 (homogeneous FFD, mixed selectors/taints over the full catalog,
-spot/on-demand with weights & limits).
+Coverage of the BASELINE configs: 1/2/5 (homogeneous FFD, mixed
+selectors/taints over the full catalog, spot/on-demand with weights &
+limits) run the packed single-buffer device scan; config 3 (topology
+spread + pod (anti-)affinity) runs the exact tensor pour of ops/topo.py
+on host state; unsupported topology shapes (non-zone/hostname keys,
+zone-id mixed with topology) fall back to the CPU oracle. Device dispatch
+is a hook (``_dispatch``) so the sidecar's RemoteSolver can ride gRPC.
 """
 
 from __future__ import annotations
@@ -156,11 +158,19 @@ class TPUSolver(Solver):
                      zfix=(ts.zfix if ts is not None else None))
         return takes, leftover, final
 
-    def _run_jax(self, enc, ex_alloc, ex_used, ex_compat):
+    def _dispatch(self, buf: np.ndarray, **statics) -> np.ndarray:
+        """Run the packed solve buffer on the local device. The sidecar's
+        RemoteSolver overrides this with a gRPC round trip — the solve
+        itself is one buffer each way either way."""
         import jax.numpy as jnp
 
-        from ..ops.ffd_jax import (pack_inputs1, solve_scan_packed1,
-                                   unpack_outputs1)
+        from ..ops.ffd_jax import solve_scan_packed1
+        d_buf = jnp.asarray(buf)  # async enqueue; no sync before dispatch
+        # np.asarray is the only sync: it waits for exec + fetch at once
+        return np.asarray(solve_scan_packed1(d_buf, **statics))
+
+    def _run_jax(self, enc, ex_alloc, ex_used, ex_compat):
+        from ..ops.hostpack import pack_inputs1, unpack_outputs1
         T, D = enc.A.shape
         Z, C = len(enc.zones), enc.avail.shape[2]
         P = len(enc.pools)
@@ -219,7 +229,6 @@ class TPUSolver(Solver):
                       ex_compat=ex_compat_p)
 
         buf = pack_inputs1(arrays, T, Dp, Z, C, Gp, Ep, Pp)
-        d_buf = jnp.asarray(buf)  # async enqueue; no sync before dispatch
 
         # --- bucketed new-node slots with overflow retry ------------------
         # Steady state needs far fewer than n_max slots; a small N keeps the
@@ -228,12 +237,9 @@ class TPUSolver(Solver):
         # invariant to N once N is large enough: spare slots never fill).
         n_bucket = self._bucket
         while True:
-            o_buf = solve_scan_packed1(
-                d_buf, T=T, D=Dp, Z=Z, C=C, G=Gp, E=Ep, P=Pp,
-                n_max=n_bucket)
-            # np.asarray is the only sync: it waits for exec + fetch at once
-            out = unpack_outputs1(np.asarray(o_buf),
-                                  T, Dp, Z, C, Gp, Ep, Pp, n_bucket)
+            o_buf = self._dispatch(buf, T=T, D=Dp, Z=Z, C=C, G=Gp, E=Ep,
+                                   P=Pp, n_max=n_bucket)
+            out = unpack_outputs1(o_buf, T, Dp, Z, C, Gp, Ep, Pp, n_bucket)
             exhausted = (out["leftover"].sum() > 0
                          and int(out["num_nodes"][0]) >= n_bucket)
             if not exhausted or n_bucket >= self.n_max:

@@ -1,0 +1,196 @@
+// Arena codec for the solver sidecar wire format.
+//
+// The control plane ships the solver's dense constraint tensors to the JAX
+// sidecar as ONE contiguous arena (named, aligned array sections) so a
+// 50k-pod solve is a single buffer each way — no per-field serialization,
+// and the receiving side reconstructs zero-copy views into the arena
+// (SURVEY §2.9: the native budget goes to the Go<->sidecar serialization
+// of the constraint tensor).
+//
+// Layout (little-endian):
+//   u64 magic            'KARPARN1'
+//   u32 n_arrays
+//   u32 header_nbytes    (offset of the payload area; 64-aligned)
+//   per array:
+//     u32 name_len, u8 name[name_len]
+//     u32 dtype          (0=i64, 1=u8/bool, 2=i32, 3=f64)
+//     u32 ndim, u64 shape[ndim]
+//     u64 payload_offset (from arena start; 64-aligned)
+//     u64 payload_nbytes
+//   payload area: concatenated array bodies, each 64-aligned
+//   trailing u64 FNV-1a checksum of everything before it
+//
+// Build: make -C native   (produces libkarpcodec.so; the Python wrapper
+// falls back to a pure-Python implementation when the library is absent).
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+static const uint64_t MAGIC = 0x314e524150524b41ULL;  // "AKRPARN1" LE bytes
+static const uint64_t ALIGN = 64;
+
+static uint64_t align_up(uint64_t x) { return (x + ALIGN - 1) & ~(ALIGN - 1); }
+
+uint64_t karp_checksum(const uint8_t* p, uint64_t n) {
+    // FNV-1a 64
+    uint64_t h = 1469598103934665603ULL;
+    for (uint64_t i = 0; i < n; i++) {
+        h ^= p[i];
+        h *= 1099511628211ULL;
+    }
+    return h;
+}
+
+static uint64_t dtype_size(uint32_t dt) {
+    switch (dt) {
+        case 0: return 8;   // i64
+        case 1: return 1;   // u8 / bool
+        case 2: return 4;   // i32
+        case 3: return 8;   // f64
+    }
+    return 0;
+}
+
+static uint64_t header_size(const uint32_t* name_lens,
+                            const uint32_t* ndims, uint32_t n) {
+    uint64_t sz = 8 + 4 + 4;  // magic + n_arrays + header_nbytes
+    for (uint32_t i = 0; i < n; i++) {
+        sz += 4 + name_lens[i];       // name
+        sz += 4 + 4;                  // dtype + ndim
+        sz += 8ULL * ndims[i];        // shape
+        sz += 8 + 8;                  // payload offset + nbytes
+    }
+    return align_up(sz);
+}
+
+// Total arena size for the given arrays (call before karp_arena_pack).
+uint64_t karp_arena_size(const uint32_t* name_lens, const uint32_t* dtypes,
+                         const uint32_t* ndims, const uint64_t* shapes_flat,
+                         uint32_t n_arrays) {
+    uint64_t sz = header_size(name_lens, ndims, n_arrays);
+    uint64_t si = 0;
+    for (uint32_t i = 0; i < n_arrays; i++) {
+        uint64_t elems = 1;
+        for (uint32_t d = 0; d < ndims[i]; d++) elems *= shapes_flat[si + d];
+        si += ndims[i];
+        sz = align_up(sz) + elems * dtype_size(dtypes[i]);
+    }
+    return align_up(sz) + 8;  // + checksum
+}
+
+static void put_u32(uint8_t*& w, uint32_t v) { memcpy(w, &v, 4); w += 4; }
+static void put_u64(uint8_t*& w, uint64_t v) { memcpy(w, &v, 8); w += 8; }
+
+// Pack arrays into dst (sized by karp_arena_size). Returns bytes written,
+// or 0 on error.
+uint64_t karp_arena_pack(const char* const* names, const uint32_t* name_lens,
+                         const uint32_t* dtypes, const uint32_t* ndims,
+                         const uint64_t* shapes_flat,
+                         const uint8_t* const* payloads,
+                         uint32_t n_arrays, uint8_t* dst, uint64_t dst_cap) {
+    uint64_t hsz = header_size(name_lens, ndims, n_arrays);
+    uint64_t total = karp_arena_size(name_lens, dtypes, ndims, shapes_flat,
+                                     n_arrays);
+    if (total > dst_cap) return 0;
+    memset(dst, 0, total);
+    uint8_t* w = dst;
+    put_u64(w, MAGIC);
+    put_u32(w, n_arrays);
+    put_u32(w, (uint32_t)hsz);
+    uint64_t off = hsz;
+    uint64_t si = 0;
+    for (uint32_t i = 0; i < n_arrays; i++) {
+        put_u32(w, name_lens[i]);
+        memcpy(w, names[i], name_lens[i]);
+        w += name_lens[i];
+        put_u32(w, dtypes[i]);
+        put_u32(w, ndims[i]);
+        uint64_t elems = 1;
+        for (uint32_t d = 0; d < ndims[i]; d++) {
+            put_u64(w, shapes_flat[si + d]);
+            elems *= shapes_flat[si + d];
+        }
+        si += ndims[i];
+        uint64_t nbytes = elems * dtype_size(dtypes[i]);
+        off = align_up(off);
+        put_u64(w, off);
+        put_u64(w, nbytes);
+        memcpy(dst + off, payloads[i], nbytes);
+        off += nbytes;
+    }
+    off = align_up(off);
+    uint64_t csum = karp_checksum(dst, off);
+    memcpy(dst + off, &csum, 8);
+    return off + 8;
+}
+
+// Parse an arena. Writes per-array metadata into caller-provided buffers
+// (capacity max_arrays; names copied into names_buf, 256 bytes each).
+// Returns n_arrays, or -1 bad magic, -2 checksum mismatch, -3 overflow.
+int64_t karp_arena_parse(const uint8_t* src, uint64_t src_len,
+                         char* names_buf, uint32_t* name_lens,
+                         uint32_t* dtypes, uint32_t* ndims,
+                         uint64_t* shapes_flat, uint64_t* offsets,
+                         uint64_t* nbytes_out, uint32_t max_arrays,
+                         uint32_t max_shape_slots) {
+    if (src_len < 24) return -1;
+    uint64_t magic;
+    memcpy(&magic, src, 8);
+    if (magic != MAGIC) return -1;
+    uint64_t csum_stored, csum;
+    memcpy(&csum_stored, src + src_len - 8, 8);
+    csum = karp_checksum(src, src_len - 8);
+    if (csum != csum_stored) return -2;
+    uint32_t n;
+    memcpy(&n, src + 8, 4);
+    if (n > max_arrays) return -3;
+    const uint8_t* r = src + 16;
+    // the header must end before the checksum; every read below is
+    // bounds-checked against it (a valid checksum proves integrity, not
+    // well-formedness — the sidecar parses untrusted request bytes)
+    const uint8_t* end = src + src_len - 8;
+    uint64_t si = 0;
+    for (uint32_t i = 0; i < n; i++) {
+        uint32_t nl;
+        if (r + 4 > end) return -3;
+        memcpy(&nl, r, 4); r += 4;
+        if (nl > 255 || r + nl > end) return -3;
+        memcpy(names_buf + i * 256, r, nl);
+        names_buf[i * 256 + nl] = 0;
+        name_lens[i] = nl;
+        r += nl;
+        if (r + 8 > end) return -3;
+        memcpy(&dtypes[i], r, 4); r += 4;
+        if (dtype_size(dtypes[i]) == 0) return -3;  // unknown dtype
+        memcpy(&ndims[i], r, 4); r += 4;
+        if (si + ndims[i] > max_shape_slots) return -3;
+        if (r + 8ULL * ndims[i] + 16 > end) return -3;
+        for (uint32_t d = 0; d < ndims[i]; d++) {
+            memcpy(&shapes_flat[si++], r, 8); r += 8;
+        }
+        memcpy(&offsets[i], r, 8); r += 8;
+        memcpy(&nbytes_out[i], r, 8); r += 8;
+        if (offsets[i] > src_len - 8 ||
+            nbytes_out[i] > src_len - 8 - offsets[i]) return -3;
+    }
+    return n;
+}
+
+// Little-endian bitpack: bits[nbits] (0/1 bytes) -> words[ceil(nbits/64)].
+void karp_pack_bits(const uint8_t* bits, uint64_t nbits, uint64_t* words) {
+    uint64_t nw = (nbits + 63) / 64;
+    memset(words, 0, nw * 8);
+    for (uint64_t i = 0; i < nbits; i++) {
+        if (bits[i]) words[i >> 6] |= (1ULL << (i & 63));
+    }
+}
+
+void karp_unpack_bits(const uint64_t* words, uint64_t nbits, uint8_t* bits) {
+    for (uint64_t i = 0; i < nbits; i++) {
+        bits[i] = (words[i >> 6] >> (i & 63)) & 1;
+    }
+}
+
+}  // extern "C"

@@ -188,8 +188,8 @@ class TestPackedBuffers:
     def test_bit_roundtrip_host(self):
         import numpy as np
 
-        from karpenter_provider_aws_tpu.ops.ffd_jax import (pack_bits_host,
-                                                            unpack_bits_host)
+        from karpenter_provider_aws_tpu.native import (
+            pack_bits as pack_bits_host, unpack_bits as unpack_bits_host)
         rng = np.random.RandomState(7)
         for n in (1, 63, 64, 65, 1000, 4096):
             bits = rng.rand(n) < 0.5
@@ -207,13 +207,15 @@ class TestPackedBuffers:
         rng = np.random.RandomState(8)
         n = 777
         bits = rng.rand(n) < 0.5
-        words = ffd_jax.pack_bits_host(bits)
+        from karpenter_provider_aws_tpu.native import pack_bits
+        words = pack_bits(bits)
         dbits = ffd_jax._words_to_bits(jnp.asarray(words), n)
         assert (np.asarray(dbits) == bits).all()
         pad = ffd_jax._nwords(n) * 64 - n
         dwords = ffd_jax._bits_to_words(
             jnp.concatenate([dbits, jnp.zeros(pad, bool)]))
-        assert (ffd_jax.unpack_bits_host(np.asarray(dwords), n) == bits).all()
+        from karpenter_provider_aws_tpu.native import unpack_bits
+        assert (unpack_bits(np.asarray(dwords), n) == bits).all()
 
     def test_bucket_overflow_retry(self, env):
         """A solve needing more new nodes than the current bucket must
