@@ -35,11 +35,23 @@ _SO_PATH = os.path.join(_REPO_ROOT, "native", "libkarpcodec.so")
 def _load() -> "ctypes.CDLL | None":
     if not os.path.exists(_SO_PATH):
         src_dir = os.path.join(_REPO_ROOT, "native")
-        if os.path.exists(os.path.join(src_dir, "codec.cpp")):
+        cpp = os.path.join(src_dir, "codec.cpp")
+        if os.path.exists(cpp):
+            # atomic: compile to a temp name, rename into place — a
+            # concurrent importer either sees the old state (falls back)
+            # or the complete library, never a truncated file
+            tmp = _SO_PATH + f".tmp.{os.getpid()}"
             try:
-                subprocess.run(["make", "-C", src_dir], check=True,
-                               capture_output=True, timeout=60)
+                subprocess.run(
+                    ["g++", "-O3", "-fPIC", "-std=c++17", "-shared",
+                     "-o", tmp, cpp],
+                    check=True, capture_output=True, timeout=60)
+                os.replace(tmp, _SO_PATH)
             except Exception:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
                 return None
     try:
         lib = ctypes.CDLL(_SO_PATH)
@@ -107,11 +119,9 @@ def _arena_pack_native(items) -> bytes:
     return buf.raw[:written]
 
 
-def _fnv1a(data: bytes) -> int:
-    h = 1469598103934665603
-    for b in data:
-        h = ((h ^ b) * 1099511628211) & 0xFFFFFFFFFFFFFFFF
-    return h
+def _crc(data: bytes) -> int:
+    import zlib
+    return zlib.crc32(data) & 0xFFFFFFFF
 
 
 def _arena_pack_py(items) -> bytes:
@@ -140,7 +150,7 @@ def _arena_pack_py(items) -> bytes:
     body[:len(header)] = header
     for o, a in payload_spans:
         body[o:o + a.nbytes] = a.tobytes()
-    csum = _fnv1a(bytes(body))
+    csum = _crc(bytes(body))
     return bytes(body) + struct.pack("<Q", csum)
 
 
@@ -202,7 +212,7 @@ def _arena_unpack_py(buf: bytes) -> Dict[str, np.ndarray]:
     if magic != _MAGIC:
         raise ValueError("bad arena magic")
     csum = struct.unpack_from("<Q", buf, len(buf) - 8)[0]
-    if _fnv1a(buf[:-8]) != csum:
+    if _crc(buf[:-8]) != csum:
         raise ValueError("arena checksum mismatch")
     r = 16
     out: Dict[str, np.ndarray] = {}

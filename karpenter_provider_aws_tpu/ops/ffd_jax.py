@@ -102,8 +102,16 @@ def solve_scan(inp: KernelInputs, n_max: int, E: int, P: int
     return _solve(inp, n_max, E, P)
 
 
-def _solve(inp: KernelInputs, n_max: int, E: int, P: int
+def _solve(inp: KernelInputs, n_max: int, E: int, P: int,
+           axis: "str | None" = None
            ) -> Tuple[jax.Array, jax.Array, Carry]:
+    """The scan. With ``axis`` set, the TYPE dimension of every input is a
+    per-device shard under shard_map over that mesh axis: candidate masks
+    and headrooms are computed on local type shards and the two cross-type
+    max-reductions ride pmax over ICI; the (tiny) node-state carry stays
+    replicated. This is the tensor-parallel split of the solver — the type
+    axis is embarrassingly wide (full EC2 catalog) while the carry is a
+    few KB. See parallel/mesh.py for the mesh wrapper."""
     T, D = inp.A.shape
     Z = inp.agz.shape[1]
     C = inp.agc.shape[1]
@@ -136,6 +144,8 @@ def _solve(inp: KernelInputs, n_max: int, E: int, P: int
 
         # ---- headroom (step 3) ---------------------------------------
         k = _headroom_slots(inp.A, carry.used, R, cand)
+        if axis is not None:
+            k = jax.lax.pmax(k, axis)   # max over type shards
         if E:
             ex_ok = carry.alive[:E] & ex_compat
             k_ex = jnp.where(ex_ok, _headroom_vec(inp.ex_alloc, carry.used[:E], R), 0)
@@ -180,6 +190,8 @@ def _solve(inp: KernelInputs, n_max: int, E: int, P: int
             hr = _headroom_vec(inp.A, daemon[pi][None, :], R)
             hr = jnp.where(cand_new, hr, 0)
             cap = hr.max()
+            if axis is not None:
+                cap = jax.lax.pmax(cap, axis)
             budget = _pool_budget_jax(inp.pool_limit[pi], pool_used[pi], R)
             can_place = jnp.where(
                 admit[pi] & (cap >= 1), jnp.minimum(n_rem, budget), 0)

@@ -1,0 +1,1 @@
+from .mesh import solve_mesh, solve_scan_sharded  # noqa: F401

@@ -37,8 +37,44 @@ _SOLVE = "/karpenter.solver.v1.Solver/Solve"
 _INFO = "/karpenter.solver.v1.Solver/Info"
 
 
+#: bounds on request statics — every distinct tuple compiles a kernel that
+#: is cached for the process lifetime, so the statics space must be small
+#: and sane (an unbounded space would let any peer pin the CPU compiling
+#: and grow the compile cache without limit)
+_STATICS_MAX = dict(T=4096, D=64, Z=64, C=8, G=1 << 17, E=1 << 14,
+                    P=256, n_max=1 << 14)
+_MAX_SHAPE_CLASSES = 64
+
+
 class _Handler:
     """Method implementations (bytes in, bytes out)."""
+
+    def __init__(self):
+        self._shapes_seen: set = set()
+
+    def _validate(self, statics, buf, context) -> Optional[dict]:
+        import grpc
+        kv = dict(zip(("T", "D", "Z", "C", "G", "E", "P", "n_max"),
+                      (int(x) for x in statics)))
+        for k, v in kv.items():
+            if not (0 <= v <= _STATICS_MAX[k]):
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                              f"statics.{k}={v} out of bounds")
+        key = tuple(kv.values())
+        if key not in self._shapes_seen:
+            if len(self._shapes_seen) >= _MAX_SHAPE_CLASSES:
+                context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED,
+                              "too many distinct solve shape classes")
+            self._shapes_seen.add(key)
+        from ..ops.hostpack import (in_layout_bool, in_layout_i64,
+                                    layout_sizes, nwords)
+        dims = {k: kv[k] for k in ("T", "D", "Z", "C", "G", "E", "P")}
+        expect = layout_sizes(in_layout_i64(**dims)) \
+            + nwords(layout_sizes(in_layout_bool(**dims)))
+        if buf.size != expect:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                          f"buf size {buf.size} != layout size {expect}")
+        return kv
 
     def solve(self, request: bytes, context) -> bytes:
         import jax.numpy as jnp
@@ -46,9 +82,8 @@ class _Handler:
         from ..ops.ffd_jax import solve_scan_packed1
         arrays = arena_unpack(request)
         buf = arrays["buf"]
-        T, D, Z, C, G, E, P, n_max = (int(x) for x in arrays["statics"])
-        o_buf = solve_scan_packed1(jnp.asarray(buf), T=T, D=D, Z=Z, C=C,
-                                   G=G, E=E, P=P, n_max=n_max)
+        kv = self._validate(arrays["statics"], buf, context)
+        o_buf = solve_scan_packed1(jnp.asarray(buf), **kv)
         return arena_pack({"out": np.asarray(o_buf)})
 
     def info(self, request: bytes, context) -> bytes:
@@ -96,8 +131,12 @@ class SolverServer:
         self._server.stop(grace)
 
 
-def serve(address: str = "0.0.0.0", port: int = 50151) -> SolverServer:
-    """Production entry: start and return the sidecar server."""
+def serve(address: str = "127.0.0.1", port: int = 50151) -> SolverServer:
+    """Production entry: start and return the sidecar server. Defaults to
+    loopback — the sidecar is a same-pod companion of the control plane;
+    exposing it wider is an explicit operator decision (the channel is
+    insecure gRPC and requests are only shape-validated, not
+    authenticated)."""
     return SolverServer(address, port).start()
 
 

@@ -34,13 +34,24 @@ static const uint64_t ALIGN = 64;
 static uint64_t align_up(uint64_t x) { return (x + ALIGN - 1) & ~(ALIGN - 1); }
 
 uint64_t karp_checksum(const uint8_t* p, uint64_t n) {
-    // FNV-1a 64
-    uint64_t h = 1469598103934665603ULL;
-    for (uint64_t i = 0; i < n; i++) {
-        h ^= p[i];
-        h *= 1099511628211ULL;
+    // CRC-32 (zlib polynomial), stored in the low 32 bits of the u64
+    // trailer slot. Chosen over FNV so the pure-Python twin can verify
+    // at C speed via zlib.crc32 instead of a per-byte Python loop.
+    static uint32_t table[256];
+    static bool init = false;
+    if (!init) {
+        for (uint32_t i = 0; i < 256; i++) {
+            uint32_t c = i;
+            for (int k = 0; k < 8; k++)
+                c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+            table[i] = c;
+        }
+        init = true;
     }
-    return h;
+    uint32_t c = 0xFFFFFFFFu;
+    for (uint64_t i = 0; i < n; i++)
+        c = table[(c ^ p[i]) & 0xFF] ^ (c >> 8);
+    return (uint64_t)(c ^ 0xFFFFFFFFu);
 }
 
 static uint64_t dtype_size(uint32_t dt) {

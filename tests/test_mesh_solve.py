@@ -1,0 +1,72 @@
+"""Type-parallel sharded solve on the virtual 8-device CPU mesh:
+decisions (takes/leftover) and final carry must exactly match the
+single-device kernel."""
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="module")
+def inputs():
+    import jax.numpy as jnp
+
+    from karpenter_provider_aws_tpu.ops.ffd_jax import KernelInputs
+    rng = np.random.RandomState(5)
+    T, D, Z, C, G, E, P = 45, 4, 3, 2, 12, 2, 2
+    A = rng.randint(1, 1 << 16, size=(T, D)).astype(np.int64)
+    inp = KernelInputs(
+        A=jnp.asarray(A),
+        avail_zc=jnp.asarray(rng.rand(T, Z * C) < 0.8),
+        R=jnp.asarray(rng.randint(1, 1 << 8, size=(G, D)).astype(np.int64)),
+        n=jnp.asarray(rng.randint(1, 40, size=(G,)).astype(np.int64)),
+        F=jnp.asarray(rng.rand(G, T) < 0.7),
+        agz=jnp.asarray(np.ones((G, Z), bool)),
+        agc=jnp.asarray(np.ones((G, C), bool)),
+        admit=jnp.asarray(np.ones((G, P), bool)),
+        daemon=jnp.asarray(np.zeros((G, P, D), np.int64)),
+        pool_types=jnp.asarray(rng.rand(P, T) < 0.9),
+        pool_agz=jnp.asarray(np.ones((P, Z), bool)),
+        pool_agc=jnp.asarray(np.ones((P, C), bool)),
+        pool_limit=jnp.asarray(np.full((P, D), -1, np.int64)),
+        pool_used0=jnp.asarray(np.zeros((P, D), np.int64)),
+        ex_alloc=jnp.asarray(
+            rng.randint(1 << 10, 1 << 16, size=(E, D)).astype(np.int64)),
+        ex_used0=jnp.asarray(np.zeros((E, D), np.int64)),
+        ex_compat=jnp.asarray(rng.rand(G, E) < 0.5),
+    )
+    return inp, dict(n_max=64, E=E, P=P)
+
+
+def test_sharded_matches_single_device(inputs):
+    import jax
+
+    from karpenter_provider_aws_tpu.ops.ffd_jax import solve_scan
+    from karpenter_provider_aws_tpu.parallel import (solve_mesh,
+                                                     solve_scan_sharded)
+    inp, statics = inputs
+    assert len(jax.devices()) >= 8, "conftest must force 8 CPU devices"
+    mesh = solve_mesh(8)
+    t1, l1, c1 = solve_scan(inp, **statics)
+    t2, l2, c2 = solve_scan_sharded(inp, mesh=mesh, **statics)
+    assert (np.asarray(t1) == np.asarray(t2)).all()
+    assert (np.asarray(l1) == np.asarray(l2)).all()
+    for name in Carry_fields():
+        a, b = getattr(c1, name), getattr(c2, name)
+        assert (np.asarray(a) == np.asarray(b)).all(), name
+
+
+def Carry_fields():
+    from karpenter_provider_aws_tpu.ops.ffd_jax import Carry
+    return Carry._fields
+
+
+def test_uneven_type_count_pads(inputs):
+    """T=45 is not divisible by 8 — padding must not change any decision."""
+    from karpenter_provider_aws_tpu.parallel import (solve_mesh,
+                                                     solve_scan_sharded)
+    inp, statics = inputs
+    mesh = solve_mesh(8)
+    takes, leftover, carry = solve_scan_sharded(inp, mesh=mesh, **statics)
+    assert carry.types.shape[1] == inp.A.shape[0]  # padding stripped
+    assert int(np.asarray(takes).sum()) + int(np.asarray(leftover).sum()) \
+        == int(np.asarray(inp.n).sum())
