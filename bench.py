@@ -1,7 +1,8 @@
 #!/usr/bin/env python
-"""Headline benchmark: 50k pending pods vs the full instance catalog.
+"""Benchmarks over the five BASELINE.json configs.
 
-Prints ONE JSON line:
+Prints ONE JSON line (the headline config-2 metric; `--all` also runs the
+other four configs and embeds their table under "extra.configs"):
   {"metric": ..., "value": N, "unit": "ms", "vs_baseline": N}
 
 - metric: p99 wall-clock of a full TPU-solver solve (encode -> device
@@ -12,7 +13,15 @@ Prints ONE JSON line:
   (how much faster the TPU path is than the reference-equivalent
   single-threaded FFD), decisions verified identical first.
 
+Configs (BASELINE.md):
+  1. 1k homogeneous cpu/mem pods, 1 NodePool, ~20 instance types
+  2. 50k mixed pods: selectors + taints/tolerations, full catalog (HEADLINE)
+  3. topology: zone spread (maxSkew=1) + hostname anti-affinity groups
+  4. consolidation: all deletion candidates of a 200-node cluster, 1 batch
+  5. spot+OD across 3 weighted NodePools with limits
+
 Usage: python bench.py [--pods N] [--rounds N] [--backend jax|numpy]
+                       [--all] [--config N]
 """
 
 import argparse
@@ -24,11 +33,38 @@ import time
 sys.path.insert(0, ".")
 
 
-def build_snapshot(env, n_pods):
+def _percentiles(times):
+    times = sorted(times)
+    p50 = statistics.median(times)
+    p99 = times[min(len(times) - 1, int(len(times) * 0.99))]
+    return round(p50, 2), round(p99, 2)
+
+
+# ---------------------------------------------------------------------------
+# snapshot builders, one per BASELINE config
+# ---------------------------------------------------------------------------
+
+def build_config1(env, n_pods):
+    """1k homogeneous cpu/mem-only pods, 1 NodePool, ~20 instance types."""
     from karpenter_provider_aws_tpu.apis import labels as L
     from karpenter_provider_aws_tpu.fake.environment import make_pods
 
-    # BASELINE config-2 shape: mixed pods, selectors, spot/OD, full catalog
+    pods = make_pods(n_pods, cpu="500m", memory="1Gi", prefix="homog")
+    pool = env.nodepool("bench-c1", requirements=[
+        {"key": L.INSTANCE_FAMILY, "operator": "In",
+         "values": ["m5", "c5", "r5"]},
+        {"key": L.INSTANCE_SIZE, "operator": "In",
+         "values": ["large", "xlarge", "2xlarge", "4xlarge",
+                    "8xlarge", "12xlarge", "16xlarge"]},
+    ])
+    return env.snapshot(pods, [pool])
+
+
+def build_config2(env, n_pods):
+    """Mixed pods, selectors, spot/OD, full catalog (the headline shape)."""
+    from karpenter_provider_aws_tpu.apis import labels as L
+    from karpenter_provider_aws_tpu.fake.environment import make_pods
+
     n_small = int(n_pods * 0.60)
     n_med = int(n_pods * 0.25)
     n_spot = int(n_pods * 0.10)
@@ -44,51 +80,227 @@ def build_snapshot(env, n_pods):
     return env.snapshot(pods, [env.nodepool("bench-pool")])
 
 
-def main():
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--pods", type=int, default=50_000)
-    ap.add_argument("--rounds", type=int, default=12)
-    ap.add_argument("--backend", default="jax", choices=["jax", "numpy"])
-    args = ap.parse_args()
+def build_config3(env, n_pods):
+    """Topology: zone spread maxSkew=1 over spread groups + one hostname
+    anti-affinity group (the deployment-per-node pattern)."""
+    from karpenter_provider_aws_tpu.apis import labels as L
+    from karpenter_provider_aws_tpu.apis.objects import (PodAffinityTerm,
+                                                         TopologySpreadConstraint)
+    from karpenter_provider_aws_tpu.fake.environment import make_pods
 
-    from karpenter_provider_aws_tpu.fake.environment import Environment
+    n_plain = int(n_pods * 0.5)
+    n_anti = min(200, n_pods // 10)
+    n_spread = max(0, n_pods - n_plain - n_anti)
+    spread_groups = max(1, min(20, n_spread))
+    pods = make_pods(n_plain, cpu="250m", memory="512Mi", prefix="plain")
+    per = n_spread // spread_groups
+    for gi in range(spread_groups):
+        cnt = per if gi < spread_groups - 1 \
+            else n_spread - per * (spread_groups - 1)
+        pods += make_pods(
+            cnt, cpu="500m", memory="1Gi", prefix=f"spread{gi:02d}",
+            group=f"spread{gi:02d}",
+            topology_spread=[TopologySpreadConstraint(
+                max_skew=1, topology_key=L.ZONE,
+                when_unsatisfiable="DoNotSchedule", group=f"spread{gi:02d}")])
+    pods += make_pods(
+        n_anti, cpu="1", memory="2Gi", prefix="anti", group="anti",
+        pod_affinity=[PodAffinityTerm(topology_key=L.HOSTNAME,
+                                      group="anti", anti=True)])
+    return env.snapshot(pods, [env.nodepool("bench-c3")])
+
+
+def build_config5(env, n_pods):
+    """Spot+OD price-capacity-optimized across weighted pools w/ limits."""
+    from karpenter_provider_aws_tpu.apis import labels as L
+    from karpenter_provider_aws_tpu.fake.environment import make_pods
+
+    n_flex = int(n_pods * 0.7)
+    n_spot = n_pods - n_flex
+    pods = (
+        make_pods(n_flex, cpu="500m", memory="1Gi", prefix="flex")
+        + make_pods(n_spot, cpu="1", memory="2Gi", prefix="spot5",
+                    node_selector={L.CAPACITY_TYPE: "spot"})
+    )
+    spot_pool = env.nodepool("bench-spot", weight=10, requirements=[
+        {"key": L.CAPACITY_TYPE, "operator": "In", "values": ["spot"]}])
+    od_pool = env.nodepool("bench-od", weight=5, requirements=[
+        {"key": L.CAPACITY_TYPE, "operator": "In", "values": ["on-demand"]}],
+        limits={"cpu": "20000", "memory": "80000Gi"})
+    fallback = env.nodepool("bench-fallback")
+    return env.snapshot(pods, [spot_pool, od_pool, fallback])
+
+
+def build_config4(env, n_nodes=200, pods_per_node=14):
+    """Consolidation: a live cluster of n nodes; every node is a deletion
+    candidate; feasibility of each = one deletion-check snapshot (pools
+    price-filtered to nothing, existing = cluster minus the candidate) —
+    the controller's batched pre-screen (disruption.py _single_consolidation).
+    Returns the list of per-candidate snapshots."""
+    from karpenter_provider_aws_tpu.apis import labels as L
+    from karpenter_provider_aws_tpu.apis.resources import Resources
+    from karpenter_provider_aws_tpu.fake.environment import make_pods
+    from karpenter_provider_aws_tpu.solver.types import (ExistingNode,
+                                                         SchedulingSnapshot)
+
+    zones = ["us-west-2a", "us-west-2b", "us-west-2c"]
+    nodes = []
+    node_pods = {}
+    for i in range(n_nodes):
+        # 16-vCPU nodes at ~45% utilization: deletions are sometimes
+        # feasible (neighbors absorb) and sometimes not — both paths hit
+        pods = make_pods(pods_per_node, cpu="900m", memory="1800Mi",
+                         prefix=f"c4n{i:03d}")
+        node_pods[i] = pods
+        nodes.append(ExistingNode(
+            name=f"bench-node-{i:03d}",
+            labels={L.ZONE: zones[i % 3], L.ARCH: "amd64",
+                    L.CAPACITY_TYPE: "on-demand",
+                    L.INSTANCE_TYPE: "m5.4xlarge"},
+            allocatable=Resources.parse(
+                {"cpu": "15800m", "memory": "57Gi", "pods": "110"}),
+            used=Resources.parse(
+                {"cpu": f"{900 * pods_per_node}m",
+                 "memory": f"{1800 * pods_per_node}Mi",
+                 "pods": str(pods_per_node)}),
+        ))
+    snaps = []
+    for i in range(n_nodes):
+        existing = [n for j, n in enumerate(nodes) if j != i]
+        snaps.append(SchedulingSnapshot(
+            pods=node_pods[i], nodepools=[], existing_nodes=existing,
+            daemon_overheads=[], zones={z: z + "-id" for z in zones}))
+    return snaps
+
+
+# ---------------------------------------------------------------------------
+# runners
+# ---------------------------------------------------------------------------
+
+def run_solver_config(name, snap, backend, rounds):
     from karpenter_provider_aws_tpu.solver import CPUSolver
     from karpenter_provider_aws_tpu.solver.tpu import TPUSolver
 
-    env = Environment()
-    snap = build_snapshot(env, args.pods)
-    tpu = TPUSolver(backend=args.backend)
+    tpu = TPUSolver(backend=backend)
     cpu = CPUSolver()
-
-    # correctness gate: decisions must be identical before timing means anything
     t0 = time.perf_counter()
     ref = cpu.solve(snap)
     cpu_ms = (time.perf_counter() - t0) * 1000
-    got = tpu.solve(snap)  # also warms the jit cache
-    if ref.decision_fingerprint() != got.decision_fingerprint():
+    got = tpu.solve(snap)  # warms the jit cache
+    identical = ref.decision_fingerprint() == got.decision_fingerprint()
+    times = []
+    for _ in range(rounds):
+        t0 = time.perf_counter()
+        tpu.solve(snap)
+        times.append((time.perf_counter() - t0) * 1000)
+    p50, p99 = _percentiles(times)
+    return {
+        "config": name, "p50_ms": p50, "p99_ms": p99,
+        "cpu_oracle_ms": round(cpu_ms, 1),
+        "speedup": round(cpu_ms / p99, 2) if p99 else 0.0,
+        "identical_decisions": identical,
+        "pods": len(snap.pods),
+        "types": max((len(s.instance_types) for s in snap.nodepools),
+                     default=0),
+        "rounds": rounds,
+        "decisions": ref.summary(),
+    }
+
+
+def run_config4(backend, rounds, n_nodes=200):
+    from karpenter_provider_aws_tpu.fake.environment import Environment
+    from karpenter_provider_aws_tpu.solver import CPUSolver
+    from karpenter_provider_aws_tpu.solver.consolidation import \
+        TPUConsolidationEvaluator
+
+    env = Environment()
+    snaps = build_config4(env, n_nodes=n_nodes)
+    ev = TPUConsolidationEvaluator(backend=backend)
+    cpu = CPUSolver()
+    t0 = time.perf_counter()
+    ref = [not (r.new_nodes or r.unschedulable)
+           for r in (cpu.solve(s) for s in snaps)]
+    cpu_ms = (time.perf_counter() - t0) * 1000
+    got = ev.deletions_feasible(snaps)  # warms the jit cache
+    identical = list(map(bool, got)) == ref
+    times = []
+    for _ in range(rounds):
+        t0 = time.perf_counter()
+        ev.deletions_feasible(snaps)
+        times.append((time.perf_counter() - t0) * 1000)
+    p50, p99 = _percentiles(times)
+    return {
+        "config": "4-consolidation", "p50_ms": p50, "p99_ms": p99,
+        "cpu_oracle_ms": round(cpu_ms, 1),
+        "speedup": round(cpu_ms / p99, 2) if p99 else 0.0,
+        "identical_decisions": identical,
+        "candidates": len(snaps), "feasible": sum(map(bool, got)),
+        "rounds": rounds,
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--pods", type=int, default=50_000)
+    ap.add_argument("--rounds", type=int, default=100)
+    ap.add_argument("--backend", default="jax", choices=["jax", "numpy"])
+    ap.add_argument("--all", action="store_true",
+                    help="run all 5 BASELINE configs (default: headline only)")
+    ap.add_argument("--config", type=int, choices=[1, 2, 3, 4, 5],
+                    help="run a single config and print its row")
+    args = ap.parse_args()
+
+    from karpenter_provider_aws_tpu.fake.environment import Environment
+
+    env = Environment()
+    builders = {1: (build_config1, 1000), 2: (build_config2, args.pods),
+                3: (build_config3, args.pods), 5: (build_config5, args.pods)}
+
+    def run_one(ci):
+        if ci == 4:
+            return run_config4(args.backend, max(10, args.rounds // 5))
+        build, n = builders[ci]
+        return run_solver_config(f"{ci}", build(env, n), args.backend,
+                                 args.rounds)
+
+    if args.config:
+        print(json.dumps(run_one(args.config)))
+        return
+
+    results = {}
+    if args.all:
+        for ci in (1, 3, 4, 5):
+            results[ci] = run_one(ci)
+            print(f"config {ci}: p99={results[ci]['p99_ms']}ms "
+                  f"(oracle {results[ci]['cpu_oracle_ms']}ms, "
+                  f"identical={results[ci]['identical_decisions']})",
+                  file=sys.stderr)
+
+    head = run_solver_config("2", build_config2(env, args.pods),
+                             args.backend, args.rounds)
+    ok = head["identical_decisions"] and all(
+        r["identical_decisions"] for r in results.values())
+    if not ok:
         print(json.dumps({"metric": "EQUIVALENCE FAILURE", "value": -1,
                           "unit": "ms", "vs_baseline": 0}))
         sys.exit(1)
 
-    times = []
-    for _ in range(args.rounds):
-        t0 = time.perf_counter()
-        tpu.solve(snap)
-        times.append((time.perf_counter() - t0) * 1000)
-    times.sort()
-    p99 = times[min(len(times) - 1, int(len(times) * 0.99))]
-
+    extra = {
+        "median_ms": head["p50_ms"],
+        "cpu_oracle_ms": head["cpu_oracle_ms"],
+        "decisions": head["decisions"],
+        "identical_decisions": True,
+        "rounds": head["rounds"],
+    }
+    if results:
+        extra["configs"] = {str(k): v for k, v in sorted(results.items())}
     print(json.dumps({
-        "metric": f"solve p99 @ {args.pods} pods x {len(snap.nodepools[0].instance_types)} types ({args.backend})",
-        "value": round(p99, 2),
+        "metric": f"solve p99 @ {head['pods']} pods x {head['types']} types "
+                  f"({args.backend})",
+        "value": head["p99_ms"],
         "unit": "ms",
-        "vs_baseline": round(cpu_ms / p99, 2),
-        "extra": {
-            "median_ms": round(statistics.median(times), 2),
-            "cpu_oracle_ms": round(cpu_ms, 1),
-            "decisions": ref.summary(),
-            "identical_decisions": True,
-        },
+        "vs_baseline": head["speedup"],
+        "extra": extra,
     }))
 
 

@@ -326,6 +326,12 @@ class NodeClaim(KubeObject):
     def nodepool(self) -> Optional[str]:
         return self.metadata.labels.get(L.NODEPOOL)
 
+    @property
+    def instance_type_names(self) -> List[str]:
+        """Candidate instance types the solver planned for this claim
+        (cheapest-first; the launch path truncates to 60)."""
+        return list(getattr(self, "instance_type_options", []))
+
     def set_condition(self, ctype: str, status: str, reason: str = "",
                       message: str = "", now: float = 0.0) -> None:
         self.conditions[ctype] = Condition(ctype, status, reason, message, now)

@@ -137,38 +137,55 @@ class InstanceTypes(List[InstanceType]):
 
     def truncate(self, reqs: Requirements, max_items: int = 60) -> "InstanceTypes":
         """Cheapest-first truncation honoring minValues flexibility floors
-        (instance.go:55,106; core InstanceTypes.Truncate)."""
+        (instance.go:55,106; core InstanceTypes.Truncate).
+
+        Two-phase: (1) a cheapest-first *coverage pass* picks types that add
+        a still-needed distinct value for some floored key until every floor
+        is met; (2) remaining slots fill cheapest-first. The result stays
+        price-ordered and within ``max_items``. Raises
+        InsufficientCapacityError (a soft launch failure the caller maps to
+        ICE retry semantics, like the reference's "validating minValues"
+        create error) only when the FULL candidate set cannot satisfy the
+        floors within the cap."""
         ordered = self.order_by_price(reqs)
-        truncated = InstanceTypes(ordered[:max_items])
-        violations = self._min_values_violations(truncated, reqs)
-        if not violations:
-            return truncated
-        # greedily extend with types that add a NEW value for a violated key
-        seen_values: Dict[str, set] = {}
-        for it in truncated:
-            for r in it.requirements:
-                if not r.complement:
-                    seen_values.setdefault(r.key, set()).update(r.values)
-        for it in ordered[max_items:]:
-            if not violations:
+        floors = {r.key: r.min_values for r in reqs
+                  if r.min_values is not None}
+        if not floors:
+            return InstanceTypes(ordered[:max_items])
+        seen: Dict[str, set] = {k: set() for k in floors}
+        chosen_ids = set()
+        for it in ordered:
+            if all(len(seen[k]) >= f for k, f in floors.items()):
                 break
             adds = False
-            for key in violations:
-                req = it.requirements.get(key)
+            for k, f in floors.items():
+                if len(seen[k]) >= f:
+                    continue
+                req = it.requirements.get(k)
                 if req is not None and not req.complement \
-                        and req.values - seen_values.get(key, set()):
+                        and req.values - seen[k]:
                     adds = True
             if adds:
-                truncated.append(it)
-                for r in it.requirements:
-                    if not r.complement:
-                        seen_values.setdefault(r.key, set()).update(r.values)
-                violations = self._min_values_violations(truncated, reqs)
-        if violations:
-            raise ValueError(
-                f"minValues unsatisfiable for keys {violations} within "
-                f"{max_items}-type truncation")
-        return truncated
+                chosen_ids.add(id(it))
+                for k in floors:
+                    req = it.requirements.get(k)
+                    if req is not None and not req.complement:
+                        seen[k].update(req.values)
+        violated = sorted(k for k, f in floors.items() if len(seen[k]) < f)
+        if violated or len(chosen_ids) > max_items:
+            raise InsufficientCapacityError(
+                f"validating minValues: floors unsatisfiable for keys "
+                f"{violated or sorted(floors)} within {max_items}-type "
+                f"truncation")
+        out = InstanceTypes()
+        budget = max_items - len(chosen_ids)
+        for it in ordered:
+            if id(it) in chosen_ids:
+                out.append(it)
+            elif budget > 0:
+                out.append(it)
+                budget -= 1
+        return out
 
     @staticmethod
     def _min_values_violations(types: "InstanceTypes", reqs: Requirements) -> List[str]:

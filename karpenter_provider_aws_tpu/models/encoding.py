@@ -185,6 +185,24 @@ class SnapshotEncoding:
     pools: List[PoolEncoding]
     admit: np.ndarray                    # [G, P] bool (reqs ∧ taints ∧ residual)
     daemon: np.ndarray                   # [G, P, D] int64 daemon overhead
+    # minValues floors (nodepool requirements with a minValues cardinality
+    # floor — karpenter.sh_nodepools.yaml:284; enforced per node the way the
+    # core scheduler's SatisfiesMinValues check in nodeclaim.Add is). K keys
+    # across all pools; each key's (type, value-id) membership pairs drive a
+    # segment-max — sharding-friendly (pairs localize per type shard).
+    mv_keys: List[str] = field(default_factory=list)
+    mv_V: int = 0                        # value-id universe size (max over keys)
+    mv_floor: Optional[np.ndarray] = None    # [P, K] int64 (0 = no floor)
+    mv_pairs_t: Optional[np.ndarray] = None  # [K, M] int64 type index of pair
+    mv_pairs_v: Optional[np.ndarray] = None  # [K, M] int64 value id (V = pad)
+
+    @property
+    def mv_K(self) -> int:
+        return len(self.mv_keys)
+
+    @property
+    def mv_M(self) -> int:
+        return 0 if self.mv_pairs_t is None else self.mv_pairs_t.shape[1]
 
 
 def _ns_name(p: Pod) -> Tuple[str, str]:
@@ -408,12 +426,61 @@ def encode_snapshot(snapshot: SchedulingSnapshot) -> SnapshotEncoding:
                     total = total + d.requests
             daemon[g.index, pe.index] = vec(total)
 
+    mv_keys, mv_V, mv_floor, mv_pairs_t, mv_pairs_v = \
+        _encode_min_values(pools, types, P)
+
     return SnapshotEncoding(
         universe=universe, dims=dims, zones=zones, zone_ids=zid_of,
         types=types, type_names=[t.name for t in types],
         type_val=type_val, A=A, avail=avail, price=price,
         groups=groups, R=R, n=n, F=F, agz=agz, agc=agc,
-        pools=pools, admit=admit, daemon=daemon)
+        pools=pools, admit=admit, daemon=daemon,
+        mv_keys=mv_keys, mv_V=mv_V, mv_floor=mv_floor,
+        mv_pairs_t=mv_pairs_t, mv_pairs_v=mv_pairs_v)
+
+
+def _encode_min_values(pools: List[PoolEncoding],
+                       types: Sequence[InstanceType], P: int):
+    """Pool-level minValues floors + per-key (type, value) membership pairs.
+
+    Value ids are interned per key over the values each type's requirement
+    carries (multi-valued requirements contribute one pair per value — the
+    same union-cardinality the launch-path truncation counts). Pairs are
+    padded with value id V, a dump segment sliced off by the kernels.
+    """
+    keys = sorted({r.key for pe in pools
+                   for r in pe.spec.nodepool.scheduling_requirements()
+                   if r.min_values is not None})
+    if not keys:
+        return [], 0, None, None, None
+    K = len(keys)
+    mv_floor = np.zeros((P, K), dtype=np.int64)
+    for pe in pools:
+        for r in pe.spec.nodepool.scheduling_requirements():
+            if r.min_values is not None:
+                mv_floor[pe.index, keys.index(r.key)] = r.min_values
+    pairs: List[List[Tuple[int, int]]] = []
+    V = 0
+    for key in keys:
+        vids: Dict[str, int] = {}
+        kp: List[Tuple[int, int]] = []
+        for ti, t in enumerate(types):
+            r = t.requirements.get(key)
+            if r is None or r.complement:
+                continue
+            for v in sorted(r.values):
+                vid = vids.setdefault(v, len(vids))
+                kp.append((ti, vid))
+        pairs.append(kp)
+        V = max(V, len(vids))
+    M = max((len(kp) for kp in pairs), default=0)
+    mv_pairs_t = np.zeros((K, M), dtype=np.int64)
+    mv_pairs_v = np.full((K, M), V, dtype=np.int64)  # pad -> dump segment
+    for ki, kp in enumerate(pairs):
+        for mi, (ti, vid) in enumerate(kp):
+            mv_pairs_t[ki, mi] = ti
+            mv_pairs_v[ki, mi] = vid
+    return keys, V, mv_floor, mv_pairs_t, mv_pairs_v
 
 
 def _zone_allow(reqs: Requirements, zones: List[str],

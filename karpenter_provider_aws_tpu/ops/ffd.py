@@ -108,6 +108,52 @@ def _headroom(A_eff: np.ndarray, used: np.ndarray, R: np.ndarray) -> np.ndarray:
     return np.clip(q.min(axis=-1), 0, BIG)
 
 
+def _mv_value_headroom(enc: SnapshotEncoding, cand: np.ndarray,
+                       hr: np.ndarray) -> np.ndarray:
+    """[..., K, V]: 1 + max headroom over candidate types carrying each
+    minValues (key, value); 0 when no candidate type carries the value.
+    Segment-max over the encoding's (type, value-id) pairs."""
+    K, M = enc.mv_pairs_t.shape
+    V = enc.mv_V
+    hr1 = np.where(cand, hr + 1, 0)
+    lead = hr1.shape[:-1]
+    flat = hr1.reshape(-1, hr1.shape[-1])
+    B = flat.shape[0]
+    out = np.zeros((B, K, V + 1), dtype=np.int64)  # col V = pad dump
+    rows = np.arange(B)[:, None]
+    for k in range(K):
+        contrib = flat[:, enc.mv_pairs_t[k]]           # [B, M]
+        np.maximum.at(out[:, k, :], (rows, enc.mv_pairs_v[k][None, :]),
+                      contrib)
+    return out[:, :, :V].reshape(lead + (K, V))
+
+
+def min_values_cap(enc: SnapshotEncoding, pi: int, cand: np.ndarray,
+                   hr: np.ndarray) -> np.ndarray:
+    """Max pods a node may take while its surviving candidate-type mask
+    ``{t in cand : hr_t >= m}`` keeps every minValues floor of pool ``pi``
+    (the closed form of core nodeclaim.Add's SatisfiesMinValues check):
+    for floor f on a key, the cap is the f-th largest per-value max
+    headroom. cand/hr: [..., T]; returns [...] int64 (BIG = no floors)."""
+    lead = np.asarray(hr).shape[:-1]
+    if enc.mv_floor is None or not enc.mv_floor[pi].any():
+        return np.full(lead, BIG, dtype=np.int64)
+    floors = enc.mv_floor[pi]
+    h1 = _mv_value_headroom(enc, cand, hr)         # [..., K, V]
+    S = -np.sort(-h1, axis=-1)
+    cap = np.full(lead, BIG, dtype=np.int64)
+    for k in range(enc.mv_K):
+        f = int(floors[k])
+        if f <= 0:
+            continue
+        if f > enc.mv_V:
+            capk = np.full(lead, -1, dtype=np.int64)
+        else:
+            capk = S[..., k, f - 1] - 1
+        cap = np.minimum(cap, capk)
+    return np.maximum(cap, 0)
+
+
 def _pool_budget(enc: SnapshotEncoding, pool_used: np.ndarray,
                  pi: int, R: np.ndarray) -> int:
     """Max additional pods of per-pod vector R pool pi's limits allow."""
@@ -142,16 +188,20 @@ def slot_candidates(st: NodeState, enc: SnapshotEncoding, g: int,
 
 
 def slot_headroom(st: NodeState, enc: SnapshotEncoding, g: int,
-                  cand: np.ndarray) -> np.ndarray:
-    """[N] max pods each slot can still absorb (step 3, before budgets)."""
+                  cand: np.ndarray):
+    """([N] max pods each slot can still absorb, per-type headroom info for
+    the open rows) — step 3, before budgets. The second element is
+    ``(open_mask[N], hr[open, T])`` (or None), reused by the minValues cap
+    so the O(rows*T*D) headroom matrix is computed once."""
     R = enc.R[g]
     k = np.zeros(st.N, dtype=np.int64)
+    hr_info = None
     # open slots: max over candidate types
     open_rows = cand.any(axis=1)
     if open_rows.any():
         hr = _headroom(enc.A[None, :, :], st.used[open_rows][:, None, :], R)
-        hr = np.where(cand[open_rows], hr, 0)
-        k[open_rows] = hr.max(axis=1)
+        k[open_rows] = np.where(cand[open_rows], hr, 0).max(axis=1)
+        hr_info = (open_rows, hr)
     # existing slots: concrete allocatable + compat
     E = st.E
     if E:
@@ -159,7 +209,7 @@ def slot_headroom(st: NodeState, enc: SnapshotEncoding, g: int,
         if ex_ok.any():
             he = _headroom(st.ex_alloc[ex_ok], st.used[:E][ex_ok], R)
             k[:E][ex_ok] = he
-    return k
+    return k, hr_info
 
 
 def admission(st: NodeState, enc: SnapshotEncoding, g: int) -> np.ndarray:
@@ -198,8 +248,23 @@ def fill_group_closed_form(st: NodeState, enc: SnapshotEncoding, g: int,
     cand = slot_candidates(st, enc, g, agz_g)
     adm = admission(st, enc, g)
     cand &= adm[:, None]
-    k = slot_headroom(st, enc, g, cand)
+    k, hr_info = slot_headroom(st, enc, g, cand)
     k = np.where(adm, k, 0)
+    # minValues floors cap per-slot takes BEFORE the budget prefix caps
+    # (same order as the device kernel — min-composition order matters
+    # because the budget caps are prefix sums over earlier slots' k)
+    if enc.mv_floor is not None and hr_info is not None:
+        open_mask, hr_open = hr_info
+        pos = np.cumsum(open_mask) - 1  # slot index -> row in hr_open
+        for pi in range(len(enc.pools)):
+            if not enc.mv_floor[pi].any():
+                continue
+            rows = np.where((st.pool == pi) & open_mask & (k > 0))[0]
+            if rows.size == 0:
+                continue
+            k[rows] = np.minimum(
+                k[rows], min_values_cap(enc, pi, cand[rows],
+                                        hr_open[pos[rows]]))
     # pool limit budgets cap fills pool-by-pool (node order preserved)
     for pi, pe in enumerate(enc.pools):
         if pe.limit_vec is None:
@@ -253,6 +318,8 @@ def fill_group_closed_form(st: NodeState, enc: SnapshotEncoding, g: int,
         hr = _headroom(enc.A, daemon[None, :], R)
         hr = np.where(cand_new, hr, 0)
         cap = int(hr.max())
+        if enc.mv_floor is not None and enc.mv_floor[pi].any():
+            cap = min(cap, int(min_values_cap(enc, pi, cand_new, hr)))
         if cap < 1:
             continue
         budget = _pool_budget(enc, st.pool_used, pi, R)

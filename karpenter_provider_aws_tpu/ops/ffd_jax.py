@@ -63,6 +63,12 @@ class KernelInputs(NamedTuple):
     ex_alloc: jax.Array    # [E, D] int64
     ex_used0: jax.Array    # [E, D] int64
     ex_compat: jax.Array   # [G, E] bool
+    # minValues floors (None when no pool carries a floor). Membership is
+    # (type, value-id) pairs per key driving a segment-max; pair type
+    # indices are GLOBAL and localized per shard inside the kernel.
+    mv_floor: "jax.Array | None" = None    # [P, K] int64 (0 = no floor)
+    mv_pairs_t: "jax.Array | None" = None  # [K, M] int64
+    mv_pairs_v: "jax.Array | None" = None  # [K, M] int64 (pad = V)
 
 
 class Carry(NamedTuple):
@@ -76,14 +82,47 @@ class Carry(NamedTuple):
     pool_used: jax.Array  # [P, D]
 
 
-def _headroom_slots(A: jax.Array, used: jax.Array, R: jax.Array,
-                    cand: jax.Array) -> jax.Array:
-    """[N] max pods per slot over candidate types."""
+def _headroom_matrix(A: jax.Array, used: jax.Array, R: jax.Array) -> jax.Array:
+    """[N, T] per-type pod headroom per slot."""
     Rsafe = jnp.where(R > 0, R, 1)
     q = (A[None, :, :] - used[:, None, :]) // Rsafe[None, None, :]   # [N,T,D]
     q = jnp.where((R > 0)[None, None, :], q, BIG)
-    hr = jnp.clip(q.min(axis=-1), 0, BIG)                            # [N,T]
-    return jnp.where(cand, hr, 0).max(axis=1)
+    return jnp.clip(q.min(axis=-1), 0, BIG)                          # [N,T]
+
+
+def _mv_h1(hr1: jax.Array, pairs_t: jax.Array, pairs_v: jax.Array,
+           V: int, T: int, axis: "str | None") -> jax.Array:
+    """[..., K, V] per-value max of ``hr1`` (= headroom+1 over candidates,
+    0 = not a candidate) via segment-max over membership pairs. Pair type
+    indices are global; each shard contributes only its local types — the
+    caller pmax-reduces across shards."""
+    off = jax.lax.axis_index(axis) * T if axis is not None else 0
+    K, _M = pairs_t.shape
+    cols = []
+    for k in range(K):
+        tloc = pairs_t[k] - off
+        valid = (tloc >= 0) & (tloc < T)
+        gathered = jnp.where(valid,
+                             hr1[..., jnp.clip(tloc, 0, T - 1)], 0)  # [..,M]
+        seg = jax.ops.segment_max(
+            jnp.moveaxis(gathered, -1, 0), pairs_v[k],
+            num_segments=V + 1)[:V]                                  # [V,..]
+        cols.append(jnp.clip(jnp.moveaxis(seg, 0, -1), 0, None))     # [..,V]
+    return jnp.stack(cols, axis=-2)                                  # [..,K,V]
+
+
+def _mv_cap(h1: jax.Array, f: jax.Array, V: int) -> jax.Array:
+    """[...] max take m keeping, per key, at least f distinct values with
+    per-value max headroom >= m: the f-th largest of the per-value maxima.
+    h1: [..., K, V] (headroom+1); f: [..., K] floors (0 = none)."""
+    if V == 0:
+        capk = jnp.where(f <= 0, BIG, -1)
+    else:
+        S = -jnp.sort(-h1, axis=-1)                                  # desc
+        idx = jnp.clip(f - 1, 0, V - 1)
+        val = jnp.take_along_axis(S, idx[..., None], axis=-1)[..., 0]
+        capk = jnp.where(f <= 0, BIG, jnp.where(f > V, -1, val - 1))
+    return jnp.maximum(capk.min(axis=-1), 0)
 
 
 def _headroom_vec(A_eff: jax.Array, base: jax.Array, R: jax.Array) -> jax.Array:
@@ -95,15 +134,15 @@ def _headroom_vec(A_eff: jax.Array, base: jax.Array, R: jax.Array) -> jax.Array:
     return jnp.clip(q.min(axis=-1), 0, BIG)
 
 
-@partial(jax.jit, static_argnames=("n_max", "E", "P"))
-def solve_scan(inp: KernelInputs, n_max: int, E: int, P: int
+@partial(jax.jit, static_argnames=("n_max", "E", "P", "V"))
+def solve_scan(inp: KernelInputs, n_max: int, E: int, P: int, V: int = 0
                ) -> Tuple[jax.Array, jax.Array, Carry]:
     """Returns (takes[G, N], leftover[G], final carry)."""
-    return _solve(inp, n_max, E, P)
+    return _solve(inp, n_max, E, P, V=V)
 
 
 def _solve(inp: KernelInputs, n_max: int, E: int, P: int,
-           axis: "str | None" = None
+           axis: "str | None" = None, V: int = 0
            ) -> Tuple[jax.Array, jax.Array, Carry]:
     """The scan. With ``axis`` set, the TYPE dimension of every input is a
     per-device shard under shard_map over that mesh axis: candidate masks
@@ -143,13 +182,25 @@ def _solve(inp: KernelInputs, n_max: int, E: int, P: int,
         cand = carry.types & F[None, :] & off_ok & adm_open[:, None]
 
         # ---- headroom (step 3) ---------------------------------------
-        k = _headroom_slots(inp.A, carry.used, R, cand)
+        hr_nt = _headroom_matrix(inp.A, carry.used, R)
+        k = jnp.where(cand, hr_nt, 0).max(axis=1)
         if axis is not None:
             k = jax.lax.pmax(k, axis)   # max over type shards
         if E:
             ex_ok = carry.alive[:E] & ex_compat
             k_ex = jnp.where(ex_ok, _headroom_vec(inp.ex_alloc, carry.used[:E], R), 0)
             k = k.at[:E].set(k_ex)
+        # minValues floors cap per-slot takes BEFORE the budget prefix
+        # caps (ops/ffd.py applies the same order)
+        if inp.mv_floor is not None:
+            hr1 = jnp.where(cand, hr_nt + 1, 0)
+            h1 = _mv_h1(hr1, inp.mv_pairs_t, inp.mv_pairs_v, V, T, axis)
+            if axis is not None:
+                h1 = jax.lax.pmax(h1, axis)
+            f = jnp.where((carry.pool >= 0)[:, None],
+                          inp.mv_floor[pool_clipped], 0)        # [N, K]
+            k = jnp.minimum(k, jnp.where(carry.pool >= 0,
+                                         _mv_cap(h1, f, V), BIG))
         # pool limit budgets: cap per-pool prefix fills
         pool_used = carry.pool_used
         for pi in range(P):
@@ -192,6 +243,12 @@ def _solve(inp: KernelInputs, n_max: int, E: int, P: int,
             cap = hr.max()
             if axis is not None:
                 cap = jax.lax.pmax(cap, axis)
+            if inp.mv_floor is not None:
+                h1n = _mv_h1(jnp.where(cand_new, hr + 1, 0),
+                             inp.mv_pairs_t, inp.mv_pairs_v, V, T, axis)
+                if axis is not None:
+                    h1n = jax.lax.pmax(h1n, axis)
+                cap = jnp.minimum(cap, _mv_cap(h1n, inp.mv_floor[pi], V))
             budget = _pool_budget_jax(inp.pool_limit[pi], pool_used[pi], R)
             can_place = jnp.where(
                 admit[pi] & (cap >= 1), jnp.minimum(n_rem, budget), 0)
@@ -257,9 +314,12 @@ from .hostpack import (in_layout_bool as _in_layout_bool,  # noqa: E402
 
 
 def _unpack_inputs(buf_i64: jax.Array, buf_bool: jax.Array,
-                   T, D, Z, C, G, E, P) -> KernelInputs:
-    vals = _split(buf_i64, _in_layout_i64(T, D, Z, C, G, E, P))
-    vals.update(_split(buf_bool, _in_layout_bool(T, D, Z, C, G, E, P)))
+                   T, D, Z, C, G, E, P, K=0, M=0) -> KernelInputs:
+    vals = _split(buf_i64, _in_layout_i64(T, D, Z, C, G, E, P, K, M))
+    vals.update(_split(buf_bool, _in_layout_bool(T, D, Z, C, G, E, P, K, M)))
+    if K == 0:
+        for nm in ("mv_floor", "mv_pairs_t", "mv_pairs_v"):
+            vals.pop(nm, None)
     return KernelInputs(**vals)
 
 
@@ -291,15 +351,17 @@ def _words_to_bits(words: jax.Array, nbits: int) -> jax.Array:
     return bits.reshape(-1)[:nbits].astype(bool)
 
 
-@partial(jax.jit, static_argnames=("T", "D", "Z", "C", "G", "E", "P", "n_max"))
+@partial(jax.jit, static_argnames=("T", "D", "Z", "C", "G", "E", "P",
+                                   "K", "V", "M", "n_max"))
 def solve_scan_packed1(buf: jax.Array, *, T: int, D: int, Z: int, C: int,
-                       G: int, E: int, P: int, n_max: int) -> jax.Array:
+                       G: int, E: int, P: int, n_max: int,
+                       K: int = 0, V: int = 0, M: int = 0) -> jax.Array:
     """One buffer in, one buffer out — a solve is a single round trip."""
-    n_i64 = _layout_sizes(_in_layout_i64(T, D, Z, C, G, E, P))
-    n_bits = _layout_sizes(_in_layout_bool(T, D, Z, C, G, E, P))
+    n_i64 = _layout_sizes(_in_layout_i64(T, D, Z, C, G, E, P, K, M))
+    n_bits = _layout_sizes(_in_layout_bool(T, D, Z, C, G, E, P, K, M))
     bool_flat = _words_to_bits(buf[n_i64:n_i64 + _nwords(n_bits)], n_bits)
-    inp = _unpack_inputs(buf[:n_i64], bool_flat, T, D, Z, C, G, E, P)
-    takes, leftover, carry = _solve(inp, n_max, E, P)
+    inp = _unpack_inputs(buf[:n_i64], bool_flat, T, D, Z, C, G, E, P, K, M)
+    takes, leftover, carry = _solve(inp, n_max, E, P, V=V)
     out_i64 = jnp.concatenate([
         takes.reshape(-1), leftover.reshape(-1),
         carry.used.reshape(-1), carry.pool.astype(jnp.int64),

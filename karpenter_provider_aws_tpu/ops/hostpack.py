@@ -15,15 +15,24 @@ import numpy as np
 from ..native import pack_bits, unpack_bits
 
 
-def in_layout_i64(T, D, Z, C, G, E, P):
-    """(name, shape) of every int64 input, in buffer order."""
+#: statics order on the sidecar wire — shared by client and server. The
+#: minValues keys append AFTER n_max so a version-skewed old server still
+#: reads its 8 keys correctly (its buffer-size check then rejects K>0
+#: requests loudly instead of misparsing n_max)
+STATIC_KEYS = ("T", "D", "Z", "C", "G", "E", "P", "n_max", "K", "V", "M")
+
+
+def in_layout_i64(T, D, Z, C, G, E, P, K=0, M=0):
+    """(name, shape) of every int64 input, in buffer order. K/M are the
+    minValues key/pair counts (0 = feature absent, zero extra bytes)."""
     return [("A", (T, D)), ("R", (G, D)), ("n", (G,)),
             ("daemon", (G, P, D)), ("pool_limit", (P, D)),
             ("pool_used0", (P, D)), ("ex_alloc", (E, D)),
-            ("ex_used0", (E, D))]
+            ("ex_used0", (E, D)), ("mv_floor", (P, K)),
+            ("mv_pairs_t", (K, M)), ("mv_pairs_v", (K, M))]
 
 
-def in_layout_bool(T, D, Z, C, G, E, P):
+def in_layout_bool(T, D, Z, C, G, E, P, K=0, M=0):
     return [("avail_zc", (T, Z * C)), ("F", (G, T)), ("agz", (G, Z)),
             ("agc", (G, C)), ("admit", (G, P)),
             ("pool_types", (P, T)), ("pool_agz", (P, Z)),
@@ -69,12 +78,14 @@ def nwords(nbits: int) -> int:
     return (nbits + 63) // 64
 
 
-def pack_inputs1(arrays: dict, T, D, Z, C, G, E, P) -> np.ndarray:
+def pack_inputs1(arrays: dict, T, D, Z, C, G, E, P, K=0, M=0) -> np.ndarray:
     """Host: all inputs -> ONE int64 buffer [i64 fields | bitpacked bools]."""
-    i64 = np.concatenate([arrays[nm].reshape(-1).astype(np.int64)
-                          for nm, _ in in_layout_i64(T, D, Z, C, G, E, P)])
+    empty = np.zeros(0, dtype=np.int64)
+    i64 = np.concatenate([
+        np.asarray(arrays.get(nm, empty)).reshape(-1).astype(np.int64)
+        for nm, _ in in_layout_i64(T, D, Z, C, G, E, P, K, M)])
     bl = np.concatenate([arrays[nm].reshape(-1).astype(bool)
-                         for nm, _ in in_layout_bool(T, D, Z, C, G, E, P)])
+                         for nm, _ in in_layout_bool(T, D, Z, C, G, E, P, K, M)])
     return np.concatenate([i64, pack_bits(bl)])
 
 

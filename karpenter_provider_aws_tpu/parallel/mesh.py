@@ -50,8 +50,9 @@ def solve_mesh(n_devices: Optional[int] = None,
 
 
 def _pad_types(inp: KernelInputs, n_shards: int) -> Tuple[KernelInputs, int]:
-    """Pad the type axis to a multiple of the shard count. Padded types
-    have zero allocatable and no offerings -> never candidates."""
+    """Pad the type axis to a multiple of the shard count (host-side
+    numpy — runs before any device placement). Padded types have zero
+    allocatable and no offerings -> never candidates."""
     T = inp.A.shape[0]
     Tp = ((T + n_shards - 1) // n_shards) * n_shards
     if Tp == T:
@@ -59,21 +60,40 @@ def _pad_types(inp: KernelInputs, n_shards: int) -> Tuple[KernelInputs, int]:
     pad = Tp - T
 
     def padT0(a):  # type axis first
-        return jnp.concatenate(
-            [a, jnp.zeros((pad,) + a.shape[1:], a.dtype)], axis=0)
+        a = np.asarray(a)
+        return np.concatenate(
+            [a, np.zeros((pad,) + a.shape[1:], a.dtype)], axis=0)
 
     def padT1(a):  # type axis second
-        return jnp.concatenate(
-            [a, jnp.zeros(a.shape[:1] + (pad,) + a.shape[2:], a.dtype)],
+        a = np.asarray(a)
+        return np.concatenate(
+            [a, np.zeros(a.shape[:1] + (pad,) + a.shape[2:], a.dtype)],
             axis=1)
 
     return inp._replace(A=padT0(inp.A), avail_zc=padT0(inp.avail_zc),
                         F=padT1(inp.F), pool_types=padT1(inp.pool_types)), T
 
 
-@partial(jax.jit, static_argnames=("n_max", "E", "P", "mesh"))
+def _input_specs(has_mv: bool) -> KernelInputs:
+    """Partition specs per kernel input: type-axis sharded tensors vs the
+    replicated carry-adjacent state (module docstring)."""
+    repl = PS()
+    return KernelInputs(
+        A=PS(AXIS, None), avail_zc=PS(AXIS, None),
+        R=repl, n=repl, F=PS(None, AXIS), agz=repl, agc=repl,
+        admit=repl, daemon=repl,
+        pool_types=PS(None, AXIS), pool_agz=repl, pool_agc=repl,
+        pool_limit=repl, pool_used0=repl,
+        ex_alloc=repl, ex_used0=repl, ex_compat=repl,
+        # pair type indices are global; the kernel localizes per shard
+        mv_floor=repl if has_mv else None,
+        mv_pairs_t=repl if has_mv else None,
+        mv_pairs_v=repl if has_mv else None)
+
+
+@partial(jax.jit, static_argnames=("n_max", "E", "P", "V", "mesh"))
 def _solve_sharded(inp: KernelInputs, n_max: int, E: int, P: int,
-                   mesh: Mesh):
+                   mesh: Mesh, V: int = 0):
     try:
         from jax import shard_map as _smap
 
@@ -93,31 +113,31 @@ def _solve_sharded(inp: KernelInputs, n_max: int, E: int, P: int,
             return _esmap(f, mesh=mesh, in_specs=in_specs,
                           out_specs=out_specs, check_rep=False)
 
-    tp = PS(AXIS)
     repl = PS()
-    in_specs = KernelInputs(
-        A=PS(AXIS, None), avail_zc=PS(AXIS, None),
-        R=repl, n=repl, F=PS(None, AXIS), agz=repl, agc=repl,
-        admit=repl, daemon=repl,
-        pool_types=PS(None, AXIS), pool_agz=repl, pool_agc=repl,
-        pool_limit=repl, pool_used0=repl,
-        ex_alloc=repl, ex_used0=repl, ex_compat=repl)
+    in_specs = _input_specs(inp.mv_floor is not None)
     out_specs = (repl, repl, Carry(
         used=repl, types=PS(None, AXIS), zones=repl, ct=repl,
         pool=repl, alive=repl, num_nodes=repl, pool_used=repl))
-    fn = shard_map(partial(_solve, n_max=n_max, E=E, P=P, axis=AXIS),
+    fn = shard_map(partial(_solve, n_max=n_max, E=E, P=P, axis=AXIS, V=V),
                    mesh=mesh, in_specs=(in_specs,), out_specs=out_specs)
     return fn(inp)
 
 
 def solve_scan_sharded(inp: KernelInputs, n_max: int, E: int, P: int,
-                       mesh: Mesh) -> Tuple[jax.Array, jax.Array, Carry]:
+                       mesh: Mesh, V: int = 0
+                       ) -> Tuple[jax.Array, jax.Array, Carry]:
     """Type-parallel solve over ``mesh``; same (takes, leftover, carry)
     contract as ops.ffd_jax.solve_scan, decisions identical."""
     n_shards = mesh.devices.size
-    inp = KernelInputs(*[jnp.asarray(x) for x in inp])
     padded, T = _pad_types(inp, n_shards)
-    takes, leftover, carry = _solve_sharded(padded, n_max, E, P, mesh)
+    # explicit placement onto the mesh per spec — never the default device
+    # (the default backend may be a different/broken platform)
+    specs = _input_specs(padded.mv_floor is not None)
+    padded = KernelInputs(*[
+        None if x is None
+        else jax.device_put(np.asarray(x), NamedSharding(mesh, s))
+        for x, s in zip(padded, specs)])
+    takes, leftover, carry = _solve_sharded(padded, n_max, E, P, mesh, V=V)
     if padded.A.shape[0] != T:
         carry = carry._replace(types=carry.types[:, :T])
     return takes, leftover, carry

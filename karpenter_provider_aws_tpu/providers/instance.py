@@ -148,11 +148,16 @@ class InstanceProvider:
                                requested) -> InstanceTypes:
         """filterInstanceTypes (instance.go:385-392): drop exotic types when
         generic alternatives exist; for mixed spot/OD launches, drop spot
-        types priced above the cheapest on-demand."""
-        types = _filter_exotic(types)
-        if self._is_mixed_capacity(reqs, types):
-            types = _filter_unwanted_spot(types)
-        return types
+        types priced above the cheapest on-demand. Each heuristic stage is
+        reverted if it would break an explicit minValues floor the
+        candidate set satisfies (the same shape as the filter's own
+        fall-back-when-empty rule — heuristics never override user
+        constraints)."""
+        filtered = _keep_min_values(_filter_exotic(types), types, reqs)
+        if self._is_mixed_capacity(reqs, filtered):
+            filtered = _keep_min_values(
+                _filter_unwanted_spot(filtered), filtered, reqs)
+        return filtered
 
     @staticmethod
     def _is_mixed_capacity(reqs: Requirements, types: InstanceTypes) -> bool:
@@ -214,6 +219,17 @@ class InstanceProvider:
                     "priority": o.price,  # price-capacity-optimized proxy
                 })
         return overrides
+
+
+def _keep_min_values(filtered: InstanceTypes, original: InstanceTypes,
+                     reqs: Requirements) -> InstanceTypes:
+    """Revert a filtering heuristic that would break minValues floors the
+    unfiltered set satisfies (floors are explicit user constraints)."""
+    if any(r.min_values is not None for r in reqs) \
+            and InstanceTypes._min_values_violations(filtered, reqs) \
+            and not InstanceTypes._min_values_violations(original, reqs):
+        return original
+    return filtered
 
 
 def _filter_exotic(types: InstanceTypes) -> InstanceTypes:

@@ -34,11 +34,11 @@ class SolverClient:
         self._info = self._channel.unary_unary(_INFO)
 
     def solve_buffer(self, buf: np.ndarray, statics: Dict[str, int]) -> np.ndarray:
+        from ..ops.hostpack import STATIC_KEYS
         req = arena_pack({
             "buf": np.ascontiguousarray(buf, dtype=np.int64),
-            "statics": np.array([statics[k] for k in
-                                 ("T", "D", "Z", "C", "G", "E", "P",
-                                  "n_max")], dtype=np.int64),
+            "statics": np.array([statics.get(k, 0) for k in STATIC_KEYS],
+                                dtype=np.int64),
         })
         resp = self._solve(req, timeout=self.timeout)
         return np.array(arena_unpack(resp)["out"])  # own the memory

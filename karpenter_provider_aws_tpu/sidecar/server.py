@@ -42,7 +42,7 @@ _INFO = "/karpenter.solver.v1.Solver/Info"
 #: and sane (an unbounded space would let any peer pin the CPU compiling
 #: and grow the compile cache without limit)
 _STATICS_MAX = dict(T=4096, D=64, Z=64, C=8, G=1 << 17, E=1 << 14,
-                    P=256, n_max=1 << 14)
+                    P=256, K=16, V=8192, M=1 << 16, n_max=1 << 14)
 _MAX_SHAPE_CLASSES = 64
 
 
@@ -54,8 +54,14 @@ class _Handler:
 
     def _validate(self, statics, buf, context) -> Optional[dict]:
         import grpc
-        kv = dict(zip(("T", "D", "Z", "C", "G", "E", "P", "n_max"),
-                      (int(x) for x in statics)))
+
+        from ..ops.hostpack import (STATIC_KEYS, in_layout_bool,
+                                    in_layout_i64, layout_sizes, nwords)
+        if len(statics) != len(STATIC_KEYS):
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                          f"expected {len(STATIC_KEYS)} statics, "
+                          f"got {len(statics)}")
+        kv = dict(zip(STATIC_KEYS, (int(x) for x in statics)))
         for k, v in kv.items():
             if not (0 <= v <= _STATICS_MAX[k]):
                 context.abort(grpc.StatusCode.INVALID_ARGUMENT,
@@ -66,9 +72,8 @@ class _Handler:
                 context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED,
                               "too many distinct solve shape classes")
             self._shapes_seen.add(key)
-        from ..ops.hostpack import (in_layout_bool, in_layout_i64,
-                                    layout_sizes, nwords)
-        dims = {k: kv[k] for k in ("T", "D", "Z", "C", "G", "E", "P")}
+        dims = {k: kv[k] for k in ("T", "D", "Z", "C", "G", "E", "P",
+                                   "K", "M")}
         expect = layout_sizes(in_layout_i64(**dims)) \
             + nwords(layout_sizes(in_layout_bool(**dims)))
         if buf.size != expect:

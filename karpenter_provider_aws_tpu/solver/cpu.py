@@ -94,6 +94,34 @@ def _term_items(term: Mapping) -> Dict:
     return {k: tuple(v) if isinstance(v, list) else v for k, v in term.items()}
 
 
+def _min_values_floors(spec: NodePoolSpec) -> Dict[str, int]:
+    """Pool-level minValues cardinality floors, memoized on the spec
+    (karpenter.sh_nodepools.yaml:284; pods cannot carry minValues)."""
+    fl = getattr(spec, "_mv_floors", None)
+    if fl is None:
+        fl = {r.key: r.min_values
+              for r in spec.nodepool.scheduling_requirements()
+              if r.min_values is not None}
+        spec._mv_floors = fl
+    return fl
+
+
+def _mv_satisfied(types: Sequence[InstanceType], keep,
+                  floors: Mapping[str, int]) -> bool:
+    """True when the kept candidate types span >= floor distinct values for
+    every floored key — core nodeclaim.Add's SatisfiesMinValues check; a
+    placement that narrows candidates below a floor must be rejected."""
+    card: Dict[str, Set[str]] = {k: set() for k in floors}
+    for i, t in enumerate(types):
+        if keep is not None and not keep[i]:
+            continue
+        for r in t.requirements:
+            s = card.get(r.key)
+            if s is not None and not r.complement:
+                s.update(r.values)
+    return all(len(card[k]) >= f for k, f in floors.items())
+
+
 class _ResourceIndex:
     """Fixed resource-dimension universe for one solve."""
 
@@ -378,6 +406,9 @@ class CPUSolver(Solver):
         )
         if not self._topology_ok_open(pod, node, merged, types, fit, topo, plan):
             return None
+        floors = _min_values_floors(node.spec)
+        if floors and not _mv_satisfied(types, plan.keep, floors):
+            return None
         return plan
 
     def _try_new(self, pod: Pod, ctx: _PodCtx, spec: NodePoolSpec, index: int,
@@ -407,6 +438,9 @@ class CPUSolver(Solver):
         if not self._topology_ok_open(pod, node, merged, node.types,
                                       plan.keep, topo, plan):
             return "topology constraints unsatisfiable"
+        floors = _min_values_floors(spec)
+        if floors and not _mv_satisfied(node.types, plan.keep, floors):
+            return "minValues floors violated"
         self._commit_open(node, pod, ctx, plan, topo, pool_usage)
         return node
 

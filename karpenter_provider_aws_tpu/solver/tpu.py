@@ -53,20 +53,44 @@ class TPUSolver(Solver):
         #: solves (steady-state clusters reuse the same compiled kernel)
         self._bucket = min(256, n_max)
         self._cpu_fallback = CPUSolver()
+        #: optional metrics registry (operator injects); fallbacks to the
+        #: sequential oracle are a perf cliff and must never be silent
+        self.metrics = None
+
+    def _oracle_fallback(self, snapshot: SchedulingSnapshot,
+                         reason: str) -> SolveResult:
+        import logging
+        logging.getLogger(__name__).warning(
+            "TPU solver falling back to CPU oracle (%s): %d pods",
+            reason, len(snapshot.pods))
+        if self.metrics is not None:
+            self.metrics.inc("karpenter_solver_oracle_fallback_total",
+                             labels={"reason": reason})
+        return self._cpu_fallback.solve(snapshot)
 
     # ------------------------------------------------------------------
     def solve(self, snapshot: SchedulingSnapshot) -> SolveResult:
+        if not snapshot.pods:
+            return SolveResult(new_nodes=[], existing_assignments={},
+                               unschedulable={})
         topo = self._needs_topology(snapshot)
         if topo and self._topology_unsupported(snapshot):
             # cheap pre-scan: don't pay a full encode only to fall back
-            return self._cpu_fallback.solve(snapshot)
+            return self._oracle_fallback(snapshot, "unsupported-topology")
         enc = encode_snapshot(snapshot)
+        if not enc.types:
+            # T == 0 (e.g. consolidation's price-filtered deletion check
+            # empties every pool): no new nodes are possible, but pods may
+            # still land on existing nodes — the oracle handles the
+            # degenerate snapshot exactly and the device kernel cannot
+            # (zero-size type axis)
+            return self._oracle_fallback(snapshot, "empty-catalog")
         existing = sorted(snapshot.existing_nodes, key=lambda n: n.name)
         if topo:
             from ..ops.topo import build_topo_encoding
             tenc = build_topo_encoding(enc, snapshot, existing)
             if not tenc.supported:
-                return self._cpu_fallback.solve(snapshot)
+                return self._oracle_fallback(snapshot, "unsupported-topology")
             ex_alloc, ex_used, ex_compat = self._encode_existing(enc, existing)
             takes, leftover, final = self._run_numpy(
                 enc, ex_alloc, ex_used, ex_compat,
@@ -228,7 +252,15 @@ class TPUSolver(Solver):
         arrays.update(ex_alloc=ex_alloc_p, ex_used0=ex_used_p,
                       ex_compat=ex_compat_p)
 
-        buf = pack_inputs1(arrays, T, Dp, Z, C, Gp, Ep, Pp)
+        # minValues floors (padded pools have zero floors — inert)
+        K, V, M = enc.mv_K, enc.mv_V, enc.mv_M
+        if K:
+            mv_floor_p = np.zeros((Pp, K), np.int64)
+            mv_floor_p[:P] = enc.mv_floor
+            arrays.update(mv_floor=mv_floor_p, mv_pairs_t=enc.mv_pairs_t,
+                          mv_pairs_v=enc.mv_pairs_v)
+
+        buf = pack_inputs1(arrays, T, Dp, Z, C, Gp, Ep, Pp, K, M)
 
         # --- bucketed new-node slots with overflow retry ------------------
         # Steady state needs far fewer than n_max slots; a small N keeps the
@@ -238,7 +270,7 @@ class TPUSolver(Solver):
         n_bucket = self._bucket
         while True:
             o_buf = self._dispatch(buf, T=T, D=Dp, Z=Z, C=C, G=Gp, E=Ep,
-                                   P=Pp, n_max=n_bucket)
+                                   P=Pp, K=K, V=V, M=M, n_max=n_bucket)
             out = unpack_outputs1(o_buf, T, Dp, Z, C, Gp, Ep, Pp, n_bucket)
             exhausted = (out["leftover"].sum() > 0
                          and int(out["num_nodes"][0]) >= n_bucket)

@@ -8,8 +8,10 @@ from karpenter_provider_aws_tpu.apis.requirements import (IN, NOT_IN,
                                                           Requirements)
 from karpenter_provider_aws_tpu.apis.resources import Resources
 from karpenter_provider_aws_tpu.cloudprovider import (InstanceType,
-                                                      InstanceTypes, Offering,
-                                                      Offerings, Overhead, usd)
+                                                      InstanceTypes,
+                                                      InsufficientCapacityError,
+                                                      Offering, Offerings,
+                                                      Overhead, usd)
 
 
 def mk_type(name, cpu_m, mem_gib, zones=("us-west-2a",), price=1_000_000,
@@ -81,8 +83,9 @@ def test_order_by_price_and_truncate():
 
 
 def test_truncate_honors_min_values():
-    # 5 families, cheapest 2 are both family "a" — minValues=3 on family must
-    # pull in extra types beyond the truncation limit.
+    # 3 families, cheapest 3 span only {a, b} — minValues=3 on family must
+    # swap coverage INTO the cap (never grow past it: instance.go:55,106
+    # keeps the launch set at MaxInstanceTypes).
     types = InstanceTypes([
         mk_type("a.small", 1000, 2, price=100_000, family="a"),
         mk_type("a.large", 2000, 4, price=110_000, family="a"),
@@ -91,14 +94,19 @@ def test_truncate_honors_min_values():
     ])
     reqs = Requirements([
         Requirement.new(L.INSTANCE_FAMILY, IN, ["a", "b", "c"], min_values=3)])
-    trunc = types.truncate(reqs, max_items=2)
+    trunc = types.truncate(reqs, max_items=3)
+    assert len(trunc) == 3
     families = {t.requirements[L.INSTANCE_FAMILY].any_value() for t in trunc}
     assert families == {"a", "b", "c"}
-    with pytest.raises(ValueError):
-        InstanceTypes(types[:2]).truncate(
-            Requirements([Requirement.new(L.INSTANCE_FAMILY, IN,
-                                          ["a", "b", "c"], min_values=3)]),
-            max_items=2)
+    # cheapest coverage wins: a.small (not a.large) fills the "a" slot
+    assert [t.name for t in trunc] == ["a.small", "b.large", "c.large"]
+    # floors that cannot fit inside the cap are a soft launch failure
+    # ("validating minValues" create error -> ICE retry semantics)
+    with pytest.raises(InsufficientCapacityError):
+        types.truncate(reqs, max_items=2)
+    # a candidate set that cannot satisfy the floor at all fails too
+    with pytest.raises(InsufficientCapacityError):
+        InstanceTypes(types[:2]).truncate(reqs, max_items=2)
 
 
 def test_worst_and_cheapest():

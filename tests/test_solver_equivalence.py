@@ -64,6 +64,35 @@ class TestBaselineConfigs:
         res = assert_equivalent(env.snapshot(pods, [tainted, plain]), solvers)
         assert not res.unschedulable
 
+    def test_min_values_floors(self, env, solvers):
+        """Pool minValues floors (karpenter.sh_nodepools.yaml:284): every
+        planned node's candidate set must keep >= floor distinct values,
+        identically across all three engines."""
+        pool = env.nodepool("mv", requirements=[
+            {"key": L.INSTANCE_FAMILY, "operator": "Exists", "minValues": 5}])
+        pods = make_pods(700, cpu="500m", memory="1Gi", prefix="mvx") \
+            + make_pods(60, cpu="2", memory="4Gi", prefix="mvy")
+        res = assert_equivalent(env.snapshot(pods, [pool]), solvers)
+        assert not res.unschedulable
+        for node in res.new_nodes:
+            fams = {t.split(".")[0] for t in node.instance_type_names}
+            assert len(fams) >= 5, (node.nodepool, sorted(fams))
+
+    def test_min_values_two_keys_and_unsatisfiable(self, env, solvers):
+        pool_ok = env.nodepool("mv2", requirements=[
+            {"key": L.INSTANCE_FAMILY, "operator": "Exists", "minValues": 3},
+            {"key": L.INSTANCE_SIZE, "operator": "Exists", "minValues": 2}])
+        # a floor no catalog can meet: pods must come back unschedulable,
+        # identically on every engine
+        pool_bad = env.nodepool("mv-bad", weight=100, requirements=[
+            {"key": L.INSTANCE_FAMILY, "operator": "In", "values": ["m5"],
+             "minValues": 2}])
+        pods = make_pods(150, cpu="1", memory="2Gi", prefix="mv2")
+        res = assert_equivalent(env.snapshot(pods, [pool_bad, pool_ok]),
+                                solvers)
+        assert not res.unschedulable
+        assert {n.nodepool for n in res.new_nodes} == {"mv2"}
+
     def test_config5_spot_od_weights_limits(self, env, solvers):
         spot_pool = env.nodepool("spot", weight=100, limits={"cpu": "40"},
                                  requirements=[{"key": L.CAPACITY_TYPE,
