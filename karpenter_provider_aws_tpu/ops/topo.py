@@ -252,10 +252,40 @@ class _Pour:
         self.touched: Set[int] = set()
         #: placement order: (slot, count) runs — pods of the group are
         #: assigned to slots in THIS order (the oracle stripes pods across
-        #: zones, so slot-order chunking would mis-assign identities)
-        self.runs: List[Tuple[int, int]] = []
+        #: zones, so slot-order chunking would mis-assign identities).
+        #: A committed periodic jump is compressed to one
+        #: ("cyc", pattern, k) entry = `pattern` repeated k times (decode
+        #: expands it with strided slices instead of k*len(pattern) runs).
+        self.runs: List[Tuple] = []
+        self._enforced_z = any(e for _, _, e in self.zsp)
+        #: per-pool static open-a-node arrays (see _open_new)
+        self._open_cache: Dict[int, object] = {}
+        #: headroom fast path: R's nonzero dims and A restricted to them,
+        #: computed once per group (ffd._headroom re-slices per call)
+        self._sel = self.R > 0
+        self._Rsel = self.R[self._sel]
+        self._Asel = enc.A[:, self._sel] if self._sel.any() else None
         #: (slot, zone, len, kind) event log for periodic-cycle detection
         self.event_log: List[Tuple[int, Optional[int], int, str]] = []
+
+    def _hr_new(self, used: np.ndarray) -> np.ndarray:
+        """[T] headroom of a slot with per-dim usage `used` (== ffd._headroom
+        for the A=[T,D] case, minus the per-call slicing of A)."""
+        if self._Asel is None:
+            return np.full(self.enc.A.shape[0], BIG, dtype=np.int64)
+        q = (self._Asel - used[self._sel]) // self._Rsel
+        return np.clip(q.min(axis=1), 0, BIG)
+
+    def _mv_cap(self, pi: int, cand: np.ndarray, hr: np.ndarray) -> int:
+        """minValues floor cap for taking pods on a pool-`pi` node whose
+        candidate types are `cand` with per-type headroom `hr` — the pour's
+        analog of the closed form's min_values_cap application
+        (ffd.fill_group_closed_form; core nodeclaim.Add SatisfiesMinValues).
+        Existing nodes (pi < 0) are exempt, as in the oracle."""
+        if pi < 0 or self.enc.mv_floor is None \
+                or not self.enc.mv_floor[pi].any():
+            return int(BIG)
+        return int(ffd.min_values_cap(self.enc, pi, cand, hr))
 
     def _ensure_slot(self, slot: int) -> None:
         """Materialize candidate types + headroom for one slot."""
@@ -278,9 +308,12 @@ class _Pour:
         if not cand.any():
             self.rem[slot] = 0
             return
-        hr = ffd._headroom(enc.A, st.used[slot][None, :], self.R)
+        hr = self._hr_new(st.used[slot])
         hr = np.where(cand, hr, 0)
-        self.rem[slot] = max(int(hr.max()) - int(self.take[slot]), 0)
+        rem = max(int(hr.max()) - int(self.take[slot]), 0)
+        if rem > 0:
+            rem = min(rem, self._mv_cap(int(st.pool[slot]), cand, hr))
+        self.rem[slot] = rem
 
     # -- dynamic topology predicates ------------------------------------
     def _zone_ok(self) -> np.ndarray:
@@ -488,7 +521,7 @@ class _Pour:
                     and zi not in touched_z:
                 # an untouched eligible zone: its count must not pin the
                 # min (delta>0 requires every eligible zone to advance)
-                if any(e for _, _, e in self.zsp):
+                if self._enforced_z:
                     return 0
         if k < 1:
             return 0
@@ -537,7 +570,7 @@ class _Pour:
             return 0
         # ---- commit k whole periods -----------------------------------
         pattern = [(slot, ln) for slot, _, ln, _ in ev]
-        self.runs.extend(pattern * k)
+        self.runs.append(("cyc", pattern, k))
         for slot, zi, ln, _ in ev:
             total = ln * k
             self.take[slot] += total
@@ -582,7 +615,7 @@ class _Pour:
         # zone admissibility
         zfix = ts.zfix[:n_act]
         dec = zfix >= 0
-        enforced_z = any(e for _, _, e in self.zsp)
+        enforced_z = self._enforced_z
         need_zone = enforced_z or bool(self.zaf)
         if need_zone:
             zmask = np.zeros(n_act, dtype=bool)
@@ -627,7 +660,7 @@ class _Pour:
                 if pi >= 0 else int(BIG)
             hcap = self._host_cap(slot)
             zi, decided = self._slot_zone(slot)
-            enforced_z = any(e for _, _, e in self.zsp)
+            enforced_z = self._enforced_z
             need_zone = enforced_z or bool(self.zaf)
             if decided:
                 room_z = self._zone_run_room(zi) \
@@ -685,9 +718,13 @@ class _Pour:
                                   & ct_mask[None, :]).any(axis=1)
         if not keep.any():
             return keep, 0
-        hr = ffd._headroom(self.enc.A, self.st.used[slot][None, :], self.R)
+        hr = self._hr_new(self.st.used[slot])
         hr = np.where(keep, hr, 0)
-        return keep, max(int(hr.max()) - int(self.take[slot]), 0)
+        rem_new = max(int(hr.max()) - int(self.take[slot]), 0)
+        if rem_new > 0:
+            rem_new = min(rem_new, self._mv_cap(int(self.st.pool[slot]),
+                                                keep, hr))
+        return keep, rem_new
 
     def _fix_slot_zone(self, slot: int, zi: int, keep: np.ndarray,
                        rem_new: int) -> None:
@@ -699,6 +736,32 @@ class _Pour:
         self.cand[slot] = keep
         self.rem[slot] = rem_new
 
+    def _open_pool_static(self, pi: int):
+        """Static (within one group's pour) open-a-node arrays for pool
+        `pi`: admission, zone/ct masks, candidate types, per-type headroom.
+        False = the pool can never open a node for this group."""
+        ent = self._open_cache.get(pi)
+        if ent is not None:
+            return ent
+        enc, g = self.enc, self.g
+        pe = enc.pools[pi]
+        ent = False
+        if enc.admit[g, pi]:
+            daemon = enc.daemon[g, pi]
+            agz_p = self.agz & pe.agz
+            agc_p = self.agc & pe.agc
+            if agz_p.any() and agc_p.any():
+                off_p = (enc.avail & agz_p[None, :, None]
+                         & agc_p[None, None, :]).any(axis=(1, 2))
+                cand_new = enc.F[g] & pe.type_rows & off_p
+                if cand_new.any():
+                    hr = self._hr_new(daemon)
+                    hr = np.where(cand_new, hr, 0)
+                    if int(hr.max()) >= 1:
+                        ent = (daemon, agz_p, agc_p, cand_new, hr)
+        self._open_cache[pi] = ent
+        return ent
+
     def _open_new(self, n_rem: int) -> int:
         st, enc, g = self.st, self.enc, self.g
         hcap = self._host_cap_new()
@@ -706,27 +769,15 @@ class _Pour:
             return 0
         for pe in enc.pools:
             pi = pe.index
-            if not enc.admit[g, pi]:
+            ent = self._open_pool_static(pi)
+            if ent is False:
                 continue
             budget = ffd._pool_budget(enc, st.pool_used, pi, self.R)
             if budget < 1:
                 continue
             if st.num_nodes >= st.N - st.E:
                 continue
-            daemon = enc.daemon[g, pi]
-            agz_p = self.agz & pe.agz
-            agc_p = self.agc & pe.agc
-            if not agz_p.any() or not agc_p.any():
-                continue
-            off_p = (enc.avail & agz_p[None, :, None]
-                     & agc_p[None, None, :]).any(axis=(1, 2))
-            cand_new = enc.F[g] & pe.type_rows & off_p
-            if not cand_new.any():
-                continue
-            hr = ffd._headroom(enc.A, daemon[None, :], self.R)
-            hr = np.where(cand_new, hr, 0)
-            if int(hr.max()) < 1:
-                continue
+            daemon, agz_p, agc_p, cand_new, hr = ent
             zi = None
             if self.zone_needed:
                 fit_types = cand_new & (hr >= 1)
@@ -752,6 +803,11 @@ class _Pour:
             st.used[slot] = daemon.copy()
             hr2 = np.where(keep, hr, 0)
             cap = int(hr2.max())
+            if cap >= 1:
+                # minValues floors bound the take exactly as in the closed
+                # form (a node whose surviving candidates can't keep the
+                # floors is unsatisfiable in this pool — core nodeclaim.Add)
+                cap = min(cap, self._mv_cap(pi, keep, hr2))
             if cap < 1:
                 # chosen zone has no capacity: the oracle would have failed
                 # fit first; treat as unsatisfiable in this pool
@@ -766,8 +822,8 @@ class _Pour:
             self.rem[slot] = cap
             self._slot_ready[slot] = True
             run_z = self._zone_run_room(zi) if (zi is not None and (
-                any(e for _, _, e in self.zsp) or self.zaf)) else int(BIG)
-            run = min(cap, self._host_cap_new(), budget, n_rem, run_z)
+                self._enforced_z or self.zaf)) else int(BIG)
+            run = min(cap, hcap, budget, n_rem, run_z)
             run = max(run, 1)
             self._commit(slot, zi, int(run), kind="new")
             return int(run)

@@ -57,6 +57,12 @@ class _Handler:
 
         from ..ops.hostpack import (STATIC_KEYS, in_layout_bool,
                                     in_layout_i64, layout_sizes, nwords)
+        if len(statics) == len(STATIC_KEYS) - 3:
+            # pre-minValues client (8 statics: T,D,Z,C,G,E,P,n_max): the
+            # floors feature is simply absent — K=V=M=0 solves identically,
+            # so a rolling upgrade with the server deployed first keeps
+            # serving old clients
+            statics = list(statics) + [0, 0, 0]
         if len(statics) != len(STATIC_KEYS):
             context.abort(grpc.StatusCode.INVALID_ARGUMENT,
                           f"expected {len(STATIC_KEYS)} statics, "

@@ -73,11 +73,14 @@ class TPUSolver(Solver):
         if not snapshot.pods:
             return SolveResult(new_nodes=[], existing_assignments={},
                                unschedulable={})
-        topo = self._needs_topology(snapshot)
-        if topo and self._topology_unsupported(snapshot):
-            # cheap pre-scan: don't pay a full encode only to fall back
-            return self._oracle_fallback(snapshot, "unsupported-topology")
         enc = encode_snapshot(snapshot)
+        # topology detection is per GROUP (~tens), not per pod (~50k): the
+        # pod-group signature includes spread/affinity terms, so the group
+        # representative is authoritative for every member
+        topo = any(
+            g.pods[0].topology_spread
+            or any(a.required for a in g.pods[0].pod_affinity)
+            for g in enc.groups)
         if not enc.types:
             # T == 0 (e.g. consolidation's price-filtered deletion check
             # empties every pool): no new nodes are possible, but pods may
@@ -102,34 +105,6 @@ class TPUSolver(Solver):
         else:
             takes, leftover, final = self._run_numpy(enc, ex_alloc, ex_used, ex_compat)
         return self._decode(enc, existing, takes, leftover, final)
-
-    @staticmethod
-    def _needs_topology(snapshot: SchedulingSnapshot) -> bool:
-        """Topology machinery is needed when any pod carries a spread /
-        (anti-)affinity constraint. Pods with only a scheduling_group record
-        membership, but with no constrained pod present nothing reads the
-        counters, so the plain path is exact."""
-        return any(p.topology_spread or any(a.required for a in p.pod_affinity)
-                   for p in snapshot.pods)
-
-    @staticmethod
-    def _topology_unsupported(snapshot: SchedulingSnapshot) -> bool:
-        """Mirror of ops.topo.build_topo_encoding's supported checks on the
-        raw pods, so unsupported snapshots skip encoding entirely."""
-        for p in snapshot.pods:
-            constrained = bool(p.topology_spread) or any(
-                a.required for a in p.pod_affinity)
-            if not constrained:
-                continue
-            for c in p.topology_spread:
-                if c.topology_key not in (L.ZONE, L.HOSTNAME):
-                    return True
-            for a in p.pod_affinity:
-                if a.required and a.topology_key not in (L.ZONE, L.HOSTNAME):
-                    return True
-            if p.scheduling_requirements().get(L.ZONE_ID) is not None:
-                return True
-        return False
 
     # ------------------------------------------------------------------
     def _encode_existing(self, enc: SnapshotEncoding,
@@ -312,9 +287,7 @@ class TPUSolver(Solver):
             if placement is None:
                 placement = [(int(s), int(takes[g.index, s]))
                              for s in np.nonzero(takes[g.index])[0]]
-            for slot, cnt in placement:
-                chunk = g.pods[off:off + cnt]
-                off += cnt
+            def place(slot, chunk):
                 if slot < E:
                     for p in chunk:
                         assignments[p.full_name()] = existing[slot].name
@@ -322,6 +295,30 @@ class TPUSolver(Solver):
                     slot_pods.setdefault(int(slot), []).extend(chunk)
                     if g.index not in slot_groups.setdefault(int(slot), []):
                         slot_groups[int(slot)].append(g.index)
+
+            for entry in placement:
+                if entry[0] == "cyc":
+                    # a committed periodic jump: `pattern` repeated k times.
+                    # Pods stripe round-robin over the pattern; entry j of
+                    # the pattern owns a strided slice of the pod list.
+                    _, pattern, k = entry
+                    d_n = sum(ln for _, ln in pattern)
+                    pos = 0
+                    for slot, ln in pattern:
+                        if ln == 1:
+                            chunk = g.pods[off + pos:off + d_n * k:d_n]
+                        else:
+                            chunk = []
+                            for p_i in range(k):
+                                base = off + pos + p_i * d_n
+                                chunk.extend(g.pods[base:base + ln])
+                        place(slot, chunk)
+                        pos += ln
+                    off += d_n * k
+                    continue
+                slot, cnt = entry
+                place(slot, g.pods[off:off + cnt])
+                off += cnt
             for p in g.pods[off:]:  # leftovers — could not be scheduled
                 unschedulable[p.full_name()] = "no capacity in any nodepool"
 
@@ -336,8 +333,10 @@ class TPUSolver(Solver):
             pz = np.where(enc.avail & zmask[None, :, None] & cmask[None, None, :],
                           enc.price, np.int64(1) << 62)
             best = pz.min(axis=(1, 2))
-            order = [i for i in np.nonzero(tmask)[0]]
-            order.sort(key=lambda i: (int(best[i]), enc.type_names[i]))
+            # (price, name) order: types are name-sorted in the encoding,
+            # so a stable argsort on price alone breaks ties by name
+            idx = np.nonzero(tmask)[0]
+            order = idx[np.argsort(best[idx], kind="stable")]
             reqs = pool.spec.nodepool.scheduling_requirements()
             for gi in slot_groups[slot]:
                 reqs = reqs.union(enc.groups[gi].reqs)

@@ -97,3 +97,38 @@ class TestSidecar:
                                 [env.nodepool("side2")])
             r = remote.solve(snap)
             assert not r.unschedulable
+
+
+class TestStaticsCompat:
+    def test_legacy_eight_statics_accepted(self, server, env):
+        """A pre-minValues client sends 8 statics (T,D,Z,C,G,E,P,n_max).
+        The upgraded server must default K=V=M=0 and solve — not abort —
+        so a rolling upgrade that deploys the server first keeps serving
+        old clients (the floors feature is simply absent for them)."""
+        snap = env.snapshot(make_pods(7, cpu="1", memory="2Gi",
+                                      prefix="lgcy"),
+                            [env.nodepool("legacy")])
+        captured = {}
+
+        class _Capture(TPUSolver):
+            def _dispatch(self, buf, **statics):
+                captured["buf"] = buf.copy()
+                captured["statics"] = dict(statics)
+                return super()._dispatch(buf, **statics)
+
+        want = _Capture(backend="jax", n_max=192).solve(snap)
+        st = captured["statics"]
+        assert st.get("K", 0) == 0  # no minValues in this snapshot
+        legacy = np.array(
+            [st[k] for k in ("T", "D", "Z", "C", "G", "E", "P", "n_max")],
+            dtype=np.int64)
+        client = SolverClient(server.address)
+        req = arena_pack({
+            "buf": np.ascontiguousarray(captured["buf"], dtype=np.int64),
+            "statics": legacy,
+        })
+        out = np.array(arena_unpack(client._solve(req, timeout=30.0))["out"])
+        assert out.size > 0
+        # and the modern 11-statics path returns the same buffer
+        modern = client.solve_buffer(captured["buf"], st)
+        assert np.array_equal(out, modern)

@@ -219,3 +219,38 @@ class TestTopologyFuzz:
             pools.append(env.nodepool(f"fzp{seed}b", weight=10,
                                       limits={"cpu": "30"}))
         assert_equivalent(env.snapshot(pods, pools), solvers)
+
+
+class TestMinValuesWithTopology:
+    """minValues floors must bind on the topology pour exactly as on the
+    closed form (core nodeclaim.Add SatisfiesMinValues; the floor rule of
+    karpenter.sh_nodepools.yaml:284)."""
+
+    def test_zone_spread_respects_min_values(self, env, solvers):
+        pods = make_pods(120, cpu="8", prefix="mvsp", group="mvsp",
+                         topology_spread=[zspread(1, group="mvsp")])
+        pool = env.nodepool("mvpool", requirements=[
+            {"key": L.INSTANCE_FAMILY, "operator": "Exists",
+             "minValues": 5}])
+        snap = env.snapshot(pods, [pool])
+        assert_equivalent(snap, solvers)
+        got = solvers[1].solve(snap)
+        assert got.new_nodes
+        for n in got.new_nodes:
+            fams = {t.split(".")[0] for t in n.instance_type_names}
+            assert len(fams) >= 5, f"minValues floor violated: {fams}"
+
+    def test_hostname_anti_affinity_respects_min_values(self, env, solvers):
+        pods = make_pods(6, cpu="4", prefix="mvanti", group="mvanti",
+                         pod_affinity=[PodAffinityTerm(
+                             topology_key=L.HOSTNAME, group="mvanti",
+                             anti=True)])
+        pool = env.nodepool("mvpool2", requirements=[
+            {"key": L.INSTANCE_FAMILY, "operator": "Exists",
+             "minValues": 3}])
+        snap = env.snapshot(pods, [pool])
+        assert_equivalent(snap, solvers)
+        got = solvers[1].solve(snap)
+        for n in got.new_nodes:
+            fams = {t.split(".")[0] for t in n.instance_type_names}
+            assert len(fams) >= 3
