@@ -25,6 +25,7 @@ Usage: python bench.py [--pods N] [--rounds N] [--backend jax|numpy]
 """
 
 import argparse
+import gc
 import json
 import statistics
 import sys
@@ -188,6 +189,11 @@ def run_solver_config(name, snap, backend, rounds):
     cpu_ms = (time.perf_counter() - t0) * 1000
     got = tpu.solve(snap)  # warms the jit cache
     identical = ref.decision_fingerprint() == got.decision_fingerprint()
+    # long-running-server GC posture (the daemon does the same): promote
+    # the warm state out of the collector so steady-state rounds are not
+    # punctuated by gen2 pauses over the oracle's garbage
+    gc.collect()
+    gc.freeze()
     times = []
     for _ in range(rounds):
         t0 = time.perf_counter()
@@ -223,6 +229,8 @@ def run_config4(backend, rounds, n_nodes=200):
     cpu_ms = (time.perf_counter() - t0) * 1000
     got = ev.deletions_feasible(snaps)  # warms the jit cache
     identical = list(map(bool, got)) == ref
+    gc.collect()
+    gc.freeze()
     times = []
     for _ in range(rounds):
         t0 = time.perf_counter()
@@ -243,7 +251,8 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--pods", type=int, default=50_000)
     ap.add_argument("--rounds", type=int, default=100)
-    ap.add_argument("--backend", default="jax", choices=["jax", "numpy"])
+    ap.add_argument("--backend", default="auto",
+                    choices=["auto", "jax", "numpy"])
     ap.add_argument("--all", action="store_true",
                     help="run all 5 BASELINE configs (default: headline only)")
     ap.add_argument("--config", type=int, choices=[1, 2, 3, 4, 5],

@@ -1,0 +1,9 @@
+"""``python -m karpenter_provider_aws_tpu`` — the controller process
+(cmd/controller/main.go:28-74)."""
+
+import sys
+
+from .daemon import main
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

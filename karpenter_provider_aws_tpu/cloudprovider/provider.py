@@ -31,12 +31,13 @@ class CloudProvider:
                  instance_types: InstanceTypeProvider,
                  instances: InstanceProvider,
                  cluster_name: str = "cluster",
-                 clock=time.time):
+                 clock=time.time, recorder=None):
         self.kube = kube
         self.instance_types = instance_types
         self.instances = instances
         self.cluster_name = cluster_name
         self.clock = clock
+        self.recorder = recorder
 
     # -- Create (cloudprovider.go:82-120) ------------------------------
     def create(self, nodeclaim: NodeClaim) -> NodeClaim:
@@ -63,7 +64,13 @@ class CloudProvider:
             nc = self.kube.get("EC2NodeClass", nodeclaim.node_class_ref.name)
         except NotFound:
             # NodeClass gone => treat as ICE so core retries elsewhere
-            # (cloudprovider.go:83-89)
+            # (cloudprovider.go:83-89); surfaced as an event the way
+            # cloudprovider/events/events.go publishes it
+            if self.recorder is not None:
+                from ..utils.events import failed_resolving_nodeclass
+                failed_resolving_nodeclass(
+                    self.recorder, "NodeClaim", nodeclaim.name,
+                    nodeclaim.node_class_ref.name)
             raise InsufficientCapacityError(
                 f"EC2NodeClass {nodeclaim.node_class_ref.name} not found")
         return nc  # type: ignore[return-value]
@@ -95,8 +102,17 @@ class CloudProvider:
 
     # -- GetInstanceTypes (cloudprovider.go:164-181) -------------------
     def get_instance_types(self, nodepool: NodePool) -> InstanceTypes:
-        nodeclass = self.kube.get("EC2NodeClass",
-                                  nodepool.template.node_class_ref.name)
+        try:
+            nodeclass = self.kube.get("EC2NodeClass",
+                                      nodepool.template.node_class_ref.name)
+        except NotFound:
+            # events.go NodePool variant: the pool is skipped, surface why
+            if self.recorder is not None:
+                from ..utils.events import failed_resolving_nodeclass
+                failed_resolving_nodeclass(
+                    self.recorder, "NodePool", nodepool.metadata.name,
+                    nodepool.template.node_class_ref.name)
+            raise
         return self.instance_types.list(nodeclass)  # type: ignore[arg-type]
 
     # -- Delete (cloudprovider.go:183-190) -----------------------------
@@ -127,6 +143,12 @@ class CloudProvider:
         if subnet_ids and instance.subnet_id \
                 and instance.subnet_id not in subnet_ids:
             return self.DRIFT_SUBNET
+        # Security-group drift: the instance's attached SGs no longer equal
+        # the NodeClass's resolved set (drift.go areSecurityGroupsDrifted)
+        sg_ids = {g["id"] for g in nodeclass.status_security_groups}
+        attached = set(instance.security_group_ids or [])
+        if sg_ids and attached and attached != sg_ids:
+            return self.DRIFT_SECURITY_GROUP
         # Static-field drift: hash annotation mismatch (versioned)
         ann = nodeclaim.metadata.annotations
         if ann.get(L.EC2NODECLASS_HASH_VERSION_ANNOTATION) == L.EC2NODECLASS_HASH_VERSION \

@@ -31,11 +31,17 @@ REGISTRATION_TTL = 15 * 60  # core: claims that never register are reaped
 class NodeClaimLifecycle:
     def __init__(self, kube: FakeKube, cloudprovider: CloudProvider,
                  instance_types: Optional[InstanceTypeProvider] = None,
-                 clock=time.time):
+                 clock=time.time, recorder=None):
         self.kube = kube
         self.cloudprovider = cloudprovider
         self.instance_types = instance_types
         self.clock = clock
+        self.recorder = recorder
+
+    def _event_launch_failed(self, claim, message: str) -> None:
+        if self.recorder is not None:
+            from ..utils.events import launch_failed
+            launch_failed(self.recorder, claim.name, message)
 
     def reconcile(self) -> dict:
         stats = {"launched": 0, "registered": 0, "initialized": 0,
@@ -57,6 +63,7 @@ class NodeClaimLifecycle:
                     if self._initialize(claim):
                         stats["initialized"] += 1
             except InsufficientCapacityError as e:
+                self._event_launch_failed(claim, str(e))
                 # ICE: delete the claim; the offending offerings are already
                 # blacklisted so the next solve avoids them (SURVEY §5)
                 log.info("nodeclaim %s ICE: %s", claim.name, e)
@@ -65,6 +72,7 @@ class NodeClaimLifecycle:
                 self._force_delete_claim(claim)
                 stats["failed"] += 1
             except CloudProviderError as e:
+                self._event_launch_failed(claim, str(e))
                 log.warning("nodeclaim %s launch error: %s", claim.name, e)
                 claim.set_condition("Launched", "False", "Error", str(e),
                                     self.clock())

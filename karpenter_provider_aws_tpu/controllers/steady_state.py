@@ -154,12 +154,14 @@ ACTIONABLE_KINDS = {"spot_interruption", "rebalance_recommendation",
 
 class InterruptionController:
     def __init__(self, kube: FakeKube, sqs: SQSProvider,
-                 unavailable_offerings, metrics=None, clock=time.time):
+                 unavailable_offerings, metrics=None, clock=time.time,
+                 recorder=None):
         self.kube = kube
         self.sqs = sqs
         self.unavailable = unavailable_offerings
         self.metrics = metrics
         self.clock = clock
+        self.recorder = recorder
 
     def reconcile(self) -> Dict[str, int]:
         stats = {"handled": 0, "cordoned": 0, "noop": 0}
@@ -195,10 +197,27 @@ class InterruptionController:
             if itype and zone:
                 self.unavailable.mark_unavailable(
                     L.CAPACITY_TYPE_SPOT, itype, zone, reason="SpotInterruption")
+        self._publish_events(msg, claim)
         if msg.kind in ACTIONABLE_KINDS:
             # CordonAndDrain: delete the claim; termination drains + replaces
             self.kube.delete("NodeClaim", claim.metadata.name)
             stats["cordoned"] += 1
+
+    def _publish_events(self, msg: InterruptionMessage, claim) -> None:
+        """interruption/events parity: surface what hit the node. Only
+        actionable kinds reach here (the caller returns early otherwise),
+        and every one of them ends in cordon-and-drain."""
+        if self.recorder is None:
+            return
+        from ..utils import events as ev
+        name = claim.metadata.name
+        if msg.kind == "spot_interruption":
+            ev.spot_interrupted(self.recorder, name)
+        elif msg.kind == "rebalance_recommendation":
+            ev.rebalance_recommendation(self.recorder, name)
+        elif msg.kind == "state_change":
+            ev.instance_stopping(self.recorder, name)
+        ev.terminating_on_interruption(self.recorder, name)
 
 
 class CatalogController:

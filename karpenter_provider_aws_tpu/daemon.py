@@ -1,0 +1,218 @@
+"""The operator daemon: a long-running process around the Operator wiring.
+
+The reference's entry point (cmd/controller/main.go:28-74) builds the
+operator, wires the cloud provider and cluster state, registers core + AWS
+controllers on one manager, and starts it with health/metrics endpoints
+served by the core operator. This daemon is that process:
+
+- `Daemon` registers every controller from operator.py on a
+  ControllerManager at the reference cadences (catalog/pricing 12h,
+  SSM invalidation 30m, version refresh 5m, GC 10s x 20 then 2m,
+  interruption long-poll, fast loops for provisioning/lifecycle),
+- serves /metrics (Prometheus text) and /healthz on an HTTP port,
+- optionally waits on a file lease before taking the controllers live
+  (the chart's 2-replica leader election analog),
+- shuts down gracefully on SIGTERM/SIGINT.
+
+Run it: ``python -m karpenter_provider_aws_tpu --cluster-name demo``.
+The cloud + kube behind it are the in-memory fakes (this framework's
+mocking boundary, pkg/fake in the reference); a real deployment would
+swap them behind the same provider seams.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from .manager import ControllerManager, FileLease
+from .operator import Operator
+from .options import Options
+
+log = logging.getLogger(__name__)
+
+#: reference cadences (seconds)
+CATALOG_REFRESH = 12 * 3600        # providers/instancetype/controller.go:59
+PRICING_REFRESH = 12 * 3600        # providers/pricing/controller.go:43
+SSM_INVALIDATION = 30 * 60         # ssm/invalidation/controller.go:55
+VERSION_REFRESH = 5 * 60           # providers/version/controller.go:45
+GC_INITIAL, GC_INITIAL_COUNT, GC_STEADY = 10.0, 20, 120.0
+#                                  # garbagecollection/controller.go:55-62
+INTERRUPTION_POLL = 0.5            # continuous long-poll loop
+FAST_LOOP = 1.0                    # pod-batch window for provisioning
+DISRUPTION_TICK = 10.0             # disruption controller tick
+NODECLASS_TICK = 10.0              # status reconciler (watch-driven in ref)
+HASH_TICK = 60.0
+CAPACITY_TICK = 60.0               # discovered-capacity (node watch in ref)
+TAGGER_TICK = 5.0                  # nodeclaim watch in ref
+
+
+class _MetricsHandler(BaseHTTPRequestHandler):
+    def do_GET(self):  # noqa: N802 - stdlib API
+        if self.path == "/metrics":
+            body = self.server.karpenter_daemon.operator.metrics.render().encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4; charset=utf-8")
+        elif self.path in ("/healthz", "/readyz"):
+            ok = self.server.karpenter_daemon.healthy()
+            body = b"ok" if ok else b"not ready"
+            self.send_response(200 if ok else 503)
+            self.send_header("Content-Type", "text/plain")
+        else:
+            body = b"not found"
+            self.send_response(404)
+            self.send_header("Content-Type", "text/plain")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, fmt, *args):  # quiet the default stderr spam
+        log.debug("http: " + fmt, *args)
+
+
+class Daemon:
+    def __init__(self, operator: Optional[Operator] = None,
+                 options: Optional[Options] = None,
+                 metrics_port: int = 8080,
+                 lease_path: str = "",
+                 solver: str = "cpu",
+                 simulate_kubelet: bool = True):
+        if operator is None:
+            sv, ev = self._build_solver(solver)
+            operator = Operator(options=options, solver=sv,
+                               consolidation_evaluator=ev)
+        self.operator = operator
+        self.manager = ControllerManager(metrics=operator.metrics)
+        self.metrics_port = metrics_port
+        self.simulate_kubelet = simulate_kubelet
+        self.lease: Optional[FileLease] = \
+            FileLease(lease_path) if lease_path else None
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._http_thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._register_controllers()
+
+    @staticmethod
+    def _build_solver(name: str):
+        """(solver, consolidation evaluator) for --solver cpu|tpu."""
+        if name == "tpu":
+            from .solver.consolidation import TPUConsolidationEvaluator
+            from .solver.tpu import TPUSolver
+            return TPUSolver(backend="jax"), TPUConsolidationEvaluator()
+        from .solver.cpu import CPUSolver
+        return CPUSolver(), None
+
+    # ------------------------------------------------------------------
+    def _register_controllers(self) -> None:
+        op = self.operator
+        reg = self.manager.register
+        # fast loops: the provision->launch->join->initialize chain
+        reg("provisioner", op.provisioner.reconcile, FAST_LOOP)
+        reg("nodeclaim.lifecycle", op.lifecycle.reconcile, FAST_LOOP)
+        reg("nodeclaim.termination", op.terminator.reconcile, FAST_LOOP)
+        if self.simulate_kubelet:
+            reg("fake.kubelet", op.kubelet.tick, FAST_LOOP)
+        # steady state (controllers.go:63-101 cadences)
+        reg("nodeclass.status", op.nodeclass_status.reconcile, NODECLASS_TICK)
+        reg("nodeclass.hash", op.nodeclass_hash.reconcile, HASH_TICK)
+        reg("nodeclaim.tagging", op.tagger.reconcile, TAGGER_TICK)
+        reg("nodeclaim.garbagecollection", op.gc.reconcile, GC_STEADY,
+            initial_interval=GC_INITIAL, initial_count=GC_INITIAL_COUNT)
+        reg("disruption", op.disruption.reconcile, DISRUPTION_TICK)
+        reg("providers.instancetype", op.catalog_controller.reconcile,
+            CATALOG_REFRESH)
+        reg("providers.pricing", op.pricing_controller.reconcile,
+            PRICING_REFRESH)
+        reg("providers.instancetype.capacity",
+            op.discovered_capacity.reconcile, CAPACITY_TICK)
+        reg("providers.ssm.invalidation", op.ssm_invalidation.reconcile,
+            SSM_INVALIDATION)
+        reg("providers.version", op.version_controller.reconcile,
+            VERSION_REFRESH)
+        if op.options.interruption_queue:
+            reg("interruption", op.interruption.reconcile, INTERRUPTION_POLL)
+
+    # ------------------------------------------------------------------
+    def healthy(self) -> bool:
+        return self.manager.running
+
+    def start(self) -> "Daemon":
+        """Serve endpoints, wait for the lease (if any), start reconciling."""
+        import gc
+        gc.collect()
+        gc.freeze()  # long-running-server posture: boot state never re-scanned
+        self._serve_http()
+        if self.lease is not None:
+            log.info("waiting for leader lease %s", self.lease.path)
+            if not self.lease.acquire(stop=self._stop):
+                return self  # stopped while waiting
+            log.info("acquired leader lease as %s", self.lease.identity)
+        self.manager.start()
+        return self
+
+    def run(self) -> None:
+        """start() + block until SIGTERM/SIGINT (the __main__ path)."""
+        signal.signal(signal.SIGTERM, self._on_signal)
+        signal.signal(signal.SIGINT, self._on_signal)
+        self.start()
+        self._stop.wait()
+        self.shutdown()
+
+    def _on_signal(self, signum, frame) -> None:
+        log.info("received signal %d, shutting down", signum)
+        self._stop.set()
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        self.manager.stop()
+        if self.lease is not None:
+            self.lease.release()
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+
+    # ------------------------------------------------------------------
+    def _serve_http(self) -> None:
+        if self.metrics_port < 0:
+            return
+        self._httpd = ThreadingHTTPServer(
+            ("127.0.0.1", self.metrics_port), _MetricsHandler)
+        self._httpd.karpenter_daemon = self
+        self.metrics_port = self._httpd.server_address[1]  # resolve :0
+        self._http_thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="metrics-http")
+        self._http_thread.start()
+        log.info("metrics on http://127.0.0.1:%d/metrics", self.metrics_port)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="karpenter-provider-aws-tpu",
+        description="Run the operator daemon against the in-memory cloud.")
+    Options.add_flags(parser)
+    parser.add_argument("--metrics-port", type=int, default=8080,
+                        help="metrics/health port (0 = ephemeral, -1 = off)")
+    parser.add_argument("--leader-elect-lease", default="",
+                        help="file lease path enabling leader election")
+    parser.add_argument("--solver", choices=["cpu", "tpu"], default="cpu",
+                        help="provisioning solver backend")
+    parser.add_argument("--log-level", default="INFO")
+    import sys as _sys
+    if argv is None:
+        argv = _sys.argv[1:]
+    ns = parser.parse_args(argv)
+    logging.basicConfig(
+        level=ns.log_level.upper(),
+        format="%(asctime)s %(levelname)s %(name)s %(message)s")
+    options = Options.parse(argv)
+    daemon = Daemon(options=options, metrics_port=ns.metrics_port,
+                    lease_path=ns.leader_elect_lease, solver=ns.solver)
+    daemon.run()
+    return 0

@@ -85,6 +85,7 @@ class FakeInstance:
     launch_time: float = 0.0
     tags: Dict[str, str] = field(default_factory=dict)
     provider_id: str = ""
+    security_group_ids: List[str] = field(default_factory=list)
 
     def __post_init__(self):
         if not self.provider_id:
@@ -329,7 +330,18 @@ class FakeEC2:
                     })
                     continue
                 lt = self.launch_templates.get(o["launch_template_name"])
-                image_id = o.get("image_id") or (lt.image_id if lt else "")
+                if lt is None:
+                    # the reference surfaces this as a fleet error the
+                    # launcher retries once after re-ensuring templates
+                    # (instance.go:111-115)
+                    errors.append({
+                        "code": "InvalidLaunchTemplateName.NotFoundException",
+                        "instance_type": o["instance_type"],
+                        "zone": o["zone"],
+                        "capacity_type": capacity_type,
+                    })
+                    continue
+                image_id = o.get("image_id") or lt.image_id
                 zone_id = next((z.zone_id for z in self.zones if z.name == o["zone"]), "")
                 while remaining > 0:
                     inst = FakeInstance(
@@ -339,8 +351,8 @@ class FakeEC2:
                         launch_template_name=o["launch_template_name"],
                         subnet_id=o.get("subnet_id", ""),
                         launch_time=self.now(),
-                        tags={**(dict(lt.tags) if lt else {}),
-                              **dict(tags or {})})
+                        tags={**dict(lt.tags), **dict(tags or {})},
+                        security_group_ids=list(lt.security_group_ids))
                     self.instances[inst.id] = inst
                     instances.append(inst)
                     remaining -= 1

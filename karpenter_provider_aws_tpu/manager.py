@@ -1,0 +1,247 @@
+"""Controller manager: per-controller cadences on one reconcile loop.
+
+The reference registers every controller on a single controller-runtime
+manager (cmd/controller/main.go:42-73, pkg/controllers/controllers.go:63-101)
+where each controller requeues at its own cadence — 12h catalog/pricing
+refresh (providers/instancetype/controller.go:59), 30m SSM invalidation
+(ssm/invalidation/controller.go:55), 10s x 20 then 2m garbage collection
+(nodeclaim/garbagecollection/controller.go:55-90), continuous SQS long-poll
+interruption (interruption/controller.go:94-134).
+
+This manager is the Python analog: controllers register with an interval
+(optionally a warm-up schedule like GC's), a binary heap orders due times,
+and one worker thread runs reconciles sequentially — the same effective
+concurrency as one manager whose controllers each have
+MaxConcurrentReconciles=1. Parallelism *within* a reconcile (the
+reference's workqueue.ParallelizeUntil fan-outs) belongs to the individual
+controllers. Every reconcile is wrapped with duration/error metrics and
+panic isolation, matching controller-runtime's recovery behavior.
+
+Leader election (charts/karpenter/values.yaml:38 runs 2 replicas with
+leader election) is a file lease: acquire-or-steal-on-expiry with a
+heartbeat, so an active/passive replica pair can share a node.
+"""
+
+from __future__ import annotations
+
+import heapq
+import json
+import logging
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional
+
+log = logging.getLogger(__name__)
+
+
+@dataclass(order=True)
+class _Entry:
+    due: float
+    seq: int
+    name: str = field(compare=False)
+    reconcile: Callable = field(compare=False)
+    interval: float = field(compare=False)
+    initial_interval: Optional[float] = field(compare=False, default=None)
+    initial_count: int = field(compare=False, default=0)
+    fired: int = field(compare=False, default=0)
+
+    def next_delay(self) -> float:
+        """Warm-up schedule: `initial_interval` for the first
+        `initial_count` fires, then the steady `interval` (GC's 10s x 20
+        then 2m — garbagecollection/controller.go:55-62)."""
+        if self.initial_interval is not None \
+                and self.fired < self.initial_count:
+            return self.initial_interval
+        return self.interval
+
+
+class ControllerManager:
+    def __init__(self, metrics=None, clock=time.monotonic):
+        self._metrics = metrics
+        self._clock = clock
+        self._heap: List[_Entry] = []
+        self._seq = 0
+        self._stop = threading.Event()
+        self._wake = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._mu = threading.Lock()
+
+    def register(self, name: str, reconcile: Callable[[], object],
+                 interval: float, *, initial_interval: Optional[float] = None,
+                 initial_count: int = 0, immediate: bool = True) -> None:
+        """Register a controller. `immediate` fires the first reconcile at
+        start (the reference hydrates catalog/pricing/version at boot —
+        operator.go:152-155 — and every singleton reconciles on start)."""
+        with self._mu:
+            self._seq += 1
+            due = self._clock() if immediate else \
+                self._clock() + (initial_interval if initial_interval
+                                 is not None else interval)
+            heapq.heappush(self._heap, _Entry(
+                due=due, seq=self._seq, name=name, reconcile=reconcile,
+                interval=interval, initial_interval=initial_interval,
+                initial_count=initial_count))
+        self._wake.set()
+
+    # ------------------------------------------------------------------
+    def start(self) -> "ControllerManager":
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="controller-manager")
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 10.0) -> None:
+        self._stop.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    # ------------------------------------------------------------------
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            with self._mu:
+                entry = self._heap[0] if self._heap else None
+            if entry is None:
+                self._wake.wait(0.2)
+                self._wake.clear()
+                continue
+            delay = entry.due - self._clock()
+            if delay > 0:
+                self._wake.wait(min(delay, 1.0))
+                self._wake.clear()
+                continue
+            with self._mu:
+                entry = heapq.heappop(self._heap)
+            self._reconcile_one(entry)
+            entry.fired += 1
+            entry.due = self._clock() + entry.next_delay()
+            with self._mu:
+                heapq.heappush(self._heap, entry)
+
+    def _reconcile_one(self, entry: _Entry) -> None:
+        t0 = self._clock()
+        try:
+            entry.reconcile()
+        except Exception:  # noqa: BLE001 - reconcile panics must not kill
+            # the manager; controller-runtime recovers and requeues
+            log.exception("reconcile %s failed", entry.name)
+            if self._metrics is not None:
+                self._metrics.inc(
+                    "karpenter_controller_reconcile_errors_total",
+                    labels={"controller": entry.name})
+        finally:
+            if self._metrics is not None:
+                self._metrics.observe(
+                    "karpenter_controller_reconcile_duration_seconds",
+                    self._clock() - t0, labels={"controller": entry.name})
+
+
+# ---------------------------------------------------------------------------
+# leader election
+# ---------------------------------------------------------------------------
+
+class FileLease:
+    """File-based lease lock: the HA analog of the chart's 2-replica
+    leader election (charts/karpenter/values.yaml:38). Acquire by O_EXCL
+    create; steal only when the holder's heartbeat is older than the TTL;
+    renew on a heartbeat thread while held."""
+
+    def __init__(self, path: str, identity: str = "",
+                 ttl: float = 15.0, clock=time.time):
+        self.path = path
+        self.identity = identity or f"pid-{os.getpid()}"
+        self.ttl = ttl
+        self._clock = clock
+        self._held = False
+        self._hb: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    def _read(self) -> Optional[dict]:
+        try:
+            with open(self.path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def _write(self) -> None:
+        tmp = f"{self.path}.{self.identity}.tmp"
+        with open(tmp, "w") as f:
+            json.dump({"holder": self.identity,
+                       "renewed": self._clock()}, f)
+        os.replace(tmp, self.path)
+
+    def try_acquire(self) -> bool:
+        if self._held:
+            return True
+        try:
+            fd = os.open(self.path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            os.close(fd)
+            self._write()
+            self._held = True
+        except FileExistsError:
+            cur = self._read()
+            if cur is not None and cur.get("holder") == self.identity:
+                self._held = True  # our own stale lease (restart)
+                self._write()
+            elif cur is None or \
+                    self._clock() - cur.get("renewed", 0) > self.ttl:
+                # expired: steal — but N standbys race here, and os.replace
+                # makes last-writer-wins, so re-read to learn who actually
+                # won before claiming leadership (split-brain guard)
+                self._write()
+                winner = self._read()
+                self._held = (winner is not None
+                              and winner.get("holder") == self.identity)
+        if self._held:
+            self._stop.clear()
+            self._hb = threading.Thread(target=self._heartbeat, daemon=True,
+                                        name="lease-heartbeat")
+            self._hb.start()
+        return self._held
+
+    def acquire(self, poll: float = 1.0,
+                stop: Optional[threading.Event] = None) -> bool:
+        """Block until the lease is held (or `stop` is set)."""
+        while not (stop and stop.is_set()):
+            if self.try_acquire():
+                return True
+            time.sleep(poll)
+        return False
+
+    def _heartbeat(self) -> None:
+        while not self._stop.wait(self.ttl / 3):
+            if not self._held:
+                continue
+            # renew only while the file still names us: a heartbeat that
+            # blindly rewrites would re-steal a lease another replica won
+            cur = self._read()
+            if cur is not None and cur.get("holder") == self.identity:
+                self._write()
+            else:
+                self._held = False  # lost the lease; stop acting as leader
+
+    def release(self) -> None:
+        self._stop.set()
+        if self._hb is not None:
+            self._hb.join(1.0)
+            self._hb = None
+        if self._held:
+            cur = self._read()
+            if cur is not None and cur.get("holder") == self.identity:
+                try:
+                    os.unlink(self.path)
+                except OSError:
+                    pass
+            self._held = False
+
+    @property
+    def held(self) -> bool:
+        return self._held

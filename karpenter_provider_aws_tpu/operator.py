@@ -44,6 +44,7 @@ from .providers.ssm import SSMProvider
 from .solver.cpu import CPUSolver
 from .solver.types import Solver
 from .state.cluster import ClusterState
+from .utils.events import Recorder
 from .utils.metrics import Metrics
 
 
@@ -62,6 +63,7 @@ class Operator:
         self.ec2 = ec2 or FakeEC2()
         self.kube = FakeKube(now=clock)
         self.metrics = Metrics()
+        self.recorder = Recorder(clock=clock)
 
         # providers (operator.go:139-186)
         self.unavailable_offerings = UnavailableOfferings()
@@ -90,16 +92,23 @@ class Operator:
         # the plugin boundary + core state (main.go:31-40)
         self.cloudprovider = CloudProvider(
             self.kube, self.instance_types, self.instances,
-            cluster_name=self.options.cluster_name, clock=clock)
+            cluster_name=self.options.cluster_name, clock=clock,
+            recorder=self.recorder)
         self.state = ClusterState(self.kube, clock=clock)
 
         # controllers (controllers.go:63-101 + core)
         self.solver = solver or CPUSolver()
+        if hasattr(self.solver, "metrics"):
+            self.solver.metrics = self.metrics
+        if consolidation_evaluator is not None \
+                and hasattr(consolidation_evaluator, "metrics"):
+            consolidation_evaluator.metrics = self.metrics
         self.provisioner = Provisioner(self.kube, self.state,
                                        self.cloudprovider, self.solver,
                                        metrics=self.metrics, clock=clock)
         self.lifecycle = NodeClaimLifecycle(self.kube, self.cloudprovider,
-                                            self.instance_types, clock=clock)
+                                            self.instance_types, clock=clock,
+                                            recorder=self.recorder)
         self.terminator = Terminator(self.kube, self.cloudprovider, clock=clock)
         self.nodeclass_status = NodeClassStatusController(
             self.kube, self.subnets, self.security_groups, self.amis,
@@ -109,7 +118,7 @@ class Operator:
                              cluster_name=self.options.cluster_name)
         self.interruption = InterruptionController(
             self.kube, self.sqs, self.unavailable_offerings,
-            metrics=self.metrics, clock=clock)
+            metrics=self.metrics, clock=clock, recorder=self.recorder)
         self.catalog_controller = CatalogController(self.ec2, self.instance_types)
         self.pricing_controller = PricingController(self.pricing)
         self.nodeclass_hash = NodeClassHashController(self.kube)

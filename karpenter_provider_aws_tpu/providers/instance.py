@@ -48,6 +48,7 @@ class LaunchedInstance:
     tags: Dict[str, str]
     state: str = "running"
     launch_time: float = 0.0
+    security_group_ids: List[str] = None
 
 
 class InstanceProvider:
@@ -82,37 +83,47 @@ class InstanceProvider:
             log.warning("launching with only %d instance type options (<%d): "
                         "flexibility is degraded", len(types), MIN_FLEXIBLE_TYPES)
         zonal_subnets = self.subnets.zonal_subnets_for_launch(nodeclass)
-        lts = self.launch_templates.ensure_all(
-            nodeclass, types,
-            labels=dict(nodeclaim.metadata.labels),
-            taints=nodeclaim.taints)
-        overrides = self._overrides(types, reqs, capacity_type, zonal_subnets, lts)
-        if not overrides:
-            raise InsufficientCapacityError(
-                f"no (type x zone x subnet) overrides for {nodeclaim.name}")
-        configs = _group_overrides(overrides)
-        fut = self.create_fleet.add(CreateFleetRequest(
-            launch_template_configs=to_hashable(configs),
-            capacity_type=capacity_type,
-            tags=to_hashable(tags or {})))
-        instance, errors = fut.result(timeout=30)
-        for err in errors:
-            # ICE -> blacklist the offering for 3m; feeds the next Solve
-            self.unavailable.mark_unavailable(
-                err["capacity_type"], err["instance_type"], err["zone"],
-                reason=err["code"])
+        # launch-template-not-found retries ONCE: the template can be
+        # deleted between EnsureAll and CreateFleet (cache eviction or an
+        # external cleanup); invalidate and re-ensure (instance.go:111-115)
+        for attempt in range(2):
+            lts = self.launch_templates.ensure_all(
+                nodeclass, types,
+                labels=dict(nodeclaim.metadata.labels),
+                taints=nodeclaim.taints)
+            overrides = self._overrides(types, reqs, capacity_type,
+                                        zonal_subnets, lts)
+            if not overrides:
+                raise InsufficientCapacityError(
+                    f"no (type x zone x subnet) overrides for {nodeclaim.name}")
+            configs = _group_overrides(overrides)
+            fut = self.create_fleet.add(CreateFleetRequest(
+                launch_template_configs=to_hashable(configs),
+                capacity_type=capacity_type,
+                tags=to_hashable(tags or {})))
+            instance, errors = fut.result(timeout=30)
+            lt_gone = [e for e in errors if is_launch_template_not_found(
+                e["code"])]
+            for err in errors:
+                if is_launch_template_not_found(err["code"]):
+                    continue  # not a capacity signal
+                # ICE -> blacklist the offering for 3m; feeds the next Solve
+                self.unavailable.mark_unavailable(
+                    err["capacity_type"], err["instance_type"], err["zone"],
+                    reason=err["code"])
+            if instance is None and lt_gone and attempt == 0:
+                log.info("launch templates disappeared mid-launch for %s; "
+                         "re-ensuring and retrying once", nodeclaim.name)
+                self.launch_templates.invalidate(
+                    {cfg["launch_template_name"] for cfg in configs})
+                continue
+            break
         if instance is None:
             raise InsufficientCapacityError(
                 "CreateFleet returned no instance: "
                 + "; ".join(e["code"] for e in errors))
         self.subnets.update_inflight_ips(instance.subnet_id)
-        return LaunchedInstance(
-            id=instance.id, instance_type=instance.instance_type,
-            zone=instance.zone, zone_id=instance.zone_id,
-            capacity_type=instance.capacity_type, image_id=instance.image_id,
-            provider_id=instance.provider_id, subnet_id=instance.subnet_id,
-            tags=dict(instance.tags), state=instance.state,
-            launch_time=instance.launch_time)
+        return _to_launched(instance)
 
     # -- read/delete ---------------------------------------------------
     def get(self, instance_id: str) -> LaunchedInstance:
@@ -280,10 +291,17 @@ def _group_overrides(overrides: List[dict]) -> List[dict]:
             for name, ovs in sorted(by_lt.items())]
 
 
+def is_launch_template_not_found(code: str) -> bool:
+    """errors.go IsLaunchTemplateNotFound classification."""
+    return code in ("InvalidLaunchTemplateName.NotFoundException",
+                    "InvalidLaunchTemplateId.NotFound")
+
+
 def _to_launched(inst) -> LaunchedInstance:
     return LaunchedInstance(
         id=inst.id, instance_type=inst.instance_type, zone=inst.zone,
         zone_id=inst.zone_id, capacity_type=inst.capacity_type,
         image_id=inst.image_id, provider_id=inst.provider_id,
         subnet_id=inst.subnet_id, tags=dict(inst.tags), state=inst.state,
-        launch_time=inst.launch_time)
+        launch_time=inst.launch_time,
+        security_group_ids=list(getattr(inst, "security_group_ids", []) or []))

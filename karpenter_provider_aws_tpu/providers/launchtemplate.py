@@ -104,6 +104,13 @@ class LaunchTemplateProvider:
         })
         return f"{LT_NAME_PREFIX}/{nodeclass.metadata.name}/{h}"
 
+    def invalidate(self, names) -> None:
+        """Drop cached templates so the next ensure_all recreates them
+        (the launcher's LT-not-found retry path, instance.go:111-115)."""
+        with self._mu:
+            for n in names:
+                self._cache.delete(n)
+
     def delete_for_nodeclass(self, nodeclass: EC2NodeClass) -> int:
         """NodeClass deletion -> drop its templates (launchtemplate.go:373-390)."""
         prefix = f"{LT_NAME_PREFIX}/{nodeclass.metadata.name}/"

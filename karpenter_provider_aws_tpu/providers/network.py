@@ -82,6 +82,10 @@ class SecurityGroupProvider:
         self.ec2 = ec2
         self._cache = TTLCache(ttl=DEFAULT_TTL, clock=clock)
 
+    def invalidate(self) -> None:
+        """Drop cached discovery (tests / forced refresh)."""
+        self._cache.clear()
+
     def list(self, nodeclass: EC2NodeClass) -> List[str]:
         key = tuple(nodeclass.security_group_selector_terms)
         cached = self._cache.get(key)

@@ -35,6 +35,7 @@ from ..controllers.disruption import ConsolidationEvaluator
 from ..models.encoding import canonical_pod_groups
 from ..solver.types import ExistingNode
 from .cpu import CPUSolver
+from .route import Router, routed
 from .types import SchedulingSnapshot, Solver
 
 
@@ -43,10 +44,22 @@ def _pow2(x: int) -> int:
 
 
 class TPUConsolidationEvaluator(ConsolidationEvaluator):
-    def __init__(self, solver: Optional[Solver] = None, backend: str = "jax"):
+    def __init__(self, solver: Optional[Solver] = None,
+                 backend: str = "auto"):
         super().__init__(solver or CPUSolver())
-        assert backend in ("jax", "numpy")
+        assert backend in ("auto", "jax", "numpy")
         self.backend = backend
+        #: optional metrics registry (operator injects, as on TPUSolver)
+        self.metrics = None
+        self._router = Router(name="consolidation")
+
+    def _routed(self, bucket, host_fn, dev_fn):
+        if self.backend == "numpy":
+            return host_fn()
+        if self.backend == "jax":
+            return dev_fn()
+        self._router.metrics = self.metrics
+        return routed(self._router, bucket, host_fn, dev_fn)
 
     # ------------------------------------------------------------------
     def deletions_feasible(
@@ -170,20 +183,21 @@ class TPUConsolidationEvaluator(ConsolidationEvaluator):
             for node in snap.existing_nodes:
                 alive[bi, npos[node.name]] = True
 
-        if self.backend == "numpy":
-            return self._numpy_shared(
-                ex_alloc, ex_used, compat_tab, R_tab, gid, cid, n,
-                alive)[:B]
+        def dev_fn():
+            import jax.numpy as jnp
 
-        import jax.numpy as jnp
+            from ..ops.consolidation_jax import deletions_feasible_kernel
+            return np.asarray(deletions_feasible_kernel(
+                jnp.asarray(ex_alloc), jnp.asarray(ex_used),
+                jnp.asarray(compat_tab), jnp.asarray(R_tab),
+                jnp.asarray(gid), jnp.asarray(cid), jnp.asarray(n),
+                jnp.asarray(alive)))
 
-        from ..ops.consolidation_jax import deletions_feasible_kernel
-        ok = deletions_feasible_kernel(
-            jnp.asarray(ex_alloc), jnp.asarray(ex_used),
-            jnp.asarray(compat_tab), jnp.asarray(R_tab),
-            jnp.asarray(gid), jnp.asarray(cid), jnp.asarray(n),
-            jnp.asarray(alive))
-        return np.asarray(ok)[:B]
+        return self._routed(
+            ("shared", Bp, Gp, Ep, Sp, Scp, Dp),
+            lambda: self._numpy_shared(ex_alloc, ex_used, compat_tab,
+                                       R_tab, gid, cid, n, alive),
+            dev_fn)[:B]
 
     @staticmethod
     def _numpy_shared(ex_alloc, ex_used, compat_tab, R_tab, gid, cid, n,
@@ -260,16 +274,18 @@ class TPUConsolidationEvaluator(ConsolidationEvaluator):
                         and all(t.tolerated_by(rep.tolerations)
                                 for t in node.taints))
 
-        if self.backend == "numpy":
-            return self._numpy_dense(ex_alloc, ex_used, ex_compat, R, n)[:B]
+        def dev_fn():
+            import jax.numpy as jnp
 
-        import jax.numpy as jnp
+            from ..ops.consolidation_jax import deletions_feasible_dense
+            return np.asarray(deletions_feasible_dense(
+                jnp.asarray(ex_alloc), jnp.asarray(ex_used),
+                jnp.asarray(ex_compat), jnp.asarray(R), jnp.asarray(n)))
 
-        from ..ops.consolidation_jax import deletions_feasible_dense
-        ok = deletions_feasible_dense(
-            jnp.asarray(ex_alloc), jnp.asarray(ex_used),
-            jnp.asarray(ex_compat), jnp.asarray(R), jnp.asarray(n))
-        return np.asarray(ok)[:B]
+        return self._routed(
+            ("dense", Bp, Gp, Ep, Dp),
+            lambda: self._numpy_dense(ex_alloc, ex_used, ex_compat, R, n),
+            dev_fn)[:B]
 
     @staticmethod
     def _numpy_dense(ex_alloc, ex_used, ex_compat, R, n) -> np.ndarray:

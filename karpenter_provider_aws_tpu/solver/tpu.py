@@ -26,6 +26,7 @@ from ..apis.resources import Resources
 from ..models.encoding import SnapshotEncoding, encode_snapshot
 from ..ops import ffd
 from .cpu import CPUSolver
+from .route import Router, routed
 from .types import (ExistingNode, NewNodeClaim, SchedulingSnapshot,
                     SolveResult, Solver)
 
@@ -38,17 +39,19 @@ def _slotmap(E: int, Ep: int, N: int) -> np.ndarray:
 class TPUSolver(Solver):
     name = "tpu"
 
-    def __init__(self, backend: str = "jax", n_max: int = 2048):
-        """backend: 'jax' (device scan kernel) or 'numpy' (host twin —
-        same math, useful for debugging and tiny snapshots).
+    def __init__(self, backend: str = "auto", n_max: int = 2048):
+        """backend: 'auto' (cost-routed, see solver/route.py), 'jax'
+        (always the device scan kernel) or 'numpy' (always the host twin —
+        same math, decision-identical by the equivalence suites).
 
         n_max bounds new-node slots per solve. If a solve would need more
         nodes than n_max, overflow pods come back unschedulable (the oracle
         would keep opening nodes) — size n_max well above the expected node
         count (default 2048 vs the 500-node scale envelope, SURVEY §6)."""
-        assert backend in ("jax", "numpy")
+        assert backend in ("auto", "jax", "numpy")
         self.backend = backend
         self.n_max = n_max
+        self._router = Router(name="solver")
         #: current new-node slot bucket; grows on overflow, sticky across
         #: solves (steady-state clusters reuse the same compiled kernel)
         self._bucket = min(256, n_max)
@@ -102,9 +105,27 @@ class TPUSolver(Solver):
         ex_alloc, ex_used, ex_compat = self._encode_existing(enc, existing)
         if self.backend == "jax":
             takes, leftover, final = self._run_jax(enc, ex_alloc, ex_used, ex_compat)
-        else:
+        elif self.backend == "numpy":
             takes, leftover, final = self._run_numpy(enc, ex_alloc, ex_used, ex_compat)
+        else:  # auto: route host twin vs device kernel by measured cost
+            self._router.metrics = self.metrics
+            takes, leftover, final = routed(
+                self._router, self._bucket_key(enc, ex_alloc.shape[0]),
+                lambda: self._run_numpy(enc, ex_alloc, ex_used, ex_compat),
+                lambda: self._run_jax(enc, ex_alloc, ex_used, ex_compat))
         return self._decode(enc, existing, takes, leftover, final)
+
+    @staticmethod
+    def _bucket_key(enc: SnapshotEncoding, E: int) -> Tuple:
+        """Shape bucket = the padded statics that key the XLA compile
+        cache (_run_jax's pow2 bucketing), so router stats live exactly as
+        long as a compiled kernel does."""
+        G, T = len(enc.groups), len(enc.types)
+        Gp = max(1, 1 << (G - 1).bit_length())
+        Ep = 1 << (E - 1).bit_length() if E else 0
+        Pp = max(1, 1 << (len(enc.pools) - 1).bit_length())
+        return (T, max(8, len(enc.dims)), len(enc.zones), Gp, Ep, Pp,
+                enc.mv_K)
 
     # ------------------------------------------------------------------
     def _encode_existing(self, enc: SnapshotEncoding,

@@ -1,0 +1,104 @@
+"""Kubernetes-events analog: the operational surface `kubectl describe`
+shows. Mirrors pkg/cloudprovider/events/events.go (NodePool/NodeClaim
+failed-to-resolve-NodeClass) and pkg/controllers/interruption/events
+(SpotInterrupted, RebalanceRecommendation, Stopping/Terminating) — the
+reference publishes through a record.EventRecorder; here a bounded ring
+buffer plays the API server's role so tests and the daemon can assert on
+and expose what happened."""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Deque, List, Optional
+
+NORMAL = "Normal"
+WARNING = "Warning"
+
+
+@dataclass(frozen=True)
+class Event:
+    kind: str          # involved object kind (NodeClaim/NodePool/Node/...)
+    name: str          # involved object name
+    type: str          # Normal | Warning
+    reason: str        # machine-readable camel-case reason
+    message: str
+    timestamp: float = field(default=0.0, compare=False)
+
+
+class Recorder:
+    def __init__(self, clock=time.time, capacity: int = 1000):
+        self._clock = clock
+        self._mu = threading.Lock()
+        self._events: Deque[Event] = deque(maxlen=capacity)
+
+    def publish(self, kind: str, name: str, reason: str, message: str,
+                type: str = NORMAL) -> Event:
+        ev = Event(kind=kind, name=name, type=type, reason=reason,
+                   message=message, timestamp=self._clock())
+        with self._mu:
+            self._events.append(ev)
+        return ev
+
+    # -- reads ----------------------------------------------------------
+    def events(self, kind: Optional[str] = None,
+               name: Optional[str] = None,
+               reason: Optional[str] = None) -> List[Event]:
+        with self._mu:
+            out = list(self._events)
+        if kind is not None:
+            out = [e for e in out if e.kind == kind]
+        if name is not None:
+            out = [e for e in out if e.name == name]
+        if reason is not None:
+            out = [e for e in out if e.reason == reason]
+        return out
+
+    def reasons(self) -> List[str]:
+        with self._mu:
+            return [e.reason for e in self._events]
+
+
+# -- reference event constructors (events.go shapes) ------------------------
+
+def spot_interrupted(recorder: Recorder, claim_name: str) -> None:
+    recorder.publish("NodeClaim", claim_name, "SpotInterrupted",
+                     f"NodeClaim {claim_name} event: A spot interruption "
+                     "warning was triggered for the node", WARNING)
+
+
+def rebalance_recommendation(recorder: Recorder, claim_name: str) -> None:
+    recorder.publish("NodeClaim", claim_name, "SpotRebalanceRecommendation",
+                     f"NodeClaim {claim_name} event: A spot rebalance "
+                     "recommendation was triggered for the node", NORMAL)
+
+
+def instance_stopping(recorder: Recorder, claim_name: str) -> None:
+    recorder.publish("NodeClaim", claim_name, "InstanceStopping",
+                     f"NodeClaim {claim_name} event: Instance is stopping",
+                     WARNING)
+
+
+def instance_terminating(recorder: Recorder, claim_name: str) -> None:
+    recorder.publish("NodeClaim", claim_name, "InstanceTerminating",
+                     f"NodeClaim {claim_name} event: Instance is terminating",
+                     WARNING)
+
+
+def terminating_on_interruption(recorder: Recorder, claim_name: str) -> None:
+    recorder.publish("NodeClaim", claim_name, "TerminatingOnInterruption",
+                     f"Interruption triggered termination for the NodeClaim "
+                     f"{claim_name}", WARNING)
+
+
+def failed_resolving_nodeclass(recorder: Recorder, kind: str,
+                               name: str, nodeclass: str) -> None:
+    recorder.publish(kind, name, "FailedResolvingNodeClass",
+                     f"Failed resolving EC2NodeClass {nodeclass}", WARNING)
+
+
+def launch_failed(recorder: Recorder, claim_name: str, message: str) -> None:
+    recorder.publish("NodeClaim", claim_name, "LaunchFailed", message,
+                     WARNING)

@@ -54,15 +54,21 @@ class Metrics:
         return vals[min(len(vals) - 1, int(len(vals) * q))]
 
     def render(self) -> str:
-        """Prometheus exposition-format-ish dump."""
+        """Prometheus exposition-format-ish dump. Locked: the daemon's
+        HTTP threads scrape concurrently with reconciling controllers."""
+        with self._mu:
+            counters = sorted(self.counters.items())
+            gauges = sorted(self.gauges.items())
+            histograms = [(k, (len(v), sum(v)))
+                          for k, v in sorted(self.histograms.items())]
         lines = []
-        for (name, labels), v in sorted(self.counters.items()):
+        for (name, labels), v in counters:
             lines.append(f"{name}{_fmt(labels)} {v}")
-        for (name, labels), v in sorted(self.gauges.items()):
+        for (name, labels), v in gauges:
             lines.append(f"{name}{_fmt(labels)} {v}")
-        for (name, labels), vals in sorted(self.histograms.items()):
-            lines.append(f"{name}_count{_fmt(labels)} {len(vals)}")
-            lines.append(f"{name}_sum{_fmt(labels)} {sum(vals)}")
+        for (name, labels), (cnt, total) in histograms:
+            lines.append(f"{name}_count{_fmt(labels)} {cnt}")
+            lines.append(f"{name}_sum{_fmt(labels)} {total}")
         return "\n".join(lines) + "\n"
 
 

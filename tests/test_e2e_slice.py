@@ -235,3 +235,108 @@ class TestDriftDetection:
         op.ssm_invalidation.reconcile(force=True)  # evict deprecated AMI params
         op.nodeclass_status.reconcile()
         assert op.cloudprovider.is_drifted(claim) == "AMIDrift"
+
+    def test_security_group_drift(self, op):
+        """drift.go areSecurityGroupsDrifted: the instance's attached SGs
+        must equal the NodeClass's resolved set — the fourth drift reason
+        (DRIFT_SECURITY_GROUP) becomes reachable."""
+        mk_cluster(op)
+        for p in make_pods(1, prefix="sgd"):
+            op.kube.create(p)
+        op.run_until_settled()
+        claim = op.kube.list("NodeClaim")[0]
+        assert op.cloudprovider.is_drifted(claim) == ""
+        # add a new SG to the cloud matching the selector: the NodeClass
+        # resolves {old, new} but the instance still has only {old}
+        from karpenter_provider_aws_tpu.fake.ec2 import FakeSecurityGroup
+        old = next(iter(op.ec2.security_groups.values()))
+        op.ec2.security_groups["sg-extra"] = FakeSecurityGroup(
+            id="sg-extra", name="karpenter-nodes-extra",
+            tags=dict(old.tags))
+        op.security_groups.invalidate()
+        op.nodeclass_status.reconcile()
+        assert op.cloudprovider.is_drifted(claim) == "SecurityGroupDrift"
+
+    def test_subnet_drift(self, op):
+        mk_cluster(op)
+        for p in make_pods(1, prefix="snd"):
+            op.kube.create(p)
+        op.run_until_settled()
+        claim = op.kube.list("NodeClaim")[0]
+        inst = op.ec2.instances[claim.provider_id.split("/")[-1]]
+        # deselect the subnet the instance runs in
+        nc = op.kube.get("EC2NodeClass", "default-class")
+        nc.status_subnets = [s for s in nc.status_subnets
+                             if s["id"] != inst.subnet_id]
+        op.kube.update(nc)
+        assert op.cloudprovider.is_drifted(claim) == "SubnetDrift"
+
+    def test_static_hash_drift(self, op):
+        mk_cluster(op)
+        for p in make_pods(1, prefix="shd"):
+            op.kube.create(p)
+        op.run_until_settled()
+        claim = op.kube.list("NodeClaim")[0]
+        nc = op.kube.get("EC2NodeClass", "default-class")
+        nc.tags = {"team": "changed"}
+        op.kube.update(nc)
+        assert op.cloudprovider.is_drifted(claim) == "NodeClassDrift"
+
+
+class TestLaunchTemplateRetry:
+    def test_lt_not_found_retries_once(self, op):
+        """instance.go:111-115: a template deleted between EnsureAll and
+        CreateFleet is re-ensured and the launch retried exactly once."""
+        mk_cluster(op)
+        # prime: one successful launch so templates exist and are cached
+        for p in make_pods(1, prefix="lt1"):
+            op.kube.create(p)
+        op.run_until_settled()
+        # sabotage: delete the templates from the cloud but NOT the cache
+        doomed = [lt.name for lt in op.ec2.describe_launch_templates()]
+        op.ec2.delete_launch_templates(doomed)
+        fleet_calls_before = op.ec2.create_fleet_log.called_times
+        create_lt_before = op.ec2.create_launch_template_log.called_times
+        for p in make_pods(1, cpu="3", prefix="lt2"):
+            op.kube.create(p)
+        op.run_until_settled()
+        # the launch succeeded via the single retry: one failed fleet call,
+        # one recreate, one successful fleet call
+        assert all(p.node_name for p in op.kube.list("Pod"))
+        assert op.ec2.create_fleet_log.called_times >= fleet_calls_before + 2
+        assert op.ec2.create_launch_template_log.called_times > create_lt_before
+
+
+class TestEvents:
+    def test_interruption_publishes_events(self, op):
+        mk_cluster(op)
+        for p in make_pods(2, prefix="evt"):
+            op.kube.create(p)
+        op.run_until_settled()
+        claim = op.kube.list("NodeClaim")[0]
+        op.sqs.send(InterruptionMessage(
+            kind="spot_interruption",
+            instance_id=claim.provider_id.split("/")[-1]))
+        op.step()
+        reasons = op.recorder.reasons()
+        assert "SpotInterrupted" in reasons
+        assert "TerminatingOnInterruption" in reasons
+        evs = op.recorder.events(kind="NodeClaim", name=claim.name,
+                                 reason="SpotInterrupted")
+        assert evs and evs[0].type == "Warning"
+
+    def test_failed_nodeclass_resolution_publishes_event(self, op):
+        mk_cluster(op)
+        op.step()  # lets the status controller stamp the finalizer
+        op.kube.delete("EC2NodeClass", "default-class")
+        obj = op.kube.try_get("EC2NodeClass", "default-class")
+        if obj is not None:
+            op.kube.remove_finalizer(obj, "karpenter.k8s.aws/termination")
+        assert op.kube.try_get("EC2NodeClass", "default-class") is None
+        # a pod arriving now provisions a claim whose launch cannot
+        # resolve the class -> cloudprovider/events FailedResolvingNodeClass
+        for p in make_pods(1, prefix="evnc"):
+            op.kube.create(p)
+        op.step()
+        op.step()
+        assert "FailedResolvingNodeClass" in op.recorder.reasons()
