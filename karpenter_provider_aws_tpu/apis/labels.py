@@ -63,6 +63,20 @@ EC2NODECLASS_HASH_ANNOTATION = f"{_G}/ec2nodeclass-hash"
 EC2NODECLASS_HASH_VERSION_ANNOTATION = f"{_G}/ec2nodeclass-hash-version"
 EC2NODECLASS_HASH_VERSION = "v4"  # pkg/apis/v1/ec2nodeclass.go (v4)
 
+#: the allowlisted karpenter.k8s.aws requirement keys
+#: (karpenter.sh_nodepools.yaml:282-283 CEL rule)
+AWS_REQUIREMENT_LABELS = frozenset({
+    EC2NODECLASS_LABEL, INSTANCE_ENCRYPTION_IN_TRANSIT, INSTANCE_CATEGORY,
+    INSTANCE_HYPERVISOR, INSTANCE_FAMILY, INSTANCE_GENERATION,
+    INSTANCE_LOCAL_NVME, INSTANCE_SIZE, INSTANCE_CPU,
+    INSTANCE_CPU_MANUFACTURER, INSTANCE_CPU_SUSTAINED_CLOCK,
+    INSTANCE_MEMORY, INSTANCE_EBS_BANDWIDTH, INSTANCE_NETWORK_BANDWIDTH,
+    INSTANCE_GPU_NAME, INSTANCE_GPU_MANUFACTURER, INSTANCE_GPU_COUNT,
+    INSTANCE_GPU_MEMORY, INSTANCE_ACCELERATOR_NAME,
+    INSTANCE_ACCELERATOR_MANUFACTURER, INSTANCE_ACCELERATOR_COUNT,
+})
+
+
 #: Labels whose values are integers, supporting Gt/Lt requirement operators.
 NUMERIC_LABELS = frozenset({
     INSTANCE_CPU, INSTANCE_MEMORY, INSTANCE_GPU_COUNT, INSTANCE_GPU_MEMORY,

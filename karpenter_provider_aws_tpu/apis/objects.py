@@ -436,8 +436,10 @@ class EC2NodeClass(KubeObject):
 
     def __init__(self, name: str,
                  ami_selector_terms: Sequence[SelectorTerm] = (SelectorTerm(alias="al2023@latest"),),
-                 subnet_selector_terms: Sequence[SelectorTerm] = (),
-                 security_group_selector_terms: Sequence[SelectorTerm] = (),
+                 subnet_selector_terms: Sequence[SelectorTerm] = (
+                     SelectorTerm((("karpenter.sh/discovery", "*"),)),),
+                 security_group_selector_terms: Sequence[SelectorTerm] = (
+                     SelectorTerm((("karpenter.sh/discovery", "*"),)),),
                  role: str = "KarpenterNodeRole",
                  instance_profile: str = "",
                  user_data: str = "",

@@ -40,6 +40,10 @@ class FakeKube:
 
     # -- CRUD --------------------------------------------------------------
     def create(self, obj: KubeObject) -> KubeObject:
+        # admission: the CEL-rule analog runs where the kube-apiserver
+        # would run it (apis/validation.py)
+        from ..apis.validation import validate
+        validate(obj)
         with self._mu:
             key = obj.key()
             if key in self._store:
@@ -80,11 +84,19 @@ class FakeKube:
             return sorted(out, key=lambda o: (o.metadata.namespace, o.metadata.name))
 
     def update(self, obj: KubeObject, expect_version: Optional[int] = None) -> KubeObject:
+        from ..apis.validation import validate, validate_update
         with self._mu:
             key = obj.key()
             cur = self._store.get(key)
             if cur is None:
                 raise NotFound(f"{key}")
+            if cur is not obj:
+                # a distinct old object allows immutability checks too
+                validate_update(cur, obj)
+            else:
+                # in-place mutation + update(obj is cur) is the common test
+                # pattern; admission rules still apply
+                validate(obj)
             if expect_version is not None and cur.metadata.resource_version != expect_version:
                 raise Conflict(f"{key}: rv {cur.metadata.resource_version} != {expect_version}")
             self._rv += 1

@@ -71,6 +71,55 @@ class Router:
 #: subsequent solve to the host twin until a background probe succeeds
 DEV_FAILED_MS = 1e12
 
+_DEVICE_ALIVE: Optional[bool] = None
+_DEVICE_ALIVE_AT: float = 0.0
+_DEVICE_ALIVE_MU = threading.Lock()
+#: a False verdict expires so a recovered device gets re-probed; True is
+#: permanent for the process (a healthy backend stays initialized)
+_DEVICE_DEAD_RECHECK_S = 300.0
+
+
+def device_alive(timeout: float = 90.0) -> bool:
+    """Probe jax backend liveness in a SUBPROCESS with a hard timeout.
+
+    A wedged accelerator link (observed with a tunneled remote TPU after a
+    crashed client) makes jax backend init block forever rather than
+    raise; an in-process try/except cannot defend against that. One
+    subprocess probe per process decides whether the device engine is
+    usable at all — if not, every solve stays on the host twin, which is
+    decision-identical. Memoized for the process lifetime."""
+    global _DEVICE_ALIVE, _DEVICE_ALIVE_AT
+    with _DEVICE_ALIVE_MU:
+        if _DEVICE_ALIVE is True:
+            return True
+        if _DEVICE_ALIVE is False and \
+                time.monotonic() - _DEVICE_ALIVE_AT < _DEVICE_DEAD_RECHECK_S:
+            return False
+        import subprocess
+        import sys
+        # inherit an explicit platform override (tests force cpu via
+        # jax.config.update — which, unlike the JAX_PLATFORMS env var,
+        # reliably skips a wedged accelerator plugin)
+        plat = None
+        if "jax" in sys.modules:
+            try:
+                plat = sys.modules["jax"].config.jax_platforms
+            except Exception:
+                plat = None
+        code = "import jax\n"
+        if plat:
+            code += f"jax.config.update('jax_platforms', {plat!r})\n"
+        code += "jax.devices(); print('ok')"
+        try:
+            proc = subprocess.run([sys.executable, "-c", code],
+                                  timeout=timeout, capture_output=True)
+            _DEVICE_ALIVE = proc.returncode == 0 \
+                and b"ok" in proc.stdout
+        except Exception:
+            _DEVICE_ALIVE = False
+        _DEVICE_ALIVE_AT = time.monotonic()
+        return _DEVICE_ALIVE
+
 
 def routed(router: Router, bucket: Tuple,
            host_fn: Callable[[], object],
@@ -84,6 +133,13 @@ def routed(router: Router, bucket: Tuple,
     background probe observes the device healthy again."""
     choice = router.choose(bucket)
     metrics = router.metrics
+    if choice == "both" and not device_alive():
+        # wedged/absent device: park it and serve from the host twin
+        router.observe(bucket, "dev", DEV_FAILED_MS)
+        choice = ("host", False)
+        if metrics is not None:
+            metrics.inc(f"karpenter_{router.name}_route_total",
+                        labels={"route": "dev-unreachable"})
     if choice == "both":
         try:
             dev_fn()  # first device run pays the XLA compile; not recorded
@@ -124,6 +180,12 @@ def routed(router: Router, bucket: Tuple,
 
         def _probe():
             try:
+                # a dev_fn against a wedged link blocks forever; gate the
+                # probe on the subprocess liveness check (in THIS thread —
+                # its up-to-90s wait must never block a solve). The False
+                # verdict expires, so recovery is still noticed.
+                if other_side == "dev" and not device_alive():
+                    return
                 t0 = time.perf_counter()
                 other_fn()
                 router.observe(bucket, other_side,

@@ -1,0 +1,187 @@
+"""Admission validation: CEL-rule analog enforcement at the fake API
+server boundary, mirroring the reference's apis/v1 CRD suites
+(karpenter.sh_nodepools.yaml / karpenter.k8s.aws_ec2nodeclasses.yaml
+x-kubernetes-validations)."""
+
+import pytest
+
+from karpenter_provider_aws_tpu.apis import labels as L
+from karpenter_provider_aws_tpu.apis.objects import (BlockDeviceMapping,
+                                                     DisruptionBudget,
+                                                     Disruption, EC2NodeClass,
+                                                     KubeletConfiguration,
+                                                     NodeClassRef, NodePool,
+                                                     NodePoolTemplate,
+                                                     SelectorTerm)
+from karpenter_provider_aws_tpu.apis.requirements import Requirements
+from karpenter_provider_aws_tpu.apis.validation import ValidationError
+from karpenter_provider_aws_tpu.fake.kube import FakeKube
+
+
+@pytest.fixture
+def kube():
+    return FakeKube()
+
+
+def pool(name="p", requirements=(), labels=None, budgets=None,
+         ref=None) -> NodePool:
+    return NodePool(name, template=NodePoolTemplate(
+        node_class_ref=ref or NodeClassRef("nc"),
+        requirements=Requirements.from_terms(list(requirements)),
+        labels=dict(labels or {})),
+        disruption=Disruption(budgets=list(budgets))
+        if budgets is not None else None)
+
+
+class TestNodePoolRules:
+    def test_valid_pool_accepted(self, kube):
+        kube.create(pool(requirements=[
+            {"key": L.INSTANCE_FAMILY, "operator": "In",
+             "values": ["m5", "c5"]}]))
+
+    def test_min_values_floor(self, kube):
+        with pytest.raises(ValidationError, match="at least that many"):
+            kube.create(pool(requirements=[
+                {"key": L.INSTANCE_FAMILY, "operator": "In",
+                 "values": ["m5", "c5"], "minValues": 3}]))
+
+    def test_min_values_bounds(self, kube):
+        with pytest.raises(ValidationError, match="minValues must be in"):
+            kube.create(pool(requirements=[
+                {"key": L.INSTANCE_FAMILY, "operator": "Exists",
+                 "minValues": 51}]))
+
+    def test_in_requires_values(self, kube):
+        with pytest.raises(ValidationError, match="must have a value"):
+            kube.create(pool(requirements=[
+                {"key": L.INSTANCE_FAMILY, "operator": "In", "values": []}]))
+
+    def test_restricted_domains(self, kube):
+        for key, frag in (
+                ("karpenter.sh/custom", 'domain "karpenter.sh"'),
+                (L.NODEPOOL, '"karpenter.sh/nodepool" is restricted'),
+                (L.HOSTNAME, '"kubernetes.io/hostname" is restricted'),
+                ("kubernetes.io/foo", 'domain "kubernetes.io"'),
+                ("kustomize.toolkit.fluxcd.k8s.io/x", 'domain "k8s.io"'),
+                ("karpenter.k8s.aws/bogus", 'domain "karpenter.k8s.aws"')):
+            with pytest.raises(ValidationError, match=frag):
+                kube.create(pool(name=f"p-{key.replace('/', '-')}",
+                                 requirements=[{"key": key,
+                                                "operator": "Exists"}]))
+
+    def test_allowed_special_labels(self, kube):
+        kube.create(pool(name="ok", requirements=[
+            {"key": L.CAPACITY_TYPE, "operator": "In", "values": ["spot"]},
+            {"key": "kubernetes.io/arch", "operator": "In",
+             "values": ["amd64"]},
+            {"key": "node.kubernetes.io/instance-type", "operator": "Exists"},
+            {"key": L.INSTANCE_CPU, "operator": "Gt", "values": ["4"]}]))
+
+    def test_restricted_template_labels(self, kube):
+        with pytest.raises(ValidationError, match="restricted"):
+            kube.create(pool(labels={L.NODEPOOL: "x"}))
+
+    def test_budget_schedule_needs_duration(self, kube):
+        with pytest.raises(ValidationError,
+                           match="'schedule' must be set with 'duration'"):
+            kube.create(pool(budgets=[DisruptionBudget(
+                nodes="10%", schedule="0 0 * * *")]))
+
+    def test_nodeclass_ref_nonempty(self, kube):
+        with pytest.raises(ValidationError, match="name may not be empty"):
+            kube.create(pool(ref=NodeClassRef("")))
+
+    def test_nodeclass_ref_immutable(self, kube):
+        p = kube.create(pool())
+        import copy
+        p2 = copy.deepcopy(p)
+        p2.template.node_class_ref.group = "other.group"
+        with pytest.raises(ValidationError, match="group is immutable"):
+            kube.update(p2)
+
+
+class TestEC2NodeClassRules:
+    def test_default_accepted(self, kube):
+        kube.create(EC2NodeClass("ok"))
+
+    def test_empty_subnet_terms_rejected(self, kube):
+        with pytest.raises(ValidationError,
+                           match="subnetSelectorTerms cannot be empty"):
+            kube.create(EC2NodeClass("bad", subnet_selector_terms=()))
+
+    def test_empty_sg_terms_rejected(self, kube):
+        with pytest.raises(
+                ValidationError,
+                match="securityGroupSelectorTerms cannot be empty"):
+            kube.create(EC2NodeClass("bad2",
+                                     security_group_selector_terms=()))
+
+    def test_term_needs_a_field(self, kube):
+        with pytest.raises(ValidationError, match="expected at least one"):
+            kube.create(EC2NodeClass(
+                "bad3", subnet_selector_terms=(SelectorTerm(),)))
+
+    def test_id_mutually_exclusive(self, kube):
+        with pytest.raises(ValidationError, match="mutually exclusive"):
+            kube.create(EC2NodeClass("bad4", subnet_selector_terms=(
+                SelectorTerm.of({"a": "b"}, id="subnet-123"),)))
+
+    def test_alias_mutually_exclusive_with_other_terms(self, kube):
+        with pytest.raises(ValidationError, match="mutually exclusive"):
+            kube.create(EC2NodeClass("bad5", ami_selector_terms=(
+                SelectorTerm(alias="al2023@latest"),
+                SelectorTerm.of({"a": "b"}))))
+
+    def test_alias_format(self, kube):
+        with pytest.raises(ValidationError, match="improperly formatted"):
+            kube.create(EC2NodeClass("bad6", ami_selector_terms=(
+                SelectorTerm(alias="al2023latest"),)))
+
+    def test_alias_family_supported(self, kube):
+        with pytest.raises(ValidationError, match="family is not supported"):
+            kube.create(EC2NodeClass("bad7", ami_selector_terms=(
+                SelectorTerm(alias="cos@latest"),)))
+
+    def test_windows_version_latest_only(self, kube):
+        with pytest.raises(ValidationError, match="only specify version"):
+            kube.create(EC2NodeClass("bad8", ami_selector_terms=(
+                SelectorTerm(alias="windows2022@v1.2"),)))
+
+    def test_empty_tag_values(self, kube):
+        with pytest.raises(ValidationError, match="empty tag keys"):
+            kube.create(EC2NodeClass("bad9", subnet_selector_terms=(
+                SelectorTerm.of({"key": ""}),)))
+
+    def test_one_root_volume(self, kube):
+        with pytest.raises(ValidationError, match="only one"):
+            kube.create(EC2NodeClass("bad10", block_device_mappings=[
+                BlockDeviceMapping(device_name="/dev/xvda", root_volume=True),
+                BlockDeviceMapping(device_name="/dev/xvdb",
+                                   root_volume=True)]))
+
+    def test_restricted_tags(self, kube):
+        with pytest.raises(ValidationError, match="restricted"):
+            kube.create(EC2NodeClass(
+                "bad11", tags={"karpenter.sh/nodepool": "x"}))
+
+    def test_kubelet_eviction_keys(self, kube):
+        with pytest.raises(ValidationError, match="valid keys for"):
+            kube.create(EC2NodeClass("bad12", kubelet=KubeletConfiguration(
+                eviction_hard={"bogus.signal": "5%"})))
+
+    def test_kubelet_reserved_keys(self, kube):
+        with pytest.raises(ValidationError, match="valid keys for"):
+            kube.create(EC2NodeClass("bad13", kubelet=KubeletConfiguration(
+                kube_reserved={"gpu": "1"})))
+
+    def test_role_required(self, kube):
+        with pytest.raises(ValidationError, match="role cannot be empty"):
+            kube.create(EC2NodeClass("bad14", role=""))
+
+    def test_role_immutable(self, kube):
+        nc = kube.create(EC2NodeClass("mut"))
+        import copy
+        nc2 = copy.deepcopy(nc)
+        nc2.role = "OtherRole"
+        with pytest.raises(ValidationError, match="immutable field changed"):
+            kube.update(nc2)
