@@ -247,6 +247,43 @@ def run_config4(backend, rounds, n_nodes=200):
     }
 
 
+def run_interruption_bench(counts=(100, 1000, 5000, 15000)):
+    """Messages/Second at the reference benchmark's message counts
+    (interruption_benchmark_test.go:58-157): N claims with instances, N
+    spot-interruption messages, one reconcile drains the queue through the
+    10-way handler fan-out."""
+    from karpenter_provider_aws_tpu.apis import labels as L
+    from karpenter_provider_aws_tpu.apis.objects import (NodeClaim,
+                                                         NodeClassRef)
+    from karpenter_provider_aws_tpu.apis.requirements import Requirements
+    from karpenter_provider_aws_tpu.operator import Operator
+    from karpenter_provider_aws_tpu.providers.pricing import \
+        InterruptionMessage
+
+    rows = []
+    for n in counts:
+        op = Operator()
+        for i in range(n):
+            claim = NodeClaim(
+                f"bench-claim-{i:05d}", requirements=Requirements([]),
+                node_class_ref=NodeClassRef("bench"),
+                labels={L.NODEPOOL: "bench",
+                        L.INSTANCE_TYPE: "m5.large",
+                        L.ZONE: "us-west-2a"})
+            claim.provider_id = f"aws:///us-west-2a/i-bench{i:08d}"
+            op.kube.create(claim)
+            op.sqs.send(InterruptionMessage(
+                kind="spot_interruption", instance_id=f"i-bench{i:08d}"))
+        t0 = time.perf_counter()
+        stats = op.interruption.reconcile()
+        dt = time.perf_counter() - t0
+        assert stats["handled"] == n, (stats, n)
+        rows.append({"messages": n, "seconds": round(dt, 3),
+                     "messages_per_second": round(n / dt, 1),
+                     "cordoned": stats["cordoned"]})
+    return rows
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--pods", type=int, default=50_000)
@@ -257,7 +294,13 @@ def main():
                     help="run all 5 BASELINE configs (default: headline only)")
     ap.add_argument("--config", type=int, choices=[1, 2, 3, 4, 5],
                     help="run a single config and print its row")
+    ap.add_argument("--interruption", action="store_true",
+                    help="run only the interruption throughput benchmark")
     args = ap.parse_args()
+
+    if args.interruption:
+        print(json.dumps({"interruption": run_interruption_bench()}))
+        return
 
     from karpenter_provider_aws_tpu.fake.environment import Environment
 

@@ -36,19 +36,25 @@ class Batcher(Generic[T, U]):
     """``exec_fn(requests) -> responses`` is called once per flushed batch;
     it must return one response per request (same order)."""
 
+    #: metric label; concrete batchers override (batcher/metrics.go emits
+    #: karpenter_cloudprovider_batcher_* series per batcher)
+    name = "generic"
+
     def __init__(self,
                  exec_fn: Callable[[Sequence[T]], Sequence[U]],
                  idle_timeout: float = 0.100,
                  max_timeout: float = 1.0,
                  max_items: int = 500,
                  hash_fn: Optional[Callable[[T], Hashable]] = None,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 metrics=None):
         self.exec_fn = exec_fn
         self.idle_timeout = idle_timeout
         self.max_timeout = max_timeout
         self.max_items = max_items
         self.hash_fn = hash_fn or (lambda _: 0)
         self.clock = clock
+        self.metrics = metrics
         self._mu = threading.Lock()
         self._buckets: Dict[Hashable, _Bucket[T, U]] = {}
         self._wake = threading.Condition(self._mu)
@@ -104,6 +110,14 @@ class Batcher(Generic[T, U]):
     def _flush_locked(self, key: Hashable, bucket: _Bucket) -> None:
         self._buckets.pop(key, None)
         requests, futures = bucket.requests, bucket.futures
+        if self.metrics is not None:
+            self.metrics.observe("karpenter_cloudprovider_batcher_batch_size",
+                                 float(len(requests)),
+                                 labels={"batcher": self.name})
+            self.metrics.observe(
+                "karpenter_cloudprovider_batcher_batch_time_seconds",
+                max(0.0, self.clock() - bucket.opened),
+                labels={"batcher": self.name})
         threading.Thread(target=self._execute, args=(requests, futures),
                          daemon=True).start()
 
@@ -150,10 +164,14 @@ class CreateFleetBatcher(Batcher):
     batch size, and hands each caller exactly one instance back
     (createfleet.go:36-100)."""
 
-    def __init__(self, ec2, clock: Callable[[], float] = time.monotonic):
+    name = "create_fleet"
+
+    def __init__(self, ec2, clock: Callable[[], float] = time.monotonic,
+                 metrics=None):
         self.ec2 = ec2
         super().__init__(self._run, idle_timeout=0.035, max_timeout=1.0,
-                         max_items=1000, hash_fn=lambda r: r, clock=clock)
+                         max_items=1000, hash_fn=lambda r: r, clock=clock,
+                         metrics=metrics)
 
     def _run(self, requests: Sequence[CreateFleetRequest]):
         req = requests[0]
@@ -175,10 +193,14 @@ class DescribeInstancesBatcher(Batcher):
     """Merges instance-ID lookups with identical filters
     (describeinstances.go:38-63)."""
 
-    def __init__(self, ec2, clock: Callable[[], float] = time.monotonic):
+    name = "describe_instances"
+
+    def __init__(self, ec2, clock: Callable[[], float] = time.monotonic,
+                 metrics=None):
         self.ec2 = ec2
         super().__init__(self._run, idle_timeout=0.100, max_timeout=1.0,
-                         max_items=500, hash_fn=lambda r: 0, clock=clock)
+                         max_items=500, hash_fn=lambda r: 0, clock=clock,
+                         metrics=metrics)
 
     def _run(self, instance_ids: Sequence[str]):
         found = {i.id: i for i in self.ec2.describe_instances(ids=list(instance_ids))}
@@ -186,10 +208,14 @@ class DescribeInstancesBatcher(Batcher):
 
 
 class TerminateInstancesBatcher(Batcher):
-    def __init__(self, ec2, clock: Callable[[], float] = time.monotonic):
+    name = "terminate_instances"
+
+    def __init__(self, ec2, clock: Callable[[], float] = time.monotonic,
+                 metrics=None):
         self.ec2 = ec2
         super().__init__(self._run, idle_timeout=0.100, max_timeout=1.0,
-                         max_items=500, hash_fn=lambda r: 0, clock=clock)
+                         max_items=500, hash_fn=lambda r: 0, clock=clock,
+                         metrics=metrics)
 
     def _run(self, instance_ids: Sequence[str]):
         done = set(self.ec2.terminate_instances(list(instance_ids)))

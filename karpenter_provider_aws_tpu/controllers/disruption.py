@@ -173,7 +173,14 @@ class DisruptionController:
                 "karpenter_voluntary_disruption_eligible_nodes",
                 float(len([c for c in candidates if not c.blocked_by])))
         for reason in _GRACEFUL_ORDER:
+            t0 = time.perf_counter()
             cmd = self._compute(reason, candidates)
+            if self.metrics is not None:
+                # metrics.md:181
+                self.metrics.observe(
+                    "karpenter_voluntary_disruption_decision_evaluation"
+                    "_duration_seconds",
+                    time.perf_counter() - t0, labels={"method": reason})
             if cmd is not None:
                 self._execute(cmd)
                 return cmd

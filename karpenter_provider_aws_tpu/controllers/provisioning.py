@@ -52,6 +52,11 @@ class Provisioner:
         """One provisioning round (core Provisioner.Schedule)."""
         pods = self.state.pending_pods()
         result = ProvisioningResult()
+        if self.metrics is not None:
+            # scheduler queue depth = pending pods entering this round
+            # (metrics.md:191-197)
+            self.metrics.set_gauge("karpenter_scheduler_queue_depth",
+                                   float(len(pods)))
         if not pods:
             return result
         snapshot = self.build_snapshot(pods)
@@ -61,7 +66,6 @@ class Provisioner:
         if self.metrics is not None:
             self.metrics.observe("karpenter_scheduler_scheduling_duration_seconds",
                                  result.solve_duration_s)
-            self.metrics.set_gauge("karpenter_scheduler_queue_depth", 0)
         result.unschedulable = solved.unschedulable
 
         pods_by_name = {p.full_name(): p for p in pods}

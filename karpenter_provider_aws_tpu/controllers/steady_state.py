@@ -18,6 +18,7 @@ catalog/pricing refresh (SURVEY §2.5).
 from __future__ import annotations
 
 import logging
+import threading
 import time
 from typing import Dict, List, Optional, Set
 
@@ -93,12 +94,18 @@ class GarbageCollector:
         self.cloudprovider = cloudprovider
         self.clock = clock
 
+    #: termination fan-out width (garbagecollection/controller.go:80:
+    #: workqueue.ParallelizeUntil(ctx, 100, ...)); parallel callers feed
+    #: the TerminateInstances micro-batcher, which coalesces them into
+    #: few API calls
+    WORKERS = 100
+
     def reconcile(self) -> int:
         """Terminate cloud instances with no NodeClaim (>30s old)."""
         claimed = {c.provider_id for c in self.kube.list("NodeClaim")
                    if c.provider_id}
-        reaped = 0
         now = self.clock()
+        doomed = []
         for claim in self.cloudprovider.list():
             pid = claim.provider_id
             if pid in claimed:
@@ -106,11 +113,21 @@ class GarbageCollector:
             instance = self.cloudprovider.instances.get(parse_instance_id(pid))
             if now - instance.launch_time < GC_GRACE_SECONDS:
                 continue
-            try:
-                self.cloudprovider.instances.delete(instance.id)
-                reaped += 1
-            except NodeClaimNotFoundError:
-                pass
+            doomed.append(instance.id)
+        reaped = 0
+        if doomed:
+            from concurrent.futures import ThreadPoolExecutor
+
+            def reap(iid):
+                try:
+                    self.cloudprovider.instances.delete(iid)
+                    return 1
+                except NodeClaimNotFoundError:
+                    return 0
+
+            with ThreadPoolExecutor(
+                    max_workers=min(self.WORKERS, len(doomed))) as pool:
+                reaped = sum(pool.map(reap, doomed))
         # also reap Node objects whose instance is gone
         live = {i.provider_id for i in self.cloudprovider.instances.list()}
         for node in self.kube.list("Node"):
@@ -163,23 +180,41 @@ class InterruptionController:
         self.clock = clock
         self.recorder = recorder
 
+    #: message-handling fan-out width (interruption/controller.go:116:
+    #: workqueue.ParallelizeUntil(ctx, 10, ...))
+    WORKERS = 10
+
     def reconcile(self) -> Dict[str, int]:
         stats = {"handled": 0, "cordoned": 0, "noop": 0}
         claims_by_instance = {}
         for c in self.kube.list("NodeClaim"):
             if c.provider_id:
                 claims_by_instance[parse_instance_id(c.provider_id)] = c
-        while True:
-            messages = self.sqs.receive(max_messages=10)
-            if not messages:
-                break
-            for msg in messages:
-                self._handle(msg, claims_by_instance, stats)
-                self.sqs.delete(msg)
-                stats["handled"] += 1
-                if self.metrics is not None:
-                    self.metrics.inc("karpenter_interruption_received_messages_total",
-                                     labels={"message_type": msg.kind})
+        from concurrent.futures import ThreadPoolExecutor
+
+        def work(msg):
+            local = {"handled": 0, "cordoned": 0, "noop": 0}
+            self._handle(msg, claims_by_instance, local)
+            self.sqs.delete(msg)
+            local["handled"] += 1
+            if self.metrics is not None:
+                self.metrics.inc(
+                    "karpenter_interruption_received_messages_total",
+                    labels={"message_type": msg.kind})
+            return local
+
+        with ThreadPoolExecutor(max_workers=self.WORKERS) as pool:
+            while True:
+                # drain in waves: receive() is non-destructive until
+                # delete, so take one deep batch per wave and fan it out
+                # 10-wide (the reference long-polls batches and hands them
+                # to ParallelizeUntil)
+                wave = self.sqs.receive(max_messages=10 * self.WORKERS)
+                if not wave:
+                    break
+                for local in pool.map(work, wave):
+                    for k, v in local.items():
+                        stats[k] += v
         return stats
 
     def _handle(self, msg: InterruptionMessage, claims, stats) -> None:
@@ -200,8 +235,12 @@ class InterruptionController:
         self._publish_events(msg, claim)
         if msg.kind in ACTIONABLE_KINDS:
             # CordonAndDrain: delete the claim; termination drains + replaces
-            self.kube.delete("NodeClaim", claim.metadata.name)
-            stats["cordoned"] += 1
+            try:
+                self.kube.delete("NodeClaim", claim.metadata.name)
+            except NotFound:
+                pass  # a concurrent message already cordoned this claim
+            else:
+                stats["cordoned"] += 1
 
     def _publish_events(self, msg: InterruptionMessage, claim) -> None:
         """interruption/events parity: surface what hit the node. Only
@@ -223,24 +262,81 @@ class InterruptionController:
 class CatalogController:
     """12h instance-type + offerings refresh (controller.go:43-60)."""
 
-    def __init__(self, ec2, provider: InstanceTypeProvider):
+    def __init__(self, ec2, provider: InstanceTypeProvider, metrics=None,
+                 unavailable_offerings=None):
         self.ec2 = ec2
         self.provider = provider
+        self.metrics = metrics
+        self.unavailable = unavailable_offerings
 
     def reconcile(self) -> bool:
-        changed = self.provider.update_instance_types(
-            self.ec2.describe_instance_types())
+        infos = self.ec2.describe_instance_types()
+        changed = self.provider.update_instance_types(infos)
         type_zones: Dict[str, set] = {}
         for t, z in self.ec2.describe_instance_type_offerings():
             type_zones.setdefault(t, set()).add(z)
+        od = self.ec2.on_demand_prices()
+        spot = {(t, z): p
+                for t, z, p in self.ec2.describe_spot_price_history()}
         changed |= self.provider.update_offerings(OfferingsSnapshot(
             zones={z.name: z for z in self.ec2.zones},
             type_zones=type_zones,
-            od_prices=self.ec2.on_demand_prices(),
-            spot_prices={(t, z): p
-                         for t, z, p in self.ec2.describe_spot_price_history()},
+            od_prices=od,
+            spot_prices=spot,
         ))
+        if changed and self.metrics is not None:
+            self._emit_gauges(infos, type_zones, od, spot)
         return changed
+
+    def _emit_gauges(self, infos, type_zones, od, spot) -> None:
+        """Provider-side gauges (instancetype/metrics.go,
+        metrics.md offering availability/price): per-type cpu/memory and
+        per-offering availability + price estimate. Full re-emit: series
+        for types/offerings that left the catalog must not linger."""
+        m = self.metrics
+        for series in ("karpenter_cloudprovider_instance_type_cpu_cores",
+                       "karpenter_cloudprovider_instance_type_memory_bytes",
+                       "karpenter_cloudprovider_instance_type"
+                       "_offering_available",
+                       "karpenter_cloudprovider_instance_type"
+                       "_offering_price_estimate"):
+            m.clear_series(series)
+
+        def available(ct, itype, zone):
+            if self.unavailable is not None                     and self.unavailable.is_unavailable(ct, itype, zone):
+                return 0.0  # ICE-blacklisted pool (solver input, 3m TTL)
+            return 1.0
+
+        for info in infos:
+            m.set_gauge("karpenter_cloudprovider_instance_type_cpu_cores",
+                        float(info.vcpus),
+                        labels={"instance_type": info.name})
+            m.set_gauge("karpenter_cloudprovider_instance_type_memory_bytes",
+                        float(info.memory_bytes),
+                        labels={"instance_type": info.name})
+            for z in type_zones.get(info.name, ()):  
+                m.set_gauge(
+                    "karpenter_cloudprovider_instance_type_offering_available",
+                    available("on-demand", info.name, z),
+                    labels={"instance_type": info.name, "zone": z,
+                            "capacity_type": "on-demand"})
+                m.set_gauge(
+                    "karpenter_cloudprovider_instance_type_offering_price_estimate",
+                    od.get(info.name, 0) / 1e6,
+                    labels={"instance_type": info.name, "zone": z,
+                            "capacity_type": "on-demand"})
+                sp = spot.get((info.name, z))
+                if sp is not None:
+                    m.set_gauge(
+                        "karpenter_cloudprovider_instance_type_offering_available",
+                        available("spot", info.name, z),
+                        labels={"instance_type": info.name, "zone": z,
+                                "capacity_type": "spot"})
+                    m.set_gauge(
+                        "karpenter_cloudprovider_instance_type_offering_price_estimate",
+                        sp / 1e6,
+                        labels={"instance_type": info.name, "zone": z,
+                                "capacity_type": "spot"})
 
 
 class PricingController:

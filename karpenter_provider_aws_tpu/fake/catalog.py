@@ -82,12 +82,50 @@ _SIZES: Dict[str, int] = {
     "32xlarge": 128, "48xlarge": 192, "metal": 96,
 }
 
-# vcpus -> (enis, ipv4 per eni): the shape of the real vpclimits table
+# vcpus -> (enis, ipv4 per eni): the base curve of the real vpclimits table
 _ENI_LIMITS: Sequence[Tuple[int, int, int]] = (
     (1, 2, 4), (2, 3, 10), (4, 4, 15), (8, 4, 15), (16, 8, 30),
     (32, 8, 30), (48, 15, 50), (64, 15, 50), (96, 15, 50),
     (128, 15, 50), (192, 15, 50),
 )
+
+#: per-type irregularities, exactly the kind the generated
+#: zz_generated.vpclimits.go table encodes where the formula is wrong
+#: for a specific type (burstables, macs, network-heavy giants)
+_VPC_LIMIT_OVERRIDES: Dict[str, Tuple[int, int]] = {
+    "t1.micro": (2, 2),
+    "t2.nano": (2, 2), "t2.micro": (2, 2), "t2.small": (3, 4),
+    "t3.nano": (2, 2), "t3.micro": (2, 2), "t3.small": (3, 4),
+    "t3a.nano": (2, 2), "t3a.micro": (2, 2), "t3a.small": (2, 4),
+    "t4g.nano": (2, 2), "t4g.micro": (2, 2), "t4g.small": (3, 4),
+    "mac1.metal": (8, 30), "mac2.metal": (8, 14),
+    "mac2-m2.metal": (8, 14), "mac2-m2pro.metal": (8, 14),
+    "p5.48xlarge": (64, 50), "p5e.48xlarge": (64, 50),
+    "trn1.32xlarge": (40, 50), "trn1n.32xlarge": (80, 50),
+    "trn2.48xlarge": (80, 50),
+    "u-6tb1.112xlarge": (15, 50), "u-12tb1.112xlarge": (15, 50),
+    "hpc6a.48xlarge": (2, 50), "hpc6id.32xlarge": (2, 50),
+    "hpc7a.96xlarge": (2, 50), "hpc7g.16xlarge": (1, 50),
+}
+
+#: per-type network-bandwidth irregularities (zz_generated.bandwidth.go
+#: carries explicit Mbps per type; these are the rows the per-family
+#: rate formula cannot produce)
+_BANDWIDTH_OVERRIDES: Dict[str, int] = {
+    "p4d.24xlarge": 400_000, "p4de.24xlarge": 400_000,
+    "p5.48xlarge": 3_200_000, "p5e.48xlarge": 3_200_000,
+    "trn1.32xlarge": 800_000, "trn1n.32xlarge": 1_600_000,
+    "trn2.48xlarge": 3_200_000,
+    "p3dn.24xlarge": 100_000, "dl1.24xlarge": 400_000,
+    "hpc6a.48xlarge": 100_000, "hpc6id.32xlarge": 200_000,
+    "hpc7a.96xlarge": 300_000, "hpc7g.16xlarge": 200_000,
+    "mac1.metal": 25_000, "mac2.metal": 10_000,
+    "mac2-m2.metal": 10_000, "mac2-m2pro.metal": 10_000,
+    "c5n.18xlarge": 100_000, "c5n.metal": 100_000,
+    "c6gn.16xlarge": 100_000, "c7gn.16xlarge": 200_000,
+    "m5zn.12xlarge": 100_000, "m5zn.metal": 100_000,
+    "x2iezn.12xlarge": 100_000, "x2iezn.metal": 100_000,
+}
 
 
 def _eni(vcpus: int) -> Tuple[int, int]:
@@ -114,12 +152,13 @@ class FamilySpec:
     accels_by_size: Mapping[str, int] = field(default_factory=dict)
     efa_sizes: Tuple[str, ...] = ()
     network_gbps_per_vcpu: float = 0.4
+    metal_vcpus: int = 0            # metal-only families (mac) set this
 
 
 _STD = ("large", "xlarge", "2xlarge", "4xlarge", "8xlarge", "12xlarge",
         "16xlarge", "24xlarge", "metal")
 _STD_NO_METAL = _STD[:-1]
-_BURST = ("medium", "large", "xlarge", "2xlarge")
+_BURST = ("nano", "micro", "small", "medium", "large", "xlarge", "2xlarge")
 
 
 def _f(family, category, gen, arch, mfr, ratio, price, sizes=_STD_NO_METAL, **kw):
@@ -218,11 +257,207 @@ FAMILIES: Tuple[FamilySpec, ...] = (
     _f("trn1", "trn", 1, "amd64", "intel", 4, 0.4163,
        sizes=("2xlarge", "32xlarge"), accel=("trainium", "aws"),
        accels_by_size={"2xlarge": 1, "32xlarge": 16}, efa_sizes=("32xlarge",)),
+    # ---- full-catalog expansion: the ~850-type surface of the real
+    # DescribeInstanceTypes sweep (instancetype.go:200-220). Network
+    # (-n), local-NVMe (-d), combined (-dn/-id/-in), block-storage (-b),
+    # high-clock (-z), flex, HPC, and previous-generation families.
+    # compute optimized extras
+    _f("c5n", "c", 5, "amd64", "intel", 3, 0.0540,
+       sizes=("large", "xlarge", "2xlarge", "4xlarge", "9xlarge", "18xlarge", "metal"),
+       network_gbps_per_vcpu=1.4, efa_sizes=("18xlarge", "metal")),
+    _f("c5ad", "c", 5, "amd64", "amd", 2, 0.0430, local_nvme_gib_per_vcpu=29),
+    _f("c6gn", "c", 6, "arm64", "aws", 2, 0.0432, network_gbps_per_vcpu=1.6,
+       efa_sizes=("16xlarge",)),
+    _f("c6id", "c", 6, "amd64", "intel", 2, 0.0504, local_nvme_gib_per_vcpu=29, sizes=_STD),
+    _f("c6in", "c", 6, "amd64", "intel", 2, 0.0567, network_gbps_per_vcpu=1.6,
+       sizes=_STD, efa_sizes=("24xlarge", "metal")),
+    _f("c7gd", "c", 7, "arm64", "aws", 2, 0.0435, local_nvme_gib_per_vcpu=29),
+    _f("c7gn", "c", 7, "arm64", "aws", 2, 0.0499, network_gbps_per_vcpu=3.1,
+       efa_sizes=("16xlarge",)),
+    _f("c8g", "c", 8, "arm64", "aws", 2, 0.0399, sizes=_STD + ("48xlarge",)),
+    _f("c7i-flex", "c", 7, "amd64", "intel", 2, 0.0424,
+       sizes=("large", "xlarge", "2xlarge", "4xlarge", "8xlarge")),
+    # general purpose extras
+    _f("m5n", "m", 5, "amd64", "intel", 4, 0.0595, network_gbps_per_vcpu=1.4, sizes=_STD),
+    _f("m5dn", "m", 5, "amd64", "intel", 4, 0.0680, network_gbps_per_vcpu=1.4,
+       local_nvme_gib_per_vcpu=37, sizes=_STD),
+    _f("m5ad", "m", 5, "amd64", "amd", 4, 0.0515, local_nvme_gib_per_vcpu=37),
+    _f("m5zn", "m", 5, "amd64", "intel", 4, 0.0826, network_gbps_per_vcpu=1.6,
+       sizes=("large", "xlarge", "2xlarge", "3xlarge", "6xlarge", "12xlarge", "metal")),
+    _f("m6id", "m", 6, "amd64", "intel", 4, 0.0566, local_nvme_gib_per_vcpu=59, sizes=_STD),
+    _f("m6idn", "m", 6, "amd64", "intel", 4, 0.0764, local_nvme_gib_per_vcpu=59,
+       network_gbps_per_vcpu=1.6, sizes=_STD),
+    _f("m6in", "m", 6, "amd64", "intel", 4, 0.0668, network_gbps_per_vcpu=1.6, sizes=_STD),
+    _f("m7gd", "m", 7, "arm64", "aws", 4, 0.0481, local_nvme_gib_per_vcpu=59),
+    _f("m7i-flex", "m", 7, "amd64", "intel", 4, 0.0479,
+       sizes=("large", "xlarge", "2xlarge", "4xlarge", "8xlarge")),
+    _f("m8g", "m", 8, "arm64", "aws", 4, 0.0449, sizes=_STD + ("48xlarge",)),
+    _f("a1", "a", 1, "arm64", "aws", 2, 0.0255,
+       sizes=("medium", "large", "xlarge", "2xlarge", "4xlarge", "metal")),
+    # memory optimized extras
+    _f("r5b", "r", 5, "amd64", "intel", 8, 0.0744, sizes=_STD),
+    _f("r5n", "r", 5, "amd64", "intel", 8, 0.0744, network_gbps_per_vcpu=1.4, sizes=_STD),
+    _f("r5dn", "r", 5, "amd64", "intel", 8, 0.0836, network_gbps_per_vcpu=1.4,
+       local_nvme_gib_per_vcpu=75, sizes=_STD),
+    _f("r5ad", "r", 5, "amd64", "amd", 8, 0.0655, local_nvme_gib_per_vcpu=75),
+    _f("r6id", "r", 6, "amd64", "intel", 8, 0.0756, local_nvme_gib_per_vcpu=118, sizes=_STD),
+    _f("r6idn", "r", 6, "amd64", "intel", 8, 0.0977, local_nvme_gib_per_vcpu=118,
+       network_gbps_per_vcpu=1.6, sizes=_STD),
+    _f("r6in", "r", 6, "amd64", "intel", 8, 0.0871, network_gbps_per_vcpu=1.6, sizes=_STD),
+    _f("r7gd", "r", 7, "arm64", "aws", 8, 0.0683, local_nvme_gib_per_vcpu=118),
+    _f("r7a", "r", 7, "amd64", "amd", 8, 0.0761, sizes=_STD + ("48xlarge",)),
+    _f("r7iz", "r", 7, "amd64", "intel", 8, 0.0930,
+       sizes=("large", "xlarge", "2xlarge", "4xlarge", "8xlarge", "12xlarge",
+              "16xlarge", "32xlarge", "metal")),
+    _f("r8g", "r", 8, "arm64", "aws", 8, 0.0590, sizes=_STD + ("48xlarge",)),
+    _f("z1d", "z", 1, "amd64", "intel", 8, 0.0930, local_nvme_gib_per_vcpu=75,
+       sizes=("large", "xlarge", "2xlarge", "3xlarge", "6xlarge", "12xlarge", "metal")),
+    # high memory extras
+    _f("x1", "x", 1, "amd64", "intel", 15, 0.1043, sizes=("16xlarge", "32xlarge")),
+    _f("x1e", "x", 1, "amd64", "intel", 30, 0.2086,
+       sizes=("xlarge", "2xlarge", "4xlarge", "8xlarge", "16xlarge", "32xlarge")),
+    _f("x2iedn", "x", 2, "amd64", "intel", 32, 0.3336, local_nvme_gib_per_vcpu=59,
+       sizes=("xlarge", "2xlarge", "4xlarge", "8xlarge", "16xlarge", "24xlarge",
+              "32xlarge", "metal")),
+    _f("x2iezn", "x", 2, "amd64", "intel", 16, 0.2084,
+       sizes=("2xlarge", "4xlarge", "6xlarge", "8xlarge", "12xlarge", "metal")),
+    _f("x8g", "x", 8, "arm64", "aws", 16, 0.0900, sizes=_STD + ("48xlarge",)),
+    # storage optimized extras
+    _f("im4gn", "i", 4, "arm64", "aws", 4, 0.0910, local_nvme_gib_per_vcpu=234,
+       sizes=("large", "xlarge", "2xlarge", "4xlarge", "8xlarge", "16xlarge")),
+    _f("is4gen", "i", 4, "arm64", "aws", 6, 0.1152, local_nvme_gib_per_vcpu=468,
+       sizes=("medium", "large", "xlarge", "2xlarge", "4xlarge", "8xlarge")),
+    _f("i4g", "i", 4, "arm64", "aws", 8, 0.0772, local_nvme_gib_per_vcpu=234,
+       sizes=("large", "xlarge", "2xlarge", "4xlarge", "8xlarge", "16xlarge")),
+    _f("i7ie", "i", 7, "amd64", "intel", 8, 0.1376, local_nvme_gib_per_vcpu=312,
+       sizes=("large", "xlarge", "2xlarge", "3xlarge", "6xlarge", "12xlarge",
+              "18xlarge", "24xlarge", "48xlarge")),
+    _f("d3en", "d", 3, "amd64", "intel", 8, 0.1501,
+       sizes=("xlarge", "2xlarge", "4xlarge", "6xlarge", "8xlarge", "12xlarge")),
+    _f("h1", "h", 1, "amd64", "intel", 4, 0.1170,
+       sizes=("2xlarge", "4xlarge", "8xlarge", "16xlarge")),
+    # HPC (EFA-first, no metal)
+    _f("hpc6a", "hpc", 6, "amd64", "amd", 4, 0.0300, sizes=("48xlarge",),
+       network_gbps_per_vcpu=1.0, efa_sizes=("48xlarge",)),
+    _f("hpc6id", "hpc", 6, "amd64", "intel", 16, 0.0892, sizes=("32xlarge",),
+       local_nvme_gib_per_vcpu=237, network_gbps_per_vcpu=1.5,
+       efa_sizes=("32xlarge",)),
+    _f("hpc7a", "hpc", 7, "amd64", "amd", 4, 0.0450,
+       sizes=("12xlarge", "24xlarge", "48xlarge", "96xlarge"),
+       network_gbps_per_vcpu=1.5, efa_sizes=("12xlarge", "24xlarge", "48xlarge", "96xlarge")),
+    _f("hpc7g", "hpc", 7, "arm64", "aws", 2, 0.0270,
+       sizes=("4xlarge", "8xlarge", "16xlarge"), network_gbps_per_vcpu=3.0,
+       efa_sizes=("4xlarge", "8xlarge", "16xlarge")),
+    # GPU extras
+    _f("g3", "g", 3, "amd64", "intel", 8, 0.2850, sizes=("4xlarge", "8xlarge", "16xlarge"),
+       gpu=("m60", "nvidia", 8),
+       gpus_by_size={"4xlarge": 1, "8xlarge": 2, "16xlarge": 4}),
+    _f("g3s", "g", 3, "amd64", "intel", 8, 0.1875, sizes=("xlarge",),
+       gpu=("m60", "nvidia", 8), gpus_by_size={"xlarge": 1}),
+    _f("p2", "p", 2, "amd64", "intel", 15, 0.2250, sizes=("xlarge", "8xlarge", "16xlarge"),
+       gpu=("k80", "nvidia", 12),
+       gpus_by_size={"xlarge": 1, "8xlarge": 8, "16xlarge": 16}),
+    _f("g6e", "g", 6, "amd64", "amd", 8, 0.4661, local_nvme_gib_per_vcpu=58,
+       sizes=("xlarge", "2xlarge", "4xlarge", "8xlarge", "12xlarge", "16xlarge",
+              "24xlarge", "48xlarge"),
+       gpu=("l40s", "nvidia", 48),
+       gpus_by_size={"xlarge": 1, "2xlarge": 1, "4xlarge": 1, "8xlarge": 1,
+                     "12xlarge": 4, "16xlarge": 1, "24xlarge": 4, "48xlarge": 8}),
+    _f("gr6", "g", 6, "amd64", "amd", 8, 0.2723, local_nvme_gib_per_vcpu=58,
+       sizes=("4xlarge", "8xlarge"), gpu=("l4", "nvidia", 24),
+       gpus_by_size={"4xlarge": 1, "8xlarge": 1}),
+    _f("p5e", "p", 5, "amd64", "amd", 10, 0.5500, local_nvme_gib_per_vcpu=158,
+       sizes=("48xlarge",), gpu=("h200", "nvidia", 141),
+       gpus_by_size={"48xlarge": 8}, efa_sizes=("48xlarge",)),
+    # video transcoding / FPGA / ML training extras
+    _f("vt1", "vt", 1, "amd64", "intel", 4, 0.1083,
+       sizes=("3xlarge", "6xlarge", "24xlarge"),
+       accel=("u30", "xilinx"),
+       accels_by_size={"3xlarge": 1, "6xlarge": 2, "24xlarge": 8}),
+    _f("f1", "f", 1, "amd64", "intel", 15, 0.2063,
+       sizes=("2xlarge", "4xlarge", "16xlarge"),
+       accel=("vu9p", "xilinx"),
+       accels_by_size={"2xlarge": 1, "4xlarge": 2, "16xlarge": 8}),
+    _f("dl1", "dl", 1, "amd64", "intel", 8, 0.1365,
+       sizes=("24xlarge",), accel=("gaudi", "habana"),
+       accels_by_size={"24xlarge": 8}, efa_sizes=("24xlarge",)),
+    _f("trn1n", "trn", 1, "amd64", "intel", 4, 0.4992,
+       sizes=("32xlarge",), accel=("trainium", "aws"),
+       accels_by_size={"32xlarge": 16}, efa_sizes=("32xlarge",),
+       network_gbps_per_vcpu=12.5),
+    _f("trn2", "trn", 2, "amd64", "intel", 4, 0.5100,
+       sizes=("48xlarge",), accel=("trainium2", "aws"),
+       accels_by_size={"48xlarge": 16}, efa_sizes=("48xlarge",)),
+    # arm GPU + large-scale training variants
+    _f("g5g", "g", 5, "arm64", "aws", 4, 0.1053,
+       sizes=("xlarge", "2xlarge", "4xlarge", "8xlarge", "16xlarge", "metal"),
+       gpu=("t4g", "nvidia", 16),
+       gpus_by_size={"xlarge": 1, "2xlarge": 1, "4xlarge": 1, "8xlarge": 1,
+                     "16xlarge": 2, "metal": 2}),
+    _f("g4ad", "g", 4, "amd64", "amd", 4, 0.0946, local_nvme_gib_per_vcpu=37,
+       sizes=("xlarge", "2xlarge", "4xlarge", "8xlarge", "16xlarge"),
+       gpu=("radeon-pro-v520", "amd", 8),
+       gpus_by_size={"xlarge": 1, "2xlarge": 1, "4xlarge": 1, "8xlarge": 2,
+                     "16xlarge": 4}),
+    _f("p4de", "p", 4, "amd64", "intel", 12, 0.4270, local_nvme_gib_per_vcpu=83,
+       sizes=("24xlarge",), gpu=("a100", "nvidia", 80),
+       gpus_by_size={"24xlarge": 8}, efa_sizes=("24xlarge",)),
+    _f("p3dn", "p", 3, "amd64", "intel", 8, 0.4266, local_nvme_gib_per_vcpu=18,
+       sizes=("24xlarge",), gpu=("v100", "nvidia", 32),
+       gpus_by_size={"24xlarge": 8}, efa_sizes=("24xlarge",)),
+    _f("i8g", "i", 8, "arm64", "aws", 8, 0.0993, local_nvme_gib_per_vcpu=234,
+       sizes=("large", "xlarge", "2xlarge", "4xlarge", "8xlarge", "16xlarge")),
+    # high-memory u-family (SAP-class, 112xlarge = 448 vCPUs)
+    _f("u-3tb1", "u", 1, "amd64", "intel", 14, 0.0488, sizes=("56xlarge",)),
+    _f("u-6tb1", "u", 1, "amd64", "intel", 14, 0.0975, sizes=("56xlarge", "112xlarge")),
+    _f("u-9tb1", "u", 1, "amd64", "intel", 21, 0.0915, sizes=("112xlarge",)),
+    _f("u-12tb1", "u", 1, "amd64", "intel", 27, 0.0813, sizes=("112xlarge",)),
+    _f("u7i-6tb", "u", 7, "amd64", "intel", 14, 0.1040, sizes=("112xlarge",)),
+    _f("u7i-8tb", "u", 7, "amd64", "intel", 18, 0.1210, sizes=("112xlarge",)),
+    _f("u7i-12tb", "u", 7, "amd64", "intel", 27, 0.1626, sizes=("112xlarge",)),
+    # mac workstations (dedicated-host bare metal)
+    _f("mac1", "mac", 1, "amd64", "intel", 3, 0.0902, sizes=("metal",),
+       metal_vcpus=12),
+    _f("mac2", "mac", 2, "arm64", "apple", 2, 0.0813, sizes=("metal",),
+       metal_vcpus=8),
+    _f("mac2-m2", "mac", 2, "arm64", "apple", 3, 0.0820, sizes=("metal",),
+       metal_vcpus=8),
+    _f("mac2-m2pro", "mac", 2, "arm64", "apple", 3, 0.1103, sizes=("metal",),
+       metal_vcpus=12),
+    # previous generations (still served by DescribeInstanceTypes)
+    _f("c3", "c", 3, "amd64", "intel", 2, 0.0525,
+       sizes=("large", "xlarge", "2xlarge", "4xlarge", "8xlarge")),
+    _f("m3", "m", 3, "amd64", "intel", 4, 0.0665,
+       sizes=("medium", "large", "xlarge", "2xlarge")),
+    _f("r3", "r", 3, "amd64", "intel", 8, 0.0832,
+       sizes=("large", "xlarge", "2xlarge", "4xlarge", "8xlarge")),
+    _f("i2", "i", 2, "amd64", "intel", 8, 0.2133,
+       sizes=("xlarge", "2xlarge", "4xlarge", "8xlarge"),
+       local_nvme_gib_per_vcpu=200),
+    _f("d2", "d", 2, "amd64", "intel", 8, 0.1725,
+       sizes=("xlarge", "2xlarge", "4xlarge", "8xlarge")),
+    _f("g2", "g", 2, "amd64", "intel", 2, 0.1625, sizes=("2xlarge", "8xlarge"),
+       gpu=("k520", "nvidia", 4), gpus_by_size={"2xlarge": 1, "8xlarge": 4}),
+    _f("m1", "m", 1, "amd64", "intel", 2, 0.0438,
+       sizes=("small", "medium", "large", "xlarge")),
+    _f("m2", "m", 2, "amd64", "intel", 9, 0.0613,
+       sizes=("xlarge", "2xlarge", "4xlarge")),
+    _f("c1", "c", 1, "amd64", "intel", 1, 0.0650, sizes=("medium", "xlarge")),
+    _f("t1", "t", 1, "amd64", "intel", 1, 0.0200, sizes=("micro",),
+       network_gbps_per_vcpu=0.1),
 )
 
 # irregular sizes used by a few families
+_SIZES["nano"] = 1
+_SIZES["micro"] = 1
+_SIZES["small"] = 1
+_SIZES["3xlarge"] = 12
 _SIZES["6xlarge"] = 24
 _SIZES["9xlarge"] = 36
+_SIZES["18xlarge"] = 72
+_SIZES["96xlarge"] = 384
+_SIZES["56xlarge"] = 224
+_SIZES["112xlarge"] = 448
 
 
 def _stable_fraction(seed: str) -> float:
@@ -237,9 +472,10 @@ def build_catalog(families: Sequence[FamilySpec] = FAMILIES) -> List[InstanceTyp
         for size in f.sizes:
             vcpus = _SIZES[size]
             if size == "metal":
-                vcpus = max(_SIZES[s] for s in f.sizes if s != "metal")
+                non_metal = [_SIZES[s] for s in f.sizes if s != "metal"]
+                vcpus = max(non_metal) if non_metal else (f.metal_vcpus or 12)
             name = f"{f.family}.{size}"
-            enis, ips = _eni(vcpus)
+            enis, ips = _VPC_LIMIT_OVERRIDES.get(name) or _eni(vcpus)
             gpus = f.gpus_by_size.get(size, 0)
             accels = f.accels_by_size.get(size, 0)
             gpu_name, gpu_mfr, gpu_mem_gib = f.gpu
@@ -254,7 +490,8 @@ def build_catalog(families: Sequence[FamilySpec] = FAMILIES) -> List[InstanceTyp
                 hypervisor="" if size == "metal" else ("nitro" if f.generation >= 5 or f.category in ("g", "p", "inf", "trn", "x", "i") else "xen"),
                 bare_metal=size == "metal",
                 enis=enis, ipv4_per_eni=ips,
-                network_bandwidth_mbps=int(vcpus * f.network_gbps_per_vcpu * 1000),
+                network_bandwidth_mbps=_BANDWIDTH_OVERRIDES.get(
+                    name, int(vcpus * f.network_gbps_per_vcpu * 1000)),
                 ebs_bandwidth_mbps=min(80_000, 650 * vcpus),
                 local_nvme_bytes=vcpus * f.local_nvme_gib_per_vcpu * GIB,
                 gpu_name=gpu_name if gpus else "",
@@ -279,3 +516,21 @@ def spot_price(info: InstanceTypeInfo, zone: str) -> int:
 
 def catalog_by_name(catalog: Sequence[InstanceTypeInfo]) -> Dict[str, InstanceTypeInfo]:
     return {i.name: i for i in catalog}
+
+
+# ---------------------------------------------------------------------------
+# generated-table views: the zz_generated.vpclimits.go /
+# zz_generated.bandwidth.go equivalents — explicit per-type rows built once
+# from the parametric specs + the irregular overrides above, deterministic
+# across processes
+# ---------------------------------------------------------------------------
+
+_DEFAULT_CATALOG: List[InstanceTypeInfo] = build_catalog()
+
+#: type name -> (max ENIs, IPv4 addresses per ENI)
+VPC_LIMITS: Dict[str, Tuple[int, int]] = {
+    i.name: (i.enis, i.ipv4_per_eni) for i in _DEFAULT_CATALOG}
+
+#: type name -> network bandwidth in Mbps
+BANDWIDTH_MBPS: Dict[str, int] = {
+    i.name: i.network_bandwidth_mbps for i in _DEFAULT_CATALOG}

@@ -295,6 +295,84 @@ def canonical_pod_groups(pods: Sequence[Pod]) -> List[Tuple[Tuple, List[Pod]]]:
     return [(sig, plist) for _, sig, plist in entries]
 
 
+@dataclass
+class _CatalogEncoding:
+    """Catalog-side tensors, reused while the catalog objects are stable.
+
+    Everything here derives from the instance-type objects + the zone map
+    alone — not from pods — and the instancetype provider hands out the
+    SAME objects until a catalog/offerings seqnum bump rebuilds them
+    (instancetype.go:119-130 cache-key discipline). Caching by object
+    identity therefore invalidates exactly when the provider does, and
+    removes the O(T x requirements) interning + O(T x Z x C) offerings
+    assembly from the per-solve hot path (at ~850 types that was most of
+    encode time)."""
+    universe: LabelUniverse
+    types: List[InstanceType]
+    type_names: List[str]
+    type_pos: Dict[str, int]
+    type_val: np.ndarray
+    A: np.ndarray
+    avail: np.ndarray
+    price: np.ndarray
+    zones: List[str]
+    zid_of: Dict[str, str]
+
+
+_CATALOG_CACHE: Dict[Tuple, _CatalogEncoding] = {}
+_CATALOG_CACHE_CAP = 8
+_CATALOG_MU = threading.Lock()
+
+
+def _encode_catalog(seen: Dict[str, InstanceType],
+                    snapshot_zones: Tuple[Tuple[str, str], ...],
+                    dims: Tuple[str, ...]) -> _CatalogEncoding:
+    types = [seen[k] for k in sorted(seen)]
+    key = (tuple(id(t) for t in types), snapshot_zones, dims)
+    with _CATALOG_MU:
+        hit = _CATALOG_CACHE.get(key)
+        if hit is not None:
+            return hit
+    universe = LabelUniverse(types)
+    type_val = universe.type_value_ids(types)
+    dpos = {d: i for i, d in enumerate(dims)}
+    zone_set: Set[str] = {z for z, _ in snapshot_zones}
+    zid_of: Dict[str, str] = dict(snapshot_zones)
+    for t in types:
+        for o in t.offerings:
+            zone_set.add(o.zone)
+            if o.zone_id:
+                zid_of.setdefault(o.zone, o.zone_id)
+    zones = sorted(zone_set)
+    zpos = {z: i for i, z in enumerate(zones)}
+    Z, C, T, D = len(zones), len(CAPACITY_TYPES), len(types), len(dims)
+    cpos = {c: i for i, c in enumerate(CAPACITY_TYPES)}
+    avail = np.zeros((T, Z, C), dtype=bool)
+    price = np.full((T, Z, C), PRICE_INF, dtype=np.int64)
+    A = np.zeros((T, D), dtype=np.int64)
+    for ti, t in enumerate(types):
+        for k, q in t.allocatable().items():
+            i = dpos.get(k)
+            if i is not None:
+                A[ti, i] = q
+        for o in t.offerings:
+            zi, ci = zpos[o.zone], cpos[o.capacity_type]
+            price[ti, zi, ci] = o.price
+            if o.available:
+                avail[ti, zi, ci] = True
+    enc = _CatalogEncoding(
+        universe=universe, types=types,
+        type_names=[t.name for t in types],
+        type_pos={t.name: i for i, t in enumerate(types)},
+        type_val=type_val, A=A, avail=avail, price=price,
+        zones=zones, zid_of=zid_of)
+    with _CATALOG_MU:
+        if len(_CATALOG_CACHE) >= _CATALOG_CACHE_CAP:
+            _CATALOG_CACHE.clear()  # tiny cache; staleness-by-identity only
+        _CATALOG_CACHE[key] = enc
+    return enc
+
+
 def encode_snapshot(snapshot: SchedulingSnapshot) -> SnapshotEncoding:
     # --- groups (canonical FFD order, O(n) grouping) ----------------------
     groups: List[PodGroup] = []
@@ -309,10 +387,6 @@ def encode_snapshot(snapshot: SchedulingSnapshot) -> SnapshotEncoding:
     for spec in snapshot.nodepools:
         for t in spec.instance_types:
             seen.setdefault(t.name, t)
-    types = [seen[k] for k in sorted(seen)]
-    type_pos = {t.name: i for i, t in enumerate(types)}
-    universe = LabelUniverse(types)
-    type_val = universe.type_value_ids(types)
 
     # --- dims -----------------------------------------------------------
     dims_set = {"cpu", "memory", "pods"}
@@ -334,29 +408,14 @@ def encode_snapshot(snapshot: SchedulingSnapshot) -> SnapshotEncoding:
                 v[i] = q
         return v
 
-    # --- zones / offerings ---------------------------------------------
-    zone_set: Set[str] = set(snapshot.zones)
-    zid_of: Dict[str, str] = dict(snapshot.zones)
-    for t in types:
-        for o in t.offerings:
-            zone_set.add(o.zone)
-            if o.zone_id:
-                zid_of.setdefault(o.zone, o.zone_id)
-    zones = sorted(zone_set)
-    zpos = {z: i for i, z in enumerate(zones)}
+    # --- catalog tensors (cached while the type objects are stable) ------
+    cenc = _encode_catalog(
+        seen, tuple(sorted(snapshot.zones.items())), tuple(dims))
+    types, type_pos = cenc.types, cenc.type_pos
+    universe, type_val = cenc.universe, cenc.type_val
+    zones, zid_of = cenc.zones, cenc.zid_of
+    A, avail, price = cenc.A, cenc.avail, cenc.price
     Z, C, T, D = len(zones), len(CAPACITY_TYPES), len(types), len(dims)
-    cpos = {c: i for i, c in enumerate(CAPACITY_TYPES)}
-
-    avail = np.zeros((T, Z, C), dtype=bool)
-    price = np.full((T, Z, C), PRICE_INF, dtype=np.int64)
-    A = np.zeros((T, D), dtype=np.int64)
-    for ti, t in enumerate(types):
-        A[ti] = vec(t.allocatable())
-        for o in t.offerings:
-            zi, ci = zpos[o.zone], cpos[o.capacity_type]
-            price[ti, zi, ci] = o.price
-            if o.available:
-                avail[ti, zi, ci] = True
 
     # --- group tensors --------------------------------------------------
     G = len(groups)
@@ -431,7 +490,7 @@ def encode_snapshot(snapshot: SchedulingSnapshot) -> SnapshotEncoding:
 
     return SnapshotEncoding(
         universe=universe, dims=dims, zones=zones, zone_ids=zid_of,
-        types=types, type_names=[t.name for t in types],
+        types=types, type_names=cenc.type_names,
         type_val=type_val, A=A, avail=avail, price=price,
         groups=groups, R=R, n=n, F=F, agz=agz, agc=agc,
         pools=pools, admit=admit, daemon=daemon,

@@ -87,7 +87,7 @@ class Operator:
         self.instances = InstanceProvider(
             self.ec2, self.subnets, self.launch_templates,
             self.unavailable_offerings,
-            cluster_name=self.options.cluster_name)
+            cluster_name=self.options.cluster_name, metrics=self.metrics)
 
         # the plugin boundary + core state (main.go:31-40)
         self.cloudprovider = CloudProvider(
@@ -119,7 +119,9 @@ class Operator:
         self.interruption = InterruptionController(
             self.kube, self.sqs, self.unavailable_offerings,
             metrics=self.metrics, clock=clock, recorder=self.recorder)
-        self.catalog_controller = CatalogController(self.ec2, self.instance_types)
+        self.catalog_controller = CatalogController(
+            self.ec2, self.instance_types, metrics=self.metrics,
+            unavailable_offerings=self.unavailable_offerings)
         self.pricing_controller = PricingController(self.pricing)
         self.nodeclass_hash = NodeClassHashController(self.kube)
         self.discovered_capacity = DiscoveredCapacityController(

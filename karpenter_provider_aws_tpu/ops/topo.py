@@ -236,8 +236,12 @@ class _Pour:
         self.zone_needed = tenc.zone_needed[g]
         self.min_mask = tenc.min_mask[g]
         #: zones with any available offering per type (_choose_zone scans
-        #: zones of available offerings regardless of capacity type)
-        self.avail_anyct = enc.avail.any(axis=2)               # [T, Z]
+        #: zones of available offerings regardless of capacity type);
+        #: computed once per encoding, not once per pour
+        self.avail_anyct = getattr(enc, "_avail_anyct", None)
+        if self.avail_anyct is None:
+            self.avail_anyct = enc.avail.any(axis=2)           # [T, Z]
+            enc._avail_anyct = self.avail_anyct
 
         # Slot admission is eager (cheap); candidate types and headroom per
         # slot are LAZY — first-fit only ever inspects a handful of slots

@@ -55,16 +55,19 @@ class InstanceProvider:
     def __init__(self, ec2, subnet_provider: SubnetProvider,
                  launch_template_provider: LaunchTemplateProvider,
                  unavailable_offerings: UnavailableOfferings,
-                 cluster_name: str = "cluster", clock=None):
+                 cluster_name: str = "cluster", clock=None, metrics=None):
         self.ec2 = ec2
         self.subnets = subnet_provider
         self.launch_templates = launch_template_provider
         self.unavailable = unavailable_offerings
         self.cluster_name = cluster_name
         clock = clock or time.monotonic
-        self.create_fleet = CreateFleetBatcher(ec2, clock=clock)
-        self.describe = DescribeInstancesBatcher(ec2, clock=clock)
-        self.terminate_batcher = TerminateInstancesBatcher(ec2, clock=clock)
+        self.create_fleet = CreateFleetBatcher(ec2, clock=clock,
+                                               metrics=metrics)
+        self.describe = DescribeInstancesBatcher(ec2, clock=clock,
+                                                 metrics=metrics)
+        self.terminate_batcher = TerminateInstancesBatcher(ec2, clock=clock,
+                                                           metrics=metrics)
 
     # -- create --------------------------------------------------------
     def create(self, nodeclass: EC2NodeClass, nodeclaim: NodeClaim,

@@ -25,7 +25,8 @@ from ..apis.resources import (AWS_EFA, AWS_NEURON, AWS_POD_ENI, NVIDIA_GPU,
 from ..cache.ttl import TTLCache
 from ..cloudprovider.types import (InstanceType, InstanceTypes, Offering,
                                    Offerings, Overhead)
-from ..fake.catalog import GIB, InstanceTypeInfo, ZoneInfo
+from ..fake.catalog import (BANDWIDTH_MBPS, GIB,
+                            InstanceTypeInfo, ZoneInfo)
 
 #: default VM memory overhead (options.go: vm-memory-overhead-percent=0.075)
 DEFAULT_VM_MEMORY_OVERHEAD_PERCENT = 0.075
@@ -183,7 +184,8 @@ class InstanceTypeProvider:
             Requirement.new(L.INSTANCE_CPU_MANUFACTURER, IN, [info.cpu_manufacturer]),
             Requirement.new(L.INSTANCE_MEMORY, IN, [str(info.memory_bytes // MIB)]),
             Requirement.new(L.INSTANCE_NETWORK_BANDWIDTH, IN,
-                            [str(info.network_bandwidth_mbps)]),
+                            [str(BANDWIDTH_MBPS.get(
+                                info.name, info.network_bandwidth_mbps))]),
             Requirement.new(L.INSTANCE_EBS_BANDWIDTH, IN,
                             [str(info.ebs_bandwidth_mbps)]),
             Requirement.new(L.INSTANCE_ENCRYPTION_IN_TRANSIT, IN,
@@ -260,7 +262,12 @@ class InstanceTypeProvider:
     def _max_pods(info: InstanceTypeInfo, kubelet: KubeletConfiguration) -> int:
         if kubelet.max_pods is not None:
             return kubelet.max_pods
-        pods = info.eni_pod_limit
+        # the generated per-type table is authoritative, exactly as the
+        # reference consults zz_generated.vpclimits.go by type name; the
+        # formula fields are the fallback for types outside the table
+        from ..fake.catalog import VPC_LIMITS
+        lim = VPC_LIMITS.get(info.name)
+        pods = lim[0] * (lim[1] - 1) + 2 if lim else info.eni_pod_limit
         if kubelet.pods_per_core is not None:
             pods = min(pods, kubelet.pods_per_core * info.vcpus)
         return pods

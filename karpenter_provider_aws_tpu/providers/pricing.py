@@ -138,23 +138,29 @@ class SQSProvider:
     def __init__(self, queue_name: str = "karpenter-interruption"):
         self.queue_name = queue_name
         self._mu = threading.Lock()
-        self._messages: List[InterruptionMessage] = []
+        #: receipt -> message, insertion-ordered (O(1) delete — the list
+        #: rebuild the naive version did made a 15k-message drain O(n^2))
+        self._messages: Dict[str, InterruptionMessage] = {}
         self._receipt = 0
 
     def send(self, message: InterruptionMessage) -> None:
         with self._mu:
             self._receipt += 1
             message.receipt = str(self._receipt)
-            self._messages.append(message)
+            self._messages[message.receipt] = message
 
     def receive(self, max_messages: int = 10) -> List[InterruptionMessage]:
         with self._mu:
-            return list(self._messages[:max_messages])
+            out = []
+            for m in self._messages.values():
+                out.append(m)
+                if len(out) >= max_messages:
+                    break
+            return out
 
     def delete(self, message: InterruptionMessage) -> None:
         with self._mu:
-            self._messages = [m for m in self._messages
-                              if m.receipt != message.receipt]
+            self._messages.pop(message.receipt, None)
 
     def __len__(self) -> int:
         with self._mu:

@@ -79,6 +79,37 @@ _DEVICE_ALIVE_MU = threading.Lock()
 _DEVICE_DEAD_RECHECK_S = 300.0
 
 
+_PROBE_IN_FLIGHT = threading.Event()
+
+
+def device_alive_nonblocking() -> Optional[bool]:
+    """Current device verdict without ever blocking the caller.
+
+    Returns True/False from cache, or None when no fresh verdict exists —
+    in which case ONE background probe is kicked off (subsequent callers
+    see None until it lands). The solve path must never wait the probe's
+    up-to-90s subprocess timeout (and on healthy machines must not pay
+    its python+jax import either)."""
+    with _DEVICE_ALIVE_MU:
+        if _DEVICE_ALIVE is True:
+            return True
+        if _DEVICE_ALIVE is False and \
+                time.monotonic() - _DEVICE_ALIVE_AT < _DEVICE_DEAD_RECHECK_S:
+            return False
+    if not _PROBE_IN_FLIGHT.is_set():
+        _PROBE_IN_FLIGHT.set()
+
+        def _bg():
+            try:
+                device_alive()
+            finally:
+                _PROBE_IN_FLIGHT.clear()
+
+        threading.Thread(target=_bg, daemon=True,
+                         name="device-alive-probe").start()
+    return None
+
+
 def device_alive(timeout: float = 90.0) -> bool:
     """Probe jax backend liveness in a SUBPROCESS with a hard timeout.
 
@@ -95,28 +126,32 @@ def device_alive(timeout: float = 90.0) -> bool:
         if _DEVICE_ALIVE is False and \
                 time.monotonic() - _DEVICE_ALIVE_AT < _DEVICE_DEAD_RECHECK_S:
             return False
-        import subprocess
-        import sys
-        # inherit an explicit platform override (tests force cpu via
-        # jax.config.update — which, unlike the JAX_PLATFORMS env var,
-        # reliably skips a wedged accelerator plugin)
-        plat = None
-        if "jax" in sys.modules:
-            try:
-                plat = sys.modules["jax"].config.jax_platforms
-            except Exception:
-                plat = None
-        code = "import jax\n"
-        if plat:
-            code += f"jax.config.update('jax_platforms', {plat!r})\n"
-        code += "jax.devices(); print('ok')"
+    # probe OUTSIDE the mutex: nonblocking readers must never queue
+    # behind a 90s subprocess wait (two concurrent probes are harmless —
+    # last writer wins with the same verdict)
+    import subprocess
+    import sys
+    # inherit an explicit platform override (tests force cpu via
+    # jax.config.update — which, unlike the JAX_PLATFORMS env var,
+    # reliably skips a wedged accelerator plugin)
+    plat = None
+    if "jax" in sys.modules:
         try:
-            proc = subprocess.run([sys.executable, "-c", code],
-                                  timeout=timeout, capture_output=True)
-            _DEVICE_ALIVE = proc.returncode == 0 \
-                and b"ok" in proc.stdout
+            plat = sys.modules["jax"].config.jax_platforms
         except Exception:
-            _DEVICE_ALIVE = False
+            plat = None
+    code = "import jax\n"
+    if plat:
+        code += f"jax.config.update('jax_platforms', {plat!r})\n"
+    code += "jax.devices(); print('ok')"
+    try:
+        proc = subprocess.run([sys.executable, "-c", code],
+                              timeout=timeout, capture_output=True)
+        verdict = proc.returncode == 0 and b"ok" in proc.stdout
+    except Exception:
+        verdict = False
+    with _DEVICE_ALIVE_MU:
+        _DEVICE_ALIVE = verdict
         _DEVICE_ALIVE_AT = time.monotonic()
         return _DEVICE_ALIVE
 
@@ -133,13 +168,27 @@ def routed(router: Router, bucket: Tuple,
     background probe observes the device healthy again."""
     choice = router.choose(bucket)
     metrics = router.metrics
-    if choice == "both" and not device_alive():
-        # wedged/absent device: park it and serve from the host twin
-        router.observe(bucket, "dev", DEV_FAILED_MS)
-        choice = ("host", False)
-        if metrics is not None:
-            metrics.inc(f"karpenter_{router.name}_route_total",
-                        labels={"route": "dev-unreachable"})
+    if choice == "both":
+        alive = device_alive_nonblocking()
+        if alive is None:
+            # verdict pending (background probe running): serve the host
+            # twin WITHOUT recording a dev observation, so this bucket
+            # re-enters calibration once the probe lands
+            t0 = time.perf_counter()
+            out = host_fn()
+            router.observe(bucket, "host",
+                           (time.perf_counter() - t0) * 1000)
+            if metrics is not None:
+                metrics.inc(f"karpenter_{router.name}_route_total",
+                            labels={"route": "probe-pending"})
+            return out
+        if alive is False:
+            # wedged/absent device: park it and serve from the host twin
+            router.observe(bucket, "dev", DEV_FAILED_MS)
+            choice = ("host", False)
+            if metrics is not None:
+                metrics.inc(f"karpenter_{router.name}_route_total",
+                            labels={"route": "dev-unreachable"})
     if choice == "both":
         try:
             dev_fn()  # first device run pays the XLA compile; not recorded

@@ -344,6 +344,10 @@ class TPUSolver(Solver):
                 unschedulable[p.full_name()] = "no capacity in any nodepool"
 
         new_nodes: List[NewNodeClaim] = []
+        #: (zone-mask, ct-mask) -> per-type best price; nodes share few
+        #: distinct mask patterns (usually one per zone), so the [T, Z, C]
+        #: reduction runs once per pattern instead of once per node
+        best_cache: Dict[bytes, np.ndarray] = {}
         for slot in sorted(slot_pods):
             pods = slot_pods[slot]
             pool = enc.pools[int(final["pool"][slot])]
@@ -351,9 +355,13 @@ class TPUSolver(Solver):
             zmask = final["zones"][slot]
             cmask = final["ct"][slot]
             # price per candidate type under the node's (zone, ct) masks
-            pz = np.where(enc.avail & zmask[None, :, None] & cmask[None, None, :],
-                          enc.price, np.int64(1) << 62)
-            best = pz.min(axis=(1, 2))
+            ck = zmask.tobytes() + cmask.tobytes()
+            best = best_cache.get(ck)
+            if best is None:
+                pz = np.where(
+                    enc.avail & zmask[None, :, None] & cmask[None, None, :],
+                    enc.price, np.int64(1) << 62)
+                best = best_cache[ck] = pz.min(axis=(1, 2))
             # (price, name) order: types are name-sorted in the encoding,
             # so a stable argsort on price alone breaks ties by name
             idx = np.nonzero(tmask)[0]
@@ -368,10 +376,14 @@ class TPUSolver(Solver):
                 reqs = reqs.add(Requirement.new(
                     L.ZONE, IN, [enc.zones[int(zfix[slot])]]))
             used_vec = final["used"][slot]
+            # per-group chunks arrive in ascending (ns, name) order, so
+            # the concatenation is a few sorted runs — timsort is ~O(n)
+            names = [p.full_name() for p in pods]
+            names.sort()
             new_nodes.append(NewNodeClaim(
                 nodepool=pool.spec.nodepool.metadata.name,
                 requirements=reqs,
-                pod_names=sorted(p.full_name() for p in pods),
+                pod_names=names,
                 instance_type_names=[enc.type_names[i] for i in order],
                 requests=Resources({d: int(used_vec[i])
                                     for i, d in enumerate(enc.dims)}),

@@ -39,6 +39,13 @@ class Metrics:
         with self._mu:
             self.histograms.setdefault((name, _lk(labels)), []).append(value)
 
+    def clear_series(self, name: str) -> None:
+        """Drop every labeled series of a gauge (full re-emit pattern:
+        series for entities that vanished must not linger stale)."""
+        with self._mu:
+            for key in [k for k in self.gauges if k[0] == name]:
+                del self.gauges[key]
+
     # -- reads -----------------------------------------------------------
     def counter(self, name: str, labels: Optional[Mapping[str, str]] = None) -> float:
         return self.counters.get((name, _lk(labels)), 0.0)

@@ -99,43 +99,34 @@ class TestProvisioningE2E:
 
 class TestICERetry:
     def test_ice_blacklists_and_retries(self, op):
+        """ICE on the launcher's first-choice pool is observed, blacklisted
+        (seqnum bump feeds the next solve), and the launch falls through to
+        another pool. Deterministic against any catalog: run once clean to
+        learn the first choice, then ICE exactly that pool."""
+        def spot_pod(prefix):
+            return make_pods(1, cpu="1", memory="2Gi", prefix=prefix,
+                             node_selector={L.CAPACITY_TYPE: "spot"})[0]
+
+        # dry run: learn the deterministic first-choice (type, zone)
         mk_cluster(op)
-        # every pool ICEs for the cheapest spot choice; claim relaunches
-        pods = make_pods(1, cpu="1", memory="2Gi", prefix="ice",
-                         node_selector={L.CAPACITY_TYPE: "spot",
-                                        L.ZONE: "us-west-2a"})
-        for p in pods:
-            op.kube.create(p)
-        # predict first choice by solving once
-        op.step()  # nodeclass ready
-        # find what got launched OR ICE everything the first claim tries
-        claims = op.kube.list("NodeClaim")
-        if not claims:
-            op.step()
-            claims = op.kube.list("NodeClaim")
-        # restart clean: inject ICE for every (type, us-west-2a, spot) pool
-        for info in op.ec2.catalog:
-            op.ec2.insufficient_capacity_pools.add(
-                (info.name, "us-west-2a", "spot"))
-        # nuke current state and re-create pod
-        for c in op.kube.list("NodeClaim"):
-            op.kube.delete("NodeClaim", c.name)
-        op.terminator.reconcile()
+        op.kube.create(spot_pod("ice-probe"))
+        op.run_until_settled()
+        first = op.ec2.describe_instances()[0]
+        choice = (first.instance_type, first.zone)
+
+        # fresh cluster with exactly that pool ICE'd
         op2 = Operator()
         mk_cluster(op2)
-        for info in op2.ec2.catalog:
-            op2.ec2.insufficient_capacity_pools.add(
-                (info.name, "us-west-2a", "spot"))
-        op2.kube.create(make_pods(
-            1, cpu="1", memory="2Gi", prefix="ice2",
-            node_selector={L.CAPACITY_TYPE: "spot"})[0])
+        op2.ec2.insufficient_capacity_pools.add(
+            (choice[0], choice[1], "spot"))
+        op2.kube.create(spot_pod("ice2"))
         op2.run_until_settled()
         pods2 = op2.kube.list("Pod")
         assert all(p.node_name for p in pods2)
-        # the launched instance avoided the ICE'd zone
+        # the launched instance avoided the ICE'd pool
         inst = op2.ec2.describe_instances()[0]
-        assert inst.zone != "us-west-2a"
-        # and the offerings got blacklisted
+        assert (inst.instance_type, inst.zone) != choice
+        # and the offering got blacklisted (the solver input seqnum moved)
         assert op2.unavailable_offerings.seqnum > 0
 
 

@@ -151,3 +151,80 @@ class TestVersionController:
         src[0] = "1.99.0"
         with pytest.raises(ValueError):
             c.reconcile(force=True)
+
+
+class TestInterruptionThroughput:
+    def test_parallel_drain_at_scale(self):
+        """The 10-way fan-out (interruption/controller.go:116) drains a
+        deep queue fast and exactly once per message — the envelope the
+        reference's interruption_benchmark_test.go:58-157 measures."""
+        import time as _time
+
+        from karpenter_provider_aws_tpu.apis import labels as L
+        from karpenter_provider_aws_tpu.apis.objects import (NodeClaim,
+                                                             NodeClassRef)
+        from karpenter_provider_aws_tpu.apis.requirements import Requirements
+        from karpenter_provider_aws_tpu.operator import Operator
+        from karpenter_provider_aws_tpu.providers.pricing import \
+            InterruptionMessage
+
+        op = Operator()
+        n = 2000
+        for i in range(n):
+            claim = NodeClaim(
+                f"thr-{i:05d}", requirements=Requirements([]),
+                node_class_ref=NodeClassRef("x"),
+                labels={L.NODEPOOL: "p", L.INSTANCE_TYPE: "m5.large",
+                        L.ZONE: "us-west-2a"})
+            claim.provider_id = f"aws:///us-west-2a/i-thr{i:08d}"
+            op.kube.create(claim)
+            op.sqs.send(InterruptionMessage(
+                kind="spot_interruption", instance_id=f"i-thr{i:08d}"))
+        t0 = _time.perf_counter()
+        stats = op.interruption.reconcile()
+        dt = _time.perf_counter() - t0
+        assert stats["handled"] == n
+        assert stats["cordoned"] == n      # exactly once despite 10 workers
+        assert len(op.sqs) == 0
+        assert n / dt > 2000, f"throughput too low: {n/dt:.0f} msg/s"
+        assert op.metrics.counter(
+            "karpenter_interruption_received_messages_total",
+            labels={"message_type": "spot_interruption"}) == n
+
+
+class TestMetricsBuildout:
+    def test_offering_and_batcher_series(self):
+        """metrics.md parity: offering availability/price gauges, batcher
+        size/time histograms, scheduler queue depth, disruption decision
+        duration — all present after one provisioned round."""
+        from tests.test_e2e_slice import mk_cluster
+
+        from karpenter_provider_aws_tpu.fake.environment import make_pods
+        from karpenter_provider_aws_tpu.operator import Operator
+
+        op = Operator()
+        mk_cluster(op)
+        for p in make_pods(5, cpu="500m", prefix="met"):
+            op.kube.create(p)
+        op.run_until_settled()
+        body = op.metrics.render()
+        for series in (
+                "karpenter_cloudprovider_instance_type_offering_available",
+                "karpenter_cloudprovider_instance_type_offering_price_estimate",
+                "karpenter_cloudprovider_instance_type_cpu_cores",
+                "karpenter_cloudprovider_batcher_batch_size",
+                "karpenter_scheduler_scheduling_duration_seconds",
+                "karpenter_scheduler_queue_depth",
+                "karpenter_voluntary_disruption_decision_evaluation"
+                "_duration_seconds"):
+            assert series in body, f"missing {series}"
+
+    def test_gauge_series_cleared_on_refresh(self):
+        from karpenter_provider_aws_tpu.utils.metrics import Metrics
+        m = Metrics()
+        m.set_gauge("g", 1.0, labels={"a": "x"})
+        m.set_gauge("g", 2.0, labels={"a": "y"})
+        m.set_gauge("other", 3.0)
+        m.clear_series("g")
+        assert m.gauge("g", {"a": "x"}) == 0.0
+        assert m.gauge("other") == 3.0
