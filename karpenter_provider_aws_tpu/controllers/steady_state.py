@@ -284,9 +284,20 @@ class CatalogController:
             od_prices=od,
             spot_prices=spot,
         ))
-        if changed and self.metrics is not None:
+        if self.metrics is not None:
+            # unconditionally: availability also tracks the 3m-TTL ICE
+            # blacklist, which moves far more often than the catalog
             self._emit_gauges(infos, type_zones, od, spot)
+            self._gauge_inputs = (infos, type_zones, od, spot)
         return changed
+
+    def refresh_gauges(self) -> None:
+        """Re-sample offering availability against the current ICE
+        blacklist without a catalog sweep (the daemon runs this at a
+        short cadence so the gauge tracks the 3m blacklist TTL)."""
+        inputs = getattr(self, "_gauge_inputs", None)
+        if inputs is not None and self.metrics is not None:
+            self._emit_gauges(*inputs)
 
     def _emit_gauges(self, infos, type_zones, od, spot) -> None:
         """Provider-side gauges (instancetype/metrics.go,

@@ -128,6 +128,8 @@ class Daemon:
             CATALOG_REFRESH)
         reg("providers.pricing", op.pricing_controller.reconcile,
             PRICING_REFRESH)
+        reg("providers.instancetype.metrics",
+            op.catalog_controller.refresh_gauges, 60.0)
         reg("providers.instancetype.capacity",
             op.discovered_capacity.reconcile, CAPACITY_TICK)
         reg("providers.ssm.invalidation", op.ssm_invalidation.reconcile,

@@ -68,6 +68,7 @@ class FakeLaunchTemplate:
     tags: Dict[str, str] = field(default_factory=dict)
     metadata_options: Optional[dict] = None
     block_device_mappings: Optional[list] = None
+    network_interfaces: Optional[list] = None
     instance_profile: str = ""
 
 
@@ -156,6 +157,8 @@ class FakeEC2:
         self.ssm_get_parameter_log = CallLog()
         #: EKS DescribeCluster version (the version controller's source)
         self.eks_cluster_version = "1.31"
+        #: cluster service CIDR (resolveClusterCIDR source)
+        self.eks_cluster_cidr = "10.100.0.0/16"
 
         self._seed_default_network()
         self._seed_default_images()

@@ -18,6 +18,16 @@ from ..fake.kube import FakeKube
 from ..state.cluster import ClusterState
 
 
+def _table_pod_limit(info) -> int:
+    """Same authority order as the scheduler side
+    (providers/instancetype._max_pods): the generated VPC-limits table by
+    type name, falling back to the info fields — keeping node allocatable
+    and scheduler capacity consistent for custom catalogs too."""
+    from .catalog import VPC_LIMITS
+    lim = VPC_LIMITS.get(info.name)
+    return lim[0] * (lim[1] - 1) + 2 if lim else info.eni_pod_limit
+
+
 class FakeKubelet:
     def __init__(self, kube: FakeKube, ec2: FakeEC2, catalog_by_name,
                  state: ClusterState, clock=time.time,
@@ -66,7 +76,7 @@ class FakeKubelet:
                 "cpu": info.vcpus * 1000,
                 # real nodes report true memory (discovered-capacity source)
                 "memory": int(info.memory_bytes * (1 - self.overhead * 0.9)),
-                "pods": info.eni_pod_limit,
+                "pods": _table_pod_limit(info),
                 "ephemeral-storage": 20 * 1024**3,
             })
         else:

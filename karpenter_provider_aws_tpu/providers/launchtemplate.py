@@ -16,7 +16,9 @@ from typing import Dict, List, Optional, Sequence
 from ..apis.objects import EC2NodeClass, Taint, stable_hash
 from ..cache.ttl import TTLCache
 from ..fake.ec2 import FakeLaunchTemplate
-from .amifamily import AMI, AMIProvider, BootstrapConfig, generate_user_data, map_to_instance_types
+from ..apis.resources import AWS_EFA
+from .amifamily import (AMI, AMIProvider, BootstrapConfig,
+                        generate_user_data, map_to_instance_types)
 from .network import SecurityGroupProvider
 
 LT_NAME_PREFIX = "karpenter.k8s.aws"
@@ -27,8 +29,33 @@ class ResolvedLaunchTemplate:
     name: str
     image_id: str
     arch: str
-    #: instance type names this template serves (same AMI mapping bucket)
+    #: instance type names this template serves (same AMI mapping bucket,
+    #: same EFA interface count)
     instance_type_names: tuple
+    efa_count: int = 0
+
+
+#: per-family default root volumes when the NodeClass specifies none
+#: (amifamily resolvers' DefaultBlockDeviceMappings; bottlerocket splits
+#: OS and data volumes)
+_DEFAULT_BDMS = {
+    "al2": [{"device_name": "/dev/xvda", "volume_size": "20Gi",
+             "volume_type": "gp3", "encrypted": True, "root_volume": True}],
+    "al2023": [{"device_name": "/dev/xvda", "volume_size": "20Gi",
+                "volume_type": "gp3", "encrypted": True,
+                "root_volume": True}],
+    "bottlerocket": [
+        {"device_name": "/dev/xvda", "volume_size": "4Gi",
+         "volume_type": "gp3", "encrypted": True, "root_volume": True},
+        {"device_name": "/dev/xvdb", "volume_size": "20Gi",
+         "volume_type": "gp3", "encrypted": True, "root_volume": False}],
+    "windows2019": [{"device_name": "/dev/sda1", "volume_size": "50Gi",
+                     "volume_type": "gp3", "encrypted": True,
+                     "root_volume": True}],
+    "windows2022": [{"device_name": "/dev/sda1", "volume_size": "50Gi",
+                     "volume_type": "gp3", "encrypted": True,
+                     "root_volume": True}],
+}
 
 
 class LaunchTemplateProvider:
@@ -38,6 +65,9 @@ class LaunchTemplateProvider:
                  cluster_endpoint: str = "https://cluster.local",
                  ca_bundle: str = "", clock=None):
         self.ec2 = ec2
+        #: cluster service CIDR, resolved lazily from the cluster on first
+        #: template build (launchtemplate.go:433+ resolveClusterCIDR)
+        self._cluster_cidr: Optional[str] = None
         self.ami = ami_provider
         self.sg = sg_provider
         self.cluster_name = cluster_name
@@ -53,12 +83,45 @@ class LaunchTemplateProvider:
             if lt.name.startswith(LT_NAME_PREFIX):
                 self._cache.put(lt.name, lt)
 
+    def _resolve_cluster_cidr(self) -> str:
+        """Service CIDR from the cluster, resolved once and cached
+        (launchtemplate.go:433+; nodeadm userdata needs it)."""
+        if self._cluster_cidr is None:
+            self._cluster_cidr = getattr(
+                self.ec2, "eks_cluster_cidr", None) or "10.100.0.0/16"
+        return self._cluster_cidr
+
+    @staticmethod
+    def _network_interfaces(efa_count: int,
+                            nodeclass: EC2NodeClass) -> List[dict]:
+        """EFA-capable buckets get one EFA interface per available slot
+        (device 0 carries the primary IP); plain buckets get the single
+        default interface with the NodeClass's public-IP choice
+        (launchtemplate.go:275-305)."""
+        if efa_count > 0:
+            return [{"device_index": 0 if i == 0 else 1,
+                     "network_card_index": i,
+                     "interface_type": "efa",
+                     "groups": "nodeclass"} for i in range(efa_count)]
+        if nodeclass.associate_public_ip is not None:
+            return [{"device_index": 0,
+                     "associate_public_ip_address":
+                         nodeclass.associate_public_ip}]
+        return []
+
+    def _block_device_mappings(self, nodeclass: EC2NodeClass) -> List[dict]:
+        if nodeclass.block_device_mappings:
+            return [vars(b) for b in nodeclass.block_device_mappings]
+        return [dict(b) for b in
+                _DEFAULT_BDMS.get(nodeclass.ami_family, ())]
+
     def ensure_all(self, nodeclass: EC2NodeClass, instance_types,
                    labels: Optional[Dict[str, str]] = None,
                    taints: Sequence[Taint] = (),
                    ) -> List[ResolvedLaunchTemplate]:
-        """One launch template per (AMI bucket) covering the given types
-        (launchtemplate.go:112-135)."""
+        """One launch template per (AMI bucket x EFA interface count)
+        covering the given types (launchtemplate.go:112-135; EFA types
+        need their own template because the interface config differs)."""
         amis = self.ami.list(nodeclass)
         buckets = map_to_instance_types(amis, instance_types)
         sgs = self.sg.list(nodeclass)
@@ -68,39 +131,62 @@ class LaunchTemplateProvider:
                 types = buckets.get(ami.id, [])
                 if not types:
                     continue
-                user_data = generate_user_data(
-                    nodeclass.ami_family, BootstrapConfig(
-                        cluster_name=self.cluster_name,
-                        cluster_endpoint=self.cluster_endpoint,
-                        ca_bundle=self.ca_bundle,
-                        labels=dict(labels or {}), taints=tuple(taints),
-                        kubelet=nodeclass.kubelet,
-                        custom_user_data=nodeclass.user_data))
-                name = self._lt_name(nodeclass, ami, sgs, user_data)
-                if self._cache.get(name) is None:
-                    lt = FakeLaunchTemplate(
-                        id="", name=name, image_id=ami.id,
-                        security_group_ids=list(sgs), user_data=user_data,
-                        tags=dict(nodeclass.tags),
-                        metadata_options=vars(nodeclass.metadata_options),
-                        block_device_mappings=[vars(b) for b in
-                                               nodeclass.block_device_mappings],
-                        instance_profile=nodeclass.status_instance_profile
-                        or nodeclass.instance_profile)
-                    self.ec2.create_launch_template(lt)
-                    self._cache.put(name, lt)
-                out.append(ResolvedLaunchTemplate(
-                    name=name, image_id=ami.id, arch=ami.arch,
-                    instance_type_names=tuple(t.name for t in types)))
+                by_efa: Dict[int, list] = {}
+                for t in types:
+                    # EFA slots ride the capacity vector
+                    # (vpc.amazonaws.com/efa, labels.go:91-98)
+                    efa = int(t.capacity.get(AWS_EFA, 0))                         if hasattr(t, "capacity") else 0
+                    by_efa.setdefault(efa, []).append(t)
+                for efa_count, efa_types in sorted(by_efa.items()):
+                    out.append(self._ensure_one(
+                        nodeclass, ami, efa_types, efa_count, sgs,
+                        labels, taints))
         return out
 
+    def _ensure_one(self, nodeclass: EC2NodeClass, ami: AMI, types,
+                    efa_count: int, sgs, labels, taints
+                    ) -> ResolvedLaunchTemplate:
+        user_data = generate_user_data(
+            nodeclass.ami_family, BootstrapConfig(
+                cluster_name=self.cluster_name,
+                cluster_endpoint=self.cluster_endpoint,
+                ca_bundle=self.ca_bundle,
+                cluster_cidr=self._resolve_cluster_cidr(),
+                labels=dict(labels or {}), taints=tuple(taints),
+                kubelet=nodeclass.kubelet,
+                custom_user_data=nodeclass.user_data))
+        name = self._lt_name(nodeclass, ami, sgs, user_data,
+                             efa_count=efa_count)
+        if self._cache.get(name) is None:
+            nis = self._network_interfaces(efa_count, nodeclass)
+            for ni in nis:
+                if ni.get("groups") == "nodeclass":
+                    ni["groups"] = list(sgs)
+            lt = FakeLaunchTemplate(
+                id="", name=name, image_id=ami.id,
+                security_group_ids=list(sgs), user_data=user_data,
+                tags=dict(nodeclass.tags),
+                metadata_options=vars(nodeclass.metadata_options),
+                block_device_mappings=self._block_device_mappings(nodeclass),
+                network_interfaces=nis,
+                instance_profile=nodeclass.status_instance_profile
+                or nodeclass.instance_profile)
+            self.ec2.create_launch_template(lt)
+            self._cache.put(name, lt)
+        return ResolvedLaunchTemplate(
+            name=name, image_id=ami.id, arch=ami.arch,
+            instance_type_names=tuple(t.name for t in types),
+            efa_count=efa_count)
+
     def _lt_name(self, nodeclass: EC2NodeClass, ami: AMI,
-                 sgs: Sequence[str], user_data: str) -> str:
+                 sgs: Sequence[str], user_data: str,
+                 efa_count: int = 0) -> str:
         """Deterministic name from the resolved options (launchtemplate.go:146)."""
         h = stable_hash({
             "ami": ami.id, "sgs": list(sgs), "userData": user_data,
             "nodeClassHash": nodeclass.hash(),
             "instanceProfile": nodeclass.status_instance_profile,
+            "efaCount": efa_count,
         })
         return f"{LT_NAME_PREFIX}/{nodeclass.metadata.name}/{h}"
 

@@ -228,7 +228,7 @@ class _PodCtx:
 class CPUSolver(Solver):
     name = "cpu"
 
-    def solve(self, snapshot: SchedulingSnapshot) -> SolveResult:
+    def _solve_core(self, snapshot: SchedulingSnapshot) -> SolveResult:
         pods = sorted(snapshot.pods, key=pod_sort_key)
         zones = sorted(snapshot.zones) if snapshot.zones else \
             sorted({o.zone for np_ in snapshot.nodepools

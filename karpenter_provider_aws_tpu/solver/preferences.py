@@ -1,0 +1,122 @@
+"""Preference relaxation: soft scheduling constraints honored when
+possible, dropped when they block a pod.
+
+Upstream core treats preferred scheduling terms as REQUIRED and, when a
+pod cannot schedule, relaxes one preference and retries (the scheduler's
+preference-relaxation loop; consumed by this provider per SURVEY §3.2).
+This module is that loop for the batch solvers:
+
+- a pod's *preference chain* is its preferred (anti-)affinity terms in
+  declaration order, then its ScheduleAnyway topology-spread constraints
+  in declaration order;
+- at relax level L the first L preferences are REMOVED and the rest are
+  HARDENED (required=True / DoNotSchedule);
+- the wrapper solves with every preference-bearing pod hardened at its
+  current level, bumps the level of exactly the pods that came back
+  unschedulable and still have something to relax, and re-solves; the
+  loop ends when nothing bumps (bounded by the longest preference chain).
+
+Pods with no preferences pass through untouched (the common case pays a
+single O(pods) scan). Hardened clones are cached on the pod object, so
+steady-state re-solves reuse them. Both solver engines share this wrapper,
+which keeps CPU/TPU decision equality by construction.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Callable, Dict, List
+
+from ..apis.objects import Pod, PodAffinityTerm, TopologySpreadConstraint
+from .types import SchedulingSnapshot, SolveResult
+
+
+def preference_count(pod: Pod) -> int:
+    """Length of the pod's preference chain (0 = nothing to relax)."""
+    n = sum(1 for a in pod.pod_affinity if not a.required)
+    n += sum(1 for c in pod.topology_spread
+             if c.when_unsatisfiable != "DoNotSchedule")
+    return n
+
+
+def harden(pod: Pod, level: int) -> Pod:
+    """A clone of `pod` with the first `level` preferences removed and the
+    remaining ones promoted to required. level=0 hardens everything."""
+    cache = pod.__dict__.setdefault("_hardened", {})
+    hit = cache.get(level)
+    if hit is not None:
+        return hit
+    clone = copy.copy(pod)
+    clone.metadata = pod.metadata  # same identity
+    # caches that depend on the (changed) topology fields must not leak:
+    # _sig_cache/_sig_digest (solver/cpu.py pod_group_signature) and
+    # _sig_id (models/encoding.py) all encode the ORIGINAL constraint
+    # tuples — a stale one would group a hardened clone with the raw pod
+    # and make relaxation a no-op
+    clone.__dict__ = dict(pod.__dict__)
+    for stale in ("_sig_id", "_sig_cache", "_sig_digest", "_hardened"):
+        clone.__dict__.pop(stale, None)
+    dropped = 0
+    aff: List[PodAffinityTerm] = []
+    for a in pod.pod_affinity:
+        if a.required:
+            aff.append(a)
+        elif dropped < level:
+            dropped += 1  # relaxed away
+        else:
+            aff.append(PodAffinityTerm(topology_key=a.topology_key,
+                                       group=a.group, anti=a.anti,
+                                       required=True))
+    spread: List[TopologySpreadConstraint] = []
+    for c in pod.topology_spread:
+        if c.when_unsatisfiable == "DoNotSchedule":
+            spread.append(c)
+        elif dropped < level:
+            dropped += 1
+        else:
+            spread.append(TopologySpreadConstraint(
+                max_skew=c.max_skew, topology_key=c.topology_key,
+                when_unsatisfiable="DoNotSchedule", group=c.group))
+    clone.pod_affinity = aff
+    clone.topology_spread = spread
+    cache[level] = clone
+    return clone
+
+
+def solve_with_preferences(
+        solve_core: Callable[[SchedulingSnapshot], SolveResult],
+        snapshot: SchedulingSnapshot) -> SolveResult:
+    chains: Dict[int, int] = {}
+    for p in snapshot.pods:
+        n = preference_count(p)
+        if n:
+            chains[id(p)] = n
+    if not chains:
+        return solve_core(snapshot)
+    level: Dict[int, int] = {pid: 0 for pid in chains}
+    soft = [p for p in snapshot.pods if id(p) in chains]
+    # relaxing one pod can newly block another (e.g. a relaxed pod lands
+    # on a node and its group-membership counter now repels a hardened
+    # anti-affinity pod), so the loop bound is the TOTAL relaxation
+    # budget, not the longest single chain — every round that doesn't
+    # terminate bumps at least one pod's level
+    max_rounds = 1 + sum(chains.values())
+    result: SolveResult = None  # type: ignore[assignment]
+    for _ in range(max_rounds):
+        pods = [harden(p, level[id(p)]) if id(p) in chains else p
+                for p in snapshot.pods]
+        result = solve_core(SchedulingSnapshot(
+            pods=pods, nodepools=snapshot.nodepools,
+            existing_nodes=snapshot.existing_nodes,
+            daemon_overheads=snapshot.daemon_overheads,
+            zones=snapshot.zones))
+        bumped = False
+        if result.unschedulable:
+            for p in soft:
+                if p.full_name() in result.unschedulable \
+                        and level[id(p)] < chains[id(p)]:
+                    level[id(p)] += 1
+                    bumped = True
+        if not bumped:
+            return result
+    return result

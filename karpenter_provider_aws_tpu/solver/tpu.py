@@ -72,7 +72,7 @@ class TPUSolver(Solver):
         return self._cpu_fallback.solve(snapshot)
 
     # ------------------------------------------------------------------
-    def solve(self, snapshot: SchedulingSnapshot) -> SolveResult:
+    def _solve_core(self, snapshot: SchedulingSnapshot) -> SolveResult:
         if not snapshot.pods:
             return SolveResult(new_nodes=[], existing_assignments={},
                                unschedulable={})

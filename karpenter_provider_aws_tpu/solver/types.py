@@ -114,6 +114,14 @@ class SolveResult:
 class Solver(abc.ABC):
     name: str = "abstract"
 
-    @abc.abstractmethod
     def solve(self, snapshot: SchedulingSnapshot) -> SolveResult:
+        """Solve with upstream's preference-relaxation semantics: soft
+        constraints (preferred affinity, ScheduleAnyway spread) are
+        hardened to required and relaxed per pod only when they block it
+        (solver/preferences.py). Engines implement _solve_core."""
+        from .preferences import solve_with_preferences
+        return solve_with_preferences(self._solve_core, snapshot)
+
+    @abc.abstractmethod
+    def _solve_core(self, snapshot: SchedulingSnapshot) -> SolveResult:
         ...

@@ -164,3 +164,53 @@ class TestLaunchTemplateIntegration:
         nc2 = env.nodeclass("same", user_data="#!/bin/bash\nextra\n")
         b = ltp.ensure_all(nc2, types)
         assert {t.name for t in a}.isdisjoint({t.name for t in b})
+
+
+class TestLaunchTemplateFidelity:
+    """launchtemplate.go:275-343,433+: EFA network interfaces, default
+    block-device mappings per family, cluster-CIDR resolve."""
+
+    def _op_with_pool(self, requirements=()):
+        from tests.test_e2e_slice import mk_cluster
+
+        from karpenter_provider_aws_tpu.operator import Operator
+        op = Operator()
+        mk_cluster(op, requirements=requirements)
+        return op
+
+    def test_efa_types_get_efa_interfaces(self):
+        from karpenter_provider_aws_tpu.apis import labels as L
+        from karpenter_provider_aws_tpu.fake.environment import make_pods
+        op = self._op_with_pool(requirements=[
+            {"key": L.INSTANCE_FAMILY, "operator": "In", "values": ["p4d"]}])
+        for p in make_pods(1, cpu="4", memory="16Gi", prefix="efa",
+                           **{"vpc.amazonaws.com/efa": "1"}):
+            op.kube.create(p)
+        op.run_until_settled()
+        pods = op.kube.list("Pod")
+        assert all(p.node_name for p in pods)
+        inst = op.ec2.describe_instances()[0]
+        lt = op.ec2.launch_templates[inst.launch_template_name]
+        assert lt.network_interfaces, "EFA LT must declare interfaces"
+        assert all(ni["interface_type"] == "efa"
+                   for ni in lt.network_interfaces)
+        assert len(lt.network_interfaces) == 1  # p4d.24xlarge: 1 EFA slot
+        assert lt.network_interfaces[0]["groups"]  # SGs attached
+
+    def test_default_bdms_and_cidr(self):
+        from karpenter_provider_aws_tpu.fake.environment import make_pods
+        op = self._op_with_pool()
+        op.ec2.eks_cluster_cidr = "172.20.0.0/16"
+        # force re-resolve in this provider instance
+        op.launch_templates._cluster_cidr = None
+        for p in make_pods(1, cpu="500m", prefix="bdm"):
+            op.kube.create(p)
+        op.run_until_settled()
+        inst = op.ec2.describe_instances()[0]
+        lt = op.ec2.launch_templates[inst.launch_template_name]
+        # al2023 default root volume materialized into the template
+        assert lt.block_device_mappings
+        assert lt.block_device_mappings[0]["device_name"] == "/dev/xvda"
+        assert lt.block_device_mappings[0]["root_volume"]
+        # nodeadm userdata carries the resolved service CIDR
+        assert "172.20.0.0/16" in lt.user_data

@@ -1,0 +1,153 @@
+"""Preference relaxation (solver/preferences.py): soft constraints are
+honored when capacity allows and relaxed — per pod — when they would
+otherwise leave pods unschedulable, mirroring upstream core's
+preference-relaxation loop."""
+
+import pytest
+
+from karpenter_provider_aws_tpu.apis import labels as L
+from karpenter_provider_aws_tpu.apis.objects import (PodAffinityTerm,
+                                                     TopologySpreadConstraint)
+from karpenter_provider_aws_tpu.fake.environment import Environment, make_pods
+from karpenter_provider_aws_tpu.solver import CPUSolver
+from karpenter_provider_aws_tpu.solver.preferences import (harden,
+                                                           preference_count)
+from karpenter_provider_aws_tpu.solver.tpu import TPUSolver
+
+
+@pytest.fixture(scope="module")
+def env():
+    return Environment()
+
+
+def soft_spread(group):
+    return TopologySpreadConstraint(max_skew=1, topology_key=L.ZONE,
+                                    when_unsatisfiable="ScheduleAnyway",
+                                    group=group)
+
+
+class TestHarden:
+    def test_chain_and_levels(self):
+        p = make_pods(1, cpu="1", prefix="h", group="h",
+                      topology_spread=[soft_spread("h")],
+                      pod_affinity=[PodAffinityTerm(
+                          topology_key=L.HOSTNAME, group="h",
+                          anti=True, required=False)])[0]
+        assert preference_count(p) == 2
+        h0 = harden(p, 0)
+        assert all(a.required for a in h0.pod_affinity)
+        assert all(c.when_unsatisfiable == "DoNotSchedule"
+                   for c in h0.topology_spread)
+        # level 1 drops the preferred affinity (first in the chain)
+        h1 = harden(p, 1)
+        assert not h1.pod_affinity and len(h1.topology_spread) == 1
+        # level 2 drops everything soft
+        h2 = harden(p, 2)
+        assert not h2.pod_affinity and not h2.topology_spread
+        # clones keep pod identity and are cached
+        assert h1.full_name() == p.full_name()
+        assert harden(p, 1) is h1
+
+    def test_required_terms_untouched(self):
+        p = make_pods(1, cpu="1", prefix="r", group="r",
+                      pod_affinity=[PodAffinityTerm(
+                          topology_key=L.ZONE, group="r", required=True)])[0]
+        assert preference_count(p) == 0
+
+
+class TestRelaxationBehavior:
+    def test_schedule_anyway_honored_when_possible(self, env):
+        """Soft zone spread behaves like a hard one while it can be
+        satisfied: pods stripe across zones."""
+        pods = make_pods(30, cpu="500m", memory="1Gi", prefix="soft",
+                         group="soft", topology_spread=[soft_spread("soft")])
+        snap = env.snapshot(pods, [env.nodepool("sa")])
+        res = CPUSolver().solve(snap)
+        assert not res.unschedulable
+        zones = set()
+        for n in res.new_nodes:
+            for r in n.requirements:
+                if r.key == L.ZONE:
+                    zones.update(r.values)
+        assert len(zones) >= 2, "soft spread should stripe zones"
+
+    def test_schedule_anyway_relaxed_when_blocking(self, env):
+        """Pin the pool to ONE zone: a hardened maxSkew=1 spread over a
+        multi-pod group cannot hold (count-min grows per pod), but
+        ScheduleAnyway pods must still all schedule."""
+        pods = make_pods(12, cpu="500m", memory="1Gi", prefix="softpin",
+                         group="softpin",
+                         node_selector={L.ZONE: "us-west-2a"},
+                         topology_spread=[soft_spread("softpin")])
+        snap = env.snapshot(pods, [env.nodepool("sb")])
+        res = CPUSolver().solve(snap)
+        assert not res.unschedulable, res.unschedulable
+
+    def test_preferred_anti_affinity_relaxed_under_pressure(self, env):
+        """Preferred hostname anti-affinity puts one pod per node while
+        nodes are available; with only two existing nodes and no pool to
+        open more, the extra pods must relax onto occupied nodes instead
+        of going pending."""
+        from karpenter_provider_aws_tpu.apis.resources import Resources
+        from karpenter_provider_aws_tpu.solver.types import ExistingNode
+
+        nodes = [ExistingNode(
+            name=f"pref-node-{i}",
+            labels={L.ZONE: "us-west-2a", L.ARCH: "amd64",
+                    L.CAPACITY_TYPE: "on-demand",
+                    L.INSTANCE_TYPE: "m5.xlarge"},
+            allocatable=Resources.parse(
+                {"cpu": "3900m", "memory": "14Gi", "pods": "58"}),
+            used=Resources.parse({"cpu": "0", "memory": "0", "pods": "0"}),
+        ) for i in range(2)]
+        pods = make_pods(4, cpu="1", memory="2Gi", prefix="pref",
+                         group="pref",
+                         pod_affinity=[PodAffinityTerm(
+                             topology_key=L.HOSTNAME, group="pref",
+                             anti=True, required=False)])
+        snap = env.snapshot(pods, [], existing_nodes=nodes)
+        res = CPUSolver().solve(snap)
+        assert not res.unschedulable, res.unschedulable
+        assert not res.new_nodes
+        per_node: dict = {}
+        for pod, node in res.existing_assignments.items():
+            per_node[node] = per_node.get(node, 0) + 1
+        # both nodes host an anti pod (the hardened pair stays spread),
+        # and the relaxed tail first-fits onto an occupied node
+        assert len(per_node) == 2 and max(per_node.values()) >= 2
+
+    def test_cpu_tpu_identical_on_preference_workloads(self, env):
+        pods = (make_pods(40, cpu="500m", memory="1Gi", prefix="eqs",
+                          group="eqs", topology_spread=[soft_spread("eqs")])
+                + make_pods(6, cpu="1", memory="2Gi", prefix="eqa",
+                            group="eqa",
+                            pod_affinity=[PodAffinityTerm(
+                                topology_key=L.HOSTNAME, group="eqa",
+                                anti=True, required=False)])
+                + make_pods(25, cpu="250m", memory="512Mi", prefix="eqp"))
+        snap = env.snapshot(pods, [env.nodepool("eq")])
+        a = CPUSolver().solve(snap)
+        b = TPUSolver(backend="numpy").solve(snap)
+        assert a.decision_fingerprint() == b.decision_fingerprint()
+
+
+class TestSignatureCacheIsolation:
+    def test_hardened_clone_has_fresh_signature(self, env):
+        """A pod whose group signature was cached BEFORE solving (the
+        consolidation controller does this) must still relax: the
+        hardened clone may not inherit the raw pod's cached signature."""
+        from karpenter_provider_aws_tpu.solver.cpu import (
+            pod_group_signature, pod_sig_digest)
+
+        pods = make_pods(8, cpu="500m", memory="1Gi", prefix="sig",
+                         group="sig",
+                         node_selector={L.ZONE: "us-west-2a"},
+                         topology_spread=[soft_spread("sig")])
+        for p in pods:  # prime the caches like canonical_pod_groups does
+            pod_group_signature(p)
+            pod_sig_digest(p)
+        h0 = harden(pods[0], 0)
+        assert pod_group_signature(h0) != pod_group_signature(pods[0])
+        snap = env.snapshot(pods, [env.nodepool("sigp")])
+        res = CPUSolver().solve(snap)
+        assert not res.unschedulable, res.unschedulable
