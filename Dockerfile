@@ -1,0 +1,7 @@
+# ko-build analog: the controller image runs the daemon
+# (cmd/controller/main.go:28-74 equivalent entrypoint).
+FROM python:3.12-slim
+WORKDIR /app
+COPY karpenter_provider_aws_tpu/ karpenter_provider_aws_tpu/
+RUN pip install --no-cache-dir numpy jax grpcio
+ENTRYPOINT ["python", "-m", "karpenter_provider_aws_tpu"]

@@ -534,3 +534,14 @@ VPC_LIMITS: Dict[str, Tuple[int, int]] = {
 #: type name -> network bandwidth in Mbps
 BANDWIDTH_MBPS: Dict[str, int] = {
     i.name: i.network_bandwidth_mbps for i in _DEFAULT_CATALOG}
+
+
+def table_pod_limit(info: InstanceTypeInfo) -> int:
+    """ENI-formula max pods with the generated table as the authority by
+    type name (how the reference consults zz_generated.vpclimits.go) and
+    the info fields as the fallback for types outside the table. This is
+    the BASE limit; kubelet maxPods/podsPerCore overrides apply on the
+    scheduler side only (they shrink the scheduler's view, never the
+    node's, so divergence is always in the safe direction)."""
+    lim = VPC_LIMITS.get(info.name)
+    return lim[0] * (lim[1] - 1) + 2 if lim else info.eni_pod_limit

@@ -18,14 +18,7 @@ from ..fake.kube import FakeKube
 from ..state.cluster import ClusterState
 
 
-def _table_pod_limit(info) -> int:
-    """Same authority order as the scheduler side
-    (providers/instancetype._max_pods): the generated VPC-limits table by
-    type name, falling back to the info fields — keeping node allocatable
-    and scheduler capacity consistent for custom catalogs too."""
-    from .catalog import VPC_LIMITS
-    lim = VPC_LIMITS.get(info.name)
-    return lim[0] * (lim[1] - 1) + 2 if lim else info.eni_pod_limit
+from .catalog import table_pod_limit as _table_pod_limit
 
 
 class FakeKubelet:

@@ -262,12 +262,8 @@ class InstanceTypeProvider:
     def _max_pods(info: InstanceTypeInfo, kubelet: KubeletConfiguration) -> int:
         if kubelet.max_pods is not None:
             return kubelet.max_pods
-        # the generated per-type table is authoritative, exactly as the
-        # reference consults zz_generated.vpclimits.go by type name; the
-        # formula fields are the fallback for types outside the table
-        from ..fake.catalog import VPC_LIMITS
-        lim = VPC_LIMITS.get(info.name)
-        pods = lim[0] * (lim[1] - 1) + 2 if lim else info.eni_pod_limit
+        from ..fake.catalog import table_pod_limit
+        pods = table_pod_limit(info)
         if kubelet.pods_per_core is not None:
             pods = min(pods, kubelet.pods_per_core * info.vcpus)
         return pods

@@ -99,10 +99,16 @@ class LaunchTemplateProvider:
         default interface with the NodeClass's public-IP choice
         (launchtemplate.go:275-305)."""
         if efa_count > 0:
-            return [{"device_index": 0 if i == 0 else 1,
-                     "network_card_index": i,
-                     "interface_type": "efa",
-                     "groups": "nodeclass"} for i in range(efa_count)]
+            out = [{"device_index": 0 if i == 0 else 1,
+                    "network_card_index": i,
+                    "interface_type": "efa",
+                    "groups": "nodeclass"} for i in range(efa_count)]
+            if nodeclass.associate_public_ip is not None:
+                # the public-IP choice rides the primary (device 0)
+                # interface even when EFA is enabled (launchtemplate.go)
+                out[0]["associate_public_ip_address"] = \
+                    nodeclass.associate_public_ip
+            return out
         if nodeclass.associate_public_ip is not None:
             return [{"device_index": 0,
                      "associate_public_ip_address":

@@ -46,14 +46,12 @@ def harden(pod: Pod, level: int) -> Pod:
     hit = cache.get(level)
     if hit is not None:
         return hit
-    clone = copy.copy(pod)
-    clone.metadata = pod.metadata  # same identity
+    clone = copy.copy(pod)  # shallow: shares metadata (same identity)
     # caches that depend on the (changed) topology fields must not leak:
     # _sig_cache/_sig_digest (solver/cpu.py pod_group_signature) and
     # _sig_id (models/encoding.py) all encode the ORIGINAL constraint
     # tuples — a stale one would group a hardened clone with the raw pod
     # and make relaxation a no-op
-    clone.__dict__ = dict(pod.__dict__)
     for stale in ("_sig_id", "_sig_cache", "_sig_digest", "_hardened"):
         clone.__dict__.pop(stale, None)
     dropped = 0
@@ -85,7 +83,7 @@ def harden(pod: Pod, level: int) -> Pod:
 
 def solve_with_preferences(
         solve_core: Callable[[SchedulingSnapshot], SolveResult],
-        snapshot: SchedulingSnapshot) -> SolveResult:
+        snapshot: SchedulingSnapshot, metrics=None) -> SolveResult:
     chains: Dict[int, int] = {}
     for p in snapshot.pods:
         n = preference_count(p)
@@ -102,6 +100,7 @@ def solve_with_preferences(
     # terminate bumps at least one pod's level
     max_rounds = 1 + sum(chains.values())
     result: SolveResult = None  # type: ignore[assignment]
+    rounds = 0
     for _ in range(max_rounds):
         pods = [harden(p, level[id(p)]) if id(p) in chains else p
                 for p in snapshot.pods]
@@ -118,5 +117,16 @@ def solve_with_preferences(
                     level[id(p)] += 1
                     bumped = True
         if not bumped:
-            return result
+            break
+        rounds += 1
+    if rounds:
+        # each extra round is a FULL re-solve — a latency cliff that must
+        # never be silent (same stance as the oracle-fallback counter)
+        import logging
+        logging.getLogger(__name__).info(
+            "preference relaxation took %d extra solve round(s) for %d "
+            "soft pods", rounds, len(soft))
+        if metrics is not None:
+            metrics.inc("karpenter_solver_preference_relaxation_rounds_total",
+                        value=float(rounds))
     return result

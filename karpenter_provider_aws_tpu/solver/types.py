@@ -113,6 +113,8 @@ class SolveResult:
 
 class Solver(abc.ABC):
     name: str = "abstract"
+    #: optional metrics registry; the operator injects its own
+    metrics = None
 
     def solve(self, snapshot: SchedulingSnapshot) -> SolveResult:
         """Solve with upstream's preference-relaxation semantics: soft
@@ -120,7 +122,8 @@ class Solver(abc.ABC):
         hardened to required and relaxed per pod only when they block it
         (solver/preferences.py). Engines implement _solve_core."""
         from .preferences import solve_with_preferences
-        return solve_with_preferences(self._solve_core, snapshot)
+        return solve_with_preferences(self._solve_core, snapshot,
+                                      metrics=getattr(self, "metrics", None))
 
     @abc.abstractmethod
     def _solve_core(self, snapshot: SchedulingSnapshot) -> SolveResult:
