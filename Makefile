@@ -1,0 +1,27 @@
+# Developer workflow targets (the reference's Makefile surface:
+# presubmit/test, deflake with randomized ordering, benchmark).
+
+PYTEST ?= python -m pytest
+
+test:  ## unit + component suites (virtual 8-device CPU mesh)
+	$(PYTEST) tests/ -x -q
+
+scale:  ## the scale suite alone (55k pods, deprovisioning, chaos)
+	$(PYTEST) tests/test_scale_suite.py -x -q
+
+deflake:  ## Makefile:63-70 analog: randomized order, repeated until failure
+	for i in 1 2 3 4 5; do \
+	  KARPENTER_TEST_SHUFFLE_SEED=$$i $(PYTEST) tests/ -q -x || exit 1; \
+	done
+
+benchmark:  ## the five BASELINE configs + interruption throughput
+	python bench.py --all --rounds 100
+	python bench.py --interruption
+
+multichip:  ## dry-run the multi-device solve on 8 virtual CPU devices
+	python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+daemon:  ## run the operator against the in-memory cloud
+	python -m karpenter_provider_aws_tpu --cluster-name dev --metrics-port 8080
+
+.PHONY: test scale deflake benchmark multichip daemon

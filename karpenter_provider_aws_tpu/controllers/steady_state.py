@@ -42,16 +42,58 @@ GC_GRACE_SECONDS = 30.0
 class NodeClassStatusController:
     def __init__(self, kube: FakeKube, subnet: SubnetProvider,
                  sg: SecurityGroupProvider, ami: AMIProvider,
-                 profiles: InstanceProfileProvider, clock=time.time):
+                 profiles: InstanceProfileProvider, clock=time.time,
+                 metrics=None, recorder=None):
         self.kube = kube
         self.subnet = subnet
         self.sg = sg
         self.ami = ami
         self.profiles = profiles
         self.clock = clock
+        self.metrics = metrics
+        self.recorder = recorder
+        #: last observed Ready status per nodeclass (transition events)
+        self._ready_seen: Dict[str, str] = {}
+
+    def _emit_conditions(self, nc: EC2NodeClass) -> None:
+        """The status-controller decorations (controllers.go:91,
+        operatorpkg status): one gauge per condition and an event on
+        Ready transitions."""
+        if self.metrics is not None:
+            for cond in nc.conditions.values():
+                self.metrics.set_gauge(
+                    "operator_status_condition_current_status",
+                    1.0 if cond.status == "True" else 0.0,
+                    labels={"kind": "EC2NodeClass",
+                            "name": nc.metadata.name,
+                            "type": cond.type})
+        ready = nc.conditions.get("Ready")
+        if ready is None:
+            return
+        prev = self._ready_seen.get(nc.metadata.name)
+        if prev != ready.status:
+            self._ready_seen[nc.metadata.name] = ready.status
+            if self.recorder is not None and prev is not None:
+                self.recorder.publish(
+                    "EC2NodeClass", nc.metadata.name,
+                    "Ready" if ready.status == "True" else "NotReady",
+                    f"EC2NodeClass {nc.metadata.name} became "
+                    f"{'ready' if ready.status == 'True' else 'not ready'}",
+                    "Normal" if ready.status == "True" else "Warning")
 
     def reconcile(self) -> int:
         n = 0
+        live = {nc.metadata.name for nc in self.kube.list("EC2NodeClass")
+                if nc.metadata.deletion_timestamp is None}
+        # deleted/deleting nodeclasses: drop their condition series and
+        # transition state, so dashboards never see a healthy ghost and a
+        # recreated same-name class gets a fresh first Ready event
+        for gone in [name for name in self._ready_seen if name not in live]:
+            del self._ready_seen[gone]
+            if self.metrics is not None:
+                self.metrics.clear_series(
+                    "operator_status_condition_current_status",
+                    match={"kind": "EC2NodeClass", "name": gone})
         for nc in self.kube.list("EC2NodeClass"):
             if nc.metadata.deletion_timestamp is not None:
                 continue
@@ -83,6 +125,7 @@ class NodeClassStatusController:
             nc.set_condition("ValidationSucceeded", "True", now=now)
             nc.set_condition("Ready", "True" if ok else "False", now=now)
             self.kube.update(nc)
+            self._emit_conditions(nc)
             n += 1
         return n
 

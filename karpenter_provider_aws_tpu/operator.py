@@ -112,7 +112,8 @@ class Operator:
         self.terminator = Terminator(self.kube, self.cloudprovider, clock=clock)
         self.nodeclass_status = NodeClassStatusController(
             self.kube, self.subnets, self.security_groups, self.amis,
-            self.instance_profiles, clock=clock)
+            self.instance_profiles, clock=clock, metrics=self.metrics,
+            recorder=self.recorder)
         self.gc = GarbageCollector(self.kube, self.cloudprovider, clock=clock)
         self.tagger = Tagger(self.kube, self.instances,
                              cluster_name=self.options.cluster_name)

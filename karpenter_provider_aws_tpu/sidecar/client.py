@@ -43,8 +43,8 @@ class SolverClient:
         resp = self._solve(req, timeout=self.timeout)
         return np.array(arena_unpack(resp)["out"])  # own the memory
 
-    def info(self) -> Dict[str, int]:
-        out = arena_unpack(self._info(b"", timeout=self.timeout))
+    def info(self, timeout: Optional[float] = None) -> Dict[str, int]:
+        out = arena_unpack(self._info(b"", timeout=timeout or self.timeout))
         return {k: int(v[0]) for k, v in out.items()}
 
     def close(self) -> None:
@@ -52,14 +52,27 @@ class SolverClient:
 
 
 class RemoteSolver(TPUSolver):
-    """TPUSolver whose packed-buffer dispatch is a sidecar round trip."""
+    """TPUSolver whose packed-buffer dispatch is a sidecar round trip.
+
+    backend='auto' (default) cost-routes each solve between the LOCAL
+    host twin and the REMOTE device via the same router the in-process
+    solver uses — the measured "device" cost now includes the gRPC hop,
+    so deployments where the sidecar round trip dominates automatically
+    stay local, and ones with a fast fabric ride the device."""
 
     name = "tpu-sidecar"
 
     def __init__(self, address: str, n_max: int = 2048,
-                 client: Optional[SolverClient] = None):
-        super().__init__(backend="jax", n_max=n_max)
+                 client: Optional[SolverClient] = None,
+                 backend: str = "auto"):
+        super().__init__(backend=backend, n_max=n_max)
         self.client = client or SolverClient(address)
+        from ..solver.route import AliveCache
+        self._router.alive = AliveCache(self._ping)
+
+    def _ping(self) -> bool:
+        """Sidecar liveness = a short-deadline Info round trip."""
+        return self.client.info(timeout=5.0)["devices"] >= 1
 
     def _dispatch(self, buf: np.ndarray, **statics) -> np.ndarray:
         return self.client.solve_buffer(buf, statics)

@@ -42,6 +42,10 @@ class Router:
         self._stats: Dict[Tuple, Dict] = {}
         self.metrics = metrics
         self.name = name
+        #: dev-engine liveness cache; None = the shared local-device
+        #: probe. RemoteSolver swaps in a sidecar ping (its dev engine is
+        #: the gRPC peer, not local jax).
+        self.alive: Optional["AliveCache"] = None
 
     def observe(self, bucket: Tuple, side: str, ms: float) -> None:
         with self._mu:
@@ -71,64 +75,68 @@ class Router:
 #: subsequent solve to the host twin until a background probe succeeds
 DEV_FAILED_MS = 1e12
 
-_DEVICE_ALIVE: Optional[bool] = None
-_DEVICE_ALIVE_AT: float = 0.0
-_DEVICE_ALIVE_MU = threading.Lock()
-#: a False verdict expires so a recovered device gets re-probed; True is
-#: permanent for the process (a healthy backend stays initialized)
-_DEVICE_DEAD_RECHECK_S = 300.0
+
+class AliveCache:
+    """Nonblocking liveness verdict around a potentially slow/blocking
+    probe: True is permanent, False expires (recheck), unknown kicks ONE
+    background probe and reports None. The device and the gRPC sidecar
+    each get an instance — their notion of 'is the dev engine reachable'
+    differs, but the caching discipline is the same."""
+
+    def __init__(self, probe: Callable[[], bool],
+                 recheck_s: float = 300.0):
+        self._probe = probe
+        self._recheck_s = recheck_s
+        self._mu = threading.Lock()
+        self._verdict: Optional[bool] = None
+        self._at = 0.0
+        self._in_flight = threading.Event()
+
+    def blocking(self) -> bool:
+        with self._mu:
+            if self._verdict is True:
+                return True
+            if self._verdict is False and \
+                    time.monotonic() - self._at < self._recheck_s:
+                return False
+        try:
+            verdict = bool(self._probe())
+        except Exception:
+            verdict = False
+        with self._mu:
+            self._verdict = verdict
+            self._at = time.monotonic()
+            return verdict
+
+    def nonblocking(self) -> Optional[bool]:
+        with self._mu:
+            if self._verdict is True:
+                return True
+            if self._verdict is False and \
+                    time.monotonic() - self._at < self._recheck_s:
+                return False
+        if not self._in_flight.is_set():
+            self._in_flight.set()
+
+            def _bg():
+                try:
+                    self.blocking()
+                finally:
+                    self._in_flight.clear()
+
+            threading.Thread(target=_bg, daemon=True,
+                             name="alive-probe").start()
+        return None
 
 
-_PROBE_IN_FLIGHT = threading.Event()
-
-
-def device_alive_nonblocking() -> Optional[bool]:
-    """Current device verdict without ever blocking the caller.
-
-    Returns True/False from cache, or None when no fresh verdict exists —
-    in which case ONE background probe is kicked off (subsequent callers
-    see None until it lands). The solve path must never wait the probe's
-    up-to-90s subprocess timeout (and on healthy machines must not pay
-    its python+jax import either)."""
-    with _DEVICE_ALIVE_MU:
-        if _DEVICE_ALIVE is True:
-            return True
-        if _DEVICE_ALIVE is False and \
-                time.monotonic() - _DEVICE_ALIVE_AT < _DEVICE_DEAD_RECHECK_S:
-            return False
-    if not _PROBE_IN_FLIGHT.is_set():
-        _PROBE_IN_FLIGHT.set()
-
-        def _bg():
-            try:
-                device_alive()
-            finally:
-                _PROBE_IN_FLIGHT.clear()
-
-        threading.Thread(target=_bg, daemon=True,
-                         name="device-alive-probe").start()
-    return None
-
-
-def device_alive(timeout: float = 90.0) -> bool:
+def _probe_device(timeout: float = 90.0) -> bool:
     """Probe jax backend liveness in a SUBPROCESS with a hard timeout.
 
     A wedged accelerator link (observed with a tunneled remote TPU after a
     crashed client) makes jax backend init block forever rather than
-    raise; an in-process try/except cannot defend against that. One
-    subprocess probe per process decides whether the device engine is
-    usable at all — if not, every solve stays on the host twin, which is
-    decision-identical. Memoized for the process lifetime."""
-    global _DEVICE_ALIVE, _DEVICE_ALIVE_AT
-    with _DEVICE_ALIVE_MU:
-        if _DEVICE_ALIVE is True:
-            return True
-        if _DEVICE_ALIVE is False and \
-                time.monotonic() - _DEVICE_ALIVE_AT < _DEVICE_DEAD_RECHECK_S:
-            return False
-    # probe OUTSIDE the mutex: nonblocking readers must never queue
-    # behind a 90s subprocess wait (two concurrent probes are harmless —
-    # last writer wins with the same verdict)
+    raise; an in-process try/except cannot defend against that — hence
+    the subprocess. Wrapped by ``_device_alive`` (an AliveCache) so the
+    solve path only ever sees the nonblocking verdict."""
     import subprocess
     import sys
     # inherit an explicit platform override (tests force cpu via
@@ -147,13 +155,21 @@ def device_alive(timeout: float = 90.0) -> bool:
     try:
         proc = subprocess.run([sys.executable, "-c", code],
                               timeout=timeout, capture_output=True)
-        verdict = proc.returncode == 0 and b"ok" in proc.stdout
+        return proc.returncode == 0 and b"ok" in proc.stdout
     except Exception:
-        verdict = False
-    with _DEVICE_ALIVE_MU:
-        _DEVICE_ALIVE = verdict
-        _DEVICE_ALIVE_AT = time.monotonic()
-        return _DEVICE_ALIVE
+        return False
+
+
+#: the shared local-device liveness cache (Router.alive default)
+_device_alive = AliveCache(_probe_device)
+
+
+def device_alive(timeout: float = 90.0) -> bool:
+    return _device_alive.blocking()
+
+
+def device_alive_nonblocking() -> Optional[bool]:
+    return _device_alive.nonblocking()
 
 
 def routed(router: Router, bucket: Tuple,
@@ -169,7 +185,7 @@ def routed(router: Router, bucket: Tuple,
     choice = router.choose(bucket)
     metrics = router.metrics
     if choice == "both":
-        alive = device_alive_nonblocking()
+        alive = (router.alive or _device_alive).nonblocking()
         if alive is None:
             # verdict pending (background probe running): serve the host
             # twin WITHOUT recording a dev observation, so this bucket
@@ -233,7 +249,11 @@ def routed(router: Router, bucket: Tuple,
                 # probe on the subprocess liveness check (in THIS thread —
                 # its up-to-90s wait must never block a solve). The False
                 # verdict expires, so recovery is still noticed.
-                if other_side == "dev" and not device_alive():
+                # blocking is correct HERE (the probe daemon thread):
+                # waiting lets a just-recovered dev engine be re-measured
+                # this cycle instead of one REFRESH_EVERY later
+                if other_side == "dev" \
+                        and not (router.alive or _device_alive).blocking():
                     return
                 t0 = time.perf_counter()
                 other_fn()

@@ -39,11 +39,15 @@ class Metrics:
         with self._mu:
             self.histograms.setdefault((name, _lk(labels)), []).append(value)
 
-    def clear_series(self, name: str) -> None:
-        """Drop every labeled series of a gauge (full re-emit pattern:
-        series for entities that vanished must not linger stale)."""
+    def clear_series(self, name: str,
+                     match: Optional[Mapping[str, str]] = None) -> None:
+        """Drop labeled series of a gauge (full re-emit pattern: series
+        for entities that vanished must not linger stale). With `match`,
+        only series whose labels contain that subset are dropped."""
         with self._mu:
-            for key in [k for k in self.gauges if k[0] == name]:
+            want = set((match or {}).items())
+            for key in [k for k in self.gauges
+                        if k[0] == name and want <= set(k[1])]:
                 del self.gauges[key]
 
     # -- reads -----------------------------------------------------------

@@ -24,3 +24,14 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_collection_modifyitems(config, items):
+    """`make deflake` randomizes test order (the reference's
+    `ginkgo --randomize-all --until-it-fails`, Makefile:63-70): set
+    KARPENTER_TEST_SHUFFLE_SEED to shuffle deterministically."""
+    import os
+    import random
+    seed = os.environ.get("KARPENTER_TEST_SHUFFLE_SEED")
+    if seed:
+        random.Random(seed).shuffle(items)

@@ -228,3 +228,51 @@ class TestMetricsBuildout:
         m.clear_series("g")
         assert m.gauge("g", {"a": "x"}) == 0.0
         assert m.gauge("other") == 3.0
+
+
+class TestConditionMetrics:
+    def test_condition_gauges_and_ready_transition_events(self):
+        """controllers.go:91 (operatorpkg status controller): per-condition
+        gauges and events on Ready transitions."""
+        from tests.test_e2e_slice import mk_cluster
+
+        from karpenter_provider_aws_tpu.operator import Operator
+
+        op = Operator()
+        mk_cluster(op)
+        op.step()
+        assert op.metrics.gauge(
+            "operator_status_condition_current_status",
+            labels={"kind": "EC2NodeClass", "name": "default-class",
+                    "type": "Ready"}) == 1.0
+        # flip readiness: drop every security group -> NotReady event
+        op.ec2.security_groups.clear()
+        op.security_groups.invalidate()
+        op.nodeclass_status.reconcile()
+        assert op.metrics.gauge(
+            "operator_status_condition_current_status",
+            labels={"kind": "EC2NodeClass", "name": "default-class",
+                    "type": "Ready"}) == 0.0
+        assert op.recorder.events(kind="EC2NodeClass",
+                                  name="default-class", reason="NotReady")
+
+    def test_deleted_nodeclass_series_cleared(self):
+        from tests.test_e2e_slice import mk_cluster
+
+        from karpenter_provider_aws_tpu.operator import Operator
+
+        op = Operator()
+        mk_cluster(op)
+        op.step()
+        labels = {"kind": "EC2NodeClass", "name": "default-class",
+                  "type": "Ready"}
+        assert op.metrics.gauge(
+            "operator_status_condition_current_status", labels=labels) == 1.0
+        op.kube.delete("EC2NodeClass", "default-class")
+        obj = op.kube.try_get("EC2NodeClass", "default-class")
+        if obj is not None:
+            op.kube.remove_finalizer(obj, "karpenter.k8s.aws/termination")
+        op.nodeclass_status.reconcile()
+        assert op.metrics.gauge(
+            "operator_status_condition_current_status", labels=labels) == 0.0
+        assert "default-class" not in op.nodeclass_status._ready_seen
