@@ -24,7 +24,7 @@ from typing import Any, Dict, FrozenSet, List, Mapping, Optional, Sequence, Tupl
 from . import labels as L
 from .requirements import (DOES_NOT_EXIST, EXISTS, GT, IN, LT, NOT_IN,
                            Requirement, Requirements)
-from .resources import Resources
+from .resources import ATTACHABLE_VOLUMES, Resources
 
 _uid_counter = itertools.count(1)
 
@@ -144,6 +144,17 @@ class PodAffinityTerm:
     required: bool = True
 
 
+def invalidate_scheduling_caches(pod: "Pod") -> None:
+    """Drop every memo derived from a pod's scheduling constraints.
+    THE authoritative attribute list — both constraint-mutation sites
+    (volume-topology application in Pod.apply_volume_constraints and
+    preference hardening in solver/preferences.py) call this."""
+    pod.__dict__.pop("_reqs_cache", None)
+    pod.__dict__.pop("_eff_requests", None)
+    for stale in ("_sig_id", "_sig_cache", "_sig_digest", "_hardened"):
+        pod.__dict__.pop(stale, None)
+
+
 class Pod(KubeObject):
     kind = "Pod"
 
@@ -158,7 +169,8 @@ class Pod(KubeObject):
                  node_name: str = "",
                  phase: str = "Pending",
                  owner_kind: str = "",
-                 scheduling_group: str = ""):
+                 scheduling_group: str = "",
+                 volume_claims: Sequence[str] = ()):
         self.metadata = ObjectMeta(name=name, namespace=namespace,
                                    labels=dict(labels or {}))
         self.requests = requests if requests is not None else Resources()
@@ -171,6 +183,22 @@ class Pod(KubeObject):
         self.phase = phase
         self.owner_kind = owner_kind
         self.scheduling_group = scheduling_group  # identity for spread/affinity
+        #: PVC names this pod mounts (spec.volumes[].persistentVolumeClaim)
+        self.volume_claims = list(volume_claims)
+
+    def apply_volume_constraints(self, reqs: "Requirements",
+                                 n_volumes: int) -> None:
+        """Install volume-topology-derived requirements + the EBS
+        attachment count before a solve (the provisioner's
+        volume-topology resolution, core volumetopology.go). Invalidate
+        the scheduling memos so the new constraints take effect; no-op
+        when nothing changed (steady-state cycles keep their caches)."""
+        if getattr(self, "_volume_count", None) == n_volumes \
+                and getattr(self, "_volume_reqs", None) == reqs:
+            return
+        self._volume_reqs = reqs
+        self._volume_count = n_volumes
+        invalidate_scheduling_caches(self)
 
     def scheduling_requirements(self) -> Requirements:
         """nodeSelector ∧ required nodeAffinity terms -> Requirements.
@@ -181,6 +209,9 @@ class Pod(KubeObject):
             if self.required_affinity_terms:
                 cached = cached.union(
                     Requirements.from_terms(self.required_affinity_terms))
+            vol = getattr(self, "_volume_reqs", None)
+            if vol is not None:
+                cached = cached.union(vol)
             self._reqs_cache = cached
         return cached
 
@@ -199,6 +230,9 @@ class Pod(KubeObject):
         if cached is None:
             cached = self.requests + Resources({"pods": 1}) \
                 if self.requests["pods"] == 0 else self.requests
+            nvol = getattr(self, "_volume_count", 0)
+            if nvol:
+                cached = cached + Resources({ATTACHABLE_VOLUMES: nvol})
             self._eff_requests = cached
         return cached
 
@@ -373,6 +407,55 @@ class Node(KubeObject):
         self.provider_id = provider_id
         self.ready = False
         self.conditions: Dict[str, Condition] = {}
+
+
+# ---------------------------------------------------------------------------
+# Storage (PV / PVC / StorageClass) — the core scheduler's volume-topology
+# inputs (core scheduling/volumetopology.go; exercised by the reference's
+# storage E2E suite)
+# ---------------------------------------------------------------------------
+
+class StorageClass(KubeObject):
+    kind = "StorageClass"
+
+    def __init__(self, name: str,
+                 provisioner: str = "ebs.csi.aws.com",
+                 volume_binding_mode: str = "WaitForFirstConsumer",
+                 allowed_topology_zones: Sequence[str] = ()):
+        self.metadata = ObjectMeta(name=name)
+        self.provisioner = provisioner
+        self.volume_binding_mode = volume_binding_mode  # | Immediate
+        #: allowedTopologies zone values ([] => any zone)
+        self.allowed_topology_zones = list(allowed_topology_zones)
+
+
+class PersistentVolume(KubeObject):
+    kind = "PersistentVolume"
+
+    def __init__(self, name: str, zone: str = "",
+                 storage_class: str = "", capacity: str = "10Gi"):
+        self.metadata = ObjectMeta(name=name)
+        #: zonal EBS volumes carry a zone node-affinity; "" => zone-free
+        self.zone = zone
+        self.storage_class = storage_class
+        self.capacity = capacity
+        self.phase = "Available"   # | Bound
+
+
+class PersistentVolumeClaim(KubeObject):
+    kind = "PersistentVolumeClaim"
+
+    def __init__(self, name: str, namespace: str = "default",
+                 storage_class: str = "", volume_name: str = "",
+                 requested: str = "10Gi"):
+        self.metadata = ObjectMeta(name=name, namespace=namespace)
+        self.storage_class = storage_class
+        self.volume_name = volume_name  # bound PV ("" => unbound)
+        self.requested = requested
+
+    @property
+    def bound(self) -> bool:
+        return bool(self.volume_name)
 
 
 # ---------------------------------------------------------------------------

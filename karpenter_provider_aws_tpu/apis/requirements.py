@@ -80,6 +80,10 @@ class Requirement:
     @staticmethod
     def new(key: str, operator: str, values: Sequence[str] = (),
             min_values: Optional[int] = None) -> "Requirement":
+        # deprecated well-known labels select on their canonical form
+        # (core scheduling NormalizedLabels)
+        from . import labels as _L
+        key = _L.NORMALIZED_LABELS.get(key, key)
         values = tuple(str(v) for v in values)
         if operator == IN:
             return Requirement(key, False, frozenset(values), None, None, min_values)

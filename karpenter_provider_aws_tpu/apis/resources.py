@@ -38,6 +38,10 @@ HABANA_GAUDI = "habana.ai/gaudi"
 AWS_POD_ENI = "vpc.amazonaws.com/pod-eni"
 AWS_PRIVATE_IPV4 = "vpc.amazonaws.com/PrivateIPv4Address"
 AWS_EFA = "vpc.amazonaws.com/efa"
+#: EBS CSI per-node attachment limit dimension (the core scheduler's
+#: CSINode volume-limit accounting; storage suite "respecting volume
+#: limits")
+ATTACHABLE_VOLUMES = "attachable-volumes-aws-ebs"
 
 # Resources measured in millicores vs bytes vs counts.
 _MILLI_RESOURCES = frozenset({CPU})

@@ -1,0 +1,50 @@
+"""CloudProvider metrics decorator.
+
+The core wraps the AWS CloudProvider in a latency/error decorator before
+anything else sees it (``metrics.Decorate(awsCloudProvider)``,
+cmd/controller/main.go:39): every interface method gets a
+``karpenter_cloudprovider_duration_seconds{method}`` histogram and a
+``karpenter_cloudprovider_errors_total{method,error_type}`` counter.
+"""
+
+from __future__ import annotations
+
+import time
+
+from ..utils.metrics import Metrics
+
+#: the CloudProvider interface methods the decorator times
+_METHODS = ("create", "get", "list", "get_instance_types", "delete",
+            "is_drifted", "repair_policies")
+
+
+class MetricsDecorator:
+    """Transparent proxy: timed interface methods + passthrough for
+    everything else (providers, helpers)."""
+
+    def __init__(self, inner, metrics: Metrics, clock=time.time):
+        self._inner = inner
+        self._metrics = metrics
+        self._clock = clock
+
+    def __getattr__(self, name):
+        attr = getattr(self._inner, name)
+        if name not in _METHODS:
+            return attr
+
+        def timed(*args, **kwargs):
+            t0 = self._clock()
+            try:
+                return attr(*args, **kwargs)
+            except Exception as e:
+                self._metrics.inc(
+                    "karpenter_cloudprovider_errors_total",
+                    labels={"method": name,
+                            "error_type": type(e).__name__})
+                raise
+            finally:
+                self._metrics.observe(
+                    "karpenter_cloudprovider_duration_seconds",
+                    self._clock() - t0, labels={"method": name})
+
+        return timed

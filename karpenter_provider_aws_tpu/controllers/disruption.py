@@ -45,7 +45,7 @@ from ..apis.objects import (DISRUPTED_TAINT, Node, NodeClaim, NodePool, Pod,
                             Taint)
 from ..apis.resources import Resources
 from ..cloudprovider.provider import CloudProvider
-from ..cloudprovider.types import InstanceTypes
+from ..cloudprovider.types import InstanceTypes, NodeClaimNotFoundError
 from ..fake.kube import FakeKube, NotFound
 from ..solver.types import (ExistingNode, NewNodeClaim, NodePoolSpec,
                             SchedulingSnapshot, Solver, SolveResult)
@@ -289,7 +289,14 @@ class DisruptionController:
 
     # -- drift ----------------------------------------------------------
     def _drifted_reason(self, cand: Candidate) -> str:
-        if self.cloudprovider.is_drifted(cand.claim):
+        try:
+            drifted = self.cloudprovider.is_drifted(cand.claim)
+        except NodeClaimNotFoundError:
+            # the cloud instance vanished behind the cluster's back —
+            # not a drift candidate; nodeclaim GC will reap it (core
+            # disruption skips candidates whose CloudProvider read errors)
+            return ""
+        if drifted:
             return "CloudProviderDrifted"
         ann = cand.claim.metadata.annotations
         if ann.get(L.NODEPOOL_HASH_VERSION_ANNOTATION) == "v3" and \

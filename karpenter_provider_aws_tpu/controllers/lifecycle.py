@@ -31,12 +31,21 @@ REGISTRATION_TTL = 15 * 60  # core: claims that never register are reaped
 class NodeClaimLifecycle:
     def __init__(self, kube: FakeKube, cloudprovider: CloudProvider,
                  instance_types: Optional[InstanceTypeProvider] = None,
-                 clock=time.time, recorder=None):
+                 clock=time.time, recorder=None, metrics=None):
         self.kube = kube
         self.cloudprovider = cloudprovider
         self.instance_types = instance_types
         self.clock = clock
         self.recorder = recorder
+        self.metrics = metrics
+
+    def _count(self, phase: str, claim) -> None:
+        """karpenter_nodeclaims_{launched,registered,initialized}_total
+        (the core lifecycle counters, metrics.md nodeclaims group)."""
+        if self.metrics is not None:
+            self.metrics.inc(
+                f"karpenter_nodeclaims_{phase}_total",
+                labels={"nodepool": claim.nodepool or ""})
 
     def _event_launch_failed(self, claim, message: str) -> None:
         if self.recorder is not None:
@@ -49,19 +58,26 @@ class NodeClaimLifecycle:
         for claim in self.kube.list("NodeClaim"):
             if claim.metadata.deletion_timestamp is not None:
                 continue
+            # core guarantees the termination finalizer on every claim it
+            # manages — including standalone ones the provisioner never saw
+            if "karpenter.sh/termination" not in claim.metadata.finalizers:
+                claim.metadata.finalizers.append("karpenter.sh/termination")
             try:
                 if not claim.launched:
                     self._launch(claim)
                     stats["launched"] += 1
+                    self._count("launched", claim)
                 elif not claim.registered:
                     if self._register(claim):
                         stats["registered"] += 1
+                        self._count("registered", claim)
                     elif self.clock() - claim.metadata.creation_timestamp > REGISTRATION_TTL:
                         self.kube.delete("NodeClaim", claim.name)
                         stats["reaped"] += 1
                 elif not claim.initialized:
                     if self._initialize(claim):
                         stats["initialized"] += 1
+                        self._count("initialized", claim)
             except InsufficientCapacityError as e:
                 self._event_launch_failed(claim, str(e))
                 # ICE: delete the claim; the offending offerings are already
@@ -123,16 +139,25 @@ class Terminator:
     pods; instance terminated; node deleted; finalizer cleared."""
 
     def __init__(self, kube: FakeKube, cloudprovider: CloudProvider,
-                 clock=time.time):
+                 clock=time.time, metrics=None):
         self.kube = kube
         self.cloudprovider = cloudprovider
         self.clock = clock
+        self.metrics = metrics
 
     def reconcile(self) -> int:
         done = 0
         for claim in self.kube.list("NodeClaim"):
             if claim.metadata.deletion_timestamp is None:
                 continue
+            if self.metrics is not None:
+                self.metrics.inc(
+                    "karpenter_nodeclaims_terminated_total",
+                    labels={"nodepool": claim.nodepool or ""})
+                self.metrics.observe(
+                    "karpenter_nodeclaims_termination_duration_seconds",
+                    max(0.0, self.clock()
+                        - claim.metadata.deletion_timestamp))
             # 1) drain: release this node's pods back to pending
             if claim.node_name:
                 for pod in self.kube.list("Pod"):

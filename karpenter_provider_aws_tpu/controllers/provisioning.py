@@ -59,6 +59,18 @@ class Provisioner:
                                    float(len(pods)))
         if not pods:
             return result
+        # pods whose PVCs don't exist yet are held out of the solve
+        # (volumetopology.go errors and skips the pod: scheduling before
+        # the claim materializes could pin it to the wrong zone)
+        held = self._pods_awaiting_claims(pods)
+        if held:
+            for p in held:
+                result.unschedulable[p.full_name()] = \
+                    "awaiting PersistentVolumeClaim creation"
+            pods = [p for p in pods if p.full_name()
+                    not in result.unschedulable]
+            if not pods:
+                return result
         snapshot = self.build_snapshot(pods)
         t0 = time.perf_counter()
         solved = self.solver.solve(snapshot)
@@ -66,7 +78,7 @@ class Provisioner:
         if self.metrics is not None:
             self.metrics.observe("karpenter_scheduler_scheduling_duration_seconds",
                                  result.solve_duration_s)
-        result.unschedulable = solved.unschedulable
+        result.unschedulable.update(solved.unschedulable)
 
         pods_by_name = {p.full_name(): p for p in pods}
         # pods onto existing capacity -> nominate
@@ -82,7 +94,50 @@ class Provisioner:
                 result.nominated[pod_name] = claim.name
         return result
 
+    def _pods_awaiting_claims(self, pods: Sequence[Pod]) -> List[Pod]:
+        """Pods referencing a PVC that doesn't exist (yet)."""
+        out = []
+        for pod in pods:
+            for claim_name in getattr(pod, "volume_claims", ()) or ():
+                if self.kube.try_get("PersistentVolumeClaim", claim_name,
+                                     namespace=pod.metadata.namespace) is None:
+                    out.append(pod)
+                    break
+        return out
+
+    def _resolve_volume_topology(self, pods: Sequence[Pod]) -> None:
+        """Core scheduling/volumetopology.go: pods mounting PVCs inherit
+        zone constraints from their bound PV's node affinity (or the
+        StorageClass's allowedTopologies for unbound claims), and consume
+        one EBS attachment slot per claim (CSINode limit accounting)."""
+        from ..apis.requirements import IN, Requirement, Requirements
+        for pod in pods:
+            claims = getattr(pod, "volume_claims", None)
+            if not claims:
+                continue
+            terms = []
+            n_volumes = 0
+            for claim_name in claims:
+                pvc = self.kube.try_get("PersistentVolumeClaim", claim_name,
+                                        namespace=pod.metadata.namespace)
+                if pvc is None:
+                    continue
+                n_volumes += 1
+                if pvc.bound:
+                    pv = self.kube.try_get("PersistentVolume",
+                                           pvc.volume_name)
+                    if pv is not None and pv.zone:
+                        terms.append(Requirement.new(L.ZONE, IN, [pv.zone]))
+                    continue
+                sc = self.kube.try_get("StorageClass", pvc.storage_class) \
+                    if pvc.storage_class else None
+                if sc is not None and sc.allowed_topology_zones:
+                    terms.append(Requirement.new(
+                        L.ZONE, IN, list(sc.allowed_topology_zones)))
+            pod.apply_volume_constraints(Requirements(terms), n_volumes)
+
     def build_snapshot(self, pods: Sequence[Pod]) -> SchedulingSnapshot:
+        self._resolve_volume_topology(pods)
         usage = self.state.nodepool_usage()
         specs: List[NodePoolSpec] = []
         for np in self.kube.list("NodePool"):
@@ -134,6 +189,9 @@ class Provisioner:
             startup_taints=nodepool.template.startup_taints,
             labels=labels,
             annotations={
+                # user template annotations ride onto the claim (and the
+                # node via the kubelet's registration)
+                **nodepool.template.annotations,
                 L.NODEPOOL_HASH_ANNOTATION: nodepool.hash(),
                 L.NODEPOOL_HASH_VERSION_ANNOTATION: "v3",
             },

@@ -177,6 +177,28 @@ class GarbageCollector:
             if node.provider_id and node.provider_id not in live \
                     and not node.ready:
                 self.kube.delete("Node", node.metadata.name)
+        # ...and NodeClaims whose launched instance vanished behind the
+        # cluster's back (the core nodeclaim GC direction: instance
+        # terminated externally -> claim+node deleted, pods reschedule).
+        # Pods are drained by name regardless of whether the Node object
+        # still exists — the node-reap loop above may have deleted it in
+        # this same pass, and bound pods must never outlive their node.
+        for claim in self.kube.list("NodeClaim"):
+            if claim.launched and claim.provider_id \
+                    and claim.provider_id not in live:
+                if claim.node_name:
+                    for pod in self.kube.list("Pod"):
+                        if pod.node_name == claim.node_name:
+                            pod.node_name = ""
+                            if pod.phase not in ("Succeeded", "Failed"):
+                                pod.phase = "Pending"
+                            self.kube.update(pod)
+                    if self.kube.try_get("Node", claim.node_name):
+                        self.kube.delete("Node", claim.node_name)
+                self.kube.remove_finalizer(claim, "karpenter.sh/termination")
+                if self.kube.try_get("NodeClaim", claim.name):
+                    self.kube.delete("NodeClaim", claim.name)
+                reaped += 1
         return reaped
 
 

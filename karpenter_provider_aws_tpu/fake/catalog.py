@@ -536,12 +536,25 @@ BANDWIDTH_MBPS: Dict[str, int] = {
     i.name: i.network_bandwidth_mbps for i in _DEFAULT_CATALOG}
 
 
-def table_pod_limit(info: InstanceTypeInfo) -> int:
+def ebs_attachment_limit(info: InstanceTypeInfo) -> int:
+    """Per-node EBS CSI attachment slots. ONE definition for both the
+    scheduler's view (instancetype capacity) and the joined node's
+    reported capacity — if they diverge, the solver packs volumes against
+    capacity the node does not report."""
+    return 27 if info.hypervisor == "nitro" else 39
+
+
+def table_pod_limit(info: InstanceTypeInfo, reserved_enis: int = 0) -> int:
     """ENI-formula max pods with the generated table as the authority by
     type name (how the reference consults zz_generated.vpclimits.go) and
     the info fields as the fallback for types outside the table. This is
     the BASE limit; kubelet maxPods/podsPerCore overrides apply on the
     scheduler side only (they shrink the scheduler's view, never the
-    node's, so divergence is always in the safe direction)."""
+    node's, so divergence is always in the safe direction).
+
+    ``reserved_enis`` (the --reserved-enis flag, options.go) withholds
+    interfaces from the formula: (enis-reserved)*(ips-1)+2
+    (types.go ENILimitedPods)."""
     lim = VPC_LIMITS.get(info.name)
-    return lim[0] * (lim[1] - 1) + 2 if lim else info.eni_pod_limit
+    enis, ips = lim if lim else (info.enis, info.ipv4_per_eni)
+    return max(0, enis - reserved_enis) * (ips - 1) + 2

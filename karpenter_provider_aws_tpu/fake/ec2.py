@@ -23,6 +23,13 @@ from ..cloudprovider.types import InsufficientCapacityError
 from .catalog import (DEFAULT_ZONES, FAMILIES, InstanceTypeInfo, ZoneInfo,
                       build_catalog, catalog_by_name, spot_price)
 
+#: instance families offered in local zones — local zones carry a small,
+#: older-generation slice of the catalog (the public local-zone feature
+#: matrix; the reference models this with a dedicated local-zone test zone,
+#: fake/ec2api.go:499)
+LOCAL_ZONE_FAMILIES = frozenset(
+    {"t3", "c5", "c5d", "m5", "m5d", "r5", "r5d", "g4dn", "i3en"})
+
 _id_counter = itertools.count(1)
 
 
@@ -87,6 +94,9 @@ class FakeInstance:
     tags: Dict[str, str] = field(default_factory=dict)
     provider_id: str = ""
     security_group_ids: List[str] = field(default_factory=list)
+    #: assigned when the launch template's interfaces request an IPv6
+    #: address (Ipv6AddressCount, launchtemplate.go:289,302)
+    ipv6_address: str = ""
 
     def __post_init__(self):
         if not self.provider_id:
@@ -159,6 +169,9 @@ class FakeEC2:
         self.eks_cluster_version = "1.31"
         #: cluster service CIDR (resolveClusterCIDR source)
         self.eks_cluster_cidr = "10.100.0.0/16"
+        #: service IPv6 CIDR; set for IPv6 clusters — resolveClusterCIDR
+        #: prefers it when present (launchtemplate.go:448-450)
+        self.eks_service_ipv6_cidr: Optional[str] = None
 
         self._seed_default_network()
         self._seed_default_images()
@@ -197,14 +210,20 @@ class FakeEC2:
 
     def describe_instance_type_offerings(self) -> List[Tuple[str, str]]:
         """(instance_type, zone) pairs. Deterministically: newest-generation
-        families are absent from the last zone (mirrors real-world partial
-        zonal rollout), plus any injected removals."""
+        families are absent from the last availability zone (mirrors
+        real-world partial zonal rollout), local zones carry only the
+        restricted LOCAL_ZONE_FAMILIES slice, plus any injected removals."""
         with self._mu:
             out = []
-            last_zone = self.zones[-1].name if self.zones else ""
+            last_az = next(
+                (z.name for z in reversed(self.zones)
+                 if z.zone_type == "availability-zone"), "")
             for info in self.catalog:
                 for z in self.zones:
-                    if z.name == last_zone and info.generation >= 7:
+                    if z.zone_type == "local-zone":
+                        if info.family not in LOCAL_ZONE_FAMILIES:
+                            continue
+                    elif z.name == last_az and info.generation >= 7:
                         continue
                     if (info.name, z.name) in self.removed_offerings:
                         continue
@@ -212,10 +231,34 @@ class FakeEC2:
             return out
 
     def describe_spot_price_history(self) -> List[Tuple[str, str, int]]:
-        """(instance_type, zone, micro_usd) triples."""
+        """(instance_type, zone, micro_usd) triples. Local zones publish no
+        spot history (local zones are on-demand only)."""
         with self._mu:
             return [(i.name, z.name, spot_price(i, z.name))
-                    for i in self.catalog for z in self.zones]
+                    for i in self.catalog for z in self.zones
+                    if z.zone_type != "local-zone"]
+
+    def enable_local_zone(self, name: str = "us-west-2-lax-1a",
+                          zone_id: str = "usw2-lax1-az1",
+                          subnet_tags: Optional[Mapping[str, str]] = None,
+                          ) -> Tuple[ZoneInfo, FakeSubnet]:
+        """Register a local zone plus one subnet in it (the fake's
+        test-zone-1a-local analog, ec2api.go:496-499). Its offerings are
+        the restricted LOCAL_ZONE_FAMILIES slice, on-demand only; callers
+        opt workloads in by constraining the NodePool to the zone
+        (test/suites/localzone/suite_test.go)."""
+        with self._mu:
+            z = ZoneInfo(name, zone_id, zone_type="local-zone")
+            self.zones.append(z)
+            sn = FakeSubnet(
+                id=f"subnet-{zone_id}", zone=name, zone_id=zone_id,
+                available_ips=4000,
+                tags=dict(subnet_tags) if subnet_tags is not None
+                else {"karpenter.sh/discovery": "cluster",
+                      "Name": f"local-{name}"},
+                zone_type="local-zone")
+            self.subnets[sn.id] = sn
+            return z, sn
 
     def on_demand_prices(self) -> Dict[str, int]:
         with self._mu:
@@ -346,6 +389,9 @@ class FakeEC2:
                     continue
                 image_id = o.get("image_id") or lt.image_id
                 zone_id = next((z.zone_id for z in self.zones if z.name == o["zone"]), "")
+                wants_ipv6 = any(
+                    ni.get("ipv6_address_count")
+                    for ni in getattr(lt, "network_interfaces", ()) or ())
                 while remaining > 0:
                     inst = FakeInstance(
                         id=_new_id("i"), instance_type=o["instance_type"],
@@ -356,6 +402,9 @@ class FakeEC2:
                         launch_time=self.now(),
                         tags={**dict(lt.tags), **dict(tags or {})},
                         security_group_ids=list(lt.security_group_ids))
+                    if wants_ipv6:
+                        inst.ipv6_address = \
+                            "2600:1f13::" + inst.id.removeprefix("i-")
                     self.instances[inst.id] = inst
                     instances.append(inst)
                     remaining -= 1

@@ -24,17 +24,31 @@ from .catalog import table_pod_limit as _table_pod_limit
 class FakeKubelet:
     def __init__(self, kube: FakeKube, ec2: FakeEC2, catalog_by_name,
                  state: ClusterState, clock=time.time,
-                 vm_overhead_percent: float = 0.075):
+                 vm_overhead_percent: float = 0.075,
+                 reserved_enis: int = 0, metrics=None):
         self.kube = kube
         self.ec2 = ec2
         self.catalog = catalog_by_name
         self.state = state
         self.clock = clock
         self.overhead = vm_overhead_percent
+        self.reserved_enis = reserved_enis
+        self.metrics = metrics
+        self._paused = False
+
+    def pause(self) -> None:
+        """Stop nodes from joining (the E2E 'node never registers'
+        scenario — drives the registration-TTL reap path)."""
+        self._paused = True
+
+    def resume(self) -> None:
+        self._paused = False
 
     def tick(self) -> int:
         """Join running instances that have a NodeClaim; bind nominated pods
         on ready nodes. Returns number of nodes joined."""
+        if self._paused:
+            return 0
         joined = 0
         claims = {c.provider_id: c for c in self.kube.list("NodeClaim")
                   if c.provider_id}
@@ -48,6 +62,11 @@ class FakeKubelet:
             node = self._make_node(inst, claim)
             self.kube.create(node)
             joined += 1
+            if self.metrics is not None:
+                self.metrics.inc(
+                    "karpenter_nodes_created_total",
+                    labels={"nodepool":
+                            node.metadata.labels.get(L.NODEPOOL, "")})
         self._bind_nominated_pods()
         self._reap_terminated(nodes_by_pid)
         return joined
@@ -64,13 +83,16 @@ class FakeKubelet:
             L.OS: L.OS_LINUX,
         })
         if info is not None:
+            from ..apis.resources import ATTACHABLE_VOLUMES
+            from .catalog import ebs_attachment_limit
             labels[L.ARCH] = info.arch
             capacity = Resources({
                 "cpu": info.vcpus * 1000,
                 # real nodes report true memory (discovered-capacity source)
                 "memory": int(info.memory_bytes * (1 - self.overhead * 0.9)),
-                "pods": _table_pod_limit(info),
+                "pods": _table_pod_limit(info, self.reserved_enis),
                 "ephemeral-storage": 20 * 1024**3,
+                ATTACHABLE_VOLUMES: ebs_attachment_limit(info),
             })
         else:
             capacity = claim.capacity
@@ -80,6 +102,8 @@ class FakeKubelet:
                     allocatable=allocatable,
                     taints=[t for t in claim.taints],
                     provider_id=inst.provider_id)
+        # claim annotations propagate to the node (core registration)
+        node.metadata.annotations.update(claim.metadata.annotations)
         node.ready = True
         return node
 
@@ -93,7 +117,41 @@ class FakeKubelet:
                 pod.node_name = target
                 pod.phase = "Running"
                 self.state.clear_nomination(pod.full_name())
+                self._bind_volumes(pod, target)
                 self.kube.update(pod)
+                if self.metrics is not None:
+                    # created -> running wall-clock (metrics.md pods group)
+                    self.metrics.observe(
+                        "karpenter_pods_startup_duration_seconds",
+                        max(0.0, self.clock()
+                            - pod.metadata.creation_timestamp))
+
+    def _bind_volumes(self, pod, node_name: str) -> None:
+        """Dynamic provisioning: unbound PVCs bind to a fresh PV in the
+        pod's zone once the pod lands (WaitForFirstConsumer semantics —
+        the storage suite's dynamic-volume specs)."""
+        if not getattr(pod, "volume_claims", None):
+            return
+        from ..apis.objects import PersistentVolume
+        node = self.kube.try_get("Node", node_name)
+        zone = node.metadata.labels.get(L.ZONE, "") if node else ""
+        for claim_name in pod.volume_claims:
+            pvc = self.kube.try_get("PersistentVolumeClaim", claim_name,
+                                    namespace=pod.metadata.namespace)
+            if pvc is None or pvc.bound:
+                continue
+            # the zone is part of the PV identity: a recreated same-named
+            # PVC landing in another zone must get a fresh volume, never a
+            # leftover one pinned elsewhere
+            pv = PersistentVolume(
+                name=f"pv-{claim_name}-{pod.metadata.namespace}-{zone}",
+                zone=zone, storage_class=pvc.storage_class,
+                capacity=pvc.requested)
+            pv.phase = "Bound"
+            if self.kube.try_get("PersistentVolume", pv.name) is None:
+                self.kube.create(pv)
+            pvc.volume_name = pv.name
+            self.kube.update(pvc)
 
     def _reap_terminated(self, nodes_by_pid: Dict[str, Node]) -> None:
         """Instance terminated out from under a node -> node NotReady."""

@@ -60,7 +60,10 @@ class Operator:
             eks_control_plane=True,
             interruption_queue="karpenter-interruption")
         self.clock = clock
-        self.ec2 = ec2 or FakeEC2()
+        # the fake cloud shares the operator clock so launch times and
+        # controller grace windows (GC's 30s, interruption ages) cohere
+        # under test clocks
+        self.ec2 = ec2 or FakeEC2(now=clock)
         self.kube = FakeKube(now=clock)
         self.metrics = Metrics()
         self.recorder = Recorder(clock=clock)
@@ -69,7 +72,8 @@ class Operator:
         self.unavailable_offerings = UnavailableOfferings()
         self.instance_types = InstanceTypeProvider(
             vm_memory_overhead_percent=self.options.vm_memory_overhead_percent,
-            unavailable_offerings=self.unavailable_offerings)
+            unavailable_offerings=self.unavailable_offerings,
+            reserved_enis=self.options.reserved_enis)
         self.pricing = PricingProvider(self.ec2)
         self.subnets = SubnetProvider(self.ec2)
         self.security_groups = SecurityGroupProvider(self.ec2)
@@ -79,21 +83,35 @@ class Operator:
             self.options.cluster_name)
         self.version = VersionProvider()
         self.sqs = SQSProvider(self.options.interruption_queue)
+        # kube-dns discovery (operator.go:243-260,262-274): the reference
+        # reads kube-system/kube-dns's ClusterIP; EKS assigns it the 10th
+        # address of the service CIDR, so the fake derives it from the
+        # cluster's (IPv6-preferred) service CIDR
+        import ipaddress
+        svc_cidr = (getattr(self.ec2, "eks_service_ipv6_cidr", None)
+                    or getattr(self.ec2, "eks_cluster_cidr", None))
+        self.kube_dns_ip = (
+            str(ipaddress.ip_network(svc_cidr)[10]) if svc_cidr else "")
         self.launch_templates = LaunchTemplateProvider(
             self.ec2, self.amis, self.security_groups,
             cluster_name=self.options.cluster_name,
             cluster_endpoint=self.options.cluster_endpoint,
-            ca_bundle=self.options.cluster_ca_bundle)
+            ca_bundle=self.options.cluster_ca_bundle,
+            kube_dns_ip=self.kube_dns_ip)
         self.instances = InstanceProvider(
             self.ec2, self.subnets, self.launch_templates,
             self.unavailable_offerings,
             cluster_name=self.options.cluster_name, metrics=self.metrics)
 
-        # the plugin boundary + core state (main.go:31-40)
-        self.cloudprovider = CloudProvider(
-            self.kube, self.instance_types, self.instances,
-            cluster_name=self.options.cluster_name, clock=clock,
-            recorder=self.recorder)
+        # the plugin boundary + core state (main.go:31-40); the metrics
+        # decorator wraps it before any controller sees it (main.go:39)
+        from .cloudprovider.decorator import MetricsDecorator
+        self.cloudprovider = MetricsDecorator(
+            CloudProvider(
+                self.kube, self.instance_types, self.instances,
+                cluster_name=self.options.cluster_name, clock=clock,
+                recorder=self.recorder),
+            self.metrics, clock=clock)
         self.state = ClusterState(self.kube, clock=clock)
 
         # controllers (controllers.go:63-101 + core)
@@ -108,8 +126,10 @@ class Operator:
                                        metrics=self.metrics, clock=clock)
         self.lifecycle = NodeClaimLifecycle(self.kube, self.cloudprovider,
                                             self.instance_types, clock=clock,
-                                            recorder=self.recorder)
-        self.terminator = Terminator(self.kube, self.cloudprovider, clock=clock)
+                                            recorder=self.recorder,
+                                            metrics=self.metrics)
+        self.terminator = Terminator(self.kube, self.cloudprovider,
+                                     clock=clock, metrics=self.metrics)
         self.nodeclass_status = NodeClassStatusController(
             self.kube, self.subnets, self.security_groups, self.amis,
             self.instance_profiles, clock=clock, metrics=self.metrics,
@@ -141,7 +161,9 @@ class Operator:
         self.kubelet = FakeKubelet(self.kube, self.ec2,
                                    catalog_by_name(self.ec2.catalog),
                                    self.state, clock=clock,
-                                   vm_overhead_percent=self.options.vm_memory_overhead_percent)
+                                   vm_overhead_percent=self.options.vm_memory_overhead_percent,
+                                   reserved_enis=self.options.reserved_enis,
+                                   metrics=self.metrics)
 
         # boot-blocking hydration (operator.go:152-155): catalog + pricing
         self.catalog_controller.reconcile()
@@ -168,7 +190,24 @@ class Operator:
         out["capacity_discovered"] = self.discovered_capacity.reconcile()
         out["ssm_evicted"] = self.ssm_invalidation.reconcile()
         out["version_changed"] = self.version_controller.reconcile()
+        self._emit_state_gauges()
         return out
+
+    def _emit_state_gauges(self) -> None:
+        """Cluster-state gauges (metrics.md cluster_state/nodepools
+        groups): node count, per-nodepool usage."""
+        nodes = self.kube.list("Node")
+        self.metrics.set_gauge("karpenter_cluster_state_node_count",
+                               len(nodes))
+        # full re-emit: drop series for pools that vanished so the gauge
+        # never shows phantom usage (the steady_state.py ghost pattern)
+        self.metrics.clear_series("karpenter_nodepools_usage")
+        for np_name, used in self.state.nodepool_usage().items():
+            for dim in ("cpu", "memory"):
+                self.metrics.set_gauge(
+                    "karpenter_nodepools_usage",
+                    used[dim],
+                    labels={"nodepool": np_name, "resource_type": dim})
 
     def run_until_settled(self, max_steps: int = 20,
                           disrupt: bool = True) -> int:

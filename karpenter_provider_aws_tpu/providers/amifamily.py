@@ -61,11 +61,15 @@ class AMIProvider:
                         tag_filters=dict(term.tags),
                         ids=[term.id] if term.id else (),
                         names=[term.name] if term.name else ()):
-                    if not img.deprecated:
-                        amis[img.id] = AMI(img.id, img.name, img.arch,
-                                           img.creation_date, img.deprecated)
+                    # deprecated AMIs stay launchable when explicitly
+                    # selected; they are deprioritized below
+                    # (ami.go:173-182,216-222)
+                    amis[img.id] = AMI(img.id, img.name, img.arch,
+                                       img.creation_date, img.deprecated)
+        # non-deprecated first, then newest, then id (types.go:44-55 +
+        # the deprecation ordering of ami.go:216-222)
         return sorted(amis.values(),
-                      key=lambda a: (-a.creation_date, a.name))
+                      key=lambda a: (a.deprecated, -a.creation_date, a.id))
 
     def _resolve_ssm(self, family: str, arch: str) -> Optional[AMI]:
         path = f"/aws/service/{family}/{arch}/latest/image_id"
@@ -113,6 +117,10 @@ class BootstrapConfig:
     cluster_endpoint: str
     ca_bundle: str = ""
     cluster_cidr: str = "10.100.0.0/16"
+    #: "ipv4" | "ipv6" — derived from the kube-dns IP family
+    #: (launchtemplate.go:98); AL2 adds --ip-family, nodeadm carries the
+    #: IPv6 service CIDR in `cidr`
+    ip_family: str = "ipv4"
     labels: Dict[str, str] = field(default_factory=dict)
     taints: Sequence[Taint] = ()
     kubelet: KubeletConfiguration = field(default_factory=KubeletConfiguration)
@@ -132,9 +140,10 @@ def generate_user_data(family: str, cfg: BootstrapConfig) -> str:
     return cfg.custom_user_data  # custom family: verbatim (custom.go)
 
 
-def _kubelet_args(cfg: BootstrapConfig) -> str:
+def _kubelet_args(cfg: BootstrapConfig, skip: Sequence[str] = ()) -> str:
     """The --kubelet-extra-args line (bootstrap/eksbootstrap.go kubelet
-    flag assembly; deterministic ordering)."""
+    flag assembly; deterministic ordering). ``skip`` drops flags a family
+    renders elsewhere (AL2's --dns-cluster-ip bootstrap arg)."""
     kl = cfg.kubelet
     args = []
     if cfg.labels:
@@ -183,6 +192,8 @@ def _kubelet_args(cfg: BootstrapConfig) -> str:
         args.append(f"--image-gc-low-threshold={kl.image_gc_low_threshold_percent}")
     if kl.cpu_cfs_quota is not None:
         args.append(f"--cpu-cfs-quota={str(kl.cpu_cfs_quota).lower()}")
+    if skip:
+        args = [a for a in args if not a.startswith(tuple(skip))]
     return " ".join(args)
 
 
@@ -195,7 +206,13 @@ def _al2(cfg: BootstrapConfig) -> str:
     )
     if cfg.ca_bundle:
         script += f" --b64-cluster-ca '{cfg.ca_bundle}'"
-    kargs = _kubelet_args(cfg)
+    if cfg.ip_family == "ipv6":
+        script += " --ip-family ipv6"
+    if cfg.kubelet.cluster_dns:
+        # AL2 takes the DNS IP as a bootstrap.sh arg, not a kubelet flag
+        # (eksbootstrap.go:70-72)
+        script += f" --dns-cluster-ip '{cfg.kubelet.cluster_dns[0]}'"
+    kargs = _kubelet_args(cfg, skip=("--cluster-dns=",))
     if kargs:
         script += f" --kubelet-extra-args '{kargs}'"
     script += "\n"
@@ -243,6 +260,9 @@ def _bottlerocket(cfg: BootstrapConfig) -> str:
     ]
     if cfg.ca_bundle:
         lines.append(f'cluster-certificate = "{cfg.ca_bundle}"')
+    if cfg.kubelet.cluster_dns:
+        # bottlerocket.go:54-55
+        lines.append(f'cluster-dns-ip = "{cfg.kubelet.cluster_dns[0]}"')
     if cfg.kubelet.max_pods is not None:
         lines.append(f"max-pods = {cfg.kubelet.max_pods}")
     if cfg.labels:

@@ -198,12 +198,15 @@ class InstanceProvider:
         on-demand (instance.go:365-381)."""
         ct = reqs.get(L.CAPACITY_TYPE)
         if ct is None or ct.has(L.CAPACITY_TYPE_SPOT):
-            spot_req = Requirements([Requirement.new(
-                L.CAPACITY_TYPE, IN, [L.CAPACITY_TYPE_SPOT])])
+            # the spot probe must honor ALL the claim's requirements
+            # (zone included): a zone-constrained claim whose zone offers
+            # no spot must fall to on-demand (instance.go:365-381 checks
+            # offering compatibility against the full requirement set)
+            spot_req = reqs.union(Requirements([Requirement.new(
+                L.CAPACITY_TYPE, IN, [L.CAPACITY_TYPE_SPOT])]))
             for t in types:
                 if t.offerings.available().compatible(spot_req):
-                    if ct is None or ct.has(L.CAPACITY_TYPE_SPOT):
-                        return L.CAPACITY_TYPE_SPOT
+                    return L.CAPACITY_TYPE_SPOT
         return L.CAPACITY_TYPE_ON_DEMAND
 
     def _overrides(self, types: InstanceTypes, reqs: Requirements,

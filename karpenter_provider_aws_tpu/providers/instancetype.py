@@ -20,13 +20,14 @@ from typing import Dict, List, Mapping, Optional, Sequence, Set, Tuple
 from ..apis import labels as L
 from ..apis.objects import EC2NodeClass, KubeletConfiguration
 from ..apis.requirements import IN, Requirement, Requirements
-from ..apis.resources import (AWS_EFA, AWS_NEURON, AWS_POD_ENI, NVIDIA_GPU,
+from ..apis.resources import (ATTACHABLE_VOLUMES, AWS_EFA, AWS_NEURON,
+                              AWS_POD_ENI, NVIDIA_GPU,
                               Resources, parse_quantity)
 from ..cache.ttl import TTLCache
 from ..cloudprovider.types import (InstanceType, InstanceTypes, Offering,
                                    Offerings, Overhead)
-from ..fake.catalog import (BANDWIDTH_MBPS, GIB,
-                            InstanceTypeInfo, ZoneInfo)
+from ..fake.catalog import (BANDWIDTH_MBPS, GIB, InstanceTypeInfo, ZoneInfo,
+                            ebs_attachment_limit as _ebs_attachment_limit)
 
 #: default VM memory overhead (options.go: vm-memory-overhead-percent=0.075)
 DEFAULT_VM_MEMORY_OVERHEAD_PERCENT = 0.075
@@ -47,7 +48,11 @@ class InstanceTypeProvider:
     """Thread-safe catalog with seqnum-invalidated resolution cache."""
 
     def __init__(self, vm_memory_overhead_percent: float = DEFAULT_VM_MEMORY_OVERHEAD_PERCENT,
-                 unavailable_offerings=None, clock=None):
+                 unavailable_offerings=None, clock=None,
+                 reserved_enis: int = 0):
+        #: interfaces withheld from the ENI max-pods formula
+        #: (--reserved-enis, options.go:36-85)
+        self.reserved_enis = reserved_enis
         self._mu = threading.RLock()
         self._raw: List[InstanceTypeInfo] = []
         self._offerings: Optional[OfferingsSnapshot] = None
@@ -246,6 +251,8 @@ class InstanceTypeProvider:
             "memory": memory,
             "pods": pods,
             "ephemeral-storage": _ephemeral_storage(info, nodeclass),
+            # EBS CSI attachment limit (CSINode allocatable)
+            ATTACHABLE_VOLUMES: _ebs_attachment_limit(info),
         }
         if info.gpu_count:
             cap[NVIDIA_GPU if info.gpu_manufacturer == "nvidia" else "amd.com/gpu"] = info.gpu_count
@@ -258,12 +265,12 @@ class InstanceTypeProvider:
             cap[AWS_POD_ENI] = min(info.enis * 9, 107)
         return Resources(cap)
 
-    @staticmethod
-    def _max_pods(info: InstanceTypeInfo, kubelet: KubeletConfiguration) -> int:
+    def _max_pods(self, info: InstanceTypeInfo,
+                  kubelet: KubeletConfiguration) -> int:
         if kubelet.max_pods is not None:
             return kubelet.max_pods
         from ..fake.catalog import table_pod_limit
-        pods = table_pod_limit(info)
+        pods = table_pod_limit(info, self.reserved_enis)
         if kubelet.pods_per_core is not None:
             pods = min(pods, kubelet.pods_per_core * info.vcpus)
         return pods

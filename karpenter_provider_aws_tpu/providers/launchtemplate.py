@@ -10,7 +10,7 @@ DeleteLaunchTemplates (:373-390).
 from __future__ import annotations
 
 import threading
-from dataclasses import dataclass
+from dataclasses import dataclass, replace as replace_dataclass
 from typing import Dict, List, Optional, Sequence
 
 from ..apis.objects import EC2NodeClass, Taint, stable_hash
@@ -63,7 +63,7 @@ class LaunchTemplateProvider:
                  sg_provider: SecurityGroupProvider,
                  cluster_name: str = "cluster",
                  cluster_endpoint: str = "https://cluster.local",
-                 ca_bundle: str = "", clock=None):
+                 ca_bundle: str = "", kube_dns_ip: str = "", clock=None):
         self.ec2 = ec2
         #: cluster service CIDR, resolved lazily from the cluster on first
         #: template build (launchtemplate.go:433+ resolveClusterCIDR)
@@ -73,6 +73,10 @@ class LaunchTemplateProvider:
         self.cluster_name = cluster_name
         self.cluster_endpoint = cluster_endpoint
         self.ca_bundle = ca_bundle
+        self.kube_dns_ip = kube_dns_ip
+        #: cluster IP family from the kube-dns address family
+        #: (launchtemplate.go:98)
+        self.cluster_ip_family = "ipv6" if ":" in kube_dns_ip else "ipv4"
         self._cache = TTLCache(ttl=600, clock=clock)
         self._mu = threading.Lock()
         self.hydrate()
@@ -87,17 +91,22 @@ class LaunchTemplateProvider:
         """Service CIDR from the cluster, resolved once and cached
         (launchtemplate.go:433+; nodeadm userdata needs it)."""
         if self._cluster_cidr is None:
-            self._cluster_cidr = getattr(
-                self.ec2, "eks_cluster_cidr", None) or "10.100.0.0/16"
+            # IPv6 service CIDR wins when the cluster has one
+            # (launchtemplate.go:448-450)
+            self._cluster_cidr = (
+                getattr(self.ec2, "eks_service_ipv6_cidr", None)
+                or getattr(self.ec2, "eks_cluster_cidr", None)
+                or "10.100.0.0/16")
         return self._cluster_cidr
 
-    @staticmethod
-    def _network_interfaces(efa_count: int,
+    def _network_interfaces(self, efa_count: int,
                             nodeclass: EC2NodeClass) -> List[dict]:
         """EFA-capable buckets get one EFA interface per available slot
         (device 0 carries the primary IP); plain buckets get the single
-        default interface with the NodeClass's public-IP choice
-        (launchtemplate.go:275-305)."""
+        default interface with the NodeClass's public-IP choice. IPv6
+        clusters ask for one IPv6 address on the primary interface
+        (PrimaryIpv6/Ipv6AddressCount, launchtemplate.go:275-305)."""
+        ipv6 = self.cluster_ip_family == "ipv6"
         if efa_count > 0:
             out = [{"device_index": 0 if i == 0 else 1,
                     "network_card_index": i,
@@ -108,12 +117,21 @@ class LaunchTemplateProvider:
                 # interface even when EFA is enabled (launchtemplate.go)
                 out[0]["associate_public_ip_address"] = \
                     nodeclass.associate_public_ip
+            if ipv6:
+                out[0]["primary_ipv6"] = True
+                out[0]["ipv6_address_count"] = 1
             return out
+        out = []
         if nodeclass.associate_public_ip is not None:
-            return [{"device_index": 0,
-                     "associate_public_ip_address":
-                         nodeclass.associate_public_ip}]
-        return []
+            out = [{"device_index": 0,
+                    "associate_public_ip_address":
+                        nodeclass.associate_public_ip}]
+        if ipv6:
+            if not out:
+                out = [{"device_index": 0}]
+            out[0]["primary_ipv6"] = True
+            out[0]["ipv6_address_count"] = 1
+        return out
 
     def _block_device_mappings(self, nodeclass: EC2NodeClass) -> List[dict]:
         if nodeclass.block_device_mappings:
@@ -149,6 +167,25 @@ class LaunchTemplateProvider:
                         labels, taints))
         return out
 
+    def _effective_kubelet(self, nodeclass: EC2NodeClass):
+        """Default ClusterDNS to the discovered kube-dns IP when the
+        NodeClass doesn't set one (resolver.go:188-200)."""
+        kl = nodeclass.kubelet
+        if self.kube_dns_ip and not kl.cluster_dns:
+            kl = replace_dataclass(kl, cluster_dns=[self.kube_dns_ip])
+        return kl
+
+    def _effective_metadata_options(self, nodeclass: EC2NodeClass) -> dict:
+        """Spec metadata options, with HttpProtocolIpv6 defaulting to
+        enabled on IPv6 clusters when the NodeClass leaves the options
+        untouched (resolver.go:178-184 DefaultMetadataOptions)."""
+        md = vars(nodeclass.metadata_options).copy()
+        from ..apis.objects import MetadataOptions
+        if (self.cluster_ip_family == "ipv6"
+                and nodeclass.metadata_options == MetadataOptions()):
+            md["http_protocol_ipv6"] = "enabled"
+        return md
+
     def _ensure_one(self, nodeclass: EC2NodeClass, ami: AMI, types,
                     efa_count: int, sgs, labels, taints
                     ) -> ResolvedLaunchTemplate:
@@ -158,8 +195,9 @@ class LaunchTemplateProvider:
                 cluster_endpoint=self.cluster_endpoint,
                 ca_bundle=self.ca_bundle,
                 cluster_cidr=self._resolve_cluster_cidr(),
+                ip_family=self.cluster_ip_family,
                 labels=dict(labels or {}), taints=tuple(taints),
-                kubelet=nodeclass.kubelet,
+                kubelet=self._effective_kubelet(nodeclass),
                 custom_user_data=nodeclass.user_data))
         name = self._lt_name(nodeclass, ami, sgs, user_data,
                              efa_count=efa_count)
@@ -172,7 +210,7 @@ class LaunchTemplateProvider:
                 id="", name=name, image_id=ami.id,
                 security_group_ids=list(sgs), user_data=user_data,
                 tags=dict(nodeclass.tags),
-                metadata_options=vars(nodeclass.metadata_options),
+                metadata_options=self._effective_metadata_options(nodeclass),
                 block_device_mappings=self._block_device_mappings(nodeclass),
                 network_interfaces=nis,
                 instance_profile=nodeclass.status_instance_profile

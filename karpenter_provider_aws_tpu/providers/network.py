@@ -23,6 +23,9 @@ class SubnetInfo:
     zone: str
     zone_id: str
     available_ips: int
+    #: availability-zone | local-zone (DescribeAvailabilityZones ZoneType;
+    #: the localzone E2E suite filters on it)
+    zone_type: str = "availability-zone"
 
 
 class SubnetProvider:
@@ -44,7 +47,8 @@ class SubnetProvider:
             for s in self.ec2.describe_subnets(
                     tag_filters=dict(term.tags),
                     ids=[term.id] if term.id else ()):
-                found[s.id] = SubnetInfo(s.id, s.zone, s.zone_id, s.available_ips)
+                found[s.id] = SubnetInfo(s.id, s.zone, s.zone_id,
+                                         s.available_ips, s.zone_type)
         out = sorted(found.values(), key=lambda s: s.id)
         self._cache.put(key, out)
         return out
@@ -58,11 +62,9 @@ class SubnetProvider:
             for s in self.list(nodeclass):
                 avail = s.available_ips - self._inflight.get(s.id, 0)
                 cur = best.get(s.zone)
-                if cur is None:
-                    best[s.zone] = SubnetInfo(s.id, s.zone, s.zone_id, avail)
-                else:
-                    if (avail, s.id) > (cur.available_ips, cur.id):
-                        best[s.zone] = SubnetInfo(s.id, s.zone, s.zone_id, avail)
+                if cur is None or (avail, s.id) > (cur.available_ips, cur.id):
+                    best[s.zone] = SubnetInfo(s.id, s.zone, s.zone_id,
+                                              avail, s.zone_type)
             return best
 
     def update_inflight_ips(self, subnet_id: str, count: int = 1) -> None:

@@ -86,6 +86,9 @@ def pod_group_signature(pod: Pod) -> Tuple:
               for c in pod.topology_spread),
         tuple((a.topology_key, a.group, a.anti, a.required) for a in pod.pod_affinity),
         pod.scheduling_group,
+        # volume-topology constraints differ per pod even when selectors
+        # match (each PVC pins its own zone)
+        tuple(r for r in (getattr(pod, "_volume_reqs", None) or ())),
     )
     return sig
 
@@ -237,7 +240,9 @@ class CPUSolver(Solver):
 
         dims = {"cpu", "memory", "pods"}
         for p in snapshot.pods:
-            dims.update(p.requests.nonzero_keys())
+            # effective requests carry derived dims too (the EBS
+            # attachment slots from volume claims)
+            dims.update(p.effective_requests().nonzero_keys())
         for d in snapshot.daemon_overheads:
             dims.update(d.requests.nonzero_keys())
         rindex = _ResourceIndex(dims)

@@ -48,12 +48,10 @@ def harden(pod: Pod, level: int) -> Pod:
         return hit
     clone = copy.copy(pod)  # shallow: shares metadata (same identity)
     # caches that depend on the (changed) topology fields must not leak:
-    # _sig_cache/_sig_digest (solver/cpu.py pod_group_signature) and
-    # _sig_id (models/encoding.py) all encode the ORIGINAL constraint
-    # tuples — a stale one would group a hardened clone with the raw pod
-    # and make relaxation a no-op
-    for stale in ("_sig_id", "_sig_cache", "_sig_digest", "_hardened"):
-        clone.__dict__.pop(stale, None)
+    # a stale signature would group a hardened clone with the raw pod and
+    # make relaxation a no-op (the attribute list lives with Pod)
+    from ..apis.objects import invalidate_scheduling_caches
+    invalidate_scheduling_caches(clone)
     dropped = 0
     aff: List[PodAffinityTerm] = []
     for a in pod.pod_affinity:

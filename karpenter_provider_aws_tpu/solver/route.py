@@ -164,7 +164,9 @@ def _probe_device(timeout: float = 90.0) -> bool:
 _device_alive = AliveCache(_probe_device)
 
 
-def device_alive(timeout: float = 90.0) -> bool:
+def device_alive() -> bool:
+    """Cached backend-liveness verdict; the probe's 90s subprocess
+    deadline lives in ``_probe_device``."""
     return _device_alive.blocking()
 
 

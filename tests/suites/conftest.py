@@ -1,0 +1,39 @@
+"""Shared fixtures for the E2E suite analogs (test/suites/* in the
+reference, SURVEY §2.8). Each suite drives the real Operator — every
+provider, controller, and the solver — against the fake cloud, the same
+"real core + fake AWS" posture as the reference's ginkgo suites."""
+
+import pytest
+
+from karpenter_provider_aws_tpu.apis.objects import (EC2NodeClass,
+                                                     NodeClassRef, NodePool,
+                                                     NodePoolTemplate)
+from karpenter_provider_aws_tpu.apis.requirements import Requirements
+from karpenter_provider_aws_tpu.fake.ec2 import FakeEC2
+from karpenter_provider_aws_tpu.operator import Operator
+
+
+@pytest.fixture
+def ec2():
+    return FakeEC2()
+
+
+@pytest.fixture
+def op(ec2):
+    return Operator(ec2=ec2)
+
+
+def mk_cluster(op: Operator, pool_name="default", requirements=(),
+               nodeclass: EC2NodeClass = None, nodeclass_name="default-class",
+               expire_after=None, **pool_kwargs):
+    """Default NodePool + EC2NodeClass pair (env.DefaultEC2NodeClass /
+    env.DefaultNodePool in the reference's suite bootstrap)."""
+    nc = nodeclass or EC2NodeClass(nodeclass_name)
+    op.kube.create(nc)
+    np = NodePool(pool_name, template=NodePoolTemplate(
+        node_class_ref=NodeClassRef(nc.metadata.name),
+        requirements=Requirements.from_terms(list(requirements)),
+        expire_after=expire_after),
+        **pool_kwargs)
+    op.kube.create(np)
+    return np, nc

@@ -1,0 +1,136 @@
+"""AMI suite (test/suites/ami/suite_test.go): AMI selector terms (id,
+name, tags, alias), newest-first resolution, deprecation semantics,
+custom AMI family, NodeClass AMI status/readiness, and userdata merge."""
+
+import pytest
+
+from karpenter_provider_aws_tpu.apis.objects import EC2NodeClass, SelectorTerm
+from karpenter_provider_aws_tpu.fake.ec2 import FakeImage, _new_id
+from karpenter_provider_aws_tpu.fake.environment import make_pods
+
+from .conftest import mk_cluster
+
+
+def add_image(ec2, name, arch="amd64", creation_date=1_900_000_000.0,
+              tags=None, deprecated=False):
+    img = FakeImage(id=_new_id("ami"), name=name, arch=arch,
+                    creation_date=creation_date, deprecated=deprecated,
+                    tags=dict(tags or {}))
+    ec2.images[img.id] = img
+    return img
+
+
+def settle(op, n_pods=1, **cluster):
+    mk_cluster(op, **cluster)
+    for p in make_pods(n_pods, cpu="500m", memory="1Gi", prefix="ami"):
+        op.kube.create(p)
+    op.run_until_settled()
+    return op.ec2.describe_instances()
+
+
+class TestAMISelection:
+    def test_ami_by_id(self, op, ec2):
+        """should use the AMI defined by the AMI Selector Terms (by id)."""
+        img = add_image(ec2, "custom-ami-v1")
+        nc = EC2NodeClass("by-id", ami_selector_terms=[
+            SelectorTerm(id=img.id)])
+        insts = settle(op, nodeclass=nc)
+        assert insts and all(i.image_id == img.id for i in insts)
+
+    def test_ami_by_name(self, op, ec2):
+        img = add_image(ec2, "named-ami-v7")
+        nc = EC2NodeClass("by-name", ami_selector_terms=[
+            SelectorTerm(name="named-ami-v7")])
+        insts = settle(op, nodeclass=nc)
+        assert insts and all(i.image_id == img.id for i in insts)
+
+    def test_ami_by_tags(self, op, ec2):
+        img = add_image(ec2, "tagged-ami", tags={"team": "infra"})
+        nc = EC2NodeClass("by-tags", ami_selector_terms=[
+            SelectorTerm.of({"team": "infra"})])
+        insts = settle(op, nodeclass=nc)
+        assert insts and all(i.image_id == img.id for i in insts)
+
+    def test_most_recent_ami_wins(self, op, ec2):
+        """should use the most recent AMI when discovering multiple
+        (types.go:44-55 newest-first sort)."""
+        add_image(ec2, "gen-v1", creation_date=1_800_000_000.0,
+                  tags={"gen": "x"})
+        newest = add_image(ec2, "gen-v2", creation_date=1_900_000_000.0,
+                           tags={"gen": "x"})
+        nc = EC2NodeClass("newest", ami_selector_terms=[
+            SelectorTerm.of({"gen": "x"})])
+        insts = settle(op, nodeclass=nc)
+        assert insts and all(i.image_id == newest.id for i in insts)
+
+    def test_deprecated_ami_still_launchable(self, op, ec2):
+        """should support launching nodes with a deprecated ami
+        (explicitly selected by id; ami.go:173-182)."""
+        img = add_image(ec2, "old-faithful", deprecated=True)
+        nc = EC2NodeClass("deprecated", ami_selector_terms=[
+            SelectorTerm(id=img.id)])
+        insts = settle(op, nodeclass=nc)
+        assert insts and all(i.image_id == img.id for i in insts)
+
+    def test_non_deprecated_prioritized(self, op, ec2):
+        """should prioritize launch with non-deprecated AMIs, even when the
+        deprecated one is newer (ami.go:216-222 ordering)."""
+        add_image(ec2, "shiny-but-deprecated", creation_date=2_000_000_000.0,
+                  deprecated=True, tags={"pool": "mixed"})
+        good = add_image(ec2, "older-but-good", creation_date=1_850_000_000.0,
+                         tags={"pool": "mixed"})
+        nc = EC2NodeClass("mixed", ami_selector_terms=[
+            SelectorTerm.of({"pool": "mixed"})])
+        insts = settle(op, nodeclass=nc)
+        assert insts and all(i.image_id == good.id for i in insts)
+
+    def test_custom_family_userdata_verbatim(self, op, ec2):
+        """should support Custom AMIFamily with AMI Selectors: userdata is
+        passed through untouched (custom.go)."""
+        img = add_image(ec2, "byo-ami")
+        nc = EC2NodeClass("custom", ami_selector_terms=[
+            SelectorTerm(id=img.id)],
+            user_data="#!/bin/bash\necho custom-bootstrap\n")
+        assert nc.ami_family == "custom"  # no alias term => custom family
+        insts = settle(op, nodeclass=nc)
+        assert insts
+        lt = op.ec2.launch_templates[insts[0].launch_template_name]
+        assert lt.user_data == "#!/bin/bash\necho custom-bootstrap\n"
+
+    def test_al2_custom_userdata_merged(self, op, ec2):
+        """should merge UserData contents for AL2 AMIFamily (MIME
+        multipart, custom part first — bootstrap/mime)."""
+        nc = EC2NodeClass("al2-merge",
+                          ami_selector_terms=[SelectorTerm(alias="al2@latest")],
+                          user_data="#!/bin/bash\necho pre-bootstrap\n")
+        insts = settle(op, nodeclass=nc)
+        assert insts
+        ud = op.ec2.launch_templates[insts[0].launch_template_name].user_data
+        assert ud.startswith("MIME-Version: 1.0")
+        assert ud.index("pre-bootstrap") < ud.index("/etc/eks/bootstrap.sh")
+
+
+class TestAMIStatus:
+    def test_status_amis_resolved(self, op, ec2):
+        """should have the EC2NodeClass status for AMIs (using tags +
+        wildcard discovery; ec2nodeclass_status.go:22-70)."""
+        img = add_image(ec2, "status-ami", tags={"status": "check"})
+        nc = EC2NodeClass("status", ami_selector_terms=[
+            SelectorTerm.of({"status": "check"})])
+        op.kube.create(nc)
+        op.nodeclass_status.reconcile()
+        got = op.kube.get("EC2NodeClass", "status")
+        assert [a["id"] for a in got.status_amis] == [img.id]
+        assert got.condition_is("AMIsReady")
+
+    def test_not_ready_without_amis(self, op, ec2):
+        """should have ec2nodeClass status as not ready since AMI was not
+        resolved — and no node may launch through it."""
+        nc = EC2NodeClass("no-amis", ami_selector_terms=[
+            SelectorTerm.of({"nothing": "matches"})])
+        insts = settle(op, nodeclass=nc)
+        assert insts == []
+        got = op.kube.get("EC2NodeClass", "no-amis")
+        assert got.condition_is("AMIsReady", "False")
+        assert not got.ready
+        assert op.kube.list("Node") == []

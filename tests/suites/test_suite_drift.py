@@ -1,0 +1,114 @@
+"""Drift suite (test/suites/drift/*): all four drift reasons
+(drift.go:41-136 — AMI, subnet, security group, static-field hash) and
+the end-to-end roll a drifted node goes through."""
+
+import pytest
+
+from karpenter_provider_aws_tpu.fake.ec2 import (FakeImage, FakeSecurityGroup,
+                                                 FakeSubnet, _new_id)
+from karpenter_provider_aws_tpu.fake.environment import make_pods
+from karpenter_provider_aws_tpu.operator import Operator
+
+from .conftest import mk_cluster
+
+
+class FakeClock:
+    def __init__(self, t=1_000_000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+@pytest.fixture
+def clock():
+    return FakeClock()
+
+
+@pytest.fixture
+def op(clock):
+    return Operator(clock=clock)
+
+
+def settled_claim(op, n=1):
+    mk_cluster(op)
+    for p in make_pods(n, cpu="500m", memory="1Gi", prefix="drift"):
+        op.kube.create(p)
+    op.run_until_settled()
+    return op.kube.list("NodeClaim")[0]
+
+
+def roll_ami(op):
+    """Deprecate every image and publish a newer generation via SSM."""
+    for img in list(op.ec2.images.values()):
+        img.deprecated = True
+    for arch in ("amd64", "arm64"):
+        new = FakeImage(id=_new_id("ami"), name=f"al2023-{arch}-v9",
+                        arch=arch, creation_date=2_000_000_000.0,
+                        ssm_alias=f"al2023@latest/{arch}")
+        op.ec2.images[new.id] = new
+        op.ec2.ssm_parameters[
+            f"/aws/service/al2023/{arch}/latest/image_id"] = new.id
+    op.ssm_invalidation.reconcile(force=True)
+    op.nodeclass_status.reconcile()
+
+
+class TestDriftReasons:
+    def test_ami_drift(self, op):
+        claim = settled_claim(op)
+        assert op.cloudprovider.is_drifted(claim) == ""
+        roll_ami(op)
+        assert op.cloudprovider.is_drifted(claim) == "AMIDrift"
+
+    def test_subnet_drift(self, op):
+        claim = settled_claim(op)
+        # retag every subnet out of the selector -> resolved set changes
+        for sn in op.ec2.subnets.values():
+            sn.tags.pop("karpenter.sh/discovery", None)
+        new = FakeSubnet(id="subnet-fresh", zone="us-west-2a",
+                         zone_id="usw2-az1", available_ips=5000,
+                         tags={"karpenter.sh/discovery": "cluster"})
+        op.ec2.subnets[new.id] = new
+        op.subnets.clear_inflight()  # drop discovery cache
+        op.nodeclass_status.reconcile()
+        assert op.cloudprovider.is_drifted(claim) == "SubnetDrift"
+
+    def test_security_group_drift(self, op):
+        claim = settled_claim(op)
+        sg = FakeSecurityGroup(id="sg-extra", name="extra",
+                               tags={"karpenter.sh/discovery": "cluster"})
+        op.ec2.security_groups[sg.id] = sg
+        op.security_groups.invalidate()
+        op.nodeclass_status.reconcile()
+        assert op.cloudprovider.is_drifted(claim) == "SecurityGroupDrift"
+
+    def test_static_field_drift(self, op):
+        """NodeClass static-field change -> hash mismatch against the
+        claim's stamped annotation (drift.go areStaticFieldsDrifted)."""
+        claim = settled_claim(op)
+        nc = op.kube.get("EC2NodeClass", "default-class")
+        nc.tags = {"changed": "true"}
+        op.kube.update(nc)
+        op.nodeclass_status.reconcile()
+        assert op.cloudprovider.is_drifted(claim) == "NodeClassDrift"
+
+
+class TestDriftRoll:
+    def test_drifted_node_replaced_end_to_end(self, op, clock):
+        """A drifted node is cordoned, replaced, and its pods land on the
+        replacement (the drift suite's core spec)."""
+        claim = settled_claim(op, n=3)
+        before = {c.name for c in op.kube.list("NodeClaim")}
+        roll_ami(op)
+        for _ in range(20):
+            op.run_until_settled()
+            clock.advance(60)
+            after = {c.name for c in op.kube.list("NodeClaim")}
+            if after and not (after & before):
+                break
+        after = {c.name for c in op.kube.list("NodeClaim")}
+        assert after and not (after & before), "drifted claim never rolled"
+        assert all(p.node_name for p in op.kube.list("Pod"))

@@ -1,0 +1,76 @@
+"""Expiration suite (test/suites/expiration/*): expireAfter rolls nodes
+once their lifetime exceeds the template's budgeted age."""
+
+import pytest
+
+from karpenter_provider_aws_tpu.fake.environment import make_pods
+from karpenter_provider_aws_tpu.operator import Operator
+
+from .conftest import mk_cluster
+
+
+class FakeClock:
+    def __init__(self, t=1_000_000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+@pytest.fixture
+def clock():
+    return FakeClock()
+
+
+@pytest.fixture
+def op(clock):
+    return Operator(clock=clock)
+
+
+class TestExpiration:
+    def test_expired_nodes_roll(self, op, clock):
+        """expireAfter: 1h — claims older than that are replaced and the
+        pods survive onto fresh nodes."""
+        mk_cluster(op, expire_after=3600.0)
+        for p in make_pods(5, cpu="500m", memory="1Gi", prefix="exp"):
+            op.kube.create(p)
+        op.run_until_settled()
+        before = {c.name for c in op.kube.list("NodeClaim")}
+        assert before
+        clock.advance(2 * 3600)
+        for _ in range(20):
+            op.run_until_settled()
+            clock.advance(60)
+            after = {c.name for c in op.kube.list("NodeClaim")}
+            if after and not (after & before):
+                break
+        after = {c.name for c in op.kube.list("NodeClaim")}
+        assert after and not (after & before), "expired fleet did not roll"
+        assert all(p.node_name for p in op.kube.list("Pod"))
+
+    def test_unexpired_nodes_untouched(self, op, clock):
+        mk_cluster(op, expire_after=24 * 3600.0)
+        for p in make_pods(3, cpu="500m", memory="1Gi", prefix="young"):
+            op.kube.create(p)
+        op.run_until_settled()
+        before = {c.name for c in op.kube.list("NodeClaim")}
+        clock.advance(3600)
+        for _ in range(4):
+            op.run_until_settled()
+            clock.advance(300)
+        assert {c.name for c in op.kube.list("NodeClaim")} == before
+
+    def test_no_expire_after_never_rolls(self, op, clock):
+        mk_cluster(op)  # expire_after=None
+        for p in make_pods(3, cpu="500m", memory="1Gi", prefix="forever"):
+            op.kube.create(p)
+        op.run_until_settled()
+        before = {c.name for c in op.kube.list("NodeClaim")}
+        clock.advance(30 * 24 * 3600)
+        for _ in range(4):
+            op.run_until_settled()
+            clock.advance(600)
+        assert {c.name for c in op.kube.list("NodeClaim")} == before

@@ -1,0 +1,142 @@
+"""Integration suite (test/suites/integration/*): metadata options, block
+device mappings, ENI-limited maxPods, kubelet maxPods, reservedENIs, and
+extended-resource (GPU / Neuron / pod-ENI) provisioning."""
+
+import pytest
+
+from karpenter_provider_aws_tpu.apis import labels as L
+from karpenter_provider_aws_tpu.apis.objects import (BlockDeviceMapping,
+                                                     EC2NodeClass,
+                                                     KubeletConfiguration,
+                                                     MetadataOptions)
+from karpenter_provider_aws_tpu.fake.catalog import VPC_LIMITS
+from karpenter_provider_aws_tpu.fake.environment import make_pods
+from karpenter_provider_aws_tpu.operator import Operator, Options
+
+from .conftest import mk_cluster
+
+
+def settle(op, pods, **cluster):
+    mk_cluster(op, **cluster)
+    for p in pods:
+        op.kube.create(p)
+    op.run_until_settled()
+    return op.ec2.describe_instances()
+
+
+class TestLaunchTemplateFidelity:
+    def test_metadata_options(self, op):
+        """should use specified metadata options."""
+        nc = EC2NodeClass("md", metadata_options=MetadataOptions(
+            http_endpoint="enabled", http_protocol_ipv6="enabled",
+            http_put_response_hop_limit=10, http_tokens="required"))
+        insts = settle(op, make_pods(1, cpu="500m", prefix="md"),
+                       nodeclass=nc)
+        lt = op.ec2.launch_templates[insts[0].launch_template_name]
+        assert lt.metadata_options == {
+            "http_endpoint": "enabled", "http_protocol_ipv6": "enabled",
+            "http_put_response_hop_limit": 10, "http_tokens": "required"}
+
+    def test_block_device_mappings(self, op):
+        """should use specified block device mappings."""
+        nc = EC2NodeClass("bdm", block_device_mappings=[
+            BlockDeviceMapping(device_name="/dev/xvda", volume_size="187Gi",
+                               volume_type="io2", iops=10_000,
+                               encrypted=True, delete_on_termination=True)])
+        insts = settle(op, make_pods(1, cpu="500m", prefix="bdm"),
+                       nodeclass=nc)
+        lt = op.ec2.launch_templates[insts[0].launch_template_name]
+        bdm = lt.block_device_mappings[0]
+        assert (bdm["volume_size"], bdm["volume_type"], bdm["iops"]) == \
+            ("187Gi", "io2", 10_000)
+
+
+class TestMaxPods:
+    def test_eni_limited_max_pods(self, op):
+        """should set eni-limited maxPods from the vpclimits table."""
+        insts = settle(
+            op, make_pods(1, cpu="500m", prefix="eni",
+                          node_selector={L.INSTANCE_TYPE: "m5.large"}))
+        node = op.kube.list("Node")[0]
+        enis, ips = VPC_LIMITS["m5.large"]
+        assert node.capacity["pods"] == enis * (ips - 1) + 2
+
+    def test_kubelet_max_pods_override(self, op):
+        """should set max pods to 110 if maxPods is set in kubelet."""
+        nc = EC2NodeClass("mp", kubelet=KubeletConfiguration(max_pods=110))
+        settle(op, make_pods(1, cpu="500m", prefix="mp"), nodeclass=nc)
+        claim = op.kube.list("NodeClaim")[0]
+        assert claim.capacity["pods"] == 110
+        ud = op.ec2.launch_templates[
+            op.ec2.describe_instances()[0].launch_template_name].user_data
+        assert "maxPods: 110" in ud or "--max-pods=110" in ud
+
+    def test_reserved_enis_shrink_max_pods(self):
+        """should set maxPods when reservedENIs is set (options.go
+        reserved-enis; types.go ENILimitedPods)."""
+        op = Operator(options=Options(
+            cluster_name="cluster", cluster_endpoint="https://cluster.local",
+            reserved_enis=1))
+        mk_cluster(op)
+        for p in make_pods(1, cpu="500m", prefix="renis",
+                           node_selector={L.INSTANCE_TYPE: "m5.large"}):
+            op.kube.create(p)
+        op.run_until_settled()
+        node = op.kube.list("Node")[0]
+        enis, ips = VPC_LIMITS["m5.large"]
+        assert node.capacity["pods"] == (enis - 1) * (ips - 1) + 2
+
+
+class TestMetricsSurface:
+    def test_lifecycle_and_cloudprovider_metrics(self, op):
+        """docs/metrics.md: lifecycle counters, the CloudProvider duration
+        decorator (main.go:39), pod startup histogram, and state gauges
+        all emit during a provisioning round."""
+        settle(op, make_pods(3, cpu="500m", memory="1Gi", prefix="met"))
+        m = op.metrics
+        claims = len(op.kube.list("NodeClaim"))
+        for phase in ("launched", "registered", "initialized"):
+            assert m.counter(f"karpenter_nodeclaims_{phase}_total",
+                             labels={"nodepool": "default"}) == claims
+        assert m.counter("karpenter_nodes_created_total",
+                         labels={"nodepool": "default"}) == claims
+        assert m.percentile(
+            "karpenter_pods_startup_duration_seconds", 0.5) >= 0
+        assert m.gauge("karpenter_cluster_state_node_count") == \
+            len(op.kube.list("Node"))
+        # the decorator timed create() calls
+        assert ("karpenter_cloudprovider_duration_seconds",
+                (("method", "create"),)) in m.histograms
+
+
+class TestExtendedResources:
+    def test_nvidia_gpu_deployment(self, op):
+        """should provision nodes for a deployment that requests
+        nvidia.com/gpu."""
+        pods = make_pods(2, cpu="1", memory="4Gi", prefix="gpu",
+                         **{"nvidia.com/gpu": "1"})
+        insts = settle(op, pods)
+        cat = op.ec2.by_name
+        assert insts and all(cat[i.instance_type].gpu_count > 0
+                             for i in insts)
+        assert all(p.node_name for p in op.kube.list("Pod"))
+
+    def test_neuron_deployment(self, op):
+        """should provision nodes for a deployment that requests
+        aws.amazon.com/neuron."""
+        pods = make_pods(1, cpu="1", memory="2Gi", prefix="neuron",
+                         **{"aws.amazon.com/neuron": "1"})
+        insts = settle(op, pods)
+        cat = op.ec2.by_name
+        assert insts and all(cat[i.instance_type].accelerator_count > 0
+                             for i in insts)
+
+    def test_pod_eni_deployment(self, op):
+        """should provision nodes for a deployment that requests
+        vpc.amazonaws.com/pod-eni (security groups for pods)."""
+        pods = make_pods(1, cpu="500m", memory="1Gi", prefix="podeni",
+                         **{"vpc.amazonaws.com/pod-eni": "1"})
+        insts = settle(op, pods)
+        cat = op.ec2.by_name
+        assert insts and all(cat[i.instance_type].hypervisor == "nitro"
+                             for i in insts)

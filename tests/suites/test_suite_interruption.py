@@ -1,0 +1,92 @@
+"""Interruption suite (test/suites/interruption/*): all five SQS message
+kinds end-to-end — cordon-and-drain, spot-offering blacklist feeding the
+next solve, replacement provisioning, and event publication."""
+
+import pytest
+
+from karpenter_provider_aws_tpu.apis import labels as L
+from karpenter_provider_aws_tpu.fake.environment import make_pods
+from karpenter_provider_aws_tpu.operator import Operator
+from karpenter_provider_aws_tpu.providers.pricing import InterruptionMessage
+
+from .conftest import mk_cluster
+
+
+def provision_spot(op, n=3):
+    mk_cluster(op, requirements=[
+        {"key": L.CAPACITY_TYPE, "operator": "In", "values": ["spot"]}])
+    for p in make_pods(n, cpu="2", memory="4Gi", prefix="spot"):
+        op.kube.create(p)
+    op.run_until_settled()
+    return op.kube.list("NodeClaim")
+
+
+def send_for(op, claim, kind):
+    op.sqs.send(InterruptionMessage(
+        kind=kind, instance_id=claim.provider_id.split("/")[-1]))
+
+
+class TestInterruptionKinds:
+    @pytest.mark.parametrize("kind", [
+        "spot_interruption", "rebalance_recommendation",
+        "scheduled_change", "state_change"])
+    def test_actionable_kind_cordons_and_replaces(self, op, kind):
+        claims = provision_spot(op)
+        victim = claims[0]
+        send_for(op, victim, kind)
+        stats = op.interruption.reconcile()
+        assert stats["cordoned"] == 1
+        op.run_until_settled()
+        # the victim claim is gone and every pod runs again
+        assert victim.name not in {c.name for c in op.kube.list("NodeClaim")}
+        assert all(p.node_name for p in op.kube.list("Pod"))
+        assert len(op.sqs) == 0  # message deleted after handling
+
+    def test_noop_message_ignored(self, op):
+        claims = provision_spot(op)
+        send_for(op, claims[0], "noop")
+        stats = op.interruption.reconcile()
+        assert stats["cordoned"] == 0 and stats["noop"] >= 1
+        assert claims[0].name in {c.name for c in op.kube.list("NodeClaim")}
+
+    def test_unknown_instance_is_noop(self, op):
+        provision_spot(op)
+        op.sqs.send(InterruptionMessage(
+            kind="spot_interruption", instance_id="i-deadbeef"))
+        stats = op.interruption.reconcile()
+        assert stats["cordoned"] == 0 and stats["noop"] == 1
+
+    def test_spot_interruption_blacklists_offering(self, op):
+        """the interrupted (type, zone) spot pool is marked unavailable so
+        the replacement avoids it (controller.go spot-offering feedback —
+        the UnavailableOfferings cache is a solver input, SURVEY §5)."""
+        claims = provision_spot(op)
+        victim = claims[0]
+        itype = victim.metadata.labels[L.INSTANCE_TYPE]
+        zone = victim.metadata.labels[L.ZONE]
+        send_for(op, victim, "spot_interruption")
+        op.interruption.reconcile()
+        assert op.unavailable_offerings.is_unavailable("spot", itype, zone)
+        op.run_until_settled()
+        # no replacement landed on the blacklisted pool
+        for inst in op.ec2.describe_instances():
+            if inst.state == "running":
+                assert not (inst.instance_type == itype
+                            and inst.zone == zone
+                            and inst.capacity_type == "spot")
+
+    def test_events_published(self, op):
+        claims = provision_spot(op)
+        send_for(op, claims[0], "spot_interruption")
+        op.interruption.reconcile()
+        reasons = [e.reason for e in op.recorder.events()]
+        assert "SpotInterrupted" in reasons or any(
+            "Interrupt" in r for r in reasons)
+
+    def test_metrics_counted(self, op):
+        claims = provision_spot(op)
+        send_for(op, claims[0], "rebalance_recommendation")
+        op.interruption.reconcile()
+        assert op.metrics.counter(
+            "karpenter_interruption_received_messages_total",
+            labels={"message_type": "rebalance_recommendation"}) == 1

@@ -1,0 +1,110 @@
+"""IPv6 suite (test/suites/ipv6/suite_test.go): provisioning on an IPv6
+cluster — kube-dns discovery, bootstrap args, primary-IPv6 launch
+templates, and instances coming up with IPv6 addresses."""
+
+import pytest
+
+from karpenter_provider_aws_tpu.apis import labels as L
+from karpenter_provider_aws_tpu.apis.objects import (EC2NodeClass,
+                                                     KubeletConfiguration,
+                                                     SelectorTerm)
+from karpenter_provider_aws_tpu.fake.ec2 import FakeEC2
+from karpenter_provider_aws_tpu.fake.environment import make_pods
+from karpenter_provider_aws_tpu.operator import Operator
+
+from .conftest import mk_cluster
+
+SERVICE_IPV6_CIDR = "fd13:b8a2:4600::/108"
+
+
+@pytest.fixture
+def ec2():
+    e = FakeEC2()
+    e.eks_service_ipv6_cidr = SERVICE_IPV6_CIDR
+    return e
+
+
+def settle_one_pod(op, **pod_kwargs):
+    mk_cluster(op, **pod_kwargs.pop("cluster", {}))
+    for p in make_pods(1, cpu="500m", memory="1Gi", prefix="v6"):
+        op.kube.create(p)
+    op.run_until_settled()
+    nodes = op.kube.list("Node")
+    assert len(nodes) == 1
+    return nodes[0]
+
+
+class TestIPv6:
+    def test_kube_dns_discovery_ipv6(self, op):
+        """The operator derives the kube-dns IP from the IPv6 service CIDR
+        (operator.go:262-274); the LT provider keys the cluster family off
+        it (launchtemplate.go:98)."""
+        assert ":" in op.kube_dns_ip
+        assert op.kube_dns_ip == "fd13:b8a2:4600::a"
+        assert op.launch_templates.cluster_ip_family == "ipv6"
+
+    def test_provisions_ipv6_node_with_dns_discovery(self, op):
+        """suite_test.go:75-85: pod → node; instance has exactly one IPv6
+        address; userdata carries --ip-family ipv6 + the v6 DNS IP."""
+        settle_one_pod(op)
+        insts = op.ec2.describe_instances()
+        assert len(insts) == 1
+        assert insts[0].ipv6_address.startswith("2600:")
+        lts = list(op.ec2.launch_templates.values())
+        assert lts, "no launch templates created"
+        for lt in lts:
+            # primary interface requests a single IPv6 address and is
+            # primary-IPv6 (launchtemplate.go:288-289,301-302)
+            prim = [ni for ni in lt.network_interfaces
+                    if ni.get("device_index") == 0]
+            assert prim and prim[0]["primary_ipv6"] is True
+            assert prim[0]["ipv6_address_count"] == 1
+
+    def test_al2_bootstrap_ip_family(self, ec2):
+        op = Operator(ec2=ec2)
+        nc = EC2NodeClass(
+            "v6-al2",
+            ami_selector_terms=[SelectorTerm(alias="al2@latest")])
+        settle_one_pod(op, cluster={"nodeclass": nc})
+        ud = next(iter(op.ec2.launch_templates.values())).user_data
+        assert "--ip-family ipv6" in ud
+        assert "--dns-cluster-ip 'fd13:b8a2:4600::a'" in ud
+
+    def test_nodeadm_carries_ipv6_service_cidr(self, op):
+        """AL2023 nodeadm config's `cidr` is the IPv6 service CIDR
+        (launchtemplate.go:448-450 feeding nodeadm.go)."""
+        settle_one_pod(op)
+        ud = next(iter(op.ec2.launch_templates.values())).user_data
+        assert f"cidr: {SERVICE_IPV6_CIDR}" in ud
+        assert "clusterDNS: [fd13:b8a2:4600::a]" in ud
+
+    def test_kubelet_config_dns_wins(self, ec2):
+        """suite_test.go:86-97: an explicit kubeletConfiguration clusterDNS
+        is respected over the discovered one (resolver.go:188-200)."""
+        op = Operator(ec2=ec2)
+        nc = EC2NodeClass(
+            "v6-custom-dns",
+            kubelet=KubeletConfiguration(cluster_dns=["fd13:b8a2:4600::53"]))
+        settle_one_pod(op, cluster={"nodeclass": nc})
+        ud = next(iter(op.ec2.launch_templates.values())).user_data
+        assert "fd13:b8a2:4600::53" in ud
+        assert "fd13:b8a2:4600::a]" not in ud
+
+    def test_metadata_http_protocol_ipv6_defaults_enabled(self, op):
+        """DefaultMetadataOptions enables HttpProtocolIpv6 on IPv6 clusters
+        (resolver.go:178-184)."""
+        settle_one_pod(op)
+        lt = next(iter(op.ec2.launch_templates.values()))
+        assert lt.metadata_options["http_protocol_ipv6"] == "enabled"
+
+    def test_ipv4_cluster_unchanged(self):
+        """Control: IPv4 cluster templates carry no IPv6 interface config
+        and metadata protocol stays disabled."""
+        op = Operator()
+        assert op.launch_templates.cluster_ip_family == "ipv4"
+        settle_one_pod(op)
+        lt = next(iter(op.ec2.launch_templates.values()))
+        assert all("primary_ipv6" not in ni
+                   for ni in lt.network_interfaces or ())
+        assert lt.metadata_options["http_protocol_ipv6"] == "disabled"
+        assert all(not i.ipv6_address for i in op.ec2.describe_instances())

@@ -1,0 +1,101 @@
+"""LocalZone suite (test/suites/localzone/suite_test.go): provisioning
+into a local zone — opt-in via an explicit zone requirement, restricted
+type catalog, on-demand only, gp2 block devices (most local zones lack
+gp3)."""
+
+import pytest
+
+from karpenter_provider_aws_tpu.apis import labels as L
+from karpenter_provider_aws_tpu.apis.objects import (BlockDeviceMapping,
+                                                     EC2NodeClass)
+from karpenter_provider_aws_tpu.fake.ec2 import LOCAL_ZONE_FAMILIES, FakeEC2
+from karpenter_provider_aws_tpu.fake.environment import make_pods
+from karpenter_provider_aws_tpu.operator import Operator
+
+from .conftest import mk_cluster
+
+LZ = "us-west-2-lax-1a"
+
+
+@pytest.fixture
+def ec2():
+    e = FakeEC2()
+    e.enable_local_zone(LZ)
+    return e
+
+
+def local_zone_cluster(op, **kw):
+    """The reference suite's BeforeEach: default cluster, gp2 BDM, NodePool
+    constrained to zones whose subnets are local zones
+    (suite_test.go:BeforeEach)."""
+    nc = EC2NodeClass("lz-class", block_device_mappings=[
+        BlockDeviceMapping(device_name="/dev/xvda", volume_size="80Gi",
+                           volume_type="gp2", encrypted=False)])
+    local_zones = sorted({
+        s.zone for s in op.subnets.list(nc) if s.zone_type == "local-zone"})
+    assert local_zones == [LZ]
+    return mk_cluster(op, nodeclass=nc, requirements=[
+        {"key": L.ZONE, "operator": "In", "values": local_zones}], **kw)
+
+
+class TestLocalZone:
+    def test_provisions_into_local_zone(self, op):
+        local_zone_cluster(op)
+        for p in make_pods(10, cpu="500m", memory="1Gi", prefix="lz"):
+            op.kube.create(p)
+        op.run_until_settled()
+        pods = op.kube.list("Pod")
+        assert all(p.node_name for p in pods)
+        insts = op.ec2.describe_instances()
+        assert insts
+        for inst in insts:
+            assert inst.zone == LZ
+            assert inst.zone_id == "usw2-lax1-az1"
+            # local zones are on-demand only: no spot offerings exist there
+            assert inst.capacity_type == "on-demand"
+            # restricted catalog slice
+            family = inst.instance_type.split(".")[0]
+            assert family in LOCAL_ZONE_FAMILIES
+        # the gp2 override rode into the launch template
+        lt = op.ec2.launch_templates[insts[0].launch_template_name]
+        assert lt.block_device_mappings[0]["volume_type"] == "gp2"
+        assert lt.block_device_mappings[0]["encrypted"] is False
+
+    def test_spot_constrained_pod_unschedulable_in_local_zone(self, op):
+        """A pod demanding spot capacity can never land in a local zone —
+        there is no spot offering to satisfy it."""
+        local_zone_cluster(op)
+        for p in make_pods(1, cpu="500m", memory="1Gi", prefix="lz-spot",
+                           node_selector={L.CAPACITY_TYPE: "spot"}):
+            op.kube.create(p)
+        op.run_until_settled()
+        assert op.kube.list("Node") == []
+        assert all(not p.node_name for p in op.kube.list("Pod"))
+
+    def test_zone_id_label_matches_local_zone(self, op):
+        """Scheduling by zone-id (topology.k8s.aws/zone-id) works for local
+        zones like any other zone."""
+        mk_cluster(op, requirements=[
+            {"key": L.ZONE_ID, "operator": "In", "values": ["usw2-lax1-az1"]}])
+        for p in make_pods(2, cpu="250m", memory="512Mi", prefix="lzid"):
+            op.kube.create(p)
+        op.run_until_settled()
+        insts = op.ec2.describe_instances()
+        assert insts and all(i.zone == LZ for i in insts)
+
+    def test_default_cluster_prefers_cheaper_azs(self, op):
+        """Without the zone constraint the solver's price ordering keeps
+        spot-capable AZ offerings ahead of the OD-only local zone."""
+        mk_cluster(op)
+        for p in make_pods(5, cpu="500m", memory="1Gi", prefix="az"):
+            op.kube.create(p)
+        op.run_until_settled()
+        insts = op.ec2.describe_instances()
+        assert insts and all(i.zone != LZ for i in insts)
+
+    def test_subnet_provider_reports_zone_type(self, op):
+        nc = EC2NodeClass("probe")
+        infos = op.subnets.list(nc)
+        by_type = {s.zone: s.zone_type for s in infos}
+        assert by_type[LZ] == "local-zone"
+        assert by_type["us-west-2a"] == "availability-zone"

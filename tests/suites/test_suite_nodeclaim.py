@@ -1,0 +1,171 @@
+"""NodeClaim suite (test/suites/nodeclaim/nodeclaim_test.go +
+garbage_collection_test.go): standalone NodeClaims, spec propagation,
+garbage collection both ways, registration-timeout reaping, and claims
+referencing missing/not-ready NodeClasses."""
+
+import pytest
+
+from karpenter_provider_aws_tpu.apis import labels as L
+from karpenter_provider_aws_tpu.apis.objects import (EC2NodeClass, NodeClaim,
+                                                     NodeClassRef, SelectorTerm,
+                                                     Taint)
+from karpenter_provider_aws_tpu.apis.requirements import Requirements
+from karpenter_provider_aws_tpu.apis.resources import Resources
+from karpenter_provider_aws_tpu.fake.ec2 import FakeEC2
+from karpenter_provider_aws_tpu.fake.environment import make_pods
+from karpenter_provider_aws_tpu.operator import Operator
+
+from .conftest import mk_cluster
+
+
+class FakeClock:
+    def __init__(self, t=1_000_000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+@pytest.fixture
+def clock():
+    return FakeClock()
+
+
+@pytest.fixture
+def op(clock):
+    return Operator(clock=clock)
+
+
+def standalone_claim(op, name="standalone", requirements=(), **kw):
+    """A NodeClaim created directly (no NodePool) — the reference's
+    standalone-NodeClaim pattern."""
+    op.kube.create(EC2NodeClass("claim-class"))
+    op.nodeclass_status.reconcile()
+    claim = NodeClaim(name, requirements=Requirements.from_terms(
+        list(requirements)), node_class_ref=NodeClassRef("claim-class"), **kw)
+    op.kube.create(claim)
+    return claim
+
+
+class TestStandaloneNodeClaim:
+    def test_create_within_c_family(self, op):
+        """should create a standard NodeClaim within the 'c' instance
+        family."""
+        standalone_claim(op, requirements=[
+            {"key": L.INSTANCE_CATEGORY, "operator": "In", "values": ["c"]}])
+        op.run_until_settled()
+        insts = op.ec2.describe_instances()
+        assert len(insts) == 1
+        assert insts[0].instance_type.startswith("c")
+        claim = op.kube.list("NodeClaim")[0]
+        assert claim.launched and claim.registered and claim.initialized
+
+    def test_create_based_on_resource_requests(self, op):
+        """should create a standard NodeClaim based on resource requests:
+        the chosen type fits them."""
+        standalone_claim(op, resources_requested=Resources.parse(
+            {"cpu": "14", "memory": "50Gi"}))
+        op.run_until_settled()
+        claim = op.kube.list("NodeClaim")[0]
+        assert claim.launched
+        assert claim.allocatable["cpu"] >= Resources.parse({"cpu": "14"})["cpu"]
+        assert claim.allocatable["memory"] >= \
+            Resources.parse({"memory": "50Gi"})["memory"]
+
+    def test_spec_details_propagate(self, op):
+        """should create a NodeClaim propagating all the NodeClaim spec
+        details (labels, taints) onto the launched node."""
+        standalone_claim(
+            op, requirements=[],
+            labels={"team": "platform"},
+            taints=[Taint("example.com/dedicated", "NoSchedule", "infra")])
+        op.run_until_settled()
+        node = op.kube.list("Node")[0]
+        assert node.metadata.labels.get("team") == "platform"
+        assert any(t.key == "example.com/dedicated" for t in node.taints)
+
+    def test_cloud_instance_removed_when_claim_deleted(self, op):
+        """should remove the cloudProvider NodeClaim when the cluster
+        NodeClaim is deleted (termination finalizer path)."""
+        standalone_claim(op)
+        op.run_until_settled()
+        claim = op.kube.list("NodeClaim")[0]
+        inst_id = claim.provider_id.split("/")[-1]
+        op.kube.delete("NodeClaim", claim.name)
+        op.run_until_settled()
+        assert op.ec2.instances[inst_id].state == "terminated"
+        assert op.kube.try_get("NodeClaim", claim.name) is None
+
+    def test_registration_timeout_reaps_claim(self, op, clock):
+        """should delete a NodeClaim after the registration timeout when
+        the node doesn't register (core registration TTL)."""
+        op.kubelet.pause()  # nodes never join
+        standalone_claim(op)
+        op.step()
+        claim = op.kube.list("NodeClaim")[0]
+        assert claim.launched and not claim.registered
+        clock.advance(16 * 60)
+        op.run_until_settled()
+        assert op.kube.try_get("NodeClaim", claim.name) is None
+        # the cloud instance was cleaned up too
+        assert all(i.state == "terminated"
+                   for i in op.ec2.instances.values())
+
+    def test_claim_with_missing_nodeclass_deleted(self, op):
+        """should delete a NodeClaim if it references a NodeClass that
+        doesn't exist."""
+        claim = NodeClaim("orphan-ref", requirements=Requirements([]),
+                          node_class_ref=NodeClassRef("ghost"))
+        op.kube.create(claim)
+        op.run_until_settled()
+        assert op.kube.try_get("NodeClaim", "orphan-ref") is None
+        assert op.ec2.describe_instances() == []
+
+    def test_claim_with_not_ready_nodeclass_not_launched(self, op):
+        """should delete a NodeClaim if it references a NodeClass that
+        isn't Ready (no AMIs resolve -> NodeClassNotReady)."""
+        op.kube.create(EC2NodeClass("not-ready", ami_selector_terms=[
+            SelectorTerm.of({"nothing": "here"})]))
+        op.nodeclass_status.reconcile()
+        claim = NodeClaim("blocked", requirements=Requirements([]),
+                          node_class_ref=NodeClassRef("not-ready"))
+        op.kube.create(claim)
+        op.run_until_settled()
+        assert op.ec2.describe_instances() == []
+        got = op.kube.try_get("NodeClaim", "blocked")
+        assert got is None or not got.launched
+
+
+class TestGarbageCollection:
+    def test_instance_with_no_claim_mapping_collected(self, op, clock):
+        """should succeed to garbage collect an Instance that was launched
+        by a NodeClaim but has no Instance mapping (claim object gone)."""
+        mk_cluster(op)
+        for p in make_pods(1, prefix="gc"):
+            op.kube.create(p)
+        op.run_until_settled()
+        claim = op.kube.list("NodeClaim")[0]
+        inst_id = claim.provider_id.split("/")[-1]
+        op.kube.remove_finalizer(claim, "karpenter.sh/termination")
+        op.kube.delete("NodeClaim", claim.name)
+        op.ec2.instances[inst_id].launch_time -= 60  # past the 30s grace
+        op.gc.reconcile()
+        assert op.ec2.instances[inst_id].state == "terminated"
+
+    def test_instance_deleted_behind_clusters_back(self, op):
+        """should succeed to garbage collect an Instance that was deleted
+        without the cluster's knowledge: claim+node are cleaned up."""
+        mk_cluster(op)
+        for p in make_pods(1, prefix="ghost"):
+            op.kube.create(p)
+        op.run_until_settled()
+        claim = op.kube.list("NodeClaim")[0]
+        inst_id = claim.provider_id.split("/")[-1]
+        op.ec2.instances[inst_id].state = "terminated"  # external kill
+        op.run_until_settled()
+        assert op.kube.try_get("NodeClaim", claim.name) is None
+        # the pod went back to pending and was re-provisioned
+        assert all(p.node_name for p in op.kube.list("Pod"))

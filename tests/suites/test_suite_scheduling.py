@@ -1,0 +1,142 @@
+"""Scheduling suite (test/suites/scheduling/suite_test.go): well-known
+label selection across the AWS label set, deprecated beta labels,
+annotations/labels propagation, Gt/Lt operators, naked pods and
+deployment-owned pods."""
+
+import pytest
+
+from karpenter_provider_aws_tpu.apis import labels as L
+from karpenter_provider_aws_tpu.apis.objects import (NodePool,
+                                                     NodePoolTemplate,
+                                                     NodeClassRef)
+from karpenter_provider_aws_tpu.apis.requirements import Requirements
+from karpenter_provider_aws_tpu.fake.environment import make_pods
+
+from .conftest import mk_cluster
+
+
+def settle_selector(op, node_selector, n=1, affinity_terms=(), **cluster):
+    mk_cluster(op, **cluster)
+    for p in make_pods(n, cpu="500m", memory="1Gi", prefix="sched",
+                       node_selector=node_selector,
+                       affinity_terms=affinity_terms):
+        op.kube.create(p)
+    op.run_until_settled()
+    insts = op.ec2.describe_instances()
+    assert insts, "nothing launched"
+    assert all(p.node_name for p in op.kube.list("Pod"))
+    return insts
+
+
+class TestWellKnownLabels:
+    def test_instance_type_selection(self, op):
+        insts = settle_selector(op, {L.INSTANCE_TYPE: "m5.2xlarge"})
+        assert all(i.instance_type == "m5.2xlarge" for i in insts)
+
+    def test_instance_family_and_size(self, op):
+        insts = settle_selector(op, {L.INSTANCE_FAMILY: "c6i",
+                                     L.INSTANCE_SIZE: "xlarge"})
+        assert all(i.instance_type == "c6i.xlarge" for i in insts)
+
+    def test_instance_category_generation(self, op):
+        insts = settle_selector(op, {L.INSTANCE_CATEGORY: "r",
+                                     L.INSTANCE_GENERATION: "7"})
+        assert all(i.instance_type.startswith("r7") for i in insts)
+
+    def test_zone_id_selection(self, op):
+        """should support well-known labels for zone id selection
+        (topology.k8s.aws/zone-id, labels.go:31-54)."""
+        insts = settle_selector(op, {L.ZONE_ID: "usw2-az2"})
+        assert all(i.zone == "us-west-2b" for i in insts)
+
+    def test_local_nvme_selection(self, op):
+        """should support well-known labels for local NVME storage."""
+        insts = settle_selector(op, {L.INSTANCE_LOCAL_NVME: "100"})
+        cat = op.ec2.by_name
+        for i in insts:
+            assert cat[i.instance_type].local_nvme_bytes == 100 * 1024**3
+
+    def test_encryption_in_transit_selection(self, op):
+        """should support well-known labels for encryption in transit."""
+        insts = settle_selector(
+            op, {L.INSTANCE_ENCRYPTION_IN_TRANSIT: "true"})
+        cat = op.ec2.by_name
+        assert all(cat[i.instance_type].encryption_in_transit for i in insts)
+
+    def test_gpu_labels(self, op):
+        """should support well-known labels for a gpu (nvidia)."""
+        insts = settle_selector(op, {L.INSTANCE_GPU_MANUFACTURER: "nvidia"})
+        cat = op.ec2.by_name
+        assert all(cat[i.instance_type].gpu_count > 0 for i in insts)
+
+    def test_accelerator_labels(self, op):
+        """should support well-known labels for an accelerator
+        (inferentia)."""
+        insts = settle_selector(
+            op, {L.INSTANCE_ACCELERATOR_MANUFACTURER: "aws"})
+        cat = op.ec2.by_name
+        assert all(cat[i.instance_type].accelerator_count > 0 for i in insts)
+
+    def test_arch_and_topology(self, op):
+        """should support well-known labels for topology and
+        architecture."""
+        insts = settle_selector(op, {L.ARCH: "arm64",
+                                     L.ZONE: "us-west-2c"})
+        cat = op.ec2.by_name
+        for i in insts:
+            assert cat[i.instance_type].arch == "arm64"
+            assert i.zone == "us-west-2c"
+
+    def test_deprecated_beta_labels(self, op):
+        """should support well-known deprecated labels
+        (beta.kubernetes.io/*, normalized by core scheduling)."""
+        insts = settle_selector(op, {
+            "beta.kubernetes.io/arch": "amd64",
+            "beta.kubernetes.io/instance-type": "c5.large",
+            "failure-domain.beta.kubernetes.io/zone": "us-west-2a"})
+        assert all(i.instance_type == "c5.large" and i.zone == "us-west-2a"
+                   for i in insts)
+
+    def test_gt_lt_operators(self, op):
+        """Gt/Lt requirement operators over numeric labels (instance-cpu)."""
+        insts = settle_selector(op, None, affinity_terms=[
+            {"key": L.INSTANCE_CPU, "operator": "Gt", "values": ["30"]},
+            {"key": L.INSTANCE_CPU, "operator": "Lt", "values": ["50"]}])
+        cat = op.ec2.by_name
+        for i in insts:
+            assert 30 < cat[i.instance_type].vcpus < 50
+
+
+class TestPropagation:
+    def test_node_annotations_and_labels(self, op, ec2):
+        """should apply annotations/labels from the NodePool template to
+        the node."""
+        from karpenter_provider_aws_tpu.apis.objects import EC2NodeClass
+        nc = EC2NodeClass("prop-class")
+        op.kube.create(nc)
+        np = NodePool("prop", template=NodePoolTemplate(
+            node_class_ref=NodeClassRef("prop-class"),
+            requirements=Requirements.from_terms([]),
+            labels={"team": "ml"},
+            annotations={"example.com/owner": "sre"}))
+        op.kube.create(np)
+        for p in make_pods(1, cpu="500m", memory="1Gi", prefix="prop"):
+            op.kube.create(p)
+        op.run_until_settled()
+        node = op.kube.list("Node")[0]
+        assert node.metadata.labels.get("team") == "ml"
+        assert node.metadata.labels[L.NODEPOOL] == "prop"
+        assert node.metadata.annotations.get("example.com/owner") == "sre"
+
+    def test_naked_pod_and_deployment(self, op):
+        """should provision a node for naked pods and deployment-owned
+        pods alike."""
+        mk_cluster(op)
+        naked = make_pods(1, cpu="500m", memory="1Gi", prefix="naked")
+        owned = make_pods(3, cpu="500m", memory="1Gi", prefix="deploy")
+        for p in owned:
+            p.owner_kind = "ReplicaSet"
+        for p in naked + owned:
+            op.kube.create(p)
+        op.run_until_settled()
+        assert all(p.node_name for p in op.kube.list("Pod"))

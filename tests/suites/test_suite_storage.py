@@ -1,0 +1,156 @@
+"""Storage suite (test/suites/storage/suite_test.go): pods with
+persistent volumes — pre-bound zonal PVs, storage-class allowed
+topologies, dynamic (WaitForFirstConsumer) provisioning, and per-node
+EBS volume limits."""
+
+import pytest
+
+from karpenter_provider_aws_tpu.apis import labels as L
+from karpenter_provider_aws_tpu.apis.objects import (PersistentVolume,
+                                                     PersistentVolumeClaim,
+                                                     StorageClass)
+from karpenter_provider_aws_tpu.apis.resources import ATTACHABLE_VOLUMES
+from karpenter_provider_aws_tpu.fake.environment import make_pods
+
+from .conftest import mk_cluster
+
+
+def pod_with_claim(op, claim_name, prefix="store", cpu="500m"):
+    p = make_pods(1, cpu=cpu, memory="1Gi", prefix=prefix)[0]
+    p.volume_claims = [claim_name]
+    op.kube.create(p)
+    return p
+
+
+class TestPreBoundVolumes:
+    def test_pre_bound_pv_pins_zone(self, op):
+        """should run a pod with a pre-bound persistent volume (empty
+        storage class): the pod lands in the PV's zone."""
+        mk_cluster(op)
+        pv = PersistentVolume("pv-zonal", zone="us-west-2b")
+        pv.phase = "Bound"
+        op.kube.create(pv)
+        op.kube.create(PersistentVolumeClaim("data", volume_name="pv-zonal"))
+        pod_with_claim(op, "data")
+        op.run_until_settled()
+        insts = op.ec2.describe_instances()
+        assert insts and all(i.zone == "us-west-2b" for i in insts)
+        assert all(p.node_name for p in op.kube.list("Pod"))
+
+    def test_pre_bound_pv_nonexistent_storage_class(self, op):
+        """should run a pod with a pre-bound persistent volume
+        (non-existent storage class): binding wins, the class is moot."""
+        mk_cluster(op)
+        pv = PersistentVolume("pv-noclass", zone="us-west-2a",
+                              storage_class="does-not-exist")
+        pv.phase = "Bound"
+        op.kube.create(pv)
+        op.kube.create(PersistentVolumeClaim(
+            "noclass", storage_class="does-not-exist",
+            volume_name="pv-noclass"))
+        pod_with_claim(op, "noclass")
+        op.run_until_settled()
+        insts = op.ec2.describe_instances()
+        assert insts and all(i.zone == "us-west-2a" for i in insts)
+
+
+class TestDynamicVolumes:
+    def test_dynamic_pv_binds_in_pod_zone(self, op):
+        """should run a pod with a dynamic persistent volume
+        (WaitForFirstConsumer): the PVC binds to a PV in the pod's zone
+        after scheduling."""
+        mk_cluster(op)
+        op.kube.create(StorageClass("ebs-sc"))
+        op.kube.create(PersistentVolumeClaim("dyn", storage_class="ebs-sc"))
+        pod_with_claim(op, "dyn")
+        op.run_until_settled()
+        pvc = op.kube.get("PersistentVolumeClaim", "dyn", namespace="default")
+        assert pvc.bound
+        pv = op.kube.get("PersistentVolume", pvc.volume_name)
+        node = op.kube.list("Node")[0]
+        assert pv.zone == node.metadata.labels[L.ZONE]
+
+    def test_allowed_topologies_respected(self, op):
+        """should run a pod with a dynamic persistent volume while
+        respecting allowed topologies."""
+        mk_cluster(op)
+        op.kube.create(StorageClass(
+            "zonal-sc", allowed_topology_zones=["us-west-2c"]))
+        op.kube.create(PersistentVolumeClaim("topo", storage_class="zonal-sc"))
+        pod_with_claim(op, "topo")
+        op.run_until_settled()
+        insts = op.ec2.describe_instances()
+        assert insts and all(i.zone == "us-west-2c" for i in insts)
+        pvc = op.kube.get("PersistentVolumeClaim", "topo", namespace="default")
+        assert pvc.bound
+        assert op.kube.get("PersistentVolume",
+                           pvc.volume_name).zone == "us-west-2c"
+
+    def test_volume_zone_conflict_with_pod_zone_unschedulable(self, op):
+        """a pod whose node selector conflicts with its bound PV's zone
+        can never schedule (volume topology is a hard constraint)."""
+        mk_cluster(op)
+        pv = PersistentVolume("pv-conflict", zone="us-west-2a")
+        pv.phase = "Bound"
+        op.kube.create(pv)
+        op.kube.create(PersistentVolumeClaim(
+            "conflict", volume_name="pv-conflict"))
+        p = make_pods(1, cpu="500m", memory="1Gi", prefix="conflict",
+                      node_selector={L.ZONE: "us-west-2b"})[0]
+        p.volume_claims = ["conflict"]
+        op.kube.create(p)
+        op.run_until_settled()
+        assert op.kube.list("Node") == []
+        assert not op.kube.list("Pod")[0].node_name
+
+
+class TestDistinctVolumeZones:
+    def test_identical_pods_with_different_pv_zones_split(self, op):
+        """two otherwise-identical pods whose PVs live in different zones
+        must land in their own zones (volume constraints are part of the
+        pod's scheduling identity)."""
+        mk_cluster(op)
+        for name, zone in (("va", "us-west-2a"), ("vb", "us-west-2b")):
+            pv = PersistentVolume(f"pv-{name}", zone=zone)
+            pv.phase = "Bound"
+            op.kube.create(pv)
+            op.kube.create(PersistentVolumeClaim(
+                name, volume_name=f"pv-{name}"))
+        pa = pod_with_claim(op, "va", prefix="zone-a")
+        pb = pod_with_claim(op, "vb", prefix="zone-b")
+        op.run_until_settled()
+        nodes = {n.name: n for n in op.kube.list("Node")}
+        za = nodes[op.kube.get("Pod", pa.name, namespace="default").node_name]
+        zb = nodes[op.kube.get("Pod", pb.name, namespace="default").node_name]
+        assert za.metadata.labels[L.ZONE] == "us-west-2a"
+        assert zb.metadata.labels[L.ZONE] == "us-west-2b"
+
+
+class TestVolumeLimits:
+    def test_per_node_attachment_limits(self, op):
+        """should run pods with dynamic persistent volumes while
+        respecting volume limits: 40 one-volume pods cannot share one
+        node (27 EBS attachments on nitro) even though cpu/memory fit."""
+        from karpenter_provider_aws_tpu.apis import labels as L2
+        mk_cluster(op, requirements=[
+            {"key": L2.INSTANCE_FAMILY, "operator": "In", "values": ["m6i"]}])
+        op.kube.create(StorageClass("ebs-sc"))
+        for i in range(40):
+            op.kube.create(PersistentVolumeClaim(
+                f"lim-{i:02d}", storage_class="ebs-sc"))
+            p = make_pods(1, cpu="50m", memory="128Mi",
+                          prefix=f"lim{i:02d}")[0]
+            p.volume_claims = [f"lim-{i:02d}"]
+            op.kube.create(p)
+        op.run_until_settled()
+        pods = op.kube.list("Pod")
+        assert all(p.node_name for p in pods)
+        nodes = op.kube.list("Node")
+        assert len(nodes) >= 2, "40 volumes must not fit one node"
+        # no node exceeds its attachment capacity
+        per_node = {}
+        for p in pods:
+            per_node[p.node_name] = per_node.get(p.node_name, 0) + 1
+        for node in nodes:
+            assert per_node.get(node.name, 0) <= \
+                node.capacity[ATTACHABLE_VOLUMES]

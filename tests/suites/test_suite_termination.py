@@ -1,0 +1,124 @@
+"""Termination suite (test/suites/termination/*): emptiness under
+budgets, empty-node termination, do-not-disrupt pods, node+instance
+deletion, and drain-then-reschedule semantics."""
+
+import pytest
+
+from karpenter_provider_aws_tpu.apis.objects import (Disruption,
+                                                     DisruptionBudget)
+from karpenter_provider_aws_tpu.fake.environment import make_pods
+from karpenter_provider_aws_tpu.operator import Operator
+
+from .conftest import mk_cluster
+
+
+class FakeClock:
+    def __init__(self, t=1_000_000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+@pytest.fixture
+def clock():
+    return FakeClock()
+
+
+@pytest.fixture
+def op(clock):
+    return Operator(clock=clock)
+
+
+def empty_node_cluster(op, clock, disruption=None, n=3):
+    """Provision n 1-pod nodes (a 16-vCPU type cap forces one 10-vCPU pod
+    per node), then delete the pods so every node is empty (the emptiness
+    tests' setup)."""
+    from karpenter_provider_aws_tpu.apis import labels as L
+    reqs = [{"key": L.INSTANCE_CPU, "operator": "In", "values": ["16"]}]
+    mk_cluster(op, requirements=reqs) if disruption is None else mk_cluster(
+        op, requirements=reqs, disruption=disruption)
+    pods = make_pods(n, cpu="10", memory="12Gi", prefix="empt")
+    for p in pods:
+        op.kube.create(p)
+    op.run_until_settled()
+    n_nodes = len(op.kube.list("Node"))
+    assert n_nodes >= n  # big pods: one per node (or close)
+    for p in op.kube.list("Pod"):
+        op.kube.delete("Pod", p.name, namespace=p.metadata.namespace)
+    clock.advance(60)
+    return n_nodes
+
+
+class TestEmptiness:
+    def test_terminates_empty_nodes(self, op, clock):
+        """should terminate an empty node."""
+        empty_node_cluster(op, clock)
+        for _ in range(10):
+            op.run_until_settled()
+            clock.advance(60)
+            if not op.kube.list("Node"):
+                break
+        assert op.kube.list("Node") == []
+        assert all(i.state == "terminated"
+                   for i in op.ec2.instances.values())
+
+    def test_fully_blocking_budget_prevents_emptiness(self, op, clock):
+        """should not allow emptiness if the budget is fully blocking
+        (nodes: '0')."""
+        n = empty_node_cluster(op, clock, disruption=Disruption(
+            budgets=[DisruptionBudget(nodes="0")]))
+        for _ in range(5):
+            op.run_until_settled()
+            clock.advance(60)
+        assert len(op.kube.list("Node")) == n  # nothing disrupted
+
+    def test_budget_limits_disruption_rate(self, op, clock):
+        """a count budget of 1 disrupts at most one node per round."""
+        n = empty_node_cluster(op, clock, disruption=Disruption(
+            budgets=[DisruptionBudget(nodes="1")]))
+        op.step()
+        # after a single reconcile round at most 1 node is gone
+        assert len(op.kube.list("Node")) >= n - 1
+
+
+class TestDoNotDisrupt:
+    def test_do_not_disrupt_pod_blocks_consolidation(self, op, clock):
+        """a pod annotated karpenter.sh/do-not-disrupt: true pins its
+        node (the termination suite's do-not-disrupt specs)."""
+        mk_cluster(op)
+        pods = make_pods(4, cpu="3", memory="12Gi", prefix="dnd")
+        for p in pods:
+            op.kube.create(p)
+        op.run_until_settled()
+        # pin every pod -> no voluntary disruption possible at all
+        for p in op.kube.list("Pod"):
+            p.metadata.annotations["karpenter.sh/do-not-disrupt"] = "true"
+            op.kube.update(p)
+        nodes_before = {n.name for n in op.kube.list("Node")}
+        for _ in range(5):
+            op.run_until_settled()
+            clock.advance(120)
+        assert {n.name for n in op.kube.list("Node")} == nodes_before
+
+
+class TestNodeDeletion:
+    def test_terminate_node_and_instance_on_deletion(self, op):
+        """should terminate the node and the instance on deletion; pods
+        drain and reschedule."""
+        mk_cluster(op)
+        for p in make_pods(6, cpu="500m", memory="1Gi", prefix="del"):
+            op.kube.create(p)
+        op.run_until_settled()
+        claims = op.kube.list("NodeClaim")
+        victim = claims[0]
+        inst_id = victim.provider_id.split("/")[-1]
+        op.kube.delete("NodeClaim", victim.name)
+        op.run_until_settled()
+        assert op.ec2.instances[inst_id].state == "terminated"
+        assert op.kube.try_get("Node", victim.node_name) is None
+        # every pod is running somewhere again
+        assert all(p.node_name for p in op.kube.list("Pod"))

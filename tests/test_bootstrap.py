@@ -50,7 +50,10 @@ class TestAL2:
         assert "--eviction-hard=memory.available<5%" in ud
         assert "--eviction-soft=memory.available<10%" in ud
         assert "--eviction-soft-grace-period=memory.available=1m0s" in ud
-        assert "--cluster-dns=10.100.0.10" in ud
+        # AL2 renders the DNS IP as a bootstrap.sh arg, not a kubelet flag
+        # (eksbootstrap.go:70-72)
+        assert "--dns-cluster-ip '10.100.0.10'" in ud
+        assert "--cluster-dns=" not in ud
         assert "--image-gc-high-threshold=80" in ud
         assert "--image-gc-low-threshold=50" in ud
         assert "--cpu-cfs-quota=false" in ud
