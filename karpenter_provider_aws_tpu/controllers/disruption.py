@@ -445,6 +445,11 @@ class DisruptionController:
         base = self._round_base
         existing = [n for n in base.existing_nodes if n.name not in gone]
         pods = [p for c in cands for p in c.pods]
+        # the simulation must honor volume topology exactly like real
+        # provisioning would: a pod pinned to a zonal PV (bound since it
+        # last scheduled) cannot be consolidated into another zone, and
+        # its EBS attachment slots count against the replacement
+        self.provisioner._resolve_volume_topology(pods)
         pools = base.nodepools
         if price_cap is not None:
             pools = []

@@ -28,6 +28,18 @@ log = logging.getLogger(__name__)
 REGISTRATION_TTL = 15 * 60  # core: claims that never register are reaped
 
 
+def drain_node_pods(kube: FakeKube, node_name: str) -> None:
+    """Release a doomed node's pods back to Pending (terminal pods are
+    released, never resurrected). Shared by the terminator and the
+    nodeclaim GC so drain semantics cannot diverge."""
+    for pod in kube.list("Pod"):
+        if pod.node_name == node_name:
+            pod.node_name = ""
+            if pod.phase not in ("Succeeded", "Failed"):
+                pod.phase = "Pending"
+            kube.update(pod)
+
+
 class NodeClaimLifecycle:
     def __init__(self, kube: FakeKube, cloudprovider: CloudProvider,
                  instance_types: Optional[InstanceTypeProvider] = None,
@@ -160,13 +172,7 @@ class Terminator:
                         - claim.metadata.deletion_timestamp))
             # 1) drain: release this node's pods back to pending
             if claim.node_name:
-                for pod in self.kube.list("Pod"):
-                    if pod.node_name == claim.node_name:
-                        pod.node_name = ""
-                        # terminal pods are released, not resurrected
-                        if pod.phase not in ("Succeeded", "Failed"):
-                            pod.phase = "Pending"
-                        self.kube.update(pod)
+                drain_node_pods(self.kube, claim.node_name)
             # 2) terminate the instance
             if claim.provider_id:
                 try:

@@ -183,16 +183,12 @@ class GarbageCollector:
         # Pods are drained by name regardless of whether the Node object
         # still exists — the node-reap loop above may have deleted it in
         # this same pass, and bound pods must never outlive their node.
+        from .lifecycle import drain_node_pods
         for claim in self.kube.list("NodeClaim"):
             if claim.launched and claim.provider_id \
                     and claim.provider_id not in live:
                 if claim.node_name:
-                    for pod in self.kube.list("Pod"):
-                        if pod.node_name == claim.node_name:
-                            pod.node_name = ""
-                            if pod.phase not in ("Succeeded", "Failed"):
-                                pod.phase = "Pending"
-                            self.kube.update(pod)
+                    drain_node_pods(self.kube, claim.node_name)
                     if self.kube.try_get("Node", claim.node_name):
                         self.kube.delete("Node", claim.node_name)
                 self.kube.remove_finalizer(claim, "karpenter.sh/termination")

@@ -121,6 +121,10 @@ class BootstrapConfig:
     #: (launchtemplate.go:98); AL2 adds --ip-family, nodeadm carries the
     #: IPv6 service CIDR in `cidr`
     ip_family: str = "ipv4"
+    #: "" | "RAID0" — local NVMe pooling (ec2nodeclass instanceStorePolicy;
+    #: AL2 renders --local-disks raid0, eksbootstrap.go:79-81; nodeadm
+    #: renders instance.localStorage.strategy)
+    instance_store_policy: str = ""
     labels: Dict[str, str] = field(default_factory=dict)
     taints: Sequence[Taint] = ()
     kubelet: KubeletConfiguration = field(default_factory=KubeletConfiguration)
@@ -215,6 +219,8 @@ def _al2(cfg: BootstrapConfig) -> str:
     kargs = _kubelet_args(cfg, skip=("--cluster-dns=",))
     if kargs:
         script += f" --kubelet-extra-args '{kargs}'"
+    if cfg.instance_store_policy == "RAID0":
+        script += " --local-disks raid0"
     script += "\n"
     if cfg.custom_user_data:
         return _mime_merge([cfg.custom_user_data, script])
@@ -232,9 +238,13 @@ def _al2023(cfg: BootstrapConfig) -> str:
         f"    apiServerEndpoint: {cfg.cluster_endpoint}",
         f"    certificateAuthority: {cfg.ca_bundle}",
         f"    cidr: {cfg.cluster_cidr}",
-        "  kubelet:",
-        "    config:",
     ]
+    if cfg.instance_store_policy == "RAID0":
+        lines += ["  instance:",
+                  "    localStorage:",
+                  "      strategy: RAID0"]
+    lines += ["  kubelet:",
+              "    config:"]
     if cfg.kubelet.max_pods is not None:
         lines.append(f"      maxPods: {cfg.kubelet.max_pods}")
     if cfg.kubelet.cluster_dns:

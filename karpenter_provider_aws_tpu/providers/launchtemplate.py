@@ -196,6 +196,7 @@ class LaunchTemplateProvider:
                 ca_bundle=self.ca_bundle,
                 cluster_cidr=self._resolve_cluster_cidr(),
                 ip_family=self.cluster_ip_family,
+                instance_store_policy=nodeclass.instance_store_policy,
                 labels=dict(labels or {}), taints=tuple(taints),
                 kubelet=self._effective_kubelet(nodeclass),
                 custom_user_data=nodeclass.user_data))

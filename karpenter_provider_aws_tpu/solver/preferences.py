@@ -32,10 +32,16 @@ from .types import SchedulingSnapshot, SolveResult
 
 
 def preference_count(pod: Pod) -> int:
-    """Length of the pod's preference chain (0 = nothing to relax)."""
-    n = sum(1 for a in pod.pod_affinity if not a.required)
-    n += sum(1 for c in pod.topology_spread
-             if c.when_unsatisfiable != "DoNotSchedule")
+    """Length of the pod's preference chain (0 = nothing to relax).
+    Memoized per pod — the sweep runs over every pod on every solve and
+    dominates steady-state rounds at 50k pods otherwise
+    (invalidate_scheduling_caches clears the memo)."""
+    n = pod.__dict__.get("_pref_count")
+    if n is None:
+        n = sum(1 for a in pod.pod_affinity if not a.required) \
+            + sum(1 for c in pod.topology_spread
+                  if c.when_unsatisfiable != "DoNotSchedule")
+        pod.__dict__["_pref_count"] = n
     return n
 
 

@@ -118,6 +118,38 @@ class TestConsolidation:
         drive(op, clock, rounds=10)
         assert len(op.kube.list("Node")) < len(nodes_before)
 
+    def test_consolidation_respects_bound_volume_zone(self, op, clock):
+        """a pod whose PVC bound to a zonal PV after scheduling must never
+        be consolidated into another zone — the simulation resolves volume
+        topology exactly like real provisioning (volumetopology.go)."""
+        from karpenter_provider_aws_tpu.apis.objects import (
+            PersistentVolumeClaim, StorageClass)
+        mk_cluster(op, requirements=[
+            {"key": L.INSTANCE_CPU, "operator": "In", "values": ["4"]}])
+        op.kube.create(StorageClass("ebs-sc"))
+        op.kube.create(PersistentVolumeClaim("data", storage_class="ebs-sc"))
+        vol_pod = make_pods(1, cpu="900m", memory="2Gi", prefix="vol")[0]
+        vol_pod.volume_claims = ["data"]
+        op.kube.create(vol_pod)
+        for p in make_pods(6, cpu="900m", memory="2Gi", prefix="fill"):
+            op.kube.create(p)
+        op.run_until_settled()
+        pvc = op.kube.get("PersistentVolumeClaim", "data",
+                          namespace="default")
+        assert pvc.bound
+        pv_zone = op.kube.get("PersistentVolume", pvc.volume_name).zone
+        # shrink the cluster -> consolidation moves pods around
+        for p in op.kube.list("Pod"):
+            if p.metadata.name.startswith("fill") and \
+                    p.metadata.name != vol_pod.metadata.name:
+                op.kube.delete("Pod", p.name, namespace=p.metadata.namespace)
+        drive(op, clock)
+        pod = op.kube.get("Pod", vol_pod.metadata.name, namespace="default")
+        assert pod.node_name, "volume pod lost its node"
+        node = op.kube.get("Node", pod.node_name)
+        assert node.metadata.labels[L.ZONE] == pv_zone, \
+            "pod consolidated away from its volume's zone"
+
     def test_budget_gates_consolidation(self, op, clock):
         """a zero budget scoped to underutilized blocks consolidation."""
         mk_cluster(op, disruption=Disruption(budgets=[

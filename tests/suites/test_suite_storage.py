@@ -126,6 +126,46 @@ class TestDistinctVolumeZones:
         assert zb.metadata.labels[L.ZONE] == "us-west-2b"
 
 
+class TestInstanceStorePolicy:
+    def test_raid0_rides_into_userdata_and_capacity(self, op):
+        """instanceStorePolicy: RAID0 — local NVMe pooled as ephemeral
+        storage (types.go:343-345) and surfaced to the node bootstrap
+        (--local-disks raid0, eksbootstrap.go:79-81)."""
+        from karpenter_provider_aws_tpu.apis.objects import (EC2NodeClass,
+                                                             SelectorTerm)
+        nc = EC2NodeClass(
+            "raid0", instance_store_policy="RAID0",
+            ami_selector_terms=[SelectorTerm(alias="al2@latest")])
+        mk_cluster(op, nodeclass=nc)
+        p = make_pods(1, cpu="500m", memory="1Gi", prefix="nvme",
+                      node_selector={
+                          "karpenter.k8s.aws/instance-family": "m5d"})[0]
+        op.kube.create(p)
+        op.run_until_settled()
+        insts = op.ec2.describe_instances()
+        assert insts
+        ud = op.ec2.launch_templates[insts[0].launch_template_name].user_data
+        assert "--local-disks raid0" in ud
+        # ephemeral-storage reflects the pooled local disks
+        claim = op.kube.list("NodeClaim")[0]
+        info = op.ec2.by_name[insts[0].instance_type]
+        assert claim.capacity["ephemeral-storage"] >= info.local_nvme_bytes
+
+    def test_raid0_nodeadm_strategy(self, op):
+        from karpenter_provider_aws_tpu.apis.objects import EC2NodeClass
+        nc = EC2NodeClass("raid0-nodeadm", instance_store_policy="RAID0")
+        mk_cluster(op, nodeclass=nc)
+        p = make_pods(1, cpu="500m", memory="1Gi", prefix="nvme2",
+                      node_selector={
+                          "karpenter.k8s.aws/instance-family": "m6id"})[0]
+        op.kube.create(p)
+        op.run_until_settled()
+        insts = op.ec2.describe_instances()
+        assert insts
+        ud = op.ec2.launch_templates[insts[0].launch_template_name].user_data
+        assert "strategy: RAID0" in ud
+
+
 class TestVolumeLimits:
     def test_per_node_attachment_limits(self, op):
         """should run pods with dynamic persistent volumes while
