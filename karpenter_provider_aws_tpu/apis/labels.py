@@ -45,6 +45,10 @@ ARCH_ARM64 = "arm64"
 OS_LINUX = "linux"
 OS_WINDOWS = "windows"
 
+WINDOWS_BUILD = "node.kubernetes.io/windows-build"
+#: ami family -> windows build version (labels.go:89-90)
+WINDOWS_BUILDS = {"windows2019": "10.0.17763", "windows2022": "10.0.20348"}
+
 # --- AWS provider labels (pkg/apis/v1/labels.go:31-54) ---------------------
 _G = "karpenter.k8s.aws"
 INSTANCE_HYPERVISOR = f"{_G}/instance-hypervisor"
@@ -100,7 +104,7 @@ NUMERIC_LABELS = frozenset({
 #: leaves them undefined (the instance types define them).
 WELL_KNOWN_LABELS = frozenset({
     ARCH, OS, INSTANCE_TYPE, ZONE, REGION, CAPACITY_TYPE, NODEPOOL,
-    HOSTNAME, ZONE_ID,
+    HOSTNAME, ZONE_ID, WINDOWS_BUILD,
     INSTANCE_HYPERVISOR, INSTANCE_ENCRYPTION_IN_TRANSIT, INSTANCE_CATEGORY,
     INSTANCE_FAMILY, INSTANCE_GENERATION, INSTANCE_LOCAL_NVME, INSTANCE_SIZE,
     INSTANCE_CPU, INSTANCE_CPU_MANUFACTURER, INSTANCE_CPU_SUSTAINED_CLOCK,

@@ -198,6 +198,13 @@ class FakeEC2:
                 self.images[img.id] = img
                 self.ssm_parameters[_ssm_path(fam, arch)] = img.id
             t += 1000
+        for fam in ("windows2019", "windows2022"):  # amd64 only
+            img = FakeImage(id=_new_id("ami"), name=f"{fam}-amd64-v2024",
+                            arch="amd64", creation_date=t,
+                            ssm_alias=f"{fam}@latest/amd64")
+            self.images[img.id] = img
+            self.ssm_parameters[_ssm_path(fam, "amd64")] = img.id
+            t += 1000
 
     # -- catalog APIs ------------------------------------------------------
     def describe_instance_types(self) -> List[InstanceTypeInfo]:

@@ -80,8 +80,10 @@ class FakeKubelet:
             L.ZONE: inst.zone, L.ZONE_ID: inst.zone_id,
             L.CAPACITY_TYPE: inst.capacity_type,
             L.HOSTNAME: claim.name,
-            L.OS: L.OS_LINUX,
         })
+        # OS rides the claim's resolved requirements (windows families
+        # produce windows nodes); default linux
+        labels.setdefault(L.OS, L.OS_LINUX)
         if info is not None:
             from ..apis.resources import ATTACHABLE_VOLUMES
             from .catalog import ebs_attachment_limit

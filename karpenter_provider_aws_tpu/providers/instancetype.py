@@ -19,7 +19,7 @@ from typing import Dict, List, Mapping, Optional, Sequence, Set, Tuple
 
 from ..apis import labels as L
 from ..apis.objects import EC2NodeClass, KubeletConfiguration
-from ..apis.requirements import IN, Requirement, Requirements
+from ..apis.requirements import DOES_NOT_EXIST, IN, Requirement, Requirements
 from ..apis.resources import (ATTACHABLE_VOLUMES, AWS_EFA, AWS_NEURON,
                               AWS_POD_ENI, NVIDIA_GPU,
                               Resources, parse_quantity)
@@ -120,6 +120,11 @@ class InstanceTypeProvider:
         zone_filter = {z for z, _ in subnet_zones} if subnet_zones else None
         primary_ami = {a.get("arch", "amd64"): a["id"] for a in nodeclass.status_amis}
         out = InstanceTypes()
+        # windows runs on amd64 only (getOS, types.go:288-296): under a
+        # windows family, non-amd64 types must be unsatisfiable — dropping
+        # them entirely matches the reference's empty OS requirement
+        if nodeclass.ami_family in L.WINDOWS_BUILDS:
+            ami_archs &= {L.ARCH_AMD64}
         for info in self._raw:
             if info.arch not in ami_archs:
                 continue
@@ -163,21 +168,32 @@ class InstanceTypeProvider:
         overhead = self._overhead(info, nodeclass, capacity)
         return InstanceType(
             name=info.name,
-            requirements=self._requirements(info, offerings),
+            requirements=self._requirements(info, offerings,
+                                            nodeclass.ami_family),
             capacity=capacity,
             overhead=overhead,
             offerings=offerings,
         )
 
-    def _requirements(self, info: InstanceTypeInfo, offerings: Offerings) -> Requirements:
+    def _requirements(self, info: InstanceTypeInfo, offerings: Offerings,
+                      ami_family: str = "") -> Requirements:
         """The ~20-label requirement set (types.go:183-287)."""
         zones = sorted({o.zone for o in offerings})
         zone_ids = sorted({o.zone_id for o in offerings if o.zone_id})
         cts = sorted({o.capacity_type for o in offerings})
+        # OS follows the resolved AMI family: windows families produce
+        # windows nodes (getOS, types.go:288-296; non-amd64 types are
+        # dropped in _resolve_all since windows has no arm64 AMIs); the
+        # windows-build label pins the family's build (types.go:268-270)
+        windows = ami_family in L.WINDOWS_BUILDS
         reqs = [
             Requirement.new(L.INSTANCE_TYPE, IN, [info.name]),
             Requirement.new(L.ARCH, IN, [info.arch]),
-            Requirement.new(L.OS, IN, [L.OS_LINUX]),
+            Requirement.new(L.OS, IN,
+                            [L.OS_WINDOWS if windows else L.OS_LINUX]),
+            Requirement.new(L.WINDOWS_BUILD, IN,
+                            [L.WINDOWS_BUILDS[ami_family]]) if windows
+            else Requirement.new(L.WINDOWS_BUILD, DOES_NOT_EXIST),
             Requirement.new(L.ZONE, IN, zones),
             Requirement.new(L.ZONE_ID, IN, zone_ids),
             Requirement.new(L.CAPACITY_TYPE, IN, cts),
@@ -199,7 +215,6 @@ class InstanceTypeProvider:
         # Optional labels get explicit DoesNotExist when absent (the reference
         # seeds these so a pod requiring e.g. instance-gpu-name can never land
         # on a non-GPU type, types.go:183-287).
-        from ..apis.requirements import DOES_NOT_EXIST
         if info.hypervisor:
             reqs.append(Requirement.new(L.INSTANCE_HYPERVISOR, IN, [info.hypervisor]))
         else:

@@ -1,0 +1,71 @@
+"""EventBridge interruption-message parsing.
+
+Mirrors pkg/controllers/interruption/messages: five parsers keyed on the
+envelope's (source, detail-type) — spot interruption, rebalance
+recommendation, scheduled change (AWS Health), instance state change,
+and the noop fallback for everything else (messages/types.go:21-57,
+messages/{spotinterruption,rebalancerecommendation,scheduledchange,
+statechange,noop}/parser.go). ``parse_message`` takes the raw SQS body
+(JSON string) and yields normalized ``InterruptionMessage``s.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List
+
+from .pricing import InterruptionMessage
+
+#: instance states worth reacting to (statechange/parser.go:27)
+_ACCEPTED_STATES = {"stopping", "stopped", "shutting-down", "terminated"}
+
+
+def _instance_id_from_arn(arn: str) -> str:
+    """arn:aws:ec2:region:acct:instance/i-... -> i-...
+    (scheduledchange/model.go EC2InstanceIDs)."""
+    return arn.rsplit("/", 1)[-1] if "/" in arn else ""
+
+
+def parse_message(raw: str) -> List[InterruptionMessage]:
+    """One raw EventBridge envelope -> normalized messages (scheduled
+    changes may name several instances in `resources`; everything
+    unrecognized degrades to a single noop, never an error —
+    interruption/controller.go parseMessage)."""
+    try:
+        env = json.loads(raw)
+    except (json.JSONDecodeError, TypeError):
+        env = None
+    if not isinstance(env, dict):
+        return [InterruptionMessage(kind="noop", instance_id="",
+                                    detail=str(raw)[:200])]
+    source = env.get("source", "")
+    detail_type = env.get("detail-type", "")
+    detail = env.get("detail")
+    if not isinstance(detail, dict):
+        detail = {}
+
+    if source == "aws.ec2" and \
+            detail_type == "EC2 Spot Instance Interruption Warning":
+        return [InterruptionMessage(kind="spot_interruption",
+                                    instance_id=detail.get("instance-id", ""))]
+    if source == "aws.ec2" and \
+            detail_type == "EC2 Instance Rebalance Recommendation":
+        return [InterruptionMessage(kind="rebalance_recommendation",
+                                    instance_id=detail.get("instance-id", ""))]
+    if source == "aws.health" and detail_type == "AWS Health Event":
+        # only EC2 scheduled changes are actionable
+        # (scheduledchange/parser.go:25-40)
+        if detail.get("service") != "EC2" or \
+                detail.get("eventTypeCategory") != "scheduledChange":
+            return [InterruptionMessage(kind="noop", instance_id="")]
+        ids = [_instance_id_from_arn(r) for r in env.get("resources", ())]
+        return [InterruptionMessage(kind="scheduled_change", instance_id=i)
+                for i in ids if i] or \
+            [InterruptionMessage(kind="noop", instance_id="")]
+    if source == "aws.ec2" and \
+            detail_type == "EC2 Instance State-change Notification":
+        if str(detail.get("state", "")).lower() not in _ACCEPTED_STATES:
+            return [InterruptionMessage(kind="noop", instance_id="")]
+        return [InterruptionMessage(kind="state_change",
+                                    instance_id=detail.get("instance-id", ""))]
+    return [InterruptionMessage(kind="noop", instance_id="", detail=detail_type)]

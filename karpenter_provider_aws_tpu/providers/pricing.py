@@ -149,6 +149,15 @@ class SQSProvider:
             message.receipt = str(self._receipt)
             self._messages[message.receipt] = message
 
+    def send_raw(self, raw: str) -> None:
+        """Enqueue a raw EventBridge JSON body — what real SQS delivers.
+        Parsed through the messages parsers (one envelope may fan out to
+        several normalized messages, e.g. a multi-instance AWS Health
+        scheduled change)."""
+        from .interruption_messages import parse_message
+        for m in parse_message(raw):
+            self.send(m)
+
     def receive(self, max_messages: int = 10) -> List[InterruptionMessage]:
         with self._mu:
             out = []

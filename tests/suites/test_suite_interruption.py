@@ -26,6 +26,92 @@ def send_for(op, claim, kind):
         kind=kind, instance_id=claim.provider_id.split("/")[-1]))
 
 
+class TestMessageParsing:
+    """messages/ parser parity: raw EventBridge envelopes -> kinds
+    (messages/{spotinterruption,rebalancerecommendation,scheduledchange,
+    statechange,noop}/parser.go)."""
+
+    def _one(self, raw):
+        from karpenter_provider_aws_tpu.providers.interruption_messages \
+            import parse_message
+        return parse_message(raw)
+
+    def test_spot_interruption_envelope(self):
+        import json
+        msgs = self._one(json.dumps({
+            "source": "aws.ec2",
+            "detail-type": "EC2 Spot Instance Interruption Warning",
+            "detail": {"instance-id": "i-abc123"}}))
+        assert [(m.kind, m.instance_id) for m in msgs] == \
+            [("spot_interruption", "i-abc123")]
+
+    def test_rebalance_envelope(self):
+        import json
+        msgs = self._one(json.dumps({
+            "source": "aws.ec2",
+            "detail-type": "EC2 Instance Rebalance Recommendation",
+            "detail": {"instance-id": "i-reb"}}))
+        assert msgs[0].kind == "rebalance_recommendation"
+
+    def test_scheduled_change_multi_instance(self):
+        import json
+        msgs = self._one(json.dumps({
+            "source": "aws.health", "detail-type": "AWS Health Event",
+            "resources": [
+                "arn:aws:ec2:us-west-2:123:instance/i-one",
+                "arn:aws:ec2:us-west-2:123:instance/i-two"],
+            "detail": {"service": "EC2",
+                       "eventTypeCategory": "scheduledChange"}}))
+        assert [(m.kind, m.instance_id) for m in msgs] == [
+            ("scheduled_change", "i-one"), ("scheduled_change", "i-two")]
+
+    def test_health_event_for_other_service_is_noop(self):
+        import json
+        msgs = self._one(json.dumps({
+            "source": "aws.health", "detail-type": "AWS Health Event",
+            "detail": {"service": "S3",
+                       "eventTypeCategory": "scheduledChange"}}))
+        assert msgs[0].kind == "noop"
+
+    def test_state_change_accepted_states_only(self):
+        import json
+        for state, kind in (("stopping", "state_change"),
+                            ("terminated", "state_change"),
+                            ("running", "noop"), ("pending", "noop")):
+            msgs = self._one(json.dumps({
+                "source": "aws.ec2",
+                "detail-type": "EC2 Instance State-change Notification",
+                "detail": {"instance-id": "i-s", "state": state}}))
+            assert msgs[0].kind == kind, state
+
+    def test_garbage_is_noop_never_error(self):
+        assert self._one("not json at all")[0].kind == "noop"
+        assert self._one('{"source": "custom.app"}')[0].kind == "noop"
+        # valid JSON that isn't an object, and non-dict detail payloads
+        assert self._one("[1, 2]")[0].kind == "noop"
+        assert self._one('"just a string"')[0].kind == "noop"
+        assert self._one('5')[0].kind == "noop"
+        # a non-dict detail degrades to empty detail, not a crash
+        msgs = self._one(
+            '{"source": "aws.ec2", "detail-type": '
+            '"EC2 Spot Instance Interruption Warning", "detail": "oops"}')
+        assert msgs[0].kind == "spot_interruption" \
+            and msgs[0].instance_id == ""
+
+    def test_raw_envelope_through_the_queue(self, op):
+        """send_raw -> controller cordons exactly like a typed message."""
+        import json
+        claims = provision_spot(op)
+        victim = claims[0]
+        op.sqs.send_raw(json.dumps({
+            "source": "aws.ec2",
+            "detail-type": "EC2 Spot Instance Interruption Warning",
+            "detail": {
+                "instance-id": victim.provider_id.split("/")[-1]}}))
+        stats = op.interruption.reconcile()
+        assert stats["cordoned"] == 1
+
+
 class TestInterruptionKinds:
     @pytest.mark.parametrize("kind", [
         "spot_interruption", "rebalance_recommendation",

@@ -107,6 +107,67 @@ class TestWellKnownLabels:
             assert 30 < cat[i.instance_type].vcpus < 50
 
 
+class TestWindows:
+    def test_windows_node_provisioning(self, op):
+        """should support well-known labels for windows-build version:
+        a windows2022 NodeClass produces windows/amd64 nodes carrying the
+        build label (types.go:268-270,288-296)."""
+        from karpenter_provider_aws_tpu.apis.objects import (EC2NodeClass,
+                                                             SelectorTerm)
+        nc = EC2NodeClass("win", ami_selector_terms=[
+            SelectorTerm(alias="windows2022@latest")])
+        mk_cluster(op, nodeclass=nc)
+        p = make_pods(1, cpu="1", memory="2Gi", prefix="win",
+                      node_selector={
+                          L.OS: "windows",
+                          "node.kubernetes.io/windows-build": "10.0.20348"})[0]
+        op.kube.create(p)
+        op.run_until_settled()
+        insts = op.ec2.describe_instances()
+        assert insts
+        cat = op.ec2.by_name
+        assert all(cat[i.instance_type].arch == "amd64" for i in insts)
+        node = op.kube.list("Node")[0]
+        assert node.metadata.labels[L.OS] == "windows"
+        assert node.metadata.labels[
+            "node.kubernetes.io/windows-build"] == "10.0.20348"
+        # windows bootstrap userdata (PS1)
+        ud = op.ec2.launch_templates[insts[0].launch_template_name].user_data
+        assert "powershell" in ud.lower() or "<powershell>" in ud.lower()
+
+    def test_arm64_pod_unschedulable_on_windows_pool(self, op):
+        """windows has no arm64 AMIs: an arch=arm64 pod against a windows
+        NodePool is cleanly unschedulable — never a launch/fail/reap churn
+        loop (getOS, types.go:288-296)."""
+        from karpenter_provider_aws_tpu.apis.objects import (EC2NodeClass,
+                                                             SelectorTerm)
+        nc = EC2NodeClass("win-arm", ami_selector_terms=[
+            SelectorTerm(alias="windows2022@latest")])
+        mk_cluster(op, nodeclass=nc)
+        p = make_pods(1, cpu="500m", memory="1Gi", prefix="arm",
+                      node_selector={L.ARCH: "arm64"})[0]
+        op.kube.create(p)
+        op.run_until_settled()
+        assert op.ec2.describe_instances() == []
+        assert op.kube.list("NodeClaim") == []
+        assert not op.kube.list("Pod")[0].node_name
+
+    def test_linux_pod_never_lands_on_windows_pool(self, op):
+        """an os=linux pod is unschedulable against a windows-only
+        NodePool."""
+        from karpenter_provider_aws_tpu.apis.objects import (EC2NodeClass,
+                                                             SelectorTerm)
+        nc = EC2NodeClass("win-only", ami_selector_terms=[
+            SelectorTerm(alias="windows2019@latest")])
+        mk_cluster(op, nodeclass=nc)
+        p = make_pods(1, cpu="500m", memory="1Gi", prefix="lin",
+                      node_selector={L.OS: "linux"})[0]
+        op.kube.create(p)
+        op.run_until_settled()
+        assert op.kube.list("Node") == []
+        assert not op.kube.list("Pod")[0].node_name
+
+
 class TestPropagation:
     def test_node_annotations_and_labels(self, op, ec2):
         """should apply annotations/labels from the NodePool template to

@@ -67,7 +67,8 @@ class TestFakeEC2:
 
     def test_images_and_ssm(self, ec2):
         amis = ec2.describe_images()
-        assert len(amis) == 6
+        # 3 linux families x 2 arches + 2 windows families (amd64 only)
+        assert len(amis) == 8
         img_id = ec2.ssm_get_parameter("/aws/service/al2023/amd64/latest/image_id")
         assert any(i.id == img_id and i.arch == "amd64" for i in amis)
 
