@@ -105,7 +105,12 @@ class InstanceTypeProvider:
             key = (self.instance_types_seqnum, self.offerings_seqnum,
                    getattr(self.unavailable_offerings, "seqnum", 0),
                    amis, subnet_zones, _kubelet_key(nodeclass.kubelet),
-                   _storage_key(nodeclass))
+                   _storage_key(nodeclass),
+                   # resolution depends on the AMI family (OS/windows-build
+                   # requirements, windows amd64-only filtering) — two
+                   # same-shaped nodeclasses of different families must
+                   # never share an entry
+                   nodeclass.ami_family)
             cached = self._cache.get(key)
             if cached is not None:
                 return cached

@@ -58,7 +58,11 @@ def parse_message(raw: str) -> List[InterruptionMessage]:
         if detail.get("service") != "EC2" or \
                 detail.get("eventTypeCategory") != "scheduledChange":
             return [InterruptionMessage(kind="noop", instance_id="")]
-        ids = [_instance_id_from_arn(r) for r in env.get("resources", ())]
+        resources = env.get("resources")
+        if not isinstance(resources, (list, tuple)):
+            resources = ()
+        ids = [_instance_id_from_arn(r) for r in resources
+               if isinstance(r, str)]
         return [InterruptionMessage(kind="scheduled_change", instance_id=i)
                 for i in ids if i] or \
             [InterruptionMessage(kind="noop", instance_id="")]

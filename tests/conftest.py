@@ -35,3 +35,27 @@ def pytest_collection_modifyitems(config, items):
     seed = os.environ.get("KARPENTER_TEST_SHUFFLE_SEED")
     if seed:
         random.Random(seed).shuffle(items)
+
+
+# --- E2E duration telemetry (test/pkg/environment/aws/metrics.go:49-115) ---
+# The reference emits per-test provisioning/deprovisioning wall-clock to
+# AWS Timestream for dashboards; the analog records suite durations to a
+# JSON artifact when KARPENTER_E2E_TELEMETRY points at a path.
+_durations = []
+
+
+def pytest_runtest_logreport(report):
+    import os
+    if os.environ.get("KARPENTER_E2E_TELEMETRY") and report.when == "call":
+        _durations.append({"test": report.nodeid,
+                           "outcome": report.outcome,
+                           "duration_s": round(report.duration, 3)})
+
+
+def pytest_sessionfinish(session, exitstatus):
+    import json
+    import os
+    path = os.environ.get("KARPENTER_E2E_TELEMETRY")
+    if path and _durations:
+        with open(path, "w") as f:
+            json.dump({"durations": _durations}, f, indent=1)

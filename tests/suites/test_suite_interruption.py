@@ -91,6 +91,14 @@ class TestMessageParsing:
         assert self._one("[1, 2]")[0].kind == "noop"
         assert self._one('"just a string"')[0].kind == "noop"
         assert self._one('5')[0].kind == "noop"
+        # malformed resources arrays in health events degrade, not crash
+        import json as _json
+        assert self._one(_json.dumps({
+            "source": "aws.health", "detail-type": "AWS Health Event",
+            "resources": [123, None],
+            "detail": {"service": "EC2",
+                       "eventTypeCategory": "scheduledChange"}}))[0].kind \
+            == "noop"
         # a non-dict detail degrades to empty detail, not a crash
         msgs = self._one(
             '{"source": "aws.ec2", "detail-type": '

@@ -152,6 +152,21 @@ class TestWindows:
         assert op.kube.list("NodeClaim") == []
         assert not op.kube.list("Pod")[0].node_name
 
+    def test_family_resolution_never_shares_cache(self, op):
+        """two same-shaped NodeClasses of different AMI families resolve
+        independently — the catalog cache keys on the family (a linux
+        entry must never be served to a windows NodeClass)."""
+        from karpenter_provider_aws_tpu.apis.objects import (EC2NodeClass,
+                                                             SelectorTerm)
+        linux = EC2NodeClass("lin-c")
+        windows = EC2NodeClass("win-c", ami_selector_terms=[
+            SelectorTerm(alias="windows2022@latest")])
+        lt = op.instance_types.list(linux)
+        wt = op.instance_types.list(windows)
+        assert any(t.requirements.get(L.OS).has("linux") for t in lt)
+        assert all(t.requirements.get(L.OS).has("windows") for t in wt)
+        assert all(not t.requirements.get(L.OS).has("windows") for t in lt)
+
     def test_linux_pod_never_lands_on_windows_pool(self, op):
         """an os=linux pod is unschedulable against a windows-only
         NodePool."""
