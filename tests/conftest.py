@@ -46,8 +46,14 @@ _durations = []
 
 def pytest_runtest_logreport(report):
     import os
-    if os.environ.get("KARPENTER_E2E_TELEMETRY") and report.when == "call":
+    if not os.environ.get("KARPENTER_E2E_TELEMETRY"):
+        return
+    # the call phase carries the real outcome; setup-phase skips and
+    # fixture errors would otherwise vanish from the artifact
+    if report.when == "call" or \
+            (report.when == "setup" and report.outcome != "passed"):
         _durations.append({"test": report.nodeid,
+                           "phase": report.when,
                            "outcome": report.outcome,
                            "duration_s": round(report.duration, 3)})
 

@@ -89,6 +89,25 @@ class TestSidecar:
         assert r.decision_fingerprint() == local.solve(snap).decision_fingerprint()
         assert r.decision_fingerprint() == oracle.solve(snap).decision_fingerprint()
 
+    def test_volume_constrained_pods_identical(self, server, env):
+        """volume topology resolves CLIENT-side (before the packed-buffer
+        dispatch), so zone-pinned + attachment-slot-consuming pods solve
+        identically through the sidecar."""
+        from karpenter_provider_aws_tpu.apis.requirements import (
+            IN, Requirement, Requirements)
+        pods = make_pods(40, cpu="500m", memory="1Gi", prefix="vol")
+        for i, p in enumerate(pods):
+            p.apply_volume_constraints(
+                Requirements([Requirement.new(
+                    L.ZONE, IN, ["us-west-2a" if i % 2 else "us-west-2b"])]),
+                n_volumes=1)
+        snap = env.snapshot(pods, [env.nodepool("side3")])
+        remote = RemoteSolver(server.address, n_max=192)
+        r = remote.solve(snap)
+        assert r.decision_fingerprint() == \
+            CPUSolver().solve(snap).decision_fingerprint()
+        assert not r.unschedulable
+
     def test_stateless_across_requests(self, server, env):
         remote = RemoteSolver(server.address, n_max=192)
         for n in (5, 25, 5):
