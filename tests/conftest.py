@@ -48,10 +48,11 @@ def pytest_runtest_logreport(report):
     import os
     if not os.environ.get("KARPENTER_E2E_TELEMETRY"):
         return
-    # the call phase carries the real outcome; setup-phase skips and
-    # fixture errors would otherwise vanish from the artifact
+    # the call phase carries the real outcome; setup/teardown-phase
+    # skips and fixture errors would otherwise vanish from the artifact
     if report.when == "call" or \
-            (report.when == "setup" and report.outcome != "passed"):
+            (report.when in ("setup", "teardown")
+             and report.outcome != "passed"):
         _durations.append({"test": report.nodeid,
                            "phase": report.when,
                            "outcome": report.outcome,

@@ -1,0 +1,118 @@
+"""Debug transition watchers (test/pkg/debug analog) + leaked-resource
+sweeper (test/hack/resource analog)."""
+
+import sys
+
+import pytest
+
+from karpenter_provider_aws_tpu.apis.objects import (EC2NodeClass,
+                                                     NodeClassRef, NodePool,
+                                                     NodePoolTemplate)
+from karpenter_provider_aws_tpu.fake.environment import make_pods
+from karpenter_provider_aws_tpu.operator import Operator
+from karpenter_provider_aws_tpu.utils.debug import attach
+
+sys.path.insert(0, ".")
+from hack.sweeper import sweep  # noqa: E402
+
+
+def mk(op):
+    op.kube.create(EC2NodeClass("dbg-class"))
+    op.kube.create(NodePool("default", template=NodePoolTemplate(
+        node_class_ref=NodeClassRef("dbg-class"))))
+
+
+class TestTransitionWatcher:
+    def test_logs_full_lifecycle(self):
+        op = Operator()
+        mk(op)
+        watcher = attach(op.kube)
+        for p in make_pods(1, cpu="500m", memory="1Gi", prefix="dbg"):
+            op.kube.create(p)
+        op.run_until_settled()
+        watcher.drain()
+        joined = "\n".join(watcher.transitions)
+        # the whole chain is visible: pod pending -> claim launched ->
+        # registered -> initialized -> node ready -> pod running
+        assert "Pod/default/dbg" in joined
+        assert "launched:False->True" in joined
+        assert "registered:False->True" in joined
+        assert "initialized:False->True" in joined
+        assert "phase:Pending->Running" in joined
+        assert any(line.startswith("Node/") and "ready:None->True" in line
+                   for line in watcher.transitions)
+
+    def test_resync_noise_suppressed(self):
+        op = Operator()
+        mk(op)
+        for p in make_pods(1, cpu="500m", memory="1Gi", prefix="quiet"):
+            op.kube.create(p)
+        op.run_until_settled()
+        watcher = attach(op.kube)   # attaches AFTER steady state
+        watcher.drain()             # initial-list replay -> baselines
+        base = len(watcher.transitions)
+        op.run_until_settled()      # no-op reconciles re-update objects
+        watcher.drain()
+        # steady-state updates that change nothing are not transitions
+        assert len(watcher.transitions) == base
+
+    def test_deletion_logged(self):
+        op = Operator()
+        mk(op)
+        for p in make_pods(1, cpu="500m", memory="1Gi", prefix="del"):
+            op.kube.create(p)
+        op.run_until_settled()
+        watcher = attach(op.kube)
+        watcher.drain()
+        claim = op.kube.list("NodeClaim")[0]
+        op.kube.delete("NodeClaim", claim.name)
+        op.run_until_settled()
+        watcher.drain()
+        assert any(ln == f"NodeClaim//{claim.name} DELETED"
+                   for ln in watcher.transitions)
+
+
+class TestSweeper:
+    def test_orphan_instance_swept_after_grace(self):
+        op = Operator()
+        mk(op)
+        for p in make_pods(2, cpu="500m", memory="1Gi", prefix="sw"):
+            op.kube.create(p)
+        op.run_until_settled()
+        victim = op.kube.list("NodeClaim")[0]
+        inst_id = victim.provider_id.split("/")[-1]
+        op.kube.remove_finalizer(victim, "karpenter.sh/termination")
+        op.kube.delete("NodeClaim", victim.name)
+        # within grace: untouched
+        assert sweep(op)["instances"] == []
+        op.ec2.instances[inst_id].launch_time -= 120
+        reaped = sweep(op)
+        assert reaped["instances"] == [inst_id]
+        assert op.ec2.instances[inst_id].state == "terminated"
+
+    def test_launch_templates_of_deleted_nodeclass_swept(self):
+        op = Operator()
+        mk(op)
+        for p in make_pods(1, cpu="500m", memory="1Gi", prefix="lt"):
+            op.kube.create(p)
+        op.run_until_settled()
+        assert op.ec2.describe_launch_templates()
+        # nodeclass vanishes without the deletion flow (leak scenario:
+        # finalizer force-removed, e.g. a kubectl patch during an outage)
+        nc = op.kube.get("EC2NodeClass", "dbg-class")
+        op.kube.remove_finalizer(nc, "karpenter.k8s.aws/termination")
+        if op.kube.try_get("EC2NodeClass", "dbg-class"):
+            op.kube.delete("EC2NodeClass", "dbg-class")
+        reaped = sweep(op)
+        assert reaped["launch_templates"]
+        assert not [lt for lt in op.ec2.describe_launch_templates()
+                    if "/dbg-class/" in lt.name]
+
+    def test_healthy_cluster_untouched(self):
+        op = Operator()
+        mk(op)
+        for p in make_pods(2, cpu="500m", memory="1Gi", prefix="ok"):
+            op.kube.create(p)
+        op.run_until_settled()
+        reaped = sweep(op)
+        assert reaped == {"instances": [], "launch_templates": []}
