@@ -16,10 +16,6 @@ operator: python hack/sweeper.py
 """
 
 import argparse
-import sys
-import time
-
-sys.path.insert(0, ".")
 
 GRACE_SECONDS = 30.0
 
@@ -55,6 +51,10 @@ def sweep(op, grace: float = GRACE_SECONDS, now=None) -> dict:
 
 
 def main():
+    import os
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
     ap = argparse.ArgumentParser()
     ap.add_argument("--grace", type=float, default=GRACE_SECONDS)
     args = ap.parse_args()

@@ -139,11 +139,12 @@ class Daemon:
         if op.options.interruption_queue:
             reg("interruption", op.interruption.reconcile, INTERRUPTION_POLL)
         # debug transition watchers (test/pkg/debug analog): only when the
-        # log level asks for them — each drain logs node/claim/pod deltas
+        # log level asks for them. Observation is eager (the watcher logs
+        # at event time through the kube watch hook) — attaching is all
+        # that's needed; keep a reference so it lives with the daemon
         if logging.getLogger().isEnabledFor(logging.DEBUG):
             from .utils.debug import attach
-            watcher = attach(op.kube)
-            reg("debug.transitions", watcher.drain, FAST_LOOP)
+            self._debug_watcher = attach(op.kube)
 
     # ------------------------------------------------------------------
     def healthy(self) -> bool:

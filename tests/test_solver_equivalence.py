@@ -211,6 +211,80 @@ class TestRandomized:
         assert res.unschedulable  # limit guarantees leftovers
 
 
+class TestVolumeFuzz:
+    """Seeded fuzzing with volume-topology constraints mixed in: zone
+    pins + EBS attachment slots must stay decision-identical across
+    engines (the volume dims ride effective_requests and the group
+    signature)."""
+
+    @pytest.mark.parametrize("seed", range(8))
+    def test_random_volume_scenarios(self, env, solvers, seed):
+        rng = random.Random(7000 + seed)
+        from karpenter_provider_aws_tpu.apis.requirements import (
+            IN, Requirement, Requirements)
+        pools = [env.nodepool(f"vol-{seed}")]
+        pods = []
+        zones = [z.name for z in env.ec2.zones]
+        for j in range(rng.randint(1, 4)):
+            batch = make_pods(
+                rng.randint(1, 40),
+                cpu=rng.choice(["100m", "250m", "1", "2"]),
+                memory=rng.choice(["256Mi", "1Gi", "4Gi"]),
+                prefix=f"v{seed}-{j}")
+            style = rng.random()
+            for p in batch:
+                if style < 0.4:
+                    # bound zonal PV: hard zone pin + one attachment
+                    p.apply_volume_constraints(Requirements([
+                        Requirement.new(L.ZONE, IN, [rng.choice(zones)])]),
+                        n_volumes=rng.randint(1, 3))
+                elif style < 0.6:
+                    # WaitForFirstConsumer: slots only, no pin
+                    p.apply_volume_constraints(Requirements([]),
+                                               n_volumes=rng.randint(1, 2))
+            pods += batch
+        snap = env.snapshot(pods, pools)
+        assert_equivalent(snap, solvers)
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_volumes_with_topology_spread(self, env, solvers, seed):
+        """zone-pinned volumes + zone spread in one solve: the pour must
+        respect both; engines must agree exactly."""
+        rng = random.Random(8500 + seed)
+        from karpenter_provider_aws_tpu.apis.objects import \
+            TopologySpreadConstraint
+        from karpenter_provider_aws_tpu.apis.requirements import (
+            IN, Requirement, Requirements)
+        zones = [z.name for z in env.ec2.zones]
+        spread = make_pods(
+            rng.randint(6, 24), cpu="500m", memory="1Gi",
+            prefix=f"sv{seed}", group=f"sv{seed}",
+            topology_spread=[TopologySpreadConstraint(
+                max_skew=1, topology_key=L.ZONE,
+                when_unsatisfiable="DoNotSchedule", group=f"sv{seed}")])
+        pinned = make_pods(rng.randint(2, 8), cpu="1", memory="2Gi",
+                           prefix=f"pv{seed}")
+        for p in pinned:
+            p.apply_volume_constraints(Requirements([
+                Requirement.new(L.ZONE, IN, [rng.choice(zones)])]),
+                n_volumes=1)
+        snap = env.snapshot(spread + pinned, [env.nodepool(f"mix-{seed}")])
+        assert_equivalent(snap, solvers)
+
+    def test_attachment_pressure_forces_split(self, env, solvers):
+        """tiny pods with volumes: the attachment limit (not cpu/mem) is
+        the binding constraint; engines must agree on the split."""
+        from karpenter_provider_aws_tpu.apis.requirements import Requirements
+        pods = make_pods(60, cpu="50m", memory="64Mi", prefix="att")
+        for p in pods:
+            p.apply_volume_constraints(Requirements([]), n_volumes=2)
+        pool = env.nodepool("att-pool", requirements=[
+            {"key": L.INSTANCE_FAMILY, "operator": "In", "values": ["m6i"]}])
+        res = assert_equivalent(env.snapshot(pods, [pool]), solvers)
+        # 120 attachments can't fit one nitro node's 27 slots
+        assert len(res.new_nodes) >= 2
+
+
 class TestPackedBuffers:
     """The single-buffer device round trip (ops/ffd_jax.py packed path)."""
 
