@@ -226,10 +226,20 @@ class DisruptionController:
             if prev is None or prev[0] != pod_set:
                 self._pod_epoch[claim.name] = (pod_set, now)
             blocked = ""
-            for p in pods:
-                if p.metadata.annotations.get(DO_NOT_DISRUPT_ANNOTATION) == "true":
-                    blocked = f"pod {p.full_name()} has do-not-disrupt"
-                    break
+            # the annotation blocks disruption at every level: node,
+            # claim, or any resident pod (core candidate filtering)
+            if node.metadata.annotations.get(
+                    DO_NOT_DISRUPT_ANNOTATION) == "true":
+                blocked = f"node {node.name} has do-not-disrupt"
+            elif claim.metadata.annotations.get(
+                    DO_NOT_DISRUPT_ANNOTATION) == "true":
+                blocked = f"nodeclaim {claim.name} has do-not-disrupt"
+            else:
+                for p in pods:
+                    if p.metadata.annotations.get(
+                            DO_NOT_DISRUPT_ANNOTATION) == "true":
+                        blocked = f"pod {p.full_name()} has do-not-disrupt"
+                        break
             itype = claim.metadata.labels.get(L.INSTANCE_TYPE, "")
             ct = claim.metadata.labels.get(L.CAPACITY_TYPE, "")
             zone = claim.metadata.labels.get(L.ZONE, "")

@@ -31,17 +31,22 @@ from ..apis.objects import Pod, PodAffinityTerm, TopologySpreadConstraint
 from .types import SchedulingSnapshot, SolveResult
 
 
+#: per-pod memo key for preference_count; shared with the inlined fast
+#: path in solve_with_preferences (invalidate_scheduling_caches pops it)
+PREF_COUNT_MEMO = "_pref_count"
+
+
 def preference_count(pod: Pod) -> int:
     """Length of the pod's preference chain (0 = nothing to relax).
     Memoized per pod — the sweep runs over every pod on every solve and
     dominates steady-state rounds at 50k pods otherwise
     (invalidate_scheduling_caches clears the memo)."""
-    n = pod.__dict__.get("_pref_count")
+    n = pod.__dict__.get(PREF_COUNT_MEMO)
     if n is None:
         n = sum(1 for a in pod.pod_affinity if not a.required) \
             + sum(1 for c in pod.topology_spread
                   if c.when_unsatisfiable != "DoNotSchedule")
-        pod.__dict__["_pref_count"] = n
+        pod.__dict__[PREF_COUNT_MEMO] = n
     return n
 
 
@@ -93,7 +98,7 @@ def solve_with_preferences(
         # inlined preference_count fast path: this sweep touches every
         # pod every solve — at 50k pods the call overhead alone is
         # measurable on the p50
-        n = p.__dict__.get("_pref_count")
+        n = p.__dict__.get(PREF_COUNT_MEMO)
         if n is None:
             n = preference_count(p)
         if n:

@@ -105,6 +105,31 @@ class TestDoNotDisrupt:
         assert {n.name for n in op.kube.list("Node")} == nodes_before
 
 
+class TestNodeLevelDoNotDisrupt:
+    def test_node_annotation_pins_node(self, op, clock):
+        """karpenter.sh/do-not-disrupt on the NODE (not just pods) blocks
+        voluntary disruption (core candidate filtering)."""
+        n = empty_node_cluster(op, clock)
+        for node in op.kube.list("Node"):
+            node.metadata.annotations["karpenter.sh/do-not-disrupt"] = "true"
+            op.kube.update(node)
+        for _ in range(5):
+            op.run_until_settled()
+            clock.advance(120)
+        assert len(op.kube.list("Node")) == n  # empty but pinned
+
+    def test_claim_annotation_pins_node(self, op, clock):
+        n = empty_node_cluster(op, clock)
+        for claim in op.kube.list("NodeClaim"):
+            claim.metadata.annotations["karpenter.sh/do-not-disrupt"] = \
+                "true"
+            op.kube.update(claim)
+        for _ in range(5):
+            op.run_until_settled()
+            clock.advance(120)
+        assert len(op.kube.list("Node")) == n
+
+
 class TestNodeDeletion:
     def test_terminate_node_and_instance_on_deletion(self, op):
         """should terminate the node and the instance on deletion; pods
