@@ -144,6 +144,11 @@ class PodAffinityTerm:
     required: bool = True
 
 
+#: per-pod memo key for the preference-chain length; owned here (the
+#: apis layer) so solver/preferences.py can import it without a cycle
+PREF_COUNT_MEMO = "_pref_count"
+
+
 def invalidate_scheduling_caches(pod: "Pod") -> None:
     """Drop every memo derived from a pod's scheduling constraints.
     THE authoritative attribute list — both constraint-mutation sites
@@ -152,7 +157,7 @@ def invalidate_scheduling_caches(pod: "Pod") -> None:
     pod.__dict__.pop("_reqs_cache", None)
     pod.__dict__.pop("_eff_requests", None)
     for stale in ("_sig_id", "_sig_cache", "_sig_digest", "_hardened",
-                  "_pref_count"):
+                  PREF_COUNT_MEMO):
         pod.__dict__.pop(stale, None)
 
 

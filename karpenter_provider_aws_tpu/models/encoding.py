@@ -310,7 +310,8 @@ class _CatalogEncoding:
     universe: LabelUniverse
     types: List[InstanceType]
     type_names: List[str]
-    type_pos: Dict[str, int]
+    #: id(resolved InstanceType) -> column (names repeat across variants)
+    type_pos: Dict[int, int]
     type_val: np.ndarray
     A: np.ndarray
     avail: np.ndarray
@@ -324,7 +325,7 @@ _CATALOG_CACHE_CAP = 8
 _CATALOG_MU = threading.Lock()
 
 
-def _encode_catalog(seen: Dict[str, InstanceType],
+def _encode_catalog(seen: Dict[Tuple[str, int], InstanceType],
                     snapshot_zones: Tuple[Tuple[str, str], ...],
                     dims: Tuple[str, ...]) -> _CatalogEncoding:
     types = [seen[k] for k in sorted(seen)]
@@ -363,7 +364,7 @@ def _encode_catalog(seen: Dict[str, InstanceType],
     enc = _CatalogEncoding(
         universe=universe, types=types,
         type_names=[t.name for t in types],
-        type_pos={t.name: i for i, t in enumerate(types)},
+        type_pos={id(t): i for i, t in enumerate(types)},
         type_val=type_val, A=A, avail=avail, price=price,
         zones=zones, zid_of=zid_of)
     with _CATALOG_MU:
@@ -383,10 +384,24 @@ def encode_snapshot(snapshot: SchedulingSnapshot) -> SnapshotEncoding:
                                requests=rep.effective_requests()))
 
     # --- union catalog --------------------------------------------------
-    seen: Dict[str, InstanceType] = {}
+    # Dedup by RESOLVED OBJECT, not by name: the same type name resolves
+    # differently under different NodeClasses (windows vs linux OS
+    # labels, kubelet-dependent allocatable), and a name-keyed union lets
+    # one pool's variant poison another's requirements/capacity. Pools
+    # sharing a NodeClass share the provider's cached objects, so the
+    # common case still dedups to one column. Variant indices follow
+    # first-seen order (snapshot pool order) — deterministic.
+    seen: Dict[Tuple[str, int], InstanceType] = {}
+    seen_ids: Set[int] = set()
+    _variant_count: Dict[str, int] = {}
     for spec in snapshot.nodepools:
         for t in spec.instance_types:
-            seen.setdefault(t.name, t)
+            if id(t) in seen_ids:
+                continue
+            v = _variant_count.get(t.name, 0)
+            _variant_count[t.name] = v + 1
+            seen[(t.name, v)] = t
+            seen_ids.add(id(t))
 
     # --- dims -----------------------------------------------------------
     dims_set = {"cpu", "memory", "pods"}
@@ -441,7 +456,7 @@ def encode_snapshot(snapshot: SchedulingSnapshot) -> SnapshotEncoding:
     for pi, spec in enumerate(ordered_specs):
         rows = np.zeros(T, dtype=bool)
         for t in spec.instance_types:
-            rows[type_pos[t.name]] = True
+            rows[type_pos[id(t)]] = True
         preqs = spec.nodepool.scheduling_requirements()
         # the pool's own label requirements restrict the type axis, exactly
         # like the oracle's merged-requirement conflict check does

@@ -57,7 +57,19 @@ class TPUConsolidationEvaluator(ConsolidationEvaluator):
         if self.backend == "numpy":
             return host_fn()
         if self.backend == "jax":
-            return dev_fn()
+            # same wedged-link discipline as TPUSolver's explicit-jax
+            # path: nonblocking verdict, host twin while unusable
+            from .route import dev_engine_usable
+            if dev_engine_usable(self._router):
+                return dev_fn()
+            import logging
+            logging.getLogger(__name__).warning(
+                "dev engine unavailable; consolidation batch on the "
+                "host twin")
+            if self.metrics is not None:
+                self.metrics.inc("karpenter_solver_device_fallback_total",
+                                 labels={"reason": "device_unavailable"})
+            return host_fn()
         self._router.metrics = self.metrics
         return routed(self._router, bucket, host_fn, dev_fn)
 

@@ -31,9 +31,10 @@ from ..apis.objects import Pod, PodAffinityTerm, TopologySpreadConstraint
 from .types import SchedulingSnapshot, SolveResult
 
 
-#: per-pod memo key for preference_count; shared with the inlined fast
-#: path in solve_with_preferences (invalidate_scheduling_caches pops it)
-PREF_COUNT_MEMO = "_pref_count"
+#: per-pod memo key for preference_count; the apis layer owns it so the
+#: invalidator (invalidate_scheduling_caches) and both lookup sites here
+#: can never silently disagree
+from ..apis.objects import PREF_COUNT_MEMO  # noqa: E402
 
 
 def preference_count(pod: Pod) -> int:

@@ -174,6 +174,18 @@ def device_alive_nonblocking() -> Optional[bool]:
     return _device_alive.nonblocking()
 
 
+def dev_engine_usable(router: Router) -> bool:
+    """Nonblocking liveness verdict for a router's dev engine — its own
+    alive cache when set (RemoteSolver pings its sidecar), else the
+    shared local-device probe. A pending probe (None) counts as not
+    usable: callers fall back to the bit-identical host twin for this
+    solve and the background probe resolves for later ones — an explicit
+    device request must never HANG on a wedged link (first array
+    creation blocks forever, no error)."""
+    cache = router.alive if router.alive is not None else _device_alive
+    return cache.nonblocking() is True
+
+
 def routed(router: Router, bucket: Tuple,
            host_fn: Callable[[], object],
            dev_fn: Callable[[], object]):

@@ -104,7 +104,25 @@ class TPUSolver(Solver):
             return self._decode(enc, existing, takes, leftover, final)
         ex_alloc, ex_used, ex_compat = self._encode_existing(enc, existing)
         if self.backend == "jax":
-            takes, leftover, final = self._run_jax(enc, ex_alloc, ex_used, ex_compat)
+            # explicit device requests still go through the NONBLOCKING
+            # liveness verdict (route.dev_engine_usable): a wedged link
+            # or an in-flight probe falls back to the bit-identical host
+            # twin for this solve — never a hang, never silent
+            from .route import dev_engine_usable
+            if dev_engine_usable(self._router):
+                takes, leftover, final = self._run_jax(
+                    enc, ex_alloc, ex_used, ex_compat)
+            else:
+                import logging
+                logging.getLogger(__name__).warning(
+                    "dev engine unavailable (probe pending or link "
+                    "dead); solving on the host twin")
+                if self.metrics is not None:
+                    self.metrics.inc(
+                        "karpenter_solver_device_fallback_total",
+                        labels={"reason": "device_unavailable"})
+                takes, leftover, final = self._run_numpy(
+                    enc, ex_alloc, ex_used, ex_compat)
         elif self.backend == "numpy":
             takes, leftover, final = self._run_numpy(enc, ex_alloc, ex_used, ex_compat)
         else:  # auto: route host twin vs device kernel by measured cost

@@ -285,6 +285,31 @@ class TestVolumeFuzz:
         assert len(res.new_nodes) >= 2
 
 
+class TestWindowsEquivalence:
+    """windows pools exercise the OS / windows-build label paths through
+    the tensor encoding; engines must agree."""
+
+    def test_mixed_windows_linux_pools(self, env, solvers):
+        from karpenter_provider_aws_tpu.apis.objects import (EC2NodeClass,
+                                                             SelectorTerm)
+        win_nc = EC2NodeClass("win-eq", ami_selector_terms=[
+            SelectorTerm(alias="windows2022@latest")])
+        win_pool = env.nodepool("win-pool", nodeclass=win_nc)
+        lin_pool = env.nodepool("lin-pool")
+        pods = (
+            make_pods(7, cpu="1", memory="2Gi", prefix="weq",
+                      node_selector={L.OS: "windows"})
+            + make_pods(9, cpu="500m", memory="1Gi", prefix="leq",
+                        node_selector={L.OS: "linux"})
+            + make_pods(3, cpu="2", memory="4Gi", prefix="beq",
+                        node_selector={
+                            "node.kubernetes.io/windows-build":
+                                "10.0.20348"}))
+        snap = env.snapshot(pods, [win_pool, lin_pool])
+        res = assert_equivalent(snap, solvers)
+        assert not res.unschedulable
+
+
 class TestPackedBuffers:
     """The single-buffer device round trip (ops/ffd_jax.py packed path)."""
 
