@@ -132,46 +132,71 @@ def build_config5(env, n_pods):
     return env.snapshot(pods, [spot_pool, od_pool, fallback])
 
 
-def build_config4(env, n_nodes=200, pods_per_node=14):
-    """Consolidation: a live cluster of n nodes; every node is a deletion
-    candidate; feasibility of each = one deletion-check snapshot (pools
-    price-filtered to nothing, existing = cluster minus the candidate) —
-    the controller's batched pre-screen (disruption.py _single_consolidation).
-    Returns the list of per-candidate snapshots."""
+def build_config4(env, n_nodes=200, n_replaceable=10):
+    """Consolidation: the controller's FULL single-candidate search over a
+    live cluster (disruption.py _single_consolidation) — per candidate, a
+    deletion check (pods absorbed by remaining capacity alone?) then a
+    replacement search (pods fit remaining + ONE strictly-cheaper node from
+    the full catalog?).
+
+    Cluster shape (all m5.4xlarge, every node a candidate, deletion
+    infeasible everywhere — per-pod requests exceed every neighbor's
+    spare):
+    - n - n_replaceable nodes pin their pods to the m5 family; no m5 type
+      cheaper than m5.4xlarge fits their 13-cpu aggregate, so replacement
+      is provably impossible — the sequential oracle burns a full
+      price-filtered simulate each to learn that.
+    - n_replaceable memory-heavy nodes (LAST in disruption-cost order, so
+      the oracle's loop meets them after every failure) fit a cheaper
+      r-family replacement.
+
+    Returns (base snapshot, candidates) where each candidate carries
+    (name, pods, gone-names, price cap)."""
     from karpenter_provider_aws_tpu.apis import labels as L
     from karpenter_provider_aws_tpu.apis.resources import Resources
     from karpenter_provider_aws_tpu.fake.environment import make_pods
-    from karpenter_provider_aws_tpu.solver.types import (ExistingNode,
-                                                         SchedulingSnapshot)
+    from karpenter_provider_aws_tpu.solver.types import ExistingNode
 
     zones = ["us-west-2a", "us-west-2b", "us-west-2c"]
-    nodes = []
-    node_pods = {}
+    pool = env.nodepool("bench-c4")
+    base = env.snapshot([], [pool])
+    cand_price = max(
+        (it.cheapest_price() or 0)
+        for s in base.nodepools for it in s.instance_types
+        if it.name == "m5.4xlarge")
+
+    nodes, cands = [], []
     for i in range(n_nodes):
-        # 16-vCPU nodes at ~45% utilization: deletions are sometimes
-        # feasible (neighbors absorb) and sometimes not — both paths hit
-        pods = make_pods(pods_per_node, cpu="900m", memory="1800Mi",
-                         prefix=f"c4n{i:03d}")
-        node_pods[i] = pods
+        heavy = i >= n_nodes - n_replaceable
+        if heavy:
+            # 3 pods x (650m, 17000Mi): deletion infeasible (17000Mi
+            # exceeds every spare), but agg (1950m, 51000Mi) fits a
+            # cheaper memory-optimized type -> replaceable
+            pods = make_pods(3, cpu="650m", memory="17000Mi",
+                             prefix=f"c4z{i:03d}")
+        else:
+            # 2 pods x (6500m, 26000Mi) pinned to the m5 family: no
+            # cheaper m5 type holds the 13-cpu aggregate -> UNreplaceable
+            pods = make_pods(2, cpu="6500m", memory="26000Mi",
+                             prefix=f"c4a{i:03d}",
+                             node_selector={L.INSTANCE_FAMILY: "m5"})
+        used = Resources()
+        for p in pods:
+            used = used + p.effective_requests()
+        name = f"bench-node-{i:03d}"
         nodes.append(ExistingNode(
-            name=f"bench-node-{i:03d}",
+            name=name,
             labels={L.ZONE: zones[i % 3], L.ARCH: "amd64",
                     L.CAPACITY_TYPE: "on-demand",
-                    L.INSTANCE_TYPE: "m5.4xlarge"},
+                    L.INSTANCE_TYPE: "m5.4xlarge",
+                    L.INSTANCE_FAMILY: "m5"},
             allocatable=Resources.parse(
-                {"cpu": "15800m", "memory": "57Gi", "pods": "110"}),
-            used=Resources.parse(
-                {"cpu": f"{900 * pods_per_node}m",
-                 "memory": f"{1800 * pods_per_node}Mi",
-                 "pods": str(pods_per_node)}),
+                {"cpu": "15796m", "memory": "57591Mi", "pods": "110"}),
+            used=used,
         ))
-    snaps = []
-    for i in range(n_nodes):
-        existing = [n for j, n in enumerate(nodes) if j != i]
-        snaps.append(SchedulingSnapshot(
-            pods=node_pods[i], nodepools=[], existing_nodes=existing,
-            daemon_overheads=[], zones={z: z + "-id" for z in zones}))
-    return snaps
+        cands.append((name, pods, {name}, cand_price))
+    base.existing_nodes = nodes
+    return base, cands
 
 
 # ---------------------------------------------------------------------------
@@ -213,28 +238,100 @@ def run_solver_config(name, snap, backend, rounds):
     }
 
 
+def _c4_deletion_snapshot(base, cand):
+    from karpenter_provider_aws_tpu.solver.types import SchedulingSnapshot
+    name, pods, gone, _cap = cand
+    return SchedulingSnapshot(
+        pods=pods, nodepools=[],
+        existing_nodes=[n for n in base.existing_nodes if n.name not in gone],
+        daemon_overheads=base.daemon_overheads, zones=base.zones)
+
+
+def _c4_replacement_snapshot(base, cand):
+    """The controller's price-filtered simulate snapshot
+    (disruption.py _snapshot, price_cap > 0)."""
+    from karpenter_provider_aws_tpu.cloudprovider.types import InstanceTypes
+    from karpenter_provider_aws_tpu.solver.types import (NodePoolSpec,
+                                                         SchedulingSnapshot)
+    name, pods, gone, cap = cand
+    pools = []
+    for spec in base.nodepools:
+        kept = InstanceTypes(
+            [it for it in spec.instance_types
+             if (p := it.cheapest_price()) is not None and p < cap])
+        if kept:
+            pools.append(NodePoolSpec(nodepool=spec.nodepool,
+                                      instance_types=kept,
+                                      in_use=spec.in_use))
+    return SchedulingSnapshot(
+        pods=pods, nodepools=pools,
+        existing_nodes=[n for n in base.existing_nodes if n.name not in gone],
+        daemon_overheads=base.daemon_overheads, zones=base.zones)
+
+
+def _c4_decide_batched(ev, solver, base, cands, queries):
+    """_single_consolidation's decision loop with the batched evaluator:
+    one deletion batch, one replacement pre-screen batch, then the
+    authoritative simulate only on surviving candidates."""
+    ok = ev.deletions_feasible(
+        [_c4_deletion_snapshot(base, c) for c in cands])
+    for c, o in zip(cands, ok):
+        if o:
+            return ("delete", c[0], "")
+    maybe = ev.replacements_prescreen(base, queries)
+    for c, m in zip(cands, maybe):
+        if not m:
+            continue
+        res = solver.solve(_c4_replacement_snapshot(base, c))
+        if res.unschedulable or len(res.new_nodes) != 1:
+            continue
+        return ("replace", c[0], res.decision_fingerprint())
+    return ("none", "", "")
+
+
+def _c4_decide_sequential(solver, base, cands):
+    """The reference-equivalent sequential loop: a full simulate per
+    candidate for deletion, then per candidate for replacement
+    (designs/consolidation.md:7-15)."""
+    for c in cands:
+        res = solver.solve(_c4_deletion_snapshot(base, c))
+        if not res.new_nodes and not res.unschedulable:
+            return ("delete", c[0], "")
+    for c in cands:
+        res = solver.solve(_c4_replacement_snapshot(base, c))
+        if res.unschedulable or len(res.new_nodes) != 1:
+            continue
+        return ("replace", c[0], res.decision_fingerprint())
+    return ("none", "", "")
+
+
 def run_config4(backend, rounds, n_nodes=200):
+    from karpenter_provider_aws_tpu.controllers.disruption import \
+        ReplacementQuery
     from karpenter_provider_aws_tpu.fake.environment import Environment
     from karpenter_provider_aws_tpu.solver import CPUSolver
     from karpenter_provider_aws_tpu.solver.consolidation import \
         TPUConsolidationEvaluator
+    from karpenter_provider_aws_tpu.solver.tpu import TPUSolver
 
     env = Environment()
-    snaps = build_config4(env, n_nodes=n_nodes)
+    base, cands = build_config4(env, n_nodes=n_nodes)
+    queries = [ReplacementQuery(pods=c[1], gone=c[2], price_cap=c[3])
+               for c in cands]
     ev = TPUConsolidationEvaluator(backend=backend)
+    tpu = TPUSolver(backend=backend)
     cpu = CPUSolver()
     t0 = time.perf_counter()
-    ref = [not (r.new_nodes or r.unschedulable)
-           for r in (cpu.solve(s) for s in snaps)]
+    ref = _c4_decide_sequential(cpu, base, cands)
     cpu_ms = (time.perf_counter() - t0) * 1000
-    got = ev.deletions_feasible(snaps)  # warms the jit cache
-    identical = list(map(bool, got)) == ref
+    got = _c4_decide_batched(ev, tpu, base, cands, queries)  # warm jit
+    identical = got == ref
     gc.collect()
     gc.freeze()
     times = []
     for _ in range(rounds):
         t0 = time.perf_counter()
-        ev.deletions_feasible(snaps)
+        _c4_decide_batched(ev, tpu, base, cands, queries)
         times.append((time.perf_counter() - t0) * 1000)
     p50, p99 = _percentiles(times)
     return {
@@ -242,7 +339,7 @@ def run_config4(backend, rounds, n_nodes=200):
         "cpu_oracle_ms": round(cpu_ms, 1),
         "speedup": round(cpu_ms / p99, 2) if p99 else 0.0,
         "identical_decisions": identical,
-        "candidates": len(snaps), "feasible": sum(map(bool, got)),
+        "candidates": len(cands), "decision": f"{ref[0]} {ref[1]}",
         "rounds": rounds,
     }
 

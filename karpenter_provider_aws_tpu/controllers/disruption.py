@@ -108,6 +108,16 @@ class _InFlight:
     started: float
 
 
+@dataclass
+class ReplacementQuery:
+    """One hypothetical disruption for the replacement pre-screen: the
+    pods that would go pending, the node/claim names that would vanish,
+    and the strict price bound on any replacement type."""
+    pods: List[Pod]
+    gone: Set[str]
+    price_cap: int
+
+
 class ConsolidationEvaluator:
     """Answers "can these pods be absorbed by existing capacity alone?" for a
     batch of deletion candidates. The base implementation runs the solver
@@ -124,6 +134,17 @@ class ConsolidationEvaluator:
             res = self.solver.solve(snap)
             out.append(not res.new_nodes and not res.unschedulable)
         return out
+
+    def replacements_prescreen(
+            self, base: SchedulingSnapshot,
+            queries: Sequence[ReplacementQuery]) -> List[bool]:
+        """Exact-NO/maybe-YES per query: can the pods fit the surviving
+        nodes plus at most one new node cheaper than the cap? False must
+        be PROOF the replacement simulate would fail (the controller skips
+        it); True means "run the authoritative simulate". The base
+        implementation prunes nothing — the controller then behaves
+        exactly like the sequential oracle."""
+        return [True] * len(queries)
 
 
 class DisruptionController:
@@ -386,7 +407,14 @@ class DisruptionController:
         for cand, ok in zip(cands, delete_ok):
             if ok:
                 return Command(REASON_UNDERUTILIZED, [cand])
-        for cand in cands:
+        # batched pre-screen of the replacement search: one device call
+        # proves most candidates un-replaceable; the (first) survivors get
+        # the authoritative simulate, so decisions stay oracle-identical
+        maybe = self.evaluator.replacements_prescreen(
+            self._round_base, [self._query([c], c.price) for c in cands])
+        for cand, m in zip(cands, maybe):
+            if not m:
+                continue
             result = self._simulate([cand], price_cap=cand.price)
             if result is None or len(result.new_nodes) != 1:
                 continue
@@ -394,6 +422,17 @@ class DisruptionController:
                 continue
             return Command(REASON_UNDERUTILIZED, [cand], result.new_nodes)
         return None
+
+    def _query(self, cands: List[Candidate],
+               price_cap: int) -> ReplacementQuery:
+        pods = [p for c in cands for p in c.pods]
+        # same volume-topology discipline as _snapshot: zonal PV pins are
+        # scheduling constraints the pre-screen must see
+        self.provisioner._resolve_volume_topology(pods)
+        return ReplacementQuery(
+            pods=pods,
+            gone={c.node.name for c in cands} | {c.name for c in cands},
+            price_cap=price_cap)
 
     def _multi_consolidation(
             self, candidates: List[Candidate]) -> Optional[Command]:
@@ -404,13 +443,33 @@ class DisruptionController:
         if len(cands) < 2:
             return None
 
+        # ONE batched pre-screen covers every prefix the binary search can
+        # visit; a False is proof _try_prefix's simulate would fail, so
+        # the search only pays for simulates on surviving prefixes.
+        # Queries are built incrementally — volume topology resolves once
+        # per candidate, not once per (candidate, prefix) pair
+        prefix_queries: List[ReplacementQuery] = []
+        pods_acc: List[Pod] = []
+        gone_acc: Set[str] = set()
+        price_acc = 0
+        for k, c in enumerate(cands, start=1):
+            self.provisioner._resolve_volume_topology(c.pods)
+            pods_acc = pods_acc + c.pods
+            gone_acc = gone_acc | {c.node.name, c.name}
+            price_acc += c.price
+            if k >= 2:
+                prefix_queries.append(ReplacementQuery(
+                    pods=pods_acc, gone=gone_acc, price_cap=price_acc))
+        maybe = self.evaluator.replacements_prescreen(
+            self._round_base, prefix_queries)
+
         # binary-search the largest workable ascending-cost prefix
         # (core firstNConsolidationOption)
         best: Optional[Command] = None
         lo, hi = 2, len(cands)
         while lo <= hi:
             mid = (lo + hi) // 2
-            cmd = self._try_prefix(cands[:mid])
+            cmd = self._try_prefix(cands[:mid]) if maybe[mid - 2] else None
             if cmd is not None:
                 best, lo = cmd, mid + 1
             else:

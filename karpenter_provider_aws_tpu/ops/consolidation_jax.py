@@ -71,6 +71,73 @@ def deletions_feasible_kernel(ex_alloc: jax.Array,    # [E, D] int64 shared
 
 
 @jax.jit
+def replacements_prescreen_kernel(
+        ex_alloc: jax.Array,    # [E, D] int64 shared node table
+        ex_used0: jax.Array,    # [E, D] int64 shared
+        compat_tab: jax.Array,  # [Sc, E] bool profile x node
+        R_tab: jax.Array,       # [S, D] int64 per signature
+        type_alloc: jax.Array,  # [T, D] int64 allocatable per catalog type
+        type_price: jax.Array,  # [T] int64 cheapest available price (BIG
+        #                         when the type has no available offering)
+        tcompat: jax.Array,     # [Sc, T] bool profile x type (no req
+        #                         conflict + an availability-compat offering)
+        padmit: jax.Array,      # [P, Sc] bool pool admits profile
+        #                         (requirements compatible, taints tolerated)
+        pool_types: jax.Array,  # [P, T] bool type is in the pool's catalog
+        gid: jax.Array,         # [B, G] int32 -> S
+        cid: jax.Array,         # [B, G] int32 -> Sc
+        n: jax.Array,           # [B, G] int64 pod count (0 => padded row)
+        alive: jax.Array,       # [B, E] bool surviving nodes
+        price_cap: jax.Array,   # [B] int64 strict upper price bound
+) -> jax.Array:                 # [B] bool: False => replacement IMPOSSIBLE
+    """Exact-NO / maybe-YES pre-screen for consolidation's replacement
+    search: "do this batch's pods fit the remaining nodes plus at most ONE
+    new node from the price-capped catalog?"
+
+    The absorption half (scan over pod groups, greedy prefix fill in
+    name-sorted node order) is bit-identical to the oracle's first-fit over
+    existing nodes — leftovers are exact. The new-node half is a
+    *relaxation* (a necessary condition for the oracle to succeed): one
+    admitted type must hold the aggregate leftover. It ignores daemonset
+    overhead, pool limits, minValues floors and cross-group requirement
+    union narrowing, each of which can only shrink oracle feasibility —
+    so a False here is proof the sequential simulate would fail
+    (designs/consolidation.md:7-15 "Node Replacement"), while a True still
+    gets the authoritative simulate. No false negatives => decisions are
+    identical to the oracle; positives only cost one confirming solve.
+    """
+    def one_candidate(gids, cids, nb, alv, cap):
+        def step(used, xs):
+            gi, ci, ng = xs
+            Rg = R_tab[gi]
+            cg = compat_tab[ci] & alv
+            Rsafe = jnp.where(Rg > 0, Rg, 1)
+            q = (ex_alloc - used) // Rsafe[None, :]
+            q = jnp.where((Rg > 0)[None, :], q, BIG)
+            k = jnp.clip(q.min(axis=-1), 0, BIG)
+            k = jnp.where(cg, k, 0)
+            cum = jnp.cumsum(k) - k
+            take = jnp.clip(ng - cum, 0, k)
+            used = used + take[:, None] * Rg[None, :]
+            return used, ng - take.sum()
+
+        _, leftover = jax.lax.scan(step, ex_used0, (gids, cids, nb))
+        active = leftover > 0                                    # [G]
+        agg = (leftover[:, None] * R_tab[gids]).sum(axis=0)      # [D]
+        # a type must be individually compatible with EVERY leftover group
+        g_ok = (tcompat[cids] | ~active[:, None]).all(axis=0)    # [T]
+        # ... and live in some pool that admits every leftover group
+        p_ok = (padmit[:, cids].T | ~active[:, None]).all(axis=0)  # [P]
+        from_pools = (p_ok[:, None] & pool_types).any(axis=0)    # [T]
+        fits = (agg[None, :] <= type_alloc).all(axis=-1)         # [T]
+        priced = type_price < cap                                # [T]
+        ok = (g_ok & from_pools & fits & priced).any()
+        return ok | ~active.any()
+
+    return jax.vmap(one_candidate)(gid, cid, n, alive, price_cap)
+
+
+@jax.jit
 def deletions_feasible_dense(ex_alloc: jax.Array,   # [B, E, D] int64
                              ex_used0: jax.Array,   # [B, E, D] int64
                              ex_compat: jax.Array,  # [B, G, E] bool

@@ -43,6 +43,75 @@ def _pow2(x: int) -> int:
     return max(1, 1 << (x - 1).bit_length())
 
 
+class _GroupTables:
+    """Shared batch-encoding of pod lists into signature/profile tables —
+    the one implementation of constraint-profile identity for both the
+    deletion kernel and the replacement pre-screen (a profile = selector,
+    affinity terms, tolerations, volume reqs: everything
+    scheduling_requirements/taint-compat can see — sig indices 0,1,3,7)."""
+
+    __slots__ = ("sig_groups", "ckeys", "ckey_groups", "sig_ckey",
+                 "per_rows", "dims", "dpos", "G")
+
+    def __init__(self, pod_lists):
+        dims_set = {"cpu", "memory", "pods"}
+        sig_of: Dict[Tuple, int] = {}
+        ckey_of: Dict[Tuple, int] = {}
+        self.sig_groups: List = []   # representative pod per signature
+        self.ckeys: List[Tuple] = []  # profile key per profile index
+        self.ckey_groups: List = []  # representative pod per profile
+        self.sig_ckey: List[int] = []
+        self.per_rows: List[List[Tuple[int, int]]] = []
+        self.G = 1
+        for pods in pod_lists:
+            rows: List[Tuple[int, int]] = []
+            for sig, plist in canonical_pod_groups(pods):
+                p = plist[0]
+                dims_set.update(p.effective_requests().nonzero_keys())
+                si = sig_of.get(sig)
+                if si is None:
+                    si = sig_of[sig] = len(self.sig_groups)
+                    self.sig_groups.append(p)
+                    ck = (sig[0], sig[1], sig[3], sig[7])
+                    ci = ckey_of.get(ck)
+                    if ci is None:
+                        ci = ckey_of[ck] = len(self.ckey_groups)
+                        self.ckeys.append(ck)
+                        self.ckey_groups.append(p)
+                    self.sig_ckey.append(ci)
+                rows.append((si, len(plist)))
+            self.per_rows.append(rows)
+            self.G = max(self.G, len(rows))
+        self.dims = sorted(dims_set)
+        self.dpos = {d: i for i, d in enumerate(self.dims)}
+
+    def vec(self, r) -> np.ndarray:
+        v = np.zeros(len(self.dims), dtype=np.int64)
+        for k, q in r.items():
+            i = self.dpos.get(k)
+            if i is not None:
+                v[i] = q
+        return v
+
+    def r_tab(self, Sp: int, Dp: int) -> np.ndarray:
+        R = np.zeros((Sp, Dp), dtype=np.int64)
+        D = len(self.dims)
+        for si, rep in enumerate(self.sig_groups):
+            R[si, :D] = self.vec(rep.effective_requests())
+        return R
+
+    def node_compat(self, Scp: int, Ep: int, by_name, npos) -> np.ndarray:
+        compat = np.zeros((Scp, Ep), dtype=bool)
+        for ci, rep in enumerate(self.ckey_groups):
+            reqs = rep.scheduling_requirements()
+            for name, node in by_name.items():
+                compat[ci, npos[name]] = (
+                    reqs.satisfied_by_labels(node.labels)
+                    and all(t.tolerated_by(rep.tolerations)
+                            for t in node.taints))
+        return compat
+
+
 class TPUConsolidationEvaluator(ConsolidationEvaluator):
     def __init__(self, solver: Optional[Solver] = None,
                  backend: str = "auto"):
@@ -52,6 +121,11 @@ class TPUConsolidationEvaluator(ConsolidationEvaluator):
         #: optional metrics registry (operator injects, as on TPUSolver)
         self.metrics = None
         self._router = Router(name="consolidation")
+        #: catalog-derived pre-screen tables, reused while the pools'
+        #: resolved InstanceTypes lists are unchanged (instancetype
+        #: provider returns the same cached list until a seqnum bump —
+        #: instancetype.go:119-130 discipline)
+        self._base_cache: Optional[Tuple[Tuple, dict]] = None
 
     def _routed(self, bucket, host_fn, dev_fn):
         if self.backend == "numpy":
@@ -101,6 +175,218 @@ class TPUConsolidationEvaluator(ConsolidationEvaluator):
         return out  # type: ignore[return-value]
 
     # ------------------------------------------------------------------
+    # replacement pre-screen (batched "± one cheaper node" search)
+    # ------------------------------------------------------------------
+    def replacements_prescreen(self, base, queries) -> List[bool]:
+        """Batched exact-NO/maybe-YES for the replacement search
+        (controllers.disruption ReplacementQuery). Queries whose pods carry
+        topology/affinity constraints are never pruned (same fallback
+        discipline as deletions_feasible); everything else is answered by
+        one ops.consolidation_jax.replacements_prescreen_kernel call."""
+        if not queries:
+            return []
+        out: List[Optional[bool]] = [None] * len(queries)
+        batch_idx: List[int] = []
+        for i, q in enumerate(queries):
+            if not q.pods:
+                out[i] = True
+            elif any(p.topology_spread or p.pod_affinity for p in q.pods):
+                out[i] = True  # the authoritative simulate decides
+            else:
+                batch_idx.append(i)
+        if batch_idx:
+            flags = self._prescreen_batch(
+                base, [queries[i] for i in batch_idx])
+            for i, ok in zip(batch_idx, flags):
+                out[i] = bool(ok)
+        return out  # type: ignore[return-value]
+
+    def _base_tables(self, base) -> dict:
+        """Catalog-derived tables (unique types, dense allocatable,
+        cheapest prices, lazily-filled per-profile compat rows). Cached on
+        the identity of the pools' resolved type lists + nodepool hashes;
+        the entry holds strong refs so ids cannot be recycled."""
+        # NodePool.hash() covers taints but NOT template.requirements
+        # (objects.py:322-329), and padmit rows depend on both — fold the
+        # requirement tuples in explicitly or a requirements-only edit
+        # would keep serving stale pool-admission rows
+        key = tuple(
+            x for spec in base.nodepools
+            for x in (spec.nodepool.hash(),
+                      tuple((r.key, r.complement, r.values,
+                             r.greater_than, r.less_than)
+                            for r in spec.nodepool.scheduling_requirements()),
+                      id(spec.instance_types)))
+        if self._base_cache is not None and self._base_cache[0] == key:
+            return self._base_cache[1]
+        types: List = []
+        tpos: Dict[int, int] = {}
+        pool_rows: List[List[int]] = []
+        for spec in base.nodepools:
+            rows = []
+            for it in spec.instance_types:
+                ti = tpos.get(id(it))
+                if ti is None:
+                    ti = tpos[id(it)] = len(types)
+                    types.append(it)
+                rows.append(ti)
+            pool_rows.append(rows)
+        T = len(types)
+        cdims = sorted({k for it in types
+                        for k in it.allocatable().nonzero_keys()})
+        cpos = {d: j for j, d in enumerate(cdims)}
+        alloc = np.zeros((T, len(cdims)), dtype=np.int64)
+        price = np.full(T, np.int64(1) << 60, dtype=np.int64)
+        for ti, it in enumerate(types):
+            for k, q in it.allocatable().items():
+                j = cpos.get(k)
+                if j is not None:
+                    alloc[ti, j] = q
+            p = it.cheapest_price()
+            if p is not None:
+                price[ti] = p
+        tab = dict(types=types, pool_rows=pool_rows, cdims=cdims,
+                   alloc=alloc, price=price, tcompat={}, padmit={},
+                   _refs=[(s.nodepool, s.instance_types)
+                          for s in base.nodepools])
+        self._base_cache = (key, tab)
+        return tab
+
+    def _prescreen_batch(self, base, queries) -> np.ndarray:
+        node_names = sorted(n.name for n in base.existing_nodes)
+        npos = {name: i for i, name in enumerate(node_names)}
+        by_name = {n.name: n for n in base.existing_nodes}
+        E = len(node_names)
+
+        tab = self._base_tables(base)
+        types, pool_rows = tab["types"], tab["pool_rows"]
+        T, P = len(types), len(base.nodepools)
+
+        gt = _GroupTables([q.pods for q in queries])
+        D = len(gt.dims)
+        S, Sc = len(gt.sig_groups), len(gt.ckey_groups)
+
+        B = len(queries)
+        Bp, Gp, Ep = _pow2(B), _pow2(gt.G), _pow2(max(1, E))
+        Sp, Scp, Tp, Pp, Dp = (_pow2(S), _pow2(Sc), _pow2(max(1, T)),
+                               _pow2(max(1, P)), max(8, D))
+        BIG = np.int64(1) << 60
+
+        ex_alloc = np.zeros((Ep, Dp), dtype=np.int64)
+        ex_used = np.zeros((Ep, Dp), dtype=np.int64)
+        for name, node in by_name.items():
+            ei = npos[name]
+            ex_alloc[ei, :D] = gt.vec(node.allocatable)
+            ex_used[ei, :D] = gt.vec(node.used)
+
+        compat_tab = np.zeros((Scp, Ep), dtype=bool)
+        compat_tab[:Sc, :E] = gt.node_compat(Sc, E, by_name, npos)
+        tcompat = np.zeros((Scp, Tp), dtype=bool)
+        padmit = np.zeros((Pp, Scp), dtype=bool)
+        for ci, (ck, rep) in enumerate(zip(gt.ckeys, gt.ckey_groups)):
+            reqs = rep.scheduling_requirements()
+            trow = tab["tcompat"].get(ck)
+            if trow is None:
+                trow = np.fromiter(
+                    (not it.requirements.conflicts(reqs)
+                     and bool(it.offerings.available().compatible(reqs))
+                     for it in types), dtype=bool, count=T)
+                tab["tcompat"][ck] = trow
+            tcompat[ci, :T] = trow
+            prow = tab["padmit"].get(ck)
+            if prow is None:
+                prow = np.fromiter(
+                    (not spec.nodepool.scheduling_requirements()
+                     .compatible(reqs)
+                     and all(t.tolerated_by(rep.tolerations)
+                             for t in spec.nodepool.template.taints)
+                     for spec in base.nodepools), dtype=bool, count=P)
+                tab["padmit"][ck] = prow
+            padmit[:P, ci] = prow
+
+        type_alloc = np.zeros((Tp, Dp), dtype=np.int64)
+        for i, d in enumerate(gt.dims):
+            if d in tab["cdims"]:
+                type_alloc[:T, i] = tab["alloc"][:, tab["cdims"].index(d)]
+        type_price = np.full(Tp, BIG, dtype=np.int64)
+        type_price[:T] = tab["price"]
+        pool_types = np.zeros((Pp, Tp), dtype=bool)
+        for pi, rows in enumerate(pool_rows):
+            pool_types[pi, rows] = True
+
+        R_tab = gt.r_tab(Sp, Dp)
+
+        gid = np.zeros((Bp, Gp), dtype=np.int32)
+        cid = np.zeros((Bp, Gp), dtype=np.int32)
+        n = np.zeros((Bp, Gp), dtype=np.int64)
+        alive = np.zeros((Bp, Ep), dtype=bool)
+        price_cap = np.zeros(Bp, dtype=np.int64)
+        for bi, q in enumerate(queries):
+            for gi, (si, cnt) in enumerate(gt.per_rows[bi]):
+                gid[bi, gi] = si
+                cid[bi, gi] = gt.sig_ckey[si]
+                n[bi, gi] = cnt
+            for name, ei in npos.items():
+                alive[bi, ei] = name not in q.gone
+            price_cap[bi] = q.price_cap
+
+        def dev_fn():
+            import jax.numpy as jnp
+
+            from ..ops.consolidation_jax import replacements_prescreen_kernel
+            return np.asarray(replacements_prescreen_kernel(
+                jnp.asarray(ex_alloc), jnp.asarray(ex_used),
+                jnp.asarray(compat_tab), jnp.asarray(R_tab),
+                jnp.asarray(type_alloc), jnp.asarray(type_price),
+                jnp.asarray(tcompat), jnp.asarray(padmit),
+                jnp.asarray(pool_types), jnp.asarray(gid),
+                jnp.asarray(cid), jnp.asarray(n), jnp.asarray(alive),
+                jnp.asarray(price_cap)))
+
+        return self._routed(
+            ("prescreen", Bp, Gp, Ep, Sp, Scp, Tp, Pp, Dp),
+            lambda: self._numpy_prescreen(
+                ex_alloc, ex_used, compat_tab, R_tab, type_alloc,
+                type_price, tcompat, padmit, pool_types, gid, cid, n,
+                alive, price_cap),
+            dev_fn)[:B]
+
+    @staticmethod
+    def _numpy_prescreen(ex_alloc, ex_used, compat_tab, R_tab, type_alloc,
+                         type_price, tcompat, padmit, pool_types, gid, cid,
+                         n, alive, price_cap) -> np.ndarray:
+        BIG = np.int64(1) << 60
+        Bp, Gp = n.shape
+        out = np.zeros(Bp, dtype=bool)
+        for b in range(Bp):
+            used = ex_used.copy()
+            leftover = np.zeros(Gp, dtype=np.int64)
+            for g in range(Gp):
+                Rg, ng = R_tab[gid[b, g]], n[b, g]
+                cg = compat_tab[cid[b, g]] & alive[b]
+                Rsafe = np.where(Rg > 0, Rg, 1)
+                q = (ex_alloc - used) // Rsafe[None, :]
+                q = np.where((Rg > 0)[None, :], q, BIG)
+                k = np.clip(q.min(axis=-1), 0, BIG)
+                k = np.where(cg, k, 0)
+                cum = np.cumsum(k) - k
+                take = np.clip(ng - cum, 0, k)
+                used = used + take[:, None] * Rg[None, :]
+                leftover[g] = ng - take.sum()
+            active = leftover > 0
+            if not active.any():
+                out[b] = True
+                continue
+            agg = (leftover[:, None] * R_tab[gid[b]]).sum(axis=0)
+            g_ok = (tcompat[cid[b]] | ~active[:, None]).all(axis=0)
+            p_ok = (padmit[:, cid[b]] | ~active[None, :]).all(axis=1)
+            from_pools = (p_ok[:, None] & pool_types).any(axis=0)
+            fits = (agg[None, :] <= type_alloc).all(axis=-1)
+            out[b] = bool((g_ok & from_pools & fits
+                           & (type_price < price_cap[b])).any())
+        return out
+
+    # ------------------------------------------------------------------
     # shared-table fast path
     # ------------------------------------------------------------------
     def _evaluate_shared(
@@ -117,80 +403,33 @@ class TPUConsolidationEvaluator(ConsolidationEvaluator):
         npos = {name: i for i, name in enumerate(node_names)}
         E = len(node_names)
 
-        dims_set = {"cpu", "memory", "pods"}
-        sig_of: Dict[Tuple, int] = {}
-        sig_groups: List[Tuple] = []          # rep pod per full signature
-        #: compatibility depends only on (selector, affinity, tolerations)
-        #: — the constraint profile — and real batches have FEW of those
-        #: even when every candidate's pods carry distinct signatures
-        ckey_of: Dict[Tuple, int] = {}
-        ckey_groups: List[Tuple] = []         # rep pod per profile
-        sig_ckey: List[int] = []              # S -> Sc
-        per_snap: List[List[Tuple[int, int]]] = []  # [(sig idx, count)]
-        G = 1
-        for snap in snaps:
-            rows: List[Tuple[int, int]] = []
-            for sig, plist in canonical_pod_groups(snap.pods):
-                p = plist[0]
-                dims_set.update(p.effective_requests().nonzero_keys())
-                si = sig_of.get(sig)
-                if si is None:
-                    si = sig_of[sig] = len(sig_groups)
-                    sig_groups.append(p)
-                    ck = (sig[0], sig[1], sig[3])
-                    ci = ckey_of.get(ck)
-                    if ci is None:
-                        ci = ckey_of[ck] = len(ckey_groups)
-                        ckey_groups.append(p)
-                    sig_ckey.append(ci)
-                rows.append((si, len(plist)))
-            per_snap.append(rows)
-            G = max(G, len(rows))
-        dims = sorted(dims_set)
-        dpos = {d: i for i, d in enumerate(dims)}
-        D = len(dims)
-        S = len(sig_groups)
-        Sc = len(ckey_groups)
-
-        def vec(r) -> np.ndarray:
-            v = np.zeros(D, dtype=np.int64)
-            for k, q in r.items():
-                i = dpos.get(k)
-                if i is not None:
-                    v[i] = q
-            return v
+        gt = _GroupTables([snap.pods for snap in snaps])
+        D = len(gt.dims)
+        S, Sc = len(gt.sig_groups), len(gt.ckey_groups)
 
         B = len(snaps)
-        Bp, Gp, Ep = _pow2(B), _pow2(G), _pow2(E)
+        Bp, Gp, Ep = _pow2(B), _pow2(gt.G), _pow2(E)
         Sp, Scp, Dp = _pow2(S), _pow2(Sc), max(8, D)
 
         ex_alloc = np.zeros((Ep, Dp), dtype=np.int64)
         ex_used = np.zeros((Ep, Dp), dtype=np.int64)
         for name, node in by_name.items():
             ei = npos[name]
-            ex_alloc[ei, :D] = vec(node.allocatable)
-            ex_used[ei, :D] = vec(node.used)
+            ex_alloc[ei, :D] = gt.vec(node.allocatable)
+            ex_used[ei, :D] = gt.vec(node.used)
 
         compat_tab = np.zeros((Scp, Ep), dtype=bool)
-        for ci, rep in enumerate(ckey_groups):
-            reqs = rep.scheduling_requirements()
-            for name, node in by_name.items():
-                compat_tab[ci, npos[name]] = (
-                    reqs.satisfied_by_labels(node.labels)
-                    and all(t.tolerated_by(rep.tolerations)
-                            for t in node.taints))
-        R_tab = np.zeros((Sp, Dp), dtype=np.int64)
-        for si, rep in enumerate(sig_groups):
-            R_tab[si, :D] = vec(rep.effective_requests())
+        compat_tab[:Sc, :E] = gt.node_compat(Sc, E, by_name, npos)
+        R_tab = gt.r_tab(Sp, Dp)
 
         gid = np.zeros((Bp, Gp), dtype=np.int32)
         cid = np.zeros((Bp, Gp), dtype=np.int32)
         n = np.zeros((Bp, Gp), dtype=np.int64)
         alive = np.zeros((Bp, Ep), dtype=bool)
         for bi, snap in enumerate(snaps):
-            for gi, (si, cnt) in enumerate(per_snap[bi]):
+            for gi, (si, cnt) in enumerate(gt.per_rows[bi]):
                 gid[bi, gi] = si
-                cid[bi, gi] = sig_ckey[si]
+                cid[bi, gi] = gt.sig_ckey[si]
                 n[bi, gi] = cnt
             for node in snap.existing_nodes:
                 alive[bi, npos[node.name]] = True
