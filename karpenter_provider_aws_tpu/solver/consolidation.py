@@ -283,6 +283,12 @@ class TPUConsolidationEvaluator(ConsolidationEvaluator):
         compat_tab[:Sc, :E] = gt.node_compat(Sc, E, by_name, npos)
         tcompat = np.zeros((Scp, Tp), dtype=bool)
         padmit = np.zeros((Pp, Scp), dtype=bool)
+        # the per-profile memos live as long as the catalog cache entry
+        # (12h between seqnum bumps); churning workloads can mint unbounded
+        # distinct profiles — cap like encoding.py's _SIG_CAP intern table
+        if len(tab["tcompat"]) > 4096:
+            tab["tcompat"].clear()
+            tab["padmit"].clear()
         for ci, (ck, rep) in enumerate(zip(gt.ckeys, gt.ckey_groups)):
             reqs = rep.scheduling_requirements()
             trow = tab["tcompat"].get(ck)

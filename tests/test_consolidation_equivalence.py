@@ -112,20 +112,37 @@ def _replacement_base(rng: random.Random, env):
     nodes = []
     node_pods = {}
     for i in range(rng.randint(2, 6)):
+        # constrained pods so the tcompat/padmit rows can actually prune:
+        # selectors restrict node+type compat, tolerations interact with
+        # tainted nodes — unconstrained-only pods would leave every
+        # compat row trivially all-True
+        sel = {}
+        if rng.random() < 0.5:
+            sel[L.ZONE] = rng.choice(ZONES)
+        if rng.random() < 0.4:
+            sel[L.ARCH] = rng.choice(["amd64", "arm64"])
+        if rng.random() < 0.3:
+            sel[L.INSTANCE_FAMILY] = rng.choice(["m5", "c5"])
+        tol = [Toleration(key="dedicated", operator="Exists")] \
+            if rng.random() < 0.3 else []
         pods = make_pods(
             rng.randint(1, 4), cpu=f"{rng.choice([500, 1200, 2500])}m",
-            memory=f"{rng.choice([512, 2048])}Mi", prefix=f"rb{i}")
+            memory=f"{rng.choice([512, 2048])}Mi", prefix=f"rb{i}",
+            node_selector=sel or None, tolerations=tol)
         node_pods[i] = pods
         used_cpu = sum(p.requests["cpu"] for p in pods)
         used_mem = sum(p.requests["memory"] for p in pods)
         nodes.append(ExistingNode(
             name=f"rb-node-{i:02d}",
-            labels={L.ZONE: rng.choice(ZONES), L.ARCH: "amd64",
+            labels={L.ZONE: rng.choice(ZONES),
+                    L.ARCH: rng.choice(["amd64", "arm64"]),
                     L.CAPACITY_TYPE: "on-demand"},
             allocatable=Resources({"cpu": rng.choice([3900, 7800]),
                                    "memory": 16 * 1024 ** 3, "pods": 58}),
             used=Resources({"cpu": used_cpu, "memory": used_mem,
-                            "pods": len(pods)})))
+                            "pods": len(pods)}),
+            taints=[Taint("dedicated", "NoSchedule", "x")]
+            if rng.random() < 0.25 else []))
     pool = env.nodepool("rb-pool", requirements=[
         {"key": L.INSTANCE_FAMILY, "operator": "In",
          "values": ["m5", "c5", "t3"]}])
@@ -163,9 +180,13 @@ class TestReplacementPrescreen:
                 pools = []
                 if cap > 0:
                     for spec in base.nodepools:
+                        # exact controller filter (disruption.py _snapshot):
+                        # price-None drops, price-0 KEEPS (an `or` default
+                        # would wrongly drop free offerings)
                         kept = InstanceTypes(
                             [it for it in spec.instance_types
-                             if (it.cheapest_price() or 1 << 62) < cap])
+                             if (p := it.cheapest_price()) is not None
+                             and p < cap])
                         if kept:
                             pools.append(NodePoolSpec(
                                 nodepool=spec.nodepool, instance_types=kept,
