@@ -69,6 +69,11 @@ class NodeState:
     #: per-slot count of pods of the currently-processed scheduling group
     #: (topology bookkeeping, host engine only)
     pool_used: Optional[np.ndarray] = None  # [P, D]
+    #: R-signature -> [N] bool "proven zero headroom for this request
+    #: vector". Slot usage only grows within a solve, so fullness under an
+    #: identical R transfers across pod groups — later groups skip the
+    #: exact per-slot headroom recompute (topo._Pour lazy ensure).
+    full_for: Dict[bytes, np.ndarray] = field(default_factory=dict)
 
     @staticmethod
     def create(enc: SnapshotEncoding, n_max: int,

@@ -247,6 +247,14 @@ class _Pour:
         # slot are LAZY — first-fit only ever inspects a handful of slots
         # per event, and an eager [N, T] pass per group dominated pour time
         adm = ffd.admission(st, enc, g)
+        #: cross-group full-slot mask for identical request vectors: a
+        #: slot proven at zero headroom for this R stays full (usage only
+        #: grows), so later same-R groups skip the exact recompute
+        self._full_shared = st.full_for.get(self.R.tobytes())
+        if self._full_shared is None:
+            self._full_shared = st.full_for[self.R.tobytes()] = \
+                np.zeros(st.N, dtype=bool)
+        adm = adm & ~self._full_shared
         self.adm = adm
         self.cand = np.zeros((st.N, enc.A.shape[0]), dtype=bool)
         self._slot_ready = np.zeros(st.N, dtype=bool)
@@ -264,6 +272,10 @@ class _Pour:
         self._enforced_z = any(e for _, _, e in self.zsp)
         #: per-pool static open-a-node arrays (see _open_new)
         self._open_cache: Dict[int, object] = {}
+        #: (zones-mask, ct-mask) -> [T] any-available-offering mask; slots
+        #: opened by the same pool share few distinct patterns, so the
+        #: [T, Z, C] reduction in _ensure_slot runs once per pattern
+        self._off_cache: Dict[bytes, np.ndarray] = {}
         #: headroom fast path: R's nonzero dims and A restricted to them,
         #: computed once per group (ffd._headroom re-slices per call)
         self._sel = self.R > 0
@@ -271,6 +283,20 @@ class _Pour:
         self._Asel = enc.A[:, self._sel] if self._sel.any() else None
         #: (slot, zone, len, kind) event log for periodic-cycle detection
         self.event_log: List[Tuple[int, Optional[int], int, str]] = []
+        #: generation replay (see _maybe_replay): tracks the nodes opened
+        #: since the last time every generation slot filled. Disabled for
+        #: affinity groups (the anti ladder has its own fast path and
+        #: occupancy semantics the replay proof doesn't cover).
+        self._gen_track = not self.zaf and not self.haf
+        self._gen_slots: List[int] = []
+        self._gen_opens: List[Tuple[int, Optional[int]]] = []
+        self._gen_runs_start = 0
+        self._gen_ztot: Dict[int, int] = {}
+        #: validated previous generation: (opens, normalized runs, slots)
+        self._gen_template: Optional[Tuple] = None
+        #: slot-index vector reused by _slot_admissible (two fresh aranges
+        #: per event added up at 50k-pod scale)
+        self._idx = np.arange(st.N)
 
     def _hr_new(self, used: np.ndarray) -> np.ndarray:
         """[T] headroom of a slot with per-dim usage `used` (== ffd._headroom
@@ -301,22 +327,37 @@ class _Pour:
             self.rem[slot] = 0
             return
         if slot < st.E:
-            hr = ffd._headroom(st.ex_alloc[slot], st.used[slot], self.R)
-            self.rem[slot] = max(int(hr) - int(self.take[slot]), 0)
+            hr = int(ffd._headroom(st.ex_alloc[slot], st.used[slot], self.R))
+            self.rem[slot] = max(hr - int(self.take[slot]), 0)
+            if hr <= 0:
+                # group-independent resource fullness: transfers to every
+                # later same-R group (usage only grows within a solve)
+                self._full_shared[slot] = True
             return
         cand = st.types[slot] & enc.F[g]
-        zc = (st.zones[slot] & self.agz)[:, None] \
-            & (st.ct[slot] & self.agc)[None, :]
-        cand &= (enc.avail & zc[None, :, :]).any(axis=(1, 2))
+        zmask = st.zones[slot] & self.agz
+        cmask = st.ct[slot] & self.agc
+        ck = zmask.tobytes() + cmask.tobytes()
+        off = self._off_cache.get(ck)
+        if off is None:
+            off = self._off_cache[ck] = (
+                enc.avail & zmask[None, :, None]
+                & cmask[None, None, :]).any(axis=(1, 2))
+        cand &= off
         self.cand[slot] = cand
         if not cand.any():
             self.rem[slot] = 0
             return
         hr = self._hr_new(st.used[slot])
-        hr = np.where(cand, hr, 0)
-        rem = max(int(hr.max()) - int(self.take[slot]), 0)
+        hrc = np.where(cand, hr, 0)
+        rem = max(int(hrc.max()) - int(self.take[slot]), 0)
         if rem > 0:
-            rem = min(rem, self._mv_cap(int(st.pool[slot]), cand, hr))
+            rem = min(rem, self._mv_cap(int(st.pool[slot]), cand, hrc))
+        elif self.take[slot] == 0 \
+                and int(np.where(st.types[slot], hr, 0).max()) <= 0:
+            # zero headroom over the slot's OWN type set (not the
+            # group-masked subset): group-independent, safe to share
+            self._full_shared[slot] = True
         self.rem[slot] = rem
 
     # -- dynamic topology predicates ------------------------------------
@@ -402,6 +443,8 @@ class _Pour:
 
     # -- records (oracle _topology_ok_fixed tail + _record_membership) --
     def _record(self, slot: int, zi: Optional[int], count: int) -> None:
+        if self._gen_track and zi is not None:
+            self._gen_ztot[zi] = self._gen_ztot.get(zi, 0) + count
         ts = self.ts
         seen_z: Set[int] = set()
         seen_h: Set[int] = set()
@@ -627,7 +670,7 @@ class _Pour:
             # zone-label-less existing slots: enforced spread rejects;
             # affinity evaluates the empty domain (anti passes, positive
             # fails when occupied elsewhere or foreign)
-            nolab = ~dec & (np.arange(n_act) < st.E)
+            nolab = ~dec & (self._idx[:n_act] < st.E)
             if nolab.any() and not enforced_z:
                 empty_ok = True
                 for gz, anti, own in self.zaf:
@@ -635,7 +678,7 @@ class _Pour:
                     if not anti and (occ_any or not own):
                         empty_ok = False
                 zmask[nolab] = empty_ok
-            und = ~dec & (np.arange(n_act) >= st.E)
+            und = ~dec & (self._idx[:n_act] >= st.E)
             zmask[und] = True  # zone chosen on selection; may still fail
             ok &= zmask
         return ok
@@ -766,8 +809,106 @@ class _Pour:
         self._open_cache[pi] = ent
         return ent
 
+    # -- generation replay ----------------------------------------------
+    # A spread ladder advances in *generations*: a set of fresh nodes
+    # (typically one per eligible zone) opens, stripes full under the
+    # cycle jump, and the next set opens. Event costs concentrate in the
+    # ~9 open/redetect events per generation. Once two consecutive
+    # generations are IDENTICAL up to slot renaming — same pool/zone open
+    # sequence, same run pattern, no foreign-slot or existing-node
+    # placements — and (for enforced spread) every zone in the group's
+    # eligible/allowed universe advanced by the same per-generation delta
+    # (so every count-vs-min and score relation is restored exactly), the
+    # sequential pour provably repeats the generation verbatim: replay k
+    # of them in one commit, bounded by pod count, pool budgets and slot
+    # space. Decisions are bit-identical to the event loop
+    # (tests/test_topology_equivalence.py fuzzes this path).
+
+    def _gen_close(self) -> Optional[Tuple]:
+        """Validate + normalize the just-finished generation; None if it
+        can't serve as a replay template."""
+        slots = self._gen_slots
+        spos = {s: i for i, s in enumerate(slots)}
+        runs = self.runs[self._gen_runs_start:]
+        norm: List[Tuple] = []
+        for entry in runs:
+            if entry[0] == "cyc":
+                _, pattern, kk = entry
+                pat = []
+                for s, ln in pattern:
+                    if s not in spos:
+                        return None  # foreign slot -> not periodic
+                    pat.append((spos[s], ln))
+                norm.append(("cyc", tuple(pat), kk))
+            else:
+                s, ln = entry
+                if s not in spos:
+                    return None
+                norm.append((spos[s], ln))
+        if self._enforced_z:
+            # every zone the group could place into or that gates its
+            # min-count must advance uniformly, or staggers shift and a
+            # later generation could diverge from the template
+            elig = self.min_mask | self.agz
+            deltas = {self._gen_ztot.get(zi, 0)
+                      for zi in np.nonzero(elig)[0]}
+            if len(deltas) != 1 or deltas == {0}:
+                return None
+        return (tuple(self._gen_opens), tuple(norm), tuple(slots))
+
+    def _maybe_replay(self, n_rem: int) -> int:
+        """At a generation boundary (every current-gen slot full and the
+        pour wants a new node): close the generation; if it matches the
+        previous one, commit as many whole copies as fit."""
+        if not self._gen_track or not self._gen_slots:
+            return 0
+        if any(self.rem[s] > 0 for s in self._gen_slots):
+            return 0  # mid-generation open (zone set growing): no boundary
+        closed = self._gen_close()
+        template, self._gen_template = self._gen_template, closed
+        self._gen_slots = []
+        self._gen_opens = []
+        self._gen_ztot = {}
+        if closed is None or template is None \
+                or closed[:2] != template[:2]:
+            return 0
+        st, enc = self.st, self.enc
+        opens, norm, slots = closed
+        takes = [int(self.take[s]) for s in slots]
+        total = sum(takes)
+        if total <= 0:
+            return 0
+        k = n_rem // total
+        k = min(k, (st.N - st.E - st.num_nodes) // len(slots))
+        if enc.pools:
+            pool_pods: Dict[int, int] = {}
+            for (pi, _zi), t in zip(opens, takes):
+                pool_pods[pi] = pool_pods.get(pi, 0) + t
+            for pi, dp in pool_pods.items():
+                budget = ffd._pool_budget(enc, st.pool_used, pi, self.R)
+                k = min(k, int(budget) // dp)
+        if k < 1:
+            return 0
+        for _ in range(k):
+            new_slots = [self._clone_slot(tsl, pi, zi, take)
+                         for (pi, zi), tsl, take in
+                         zip(opens, slots, takes)]
+            for entry in norm:
+                if entry[0] == "cyc":
+                    _, pat, kk = entry
+                    self.runs.append((
+                        "cyc", [(new_slots[j], ln) for j, ln in pat], kk))
+                else:
+                    j, ln = entry
+                    self.runs.append((new_slots[j], ln))
+        # the template stays armed: the NEXT boundary compares against it
+        return total * k
+
     def _open_new(self, n_rem: int) -> int:
         st, enc, g = self.st, self.enc, self.g
+        placed = self._maybe_replay(n_rem)
+        if placed:
+            return placed
         hcap = self._host_cap_new()
         if hcap < 1:
             return 0
@@ -829,9 +970,73 @@ class _Pour:
                 self._enforced_z or self.zaf)) else int(BIG)
             run = min(cap, hcap, budget, n_rem, run_z)
             run = max(run, 1)
+            if self._gen_track:
+                if not self._gen_slots:
+                    self._gen_runs_start = len(self.runs)
+                    self._gen_ztot = {}
+                self._gen_slots.append(slot)
+                self._gen_opens.append((pi, zi))
             self._commit(slot, zi, int(run), kind="new")
+            if (run == 1 and hcap == 1 and zi is None
+                    and not self.zsp and not self.zaf and not self.hsp
+                    and all(anti and own for _gh, anti, own in self.haf)
+                    and n_rem > 1):
+                # cap-1 hostname-anti ladder (the one-pod-per-node
+                # deployment pattern): every subsequent pod provably
+                # repeats this exact decision — no slot readmits (anti
+                # occupancy and full slots are monotone, zone state is
+                # untouched), earlier pools keep failing for their static
+                # reasons, this pool's budget only decreases — so clone
+                # the fresh-node state instead of re-running the event
+                # loop once per pod
+                return int(run) + self._bulk_anti_clones(slot, pi,
+                                                         n_rem - 1)
             return int(run)
         return 0
+
+    def _clone_slot(self, template: int, pi: int, zi: Optional[int],
+                    take: int) -> int:
+        """Open a new node whose state copies `template` (a same-pour slot
+        whose open parameters are proven identical), committing `take`
+        pods on it. Shared by the cap-1 anti ladder and the generation
+        replay so open-slot bookkeeping lives in one place."""
+        st = self.st
+        slot = st.E + st.num_nodes
+        st.num_nodes += 1
+        st.alive[slot] = True
+        st.pool[slot] = pi
+        st.zones[slot] = st.zones[template].copy()
+        st.ct[slot] = st.ct[template].copy()
+        st.used[slot] = st.used[template].copy()
+        self.cand[slot] = self.cand[template]
+        self.adm[slot] = True
+        self.rem[slot] = self.rem[template]
+        self._slot_ready[slot] = True
+        if zi is not None:
+            self.ts.zfix[slot] = zi
+        self.take[slot] = take
+        st.pool_used[pi] += take * self.R
+        self.touched.add(slot)
+        self._record(slot, zi, take)
+        return slot
+
+    def _bulk_anti_clones(self, template: int, pi: int, want: int) -> int:
+        """Open `want` more one-pod nodes identical to `template`
+        (post-commit state copied), bounded by slot space and pool
+        budget. Exactly the sequential pour's decisions, minus the
+        per-event admissibility scans."""
+        st, enc = self.st, self.enc
+        placed = 0
+        while placed < want:
+            if st.num_nodes >= st.N - st.E:
+                break
+            if ffd._pool_budget(enc, st.pool_used, pi, self.R) < 1:
+                break
+            slot = self._clone_slot(template, pi, None, 1)
+            self.runs.append((slot, 1))
+            self.event_log.append((slot, None, 1, "new"))
+            placed += 1
+        return placed
 
     def _commit(self, slot: int, zi: Optional[int], count: int,
                 kind: str = "place") -> None:
@@ -854,11 +1059,16 @@ class _Pour:
         """Mirror the closed-form commit: candidate-intersection + refit
         against final aggregate usage, zone/ct mask narrowing."""
         st, enc = self.st, self.enc
-        for slot in sorted(self.touched):
-            if st.pool[slot] < 0:
-                continue  # existing node: no narrowing
-            fit = (st.used[slot][None, :] <= enc.A).all(axis=1)
-            st.types[slot] = self.cand[slot] & fit
+        open_slots = np.array(
+            [s for s in sorted(self.touched) if st.pool[s] >= 0],
+            dtype=np.int64)
+        if not len(open_slots):
+            return
+        # one [S, T, D] comparison instead of S separate [T, D] passes
+        fit = (st.used[open_slots][:, None, :]
+               <= enc.A[None, :, :]).all(axis=2)
+        st.types[open_slots] = self.cand[open_slots] & fit
+        for slot in open_slots:
             if self.ts.zfix[slot] < 0:
                 st.zones[slot] &= self.agz
             st.ct[slot] &= self.agc

@@ -366,6 +366,14 @@ class TPUSolver(Solver):
         #: distinct mask patterns (usually one per zone), so the [T, Z, C]
         #: reduction runs once per pattern instead of once per node
         best_cache: Dict[bytes, np.ndarray] = {}
+        #: (type-mask, zone-mask, ct-mask) -> cheapest-first type-name
+        #: list; same sharing argument as best_cache (one argsort per
+        #: pattern instead of one per node)
+        order_cache: Dict[bytes, List[str]] = {}
+        #: (pool, groups, fixed-zone) -> merged node requirements; ladders
+        #: mint hundreds of nodes with identical group mixes
+        reqs_cache: Dict[Tuple, Requirements] = {}
+        zfix = final.get("zfix")
         for slot in sorted(slot_pods):
             pods = slot_pods[slot]
             pool = enc.pools[int(final["pool"][slot])]
@@ -374,25 +382,36 @@ class TPUSolver(Solver):
             cmask = final["ct"][slot]
             # price per candidate type under the node's (zone, ct) masks
             ck = zmask.tobytes() + cmask.tobytes()
-            best = best_cache.get(ck)
-            if best is None:
-                pz = np.where(
-                    enc.avail & zmask[None, :, None] & cmask[None, None, :],
-                    enc.price, np.int64(1) << 62)
-                best = best_cache[ck] = pz.min(axis=(1, 2))
-            # (price, name) order: types are name-sorted in the encoding,
-            # so a stable argsort on price alone breaks ties by name
-            idx = np.nonzero(tmask)[0]
-            order = idx[np.argsort(best[idx], kind="stable")]
-            reqs = pool.spec.nodepool.scheduling_requirements()
-            for gi in slot_groups[slot]:
-                reqs = reqs.union(enc.groups[gi].reqs)
-            zfix = final.get("zfix")
-            if zfix is not None and zfix[slot] >= 0:
-                # topology pinned this node's zone (_choose_zone); the
-                # oracle narrows node requirements with ZONE IN [chosen]
-                reqs = reqs.add(Requirement.new(
-                    L.ZONE, IN, [enc.zones[int(zfix[slot])]]))
+            ok = tmask.tobytes() + ck
+            type_names = order_cache.get(ok)
+            if type_names is None:
+                best = best_cache.get(ck)
+                if best is None:
+                    pz = np.where(
+                        enc.avail & zmask[None, :, None]
+                        & cmask[None, None, :],
+                        enc.price, np.int64(1) << 62)
+                    best = best_cache[ck] = pz.min(axis=(1, 2))
+                # (price, name) order: types are name-sorted in the
+                # encoding, so a stable argsort on price alone breaks
+                # ties by name
+                idx = np.nonzero(tmask)[0]
+                order = idx[np.argsort(best[idx], kind="stable")]
+                type_names = order_cache[ok] = \
+                    [enc.type_names[i] for i in order]
+            zf = int(zfix[slot]) if zfix is not None else -1
+            rk = (int(final["pool"][slot]), tuple(slot_groups[slot]), zf)
+            reqs = reqs_cache.get(rk)
+            if reqs is None:
+                reqs = pool.spec.nodepool.scheduling_requirements()
+                for gi in slot_groups[slot]:
+                    reqs = reqs.union(enc.groups[gi].reqs)
+                if zf >= 0:
+                    # topology pinned this node's zone (_choose_zone); the
+                    # oracle narrows node requirements with ZONE IN [chosen]
+                    reqs = reqs.add(Requirement.new(
+                        L.ZONE, IN, [enc.zones[zf]]))
+                reqs_cache[rk] = reqs
             used_vec = final["used"][slot]
             # per-group chunks arrive in ascending (ns, name) order, so
             # the concatenation is a few sorted runs — timsort is ~O(n)
@@ -402,7 +421,7 @@ class TPUSolver(Solver):
                 nodepool=pool.spec.nodepool.metadata.name,
                 requirements=reqs,
                 pod_names=names,
-                instance_type_names=[enc.type_names[i] for i in order],
+                instance_type_names=type_names,
                 requests=Resources({d: int(used_vec[i])
                                     for i, d in enumerate(enc.dims)}),
                 taints=list(pool.spec.nodepool.template.taints),

@@ -120,6 +120,24 @@ class TestAffinity:
         # at most one pod per zone; the rest are unschedulable
         assert len(res.unschedulable) >= 2
 
+    def test_anti_plus_positive_self_affinity(self, env, solvers):
+        """Self anti-affinity AND positive self-affinity on hostname is
+        self-contradictory after the first pod: pod 1 seeds a node, pod 2
+        is blocked by anti on the occupied node and by positive affinity
+        everywhere else. The bulk cap-1 ladder must NOT fire here
+        (regression: its gate once ignored non-anti haf entries and
+        over-provisioned one node per pod)."""
+        pods = make_pods(6, cpu="1", memory="2Gi", prefix="ap",
+                         pod_affinity=[
+                             PodAffinityTerm(topology_key=L.HOSTNAME,
+                                             group="ap", anti=True),
+                             PodAffinityTerm(topology_key=L.HOSTNAME,
+                                             group="ap", anti=False)])
+        res = assert_equivalent(env.snapshot(pods, [env.nodepool("d")]),
+                                solvers)
+        assert len(res.new_nodes) == 1
+        assert len(res.unschedulable) == 5
+
     def test_zone_self_affinity_colocates(self, env, solvers):
         pods = make_pods(10, cpu="1", memory="2Gi", prefix="co",
                          pod_affinity=[PodAffinityTerm(
