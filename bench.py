@@ -19,6 +19,7 @@ Configs (BASELINE.md):
   3. topology: zone spread (maxSkew=1) + hostname anti-affinity groups
   4. consolidation: all deletion candidates of a 200-node cluster, 1 batch
   5. spot+OD across 3 weighted NodePools with limits
+  6. preference relaxation: soft spread + preferred anti-affinity at 50k
 
 Usage: python bench.py [--pods N] [--rounds N] [--backend jax|numpy]
                        [--all] [--config N]
@@ -109,6 +110,49 @@ def build_config3(env, n_pods):
         pod_affinity=[PodAffinityTerm(topology_key=L.HOSTNAME,
                                       group="anti", anti=True)])
     return env.snapshot(pods, [env.nodepool("bench-c3")])
+
+
+def build_config6(env, n_pods):
+    """Preference relaxation at headline scale (config-2 shape with soft
+    constraints on a meaningful fraction): 20% of pods carry
+    ScheduleAnyway zone spread, 10% carry preferred (soft) zone
+    anti-affinity in small groups — the solver's relaxation wrapper
+    (solver/preferences.py) hardens and selectively relaxes them."""
+    from karpenter_provider_aws_tpu.apis import labels as L
+    from karpenter_provider_aws_tpu.apis.objects import (
+        PodAffinityTerm, TopologySpreadConstraint)
+    from karpenter_provider_aws_tpu.fake.environment import make_pods
+
+    n_plain = int(n_pods * 0.70)
+    n_soft_spread = int(n_pods * 0.20)
+    n_soft_anti = n_pods - n_plain - n_soft_spread
+    pods = make_pods(n_plain, cpu="250m", memory="512Mi", prefix="plain6")
+    groups = max(1, min(10, n_soft_spread))
+    per = n_soft_spread // groups
+    for gi in range(groups):
+        cnt = per if gi < groups - 1 else n_soft_spread - per * (groups - 1)
+        pods += make_pods(
+            cnt, cpu="500m", memory="1Gi", prefix=f"soft{gi:02d}",
+            group=f"soft{gi:02d}",
+            topology_spread=[TopologySpreadConstraint(
+                max_skew=1, topology_key=L.ZONE,
+                when_unsatisfiable="ScheduleAnyway", group=f"soft{gi:02d}")])
+    # preferred anti-affinity groups larger than the zone count: the
+    # hardened constraint cannot hold, so the wrapper must relax.
+    # ~200-pod groups (deployment-sized) — group COUNT stays realistic;
+    # a fleet of 8-pod groups would be a group-count stress test, not a
+    # relaxation benchmark
+    anti_groups = max(1, n_soft_anti // 200)
+    per = n_soft_anti // anti_groups
+    for gi in range(anti_groups):
+        cnt = per if gi < anti_groups - 1 else n_soft_anti - per * (anti_groups - 1)
+        pods += make_pods(
+            cnt, cpu="1", memory="2Gi", prefix=f"panti{gi:03d}",
+            group=f"panti{gi:03d}",
+            pod_affinity=[PodAffinityTerm(
+                topology_key=L.ZONE, group=f"panti{gi:03d}", anti=True,
+                required=False)])
+    return env.snapshot(pods, [env.nodepool("bench-c6")])
 
 
 def build_config5(env, n_pods):
@@ -203,6 +247,36 @@ def build_config4(env, n_nodes=200, n_replaceable=10):
 # runners
 # ---------------------------------------------------------------------------
 
+def _count_engines(tpu):
+    """Wrap the solver's engine entry points so every result names what
+    ACTUALLY served each solve (a wedged tunnel or a cost-router choice
+    must never let a host-twin number masquerade as a device number)."""
+    counts = {"host": 0, "dev": 0}
+    orig_np, orig_jax = tpu._run_numpy, tpu._run_jax
+
+    def run_np(*a, **k):
+        counts["host"] += 1
+        return orig_np(*a, **k)
+
+    def run_jax(*a, **k):
+        counts["dev"] += 1
+        return orig_jax(*a, **k)
+
+    tpu._run_numpy, tpu._run_jax = run_np, run_jax
+    return counts
+
+
+def _engine_report(counts):
+    from karpenter_provider_aws_tpu.solver.route import (dev_device_count,
+                                                         dev_platform)
+    return {
+        "host_twin_solves": counts["host"],
+        "device_solves": counts["dev"],
+        "device_platform": dev_platform(),
+        "device_count": dev_device_count(),
+    }
+
+
 def run_solver_config(name, snap, backend, rounds):
     from karpenter_provider_aws_tpu.solver import CPUSolver
     from karpenter_provider_aws_tpu.solver.tpu import TPUSolver
@@ -219,6 +293,7 @@ def run_solver_config(name, snap, backend, rounds):
     # punctuated by gen2 pauses over the oracle's garbage
     gc.collect()
     gc.freeze()
+    counts = _count_engines(tpu)
     times = []
     for _ in range(rounds):
         t0 = time.perf_counter()
@@ -234,6 +309,7 @@ def run_solver_config(name, snap, backend, rounds):
         "types": max((len(s.instance_types) for s in snap.nodepools),
                      default=0),
         "rounds": rounds,
+        "engine": _engine_report(counts),
         "decisions": ref.summary(),
     }
 
@@ -341,7 +417,77 @@ def run_config4(backend, rounds, n_nodes=200):
         "identical_decisions": identical,
         "candidates": len(cands), "decision": f"{ref[0]} {ref[1]}",
         "rounds": rounds,
+        "engine": _engine_report({"host": -1, "dev": -1}),
     }
+
+
+def run_device_probe(pods=50_000):
+    """The link-vs-kernel decomposition (BASELINE 'device-engine truth'):
+    is the accelerator reachable, what does one round trip cost, and how
+    does a config-2-shaped device solve split into h2d / kernel / d2h?
+    On a wedged or absent backend this reports that fact instead of
+    hanging — no number here may masquerade as a device number."""
+    from karpenter_provider_aws_tpu.solver.route import (dev_device_count,
+                                                         dev_platform,
+                                                         device_alive)
+    out = {"alive": device_alive()}  # blocking, 90s subprocess deadline
+    out["platform"] = dev_platform()
+    out["devices"] = dev_device_count()
+    if not out["alive"]:
+        out["note"] = (
+            "device backend unreachable (wedged tunnel or no accelerator): "
+            "no RTT/kernel decomposition is possible from this host; the "
+            "cost router serves the bit-identical host twin")
+        print(json.dumps(out))
+        return
+    import jax.numpy as jnp
+    import numpy as np
+
+    from karpenter_provider_aws_tpu.fake.environment import Environment
+    from karpenter_provider_aws_tpu.solver.tpu import TPUSolver
+
+    # link RTT: tiny tensor up + back, best of 20
+    small = np.zeros(128, np.int64)
+    d = jnp.asarray(small)
+    np.asarray(d)
+    rtts = []
+    for _ in range(20):
+        t0 = time.perf_counter()
+        np.asarray(jnp.asarray(small))
+        rtts.append((time.perf_counter() - t0) * 1000)
+    out["link_rtt_ms"] = round(min(rtts), 3)
+
+    env = Environment()
+    snap = build_config2(env, pods)
+    tpu = TPUSolver(backend="jax")
+    phases = {}
+
+    def timed_dispatch(buf, **statics):
+        from karpenter_provider_aws_tpu.ops.ffd_jax import solve_scan_packed1
+        t0 = time.perf_counter()
+        d_buf = jnp.asarray(buf)
+        d_buf.block_until_ready()
+        t1 = time.perf_counter()
+        o = solve_scan_packed1(d_buf, **statics)
+        o.block_until_ready()
+        t2 = time.perf_counter()
+        res = np.asarray(o)
+        t3 = time.perf_counter()
+        phases.update(h2d_ms=(t1 - t0) * 1e3, kernel_ms=(t2 - t1) * 1e3,
+                      d2h_ms=(t3 - t2) * 1e3,
+                      in_bytes=buf.nbytes, out_bytes=res.nbytes)
+        return res
+
+    tpu._dispatch = timed_dispatch
+    tpu._dev_devices = lambda: 1  # decompose the packed single-device path
+    t0 = time.perf_counter()
+    tpu.solve(snap)  # compile
+    compile_s = time.perf_counter() - t0
+    tpu.solve(snap)  # warm: phases now reflect steady state
+    out["compile_s_first_solve"] = round(compile_s, 1)
+    out["warm"] = {k: (round(v, 2) if isinstance(v, float) else v)
+                   for k, v in phases.items()}
+    print(json.dumps(out))
 
 
 def run_interruption_bench(counts=(100, 1000, 5000, 15000)):
@@ -389,21 +535,27 @@ def main():
                     choices=["auto", "jax", "numpy"])
     ap.add_argument("--all", action="store_true",
                     help="run all 5 BASELINE configs (default: headline only)")
-    ap.add_argument("--config", type=int, choices=[1, 2, 3, 4, 5],
+    ap.add_argument("--config", type=int, choices=[1, 2, 3, 4, 5, 6],
                     help="run a single config and print its row")
     ap.add_argument("--interruption", action="store_true",
                     help="run only the interruption throughput benchmark")
+    ap.add_argument("--probe-device", action="store_true",
+                    help="link-vs-kernel decomposition of the device path")
     args = ap.parse_args()
 
     if args.interruption:
         print(json.dumps({"interruption": run_interruption_bench()}))
+        return
+    if args.probe_device:
+        run_device_probe(args.pods)
         return
 
     from karpenter_provider_aws_tpu.fake.environment import Environment
 
     env = Environment()
     builders = {1: (build_config1, 1000), 2: (build_config2, args.pods),
-                3: (build_config3, args.pods), 5: (build_config5, args.pods)}
+                3: (build_config3, args.pods), 5: (build_config5, args.pods),
+                6: (build_config6, args.pods)}
 
     def run_one(ci):
         if ci == 4:
@@ -423,7 +575,7 @@ def main():
         # next one's tail latency — measured: config 3 p99 ~305ms when
         # sharing a process with config 1's leftovers vs ~170ms isolated
         import subprocess
-        for i, ci in enumerate((1, 3, 4, 5)):
+        for i, ci in enumerate((1, 3, 4, 5, 6)):
             if i:
                 # cooldown between configs: sustained back-to-back load
                 # (oracle solves are minutes of pinned CPU) degrades later

@@ -260,13 +260,17 @@ def canonical_pod_groups(pods: Sequence[Pod]) -> List[Tuple[Tuple, List[Pod]]]:
     for _attempt in range(3):
         by_sid: Dict[int, List[Pod]] = {}
         epoch = _SIG_EPOCH
+        prev_sid = -1
+        bucket: List[Pod] = []
         for p in pods:
             ent = p.__dict__.get("_sig_id")
             sid = ent[1] if (ent is not None and ent[0] == epoch) \
                 else _sig_id(p)
-            bucket = by_sid.get(sid)
-            if bucket is None:
-                by_sid[sid] = bucket = []
+            if sid != prev_sid:  # pods arrive in same-sig runs: skip the
+                prev_sid = sid   # bucket lookup inside a run
+                bucket = by_sid.get(sid)
+                if bucket is None:
+                    by_sid[sid] = bucket = []
             bucket.append(p)
         # ids assigned before an intern-table reset collide with ids after
         # it; resolve ids back to sig tuples under the lock, and only if

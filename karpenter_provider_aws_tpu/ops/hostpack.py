@@ -98,3 +98,28 @@ def unpack_outputs1(buf, T, D, Z, C, G, E, P, n_max) -> dict:
     vals = split(buf[:n_i64], li)
     vals.update(split(bool_flat, lb))
     return vals
+
+
+def unpack_inputs1(buf, T, D, Z, C, G, E, P, K=0, M=0) -> dict:
+    """Inverse of pack_inputs1 (the sidecar server's mesh path unpacks
+    the wire buffer back into arrays to shard them over its local mesh)."""
+    li = in_layout_i64(T, D, Z, C, G, E, P, K, M)
+    lb = in_layout_bool(T, D, Z, C, G, E, P, K, M)
+    n_i64 = layout_sizes(li)
+    bool_flat = unpack_bits(np.ascontiguousarray(buf[n_i64:]),
+                            layout_sizes(lb))
+    vals = split(np.asarray(buf[:n_i64]), li)
+    vals.update(split(bool_flat, lb))
+    return vals
+
+
+def pack_outputs1(arrays: dict, T, D, Z, C, G, E, P, n_max) -> np.ndarray:
+    """Inverse of unpack_outputs1 (the server's mesh path re-packs the
+    carry into the single wire buffer the client expects)."""
+    li, lb = out_layout(T, D, Z, C, G, E, P, n_max)
+    i64 = np.concatenate([
+        np.asarray(arrays[nm]).reshape(-1).astype(np.int64)
+        for nm, _ in li])
+    bl = np.concatenate([np.asarray(arrays[nm]).reshape(-1).astype(bool)
+                         for nm, _ in lb])
+    return np.concatenate([i64, pack_bits(bl)])

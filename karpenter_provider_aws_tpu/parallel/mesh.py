@@ -123,6 +123,27 @@ def _solve_sharded(inp: KernelInputs, n_max: int, E: int, P: int,
     return fn(inp)
 
 
+def dispatch_mesh(arrays: dict, *, n_max: int, E: int, P: int, V: int,
+                  ndev: int, cache: dict) -> dict:
+    """The one mesh-dispatch implementation shared by the local solver
+    (TPUSolver._dispatch_mesh) and the sidecar server: build/reuse the
+    mesh (cache key: device count), run the type-parallel solve, and
+    return the carry as the same dict shape as hostpack.unpack_outputs1
+    — so the two paths can never drift apart."""
+    mesh = cache.get("mesh")
+    if mesh is None or mesh.devices.size != ndev:
+        mesh = cache["mesh"] = solve_mesh(ndev)
+    takes, leftover, carry = solve_scan_sharded(
+        KernelInputs(**arrays), n_max=n_max, E=E, P=P, mesh=mesh, V=V)
+    return dict(
+        takes=np.asarray(takes), leftover=np.asarray(leftover),
+        num_nodes=np.asarray([carry.num_nodes]),
+        used=np.asarray(carry.used), pool=np.asarray(carry.pool),
+        pool_used=np.asarray(carry.pool_used),
+        types=np.asarray(carry.types), zones=np.asarray(carry.zones),
+        ct=np.asarray(carry.ct), alive=np.asarray(carry.alive))
+
+
 def solve_scan_sharded(inp: KernelInputs, n_max: int, E: int, P: int,
                        mesh: Mesh, V: int = 0
                        ) -> Tuple[jax.Array, jax.Array, Carry]:

@@ -74,5 +74,10 @@ class RemoteSolver(TPUSolver):
         """Sidecar liveness = a short-deadline Info round trip."""
         return self.client.info(timeout=5.0)["devices"] >= 1
 
+    def _dev_devices(self) -> int:
+        """Always the packed wire dispatch: the SERVER owns the
+        mesh-vs-single decision for its local devices (server.py solve)."""
+        return 1
+
     def _dispatch(self, buf: np.ndarray, **statics) -> np.ndarray:
         return self.client.solve_buffer(buf, statics)

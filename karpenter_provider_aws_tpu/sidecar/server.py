@@ -88,14 +88,38 @@ class _Handler:
         return kv
 
     def solve(self, request: bytes, context) -> bytes:
+        import jax
         import jax.numpy as jnp
 
         from ..ops.ffd_jax import solve_scan_packed1
         arrays = arena_unpack(request)
         buf = arrays["buf"]
         kv = self._validate(arrays["statics"], buf, context)
+        ndev = len(jax.devices())
+        if ndev > 1:
+            return arena_pack({"out": self._solve_mesh(buf, kv, ndev)})
         o_buf = solve_scan_packed1(jnp.asarray(buf), **kv)
         return arena_pack({"out": np.asarray(o_buf)})
+
+    def _solve_mesh(self, buf: np.ndarray, kv: dict,
+                    ndev: int) -> np.ndarray:
+        """Multi-device server: unpack the wire buffer, run the SAME
+        shared mesh dispatch as the local solver (parallel/mesh.py
+        dispatch_mesh), re-pack the carry into the single output buffer
+        the client expects — the wire protocol is identical either way."""
+        from ..ops.hostpack import pack_outputs1, unpack_inputs1
+        from ..parallel.mesh import dispatch_mesh
+        dims = {k: kv[k] for k in ("T", "D", "Z", "C", "G", "E", "P",
+                                   "K", "M")}
+        arrays = unpack_inputs1(np.asarray(buf), **dims)
+        if kv["K"] == 0:
+            for mk in ("mv_floor", "mv_pairs_t", "mv_pairs_v"):
+                arrays.pop(mk, None)
+        cache = self.__dict__.setdefault("_mesh_cache", {})
+        out = dispatch_mesh(arrays, n_max=kv["n_max"], E=kv["E"],
+                            P=kv["P"], V=kv["V"], ndev=ndev, cache=cache)
+        return pack_outputs1(out, kv["T"], kv["D"], kv["Z"], kv["C"],
+                             kv["G"], kv["E"], kv["P"], kv["n_max"])
 
     def info(self, request: bytes, context) -> bytes:
         import jax

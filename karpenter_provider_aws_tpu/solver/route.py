@@ -151,13 +151,50 @@ def _probe_device(timeout: float = 90.0) -> bool:
     code = "import jax\n"
     if plat:
         code += f"jax.config.update('jax_platforms', {plat!r})\n"
-    code += "jax.devices(); print('ok')"
+    code += ("ds = jax.devices()\n"
+             "print('ok', len(ds), ds[0].platform)")
     try:
         proc = subprocess.run([sys.executable, "-c", code],
                               timeout=timeout, capture_output=True)
-        return proc.returncode == 0 and b"ok" in proc.stdout
+        if proc.returncode != 0 or b"ok" not in proc.stdout:
+            return False
+        global _DEV_COUNT, _DEV_PLATFORM
+        try:
+            # parse relative to the 'ok' token: runtime banners may
+            # precede it on stdout, and a misparse here would silently
+            # disable the mesh path on a healthy multi-chip host
+            parts = proc.stdout.split()
+            i = parts.index(b"ok")
+            _DEV_COUNT = int(parts[i + 1])
+            _DEV_PLATFORM = parts[i + 2].decode()
+        except (IndexError, ValueError):
+            _DEV_COUNT = 1
+        return True
     except Exception:
         return False
+
+
+#: device count/platform observed by the last successful liveness probe
+#: (the mesh dispatch decision rides the same subprocess probe as
+#: liveness — a wedged link must never block a count query either)
+_DEV_COUNT = 0
+_DEV_PLATFORM = ""
+
+
+def dev_device_count() -> int:
+    """Nonblocking: devices on the probed backend; 0 while the probe is
+    pending or the backend is dead. Single-device hosts dispatch the
+    packed kernel; multi-device hosts dispatch the type-parallel mesh
+    solve (parallel/mesh.py)."""
+    return _DEV_COUNT if _device_alive.nonblocking() is True else 0
+
+
+def dev_platform() -> str:
+    """Nonblocking: probed backend platform name ('tpu', 'cpu', ...);
+    'unavailable' while dead/pending — benches record which engine
+    ACTUALLY served (a wedged tunnel must never be reported as tpu)."""
+    alive = _device_alive.nonblocking()
+    return _DEV_PLATFORM if alive is True else "unavailable"
 
 
 #: the shared local-device liveness cache (Router.alive default)

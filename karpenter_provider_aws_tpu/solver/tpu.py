@@ -133,17 +133,17 @@ class TPUSolver(Solver):
                 lambda: self._run_jax(enc, ex_alloc, ex_used, ex_compat))
         return self._decode(enc, existing, takes, leftover, final)
 
-    @staticmethod
-    def _bucket_key(enc: SnapshotEncoding, E: int) -> Tuple:
+    def _bucket_key(self, enc: SnapshotEncoding, E: int) -> Tuple:
         """Shape bucket = the padded statics that key the XLA compile
-        cache (_run_jax's pow2 bucketing), so router stats live exactly as
-        long as a compiled kernel does."""
+        cache (_run_jax's pow2 bucketing) + the dev-engine device count
+        (the mesh solve is its own engine with its own latency curve), so
+        router stats live exactly as long as a compiled kernel does."""
         G, T = len(enc.groups), len(enc.types)
         Gp = max(1, 1 << (G - 1).bit_length())
         Ep = 1 << (E - 1).bit_length() if E else 0
         Pp = max(1, 1 << (len(enc.pools) - 1).bit_length())
         return (T, max(8, len(enc.dims)), len(enc.zones), Gp, Ep, Pp,
-                enc.mv_K)
+                enc.mv_K, self._dev_devices())
 
     # ------------------------------------------------------------------
     def _encode_existing(self, enc: SnapshotEncoding,
@@ -206,6 +206,24 @@ class TPUSolver(Solver):
         d_buf = jnp.asarray(buf)  # async enqueue; no sync before dispatch
         # np.asarray is the only sync: it waits for exec + fetch at once
         return np.asarray(solve_scan_packed1(d_buf, **statics))
+
+    def _dev_devices(self) -> int:
+        """Device count of the dev engine (nonblocking, probed). >1 routes
+        the type-parallel mesh solve; the sidecar's RemoteSolver pins this
+        to 1 — its SERVER makes the mesh decision for its own devices."""
+        from .route import dev_device_count
+        return dev_device_count()
+
+    def _dispatch_mesh(self, arrays: dict, *, T, D, Z, C, G, E, P, K, V, M,
+                       n_max: int, ndev: int) -> dict:
+        """The multi-device solve: catalog/candidate tensors sharded over
+        the type axis, carry replicated, pmax collectives across the mesh
+        (parallel/mesh.py dispatch_mesh — shared with the sidecar server).
+        Same outputs as unpack_outputs1."""
+        from ..parallel.mesh import dispatch_mesh
+        cache = self.__dict__.setdefault("_mesh_cache", {})
+        return dispatch_mesh(arrays, n_max=n_max, E=E, P=P, V=V,
+                             ndev=ndev, cache=cache)
 
     def _run_jax(self, enc, ex_alloc, ex_used, ex_compat):
         from ..ops.hostpack import pack_inputs1, unpack_outputs1
@@ -274,7 +292,10 @@ class TPUSolver(Solver):
             arrays.update(mv_floor=mv_floor_p, mv_pairs_t=enc.mv_pairs_t,
                           mv_pairs_v=enc.mv_pairs_v)
 
-        buf = pack_inputs1(arrays, T, Dp, Z, C, Gp, Ep, Pp, K, M)
+        ndev = self._dev_devices()
+        buf = None
+        if ndev <= 1:
+            buf = pack_inputs1(arrays, T, Dp, Z, C, Gp, Ep, Pp, K, M)
 
         # --- bucketed new-node slots with overflow retry ------------------
         # Steady state needs far fewer than n_max slots; a small N keeps the
@@ -283,9 +304,16 @@ class TPUSolver(Solver):
         # invariant to N once N is large enough: spare slots never fill).
         n_bucket = self._bucket
         while True:
-            o_buf = self._dispatch(buf, T=T, D=Dp, Z=Z, C=C, G=Gp, E=Ep,
-                                   P=Pp, K=K, V=V, M=M, n_max=n_bucket)
-            out = unpack_outputs1(o_buf, T, Dp, Z, C, Gp, Ep, Pp, n_bucket)
+            if ndev > 1:
+                out = self._dispatch_mesh(
+                    arrays, T=T, D=Dp, Z=Z, C=C, G=Gp, E=Ep, P=Pp,
+                    K=K, V=V, M=M, n_max=n_bucket, ndev=ndev)
+            else:
+                o_buf = self._dispatch(buf, T=T, D=Dp, Z=Z, C=C, G=Gp,
+                                       E=Ep, P=Pp, K=K, V=V, M=M,
+                                       n_max=n_bucket)
+                out = unpack_outputs1(o_buf, T, Dp, Z, C, Gp, Ep, Pp,
+                                      n_bucket)
             exhausted = (out["leftover"].sum() > 0
                          and int(out["num_nodes"][0]) >= n_bucket)
             if not exhausted or n_bucket >= self.n_max:

@@ -129,7 +129,14 @@ class TestStaticsCompat:
                             [env.nodepool("legacy")])
         captured = {}
 
+        from karpenter_provider_aws_tpu.solver.route import device_alive
+        assert device_alive()  # resolve the probe: the capture needs the
+        #                        real device dispatch, not the host twin
+
         class _Capture(TPUSolver):
+            def _dev_devices(self):
+                return 1  # force the packed wire path we're capturing
+
             def _dispatch(self, buf, **statics):
                 captured["buf"] = buf.copy()
                 captured["statics"] = dict(statics)
