@@ -153,7 +153,8 @@ class DisruptionController:
                  provisioner,  # controllers.provisioning.Provisioner
                  evaluator: Optional[ConsolidationEvaluator] = None,
                  metrics=None, clock=time.time,
-                 consolidation_min_lifetime: float = 0.0):
+                 consolidation_min_lifetime: float = 0.0,
+                 consolidation_timeout: float = 60.0):
         self.kube = kube
         self.state = state
         self.cloudprovider = cloudprovider
@@ -163,6 +164,11 @@ class DisruptionController:
         self.metrics = metrics
         self.clock = clock
         self.consolidation_min_lifetime = consolidation_min_lifetime
+        #: evaluation budget: an underutilized pass running longer than
+        #: this counts a consolidation timeout (the reference aborts its
+        #: search at a deadline; the batched kernel finishes the pass, so
+        #: the metric marks budget overruns instead of truncations)
+        self.consolidation_timeout = consolidation_timeout
         self._in_flight: List[_InFlight] = []
         #: claim name -> (frozenset of pod names, when it last changed);
         #: anchors consolidate_after to the last pod-set change
@@ -196,12 +202,18 @@ class DisruptionController:
         for reason in _GRACEFUL_ORDER:
             t0 = time.perf_counter()
             cmd = self._compute(reason, candidates)
+            elapsed = time.perf_counter() - t0
             if self.metrics is not None:
                 # metrics.md:181
                 self.metrics.observe(
                     "karpenter_voluntary_disruption_decision_evaluation"
                     "_duration_seconds",
-                    time.perf_counter() - t0, labels={"method": reason})
+                    elapsed, labels={"method": reason})
+                if reason == REASON_UNDERUTILIZED \
+                        and elapsed > self.consolidation_timeout:
+                    self.metrics.inc(
+                        "karpenter_voluntary_disruption_consolidation"
+                        "_timeouts_total")
             if cmd is not None:
                 self._execute(cmd)
                 return cmd
@@ -632,6 +644,12 @@ class DisruptionController:
                     if self.kube.try_get("NodeClaim", name) is not None:
                         self.kube.delete("NodeClaim", name)
                 log.info("disruption rolled back: %s", inf.command.summary())
+                if self.metrics is not None:
+                    # a command that could not complete = a failed item on
+                    # the disruption queue (metrics.md queue_failures)
+                    self.metrics.inc(
+                        "karpenter_voluntary_disruption_queue"
+                        "_failures_total")
                 acted = True
                 continue
             if all(states):

@@ -28,16 +28,23 @@ log = logging.getLogger(__name__)
 REGISTRATION_TTL = 15 * 60  # core: claims that never register are reaped
 
 
-def drain_node_pods(kube: FakeKube, node_name: str) -> None:
+def drain_node_pods(kube: FakeKube, node_name: str, metrics=None) -> None:
     """Release a doomed node's pods back to Pending (terminal pods are
     released, never resurrected). Shared by the terminator and the
     nodeclaim GC so drain semantics cannot diverge."""
+    evicted = 0
     for pod in kube.list("Pod"):
         if pod.node_name == node_name:
             pod.node_name = ""
             if pod.phase not in ("Succeeded", "Failed"):
                 pod.phase = "Pending"
+                evicted += 1
             kube.update(pod)
+    if metrics is not None:
+        if evicted:
+            metrics.inc("karpenter_nodes_eviction_requests_total", evicted,
+                        labels={"node_name": node_name})
+        metrics.inc("karpenter_nodes_drained_total")
 
 
 class NodeClaimLifecycle:
@@ -172,15 +179,36 @@ class Terminator:
                         - claim.metadata.deletion_timestamp))
             # 1) drain: release this node's pods back to pending
             if claim.node_name:
-                drain_node_pods(self.kube, claim.node_name)
+                drain_node_pods(self.kube, claim.node_name,
+                                metrics=self.metrics)
             # 2) terminate the instance
             if claim.provider_id:
+                t0 = self.clock()
                 try:
                     self.cloudprovider.delete(claim)
                 except NodeClaimNotFoundError:
                     pass
+                if self.metrics is not None:
+                    self.metrics.observe(
+                        "karpenter_nodeclaims_instance_termination"
+                        "_duration_seconds", max(0.0, self.clock() - t0))
             # 3) delete the Node object
-            if claim.node_name and self.kube.try_get("Node", claim.node_name):
+            node = self.kube.try_get("Node", claim.node_name) \
+                if claim.node_name else None
+            if node is not None:
+                if self.metrics is not None:
+                    pool = claim.nodepool or ""
+                    self.metrics.inc("karpenter_nodes_terminated_total",
+                                     labels={"nodepool": pool})
+                    self.metrics.observe(
+                        "karpenter_nodes_termination_duration_seconds",
+                        max(0.0, self.clock()
+                            - claim.metadata.deletion_timestamp))
+                    self.metrics.observe(
+                        "karpenter_nodes_lifetime_duration_seconds",
+                        max(0.0, self.clock()
+                            - node.metadata.creation_timestamp),
+                        labels={"nodepool": pool})
                 self.kube.delete("Node", claim.node_name)
             # 4) clear the finalizer -> object goes away
             self.kube.remove_finalizer(claim, "karpenter.sh/termination")

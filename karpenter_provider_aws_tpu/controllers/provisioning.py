@@ -199,4 +199,7 @@ class Provisioner:
         claim.metadata.finalizers.append("karpenter.sh/termination")
         claim.instance_type_options = list(plan.instance_type_names)
         self.kube.create(claim)
+        if self.metrics is not None:
+            self.metrics.inc("karpenter_nodeclaims_created_total",
+                             labels={"nodepool": plan.nodepool})
         return claim

@@ -132,10 +132,11 @@ class NodeClassStatusController:
 
 class GarbageCollector:
     def __init__(self, kube: FakeKube, cloudprovider: CloudProvider,
-                 clock=time.time):
+                 clock=time.time, metrics=None):
         self.kube = kube
         self.cloudprovider = cloudprovider
         self.clock = clock
+        self.metrics = metrics
 
     #: termination fan-out width (garbagecollection/controller.go:80:
     #: workqueue.ParallelizeUntil(ctx, 100, ...)); parallel callers feed
@@ -188,7 +189,8 @@ class GarbageCollector:
             if claim.launched and claim.provider_id \
                     and claim.provider_id not in live:
                 if claim.node_name:
-                    drain_node_pods(self.kube, claim.node_name)
+                    drain_node_pods(self.kube, claim.node_name,
+                                    metrics=self.metrics)
                     if self.kube.try_get("Node", claim.node_name):
                         self.kube.delete("Node", claim.node_name)
                 self.kube.remove_finalizer(claim, "karpenter.sh/termination")
@@ -255,6 +257,7 @@ class InterruptionController:
 
         def work(msg):
             local = {"handled": 0, "cordoned": 0, "noop": 0}
+            t_recv = self.clock()
             self._handle(msg, claims_by_instance, local)
             self.sqs.delete(msg)
             local["handled"] += 1
@@ -262,6 +265,15 @@ class InterruptionController:
                 self.metrics.inc(
                     "karpenter_interruption_received_messages_total",
                     labels={"message_type": msg.kind})
+                self.metrics.inc(
+                    "karpenter_interruption_deleted_messages_total",
+                    labels={"message_type": msg.kind})
+                # receive -> delete residency (the reference measures SQS
+                # SentTimestamp -> delete; the fake has no transport delay
+                # so handling time is the whole queue residency)
+                self.metrics.observe(
+                    "karpenter_interruption_message_queue"
+                    "_duration_seconds", max(0.0, self.clock() - t_recv))
             return local
 
         with ThreadPoolExecutor(max_workers=self.WORKERS) as pool:
@@ -366,7 +378,8 @@ class CatalogController:
         per-offering availability + price estimate. Full re-emit: series
         for types/offerings that left the catalog must not linger."""
         m = self.metrics
-        for series in ("karpenter_cloudprovider_instance_type_cpu_cores",
+        for series in ("karpenter_cloudprovider_instance_type",
+                       "karpenter_cloudprovider_instance_type_cpu_cores",
                        "karpenter_cloudprovider_instance_type_memory_bytes",
                        "karpenter_cloudprovider_instance_type"
                        "_offering_available",
@@ -380,6 +393,8 @@ class CatalogController:
             return 1.0
 
         for info in infos:
+            m.set_gauge("karpenter_cloudprovider_instance_type", 1.0,
+                        labels={"instance_type": info.name})
             m.set_gauge("karpenter_cloudprovider_instance_type_cpu_cores",
                         float(info.vcpus),
                         labels={"instance_type": info.name})

@@ -1,0 +1,334 @@
+"""Fleet-operations telemetry: the metric families the reference documents
+beyond the solver hot path (website/content/en/docs/reference/metrics.md,
+101 series in 20 groups).
+
+Three pieces:
+
+- :class:`TelemetryEmitter` — a periodic reconciler that walks cluster
+  state and re-emits the gauge families (nodes/pods/cluster-state/
+  nodepools) plus the operatorpkg-style status-condition and termination
+  series for every karpenter kind;
+- :func:`instrument_kube` — wraps the kube boundary with the
+  ``client_go_request_*`` series (client-go's rest_client metrics);
+- :func:`instrument_ec2` — wraps the fake AWS seam with the
+  ``aws_sdk_go_request_*`` series (the prometheusv2-wrapped AWS config of
+  operator.go:110).
+
+Counters for one-shot events (created/terminated/drained/evicted,
+interruption deletions, disruption failures) are emitted at their source
+controllers; this module owns only the walk-the-world families.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional, Tuple
+
+from ..apis import labels as L
+from ..apis.resources import Resources
+
+#: kinds that get operatorpkg status-condition + termination series
+#: (metrics.md operator_{kind}_* groups)
+_KINDS = (("NodeClaim", "nodeclaim"), ("Node", "node"),
+          ("NodePool", "nodepool"), ("EC2NodeClass", "ec2nodeclass"))
+
+_RESOURCES = ("cpu", "memory")
+
+
+class TelemetryEmitter:
+    """Walks kube state once per reconcile and re-emits every
+    walk-the-world gauge family. Transition counters keep a previous-state
+    map so `*_transitions_total` / `*_transition_seconds` match the
+    operatorpkg semantics (count + duration of the status being left)."""
+
+    def __init__(self, kube, state, metrics, clock=time.time):
+        self.kube = kube
+        self.state = state
+        self.metrics = metrics
+        self.clock = clock
+        #: (kind, name, ctype) -> (status, since)
+        self._cond_prev: Dict[Tuple[str, str, str], Tuple[str, float]] = {}
+        #: (kind, name) -> deletion timestamp of objects seen deleting
+        self._deleting: Dict[Tuple[str, str], float] = {}
+
+    # ------------------------------------------------------------------
+    def reconcile(self) -> int:
+        now = self.clock()
+        m = self.metrics
+        nodes = self.kube.list("Node")
+        claims = self.kube.list("NodeClaim")
+        pools = self.kube.list("NodePool")
+        pods = self.kube.list("Pod")
+
+        self._emit_nodes(nodes, claims, pods, now)
+        self._emit_pods(pods, now)
+        self._emit_cluster(nodes, pods)
+        self._emit_nodepools(pools, claims)
+        for kind, prefix in _KINDS:
+            objs = self.kube.list(kind)
+            self._emit_conditions(kind, prefix, objs, now)
+            self._emit_termination(kind, prefix, objs, now)
+        return 1
+
+    # -- nodes family ---------------------------------------------------
+    def _emit_nodes(self, nodes, claims, pods, now) -> None:
+        m = self.metrics
+        claim_by_node = {c.node_name: c for c in claims if c.node_name}
+        by_node: Dict[str, list] = {}
+        for p in pods:
+            if p.node_name and p.phase not in ("Succeeded", "Failed"):
+                by_node.setdefault(p.node_name, []).append(p)
+        for name in ("karpenter_nodes_allocatable",
+                     "karpenter_nodes_total_pod_requests",
+                     "karpenter_nodes_total_pod_limits",
+                     "karpenter_nodes_total_daemon_requests",
+                     "karpenter_nodes_total_daemon_limits",
+                     "karpenter_nodes_system_overhead",
+                     "karpenter_nodes_current_lifetime_seconds"):
+            m.clear_series(name)
+        for node in nodes:
+            claim = claim_by_node.get(node.metadata.name)
+            pool = node.metadata.labels.get(L.NODEPOOL, "")
+            base = {"node_name": node.metadata.name, "nodepool": pool}
+            reqs = Resources()
+            lims = Resources()
+            dreqs = Resources()
+            dlims = Resources()
+            for p in by_node.get(node.metadata.name, []):
+                r = p.effective_requests()
+                lim = getattr(p, "limits", None) or Resources()
+                if p.owner_kind == "DaemonSet":
+                    dreqs = dreqs + r
+                    dlims = dlims + lim
+                else:
+                    reqs = reqs + r
+                    lims = lims + lim
+            overhead = (node.capacity - node.allocatable).clamp_nonnegative()
+            for res in _RESOURCES:
+                lab = dict(base, resource_type=res)
+                m.set_gauge("karpenter_nodes_allocatable",
+                            node.allocatable[res], labels=lab)
+                m.set_gauge("karpenter_nodes_total_pod_requests",
+                            reqs[res], labels=lab)
+                m.set_gauge("karpenter_nodes_total_pod_limits",
+                            lims[res], labels=lab)
+                m.set_gauge("karpenter_nodes_total_daemon_requests",
+                            dreqs[res], labels=lab)
+                m.set_gauge("karpenter_nodes_total_daemon_limits",
+                            dlims[res], labels=lab)
+                m.set_gauge("karpenter_nodes_system_overhead",
+                            overhead[res], labels=lab)
+            m.set_gauge("karpenter_nodes_current_lifetime_seconds",
+                        max(0.0, now - node.metadata.creation_timestamp),
+                        labels=base)
+
+    # -- pods ------------------------------------------------------------
+    def _emit_pods(self, pods, now) -> None:
+        m = self.metrics
+        m.clear_series("karpenter_pods_state")
+        counts: Dict[str, int] = {}
+        ignored = 0
+        for p in pods:
+            counts[p.phase] = counts.get(p.phase, 0) + 1
+            # a pending pod the provisioner cannot act on (already being
+            # deleted) is ignored, the metrics.md ignored_pod_count shape
+            if p.phase == "Pending" and not p.node_name \
+                    and p.metadata.deletion_timestamp is not None:
+                ignored += 1
+        for phase, n in counts.items():
+            m.set_gauge("karpenter_pods_state", n, labels={"phase": phase})
+        m.set_gauge("karpenter_ignored_pod_count", ignored)
+
+    # -- cluster state ---------------------------------------------------
+    def _emit_cluster(self, nodes, pods) -> None:
+        m = self.metrics
+        total_alloc = Resources()
+        for node in nodes:
+            total_alloc = total_alloc + node.allocatable
+        total_req = Resources()
+        for p in pods:
+            if p.node_name and p.phase not in ("Succeeded", "Failed"):
+                total_req = total_req + p.effective_requests()
+        for res in _RESOURCES:
+            alloc = total_alloc[res]
+            m.set_gauge("karpenter_cluster_utilization_percent",
+                        100.0 * total_req[res] / alloc if alloc else 0.0,
+                        labels={"resource_type": res})
+
+    # -- nodepools -------------------------------------------------------
+    def _emit_nodepools(self, pools, claims) -> None:
+        m = self.metrics
+        for name in ("karpenter_nodepools_limit",
+                     "karpenter_nodepools_allowed_disruptions"):
+            m.clear_series(name)
+        by_pool: Dict[str, int] = {}
+        for c in claims:
+            if c.registered:
+                by_pool[c.nodepool or ""] = by_pool.get(c.nodepool or "", 0) + 1
+        for pool in pools:
+            if pool.limits:
+                for res, lim in pool.limits.items():
+                    m.set_gauge("karpenter_nodepools_limit", lim,
+                                labels={"nodepool": pool.name,
+                                        "resource_type": res})
+            total = by_pool.get(pool.name, 0)
+            allowed = total
+            for b in pool.disruption.budgets:
+                allowed = min(allowed, b.max_disruptions(total))
+            m.set_gauge("karpenter_nodepools_allowed_disruptions", allowed,
+                        labels={"nodepool": pool.name})
+
+    # -- operatorpkg status conditions ----------------------------------
+    def _emit_conditions(self, kind, prefix, objs, now) -> None:
+        m = self.metrics
+        m.clear_series(f"operator_{prefix}_status_condition_count")
+        m.clear_series(
+            f"operator_{prefix}_status_condition_current_status_seconds")
+        live = set()
+        for obj in objs:
+            for cond in getattr(obj, "conditions", {}).values():
+                key = (kind, obj.metadata.name, cond.type)
+                live.add(key)
+                lab = {"type": cond.type, "status": cond.status}
+                m.set_gauge(f"operator_{prefix}_status_condition_count",
+                            m.gauge(
+                                f"operator_{prefix}_status_condition_count",
+                                labels=lab) + 1, labels=lab)
+                prev = self._cond_prev.get(key)
+                if prev is None:
+                    self._cond_prev[key] = (cond.status,
+                                            cond.last_transition)
+                elif prev[0] != cond.status:
+                    m.inc(f"operator_{prefix}"
+                          "_status_condition_transitions_total",
+                          labels={"type": cond.type, "from": prev[0],
+                                  "to": cond.status})
+                    m.observe(f"operator_{prefix}"
+                              "_status_condition_transition_seconds",
+                              max(0.0, now - prev[1]),
+                              labels={"type": cond.type})
+                    # the generic operatorpkg group (metrics.md
+                    # operator_status_condition_*) aggregates every kind
+                    m.inc("operator_status_condition_transitions_total",
+                          labels={"kind": kind, "type": cond.type})
+                    m.observe("operator_status_condition_transition_seconds",
+                              max(0.0, now - prev[1]),
+                              labels={"kind": kind, "type": cond.type})
+                    self._cond_prev[key] = (cond.status, now)
+                m.set_gauge(
+                    f"operator_{prefix}_status_condition"
+                    "_current_status_seconds",
+                    max(0.0, now - self._cond_prev[key][1]),
+                    labels={"name": obj.metadata.name, "type": cond.type})
+        # aggregated per-kind counts for the generic group
+        m.clear_series("operator_status_condition_count",
+                       match={"kind": kind})
+        per: Dict[Tuple[str, str], int] = {}
+        for obj in objs:
+            for cond in getattr(obj, "conditions", {}).values():
+                k = (cond.type, cond.status)
+                per[k] = per.get(k, 0) + 1
+        for (ctype, status), n in per.items():
+            m.set_gauge("operator_status_condition_count", n,
+                        labels={"kind": kind, "type": ctype,
+                                "status": status})
+        m.set_gauge("operator_status_condition_current_status_seconds",
+                    float(len(live)), labels={"kind": kind})
+        # drop transition state for vanished objects
+        for key in [k for k in self._cond_prev
+                    if k[0] == kind and k not in live]:
+            del self._cond_prev[key]
+
+    # -- operatorpkg termination ----------------------------------------
+    def _emit_termination(self, kind, prefix, objs, now) -> None:
+        m = self.metrics
+        m.clear_series(
+            f"operator_{prefix}_termination_current_time_seconds")
+        seen = set()
+        for obj in objs:
+            dt = obj.metadata.deletion_timestamp
+            if dt is None:
+                continue
+            key = (kind, obj.metadata.name)
+            seen.add(key)
+            self._deleting.setdefault(key, dt)
+            m.set_gauge(
+                f"operator_{prefix}_termination_current_time_seconds",
+                max(0.0, now - dt), labels={"name": obj.metadata.name})
+        for key in [k for k in self._deleting
+                    if k[0] == kind and k not in seen]:
+            dt = self._deleting.pop(key)
+            m.observe(f"operator_{prefix}_termination_duration_seconds",
+                      max(0.0, now - dt))
+            m.observe("operator_termination_duration_seconds",
+                      max(0.0, now - dt), labels={"kind": kind})
+        m.set_gauge("operator_termination_current_time_seconds",
+                    float(sum(1 for k in self._deleting if k[0] == kind)),
+                    labels={"kind": kind})
+
+
+# ---------------------------------------------------------------------------
+# boundary instrumentation
+# ---------------------------------------------------------------------------
+
+def instrument_kube(kube, metrics, clock=time.perf_counter) -> None:
+    """client_go_request_total/_duration_seconds at the kube boundary —
+    the rest_client metrics of metrics.md's Client Go group. Wraps the
+    five verbs in place; labels mirror client-go (verb, code)."""
+    for verb, method in (("GET", "get"), ("LIST", "list"),
+                         ("POST", "create"), ("PUT", "update"),
+                         ("DELETE", "delete")):
+        orig = getattr(kube, method)
+
+        def wrapped(*a, _orig=orig, _verb=verb, **kw):
+            t0 = clock()
+            code = "200"
+            try:
+                return _orig(*a, **kw)
+            except Exception:
+                code = "error"
+                raise
+            finally:
+                metrics.inc("client_go_request_total",
+                            labels={"verb": _verb, "code": code})
+                metrics.observe("client_go_request_duration_seconds",
+                                clock() - t0, labels={"verb": _verb})
+
+        setattr(kube, method, wrapped)
+
+
+#: fake-EC2 methods that stand in for SDK operations (pkg/aws/sdk.go seam)
+_EC2_OPS = ("describe_instance_types", "describe_instance_type_offerings",
+            "describe_spot_price_history", "describe_subnets",
+            "describe_security_groups", "describe_images",
+            "describe_launch_templates", "create_fleet",
+            "describe_instances", "terminate_instances")
+
+
+def instrument_ec2(ec2, metrics, clock=time.perf_counter) -> None:
+    """aws_sdk_go_request_* at the cloud seam — the prometheusv2-wrapped
+    AWS config of operator.go:110. One attempt per call here (the fake
+    has no transport retries); the LT-not-found application-level retry
+    increments aws_sdk_go_request_retry_count at its site
+    (providers/instance.py)."""
+    for op in _EC2_OPS:
+        orig = getattr(ec2, op, None)
+        if orig is None:
+            continue
+
+        def wrapped(*a, _orig=orig, _op=op, **kw):
+            t0 = clock()
+            try:
+                return _orig(*a, **kw)
+            finally:
+                dt = clock() - t0
+                lab = {"service": "EC2", "operation": _op}
+                metrics.inc("aws_sdk_go_request_total", labels=lab)
+                metrics.observe("aws_sdk_go_request_duration_seconds",
+                                dt, labels=lab)
+                metrics.inc("aws_sdk_go_request_attempt_total", labels=lab)
+                metrics.observe(
+                    "aws_sdk_go_request_attempt_duration_seconds",
+                    dt, labels=lab)
+
+        setattr(ec2, op, wrapped)

@@ -138,6 +138,8 @@ class Daemon:
             VERSION_REFRESH)
         if op.options.interruption_queue:
             reg("interruption", op.interruption.reconcile, INTERRUPTION_POLL)
+        # fleet-ops gauge families (nodes/pods/cluster/conditions)
+        reg("telemetry", op.telemetry.reconcile, 30.0)
         # debug transition watchers (test/pkg/debug analog): only when the
         # log level asks for them. Observation is eager (the watcher logs
         # at event time through the kube watch hook) — attaching is all

@@ -36,6 +36,16 @@ from typing import Callable, List, Optional
 log = logging.getLogger(__name__)
 
 
+class ReconcileError(Exception):
+    """A typed, retryable reconcile failure — the controller-runtime
+    'return error' path (counted as an error + retry, not a panic)."""
+
+
+class TerminalReconcileError(Exception):
+    """A reconcile failure retrying cannot fix (bad object spec) —
+    controller_runtime_terminal_reconcile_errors_total."""
+
+
 @dataclass(order=True)
 class _Entry:
     due: float
@@ -83,6 +93,9 @@ class ControllerManager:
                 due=due, seq=self._seq, name=name, reconcile=reconcile,
                 interval=interval, initial_interval=initial_interval,
                 initial_count=initial_count))
+        if self._metrics is not None:
+            self._metrics.inc("workqueue_adds_total",
+                              labels={"controller": name})
         self._wake.set()
 
     # ------------------------------------------------------------------
@@ -125,23 +138,70 @@ class ControllerManager:
             entry.due = self._clock() + entry.next_delay()
             with self._mu:
                 heapq.heappush(self._heap, entry)
+            if self._metrics is not None:  # the cadence requeue
+                self._metrics.inc("workqueue_adds_total",
+                                  labels={"controller": entry.name})
 
     def _reconcile_one(self, entry: _Entry) -> None:
         t0 = self._clock()
+        m = self._metrics
+        lab = {"controller": entry.name}
+        if m is not None:
+            # workqueue group: how long the item sat due before running,
+            # and the single-worker loop's live state
+            m.observe("workqueue_queue_duration_seconds",
+                      max(0.0, t0 - entry.due), labels=lab)
+            m.set_gauge("workqueue_depth", float(len(self._heap)))
+            m.set_gauge("controller_runtime_active_workers", 1.0,
+                        labels=lab)
+            m.set_gauge("controller_runtime_max_concurrent_reconciles",
+                        1.0, labels=lab)
         try:
             entry.reconcile()
+        except ReconcileError:
+            # a typed, retryable reconcile error (the requeue-with-error
+            # path); the cadence retries it
+            log.exception("reconcile %s errored", entry.name)
+            if m is not None:
+                m.inc("karpenter_controller_reconcile_errors_total",
+                      labels=lab)
+                m.inc("controller_runtime_reconcile_errors_total",
+                      labels=lab)
+                m.inc("workqueue_retries_total", labels=lab)
+        except TerminalReconcileError:
+            log.exception("reconcile %s failed terminally", entry.name)
+            if m is not None:
+                m.inc("controller_runtime_terminal_reconcile_errors_total",
+                      labels=lab)
         except Exception:  # noqa: BLE001 - reconcile panics must not kill
             # the manager; controller-runtime recovers and requeues
-            log.exception("reconcile %s failed", entry.name)
-            if self._metrics is not None:
-                self._metrics.inc(
-                    "karpenter_controller_reconcile_errors_total",
-                    labels={"controller": entry.name})
+            log.exception("reconcile %s panicked", entry.name)
+            if m is not None:
+                m.inc("karpenter_controller_reconcile_errors_total",
+                      labels=lab)
+                m.inc("controller_runtime_reconcile_panics_total",
+                      labels=lab)
+                m.inc("workqueue_retries_total", labels=lab)
         finally:
-            if self._metrics is not None:
-                self._metrics.observe(
+            dt = self._clock() - t0
+            if m is not None:
+                m.observe(
                     "karpenter_controller_reconcile_duration_seconds",
-                    self._clock() - t0, labels={"controller": entry.name})
+                    dt, labels=lab)
+                m.inc("controller_runtime_reconcile_total", labels=lab)
+                m.observe("controller_runtime_reconcile_time_seconds",
+                          dt, labels=lab)
+                m.observe("workqueue_work_duration_seconds", dt,
+                          labels=lab)
+                m.set_gauge("workqueue_unfinished_work_seconds", 0.0,
+                            labels=lab)
+                m.set_gauge(
+                    "workqueue_longest_running_processor_seconds",
+                    max(dt, m.gauge(
+                        "workqueue_longest_running_processor_seconds",
+                        labels=lab)), labels=lab)
+                m.set_gauge("controller_runtime_active_workers", 0.0,
+                            labels=lab)
 
 
 # ---------------------------------------------------------------------------
@@ -155,7 +215,7 @@ class FileLease:
     renew on a heartbeat thread while held."""
 
     def __init__(self, path: str, identity: str = "",
-                 ttl: float = 15.0, clock=time.time):
+                 ttl: float = 15.0, clock=time.time, metrics=None):
         self.path = path
         self.identity = identity or f"pid-{os.getpid()}"
         self.ttl = ttl
@@ -163,6 +223,14 @@ class FileLease:
         self._held = False
         self._hb: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        self.metrics = metrics
+
+    def _set_master(self, held: bool) -> None:
+        self._held = held
+        if self.metrics is not None:
+            self.metrics.set_gauge("leader_election_master_status",
+                                   1.0 if held else 0.0,
+                                   labels={"name": self.identity})
 
     def _read(self) -> Optional[dict]:
         try:
@@ -185,21 +253,24 @@ class FileLease:
             fd = os.open(self.path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
             os.close(fd)
             self._write()
-            self._held = True
+            self._set_master(True)
         except FileExistsError:
             cur = self._read()
             if cur is not None and cur.get("holder") == self.identity:
-                self._held = True  # our own stale lease (restart)
+                self._set_master(True)  # our own stale lease (restart)
                 self._write()
             elif cur is None or \
                     self._clock() - cur.get("renewed", 0) > self.ttl:
                 # expired: steal — but N standbys race here, and os.replace
                 # makes last-writer-wins, so re-read to learn who actually
                 # won before claiming leadership (split-brain guard)
+                if self.metrics is not None:
+                    self.metrics.inc("leader_election_slowpath_total",
+                                     labels={"name": self.identity})
                 self._write()
                 winner = self._read()
-                self._held = (winner is not None
-                              and winner.get("holder") == self.identity)
+                self._set_master(winner is not None
+                                 and winner.get("holder") == self.identity)
         if self._held:
             self._stop.clear()
             self._hb = threading.Thread(target=self._heartbeat, daemon=True,
@@ -226,7 +297,8 @@ class FileLease:
             if cur is not None and cur.get("holder") == self.identity:
                 self._write()
             else:
-                self._held = False  # lost the lease; stop acting as leader
+                # lost the lease; stop acting as leader
+                self._set_master(False)
 
     def release(self) -> None:
         self._stop.set()
@@ -240,7 +312,7 @@ class FileLease:
                     os.unlink(self.path)
                 except OSError:
                     pass
-            self._held = False
+            self._set_master(False)
 
     @property
     def held(self) -> bool:

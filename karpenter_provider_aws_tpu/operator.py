@@ -134,7 +134,8 @@ class Operator:
             self.kube, self.subnets, self.security_groups, self.amis,
             self.instance_profiles, clock=clock, metrics=self.metrics,
             recorder=self.recorder)
-        self.gc = GarbageCollector(self.kube, self.cloudprovider, clock=clock)
+        self.gc = GarbageCollector(self.kube, self.cloudprovider, clock=clock,
+                                   metrics=self.metrics)
         self.tagger = Tagger(self.kube, self.instances,
                              cluster_name=self.options.cluster_name)
         self.interruption = InterruptionController(
@@ -165,9 +166,26 @@ class Operator:
                                    reserved_enis=self.options.reserved_enis,
                                    metrics=self.metrics)
 
+        # fleet-ops telemetry: walk-the-world gauge families + the
+        # client-go / aws-sdk boundary series (controllers/telemetry.py)
+        from .controllers.telemetry import (TelemetryEmitter,
+                                            instrument_ec2, instrument_kube)
+        self.telemetry = TelemetryEmitter(self.kube, self.state,
+                                          self.metrics, clock=clock)
+        instrument_kube(self.kube, self.metrics)
+        instrument_ec2(self.ec2, self.metrics)
+        from . import __version__ as _version
+        self.metrics.set_gauge(
+            "karpenter_build_info", 1.0,
+            labels={"version": _version, "solver": self.solver.name})
+
         # boot-blocking hydration (operator.go:152-155): catalog + pricing
+        t_boot = time.perf_counter()
         self.catalog_controller.reconcile()
         self.pricing_controller.reconcile()
+        self.metrics.set_gauge("karpenter_cluster_state_unsynced_time_seconds",
+                               time.perf_counter() - t_boot)
+        self.metrics.set_gauge("karpenter_cluster_state_synced", 1.0)
 
     # ------------------------------------------------------------------
     def step(self, disrupt: bool = True) -> dict:
@@ -191,6 +209,7 @@ class Operator:
         out["ssm_evicted"] = self.ssm_invalidation.reconcile()
         out["version_changed"] = self.version_controller.reconcile()
         self._emit_state_gauges()
+        self.telemetry.reconcile()
         return out
 
     def _emit_state_gauges(self) -> None:

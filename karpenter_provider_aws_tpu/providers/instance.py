@@ -61,6 +61,7 @@ class InstanceProvider:
         self.launch_templates = launch_template_provider
         self.unavailable = unavailable_offerings
         self.cluster_name = cluster_name
+        self.metrics = metrics
         clock = clock or time.monotonic
         self.create_fleet = CreateFleetBatcher(ec2, clock=clock,
                                                metrics=metrics)
@@ -117,6 +118,11 @@ class InstanceProvider:
             if instance is None and lt_gone and attempt == 0:
                 log.info("launch templates disappeared mid-launch for %s; "
                          "re-ensuring and retrying once", nodeclaim.name)
+                if self.metrics is not None:
+                    self.metrics.inc(
+                        "aws_sdk_go_request_retry_count",
+                        labels={"service": "EC2",
+                                "operation": "create_fleet"})
                 self.launch_templates.invalidate(
                     {cfg["launch_template_name"] for cfg in configs})
                 continue
