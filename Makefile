@@ -3,16 +3,22 @@
 
 PYTEST ?= python -m pytest
 
-test:  ## unit + component suites (virtual 8-device CPU mesh)
+test:  ## fast tier: everything but the scale envelopes (<~3min)
+	$(PYTEST) tests/ -x -q -m "not scale"
+
+test-all:  ## every suite including the scale tier
 	$(PYTEST) tests/ -x -q
 
-scale:  ## the scale suite alone (55k pods, deprovisioning, chaos)
-	$(PYTEST) tests/test_scale_suite.py -x -q
+scale:  ## the scale tier alone (55k pods, deprovisioning, chaos)
+	$(PYTEST) tests/ -x -q -m scale
 
 deflake:  ## Makefile:63-70 analog: randomized order, repeated until failure
 	for i in 1 2 3 4 5; do \
-	  KARPENTER_TEST_SHUFFLE_SEED=$$i $(PYTEST) tests/ -q -x || exit 1; \
+	  KARPENTER_TEST_SHUFFLE_SEED=$$i $(PYTEST) tests/ -q -x -m "not scale" || exit 1; \
 	done
+
+chart:  ## render + lint the deploy chart (no helm needed)
+	python hack/render_chart.py --validate
 
 benchmark:  ## the five BASELINE configs + interruption throughput
 	python bench.py --all --rounds 100
@@ -24,4 +30,4 @@ multichip:  ## dry-run the multi-device solve on 8 virtual CPU devices
 daemon:  ## run the operator against the in-memory cloud
 	python -m karpenter_provider_aws_tpu --cluster-name dev --metrics-port 8080
 
-.PHONY: test scale deflake benchmark multichip daemon
+.PHONY: test test-all scale deflake benchmark multichip daemon chart

@@ -1,0 +1,134 @@
+#!/usr/bin/env python
+"""Render deploy/chart without helm.
+
+The chart's templates deliberately use only a small Helm subset —
+``{{ .Values.path }}`` substitution, the ``quote`` filter, and
+``{{- if .Values.path }} ... {{- end }}`` blocks (no nesting across
+files, no loops, no includes) — so this renderer and real helm produce
+the same manifests. CI renders with this script and YAML-validates every
+document; users with helm install the chart directly.
+
+Usage:
+  python hack/render_chart.py [--chart deploy/chart]
+                              [--set settings.clusterName=prod] ...
+"""
+
+import argparse
+import os
+import re
+import sys
+
+
+def load_values(path):
+    import yaml
+    with open(path) as f:
+        return yaml.safe_load(f)
+
+
+def set_path(values, dotted, raw):
+    keys = dotted.split(".")
+    cur = values
+    for k in keys[:-1]:
+        cur = cur.setdefault(k, {})
+    val = raw
+    if raw.lower() in ("true", "false"):
+        val = raw.lower() == "true"
+    else:
+        try:
+            val = int(raw)
+        except ValueError:
+            try:
+                val = float(raw)
+            except ValueError:
+                pass
+    cur[keys[-1]] = val
+
+
+def get_path(values, dotted):
+    cur = values
+    for k in dotted.split("."):
+        if not isinstance(cur, dict) or k not in cur:
+            raise KeyError(f".Values.{dotted} is not defined in values")
+        cur = cur[k]
+    return cur
+
+
+_IF = re.compile(r"^\{\{-? *if \.Values\.([a-zA-Z0-9_.]+) *-?\}\} *$")
+_END = re.compile(r"^\{\{-? *end *-?\}\} *$")
+_SUBST = re.compile(
+    r"\{\{ *\.Values\.([a-zA-Z0-9_.]+)( *\| *quote)? *\}\}")
+
+
+def render(text, values):
+    out = []
+    keep = [True]  # if-block stack
+    for line in text.splitlines():
+        m = _IF.match(line.strip())
+        if m:
+            try:
+                truthy = bool(get_path(values, m.group(1)))
+            except KeyError:
+                truthy = False
+            keep.append(keep[-1] and truthy)
+            continue
+        if _END.match(line.strip()):
+            if len(keep) == 1:
+                raise ValueError("unbalanced {{- end }}")
+            keep.pop()
+            continue
+        if not keep[-1]:
+            continue
+
+        def sub(mm):
+            v = get_path(values, mm.group(1))
+            if mm.group(2):  # | quote
+                return '"' + str(v).replace('"', '\\"') + '"'
+            if isinstance(v, bool):
+                return "true" if v else "false"
+            return str(v)
+
+        out.append(_SUBST.sub(sub, line))
+    if len(keep) != 1:
+        raise ValueError("unclosed {{- if }}")
+    return "\n".join(out) + "\n"
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--chart", default=os.path.join(
+        os.path.dirname(__file__), "..", "deploy", "chart"))
+    ap.add_argument("--set", action="append", default=[],
+                    metavar="KEY=VALUE")
+    ap.add_argument("--validate", action="store_true",
+                    help="YAML-parse every rendered document and exit")
+    args = ap.parse_args()
+
+    values = load_values(os.path.join(args.chart, "values.yaml"))
+    for kv in getattr(args, "set"):
+        k, _, v = kv.partition("=")
+        set_path(values, k, v)
+
+    docs = []
+    tdir = os.path.join(args.chart, "templates")
+    for name in sorted(os.listdir(tdir)):
+        if not name.endswith(".yaml"):
+            continue
+        rendered = render(open(os.path.join(tdir, name)).read(), values)
+        if rendered.strip():
+            docs.append(f"---\n# Source: {name}\n{rendered}")
+    text = "".join(docs)
+
+    if args.validate:
+        import yaml
+        n = 0
+        for doc in yaml.safe_load_all(text):
+            if doc is not None:
+                assert "kind" in doc, f"document without kind: {doc}"
+                n += 1
+        print(f"OK: {n} documents rendered and parsed", file=sys.stderr)
+        return
+    sys.stdout.write(text)
+
+
+if __name__ == "__main__":
+    main()

@@ -66,3 +66,10 @@ def pytest_sessionfinish(session, exitstatus):
     if path and _durations:
         with open(path, "w") as f:
             json.dump({"durations": _durations}, f, indent=1)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "scale: 50k-pod / 500-node scale-envelope tests (the slow tier; "
+        "`pytest -m 'not scale'` is the fast default path)")

@@ -16,6 +16,10 @@ simulated so the suite asserts outcomes and keeps runtimes in CI range.
 
 import pytest
 
+#: the scale tier: 500-node / 55k-pod envelopes (minutes of wall clock);
+#: excluded from the fast path via `pytest -m "not scale"`
+pytestmark = pytest.mark.scale
+
 from karpenter_provider_aws_tpu.apis import labels as L
 from karpenter_provider_aws_tpu.apis.objects import (Disruption, EC2NodeClass,
                                                      NodeClassRef, NodePool,
