@@ -22,14 +22,24 @@ _INFO = "/karpenter.solver.v1.Solver/Info"
 
 
 class SolverClient:
-    def __init__(self, address: str, timeout: float = 30.0):
+    def __init__(self, address: str, timeout: float = 30.0,
+                 token: Optional[str] = None,
+                 root_cert: Optional[bytes] = None):
+        """`token` rides as x-solver-token metadata on every call (the
+        server rejects mismatches with UNAUTHENTICATED); `root_cert`
+        (PEM) switches the channel to TLS — both optional, matching the
+        server's posture flags (sidecar/server.py serve())."""
         import grpc
         self.address = address
         self.timeout = timeout
-        self._channel = grpc.insecure_channel(
-            address,
-            options=[("grpc.max_receive_message_length", 256 * 1024 * 1024),
-                     ("grpc.max_send_message_length", 256 * 1024 * 1024)])
+        self._md = (("x-solver-token", token),) if token else None
+        opts = [("grpc.max_receive_message_length", 256 * 1024 * 1024),
+                ("grpc.max_send_message_length", 256 * 1024 * 1024)]
+        if root_cert is not None:
+            creds = grpc.ssl_channel_credentials(root_certificates=root_cert)
+            self._channel = grpc.secure_channel(address, creds, options=opts)
+        else:
+            self._channel = grpc.insecure_channel(address, options=opts)
         self._solve = self._channel.unary_unary(_SOLVE)
         self._info = self._channel.unary_unary(_INFO)
 
@@ -40,11 +50,12 @@ class SolverClient:
             "statics": np.array([statics.get(k, 0) for k in STATIC_KEYS],
                                 dtype=np.int64),
         })
-        resp = self._solve(req, timeout=self.timeout)
+        resp = self._solve(req, timeout=self.timeout, metadata=self._md)
         return np.array(arena_unpack(resp)["out"])  # own the memory
 
     def info(self, timeout: Optional[float] = None) -> Dict[str, int]:
-        out = arena_unpack(self._info(b"", timeout=timeout or self.timeout))
+        out = arena_unpack(self._info(b"", timeout=timeout or self.timeout,
+                                      metadata=self._md))
         return {k: int(v[0]) for k, v in out.items()}
 
     def close(self) -> None:
@@ -64,9 +75,19 @@ class RemoteSolver(TPUSolver):
 
     def __init__(self, address: str, n_max: int = 2048,
                  client: Optional[SolverClient] = None,
-                 backend: str = "auto"):
+                 backend: str = "auto", token: Optional[str] = None,
+                 root_cert: Optional[bytes] = None):
+        """`token`/`root_cert` plumb straight into SolverClient — when the
+        server runs with sidecar.token / TLS, the production consumer must
+        be able to authenticate (defaults also read from
+        SOLVER_SIDECAR_TOKEN so the chart env reaches both containers)."""
         super().__init__(backend=backend, n_max=n_max)
-        self.client = client or SolverClient(address)
+        if client is None:
+            if token is None:
+                import os
+                token = os.environ.get("SOLVER_SIDECAR_TOKEN") or None
+            client = SolverClient(address, token=token, root_cert=root_cert)
+        self.client = client
         from ..solver.route import AliveCache
         self._router.alive = AliveCache(self._ping)
 

@@ -143,18 +143,66 @@ def _generic_handler(handler: _Handler):
     return Svc()
 
 
+def _token_interceptor(token: str):
+    """Shared-secret auth: every call must carry x-solver-token metadata
+    matching `token` or it is rejected UNAUTHENTICATED before the handler
+    runs. Compared with hmac.compare_digest — a solver sidecar exposed
+    beyond loopback must not leak the token through timing."""
+    import hmac
+
+    import grpc
+
+    class _Auth(grpc.ServerInterceptor):
+        def intercept_service(self, continuation, handler_call_details):
+            md = dict(handler_call_details.invocation_metadata or ())
+            got = md.get("x-solver-token", "")
+            # compare as bytes: compare_digest on str raises for
+            # non-ASCII, which would turn every call (correct token
+            # included) into UNKNOWN instead of UNAUTHENTICATED
+            if hmac.compare_digest(got.encode("utf-8", "surrogatepass"),
+                                   token.encode("utf-8")):
+                return continuation(handler_call_details)
+
+            def reject(request, context):
+                context.abort(grpc.StatusCode.UNAUTHENTICATED,
+                              "missing or invalid x-solver-token")
+
+            return grpc.unary_unary_rpc_method_handler(reject)
+
+    return _Auth()
+
+
 class SolverServer:
-    """Owns the grpc.Server; bind with port=0 for an ephemeral port."""
+    """Owns the grpc.Server; bind with port=0 for an ephemeral port.
+
+    Default posture is loopback + insecure (same-pod companion). Binding
+    wider is an explicit decision and should come with `token` (shared
+    secret) and/or `tls_cert`/`tls_key` (PEM bytes -> TLS listener) —
+    the flags the deploy chart exposes under sidecar.*."""
 
     def __init__(self, address: str = "127.0.0.1", port: int = 0,
-                 max_workers: int = 4):
+                 max_workers: int = 4, token: Optional[str] = None,
+                 tls_cert: Optional[bytes] = None,
+                 tls_key: Optional[bytes] = None):
         import grpc
+        if (tls_cert is None) != (tls_key is None):
+            # a security posture must fail CLOSED: half a TLS config is
+            # an operator mistake, not a request for plaintext
+            raise ValueError(
+                "sidecar TLS requires BOTH tls_cert and tls_key")
+        interceptors = [_token_interceptor(token)] if token else []
         self._server = grpc.server(
             futures.ThreadPoolExecutor(max_workers=max_workers),
+            interceptors=interceptors,
             options=[("grpc.max_receive_message_length", 256 * 1024 * 1024),
                      ("grpc.max_send_message_length", 256 * 1024 * 1024)])
         self._server.add_generic_rpc_handlers((_generic_handler(_Handler()),))
-        self.port = self._server.add_insecure_port(f"{address}:{port}")
+        if tls_cert is not None and tls_key is not None:
+            creds = grpc.ssl_server_credentials(((tls_key, tls_cert),))
+            self.port = self._server.add_secure_port(
+                f"{address}:{port}", creds)
+        else:
+            self.port = self._server.add_insecure_port(f"{address}:{port}")
         self.address = f"{address}:{self.port}"
 
     def start(self) -> "SolverServer":
@@ -166,18 +214,26 @@ class SolverServer:
         self._server.stop(grace)
 
 
-def serve(address: str = "127.0.0.1", port: int = 50151) -> SolverServer:
+def serve(address: str = "127.0.0.1", port: int = 50151,
+          token: Optional[str] = None,
+          tls_cert_file: Optional[str] = None,
+          tls_key_file: Optional[str] = None) -> SolverServer:
     """Production entry: start and return the sidecar server. Defaults to
-    loopback — the sidecar is a same-pod companion of the control plane;
-    exposing it wider is an explicit operator decision (the channel is
-    insecure gRPC and requests are only shape-validated, not
-    authenticated)."""
-    return SolverServer(address, port).start()
+    loopback-insecure (same-pod companion). Exposing it wider is an
+    explicit operator decision — pass `token` (also SOLVER_SIDECAR_TOKEN
+    env) for shared-secret auth and cert/key paths for a TLS listener."""
+    cert = open(tls_cert_file, "rb").read() if tls_cert_file else None
+    key = open(tls_key_file, "rb").read() if tls_key_file else None
+    return SolverServer(address, port, token=token,
+                        tls_cert=cert, tls_key=key).start()
 
 
 if __name__ == "__main__":  # pragma: no cover
+    import os
     import time
     logging.basicConfig(level=logging.INFO)
-    s = serve()
+    s = serve(token=os.environ.get("SOLVER_SIDECAR_TOKEN") or None,
+              tls_cert_file=os.environ.get("SOLVER_SIDECAR_TLS_CERT") or None,
+              tls_key_file=os.environ.get("SOLVER_SIDECAR_TLS_KEY") or None)
     while True:
         time.sleep(3600)
