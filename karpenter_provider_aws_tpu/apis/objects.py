@@ -177,6 +177,12 @@ class Pod(KubeObject):
                  owner_kind: str = "",
                  scheduling_group: str = "",
                  volume_claims: Sequence[str] = ()):
+        # sort identity, set eagerly: canonical grouping sorts millions
+        # of pods by this key per solve — an instance attribute lets the
+        # hot sort use operator.attrgetter (C speed) instead of a
+        # memoizing helper function
+        self._nskey = (namespace, name)
+        self._full_name = f"{namespace}/{name}"
         self.metadata = ObjectMeta(name=name, namespace=namespace,
                                    labels=dict(labels or {}))
         self.requests = requests if requests is not None else Resources()
@@ -222,13 +228,10 @@ class Pod(KubeObject):
         return cached
 
     def full_name(self) -> str:
-        """namespace/name — the identity used in solver decisions (pod names
-        alone collide across namespaces). Memoized (hot in decode)."""
-        fn = self.__dict__.get("_full_name")
-        if fn is None:
-            self.__dict__["_full_name"] = fn = \
-                f"{self.metadata.namespace}/{self.metadata.name}"
-        return fn
+        """namespace/name — the identity used in solver decisions (pod
+        names alone collide across namespaces). Set eagerly in __init__;
+        hot paths read the attribute directly."""
+        return self._full_name
 
     def effective_requests(self) -> Resources:
         """requests + the implicit 1-pod slot. Memoized (hot path)."""

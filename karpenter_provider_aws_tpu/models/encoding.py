@@ -29,6 +29,7 @@ by solver/tpu.py.
 
 from __future__ import annotations
 
+import operator
 from dataclasses import dataclass, field
 from typing import Dict, List, Mapping, Optional, Sequence, Set, Tuple
 
@@ -205,11 +206,8 @@ class SnapshotEncoding:
         return 0 if self.mv_pairs_t is None else self.mv_pairs_t.shape[1]
 
 
-def _ns_name(p: Pod) -> Tuple[str, str]:
-    k = p.__dict__.get("_nskey")
-    if k is None:
-        p.__dict__["_nskey"] = k = (p.metadata.namespace, p.metadata.name)
-    return k
+#: C-speed sort key over Pod._nskey (set eagerly in Pod.__init__)
+_NSKEY_GET = operator.attrgetter("_nskey")
 
 
 #: process-wide signature intern table: sig tuple -> (small id, sig).
@@ -293,7 +291,7 @@ def canonical_pod_groups(pods: Sequence[Pod]) -> List[Tuple[Tuple, List[Pod]]]:
         rep = plist[0]
         r = rep.effective_requests()
         dig = pod_sig_digest(rep)
-        plist.sort(key=_ns_name)
+        plist.sort(key=_NSKEY_GET)
         entries.append(((-r["cpu"], -r["memory"], dig), sig, plist))
     entries.sort(key=lambda e: e[0])
     return [(sig, plist) for _, sig, plist in entries]

@@ -442,8 +442,10 @@ class TPUSolver(Solver):
                 reqs_cache[rk] = reqs
             used_vec = final["used"][slot]
             # per-group chunks arrive in ascending (ns, name) order, so
-            # the concatenation is a few sorted runs — timsort is ~O(n)
-            names = [p.full_name() for p in pods]
+            # the concatenation is a few sorted runs — timsort is ~O(n);
+            # _full_name is set eagerly in Pod.__init__ (attribute access
+            # beats a method call at 50k pods per solve)
+            names = [p._full_name for p in pods]
             names.sort()
             new_nodes.append(NewNodeClaim(
                 nodepool=pool.spec.nodepool.metadata.name,
