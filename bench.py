@@ -277,6 +277,32 @@ def _engine_report(counts):
     }
 
 
+def _phase_timed_dispatch(phases):
+    """A TPUSolver._dispatch replacement that splits each packed-kernel
+    dispatch into explicitly-synced h2d / kernel / d2h phases, recording
+    the latest split into ``phases`` (shared by --probe-device and the
+    device-kernel evidence capture)."""
+    def timed_dispatch(buf, **statics):
+        import jax.numpy as jnp
+        import numpy as np
+
+        from karpenter_provider_aws_tpu.ops.ffd_jax import solve_scan_packed1
+        t0 = time.perf_counter()
+        d_buf = jnp.asarray(buf)
+        d_buf.block_until_ready()
+        t1 = time.perf_counter()
+        o = solve_scan_packed1(d_buf, **statics)
+        o.block_until_ready()
+        t2 = time.perf_counter()
+        res = np.asarray(o)
+        t3 = time.perf_counter()
+        phases.update(h2d_ms=(t1 - t0) * 1e3, kernel_ms=(t2 - t1) * 1e3,
+                      d2h_ms=(t3 - t2) * 1e3,
+                      in_bytes=buf.nbytes, out_bytes=res.nbytes)
+        return res
+    return timed_dispatch
+
+
 def run_solver_config(name, snap, backend, rounds):
     from karpenter_provider_aws_tpu.solver import CPUSolver
     from karpenter_provider_aws_tpu.solver.tpu import TPUSolver
@@ -461,24 +487,7 @@ def run_device_probe(pods=50_000):
     snap = build_config2(env, pods)
     tpu = TPUSolver(backend="jax")
     phases = {}
-
-    def timed_dispatch(buf, **statics):
-        from karpenter_provider_aws_tpu.ops.ffd_jax import solve_scan_packed1
-        t0 = time.perf_counter()
-        d_buf = jnp.asarray(buf)
-        d_buf.block_until_ready()
-        t1 = time.perf_counter()
-        o = solve_scan_packed1(d_buf, **statics)
-        o.block_until_ready()
-        t2 = time.perf_counter()
-        res = np.asarray(o)
-        t3 = time.perf_counter()
-        phases.update(h2d_ms=(t1 - t0) * 1e3, kernel_ms=(t2 - t1) * 1e3,
-                      d2h_ms=(t3 - t2) * 1e3,
-                      in_bytes=buf.nbytes, out_bytes=res.nbytes)
-        return res
-
-    tpu._dispatch = timed_dispatch
+    tpu._dispatch = _phase_timed_dispatch(phases)
     tpu._dev_devices = lambda: 1  # decompose the packed single-device path
     t0 = time.perf_counter()
     tpu.solve(snap)  # compile
@@ -488,6 +497,251 @@ def run_device_probe(pods=50_000):
     out["warm"] = {k: (round(v, 2) if isinstance(v, float) else v)
                    for k, v in phases.items()}
     print(json.dumps(out))
+
+
+EVIDENCE_PATH = "DEVICE_EVIDENCE.json"
+
+
+def _append_evidence(rec, path=EVIDENCE_PATH):
+    """Append one attempt record to the cumulative evidence file.
+
+    flock'd read-modify-write: the session's background watcher and a
+    driver bench run may both append; losing an attempt record would
+    defeat the whole 'one healthy window produces the number' design."""
+    import fcntl
+    # read-modify-write through the LOCKED fd itself (seek/truncate, no
+    # os.replace): swapping the inode under the path would let a writer
+    # blocked on the old inode's lock resurrect stale content and drop
+    # the other writer's record
+    with open(path, "a+") as f:
+        fcntl.flock(f, fcntl.LOCK_EX)
+        f.seek(0)
+        raw = f.read().strip()
+        try:
+            doc = json.loads(raw) if raw else {"attempts": []}
+        except ValueError:
+            # a writer killed mid-dump leaves partial JSON; quarantine it
+            # and start fresh rather than killing every future attempt
+            # (the watcher loops regardless of exit codes — a poisoned
+            # file would silently end evidence collection for the session)
+            side = path + ".corrupt"
+            with open(side, "a") as g:
+                g.write(raw + "\n")
+            doc = {"attempts": [], "recovered_from_corruption": side}
+        doc["attempts"].append(rec)
+        f.seek(0)
+        f.truncate()
+        json.dump(doc, f, indent=1)
+        f.write("\n")
+        f.flush()
+        fcntl.flock(f, fcntl.LOCK_UN)
+    return len(doc["attempts"])
+
+
+def run_device_kernel(pods, rounds, timeout_s=900.0):
+    """Persistent device-evidence capture: probe the accelerator link with
+    the 90s-subprocess discipline; when it is healthy, measure the
+    device-served solve at catalog scale (configs 1/2/5 + the mesh path)
+    in a timeout-guarded subprocess — a link that wedges MID-measurement
+    must cost this process a timeout, never a hang. Every attempt,
+    healthy or not, appends to DEVICE_EVIDENCE.json, so a single healthy
+    window during any bench/watcher run produces the device number the
+    published tables have lacked since r01.
+
+    Writes NOTHING to stdout when invoked from the driver path: the
+    driver parses the last stdout line as the bench artifact."""
+    import datetime
+    import subprocess
+
+    from karpenter_provider_aws_tpu.solver.route import (dev_device_count,
+                                                         dev_platform,
+                                                         device_alive)
+    rec = {
+        "ts": datetime.datetime.now(datetime.timezone.utc)
+              .isoformat(timespec="seconds"),
+        "pods": pods, "rounds": rounds,
+    }
+    rec["alive"] = device_alive()  # blocking; 90s subprocess deadline
+    rec["platform"] = dev_platform()
+    rec["devices"] = dev_device_count()
+    if not rec["alive"]:
+        rec["ok"] = False
+        rec["note"] = ("liveness probe failed (90s subprocess deadline): "
+                       "link wedged or no accelerator; no device "
+                       "measurement possible from this host right now")
+        _append_evidence(rec)
+        return rec
+    cmd = [sys.executable, __file__, "--device-kernel-inner",
+           "--pods", str(pods), "--rounds", str(rounds)]
+    # propagate an in-process platform override (tests force cpu via
+    # jax.config.update; the JAX_PLATFORMS env var does NOT skip a wedged
+    # accelerator plugin — measured on this host) to the inner process
+    import os
+    inner_env = dict(os.environ)
+    if "jax" in sys.modules:
+        try:
+            plat = sys.modules["jax"].config.jax_platforms
+            if plat:
+                inner_env["KARP_JAX_PLATFORMS"] = plat
+        except Exception:
+            pass
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=timeout_s, env=inner_env)
+    except subprocess.TimeoutExpired as e:
+        out = e.stdout
+        _merge_inner_sections(
+            rec, out.decode() if isinstance(out, bytes) else out)
+        rec["note"] = (f"measurement subprocess exceeded {timeout_s:.0f}s "
+                       f"(link wedged mid-measurement)"
+                       + ("; partial capture kept"
+                          if rec.get("configs") else ""))
+        _finalize_device_verdict(rec)
+        _append_evidence(rec)
+        return rec
+    _merge_inner_sections(rec, proc.stdout)
+    if proc.returncode != 0:
+        rec["note"] = "measurement subprocess failed" + \
+            (" after partial capture" if rec.get("configs") else "")
+        rec["stderr_tail"] = proc.stderr[-2000:]
+    _finalize_device_verdict(rec)
+    _append_evidence(rec)
+    return rec
+
+
+def _finalize_device_verdict(rec):
+    """ok means DEVICE-SERVED, not merely 'subprocess exited 0': a link
+    that wedges after the initial alive check makes backend='jax' fall
+    back (nonblocking verdict) to the host twin per solve — such a
+    capture must never read as the device number."""
+    secs = list(rec.get("configs", {}).values())
+    if "mesh" in rec:
+        secs.append(rec["mesh"])
+    rec["ok"] = bool(secs) and all(s.get("device_solves", 0) > 0
+                                   for s in secs)
+    if secs and not rec["ok"]:
+        rec["note"] = (rec.get("note", "") +
+                       "; sections recorded but some were HOST-served "
+                       "(device_solves=0): link degraded mid-capture"
+                       ).lstrip("; ")
+
+
+def _merge_inner_sections(rec, stdout_text):
+    """Fold the inner process's per-section JSON lines into the attempt
+    record. The inner emits one line per COMPLETED section precisely so a
+    late wedge/timeout cannot discard configs that already measured —
+    partial device evidence is the whole point of this file."""
+    for line in (stdout_text or "").splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            sec = json.loads(line)
+        except ValueError:
+            continue
+        kind = sec.pop("section", None)
+        if kind == "env":
+            rec.update(sec)
+        elif kind == "mesh":
+            rec["mesh"] = sec
+        elif kind:
+            rec.setdefault("configs", {})[kind] = sec
+
+
+def run_device_kernel_inner(pods, rounds):
+    """The healthy-link measurement body (separate process so the parent
+    can deadline it): device-served full-solve p50/p99 for configs 1/2/5
+    at the full catalog, warm h2d/kernel/d2h decomposition, and the mesh
+    path on a real-device mesh. Decisions are verified identical to the
+    CPU oracle before any timing is recorded, and engine counts prove
+    every timed solve was device-served."""
+    import os
+
+    import jax
+    if os.environ.get("KARP_JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["KARP_JAX_PLATFORMS"])
+    import numpy as np
+
+    from karpenter_provider_aws_tpu.fake.environment import Environment
+    from karpenter_provider_aws_tpu.solver import CPUSolver
+    from karpenter_provider_aws_tpu.solver.route import device_alive
+    from karpenter_provider_aws_tpu.solver.tpu import TPUSolver
+
+    # resolve the route verdict BEFORE constructing solvers: backend="jax"
+    # falls back to the host twin while the probe is pending, which would
+    # silently turn this into a host measurement
+    assert device_alive(), "inner launched without a live device"
+    ds = jax.devices()
+    # one JSON line per COMPLETED section, flushed immediately: the
+    # parent folds whatever lines exist back into the attempt record, so
+    # a wedge during config 5 cannot discard configs 1 and 2
+    print(json.dumps({"section": "env",
+                      "measured_platform": ds[0].platform,
+                      "measured_devices": len(ds)}), flush=True)
+
+    def measure(tpu, snap, ref_fp_fn):
+        """compile → identity check → engine-counted timed rounds."""
+        t0 = time.perf_counter()
+        got = tpu.solve(snap)  # compile
+        compile_s = time.perf_counter() - t0
+        identical = got.decision_fingerprint() == ref_fp_fn()
+        counts = _count_engines(tpu)
+        gc.collect()
+        times = []
+        for _ in range(rounds):
+            t0 = time.perf_counter()
+            tpu.solve(snap)
+            times.append((time.perf_counter() - t0) * 1000)
+        p50, p99 = _percentiles(times)
+        return {"p50_ms": p50, "p99_ms": p99,
+                "identical_decisions": identical,
+                "device_solves": counts["dev"],
+                "host_solves": counts["host"],
+                "compile_s": round(compile_s, 1)}
+
+    env = Environment()
+    builders = {"1": (build_config1, 1000), "2": (build_config2, pods),
+                "5": (build_config5, pods)}
+    for name, (build, n) in builders.items():
+        snap = build(env, n)
+        tpu = TPUSolver(backend="jax")
+        phases = {}
+        tpu._dispatch = _phase_timed_dispatch(phases)
+        tpu._dev_devices = lambda: 1  # decompose the packed path
+
+        def oracle_fp(snap=snap, phases=phases):
+            cpu_t0 = time.perf_counter()
+            ref = CPUSolver().solve(snap)
+            phases["cpu_oracle_ms"] = (time.perf_counter() - cpu_t0) * 1000
+            return ref.decision_fingerprint()
+
+        sec = measure(tpu, snap, oracle_fp)
+        cpu_ms = phases.pop("cpu_oracle_ms")
+        sec.update(
+            cpu_oracle_ms=round(cpu_ms, 1),
+            speedup=round(cpu_ms / sec["p99_ms"], 2) if sec["p99_ms"] else 0.0,
+            warm={k: (round(v, 2) if isinstance(v, float) else v)
+                  for k, v in phases.items()},
+            section=name)
+        print(json.dumps(sec), flush=True)
+
+    # mesh path on the REAL device(s): with one chip this is a 1-device
+    # mesh (collectives degenerate but the shard_map/pmax program is the
+    # production multi-chip code path, measured end to end on hardware)
+    snap = build_config2(env, pods)
+    mesh_ndev = len(ds)
+    tpu = TPUSolver(backend="jax")
+    tpu._dev_devices = lambda: max(2, mesh_ndev)  # force the mesh branch
+    orig_mesh = tpu._dispatch_mesh
+
+    def forced_mesh(arrays, *, ndev, **kw):
+        return orig_mesh(arrays, ndev=mesh_ndev, **kw)
+
+    tpu._dispatch_mesh = forced_mesh
+    host_fp = TPUSolver(backend="numpy").solve(snap).decision_fingerprint
+    sec = measure(tpu, snap, lambda: host_fp())
+    sec.update(ndev=mesh_ndev, section="mesh")
+    print(json.dumps(sec), flush=True)
 
 
 def run_interruption_bench(counts=(100, 1000, 5000, 15000)):
@@ -527,6 +781,54 @@ def run_interruption_bench(counts=(100, 1000, 5000, 15000)):
     return rows
 
 
+PAUSE_PATH = "/tmp/karp_bench_pause"
+
+
+def _hold_pause_file(path=PAUSE_PATH, wait_s=600.0):
+    """Serialize measuring paths against the background device watcher.
+
+    The file holds the owning pid. Semantics: a LIVE holder that is not
+    our parent means another measurement is running — wait for it
+    (measuring concurrently contaminates both, 2-5x tail inflation on
+    this host); a dead holder is stale (bench SIGKILLed before atexit) —
+    take over; a holder that is our own parent means we are its child
+    worker (--all per-config subprocess, --device-kernel-inner) and must
+    neither wait nor touch the file."""
+    import atexit
+    import os
+    deadline = time.monotonic() + wait_s
+    while True:
+        try:
+            holder = int(open(path).read().strip() or 0)
+        except (OSError, ValueError):
+            holder = 0
+        if holder in (0, os.getpid()):
+            break
+        if holder == os.getppid():
+            return  # parent's hold covers us; it owns the cleanup
+        try:
+            os.kill(holder, 0)
+        except OSError:
+            break  # stale: holder died without cleanup; take over
+        if time.monotonic() >= deadline:
+            print(f"warning: pause file held by live pid {holder} for "
+                  f">{wait_s:.0f}s; proceeding (results may be "
+                  f"contaminated by the concurrent run)", file=sys.stderr)
+            break
+        time.sleep(5)
+    with open(path, "w") as f:
+        f.write(str(os.getpid()))
+
+    def _cleanup():
+        try:
+            if open(path).read().strip() == str(os.getpid()):
+                os.remove(path)
+        except OSError:
+            pass
+
+    atexit.register(_cleanup)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--pods", type=int, default=50_000)
@@ -541,13 +843,30 @@ def main():
                     help="run only the interruption throughput benchmark")
     ap.add_argument("--probe-device", action="store_true",
                     help="link-vs-kernel decomposition of the device path")
+    ap.add_argument("--device-kernel", action="store_true",
+                    help="probe the link and (if healthy) capture a "
+                         "device-served measurement; ALWAYS appends the "
+                         "attempt to DEVICE_EVIDENCE.json")
+    ap.add_argument("--device-kernel-inner", action="store_true",
+                    help=argparse.SUPPRESS)  # subprocess body, deadline'd
     args = ap.parse_args()
+
+    # every branch below measures something; hold the pause file for all
+    # of them (watcher coordination — see _hold_pause_file)
+    _hold_pause_file()
 
     if args.interruption:
         print(json.dumps({"interruption": run_interruption_bench()}))
         return
     if args.probe_device:
         run_device_probe(args.pods)
+        return
+    if args.device_kernel_inner:
+        run_device_kernel_inner(args.pods, args.rounds)
+        return
+    if args.device_kernel:
+        rec = run_device_kernel(args.pods, min(args.rounds, 50))
+        print(json.dumps(rec))
         return
 
     from karpenter_provider_aws_tpu.fake.environment import Environment
@@ -619,6 +938,10 @@ def main():
         "decisions": head["decisions"],
         "identical_decisions": True,
         "rounds": head["rounds"],
+        # which engine actually served: the driver artifact must prove
+        # device_solves/device_platform on its own, with no human
+        # cross-referencing to BASELINE.md
+        "engine": head["engine"],
     }
     if results:
         extra["configs"] = {str(k): v for k, v in sorted(results.items())}
@@ -629,7 +952,24 @@ def main():
         "unit": "ms",
         "vs_baseline": head["speedup"],
         "extra": extra,
-    }))
+    }), flush=True)
+
+    # Opportunistic device-evidence attempt on every driver bench run —
+    # the driver's end-of-round run on real hardware is exactly the
+    # healthy window DEVICE_EVIDENCE.json exists to catch. Runs AFTER the
+    # headline line is flushed (the driver parses the last stdout line;
+    # this writes only to the evidence file and stderr) and is
+    # deadline-guarded, so a wedged link costs minutes, never the round.
+    import os
+    if args.backend != "numpy" and \
+            os.environ.get("KARP_BENCH_DEVICE_EVIDENCE", "1") != "0":
+        try:
+            rec = run_device_kernel(args.pods, rounds=30)
+            print(f"device evidence: ok={rec.get('ok')} "
+                  f"platform={rec.get('platform')} "
+                  f"(cumulative log: {EVIDENCE_PATH})", file=sys.stderr)
+        except Exception as e:  # evidence must never fail the bench
+            print(f"device evidence attempt errored: {e}", file=sys.stderr)
 
 
 if __name__ == "__main__":
