@@ -253,6 +253,7 @@ def _count_engines(tpu):
     must never let a host-twin number masquerade as a device number)."""
     counts = {"host": 0, "dev": 0}
     orig_np, orig_jax = tpu._run_numpy, tpu._run_jax
+    orig_topo = tpu._run_jax_topo
 
     def run_np(*a, **k):
         counts["host"] += 1
@@ -262,7 +263,16 @@ def _count_engines(tpu):
         counts["dev"] += 1
         return orig_jax(*a, **k)
 
+    def run_topo(*a, **k):
+        # the topology event kernel is a device engine too (config 3);
+        # counted only on success — a TopoKernelBail falls through to
+        # _run_numpy, which the host wrapper counts instead
+        out = orig_topo(*a, **k)
+        counts["dev"] += 1
+        return out
+
     tpu._run_numpy, tpu._run_jax = run_np, run_jax
+    tpu._run_jax_topo = run_topo
     return counts
 
 
@@ -617,13 +627,15 @@ def _finalize_device_verdict(rec):
     secs = list(rec.get("configs", {}).values())
     if "mesh" in rec:
         secs.append(rec["mesh"])
-    rec["ok"] = bool(secs) and all(s.get("device_solves", 0) > 0
-                                   for s in secs)
+    rec["ok"] = bool(secs) and all(
+        s.get("device_solves", 0) > 0
+        and s.get("identical_decisions", False) for s in secs)
     if secs and not rec["ok"]:
         rec["note"] = (rec.get("note", "") +
                        "; sections recorded but some were HOST-served "
-                       "(device_solves=0): link degraded mid-capture"
-                       ).lstrip("; ")
+                       "(device_solves=0) or decision-divergent "
+                       "(identical_decisions=false): not a usable "
+                       "device number").lstrip("; ")
 
 
 def _merge_inner_sections(rec, stdout_text):

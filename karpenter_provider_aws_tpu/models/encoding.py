@@ -286,13 +286,36 @@ def canonical_pod_groups(pods: Sequence[Pod]) -> List[Tuple[Tuple, List[Pod]]]:
         for p in pods:  # degenerate fallback: group by the raw sig tuple
             raw.setdefault(pod_group_signature(p), []).append(p)
         sig_groups = list(raw.items())
+    for _sig, plist in sig_groups:
+        plist.sort(key=_NSKEY_GET)
+    return canonical_group_order(sig_groups)
+
+
+def canonical_group_order(
+        raw: List[Tuple[Tuple, List[Pod]]]) -> List[Tuple[Tuple, List[Pod]]]:
+    """Order (sig, members) groups canonically — by the representative's
+    (-cpu, -mem, sig-digest) FFD key — merging duplicate signatures
+    (member lists must each already be (ns, name)-sorted). Shared by the
+    full grouping above and the preference wrapper's group-level
+    reassembly, so both produce the oracle's exact processing order."""
+    by_sig: Dict[Tuple, List[Pod]] = {}
+    for sig, plist in raw:
+        cur = by_sig.get(sig)
+        if cur is None:
+            by_sig[sig] = plist
+        else:
+            # two partitions converged on one signature (e.g. a hardened
+            # chain meeting another group's raw spec): the oracle would
+            # interleave them by (ns, name) — merge and re-sort
+            merged = cur + plist
+            merged.sort(key=_NSKEY_GET)
+            by_sig[sig] = merged
     entries = []
-    for sig, plist in sig_groups:
+    for sig, plist in by_sig.items():
         rep = plist[0]
         r = rep.effective_requests()
-        dig = pod_sig_digest(rep)
-        plist.sort(key=_NSKEY_GET)
-        entries.append(((-r["cpu"], -r["memory"], dig), sig, plist))
+        entries.append(((-r["cpu"], -r["memory"], pod_sig_digest(rep)),
+                        sig, plist))
     entries.sort(key=lambda e: e[0])
     return [(sig, plist) for _, sig, plist in entries]
 
@@ -376,10 +399,15 @@ def _encode_catalog(seen: Dict[Tuple[str, int], InstanceType],
     return enc
 
 
-def encode_snapshot(snapshot: SchedulingSnapshot) -> SnapshotEncoding:
+def encode_snapshot(snapshot: SchedulingSnapshot,
+                    pod_groups: Optional[List[Tuple[Tuple, List[Pod]]]] = None
+                    ) -> SnapshotEncoding:
     # --- groups (canonical FFD order, O(n) grouping) ----------------------
+    # the preference wrapper already walked every pod to group them; when
+    # it hands the grouping down, the second 50k-pod walk disappears
     groups: List[PodGroup] = []
-    for sig, plist in canonical_pod_groups(snapshot.pods):
+    for sig, plist in (pod_groups if pod_groups is not None
+                       else canonical_pod_groups(snapshot.pods)):
         rep = plist[0]
         groups.append(PodGroup(index=len(groups), sig=sig, pods=plist,
                                reqs=rep.scheduling_requirements(),

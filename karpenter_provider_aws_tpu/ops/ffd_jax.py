@@ -170,122 +170,135 @@ def _solve(inp: KernelInputs, n_max: int, E: int, P: int,
     slot_idx = jnp.arange(N)
 
     def step(carry: Carry, xs):
-        R, n, F, agz, agc, admit, daemon, ex_compat = xs
-        n_rem = n
-
-        # ---- candidate types per open slot (steps 1-2) ----------------
-        zc = ((carry.zones & agz[None, :])[:, :, None]
-              & (carry.ct & agc[None, :])[:, None, :]).reshape(N, Z * C)
-        off_ok = (zc.astype(jnp.int32) @ inp.avail_zc.T.astype(jnp.int32)) > 0
-        pool_clipped = jnp.clip(carry.pool, 0, P - 1)
-        adm_open = jnp.where(carry.pool >= 0, admit[pool_clipped], False)
-        cand = carry.types & F[None, :] & off_ok & adm_open[:, None]
-
-        # ---- headroom (step 3) ---------------------------------------
-        hr_nt = _headroom_matrix(inp.A, carry.used, R)
-        k = jnp.where(cand, hr_nt, 0).max(axis=1)
-        if axis is not None:
-            k = jax.lax.pmax(k, axis)   # max over type shards
-        if E:
-            ex_ok = carry.alive[:E] & ex_compat
-            k_ex = jnp.where(ex_ok, _headroom_vec(inp.ex_alloc, carry.used[:E], R), 0)
-            k = k.at[:E].set(k_ex)
-        # minValues floors cap per-slot takes BEFORE the budget prefix
-        # caps (ops/ffd.py applies the same order)
-        if inp.mv_floor is not None:
-            hr1 = jnp.where(cand, hr_nt + 1, 0)
-            h1 = _mv_h1(hr1, inp.mv_pairs_t, inp.mv_pairs_v, V, T, axis)
-            if axis is not None:
-                h1 = jax.lax.pmax(h1, axis)
-            f = jnp.where((carry.pool >= 0)[:, None],
-                          inp.mv_floor[pool_clipped], 0)        # [N, K]
-            k = jnp.minimum(k, jnp.where(carry.pool >= 0,
-                                         _mv_cap(h1, f, V), BIG))
-        # pool limit budgets: cap per-pool prefix fills
-        pool_used = carry.pool_used
-        for pi in range(P):
-            has_limit = (inp.pool_limit[pi] >= 0).any()
-            budget = _pool_budget_jax(inp.pool_limit[pi], pool_used[pi], R)
-            rows = carry.pool == pi
-            kp = jnp.where(rows, k, 0)
-            cum = _cumsum(kp) - kp
-            capped = jnp.clip(jnp.minimum(kp, budget - cum), 0, None)
-            k = jnp.where(rows & has_limit, capped, k)
-
-        # ---- greedy prefix fill (step 4) ------------------------------
-        cum = _cumsum(k) - k
-        take = jnp.clip(n_rem - cum, 0, k)
-        n_rem = n_rem - take.sum()
-
-        used = carry.used + take[:, None] * R[None, :]
-        filled_open = (take > 0) & (carry.pool >= 0)
-        fit_all = (used[:, None, :] <= inp.A[None, :, :]).all(axis=-1)
-        types = jnp.where(filled_open[:, None], cand & fit_all, carry.types)
-        zones = jnp.where(filled_open[:, None], carry.zones & agz[None, :], carry.zones)
-        ct = jnp.where(filled_open[:, None], carry.ct & agc[None, :], carry.ct)
-        take_by_pool = jax.ops.segment_sum(
-            take, pool_clipped * (carry.pool >= 0) + (carry.pool < 0) * P,
-            num_segments=P + 1)[:P]
-        pool_used = pool_used + take_by_pool[:, None] * R[None, :]
-
-        # ---- new nodes pool-by-pool (step 5) --------------------------
-        pool_arr = carry.pool
-        alive = carry.alive
-        num_nodes = carry.num_nodes
-        for pi in range(P):
-            agz_p = agz & inp.pool_agz[pi]
-            agc_p = agc & inp.pool_agc[pi]
-            zc_p = (agz_p[:, None] & agc_p[None, :]).reshape(Z * C)
-            off_p = (inp.avail_zc & zc_p[None, :]).any(axis=1)
-            cand_new = F & inp.pool_types[pi] & off_p
-            hr = _headroom_vec(inp.A, daemon[pi][None, :], R)
-            hr = jnp.where(cand_new, hr, 0)
-            cap = hr.max()
-            if axis is not None:
-                cap = jax.lax.pmax(cap, axis)
-            if inp.mv_floor is not None:
-                h1n = _mv_h1(jnp.where(cand_new, hr + 1, 0),
-                             inp.mv_pairs_t, inp.mv_pairs_v, V, T, axis)
-                if axis is not None:
-                    h1n = jax.lax.pmax(h1n, axis)
-                cap = jnp.minimum(cap, _mv_cap(h1n, inp.mv_floor[pi], V))
-            budget = _pool_budget_jax(inp.pool_limit[pi], pool_used[pi], R)
-            can_place = jnp.where(
-                admit[pi] & (cap >= 1), jnp.minimum(n_rem, budget), 0)
-            # q new nodes: full nodes of `cap` + one partial
-            q = jnp.where(can_place > 0, -(-can_place // jnp.maximum(cap, 1)), 0)
-            free_slots = N - E - num_nodes
-            q = jnp.minimum(q, free_slots)
-            placed = jnp.minimum(can_place, q * cap)
-            start = E + num_nodes
-            is_new = (slot_idx >= start) & (slot_idx < start + q)
-            # pods per new slot: cap, except the last gets the remainder
-            offset = slot_idx - start
-            m_slot = jnp.where(
-                is_new,
-                jnp.where(offset == q - 1, placed - cap * (q - 1), cap), 0)
-            take = take + m_slot
-            used = used + m_slot[:, None] * R[None, :] \
-                + is_new[:, None] * daemon[pi][None, :]
-            hr_fit = (hr[None, :] >= m_slot[:, None]) & cand_new[None, :]
-            types = jnp.where(is_new[:, None], hr_fit, types)
-            zones = jnp.where(is_new[:, None], agz_p[None, :], zones)
-            ct = jnp.where(is_new[:, None], agc_p[None, :], ct)
-            pool_arr = jnp.where(is_new, pi, pool_arr)
-            alive = alive | is_new
-            num_nodes = num_nodes + q.astype(jnp.int32)
-            pool_used = pool_used.at[pi].add(placed * R)
-            n_rem = n_rem - placed
-
-        new_carry = Carry(used=used, types=types, zones=zones, ct=ct,
-                          pool=pool_arr, alive=alive, num_nodes=num_nodes,
-                          pool_used=pool_used)
-        return new_carry, (take, n_rem)
+        return plain_group_step(inp, carry, xs, axis=axis, P=P, E=E, N=N,
+                                V=V, slot_idx=slot_idx)
 
     xs = (inp.R, inp.n, inp.F, inp.agz, inp.agc, inp.admit, inp.daemon,
           inp.ex_compat)
     final, (takes, leftover) = jax.lax.scan(step, carry0, xs)
     return takes, leftover, final
+
+
+def plain_group_step(inp: KernelInputs, carry: Carry, xs, *, axis, P, E, N,
+                     V, slot_idx):
+    """One scan step of the closed-form (topology-free) group fill —
+    factored out so the topology kernel (ops/topo_jax.py) runs the same
+    math for its non-topology groups, sharing this single implementation
+    with the plain kernel."""
+    R, n, F, agz, agc, admit, daemon, ex_compat = xs
+    T, D = inp.A.shape
+    Z = inp.agz.shape[1]
+    C = inp.agc.shape[1]
+    n_rem = n
+
+    # ---- candidate types per open slot (steps 1-2) ----------------
+    zc = ((carry.zones & agz[None, :])[:, :, None]
+          & (carry.ct & agc[None, :])[:, None, :]).reshape(N, Z * C)
+    off_ok = (zc.astype(jnp.int32) @ inp.avail_zc.T.astype(jnp.int32)) > 0
+    pool_clipped = jnp.clip(carry.pool, 0, P - 1)
+    adm_open = jnp.where(carry.pool >= 0, admit[pool_clipped], False)
+    cand = carry.types & F[None, :] & off_ok & adm_open[:, None]
+
+    # ---- headroom (step 3) ---------------------------------------
+    hr_nt = _headroom_matrix(inp.A, carry.used, R)
+    k = jnp.where(cand, hr_nt, 0).max(axis=1)
+    if axis is not None:
+        k = jax.lax.pmax(k, axis)   # max over type shards
+    if E:
+        ex_ok = carry.alive[:E] & ex_compat
+        k_ex = jnp.where(ex_ok, _headroom_vec(inp.ex_alloc, carry.used[:E], R), 0)
+        k = k.at[:E].set(k_ex)
+    # minValues floors cap per-slot takes BEFORE the budget prefix
+    # caps (ops/ffd.py applies the same order)
+    if inp.mv_floor is not None:
+        hr1 = jnp.where(cand, hr_nt + 1, 0)
+        h1 = _mv_h1(hr1, inp.mv_pairs_t, inp.mv_pairs_v, V, T, axis)
+        if axis is not None:
+            h1 = jax.lax.pmax(h1, axis)
+        f = jnp.where((carry.pool >= 0)[:, None],
+                      inp.mv_floor[pool_clipped], 0)        # [N, K]
+        k = jnp.minimum(k, jnp.where(carry.pool >= 0,
+                                     _mv_cap(h1, f, V), BIG))
+    # pool limit budgets: cap per-pool prefix fills
+    pool_used = carry.pool_used
+    for pi in range(P):
+        has_limit = (inp.pool_limit[pi] >= 0).any()
+        budget = _pool_budget_jax(inp.pool_limit[pi], pool_used[pi], R)
+        rows = carry.pool == pi
+        kp = jnp.where(rows, k, 0)
+        cum = _cumsum(kp) - kp
+        capped = jnp.clip(jnp.minimum(kp, budget - cum), 0, None)
+        k = jnp.where(rows & has_limit, capped, k)
+
+    # ---- greedy prefix fill (step 4) ------------------------------
+    cum = _cumsum(k) - k
+    take = jnp.clip(n_rem - cum, 0, k)
+    n_rem = n_rem - take.sum()
+
+    used = carry.used + take[:, None] * R[None, :]
+    filled_open = (take > 0) & (carry.pool >= 0)
+    fit_all = (used[:, None, :] <= inp.A[None, :, :]).all(axis=-1)
+    types = jnp.where(filled_open[:, None], cand & fit_all, carry.types)
+    zones = jnp.where(filled_open[:, None], carry.zones & agz[None, :], carry.zones)
+    ct = jnp.where(filled_open[:, None], carry.ct & agc[None, :], carry.ct)
+    take_by_pool = jax.ops.segment_sum(
+        take, pool_clipped * (carry.pool >= 0) + (carry.pool < 0) * P,
+        num_segments=P + 1)[:P]
+    pool_used = pool_used + take_by_pool[:, None] * R[None, :]
+
+    # ---- new nodes pool-by-pool (step 5) --------------------------
+    pool_arr = carry.pool
+    alive = carry.alive
+    num_nodes = carry.num_nodes
+    for pi in range(P):
+        agz_p = agz & inp.pool_agz[pi]
+        agc_p = agc & inp.pool_agc[pi]
+        zc_p = (agz_p[:, None] & agc_p[None, :]).reshape(Z * C)
+        off_p = (inp.avail_zc & zc_p[None, :]).any(axis=1)
+        cand_new = F & inp.pool_types[pi] & off_p
+        hr = _headroom_vec(inp.A, daemon[pi][None, :], R)
+        hr = jnp.where(cand_new, hr, 0)
+        cap = hr.max()
+        if axis is not None:
+            cap = jax.lax.pmax(cap, axis)
+        if inp.mv_floor is not None:
+            h1n = _mv_h1(jnp.where(cand_new, hr + 1, 0),
+                         inp.mv_pairs_t, inp.mv_pairs_v, V, T, axis)
+            if axis is not None:
+                h1n = jax.lax.pmax(h1n, axis)
+            cap = jnp.minimum(cap, _mv_cap(h1n, inp.mv_floor[pi], V))
+        budget = _pool_budget_jax(inp.pool_limit[pi], pool_used[pi], R)
+        can_place = jnp.where(
+            admit[pi] & (cap >= 1), jnp.minimum(n_rem, budget), 0)
+        # q new nodes: full nodes of `cap` + one partial
+        q = jnp.where(can_place > 0, -(-can_place // jnp.maximum(cap, 1)), 0)
+        free_slots = N - E - num_nodes
+        q = jnp.minimum(q, free_slots)
+        placed = jnp.minimum(can_place, q * cap)
+        start = E + num_nodes
+        is_new = (slot_idx >= start) & (slot_idx < start + q)
+        # pods per new slot: cap, except the last gets the remainder
+        offset = slot_idx - start
+        m_slot = jnp.where(
+            is_new,
+            jnp.where(offset == q - 1, placed - cap * (q - 1), cap), 0)
+        take = take + m_slot
+        used = used + m_slot[:, None] * R[None, :] \
+            + is_new[:, None] * daemon[pi][None, :]
+        hr_fit = (hr[None, :] >= m_slot[:, None]) & cand_new[None, :]
+        types = jnp.where(is_new[:, None], hr_fit, types)
+        zones = jnp.where(is_new[:, None], agz_p[None, :], zones)
+        ct = jnp.where(is_new[:, None], agc_p[None, :], ct)
+        pool_arr = jnp.where(is_new, pi, pool_arr)
+        alive = alive | is_new
+        num_nodes = num_nodes + q.astype(jnp.int32)
+        pool_used = pool_used.at[pi].add(placed * R)
+        n_rem = n_rem - placed
+
+    new_carry = Carry(used=used, types=types, zones=zones, ct=ct,
+                      pool=pool_arr, alive=alive, num_nodes=num_nodes,
+                      pool_used=pool_used)
+    return new_carry, (take, n_rem)
 
 
 def _pool_budget_jax(limit: jax.Array, used: jax.Array, R: jax.Array) -> jax.Array:

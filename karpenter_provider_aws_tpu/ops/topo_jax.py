@@ -1,0 +1,727 @@
+"""Device lowering of the topology pour (ops/topo.py) — a jitted group
+scan whose topology groups run the pour's per-event decision loop inside
+``lax.while_loop``, with the same event compression the host engine uses:
+
+- run batching: each event places ``room`` pods (zone-run-room / host
+  caps / budget bounded), not one;
+- the periodic-cycle jump: a ring buffer of the last ``2*PMAX`` events
+  detects the staggered-ladder steady state and commits ``k`` whole
+  periods in one event (ops/topo.py:_try_jump, same bounds);
+- the cap-1 hostname-anti ladder bulk commit (one event opens the whole
+  one-pod-per-node run, ops/topo.py:_bulk_anti_clones).
+
+Non-topology groups in the same scan run the shared closed-form step
+(ops/ffd_jax.plain_group_step) plus membership-counter recording, so the
+carry state any group sees is bit-identical to the host engine's.
+
+Outputs: per-group ``takes`` plus a compact EVENT LOG (slot/zone/len/
+kind/aux per event) that the solver decodes into the same placement-run
+structure the host pour emits (including ("cyc", pattern, k) entries) —
+pod-to-node identity assignment is therefore identical, which
+tests/test_topology_equivalence.py enforces against the CPU oracle.
+
+Scope (the host pour remains the engine outside it, chosen by
+solver/tpu.py's lowerability predicate): no existing nodes, no minValues
+floors, no duplicate counter references within one group's constraint
+lists, and at most EVCAP events per group / periods up to PMAX (a bail
+flag falls back to the host pour — never a wrong answer).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .ffd_jax import (BIG, Carry, KernelInputs, _headroom_matrix,
+                      _headroom_vec, _pool_budget_jax, plain_group_step)
+
+#: event log kinds (host decode expands them into placement runs)
+K_PLACE = 0   # run on a zone-decided (or zone-free) slot
+K_FIX = 1     # run that also fixed an undecided slot's zone
+K_OPEN = 2    # run on a freshly opened node
+K_CYC = 3     # periodic jump: len=period p, aux=k whole periods
+K_ANTIRUN = 4  # cap-1 anti ladder: len=m fresh one-pod nodes from slot
+
+#: ring sentinel that can never equal a real event (see antirun poisoning)
+_RB_INVALID = -9
+
+
+class TopoGroupRows(NamedTuple):
+    """Per-group dense topology structure (scanned alongside the plain
+    group rows). GZ/GH are the interned zone/hostname counter spaces of
+    ops/topo.py's TopoEncoding."""
+    has_topo: jax.Array     # [G] bool
+    zone_needed: jax.Array  # [G] bool
+    min_mask: jax.Array     # [G, Z] bool  eligible zones for min-count
+    zs_any: jax.Array       # [G, GZ] bool  spread records into counter
+    zs_skew: jax.Array      # [G, GZ] i64   min enforced skew (BIG = none)
+    hs_any: jax.Array       # [G, GH] bool
+    hs_skew: jax.Array      # [G, GH] i64
+    za_any: jax.Array       # [G, GZ] bool  required zone affinity
+    za_anti: jax.Array      # [G, GZ] bool
+    za_own: jax.Array       # [G, GZ] bool
+    ha_any: jax.Array       # [G, GH] bool
+    ha_anti: jax.Array      # [G, GH] bool
+    ha_own: jax.Array       # [G, GH] bool
+    member_z: jax.Array     # [G] i32  counter to record membership into,
+    member_h: jax.Array     # [G] i32  -1 or already covered by zs/hs rows
+
+
+class _EvState(NamedTuple):
+    """Carry of the per-group event while_loop."""
+    # node state (Carry fields, mutated by commits)
+    used: jax.Array
+    types: jax.Array
+    zones: jax.Array
+    ct: jax.Array
+    pool: jax.Array
+    alive: jax.Array
+    num_nodes: jax.Array
+    pool_used: jax.Array
+    # topology counters
+    cz: jax.Array           # [GZ, Z]
+    ch: jax.Array           # [GH, N]
+    zfix: jax.Array         # [N] i32
+    # group-fill state
+    take: jax.Array         # [N]
+    rem: jax.Array          # [N]
+    cand: jax.Array         # [N, T]
+    ok: jax.Array           # [N] live admissibility (cleared on skips)
+    n_rem: jax.Array
+    # event log
+    ev_slot: jax.Array      # [EVCAP] i64
+    ev_zone: jax.Array
+    ev_len: jax.Array
+    ev_kind: jax.Array
+    ev_aux: jax.Array
+    ev_n: jax.Array
+    # jump ring buffer: last RB events as (slot, zone, len, kind)
+    rb: jax.Array           # [RB, 4] i64
+    L: jax.Array            # total host-equivalent event count
+    stuck: jax.Array        # bool: no placement possible this event
+    bail: jax.Array         # bool: EVCAP exhausted -> host fallback
+
+
+def _zone_ok(cz, min_mask, zs_skew, za_any, za_anti, za_own):
+    """[Z] zones admissible under enforced spread + zone affinity
+    (ops/topo.py:_zone_ok)."""
+    GZ, Z = cz.shape
+    elig_any = min_mask.any()
+    mn = jnp.where(elig_any,
+                   jnp.where(min_mask[None, :], cz, BIG).min(axis=1), 0)
+    ok = ((cz + 1 - mn[:, None]) <= zs_skew[:, None]).all(axis=0)
+    occ = cz > 0
+    occ_any = occ.any(axis=1)
+    aff_ok = jnp.where(
+        za_anti[:, None], ~occ,
+        jnp.where(occ_any[:, None], occ,
+                  jnp.broadcast_to(za_own[:, None], (GZ, Z))))
+    ok &= jnp.where(za_any[:, None], aff_ok, True).all(axis=0)
+    return ok
+
+
+def _zone_score(cz, zs_skew):
+    """[Z] zone-choice score: sum of enforced-spread counts
+    (ops/topo.py:_choose_zone). Zones are name-sorted in the encoding, so
+    index order IS the lexicographic tie-break."""
+    return jnp.where((zs_skew < BIG)[:, None], cz, 0).sum(axis=0)
+
+
+def _choose_zone(zcand, zok, cz, zs_skew):
+    """Min-(score, index) zone among zcand & zok; -1 if none."""
+    ok = zcand & zok
+    score = _zone_score(cz, zs_skew)
+    Z = score.shape[0]
+    key = jnp.where(ok, score * Z + jnp.arange(Z), BIG)
+    zi = jnp.argmin(key)
+    return jnp.where(ok.any(), zi, -1).astype(jnp.int64)
+
+
+def _zone_run_room(zi, cz, min_mask, zs_skew, za_any, za_anti, za_own):
+    """Consecutive-pour room in zone ``zi`` (ops/topo.py:_zone_run_room).
+    Callers guarantee zi >= 0."""
+    elig_any = min_mask.any()
+    mn = jnp.where(elig_any,
+                   jnp.where(min_mask[None, :], cz, BIG).min(axis=1), 0)
+    c = cz[:, zi]
+    at_min = elig_any & (c == mn)
+    per = jnp.where(zs_skew < BIG,
+                    jnp.where(at_min, 1, mn + zs_skew - c), BIG)
+    room = per.min()
+    occ_any = (cz > 0).any(axis=1)
+    za_room = jnp.where(
+        za_any & (za_anti | (za_own & ~occ_any)), 1, BIG)
+    return jnp.maximum(jnp.minimum(room, za_room.min()), 1)
+
+
+def _host_cap_slots(ch, hs_skew, ha_any, ha_anti, ha_own):
+    """[N] max further pods per slot under hostname spread/affinity
+    (ops/topo.py:_host_cap, vectorized over slots)."""
+    cap = jnp.where((hs_skew < BIG)[:, None], hs_skew[:, None] - ch,
+                    BIG).min(axis=0)
+    occ_here = ch > 0
+    occ_any = occ_here.any(axis=1)
+    anti_cap = jnp.where(occ_here, 0, jnp.where(ha_own[:, None], 1, BIG))
+    pos_cap = jnp.where(occ_any[:, None],
+                        jnp.where(occ_here, BIG, 0),
+                        jnp.where(ha_own[:, None], BIG, 0))
+    ha_cap = jnp.where(ha_anti[:, None], anti_cap, pos_cap)
+    cap = jnp.minimum(cap, jnp.where(ha_any[:, None], ha_cap, BIG).min(axis=0))
+    return jnp.clip(cap, 0, BIG)
+
+
+def _host_cap_new(ch, hs_skew, ha_any, ha_anti, ha_own):
+    """Cap for a brand-new node (ops/topo.py:_host_cap_new)."""
+    cap = jnp.where(hs_skew < BIG, hs_skew, BIG).min()
+    occ_any = (ch > 0).any(axis=1)
+    per = jnp.where(
+        ha_anti, jnp.where(ha_own, 1, BIG),
+        jnp.where(occ_any | ~ha_own, 0, BIG))
+    cap = jnp.minimum(cap, jnp.where(ha_any, per, BIG).min())
+    return jnp.clip(cap, 0, BIG)
+
+
+def _record_scatter(st: _EvState, g, slot, zi, count):
+    """Counter updates for one commit (ops/topo.py:_record): spread
+    counters (zone ones only when a zone is decided), then membership
+    counters not already covered."""
+    zs_any, hs_any = g.zs_any, g.hs_any
+    mz, mh = g.member_z, g.member_h
+    has_z = zi >= 0
+    zic = jnp.clip(zi, 0, st.cz.shape[1] - 1)
+    dz = jnp.where(zs_any & has_z, count, 0)
+    cz = st.cz.at[:, zic].add(dz)
+    mz_ok = (mz >= 0) & has_z
+    cz = cz.at[jnp.clip(mz, 0), zic].add(jnp.where(mz_ok, count, 0))
+    dh = jnp.where(hs_any, count, 0)
+    ch = st.ch.at[:, slot].add(dh)
+    ch = ch.at[jnp.clip(mh, 0), slot].add(jnp.where(mh >= 0, count, 0))
+    return st._replace(cz=cz, ch=ch)
+
+
+def _log_event(st: _EvState, slot, zi, ln, kind, aux=0, ring=True):
+    """Append to the event log (+ ring buffer unless the caller manages
+    it). EVCAP overflow sets bail — the host engine takes over."""
+    i = st.ev_n
+    over = i >= st.ev_slot.shape[0]
+    ic = jnp.clip(i, 0, st.ev_slot.shape[0] - 1)
+    st = st._replace(
+        ev_slot=st.ev_slot.at[ic].set(jnp.where(over, st.ev_slot[ic], slot)),
+        ev_zone=st.ev_zone.at[ic].set(jnp.where(over, st.ev_zone[ic], zi)),
+        ev_len=st.ev_len.at[ic].set(jnp.where(over, st.ev_len[ic], ln)),
+        ev_kind=st.ev_kind.at[ic].set(jnp.where(over, st.ev_kind[ic], kind)),
+        ev_aux=st.ev_aux.at[ic].set(jnp.where(over, st.ev_aux[ic], aux)),
+        ev_n=i + 1,
+        bail=st.bail | over,
+    )
+    if ring:
+        ev = jnp.array([0, 0, 0, 0], jnp.int64)
+        ev = ev.at[0].set(slot).at[1].set(zi).at[2].set(ln).at[3].set(kind)
+        st = st._replace(rb=jnp.concatenate([st.rb[1:], ev[None, :]]),
+                         L=st.L + 1)
+    return st
+
+
+def _commit(st: _EvState, g, R, slot, zi, count, kind):
+    """Place ``count`` pods on ``slot`` (ops/topo.py:_commit)."""
+    pi = st.pool[slot]
+    st = st._replace(
+        take=st.take.at[slot].add(count),
+        rem=st.rem.at[slot].add(-count),
+        used=st.used.at[slot].add(count * R),
+        pool_used=st.pool_used.at[jnp.clip(pi, 0)].add(
+            jnp.where(pi >= 0, count * R, 0)),
+        n_rem=st.n_rem - count)
+    st = _record_scatter(st, g, slot, zi, count)
+    return _log_event(st, slot, zi, count, kind)
+
+
+@partial(jax.jit, static_argnames=("n_max", "P", "V", "EVCAP", "PMAX"))
+def solve_scan_topo(inp: KernelInputs, topo: TopoGroupRows, cz0, ch0,
+                    n_max: int, P: int, V: int = 0,
+                    EVCAP: int = 128, PMAX: int = 8):
+    """The topology-aware group scan (existing-node-free: E=0 is enforced
+    by the caller's lowerability predicate). Returns (takes[G, N],
+    leftover[G], events dict, zfix[N], bail[G], final Carry)."""
+    E = 0
+    T, D = inp.A.shape
+    Z = inp.agz.shape[1]
+    C = inp.agc.shape[1]
+    N = n_max
+    GZ = cz0.shape[0]
+    GH = ch0.shape[0]
+    RB = 2 * PMAX
+    slot_idx = jnp.arange(N)
+
+    carry0 = Carry(
+        used=jnp.zeros((N, D), jnp.int64),
+        types=jnp.zeros((N, T), bool),
+        zones=jnp.zeros((N, Z), bool),
+        ct=jnp.zeros((N, C), bool),
+        pool=jnp.full((N,), -1, jnp.int32),
+        alive=jnp.zeros((N,), bool),
+        num_nodes=jnp.int32(0),
+        pool_used=inp.pool_used0,
+    )
+    tcarry0 = (carry0, cz0, ch0, jnp.full((N,), -1, jnp.int32))
+
+    # static per-solve tensors
+    avail_tzc = inp.avail_zc.reshape(T, Z, C)
+    availz_anyct = avail_tzc.any(axis=2)                      # [T, Z]
+
+    def topo_group(carry, czv, chv, zfixv, xs, gx: TopoGroupRows):
+        R, n, F, agz, agc, admit, daemon, _ex = xs
+
+        # ---- group-start eager state (the host computes these lazily;
+        # values are identical because nothing mutates between events of
+        # other groups) ------------------------------------------------
+        zc = ((carry.zones & agz[None, :])[:, :, None]
+              & (carry.ct & agc[None, :])[:, None, :]).reshape(N, Z * C)
+        off_ok = (zc.astype(jnp.int32)
+                  @ inp.avail_zc.T.astype(jnp.int32)) > 0
+        pool_clipped = jnp.clip(carry.pool, 0, P - 1)
+        adm_open = jnp.where(carry.pool >= 0, admit[pool_clipped], False)
+        cand = carry.types & F[None, :] & off_ok & adm_open[:, None]
+        hr_nt = _headroom_matrix(inp.A, carry.used, R)
+        rem0 = jnp.where(cand, hr_nt, 0).max(axis=1)
+
+        # per-pool open-a-node statics (ops/topo.py:_open_pool_static)
+        agz_p = agz[None, :] & inp.pool_agz                   # [P, Z]
+        agc_p = agc[None, :] & inp.pool_agc                   # [P, C]
+        off_p = (avail_tzc[None] & agz_p[:, None, :, None]
+                 & agc_p[:, None, None, :]).any(axis=(2, 3))  # [P, T]
+        cand_new = F[None, :] & inp.pool_types & off_p        # [P, T]
+        hr_new = jax.vmap(
+            lambda d: _headroom_vec(inp.A, d[None, :], R))(daemon)  # [P, T]
+        hrc_new = jnp.where(cand_new, hr_new, 0)
+        open_ok0 = (admit & agz_p.any(axis=1) & agc_p.any(axis=1)
+                    & (hrc_new.max(axis=1) >= 1))             # [P]
+        availz_p = (avail_tzc[None] & agc_p[:, None, None, :]
+                    ).any(axis=3)                             # [P, T, Z]
+        cap_pz = jnp.where(availz_p & cand_new[:, :, None],
+                           hr_new[:, :, None], 0).max(axis=1)  # [P, Z]
+        cap_any = hrc_new.max(axis=1)                         # [P]
+        zcand_pz = ((cand_new & (hr_new >= 1))[:, :, None]
+                    & availz_anyct[None]).any(axis=1) & agz_p  # [P, Z]
+        hcap_new0 = _host_cap_new(chv, gx.hs_skew, gx.ha_any,
+                                  gx.ha_anti, gx.ha_own)
+        anti_bulk_grp = (~gx.zs_any.any()) & (~gx.za_any.any()) \
+            & (~gx.hs_any.any()) \
+            & jnp.where(gx.ha_any, gx.ha_anti & gx.ha_own, True).all() \
+            & gx.ha_any.any()
+        need_zone = gx.zone_needed
+
+        st0 = _EvState(
+            used=carry.used, types=carry.types, zones=carry.zones,
+            ct=carry.ct, pool=carry.pool, alive=carry.alive,
+            num_nodes=carry.num_nodes, pool_used=carry.pool_used,
+            cz=czv, ch=chv, zfix=zfixv,
+            take=jnp.zeros(N, jnp.int64), rem=rem0, cand=cand,
+            ok=jnp.ones(N, bool), n_rem=n,
+            ev_slot=jnp.zeros(EVCAP, jnp.int64),
+            ev_zone=jnp.full(EVCAP, -1, jnp.int64),
+            ev_len=jnp.zeros(EVCAP, jnp.int64),
+            ev_kind=jnp.full(EVCAP, -1, jnp.int64),
+            ev_aux=jnp.zeros(EVCAP, jnp.int64),
+            ev_n=jnp.int64(0),
+            rb=jnp.full((RB, 4), _RB_INVALID, jnp.int64),
+            L=jnp.int64(0),
+            stuck=jnp.array(False), bail=jnp.array(False),
+        )
+
+        def budgets_of(st):
+            return jax.vmap(
+                lambda lim, us: _pool_budget_jax(lim, us, R)
+            )(inp.pool_limit, st.pool_used)                   # [P]
+
+        # ---- the periodic-cycle jump (ops/topo.py:_try_jump) ----------
+        def try_jump(st: _EvState):
+            halves_eq = []
+            for p in range(1, PMAX + 1):
+                a = st.rb[RB - 2 * p:RB - p]
+                b = st.rb[RB - p:]
+                halves_eq.append((st.L >= 2 * p) & (a == b).all())
+            eq = jnp.array(halves_eq)
+            p_star = jnp.argmax(eq) + 1          # smallest matching p
+            found = eq.any()
+            # host picks the FIRST matching p then requires all-place
+            tail_kind = st.rb[:, 3]
+            idx = jnp.arange(RB)
+            in_pat = idx >= (RB - p_star)
+            all_place = jnp.where(in_pat, tail_kind == K_PLACE, True).all()
+
+            pat_slot = st.rb[:, 0]
+            pat_zone = st.rb[:, 1]
+            pat_len = jnp.where(in_pat, st.rb[:, 2], 0)
+            d_n = pat_len.sum()
+            d_take = jnp.zeros(N, jnp.int64).at[
+                jnp.clip(pat_slot, 0, N - 1)].add(pat_len)
+            zsafe = jnp.clip(pat_zone, 0, Z - 1)
+            d_zone = jnp.zeros(Z, jnp.int64).at[zsafe].add(
+                jnp.where(pat_zone >= 0, pat_len, 0))
+            touched_z = d_zone > 0
+            deltas = jnp.where(touched_z, d_zone, -1)
+            delta = deltas.max()
+            uniform = jnp.where(touched_z, d_zone == delta, True).all() \
+                & (delta > 0)
+            enforced_z = (gx.zs_skew < BIG).any()
+            untouched_elig = (gx.min_mask & ~touched_z).any()
+            viable = found & all_place & (d_n > 0) & uniform \
+                & ~(enforced_z & gx.min_mask.any() & untouched_elig) \
+                & ~jnp.where(gx.ha_any, gx.ha_anti & gx.ha_own,
+                             False).any()
+
+            k = st.n_rem // jnp.maximum(d_n, 1)
+            # re-admission horizon of untouched zones with usable slots
+            elig_any = gx.min_mask.any()
+            mn = jnp.where(
+                elig_any,
+                jnp.where(gx.min_mask[None, :], st.cz, BIG).min(axis=1), 0)
+            usable_z = jnp.zeros(Z, bool).at[
+                jnp.clip(st.zfix, 0, Z - 1)].max(
+                (st.rem > 0) & (st.zfix >= 0))
+            horizon = jnp.where(
+                (gx.zs_skew < BIG)[:, None]
+                & (~touched_z & usable_z)[None, :],
+                jnp.clip((st.cz - gx.zs_skew[:, None] - mn[:, None])
+                         // jnp.maximum(delta, 1), 0, BIG), BIG)
+            k = jnp.minimum(k, horizon.min())
+            viable &= jnp.where((gx.zs_skew < BIG), elig_any, True).all()
+            # per-slot capacity + hostname-spread bounds
+            dt_safe = jnp.maximum(d_take, 1)
+            k = jnp.minimum(k, jnp.where(d_take > 0,
+                                         st.rem // dt_safe, BIG).min())
+            hs_room = jnp.where(
+                (gx.hs_skew < BIG)[:, None] & (d_take > 0)[None, :],
+                (gx.hs_skew[:, None] - st.ch) // dt_safe[None, :], BIG)
+            k = jnp.minimum(k, hs_room.min())
+            # pool budgets
+            d_pool = jnp.zeros(P + 1, jnp.int64).at[
+                jnp.where(st.pool >= 0, st.pool, P)].add(d_take)[:P]
+            k = jnp.minimum(k, jnp.where(
+                d_pool > 0, budgets_of(st) // jnp.maximum(d_pool, 1),
+                BIG).min())
+            viable &= k >= 1
+
+            def commit(st: _EvState):
+                total_slot = d_take * k
+                total_zone = d_zone * k
+                st = st._replace(
+                    take=st.take + total_slot,
+                    rem=st.rem - total_slot,
+                    used=st.used + total_slot[:, None] * R[None, :],
+                    pool_used=st.pool_used + jnp.where(
+                        (d_pool > 0)[:, None], (d_pool * k)[:, None] * R,
+                        0),
+                    n_rem=st.n_rem - d_n * k,
+                    cz=st.cz + jnp.where(gx.zs_any[:, None],
+                                         total_zone[None, :], 0)
+                    + jnp.where(
+                        (jnp.arange(GZ) == gx.member_z)[:, None]
+                        & (gx.member_z >= 0),
+                        total_zone[None, :], 0),
+                    ch=st.ch + jnp.where(gx.hs_any[:, None],
+                                         total_slot[None, :], 0)
+                    + jnp.where(
+                        (jnp.arange(GH) == gx.member_h)[:, None]
+                        & (gx.member_h >= 0),
+                        total_slot[None, :], 0),
+                    # host appends the pattern k (k<3) or 2 more times;
+                    # the ring tail is the pattern either way, so only
+                    # the event count moves
+                    L=st.L + p_star * jnp.minimum(k, 2),
+                )
+                return _log_event(st, 0, -1, p_star, K_CYC, aux=k,
+                                  ring=False)
+
+            return jax.lax.cond(viable, commit, lambda s: s, st), \
+                jnp.where(viable, d_n * k, 0)
+
+        # ---- slot selection + placement (ops/topo.py:_place_run) ------
+        def place_event(st: _EvState):
+            st, jumped = try_jump(st)
+
+            def after_jump(st: _EvState):
+                zok = _zone_ok(st.cz, gx.min_mask, gx.zs_skew,
+                               gx.za_any, gx.za_anti, gx.za_own)
+                # vectorized admissibility (ops/topo.py:_slot_admissible)
+                ok = st.rem > 0
+                hs_ok = (st.ch < gx.hs_skew[:, None]).all(axis=0)
+                occ_here = st.ch > 0
+                occ_any = occ_here.any(axis=1)
+                ha_ok = jnp.where(
+                    gx.ha_anti[:, None], ~occ_here,
+                    jnp.where(occ_any[:, None], occ_here,
+                              jnp.broadcast_to(gx.ha_own[:, None],
+                                               occ_here.shape)))
+                ok &= hs_ok & jnp.where(gx.ha_any[:, None], ha_ok,
+                                        True).all(axis=0)
+                bud = budgets_of(st)
+                ok &= jnp.where(st.pool >= 0,
+                                bud[jnp.clip(st.pool, 0)] > 0, False)
+                enforced_z = (gx.zs_skew < BIG).any()
+                needz = enforced_z | gx.za_any.any()
+                dec = st.zfix >= 0
+                zmask = jnp.where(dec, zok[jnp.clip(st.zfix, 0)], True)
+                ok &= jnp.where(needz, zmask, True)
+
+                hcaps = _host_cap_slots(st.ch, gx.hs_skew, gx.ha_any,
+                                        gx.ha_anti, gx.ha_own)
+
+                # first-admissible with skip-and-retry for undecided
+                # slots whose zone choice fails (pure until commit)
+                def sel_cond(c):
+                    ok_v, done, *_ = c
+                    return (~done) & ok_v.any()
+
+                def sel_body(c):
+                    ok_v, done, slot_o, zi_o, run_o, fix_o, keep_o, \
+                        remnew_o = c
+                    slot = jnp.argmax(ok_v)
+                    decided = st.zfix[slot] >= 0
+                    zi_d = st.zfix[slot].astype(jnp.int64)
+                    hcap = hcaps[slot]
+                    budget = bud[jnp.clip(st.pool[slot], 0)]
+                    roomz_d = jnp.where(
+                        needz & (zi_d >= 0),
+                        _zone_run_room(jnp.clip(zi_d, 0), st.cz,
+                                       gx.min_mask, gx.zs_skew,
+                                       gx.za_any, gx.za_anti, gx.za_own),
+                        BIG)
+                    run_d = jnp.minimum(
+                        jnp.minimum(st.rem[slot], hcap),
+                        jnp.minimum(budget,
+                                    jnp.minimum(st.n_rem, roomz_d)))
+                    # undecided path: choose a zone from the slot's
+                    # one-more-pod fit types (ops/topo.py:_choose_slot_zone)
+                    new_used = st.used[slot] + R
+                    fit1 = (new_used[None, :] <= inp.A).all(axis=1)
+                    fit_types = st.cand[slot] & fit1
+                    zcand = (availz_anyct & fit_types[:, None]).any(axis=0) \
+                        & st.zones[slot] & agz
+                    zi_u = _choose_zone(zcand, zok, st.cz, gx.zs_skew)
+                    zuc = jnp.clip(zi_u, 0)
+                    keep = st.cand[slot] & (
+                        avail_tzc[:, zuc, :]
+                        & (st.ct[slot] & agc)[None, :]).any(axis=1)
+                    hr_slot = _headroom_vec(
+                        inp.A, st.used[slot][None, :], R)
+                    remnew = jnp.clip(
+                        jnp.where(keep, hr_slot, 0).max()
+                        - st.take[slot], 0, BIG)
+                    roomz_u = _zone_run_room(zuc, st.cz, gx.min_mask,
+                                             gx.zs_skew, gx.za_any,
+                                             gx.za_anti, gx.za_own)
+                    run_u = jnp.minimum(
+                        jnp.minimum(remnew, hcap),
+                        jnp.minimum(budget,
+                                    jnp.minimum(st.n_rem, roomz_u)))
+                    use_undecided = (~decided) & needz
+                    run = jnp.where(use_undecided, run_u, run_d)
+                    zi = jnp.where(use_undecided, zi_u,
+                                   jnp.where(decided, zi_d, -1))
+                    viable = jnp.where(use_undecided,
+                                       (zi_u >= 0) & (run_u >= 1),
+                                       run_d >= 1)
+                    ok_v = ok_v.at[slot].set(jnp.where(viable,
+                                                       ok_v[slot], False))
+                    return (ok_v, viable, jnp.where(viable, slot, slot_o),
+                            jnp.where(viable, zi, zi_o),
+                            jnp.where(viable, run, run_o),
+                            jnp.where(viable, use_undecided, fix_o),
+                            jnp.where(viable, keep, keep_o),
+                            jnp.where(viable, remnew, remnew_o))
+
+                init = (ok, jnp.array(False), jnp.int64(0),
+                        jnp.int64(-1), jnp.int64(0), jnp.array(False),
+                        jnp.zeros(T, bool), jnp.int64(0))
+                _okv, found, slot, zi, run, fix, keep, remnew = \
+                    jax.lax.while_loop(sel_cond, sel_body, init)
+
+                def commit_slot(st: _EvState):
+                    def apply_fix(st: _EvState):
+                        onehot = jnp.arange(Z) == zi
+                        return st._replace(
+                            zfix=st.zfix.at[slot].set(
+                                zi.astype(jnp.int32)),
+                            zones=st.zones.at[slot].set(onehot),
+                            cand=st.cand.at[slot].set(keep),
+                            rem=st.rem.at[slot].set(remnew))
+                    st = jax.lax.cond(fix, apply_fix, lambda s: s, st)
+                    return _commit(st, gx, R, slot, zi, run,
+                                   jnp.where(fix, K_FIX, K_PLACE))
+
+                # ---- open a new node (ops/topo.py:_open_new) ----------
+                def open_new(st: _EvState):
+                    hcap_new = _host_cap_new(st.ch, gx.hs_skew, gx.ha_any,
+                                             gx.ha_anti, gx.ha_own)
+                    bud2 = budgets_of(st)
+                    free = N - st.num_nodes
+                    candz = zcand_pz & zok[None, :]
+                    score = _zone_score(st.cz, gx.zs_skew)
+                    key = jnp.where(candz, score[None, :] * Z
+                                    + jnp.arange(Z)[None, :], BIG)
+                    zi_p = jnp.argmin(key, axis=1)               # [P]
+                    zvalid = candz.any(axis=1)
+                    capz = cap_pz[jnp.arange(P), zi_p]
+                    cap = jnp.where(need_zone, capz, cap_any)
+                    valid_p = open_ok0 & (bud2 >= 1) & (free > 0) \
+                        & (cap >= 1) & (hcap_new >= 1) \
+                        & jnp.where(need_zone, zvalid, True)
+                    pi = jnp.argmax(valid_p)
+                    any_p = valid_p.any()
+
+                    def do_open(st: _EvState):
+                        zi = jnp.where(need_zone,
+                                       zi_p[pi].astype(jnp.int64), -1)
+                        zc_ = jnp.clip(zi, 0)
+                        slot = st.num_nodes.astype(jnp.int64)
+                        keep = jnp.where(
+                            need_zone,
+                            cand_new[pi] & availz_p[pi, :, zc_],
+                            cand_new[pi])
+                        capn = jnp.where(need_zone, cap_pz[pi, zc_],
+                                         cap_any[pi])
+                        zmask = jnp.where(need_zone,
+                                          jnp.arange(Z) == zi, agz_p[pi])
+                        roomz = jnp.where(
+                            need_zone & ((gx.zs_skew < BIG).any()
+                                         | gx.za_any.any()),
+                            _zone_run_room(zc_, st.cz, gx.min_mask,
+                                           gx.zs_skew, gx.za_any,
+                                           gx.za_anti, gx.za_own), BIG)
+                        run = jnp.maximum(jnp.minimum(
+                            jnp.minimum(capn, hcap_new),
+                            jnp.minimum(bud2[pi],
+                                        jnp.minimum(st.n_rem, roomz))), 1)
+                        st = st._replace(
+                            num_nodes=st.num_nodes + 1,
+                            alive=st.alive.at[slot].set(True),
+                            pool=st.pool.at[slot].set(
+                                pi.astype(jnp.int32)),
+                            zones=st.zones.at[slot].set(zmask),
+                            ct=st.ct.at[slot].set(agc_p[pi]),
+                            used=st.used.at[slot].set(daemon[pi]),
+                            zfix=st.zfix.at[slot].set(jnp.where(
+                                need_zone, zi.astype(jnp.int32), -1)),
+                            cand=st.cand.at[slot].set(keep),
+                            rem=st.rem.at[slot].set(capn))
+                        st = _commit(st, gx, R, slot, zi, run, K_OPEN)
+
+                        # cap-1 anti ladder bulk commit
+                        bulk_ok = (run == 1) & (hcap_new == 1) \
+                            & (zi < 0) & anti_bulk_grp & (st.n_rem > 0)
+
+                        def do_bulk(st: _EvState):
+                            m = jnp.minimum(
+                                jnp.minimum(st.n_rem, budgets_of(st)[pi]),
+                                (N - st.num_nodes).astype(jnp.int64))
+                            s0 = st.num_nodes.astype(jnp.int64)
+                            isn = (slot_idx >= s0) & (slot_idx < s0 + m)
+                            st = st._replace(
+                                num_nodes=st.num_nodes
+                                + m.astype(jnp.int32),
+                                alive=st.alive | isn,
+                                pool=jnp.where(
+                                    isn, pi.astype(jnp.int32), st.pool),
+                                zones=jnp.where(isn[:, None],
+                                                zmask[None, :], st.zones),
+                                ct=jnp.where(isn[:, None],
+                                             agc_p[pi][None, :], st.ct),
+                                used=jnp.where(
+                                    isn[:, None],
+                                    (daemon[pi] + R)[None, :], st.used),
+                                cand=jnp.where(isn[:, None],
+                                               keep[None, :], st.cand),
+                                rem=jnp.where(isn, 0, st.rem),
+                                take=jnp.where(isn, 1, st.take),
+                                pool_used=st.pool_used.at[pi].add(m * R),
+                                n_rem=st.n_rem - m,
+                                ch=st.ch + jnp.where(
+                                    ((jnp.arange(GH) == gx.member_h)
+                                     & (gx.member_h >= 0))[:, None]
+                                    & isn[None, :], 1, 0),
+                                # distinct fresh slots can never form a
+                                # periodic pattern: poison the ring
+                                rb=jnp.full((RB, 4), _RB_INVALID,
+                                            jnp.int64),
+                                L=st.L + m)
+                            return _log_event(st, s0, -1, m, K_ANTIRUN,
+                                              ring=False)
+
+                        return jax.lax.cond(bulk_ok, do_bulk,
+                                            lambda s: s, st)
+
+                    return jax.lax.cond(
+                        any_p, do_open,
+                        lambda s: s._replace(stuck=True), st)
+
+                return jax.lax.cond(found, commit_slot, open_new, st)
+
+            return jax.lax.cond(jumped > 0, lambda s: s, after_jump, st)
+
+        def ev_cond(st: _EvState):
+            return (st.n_rem > 0) & ~st.stuck & ~st.bail
+
+        st = jax.lax.while_loop(ev_cond, place_event, st0)
+
+        # group-end narrowing (ops/topo.py:_commit_narrowing)
+        touched = (st.take > 0) & (st.pool >= 0)
+        fit = (st.used[:, None, :] <= inp.A[None, :, :]).all(axis=2)
+        types = jnp.where(touched[:, None], st.cand & fit, st.types)
+        zones = jnp.where((touched & (st.zfix < 0))[:, None],
+                          st.zones & agz[None, :], st.zones)
+        ct = jnp.where(touched[:, None], st.ct & agc[None, :], st.ct)
+        new_carry = Carry(used=st.used, types=types, zones=zones, ct=ct,
+                          pool=st.pool, alive=st.alive,
+                          num_nodes=st.num_nodes, pool_used=st.pool_used)
+        ys = (st.take, st.n_rem, st.ev_slot, st.ev_zone, st.ev_len,
+              st.ev_kind, st.ev_aux, jnp.minimum(st.ev_n, EVCAP), st.bail)
+        return (new_carry, st.cz, st.ch, st.zfix), ys
+
+    def plain_group(carry, czv, chv, zfixv, xs, gx: TopoGroupRows):
+        new_carry, (take, leftover) = plain_group_step(
+            inp, carry, xs, axis=None, P=P, E=E, N=N, V=V,
+            slot_idx=slot_idx)
+        # membership recording (ops/topo.py:record_plain_fill)
+        mz, mh = gx.member_z, gx.member_h
+        chv = chv.at[jnp.clip(mh, 0)].add(
+            jnp.where(mh >= 0, take, 0))
+        zi = jnp.clip(zfixv, 0, Z - 1)
+        dz = jnp.zeros((Z,), jnp.int64).at[zi].add(
+            jnp.where((zfixv >= 0) & (take > 0), take, 0))
+        czv = czv.at[jnp.clip(mz, 0)].add(jnp.where(mz >= 0, dz, 0))
+        ys = (take, leftover,
+              jnp.zeros(EVCAP, jnp.int64), jnp.full(EVCAP, -1, jnp.int64),
+              jnp.zeros(EVCAP, jnp.int64), jnp.full(EVCAP, -1, jnp.int64),
+              jnp.zeros(EVCAP, jnp.int64), jnp.int64(0),
+              jnp.array(False))
+        return (new_carry, czv, chv, zfixv), ys
+
+    def step(tc, xs_all):
+        carry, czv, chv, zfixv = tc
+        xs = xs_all[:8]
+        gx = TopoGroupRows(*xs_all[8:])
+        return jax.lax.cond(
+            gx.has_topo,
+            lambda args: topo_group(*args),
+            lambda args: plain_group(*args),
+            (carry, czv, chv, zfixv, xs, gx))
+
+    xs_all = (inp.R, inp.n, inp.F, inp.agz, inp.agc, inp.admit,
+              inp.daemon, inp.ex_compat)
+    topo_fields = (topo.has_topo, topo.zone_needed, topo.min_mask,
+                   topo.zs_any, topo.zs_skew, topo.hs_any, topo.hs_skew,
+                   topo.za_any, topo.za_anti, topo.za_own,
+                   topo.ha_any, topo.ha_anti, topo.ha_own,
+                   topo.member_z, topo.member_h)
+    xs_all = xs_all + topo_fields
+    (final, cz, ch, zfix), ys = jax.lax.scan(step, tcarry0, xs_all)
+    takes, leftover, ev_slot, ev_zone, ev_len, ev_kind, ev_aux, ev_n, \
+        bail = ys
+    events = dict(slot=ev_slot, zone=ev_zone, len=ev_len, kind=ev_kind,
+                  aux=ev_aux, n=ev_n)
+    return takes, leftover, events, zfix, bail, final

@@ -100,5 +100,16 @@ class RemoteSolver(TPUSolver):
         mesh-vs-single decision for its local devices (server.py solve)."""
         return 1
 
+    def _topo_lowerable(self, enc, tenc, existing) -> bool:
+        """Topology snapshots run the host pour locally: this solver's
+        dev engine is the gRPC peer (router.alive = sidecar ping), and
+        the in-process topology kernel would (a) be gated by the WRONG
+        liveness verdict — a wedged local accelerator plugin hangs the
+        first array creation while the sidecar ping says alive — and
+        (b) feed local CPU-jax latencies into the sidecar's router
+        bucket. Lowering topo solves over the wire needs a dedicated
+        sidecar RPC, not a silent local detour."""
+        return False
+
     def _dispatch(self, buf: np.ndarray, **statics) -> np.ndarray:
         return self.client.solve_buffer(buf, statics)

@@ -231,7 +231,10 @@ class _PodCtx:
 class CPUSolver(Solver):
     name = "cpu"
 
-    def _solve_core(self, snapshot: SchedulingSnapshot) -> SolveResult:
+    def _solve_core(self, snapshot: SchedulingSnapshot,
+                    pod_groups=None) -> SolveResult:
+        # pod_groups intentionally unused: the oracle's own sort is its
+        # independence from the grouped encoder it validates
         pods = sorted(snapshot.pods, key=pod_sort_key)
         zones = sorted(snapshot.zones) if snapshot.zones else \
             sorted({o.zone for np_ in snapshot.nodepools

@@ -14,22 +14,28 @@ This module is that loop for the batch solvers:
 - the wrapper solves with every preference-bearing pod hardened at its
   current level, bumps the level of exactly the pods that came back
   unschedulable and still have something to relax, and re-solves; the
-  loop ends when nothing bumps (bounded by the longest preference chain).
+  loop ends when nothing bumps (bounded by the total relaxation budget).
 
-Pods with no preferences pass through untouched (the common case pays a
-single O(pods) scan). Hardened clones are cached on the pod object, so
-steady-state re-solves reuse them. Both solver engines share this wrapper,
-which keeps CPU/TPU decision equality by construction.
+The wrapper works at GROUP granularity: it computes the canonical pod
+grouping once (the same grouping the encoder needs — handed down so the
+50k-pod walk happens exactly once per solve), reads the preference chain
+off each group representative (the chain is a function of the scheduling
+signature, which all members share), and in relax rounds rebuilds only
+the partitions of soft groups whose levels moved — pods with no
+preferences are never walked again. Hardened clones are cached on the
+pod object, so steady-state re-solves reuse them. Both solver engines
+share this wrapper, which keeps CPU/TPU decision equality by
+construction.
 """
 
 from __future__ import annotations
 
 import copy
-from typing import Callable, Dict, List
+from typing import Callable, Dict, List, Tuple
 
 from ..apis.objects import Pod, PodAffinityTerm, TopologySpreadConstraint
+from ..models.encoding import canonical_group_order, canonical_pod_groups
 from .types import SchedulingSnapshot, SolveResult
-
 
 #: per-pod memo key for preference_count; the apis layer owns it so the
 #: invalidator (invalidate_scheduling_caches) and both lookup sites here
@@ -39,8 +45,8 @@ from ..apis.objects import PREF_COUNT_MEMO  # noqa: E402
 
 def preference_count(pod: Pod) -> int:
     """Length of the pod's preference chain (0 = nothing to relax).
-    Memoized per pod — the sweep runs over every pod on every solve and
-    dominates steady-state rounds at 50k pods otherwise
+    A function of the pod's scheduling signature, so one call per GROUP
+    representative covers every member
     (invalidate_scheduling_caches clears the memo)."""
     n = pod.__dict__.get(PREF_COUNT_MEMO)
     if n is None:
@@ -91,46 +97,69 @@ def harden(pod: Pod, level: int) -> Pod:
     return clone
 
 
+def _group_signature_of(pod: Pod) -> Tuple:
+    from ..models.encoding import pod_group_signature
+    return pod_group_signature(pod)
+
+
 def solve_with_preferences(
-        solve_core: Callable[[SchedulingSnapshot], SolveResult],
+        solve_core: Callable[..., SolveResult],
         snapshot: SchedulingSnapshot, metrics=None) -> SolveResult:
+    raw_groups = canonical_pod_groups(snapshot.pods)
+    #: group position -> chain length (>0 only for soft groups)
     chains: Dict[int, int] = {}
-    for p in snapshot.pods:
-        # inlined preference_count fast path: this sweep touches every
-        # pod every solve — at 50k pods the call overhead alone is
-        # measurable on the p50
-        n = p.__dict__.get(PREF_COUNT_MEMO)
-        if n is None:
-            n = preference_count(p)
+    for gi, (_sig, plist) in enumerate(raw_groups):
+        n = preference_count(plist[0])
         if n:
-            chains[id(p)] = n
+            chains[gi] = n
     if not chains:
-        return solve_core(snapshot)
-    level: Dict[int, int] = {pid: 0 for pid in chains}
-    soft = [p for p in snapshot.pods if id(p) in chains]
+        return solve_core(snapshot, pod_groups=raw_groups)
+    #: per-pod relaxation level (pods of one group can diverge: only the
+    #: members that came back unschedulable bump)
+    level: Dict[int, int] = {id(p): 0 for gi in chains
+                             for p in raw_groups[gi][1]}
     # relaxing one pod can newly block another (e.g. a relaxed pod lands
     # on a node and its group-membership counter now repels a hardened
     # anti-affinity pod), so the loop bound is the TOTAL relaxation
     # budget, not the longest single chain — every round that doesn't
     # terminate bumps at least one pod's level
-    max_rounds = 1 + sum(chains.values())
+    max_rounds = 1 + sum(chains[gi] * len(raw_groups[gi][1])
+                         for gi in chains)
     result: SolveResult = None  # type: ignore[assignment]
     rounds = 0
     for _ in range(max_rounds):
-        pods = [harden(p, level[id(p)]) if id(p) in chains else p
-                for p in snapshot.pods]
+        # group-level assembly: hard groups pass through untouched; each
+        # soft group splits into per-level partitions of hardened clones
+        # (partition preserves the (ns, name) member order). Only soft
+        # pods are walked per round.
+        assembled: List[Tuple[Tuple, List[Pod]]] = []
+        for gi, (sig, plist) in enumerate(raw_groups):
+            if gi not in chains:
+                assembled.append((sig, plist))
+                continue
+            parts: Dict[int, List[Pod]] = {}
+            for p in plist:
+                parts.setdefault(level[id(p)], []).append(p)
+            for lv, members in parts.items():
+                hardened = [harden(p, lv) for p in members]
+                assembled.append((_group_signature_of(hardened[0]),
+                                  hardened))
+        groups = canonical_group_order(assembled)
+        pods = [p for _, pl in groups for p in pl]
         result = solve_core(SchedulingSnapshot(
             pods=pods, nodepools=snapshot.nodepools,
             existing_nodes=snapshot.existing_nodes,
             daemon_overheads=snapshot.daemon_overheads,
-            zones=snapshot.zones))
+            zones=snapshot.zones), pod_groups=groups)
         bumped = False
         if result.unschedulable:
-            for p in soft:
-                if p.full_name() in result.unschedulable \
-                        and level[id(p)] < chains[id(p)]:
-                    level[id(p)] += 1
-                    bumped = True
+            for gi in chains:
+                cap = chains[gi]
+                for p in raw_groups[gi][1]:
+                    if level[id(p)] < cap and \
+                            p.full_name() in result.unschedulable:
+                        level[id(p)] += 1
+                        bumped = True
         if not bumped:
             break
         rounds += 1
@@ -139,8 +168,8 @@ def solve_with_preferences(
         # never be silent (same stance as the oracle-fallback counter)
         import logging
         logging.getLogger(__name__).info(
-            "preference relaxation took %d extra solve round(s) for %d "
-            "soft pods", rounds, len(soft))
+            "preference relaxation took %d extra solve round(s) across %d "
+            "soft group(s)", rounds, len(chains))
         if metrics is not None:
             metrics.inc("karpenter_solver_preference_relaxation_rounds_total",
                         value=float(rounds))

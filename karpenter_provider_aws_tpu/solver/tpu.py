@@ -36,6 +36,47 @@ def _slotmap(E: int, Ep: int, N: int) -> np.ndarray:
     return np.concatenate([np.arange(E), np.arange(Ep, N)])
 
 
+class TopoKernelBail(RuntimeError):
+    """The topology device kernel left its static event envelope for this
+    snapshot; the caller must serve it from the host pour instead."""
+
+
+def _runs_from_events(ev, gi: int):
+    """Reconstruct the host pour's placement-run list from the device
+    event log (ops/topo_jax.py kinds). The reconstruction mirrors the
+    host engine's exact bookkeeping: consecutive same-slot runs merge
+    (ops/topo.py:_commit), a cyc entry's pattern is the last `p` events
+    of the (host-equivalent) event tail, and the tail grows by the
+    pattern after a jump exactly as the host's event_log does."""
+    from ..ops.topo_jax import K_ANTIRUN, K_CYC
+    n = int(ev["n"][gi])
+    runs = []
+    tail = []  # (slot, len) of the host-equivalent event log tail
+    for i in range(n):
+        kind = int(ev["kind"][gi, i])
+        slot = int(ev["slot"][gi, i])
+        ln = int(ev["len"][gi, i])
+        if kind == K_CYC:
+            p = ln
+            k = int(ev["aux"][gi, i])
+            pattern = tail[-p:]
+            runs.append(("cyc", list(pattern), k))
+            tail.extend(pattern * (k if k < 3 else 2))
+        elif kind == K_ANTIRUN:
+            for j in range(ln):
+                runs.append((slot + j, 1))
+                tail.append((slot + j, 1))
+        else:  # place / fix / open
+            if runs and runs[-1][0] == slot:
+                runs[-1] = (slot, runs[-1][1] + ln)
+            else:
+                runs.append((slot, ln))
+            tail.append((slot, ln))
+        if len(tail) > 64:
+            del tail[:len(tail) - 64]
+    return runs
+
+
 class TPUSolver(Solver):
     name = "tpu"
 
@@ -72,11 +113,12 @@ class TPUSolver(Solver):
         return self._cpu_fallback.solve(snapshot)
 
     # ------------------------------------------------------------------
-    def _solve_core(self, snapshot: SchedulingSnapshot) -> SolveResult:
+    def _solve_core(self, snapshot: SchedulingSnapshot,
+                    pod_groups=None) -> SolveResult:
         if not snapshot.pods:
             return SolveResult(new_nodes=[], existing_assignments={},
                                unschedulable={})
-        enc = encode_snapshot(snapshot)
+        enc = encode_snapshot(snapshot, pod_groups=pod_groups)
         # topology detection is per GROUP (~tens), not per pod (~50k): the
         # pod-group signature includes spread/affinity terms, so the group
         # representative is authoritative for every member
@@ -98,9 +140,30 @@ class TPUSolver(Solver):
             if not tenc.supported:
                 return self._oracle_fallback(snapshot, "unsupported-topology")
             ex_alloc, ex_used, ex_compat = self._encode_existing(enc, existing)
-            takes, leftover, final = self._run_numpy(
-                enc, ex_alloc, ex_used, ex_compat,
-                tenc=tenc, existing=existing)
+
+            def host_pour():
+                return self._run_numpy(enc, ex_alloc, ex_used, ex_compat,
+                                       tenc=tenc, existing=existing)
+
+            lowerable = self._topo_lowerable(enc, tenc, existing)
+            if self.backend == "numpy" or not lowerable:
+                takes, leftover, final = host_pour()
+            elif self.backend == "jax":
+                from .route import dev_engine_usable
+                if dev_engine_usable(self._router):
+                    try:
+                        takes, leftover, final = self._run_jax_topo(enc, tenc)
+                    except TopoKernelBail:
+                        takes, leftover, final = host_pour()
+                else:
+                    takes, leftover, final = host_pour()
+            else:  # auto: cost-route host pour vs device event kernel
+                self._router.metrics = self.metrics
+                takes, leftover, final = routed(
+                    self._router,
+                    self._bucket_key(enc, ex_alloc.shape[0]) + ("topo",),
+                    host_pour,
+                    lambda: self._run_jax_topo(enc, tenc))
             return self._decode(enc, existing, takes, leftover, final)
         ex_alloc, ex_used, ex_compat = self._encode_existing(enc, existing)
         if self.backend == "jax":
@@ -224,6 +287,203 @@ class TPUSolver(Solver):
         cache = self.__dict__.setdefault("_mesh_cache", {})
         return dispatch_mesh(arrays, n_max=n_max, E=E, P=P, V=V,
                              ndev=ndev, cache=cache)
+
+    # -- topology device path ------------------------------------------
+    #: static event-loop bounds of the device pour (ops/topo_jax.py);
+    #: snapshots that exceed them bail back to the host engine
+    TOPO_EVCAP = 128
+    TOPO_PMAX = 8
+
+    def _topo_lowerable(self, enc, tenc, existing) -> bool:
+        """Conservative device-pour envelope (ops/topo_jax.py scope): no
+        existing nodes, no minValues floors, and no duplicate counter
+        references inside one group's constraint lists (the dense kernel
+        rows merge duplicates, which would change the zone-choice score
+        the host computes per-constraint)."""
+        if existing:
+            return False
+        if enc.mv_floor is not None and enc.mv_floor.any():
+            return False
+        for g in enc.groups:
+            gi = g.index
+            for lst in (tenc.zspread[gi], tenc.hspread[gi],
+                        tenc.zaff[gi], tenc.haff[gi]):
+                ids = [e[0] for e in lst]
+                if len(ids) != len(set(ids)):
+                    return False
+        return True
+
+    def _topo_rows(self, enc, tenc):
+        """Densify TopoEncoding into ops/topo_jax.TopoGroupRows arrays
+        (numpy, padded to the group bucket by the caller)."""
+        G = len(enc.groups)
+        Z = len(enc.zones)
+        GZ = max(1, tenc.GZ)
+        GH = max(1, tenc.GH)
+        big = np.int64(1) << 60
+        rows = dict(
+            has_topo=np.array(tenc.has_topo, dtype=bool),
+            zone_needed=np.array(tenc.zone_needed, dtype=bool),
+            min_mask=np.asarray(tenc.min_mask, dtype=bool),
+            zs_any=np.zeros((G, GZ), bool),
+            zs_skew=np.full((G, GZ), big, np.int64),
+            hs_any=np.zeros((G, GH), bool),
+            hs_skew=np.full((G, GH), big, np.int64),
+            za_any=np.zeros((G, GZ), bool),
+            za_anti=np.zeros((G, GZ), bool),
+            za_own=np.zeros((G, GZ), bool),
+            ha_any=np.zeros((G, GH), bool),
+            ha_anti=np.zeros((G, GH), bool),
+            ha_own=np.zeros((G, GH), bool),
+            member_z=np.full(G, -1, np.int32),
+            member_h=np.full(G, -1, np.int32),
+        )
+        for g in range(G):
+            for gz, s, enforce in tenc.zspread[g]:
+                rows["zs_any"][g, gz] = True
+                if enforce:
+                    rows["zs_skew"][g, gz] = min(rows["zs_skew"][g, gz], s)
+            for gh, s, enforce in tenc.hspread[g]:
+                rows["hs_any"][g, gh] = True
+                if enforce:
+                    rows["hs_skew"][g, gh] = min(rows["hs_skew"][g, gh], s)
+            for gz, anti, own in tenc.zaff[g]:
+                rows["za_any"][g, gz] = True
+                rows["za_anti"][g, gz] = anti
+                rows["za_own"][g, gz] = own
+            for gh, anti, own in tenc.haff[g]:
+                rows["ha_any"][g, gh] = True
+                rows["ha_anti"][g, gh] = anti
+                rows["ha_own"][g, gh] = own
+            # membership counters not already covered by the spread rows
+            # (ops/topo.py:_record's seen_z/seen_h dedup)
+            mz = tenc.member_z[g]
+            if mz >= 0 and not rows["zs_any"][g, mz]:
+                rows["member_z"][g] = mz
+            mh = tenc.member_h[g]
+            if mh >= 0 and not rows["hs_any"][g, mh]:
+                rows["member_h"][g] = mh
+        return rows, GZ, GH
+
+    def _run_jax_topo(self, enc, tenc):
+        """The device pour: same decisions as _run_numpy's topology path,
+        served by ops/topo_jax.solve_scan_topo. Raises TopoKernelBail
+        when the snapshot leaves the kernel's event envelope."""
+        from ..ops import topo_jax
+        from ..ops.topo_jax import TopoGroupRows, solve_scan_topo
+        import jax.numpy as jnp
+
+        T, D = enc.A.shape
+        Z, C = len(enc.zones), enc.avail.shape[2]
+        P = len(enc.pools)
+        G = len(enc.groups)
+        Gp = max(1, 1 << (G - 1).bit_length())
+        Pp = max(1, 1 << (P - 1).bit_length())
+        Dp = max(8, D)
+
+        def padG(a):
+            return np.pad(a, [(0, Gp - G)] + [(0, 0)] * (a.ndim - 1))
+
+        def padD(a):
+            return np.pad(a, [(0, 0)] * (a.ndim - 1) + [(0, Dp - D)])
+
+        arrays = dict(
+            A=padD(enc.A),
+            avail_zc=enc.avail.reshape(T, Z * C),
+            R=padG(padD(enc.R)), n=padG(enc.n), F=padG(enc.F),
+            agz=padG(enc.agz), agc=padG(enc.agc),
+            admit=np.pad(padG(enc.admit), [(0, 0), (0, Pp - P)]),
+            daemon=np.pad(padG(padD(enc.daemon)),
+                          [(0, 0), (0, Pp - P), (0, 0)]),
+            ex_alloc=np.zeros((0, Dp), np.int64),
+            ex_used0=np.zeros((0, Dp), np.int64),
+            ex_compat=np.zeros((Gp, 0), bool),
+        )
+        pool_types = np.zeros((Pp, T), bool)
+        pool_agz = np.zeros((Pp, Z), bool)
+        pool_agc = np.zeros((Pp, C), bool)
+        pool_limit = np.zeros((Pp, Dp), np.int64)
+        pool_used0 = np.zeros((Pp, Dp), np.int64)
+        for p in enc.pools:
+            pool_types[p.index] = p.type_rows
+            pool_agz[p.index] = p.agz
+            pool_agc[p.index] = p.agc
+            lim = p.limit_vec if p.limit_vec is not None \
+                else np.full(D, -1, dtype=np.int64)
+            pool_limit[p.index, :D] = lim
+            pool_limit[p.index, D:] = -1
+            pool_used0[p.index, :D] = p.in_use_vec
+        arrays.update(pool_types=pool_types, pool_agz=pool_agz,
+                      pool_agc=pool_agc, pool_limit=pool_limit,
+                      pool_used0=pool_used0)
+        from ..ops.ffd_jax import KernelInputs
+        inp = KernelInputs(**{k: jnp.asarray(v) for k, v in arrays.items()})
+
+        rows, GZ, GH = self._topo_rows(enc, tenc)
+        GZp = max(1, 1 << (GZ - 1).bit_length())
+        GHp = max(1, 1 << (GH - 1).bit_length())
+        big = np.int64(1) << 60
+
+        def padC(a, width, fill):
+            out = np.full((Gp, width), fill, a.dtype)
+            out[:G, :a.shape[1]] = a
+            return out
+
+        topo_rows = TopoGroupRows(
+            has_topo=np.pad(rows["has_topo"], (0, Gp - G)),
+            zone_needed=np.pad(rows["zone_needed"], (0, Gp - G)),
+            min_mask=padG(rows["min_mask"]),
+            zs_any=padC(rows["zs_any"], GZp, False),
+            zs_skew=padC(rows["zs_skew"], GZp, big),
+            hs_any=padC(rows["hs_any"], GHp, False),
+            hs_skew=padC(rows["hs_skew"], GHp, big),
+            za_any=padC(rows["za_any"], GZp, False),
+            za_anti=padC(rows["za_anti"], GZp, False),
+            za_own=padC(rows["za_own"], GZp, False),
+            ha_any=padC(rows["ha_any"], GHp, False),
+            ha_anti=padC(rows["ha_anti"], GHp, False),
+            ha_own=padC(rows["ha_own"], GHp, False),
+            member_z=np.pad(rows["member_z"], (0, Gp - G),
+                            constant_values=-1),
+            member_h=np.pad(rows["member_h"], (0, Gp - G),
+                            constant_values=-1),
+        )
+        topo_rows = TopoGroupRows(*[jnp.asarray(v) for v in topo_rows])
+        cz0 = jnp.zeros((GZp, Z), jnp.int64)
+        n_bucket = self._bucket
+        while True:
+            ch0 = jnp.zeros((GHp, n_bucket), jnp.int64)
+            takes_d, leftover_d, events, zfix_d, bail_d, carry = \
+                solve_scan_topo(inp, topo_rows, cz0, ch0,
+                                n_max=n_bucket, P=Pp,
+                                EVCAP=self.TOPO_EVCAP, PMAX=self.TOPO_PMAX)
+            bail = np.asarray(bail_d)
+            takes = np.asarray(takes_d)
+            leftover = np.asarray(leftover_d)
+            nn = int(np.asarray(carry.num_nodes))
+            if bail.any():
+                raise TopoKernelBail(
+                    f"{int(bail.sum())} group(s) exceeded the "
+                    f"{self.TOPO_EVCAP}-event device envelope")
+            exhausted = leftover.sum() > 0 and nn >= n_bucket
+            if not exhausted or n_bucket >= self.n_max:
+                break
+            n_bucket = min(n_bucket * 4, self.n_max)
+        self._bucket = n_bucket
+
+        ev = {k: np.asarray(v) for k, v in events.items()}
+        run_log = {}
+        for g in enc.groups:
+            gi = g.index
+            if rows["has_topo"][gi]:
+                run_log[gi] = _runs_from_events(ev, gi)
+        final = dict(
+            types=np.asarray(carry.types), zones=np.asarray(carry.zones),
+            ct=np.asarray(carry.ct), pool=np.asarray(carry.pool),
+            alive=np.asarray(carry.alive),
+            used=np.asarray(carry.used)[:, :D],
+            E=0, run_log=run_log, zfix=np.asarray(zfix_d))
+        return takes[:G], leftover[:G], final
 
     def _run_jax(self, enc, ex_alloc, ex_used, ex_compat):
         from ..ops.hostpack import pack_inputs1, unpack_outputs1

@@ -126,5 +126,10 @@ class Solver(abc.ABC):
                                       metrics=getattr(self, "metrics", None))
 
     @abc.abstractmethod
-    def _solve_core(self, snapshot: SchedulingSnapshot) -> SolveResult:
+    def _solve_core(self, snapshot: SchedulingSnapshot,
+                    pod_groups=None) -> SolveResult:
+        """pod_groups: optional canonical [(sig, members)] grouping the
+        preference wrapper already computed — engines that encode by
+        group reuse it instead of re-walking every pod; the oracle
+        ignores it (its independent sort is part of being the oracle)."""
         ...

@@ -23,8 +23,16 @@ def env():
     return Environment()
 
 
-@pytest.fixture(scope="module")
-def solvers():
+@pytest.fixture(scope="module", params=["host", "dev"])
+def solvers(request):
+    """Every scenario runs twice: tensor pour on the host twin, then on
+    the device event kernel (ops/topo_jax.py; jax-cpu under pytest).
+    Non-lowerable scenarios (existing nodes, minValues) fall back to the
+    host pour inside the jax solver — still asserted equivalent."""
+    if request.param == "dev":
+        from karpenter_provider_aws_tpu.solver import route
+        assert route.device_alive()
+        return (CPUSolver(), TPUSolver(backend="jax", n_max=192))
     return (CPUSolver(), TPUSolver(backend="numpy", n_max=192))
 
 
@@ -237,6 +245,40 @@ class TestTopologyFuzz:
             pools.append(env.nodepool(f"fzp{seed}b", weight=10,
                                       limits={"cpu": "30"}))
         assert_equivalent(env.snapshot(pods, pools), solvers)
+
+
+class TestDeviceKernelServes:
+    """The dev-path fixture above proves equivalence; this proves the
+    device kernel (not a silent host fallback) actually served a
+    lowerable config-3-shaped snapshot."""
+
+    def test_kernel_served_and_bail_falls_back(self, env):
+        from karpenter_provider_aws_tpu.solver import route
+        assert route.device_alive()
+        pods = (make_pods(40, cpu="500m", memory="1Gi", prefix="ksp")
+                + make_pods(24, cpu="1", memory="2Gi", prefix="kss",
+                            group="kss",
+                            topology_spread=[zspread(1, group="kss")]))
+        snap = env.snapshot(pods, [env.nodepool("ks")])
+        ref = CPUSolver().solve(snap)
+        tpu = TPUSolver(backend="jax", n_max=192)
+        served = {"dev": 0}
+        orig = tpu._run_jax_topo
+
+        def counting(*a, **k):
+            served["dev"] += 1
+            return orig(*a, **k)
+
+        tpu._run_jax_topo = counting
+        got = tpu.solve(snap)
+        assert served["dev"] == 1
+        assert ref.decision_fingerprint() == got.decision_fingerprint()
+
+        # EVCAP=1 forces the bail path: same decisions, host-served
+        tpu2 = TPUSolver(backend="jax", n_max=192)
+        tpu2.TOPO_EVCAP = 1
+        got2 = tpu2.solve(snap)
+        assert ref.decision_fingerprint() == got2.decision_fingerprint()
 
 
 class TestMinValuesWithTopology:
