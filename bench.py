@@ -155,6 +155,42 @@ def build_config6(env, n_pods):
     return env.snapshot(pods, [env.nodepool("bench-c6")])
 
 
+def build_config7(env, n_pods, n_sigs=10_000):
+    """High-cardinality pod-signature stress (the G axis): ~n_sigs
+    distinct scheduling signatures across n_pods pods. Solve cost scales
+    with the number of GROUPS, not pods — this config benches that
+    scaling law directly (the reference's pod-dense envelope,
+    test/suites/scale/provisioning_test.go:179-214, is the analog
+    workload; its 55k pods carry ~hundreds of distinct shapes — this
+    pushes 20x beyond that).
+
+    Signature mix (all satisfiable against the default catalog):
+    - 80% unique-requests groups: cpu varies at 1m granularity and
+      memory at 1Mi granularity (distinct owner/deployment shapes);
+    - 15% add a node selector on instance family;
+    - 5% add a spot capacity-type selector."""
+    from karpenter_provider_aws_tpu.apis import labels as L
+    from karpenter_provider_aws_tpu.fake.environment import make_pods
+
+    per = max(1, n_pods // n_sigs)
+    pods = []
+    fams = ["m5", "c5", "r5", "m6i", "c6i"]
+    i = 0
+    while len(pods) < n_pods:
+        cpu = 100 + (i % 400)          # 100..499 m
+        mem = 256 + (i // 400) * 3     # Mi; unique (cpu, mem) pairs
+        sel = None
+        if i % 20 >= 17:               # 15%: family-pinned
+            sel = {L.INSTANCE_FAMILY: fams[i % len(fams)]}
+        elif i % 20 == 16:             # 5%: spot-pinned
+            sel = {L.CAPACITY_TYPE: "spot"}
+        cnt = min(per, n_pods - len(pods))
+        pods += make_pods(cnt, cpu=f"{cpu}m", memory=f"{mem}Mi",
+                          prefix=f"hc{i:05d}", node_selector=sel)
+        i += 1
+    return env.snapshot(pods, [env.nodepool("bench-c7")])
+
+
 def build_config5(env, n_pods):
     """Spot+OD price-capacity-optimized across weighted pools w/ limits."""
     from karpenter_provider_aws_tpu.apis import labels as L
@@ -848,8 +884,10 @@ def main():
     ap.add_argument("--backend", default="auto",
                     choices=["auto", "jax", "numpy"])
     ap.add_argument("--all", action="store_true",
-                    help="run all 5 BASELINE configs (default: headline only)")
-    ap.add_argument("--config", type=int, choices=[1, 2, 3, 4, 5, 6],
+                    help="run configs 1/3/4/5/6/7 in isolated subprocesses, "
+                         "then the config-2 headline (default: headline "
+                         "only)")
+    ap.add_argument("--config", type=int, choices=[1, 2, 3, 4, 5, 6, 7],
                     help="run a single config and print its row")
     ap.add_argument("--interruption", action="store_true",
                     help="run only the interruption throughput benchmark")
@@ -886,7 +924,8 @@ def main():
     env = Environment()
     builders = {1: (build_config1, 1000), 2: (build_config2, args.pods),
                 3: (build_config3, args.pods), 5: (build_config5, args.pods),
-                6: (build_config6, args.pods)}
+                6: (build_config6, args.pods),
+                7: (build_config7, args.pods)}
 
     def run_one(ci):
         if ci == 4:
@@ -906,7 +945,7 @@ def main():
         # next one's tail latency — measured: config 3 p99 ~305ms when
         # sharing a process with config 1's leftovers vs ~170ms isolated
         import subprocess
-        for i, ci in enumerate((1, 3, 4, 5, 6)):
+        for i, ci in enumerate((1, 3, 4, 5, 6, 7)):
             if i:
                 # cooldown between configs: sustained back-to-back load
                 # (oracle solves are minutes of pinned CPU) degrades later

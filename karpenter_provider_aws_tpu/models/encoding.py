@@ -348,6 +348,9 @@ class _CatalogEncoding:
 _CATALOG_CACHE: Dict[Tuple, _CatalogEncoding] = {}
 _CATALOG_CACHE_CAP = 8
 _CATALOG_MU = threading.Lock()
+#: per-catalog-encoding signature->group-row cache bound (a long-lived
+#: operator watching churning workloads must not grow memory monotonically)
+_GROUP_ROW_CACHE_CAP = 1 << 16
 
 
 def _encode_catalog(seen: Dict[Tuple[str, int], InstanceType],
@@ -462,22 +465,6 @@ def encode_snapshot(snapshot: SchedulingSnapshot,
     A, avail, price = cenc.A, cenc.avail, cenc.price
     Z, C, T, D = len(zones), len(CAPACITY_TYPES), len(types), len(dims)
 
-    # --- group tensors --------------------------------------------------
-    G = len(groups)
-    R = np.zeros((G, D), dtype=np.int64)
-    n = np.zeros(G, dtype=np.int64)
-    F = np.ones((G, T), dtype=bool)
-    agz = np.ones((G, Z), dtype=bool)
-    agc = np.ones((G, C), dtype=bool)
-    for g in groups:
-        R[g.index] = vec(g.requests)
-        n[g.index] = g.count
-        g.masks = universe.group_masks(g.reqs)
-        for ki, mask in g.masks.items():
-            F[g.index] &= mask[type_val[:, ki]]
-        agz[g.index] = _zone_allow(g.reqs, zones, zid_of)
-        agc[g.index] = _ct_allow(g.reqs)
-
     # --- pools ----------------------------------------------------------
     pools: List[PoolEncoding] = []
     ordered_specs = sorted(
@@ -506,29 +493,74 @@ def encode_snapshot(snapshot: SchedulingSnapshot,
             masks=universe.group_masks(preqs),
             limit_vec=lim_vec,
             in_use_vec=vec(spec.in_use)))
-
     P = len(pools)
+
+    # --- group tensors (signature-keyed row cache) -----------------------
+    # Everything per-group here is a pure function of (scheduling
+    # signature, catalog encoding, pool set, daemon set, dims): cache the
+    # rows on the catalog encoding so recurring signatures — steady-state
+    # reconcile rounds, preference-relaxation re-solves, and the
+    # high-cardinality G axis — skip the requirements algebra entirely.
+    # Keyed by object identity for pools/daemons (the same staleness
+    # discipline as _CATALOG_CACHE: providers hand out stable objects
+    # until a seqnum bump rebuilds them).
+    row_cache = getattr(cenc, "_group_row_cache", None)
+    if row_cache is None:
+        row_cache = cenc._group_row_cache = {}
+    pkey = (tuple(id(spec.nodepool) for spec in ordered_specs),
+            tuple(id(d) for d in snapshot.daemon_overheads),
+            tuple(dims))
+    G = len(groups)
+    R = np.zeros((G, D), dtype=np.int64)
+    n = np.zeros(G, dtype=np.int64)
+    F = np.ones((G, T), dtype=bool)
+    agz = np.ones((G, Z), dtype=bool)
+    agc = np.ones((G, C), dtype=bool)
     admit = np.zeros((G, P), dtype=bool)
     daemon = np.zeros((G, P, D), dtype=np.int64)
     for g in groups:
-        pod = g.pods[0]
-        for pe in pools:
-            np_obj = pe.spec.nodepool
-            base = np_obj.scheduling_requirements()
-            if base.compatible(g.reqs):
-                continue
-            if not all(t.tolerated_by(pod.tolerations)
-                       for t in np_obj.template.taints):
-                continue
-            merged = base.union(g.reqs)
-            if any(r.unsatisfiable() for r in merged):
-                continue
-            admit[g.index, pe.index] = True
-            total = Resources()
-            for d in snapshot.daemon_overheads:
-                if not merged.compatible(d.requirements):
-                    total = total + d.requests
-            daemon[g.index, pe.index] = vec(total)
+        n[g.index] = g.count
+        ent = row_cache.get((g.sig, pkey))
+        if ent is None:
+            Rrow = vec(g.requests)
+            masks = universe.group_masks(g.reqs)
+            Frow = np.ones(T, dtype=bool)
+            for ki, mask in masks.items():
+                Frow &= mask[type_val[:, ki]]
+            agzrow = _zone_allow(g.reqs, zones, zid_of)
+            agcrow = _ct_allow(g.reqs)
+            admit_row = np.zeros(P, dtype=bool)
+            daemon_rows = np.zeros((P, D), dtype=np.int64)
+            pod = g.pods[0]
+            for pe in pools:
+                np_obj = pe.spec.nodepool
+                base = np_obj.scheduling_requirements()
+                if base.compatible(g.reqs):
+                    continue
+                if not all(t.tolerated_by(pod.tolerations)
+                           for t in np_obj.template.taints):
+                    continue
+                merged = base.union(g.reqs)
+                if any(r.unsatisfiable() for r in merged):
+                    continue
+                admit_row[pe.index] = True
+                total = Resources()
+                for d in snapshot.daemon_overheads:
+                    if not merged.compatible(d.requirements):
+                        total = total + d.requests
+                daemon_rows[pe.index] = vec(total)
+            if len(row_cache) >= _GROUP_ROW_CACHE_CAP:
+                row_cache.clear()
+            # the trailing pin holds the id()-keyed pool/daemon objects
+            # alive for the entry's lifetime: a GC'd pool whose address
+            # CPython recycles for a NEW pool must never alias an old
+            # key (same discipline as _CATALOG_CACHE pinning its types)
+            ent = row_cache[(g.sig, pkey)] = (
+                Rrow, masks, Frow, agzrow, agcrow, admit_row, daemon_rows,
+                (tuple(spec.nodepool for spec in ordered_specs),
+                 tuple(snapshot.daemon_overheads)))
+        (R[g.index], g.masks, F[g.index], agz[g.index], agc[g.index],
+         admit[g.index], daemon[g.index]) = ent[:7]
 
     mv_keys, mv_V, mv_floor, mv_pairs_t, mv_pairs_v = \
         _encode_min_values(pools, types, P)

@@ -1,0 +1,116 @@
+"""ctypes binding for the native whole-solve FFD fill
+(native/fastfill.cpp) — the C twin of ops/ffd.py::_fill_group_fast run
+over every group in one call.
+
+Used by the solver only when the snapshot fits the fast-path guards (no
+topology, no minValues floors, no pool limits); decision identity with
+the numpy engine is fuzz-enforced by tests/test_solver_equivalence.py.
+Falls back silently (``available() -> False``) when the library can't be
+built — the numpy fast path serves instead, slower but identical.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional, Tuple
+
+import numpy as np
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_SO_PATH = os.path.join(_REPO_ROOT, "native", "libkarpfastfill.so")
+
+_I64P = ctypes.POINTER(ctypes.c_int64)
+_U8P = ctypes.POINTER(ctypes.c_uint8)
+_I32P = ctypes.POINTER(ctypes.c_int32)
+
+
+def _load() -> "ctypes.CDLL | None":
+    if not os.path.exists(_SO_PATH):
+        cpp = os.path.join(_REPO_ROOT, "native", "fastfill.cpp")
+        if not os.path.exists(cpp):
+            return None
+        tmp = _SO_PATH + f".tmp.{os.getpid()}"
+        try:
+            subprocess.run(
+                ["g++", "-O3", "-fPIC", "-std=c++17", "-shared",
+                 "-o", tmp, cpp],
+                check=True, capture_output=True, timeout=60)
+            os.replace(tmp, _SO_PATH)
+        except Exception:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            return None
+    try:
+        lib = ctypes.CDLL(_SO_PATH)
+    except OSError:
+        return None
+    lib.karp_fast_fill.restype = ctypes.c_int64
+    lib.karp_fast_fill.argtypes = (
+        [ctypes.c_int64] * 9
+        + [_I64P, _U8P,                       # A, avail
+           _I64P, _I64P, _U8P, _U8P, _U8P, _U8P, _I64P,  # group rows
+           _U8P, _U8P, _U8P,                  # pool rows
+           _I64P, _U8P,                       # existing
+           _I64P, _U8P, _U8P, _U8P, _I32P, _U8P, _I64P, _I64P,  # state
+           _I64P, _I64P])                     # outputs
+    return lib
+
+
+_LIB = _load()
+
+
+def available() -> bool:
+    return _LIB is not None
+
+
+def _i64(a: np.ndarray) -> _I64P:
+    return a.ctypes.data_as(_I64P)
+
+
+def _u8(a: np.ndarray) -> _U8P:
+    return a.ctypes.data_as(_U8P)
+
+
+def fill_all(st, enc) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Run every group's closed-form fill natively, mutating ``st`` in
+    place exactly as the per-group numpy path would. Returns
+    (takes[G, N], leftover[G]), or None when the library is absent.
+    Caller enforces the fast-path guards."""
+    if _LIB is None:
+        return None
+    G = len(enc.groups)
+    P = len(enc.pools)
+    T, D = enc.A.shape
+    Z, C = len(enc.zones), enc.avail.shape[2]
+    takes = np.zeros((G, st.N), dtype=np.int64)
+    leftover = np.zeros(G, dtype=np.int64)
+    pool_types = np.ascontiguousarray(
+        np.stack([p.type_rows for p in enc.pools])
+        if P else np.zeros((0, T), bool))
+    pool_agz = np.ascontiguousarray(
+        np.stack([p.agz for p in enc.pools])
+        if P else np.zeros((0, Z), bool))
+    pool_agc = np.ascontiguousarray(
+        np.stack([p.agc for p in enc.pools])
+        if P else np.zeros((0, C), bool))
+    ex_alloc = st.ex_alloc if st.E else np.zeros((0, D), np.int64)
+    ex_compat = st.ex_compat if st.E else np.zeros((G, 0), bool)
+    num_nodes = _LIB.karp_fast_fill(
+        G, st.N, T, D, Z, C, st.E, P, st.num_nodes,
+        _i64(enc.A), _u8(enc.avail),
+        _i64(enc.R), _i64(enc.n), _u8(enc.F), _u8(enc.agz), _u8(enc.agc),
+        _u8(enc.admit), _i64(enc.daemon),
+        _u8(pool_types), _u8(pool_agz), _u8(pool_agc),
+        _i64(np.ascontiguousarray(ex_alloc)),
+        _u8(np.ascontiguousarray(ex_compat)),
+        _i64(st.used), _u8(st.types), _u8(st.zones), _u8(st.ct),
+        st.pool.ctypes.data_as(_I32P), _u8(st.alive),
+        _i64(st.cap_hint), _i64(st.pool_used),
+        _i64(takes), _i64(leftover))
+    st.num_nodes = int(num_nodes)
+    return takes, leftover

@@ -74,6 +74,12 @@ class NodeState:
     #: identical R transfers across pod groups — later groups skip the
     #: exact per-slot headroom recompute (topo._Pour lazy ensure).
     full_for: Dict[bytes, np.ndarray] = field(default_factory=dict)
+    #: [N, D] per-slot capacity UPPER BOUND: max allocatable over the
+    #: slot's candidate types at the last tightening. Safe to be stale
+    #: HIGH (type masks only ever narrow, usage only grows), so mutation
+    #: sites may skip updating it — the high-cardinality fast path
+    #: (_fill_group_fast) just probes a few extra slots. BIG = unknown.
+    cap_hint: Optional[np.ndarray] = None
 
     @staticmethod
     def create(enc: SnapshotEncoding, n_max: int,
@@ -98,6 +104,8 @@ class NodeState:
         st.used[:E] = ex_used
         st.pool[:E] = -2
         st.alive[:E] = True
+        st.cap_hint = np.full((N, D), BIG, dtype=np.int64)
+        st.cap_hint[:E] = ex_alloc
         return st
 
 
@@ -235,6 +243,159 @@ def greedy_fill(k: np.ndarray, n: int) -> Tuple[np.ndarray, int]:
     return take.astype(np.int64), int(n - take.sum())
 
 
+def _off_any(enc: SnapshotEncoding, zmask: np.ndarray,
+             cmask: np.ndarray) -> np.ndarray:
+    """[T] has-an-available-offering under the (zone, ct) masks; cached on
+    the encoding by mask bytes (slots share few distinct patterns)."""
+    cache = getattr(enc, "_off_any_cache", None)
+    if cache is None:
+        cache = enc._off_any_cache = {}
+    key = zmask.tobytes() + cmask.tobytes()
+    off = cache.get(key)
+    if off is None:
+        off = cache[key] = (enc.avail & zmask[None, :, None]
+                            & cmask[None, None, :]).any(axis=(1, 2))
+    return off
+
+
+def _fill_group_fast(st: NodeState, enc: SnapshotEncoding, g: int
+                     ) -> Tuple[np.ndarray, int]:
+    """The high-cardinality (G-axis) fast path of the closed form:
+    identical decisions, O(probed slots) instead of O(N x T) per group.
+
+    The full [N, T] candidate/headroom pass recomputes near-identical
+    tensors for every group; at ~10k distinct pod signatures that O(G x
+    N x T) dominates the solve (BASELINE config 7). FFD only ever
+    consumes per-slot headroom in slot order until the group is placed,
+    so this walk (a) prunes slots whose conservative capacity bound
+    (cap_hint, stale-high-safe) cannot fit even one pod — provably k=0 —
+    and (b) computes the exact [T] candidate/headroom row only for the
+    few surviving probe slots, committing in the same slot order the
+    prefix fill uses. Guards in fill_group_closed_form keep every
+    override/minValues/pool-limit shape on the exact full pass."""
+    n_rem = int(enc.n[g])
+    R = enc.R[g]
+    sel = R > 0
+    Rsel = R[sel]
+    take = np.zeros(st.N, dtype=np.int64)
+    agz_g = enc.agz[g]
+    agc_g = enc.agc[g]
+    n_act = st.E + st.num_nodes
+    if n_act and n_rem:
+        adm = st.alive[:n_act].copy()
+        if st.E:
+            adm[:st.E] &= st.ex_compat[g]
+        open_sel = st.pool[:n_act] >= 0
+        adm[open_sel] &= enc.admit[g][st.pool[:n_act][open_sel]]
+        if sel.any():
+            room = (st.cap_hint[:n_act][:, sel]
+                    - st.used[:n_act][:, sel]) >= Rsel[None, :]
+            adm &= room.all(axis=1)
+        for slot in np.nonzero(adm)[0]:
+            slot = int(slot)
+            if slot < st.E:
+                k = int(_headroom(st.ex_alloc[slot], st.used[slot], R))
+                crow = None
+            else:
+                crow = st.types[slot] & enc.F[g]
+                if not crow.any():
+                    continue
+                crow = crow & _off_any(enc, st.zones[slot] & agz_g,
+                                       st.ct[slot] & agc_g)
+                if not crow.any():
+                    continue
+                hr = _headroom(enc.A, st.used[slot][None, :], R)
+                k = int(np.where(crow, hr, 0).max())
+            if k <= 0:
+                continue
+            m = min(k, n_rem)
+            take[slot] = m
+            n_rem -= m
+            st.used[slot] += m * R
+            if crow is not None:  # open slot: narrow + tighten the bound
+                fit = (st.used[slot][None, :] <= enc.A).all(axis=1)
+                st.types[slot] = crow & fit
+                st.zones[slot] &= agz_g
+                st.ct[slot] &= agc_g
+                st.cap_hint[slot] = np.where(
+                    st.types[slot][:, None], enc.A, 0).max(axis=0)
+                pi = int(st.pool[slot])
+                st.pool_used[pi] += m * R
+            if n_rem == 0:
+                return take, 0
+    return _open_new_nodes(st, enc, g, n_rem, R, agz_g, agc_g, take)
+
+
+def _open_new_nodes(st: NodeState, enc: SnapshotEncoding, g: int,
+                    n_rem: int, R: np.ndarray, agz_g: np.ndarray,
+                    agc_g: np.ndarray, take: np.ndarray
+                    ) -> Tuple[np.ndarray, int]:
+    """Step 5 — open new nodes pool-by-pool (weight order). The single
+    Python implementation shared by the fast walk and the full closed
+    form (the C twin in native/fastfill.cpp is the third copy and is
+    fuzz-pinned to this one). Candidate masks are cached per
+    (constraint-bytes, pool) on the encoding."""
+    if not enc.pools:
+        return take, n_rem
+    cache = getattr(enc, "_cand_new_cache", None)
+    if cache is None:
+        cache = enc._cand_new_cache = {}
+    for pe in enc.pools:
+        if n_rem == 0:
+            break
+        pi = pe.index
+        if not enc.admit[g, pi]:
+            continue
+        daemon = enc.daemon[g, pi]
+        key = (enc.F[g].tobytes() + agz_g.tobytes() + agc_g.tobytes(), pi)
+        ent = cache.get(key)
+        if ent is None:
+            agz_p = agz_g & pe.agz
+            agc_p = agc_g & pe.agc
+            if not agz_p.any() or not agc_p.any():
+                cand_new = None
+            else:
+                cand_new = enc.F[g] & pe.type_rows \
+                    & _off_any(enc, agz_p, agc_p)
+                if not cand_new.any():
+                    cand_new = None
+            ent = cache[key] = (cand_new,
+                                agz_p if cand_new is not None else None,
+                                agc_p if cand_new is not None else None)
+        cand_new, agz_p, agc_p = ent
+        if cand_new is None:
+            continue
+        hr = _headroom(enc.A, daemon[None, :], R)
+        hr = np.where(cand_new, hr, 0)
+        cap = int(hr.max())
+        if enc.mv_floor is not None and enc.mv_floor[pi].any():
+            cap = min(cap, int(min_values_cap(enc, pi, cand_new, hr)))
+        if cap < 1:
+            continue
+        budget = _pool_budget(enc, st.pool_used, pi, R)
+        can_place = min(n_rem, budget)
+        if can_place < 1:
+            continue
+        while can_place > 0 and st.num_nodes < st.N - st.E:
+            slot = st.E + st.num_nodes
+            m = min(cap, can_place)
+            st.num_nodes += 1
+            st.alive[slot] = True
+            st.pool[slot] = pi
+            st.used[slot] = daemon + m * R
+            st.types[slot] = cand_new & (hr >= m)
+            st.zones[slot] = agz_p
+            st.ct[slot] = agc_p
+            if st.cap_hint is not None:
+                st.cap_hint[slot] = np.where(
+                    st.types[slot][:, None], enc.A, 0).max(axis=0)
+            take[slot] = m
+            st.pool_used[pi] += m * R
+            can_place -= m
+            n_rem -= m
+    return take, n_rem
+
+
 def fill_group_closed_form(st: NodeState, enc: SnapshotEncoding, g: int,
                            n_override: Optional[int] = None,
                            agz_override: Optional[np.ndarray] = None,
@@ -245,6 +406,11 @@ def fill_group_closed_form(st: NodeState, enc: SnapshotEncoding, g: int,
     (take[N], leftover). Overrides support the topology pre-pass: zone-
     restricted subgroups, per-slot pod caps (hostname spread), forbidden
     slots (hostname anti-affinity)."""
+    if (n_override is None and agz_override is None and slot_cap is None
+            and forbid_slots is None and enc.mv_floor is None
+            and st.cap_hint is not None
+            and all(pe.limit_vec is None for pe in enc.pools)):
+        return _fill_group_fast(st, enc, g)
     n_rem = int(enc.n[g]) if n_override is None else n_override
     R = enc.R[g]
     agz_g = enc.agz[g] if agz_override is None else agz_override
@@ -302,47 +468,4 @@ def fill_group_closed_form(st: NodeState, enc: SnapshotEncoding, g: int,
             st.pool_used[pi] += int(take[i]) * R
     if n_rem == 0 or not enc.pools:
         return take, n_rem
-
-    # ---- new nodes pool-by-pool ---------------------------------------
-    for pe in enc.pools:
-        if n_rem == 0:
-            break
-        pi = pe.index
-        if not enc.admit[g, pi]:
-            continue
-        daemon = enc.daemon[g, pi]
-        agz_p = agz_g & pe.agz
-        agc_p = enc.agc[g] & pe.agc
-        if not agz_p.any() or not agc_p.any():
-            continue
-        off_p = (enc.avail & agz_p[None, :, None]
-                 & agc_p[None, None, :]).any(axis=(1, 2))
-        cand_new = enc.F[g] & pe.type_rows & off_p
-        if not cand_new.any():
-            continue
-        hr = _headroom(enc.A, daemon[None, :], R)
-        hr = np.where(cand_new, hr, 0)
-        cap = int(hr.max())
-        if enc.mv_floor is not None and enc.mv_floor[pi].any():
-            cap = min(cap, int(min_values_cap(enc, pi, cand_new, hr)))
-        if cap < 1:
-            continue
-        budget = _pool_budget(enc, st.pool_used, pi, R)
-        can_place = min(n_rem, budget)
-        if can_place < 1:
-            continue
-        while can_place > 0 and st.num_nodes < st.N - st.E:
-            slot = st.E + st.num_nodes
-            m = min(cap, can_place)
-            st.num_nodes += 1
-            st.alive[slot] = True
-            st.pool[slot] = pi
-            st.used[slot] = daemon + m * R
-            st.types[slot] = cand_new & (hr >= m)
-            st.zones[slot] = agz_p
-            st.ct[slot] = agc_p
-            take[slot] = m
-            st.pool_used[pi] += m * R
-            can_place -= m
-            n_rem -= m
-    return take, n_rem
+    return _open_new_nodes(st, enc, g, n_rem, R, agz_g, enc.agc[g], take)

@@ -127,25 +127,34 @@ def solve_with_preferences(
                          for gi in chains)
     result: SolveResult = None  # type: ignore[assignment]
     rounds = 0
+
+    def partitions_of(gi: int) -> List[Tuple[Tuple, List[Pod]]]:
+        """Split one soft group into per-level partitions of hardened
+        clones (partition preserves the (ns, name) member order)."""
+        parts: Dict[int, List[Pod]] = {}
+        for p in raw_groups[gi][1]:
+            parts.setdefault(level[id(p)], []).append(p)
+        return [(_group_signature_of(h0 := harden(members[0], lv)),
+                 [h0] + [harden(p, lv) for p in members[1:]])
+                for lv, members in parts.items()]
+
+    #: gi -> current partitions; recomputed only when the group's levels
+    #: moved (the bump loop below): steady-state rounds walk only the
+    #: pods of groups that actually changed, not all 50k
+    soft_parts: Dict[int, List[Tuple[Tuple, List[Pod]]]] = {
+        gi: partitions_of(gi) for gi in chains}
     for _ in range(max_rounds):
-        # group-level assembly: hard groups pass through untouched; each
-        # soft group splits into per-level partitions of hardened clones
-        # (partition preserves the (ns, name) member order). Only soft
-        # pods are walked per round.
+        # group-level assembly: hard groups pass through untouched; soft
+        # groups contribute their current hardened partitions
         assembled: List[Tuple[Tuple, List[Pod]]] = []
         for gi, (sig, plist) in enumerate(raw_groups):
-            if gi not in chains:
+            if gi in chains:
+                assembled.extend(soft_parts[gi])
+            else:
                 assembled.append((sig, plist))
-                continue
-            parts: Dict[int, List[Pod]] = {}
-            for p in plist:
-                parts.setdefault(level[id(p)], []).append(p)
-            for lv, members in parts.items():
-                hardened = [harden(p, lv) for p in members]
-                assembled.append((_group_signature_of(hardened[0]),
-                                  hardened))
         groups = canonical_group_order(assembled)
-        pods = [p for _, pl in groups for p in pl]
+        from itertools import chain as _chain
+        pods = list(_chain.from_iterable(pl for _, pl in groups))
         result = solve_core(SchedulingSnapshot(
             pods=pods, nodepools=snapshot.nodepools,
             existing_nodes=snapshot.existing_nodes,
@@ -155,11 +164,15 @@ def solve_with_preferences(
         if result.unschedulable:
             for gi in chains:
                 cap = chains[gi]
+                moved = False
                 for p in raw_groups[gi][1]:
                     if level[id(p)] < cap and \
                             p.full_name() in result.unschedulable:
                         level[id(p)] += 1
-                        bumped = True
+                        moved = True
+                if moved:
+                    soft_parts[gi] = partitions_of(gi)
+                    bumped = True
         if not bumped:
             break
         rounds += 1

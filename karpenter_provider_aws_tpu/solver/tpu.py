@@ -92,6 +92,22 @@ class TPUSolver(Solver):
         assert backend in ("auto", "jax", "numpy")
         self.backend = backend
         self.n_max = n_max
+        #: device group-scan cap: beyond this padded group count the
+        #: solve stays on the host engine (a scan step per group makes
+        #: compile and run time O(G); calibrating the router against a
+        #: 16k-step kernel would stall the first high-cardinality solve
+        #: for minutes). See docs/solver-design.md "The G axis".
+        self.dev_max_groups = 4096
+        # resolve the native fill at CONSTRUCTION, not mid-solve: the
+        # binding's one-shot build attempt (repo convention, codec.py)
+        # must never appear as a first-solve latency cliff, and running
+        # without it deserves one visible line, not silence
+        from ..native import fastfill as _fastfill
+        if not _fastfill.available():
+            import logging
+            logging.getLogger(__name__).info(
+                "native fastfill unavailable (no compiler or build "
+                "failed); high-cardinality solves use the numpy path")
         self._router = Router(name="solver")
         #: current new-node slot bucket; grows on overflow, sticky across
         #: solves (steady-state clusters reuse the same compiled kernel)
@@ -145,7 +161,8 @@ class TPUSolver(Solver):
                 return self._run_numpy(enc, ex_alloc, ex_used, ex_compat,
                                        tenc=tenc, existing=existing)
 
-            lowerable = self._topo_lowerable(enc, tenc, existing)
+            lowerable = self._topo_lowerable(enc, tenc, existing) \
+                and len(enc.groups) <= self.dev_max_groups
             if self.backend == "numpy" or not lowerable:
                 takes, leftover, final = host_pour()
             elif self.backend == "jax":
@@ -166,7 +183,25 @@ class TPUSolver(Solver):
                     lambda: self._run_jax_topo(enc, tenc))
             return self._decode(enc, existing, takes, leftover, final)
         ex_alloc, ex_used, ex_compat = self._encode_existing(enc, existing)
-        if self.backend == "jax":
+        if len(enc.groups) > self.dev_max_groups:
+            # beyond the device group-scan cap: host engine only (the
+            # G-axis law, docs/solver-design.md) — never let router
+            # calibration compile a many-thousand-step scan. A latency
+            # or engine cliff must never be silent, even when requested
+            # via backend="jax"
+            if self.backend != "numpy":
+                import logging
+                logging.getLogger(__name__).info(
+                    "group count %d exceeds dev_max_groups=%d; serving "
+                    "from the host engine", len(enc.groups),
+                    self.dev_max_groups)
+                if self.metrics is not None:
+                    self.metrics.inc(
+                        "karpenter_solver_device_fallback_total",
+                        labels={"reason": "group_cap"})
+            takes, leftover, final = self._run_numpy(
+                enc, ex_alloc, ex_used, ex_compat)
+        elif self.backend == "jax":
             # explicit device requests still go through the NONBLOCKING
             # liveness verdict (route.dev_engine_usable): a wedged link
             # or an in-flight probe falls back to the bit-identical host
@@ -235,6 +270,23 @@ class TPUSolver(Solver):
     def _run_numpy(self, enc, ex_alloc, ex_used, ex_compat,
                    tenc=None, existing=()):
         st = ffd.NodeState.create(enc, self.n_max, ex_alloc, ex_used, ex_compat)
+        if tenc is None and enc.mv_floor is None \
+                and all(pe.limit_vec is None for pe in enc.pools):
+            # the whole solve fits the fast-path guards: run every
+            # group's fill in ONE native call (the G-axis scaling law —
+            # a 10k-signature snapshot costs ~10k interpreted group
+            # fills otherwise; see native/fastfill.cpp). Decision
+            # identity is fuzz-enforced against both python engines.
+            from ..native import fastfill
+            if fastfill.available():
+                out = fastfill.fill_all(st, enc)
+                if out is not None:
+                    takes_m, leftover_v = out
+                    final = dict(types=st.types, zones=st.zones,
+                                 ct=st.ct, pool=st.pool, alive=st.alive,
+                                 used=st.used, E=st.E, run_log={},
+                                 zfix=None)
+                    return takes_m, leftover_v, final
         ts = None
         if tenc is not None:
             from ..ops.topo import TopoState, fill_group_topo, \
@@ -606,14 +658,19 @@ class TPUSolver(Solver):
         slot_groups: Dict[int, List[int]] = {}
 
         run_log = final.get("run_log") or {}
+        # one global nonzero instead of one per group: np.nonzero walks
+        # row-major, so each group's slots arrive contiguous and ordered
+        gnz, snz = np.nonzero(takes)
+        bounds = np.searchsorted(gnz, np.arange(len(enc.groups) + 1))
         for g in enc.groups:
             off = 0
             # topology pours stripe pods across slots; replay their
             # placement order. Plain fills are slot-order chunks.
             placement = run_log.get(g.index)
             if placement is None:
+                lo, hi = bounds[g.index], bounds[g.index + 1]
                 placement = [(int(s), int(takes[g.index, s]))
-                             for s in np.nonzero(takes[g.index])[0]]
+                             for s in snz[lo:hi]]
             def place(slot, chunk):
                 if slot < E:
                     for p in chunk:

@@ -1,0 +1,236 @@
+// Whole-solve closed-form FFD fill for the high-cardinality (G-axis)
+// regime — the native twin of ops/ffd.py::_fill_group_fast, run for ALL
+// groups in one call so a 10k-signature solve costs one library call
+// instead of 10k interpreted group fills (BASELINE config 7; the
+// reference's pod-dense envelope is test/suites/scale/
+// provisioning_test.go:179-214).
+//
+// Scope mirrors the Python fast path's guards exactly (enforced by the
+// caller, ops-level: no topology, no minValues floors, no pool limits,
+// no overrides). Decision identity with the numpy engine — and through
+// it the CPU oracle — is enforced by tests/test_solver_equivalence.py's
+// fuzz against this engine.
+//
+// All arrays are contiguous row-major numpy buffers; bools are 1 byte.
+// Division semantics: quotients are clipped at 0 on both sides, so C
+// truncation vs numpy floor never diverges (negative quotients clip to
+// 0 either way).
+
+#include <cstdint>
+
+namespace {
+
+constexpr int64_t BIG = int64_t(1) << 60;
+
+// min over R>0 dims of (a[d]-u[d])/R[d], clipped to [0, BIG]
+static inline int64_t headroom(const int64_t* a, const int64_t* u,
+                               const int64_t* R, int64_t D) {
+    int64_t k = BIG;
+    bool any = false;
+    for (int64_t d = 0; d < D; ++d) {
+        if (R[d] <= 0) continue;
+        any = true;
+        int64_t diff = a[d] - u[d];
+        if (diff < 0) return 0;
+        int64_t q = diff / R[d];
+        if (q < k) k = q;
+        if (k == 0) return 0;
+    }
+    (void)any;
+    return k;
+}
+
+}  // namespace
+
+extern "C" int64_t karp_fast_fill(
+    int64_t G, int64_t N, int64_t T, int64_t D, int64_t Z, int64_t C,
+    int64_t E, int64_t P, int64_t num_nodes_in,
+    const int64_t* A,          // [T, D]
+    const uint8_t* avail,      // [T, Z, C]
+    const int64_t* Rg,         // [G, D]
+    const int64_t* ng,         // [G]
+    const uint8_t* F,          // [G, T]
+    const uint8_t* agz,        // [G, Z]
+    const uint8_t* agc,        // [G, C]
+    const uint8_t* admit,      // [G, P]
+    const int64_t* daemon,     // [G, P, D]
+    const uint8_t* pool_types, // [P, T]
+    const uint8_t* pool_agz,   // [P, Z]
+    const uint8_t* pool_agc,   // [P, C]
+    const int64_t* ex_alloc,   // [E, D]
+    const uint8_t* ex_compat,  // [G, E]
+    int64_t* used,             // [N, D]   (mutated)
+    uint8_t* types,            // [N, T]   (mutated)
+    uint8_t* zones,            // [N, Z]   (mutated)
+    uint8_t* ct,               // [N, C]   (mutated)
+    int32_t* pool,             // [N]      (mutated)
+    uint8_t* alive,            // [N]      (mutated)
+    int64_t* cap_hint,         // [N, D]   (mutated)
+    int64_t* pool_used,        // [P, D]   (mutated)
+    int64_t* takes,            // [G, N]   (out, zeroed by caller)
+    int64_t* leftover          // [G]      (out)
+) {
+    int64_t num_nodes = num_nodes_in;
+    // scratch: candidate row + per-type headroom for one slot
+    // (allocated once; T is bounded by the catalog)
+    int64_t* hr_buf = new int64_t[T];
+    uint8_t* crow = new uint8_t[T];
+
+    for (int64_t g = 0; g < G; ++g) {
+        int64_t n_rem = ng[g];
+        const int64_t* R = Rg + g * D;
+        const uint8_t* Fg = F + g * T;
+        const uint8_t* agz_g = agz + g * Z;
+        const uint8_t* agc_g = agc + g * C;
+        leftover[g] = n_rem;
+        if (n_rem <= 0) continue;
+
+        // ---- walk existing + open slots in order -------------------
+        int64_t n_act = E + num_nodes;
+        for (int64_t slot = 0; slot < n_act && n_rem > 0; ++slot) {
+            if (!alive[slot]) continue;
+            int32_t pi = pool[slot];
+            if (slot < E) {
+                if (!ex_compat[g * E + slot]) continue;
+            } else {
+                if (pi < 0 || !admit[g * P + pi]) continue;
+            }
+            // conservative capacity prune (cap_hint is stale-high-safe)
+            bool full = false;
+            const int64_t* uh = used + slot * D;
+            const int64_t* chh = cap_hint + slot * D;
+            for (int64_t d = 0; d < D; ++d)
+                if (R[d] > 0 && chh[d] - uh[d] < R[d]) { full = true; break; }
+            if (full) continue;
+
+            int64_t k = 0;
+            if (slot < E) {
+                k = headroom(ex_alloc + slot * D, uh, R, D);
+            } else {
+                const uint8_t* ts = types + slot * T;
+                const uint8_t* zs = zones + slot * Z;
+                const uint8_t* cs = ct + slot * C;
+                for (int64_t t = 0; t < T; ++t) {
+                    crow[t] = 0;
+                    if (!ts[t] || !Fg[t]) continue;
+                    bool off = false;
+                    const uint8_t* av = avail + t * Z * C;
+                    for (int64_t z = 0; z < Z && !off; ++z) {
+                        if (!(zs[z] && agz_g[z])) continue;
+                        for (int64_t c = 0; c < C; ++c)
+                            if (cs[c] && agc_g[c] && av[z * C + c]) {
+                                off = true; break;
+                            }
+                    }
+                    if (!off) continue;
+                    crow[t] = 1;
+                    int64_t h = headroom(A + t * D, uh, R, D);
+                    hr_buf[t] = h;
+                    if (h > k) k = h;
+                }
+            }
+            if (k <= 0) continue;
+            int64_t m = (k < n_rem) ? k : n_rem;
+            takes[g * N + slot] = m;
+            n_rem -= m;
+            int64_t* uw = used + slot * D;
+            for (int64_t d = 0; d < D; ++d) uw[d] += m * R[d];
+            if (slot >= E) {
+                // narrow: cand & fit(new aggregate); masks; tighten hint
+                uint8_t* ts = types + slot * T;
+                int64_t* chw = cap_hint + slot * D;
+                for (int64_t d = 0; d < D; ++d) chw[d] = 0;
+                for (int64_t t = 0; t < T; ++t) {
+                    bool keep = crow[t];
+                    if (keep) {
+                        const int64_t* at = A + t * D;
+                        for (int64_t d = 0; d < D; ++d)
+                            if (uw[d] > at[d]) { keep = false; break; }
+                    }
+                    ts[t] = keep ? 1 : 0;
+                    if (keep) {
+                        const int64_t* at = A + t * D;
+                        for (int64_t d = 0; d < D; ++d)
+                            if (at[d] > chw[d]) chw[d] = at[d];
+                    }
+                }
+                uint8_t* zs = zones + slot * Z;
+                for (int64_t z = 0; z < Z; ++z) zs[z] &= agz_g[z];
+                uint8_t* cs = ct + slot * C;
+                for (int64_t c = 0; c < C; ++c) cs[c] &= agc_g[c];
+                int64_t* puw = pool_used + pi * D;
+                for (int64_t d = 0; d < D; ++d) puw[d] += m * R[d];
+            }
+        }
+
+        // ---- new nodes pool-by-pool (pools are weight-ordered) -----
+        for (int64_t pi = 0; pi < P && n_rem > 0; ++pi) {
+            if (!admit[g * P + pi]) continue;
+            const uint8_t* pz = pool_agz + pi * Z;
+            const uint8_t* pc = pool_agc + pi * C;
+            bool anyz = false, anyc = false;
+            for (int64_t z = 0; z < Z; ++z)
+                if (agz_g[z] && pz[z]) { anyz = true; break; }
+            for (int64_t c = 0; c < C; ++c)
+                if (agc_g[c] && pc[c]) { anyc = true; break; }
+            if (!anyz || !anyc) continue;
+            const int64_t* dmn = daemon + (g * P + pi) * D;
+            const uint8_t* ptypes = pool_types + pi * T;
+            int64_t cap = 0;
+            for (int64_t t = 0; t < T; ++t) {
+                crow[t] = 0;
+                if (!Fg[t] || !ptypes[t]) continue;
+                bool off = false;
+                const uint8_t* av = avail + t * Z * C;
+                for (int64_t z = 0; z < Z && !off; ++z) {
+                    if (!(agz_g[z] && pz[z])) continue;
+                    for (int64_t c = 0; c < C; ++c)
+                        if (agc_g[c] && pc[c] && av[z * C + c]) {
+                            off = true; break;
+                        }
+                }
+                if (!off) continue;
+                crow[t] = 1;
+                int64_t h = headroom(A + t * D, dmn, R, D);
+                hr_buf[t] = h;
+                if (h > cap) cap = h;
+            }
+            if (cap < 1) continue;
+            while (n_rem > 0 && num_nodes < N - E) {
+                int64_t slot = E + num_nodes;
+                int64_t m = (cap < n_rem) ? cap : n_rem;
+                ++num_nodes;
+                alive[slot] = 1;
+                pool[slot] = (int32_t)pi;
+                int64_t* uw = used + slot * D;
+                int64_t* chw = cap_hint + slot * D;
+                for (int64_t d = 0; d < D; ++d) {
+                    uw[d] = dmn[d] + m * R[d];
+                    chw[d] = 0;
+                }
+                uint8_t* ts = types + slot * T;
+                for (int64_t t = 0; t < T; ++t) {
+                    bool keep = crow[t] && hr_buf[t] >= m;
+                    ts[t] = keep ? 1 : 0;
+                    if (keep) {
+                        const int64_t* at = A + t * D;
+                        for (int64_t d = 0; d < D; ++d)
+                            if (at[d] > chw[d]) chw[d] = at[d];
+                    }
+                }
+                uint8_t* zs = zones + slot * Z;
+                for (int64_t z = 0; z < Z; ++z) zs[z] = agz_g[z] && pz[z];
+                uint8_t* cs = ct + slot * C;
+                for (int64_t c = 0; c < C; ++c) cs[c] = agc_g[c] && pc[c];
+                int64_t* puw = pool_used + pi * D;
+                for (int64_t d = 0; d < D; ++d) puw[d] += m * R[d];
+                takes[g * N + slot] = m;
+                n_rem -= m;
+            }
+        }
+        leftover[g] = n_rem;
+    }
+    delete[] hr_buf;
+    delete[] crow;
+    return num_nodes;
+}

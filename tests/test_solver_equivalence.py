@@ -106,6 +106,54 @@ class TestBaselineConfigs:
         assert pools == {"spot", "od"}
 
 
+class TestHighCardinality:
+    """The G axis (BASELINE config 7): many distinct pod signatures.
+    Exercises the native whole-solve fill (native/fastfill.cpp) against
+    the oracle, plus the guard boundaries that must keep the exact
+    numpy pass (pool limits) and existing-node handling."""
+
+    def test_many_signatures_native_path(self, env, solvers):
+        pods = []
+        for i in range(120):
+            sel = {"karpenter.k8s.aws/instance-family":
+                   ["m5", "c5", "r5"][i % 3]} if i % 5 == 4 else None
+            pods += make_pods(3, cpu=f"{100 + i}m",
+                              memory=f"{256 + i}Mi",
+                              prefix=f"hc{i:03d}", node_selector=sel)
+        res = assert_equivalent(
+            env.snapshot(pods, [env.nodepool("hc")]), solvers)
+        assert not res.unschedulable
+
+    def test_many_signatures_with_limits_slow_path(self, env, solvers):
+        # pool limits disable the native fast path; decisions must not
+        # depend on which pass served
+        pods = []
+        for i in range(60):
+            pods += make_pods(3, cpu=f"{100 + i}m", memory="256Mi",
+                              prefix=f"hl{i:03d}")
+        pools = [env.nodepool("hl-lim", weight=10, limits={"cpu": "20"}),
+                 env.nodepool("hl-free")]
+        assert_equivalent(env.snapshot(pods, pools), solvers)
+
+    def test_many_signatures_onto_existing(self, env, solvers):
+        from karpenter_provider_aws_tpu.apis import labels as L
+        from karpenter_provider_aws_tpu.apis.resources import Resources
+        from karpenter_provider_aws_tpu.solver.types import ExistingNode
+        pods = []
+        for i in range(40):
+            pods += make_pods(2, cpu=f"{100 + i}m", memory="200Mi",
+                              prefix=f"he{i:03d}")
+        snap = env.snapshot(pods, [env.nodepool("he")])
+        snap.existing_nodes = [ExistingNode(
+            name=f"he-node-{j}",
+            labels={L.ZONE: "us-west-2a", L.ARCH: "amd64",
+                    L.CAPACITY_TYPE: "on-demand"},
+            allocatable=Resources.parse(
+                {"cpu": "4", "memory": "8Gi", "pods": "110"}),
+            used=Resources()) for j in range(3)]
+        assert_equivalent(snap, solvers)
+
+
 class TestExistingNodes:
     def test_pack_onto_existing_then_overflow(self, env, solvers):
         nodes = [ExistingNode(
