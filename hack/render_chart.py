@@ -28,6 +28,16 @@ def load_values(path):
 def set_path(values, dotted, raw):
     keys = dotted.split(".")
     cur = values
+    # fail loudly on keys the chart does not declare: a typo'd --set (or
+    # a stale values file after an upgrade) must not silently no-op —
+    # docs/upgrade.md sells this as the quickest compat check
+    probe = values
+    for k in keys:
+        if not isinstance(probe, dict) or k not in probe:
+            raise SystemExit(
+                f"--set {dotted}: unknown value path {k!r} "
+                f"(not declared in values.yaml)")
+        probe = probe[k]
     for k in keys[:-1]:
         cur = cur.setdefault(k, {})
     val = raw

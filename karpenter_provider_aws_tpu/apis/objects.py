@@ -17,13 +17,11 @@ from __future__ import annotations
 import hashlib
 import itertools
 import json
-import time
-from dataclasses import dataclass, field, replace
-from typing import Any, Dict, FrozenSet, List, Mapping, Optional, Sequence, Tuple
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
 
 from . import labels as L
-from .requirements import (DOES_NOT_EXIST, EXISTS, GT, IN, LT, NOT_IN,
-                           Requirement, Requirements)
+from .requirements import IN, Requirement, Requirements
 from .resources import ATTACHABLE_VOLUMES, Resources
 
 _uid_counter = itertools.count(1)

@@ -30,10 +30,10 @@ Covered rules (file:line cites into the reference CRDs):
 from __future__ import annotations
 
 import re
-from typing import List, Optional
+from typing import Optional
 
 from . import labels as L
-from .requirements import Requirement, Requirements
+from .requirements import Requirements
 
 MIN_VALUES_MIN, MIN_VALUES_MAX = 1, 50
 

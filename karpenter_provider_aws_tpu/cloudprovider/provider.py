@@ -15,8 +15,7 @@ from typing import Dict, List, Optional
 
 from ..apis import labels as L
 from ..apis.objects import EC2NodeClass, NodeClaim, NodePool
-from ..apis.requirements import IN, Requirement, Requirements
-from ..apis.resources import Resources
+from ..apis.requirements import Requirements
 from ..fake.kube import FakeKube, NotFound
 from ..providers.instance import InstanceProvider, LaunchedInstance
 from ..providers.instancetype import InstanceTypeProvider

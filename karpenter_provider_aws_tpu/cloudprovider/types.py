@@ -15,7 +15,7 @@ the scheduling path (decision determinism, see apis/resources.py).
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Callable, Dict, FrozenSet, Iterable, List, Mapping, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from ..apis import labels as L
 from ..apis.requirements import IN, Requirement, Requirements

@@ -38,17 +38,20 @@ from __future__ import annotations
 import logging
 import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Mapping, Optional, Sequence, Set, Tuple
+from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from ..apis import labels as L
 from ..apis.objects import (DISRUPTED_TAINT, Node, NodeClaim, NodePool, Pod,
                             Taint)
-from ..apis.resources import Resources
 from ..cloudprovider.provider import CloudProvider
 from ..cloudprovider.types import InstanceTypes, NodeClaimNotFoundError
 from ..fake.kube import FakeKube, NotFound
-from ..solver.types import (ExistingNode, NewNodeClaim, NodePoolSpec,
-                            SchedulingSnapshot, Solver, SolveResult)
+from ..solver.types import (
+    NewNodeClaim,
+    NodePoolSpec,
+    SchedulingSnapshot,
+    Solver,
+    SolveResult)
 from ..state.cluster import ClusterState
 
 log = logging.getLogger(__name__)

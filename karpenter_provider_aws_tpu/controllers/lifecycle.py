@@ -12,9 +12,8 @@ from __future__ import annotations
 
 import logging
 import time
-from typing import List, Optional
+from typing import Optional
 
-from ..apis import labels as L
 from ..apis.objects import NodeClaim
 from ..cloudprovider.provider import CloudProvider
 from ..cloudprovider.types import (CloudProviderError,

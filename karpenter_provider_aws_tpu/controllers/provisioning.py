@@ -13,12 +13,12 @@ import itertools
 import logging
 import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Sequence
 
 from ..apis import labels as L
 from ..apis.objects import NodeClaim, NodePool, Pod
 from ..apis.requirements import Requirements
-from ..apis.resources import Resources, sum_resources
+from ..apis.resources import Resources
 from ..cloudprovider.provider import CloudProvider
 from ..fake.kube import FakeKube
 from ..solver.types import (DaemonOverhead, NewNodeClaim, NodePoolSpec,

@@ -18,9 +18,8 @@ catalog/pricing refresh (SURVEY §2.5).
 from __future__ import annotations
 
 import logging
-import threading
 import time
-from typing import Dict, List, Optional, Set
+from typing import Dict, List, Set
 
 from ..apis import labels as L
 from ..apis.objects import EC2NodeClass

@@ -22,7 +22,7 @@ controllers; this module owns only the walk-the-world families.
 from __future__ import annotations
 
 import time
-from typing import Dict, Optional, Tuple
+from typing import Dict, Tuple
 
 from ..apis import labels as L
 from ..apis.resources import Resources

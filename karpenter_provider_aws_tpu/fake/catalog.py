@@ -16,9 +16,9 @@ from __future__ import annotations
 
 import hashlib
 from dataclasses import dataclass, field
-from typing import Dict, List, Mapping, Optional, Sequence, Tuple
+from typing import Dict, List, Mapping, Sequence, Tuple
 
-from ..cloudprovider.types import MICRO, usd
+from ..cloudprovider.types import usd
 
 GIB = 1024**3
 

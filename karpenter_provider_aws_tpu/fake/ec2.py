@@ -19,9 +19,13 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence, Set, Tuple
 
-from ..cloudprovider.types import InsufficientCapacityError
-from .catalog import (DEFAULT_ZONES, FAMILIES, InstanceTypeInfo, ZoneInfo,
-                      build_catalog, catalog_by_name, spot_price)
+from .catalog import (
+    DEFAULT_ZONES,
+    InstanceTypeInfo,
+    ZoneInfo,
+    build_catalog,
+    catalog_by_name,
+    spot_price)
 
 #: instance families offered in local zones — local zones carry a small,
 #: older-generation slice of the catalog (the public local-zone feature

@@ -6,11 +6,10 @@ from __future__ import annotations
 import itertools
 from typing import Dict, List, Mapping, Optional, Sequence, Tuple
 
-from ..apis import labels as L
 from ..apis.objects import (EC2NodeClass, NodeClassRef, NodePool,
                             NodePoolTemplate, Pod, Taint, Toleration,
                             TopologySpreadConstraint)
-from ..apis.requirements import IN, Requirement, Requirements
+from ..apis.requirements import Requirements
 from ..apis.resources import Resources
 from ..cache.ttl import UnavailableOfferings
 from ..providers.instancetype import InstanceTypeProvider, OfferingsSnapshot

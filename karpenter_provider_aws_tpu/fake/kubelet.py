@@ -9,10 +9,10 @@ launch -> register -> initialize -> pods bound).
 from __future__ import annotations
 
 import time
-from typing import Dict, Optional
+from typing import Dict
 
 from ..apis import labels as L
-from ..apis.objects import Node, Taint
+from ..apis.objects import Node
 from ..fake.ec2 import FakeEC2
 from ..fake.kube import FakeKube
 from ..state.cluster import ClusterState

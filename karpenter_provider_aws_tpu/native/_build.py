@@ -1,0 +1,42 @@
+"""Shared build-and-dlopen for the native libraries (codec, fastfill).
+
+One-shot silent build on first import when a compiler is available
+(atomic: compile to a pid-suffixed temp, rename into place — a
+concurrent importer either sees the old state and falls back, or the
+complete library, never a truncated file). Honors $CXX like
+native/Makefile."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+NATIVE_DIR = os.path.join(REPO_ROOT, "native")
+
+
+def build_and_load(so_name: str, cpp_name: str) -> "ctypes.CDLL | None":
+    so_path = os.path.join(NATIVE_DIR, so_name)
+    if not os.path.exists(so_path):
+        cpp = os.path.join(NATIVE_DIR, cpp_name)
+        if not os.path.exists(cpp):
+            return None
+        tmp = so_path + f".tmp.{os.getpid()}"
+        try:
+            subprocess.run(
+                [os.environ.get("CXX", "g++"), "-O3", "-fPIC",
+                 "-std=c++17", "-shared", "-o", tmp, cpp],
+                check=True, capture_output=True, timeout=60)
+            os.replace(tmp, so_path)
+        except Exception:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            return None
+    try:
+        return ctypes.CDLL(so_path)
+    except OSError:
+        return None

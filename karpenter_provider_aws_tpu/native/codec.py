@@ -14,8 +14,7 @@ from __future__ import annotations
 import ctypes
 import os
 import struct
-import subprocess
-from typing import Dict, List, Sequence, Tuple
+from typing import Dict, List, Tuple
 
 import numpy as np
 
@@ -33,29 +32,9 @@ _SO_PATH = os.path.join(_REPO_ROOT, "native", "libkarpcodec.so")
 
 
 def _load() -> "ctypes.CDLL | None":
-    if not os.path.exists(_SO_PATH):
-        src_dir = os.path.join(_REPO_ROOT, "native")
-        cpp = os.path.join(src_dir, "codec.cpp")
-        if os.path.exists(cpp):
-            # atomic: compile to a temp name, rename into place — a
-            # concurrent importer either sees the old state (falls back)
-            # or the complete library, never a truncated file
-            tmp = _SO_PATH + f".tmp.{os.getpid()}"
-            try:
-                subprocess.run(
-                    ["g++", "-O3", "-fPIC", "-std=c++17", "-shared",
-                     "-o", tmp, cpp],
-                    check=True, capture_output=True, timeout=60)
-                os.replace(tmp, _SO_PATH)
-            except Exception:
-                try:
-                    os.unlink(tmp)
-                except OSError:
-                    pass
-                return None
-    try:
-        lib = ctypes.CDLL(_SO_PATH)
-    except OSError:
+    from ._build import build_and_load
+    lib = build_and_load("libkarpcodec.so", "codec.cpp")
+    if lib is None:
         return None
     lib.karp_arena_size.restype = ctypes.c_uint64
     lib.karp_arena_pack.restype = ctypes.c_uint64

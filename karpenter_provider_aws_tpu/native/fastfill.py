@@ -12,15 +12,11 @@ built — the numpy fast path serves instead, slower but identical.
 from __future__ import annotations
 
 import ctypes
-import os
-import subprocess
 from typing import Optional, Tuple
 
 import numpy as np
 
-_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))))
-_SO_PATH = os.path.join(_REPO_ROOT, "native", "libkarpfastfill.so")
+from ._build import build_and_load
 
 _I64P = ctypes.POINTER(ctypes.c_int64)
 _U8P = ctypes.POINTER(ctypes.c_uint8)
@@ -28,26 +24,8 @@ _I32P = ctypes.POINTER(ctypes.c_int32)
 
 
 def _load() -> "ctypes.CDLL | None":
-    if not os.path.exists(_SO_PATH):
-        cpp = os.path.join(_REPO_ROOT, "native", "fastfill.cpp")
-        if not os.path.exists(cpp):
-            return None
-        tmp = _SO_PATH + f".tmp.{os.getpid()}"
-        try:
-            subprocess.run(
-                ["g++", "-O3", "-fPIC", "-std=c++17", "-shared",
-                 "-o", tmp, cpp],
-                check=True, capture_output=True, timeout=60)
-            os.replace(tmp, _SO_PATH)
-        except Exception:
-            try:
-                os.unlink(tmp)
-            except OSError:
-                pass
-            return None
-    try:
-        lib = ctypes.CDLL(_SO_PATH)
-    except OSError:
+    lib = build_and_load("libkarpfastfill.so", "fastfill.cpp")
+    if lib is None:
         return None
     lib.karp_fast_fill.restype = ctypes.c_int64
     lib.karp_fast_fill.argtypes = (

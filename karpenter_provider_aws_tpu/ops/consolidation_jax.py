@@ -27,8 +27,6 @@ decisions match the oracle exactly
 
 from __future__ import annotations
 
-from functools import partial
-from typing import Tuple
 
 import jax
 import jax.numpy as jnp

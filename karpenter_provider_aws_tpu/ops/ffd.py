@@ -35,11 +35,11 @@ counters are the closed forms of the oracle's per-pod loop.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ..models.encoding import PRICE_INF, SnapshotEncoding
+from ..models.encoding import SnapshotEncoding
 
 BIG = np.int64(1) << 60
 

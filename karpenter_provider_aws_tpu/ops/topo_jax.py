@@ -30,7 +30,7 @@ flag falls back to the host pour — never a wrong answer).
 from __future__ import annotations
 
 from functools import partial
-from typing import NamedTuple, Tuple
+from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp

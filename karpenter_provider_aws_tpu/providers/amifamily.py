@@ -12,12 +12,11 @@ TOML, and MIME-multipart merge of custom userdata (bootstrap/mime/).
 
 from __future__ import annotations
 
-import base64
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence
 
 from ..apis import labels as L
-from ..apis.objects import EC2NodeClass, KubeletConfiguration, SelectorTerm, Taint
+from ..apis.objects import EC2NodeClass, KubeletConfiguration, Taint
 
 FAMILIES = ("al2", "al2023", "bottlerocket", "windows2019", "windows2022",
             "custom")

@@ -14,15 +14,16 @@ from __future__ import annotations
 import logging
 import time
 from dataclasses import dataclass
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional
 
 from ..apis import labels as L
 from ..apis.objects import EC2NodeClass, NodeClaim
 from ..apis.requirements import IN, Requirement, Requirements
 from ..cache.ttl import UnavailableOfferings
-from ..cloudprovider.types import (InstanceType, InstanceTypes,
-                                   InsufficientCapacityError,
-                                   NodeClaimNotFoundError)
+from ..cloudprovider.types import (
+    InstanceTypes,
+    InsufficientCapacityError,
+    NodeClaimNotFoundError)
 from ..batcher.core import (CreateFleetBatcher, CreateFleetRequest,
                             DescribeInstancesBatcher,
                             TerminateInstancesBatcher, to_hashable)

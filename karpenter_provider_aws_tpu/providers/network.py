@@ -11,10 +11,10 @@ from __future__ import annotations
 
 import threading
 from dataclasses import dataclass
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List
 
 from ..apis.objects import EC2NodeClass, SelectorTerm
-from ..cache.ttl import AVAILABLE_IPS_TTL, DEFAULT_TTL, TTLCache
+from ..cache.ttl import DEFAULT_TTL, TTLCache
 
 
 @dataclass(frozen=True)

@@ -42,12 +42,17 @@ from typing import Dict, List, Mapping, Optional, Sequence, Set, Tuple
 import numpy as np
 
 from ..apis import labels as L
-from ..apis.objects import Pod, Taint, TopologySpreadConstraint
+from ..apis.objects import Pod
 from ..apis.requirements import IN, Requirement, Requirements
 from ..apis.resources import Resources
 from ..cloudprovider.types import InstanceType, InstanceTypes
-from .types import (DaemonOverhead, ExistingNode, NewNodeClaim, NodePoolSpec,
-                    SchedulingSnapshot, SolveResult, Solver)
+from .types import (
+    ExistingNode,
+    NewNodeClaim,
+    NodePoolSpec,
+    SchedulingSnapshot,
+    SolveResult,
+    Solver)
 
 
 def pod_sig_digest(pod: Pod) -> str:

@@ -16,7 +16,7 @@ is a hook (``_dispatch``) so the sidecar's RemoteSolver can ride gRPC.
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, Sequence, Tuple
 
 import numpy as np
 
@@ -161,8 +161,20 @@ class TPUSolver(Solver):
                 return self._run_numpy(enc, ex_alloc, ex_used, ex_compat,
                                        tenc=tenc, existing=existing)
 
-            lowerable = self._topo_lowerable(enc, tenc, existing) \
-                and len(enc.groups) <= self.dev_max_groups
+            group_cap = len(enc.groups) > self.dev_max_groups
+            if group_cap and self.backend != "numpy":
+                # same non-silent cliff contract as the non-topo branch
+                import logging
+                logging.getLogger(__name__).info(
+                    "group count %d exceeds dev_max_groups=%d; topology "
+                    "solve serves from the host pour", len(enc.groups),
+                    self.dev_max_groups)
+                if self.metrics is not None:
+                    self.metrics.inc(
+                        "karpenter_solver_device_fallback_total",
+                        labels={"reason": "group_cap"})
+            lowerable = not group_cap \
+                and self._topo_lowerable(enc, tenc, existing)
             if self.backend == "numpy" or not lowerable:
                 takes, leftover, final = host_pour()
             elif self.backend == "jax":

@@ -19,12 +19,12 @@ from __future__ import annotations
 
 import abc
 from dataclasses import dataclass, field
-from typing import Dict, List, Mapping, Optional, Sequence, Tuple
+from typing import Dict, List, Mapping, Sequence, Tuple
 
 from ..apis.objects import NodePool, Pod, Taint
 from ..apis.requirements import Requirements
 from ..apis.resources import Resources
-from ..cloudprovider.types import InstanceType, InstanceTypes
+from ..cloudprovider.types import InstanceTypes
 
 
 @dataclass

@@ -13,7 +13,7 @@ import logging
 import queue
 import threading
 from collections import deque
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, Optional
 
 from ..fake.kube import Event, FakeKube
 

@@ -2,7 +2,6 @@
 name, tags, alias), newest-first resolution, deprecation semantics,
 custom AMI family, NodeClass AMI status/readiness, and userdata merge."""
 
-import pytest
 
 from karpenter_provider_aws_tpu.apis.objects import EC2NodeClass, SelectorTerm
 from karpenter_provider_aws_tpu.fake.ec2 import FakeImage, _new_id

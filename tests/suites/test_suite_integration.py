@@ -2,7 +2,6 @@
 device mappings, ENI-limited maxPods, kubelet maxPods, reservedENIs, and
 extended-resource (GPU / Neuron / pod-ENI) provisioning."""
 
-import pytest
 
 from karpenter_provider_aws_tpu.apis import labels as L
 from karpenter_provider_aws_tpu.apis.objects import (BlockDeviceMapping,

@@ -4,7 +4,6 @@ templates, and instances coming up with IPv6 addresses."""
 
 import pytest
 
-from karpenter_provider_aws_tpu.apis import labels as L
 from karpenter_provider_aws_tpu.apis.objects import (EC2NodeClass,
                                                      KubeletConfiguration,
                                                      SelectorTerm)

@@ -10,7 +10,6 @@ from karpenter_provider_aws_tpu.apis.objects import (BlockDeviceMapping,
                                                      EC2NodeClass)
 from karpenter_provider_aws_tpu.fake.ec2 import LOCAL_ZONE_FAMILIES, FakeEC2
 from karpenter_provider_aws_tpu.fake.environment import make_pods
-from karpenter_provider_aws_tpu.operator import Operator
 
 from .conftest import mk_cluster
 

@@ -11,7 +11,6 @@ from karpenter_provider_aws_tpu.apis.objects import (EC2NodeClass, NodeClaim,
                                                      Taint)
 from karpenter_provider_aws_tpu.apis.requirements import Requirements
 from karpenter_provider_aws_tpu.apis.resources import Resources
-from karpenter_provider_aws_tpu.fake.ec2 import FakeEC2
 from karpenter_provider_aws_tpu.fake.environment import make_pods
 from karpenter_provider_aws_tpu.operator import Operator
 

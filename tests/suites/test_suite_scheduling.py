@@ -3,7 +3,6 @@ label selection across the AWS label set, deprecated beta labels,
 annotations/labels propagation, Gt/Lt operators, naked pods and
 deployment-owned pods."""
 
-import pytest
 
 from karpenter_provider_aws_tpu.apis import labels as L
 from karpenter_provider_aws_tpu.apis.objects import (NodePool,

@@ -3,7 +3,6 @@ persistent volumes — pre-bound zonal PVs, storage-class allowed
 topologies, dynamic (WaitForFirstConsumer) provisioning, and per-node
 EBS volume limits."""
 
-import pytest
 
 from karpenter_provider_aws_tpu.apis import labels as L
 from karpenter_provider_aws_tpu.apis.objects import (PersistentVolume,

@@ -3,7 +3,6 @@
 Bottlerocket TOML, Windows PS1, custom passthrough, MIME multipart merge,
 and the launch-template integration."""
 
-import pytest
 
 from karpenter_provider_aws_tpu.apis.objects import (EC2NodeClass,
                                                      KubeletConfiguration,
@@ -151,7 +150,6 @@ class TestLaunchTemplateIntegration:
     def test_lt_name_changes_with_userdata(self):
         """Userdata participates in the LT hash -> new template on change
         (drift correctness; launchtemplate.go:146)."""
-        from karpenter_provider_aws_tpu.fake.ec2 import FakeEC2
         from karpenter_provider_aws_tpu.providers.amifamily import AMIProvider
         from karpenter_provider_aws_tpu.providers.launchtemplate import \
             LaunchTemplateProvider

@@ -1,0 +1,88 @@
+"""Golden-file chart parity (VERDICT r3 #8): the rendered manifests for
+three value-sets are committed under tests/golden/chart/ and pinned
+byte-for-byte.
+
+Two layers:
+- the helm-free renderer (hack/render_chart.py) must reproduce the
+  goldens exactly — any template or renderer change that moves a byte
+  is a test failure, not a silent drift;
+- when a real ``helm`` binary is available (CI images that carry one;
+  not this environment), ``helm template`` output for the same values
+  is normalized and diffed against the same goldens — closing the loop
+  on the "our subset renders identically under helm" claim. Skipped,
+  visibly, when helm is absent.
+"""
+
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+GOLDEN = os.path.join(os.path.dirname(__file__), "golden", "chart")
+
+VALUE_SETS = {
+    "default.yaml": ["settings.clusterName=golden-cluster",
+                     "settings.clusterEndpoint=https://golden.example"],
+    "sidecar.yaml": ["settings.clusterName=golden-cluster",
+                     "sidecar.enabled=true",
+                     "sidecar.token=golden-token"],
+    "overrides.yaml": ["settings.clusterName=golden-cluster",
+                       "replicas=3",
+                       "controller.solver=cpu",
+                       "settings.interruptionQueue=golden-q"],
+}
+
+
+def render(sets):
+    cmd = [sys.executable, "hack/render_chart.py"]
+    for s in sets:
+        cmd += ["--set", s]
+    out = subprocess.run(cmd, capture_output=True, text=True, cwd=REPO)
+    assert out.returncode == 0, out.stderr
+    return out.stdout
+
+
+@pytest.mark.parametrize("name", sorted(VALUE_SETS))
+def test_renderer_matches_golden(name):
+    got = render(VALUE_SETS[name])
+    want = open(os.path.join(GOLDEN, name)).read()
+    assert got == want, (
+        f"{name}: rendered chart diverged from the committed golden — "
+        f"if the template change is intentional, re-record with "
+        f"`python hack/render_chart.py --set "
+        f"{' --set '.join(VALUE_SETS[name])} > tests/golden/chart/{name}`")
+
+
+def _normalize_helm(text):
+    """helm template adds '# Source:' comments and a leading '---';
+    strip comment/blank lines on both sides for the comparison."""
+    keep = [ln for ln in text.splitlines()
+            if ln.strip() and not ln.lstrip().startswith("#")]
+    return "\n".join(keep) + "\n"
+
+
+@pytest.mark.parametrize("name", sorted(VALUE_SETS))
+def test_helm_template_matches_golden(name):
+    helm = shutil.which("helm")
+    if helm is None:
+        pytest.skip("no helm binary in this environment; the renderer "
+                    "golden above is the enforced contract here")
+    cmd = [helm, "template", "karpenter", os.path.join(REPO, "deploy/chart")]
+    for s in VALUE_SETS[name]:
+        cmd += ["--set", s]
+    out = subprocess.run(cmd, capture_output=True, text=True)
+    assert out.returncode == 0, out.stderr
+    want = open(os.path.join(GOLDEN, name)).read()
+    assert _normalize_helm(out.stdout) == _normalize_helm(want)
+
+
+def test_goldens_are_valid_yaml():
+    import yaml
+    for name in VALUE_SETS:
+        docs = list(yaml.safe_load_all(
+            open(os.path.join(GOLDEN, name)).read()))
+        kinds = [d["kind"] for d in docs if d]
+        assert "Deployment" in kinds and "ServiceAccount" in kinds, kinds

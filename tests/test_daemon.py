@@ -13,7 +13,6 @@ import pytest
 from karpenter_provider_aws_tpu.daemon import Daemon
 from karpenter_provider_aws_tpu.fake.environment import make_pods
 from karpenter_provider_aws_tpu.manager import ControllerManager, FileLease, _Entry
-from karpenter_provider_aws_tpu.operator import Operator
 from karpenter_provider_aws_tpu.utils.metrics import Metrics
 
 

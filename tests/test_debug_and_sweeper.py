@@ -3,7 +3,6 @@ sweeper (test/hack/resource analog)."""
 
 import sys
 
-import pytest
 
 from karpenter_provider_aws_tpu.apis.objects import (EC2NodeClass,
                                                      NodeClassRef, NodePool,

@@ -11,7 +11,7 @@ from karpenter_provider_aws_tpu.apis.objects import (EC2NodeClass,
                                                      NodePoolTemplate)
 from karpenter_provider_aws_tpu.apis.requirements import Requirements
 from karpenter_provider_aws_tpu.fake.environment import make_pods
-from karpenter_provider_aws_tpu.operator import Operator, Options
+from karpenter_provider_aws_tpu.operator import Operator
 from karpenter_provider_aws_tpu.providers.pricing import InterruptionMessage
 
 

@@ -3,9 +3,10 @@
 import pytest
 
 from karpenter_provider_aws_tpu.apis import labels as L
-from karpenter_provider_aws_tpu.apis.requirements import (IN, NOT_IN,
-                                                          Requirement,
-                                                          Requirements)
+from karpenter_provider_aws_tpu.apis.requirements import (
+    IN,
+    Requirement,
+    Requirements)
 from karpenter_provider_aws_tpu.apis.resources import Resources
 from karpenter_provider_aws_tpu.cloudprovider import (InstanceType,
                                                       InstanceTypes,

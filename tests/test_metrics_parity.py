@@ -233,6 +233,15 @@ def emitted():
         pods=make_pods(1, prefix="fb2"),
         nodepools=op.provisioner.build_snapshot([]).nodepools,
         existing_nodes=[]))
+    # cost-router route labels (dead dev engine -> dev-unreachable)
+    routed_s = TPUSolver(backend="auto")
+    routed_s.metrics = op.metrics
+    routed_s._router.alive = AliveCache(lambda: False)
+    routed_s._router.alive.blocking()
+    routed_s.solve(SchedulingSnapshot(
+        pods=make_pods(1, prefix="fb3"),
+        nodepools=op.provisioner.build_snapshot([]).nodepools,
+        existing_nodes=[]))
 
     # preference relaxation: soft zone anti-affinity that cannot hold
     # when hardened (more pods than zones)

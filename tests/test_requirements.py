@@ -1,7 +1,6 @@
 """Requirements-algebra semantics, mirroring the core library's behavior the
 reference relies on (SURVEY §2.4; types.go:183-287, cloudprovider.go:329)."""
 
-import pytest
 
 from karpenter_provider_aws_tpu.apis import labels as L
 from karpenter_provider_aws_tpu.apis.requirements import (

@@ -6,12 +6,11 @@ import pytest
 
 from karpenter_provider_aws_tpu.apis import labels as L
 from karpenter_provider_aws_tpu.apis.objects import EC2NodeClass
-from karpenter_provider_aws_tpu.apis.resources import Resources
 from karpenter_provider_aws_tpu.controllers.steady_state import (
     DiscoveredCapacityController, NodeClassHashController,
     SSMInvalidationController, VersionController)
 from karpenter_provider_aws_tpu.fake.ec2 import FakeEC2
-from karpenter_provider_aws_tpu.fake.environment import Environment, make_pods
+from karpenter_provider_aws_tpu.fake.environment import make_pods
 from karpenter_provider_aws_tpu.operator import Operator
 from karpenter_provider_aws_tpu.providers.pricing import VersionProvider
 from karpenter_provider_aws_tpu.providers.ssm import SSMProvider, is_mutable
