@@ -725,3 +725,46 @@ def solve_scan_topo(inp: KernelInputs, topo: TopoGroupRows, cz0, ch0,
     events = dict(slot=ev_slot, zone=ev_zone, len=ev_len, kind=ev_kind,
                   aux=ev_aux, n=ev_n)
     return takes, leftover, events, zfix, bail, final
+
+
+def dispatch_topo(arrays: dict, rows: dict, statics: dict,
+                  cache: "dict | None" = None) -> dict:
+    """The one topology-kernel dispatch shared by the local solver
+    (TPUSolver._dispatch_topo) and the sidecar server's SolveTopo RPC —
+    dict in, dict out, so the two paths can never drift (same
+    discipline as parallel/mesh.dispatch_mesh).
+
+    ``arrays``: KernelInputs fields (bool masks may arrive as uint8 off
+    the wire); ``rows``: TopoGroupRows fields; ``statics``: Z/P/GZ/GH/
+    n_max/EVCAP/PMAX. ``cache`` (one bucket-retry loop's scope) reuses
+    the device-placed inputs across n_max escalations so a retry pays
+    only the kernel, not a re-upload. Output values may be jax arrays —
+    callers np.asarray exactly what they consume (bail/leftover checks
+    on retry iterations must not force the full event-log transfer)."""
+    import numpy as np
+
+    def conv(v):
+        a = np.asarray(v)
+        if a.dtype == np.uint8:  # wire bools
+            a = a.view(bool)
+        return jnp.asarray(a)
+
+    if cache is not None and "inp" in cache:
+        inp, trows = cache["inp"], cache["rows"]
+    else:
+        inp = KernelInputs(**{k: conv(v) for k, v in arrays.items()})
+        trows = TopoGroupRows(**{k: conv(v) for k, v in rows.items()})
+        if cache is not None:
+            cache["inp"], cache["rows"] = inp, trows
+    cz0 = jnp.zeros((statics["GZ"], statics["Z"]), jnp.int64)
+    ch0 = jnp.zeros((statics["GH"], statics["n_max"]), jnp.int64)
+    takes, leftover, events, zfix, bail, carry = solve_scan_topo(
+        inp, trows, cz0, ch0, n_max=statics["n_max"], P=statics["P"],
+        EVCAP=statics["EVCAP"], PMAX=statics["PMAX"])
+    out = dict(takes=takes, leftover=leftover, zfix=zfix, bail=bail,
+               used=carry.used, types=carry.types, zones=carry.zones,
+               ct=carry.ct, pool=carry.pool, alive=carry.alive,
+               num_nodes=jnp.reshape(carry.num_nodes, (1,)))
+    for k, v in events.items():
+        out[f"ev_{k}"] = v
+    return out

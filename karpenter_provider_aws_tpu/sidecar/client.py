@@ -4,8 +4,9 @@
 drop-in :class:`solver.types.Solver` whose device dispatch rides the wire
 (everything else — requirements compilation, canonical ordering, decode —
 is identical to the local TPU solver, so decisions are identical by
-construction). Topology-constrained snapshots run the host pour locally,
-exactly as TPUSolver does.
+construction). Topology-constrained snapshots ride the SolveTopo RPC
+(the same ops/topo_jax event kernel the local solver runs); snapshots
+outside its envelope fall back to the in-process host pour.
 """
 
 from __future__ import annotations
@@ -18,7 +19,12 @@ from ..native import arena_pack, arena_unpack
 from ..solver.tpu import TPUSolver
 
 _SOLVE = "/karpenter.solver.v1.Solver/Solve"
+_SOLVE_TOPO = "/karpenter.solver.v1.Solver/SolveTopo"
 _INFO = "/karpenter.solver.v1.Solver/Info"
+
+#: SolveTopo output fields that are booleans on the kernel side (the
+#: arena wire carries them as uint8; decode expects real bool masks)
+_TOPO_BOOL_OUT = ("types", "zones", "ct", "alive", "bail")
 
 
 class SolverClient:
@@ -41,6 +47,7 @@ class SolverClient:
         else:
             self._channel = grpc.insecure_channel(address, options=opts)
         self._solve = self._channel.unary_unary(_SOLVE)
+        self._solve_topo = self._channel.unary_unary(_SOLVE_TOPO)
         self._info = self._channel.unary_unary(_INFO)
 
     def solve_buffer(self, buf: np.ndarray, statics: Dict[str, int]) -> np.ndarray:
@@ -52,6 +59,25 @@ class SolverClient:
         })
         resp = self._solve(req, timeout=self.timeout, metadata=self._md)
         return np.array(arena_unpack(resp)["out"])  # own the memory
+
+    def solve_topo(self, arrays: Dict[str, np.ndarray],
+                   rows: Dict[str, np.ndarray],
+                   statics: Dict[str, int]) -> Dict[str, np.ndarray]:
+        """Topology event-kernel solve over the wire; returns the
+        dispatch_topo output dict with bool masks restored."""
+        from .server import TOPO_STATIC_KEYS
+        req = {"statics": np.array([statics[k] for k in TOPO_STATIC_KEYS],
+                                   dtype=np.int64)}
+        for k, v in arrays.items():
+            req[f"i_{k}"] = np.ascontiguousarray(v)
+        for k, v in rows.items():
+            req[f"t_{k}"] = np.ascontiguousarray(v)
+        resp = self._solve_topo(arena_pack(req), timeout=self.timeout,
+                                metadata=self._md)
+        out = {k: np.array(v) for k, v in arena_unpack(resp).items()}
+        for k in _TOPO_BOOL_OUT:
+            out[k] = out[k].view(bool)
+        return out
 
     def info(self, timeout: Optional[float] = None) -> Dict[str, int]:
         out = arena_unpack(self._info(b"", timeout=timeout or self.timeout,
@@ -100,16 +126,37 @@ class RemoteSolver(TPUSolver):
         mesh-vs-single decision for its local devices (server.py solve)."""
         return 1
 
-    def _topo_lowerable(self, enc, tenc, existing) -> bool:
-        """Topology snapshots run the host pour locally: this solver's
-        dev engine is the gRPC peer (router.alive = sidecar ping), and
-        the in-process topology kernel would (a) be gated by the WRONG
-        liveness verdict — a wedged local accelerator plugin hangs the
-        first array creation while the sidecar ping says alive — and
-        (b) feed local CPU-jax latencies into the sidecar's router
-        bucket. Lowering topo solves over the wire needs a dedicated
-        sidecar RPC, not a silent local detour."""
-        return False
-
     def _dispatch(self, buf: np.ndarray, **statics) -> np.ndarray:
         return self.client.solve_buffer(buf, statics)
+
+    def _topo_lowerable(self, enc, tenc, existing) -> bool:
+        """The local envelope plus the SERVER's SolveTopo bounds
+        (sidecar/server.py _TOPO_STATICS_MAX): a snapshot the server
+        would reject INVALID_ARGUMENT must route to the host pour here,
+        not crash a backend='jax' solve or poison the dev EWMA."""
+        if not super()._topo_lowerable(enc, tenc, existing):
+            return False
+        GZp = max(1, 1 << (max(1, tenc.GZ) - 1).bit_length())
+        GHp = max(1, 1 << (max(1, tenc.GH) - 1).bit_length())
+        return GZp <= 1 << 12 and GHp <= 1 << 12 \
+            and self.n_max <= 1 << 14
+
+    def _dispatch_topo(self, arrays, rows, statics, cache=None):
+        """Topology solves ride the SolveTopo RPC: this solver's dev
+        engine is the gRPC peer end to end — gated by the sidecar ping
+        (router.alive), never by the local accelerator plugin, and the
+        router's dev EWMA for topo buckets measures the wire round trip
+        it will actually pay. A peer that rejects or dies mid-call maps
+        to TopoKernelBail — the bit-identical host pour serves, never a
+        crash (cache unused: each wire call re-ships the arena)."""
+        import grpc
+
+        from ..solver.tpu import TopoKernelBail
+        try:
+            return self.client.solve_topo(arrays, rows, statics)
+        except grpc.RpcError as e:
+            import logging
+            logging.getLogger(__name__).warning(
+                "SolveTopo RPC failed (%s); serving from the host pour",
+                e.code() if hasattr(e, "code") else e)
+            raise TopoKernelBail(f"sidecar SolveTopo failed: {e}") from e

@@ -34,7 +34,17 @@ from ..native import arena_pack, arena_unpack
 log = logging.getLogger(__name__)
 
 _SOLVE = "/karpenter.solver.v1.Solver/Solve"
+_SOLVE_TOPO = "/karpenter.solver.v1.Solver/SolveTopo"
 _INFO = "/karpenter.solver.v1.Solver/Info"
+
+#: SolveTopo statics vector order (client and server share this module
+#: constant via sidecar.client's import — one source of truth)
+TOPO_STATIC_KEYS = ("Z", "P", "GZ", "GH", "n_max", "EVCAP", "PMAX")
+_TOPO_STATICS_MAX = dict(Z=64, P=256, GZ=1 << 12, GH=1 << 12,
+                         n_max=1 << 14, EVCAP=1024, PMAX=64)
+#: derived-dimension bounds for SolveTopo arrays (same rationale as
+#: _STATICS_MAX: every distinct shape class compiles a kernel)
+_TOPO_DIM_MAX = dict(T=4096, D=64, C=8, G=1 << 13)
 
 
 #: bounds on request statics — every distinct tuple compiles a kernel that
@@ -121,6 +131,101 @@ class _Handler:
         return pack_outputs1(out, kv["T"], kv["D"], kv["Z"], kv["C"],
                              kv["G"], kv["E"], kv["P"], kv["n_max"])
 
+    def solve_topo(self, request: bytes, context) -> bytes:
+        """Topology event-kernel solve over the wire: 'i_*' arrays are
+        KernelInputs fields, 't_*' arrays are TopoGroupRows fields,
+        'statics' is the TOPO_STATIC_KEYS vector. The shared
+        ops/topo_jax.dispatch_topo implementation serves both this RPC
+        and the local solver, so the two paths cannot drift."""
+        import grpc
+
+        from ..ops.topo_jax import dispatch_topo
+        all_arrays = arena_unpack(request)
+        raw = all_arrays.get("statics")
+        if raw is None or len(raw) != len(TOPO_STATIC_KEYS):
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                          f"expected {len(TOPO_STATIC_KEYS)} topo statics")
+        kv = dict(zip(TOPO_STATIC_KEYS, (int(x) for x in raw)))
+        for k, v in kv.items():
+            if not (0 <= v <= _TOPO_STATICS_MAX[k]):
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                              f"statics.{k}={v} out of bounds")
+        arrays = {k[2:]: v for k, v in all_arrays.items()
+                  if k.startswith("i_")}
+        rows = {k[2:]: v for k, v in all_arrays.items()
+                if k.startswith("t_")}
+        self._validate_topo(arrays, rows, kv, context)
+        # dtypes are canonical after validation, so shapes + statics
+        # fully determine the compiled kernel; C rides via avail_zc
+        key = ("topo",) + tuple(kv.values()) + (
+            arrays["A"].shape, arrays["avail_zc"].shape,
+            arrays["R"].shape[0])
+        if key not in self._shapes_seen:
+            if len(self._shapes_seen) >= _MAX_SHAPE_CLASSES:
+                context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED,
+                              "too many distinct solve shape classes")
+            self._shapes_seen.add(key)
+        out = dispatch_topo(arrays, rows, kv)
+        return arena_pack({k: np.asarray(v) for k, v in out.items()})
+
+    def _validate_topo(self, arrays, rows, kv, context) -> None:
+        """Every array shape must agree with the dims the request
+        implies — a peer must not be able to shape-shift the kernel into
+        unbounded compiles or out-of-bounds gathers."""
+        import grpc
+
+        def fail(msg):
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, msg)
+
+        try:
+            T, D = arrays["A"].shape
+            G = arrays["R"].shape[0]
+            C = arrays["agc"].shape[1]
+        except (KeyError, ValueError, IndexError, AttributeError):
+            fail("missing/odd core arrays (A, R, agc)")
+        Z, P = kv["Z"], kv["P"]
+        GZ, GH = kv["GZ"], kv["GH"]
+        for name, bound in (("T", _TOPO_DIM_MAX["T"]),
+                            ("D", _TOPO_DIM_MAX["D"]),
+                            ("C", _TOPO_DIM_MAX["C"]),
+                            ("G", _TOPO_DIM_MAX["G"])):
+            if not (0 < {"T": T, "D": D, "C": C, "G": G}[name] <= bound):
+                fail(f"dim {name} out of bounds")
+        # (shape, dtype-class) per array: 'i' = int64, 'b' = bool/uint8,
+        # 'i32' = int32. Dtype enforcement is part of the compile-cache
+        # defense — a peer varying dtypes at fixed shapes would mint
+        # unbounded kernels past the shape-class budget otherwise.
+        expect_i = dict(
+            A=((T, D), "i"), avail_zc=((T, Z * C), "b"),
+            R=((G, D), "i"), n=((G,), "i"), F=((G, T), "b"),
+            agz=((G, Z), "b"), agc=((G, C), "b"), admit=((G, P), "b"),
+            daemon=((G, P, D), "i"),
+            pool_types=((P, T), "b"), pool_agz=((P, Z), "b"),
+            pool_agc=((P, C), "b"), pool_limit=((P, D), "i"),
+            pool_used0=((P, D), "i"),
+            ex_alloc=((0, D), "i"), ex_used0=((0, D), "i"),
+            ex_compat=((G, 0), "b"))
+        expect_t = dict(
+            has_topo=((G,), "b"), zone_needed=((G,), "b"),
+            min_mask=((G, Z), "b"),
+            zs_any=((G, GZ), "b"), zs_skew=((G, GZ), "i"),
+            hs_any=((G, GH), "b"), hs_skew=((G, GH), "i"),
+            za_any=((G, GZ), "b"), za_anti=((G, GZ), "b"),
+            za_own=((G, GZ), "b"), ha_any=((G, GH), "b"),
+            ha_anti=((G, GH), "b"), ha_own=((G, GH), "b"),
+            member_z=((G,), "i32"), member_h=((G,), "i32"))
+        ok_dtypes = {"i": (np.dtype(np.int64),),
+                     "b": (np.dtype(bool), np.dtype(np.uint8)),
+                     "i32": (np.dtype(np.int32),)}
+        for table, got in ((expect_i, arrays), (expect_t, rows)):
+            if set(table) != set(got):
+                fail(f"array set mismatch: {sorted(set(table) ^ set(got))}")
+            for name, (shape, kind) in table.items():
+                if tuple(got[name].shape) != shape:
+                    fail(f"{name} shape {got[name].shape} != {shape}")
+                if got[name].dtype not in ok_dtypes[kind]:
+                    fail(f"{name} dtype {got[name].dtype} not allowed")
+
     def info(self, request: bytes, context) -> bytes:
         import jax
         return arena_pack({
@@ -136,6 +241,9 @@ def _generic_handler(handler: _Handler):
         def service(self, call_details):
             if call_details.method == _SOLVE:
                 return grpc.unary_unary_rpc_method_handler(handler.solve)
+            if call_details.method == _SOLVE_TOPO:
+                return grpc.unary_unary_rpc_method_handler(
+                    handler.solve_topo)
             if call_details.method == _INFO:
                 return grpc.unary_unary_rpc_method_handler(handler.info)
             return None

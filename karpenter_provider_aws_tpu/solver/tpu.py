@@ -429,14 +429,20 @@ class TPUSolver(Solver):
                 rows["member_h"][g] = mh
         return rows, GZ, GH
 
+    def _dispatch_topo(self, arrays: dict, rows: dict, statics: dict,
+                       cache: dict = None) -> dict:
+        """Run the topology event kernel locally (the sidecar's
+        RemoteSolver overrides this with a SolveTopo gRPC round trip —
+        ops/topo_jax.dispatch_topo is the shared implementation both
+        ends run)."""
+        from ..ops.topo_jax import dispatch_topo
+        return dispatch_topo(arrays, rows, statics, cache=cache)
+
     def _run_jax_topo(self, enc, tenc):
         """The device pour: same decisions as _run_numpy's topology path,
-        served by ops/topo_jax.solve_scan_topo. Raises TopoKernelBail
-        when the snapshot leaves the kernel's event envelope."""
-        from ..ops import topo_jax
-        from ..ops.topo_jax import TopoGroupRows, solve_scan_topo
-        import jax.numpy as jnp
-
+        served by ops/topo_jax.solve_scan_topo via _dispatch_topo.
+        Raises TopoKernelBail when the snapshot leaves the kernel's
+        event envelope."""
         T, D = enc.A.shape
         Z, C = len(enc.zones), enc.avail.shape[2]
         P = len(enc.pools)
@@ -480,8 +486,6 @@ class TPUSolver(Solver):
         arrays.update(pool_types=pool_types, pool_agz=pool_agz,
                       pool_agc=pool_agc, pool_limit=pool_limit,
                       pool_used0=pool_used0)
-        from ..ops.ffd_jax import KernelInputs
-        inp = KernelInputs(**{k: jnp.asarray(v) for k, v in arrays.items()})
 
         rows, GZ, GH = self._topo_rows(enc, tenc)
         GZp = max(1, 1 << (GZ - 1).bit_length())
@@ -493,7 +497,7 @@ class TPUSolver(Solver):
             out[:G, :a.shape[1]] = a
             return out
 
-        topo_rows = TopoGroupRows(
+        topo_rows = dict(
             has_topo=np.pad(rows["has_topo"], (0, Gp - G)),
             zone_needed=np.pad(rows["zone_needed"], (0, Gp - G)),
             min_mask=padG(rows["min_mask"]),
@@ -512,19 +516,18 @@ class TPUSolver(Solver):
             member_h=np.pad(rows["member_h"], (0, Gp - G),
                             constant_values=-1),
         )
-        topo_rows = TopoGroupRows(*[jnp.asarray(v) for v in topo_rows])
-        cz0 = jnp.zeros((GZp, Z), jnp.int64)
         n_bucket = self._bucket
+        conv_cache: dict = {}  # reuse device-placed inputs across retries
         while True:
-            ch0 = jnp.zeros((GHp, n_bucket), jnp.int64)
-            takes_d, leftover_d, events, zfix_d, bail_d, carry = \
-                solve_scan_topo(inp, topo_rows, cz0, ch0,
-                                n_max=n_bucket, P=Pp,
-                                EVCAP=self.TOPO_EVCAP, PMAX=self.TOPO_PMAX)
-            bail = np.asarray(bail_d)
-            takes = np.asarray(takes_d)
-            leftover = np.asarray(leftover_d)
-            nn = int(np.asarray(carry.num_nodes))
+            out = self._dispatch_topo(arrays, topo_rows, dict(
+                Z=Z, P=Pp, GZ=GZp, GH=GHp, n_max=n_bucket,
+                EVCAP=self.TOPO_EVCAP, PMAX=self.TOPO_PMAX),
+                cache=conv_cache)
+            # materialize only the retry-decision scalars; the full
+            # output set transfers once, after the loop settles
+            bail = np.asarray(out["bail"])
+            leftover = np.asarray(out["leftover"])
+            nn = int(np.asarray(out["num_nodes"])[0])
             if bail.any():
                 raise TopoKernelBail(
                     f"{int(bail.sum())} group(s) exceeded the "
@@ -534,19 +537,21 @@ class TPUSolver(Solver):
                 break
             n_bucket = min(n_bucket * 4, self.n_max)
         self._bucket = n_bucket
+        out = {k: np.asarray(v) for k, v in out.items()}
+        takes = out["takes"]
+        leftover = out["leftover"]
 
-        ev = {k: np.asarray(v) for k, v in events.items()}
+        ev = {k[3:]: v for k, v in out.items() if k.startswith("ev_")}
         run_log = {}
         for g in enc.groups:
             gi = g.index
             if rows["has_topo"][gi]:
                 run_log[gi] = _runs_from_events(ev, gi)
         final = dict(
-            types=np.asarray(carry.types), zones=np.asarray(carry.zones),
-            ct=np.asarray(carry.ct), pool=np.asarray(carry.pool),
-            alive=np.asarray(carry.alive),
-            used=np.asarray(carry.used)[:, :D],
-            E=0, run_log=run_log, zfix=np.asarray(zfix_d))
+            types=out["types"], zones=out["zones"], ct=out["ct"],
+            pool=out["pool"], alive=out["alive"],
+            used=out["used"][:, :D],
+            E=0, run_log=run_log, zfix=out["zfix"])
         return takes[:G], leftover[:G], final
 
     def _run_jax(self, enc, ex_alloc, ex_used, ex_compat):
