@@ -749,12 +749,13 @@ def run_device_kernel_inner(pods, rounds):
 
     env = Environment()
     builders = {"1": (build_config1, 1000), "2": (build_config2, pods),
-                "5": (build_config5, pods)}
+                "3": (build_config3, pods), "5": (build_config5, pods)}
     for name, (build, n) in builders.items():
         snap = build(env, n)
         tpu = TPUSolver(backend="jax")
         phases = {}
-        tpu._dispatch = _phase_timed_dispatch(phases)
+        if name != "3":  # config 3 rides the topo event kernel instead
+            tpu._dispatch = _phase_timed_dispatch(phases)
         tpu._dev_devices = lambda: 1  # decompose the packed path
 
         def oracle_fp(snap=snap, phases=phases):

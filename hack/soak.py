@@ -65,11 +65,29 @@ def main():
     while time.monotonic() < deadline:
         it += 1
         action = rng.random()
-        if action < 0.45:  # scale up
+        if action < 0.45:  # scale up (1/3 of scale-ups carry topology)
             n = rng.randint(5, 60)
             cpu = rng.choice(["250m", "500m", "1", "2"])
+            kw = {}
+            shape = rng.random()
+            if shape < 0.2:  # zone spread (the pour / device kernel)
+                from karpenter_provider_aws_tpu.apis import labels as L
+                from karpenter_provider_aws_tpu.apis.objects import \
+                    TopologySpreadConstraint
+                kw = dict(group=f"soak{it:04d}", topology_spread=[
+                    TopologySpreadConstraint(
+                        max_skew=1, topology_key=L.ZONE,
+                        group=f"soak{it:04d}")])
+            elif shape < 0.33:  # soft anti-affinity (relaxation wrapper)
+                from karpenter_provider_aws_tpu.apis import labels as L
+                from karpenter_provider_aws_tpu.apis.objects import \
+                    PodAffinityTerm
+                kw = dict(group=f"soak{it:04d}", pod_affinity=[
+                    PodAffinityTerm(topology_key=L.ZONE,
+                                    group=f"soak{it:04d}", anti=True,
+                                    required=False)])
             for p in make_pods(n, cpu=cpu, memory="1Gi",
-                               prefix=f"soak{it:04d}"):
+                               prefix=f"soak{it:04d}", **kw):
                 op.kube.create(p)
         elif action < 0.75:  # scale down
             pods = op.kube.list("Pod")
