@@ -247,6 +247,54 @@ class TestTopologyFuzz:
         assert_equivalent(env.snapshot(pods, pools), solvers)
 
 
+@pytest.mark.scale
+class TestExtendedTopologyFuzz:
+    """Slow-tier three-engine fuzz (oracle / host pour / device kernel)
+    over a wider seed space than the fast-tier class above — the device
+    kernel is the newest engine and earns the deepest adversarial
+    coverage."""
+
+    @pytest.mark.parametrize("seed", range(24))
+    def test_three_engines_identical(self, env, seed):
+        from karpenter_provider_aws_tpu.solver import route
+        assert route.device_alive()
+        rng = random.Random(5000 + seed)
+        pods = []
+        for gi in range(rng.randint(1, 6)):
+            spread, aff = [], []
+            if rng.random() < 0.6:
+                spread.append(zspread(rng.randint(1, 3),
+                                      group=f"e{seed}g{gi}"))
+            if rng.random() < 0.35:
+                spread.append(hspread(rng.randint(1, 4),
+                                      group=f"e{seed}g{gi}"))
+            if rng.random() < 0.35:
+                aff.append(PodAffinityTerm(
+                    topology_key=rng.choice([L.ZONE, L.HOSTNAME]),
+                    group=f"e{seed}g{rng.randint(0, gi)}",
+                    anti=rng.random() < 0.6))
+            pods += make_pods(
+                rng.randint(1, 40),
+                cpu=rng.choice(["250m", "500m", "1", "2", "4"]),
+                memory=rng.choice(["512Mi", "1Gi", "4Gi"]),
+                prefix=f"e{seed}g{gi}", group=f"e{seed}g{gi}",
+                topology_spread=spread, pod_affinity=aff)
+        if rng.random() < 0.4:
+            pods += make_pods(rng.randint(10, 50), cpu="250m",
+                              memory="512Mi", prefix=f"e{seed}p")
+        pools = [env.nodepool(f"ep{seed}")]
+        if rng.random() < 0.35:
+            pools.append(env.nodepool(f"ep{seed}b", weight=10))
+        snap = env.snapshot(pods, pools)
+        a = CPUSolver().solve(snap).decision_fingerprint()
+        b = TPUSolver(backend="numpy", n_max=192).solve(snap) \
+            .decision_fingerprint()
+        c = TPUSolver(backend="jax", n_max=192).solve(snap) \
+            .decision_fingerprint()
+        assert a == b, "host pour diverged from oracle"
+        assert a == c, "device kernel diverged from oracle"
+
+
 class TestDeviceKernelServes:
     """The dev-path fixture above proves equivalence; this proves the
     device kernel (not a silent host fallback) actually served a
