@@ -349,6 +349,22 @@ def _phase_timed_dispatch(phases):
     return timed_dispatch
 
 
+def _resolve_device_verdict(tpu, snap, backend):
+    """Settle the liveness probe and router calibration BEFORE the timed
+    loop. Without this, a healthy device whose background probe lands
+    mid-measurement makes the router calibrate inside a timed round —
+    and calibration pays the XLA compile (~20-40s on TPU), which would
+    land straight in the published p99. On a wedged link the wait is
+    bounded by the probe's 90s subprocess deadline, once per process
+    (the False verdict caches)."""
+    if backend == "numpy":
+        return
+    from karpenter_provider_aws_tpu.solver import route
+    if route.device_alive():  # blocking, 90s deadline, cached
+        tpu.solve(snap)       # calibration + compile, outside the timing
+        tpu.solve(snap)
+
+
 def run_solver_config(name, snap, backend, rounds):
     from karpenter_provider_aws_tpu.solver import CPUSolver
     from karpenter_provider_aws_tpu.solver.tpu import TPUSolver
@@ -360,6 +376,7 @@ def run_solver_config(name, snap, backend, rounds):
     cpu_ms = (time.perf_counter() - t0) * 1000
     got = tpu.solve(snap)  # warms the jit cache
     identical = ref.decision_fingerprint() == got.decision_fingerprint()
+    _resolve_device_verdict(tpu, snap, backend)
     # long-running-server GC posture (the daemon does the same): promote
     # the warm state out of the collector so steady-state rounds are not
     # punctuated by gen2 pauses over the oracle's garbage
@@ -474,6 +491,11 @@ def run_config4(backend, rounds, n_nodes=200):
     cpu_ms = (time.perf_counter() - t0) * 1000
     got = _c4_decide_batched(ev, tpu, base, cands, queries)  # warm jit
     identical = got == ref
+    if backend != "numpy":
+        from karpenter_provider_aws_tpu.solver import route
+        if route.device_alive():  # settle probe + calibrate off the clock
+            _c4_decide_batched(ev, tpu, base, cands, queries)
+            _c4_decide_batched(ev, tpu, base, cands, queries)
     gc.collect()
     gc.freeze()
     times = []
