@@ -81,9 +81,10 @@ class Daemon:
                  metrics_port: int = 8080,
                  lease_path: str = "",
                  solver: str = "cpu",
+                 sidecar_address: str = "",
                  simulate_kubelet: bool = True):
         if operator is None:
-            sv, ev = self._build_solver(solver)
+            sv, ev = self._build_solver(solver, sidecar_address)
             operator = Operator(options=options, solver=sv,
                                consolidation_evaluator=ev)
         self.operator = operator
@@ -98,12 +99,28 @@ class Daemon:
         self._register_controllers()
 
     @staticmethod
-    def _build_solver(name: str):
-        """(solver, consolidation evaluator) for --solver cpu|tpu."""
+    def _build_solver(name: str, sidecar_address: str = ""):
+        """(solver, consolidation evaluator) for --solver cpu|tpu.
+
+        A sidecar address upgrades the tpu solver to RemoteSolver: the
+        packed/topology dispatches ride the chart's companion container
+        (gRPC), cost-routed against the in-process host twin; the
+        consolidation evaluator stays local (its prescreen kernels are
+        latency-sensitive batched calls on host state)."""
         if name == "tpu":
             from .solver.consolidation import TPUConsolidationEvaluator
+            if sidecar_address:
+                from .sidecar.client import RemoteSolver
+                return (RemoteSolver(sidecar_address),
+                        TPUConsolidationEvaluator())
             from .solver.tpu import TPUSolver
-            return TPUSolver(backend="jax"), TPUConsolidationEvaluator()
+            # auto = per-shape cost routing between the device kernel
+            # and the bit-identical host twin (solver/route.py)
+            return TPUSolver(backend="auto"), TPUConsolidationEvaluator()
+        if sidecar_address:
+            import logging
+            logging.getLogger(__name__).warning(
+                "--solver-sidecar-address is ignored with --solver cpu")
         from .solver.cpu import CPUSolver
         return CPUSolver(), None
 
@@ -214,6 +231,11 @@ def main(argv=None) -> int:
                         help="file lease path enabling leader election")
     parser.add_argument("--solver", choices=["cpu", "tpu"], default="cpu",
                         help="provisioning solver backend")
+    parser.add_argument("--solver-sidecar-address", default="",
+                        help="host:port of the solver sidecar; with "
+                             "--solver tpu, device dispatches ride the "
+                             "gRPC companion (the chart sets this when "
+                             "sidecar.enabled)")
     parser.add_argument("--log-level", default="INFO")
     import sys as _sys
     if argv is None:
@@ -224,6 +246,7 @@ def main(argv=None) -> int:
         format="%(asctime)s %(levelname)s %(name)s %(message)s")
     options = Options.parse(argv)
     daemon = Daemon(options=options, metrics_port=ns.metrics_port,
-                    lease_path=ns.leader_elect_lease, solver=ns.solver)
+                    lease_path=ns.leader_elect_lease, solver=ns.solver,
+                    sidecar_address=ns.solver_sidecar_address)
     daemon.run()
     return 0

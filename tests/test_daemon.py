@@ -158,6 +158,41 @@ class TestDaemon:
         d.shutdown()
         assert not d.manager.running
 
+    def test_daemon_provisions_through_sidecar(self):
+        """The chart's sidecar.enabled wiring end to end: a daemon built
+        with --solver tpu --solver-sidecar-address provisions pending
+        pods with its solve dispatches riding the gRPC companion."""
+        from karpenter_provider_aws_tpu.apis.objects import (
+            EC2NodeClass, NodeClassRef, NodePool, NodePoolTemplate)
+        from karpenter_provider_aws_tpu.sidecar.client import RemoteSolver
+        from karpenter_provider_aws_tpu.sidecar.server import SolverServer
+        server = SolverServer().start()
+        d = None
+        try:
+            d = Daemon(metrics_port=0, solver="tpu",
+                       sidecar_address=server.address)
+            assert isinstance(d.operator.solver, RemoteSolver)
+            d.start()
+            op = d.operator
+            op.kube.create(EC2NodeClass("sc-class"))
+            op.kube.create(NodePool("sc-pool", template=NodePoolTemplate(
+                node_class_ref=NodeClassRef("sc-class"))))
+            for p in make_pods(15, cpu="500m", memory="1Gi", prefix="sc"):
+                op.kube.create(p)
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                pods = op.kube.list("Pod")
+                if pods and all(p.node_name for p in pods):
+                    break
+                time.sleep(0.25)
+            pods = op.kube.list("Pod")
+            assert pods and all(p.node_name for p in pods), \
+                "sidecar-backed daemon did not schedule pods"
+        finally:
+            if d is not None:
+                d.shutdown()
+            server.stop(0)
+
     def test_leader_election_gates_controllers(self, tmp_path):
         path = str(tmp_path / "lease")
         holder = FileLease(path, identity="other", ttl=30.0)
