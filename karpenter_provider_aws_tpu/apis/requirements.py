@@ -321,6 +321,13 @@ class Requirements:
 
     def union(self, other: "Requirements") -> "Requirements":
         """Conjunction (core ``Add``): same-key requirements intersect."""
+        # an empty side changes nothing; Requirements are immutable, so
+        # returning the other side is safe — and the decode path unions
+        # thousands of empty group-requirement sets per solve
+        if not other._by_key:
+            return self
+        if not self._by_key:
+            return other
         return Requirements(list(self._by_key.values()) + list(other._by_key.values()))
 
     def conflicts(self, other: "Requirements") -> List[str]:

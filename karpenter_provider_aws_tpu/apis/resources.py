@@ -128,7 +128,7 @@ class Resources(Mapping[str, int]):
     and iteration are canonical.
     """
 
-    __slots__ = ("_q",)
+    __slots__ = ("_q", "_nz")
 
     def __init__(self, quantities: Optional[Mapping[str, int]] = None, **kw: int):
         q: Dict[str, int] = {}
@@ -199,7 +199,12 @@ class Resources(Mapping[str, int]):
         return Resources({k: max(self[k], other[k]) for k in keys})
 
     def nonzero_keys(self) -> Tuple[str, ...]:
-        return tuple(sorted(self._q))
+        # memoized: the encoder asks once per group per solve and
+        # Resources is immutable (10k calls at the G-axis envelope)
+        nz = getattr(self, "_nz", None)
+        if nz is None:
+            nz = self._nz = tuple(sorted(self._q))
+        return nz
 
     # Identity -------------------------------------------------------------
     def __eq__(self, other: object) -> bool:

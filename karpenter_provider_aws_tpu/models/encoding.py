@@ -136,19 +136,29 @@ class LabelUniverse:
         return out
 
 
-@dataclass
 class PodGroup:
-    index: int
-    sig: Tuple
-    pods: List[Pod]                      # canonical order
-    reqs: Requirements
-    requests: Resources
-    #: ki -> allow mask over interned values (only constrained keys)
-    masks: Dict[int, np.ndarray] = field(default_factory=dict)
+    """One scheduling-signature group (plain __slots__ class: the
+    constructor runs once per group per solve — 10k times at the G-axis
+    envelope — so dataclass/default-factory overhead is measurable)."""
+    __slots__ = ("index", "sig", "pods", "reqs", "requests", "masks")
+
+    def __init__(self, index: int, sig: Tuple, pods: List[Pod],
+                 reqs: Requirements, requests: Resources,
+                 masks: Optional[Dict[int, np.ndarray]] = None):
+        self.index = index
+        self.sig = sig
+        self.pods = pods                 # canonical order
+        self.reqs = reqs
+        self.requests = requests
+        #: ki -> allow mask over interned values (only constrained keys)
+        self.masks = masks if masks is not None else {}
 
     @property
     def count(self) -> int:
         return len(self.pods)
+
+    def __repr__(self):
+        return f"PodGroup(index={self.index}, n={len(self.pods)})"
 
 
 @dataclass
@@ -196,6 +206,12 @@ class SnapshotEncoding:
     mv_floor: Optional[np.ndarray] = None    # [P, K] int64 (0 = no floor)
     mv_pairs_t: Optional[np.ndarray] = None  # [K, M] int64 type index of pair
     mv_pairs_v: Optional[np.ndarray] = None  # [K, M] int64 value id (V = pad)
+    #: any group carries required topology constraints (spread or
+    #: required (anti-)affinity) — a pure function of the signatures,
+    #: computed from the bank so the solver skips a per-group python scan
+    topo_any: bool = False
+    #: [G] uint8 — F[g].all() per group (native fill frontier eligibility)
+    F_full: Optional[np.ndarray] = None
 
     @property
     def mv_K(self) -> int:
@@ -217,6 +233,9 @@ _NSKEY_GET = operator.attrgetter("_nskey")
 #: watching churning workloads must not grow memory monotonically).
 _SIG_IDS: Dict[Tuple, int] = {}
 _SIG_BY_ID: List[Tuple] = []
+#: lazily-filled canonical FFD key (-cpu, -mem, digest) per sig id —
+#: saves recomputing effective_requests/digest per group per solve
+_SIG_KEY_BY_ID: List[Optional[Tuple]] = []
 _SIG_EPOCH = 0
 _SIG_CAP = 1 << 16
 _SIG_MU = threading.Lock()  # two unlocked misses could hand one id to two sigs
@@ -234,10 +253,12 @@ def _sig_id(pod: Pod) -> int:
             if len(_SIG_BY_ID) >= _SIG_CAP:
                 _SIG_IDS.clear()
                 _SIG_BY_ID.clear()
+                _SIG_KEY_BY_ID.clear()
                 _SIG_EPOCH += 1
             sid = len(_SIG_BY_ID)
             _SIG_IDS[sig] = sid
             _SIG_BY_ID.append(sig)
+            _SIG_KEY_BY_ID.append(None)
         epoch = _SIG_EPOCH
     pod.__dict__["_sig_id"] = (epoch, sid)
     return sid
@@ -254,7 +275,6 @@ def canonical_pod_groups(pods: Sequence[Pod]) -> List[Tuple[Tuple, List[Pod]]]:
     representative's key prefix and members by (ns, name) reproduces the
     exact canonical order.
     """
-    sig_groups: Optional[List[Tuple[Tuple, List[Pod]]]] = None
     for _attempt in range(3):
         by_sid: Dict[int, List[Pod]] = {}
         epoch = _SIG_EPOCH
@@ -275,17 +295,48 @@ def canonical_pod_groups(pods: Sequence[Pod]) -> List[Tuple[Tuple, List[Pod]]]:
         # the epoch never moved mid-loop — otherwise the grouping is
         # suspect and we retry (the fresh table now holds this snapshot's
         # sigs, so one retry suffices unless the snapshot alone overflows)
+        entries = None
+        misses = []
         with _SIG_MU:
             if _SIG_EPOCH == epoch:
-                sig_groups = [(_SIG_BY_ID[sid], plist)
-                              for sid, plist in by_sid.items()]
-        if sig_groups is not None:
-            break
-    if sig_groups is None:
-        raw: Dict[Tuple, List[Pod]] = {}
-        for p in pods:  # degenerate fallback: group by the raw sig tuple
-            raw.setdefault(pod_group_signature(p), []).append(p)
-        sig_groups = list(raw.items())
+                # per-sid FFD keys are cached alongside the intern table:
+                # a recurring signature costs one list index instead of
+                # effective_requests + digest per solve. Misses are only
+                # COLLECTED here — the md5-digest computation runs after
+                # the lock drops, so a cold table never serializes
+                # concurrent solves on the process-wide intern mutex
+                entries = []
+                for sid, plist in by_sid.items():
+                    key = _SIG_KEY_BY_ID[sid]
+                    if key is None:
+                        misses.append((len(entries), sid))
+                    entries.append((key, _SIG_BY_ID[sid], plist))
+        if entries is not None and misses:
+            computed = []
+            for pos, sid in misses:
+                rep = entries[pos][2][0]
+                r = rep.effective_requests()
+                key = (-r["cpu"], -r["memory"], pod_sig_digest(rep))
+                entries[pos] = (key, entries[pos][1], entries[pos][2])
+                computed.append((sid, key))
+            with _SIG_MU:
+                # write-back is idempotent (the key is a pure function of
+                # the signature); skip if the table reset meanwhile
+                if _SIG_EPOCH == epoch:
+                    for sid, key in computed:
+                        _SIG_KEY_BY_ID[sid] = key
+        if entries is not None:
+            # sids are unique within an epoch-stable pass, so no
+            # duplicate-signature merge is possible here (the
+            # canonical_group_order fallback handles that case)
+            for _k, _sig, plist in entries:
+                plist.sort(key=_NSKEY_GET)
+            entries.sort(key=operator.itemgetter(0))
+            return [(sig, plist) for _, sig, plist in entries]
+    raw: Dict[Tuple, List[Pod]] = {}
+    for p in pods:  # degenerate fallback: group by the raw sig tuple
+        raw.setdefault(pod_group_signature(p), []).append(p)
+    sig_groups = list(raw.items())
     for _sig, plist in sig_groups:
         plist.sort(key=_NSKEY_GET)
     return canonical_group_order(sig_groups)
@@ -353,6 +404,61 @@ _CATALOG_MU = threading.Lock()
 _GROUP_ROW_CACHE_CAP = 1 << 16
 
 
+class _RowBank:
+    """Signature-keyed per-group row store with contiguous bank matrices.
+
+    ``idx`` maps a scheduling signature to its row in the banks; warm
+    encode assembly is then one fancy-index gather per tensor instead of
+    a python loop of per-row copies. Banks double geometrically; rows are
+    immutable once written."""
+
+    def __init__(self, T: int, Z: int, C: int, P: int, D: int, pins=()):
+        self.idx: Dict[Tuple, int] = {}
+        self.size = 0
+        self.masks: List[Dict[int, np.ndarray]] = []
+        self.pins = pins
+        cap = 256
+        self.R = np.zeros((cap, D), dtype=np.int64)
+        self.F = np.zeros((cap, T), dtype=bool)
+        self.agz = np.zeros((cap, Z), dtype=bool)
+        self.agc = np.zeros((cap, C), dtype=bool)
+        self.admit = np.zeros((cap, P), dtype=bool)
+        self.daemon = np.zeros((cap, P, D), dtype=np.int64)
+        self.topo = np.zeros(cap, dtype=bool)
+        self.F_full = np.zeros(cap, dtype=np.uint8)
+
+    def _grow(self):
+        for name in ("R", "F", "agz", "agc", "admit", "daemon", "topo",
+                     "F_full"):
+            a = getattr(self, name)
+            b = np.zeros((a.shape[0] * 2,) + a.shape[1:], dtype=a.dtype)
+            b[:a.shape[0]] = a
+            setattr(self, name, b)
+
+    def reset(self):
+        self.idx.clear()
+        self.masks.clear()
+        self.size = 0
+
+    def add(self, sig: Tuple, Rrow, masks, Frow, agzrow, agcrow,
+            admit_row, daemon_rows, topo_flag: bool) -> int:
+        i = self.size
+        if i >= self.R.shape[0]:
+            self._grow()
+        self.R[i] = Rrow
+        self.F[i] = Frow
+        self.agz[i] = agzrow
+        self.agc[i] = agcrow
+        self.admit[i] = admit_row
+        self.daemon[i] = daemon_rows
+        self.topo[i] = topo_flag
+        self.F_full[i] = 1 if Frow.all() else 0
+        self.masks.append(masks)
+        self.idx[sig] = i
+        self.size = i + 1
+        return i
+
+
 def _encode_catalog(seen: Dict[Tuple[str, int], InstanceType],
                     snapshot_zones: Tuple[Tuple[str, str], ...],
                     dims: Tuple[str, ...]) -> _CatalogEncoding:
@@ -409,12 +515,14 @@ def encode_snapshot(snapshot: SchedulingSnapshot,
     # the preference wrapper already walked every pod to group them; when
     # it hands the grouping down, the second 50k-pod walk disappears
     groups: List[PodGroup] = []
+    dims_set = {"cpu", "memory", "pods"}
     for sig, plist in (pod_groups if pod_groups is not None
                        else canonical_pod_groups(snapshot.pods)):
         rep = plist[0]
-        groups.append(PodGroup(index=len(groups), sig=sig, pods=plist,
-                               reqs=rep.scheduling_requirements(),
-                               requests=rep.effective_requests()))
+        req = rep.effective_requests()
+        dims_set.update(req.nonzero_keys())
+        groups.append(PodGroup(len(groups), sig, plist,
+                               rep.scheduling_requirements(), req))
 
     # --- union catalog --------------------------------------------------
     # Dedup by RESOLVED OBJECT, not by name: the same type name resolves
@@ -436,10 +544,7 @@ def encode_snapshot(snapshot: SchedulingSnapshot,
             seen[(t.name, v)] = t
             seen_ids.add(id(t))
 
-    # --- dims -----------------------------------------------------------
-    dims_set = {"cpu", "memory", "pods"}
-    for g in groups:
-        dims_set.update(g.requests.nonzero_keys())
+    # --- dims (group keys folded in during the grouping walk above) ------
     for d in snapshot.daemon_overheads:
         dims_set.update(d.requests.nonzero_keys())
     for spec in snapshot.nodepools:
@@ -495,7 +600,7 @@ def encode_snapshot(snapshot: SchedulingSnapshot,
             in_use_vec=vec(spec.in_use)))
     P = len(pools)
 
-    # --- group tensors (signature-keyed row cache) -----------------------
+    # --- group tensors (signature-keyed row bank) ------------------------
     # Everything per-group here is a pure function of (scheduling
     # signature, catalog encoding, pool set, daemon set, dims): cache the
     # rows on the catalog encoding so recurring signatures — steady-state
@@ -503,25 +608,41 @@ def encode_snapshot(snapshot: SchedulingSnapshot,
     # high-cardinality G axis — skip the requirements algebra entirely.
     # Keyed by object identity for pools/daemons (the same staleness
     # discipline as _CATALOG_CACHE: providers hand out stable objects
-    # until a seqnum bump rebuilds them).
-    row_cache = getattr(cenc, "_group_row_cache", None)
-    if row_cache is None:
-        row_cache = cenc._group_row_cache = {}
+    # until a seqnum bump rebuilds them). Rows live in contiguous bank
+    # matrices so warm assembly is G fancy-index gathers, not a
+    # G-iteration python loop of row copies (at 10k signatures the loop
+    # was most of encode time).
+    banks = getattr(cenc, "_row_banks", None)
+    if banks is None:
+        banks = cenc._row_banks = {}
     pkey = (tuple(id(spec.nodepool) for spec in ordered_specs),
             tuple(id(d) for d in snapshot.daemon_overheads),
             tuple(dims))
+    bank = banks.get(pkey)
+    if bank is not None and bank.size >= _GROUP_ROW_CACHE_CAP:
+        # cap enforcement happens BETWEEN encodes only: a mid-encode
+        # reset would let later adds overwrite bank rows this encode's
+        # gather indices already reference
+        bank.reset()
+    if bank is None:
+        if sum(b.size for b in banks.values()) >= _GROUP_ROW_CACHE_CAP:
+            banks.clear()
+        # the pins hold the id()-keyed pool/daemon objects alive for the
+        # bank's lifetime: a GC'd pool whose address CPython recycles for
+        # a NEW pool must never alias an old key (same discipline as
+        # _CATALOG_CACHE pinning its types)
+        bank = banks[pkey] = _RowBank(
+            T=T, Z=Z, C=C, P=P, D=D,
+            pins=(tuple(spec.nodepool for spec in ordered_specs),
+                  tuple(snapshot.daemon_overheads)))
     G = len(groups)
-    R = np.zeros((G, D), dtype=np.int64)
-    n = np.zeros(G, dtype=np.int64)
-    F = np.ones((G, T), dtype=bool)
-    agz = np.ones((G, Z), dtype=bool)
-    agc = np.ones((G, C), dtype=bool)
-    admit = np.zeros((G, P), dtype=bool)
-    daemon = np.zeros((G, P, D), dtype=np.int64)
+    n = np.empty(G, dtype=np.int64)
+    idxs = np.empty(G, dtype=np.int64)
+    bank_idx = bank.idx
     for g in groups:
         n[g.index] = g.count
-        ent = row_cache.get((g.sig, pkey))
-        if ent is None:
+        bi = bank_idx.get(g.sig)
+        if bi is None:
             Rrow = vec(g.requests)
             masks = universe.group_masks(g.reqs)
             Frow = np.ones(T, dtype=bool)
@@ -549,18 +670,20 @@ def encode_snapshot(snapshot: SchedulingSnapshot,
                     if not merged.compatible(d.requirements):
                         total = total + d.requests
                 daemon_rows[pe.index] = vec(total)
-            if len(row_cache) >= _GROUP_ROW_CACHE_CAP:
-                row_cache.clear()
-            # the trailing pin holds the id()-keyed pool/daemon objects
-            # alive for the entry's lifetime: a GC'd pool whose address
-            # CPython recycles for a NEW pool must never alias an old
-            # key (same discipline as _CATALOG_CACHE pinning its types)
-            ent = row_cache[(g.sig, pkey)] = (
-                Rrow, masks, Frow, agzrow, agcrow, admit_row, daemon_rows,
-                (tuple(spec.nodepool for spec in ordered_specs),
-                 tuple(snapshot.daemon_overheads)))
-        (R[g.index], g.masks, F[g.index], agz[g.index], agc[g.index],
-         admit[g.index], daemon[g.index]) = ent[:7]
+            topo_flag = bool(pod.topology_spread) or \
+                any(a.required for a in pod.pod_affinity)
+            bi = bank.add(g.sig, Rrow, masks, Frow, agzrow, agcrow,
+                          admit_row, daemon_rows, topo_flag)
+        g.masks = bank.masks[bi]
+        idxs[g.index] = bi
+    R = bank.R[idxs]
+    F = bank.F[idxs]
+    agz = bank.agz[idxs]
+    agc = bank.agc[idxs]
+    admit = bank.admit[idxs]
+    daemon = bank.daemon[idxs]
+    topo_any = bool(bank.topo[idxs].any())
+    F_full = np.ascontiguousarray(bank.F_full[idxs])
 
     mv_keys, mv_V, mv_floor, mv_pairs_t, mv_pairs_v = \
         _encode_min_values(pools, types, P)
@@ -572,7 +695,8 @@ def encode_snapshot(snapshot: SchedulingSnapshot,
         groups=groups, R=R, n=n, F=F, agz=agz, agc=agc,
         pools=pools, admit=admit, daemon=daemon,
         mv_keys=mv_keys, mv_V=mv_V, mv_floor=mv_floor,
-        mv_pairs_t=mv_pairs_t, mv_pairs_v=mv_pairs_v)
+        mv_pairs_t=mv_pairs_t, mv_pairs_v=mv_pairs_v,
+        topo_any=topo_any, F_full=F_full)
 
 
 def _encode_min_values(pools: List[PoolEncoding],

@@ -17,10 +17,25 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
 NATIVE_DIR = os.path.join(REPO_ROOT, "native")
 
 
+def _stale(so_path: str, cpp: str) -> bool:
+    """A .so older than its source must be rebuilt: loading a library
+    compiled against a previous signature is an ABI mismatch ctypes
+    cannot detect (silent memory corruption, not an error). A .so with
+    NO adjacent source (source-pruned deployment artifact) is trusted
+    as-is — staleness is indeterminate and refusing to load it would be
+    a silent perf cliff."""
+    if not os.path.exists(cpp):
+        return False
+    try:
+        return os.path.getmtime(so_path) < os.path.getmtime(cpp)
+    except OSError:
+        return True
+
+
 def build_and_load(so_name: str, cpp_name: str) -> "ctypes.CDLL | None":
     so_path = os.path.join(NATIVE_DIR, so_name)
-    if not os.path.exists(so_path):
-        cpp = os.path.join(NATIVE_DIR, cpp_name)
+    cpp = os.path.join(NATIVE_DIR, cpp_name)
+    if not os.path.exists(so_path) or _stale(so_path, cpp):
         if not os.path.exists(cpp):
             return None
         tmp = so_path + f".tmp.{os.getpid()}"

@@ -31,11 +31,12 @@ def _load() -> "ctypes.CDLL | None":
     lib.karp_fast_fill.argtypes = (
         [ctypes.c_int64] * 9
         + [_I64P, _U8P,                       # A, avail
-           _I64P, _I64P, _U8P, _U8P, _U8P, _U8P, _I64P,  # group rows
+           _I64P, _I64P, _U8P, _U8P, _U8P, _U8P, _U8P, _I64P,  # group rows
            _U8P, _U8P, _U8P,                  # pool rows
            _I64P, _U8P,                       # existing
            _I64P, _U8P, _U8P, _U8P, _I32P, _U8P, _I64P, _I64P,  # state
-           _I64P, _I64P])                     # outputs
+           _I64P, _I64P, _I64P, ctypes.c_int64, _I64P,  # placement triples
+           _I64P])                            # leftover
     return lib
 
 
@@ -54,10 +55,13 @@ def _u8(a: np.ndarray) -> _U8P:
     return a.ctypes.data_as(_U8P)
 
 
-def fill_all(st, enc) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+def fill_all(st, enc) -> Optional[Tuple[Tuple[np.ndarray, np.ndarray,
+                                              np.ndarray], np.ndarray]]:
     """Run every group's closed-form fill natively, mutating ``st`` in
     place exactly as the per-group numpy path would. Returns
-    (takes[G, N], leftover[G]), or None when the library is absent.
+    ((g, slot, count) placement triples in walk order, leftover[G]), or
+    None when the library is absent or the triple buffer overflowed (the
+    caller must then re-solve on FRESH state — ``st`` has been mutated).
     Caller enforces the fast-path guards."""
     if _LIB is None:
         return None
@@ -65,7 +69,14 @@ def fill_all(st, enc) -> Optional[Tuple[np.ndarray, np.ndarray]]:
     P = len(enc.pools)
     T, D = enc.A.shape
     Z, C = len(enc.zones), enc.avail.shape[2]
-    takes = np.zeros((G, st.N), dtype=np.int64)
+    # each triple is one (group, slot) fill; a group rarely touches more
+    # than a couple of slots, so G+N-proportional capacity is generous.
+    # Overflow is signalled, never silent (out_n == -1).
+    cap = 8 * G + 8 * st.N + 4096
+    out_g = np.empty(cap, dtype=np.int64)
+    out_slot = np.empty(cap, dtype=np.int64)
+    out_cnt = np.empty(cap, dtype=np.int64)
+    out_n = np.zeros(1, dtype=np.int64)
     leftover = np.zeros(G, dtype=np.int64)
     pool_types = np.ascontiguousarray(
         np.stack([p.type_rows for p in enc.pools])
@@ -78,10 +89,17 @@ def fill_all(st, enc) -> Optional[Tuple[np.ndarray, np.ndarray]]:
         if P else np.zeros((0, C), bool))
     ex_alloc = st.ex_alloc if st.E else np.zeros((0, D), np.int64)
     ex_compat = st.ex_compat if st.E else np.zeros((G, 0), bool)
+    F_full = enc.F_full
+    if F_full is None:
+        # frontier eligibility per group; normally precomputed row-wise
+        # by the encoder's signature bank
+        F_full = enc.F_full = np.ascontiguousarray(
+            enc.F.all(axis=1), dtype=np.uint8)
     num_nodes = _LIB.karp_fast_fill(
         G, st.N, T, D, Z, C, st.E, P, st.num_nodes,
         _i64(enc.A), _u8(enc.avail),
-        _i64(enc.R), _i64(enc.n), _u8(enc.F), _u8(enc.agz), _u8(enc.agc),
+        _i64(enc.R), _i64(enc.n), _u8(enc.F), _u8(F_full),
+        _u8(enc.agz), _u8(enc.agc),
         _u8(enc.admit), _i64(enc.daemon),
         _u8(pool_types), _u8(pool_agz), _u8(pool_agc),
         _i64(np.ascontiguousarray(ex_alloc)),
@@ -89,6 +107,10 @@ def fill_all(st, enc) -> Optional[Tuple[np.ndarray, np.ndarray]]:
         _i64(st.used), _u8(st.types), _u8(st.zones), _u8(st.ct),
         st.pool.ctypes.data_as(_I32P), _u8(st.alive),
         _i64(st.cap_hint), _i64(st.pool_used),
-        _i64(takes), _i64(leftover))
+        _i64(out_g), _i64(out_slot), _i64(out_cnt), cap, _i64(out_n),
+        _i64(leftover))
     st.num_nodes = int(num_nodes)
-    return takes, leftover
+    n = int(out_n[0])
+    if n < 0:
+        return None  # overflow: placements incomplete, state is dirty
+    return (out_g[:n], out_slot[:n], out_cnt[:n]), leftover

@@ -137,11 +137,9 @@ class TPUSolver(Solver):
         enc = encode_snapshot(snapshot, pod_groups=pod_groups)
         # topology detection is per GROUP (~tens), not per pod (~50k): the
         # pod-group signature includes spread/affinity terms, so the group
-        # representative is authoritative for every member
-        topo = any(
-            g.pods[0].topology_spread
-            or any(a.required for a in g.pods[0].pod_affinity)
-            for g in enc.groups)
+        # representative is authoritative for every member (the flag is
+        # computed in the encoder's signature row bank — no group scan)
+        topo = enc.topo_any
         if not enc.types:
             # T == 0 (e.g. consolidation's price-filtered deletion check
             # empties every pool): no new nodes are possible, but pods may
@@ -293,12 +291,18 @@ class TPUSolver(Solver):
             if fastfill.available():
                 out = fastfill.fill_all(st, enc)
                 if out is not None:
-                    takes_m, leftover_v = out
+                    placements, leftover_v = out
                     final = dict(types=st.types, zones=st.zones,
                                  ct=st.ct, pool=st.pool, alive=st.alive,
                                  used=st.used, E=st.E, run_log={},
-                                 zfix=None)
-                    return takes_m, leftover_v, final
+                                 zfix=None, placements=placements)
+                    return None, leftover_v, final
+                # triple-buffer overflow: the native call mutated st
+                # mid-walk, so the interpreted path below must start
+                # from FRESH state (decisions, not just perf, depend
+                # on it)
+                st = ffd.NodeState.create(enc, self.n_max, ex_alloc,
+                                          ex_used, ex_compat)
         ts = None
         if tenc is not None:
             from ..ops.topo import TopoState, fill_group_topo, \
@@ -667,12 +671,48 @@ class TPUSolver(Solver):
                 takes: np.ndarray, leftover: np.ndarray,
                 final: dict) -> SolveResult:
         E = final["E"]
-        N = takes.shape[1]
         assignments: Dict[str, str] = {}
         unschedulable: Dict[str, str] = {}
         #: slot -> list of pods (in canonical order)
         slot_pods: Dict[int, List] = {}
         slot_groups: Dict[int, List[int]] = {}
+
+        if takes is None:
+            # sparse placements (native fill): (g, slot, cnt) triples in
+            # walk order — groups ascending, slots ascending and unique
+            # within a group — so a linear walk with a per-group offset
+            # reproduces the dense nonzero exactly, without ever
+            # materializing the [G, N] matrix
+            g_arr, s_arr, c_arr = final["placements"]
+            groups = enc.groups
+            cur_g, off = -1, 0
+            for i in range(len(g_arr)):
+                gi = int(g_arr[i])
+                slot = int(s_arr[i])
+                cnt = int(c_arr[i])
+                if gi != cur_g:
+                    cur_g, off = gi, 0
+                chunk = groups[gi].pods[off:off + cnt]
+                off += cnt
+                if slot < E:
+                    nm = existing[slot].name
+                    for p in chunk:
+                        assignments[p.full_name()] = nm
+                else:
+                    sp = slot_pods.get(slot)
+                    if sp is None:
+                        slot_pods[slot] = list(chunk)
+                        slot_groups[slot] = [gi]
+                    else:
+                        sp.extend(chunk)
+                        slot_groups[slot].append(gi)
+            for gi in np.nonzero(leftover)[0]:
+                g = groups[int(gi)]
+                for p in g.pods[len(g.pods) - int(leftover[gi]):]:
+                    unschedulable[p.full_name()] = \
+                        "no capacity in any nodepool"
+            return self._decode_nodes(enc, assignments, unschedulable,
+                                      slot_pods, slot_groups, final)
 
         run_log = final.get("run_log") or {}
         # one global nonzero instead of one per group: np.nonzero walks
@@ -722,7 +762,14 @@ class TPUSolver(Solver):
                 off += cnt
             for p in g.pods[off:]:  # leftovers — could not be scheduled
                 unschedulable[p.full_name()] = "no capacity in any nodepool"
+        return self._decode_nodes(enc, assignments, unschedulable,
+                                  slot_pods, slot_groups, final)
 
+    def _decode_nodes(self, enc: SnapshotEncoding, assignments,
+                      unschedulable, slot_pods, slot_groups,
+                      final: dict) -> SolveResult:
+        """Mint NewNodeClaims from the per-slot pod lists — the decode
+        tail shared by the dense-takes and sparse-placement paths."""
         new_nodes: List[NewNodeClaim] = []
         #: (zone-mask, ct-mask) -> per-type best price; nodes share few
         #: distinct mask patterns (usually one per zone), so the [T, Z, C]

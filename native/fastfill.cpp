@@ -50,6 +50,7 @@ extern "C" int64_t karp_fast_fill(
     const int64_t* Rg,         // [G, D]
     const int64_t* ng,         // [G]
     const uint8_t* F,          // [G, T]
+    const uint8_t* F_full,     // [G] (precomputed F[g].all(): frontier-eligible)
     const uint8_t* agz,        // [G, Z]
     const uint8_t* agc,        // [G, C]
     const uint8_t* admit,      // [G, P]
@@ -67,14 +68,118 @@ extern "C" int64_t karp_fast_fill(
     uint8_t* alive,            // [N]      (mutated)
     int64_t* cap_hint,         // [N, D]   (mutated)
     int64_t* pool_used,        // [P, D]   (mutated)
-    int64_t* takes,            // [G, N]   (out, zeroed by caller)
+    int64_t* out_g,            // [out_cap] (out: placement group ids)
+    int64_t* out_slot,         // [out_cap] (out: placement slots)
+    int64_t* out_cnt,          // [out_cap] (out: placement pod counts)
+    int64_t out_cap,           // triple capacity
+    int64_t* out_n,            // (out) triples written; -1 = overflow
     int64_t* leftover          // [G]      (out)
 ) {
+    // Placements come back as (group, slot, count) triples instead of a
+    // dense [G, N] takes matrix: at the G-axis envelope (10k signatures x
+    // 2k slots) the dense matrix is ~170MB of allocation + a full-matrix
+    // nonzero on the Python side, which dominated the solve. Triples are
+    // emitted in walk order (groups ascending, slots ascending within a
+    // group) — the exact order the dense nonzero produced.
     int64_t num_nodes = num_nodes_in;
+    int64_t n_out = 0;
+    bool overflow = false;
+    auto emit = [&](int64_t g, int64_t slot, int64_t m) {
+        if (n_out < out_cap) {
+            out_g[n_out] = g; out_slot[n_out] = slot; out_cnt[n_out] = m;
+            ++n_out;
+        } else {
+            overflow = true;  // state keeps mutating; caller re-solves
+        }
+    };
+    // Per-slot PARETO FRONTIER of the kept candidate types: the subset
+    // not dominated per-dim by another kept type. Headroom is monotone
+    // under dominance (A_u >= A_t per dim => headroom_u >= headroom_t),
+    // so for a group whose F row filters nothing (the common case — no
+    // node selector), the slot's exact max headroom is the max over the
+    // frontier: O(|frontier| * D) instead of the full O(T * (Z*C + D))
+    // candidate scan. Frontiers are rebuilt only when a narrowing
+    // actually changes the kept set. parn = -1 => frontier overflowed
+    // its cap; that slot always takes the full scan.
+    constexpr int PARCAP = 48;
+    int32_t* par = new int32_t[N * PARCAP];
+    int32_t* parn = new int32_t[N];
+    // per-dim MIN allocatable over the kept types: while the slot's new
+    // aggregate stays under this floor, no kept type can fail the fit
+    // check, so a take provably leaves the kept set unchanged (the O(D)
+    // take path below)
+    int64_t* floor_hint = new int64_t[N * D];
+    for (int64_t s = 0; s < N; ++s) parn[s] = 0;
+    for (int64_t i = 0; i < N * D; ++i) floor_hint[i] = 0;
+    auto build_frontier = [&](int64_t slot) {
+        const uint8_t* ts = types + slot * T;
+        int32_t* pf = par + slot * PARCAP;
+        int64_t* fl = floor_hint + slot * D;
+        for (int64_t d = 0; d < D; ++d) fl[d] = BIG;
+        int n = 0;
+        for (int64_t t = 0; t < T; ++t) {
+            if (!ts[t]) continue;
+            const int64_t* at = A + t * D;
+            for (int64_t d = 0; d < D; ++d)
+                if (at[d] < fl[d]) fl[d] = at[d];
+            if (n < 0) continue;  // frontier overflowed; keep min-scan
+            bool dominated = false;
+            for (int i = 0; i < n && !dominated; ++i) {
+                const int64_t* am = A + pf[i] * D;
+                dominated = true;
+                for (int64_t d = 0; d < D; ++d)
+                    if (am[d] < at[d]) { dominated = false; break; }
+            }
+            if (dominated) continue;
+            int w = 0;  // drop members the new type dominates
+            for (int i = 0; i < n; ++i) {
+                const int64_t* am = A + pf[i] * D;
+                bool t_ge = true;
+                for (int64_t d = 0; d < D; ++d)
+                    if (at[d] < am[d]) { t_ge = false; break; }
+                if (!t_ge) pf[w++] = pf[i];
+            }
+            n = w;
+            if (n >= PARCAP) { n = -1; continue; }
+            pf[n++] = (int32_t)t;
+        }
+        parn[slot] = n;
+    };
     // scratch: candidate row + per-type headroom for one slot
     // (allocated once; T is bounded by the catalog)
     int64_t* hr_buf = new int64_t[T];
     uint8_t* crow = new uint8_t[T];
+    // shared candidate/offering scan — the ONE implementation all call
+    // sites use (this file's decision-identity discipline forbids
+    // divergent copies of the scan)
+    auto type_off_ok = [&](int64_t t, const uint8_t* zm1, const uint8_t* zm2,
+                           const uint8_t* cm1, const uint8_t* cm2) -> bool {
+        const uint8_t* av = avail + t * Z * C;
+        for (int64_t z = 0; z < Z; ++z) {
+            if (!(zm1[z] && zm2[z])) continue;
+            for (int64_t c = 0; c < C; ++c)
+                if (cm1[c] && cm2[c] && av[z * C + c]) return true;
+        }
+        return false;
+    };
+    // fill `crow`/`hr_buf` for tmask ∧ fmask ∧ offering(zm1∧zm2, cm1∧cm2)
+    // against the `base` usage vector; returns the max headroom
+    auto scan_crow = [&](const uint8_t* tmask, const uint8_t* fmask,
+                         const uint8_t* zm1, const uint8_t* zm2,
+                         const uint8_t* cm1, const uint8_t* cm2,
+                         const int64_t* base, const int64_t* R) -> int64_t {
+        int64_t kk = 0;
+        for (int64_t t = 0; t < T; ++t) {
+            crow[t] = 0;
+            if (!tmask[t] || !fmask[t]) continue;
+            if (!type_off_ok(t, zm1, zm2, cm1, cm2)) continue;
+            crow[t] = 1;
+            int64_t h = headroom(A + t * D, base, R, D);
+            hr_buf[t] = h;
+            if (h > kk) kk = h;
+        }
+        return kk;
+    };
 
     for (int64_t g = 0; g < G; ++g) {
         int64_t n_rem = ng[g];
@@ -104,42 +209,83 @@ extern "C" int64_t karp_fast_fill(
             if (full) continue;
 
             int64_t k = 0;
+            bool crow_valid = false;
             if (slot < E) {
                 k = headroom(ex_alloc + slot * D, uh, R, D);
             } else {
                 const uint8_t* ts = types + slot * T;
                 const uint8_t* zs = zones + slot * Z;
                 const uint8_t* cs = ct + slot * C;
-                for (int64_t t = 0; t < T; ++t) {
-                    crow[t] = 0;
-                    if (!ts[t] || !Fg[t]) continue;
-                    bool off = false;
-                    const uint8_t* av = avail + t * Z * C;
-                    for (int64_t z = 0; z < Z && !off; ++z) {
-                        if (!(zs[z] && agz_g[z])) continue;
-                        for (int64_t c = 0; c < C; ++c)
-                            if (cs[c] && agc_g[c] && av[z * C + c]) {
-                                off = true; break;
-                            }
+                // frontier shortcut: when the group's F row filters
+                // nothing and every frontier member has an offering
+                // under the merged masks, the max headroom over the
+                // frontier is exact — skip the full candidate scan
+                bool served = false;
+                if (F_full[g] && parn[slot] > 0) {
+                    const int32_t* pf = par + slot * PARCAP;
+                    bool all_off = true;
+                    int64_t kk = 0;
+                    for (int i = 0; i < parn[slot] && all_off; ++i) {
+                        int64_t t = pf[i];
+                        if (!type_off_ok(t, zs, agz_g, cs, agc_g)) {
+                            all_off = false; break;
+                        }
+                        int64_t h = headroom(A + t * D, uh, R, D);
+                        if (h > kk) kk = h;
                     }
-                    if (!off) continue;
-                    crow[t] = 1;
-                    int64_t h = headroom(A + t * D, uh, R, D);
-                    hr_buf[t] = h;
-                    if (h > k) k = h;
+                    if (all_off) { k = kk; served = true; }
+                }
+                if (!served) {
+                    crow_valid = true;
+                    k = scan_crow(ts, Fg, zs, agz_g, cs, agc_g, uh, R);
                 }
             }
             if (k <= 0) continue;
             int64_t m = (k < n_rem) ? k : n_rem;
-            takes[g * N + slot] = m;
+            emit(g, slot, m);
             n_rem -= m;
             int64_t* uw = used + slot * D;
             for (int64_t d = 0; d < D; ++d) uw[d] += m * R[d];
             if (slot >= E) {
+                // O(D+Z+C) take: if the group's filters are supersets of
+                // the slot's masks (crow == kept) and the new aggregate
+                // stays under the kept-type floor, no type can drop —
+                // kept set, masks, hints and frontier are all provably
+                // unchanged, so the narrowing scan is skipped entirely
+                bool fast = F_full[g] != 0;
+                if (fast) {
+                    const uint8_t* zs2 = zones + slot * Z;
+                    for (int64_t z = 0; z < Z && fast; ++z)
+                        if (zs2[z] && !agz_g[z]) fast = false;
+                }
+                if (fast) {
+                    const uint8_t* cs2 = ct + slot * C;
+                    for (int64_t c = 0; c < C && fast; ++c)
+                        if (cs2[c] && !agc_g[c]) fast = false;
+                }
+                if (fast) {
+                    const int64_t* fl = floor_hint + slot * D;
+                    for (int64_t d = 0; d < D && fast; ++d)
+                        if (uw[d] > fl[d]) fast = false;
+                }
+                if (fast) {
+                    int64_t* puw = pool_used + pi * D;
+                    for (int64_t d = 0; d < D; ++d) puw[d] += m * R[d];
+                    continue;
+                }
+                // narrowing needs the full candidate row; the frontier
+                // shortcut skipped building it on the probe. crow is a
+                // pure mask function (types ∧ F ∧ offerings) independent
+                // of usage; the hr side-channel this also fills is not
+                // consumed by the narrowing below
+                if (!crow_valid)
+                    scan_crow(types + slot * T, Fg, zones + slot * Z,
+                              agz_g, ct + slot * C, agc_g, uh, R);
                 // narrow: cand & fit(new aggregate); masks; tighten hint
                 uint8_t* ts = types + slot * T;
                 int64_t* chw = cap_hint + slot * D;
                 for (int64_t d = 0; d < D; ++d) chw[d] = 0;
+                bool kept_changed = false;
                 for (int64_t t = 0; t < T; ++t) {
                     bool keep = crow[t];
                     if (keep) {
@@ -147,6 +293,7 @@ extern "C" int64_t karp_fast_fill(
                         for (int64_t d = 0; d < D; ++d)
                             if (uw[d] > at[d]) { keep = false; break; }
                     }
+                    if ((ts[t] != 0) != keep) kept_changed = true;
                     ts[t] = keep ? 1 : 0;
                     if (keep) {
                         const int64_t* at = A + t * D;
@@ -160,6 +307,7 @@ extern "C" int64_t karp_fast_fill(
                 for (int64_t c = 0; c < C; ++c) cs[c] &= agc_g[c];
                 int64_t* puw = pool_used + pi * D;
                 for (int64_t d = 0; d < D; ++d) puw[d] += m * R[d];
+                if (kept_changed) build_frontier(slot);
             }
         }
 
@@ -176,25 +324,8 @@ extern "C" int64_t karp_fast_fill(
             if (!anyz || !anyc) continue;
             const int64_t* dmn = daemon + (g * P + pi) * D;
             const uint8_t* ptypes = pool_types + pi * T;
-            int64_t cap = 0;
-            for (int64_t t = 0; t < T; ++t) {
-                crow[t] = 0;
-                if (!Fg[t] || !ptypes[t]) continue;
-                bool off = false;
-                const uint8_t* av = avail + t * Z * C;
-                for (int64_t z = 0; z < Z && !off; ++z) {
-                    if (!(agz_g[z] && pz[z])) continue;
-                    for (int64_t c = 0; c < C; ++c)
-                        if (agc_g[c] && pc[c] && av[z * C + c]) {
-                            off = true; break;
-                        }
-                }
-                if (!off) continue;
-                crow[t] = 1;
-                int64_t h = headroom(A + t * D, dmn, R, D);
-                hr_buf[t] = h;
-                if (h > cap) cap = h;
-            }
+            int64_t cap = scan_crow(ptypes, Fg, agz_g, pz, agc_g, pc,
+                                    dmn, R);
             if (cap < 1) continue;
             while (n_rem > 0 && num_nodes < N - E) {
                 int64_t slot = E + num_nodes;
@@ -224,7 +355,8 @@ extern "C" int64_t karp_fast_fill(
                 for (int64_t c = 0; c < C; ++c) cs[c] = agc_g[c] && pc[c];
                 int64_t* puw = pool_used + pi * D;
                 for (int64_t d = 0; d < D; ++d) puw[d] += m * R[d];
-                takes[g * N + slot] = m;
+                build_frontier(slot);
+                emit(g, slot, m);
                 n_rem -= m;
             }
         }
@@ -232,5 +364,9 @@ extern "C" int64_t karp_fast_fill(
     }
     delete[] hr_buf;
     delete[] crow;
+    delete[] par;
+    delete[] parn;
+    delete[] floor_hint;
+    *out_n = overflow ? -1 : n_out;
     return num_nodes;
 }
