@@ -43,6 +43,76 @@ def _percentiles(times):
 
 
 # ---------------------------------------------------------------------------
+# bench discipline (BASELINE.md "host drift"): the host's clock speed
+# drifts with thermal state, and the CPU-oracle reference solve that runs
+# right before the timed rounds leaves the package hot — the tail of the
+# published p99 used to be thermal, not algorithmic. Three mechanisms:
+#   1. pin_affinity(): one fixed core — no migration noise, and the
+#      per-round calibration probe measures the core the solve runs on.
+#   2. cooldown(): bounded idle wait after any sustained load (the
+#      oracle, jit warm-up) before timing starts.
+#   3. hot-round guard: a ~1ms fixed integer-matmul calibration probe
+#      runs before each timed round; rounds whose probe exceeds 2x the
+#      post-cooldown baseline are REJECTED and re-run after a pause
+#      (bounded), and the count is published — a thermally-inflated
+#      round can no longer slip into the p99 silently.
+# ---------------------------------------------------------------------------
+
+def pin_affinity():
+    try:
+        cpus = sorted(__import__("os").sched_getaffinity(0))
+        if len(cpus) > 1:
+            # stay off cpu0 (IRQ/housekeeping target on most hosts)
+            __import__("os").sched_setaffinity(0, {cpus[-1]})
+    except (AttributeError, OSError):
+        pass
+
+
+def _calib_ms():
+    """Fixed-work calibration probe (~1ms cold): int64 matmul, the same
+    ALU/cache mix as the solve kernels, no allocation after first use."""
+    import numpy as np
+    bufs = getattr(_calib_ms, "_bufs", None)
+    if bufs is None:
+        a = np.arange(160 * 160, dtype=np.int64).reshape(160, 160) % 97
+        bufs = _calib_ms._bufs = (a, np.empty_like(a))
+    a, out = bufs
+    t0 = time.perf_counter()
+    np.matmul(a, a, out=out)
+    return (time.perf_counter() - t0) * 1000
+
+
+def cooldown(seconds):
+    time.sleep(seconds)
+
+
+def calib_baseline():
+    """Post-cooldown calibration floor: best of 7 probes."""
+    return min(_calib_ms() for _ in range(7))
+
+
+def guarded_rounds(fn, rounds, baseline, max_redo_factor=1.0):
+    """Run ``rounds`` timed calls of fn() with the hot-round guard.
+    Returns (times_ms, hot_rejected). A round is measured only when the
+    immediately-preceding calibration probe is within 2x the baseline;
+    otherwise the bench pauses 1s and retries (redo budget bounded so a
+    permanently-hot host still terminates, with the tail published)."""
+    times = []
+    hot_rejected = 0
+    redo_budget = int(rounds * max_redo_factor)
+    while len(times) < rounds:
+        if _calib_ms() > 2.0 * baseline and redo_budget > 0:
+            hot_rejected += 1
+            redo_budget -= 1
+            time.sleep(1.0)
+            continue
+        t0 = time.perf_counter()
+        fn()
+        times.append((time.perf_counter() - t0) * 1000)
+    return times, hot_rejected
+
+
+# ---------------------------------------------------------------------------
 # snapshot builders, one per BASELINE config
 # ---------------------------------------------------------------------------
 
@@ -371,6 +441,12 @@ def run_solver_config(name, snap, backend, rounds):
 
     tpu = TPUSolver(backend=backend)
     cpu = CPUSolver()
+    # calibration floor BEFORE the oracle heats the package: the guard
+    # must compare timed rounds against the host's cold capability, not
+    # a post-oracle thermal plateau. The snapshot build that just ran is
+    # itself seconds of load — breathe first
+    cooldown(2.0)
+    baseline = calib_baseline()
     t0 = time.perf_counter()
     ref = cpu.solve(snap)
     cpu_ms = (time.perf_counter() - t0) * 1000
@@ -383,11 +459,11 @@ def run_solver_config(name, snap, backend, rounds):
     gc.collect()
     gc.freeze()
     counts = _count_engines(tpu)
-    times = []
-    for _ in range(rounds):
-        t0 = time.perf_counter()
-        tpu.solve(snap)
-        times.append((time.perf_counter() - t0) * 1000)
+    # the oracle reference solve above is seconds of sustained load —
+    # let the package cool before the timed rounds
+    cooldown(min(20.0, max(2.0, cpu_ms / 1000.0)))
+    times, hot_rejected = guarded_rounds(
+        lambda: tpu.solve(snap), rounds, baseline)
     p50, p99 = _percentiles(times)
     return {
         "config": name, "p50_ms": p50, "p99_ms": p99,
@@ -398,6 +474,8 @@ def run_solver_config(name, snap, backend, rounds):
         "types": max((len(s.instance_types) for s in snap.nodepools),
                      default=0),
         "rounds": rounds,
+        "hot_rejected": hot_rejected,
+        "calib_baseline_ms": round(baseline, 3),
         "engine": _engine_report(counts),
         "decisions": ref.summary(),
     }
@@ -486,6 +564,8 @@ def run_config4(backend, rounds, n_nodes=200):
     ev = TPUConsolidationEvaluator(backend=backend)
     tpu = TPUSolver(backend=backend)
     cpu = CPUSolver()
+    cooldown(2.0)  # the cluster build above is load too
+    baseline = calib_baseline()  # cold floor, before the oracle heats
     t0 = time.perf_counter()
     ref = _c4_decide_sequential(cpu, base, cands)
     cpu_ms = (time.perf_counter() - t0) * 1000
@@ -498,11 +578,10 @@ def run_config4(backend, rounds, n_nodes=200):
             _c4_decide_batched(ev, tpu, base, cands, queries)
     gc.collect()
     gc.freeze()
-    times = []
-    for _ in range(rounds):
-        t0 = time.perf_counter()
-        _c4_decide_batched(ev, tpu, base, cands, queries)
-        times.append((time.perf_counter() - t0) * 1000)
+    cooldown(min(20.0, max(2.0, cpu_ms / 1000.0)))
+    times, hot_rejected = guarded_rounds(
+        lambda: _c4_decide_batched(ev, tpu, base, cands, queries),
+        rounds, baseline)
     p50, p99 = _percentiles(times)
     return {
         "config": "4-consolidation", "p50_ms": p50, "p99_ms": p99,
@@ -511,6 +590,8 @@ def run_config4(backend, rounds, n_nodes=200):
         "identical_decisions": identical,
         "candidates": len(cands), "decision": f"{ref[0]} {ref[1]}",
         "rounds": rounds,
+        "hot_rejected": hot_rejected,
+        "calib_baseline_ms": round(baseline, 3),
         "engine": _engine_report({"host": -1, "dev": -1}),
     }
 
@@ -923,6 +1004,10 @@ def main():
     ap.add_argument("--device-kernel-inner", action="store_true",
                     help=argparse.SUPPRESS)  # subprocess body, deadline'd
     args = ap.parse_args()
+
+    # bench discipline: one fixed core for every measuring branch (the
+    # --all subprocesses each run their own main and re-pin themselves)
+    pin_affinity()
 
     # every branch below measures something; hold the pause file for all
     # of them (watcher coordination — see _hold_pause_file)

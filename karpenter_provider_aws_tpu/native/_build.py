@@ -55,3 +55,43 @@ def build_and_load(so_name: str, cpp_name: str) -> "ctypes.CDLL | None":
         return ctypes.CDLL(so_path)
     except OSError:
         return None
+
+
+def build_ext_and_import(module_name: str, c_name: str):
+    """Build and import a CPython extension module from native/ (same
+    one-shot/atomic/staleness discipline as the ctypes libraries).
+    Returns the module or None — callers keep a pure-python fallback.
+
+    Unlike the ctypes libraries (pure C ABI), a CPython extension is
+    ABI-specific — the .so carries the interpreter's EXT_SUFFIX tag so a
+    Python upgrade rebuilds instead of importing an extension compiled
+    against different object layouts (silent corruption, not an error)."""
+    import importlib.util
+    import sysconfig
+    suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
+    so_path = os.path.join(NATIVE_DIR, module_name + suffix)
+    src = os.path.join(NATIVE_DIR, c_name)
+    if not os.path.exists(so_path) or _stale(so_path, src):
+        if not os.path.exists(src):
+            return None
+        inc = sysconfig.get_paths()["include"]
+        tmp = so_path + f".tmp.{os.getpid()}"
+        try:
+            subprocess.run(
+                [os.environ.get("CC", os.environ.get("CXX", "gcc")),
+                 "-O2", "-fPIC", "-shared", "-I", inc, "-o", tmp, src],
+                check=True, capture_output=True, timeout=60)
+            os.replace(tmp, so_path)
+        except Exception:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            return None
+    try:
+        spec = importlib.util.spec_from_file_location(module_name, so_path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+    except Exception:
+        return None

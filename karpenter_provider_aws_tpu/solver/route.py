@@ -148,7 +148,16 @@ def _probe_device(timeout: float = 90.0) -> bool:
             plat = sys.modules["jax"].config.jax_platforms
         except Exception:
             plat = None
-    code = "import jax\n"
+    # the probe child arms a SIGALRM self-destruct BEFORE importing jax:
+    # a probe against a wedged plugin busy-spins, and if the parent exits
+    # mid-probe (bench printing its JSON and quitting with the daemon
+    # probe thread in flight) subprocess.run's timeout-kill never runs —
+    # the orphan would spin forever and eat the host CPU the benches
+    # measure (observed: four orphans accumulated across bench runs on a
+    # single-core host). The kernel delivers SIGALRM regardless of what
+    # the plugin is doing; default disposition terminates the process.
+    code = (f"import signal; signal.alarm({int(timeout) + 5})\n"
+            "import jax\n")
     if plat:
         code += f"jax.config.update('jax_platforms', {plat!r})\n"
     code += ("ds = jax.devices()\n"

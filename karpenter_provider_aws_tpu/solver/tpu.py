@@ -686,10 +686,10 @@ class TPUSolver(Solver):
             g_arr, s_arr, c_arr = final["placements"]
             groups = enc.groups
             cur_g, off = -1, 0
-            for i in range(len(g_arr)):
-                gi = int(g_arr[i])
-                slot = int(s_arr[i])
-                cnt = int(c_arr[i])
+            # tolist() up front: iterating numpy scalars boxes one object
+            # per element access — plain ints walk ~3x faster
+            for gi, slot, cnt in zip(g_arr.tolist(), s_arr.tolist(),
+                                     c_arr.tolist()):
                 if gi != cur_g:
                     cur_g, off = gi, 0
                 chunk = groups[gi].pods[off:off + cnt]
@@ -809,11 +809,18 @@ class TPUSolver(Solver):
                 type_names = order_cache[ok] = \
                     [enc.type_names[i] for i in order]
             zf = int(zfix[slot]) if zfix is not None else -1
-            rk = (int(final["pool"][slot]), tuple(slot_groups[slot]), zf)
+            # key on the groups that CONTRIBUTE requirements: empty-req
+            # groups can't change the union, and dropping them collapses
+            # most per-node keys onto a handful of shared cache entries
+            # (at the G-axis envelope a node hosts ~100 groups of which
+            # only the selector-bearing few have requirements)
+            gs = tuple(gi for gi in slot_groups[slot]
+                       if enc.groups[gi].reqs)
+            rk = (int(final["pool"][slot]), gs, zf)
             reqs = reqs_cache.get(rk)
             if reqs is None:
                 reqs = pool.spec.nodepool.scheduling_requirements()
-                for gi in slot_groups[slot]:
+                for gi in gs:
                     reqs = reqs.union(enc.groups[gi].reqs)
                 if zf >= 0:
                     # topology pinned this node's zone (_choose_zone); the
