@@ -228,9 +228,11 @@ _NSKEY_GET = operator.attrgetter("_nskey")
 #: C-accelerated grouping walk (native/groupwalk.c); None -> pure python.
 #: The walk reads each pod's cached (epoch, sig-id) pair and buckets by
 #: sig id — six C-API calls per pod that cost ~0.7us each as bytecode,
-#: the single largest host-engine term at the 50k-pod envelope. Built
-#: LAZILY on first grouping (fastfill's pattern): the one-shot compile
-#: must never sit on the import path.
+#: the single largest host-engine term at the 50k-pod envelope. The
+#: one-shot compile sits neither on the import path nor mid-solve:
+#: solver constructors call _groupwalk() to pay it up front (the repo's
+#: no-first-solve-latency-cliff convention), and the first grouping
+#: builds it only if no solver was constructed first.
 _GROUPWALK = None
 _GROUPWALK_TRIED = False
 

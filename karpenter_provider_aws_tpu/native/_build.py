@@ -32,25 +32,36 @@ def _stale(so_path: str, cpp: str) -> bool:
         return True
 
 
+def _ensure_built(so_path: str, src: str, compile_cmd) -> bool:
+    """The shared atomic build step: compile to a pid-suffixed temp and
+    rename into place (a concurrent builder either sees the old state
+    and falls back, or the complete library — never a truncated file).
+    ``compile_cmd(tmp)`` returns the argv. True iff so_path is usable."""
+    if os.path.exists(so_path) and not _stale(so_path, src):
+        return True
+    if not os.path.exists(src):
+        return False
+    tmp = so_path + f".tmp.{os.getpid()}"
+    try:
+        subprocess.run(compile_cmd(tmp), check=True, capture_output=True,
+                       timeout=60)
+        os.replace(tmp, so_path)
+        return True
+    except Exception:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
+
+
 def build_and_load(so_name: str, cpp_name: str) -> "ctypes.CDLL | None":
     so_path = os.path.join(NATIVE_DIR, so_name)
     cpp = os.path.join(NATIVE_DIR, cpp_name)
-    if not os.path.exists(so_path) or _stale(so_path, cpp):
-        if not os.path.exists(cpp):
-            return None
-        tmp = so_path + f".tmp.{os.getpid()}"
-        try:
-            subprocess.run(
-                [os.environ.get("CXX", "g++"), "-O3", "-fPIC",
-                 "-std=c++17", "-shared", "-o", tmp, cpp],
-                check=True, capture_output=True, timeout=60)
-            os.replace(tmp, so_path)
-        except Exception:
-            try:
-                os.unlink(tmp)
-            except OSError:
-                pass
-            return None
+    if not _ensure_built(so_path, cpp, lambda tmp: [
+            os.environ.get("CXX", "g++"), "-O3", "-fPIC", "-std=c++17",
+            "-shared", "-o", tmp, cpp]):
+        return None
     try:
         return ctypes.CDLL(so_path)
     except OSError:
@@ -71,23 +82,11 @@ def build_ext_and_import(module_name: str, c_name: str):
     suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
     so_path = os.path.join(NATIVE_DIR, module_name + suffix)
     src = os.path.join(NATIVE_DIR, c_name)
-    if not os.path.exists(so_path) or _stale(so_path, src):
-        if not os.path.exists(src):
-            return None
-        inc = sysconfig.get_paths()["include"]
-        tmp = so_path + f".tmp.{os.getpid()}"
-        try:
-            subprocess.run(
-                [os.environ.get("CC", os.environ.get("CXX", "gcc")),
-                 "-O2", "-fPIC", "-shared", "-I", inc, "-o", tmp, src],
-                check=True, capture_output=True, timeout=60)
-            os.replace(tmp, so_path)
-        except Exception:
-            try:
-                os.unlink(tmp)
-            except OSError:
-                pass
-            return None
+    inc = sysconfig.get_paths()["include"]
+    if not _ensure_built(so_path, src, lambda tmp: [
+            os.environ.get("CC", os.environ.get("CXX", "gcc")),
+            "-O2", "-fPIC", "-shared", "-I", inc, "-o", tmp, src]):
+        return None
     try:
         spec = importlib.util.spec_from_file_location(module_name, so_path)
         mod = importlib.util.module_from_spec(spec)

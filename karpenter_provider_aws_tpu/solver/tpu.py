@@ -85,10 +85,13 @@ class TPUSolver(Solver):
         (always the device scan kernel) or 'numpy' (always the host twin —
         same math, decision-identical by the equivalence suites).
 
-        n_max bounds new-node slots per solve. If a solve would need more
-        nodes than n_max, overflow pods come back unschedulable (the oracle
-        would keep opening nodes) — size n_max well above the expected node
-        count (default 2048 vs the 500-node scale envelope, SURVEY §6)."""
+        n_max sizes the new-node slot arrays per solve. It is a CAPACITY,
+        not a decision bound: a solve that exhausts every slot with pods
+        left over GROWS n_max (x4, capped at the pod count — each new
+        node hosts >= 1 pod, so that cap is loss-free) and re-runs, so
+        decisions always match the oracle, which opens nodes unboundedly.
+        Default 2048 vs the 500-node scale envelope (SURVEY §6) means the
+        growth path is cold in production."""
         assert backend in ("auto", "jax", "numpy")
         self.backend = backend
         self.n_max = n_max
@@ -108,6 +111,10 @@ class TPUSolver(Solver):
             logging.getLogger(__name__).info(
                 "native fastfill unavailable (no compiler or build "
                 "failed); high-cardinality solves use the numpy path")
+        # same convention for the grouping-walk extension: its one-shot
+        # build must never appear as a first-solve latency cliff
+        from ..models.encoding import _groupwalk
+        _groupwalk()
         self._router = Router(name="solver")
         #: current new-node slot bucket; grows on overflow, sticky across
         #: solves (steady-state clusters reuse the same compiled kernel)
@@ -127,6 +134,44 @@ class TPUSolver(Solver):
             self.metrics.inc("karpenter_solver_oracle_fallback_total",
                              labels={"reason": reason})
         return self._cpu_fallback.solve(snapshot)
+
+    def solve(self, snapshot: SchedulingSnapshot) -> SolveResult:
+        """Slot growth (``_grow_if_exhausted``) is scoped to ONE solve:
+        it persists across the preference wrapper's relax rounds (they
+        re-solve the same workload) but resets afterwards — a single
+        pathological snapshot must not permanently inflate every later
+        solve's state arrays to its size."""
+        orig_n_max = self.n_max
+        try:
+            return super().solve(snapshot)
+        finally:
+            self.n_max = orig_n_max
+            self._bucket = min(self._bucket, orig_n_max)
+
+    def _grow_if_exhausted(self, snapshot: SchedulingSnapshot,
+                           leftover, final) -> bool:
+        """True iff the solve ran out of new-node slots with pods left
+        over AND growing can help — the caller then re-solves with 4x
+        slots. Closes the one spot where the tensor path could silently
+        diverge from the oracle (which opens nodes unboundedly): overflow
+        pods must never be reported unschedulable just because the slot
+        arrays were sized too small."""
+        if self.n_max >= len(snapshot.pods):
+            return False  # nodes <= pods: genuine unschedulability
+        if int(np.asarray(leftover).sum()) <= 0:
+            return False
+        alive = np.asarray(final["alive"])
+        if int(alive[final["E"]:].sum()) < self.n_max:
+            return False  # slots to spare: leftovers are real
+        self.n_max = min(self.n_max * 4, len(snapshot.pods))
+        self._bucket = min(self._bucket, self.n_max)
+        import logging
+        logging.getLogger(__name__).info(
+            "new-node slots exhausted with pods left over; growing "
+            "n_max to %d and re-solving", self.n_max)
+        if self.metrics is not None:
+            self.metrics.inc("karpenter_solver_slot_growth_total")
+        return True
 
     # ------------------------------------------------------------------
     def _solve_core(self, snapshot: SchedulingSnapshot,
@@ -191,6 +236,8 @@ class TPUSolver(Solver):
                     self._bucket_key(enc, ex_alloc.shape[0]) + ("topo",),
                     host_pour,
                     lambda: self._run_jax_topo(enc, tenc))
+            if self._grow_if_exhausted(snapshot, leftover, final):
+                return self._solve_core(snapshot, pod_groups=pod_groups)
             return self._decode(enc, existing, takes, leftover, final)
         ex_alloc, ex_used, ex_compat = self._encode_existing(enc, existing)
         if len(enc.groups) > self.dev_max_groups:
@@ -239,6 +286,8 @@ class TPUSolver(Solver):
                 self._router, self._bucket_key(enc, ex_alloc.shape[0]),
                 lambda: self._run_numpy(enc, ex_alloc, ex_used, ex_compat),
                 lambda: self._run_jax(enc, ex_alloc, ex_used, ex_compat))
+        if self._grow_if_exhausted(snapshot, leftover, final):
+            return self._solve_core(snapshot, pod_groups=pod_groups)
         return self._decode(enc, existing, takes, leftover, final)
 
     def _bucket_key(self, enc: SnapshotEncoding, E: int) -> Tuple:

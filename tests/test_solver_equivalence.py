@@ -406,3 +406,48 @@ class TestPackedBuffers:
         got = s.solve(snap)
         assert ref.decision_fingerprint() == got.decision_fingerprint()
         assert s._bucket > 8  # sticky growth for the next solve
+
+
+class TestSlotGrowth:
+    """n_max is array capacity, not a decision bound: exhausting every
+    new-node slot with pods left over must GROW the slot arrays and
+    re-solve until decisions match the oracle (which opens nodes
+    unboundedly). This pins the one spot where the tensor path was
+    allowed to silently diverge (round-4 verdict item 3)."""
+
+    def test_growth_small_nmax_host_and_device(self, env):
+        # each pod fills more than half the biggest machine -> one node
+        # per pod; 20 pods vs n_max=4 forces two growth rounds (4->16->20)
+        pods = make_pods(20, cpu="225", memory="1Gi", prefix="grow")
+        snap = env.snapshot(pods, [env.nodepool("grow-pool")])
+        ref = CPUSolver().solve(snap)
+        assert len(ref.new_nodes) == 20 and not ref.unschedulable
+        for backend in ("numpy", "jax"):
+            t = TPUSolver(backend=backend, n_max=4)
+            got = t.solve(snap)
+            assert got.decision_fingerprint() == ref.decision_fingerprint()
+            # growth is scoped to the solve: capacity resets afterwards
+            assert t.n_max == 4
+
+    def test_growth_beyond_default_capacity(self, env):
+        # ~3x the default 2048-slot capacity: 6200 one-pod nodes. The
+        # oracle keeps opening nodes; the tensor path must grow to match
+        # instead of reporting overflow pods unschedulable.
+        pods = make_pods(6200, cpu="225", memory="1Gi", prefix="big")
+        snap = env.snapshot(pods, [env.nodepool("grow-pool2")])
+        ref = CPUSolver().solve(snap)
+        assert len(ref.new_nodes) == 6200 and not ref.unschedulable
+        t = TPUSolver(backend="numpy")  # default n_max=2048
+        got = t.solve(snap)
+        assert got.decision_fingerprint() == ref.decision_fingerprint()
+        assert t.n_max == 2048  # growth never outlives its solve
+
+    def test_genuine_unschedulability_does_not_grow(self, env):
+        # a pod nothing in the catalog can hold: growth must NOT loop
+        pods = make_pods(3, cpu="9999", prefix="huge")
+        snap = env.snapshot(pods, [env.nodepool("grow-pool3")])
+        t = TPUSolver(backend="numpy", n_max=2)
+        got = t.solve(snap)
+        assert len(got.unschedulable) == 3
+        ref = CPUSolver().solve(snap)
+        assert got.decision_fingerprint() == ref.decision_fingerprint()
