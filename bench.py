@@ -906,7 +906,7 @@ def run_interruption_bench(counts=(100, 1000, 5000, 15000)):
                                                          NodeClassRef)
     from karpenter_provider_aws_tpu.apis.requirements import Requirements
     from karpenter_provider_aws_tpu.operator import Operator
-    from karpenter_provider_aws_tpu.providers.pricing import \
+    from karpenter_provider_aws_tpu.providers.sqs import \
         InterruptionMessage
 
     rows = []

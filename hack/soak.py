@@ -51,7 +51,7 @@ def main():
                                                          NodePoolTemplate)
     from karpenter_provider_aws_tpu.fake.environment import make_pods
     from karpenter_provider_aws_tpu.operator import Operator
-    from karpenter_provider_aws_tpu.providers.pricing import \
+    from karpenter_provider_aws_tpu.providers.sqs import \
         InterruptionMessage
 
     rng = random.Random(args.seed)

@@ -30,8 +30,9 @@ from ..providers.amifamily import AMIProvider
 from ..providers.instance import InstanceProvider
 from ..providers.instancetype import InstanceTypeProvider, OfferingsSnapshot
 from ..providers.network import SecurityGroupProvider, SubnetProvider
-from ..providers.pricing import (InstanceProfileProvider, InterruptionMessage,
-                                 PricingProvider, SQSProvider)
+from ..providers.instanceprofile import InstanceProfileProvider
+from ..providers.pricing import PricingProvider
+from ..providers.sqs import InterruptionMessage, SQSProvider
 
 log = logging.getLogger(__name__)
 
@@ -95,6 +96,22 @@ class NodeClassStatusController:
                     match={"kind": "EC2NodeClass", "name": gone})
         for nc in self.kube.list("EC2NodeClass"):
             if nc.metadata.deletion_timestamp is not None:
+                # termination path: hold the finalizer while NodeClaims
+                # still reference this class (running capacity keeps its
+                # IAM binding; the reference termination controller
+                # requeues the same way), then reap the instance profile
+                # this class created (instanceprofile.go Delete — a spec-
+                # pinned profile is user-managed and never touched) and
+                # release the finalizer so deletion completes
+                if "karpenter.k8s.aws/termination" in nc.metadata.finalizers:
+                    held = any(
+                        c.node_class_ref.name == nc.metadata.name
+                        for c in self.kube.list("NodeClaim"))
+                    if not held:
+                        self.profiles.delete(nc)
+                        self.kube.remove_finalizer(
+                            nc, "karpenter.k8s.aws/termination")
+                        n += 1
                 continue
             if "karpenter.k8s.aws/termination" not in nc.metadata.finalizers:
                 nc.metadata.finalizers.append("karpenter.k8s.aws/termination")

@@ -1,0 +1,103 @@
+"""In-memory IAM: the instance-profile API surface the instanceprofile
+provider consumes (the mocking boundary, like fake/ec2.py is for EC2 —
+reference seam: pkg/aws/sdk.go IAMAPI, 6 methods).
+
+Profiles hold at most ONE role (the IAM invariant the reference's
+provider leans on — instanceprofile.go:94-96) and a tag map. NotFound is
+a typed error so provider code can ignore-or-propagate exactly like the
+reference's awserrors.IsNotFound handling.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Mapping
+
+from .ec2 import CallLog
+
+
+class ProfileNotFoundError(Exception):
+    """GetInstanceProfile / DeleteInstanceProfile on an absent name."""
+
+
+@dataclass
+class FakeInstanceProfile:
+    name: str
+    roles: List[str] = field(default_factory=list)  # 0 or 1 entries
+    tags: Dict[str, str] = field(default_factory=dict)
+
+
+class FakeIAM:
+    def __init__(self):
+        self._mu = threading.RLock()
+        self._profiles: Dict[str, FakeInstanceProfile] = {}
+        self.create_profile_calls = CallLog()
+        self.delete_profile_calls = CallLog()
+        self.add_role_calls = CallLog()
+        self.remove_role_calls = CallLog()
+
+    def get_instance_profile(self, name: str) -> FakeInstanceProfile:
+        with self._mu:
+            p = self._profiles.get(name)
+            if p is None:
+                raise ProfileNotFoundError(name)
+            return FakeInstanceProfile(name=p.name, roles=list(p.roles),
+                                       tags=dict(p.tags))
+
+    def create_instance_profile(self, name: str,
+                                tags: Mapping[str, str] = ()) -> None:
+        self.create_profile_calls.record(name)
+        self.create_profile_calls.maybe_raise()
+        with self._mu:
+            if name in self._profiles:
+                raise ValueError(f"instance profile {name} already exists")
+            self._profiles[name] = FakeInstanceProfile(
+                name=name, tags=dict(tags or {}))
+
+    def add_role_to_instance_profile(self, name: str, role: str) -> None:
+        self.add_role_calls.record((name, role))
+        self.add_role_calls.maybe_raise()
+        with self._mu:
+            p = self._profiles.get(name)
+            if p is None:
+                raise ProfileNotFoundError(name)
+            if p.roles:
+                raise ValueError(
+                    f"instance profile {name} already has a role")
+            p.roles.append(role)
+
+    def remove_role_from_instance_profile(self, name: str,
+                                          role: str) -> None:
+        self.remove_role_calls.record((name, role))
+        self.remove_role_calls.maybe_raise()
+        with self._mu:
+            p = self._profiles.get(name)
+            if p is None:
+                raise ProfileNotFoundError(name)
+            if role in p.roles:
+                p.roles.remove(role)
+
+    def delete_instance_profile(self, name: str) -> None:
+        self.delete_profile_calls.record(name)
+        self.delete_profile_calls.maybe_raise()
+        with self._mu:
+            if name not in self._profiles:
+                raise ProfileNotFoundError(name)
+            p = self._profiles[name]
+            if p.roles:
+                raise ValueError(
+                    f"instance profile {name} still has a role attached")
+            del self._profiles[name]
+
+    # test helpers ---------------------------------------------------------
+    def profile_names(self) -> List[str]:
+        with self._mu:
+            return sorted(self._profiles)
+
+    def reset(self) -> None:
+        with self._mu:
+            self._profiles.clear()
+        for c in (self.create_profile_calls, self.delete_profile_calls,
+                  self.add_role_calls, self.remove_role_calls):
+            c.reset()

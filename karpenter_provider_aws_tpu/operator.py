@@ -30,6 +30,7 @@ from .controllers.steady_state import (CatalogController,
                                        VersionController)
 from .fake.catalog import catalog_by_name
 from .fake.ec2 import FakeEC2
+from .fake.iam import FakeIAM
 from .fake.kube import FakeKube
 from .fake.kubelet import FakeKubelet
 from .options import Options
@@ -38,8 +39,10 @@ from .providers.instance import InstanceProvider
 from .providers.instancetype import InstanceTypeProvider
 from .providers.launchtemplate import LaunchTemplateProvider
 from .providers.network import SecurityGroupProvider, SubnetProvider
-from .providers.pricing import (InstanceProfileProvider, PricingProvider,
-                                SQSProvider, VersionProvider)
+from .providers.instanceprofile import InstanceProfileProvider
+from .providers.pricing import PricingProvider
+from .providers.sqs import SQSProvider
+from .providers.version import VersionProvider
 from .providers.ssm import SSMProvider
 from .solver.cpu import CPUSolver
 from .solver.types import Solver
@@ -79,8 +82,9 @@ class Operator:
         self.security_groups = SecurityGroupProvider(self.ec2)
         self.ssm = SSMProvider(self.ec2)
         self.amis = AMIProvider(self.ec2, ssm=self.ssm)
+        self.iam = FakeIAM()
         self.instance_profiles = InstanceProfileProvider(
-            self.options.cluster_name)
+            self.options.cluster_name, iam=self.iam)
         self.version = VersionProvider()
         self.sqs = SQSProvider(self.options.interruption_queue)
         # kube-dns discovery (operator.go:243-260,262-274): the reference

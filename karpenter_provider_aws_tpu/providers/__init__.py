@@ -4,8 +4,10 @@ from .instancetype import (DEFAULT_VM_MEMORY_OVERHEAD_PERCENT,
                            InstanceTypeProvider, OfferingsSnapshot)
 from .launchtemplate import LaunchTemplateProvider, ResolvedLaunchTemplate
 from .network import SecurityGroupProvider, SubnetInfo, SubnetProvider
-from .pricing import (InstanceProfileProvider, InterruptionMessage,
-                      PricingProvider, SQSProvider, VersionProvider)
+from .instanceprofile import InstanceProfileProvider
+from .pricing import PricingProvider
+from .sqs import InterruptionMessage, SQSProvider
+from .version import VersionProvider
 
 __all__ = ["InstanceTypeProvider", "OfferingsSnapshot",
            "DEFAULT_VM_MEMORY_OVERHEAD_PERCENT", "InstanceProvider",

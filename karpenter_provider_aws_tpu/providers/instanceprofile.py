@@ -1,0 +1,114 @@
+"""IAM instance-profile lifecycle for the NodeClass role.
+
+Mirrors pkg/providers/instanceprofile/instanceprofile.go:43-46 (the 264
+LoC Create/Delete provider):
+
+- ``create(nc)`` is get-or-create against IAM, validated for role drift:
+  a profile that exists with a DIFFERENT role gets the old role removed
+  and the desired one attached (instanceprofile.go:92-113) — IAM
+  profiles hold at most one role. Role paths are stripped before
+  AddRole (AddRoleToInstanceProfile takes bare names).
+- a per-NodeClass-UID TTL cache (cache.go InstanceProfile 15m) skips the
+  IAM round trips while the binding is known-good; role drift is
+  revalidated after expiry.
+- ``delete(nc)`` removes the role then the profile, ignoring NotFound
+  (instanceprofile.go:117-140) — called by the NodeClass termination
+  path, so deleting a NodeClass reaps the profile it created.
+- A spec-pinned ``instanceProfile`` bypasses the provider entirely: the
+  user owns that profile's lifecycle (cloudprovider semantics for
+  spec.instanceProfile).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from ..cache.ttl import INSTANCE_PROFILE_TTL, TTLCache
+from ..fake.iam import FakeIAM, ProfileNotFoundError
+
+REGION_TAG = "topology.kubernetes.io/region"
+
+
+class InstanceProfileProvider:
+    def __init__(self, cluster_name: str = "cluster",
+                 region: str = "us-west-2",
+                 iam: Optional[FakeIAM] = None, clock=None):
+        self.cluster_name = cluster_name
+        self.region = region
+        self.iam = iam if iam is not None else FakeIAM()
+        self._mu = threading.Lock()
+        self._cache = TTLCache(ttl=INSTANCE_PROFILE_TTL,
+                               clock=clock or time.monotonic)
+
+    def profile_name(self, nodeclass) -> str:
+        """Deterministic per-(cluster, nodeclass, region) profile name —
+        reconstructable on restart, the state-in-cluster discipline."""
+        return (f"{self.cluster_name}_{nodeclass.metadata.name}_"
+                f"{self.region}_profile")
+
+    @staticmethod
+    def _role_name(role: str) -> str:
+        # AddRoleToInstanceProfile takes the bare role name; strip any
+        # IAM path prefix (instanceprofile.go:106-108)
+        return role.rsplit("/", 1)[-1]
+
+    def create(self, nodeclass) -> str:
+        if nodeclass.instance_profile:
+            return nodeclass.instance_profile  # user-managed profile
+        name = self.profile_name(nodeclass)
+        if self._cache.get(nodeclass.metadata.uid) is not None:
+            return name
+        role = self._role_name(nodeclass.role)
+        # the get-or-create + role-rebind sequence is check-then-act;
+        # serialize it (concurrent reconciles of one class race the IAM
+        # create, and the rebind must never interleave)
+        with self._mu:
+            try:
+                profile = self.iam.get_instance_profile(name)
+            except ProfileNotFoundError:
+                try:
+                    self.iam.create_instance_profile(
+                        name,
+                        tags={REGION_TAG: self.region,
+                              "karpenter.k8s.aws/cluster": self.cluster_name,
+                              "karpenter.k8s.aws/ec2nodeclass":
+                                  nodeclass.metadata.name})
+                except ValueError:
+                    pass  # EntityAlreadyExists: another actor won the race
+                profile = self.iam.get_instance_profile(name)
+            if profile.roles:
+                if profile.roles[0] == role:
+                    self._cache.put(nodeclass.metadata.uid, name)
+                    return name
+                # role drift: rebind (profiles hold at most one role)
+                self.iam.remove_role_from_instance_profile(
+                    name, profile.roles[0])
+            self.iam.add_role_to_instance_profile(name, role)
+            self._cache.put(nodeclass.metadata.uid, name)
+            return name
+
+    def delete(self, nodeclass) -> None:
+        if nodeclass.instance_profile:
+            return  # user-managed: never reap
+        name = self.profile_name(nodeclass)
+        try:
+            profile = self.iam.get_instance_profile(name)
+        except ProfileNotFoundError:
+            return
+        for role in list(profile.roles):
+            self.iam.remove_role_from_instance_profile(name, role)
+        try:
+            self.iam.delete_instance_profile(name)
+        except ProfileNotFoundError:
+            pass
+        self._cache.delete(nodeclass.metadata.uid)
+
+    # compatibility with callers that look profiles up by name ------------
+    def get(self, name: str) -> Optional[str]:
+        try:
+            profile = self.iam.get_instance_profile(name)
+        except ProfileNotFoundError:
+            return None
+        return profile.roles[0] if profile.roles else ""

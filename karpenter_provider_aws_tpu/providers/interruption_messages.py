@@ -14,7 +14,7 @@ from __future__ import annotations
 import json
 from typing import List
 
-from .pricing import InterruptionMessage
+from .sqs import InterruptionMessage
 
 #: instance states worth reacting to (statechange/parser.go:27)
 _ACCEPTED_STATES = {"stopping", "stopped", "shutting-down", "terminated"}

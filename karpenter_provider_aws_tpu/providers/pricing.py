@@ -1,26 +1,49 @@
-"""Pricing + small providers (instance profile, version, SQS interruption
-queue).
+"""Pricing provider (pkg/providers/pricing).
 
-Pricing mirrors pkg/providers/pricing: on-demand prices via the pricing API
-pages (pricing.go:228-354), spot via DescribeSpotPriceHistory into a
-per-zone map (:281-309,356-399), a static fallback snapshot per partition
-(zz_generated.pricing_aws*.go), 12h refresh cadence driven by the pricing
-controller. All prices fixed-point micro-USD.
+On-demand prices via the pricing API pages (pricing.go:228-354), spot via
+DescribeSpotPriceHistory into a per-zone map (:281-309,356-399), 12h
+refresh driven by the pricing controller. All prices fixed-point
+micro-USD.
+
+Static-fallback semantics mirror the reference exactly
+(pricing.go:108-157 NewDefaultProvider->Reset + the empty-result guards
+in UpdateOnDemandPricing/UpdateSpotPricing):
+
+- construction seeds BOTH maps from the static tables (the
+  zz_generated.pricing analog — here derived from the deterministic
+  catalog), so a cold control plane prices every offering before the
+  first refresh, and a boot with a DEAD pricing API still prices
+  everything;
+- a refresh that errors or returns an empty page KEEPS the previous
+  data (last-known-good, falling back to static at boot) instead of
+  wiping the maps — the reference returns "no on-demand pricing found"
+  and leaves its maps untouched;
+- spot lookups before the first live spot refresh serve the per-type
+  static default price regardless of zone (pricing.go SpotPrice's
+  !spotPricingUpdated branch); after a live refresh, the per-zone map
+  is authoritative.
 """
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
-from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
 from ..fake.catalog import build_catalog, spot_price
 
-#: static fallback (the zz_generated.pricing table analog): derived from the
-#: deterministic catalog so a cold control plane prices sanely before the
-#: first refresh.
-_STATIC_OD: Dict[str, int] = {i.name: i.od_price for i in build_catalog()}
+log = logging.getLogger(__name__)
+
+#: static fallback tables (the zz_generated.pricing_aws*.go analog):
+#: derived from the deterministic catalog at import, one OD price per
+#: type and one zone-independent default spot price per type.
+_STATIC_OD: Dict[str, int] = {}
+_STATIC_SPOT_DEFAULT: Dict[str, int] = {}
+for _i in build_catalog():
+    _STATIC_OD[_i.name] = _i.od_price
+    _STATIC_SPOT_DEFAULT[_i.name] = spot_price(_i, "")
+del _i
 
 
 class PricingProvider:
@@ -29,13 +52,22 @@ class PricingProvider:
         self._mu = threading.RLock()
         self._od: Dict[str, int] = dict(_STATIC_OD)
         self._spot: Dict[Tuple[str, str], int] = {}
+        #: False until the first successful live spot refresh: spot
+        #: lookups serve the static per-type default until then
+        self._spot_updated = False
         self._clock = clock or time.monotonic
         self.od_updated: float = 0.0
         self.spot_updated: float = 0.0
 
     def instance_types(self) -> List[str]:
+        """Types with either an OD or spot price known
+        (pricing.go InstanceTypes: the union of both maps)."""
         with self._mu:
-            return sorted(self._od)
+            names = set(self._od)
+            names.update(t for t, _z in self._spot)
+            if not self._spot_updated:
+                names.update(_STATIC_SPOT_DEFAULT)
+            return sorted(names)
 
     def on_demand_price(self, instance_type: str) -> Optional[int]:
         with self._mu:
@@ -43,6 +75,8 @@ class PricingProvider:
 
     def spot_price(self, instance_type: str, zone: str) -> Optional[int]:
         with self._mu:
+            if not self._spot_updated:
+                return _STATIC_SPOT_DEFAULT.get(instance_type)
             return self._spot.get((instance_type, zone))
 
     def spot_prices(self) -> Dict[Tuple[str, str], int]:
@@ -55,7 +89,17 @@ class PricingProvider:
 
     # controller-driven refreshes (providers/pricing/controller.go:43-60)
     def update_on_demand_pricing(self) -> bool:
-        fresh = self.ec2.on_demand_prices()
+        try:
+            fresh = self.ec2.on_demand_prices()
+        except Exception as e:  # dead pricing API: keep last known good
+            log.warning("on-demand pricing refresh failed (%s); keeping "
+                        "previous prices", e)
+            return False
+        if not fresh:
+            # reference: "no on-demand pricing found" — maps untouched
+            log.warning("on-demand pricing refresh returned no prices; "
+                        "keeping previous prices")
+            return False
         with self._mu:
             changed = fresh != self._od
             self._od = dict(fresh)
@@ -63,114 +107,20 @@ class PricingProvider:
             return changed
 
     def update_spot_pricing(self) -> bool:
-        fresh = {(t, z): p for t, z, p in self.ec2.describe_spot_price_history()}
+        try:
+            rows = self.ec2.describe_spot_price_history()
+        except Exception as e:
+            log.warning("spot pricing refresh failed (%s); keeping "
+                        "previous prices", e)
+            return False
+        fresh = {(t, z): p for t, z, p in rows}
+        if not fresh:
+            log.warning("spot pricing refresh returned no prices; "
+                        "keeping previous prices")
+            return False
         with self._mu:
-            changed = fresh != self._spot
+            changed = (fresh != self._spot) or not self._spot_updated
             self._spot = fresh
+            self._spot_updated = True
             self.spot_updated = self._clock()
             return changed
-
-
-class InstanceProfileProvider:
-    """IAM instance-profile CRUD for the NodeClass role
-    (pkg/providers/instanceprofile, instanceprofile.go:43-46)."""
-
-    def __init__(self, cluster_name: str = "cluster", region: str = "us-west-2"):
-        self.cluster_name = cluster_name
-        self.region = region
-        self._mu = threading.Lock()
-        self._profiles: Dict[str, str] = {}   # profile name -> role
-
-    def create(self, nodeclass) -> str:
-        if nodeclass.instance_profile:
-            return nodeclass.instance_profile
-        name = (f"{self.cluster_name}_{nodeclass.metadata.name}_"
-                f"{self.region}_profile")
-        with self._mu:
-            self._profiles[name] = nodeclass.role
-        return name
-
-    def get(self, name: str) -> Optional[str]:
-        with self._mu:
-            return self._profiles.get(name)
-
-    def delete(self, name: str) -> None:
-        with self._mu:
-            self._profiles.pop(name, None)
-
-
-class VersionProvider:
-    """Kubernetes version discovery, hydrated synchronously at boot
-    (pkg/providers/version, version.go:46-50; operator.go:155)."""
-
-    SUPPORTED = ("1.28", "1.29", "1.30", "1.31", "1.32")
-
-    def __init__(self, version: str = "1.31"):
-        self._version = version
-
-    def get(self) -> str:
-        return self._version
-
-    def update(self, version: str) -> bool:
-        major_minor = ".".join(version.split(".")[:2])
-        if major_minor not in self.SUPPORTED:
-            raise ValueError(f"unsupported kubernetes version {version}")
-        changed = self._version != major_minor
-        self._version = major_minor
-        return changed
-
-
-@dataclass
-class InterruptionMessage:
-    """Parsed SQS interruption message (interruption/messages/types.go:21-57).
-    kinds: spot_interruption | rebalance_recommendation | scheduled_change |
-    state_change | noop"""
-    kind: str
-    instance_id: str
-    detail: str = ""
-    receipt: str = ""
-
-
-class SQSProvider:
-    """Interruption queue (pkg/providers/sqs, sqs.go:31-36): receive/delete
-    plus send for tests."""
-
-    def __init__(self, queue_name: str = "karpenter-interruption"):
-        self.queue_name = queue_name
-        self._mu = threading.Lock()
-        #: receipt -> message, insertion-ordered (O(1) delete — the list
-        #: rebuild the naive version did made a 15k-message drain O(n^2))
-        self._messages: Dict[str, InterruptionMessage] = {}
-        self._receipt = 0
-
-    def send(self, message: InterruptionMessage) -> None:
-        with self._mu:
-            self._receipt += 1
-            message.receipt = str(self._receipt)
-            self._messages[message.receipt] = message
-
-    def send_raw(self, raw: str) -> None:
-        """Enqueue a raw EventBridge JSON body — what real SQS delivers.
-        Parsed through the messages parsers (one envelope may fan out to
-        several normalized messages, e.g. a multi-instance AWS Health
-        scheduled change)."""
-        from .interruption_messages import parse_message
-        for m in parse_message(raw):
-            self.send(m)
-
-    def receive(self, max_messages: int = 10) -> List[InterruptionMessage]:
-        with self._mu:
-            out = []
-            for m in self._messages.values():
-                out.append(m)
-                if len(out) >= max_messages:
-                    break
-            return out
-
-    def delete(self, message: InterruptionMessage) -> None:
-        with self._mu:
-            self._messages.pop(message.receipt, None)
-
-    def __len__(self) -> int:
-        with self._mu:
-            return len(self._messages)

@@ -1,0 +1,63 @@
+"""Interruption queue (pkg/providers/sqs, sqs.go:31-36): receive/delete
+plus send for tests, and the normalized interruption-message model
+(interruption/messages/types.go:21-57)."""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Dict, List
+
+
+@dataclass
+class InterruptionMessage:
+    """Parsed SQS interruption message (interruption/messages/types.go:21-57).
+    kinds: spot_interruption | rebalance_recommendation | scheduled_change |
+    state_change | noop"""
+    kind: str
+    instance_id: str
+    detail: str = ""
+    receipt: str = ""
+
+
+class SQSProvider:
+    """Receive/delete with send for tests; messages are insertion-ordered
+    with O(1) delete (a 15k-message drain must not be O(n^2))."""
+
+    def __init__(self, queue_name: str = "karpenter-interruption"):
+        self.queue_name = queue_name
+        self._mu = threading.Lock()
+        self._messages: Dict[str, InterruptionMessage] = {}
+        self._receipt = 0
+
+    def send(self, message: InterruptionMessage) -> None:
+        with self._mu:
+            self._receipt += 1
+            message.receipt = str(self._receipt)
+            self._messages[message.receipt] = message
+
+    def send_raw(self, raw: str) -> None:
+        """Enqueue a raw EventBridge JSON body — what real SQS delivers.
+        Parsed through the messages parsers (one envelope may fan out to
+        several normalized messages, e.g. a multi-instance AWS Health
+        scheduled change)."""
+        from .interruption_messages import parse_message
+        for m in parse_message(raw):
+            self.send(m)
+
+    def receive(self, max_messages: int = 10) -> List[InterruptionMessage]:
+        with self._mu:
+            out = []
+            for m in self._messages.values():
+                out.append(m)
+                if len(out) >= max_messages:
+                    break
+            return out
+
+    def delete(self, message: InterruptionMessage) -> None:
+        with self._mu:
+            self._messages.pop(message.receipt, None)
+
+    def __len__(self) -> int:
+        with self._mu:
+            return len(self._messages)

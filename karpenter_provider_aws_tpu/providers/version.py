@@ -1,0 +1,22 @@
+"""Kubernetes version discovery, hydrated synchronously at boot
+(pkg/providers/version, version.go:46-50; operator.go:155)."""
+
+from __future__ import annotations
+
+
+class VersionProvider:
+    SUPPORTED = ("1.28", "1.29", "1.30", "1.31", "1.32")
+
+    def __init__(self, version: str = "1.31"):
+        self._version = version
+
+    def get(self) -> str:
+        return self._version
+
+    def update(self, version: str) -> bool:
+        major_minor = ".".join(version.split(".")[:2])
+        if major_minor not in self.SUPPORTED:
+            raise ValueError(f"unsupported kubernetes version {version}")
+        changed = self._version != major_minor
+        self._version = major_minor
+        return changed

@@ -6,7 +6,7 @@ import pytest
 
 from karpenter_provider_aws_tpu.apis import labels as L
 from karpenter_provider_aws_tpu.fake.environment import make_pods
-from karpenter_provider_aws_tpu.providers.pricing import InterruptionMessage
+from karpenter_provider_aws_tpu.providers.sqs import InterruptionMessage
 
 from .conftest import mk_cluster
 

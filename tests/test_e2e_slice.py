@@ -12,7 +12,7 @@ from karpenter_provider_aws_tpu.apis.objects import (EC2NodeClass,
 from karpenter_provider_aws_tpu.apis.requirements import Requirements
 from karpenter_provider_aws_tpu.fake.environment import make_pods
 from karpenter_provider_aws_tpu.operator import Operator
-from karpenter_provider_aws_tpu.providers.pricing import InterruptionMessage
+from karpenter_provider_aws_tpu.providers.sqs import InterruptionMessage
 
 
 def mk_cluster(op: Operator, pool_name="default", requirements=(),

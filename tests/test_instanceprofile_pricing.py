@@ -1,0 +1,158 @@
+"""Instance-profile lifecycle (ref instanceprofile.go:43-46) and pricing
+static-fallback semantics (ref pricing.go:108-157)."""
+
+import pytest
+
+from karpenter_provider_aws_tpu.apis.objects import EC2NodeClass
+from karpenter_provider_aws_tpu.fake.iam import FakeIAM, ProfileNotFoundError
+from karpenter_provider_aws_tpu.providers.instanceprofile import \
+    InstanceProfileProvider
+from karpenter_provider_aws_tpu.providers.pricing import PricingProvider
+
+
+def _nodeclass(name="default", role="KarpenterNodeRole", profile=""):
+    return EC2NodeClass(name=name, role=role, instance_profile=profile)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+class TestInstanceProfileLifecycle:
+    def test_create_get_delete(self):
+        iam = FakeIAM()
+        p = InstanceProfileProvider("cl", "us-west-2", iam=iam)
+        nc = _nodeclass()
+        name = p.create(nc)
+        assert name == "cl_default_us-west-2_profile"
+        assert p.get(name) == "KarpenterNodeRole"
+        prof = iam.get_instance_profile(name)
+        assert prof.tags["karpenter.k8s.aws/ec2nodeclass"] == "default"
+        p.delete(nc)
+        assert p.get(name) is None
+        with pytest.raises(ProfileNotFoundError):
+            iam.get_instance_profile(name)
+
+    def test_create_is_idempotent_and_cached(self):
+        iam = FakeIAM()
+        p = InstanceProfileProvider("cl", "us-west-2", iam=iam)
+        nc = _nodeclass()
+        p.create(nc)
+        p.create(nc)
+        p.create(nc)
+        # the UID cache short-circuits the IAM round trips
+        assert iam.create_profile_calls.called_times == 1
+        assert iam.add_role_calls.called_times == 1
+
+    def test_role_drift_rebinds(self):
+        iam = FakeIAM()
+        clock = FakeClock()
+        p = InstanceProfileProvider("cl", "us-west-2", iam=iam, clock=clock)
+        nc = _nodeclass(role="RoleA")
+        name = p.create(nc)
+        assert p.get(name) == "RoleA"
+        # the role changes on the nodeclass; after cache expiry create()
+        # must remove the stale role and attach the new one
+        # (instanceprofile.go:92-113)
+        nc.role = "RoleB"
+        clock.t += 16 * 60
+        assert p.create(nc) == name
+        assert p.get(name) == "RoleB"
+        assert iam.remove_role_calls.called_times == 1
+        assert iam.create_profile_calls.called_times == 1  # no recreate
+
+    def test_role_path_is_stripped(self):
+        iam = FakeIAM()
+        p = InstanceProfileProvider("cl", "us-west-2", iam=iam)
+        nc = _nodeclass(role="path/to/KarpenterNodeRole")
+        name = p.create(nc)
+        assert p.get(name) == "KarpenterNodeRole"
+
+    def test_spec_pinned_profile_is_user_managed(self):
+        iam = FakeIAM()
+        p = InstanceProfileProvider("cl", "us-west-2", iam=iam)
+        nc = _nodeclass(profile="my-own-profile")
+        assert p.create(nc) == "my-own-profile"
+        assert iam.create_profile_calls.called_times == 0
+        p.delete(nc)  # never touches IAM for user-managed profiles
+        assert iam.delete_profile_calls.called_times == 0
+
+    def test_delete_ignores_absent_profile(self):
+        p = InstanceProfileProvider("cl", "us-west-2", iam=FakeIAM())
+        p.delete(_nodeclass())  # no raise
+
+    def test_nodeclass_deletion_reaps_profile_via_controller(self):
+        from karpenter_provider_aws_tpu.operator import Operator
+        op = Operator()
+        nc = _nodeclass(name="reap-me")
+        op.kube.create(nc)
+        op.nodeclass_status.reconcile()
+        name = nc.status_instance_profile
+        assert op.instance_profiles.get(name) == "KarpenterNodeRole"
+        assert "karpenter.k8s.aws/termination" in nc.metadata.finalizers
+        op.kube.delete('EC2NodeClass', nc.metadata.name)  # finalizer holds it
+        op.nodeclass_status.reconcile()
+        assert op.instance_profiles.get(name) is None
+        import pytest as _pt
+        from karpenter_provider_aws_tpu.fake.kube import NotFound
+        with _pt.raises(NotFound):
+            op.kube.get("EC2NodeClass", nc.metadata.name)
+
+
+class TestPricingFallback:
+    class DeadPricingAPI:
+        def on_demand_prices(self):
+            raise ConnectionError("pricing API unreachable")
+
+        def describe_spot_price_history(self):
+            raise ConnectionError("pricing API unreachable")
+
+    class EmptyPricingAPI:
+        def on_demand_prices(self):
+            return {}
+
+        def describe_spot_price_history(self):
+            return []
+
+    def test_boot_with_dead_api_prices_every_type(self):
+        p = PricingProvider(self.DeadPricingAPI())
+        assert p.update_on_demand_pricing() is False
+        assert p.update_spot_pricing() is False
+        types = p.instance_types()
+        assert len(types) > 500  # the full static table
+        for t in types[:50]:
+            od = p.on_demand_price(t)
+            sp = p.spot_price(t, "us-west-2a")
+            assert od and od > 0
+            assert sp and 0 < sp < od  # static default spot < od
+
+    def test_empty_refresh_keeps_previous_prices(self):
+        p = PricingProvider(self.EmptyPricingAPI())
+        before = p.on_demand_prices()
+        assert before
+        assert p.update_on_demand_pricing() is False
+        assert p.update_spot_pricing() is False
+        assert p.on_demand_prices() == before
+
+    def test_live_refresh_takes_over_spot_zoning(self):
+        class LiveAPI:
+            def on_demand_prices(self):
+                return {"m5.large": 96_000}
+
+            def describe_spot_price_history(self):
+                return [("m5.large", "us-west-2a", 30_000)]
+
+        p = PricingProvider(LiveAPI())
+        # pre-refresh: static default regardless of zone
+        assert p.spot_price("m5.large", "nonexistent-zone") is not None
+        assert p.update_spot_pricing() is True
+        assert p.spot_price("m5.large", "us-west-2a") == 30_000
+        # post-refresh the per-zone map is authoritative: unknown zone
+        # has no price (pricing.go SpotPrice second branch)
+        assert p.spot_price("m5.large", "nonexistent-zone") is None
+        assert p.update_on_demand_pricing() is True
+        assert p.on_demand_price("m5.large") == 96_000

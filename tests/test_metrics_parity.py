@@ -21,7 +21,7 @@ from karpenter_provider_aws_tpu.manager import (ControllerManager,
                                                 FileLease, ReconcileError,
                                                 TerminalReconcileError)
 from karpenter_provider_aws_tpu.operator import Operator
-from karpenter_provider_aws_tpu.providers.pricing import InterruptionMessage
+from karpenter_provider_aws_tpu.providers.sqs import InterruptionMessage
 
 DOC = os.path.join(os.path.dirname(__file__), "..", "docs", "metrics.md")
 

@@ -12,7 +12,7 @@ from karpenter_provider_aws_tpu.controllers.steady_state import (
 from karpenter_provider_aws_tpu.fake.ec2 import FakeEC2
 from karpenter_provider_aws_tpu.fake.environment import make_pods
 from karpenter_provider_aws_tpu.operator import Operator
-from karpenter_provider_aws_tpu.providers.pricing import VersionProvider
+from karpenter_provider_aws_tpu.providers.version import VersionProvider
 from karpenter_provider_aws_tpu.providers.ssm import SSMProvider, is_mutable
 
 
@@ -164,7 +164,7 @@ class TestInterruptionThroughput:
                                                              NodeClassRef)
         from karpenter_provider_aws_tpu.apis.requirements import Requirements
         from karpenter_provider_aws_tpu.operator import Operator
-        from karpenter_provider_aws_tpu.providers.pricing import \
+        from karpenter_provider_aws_tpu.providers.sqs import \
             InterruptionMessage
 
         op = Operator()
