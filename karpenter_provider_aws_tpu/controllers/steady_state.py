@@ -349,14 +349,22 @@ class InterruptionController:
 
 
 class CatalogController:
-    """12h instance-type + offerings refresh (controller.go:43-60)."""
+    """12h instance-type + offerings refresh (controller.go:43-60).
+
+    Offering prices come from the PricingProvider when one is wired —
+    that is where the static-fallback / last-known-good semantics live
+    (pricing.go:108-157): a dead pricing API must not leave the catalog
+    unpriced, and the catalog must never bypass the fallback by reading
+    the raw cloud API (the reference's instancetype resolver reads
+    pricing.OnDemandPrice/SpotPrice the same way, types.go:120-157)."""
 
     def __init__(self, ec2, provider: InstanceTypeProvider, metrics=None,
-                 unavailable_offerings=None):
+                 unavailable_offerings=None, pricing=None):
         self.ec2 = ec2
         self.provider = provider
         self.metrics = metrics
         self.unavailable = unavailable_offerings
+        self.pricing = pricing
 
     def reconcile(self) -> bool:
         infos = self.ec2.describe_instance_types()
@@ -364,9 +372,18 @@ class CatalogController:
         type_zones: Dict[str, set] = {}
         for t, z in self.ec2.describe_instance_type_offerings():
             type_zones.setdefault(t, set()).add(z)
-        od = self.ec2.on_demand_prices()
-        spot = {(t, z): p
-                for t, z, p in self.ec2.describe_spot_price_history()}
+        if self.pricing is not None:
+            od = self.pricing.on_demand_prices()
+            spot = {}
+            for t, zs in type_zones.items():
+                for z in zs:
+                    p = self.pricing.spot_price(t, z)
+                    if p is not None:
+                        spot[(t, z)] = p
+        else:  # no pricing provider wired (bare test harnesses)
+            od = self.ec2.on_demand_prices()
+            spot = {(t, z): p
+                    for t, z, p in self.ec2.describe_spot_price_history()}
         changed |= self.provider.update_offerings(OfferingsSnapshot(
             zones={z.name: z for z in self.ec2.zones},
             type_zones=type_zones,

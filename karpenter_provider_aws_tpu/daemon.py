@@ -30,7 +30,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from .manager import ControllerManager, FileLease
-from .operator import Operator
+from .operator import Operator, PreflightError
 from .options import Options
 
 log = logging.getLogger(__name__)
@@ -245,8 +245,15 @@ def main(argv=None) -> int:
         level=ns.log_level.upper(),
         format="%(asctime)s %(levelname)s %(name)s %(message)s")
     options = Options.parse(argv)
-    daemon = Daemon(options=options, metrics_port=ns.metrics_port,
-                    lease_path=ns.leader_elect_lease, solver=ns.solver,
-                    sidecar_address=ns.solver_sidecar_address)
+    try:
+        daemon = Daemon(options=options, metrics_port=ns.metrics_port,
+                        lease_path=ns.leader_elect_lease, solver=ns.solver,
+                        sidecar_address=ns.solver_sidecar_address)
+    except PreflightError as e:
+        # fail-fast boot contract (operator.go:111-115,218-227 analog):
+        # a dead/wedged cloud seam must exit with a clear error in
+        # seconds, not start controllers that spin against it
+        log.error("boot preflight failed: %s", e)
+        return 1
     daemon.run()
     return 0

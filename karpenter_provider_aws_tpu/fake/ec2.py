@@ -134,6 +134,12 @@ class CallLog:
         self.output_override = None
 
 
+class DryRunOperation(Exception):
+    """The EC2 'DryRunOperation' marker: request WOULD have succeeded.
+    The connectivity preflight treats exactly this error as healthy
+    (operator.go:222-225)."""
+
+
 class FakeEC2:
     """The fake cloud. All state mutations lock ``self._mu``."""
 
@@ -177,8 +183,35 @@ class FakeEC2:
         #: prefers it when present (launchtemplate.go:448-450)
         self.eks_service_ipv6_cidr: Optional[str] = None
 
+        # boot-preflight failure injection (operator.go:111-115,218-227
+        # analogs): a DOWN link errors immediately; a WEDGED link stalls
+        # the call — the two failure modes the preflight must fail fast on
+        self.link_down = False
+        self.link_stall_s = 0.0
+
         self._seed_default_network()
         self._seed_default_images()
+
+    # -- boot preflight seams ---------------------------------------------
+    def _link_gate(self) -> None:
+        if self.link_stall_s > 0:
+            time.sleep(self.link_stall_s)
+        if self.link_down:
+            raise ConnectionError("cloud API unreachable")
+
+    def imds_region(self) -> str:
+        """IMDS region discovery (operator.go:111-115): the instance
+        metadata endpoint names the region the control plane runs in."""
+        self._link_gate()
+        return self.region
+
+    def dry_run_describe_instance_types(self) -> None:
+        """EC2 connectivity preflight (operator.go:218-227): a dry-run
+        DescribeInstanceTypes. A healthy, authenticated link answers
+        with the DryRunOperation marker error — anything else (silence,
+        auth failure, transport error) is a dead cloud seam."""
+        self._link_gate()
+        raise DryRunOperation()
 
     # -- seeding -----------------------------------------------------------
     def _seed_default_network(self) -> None:

@@ -10,6 +10,7 @@ loop to a fixed point (the envtest-style test harness).
 
 from __future__ import annotations
 
+import threading
 import time
 
 from typing import Optional
@@ -51,12 +52,58 @@ from .utils.events import Recorder
 from .utils.metrics import Metrics
 
 
+class PreflightError(RuntimeError):
+    """Boot preflight failed: the cloud seam is dead or wedged. The
+    daemon exits with this error instead of starting controllers that
+    would silently spin against an unreachable cloud."""
+
+
+def _with_deadline(fn, deadline_s: float, what: str):
+    """Run ``fn`` with a hard wall-clock deadline. A wedged link BLOCKS
+    rather than erroring (the same failure mode as the accelerator
+    tunnel, solver/route.py), so an in-thread try/except cannot defend —
+    the call runs in a worker thread and an overrun raises PreflightError
+    while the daemon can still exit fast."""
+    out: dict = {}
+
+    def _run():
+        try:
+            out["v"] = fn()
+        except Exception as e:  # re-raised typed below
+            out["e"] = e
+
+    t = threading.Thread(target=_run, daemon=True, name="preflight")
+    t.start()
+    t.join(deadline_s)
+    if t.is_alive():
+        raise PreflightError(
+            f"{what} did not respond within {deadline_s:.0f}s "
+            "(cloud link wedged?)")
+    if "e" in out:
+        raise PreflightError(f"{what} failed: {out['e']}")
+    return out.get("v")
+
+
 class Operator:
+    def _check_ec2_connectivity(self) -> bool:
+        """CheckEC2Connectivity (operator.go:218-227): issue the dry-run
+        and require the DryRunOperation marker — any other outcome (a
+        normal return, an auth error, a transport error) is a dead seam."""
+        from .fake.ec2 import DryRunOperation
+        try:
+            self.ec2.dry_run_describe_instance_types()
+        except DryRunOperation:
+            return True
+        raise ConnectionError(
+            "dry-run DescribeInstanceTypes returned without the "
+            "DryRunOperation marker")
+
     def __init__(self, options: Optional[Options] = None,
                  ec2: Optional[FakeEC2] = None,
                  solver: Optional[Solver] = None,
                  consolidation_evaluator=None,
-                 clock=time.time):
+                 clock=time.time,
+                 preflight_deadline: float = 5.0):
         self.options = options or Options(
             cluster_name="cluster",
             cluster_endpoint="https://cluster.local",
@@ -68,6 +115,16 @@ class Operator:
         # under test clocks
         self.ec2 = ec2 or FakeEC2(now=clock)
         self.kube = FakeKube(now=clock)
+        # boot preflight (operator.go:111-115,218-227): discover the
+        # region from IMDS and prove the EC2 seam answers a dry-run —
+        # fail fast (< preflight_deadline) on a dead or wedged cloud
+        # link instead of starting controllers that would spin forever
+        self.region = _with_deadline(
+            self.ec2.imds_region, preflight_deadline,
+            "IMDS region discovery")
+        _with_deadline(
+            self._check_ec2_connectivity, preflight_deadline,
+            "EC2 connectivity preflight (dry-run DescribeInstanceTypes)")
         self.metrics = Metrics()
         self.recorder = Recorder(clock=clock)
 
@@ -84,7 +141,7 @@ class Operator:
         self.amis = AMIProvider(self.ec2, ssm=self.ssm)
         self.iam = FakeIAM()
         self.instance_profiles = InstanceProfileProvider(
-            self.options.cluster_name, iam=self.iam)
+            self.options.cluster_name, region=self.region, iam=self.iam)
         self.version = VersionProvider()
         self.sqs = SQSProvider(self.options.interruption_queue)
         # kube-dns discovery (operator.go:243-260,262-274): the reference
@@ -118,6 +175,7 @@ class Operator:
             self.metrics, clock=clock)
         self.state = ClusterState(self.kube, clock=clock)
 
+
         # controllers (controllers.go:63-101 + core)
         self.solver = solver or CPUSolver()
         if hasattr(self.solver, "metrics"):
@@ -147,7 +205,8 @@ class Operator:
             metrics=self.metrics, clock=clock, recorder=self.recorder)
         self.catalog_controller = CatalogController(
             self.ec2, self.instance_types, metrics=self.metrics,
-            unavailable_offerings=self.unavailable_offerings)
+            unavailable_offerings=self.unavailable_offerings,
+            pricing=self.pricing)
         self.pricing_controller = PricingController(self.pricing)
         self.nodeclass_hash = NodeClassHashController(self.kube)
         self.discovered_capacity = DiscoveredCapacityController(
@@ -185,8 +244,14 @@ class Operator:
 
         # boot-blocking hydration (operator.go:152-155): catalog + pricing
         t_boot = time.perf_counter()
-        self.catalog_controller.reconcile()
+        # pricing BEFORE catalog: the catalog prices offerings through
+        # the pricing provider, and until the first live spot refresh
+        # the provider serves the zone-agnostic static default — which
+        # must not mint spot offerings in zones with no spot market
+        # (local zones). Same settling order as the reference's boot
+        # (version/pricing hydrate synchronously, operator.go:152-155).
         self.pricing_controller.reconcile()
+        self.catalog_controller.reconcile()
         self.metrics.set_gauge("karpenter_cluster_state_unsynced_time_seconds",
                                time.perf_counter() - t_boot)
         self.metrics.set_gauge("karpenter_cluster_state_synced", 1.0)

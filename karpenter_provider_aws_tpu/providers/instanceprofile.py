@@ -93,17 +93,24 @@ class InstanceProfileProvider:
         if nodeclass.instance_profile:
             return  # user-managed: never reap
         name = self.profile_name(nodeclass)
-        try:
-            profile = self.iam.get_instance_profile(name)
-        except ProfileNotFoundError:
-            return
-        for role in list(profile.roles):
-            self.iam.remove_role_from_instance_profile(name, role)
-        try:
-            self.iam.delete_instance_profile(name)
-        except ProfileNotFoundError:
-            pass
-        self._cache.delete(nodeclass.metadata.uid)
+        # same serialization as create(): remove-roles-then-delete is
+        # check-then-act, and a concurrent create() re-adding a role
+        # between the two steps must not crash the reconcile
+        with self._mu:
+            try:
+                profile = self.iam.get_instance_profile(name)
+            except ProfileNotFoundError:
+                return
+            for role in list(profile.roles):
+                self.iam.remove_role_from_instance_profile(name, role)
+            try:
+                self.iam.delete_instance_profile(name)
+            except (ProfileNotFoundError, ValueError):
+                # NotFound: someone else deleted it; ValueError ("still
+                # has a role"): a create() raced us — it will be reaped
+                # on the next termination reconcile
+                pass
+            self._cache.delete(nodeclass.metadata.uid)
 
     # compatibility with callers that look profiles up by name ------------
     def get(self, name: str) -> Optional[str]:

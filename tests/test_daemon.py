@@ -208,3 +208,53 @@ class TestDaemon:
             time.sleep(0.2)
         assert d.manager.running          # took over after release
         d.shutdown()
+
+
+class TestBootPreflight:
+    """Fail-fast boot contract (operator.go:111-115,218-227 analogs): a
+    dead or WEDGED cloud seam must abort boot with a clear error well
+    inside 5s, never start controllers that spin against it."""
+
+    def test_healthy_boot_discovers_region(self):
+        from karpenter_provider_aws_tpu.operator import Operator
+        op = Operator()
+        assert op.region == "us-west-2"
+        assert op.instance_profiles.region == "us-west-2"
+
+    def test_dead_link_fails_fast(self):
+        import time
+
+        from karpenter_provider_aws_tpu.fake.ec2 import FakeEC2
+        from karpenter_provider_aws_tpu.operator import (Operator,
+                                                         PreflightError)
+        ec2 = FakeEC2()
+        ec2.link_down = True
+        t0 = time.perf_counter()
+        with pytest.raises(PreflightError, match="unreachable"):
+            Operator(ec2=ec2)
+        assert time.perf_counter() - t0 < 5.0
+
+    def test_wedged_link_fails_within_deadline(self):
+        import time
+
+        from karpenter_provider_aws_tpu.fake.ec2 import FakeEC2
+        from karpenter_provider_aws_tpu.operator import (Operator,
+                                                         PreflightError)
+        ec2 = FakeEC2()
+        ec2.link_stall_s = 30.0  # blocks, does not error — the wedge
+        t0 = time.perf_counter()
+        with pytest.raises(PreflightError, match="wedged"):
+            Operator(ec2=ec2, preflight_deadline=1.0)
+        assert time.perf_counter() - t0 < 5.0
+
+    def test_daemon_main_exits_nonzero_on_dead_cloud(self, monkeypatch,
+                                                     tmp_path):
+        from karpenter_provider_aws_tpu import daemon as daemon_mod
+        from karpenter_provider_aws_tpu.operator import PreflightError
+
+        def _boom(*a, **k):
+            raise PreflightError("EC2 connectivity preflight failed")
+
+        monkeypatch.setattr(daemon_mod, "Daemon", _boom)
+        rc = daemon_mod.main(["--cluster-name", "demo"])
+        assert rc == 1
