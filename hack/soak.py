@@ -16,10 +16,11 @@ Exit code 0 = clean soak. Usage: python hack/soak.py --minutes 3
 
 import argparse
 import random
+import os
 import sys
 import time
 
-sys.path.insert(0, ".")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def check_invariants(op, log):
@@ -43,6 +44,8 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--minutes", type=float, default=3.0)
     ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--out", default="",
+                    help="write a JSON soak report (CI artifact)")
     args = ap.parse_args()
 
     from karpenter_provider_aws_tpu.apis.objects import (EC2NodeClass,
@@ -108,13 +111,37 @@ def main():
             op.ec2.insufficient_capacity_pools.add(
                 (t.name, z.name, "spot"))
         op.run_until_settled(max_steps=30)
-        check_invariants(op, f"iteration {it}")
+        try:
+            check_invariants(op, f"iteration {it}")
+        except AssertionError as e:
+            # the CI artifact must exist precisely when the soak FAILS
+            if args.out:
+                import json
+                with open(args.out, "w") as f:
+                    json.dump({"clean": False, "iterations": it,
+                               "failure": str(e)}, f, indent=1)
+            raise
 
     pods = op.kube.list("Pod")
+    report = {
+        "iterations": it,
+        "minutes": args.minutes,
+        "seed": args.seed,
+        "nodes": len(op.kube.list("Node")),
+        "pods": len(pods),
+        "running_instances": sum(
+            1 for i in op.ec2.instances.values() if i.state == "running"),
+        "nodeclaims": len(op.kube.list("NodeClaim")),
+        "launch_templates": len(op.ec2.launch_templates),
+        "clean": True,
+    }
     print(f"soak clean: {it} iterations, "
-          f"{len(op.kube.list('Node'))} nodes, {len(pods)} pods, "
-          f"{sum(1 for i in op.ec2.instances.values() if i.state == 'running')}"
-          f" running instances")
+          f"{report['nodes']} nodes, {len(pods)} pods, "
+          f"{report['running_instances']} running instances")
+    if args.out:
+        import json
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=1)
 
 
 if __name__ == "__main__":

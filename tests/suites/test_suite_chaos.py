@@ -1,0 +1,174 @@
+"""Chaos suite (ref test/suites/chaos/suite_test.go): adversarial agents
+run against the full operator and a RUNAWAY DETECTOR asserts the node
+count stays bounded the whole time.
+
+The reference's chaos agent is a taint-adder controller: every node gets
+a NoExecute taint right after it joins, evicting its pods, so the
+provisioner keeps launching while consolidation keeps reaping — a buggy
+controller pair runs away to hundreds of nodes; the suite's node-count
+monitor requires < 35 the entire run (suite_test.go:72-143). The fake
+cluster models eviction with the operator's own drain helper
+(controllers/lifecycle.py drain_node_pods), so the loop shape is
+identical: taint -> drain -> pending pods -> provision -> empty tainted
+nodes -> consolidate.
+"""
+
+import pytest
+
+from karpenter_provider_aws_tpu.apis import labels as L
+from karpenter_provider_aws_tpu.apis.objects import Disruption, Taint
+from karpenter_provider_aws_tpu.apis.resources import Resources
+from karpenter_provider_aws_tpu.controllers.lifecycle import drain_node_pods
+from karpenter_provider_aws_tpu.fake.environment import make_pods
+from karpenter_provider_aws_tpu.operator import Operator
+from karpenter_provider_aws_tpu.providers.sqs import InterruptionMessage
+from karpenter_provider_aws_tpu.solver.tpu import TPUSolver
+from karpenter_provider_aws_tpu.utils import debug
+
+from .conftest import mk_cluster
+
+RUNAWAY_BOUND = 35  # the reference's node-count ceiling (suite_test.go:108)
+CHAOS_TAINT = Taint(key="test", value="true", effect="NoExecute")
+
+
+class FakeClock:
+    def __init__(self, t=1_000_000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+@pytest.fixture
+def clock():
+    return FakeClock()
+
+
+@pytest.fixture
+def op(clock):
+    return Operator(clock=clock, solver=TPUSolver(backend="numpy"))
+
+
+def mk_pool(op, disruption, limits=None):
+    pool, _nc = mk_cluster(op, pool_name="chaos",
+                           nodeclass_name="chaos-class",
+                           disruption=disruption, limits=limits)
+    return pool
+
+
+class TaintAdder:
+    """The reference's chaos controller (suite_test.go:146-176): taint
+    every node NoExecute after it joins and evict its pods."""
+
+    def __init__(self, op):
+        self.op = op
+        self.tainted = set()
+
+    def reconcile(self) -> int:
+        n = 0
+        for node in self.op.kube.list("Node"):
+            if node.metadata.name in self.tainted:
+                continue
+            node.taints.append(CHAOS_TAINT)
+            self.op.kube.update(node)
+            drain_node_pods(self.op.kube, node.metadata.name)
+            self.tainted.add(node.metadata.name)
+            n += 1
+        return n
+
+
+class NodeCountMonitor:
+    """startNodeCountMonitor analog + debug watcher: samples the node
+    count every step and keeps the high-water mark the assertion reads."""
+
+    def __init__(self, op):
+        self.op = op
+        self.max_nodes = 0
+        self.samples = []
+
+    def sample(self):
+        n = len(self.op.kube.list("Node"))
+        self.samples.append(n)
+        self.max_nodes = max(self.max_nodes, n)
+
+
+def run_chaos(op, clock, adder, monitor, steps=40, dt=10.0):
+    for _ in range(steps):
+        adder.reconcile()
+        op.step()
+        monitor.sample()
+        clock.advance(dt)
+
+
+class TestRunawayScaleUp:
+    # the two taint-chaos loops run ~30s each: nightly scale tier, not
+    # the per-PR fast tier (the reference runs chaos as its own suite)
+    pytestmark = pytest.mark.scale
+
+    def test_no_runaway_with_consolidation(self, op, clock):
+        """suite_test.go:74-110: consolidation WhenEmptyOrUnderutilized +
+        taint chaos must not run away past the node-count bound."""
+        mk_pool(op, Disruption(
+            consolidation_policy="WhenEmptyOrUnderutilized",
+            consolidate_after=0.0))
+        for p in make_pods(1, cpu="500m", memory="1Gi", prefix="chaos"):
+            op.kube.create(p)
+        watcher = debug.attach(op.kube)
+        adder = TaintAdder(op)
+        monitor = NodeCountMonitor(op)
+        run_chaos(op, clock, adder, monitor)
+        assert monitor.max_nodes < RUNAWAY_BOUND, monitor.samples
+        assert adder.tainted, "chaos agent never fired"
+        assert watcher.drain() > 0  # transitions observed by the watcher
+
+    def test_no_runaway_with_emptiness(self, op, clock):
+        """suite_test.go:112-142: WhenEmpty + 30s consolidateAfter."""
+        mk_pool(op, Disruption(consolidation_policy="WhenEmpty",
+                               consolidate_after=30.0))
+        for p in make_pods(1, cpu="500m", memory="1Gi", prefix="chaos2"):
+            op.kube.create(p)
+        adder = TaintAdder(op)
+        monitor = NodeCountMonitor(op)
+        run_chaos(op, clock, adder, monitor)
+        assert monitor.max_nodes < RUNAWAY_BOUND, monitor.samples
+
+    def test_runaway_capped_by_limits(self, op, clock):
+        """a pool limit stops unbounded launches even with an
+        unsatisfiable pod backlog (the budget backstop)."""
+        mk_pool(op, Disruption(), limits=Resources.parse({"cpu": "64"}))
+        for p in make_pods(2000, cpu="2", memory="4Gi", prefix="runaway"):
+            op.kube.create(p)
+        op.run_until_settled(max_steps=10, disrupt=False)
+        total_cpu = sum(
+            (c.resources_requested["cpu"]
+             for c in op.kube.list("NodeClaim")), 0)
+        assert total_cpu <= 64_000  # millicores
+        assert op.metrics.gauge("karpenter_scheduler_queue_depth") >= 0
+
+
+class TestInterruptionStorm:
+    def test_storm_converges(self, op, clock):
+        """a storm of spot interruptions against half the fleet; every
+        pod must end up bound again on replacement capacity."""
+        mk_pool(op, Disruption())
+        for p in make_pods(300, cpu="500m", memory="1Gi", prefix="storm",
+                           node_selector={L.CAPACITY_TYPE: "spot"}):
+            op.kube.create(p)
+        op.run_until_settled(disrupt=False)
+        claims = op.kube.list("NodeClaim")
+        victims = claims[: max(1, len(claims) // 2)]
+        for c in victims:
+            op.sqs.send(InterruptionMessage(
+                kind="spot_interruption",
+                instance_id=c.provider_id.split("/")[-1]))
+        for _ in range(25):
+            op.run_until_settled()
+            clock.advance(10)
+            if all(p.node_name for p in op.kube.list("Pod")):
+                break
+        assert all(p.node_name for p in op.kube.list("Pod"))
+        names = {c.name for c in op.kube.list("NodeClaim")}
+        assert not ({v.name for v in victims} & names)

@@ -107,3 +107,34 @@ class TestIPv6:
                    for ni in lt.network_interfaces or ())
         assert lt.metadata_options["http_protocol_ipv6"] == "disabled"
         assert all(not i.ipv6_address for i in op.ec2.describe_instances())
+
+
+class TestIPv6LaunchPath:
+    def test_primary_ipv6_interface_on_launch(self, op):
+        """ref 'static IPv6 prefix ... IPv6 as primary in the primary
+        network interface': the created launch template marks interface 0
+        primary-IPv6 with one address, and the instance launches with it."""
+        settle_one_pod(op)
+        insts = op.ec2.describe_instances()
+        assert insts
+        lt = op.ec2.launch_templates[insts[0].launch_template_name]
+        ni = lt.network_interfaces[0]
+        assert ni.get("primary_ipv6") is True
+        assert ni.get("ipv6_address_count") == 1
+
+    def test_ipv6_bottlerocket_dns_settings(self, op):
+        """bottlerocket TOML on an IPv6 cluster carries the discovered
+        IPv6 cluster-dns (the family-specific render of the same
+        kube-dns discovery AL2/nodeadm already assert)."""
+        from karpenter_provider_aws_tpu.apis.objects import (EC2NodeClass,
+                                                             SelectorTerm)
+        nc = EC2NodeClass("br-v6", ami_selector_terms=[
+            SelectorTerm(alias="bottlerocket@latest")])
+        mk_cluster(op, nodeclass=nc, nodeclass_name="br-v6")
+        for p in make_pods(1, cpu="500m", memory="1Gi", prefix="brv6"):
+            op.kube.create(p)
+        op.run_until_settled()
+        insts = op.ec2.describe_instances()
+        assert insts
+        lt = op.ec2.launch_templates[insts[0].launch_template_name]
+        assert op.kube_dns_ip in lt.user_data

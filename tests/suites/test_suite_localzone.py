@@ -98,3 +98,60 @@ class TestLocalZone:
         by_type = {s.zone: s.zone_type for s in infos}
         assert by_type[LZ] == "local-zone"
         assert by_type["us-west-2a"] == "availability-zone"
+
+
+class TestLocalZoneOptIn:
+    """The reference's local-zone posture: local zones are OPT-IN — a
+    default cluster must never drift into one; an explicit zone (or
+    zone-id) requirement at the pool or pod level opts in."""
+
+    def test_pod_level_zone_selector_opts_in(self, op):
+        """a default pool (no zone requirement): a pod-level zone
+        selector alone opts into the local zone — no pool change needed.
+        (Mixing constrained and unconstrained pods in one solve narrows
+        shared nodes by design — first-fit — so the unconstrained-pod
+        posture is pinned separately by
+        test_default_cluster_prefers_cheaper_azs.)"""
+        mk_cluster(op)
+        for p in make_pods(2, cpu="500m", memory="1Gi", prefix="lzsel",
+                           node_selector={L.ZONE: LZ}):
+            op.kube.create(p)
+        op.run_until_settled()
+        assert all(p.node_name for p in op.kube.list("Pod"))
+        insts = op.ec2.describe_instances()
+        assert insts and all(i.zone == LZ for i in insts)
+        for inst in insts:  # OD only: local zones have no spot market
+            assert inst.capacity_type == "on-demand"
+
+    def test_local_zone_capacity_counts_in_pool_limits(self, op):
+        """opted-in local-zone capacity is still governed by the pool's
+        cpu limits like any other capacity."""
+        from karpenter_provider_aws_tpu.apis.resources import Resources
+        local_zone_cluster(op, limits=Resources.parse({"cpu": "8"}))
+        for p in make_pods(40, cpu="1", memory="1Gi", prefix="lzlim"):
+            op.kube.create(p)
+        op.run_until_settled(max_steps=8)
+        total = sum((c.resources_requested["cpu"]
+                     for c in op.kube.list("NodeClaim")), 0)
+        assert total <= 8_000
+
+    def test_interruption_in_local_zone_replaces_in_local_zone(self, op):
+        """an interrupted local-zone node is replaced by capacity that
+        still satisfies the pool's local-zone constraint."""
+        from karpenter_provider_aws_tpu.providers.sqs import \
+            InterruptionMessage
+        local_zone_cluster(op)
+        for p in make_pods(5, cpu="500m", memory="1Gi", prefix="lzint"):
+            op.kube.create(p)
+        op.run_until_settled()
+        claim = next(c for c in op.kube.list("NodeClaim"))
+        op.sqs.send(InterruptionMessage(
+            kind="spot_interruption",
+            instance_id=claim.provider_id.split("/")[-1]))
+        for _ in range(10):
+            op.run_until_settled()
+            if all(p.node_name for p in op.kube.list("Pod")):
+                break
+        assert all(p.node_name for p in op.kube.list("Pod"))
+        for inst in op.ec2.describe_instances():
+            assert inst.zone == LZ

@@ -193,3 +193,199 @@ class TestVolumeLimits:
         for node in nodes:
             assert per_node.get(node.name, 0) <= \
                 node.capacity[ATTACHABLE_VOLUMES]
+
+
+class TestMultiPVC:
+    def test_multi_pvc_same_zone_lands_there(self, op):
+        """a pod mounting TWO pre-bound PVs in the same zone schedules
+        into that zone (the constraint set intersects cleanly)."""
+        mk_cluster(op)
+        for i, name in enumerate(("pv-a", "pv-b")):
+            pv = PersistentVolume(name, zone="us-west-2c")
+            pv.phase = "Bound"
+            op.kube.create(pv)
+            op.kube.create(PersistentVolumeClaim(f"claim-{i}",
+                                                 volume_name=name))
+        p = make_pods(1, cpu="500m", memory="1Gi", prefix="multi")[0]
+        p.volume_claims = ["claim-0", "claim-1"]
+        op.kube.create(p)
+        op.run_until_settled()
+        insts = op.ec2.describe_instances()
+        assert insts and all(i.zone == "us-west-2c" for i in insts)
+        assert all(q.node_name for q in op.kube.list("Pod"))
+
+    def test_multi_pvc_zone_conflict_unschedulable(self, op):
+        """ref storage matrix: a pod mounting PVs bound in DIFFERENT
+        zones is unsatisfiable — it must surface as unschedulable, not
+        land in either zone and strand a volume."""
+        mk_cluster(op)
+        for name, zone in (("pv-west-a", "us-west-2a"),
+                           ("pv-west-b", "us-west-2b")):
+            pv = PersistentVolume(name, zone=zone)
+            pv.phase = "Bound"
+            op.kube.create(pv)
+        op.kube.create(PersistentVolumeClaim("ca", volume_name="pv-west-a"))
+        op.kube.create(PersistentVolumeClaim("cb", volume_name="pv-west-b"))
+        p = make_pods(1, cpu="500m", memory="1Gi", prefix="conflict")[0]
+        p.volume_claims = ["ca", "cb"]
+        op.kube.create(p)
+        op.run_until_settled()
+        assert not op.ec2.describe_instances()
+        assert op.kube.get("Pod", p.metadata.name,
+                           p.metadata.namespace).node_name in (None, "")
+
+    def test_pre_bound_pv_with_topology_spread(self, op):
+        """pre-bound PV + zone topology spread on other pods: the
+        volume-pinned pod takes its PV's zone and the spread group still
+        balances across the remaining zones."""
+        from karpenter_provider_aws_tpu.apis.objects import \
+            TopologySpreadConstraint
+        mk_cluster(op)
+        pv = PersistentVolume("pv-pin", zone="us-west-2a")
+        pv.phase = "Bound"
+        op.kube.create(pv)
+        op.kube.create(PersistentVolumeClaim("pin", volume_name="pv-pin"))
+        pinned = make_pods(1, cpu="500m", memory="1Gi", prefix="pinned")[0]
+        pinned.volume_claims = ["pin"]
+        op.kube.create(pinned)
+        for p in make_pods(9, cpu="500m", memory="1Gi", prefix="spreadv",
+                           group="spreadv",
+                           topology_spread=[TopologySpreadConstraint(
+                               max_skew=1, topology_key=L.ZONE,
+                               when_unsatisfiable="DoNotSchedule",
+                               group="spreadv")]):
+            op.kube.create(p)
+        op.run_until_settled()
+        pods = op.kube.list("Pod")
+        assert all(p.node_name for p in pods)
+        node_zone = {n.metadata.name: n.metadata.labels[L.ZONE]
+                     for n in op.kube.list("Node")}
+        assert node_zone[op.kube.get(
+            "Pod", pinned.metadata.name,
+            pinned.metadata.namespace).node_name] == "us-west-2a"
+        counts = {}
+        for p in pods:
+            if p.metadata.name.startswith("spreadv"):
+                z = node_zone[p.node_name]
+                counts[z] = counts.get(z, 0) + 1
+        assert max(counts.values()) - min(counts.values()) <= 1
+
+
+class TestAttachmentLimitMatrix:
+    """Per-hypervisor EBS attachment-limit matrix (ref storage suite's
+    volume-limit scenarios): nitro nodes take 27 attachment slots, xen
+    nodes 39 (fake/catalog.py ebs_attachment_limit — one definition for
+    the scheduler AND the joined node), so identical volume-dense
+    workloads pack differently per family."""
+
+    @staticmethod
+    def _volume_dense_pods(op, n, claims_per_pod, prefix):
+        pods = []
+        for i in range(n):
+            names = []
+            for j in range(claims_per_pod):
+                cn = f"{prefix}-c{i:02d}-{j}"
+                op.kube.create(PersistentVolumeClaim(
+                    cn, storage_class="dyn"))
+                names.append(cn)
+            p = make_pods(1, cpu="100m", memory="256Mi",
+                          prefix=f"{prefix}{i:02d}")[0]
+            p.volume_claims = names
+            op.kube.create(p)
+            pods.append(p)
+        return pods
+
+    def _run_family(self, op, family, prefix):
+        op.kube.create(StorageClass("dyn"))
+        mk_cluster(op, pool_name=prefix + "-pool",
+                   nodeclass_name=prefix + "-class", requirements=[
+                       {"key": L.INSTANCE_FAMILY, "operator": "In",
+                        "values": [family]},
+                       # metal sizes carry the non-nitro 39-slot limit;
+                       # keep the matrix row pure per hypervisor
+                       {"key": L.INSTANCE_SIZE, "operator": "NotIn",
+                        "values": ["metal"]}])
+        # 8 pods x 5 claims = 40 volumes: > 39 (xen) > 27 (nitro)
+        self._volume_dense_pods(op, 8, 5, prefix)
+        op.run_until_settled()
+        per_node = {}
+        for p in op.kube.list("Pod"):
+            if p.metadata.name.startswith(prefix):
+                assert p.node_name, "volume-dense pod unbound"
+                per_node[p.node_name] = per_node.get(p.node_name, 0) + 5
+        return per_node
+
+    def test_nitro_family_packs_27(self, op):
+        per_node = self._run_family(op, "m5", "nit")
+        assert all(v <= 27 for v in per_node.values()), per_node
+        assert len(per_node) >= 2  # 40 volumes cannot fit one nitro node
+
+    def test_xen_family_packs_39(self, op):
+        per_node = self._run_family(op, "c4", "xen")
+        assert all(v <= 39 for v in per_node.values()), per_node
+        # distinguishes the 39-slot xen row from nitro's 27: one xen
+        # node must actually absorb more than a nitro node ever could
+        assert max(per_node.values()) > 27, per_node
+
+
+class TestStatefulWorkloads:
+    def test_disrupted_stateful_pod_returns_to_pv_zone(self, op):
+        """ref 'stateful workloads' scenarios: interrupting the node
+        under a volume-bound pod reschedules it into the SAME zone (the
+        volume cannot move)."""
+        from karpenter_provider_aws_tpu.providers.sqs import \
+            InterruptionMessage
+        mk_cluster(op)
+        pv = PersistentVolume("pv-sticky", zone="us-west-2b")
+        pv.phase = "Bound"
+        op.kube.create(pv)
+        op.kube.create(PersistentVolumeClaim("sticky",
+                                             volume_name="pv-sticky"))
+        p = pod_with_claim(op, "sticky", prefix="stateful")
+        op.run_until_settled()
+        claim = next(c for c in op.kube.list("NodeClaim"))
+        op.sqs.send(InterruptionMessage(
+            kind="spot_interruption",
+            instance_id=claim.provider_id.split("/")[-1]))
+        for _ in range(10):
+            op.run_until_settled()
+            pod = op.kube.get("Pod", p.metadata.name, p.metadata.namespace)
+            if pod.node_name and pod.node_name != claim.node_name:
+                break
+        pod = op.kube.get("Pod", p.metadata.name, p.metadata.namespace)
+        assert pod.node_name
+        node = op.kube.get("Node", pod.node_name)
+        assert node.metadata.labels[L.ZONE] == "us-west-2b"
+
+    def test_do_not_disrupt_blocks_voluntary_not_termination(self, op):
+        """a do-not-disrupt stateful pod blocks consolidation, but an
+        involuntary interruption still drains and replaces the node (ref
+        'should not block node deletion if stateful workload cannot be
+        drained' — involuntary paths win)."""
+        from karpenter_provider_aws_tpu.controllers.disruption import \
+            DO_NOT_DISRUPT_ANNOTATION
+        from karpenter_provider_aws_tpu.providers.sqs import \
+            InterruptionMessage
+        mk_cluster(op)
+        p = make_pods(1, cpu="500m", memory="1Gi", prefix="dnd")[0]
+        p.metadata.annotations[DO_NOT_DISRUPT_ANNOTATION] = "true"
+        op.kube.create(p)
+        op.run_until_settled()
+        claim = next(c for c in op.kube.list("NodeClaim"))
+        # voluntary path blocked
+        assert op.disruption.reconcile() is None
+        assert op.kube.get("NodeClaim", claim.metadata.name) is not None
+        # involuntary interruption still replaces the capacity
+        op.sqs.send(InterruptionMessage(
+            kind="spot_interruption",
+            instance_id=claim.provider_id.split("/")[-1]))
+        for _ in range(10):
+            op.run_until_settled()
+            pod = op.kube.get("Pod", p.metadata.name, p.metadata.namespace)
+            claims = {c.metadata.name for c in op.kube.list("NodeClaim")}
+            if pod.node_name and claim.metadata.name not in claims:
+                break
+        assert claim.metadata.name not in {
+            c.metadata.name for c in op.kube.list("NodeClaim")}
+        assert op.kube.get("Pod", p.metadata.name,
+                           p.metadata.namespace).node_name

@@ -6,8 +6,8 @@ scale envelopes, run against the fake cloud instead of EKS):
 - minValues scale-up: launch candidates respect requirement minValues
 - deprovisioning: consolidation / emptiness / expiration / drift, with
   all methods exercised in one cluster
-- chaos: interruption storm converges; runaway provisioning is capped by
-  NodePool limits
+- chaos moved to its own suite (tests/suites/test_suite_chaos.py), the
+  reference's dedicated chaos suite analog
 
 The TPU solver drives provisioning (the whole point of the rebuild); the
 reference's wall-clock envelope is 30m on real EKS — here the cluster is
@@ -28,7 +28,6 @@ from karpenter_provider_aws_tpu.apis.objects import (Disruption, EC2NodeClass,
 from karpenter_provider_aws_tpu.apis.requirements import Requirements
 from karpenter_provider_aws_tpu.fake.environment import make_pods
 from karpenter_provider_aws_tpu.operator import Operator
-from karpenter_provider_aws_tpu.providers.sqs import InterruptionMessage
 from karpenter_provider_aws_tpu.solver.tpu import TPUSolver
 
 
@@ -189,41 +188,3 @@ class TestDeprovisioningScale:
         assert after and not (after & before), "drifted fleet did not roll"
 
 
-class TestChaos:
-    def test_interruption_storm_converges(self, op, clock):
-        """chaos-suite analog: a storm of spot interruptions against half
-        the fleet; every pod must end up bound again on replacements."""
-        mk_cluster(op)
-        for p in make_pods(300, cpu="500m", memory="1Gi", prefix="storm",
-                           node_selector={L.CAPACITY_TYPE: "spot"}):
-            op.kube.create(p)
-        op.run_until_settled(disrupt=False)
-        claims = op.kube.list("NodeClaim")
-        victims = claims[: max(1, len(claims) // 2)]
-        for c in victims:
-            op.sqs.send(InterruptionMessage(
-                kind="spot_interruption",
-                instance_id=c.provider_id.split("/")[-1]))
-        for _ in range(25):
-            op.run_until_settled()
-            clock.advance(10)
-            if all(p.node_name for p in op.kube.list("Pod")):
-                break
-        assert all(p.node_name for p in op.kube.list("Pod"))
-        names = {c.name for c in op.kube.list("NodeClaim")}
-        assert not ({v.name for v in victims} & names)
-
-    def test_runaway_capped_by_limits(self, op, clock):
-        """chaos 'runaway' analog: a pool limit stops unbounded launches
-        even with an unsatisfiable pod backlog."""
-        from karpenter_provider_aws_tpu.apis.resources import Resources
-        mk_cluster(op, limits=Resources.parse({"cpu": "64"}))
-        for p in make_pods(2000, cpu="2", memory="4Gi", prefix="runaway"):
-            op.kube.create(p)
-        op.run_until_settled(max_steps=10, disrupt=False)
-        total_cpu = sum(
-            (c.resources_requested["cpu"] for c in op.kube.list("NodeClaim")),
-            0)
-        assert total_cpu <= 64_000  # millicores
-        # backlog reported unschedulable, not silently dropped
-        assert op.metrics.gauge("karpenter_scheduler_queue_depth") >= 0
