@@ -110,16 +110,18 @@ def main():
             z = rng.choice(op.ec2.zones)
             op.ec2.insufficient_capacity_pools.add(
                 (t.name, z.name, "spot"))
-        op.run_until_settled(max_steps=30)
         try:
+            op.run_until_settled(max_steps=30)
             check_invariants(op, f"iteration {it}")
-        except AssertionError as e:
-            # the CI artifact must exist precisely when the soak FAILS
+        except Exception as e:
+            # the CI artifact must exist precisely when the soak FAILS —
+            # for ANY failure mode, not just invariant assertions
             if args.out:
                 import json
                 with open(args.out, "w") as f:
                     json.dump({"clean": False, "iterations": it,
-                               "failure": str(e)}, f, indent=1)
+                               "failure": f"{type(e).__name__}: {e}"},
+                              f, indent=1)
             raise
 
     pods = op.kube.list("Pod")

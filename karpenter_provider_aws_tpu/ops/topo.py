@@ -112,7 +112,7 @@ def build_topo_encoding(enc: SnapshotEncoding, snapshot,
     # the oracle's zone universe: snapshot.zones if non-empty else offering
     # zones (solver/cpu.py::solve) — both are subsets of enc.zones
     if snapshot.zones:
-        universe = np.array([z in dict(snapshot.zones) for z in enc.zones])
+        universe = np.array([z in dict(snapshot.zones) for z in enc.zones], dtype=bool)
     else:
         universe = np.ones(Z, dtype=bool)
     min_mask = np.zeros((G, Z), dtype=bool)
@@ -129,7 +129,7 @@ def build_topo_encoding(enc: SnapshotEncoding, snapshot,
         # (not merged with pool/node), over the oracle universe
         zr = pod.scheduling_requirements().get(L.ZONE)
         min_mask[g.index] = universe & np.array(
-            [zr is None or zr.has(z) for z in enc.zones])
+            [zr is None or zr.has(z) for z in enc.zones], dtype=bool)
         if pod.scheduling_requirements().get(L.ZONE_ID) is not None \
                 and constrained:
             supported, reason = False, "zone-id requirement with topology"

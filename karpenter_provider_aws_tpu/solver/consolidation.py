@@ -19,9 +19,11 @@ Two encodings:
   evaluator stays correct for any input).
 
 Exactness discipline (same as solver/tpu.py): snapshots whose pods carry
-topology spread / pod-affinity constraints fall back to the sequential
-oracle; everything else is evaluated with int64 math bit-identical to the
-oracle's, so decisions never diverge
+topology spread / pod-affinity constraints leave the batched kernels and
+are served per-candidate by the TENSOR engine's topology path (the exact
+pour / device event kernel, solver/tpu.py) — never the sequential
+per-pod oracle; everything else is evaluated with int64 math
+bit-identical to the oracle's, so decisions never diverge
 (tests/test_consolidation_equivalence.py enforces equality).
 """
 
@@ -34,7 +36,7 @@ import numpy as np
 from ..controllers.disruption import ConsolidationEvaluator
 from ..models.encoding import canonical_pod_groups
 from ..solver.types import ExistingNode
-from .cpu import CPUSolver
+
 from .route import Router, routed
 from .types import SchedulingSnapshot, Solver
 
@@ -115,17 +117,39 @@ class _GroupTables:
 class TPUConsolidationEvaluator(ConsolidationEvaluator):
     def __init__(self, solver: Optional[Solver] = None,
                  backend: str = "auto"):
-        super().__init__(solver or CPUSolver())
         assert backend in ("auto", "jax", "numpy")
+        if solver is None:
+            # topology-bearing candidates leave the batched kernels (the
+            # exactness discipline below) but must NOT regress all the
+            # way to the sequential per-pod oracle: the tensor engine's
+            # topology pour/event kernel (solver/tpu.py) serves them with
+            # identical decisions, so mixed clusters keep the batched
+            # speedup on the per-candidate solves too
+            from .tpu import TPUSolver
+            solver = TPUSolver(backend=backend)
+        super().__init__(solver)
         self.backend = backend
         #: optional metrics registry (operator injects, as on TPUSolver)
-        self.metrics = None
+        self._metrics = None
         self._router = Router(name="consolidation")
         #: catalog-derived pre-screen tables, reused while the pools'
         #: resolved InstanceTypes lists are unchanged (instancetype
         #: provider returns the same cached list until a seqnum bump —
         #: instancetype.go:119-130 discipline)
         self._base_cache: Optional[Tuple[Tuple, dict]] = None
+
+    @property
+    def metrics(self):
+        return self._metrics
+
+    @metrics.setter
+    def metrics(self, m):
+        # forward to the inner solver: its oracle-fallback / slot-growth
+        # counters from topology-candidate solves must not go dark (the
+        # "fallbacks are never silent" contract, solver/tpu.py)
+        self._metrics = m
+        if hasattr(self.solver, "metrics"):
+            self.solver.metrics = m
 
     def _routed(self, bucket, host_fn, dev_fn):
         if self.backend == "numpy":
@@ -156,7 +180,8 @@ class TPUConsolidationEvaluator(ConsolidationEvaluator):
         batch_idx: List[int] = []
         for i, snap in enumerate(snapshots):
             if any(p.topology_spread or p.pod_affinity for p in snap.pods):
-                # oracle fallback (same discipline as TPUSolver)
+                # topology path: per-candidate solve on the tensor
+                # engine's pour/event kernel (decision-identical)
                 res = self.solver.solve(snap)
                 out[i] = not res.new_nodes and not res.unschedulable
             elif not snap.pods:

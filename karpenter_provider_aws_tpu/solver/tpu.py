@@ -185,14 +185,17 @@ class TPUSolver(Solver):
         # representative is authoritative for every member (the flag is
         # computed in the encoder's signature row bank — no group scan)
         topo = enc.topo_any
-        if not enc.types:
-            # T == 0 (e.g. consolidation's price-filtered deletion check
-            # empties every pool): no new nodes are possible, but pods may
-            # still land on existing nodes — the oracle handles the
-            # degenerate snapshot exactly and the device kernel cannot
-            # (zero-size type axis)
-            return self._oracle_fallback(snapshot, "empty-catalog")
         existing = sorted(snapshot.existing_nodes, key=lambda n: n.name)
+        # T == 0 (e.g. consolidation's price-filtered deletion check
+        # empties every pool): no new nodes are possible, but pods may
+        # still land on existing nodes. The HOST engines handle the
+        # zero-width type axis exactly (candidate rows are empty,
+        # existing-slot fills use concrete allocatable); only the device
+        # kernels need T > 0, so such a solve is pinned to the host twin
+        # below — including the topology pour, which keeps
+        # consolidation's topology-bearing deletion checks on the tensor
+        # engine instead of the sequential oracle.
+        host_only = not enc.types
         if topo:
             from ..ops.topo import build_topo_encoding
             tenc = build_topo_encoding(enc, snapshot, existing)
@@ -216,7 +219,7 @@ class TPUSolver(Solver):
                     self.metrics.inc(
                         "karpenter_solver_device_fallback_total",
                         labels={"reason": "group_cap"})
-            lowerable = not group_cap \
+            lowerable = not host_only and not group_cap \
                 and self._topo_lowerable(enc, tenc, existing)
             if self.backend == "numpy" or not lowerable:
                 takes, leftover, final = host_pour()
@@ -240,13 +243,14 @@ class TPUSolver(Solver):
                 return self._solve_core(snapshot, pod_groups=pod_groups)
             return self._decode(enc, existing, takes, leftover, final)
         ex_alloc, ex_used, ex_compat = self._encode_existing(enc, existing)
-        if len(enc.groups) > self.dev_max_groups:
-            # beyond the device group-scan cap: host engine only (the
-            # G-axis law, docs/solver-design.md) — never let router
-            # calibration compile a many-thousand-step scan. A latency
-            # or engine cliff must never be silent, even when requested
-            # via backend="jax"
-            if self.backend != "numpy":
+        if host_only or len(enc.groups) > self.dev_max_groups:
+            # zero-width type axis (host engines only), or beyond the
+            # device group-scan cap: host engine only (the G-axis law,
+            # docs/solver-design.md) — never let router calibration
+            # compile a many-thousand-step scan. A latency or engine
+            # cliff must never be silent, even when requested via
+            # backend="jax"
+            if self.backend != "numpy" and not host_only:
                 import logging
                 logging.getLogger(__name__).info(
                     "group count %d exceeds dev_max_groups=%d; serving "

@@ -222,9 +222,19 @@ def emitted():
     from karpenter_provider_aws_tpu.solver.types import SchedulingSnapshot
     tpu = TPUSolver(backend="numpy")
     tpu.metrics = op.metrics
-    # empty catalog -> oracle fallback
+    # unsupported topology shape (zone-id + spread) -> oracle fallback
+    # (an empty catalog no longer falls back: the host engines serve the
+    # zero-width type axis directly)
+    from karpenter_provider_aws_tpu.apis import labels as _L
+    from karpenter_provider_aws_tpu.apis.objects import \
+        TopologySpreadConstraint as _TSC
+    _fbp = make_pods(1, prefix="fb", group="fbg",
+                     node_selector={_L.ZONE_ID: "usw2-az1"},
+                     topology_spread=[_TSC(max_skew=1, topology_key=_L.ZONE,
+                                           group="fbg")])
     tpu.solve(SchedulingSnapshot(
-        pods=make_pods(1, prefix="fb"), nodepools=[], existing_nodes=[]))
+        pods=_fbp, nodepools=op.provisioner.build_snapshot([]).nodepools,
+        existing_nodes=[]))
     dead = TPUSolver(backend="jax")
     dead.metrics = op.metrics
     dead._router.alive = AliveCache(lambda: False)
