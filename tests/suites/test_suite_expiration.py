@@ -74,3 +74,70 @@ class TestExpiration:
             op.run_until_settled()
             clock.advance(600)
         assert {c.name for c in op.kube.list("NodeClaim")} == before
+
+    def test_expired_fleet_replaced_and_repacked(self, op, clock):
+        """ref 'should replace expired node with a single node and
+        schedule all pods': after expiry the replacement capacity holds
+        every pod (repacking may consolidate them onto fewer nodes)."""
+        mk_cluster(op, expire_after=1800.0)
+        pods = make_pods(12, cpu="250m", memory="512Mi", prefix="repack")
+        for p in pods:
+            op.kube.create(p)
+        op.run_until_settled()
+        before = {c.name for c in op.kube.list("NodeClaim")}
+        clock.advance(3600)
+        for _ in range(20):
+            op.run_until_settled()
+            clock.advance(60)
+            after = {c.name for c in op.kube.list("NodeClaim")}
+            if after and not (after & before) \
+                    and all(p.node_name for p in op.kube.list("Pod")):
+                break
+        assert all(p.node_name for p in op.kube.list("Pod"))
+        live = {c.name for c in op.kube.list("NodeClaim")}
+        assert live and not (live & before)
+
+    def test_do_not_disrupt_does_not_block_expiration(self, op, clock):
+        """expiration is FORCEFUL (not budgeted, not blocked by
+        do-not-disrupt — disruption.py _expire): a pod annotation that
+        blocks consolidation does not pin an expired node forever."""
+        from karpenter_provider_aws_tpu.controllers.disruption import \
+            DO_NOT_DISRUPT_ANNOTATION
+        mk_cluster(op, expire_after=600.0)
+        p = make_pods(1, cpu="500m", memory="1Gi", prefix="pinexp")[0]
+        p.metadata.annotations[DO_NOT_DISRUPT_ANNOTATION] = "true"
+        op.kube.create(p)
+        op.run_until_settled()
+        before = {c.name for c in op.kube.list("NodeClaim")}
+        clock.advance(1200)
+        for _ in range(15):
+            op.run_until_settled()
+            clock.advance(60)
+            if not ({c.name for c in op.kube.list("NodeClaim")} & before):
+                break
+        assert not ({c.name for c in op.kube.list("NodeClaim")} & before)
+
+    def test_staggered_ages_roll_only_the_expired(self, op, clock):
+        """two generations of capacity: only claims past expireAfter
+        roll; the younger generation stays."""
+        mk_cluster(op, expire_after=3600.0)
+        for p in make_pods(4, cpu="500m", memory="1Gi", prefix="gen1"):
+            op.kube.create(p)
+        op.run_until_settled()
+        gen1 = {c.name for c in op.kube.list("NodeClaim")}
+        clock.advance(1800)  # gen1 at 30m
+        for p in make_pods(4, cpu="8", memory="16Gi", prefix="gen2"):
+            op.kube.create(p)
+        op.run_until_settled()
+        gen2 = {c.name for c in op.kube.list("NodeClaim")} - gen1
+        assert gen2
+        clock.advance(2100)  # gen1 at ~65m (expired), gen2 at ~35m
+        for _ in range(15):
+            op.run_until_settled()
+            clock.advance(30)
+            live = {c.name for c in op.kube.list("NodeClaim")}
+            if not (live & gen1):
+                break
+        live = {c.name for c in op.kube.list("NodeClaim")}
+        assert not (live & gen1), "expired generation survived"
+        assert gen2 <= live, "young generation was disrupted"
