@@ -4,6 +4,8 @@ the end-to-end roll a drifted node goes through."""
 
 import pytest
 
+from karpenter_provider_aws_tpu.apis.objects import (Disruption,
+                                                     DisruptionBudget)
 from karpenter_provider_aws_tpu.fake.ec2 import (FakeImage, FakeSecurityGroup,
                                                  FakeSubnet, _new_id)
 from karpenter_provider_aws_tpu.fake.environment import make_pods
@@ -111,4 +113,104 @@ class TestDriftRoll:
                 break
         after = {c.name for c in op.kube.list("NodeClaim")}
         assert after and not (after & before), "drifted claim never rolled"
+        assert all(p.node_name for p in op.kube.list("Pod"))
+
+
+class TestDriftBudgets:
+    """ref drift suite budget scenarios (suite_test.go:101-346): drift is
+    a budgeted voluntary method — a fully-blocking budget pins drifted
+    nodes, a count budget meters the roll rate, and a reason-scoped
+    budget gates only its reason."""
+
+    def _drifted_fleet(self, op, n=4, disruption=None):
+        mk_cluster(op, disruption=disruption or Disruption())
+        for p in make_pods(n, cpu="225", memory="12Gi", prefix="db"):  # 1 pod/node
+            op.kube.create(p)
+        op.run_until_settled()
+        assert len(op.kube.list("NodeClaim")) >= n
+        roll_ami(op)
+        return {c.name for c in op.kube.list("NodeClaim")}
+
+    def test_fully_blocking_budget_prevents_drift_roll(self, op, clock):
+        before = self._drifted_fleet(op, disruption=Disruption(
+            budgets=[DisruptionBudget(nodes="0")]))
+        for _ in range(6):
+            op.run_until_settled()
+            clock.advance(60)
+        assert {c.name for c in op.kube.list("NodeClaim")} == before
+
+    def test_count_budget_meters_drift_roll(self, op, clock):
+        before = self._drifted_fleet(op, disruption=Disruption(
+            budgets=[DisruptionBudget(nodes="1")]))
+        remaining = set(before)
+        for _ in range(40):
+            held = set(remaining)
+            op.step()  # ONE reconcile round (run_until_settled is many)
+            clock.advance(60)
+            remaining = before & {c.name
+                                  for c in op.kube.list("NodeClaim")}
+            # metered: never more than one drifted node rolls per round
+            assert len(held - remaining) <= 1, (held, remaining)
+            if not remaining:
+                break
+        assert not remaining, "budgeted drift roll never completed"
+        assert all(p.node_name for p in op.kube.list("Pod"))
+
+    def test_drift_scoped_budget_does_not_block_other_reasons(self, op,
+                                                              clock):
+        """a budget with reasons=["drifted"] nodes:"0" blocks drift but
+        leaves emptiness free to reap an empty node."""
+        before = self._drifted_fleet(op, disruption=Disruption(
+            consolidation_policy="WhenEmpty", consolidate_after=0.0,
+            budgets=[DisruptionBudget(nodes="0", reasons=["drifted"])]))
+        # drift blocked: fleet unchanged across rounds
+        for _ in range(4):
+            op.run_until_settled()
+            clock.advance(60)
+        assert {c.name for c in op.kube.list("NodeClaim")} == before
+        # but an EMPTY node is still fair game for emptiness
+        for p in list(op.kube.list("Pod")):
+            op.kube.delete("Pod", p.name, namespace=p.metadata.namespace)
+        for _ in range(10):
+            op.run_until_settled()
+            clock.advance(60)
+            if not op.kube.list("NodeClaim"):
+                break
+        assert not op.kube.list("NodeClaim"), \
+            "emptiness was wrongly gated by the drift-scoped budget"
+
+
+class TestDriftReplacementSafety:
+    """ref suite_test.go:815-911 ('Failure' context): graceful drift is
+    replacement-first — if the replacement capacity never becomes ready,
+    the drifted node must NOT be terminated (capacity is never destroyed
+    ahead of its replacement)."""
+
+    def test_drifted_node_kept_while_replacement_never_registers(
+            self, op, clock):
+        mk_cluster(op)
+        for p in make_pods(2, cpu="225", memory="12Gi", prefix="keep"):
+            op.kube.create(p)
+        op.run_until_settled()
+        before = {c.name for c in op.kube.list("NodeClaim")}
+        roll_ami(op)
+        op.kubelet.pause()  # replacements launch but never join
+        for _ in range(6):
+            op.step()
+            clock.advance(60)
+        live = {c.name for c in op.kube.list("NodeClaim")}
+        assert before <= live, "drifted node terminated before its " \
+            "replacement registered"
+        # pods never went pending: still bound to the old nodes
+        assert all(p.node_name for p in op.kube.list("Pod"))
+        # once the replacement registers, the drifted fleet rolls
+        op.kubelet.resume()
+        for _ in range(15):
+            op.run_until_settled()
+            clock.advance(60)
+            live = {c.name for c in op.kube.list("NodeClaim")}
+            if live and not (live & before):
+                break
+        live = {c.name for c in op.kube.list("NodeClaim")}
+        assert live and not (live & before)
         assert all(p.node_name for p in op.kube.list("Pod"))
