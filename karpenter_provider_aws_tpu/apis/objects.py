@@ -174,7 +174,8 @@ class Pod(KubeObject):
                  phase: str = "Pending",
                  owner_kind: str = "",
                  scheduling_group: str = "",
-                 volume_claims: Sequence[str] = ()):
+                 volume_claims: Sequence[str] = (),
+                 ephemeral_volumes: Sequence[Tuple[str, str]] = ()):
         # sort identity, set eagerly: canonical grouping sorts millions
         # of pods by this key per solve — an instance attribute lets the
         # hot sort use operator.attrgetter (C speed) instead of a
@@ -195,6 +196,13 @@ class Pod(KubeObject):
         self.scheduling_group = scheduling_group  # identity for spread/affinity
         #: PVC names this pod mounts (spec.volumes[].persistentVolumeClaim)
         self.volume_claims = list(volume_claims)
+        #: generic ephemeral volumes (spec.volumes[].ephemeral): (volume
+        #: name, storage class). The PVC is OWNED by the pod and named
+        #: `<pod>-<volume>` (the k8s generic-ephemeral convention); the
+        #: kubelet creates it at bind time, and the provisioner's volume
+        #: resolution counts it toward attachment slots and applies its
+        #: class's allowed topologies before any PVC object exists.
+        self.ephemeral_volumes = [tuple(e) for e in ephemeral_volumes]
 
     def apply_volume_constraints(self, reqs: "Requirements",
                                  n_volumes: int) -> None:

@@ -113,27 +113,48 @@ class Provisioner:
         from ..apis.requirements import IN, Requirement, Requirements
         for pod in pods:
             claims = getattr(pod, "volume_claims", None)
-            if not claims:
+            ephemeral = getattr(pod, "ephemeral_volumes", None)
+            if not claims and not ephemeral:
                 continue
             terms = []
             n_volumes = 0
-            for claim_name in claims:
+
+            def _claim_constraints(pvc, fallback_class=""):
+                """One claim's zone terms (bound PV wins; else the
+                class's allowedTopologies)."""
+                if pvc is not None and pvc.bound:
+                    pv = self.kube.try_get("PersistentVolume",
+                                           pvc.volume_name)
+                    if pv is not None and pv.zone:
+                        terms.append(Requirement.new(L.ZONE, IN, [pv.zone]))
+                    return
+                sc_name = pvc.storage_class if pvc is not None \
+                    else fallback_class
+                sc = self.kube.try_get("StorageClass", sc_name) \
+                    if sc_name else None
+                if sc is not None and sc.allowed_topology_zones:
+                    terms.append(Requirement.new(
+                        L.ZONE, IN, list(sc.allowed_topology_zones)))
+
+            for claim_name in claims or ():
                 pvc = self.kube.try_get("PersistentVolumeClaim", claim_name,
                                         namespace=pod.metadata.namespace)
                 if pvc is None:
                     continue
                 n_volumes += 1
-                if pvc.bound:
-                    pv = self.kube.try_get("PersistentVolume",
-                                           pvc.volume_name)
-                    if pv is not None and pv.zone:
-                        terms.append(Requirement.new(L.ZONE, IN, [pv.zone]))
-                    continue
-                sc = self.kube.try_get("StorageClass", pvc.storage_class) \
-                    if pvc.storage_class else None
-                if sc is not None and sc.allowed_topology_zones:
-                    terms.append(Requirement.new(
-                        L.ZONE, IN, list(sc.allowed_topology_zones)))
+                _claim_constraints(pvc)
+            # generic ephemeral volumes: the PVC (`<pod>-<volume>`) is
+            # created by the kubelet at bind time, so an absent PVC does
+            # NOT skip the volume — it still takes an attachment slot and
+            # its class's allowed topologies apply (core
+            # volumetopology.go treats the templated claim the same way)
+            for vol_name, sc_name in ephemeral or ():
+                n_volumes += 1
+                pvc = self.kube.try_get(
+                    "PersistentVolumeClaim",
+                    f"{pod.metadata.name}-{vol_name}",
+                    namespace=pod.metadata.namespace)
+                _claim_constraints(pvc, fallback_class=sc_name)
             pod.apply_volume_constraints(Requirements(terms), n_volumes)
 
     def build_snapshot(self, pods: Sequence[Pod]) -> SchedulingSnapshot:

@@ -69,7 +69,36 @@ class FakeKubelet:
                             node.metadata.labels.get(L.NODEPOOL, "")})
         self._bind_nominated_pods()
         self._reap_terminated(nodes_by_pid)
+        self._reap_orphaned_ephemeral_pvcs()
         return joined
+
+    def _reap_orphaned_ephemeral_pvcs(self) -> None:
+        """The ownerRef cascade on generic ephemeral PVCs: a pod-owned
+        PVC (and its bound dynamic PV — Delete reclaim) is garbage-
+        collected once the owning pod is gone. Without this a recreated
+        same-named pod with a different volume spec would inherit the
+        stale claim and be pinned to the old zone/class."""
+        from ..fake.kube import NotFound
+        for pvc in list(self.kube.list("PersistentVolumeClaim")):
+            for ref in pvc.metadata.owner_refs:
+                parts = ref.split("/")
+                if len(parts) != 3 or parts[0] != "Pod":
+                    continue
+                _, ns, name = parts
+                if self.kube.try_get("Pod", name, namespace=ns) is None:
+                    if pvc.volume_name:
+                        try:
+                            self.kube.delete("PersistentVolume",
+                                             pvc.volume_name)
+                        except NotFound:
+                            pass
+                    try:
+                        self.kube.delete("PersistentVolumeClaim",
+                                         pvc.metadata.name,
+                                         namespace=pvc.metadata.namespace)
+                    except NotFound:
+                        pass
+                    break
 
     def _make_node(self, inst, claim) -> Node:
         from ..apis.resources import Resources
@@ -131,13 +160,30 @@ class FakeKubelet:
     def _bind_volumes(self, pod, node_name: str) -> None:
         """Dynamic provisioning: unbound PVCs bind to a fresh PV in the
         pod's zone once the pod lands (WaitForFirstConsumer semantics —
-        the storage suite's dynamic-volume specs)."""
-        if not getattr(pod, "volume_claims", None):
+        the storage suite's dynamic-volume specs). Generic ephemeral
+        volumes create their pod-owned `<pod>-<volume>` PVC here first
+        (the k8s ephemeral-controller analog), then bind the same way."""
+        ephemeral = getattr(pod, "ephemeral_volumes", None) or ()
+        if not getattr(pod, "volume_claims", None) and not ephemeral:
             return
-        from ..apis.objects import PersistentVolume
+        from ..apis.objects import PersistentVolume, PersistentVolumeClaim
         node = self.kube.try_get("Node", node_name)
         zone = node.metadata.labels.get(L.ZONE, "") if node else ""
-        for claim_name in pod.volume_claims:
+        claim_names = list(pod.volume_claims)
+        for vol_name, sc_name in ephemeral:
+            cn = f"{pod.metadata.name}-{vol_name}"
+            if self.kube.try_get("PersistentVolumeClaim", cn,
+                                 namespace=pod.metadata.namespace) is None:
+                pvc = PersistentVolumeClaim(
+                    cn, namespace=pod.metadata.namespace,
+                    storage_class=sc_name)
+                # pod-owned: the GC sweep below reaps it with the pod
+                # (the k8s ownerRef cascade on generic ephemeral PVCs)
+                pvc.metadata.owner_refs.append(
+                    f"Pod/{pod.metadata.namespace}/{pod.metadata.name}")
+                self.kube.create(pvc)
+            claim_names.append(cn)
+        for claim_name in claim_names:
             pvc = self.kube.try_get("PersistentVolumeClaim", claim_name,
                                     namespace=pod.metadata.namespace)
             if pvc is None or pvc.bound:

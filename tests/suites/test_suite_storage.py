@@ -4,6 +4,8 @@ topologies, dynamic (WaitForFirstConsumer) provisioning, and per-node
 EBS volume limits."""
 
 
+import pytest
+
 from karpenter_provider_aws_tpu.apis import labels as L
 from karpenter_provider_aws_tpu.apis.objects import (PersistentVolume,
                                                      PersistentVolumeClaim,
@@ -389,3 +391,98 @@ class TestStatefulWorkloads:
             c.metadata.name for c in op.kube.list("NodeClaim")}
         assert op.kube.get("Pod", p.metadata.name,
                            p.metadata.namespace).node_name
+
+
+class TestGenericEphemeralVolumes:
+    """ref storage suite: 'should run a pod with a generic ephemeral
+    volume' in both the Static and Dynamic contexts. The PVC is
+    pod-owned (`<pod>-<volume>`), created at bind time, and its slot +
+    class topologies constrain scheduling BEFORE it exists."""
+
+    def test_dynamic_ephemeral_volume(self, op):
+        op.kube.create(StorageClass("eph-sc"))
+        mk_cluster(op)
+        p = make_pods(1, cpu="500m", memory="1Gi", prefix="eph")[0]
+        p.ephemeral_volumes = [("scratch", "eph-sc")]
+        op.kube.create(p)
+        op.run_until_settled()
+        pod = op.kube.get("Pod", p.metadata.name, p.metadata.namespace)
+        assert pod.node_name
+        pvc = op.kube.get("PersistentVolumeClaim",
+                          f"{p.metadata.name}-scratch",
+                          p.metadata.namespace)
+        assert pvc.bound, "ephemeral PVC not created+bound at bind time"
+        pv = op.kube.get("PersistentVolume", pvc.volume_name)
+        node = op.kube.get("Node", pod.node_name)
+        assert pv.zone == node.metadata.labels[L.ZONE]
+
+    def test_ephemeral_volume_respects_allowed_topologies(self, op):
+        op.kube.create(StorageClass(
+            "eph-zonal", allowed_topology_zones=["us-west-2c"]))
+        mk_cluster(op)
+        p = make_pods(1, cpu="500m", memory="1Gi", prefix="ephz")[0]
+        p.ephemeral_volumes = [("data", "eph-zonal")]
+        op.kube.create(p)
+        op.run_until_settled()
+        insts = op.ec2.describe_instances()
+        assert insts and all(i.zone == "us-west-2c" for i in insts)
+
+    def test_ephemeral_volumes_count_attachment_slots(self, op):
+        """8 pods x 5 ephemeral volumes on a nitro-only pool: the 27-slot
+        limit splits them across nodes before any PVC exists."""
+        op.kube.create(StorageClass("eph-sc2"))
+        mk_cluster(op, pool_name="ephlim", nodeclass_name="ephlim-class",
+                   requirements=[
+                       {"key": L.INSTANCE_FAMILY, "operator": "In",
+                        "values": ["m5"]},
+                       {"key": L.INSTANCE_SIZE, "operator": "NotIn",
+                        "values": ["metal"]}])
+        for i in range(8):
+            p = make_pods(1, cpu="100m", memory="256Mi",
+                          prefix=f"ephl{i:02d}")[0]
+            p.ephemeral_volumes = [(f"v{j}", "eph-sc2") for j in range(5)]
+            op.kube.create(p)
+        op.run_until_settled()
+        per_node = {}
+        for p in op.kube.list("Pod"):
+            assert p.node_name
+            per_node[p.node_name] = per_node.get(p.node_name, 0) + 5
+        assert all(v <= 27 for v in per_node.values()), per_node
+        assert len(per_node) >= 2
+
+    def test_ephemeral_pvc_reaped_with_its_pod(self, op):
+        """ownerRef cascade: deleting the pod reaps its ephemeral PVC +
+        bound PV, so a recreated same-named pod with a different class
+        is NOT pinned by the stale claim."""
+        op.kube.create(StorageClass("eph-a"))
+        op.kube.create(StorageClass(
+            "eph-b", allowed_topology_zones=["us-west-2b"]))
+        mk_cluster(op)
+        p = make_pods(1, cpu="500m", memory="1Gi", prefix="ephgc")[0]
+        p.ephemeral_volumes = [("scratch", "eph-a")]
+        op.kube.create(p)
+        op.run_until_settled()
+        cn = f"{p.metadata.name}-scratch"
+        pvc = op.kube.get("PersistentVolumeClaim", cn, p.metadata.namespace)
+        pv_name = pvc.volume_name
+        op.kube.delete("Pod", p.metadata.name,
+                       namespace=p.metadata.namespace)
+        op.run_until_settled()
+        from karpenter_provider_aws_tpu.fake.kube import NotFound
+        with pytest.raises(NotFound):
+            op.kube.get("PersistentVolumeClaim", cn, p.metadata.namespace)
+        with pytest.raises(NotFound):
+            op.kube.get("PersistentVolume", pv_name)
+        # a recreated same-named pod with a DIFFERENT class follows the
+        # new class's topology, not the old claim's zone
+        p2 = make_pods(1, cpu="500m", memory="1Gi", prefix="ephgc2")[0]
+        p2.metadata.name = p.metadata.name
+        p2._nskey = (p2.metadata.namespace, p2.metadata.name)
+        p2._full_name = f"{p2.metadata.namespace}/{p2.metadata.name}"
+        p2.ephemeral_volumes = [("scratch", "eph-b")]
+        op.kube.create(p2)
+        op.run_until_settled()
+        pod = op.kube.get("Pod", p2.metadata.name, p2.metadata.namespace)
+        assert pod.node_name
+        node = op.kube.get("Node", pod.node_name)
+        assert node.metadata.labels[L.ZONE] == "us-west-2b"
