@@ -89,8 +89,17 @@ def main():
                     PodAffinityTerm(topology_key=L.ZONE,
                                     group=f"soak{it:04d}", anti=True,
                                     required=False)])
+            ephemeral = None
+            if 0.33 <= shape < 0.45:  # volume churn (storage paths)
+                from karpenter_provider_aws_tpu.apis.objects import \
+                    StorageClass
+                if op.kube.try_get("StorageClass", "soak-sc") is None:
+                    op.kube.create(StorageClass("soak-sc"))
+                ephemeral = [("data", "soak-sc")]
             for p in make_pods(n, cpu=cpu, memory="1Gi",
                                prefix=f"soak{it:04d}", **kw):
+                if ephemeral:
+                    p.ephemeral_volumes = list(ephemeral)
                 op.kube.create(p)
         elif action < 0.75:  # scale down
             pods = op.kube.list("Pod")

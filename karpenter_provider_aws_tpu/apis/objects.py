@@ -51,7 +51,7 @@ class ObjectMeta:
     deletion_timestamp: Optional[float] = None
     finalizers: List[str] = field(default_factory=list)
     resource_version: int = 0
-    owner_refs: List[str] = field(default_factory=list)  # "kind/name" strings
+    owner_refs: List[str] = field(default_factory=list)  # "Kind/ns/name/uid" refs (ephemeral PVCs; UID-matched like k8s ownerRefs)
 
     def __post_init__(self):
         if not self.uid:

@@ -82,10 +82,12 @@ class FakeKubelet:
         for pvc in list(self.kube.list("PersistentVolumeClaim")):
             for ref in pvc.metadata.owner_refs:
                 parts = ref.split("/")
-                if len(parts) != 3 or parts[0] != "Pod":
+                if len(parts) != 4 or parts[0] != "Pod":
                     continue
-                _, ns, name = parts
-                if self.kube.try_get("Pod", name, namespace=ns) is None:
+                _, ns, name, uid = parts
+                owner = self.kube.try_get("Pod", name, namespace=ns)
+                # UID match: a recreated same-named pod is NOT the owner
+                if owner is None or owner.metadata.uid != uid:
                     if pvc.volume_name:
                         try:
                             self.kube.delete("PersistentVolume",
@@ -172,16 +174,32 @@ class FakeKubelet:
         claim_names = list(pod.volume_claims)
         for vol_name, sc_name in ephemeral:
             cn = f"{pod.metadata.name}-{vol_name}"
-            if self.kube.try_get("PersistentVolumeClaim", cn,
-                                 namespace=pod.metadata.namespace) is None:
+            owner_ref = (f"Pod/{pod.metadata.namespace}/"
+                         f"{pod.metadata.name}/{pod.metadata.uid}")
+            existing = self.kube.try_get(
+                "PersistentVolumeClaim", cn,
+                namespace=pod.metadata.namespace)
+            if existing is None:
                 pvc = PersistentVolumeClaim(
                     cn, namespace=pod.metadata.namespace,
                     storage_class=sc_name)
-                # pod-owned: the GC sweep below reaps it with the pod
-                # (the k8s ownerRef cascade on generic ephemeral PVCs)
-                pvc.metadata.owner_refs.append(
-                    f"Pod/{pod.metadata.namespace}/{pod.metadata.name}")
+                # pod-owned BY UID: the GC sweep below reaps it with the
+                # pod (the k8s ownerRef cascade on generic ephemeral
+                # PVCs), and a recreated same-named pod never matches
+                pvc.metadata.owner_refs.append(owner_ref)
                 self.kube.create(pvc)
+            elif owner_ref not in existing.metadata.owner_refs:
+                # claim-name collision with a claim this pod does NOT
+                # own (e.g. pods 'a'/'b-data' vs 'a-b'/'data'): real
+                # k8s's ephemeral controller refuses to adopt — never
+                # bind someone else's volume (its owner's deletion
+                # would reap the PV out from under us)
+                import logging
+                logging.getLogger(__name__).warning(
+                    "ephemeral volume %s of pod %s collides with a "
+                    "claim owned elsewhere; not adopting", cn,
+                    pod.full_name())
+                continue
             claim_names.append(cn)
         for claim_name in claim_names:
             pvc = self.kube.try_get("PersistentVolumeClaim", claim_name,

@@ -475,11 +475,12 @@ class TestGenericEphemeralVolumes:
             op.kube.get("PersistentVolume", pv_name)
         # a recreated same-named pod with a DIFFERENT class follows the
         # new class's topology, not the old claim's zone
-        p2 = make_pods(1, cpu="500m", memory="1Gi", prefix="ephgc2")[0]
-        p2.metadata.name = p.metadata.name
-        p2._nskey = (p2.metadata.namespace, p2.metadata.name)
-        p2._full_name = f"{p2.metadata.namespace}/{p2.metadata.name}"
-        p2.ephemeral_volumes = [("scratch", "eph-b")]
+        from karpenter_provider_aws_tpu.apis.objects import Pod
+        from karpenter_provider_aws_tpu.apis.resources import Resources
+        p2 = Pod(p.metadata.name,
+                 requests=Resources.parse({"cpu": "500m",
+                                           "memory": "1Gi"}),
+                 ephemeral_volumes=[("scratch", "eph-b")])
         op.kube.create(p2)
         op.run_until_settled()
         pod = op.kube.get("Pod", p2.metadata.name, p2.metadata.namespace)
