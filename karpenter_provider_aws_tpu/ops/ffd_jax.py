@@ -329,6 +329,212 @@ def _pool_budget_jax(limit: jax.Array, used: jax.Array, R: jax.Array) -> jax.Arr
 
 
 # ---------------------------------------------------------------------------
+# Pruned scan: the device G-axis kernel.
+#
+# The base step pays O(N*T*D) per group for the full [N, T] candidate/
+# headroom pass — at the 10k-signature envelope that is ~2e11 ops per
+# solve, which is why high-G solves route to the host engine. This
+# variant applies the host fast path's insight (ops/ffd.py
+# _fill_group_fast) in data-parallel form:
+#
+# - the carry keeps a per-slot capacity UPPER BOUND ``cap_hint`` [N, D]
+#   (max allocatable over the slot's candidate types at open; stale-high
+#   after narrowing — safe, exactly like the host's NodeState.cap_hint),
+#   so a cheap O(N*D) bound pass proves most slots full for this group;
+# - EXACT candidate masks + headroom are computed only for the FIRST S
+#   bound-positive open slots in slot order ([S, T] gather) — FFD fills
+#   in slot order, so those are the only slots the oracle could touch
+#   unless they all fill;
+# - if the group still has pods left after those S slots AND more
+#   bound-positive slots existed beyond them, the step sets a BAIL flag:
+#   the caller discards the solve and re-runs on the bit-identical host
+#   twin (the TopoKernelBail discipline). Decisions are therefore always
+#   oracle-identical — the flag marks exactly the inputs where pruning
+#   could have mattered.
+#
+# Per-step cost drops to O(N*D + S*T*D + P*T*D); compile cost stays O(1)
+# in G (one scan body). Scope guards (enforced by the caller): no
+# minValues floors, single device (the mesh path keeps the base kernel).
+# ---------------------------------------------------------------------------
+
+
+class CarryP(NamedTuple):
+    used: jax.Array       # [N, D]
+    types: jax.Array      # [N, T]
+    zones: jax.Array      # [N, Z]
+    ct: jax.Array         # [N, C]
+    pool: jax.Array       # [N] int32 (-1 free, -2 existing)
+    alive: jax.Array      # [N] bool
+    num_nodes: jax.Array  # scalar int32
+    pool_used: jax.Array  # [P, D]
+    cap_hint: jax.Array   # [N, D] int64 stale-high capacity bound
+    bail: jax.Array       # scalar bool — pruning was insufficient
+
+
+def pruned_group_step(inp: KernelInputs, carry: CarryP, xs, *, P, E, N, S,
+                      slot_idx):
+    R, n, F, agz, agc, admit, daemon, ex_compat = xs
+    T, D = inp.A.shape
+    Z = inp.agz.shape[1]
+    C = inp.agc.shape[1]
+    n_rem = n
+
+    # ---- bound pass over every slot: O(N*D) -----------------------
+    pool_clipped = jnp.clip(carry.pool, 0, P - 1)
+    adm_open = jnp.where(carry.pool >= 0, admit[pool_clipped], False)
+    Rsafe = jnp.where(R > 0, R, 1)
+    qb = (carry.cap_hint - carry.used) // Rsafe[None, :]
+    qb = jnp.where((R > 0)[None, :], qb, BIG)
+    k_bound = jnp.clip(qb.min(axis=-1), 0, BIG)
+    open_cand = adm_open & (k_bound > 0) & carry.alive
+    if E:
+        open_cand = open_cand.at[:E].set(False)
+    n_pos = open_cand.sum()
+
+    # ---- first S bound-positive open slots, slot order ------------
+    sel_rank = jnp.where(open_cand, slot_idx, N + 1)
+    sel = jnp.argsort(sel_rank)[:S]                       # [S] slots
+    sel_valid = open_cand[sel]
+
+    # ---- exact candidates + headroom for the selected: O(S*T*D) ---
+    types_s = carry.types[sel]
+    zc_s = ((carry.zones[sel] & agz[None, :])[:, :, None]
+            & (carry.ct[sel] & agc[None, :])[:, None, :]).reshape(S, Z * C)
+    off_ok_s = (zc_s.astype(jnp.int32)
+                @ inp.avail_zc.T.astype(jnp.int32)) > 0
+    cand_s = types_s & F[None, :] & off_ok_s & sel_valid[:, None]
+    hr_s = _headroom_matrix(inp.A, carry.used[sel], R)    # [S, T]
+    k_exact_s = jnp.where(cand_s, hr_s, 0).max(axis=1)
+
+    k = jnp.zeros(N, jnp.int64).at[sel].set(
+        jnp.where(sel_valid, k_exact_s, 0))
+    if E:
+        ex_ok = carry.alive[:E] & ex_compat
+        k_ex = jnp.where(ex_ok,
+                         _headroom_vec(inp.ex_alloc, carry.used[:E], R), 0)
+        k = k.at[:E].set(k_ex)
+
+    # ---- pool limit budgets (same order as the base kernel) -------
+    pool_used = carry.pool_used
+    for pi in range(P):
+        has_limit = (inp.pool_limit[pi] >= 0).any()
+        budget = _pool_budget_jax(inp.pool_limit[pi], pool_used[pi], R)
+        rows = carry.pool == pi
+        kp = jnp.where(rows, k, 0)
+        cum = _cumsum(kp) - kp
+        capped = jnp.clip(jnp.minimum(kp, budget - cum), 0, None)
+        k = jnp.where(rows & has_limit, capped, k)
+
+    # ---- greedy prefix fill ---------------------------------------
+    cum = _cumsum(k) - k
+    take = jnp.clip(n_rem - cum, 0, k)
+    n_rem = n_rem - take.sum()
+
+    # pruning was insufficient: pods remain AND an unselected bound-
+    # positive open slot existed (FFD would have consulted it next)
+    bail = carry.bail | ((n_pos > S) & (n_rem > 0))
+
+    used = carry.used + take[:, None] * R[None, :]
+    # narrowing — only slots that took pods narrow, and every open
+    # taker is in the selection (take > 0 needs k > 0)
+    took_s = (take[sel] > 0) & sel_valid
+    fit_s = (used[sel][:, None, :] <= inp.A[None, :, :]).all(axis=-1)
+    new_types_s = cand_s & fit_s
+    types = carry.types.at[sel].set(jnp.where(
+        took_s[:, None], new_types_s, carry.types[sel]))
+    zones = carry.zones.at[sel].set(jnp.where(
+        took_s[:, None], carry.zones[sel] & agz[None, :],
+        carry.zones[sel]))
+    ct = carry.ct.at[sel].set(jnp.where(
+        took_s[:, None], carry.ct[sel] & agc[None, :], carry.ct[sel]))
+    # cap_hint stays stale-high for narrowed slots (host discipline)
+    take_by_pool = jax.ops.segment_sum(
+        take, pool_clipped * (carry.pool >= 0) + (carry.pool < 0) * P,
+        num_segments=P + 1)[:P]
+    pool_used = pool_used + take_by_pool[:, None] * R[None, :]
+
+    # ---- new nodes pool-by-pool (base math + cap_hint rows) -------
+    pool_arr = carry.pool
+    alive = carry.alive
+    num_nodes = carry.num_nodes
+    cap_hint = carry.cap_hint
+    for pi in range(P):
+        agz_p = agz & inp.pool_agz[pi]
+        agc_p = agc & inp.pool_agc[pi]
+        zc_p = (agz_p[:, None] & agc_p[None, :]).reshape(Z * C)
+        off_p = (inp.avail_zc & zc_p[None, :]).any(axis=1)
+        cand_new = F & inp.pool_types[pi] & off_p
+        hr = _headroom_vec(inp.A, daemon[pi][None, :], R)
+        hr = jnp.where(cand_new, hr, 0)
+        cap = hr.max()
+        budget = _pool_budget_jax(inp.pool_limit[pi], pool_used[pi], R)
+        can_place = jnp.where(
+            admit[pi] & (cap >= 1), jnp.minimum(n_rem, budget), 0)
+        q = jnp.where(can_place > 0, -(-can_place // jnp.maximum(cap, 1)), 0)
+        free_slots = N - E - num_nodes
+        q = jnp.minimum(q, free_slots)
+        placed = jnp.minimum(can_place, q * cap)
+        start = E + num_nodes
+        is_new = (slot_idx >= start) & (slot_idx < start + q)
+        offset = slot_idx - start
+        m_slot = jnp.where(
+            is_new,
+            jnp.where(offset == q - 1, placed - cap * (q - 1), cap), 0)
+        take = take + m_slot
+        used = used + m_slot[:, None] * R[None, :] \
+            + is_new[:, None] * daemon[pi][None, :]
+        hr_fit = (hr[None, :] >= m_slot[:, None]) & cand_new[None, :]
+        types = jnp.where(is_new[:, None], hr_fit, types)
+        zones = jnp.where(is_new[:, None], agz_p[None, :], zones)
+        ct = jnp.where(is_new[:, None], agc_p[None, :], ct)
+        # capacity bound for the opened slots: max allocatable over the
+        # pool's candidate set (a superset of the kept mask — stale-high
+        # safe, and O(T*D) once per pool instead of per slot)
+        cap_row = jnp.where(cand_new[:, None], inp.A,
+                            jnp.int64(0)).max(axis=0)
+        cap_hint = jnp.where(is_new[:, None], cap_row[None, :], cap_hint)
+        pool_arr = jnp.where(is_new, pi, pool_arr)
+        alive = alive | is_new
+        num_nodes = num_nodes + q.astype(jnp.int32)
+        pool_used = pool_used.at[pi].add(placed * R)
+        n_rem = n_rem - placed
+
+    new_carry = CarryP(used=used, types=types, zones=zones, ct=ct,
+                       pool=pool_arr, alive=alive, num_nodes=num_nodes,
+                       pool_used=pool_used, cap_hint=cap_hint, bail=bail)
+    return new_carry, (take, n_rem)
+
+
+def _solve_pruned(inp: KernelInputs, n_max: int, E: int, P: int, S: int):
+    T, D = inp.A.shape
+    Z = inp.agz.shape[1]
+    C = inp.agc.shape[1]
+    N = E + n_max
+    carry0 = CarryP(
+        used=jnp.zeros((N, D), jnp.int64).at[:E].set(inp.ex_used0),
+        types=jnp.zeros((N, T), bool),
+        zones=jnp.zeros((N, Z), bool),
+        ct=jnp.zeros((N, C), bool),
+        pool=jnp.full((N,), -1, jnp.int32).at[:E].set(-2),
+        alive=jnp.zeros((N,), bool).at[:E].set(True),
+        num_nodes=jnp.int32(0),
+        pool_used=inp.pool_used0,
+        cap_hint=jnp.zeros((N, D), jnp.int64).at[:E].set(inp.ex_alloc),
+        bail=jnp.asarray(False),
+    )
+    slot_idx = jnp.arange(N)
+
+    def step(carry, xs):
+        return pruned_group_step(inp, carry, xs, P=P, E=E, N=N, S=S,
+                                 slot_idx=slot_idx)
+
+    xs = (inp.R, inp.n, inp.F, inp.agz, inp.agc, inp.admit, inp.daemon,
+          inp.ex_compat)
+    final, (takes, leftover) = jax.lax.scan(step, carry0, xs)
+    return takes, leftover, final
+
+
+# ---------------------------------------------------------------------------
 # Packed I/O path: the TPU sits behind a network tunnel, so PER-TRANSFER
 # round-trip latency dominates end-to-end solve time (measured ~5ms h2d and
 # far worse d2h per array vs ~30KB of actual payload). All 17 inputs ride
@@ -407,3 +613,33 @@ def solve_scan_packed1(buf: jax.Array, *, T: int, D: int, Z: int, C: int,
     out_words = _bits_to_words(jnp.concatenate(
         [out_bool, jnp.zeros(pad, bool)]))
     return jnp.concatenate([out_i64, out_words])
+
+
+@partial(jax.jit, static_argnames=("T", "D", "Z", "C", "G", "E", "P",
+                                   "n_max", "S"))
+def solve_scan_packed1_pruned(buf: jax.Array, *, T: int, D: int, Z: int,
+                              C: int, G: int, E: int, P: int, n_max: int,
+                              S: int = 16) -> jax.Array:
+    """The pruned G-axis kernel behind the same single-buffer wire as
+    the base kernel, with ONE extra trailing int64: the bail flag (1 =
+    pruning was insufficient; the caller must discard and re-solve on
+    the host twin). minValues floors are out of scope (caller-gated)."""
+    n_i64 = _layout_sizes(_in_layout_i64(T, D, Z, C, G, E, P, 0, 0))
+    n_bits = _layout_sizes(_in_layout_bool(T, D, Z, C, G, E, P, 0, 0))
+    bool_flat = _words_to_bits(buf[n_i64:n_i64 + _nwords(n_bits)], n_bits)
+    inp = _unpack_inputs(buf[:n_i64], bool_flat, T, D, Z, C, G, E, P, 0, 0)
+    takes, leftover, carry = _solve_pruned(inp, n_max, E, P, S)
+    out_i64 = jnp.concatenate([
+        takes.reshape(-1), leftover.reshape(-1),
+        carry.used.reshape(-1), carry.pool.astype(jnp.int64),
+        carry.num_nodes.reshape(1).astype(jnp.int64),
+        carry.pool_used.reshape(-1)])
+    out_bool = jnp.concatenate([
+        carry.types.reshape(-1), carry.zones.reshape(-1),
+        carry.ct.reshape(-1), carry.alive])
+    nb = out_bool.shape[0]
+    pad = _nwords(nb) * 64 - nb
+    out_words = _bits_to_words(jnp.concatenate(
+        [out_bool, jnp.zeros(pad, bool)]))
+    return jnp.concatenate([out_i64, out_words,
+                            carry.bail.astype(jnp.int64).reshape(1)])

@@ -97,6 +97,10 @@ class RemoteSolver(TPUSolver):
     so deployments where the sidecar round trip dominates automatically
     stay local, and ones with a fast fabric ride the device."""
 
+    #: the wire protocol speaks the base kernel only; high-G solves on a
+    #: remote engine route to the host twin instead of the pruned kernel
+    supports_pruned_kernel = False
+
     name = "tpu-sidecar"
 
     def __init__(self, address: str, n_max: int = 2048,

@@ -79,6 +79,10 @@ def _runs_from_events(ev, gi: int):
 
 class TPUSolver(Solver):
     name = "tpu"
+    #: the pruned G-axis kernel runs the solve locally; the sidecar's
+    #: RemoteSolver (whose dispatches ride gRPC to a server that only
+    #: speaks the base kernel) turns this off
+    supports_pruned_kernel = True
 
     def __init__(self, backend: str = "auto", n_max: int = 2048):
         """backend: 'auto' (cost-routed, see solver/route.py), 'jax'
@@ -95,12 +99,17 @@ class TPUSolver(Solver):
         assert backend in ("auto", "jax", "numpy")
         self.backend = backend
         self.n_max = n_max
-        #: device group-scan cap: beyond this padded group count the
-        #: solve stays on the host engine (a scan step per group makes
-        #: compile and run time O(G); calibrating the router against a
-        #: 16k-step kernel would stall the first high-cardinality solve
-        #: for minutes). See docs/solver-design.md "The G axis".
+        #: BASE device group-scan cap: beyond this padded group count the
+        #: full [N, T]-per-step kernel is never dispatched (its run time
+        #: is O(G * N * T)). See docs/solver-design.md "The G axis".
         self.dev_max_groups = 4096
+        #: PRUNED-kernel cap (ops/ffd_jax.py solve_scan_packed1_pruned):
+        #: the bound-pass + S-slot-exact step costs O(N*D + S*T*D), so
+        #: the device G envelope quadruples. Solves between the two caps
+        #: ride the pruned kernel (single device, no minValues floors);
+        #: a pruning-insufficient solve BAILS to the host twin, so
+        #: decisions never depend on which kernel served.
+        self.dev_max_groups_pruned = 16384
         # resolve the native fill at CONSTRUCTION, not mid-solve: the
         # binding's one-shot build attempt (repo convention, codec.py)
         # must never appear as a first-solve latency cliff, and running
@@ -243,19 +252,18 @@ class TPUSolver(Solver):
                 return self._solve_core(snapshot, pod_groups=pod_groups)
             return self._decode(enc, existing, takes, leftover, final)
         ex_alloc, ex_used, ex_compat = self._encode_existing(enc, existing)
-        if host_only or len(enc.groups) > self.dev_max_groups:
+        if host_only or len(enc.groups) > self._dev_group_cap(enc):
             # zero-width type axis (host engines only), or beyond the
-            # device group-scan cap: host engine only (the G-axis law,
-            # docs/solver-design.md) — never let router calibration
-            # compile a many-thousand-step scan. A latency or engine
-            # cliff must never be silent, even when requested via
-            # backend="jax"
+            # device group caps (base 4096, pruned 16384 — the G-axis
+            # law, docs/solver-design.md): host engine only. A latency
+            # or engine cliff must never be silent, even when requested
+            # via backend="jax"
             if self.backend != "numpy" and not host_only:
                 import logging
                 logging.getLogger(__name__).info(
-                    "group count %d exceeds dev_max_groups=%d; serving "
-                    "from the host engine", len(enc.groups),
-                    self.dev_max_groups)
+                    "group count %d exceeds the effective device group "
+                    "cap %d; serving from the host engine",
+                    len(enc.groups), self._dev_group_cap(enc))
                 if self.metrics is not None:
                     self.metrics.inc(
                         "karpenter_solver_device_fallback_total",
@@ -293,6 +301,15 @@ class TPUSolver(Solver):
         if self._grow_if_exhausted(snapshot, leftover, final):
             return self._solve_core(snapshot, pod_groups=pod_groups)
         return self._decode(enc, existing, takes, leftover, final)
+
+    def _dev_group_cap(self, enc: SnapshotEncoding) -> int:
+        """Effective device group cap for this snapshot: the pruned
+        kernel's envelope when it is eligible (local dispatch, single
+        device, no minValues floors), else the base kernel's."""
+        if (self.supports_pruned_kernel and enc.mv_K == 0
+                and self._dev_devices() <= 1):
+            return self.dev_max_groups_pruned
+        return self.dev_max_groups
 
     def _bucket_key(self, enc: SnapshotEncoding, E: int) -> Tuple:
         """Shape bucket = the padded statics that key the XLA compile
@@ -390,6 +407,15 @@ class TPUSolver(Solver):
         d_buf = jnp.asarray(buf)  # async enqueue; no sync before dispatch
         # np.asarray is the only sync: it waits for exec + fetch at once
         return np.asarray(solve_scan_packed1(d_buf, **statics))
+
+    def _dispatch_pruned(self, buf: np.ndarray, **statics) -> np.ndarray:
+        """The pruned G-axis kernel (same wire + one trailing bail word).
+        Local only — RemoteSolver disables it via supports_pruned_kernel."""
+        import jax.numpy as jnp
+
+        from ..ops.ffd_jax import solve_scan_packed1_pruned
+        d_buf = jnp.asarray(buf)
+        return np.asarray(solve_scan_packed1_pruned(d_buf, **statics))
 
     def _dev_devices(self) -> int:
         """Device count of the dev engine (nonblocking, probed). >1 routes
@@ -688,12 +714,50 @@ class TPUSolver(Solver):
         # carry (and the d2h payload) small. If the solve exhausts every
         # slot with pods left over, rerun with 4x slots (decisions are
         # invariant to N once N is large enough: spare slots never fill).
+        # beyond the base kernel's group cap the PRUNED kernel serves
+        # (bound pass + S-slot exact; ops/ffd_jax.py) — eligible only
+        # locally, single-device, without minValues floors
+        use_pruned = (self.supports_pruned_kernel and ndev <= 1
+                      and K == 0 and Gp > self.dev_max_groups)
+        if ndev > 1 and Gp > self.dev_max_groups:
+            # the routing gate probed the device count nonblockingly and
+            # may have allowed the pruned cap before the probe resolved
+            # to a multi-device mesh; never mesh-dispatch a scan past the
+            # BASE cap (the multi-minute stall the cap exists to prevent)
+            import logging
+            logging.getLogger(__name__).info(
+                "padded group count %d exceeds the mesh kernel cap %d; "
+                "serving from the host twin", Gp, self.dev_max_groups)
+            if self.metrics is not None:
+                self.metrics.inc(
+                    "karpenter_solver_device_fallback_total",
+                    labels={"reason": "group_cap"})
+            return self._run_numpy(enc, ex_alloc, ex_used, ex_compat)
         n_bucket = self._bucket
         while True:
             if ndev > 1:
                 out = self._dispatch_mesh(
                     arrays, T=T, D=Dp, Z=Z, C=C, G=Gp, E=Ep, P=Pp,
                     K=K, V=V, M=M, n_max=n_bucket, ndev=ndev)
+            elif use_pruned:
+                o_buf = self._dispatch_pruned(
+                    buf, T=T, D=Dp, Z=Z, C=C, G=Gp, E=Ep, P=Pp,
+                    n_max=n_bucket)
+                if int(o_buf[-1]):
+                    # pruning insufficient for this input: host twin
+                    # serves it, identically — never silently
+                    import logging
+                    logging.getLogger(__name__).info(
+                        "pruned device kernel bailed (deep fill); "
+                        "serving this solve from the host twin")
+                    if self.metrics is not None:
+                        self.metrics.inc(
+                            "karpenter_solver_device_fallback_total",
+                            labels={"reason": "pruned_bail"})
+                    return self._run_numpy(enc, ex_alloc, ex_used,
+                                           ex_compat)
+                out = unpack_outputs1(o_buf[:-1], T, Dp, Z, C, Gp, Ep,
+                                      Pp, n_bucket)
             else:
                 o_buf = self._dispatch(buf, T=T, D=Dp, Z=Z, C=C, G=Gp,
                                        E=Ep, P=Pp, K=K, V=V, M=M,

@@ -453,6 +453,21 @@ class TestSlotGrowth:
         assert got.decision_fingerprint() == ref.decision_fingerprint()
 
 
+def _high_g_snapshot(env, n_sigs=5000, per=1):
+    """The shared high-G synthetic workload (one shape for the base- and
+    pruned-kernel beyond-cap tests, so they cannot drift apart)."""
+    pods = []
+    for i in range(n_sigs):
+        pods += make_pods(per, cpu=f"{100 + (i % 400)}m",
+                          memory=f"{256 + i // 400}Mi",
+                          prefix=f"dg{i:05d}")
+    pool = env.nodepool(f"highg-{n_sigs}-{per}", requirements=[
+        {"key": L.INSTANCE_FAMILY, "operator": "In", "values": ["m5"]},
+        {"key": L.INSTANCE_SIZE, "operator": "In",
+         "values": ["large", "xlarge", "2xlarge", "4xlarge"]}])
+    return env.snapshot(pods, [pool])
+
+
 @pytest.mark.scale
 class TestDeviceScanBeyondGroupCap:
     def test_device_scan_identical_past_dev_max_groups(self, env):
@@ -466,16 +481,7 @@ class TestDeviceScanBeyondGroupCap:
         from karpenter_provider_aws_tpu.solver import route
         if not route.device_alive():  # settle the probe (CPU backend)
             pytest.skip("no dev engine in this environment")
-        pods = []
-        for i in range(5000):
-            pods += make_pods(1, cpu=f"{100 + (i % 400)}m",
-                              memory=f"{256 + i // 400}Mi",
-                              prefix=f"dg{i:05d}")
-        pool = env.nodepool("dev-g", requirements=[
-            {"key": L.INSTANCE_FAMILY, "operator": "In", "values": ["m5"]},
-            {"key": L.INSTANCE_SIZE, "operator": "In",
-             "values": ["large", "xlarge", "2xlarge", "4xlarge"]}])
-        snap = env.snapshot(pods, [pool])
+        snap = _high_g_snapshot(env)
         t = TPUSolver(backend="jax")
         t.dev_max_groups = 8192
         t._dev_devices = lambda: 1  # single-device packed path
@@ -491,3 +497,64 @@ class TestDeviceScanBeyondGroupCap:
         assert dispatches["n"] >= 1, "device kernel never dispatched"
         ref = CPUSolver().solve(snap)
         assert got.decision_fingerprint() == ref.decision_fingerprint()
+
+
+@pytest.mark.scale
+class TestPrunedDeviceKernel:
+    """The pruned G-axis device kernel (ops/ffd_jax.py
+    solve_scan_packed1_pruned): beyond the base kernel's 4096-group cap,
+    solves ride a bound-pass + S-slot-exact scan whose per-step cost is
+    O(N*D + S*T*D) instead of O(N*T*D). Decisions stay oracle-identical
+    because any input where pruning could matter BAILS to the host twin."""
+
+    def test_pruned_kernel_identical_at_high_g(self, env):
+        from karpenter_provider_aws_tpu.solver import route
+        if not route.device_alive():
+            pytest.skip("no dev engine in this environment")
+        snap = _high_g_snapshot(env)
+        t = TPUSolver(backend="jax")
+        t._dev_devices = lambda: 1
+        dispatches = {"pruned": 0, "base": 0}
+        orig_p, orig_b = t._dispatch_pruned, t._dispatch
+
+        def cp(buf, **st):
+            dispatches["pruned"] += 1
+            return orig_p(buf, **st)
+
+        def cb(buf, **st):
+            dispatches["base"] += 1
+            return orig_b(buf, **st)
+
+        t._dispatch_pruned, t._dispatch = cp, cb
+        got = t.solve(snap)
+        assert dispatches["pruned"] >= 1 and dispatches["base"] == 0, \
+            dispatches
+        ref = CPUSolver().solve(snap)
+        assert got.decision_fingerprint() == ref.decision_fingerprint()
+
+    def test_bail_serves_host_identically(self, env):
+        """With S forced to 1, any multi-slot fill trips the bail flag;
+        the solve must come back from the host twin, identical."""
+        from karpenter_provider_aws_tpu.solver import route
+        if not route.device_alive():
+            pytest.skip("no dev engine in this environment")
+        # multi-pod groups spill across slots as nodes fill; with S=1
+        # the spill target is unselected, so bails must occur
+        snap = _high_g_snapshot(env, per=3)
+        t = TPUSolver(backend="jax")
+        t._dev_devices = lambda: 1
+        orig = t._dispatch_pruned
+        bails = {"n": 0}
+
+        def tiny_s(buf, **st):
+            out = orig(buf, S=1, **st)
+            bails["n"] += int(out[-1])
+            return out
+
+        t._dispatch_pruned = tiny_s
+        got = t.solve(snap)
+        ref = CPUSolver().solve(snap)
+        assert got.decision_fingerprint() == ref.decision_fingerprint()
+        # the S=1 selection cannot hold a multi-slot fill: the kernel
+        # must have bailed at least once (else the test is vacuous)
+        assert bails["n"] >= 1
