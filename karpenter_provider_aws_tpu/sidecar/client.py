@@ -20,6 +20,7 @@ from ..solver.tpu import TPUSolver
 
 _SOLVE = "/karpenter.solver.v1.Solver/Solve"
 _SOLVE_TOPO = "/karpenter.solver.v1.Solver/SolveTopo"
+_SOLVE_PRUNED = "/karpenter.solver.v1.Solver/SolvePruned"
 _INFO = "/karpenter.solver.v1.Solver/Info"
 
 #: SolveTopo output fields that are booleans on the kernel side (the
@@ -48,6 +49,7 @@ class SolverClient:
             self._channel = grpc.insecure_channel(address, options=opts)
         self._solve = self._channel.unary_unary(_SOLVE)
         self._solve_topo = self._channel.unary_unary(_SOLVE_TOPO)
+        self._solve_pruned = self._channel.unary_unary(_SOLVE_PRUNED)
         self._info = self._channel.unary_unary(_INFO)
 
     def solve_buffer(self, buf: np.ndarray, statics: Dict[str, int]) -> np.ndarray:
@@ -59,6 +61,22 @@ class SolverClient:
         })
         resp = self._solve(req, timeout=self.timeout, metadata=self._md)
         return np.array(arena_unpack(resp)["out"])  # own the memory
+
+    def solve_pruned_buffer(self, buf: np.ndarray,
+                            statics: Dict[str, int]) -> np.ndarray:
+        """SolvePruned wire: base-solve buffer + (base statics, S); the
+        response carries the trailing bail word."""
+        from .server import PRUNED_STATIC_KEYS
+        vec = [statics.get(k, 0) for k in PRUNED_STATIC_KEYS]
+        if vec[-1] == 0:
+            vec[-1] = 16  # the kernel's default selection width
+        req = arena_pack({
+            "buf": np.ascontiguousarray(buf, dtype=np.int64),
+            "statics": np.array(vec, dtype=np.int64),
+        })
+        resp = self._solve_pruned(req, timeout=self.timeout,
+                                  metadata=self._md)
+        return np.array(arena_unpack(resp)["out"])
 
     def solve_topo(self, arrays: Dict[str, np.ndarray],
                    rows: Dict[str, np.ndarray],
@@ -97,10 +115,6 @@ class RemoteSolver(TPUSolver):
     so deployments where the sidecar round trip dominates automatically
     stay local, and ones with a fast fabric ride the device."""
 
-    #: the wire protocol speaks the base kernel only; high-G solves on a
-    #: remote engine route to the host twin instead of the pruned kernel
-    supports_pruned_kernel = False
-
     name = "tpu-sidecar"
 
     def __init__(self, address: str, n_max: int = 2048,
@@ -118,12 +132,24 @@ class RemoteSolver(TPUSolver):
                 token = os.environ.get("SOLVER_SIDECAR_TOKEN") or None
             client = SolverClient(address, token=token, root_cert=root_cert)
         self.client = client
+        #: SolvePruned is capability-gated: None until the first ping
+        #: fetches the server's Info (an old server without the flag —
+        #: or a mesh server — never receives the RPC)
+        self._pruned_ok: "Optional[bool]" = None
         from ..solver.route import AliveCache
         self._router.alive = AliveCache(self._ping)
 
     def _ping(self) -> bool:
-        """Sidecar liveness = a short-deadline Info round trip."""
-        return self.client.info(timeout=5.0)["devices"] >= 1
+        """Sidecar liveness = a short-deadline Info round trip (also
+        resolves the SolvePruned capability)."""
+        info = self.client.info(timeout=5.0)
+        self._pruned_ok = bool(info.get("pruned", 0)) \
+            and info["devices"] == 1
+        return info["devices"] >= 1
+
+    @property
+    def supports_pruned_kernel(self) -> bool:
+        return bool(self._pruned_ok)
 
     def _dev_devices(self) -> int:
         """Always the packed wire dispatch: the SERVER owns the
@@ -132,6 +158,27 @@ class RemoteSolver(TPUSolver):
 
     def _dispatch(self, buf: np.ndarray, **statics) -> np.ndarray:
         return self.client.solve_buffer(buf, statics)
+
+    def _dispatch_pruned(self, buf: np.ndarray, **statics) -> np.ndarray:
+        """High-G solves ride SolvePruned. A peer that rejects or dies
+        mid-call returns a synthetic one-word bail buffer — the caller's
+        contract reads only the trailing word, so the bit-identical host
+        twin serves, never a crash."""
+        import grpc
+        try:
+            return self.client.solve_pruned_buffer(buf, statics)
+        except grpc.RpcError as e:
+            import logging
+            code = e.code() if hasattr(e, "code") else None
+            logging.getLogger(__name__).warning(
+                "SolvePruned RPC failed (%s); serving from the host twin",
+                code or e)
+            if code in (grpc.StatusCode.FAILED_PRECONDITION,
+                        grpc.StatusCode.UNIMPLEMENTED):
+                # the peer cannot speak this RPC anymore (mesh restart,
+                # rollback): stop paying a doomed round trip per solve
+                self._pruned_ok = False
+            return np.ones(1, dtype=np.int64)  # bail word only
 
     def _topo_lowerable(self, enc, tenc, existing) -> bool:
         """The local envelope plus the SERVER's SolveTopo bounds

@@ -35,7 +35,13 @@ log = logging.getLogger(__name__)
 
 _SOLVE = "/karpenter.solver.v1.Solver/Solve"
 _SOLVE_TOPO = "/karpenter.solver.v1.Solver/SolveTopo"
+_SOLVE_PRUNED = "/karpenter.solver.v1.Solver/SolvePruned"
 _INFO = "/karpenter.solver.v1.Solver/Info"
+
+#: SolvePruned statics vector order (the base-solve statics minus the
+#: minValues triple — out of the pruned kernel's scope — plus S, the
+#: per-step exact-slot selection width)
+PRUNED_STATIC_KEYS = ("T", "D", "Z", "C", "G", "E", "P", "n_max", "S")
 
 #: SolveTopo statics vector order (client and server share this module
 #: constant via sidecar.client's import — one source of truth)
@@ -62,7 +68,8 @@ class _Handler:
     def __init__(self):
         self._shapes_seen: set = set()
 
-    def _validate(self, statics, buf, context) -> Optional[dict]:
+    def _validate(self, statics, buf, context,
+                  shape_tag=()) -> Optional[dict]:
         import grpc
 
         from ..ops.hostpack import (STATIC_KEYS, in_layout_bool,
@@ -82,7 +89,7 @@ class _Handler:
             if not (0 <= v <= _STATICS_MAX[k]):
                 context.abort(grpc.StatusCode.INVALID_ARGUMENT,
                               f"statics.{k}={v} out of bounds")
-        key = tuple(kv.values())
+        key = tuple(kv.values()) + tuple(shape_tag)
         if key not in self._shapes_seen:
             if len(self._shapes_seen) >= _MAX_SHAPE_CLASSES:
                 context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED,
@@ -96,6 +103,46 @@ class _Handler:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT,
                           f"buf size {buf.size} != layout size {expect}")
         return kv
+
+    def solve_pruned(self, request: bytes, context) -> bytes:
+        """The pruned G-axis kernel over the wire (single-buffer + one
+        trailing bail word, exactly the local _dispatch_pruned contract).
+        Single-device servers only — the mesh path keeps the base
+        kernel, so a multi-device server refuses and the client's host
+        twin serves instead."""
+        import grpc
+        import jax
+        import jax.numpy as jnp
+
+        from ..ops.ffd_jax import solve_scan_packed1_pruned
+        if len(jax.devices()) > 1:
+            # precedes payload validation: a mesh server refuses the RPC
+            # regardless of what was sent (clients gate on Info, so this
+            # is the version-skew backstop)
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION,
+                          "pruned kernel is single-device; this server "
+                          "runs a mesh")
+        arrays = arena_unpack(request)
+        buf = arrays["buf"]
+        statics = [int(x) for x in arrays["statics"]]
+        if len(statics) != len(PRUNED_STATIC_KEYS):
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                          f"expected {len(PRUNED_STATIC_KEYS)} statics, "
+                          f"got {len(statics)}")
+        S = statics[-1]
+        if not (1 <= S <= 256):
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                          f"statics.S={S} out of bounds")
+        # layout/bounds validation shares the base path (K=V=M=0); the
+        # shape-class key carries S + a pruned marker, since every
+        # distinct S compiles its own kernel and must spend a slot of
+        # the compile-cache budget like any other shape class
+        kv = self._validate(statics[:-1] + [0, 0, 0], buf, context,
+                            shape_tag=("pruned", S))
+        dims = {k: kv[k] for k in ("T", "D", "Z", "C", "G", "E", "P",
+                                   "n_max")}
+        o_buf = solve_scan_packed1_pruned(jnp.asarray(buf), S=S, **dims)
+        return arena_pack({"out": np.asarray(o_buf)})
 
     def solve(self, request: bytes, context) -> bytes:
         import jax
@@ -231,6 +278,9 @@ class _Handler:
         return arena_pack({
             "devices": np.array([len(jax.devices())], dtype=np.int64),
             "x64": np.array([1], dtype=np.int64),
+            # capability flag: clients gate SolvePruned on it, so an
+            # old server (no flag) simply never receives the RPC
+            "pruned": np.array([1], dtype=np.int64),
         })
 
 
@@ -244,6 +294,9 @@ def _generic_handler(handler: _Handler):
             if call_details.method == _SOLVE_TOPO:
                 return grpc.unary_unary_rpc_method_handler(
                     handler.solve_topo)
+            if call_details.method == _SOLVE_PRUNED:
+                return grpc.unary_unary_rpc_method_handler(
+                    handler.solve_pruned)
             if call_details.method == _INFO:
                 return grpc.unary_unary_rpc_method_handler(handler.info)
             return None
