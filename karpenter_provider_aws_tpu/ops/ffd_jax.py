@@ -51,6 +51,24 @@ except Exception:  # older jax without the knobs: in-memory cache only
 BIG = jnp.int64(1) << 60
 
 
+def _axis_max(x: jax.Array, axis: "str | None", sum_only: bool) -> jax.Array:
+    """Cross-shard max over the mesh axis (identity when ``axis`` is
+    None, i.e. the single-device kernel). Native ``pmax`` by default;
+    with ``sum_only`` the exact max is an ``all_gather`` + local max —
+    the tunneled axon AOT backend cannot lower a Max all-reduce (int64
+    pmax fails there with "Supported lowering only of Sum all reduce",
+    and int64 is non-negotiable in this kernel: BIG sentinels and
+    byte-scale resource quantities overflow int32) but AllGather is a
+    different HLO and lowers fine. Exact integer math either way, so
+    decisions are unchanged; bandwidth is S× on KB-scale buffers,
+    latency-dominated either way."""
+    if axis is None:
+        return x
+    if not sum_only:
+        return jax.lax.pmax(x, axis)
+    return jax.lax.all_gather(x, axis).max(axis=0)
+
+
 def _cumsum(x: jax.Array) -> jax.Array:
     """Exclusive-free prefix sum via associative_scan. Bit-identical to
     jnp.cumsum for integers, but lowers to log-depth slices instead of a
@@ -161,7 +179,8 @@ def solve_scan(inp: KernelInputs, n_max: int, E: int, P: int, V: int = 0
 
 
 def _solve(inp: KernelInputs, n_max: int, E: int, P: int,
-           axis: "str | None" = None, V: int = 0
+           axis: "str | None" = None, V: int = 0,
+           sum_only: bool = False
            ) -> Tuple[jax.Array, jax.Array, Carry]:
     """The scan. With ``axis`` set, the TYPE dimension of every input is a
     per-device shard under shard_map over that mesh axis: candidate masks
@@ -190,7 +209,7 @@ def _solve(inp: KernelInputs, n_max: int, E: int, P: int,
 
     def step(carry: Carry, xs):
         return plain_group_step(inp, carry, xs, axis=axis, P=P, E=E, N=N,
-                                V=V, slot_idx=slot_idx)
+                                V=V, slot_idx=slot_idx, sum_only=sum_only)
 
     xs = (inp.R, inp.n, inp.F, inp.agz, inp.agc, inp.admit, inp.daemon,
           inp.ex_compat)
@@ -199,7 +218,7 @@ def _solve(inp: KernelInputs, n_max: int, E: int, P: int,
 
 
 def plain_group_step(inp: KernelInputs, carry: Carry, xs, *, axis, P, E, N,
-                     V, slot_idx):
+                     V, slot_idx, sum_only=False):
     """One scan step of the closed-form (topology-free) group fill —
     factored out so the topology kernel (ops/topo_jax.py) runs the same
     math for its non-topology groups, sharing this single implementation
@@ -221,8 +240,7 @@ def plain_group_step(inp: KernelInputs, carry: Carry, xs, *, axis, P, E, N,
     # ---- headroom (step 3) ---------------------------------------
     hr_nt = _headroom_matrix(inp.A, carry.used, R)
     k = jnp.where(cand, hr_nt, 0).max(axis=1)
-    if axis is not None:
-        k = jax.lax.pmax(k, axis)   # max over type shards
+    k = _axis_max(k, axis, sum_only)   # max over type shards
     if E:
         ex_ok = carry.alive[:E] & ex_compat
         k_ex = jnp.where(ex_ok, _headroom_vec(inp.ex_alloc, carry.used[:E], R), 0)
@@ -232,8 +250,7 @@ def plain_group_step(inp: KernelInputs, carry: Carry, xs, *, axis, P, E, N,
     if inp.mv_floor is not None:
         hr1 = jnp.where(cand, hr_nt + 1, 0)
         h1 = _mv_h1(hr1, inp.mv_pairs_t, inp.mv_pairs_v, V, T, axis)
-        if axis is not None:
-            h1 = jax.lax.pmax(h1, axis)
+        h1 = _axis_max(h1, axis, sum_only)
         f = jnp.where((carry.pool >= 0)[:, None],
                       inp.mv_floor[pool_clipped], 0)        # [N, K]
         k = jnp.minimum(k, jnp.where(carry.pool >= 0,
@@ -277,14 +294,11 @@ def plain_group_step(inp: KernelInputs, carry: Carry, xs, *, axis, P, E, N,
         cand_new = F & inp.pool_types[pi] & off_p
         hr = _headroom_vec(inp.A, daemon[pi][None, :], R)
         hr = jnp.where(cand_new, hr, 0)
-        cap = hr.max()
-        if axis is not None:
-            cap = jax.lax.pmax(cap, axis)
+        cap = _axis_max(hr.max(), axis, sum_only)
         if inp.mv_floor is not None:
             h1n = _mv_h1(jnp.where(cand_new, hr + 1, 0),
                          inp.mv_pairs_t, inp.mv_pairs_v, V, T, axis)
-            if axis is not None:
-                h1n = jax.lax.pmax(h1n, axis)
+            h1n = _axis_max(h1n, axis, sum_only)
             cap = jnp.minimum(cap, _mv_cap(h1n, inp.mv_floor[pi], V))
         budget = _pool_budget_jax(inp.pool_limit[pi], pool_used[pi], R)
         can_place = jnp.where(
@@ -379,14 +393,26 @@ def pruned_group_step(inp: KernelInputs, carry: CarryP, xs, *, P, E, N, S,
     C = inp.agc.shape[1]
     n_rem = n
 
-    # ---- bound pass over every slot: O(N*D) -----------------------
+    # ---- bound pass over every slot: O(N*D + N*T bool) ------------
     pool_clipped = jnp.clip(carry.pool, 0, P - 1)
     adm_open = jnp.where(carry.pool >= 0, admit[pool_clipped], False)
     Rsafe = jnp.where(R > 0, R, 1)
     qb = (carry.cap_hint - carry.used) // Rsafe[None, :]
     qb = jnp.where((R > 0)[None, :], qb, BIG)
     k_bound = jnp.clip(qb.min(axis=-1), 0, BIG)
-    open_cand = adm_open & (k_bound > 0) & carry.alive
+    # compatibility pre-screen, EXACT wrt the base kernel: carry.types
+    # is the same narrowed mask the base kernel carries (selected slots
+    # narrow identically, unselected slots never took), so a slot with
+    # no (types ∧ F) overlap — or no zone / capacity-type overlap —
+    # has an all-False cand row there and k=0: excluding it from the
+    # selection AND from n_pos loses nothing and stops incompatible
+    # slots from wasting the S selection (the high-signature-diversity
+    # shape of BASELINE config 7, where resource-positive slots
+    # usually belong to other signatures' pools/selectors).
+    compat = (carry.types & F[None, :]).any(axis=1) \
+        & (carry.zones & agz[None, :]).any(axis=1) \
+        & (carry.ct & agc[None, :]).any(axis=1)
+    open_cand = adm_open & (k_bound > 0) & carry.alive & compat
     if E:
         open_cand = open_cand.at[:E].set(False)
     n_pos = open_cand.sum()
@@ -510,6 +536,11 @@ def _solve_pruned(inp: KernelInputs, n_max: int, E: int, P: int, S: int):
     Z = inp.agz.shape[1]
     C = inp.agc.shape[1]
     N = E + n_max
+    # selection cannot exceed the slot count: argsort(...)[:S] would
+    # silently yield N rows and the [S, ...] reshapes would fail at
+    # trace time (a small-n_max solver with the 64-slot default).
+    # S == N selects everything — exact, bail-free.
+    S = min(S, N)
     carry0 = CarryP(
         used=jnp.zeros((N, D), jnp.int64).at[:E].set(inp.ex_used0),
         types=jnp.zeros((N, T), bool),
@@ -544,7 +575,8 @@ def _solve_pruned(inp: KernelInputs, n_max: int, E: int, P: int, S: int):
 # sides; ``_split`` is the only buffer walker.
 # ---------------------------------------------------------------------------
 
-from .hostpack import (in_layout_bool as _in_layout_bool,  # noqa: E402
+from .hostpack import (DEV_PRUNED_SLOTS,  # noqa: E402
+                       in_layout_bool as _in_layout_bool,
                        in_layout_i64 as _in_layout_i64,
                        layout_sizes as _layout_sizes,
                        nwords as _nwords, out_layout, pack_inputs1,
@@ -619,7 +651,7 @@ def solve_scan_packed1(buf: jax.Array, *, T: int, D: int, Z: int, C: int,
                                    "n_max", "S"))
 def solve_scan_packed1_pruned(buf: jax.Array, *, T: int, D: int, Z: int,
                               C: int, G: int, E: int, P: int, n_max: int,
-                              S: int = 16) -> jax.Array:
+                              S: int = DEV_PRUNED_SLOTS) -> jax.Array:
     """The pruned G-axis kernel behind the same single-buffer wire as
     the base kernel, with ONE extra trailing int64: the bail flag (1 =
     pruning was insufficient; the caller must discard and re-solve on

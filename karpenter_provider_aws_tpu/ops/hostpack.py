@@ -21,6 +21,15 @@ from ..native import pack_bits, unpack_bits
 #: requests loudly instead of misparsing n_max)
 STATIC_KEYS = ("T", "D", "Z", "C", "G", "E", "P", "n_max", "K", "V", "M")
 
+#: default exact-slot budget per pruned-kernel step — the ONE source for
+#: the kernel signature default (ops/ffd_jax.py), the local solver knob
+#: (solver/tpu.py dev_pruned_slots) and the sidecar client's wire
+#: fallback (sidecar/client.py). The compat-aware bound pass counts only
+#: slots the exact kernel could fill, and BASELINE config 7 (50k pods,
+#: ~10k signatures, ~5 pods/signature) clears its deepest fill at S=48;
+#: 64 leaves margin without moving the O(S*T*D) step-cost class.
+DEV_PRUNED_SLOTS = 64
+
 
 def in_layout_i64(T, D, Z, C, G, E, P, K=0, M=0):
     """(name, shape) of every int64 input, in buffer order. K/M are the

@@ -35,6 +35,44 @@ from ..ops.ffd_jax import Carry, KernelInputs, _solve
 
 AXIS = "tp"
 
+#: mesh id -> detected sum_only verdict (solve_scan_sharded memoization)
+_SUM_ONLY_CACHE: dict = {}
+
+
+def _needs_sum_only(mesh: Mesh) -> bool:
+    """True when the mesh's cross-shard maxima should ride the
+    all_gather emulation instead of native pmax (ops/ffd_jax._axis_max,
+    exact either way). The tunneled axon AOT compiler rejects int64 pmax
+    ("Supported lowering only of Sum all reduce") while AllGather lowers
+    fine; since the gathered buffers are KB-scale and latency-dominated,
+    the emulation costs nothing measurable, so ANY tpu-platform mesh
+    defaults to it — a version-string sniff alone would silently miss an
+    axon plugin whose platform_version is a bare version number.
+    Overridable via KARP_SUM_ONLY_COLLECTIVES (KARP_ is the repo's
+    env-var prefix — see KARP_JAX_PLATFORMS; strconv.ParseBool
+    semantics, typos are errors not False)."""
+    import logging
+    import os
+
+    from ..options import _parse_bool
+    log = logging.getLogger(__name__)
+    env = os.environ.get("KARP_SUM_ONLY_COLLECTIVES")
+    if env is not None:
+        val = _parse_bool(env)
+        log.info("mesh collectives: sum_only=%s (KARP_SUM_ONLY_"
+                 "COLLECTIVES override)", val)
+        return val
+    try:
+        dev = mesh.devices.flat[0]
+        ver = getattr(dev.client, "platform_version", "") or ""
+        val = dev.platform == "tpu" or "axon" in ver.lower()
+    except Exception:
+        val = False
+    if val:
+        log.info("mesh collectives: sum_only=True (tpu/axon backend — "
+                 "int64 pmax may not lower; using all_gather max)")
+    return val
+
 
 def solve_mesh(n_devices: Optional[int] = None,
                devices=None) -> Mesh:
@@ -90,9 +128,10 @@ def _input_specs(has_mv: bool) -> KernelInputs:
         mv_pairs_v=repl if has_mv else None)
 
 
-@partial(jax.jit, static_argnames=("n_max", "E", "P", "V", "mesh"))
+@partial(jax.jit,
+         static_argnames=("n_max", "E", "P", "V", "mesh", "sum_only"))
 def _solve_sharded(inp: KernelInputs, n_max: int, E: int, P: int,
-                   mesh: Mesh, V: int = 0):
+                   mesh: Mesh, V: int = 0, sum_only: bool = False):
     try:
         from jax import shard_map as _smap
 
@@ -117,7 +156,8 @@ def _solve_sharded(inp: KernelInputs, n_max: int, E: int, P: int,
     out_specs = (repl, repl, Carry(
         used=repl, types=PS(None, AXIS), zones=repl, ct=repl,
         pool=repl, alive=repl, num_nodes=repl, pool_used=repl))
-    fn = shard_map(partial(_solve, n_max=n_max, E=E, P=P, axis=AXIS, V=V),
+    fn = shard_map(partial(_solve, n_max=n_max, E=E, P=P, axis=AXIS, V=V,
+                           sum_only=sum_only),
                    mesh=mesh, in_specs=(in_specs,), out_specs=out_specs)
     return fn(inp)
 
@@ -144,10 +184,19 @@ def dispatch_mesh(arrays: dict, *, n_max: int, E: int, P: int, V: int,
 
 
 def solve_scan_sharded(inp: KernelInputs, n_max: int, E: int, P: int,
-                       mesh: Mesh, V: int = 0
+                       mesh: Mesh, V: int = 0,
+                       sum_only: Optional[bool] = None
                        ) -> Tuple[jax.Array, jax.Array, Carry]:
     """Type-parallel solve over ``mesh``; same (takes, leftover, carry)
     contract as ops.ffd_jax.solve_scan, decisions identical."""
+    if sum_only is None:
+        # detection is a property of the mesh: memoize so a steady-state
+        # control loop doesn't re-sniff and re-log once per solve
+        cached = _SUM_ONLY_CACHE.get(id(mesh))
+        if cached is None:
+            cached = _needs_sum_only(mesh)
+            _SUM_ONLY_CACHE[id(mesh)] = cached
+        sum_only = cached
     n_shards = mesh.devices.size
     padded, T = _pad_types(inp, n_shards)
     # explicit placement onto the mesh per spec — never the default device
@@ -157,7 +206,8 @@ def solve_scan_sharded(inp: KernelInputs, n_max: int, E: int, P: int,
         None if x is None
         else jax.device_put(np.asarray(x), NamedSharding(mesh, s))
         for x, s in zip(padded, specs)])
-    takes, leftover, carry = _solve_sharded(padded, n_max, E, P, mesh, V=V)
+    takes, leftover, carry = _solve_sharded(padded, n_max, E, P, mesh, V=V,
+                                            sum_only=sum_only)
     if padded.A.shape[0] != T:
         carry = carry._replace(types=carry.types[:, :T])
     return takes, leftover, carry

@@ -66,10 +66,11 @@ class SolverClient:
                             statics: Dict[str, int]) -> np.ndarray:
         """SolvePruned wire: base-solve buffer + (base statics, S); the
         response carries the trailing bail word."""
+        from ..ops.hostpack import DEV_PRUNED_SLOTS
         from .server import PRUNED_STATIC_KEYS
         vec = [statics.get(k, 0) for k in PRUNED_STATIC_KEYS]
-        if vec[-1] == 0:
-            vec[-1] = 16  # the kernel's default selection width
+        if vec[-1] == 0:  # caller predates the S-bearing dispatch site
+            vec[-1] = DEV_PRUNED_SLOTS
         req = arena_pack({
             "buf": np.ascontiguousarray(buf, dtype=np.int64),
             "statics": np.array(vec, dtype=np.int64),

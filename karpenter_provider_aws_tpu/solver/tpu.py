@@ -110,6 +110,12 @@ class TPUSolver(Solver):
         #: a pruning-insufficient solve BAILS to the host twin, so
         #: decisions never depend on which kernel served.
         self.dev_max_groups_pruned = 16384
+        #: exact-slot budget per pruned-kernel step (see the constant's
+        #: sizing rationale in ops/hostpack.py); injected at the _run_jax
+        #: dispatch site so the RemoteSolver override ships it on the
+        #: SolvePruned wire too
+        from ..ops.hostpack import DEV_PRUNED_SLOTS
+        self.dev_pruned_slots = DEV_PRUNED_SLOTS
         # resolve the native fill at CONSTRUCTION, not mid-solve: the
         # binding's one-shot build attempt (repo convention, codec.py)
         # must never appear as a first-solve latency cliff, and running
@@ -410,7 +416,9 @@ class TPUSolver(Solver):
 
     def _dispatch_pruned(self, buf: np.ndarray, **statics) -> np.ndarray:
         """The pruned G-axis kernel (same wire + one trailing bail word).
-        Local only — RemoteSolver disables it via supports_pruned_kernel."""
+        S arrives in ``statics`` from the _run_jax dispatch site — the
+        single resolution point RemoteSolver shares. Local only —
+        RemoteSolver disables it via supports_pruned_kernel."""
         import jax.numpy as jnp
 
         from ..ops.ffd_jax import solve_scan_packed1_pruned
@@ -740,9 +748,12 @@ class TPUSolver(Solver):
                     arrays, T=T, D=Dp, Z=Z, C=C, G=Gp, E=Ep, P=Pp,
                     K=K, V=V, M=M, n_max=n_bucket, ndev=ndev)
             elif use_pruned:
+                # S resolved HERE, the call site both the local and the
+                # RemoteSolver dispatch share — so the sidecar wire
+                # carries the same selection width the local kernel uses
                 o_buf = self._dispatch_pruned(
                     buf, T=T, D=Dp, Z=Z, C=C, G=Gp, E=Ep, P=Pp,
-                    n_max=n_bucket)
+                    n_max=n_bucket, S=self.dev_pruned_slots)
                 if int(o_buf[-1]):
                     # pruning insufficient for this input: host twin
                     # serves it, identically — never silently

@@ -60,6 +60,59 @@ def Carry_fields():
     return Carry._fields
 
 
+def test_sum_only_collectives_identical(inputs):
+    """The axon AOT backend lowers only Sum all-reduce (int64 pmax is
+    rejected: "Supported lowering only of Sum all reduce") but AllGather
+    is a different HLO and lowers fine, so the mesh kernel emulates the
+    cross-shard max as all_gather + local max (ops/ffd_jax._axis_max).
+    It is exact integer math: every decision and the whole carry must
+    match the native-pmax sharded solve bit for bit."""
+    from karpenter_provider_aws_tpu.parallel import (solve_mesh,
+                                                     solve_scan_sharded)
+    inp, statics = inputs
+    mesh = solve_mesh(8)
+    t1, l1, c1 = solve_scan_sharded(inp, mesh=mesh, sum_only=False,
+                                    **statics)
+    t2, l2, c2 = solve_scan_sharded(inp, mesh=mesh, sum_only=True,
+                                    **statics)
+    assert (np.asarray(t1) == np.asarray(t2)).all()
+    assert (np.asarray(l1) == np.asarray(l2)).all()
+    for name in Carry_fields():
+        a, b = getattr(c1, name), getattr(c2, name)
+        assert (np.asarray(a) == np.asarray(b)).all(), name
+
+
+def test_sum_only_collectives_identical_minvalues(inputs):
+    """Same bit-for-bit claim, with minValues floors live: the mv path
+    gathers the shape-complex [N, K, V] h1 slabs across shards — the
+    emulation sites a flat-k-only test never reaches."""
+    import jax.numpy as jnp
+
+    from karpenter_provider_aws_tpu.parallel import (solve_mesh,
+                                                     solve_scan_sharded)
+    inp, statics = inputs
+    rng = np.random.RandomState(11)
+    T = inp.A.shape[0]
+    P = statics["P"]
+    K, V, M = 2, 3, T
+    inp = inp._replace(
+        mv_floor=jnp.asarray(rng.randint(1, 4, size=(P, K)).astype(np.int64)),
+        mv_pairs_t=jnp.asarray(np.tile(np.arange(T, dtype=np.int64), (K, 1))),
+        mv_pairs_v=jnp.asarray(rng.randint(0, V, size=(K, M)).astype(np.int64)))
+    statics = dict(statics, V=V)
+    mesh = solve_mesh(8)
+    t1, l1, c1 = solve_scan_sharded(inp, mesh=mesh, sum_only=False,
+                                    **statics)
+    t2, l2, c2 = solve_scan_sharded(inp, mesh=mesh, sum_only=True,
+                                    **statics)
+    assert int(np.asarray(t1).sum()) > 0  # floors engaged, pods placed
+    assert (np.asarray(t1) == np.asarray(t2)).all()
+    assert (np.asarray(l1) == np.asarray(l2)).all()
+    for name in Carry_fields():
+        a, b = getattr(c1, name), getattr(c2, name)
+        assert (np.asarray(a) == np.asarray(b)).all(), name
+
+
 def test_uneven_type_count_pads(inputs):
     """T=45 is not divisible by 8 — padding must not change any decision."""
     from karpenter_provider_aws_tpu.parallel import (solve_mesh,

@@ -284,6 +284,37 @@ class TestSolvePrunedWire:
         assert host.decision_fingerprint() == \
             CPUSolver().solve(snap).decision_fingerprint()
 
+    def test_wire_carries_dispatch_site_selection_width(self):
+        """The S the _run_jax dispatch site injects must reach the wire:
+        a RemoteSolver solve-pruned call ships statics whose trailing S
+        equals dev_pruned_slots — NOT a client-side hardcoded fallback
+        (the regression where the sidecar path stayed at S=16 while the
+        local kernel moved to 64 and config-7 shapes silently bailed)."""
+        import numpy as np
+
+        from karpenter_provider_aws_tpu.ops.hostpack import \
+            DEV_PRUNED_SLOTS
+        from karpenter_provider_aws_tpu.sidecar.server import \
+            PRUNED_STATIC_KEYS
+
+        class CaptureClient:
+            def __init__(self):
+                self.vec = None
+
+            def solve_pruned_buffer(self, buf, statics):
+                self.vec = [statics.get(k, 0) for k in PRUNED_STATIC_KEYS]
+                return np.ones(1, np.int64)  # bail word
+
+        remote = RemoteSolver.__new__(RemoteSolver)
+        remote.client = CaptureClient()
+        remote.dev_pruned_slots = DEV_PRUNED_SLOTS
+        out = RemoteSolver._dispatch_pruned(
+            remote, np.zeros(8, np.int64), T=4, D=8, Z=3, C=3, G=8,
+            E=0, P=1, n_max=16, S=remote.dev_pruned_slots)
+        assert int(out[-1]) == 1  # bail word passthrough
+        assert remote.client.vec is not None
+        assert remote.client.vec[-1] == DEV_PRUNED_SLOTS
+
     def test_remote_solver_gates_on_capability(self, server, env):
         remote = RemoteSolver(server.address, n_max=64)
         assert remote.supports_pruned_kernel is False  # before any ping

@@ -532,6 +532,64 @@ class TestPrunedDeviceKernel:
         ref = CPUSolver().solve(snap)
         assert got.decision_fingerprint() == ref.decision_fingerprint()
 
+    def test_multi_pod_groups_serve_without_bail(self, env):
+        """BASELINE config 7's defining shape — several pods per
+        signature, so fills go DEEP across open slots — must be served
+        by the pruned kernel itself, not the bail→host path: the
+        compat-aware bound pass (types/zone/ct overlap, exact wrt the
+        base kernel) plus the S=64 exact-slot budget hold its deepest
+        fill. Decisions identical to the oracle as always."""
+        from karpenter_provider_aws_tpu.solver import route
+        if not route.device_alive():
+            pytest.skip("no dev engine in this environment")
+        snap = _high_g_snapshot(env, per=5)
+        t = TPUSolver(backend="jax")
+        t._dev_devices = lambda: 1
+        orig_p, orig_np = t._dispatch_pruned, t._run_numpy
+        counts = {"pruned": 0, "host": 0, "bails": 0}
+
+        def cp(buf, **st):
+            counts["pruned"] += 1
+            out = orig_p(buf, **st)
+            counts["bails"] += int(out[-1])
+            return out
+
+        def cn(*a, **k):
+            counts["host"] += 1
+            return orig_np(*a, **k)
+
+        t._dispatch_pruned, t._run_numpy = cp, cn
+        got = t.solve(snap)
+        assert counts["pruned"] >= 1 and counts["bails"] == 0 \
+            and counts["host"] == 0, counts
+        ref = CPUSolver().solve(snap)
+        assert got.decision_fingerprint() == ref.decision_fingerprint()
+
+    def test_small_slot_count_clamps_selection(self, env):
+        """n_max below the 64-slot default: the kernel must clamp S to
+        the slot count (argsort[:S] would otherwise feed an [S, ...]
+        reshape N rows and crash at trace time) and still solve
+        oracle-identically."""
+        from karpenter_provider_aws_tpu.solver import route
+        if not route.device_alive():
+            pytest.skip("no dev engine in this environment")
+        snap = _high_g_snapshot(env, n_sigs=24)
+        t = TPUSolver(backend="jax", n_max=16)
+        t._dev_devices = lambda: 1
+        t.dev_max_groups = 8  # route this small G onto the pruned path
+        counts = {"pruned": 0}
+        orig_p = t._dispatch_pruned
+
+        def cp(buf, **st):
+            counts["pruned"] += 1
+            return orig_p(buf, **st)
+
+        t._dispatch_pruned = cp
+        got = t.solve(snap)
+        assert counts["pruned"] >= 1, "pruned path never dispatched"
+        ref = CPUSolver().solve(snap)
+        assert got.decision_fingerprint() == ref.decision_fingerprint()
+
     def test_bail_serves_host_identically(self, env):
         """With S forced to 1, any multi-slot fill trips the bail flag;
         the solve must come back from the host twin, identical."""
@@ -547,6 +605,7 @@ class TestPrunedDeviceKernel:
         bails = {"n": 0}
 
         def tiny_s(buf, **st):
+            st.pop("S", None)  # the dispatch site injects its own S
             out = orig(buf, S=1, **st)
             bails["n"] += int(out[-1])
             return out
