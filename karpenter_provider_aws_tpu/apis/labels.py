@@ -24,6 +24,10 @@ NODE_REGISTERED = "karpenter.sh/registered"
 DO_NOT_DISRUPT_ANNOTATION = "karpenter.sh/do-not-disrupt"
 NODEPOOL_HASH_ANNOTATION = "karpenter.sh/nodepool-hash"
 NODEPOOL_HASH_VERSION_ANNOTATION = "karpenter.sh/nodepool-hash-version"
+#: bumped whenever NodePool.hash() gains/loses fields (v4: added
+#: terminationGracePeriod) — the hash controller restamps old-version
+#: claims so the computation change itself never reads as drift
+NODEPOOL_HASH_VERSION = "v4"
 
 #: deprecated -> canonical well-known labels (core scheduling's
 #: NormalizedLabels; the reference supports selecting on the beta names)

@@ -175,7 +175,8 @@ class Pod(KubeObject):
                  owner_kind: str = "",
                  scheduling_group: str = "",
                  volume_claims: Sequence[str] = (),
-                 ephemeral_volumes: Sequence[Tuple[str, str]] = ()):
+                 ephemeral_volumes: Sequence[Tuple[str, str]] = (),
+                 priority_class_name: str = ""):
         # sort identity, set eagerly: canonical grouping sorts millions
         # of pods by this key per solve — an instance attribute lets the
         # hot sort use operator.attrgetter (C speed) instead of a
@@ -203,6 +204,9 @@ class Pod(KubeObject):
         #: resolution counts it toward attachment slots and applies its
         #: class's allowed topologies before any PVC object exists.
         self.ephemeral_volumes = [tuple(e) for e in ephemeral_volumes]
+        #: system-node-critical / system-cluster-critical pods drain
+        #: LAST (the terminator's drain order)
+        self.priority_class_name = priority_class_name
 
     def apply_volume_constraints(self, reqs: "Requirements",
                                  n_volumes: int) -> None:
@@ -335,6 +339,10 @@ class NodePool(KubeObject):
             "taints": [(t.key, t.effect, t.value) for t in self.template.taints],
             "startupTaints": [(t.key, t.effect, t.value) for t in self.template.startup_taints],
             "expireAfter": self.template.expire_after,
+            # in the static drift hash upstream too: retuning a pool's
+            # terminationGracePeriod must reach existing claims (e.g. to
+            # unpin a node held by a do-not-disrupt pod) via drift
+            "terminationGracePeriod": self.template.termination_grace_period,
         })
 
 
@@ -353,7 +361,8 @@ class NodeClaim(KubeObject):
                  startup_taints: Sequence[Taint] = (),
                  labels: Optional[Dict[str, str]] = None,
                  annotations: Optional[Dict[str, str]] = None,
-                 expire_after: Optional[float] = None):
+                 expire_after: Optional[float] = None,
+                 termination_grace_period: Optional[float] = None):
         self.metadata = ObjectMeta(name=name, labels=dict(labels or {}),
                                    annotations=dict(annotations or {}))
         self.requirements = requirements
@@ -362,6 +371,10 @@ class NodeClaim(KubeObject):
         self.taints = list(taints)
         self.startup_taints = list(startup_taints)
         self.expire_after = expire_after
+        #: seconds the terminator waits before force-draining (bypassing
+        #: do-not-disrupt); None = wait indefinitely
+        #: (karpenter.sh_nodepools.yaml:407-416)
+        self.termination_grace_period = termination_grace_period
         # status
         self.provider_id: str = ""
         self.image_id: str = ""

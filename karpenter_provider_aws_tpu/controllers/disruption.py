@@ -56,7 +56,7 @@ from ..state.cluster import ClusterState
 
 log = logging.getLogger(__name__)
 
-DO_NOT_DISRUPT_ANNOTATION = "karpenter.sh/do-not-disrupt"
+DO_NOT_DISRUPT_ANNOTATION = L.DO_NOT_DISRUPT_ANNOTATION
 POD_DELETION_COST_ANNOTATION = "controller.kubernetes.io/pod-deletion-cost"
 
 #: spot→spot single-node replacement needs this much cheaper-type
@@ -345,7 +345,8 @@ class DisruptionController:
         if drifted:
             return "CloudProviderDrifted"
         ann = cand.claim.metadata.annotations
-        if ann.get(L.NODEPOOL_HASH_VERSION_ANNOTATION) == "v3" and \
+        if ann.get(L.NODEPOOL_HASH_VERSION_ANNOTATION) \
+                == L.NODEPOOL_HASH_VERSION and \
                 ann.get(L.NODEPOOL_HASH_ANNOTATION,
                         cand.nodepool.hash()) != cand.nodepool.hash():
             return "NodePoolDrifted"

@@ -14,6 +14,7 @@ import logging
 import time
 from typing import Optional
 
+from ..apis import labels as L
 from ..apis.objects import NodeClaim
 from ..cloudprovider.provider import CloudProvider
 from ..cloudprovider.types import (CloudProviderError,
@@ -27,6 +28,15 @@ log = logging.getLogger(__name__)
 REGISTRATION_TTL = 15 * 60  # core: claims that never register are reaped
 
 
+def _release_pod(kube: FakeKube, pod) -> None:
+    """The one per-pod release: unbind; non-terminal pods go back to
+    Pending (terminal pods are released, never resurrected)."""
+    pod.node_name = ""
+    if pod.phase not in ("Succeeded", "Failed"):
+        pod.phase = "Pending"
+    kube.update(pod)
+
+
 def drain_node_pods(kube: FakeKube, node_name: str, metrics=None) -> None:
     """Release a doomed node's pods back to Pending (terminal pods are
     released, never resurrected). Shared by the terminator and the
@@ -34,11 +44,9 @@ def drain_node_pods(kube: FakeKube, node_name: str, metrics=None) -> None:
     evicted = 0
     for pod in kube.list("Pod"):
         if pod.node_name == node_name:
-            pod.node_name = ""
             if pod.phase not in ("Succeeded", "Failed"):
-                pod.phase = "Pending"
                 evicted += 1
-            kube.update(pod)
+            _release_pod(kube, pod)
     if metrics is not None:
         if evicted:
             metrics.inc("karpenter_nodes_eviction_requests_total", evicted,
@@ -152,9 +160,28 @@ class NodeClaimLifecycle:
             self.kube.remove_finalizer(obj, "karpenter.sh/termination")
 
 
+#: drain order of a doomed node's pods (termination_test.go:56-61):
+#: non-critical non-daemonset → non-critical daemonset → critical
+#: non-daemonset → critical daemonset; a group must be fully gone before
+#: the next one is evicted
+CRITICAL_PRIORITY_CLASSES = ("system-cluster-critical",
+                             "system-node-critical")
+
+
+def _drain_group(pod) -> int:
+    critical = getattr(pod, "priority_class_name", "") \
+        in CRITICAL_PRIORITY_CLASSES
+    daemon = pod.owner_kind == "DaemonSet"
+    return (2 if critical else 0) + (1 if daemon else 0)
+
+
 class Terminator:
-    """NodeClaim deletion: drain semantics are approximated by unbinding
-    pods; instance terminated; node deleted; finalizer cleared."""
+    """NodeClaim deletion: ordered drain (one group per reconcile, the
+    four-group order above), do-not-disrupt pods block the drain until
+    the claim's terminationGracePeriod elapses — at which point
+    EVERYTHING is force-evicted, bypassing do-not-disrupt
+    (karpenter.sh_nodepools.yaml:407-416) — then instance terminated,
+    node deleted, finalizer cleared."""
 
     def __init__(self, kube: FakeKube, cloudprovider: CloudProvider,
                  clock=time.time, metrics=None):
@@ -163,11 +190,73 @@ class Terminator:
         self.clock = clock
         self.metrics = metrics
 
+    def _drain_step(self, claim) -> bool:
+        """One drain round for a deleting claim's node. Returns True when
+        the node holds no more bound pods (drain complete)."""
+        bound = []
+        for p in self.kube.list("Pod"):
+            if p.node_name != claim.node_name:
+                continue
+            if p.phase in ("Succeeded", "Failed"):
+                # terminal pods never gate the drain, but they must not
+                # outlive the node either (the GC invariant)
+                _release_pod(self.kube, p)
+            else:
+                bound.append(p)
+        if not bound:
+            return True
+        tgp = claim.termination_grace_period
+        forced = tgp is not None and \
+            self.clock() - claim.metadata.deletion_timestamp >= tgp
+        if forced:
+            victims = bound
+        else:
+            evictable = [
+                p for p in bound
+                if p.metadata.annotations.get(
+                    L.DO_NOT_DISRUPT_ANNOTATION) != "true"]
+            if not evictable:
+                return False  # do-not-disrupt pods hold the node
+            first = min(_drain_group(p) for p in evictable)
+            victims = [p for p in evictable if _drain_group(p) == first]
+        for p in victims:
+            _release_pod(self.kube, p)
+        if self.metrics is not None and victims:
+            self.metrics.inc("karpenter_nodes_eviction_requests_total",
+                             len(victims),
+                             labels={"node_name": claim.node_name})
+        return len(victims) == len(bound)
+
+    def _instance_gone(self, claim) -> bool:
+        """True when the backing instance no longer exists (or is
+        terminating) — spot reclaim, console terminate. Drain is moot on
+        a dead machine; upstream cleans such claims up via the
+        instance-not-found path rather than waiting on eviction."""
+        if not claim.provider_id:
+            return False
+        try:
+            self.cloudprovider.get(claim.provider_id)
+            return False
+        except NodeClaimNotFoundError:
+            return True
+
     def reconcile(self) -> int:
         done = 0
         for claim in self.kube.list("NodeClaim"):
             if claim.metadata.deletion_timestamp is None:
                 continue
+            # 1) drain: ordered, do-not-disrupt-aware, TGP-forced. The
+            #    instance probe runs only when the drain did not finish
+            #    this round — a dead machine (spot reclaim, console
+            #    terminate) makes the remaining drain moot
+            if claim.node_name and not self._drain_step(claim):
+                if self._instance_gone(claim):
+                    # pods on a dead machine are released, not evicted
+                    # (the completion path below counts the drain)
+                    drain_node_pods(self.kube, claim.node_name,
+                                    metrics=None)
+                else:
+                    continue  # more drain rounds (or DND wait) needed
             if self.metrics is not None:
                 self.metrics.inc(
                     "karpenter_nodeclaims_terminated_total",
@@ -176,10 +265,8 @@ class Terminator:
                     "karpenter_nodeclaims_termination_duration_seconds",
                     max(0.0, self.clock()
                         - claim.metadata.deletion_timestamp))
-            # 1) drain: release this node's pods back to pending
-            if claim.node_name:
-                drain_node_pods(self.kube, claim.node_name,
-                                metrics=self.metrics)
+                if claim.node_name:
+                    self.metrics.inc("karpenter_nodes_drained_total")
             # 2) terminate the instance
             if claim.provider_id:
                 t0 = self.clock()

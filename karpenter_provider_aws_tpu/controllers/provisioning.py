@@ -214,9 +214,11 @@ class Provisioner:
                 # node via the kubelet's registration)
                 **nodepool.template.annotations,
                 L.NODEPOOL_HASH_ANNOTATION: nodepool.hash(),
-                L.NODEPOOL_HASH_VERSION_ANNOTATION: "v3",
+                L.NODEPOOL_HASH_VERSION_ANNOTATION: L.NODEPOOL_HASH_VERSION,
             },
-            expire_after=nodepool.template.expire_after)
+            expire_after=nodepool.template.expire_after,
+            termination_grace_period=(
+                nodepool.template.termination_grace_period))
         claim.metadata.finalizers.append("karpenter.sh/termination")
         claim.instance_type_options = list(plan.instance_type_names)
         self.kube.create(claim)

@@ -202,6 +202,10 @@ class GarbageCollector:
         # this same pass, and bound pods must never outlive their node.
         from .lifecycle import drain_node_pods
         for claim in self.kube.list("NodeClaim"):
+            if claim.metadata.deletion_timestamp is not None:
+                # already terminating: the Terminator owns its drain,
+                # metrics, and cleanup (it handles instance-gone itself)
+                continue
             if claim.launched and claim.provider_id \
                     and claim.provider_id not in live:
                 if claim.node_name:
@@ -251,13 +255,17 @@ ACTIONABLE_KINDS = {"spot_interruption", "rebalance_recommendation",
 class InterruptionController:
     def __init__(self, kube: FakeKube, sqs: SQSProvider,
                  unavailable_offerings, metrics=None, clock=time.time,
-                 recorder=None):
+                 recorder=None, ec2=None):
         self.kube = kube
         self.sqs = sqs
         self.unavailable = unavailable_offerings
         self.metrics = metrics
         self.clock = clock
         self.recorder = recorder
+        #: the fake cloud, for compressing AWS's spot reclaim into the
+        #: handling instant (see _handle) — None in unit tests that only
+        #: exercise message parsing
+        self.ec2 = ec2
 
     #: message-handling fan-out width (interruption/controller.go:116:
     #: workqueue.ParallelizeUntil(ctx, 10, ...))
@@ -321,6 +329,14 @@ class InterruptionController:
             if itype and zone:
                 self.unavailable.mark_unavailable(
                     L.CAPACITY_TYPE_SPOT, itype, zone, reason="SpotInterruption")
+            # EC2 reclaims a spot instance ~2 minutes after the warning
+            # regardless of drain progress; the fake environment has no
+            # independent AWS actor, so the reclaim is compressed into
+            # the handling instant. The terminator sees the instance
+            # gone and skips the (moot) ordered drain — upstream's
+            # instance-not-found cleanup path.
+            if self.ec2 is not None:
+                self.ec2.terminate_instances([msg.instance_id])
         self._publish_events(msg, claim)
         if msg.kind in ACTIONABLE_KINDS:
             # CordonAndDrain: delete the claim; termination drains + replaces
@@ -483,19 +499,33 @@ class NodeClassHashController:
         n = 0
         nodeclasses = {nc.metadata.name: nc
                        for nc in self.kube.list("EC2NodeClass")}
+        nodepools = {np.metadata.name: np
+                     for np in self.kube.list("NodePool")}
         for claim in self.kube.list("NodeClaim"):
             ann = claim.metadata.annotations
+            changed = False
             if ann.get(L.EC2NODECLASS_HASH_VERSION_ANNOTATION) \
-                    == L.EC2NODECLASS_HASH_VERSION:
-                continue
-            nc = nodeclasses.get(claim.node_class_ref.name)
-            if nc is None:
-                continue
-            ann[L.EC2NODECLASS_HASH_ANNOTATION] = nc.hash()
-            ann[L.EC2NODECLASS_HASH_VERSION_ANNOTATION] = \
-                L.EC2NODECLASS_HASH_VERSION
-            self.kube.update(claim)
-            n += 1
+                    != L.EC2NODECLASS_HASH_VERSION:
+                nc = nodeclasses.get(claim.node_class_ref.name)
+                if nc is not None:
+                    ann[L.EC2NODECLASS_HASH_ANNOTATION] = nc.hash()
+                    ann[L.EC2NODECLASS_HASH_VERSION_ANNOTATION] = \
+                        L.EC2NODECLASS_HASH_VERSION
+                    changed = True
+            # same upgrade-safety for the NODEPOOL static hash (core's
+            # nodepool-hash migration): a version bump restamps, so only
+            # real spec changes drift
+            if ann.get(L.NODEPOOL_HASH_VERSION_ANNOTATION) \
+                    != L.NODEPOOL_HASH_VERSION:
+                np = nodepools.get(claim.nodepool or "")
+                if np is not None:
+                    ann[L.NODEPOOL_HASH_ANNOTATION] = np.hash()
+                    ann[L.NODEPOOL_HASH_VERSION_ANNOTATION] = \
+                        L.NODEPOOL_HASH_VERSION
+                    changed = True
+            if changed:
+                self.kube.update(claim)
+                n += 1
         return n
 
 

@@ -202,7 +202,8 @@ class Operator:
                              cluster_name=self.options.cluster_name)
         self.interruption = InterruptionController(
             self.kube, self.sqs, self.unavailable_offerings,
-            metrics=self.metrics, clock=clock, recorder=self.recorder)
+            metrics=self.metrics, clock=clock, recorder=self.recorder,
+            ec2=self.ec2)
         self.catalog_controller = CatalogController(
             self.ec2, self.instance_types, metrics=self.metrics,
             unavailable_offerings=self.unavailable_offerings,

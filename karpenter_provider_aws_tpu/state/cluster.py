@@ -91,8 +91,17 @@ class ClusterState:
         by_node = self.bound_pods_by_node()
         out: List[ExistingNode] = []
         seen_provider_ids = set()
+        # nodes whose claim is deleting are mid-drain: they must not be
+        # scheduling targets (core MarkForDeletion semantics) or the
+        # solver re-binds just-evicted pods onto the doomed node
+        deleting = {c.node_name for c in self.kube.list("NodeClaim")
+                    if c.metadata.deletion_timestamp is not None
+                    and c.node_name}
         for node in self.kube.list("Node"):
             if not node.ready:
+                continue
+            if node.name in deleting \
+                    or node.metadata.deletion_timestamp is not None:
                 continue
             pods = by_node.get(node.name, [])
             out.append(ExistingNode(

@@ -25,7 +25,8 @@ def op(ec2):
 
 def mk_cluster(op: Operator, pool_name="default", requirements=(),
                nodeclass: EC2NodeClass = None, nodeclass_name="default-class",
-               expire_after=None, **pool_kwargs):
+               expire_after=None, termination_grace_period=None,
+               **pool_kwargs):
     """Default NodePool + EC2NodeClass pair (env.DefaultEC2NodeClass /
     env.DefaultNodePool in the reference's suite bootstrap)."""
     nc = nodeclass or EC2NodeClass(nodeclass_name)
@@ -33,7 +34,8 @@ def mk_cluster(op: Operator, pool_name="default", requirements=(),
     np = NodePool(pool_name, template=NodePoolTemplate(
         node_class_ref=NodeClassRef(nc.metadata.name),
         requirements=Requirements.from_terms(list(requirements)),
-        expire_after=expire_after),
+        expire_after=expire_after,
+        termination_grace_period=termination_grace_period),
         **pool_kwargs)
     op.kube.create(np)
     return np, nc

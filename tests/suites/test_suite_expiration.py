@@ -97,14 +97,46 @@ class TestExpiration:
         live = {c.name for c in op.kube.list("NodeClaim")}
         assert live and not (live & before)
 
-    def test_do_not_disrupt_does_not_block_expiration(self, op, clock):
+    def test_do_not_disrupt_does_not_block_expiration_decision(self, op,
+                                                               clock):
         """expiration is FORCEFUL (not budgeted, not blocked by
-        do-not-disrupt — disruption.py _expire): a pod annotation that
-        blocks consolidation does not pin an expired node forever."""
+        do-not-disrupt — disruption.py _expire): the expired claim is
+        DELETED (deletion timestamp set) despite the annotation. The
+        drain itself still waits on the do-not-disrupt pod — upstream's
+        documented split (disruption.md:173,207: forceful methods begin
+        draining immediately; 'Pods blocking eviction like PDBs and
+        do-not-disrupt will block full draining until the
+        terminationGracePeriod is reached')."""
         from karpenter_provider_aws_tpu.controllers.disruption import \
             DO_NOT_DISRUPT_ANNOTATION
         mk_cluster(op, expire_after=600.0)
         p = make_pods(1, cpu="500m", memory="1Gi", prefix="pinexp")[0]
+        p.metadata.annotations[DO_NOT_DISRUPT_ANNOTATION] = "true"
+        op.kube.create(p)
+        op.run_until_settled()
+        before = {c.name for c in op.kube.list("NodeClaim")}
+        clock.advance(1200)
+        for _ in range(15):
+            op.run_until_settled()
+            clock.advance(60)
+        expired = [c for c in op.kube.list("NodeClaim")
+                   if c.name in before]
+        # the decision went through: every old claim is terminating
+        assert all(c.metadata.deletion_timestamp is not None
+                   for c in expired)
+        # ...but the do-not-disrupt pod blocks the final cleanup
+        # (no terminationGracePeriod on this pool)
+        assert p.node_name  # still bound to the doomed node
+
+    def test_tgp_unpins_do_not_disrupt_after_expiration(self, op, clock):
+        """expireAfter + terminationGracePeriod is upstream's 'absolute
+        maximum node lifetime' recipe (disruption.md:207-209): the
+        expired node drains its do-not-disrupt pod once the grace period
+        elapses, and the claim rolls completely."""
+        from karpenter_provider_aws_tpu.controllers.disruption import \
+            DO_NOT_DISRUPT_ANNOTATION
+        mk_cluster(op, expire_after=600.0, termination_grace_period=120.0)
+        p = make_pods(1, cpu="500m", memory="1Gi", prefix="tgpexp")[0]
         p.metadata.annotations[DO_NOT_DISRUPT_ANNOTATION] = "true"
         op.kube.create(p)
         op.run_until_settled()

@@ -130,6 +130,101 @@ class TestNodeLevelDoNotDisrupt:
         assert len(op.kube.list("Node")) == n
 
 
+class TestOrderedDrain:
+    """Pods drain from a doomed node in four groups, each fully removed
+    before the next (termination_test.go:56-61): non-critical
+    non-daemonset, non-critical daemonset, critical non-daemonset,
+    critical daemonset."""
+
+    def _bound(self, op, node):
+        return sorted(p.metadata.name for p in op.kube.list("Pod")
+                      if p.node_name == node
+                      and p.phase not in ("Succeeded", "Failed"))
+
+    def _doomed_node(self, op):
+        """One provisioned node carrying a pod of every drain group."""
+        from karpenter_provider_aws_tpu.apis.objects import Pod
+        mk_cluster(op)
+        for p in make_pods(2, cpu="500m", memory="1Gi", prefix="plain"):
+            op.kube.create(p)
+        op.run_until_settled()
+        node = op.kube.list("Node")[0].name
+        # the DS controller's work, done by hand: bind one pod per
+        # remaining group straight onto the node
+        extras = [
+            Pod("ds-a", owner_kind="DaemonSet",
+                node_name=node, phase="Running"),
+            Pod("crit-a",
+                priority_class_name="system-cluster-critical",
+                node_name=node, phase="Running"),
+            Pod("crit-ds-a", owner_kind="DaemonSet",
+                priority_class_name="system-node-critical",
+                node_name=node, phase="Running"),
+        ]
+        for p in extras:
+            op.kube.create(p)
+        claim = next(c for c in op.kube.list("NodeClaim")
+                     if c.node_name == node)
+        return node, claim
+
+    def test_drain_groups_in_order(self, op, clock):
+        node, claim = self._doomed_node(op)
+        op.kube.delete("NodeClaim", claim.name)
+        op.step()  # round 1: the plain (non-critical non-DS) pods go
+        assert self._bound(op, node) == ["crit-a", "crit-ds-a", "ds-a"]
+        op.step()  # round 2: non-critical daemonset
+        assert self._bound(op, node) == ["crit-a", "crit-ds-a"]
+        op.step()  # round 3: critical non-daemonset
+        assert self._bound(op, node) == ["crit-ds-a"]
+        op.step()  # round 4: critical daemonset — drain complete
+        assert self._bound(op, node) == []
+        op.run_until_settled()
+        assert op.kube.try_get("Node", node) is None
+
+    def test_do_not_disrupt_pod_blocks_drain_without_tgp(self, op, clock):
+        """A do-not-disrupt pod holds a deleting node indefinitely when
+        no terminationGracePeriod is set."""
+        node, claim = self._doomed_node(op)
+        pod = next(p for p in op.kube.list("Pod")
+                   if p.node_name == node
+                   and p.metadata.name.startswith("plain"))
+        pod.metadata.annotations["karpenter.sh/do-not-disrupt"] = "true"
+        op.kube.update(pod)
+        op.kube.delete("NodeClaim", claim.name)
+        for _ in range(6):
+            op.step()
+            clock.advance(600)
+        # everything else drained around it; the DND pod pins the node
+        assert self._bound(op, node) == [pod.metadata.name]
+        assert op.kube.try_get("Node", node) is not None
+
+    def test_tgp_force_drains_do_not_disrupt(self, op, clock):
+        """should delete pod with do-not-disrupt when it reaches its
+        terminationGracePeriodSeconds
+        (termination_grace_period_test.go:37): the claim's
+        terminationGracePeriod bypasses do-not-disrupt."""
+        from karpenter_provider_aws_tpu.apis.objects import Pod
+        mk_cluster(op, termination_grace_period=300)
+        for p in make_pods(2, cpu="500m", memory="1Gi", prefix="plain"):
+            op.kube.create(p)
+        op.run_until_settled()
+        node = op.kube.list("Node")[0].name
+        dnd = Pod("dnd-pinned", node_name=node, phase="Running")
+        dnd.metadata.annotations["karpenter.sh/do-not-disrupt"] = "true"
+        op.kube.create(dnd)
+        claim = next(c for c in op.kube.list("NodeClaim")
+                     if c.node_name == node)
+        assert claim.termination_grace_period == 300  # template threaded
+        op.kube.delete("NodeClaim", claim.name)
+        op.step()
+        assert "dnd-pinned" in self._bound(op, node)  # blocked pre-TGP
+        clock.advance(301)
+        op.step()
+        assert self._bound(op, node) == []  # TGP bypassed do-not-disrupt
+        op.run_until_settled()
+        assert op.kube.try_get("Node", node) is None
+
+
 class TestNodeDeletion:
     def test_terminate_node_and_instance_on_deletion(self, op):
         """should terminate the node and the instance on deletion; pods

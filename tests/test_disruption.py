@@ -281,6 +281,51 @@ class TestDriftDisruption:
             assert c.metadata.annotations[L.NODEPOOL_HASH_ANNOTATION] == np.hash()
 
 
+class TestTGPDriftAndHashVersion:
+    def test_tgp_change_drifts_existing_claims(self, op, clock):
+        """terminationGracePeriod is in the static drift hash: setting
+        it on a live pool rolls existing claims (the unpin-a-DND-node
+        recipe needs the new TGP to actually reach nodes)."""
+        np, _ = mk_cluster(op)
+        for p in make_pods(2, cpu="500m", memory="1Gi", prefix="tg"):
+            op.kube.create(p)
+        op.run_until_settled()
+        old = {c.name for c in op.kube.list("NodeClaim")}
+        np.template.termination_grace_period = 900.0
+        op.kube.update(np)
+        settle(op, clock, rounds=10)
+        assert not (old & {c.name for c in op.kube.list("NodeClaim")})
+        for c in op.kube.list("NodeClaim"):
+            assert c.termination_grace_period == 900.0
+
+    def test_old_hash_version_restamps_without_drift(self, op, clock):
+        """a hash-VERSION bump alone must not drift anything
+        (nodeclass/hash/controller.go:41-47 applied to the nodepool
+        hash): old-version claims get the fresh hash + version stamped
+        and stay."""
+        mk_cluster(op)
+        for p in make_pods(2, cpu="500m", memory="1Gi", prefix="hv"):
+            op.kube.create(p)
+        op.run_until_settled()
+        claims = op.kube.list("NodeClaim")
+        for c in claims:
+            # simulate claims stamped by the previous release
+            c.metadata.annotations[L.NODEPOOL_HASH_VERSION_ANNOTATION] = \
+                "v3"
+            c.metadata.annotations[L.NODEPOOL_HASH_ANNOTATION] = \
+                "stale-v3-hash"
+            op.kube.update(c)
+        before = {c.name for c in claims}
+        settle(op, clock, rounds=6)
+        after = {c.name for c in op.kube.list("NodeClaim")}
+        assert before == after  # restamped, not rolled
+        for c in op.kube.list("NodeClaim"):
+            ann = c.metadata.annotations
+            assert ann[L.NODEPOOL_HASH_VERSION_ANNOTATION] \
+                == L.NODEPOOL_HASH_VERSION
+            assert ann[L.NODEPOOL_HASH_ANNOTATION] != "stale-v3-hash"
+
+
 def _total_price(op):
     total = 0
     for claim in op.kube.list("NodeClaim"):
