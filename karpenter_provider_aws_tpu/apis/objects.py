@@ -268,11 +268,36 @@ class Pod(KubeObject):
 class DisruptionBudget:
     nodes: str = "10%"           # count or percentage
     reasons: Optional[List[str]] = None  # None => all reasons
-    schedule: Optional[str] = None       # cron, unsupported-for-now -> always
+    #: upstream cronjob syntax (plus @-shortcuts), naive UTC; paired
+    #: with duration by validation. None => always active
+    schedule: Optional[str] = None
+    #: seconds; the CRD's "8h"/"1h30m" string form is normalized to
+    #: seconds at construction (__post_init__)
     duration: Optional[float] = None
+
+    def __post_init__(self):
+        if isinstance(self.duration, str):
+            from ..utils.cron import parse_duration
+            self.duration = parse_duration(self.duration)
 
     def allows(self, reason: str) -> bool:
         return self.reasons is None or reason in self.reasons
+
+    def active(self, now: float) -> bool:
+        """Schedule window: active from each schedule firing until
+        firing + duration (core budget semantics; the CRD documents the
+        syntax at karpenter.sh_nodepools.yaml:126-133)."""
+        if self.schedule is None:
+            return True
+        from ..utils.cron import Cron
+        cron = getattr(self, "_cron", None)
+        if cron is None or getattr(self, "_cron_src", None) != self.schedule:
+            cron = Cron(self.schedule)
+            self._cron = cron
+            self._cron_src = self.schedule
+        fire = cron.most_recent_fire(now)
+        return fire is not None and self.duration is not None \
+            and now < fire + self.duration
 
     def max_disruptions(self, total_nodes: int) -> int:
         s = self.nodes.strip()

@@ -157,6 +157,13 @@ def validate_nodepool(np) -> None:
             raise ValidationError("'schedule' must be set with 'duration'")
         if not _BUDGET_NODES_RE.match(b.nodes.strip()):
             raise ValidationError(f"invalid budget nodes value {b.nodes!r}")
+        if b.schedule is not None:
+            from ..utils.cron import Cron, CronError, parse_duration
+            try:
+                Cron(b.schedule)
+                parse_duration(b.duration)
+            except CronError as e:
+                raise ValidationError(f"invalid budget schedule: {e}")
 
 
 def validate_nodeclaim(nc) -> None:

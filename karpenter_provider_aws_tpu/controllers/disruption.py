@@ -572,9 +572,12 @@ class DisruptionController:
                         if c.nodepool.name == pool_name)
             total, disrupting = self._pool_counts(pool_name)
             allowed = total  # no budgets => everything allowed
+            now = self.clock()
             for budget in pool.disruption.budgets:
                 if not budget.allows(reason):
                     continue
+                if not budget.active(now):
+                    continue  # outside its schedule+duration window
                 allowed = min(allowed, budget.max_disruptions(total))
             if disrupting + want > allowed:
                 return False

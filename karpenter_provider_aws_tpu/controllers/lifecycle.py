@@ -169,8 +169,7 @@ CRITICAL_PRIORITY_CLASSES = ("system-cluster-critical",
 
 
 def _drain_group(pod) -> int:
-    critical = getattr(pod, "priority_class_name", "") \
-        in CRITICAL_PRIORITY_CLASSES
+    critical = pod.priority_class_name in CRITICAL_PRIORITY_CLASSES
     daemon = pod.owner_kind == "DaemonSet"
     return (2 if critical else 0) + (1 if daemon else 0)
 

@@ -485,7 +485,7 @@ class PricingController:
         return a or b
 
 
-class NodeClassHashController:
+class StaticHashController:
     """Re-stamp NodeClaim hash annotations when the hash VERSION bumps
     (nodeclass/hash/controller.go:41-47): a framework upgrade that changes
     how the static-field hash is computed must not report every node as

@@ -24,7 +24,7 @@ from .controllers.steady_state import (CatalogController,
                                        DiscoveredCapacityController,
                                        GarbageCollector,
                                        InterruptionController,
-                                       NodeClassHashController,
+                                       StaticHashController,
                                        NodeClassStatusController,
                                        PricingController,
                                        SSMInvalidationController, Tagger,
@@ -209,7 +209,7 @@ class Operator:
             unavailable_offerings=self.unavailable_offerings,
             pricing=self.pricing)
         self.pricing_controller = PricingController(self.pricing)
-        self.nodeclass_hash = NodeClassHashController(self.kube)
+        self.nodeclass_hash = StaticHashController(self.kube)
         self.discovered_capacity = DiscoveredCapacityController(
             self.kube, self.instance_types)
         self.ssm_invalidation = SSMInvalidationController(

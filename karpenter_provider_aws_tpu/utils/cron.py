@@ -1,0 +1,145 @@
+"""Upstream-cronjob-syntax schedules for disruption budgets.
+
+A budget with ``schedule`` + ``duration`` is active from each schedule
+firing until ``firing + duration`` (core NodePool budget semantics —
+the reference documents the syntax and the no-timezone rule in
+karpenter.sh_nodepools.yaml:126-133). Times are naive UTC, matching
+"Timezones are not supported".
+
+Five standard fields (minute hour day-of-month month day-of-week) plus
+the @-shortcuts. The classic cron quirk is preserved: when BOTH
+day-of-month and day-of-week are restricted, a day matches if EITHER
+does.
+"""
+
+from __future__ import annotations
+
+import re
+from datetime import datetime, timedelta, timezone
+from typing import Optional, Set, Tuple
+
+_SHORTCUTS = {
+    "@annually": "0 0 1 1 *",
+    "@yearly": "0 0 1 1 *",
+    "@monthly": "0 0 1 * *",
+    "@weekly": "0 0 * * 0",
+    "@daily": "0 0 * * *",
+    "@midnight": "0 0 * * *",
+    "@hourly": "0 * * * *",
+}
+
+_RANGES = ((0, 59), (0, 23), (1, 31), (1, 12), (0, 6))
+
+_MONTH_NAMES = {n: i + 1 for i, n in enumerate(
+    "jan feb mar apr may jun jul aug sep oct nov dec".split())}
+_DOW_NAMES = {n: i for i, n in enumerate(
+    "sun mon tue wed thu fri sat".split())}
+
+
+class CronError(ValueError):
+    pass
+
+
+def _parse_field(spec: str, lo: int, hi: int, names) -> Tuple[Set[int], bool]:
+    """-> (allowed values, was-unrestricted)."""
+    if spec == "*":
+        return set(range(lo, hi + 1)), True
+    out: Set[int] = set()
+    for part in spec.split(","):
+        step = 1
+        if "/" in part:
+            part, step_s = part.split("/", 1)
+            if not step_s.isdigit() or int(step_s) < 1:
+                raise CronError(f"invalid step {step_s!r}")
+            step = int(step_s)
+        if part == "*":
+            a, b = lo, hi
+        elif "-" in part:
+            a_s, b_s = part.split("-", 1)
+            a, b = _parse_value(a_s, names), _parse_value(b_s, names)
+        else:
+            a = b = _parse_value(part, names)
+            if step > 1:  # "5/15" means 5-hi/15 in cron
+                b = hi
+        if not (lo <= a <= hi and lo <= b <= hi and a <= b):
+            raise CronError(f"value out of range in {spec!r}")
+        out.update(range(a, b + 1, step))
+    return out, False
+
+
+def _parse_value(s: str, names) -> int:
+    s = s.strip().lower()
+    if names and s in names:
+        return names[s]
+    if not s.isdigit():
+        raise CronError(f"invalid value {s!r}")
+    v = int(s)
+    if names is _DOW_NAMES and v == 7:  # both 0 and 7 mean Sunday
+        return 0
+    return v
+
+
+class Cron:
+    def __init__(self, expr: str):
+        expr = expr.strip()
+        expr = _SHORTCUTS.get(expr.lower(), expr)
+        fields = expr.split()
+        if len(fields) != 5:
+            raise CronError(f"expected 5 fields, got {len(fields)}: {expr!r}")
+        namemaps = (None, None, None, _MONTH_NAMES, _DOW_NAMES)
+        parsed = [_parse_field(f, lo, hi, nm)
+                  for f, (lo, hi), nm in zip(fields, _RANGES, namemaps)]
+        (self.minutes, _), (self.hours, _) = parsed[0], parsed[1]
+        (self.doms, self.dom_star) = parsed[2]
+        (self.months, _) = parsed[3]
+        (self.dows, self.dow_star) = parsed[4]
+        self._minutes_desc = sorted(self.minutes, reverse=True)
+        self._hours_desc = sorted(self.hours, reverse=True)
+
+    def _day_matches(self, d) -> bool:
+        if d.month not in self.months:
+            return False
+        dow = (d.weekday() + 1) % 7  # python Mon=0 -> cron Sun=0
+        dom_ok = d.day in self.doms
+        dow_ok = dow in self.dows
+        if not self.dom_star and not self.dow_star:
+            return dom_ok or dow_ok  # the classic either-matches quirk
+        return dom_ok and dow_ok
+
+    def most_recent_fire(self, now: float) -> Optional[float]:
+        """Unix time of the latest firing <= ``now`` (naive UTC), or
+        None if none in the past 366 days (cannot happen for a valid
+        spec, which fires at least yearly)."""
+        t = datetime.fromtimestamp(now, tz=timezone.utc)
+        for day_off in range(367):
+            d = (t - timedelta(days=day_off)).date()
+            if not self._day_matches(d):
+                continue
+            max_h = t.hour if day_off == 0 else 23
+            for h in self._hours_desc:
+                if h > max_h:
+                    continue
+                max_m = t.minute if day_off == 0 and h == t.hour else 59
+                for m in self._minutes_desc:
+                    if m <= max_m:
+                        return datetime(
+                            d.year, d.month, d.day, h, m,
+                            tzinfo=timezone.utc).timestamp()
+        return None
+
+
+_DUR_RE = re.compile(r"^(?:(\d+)h)?(?:(\d+)m)?(?:0s)?$")
+
+
+def parse_duration(d) -> Optional[float]:
+    """Budget duration -> seconds. Accepts float seconds (the model's
+    native type) or the CRD's go-duration subset ("8h", "30m",
+    "1h30m" — karpenter.sh_nodepools.yaml duration pattern)."""
+    if d is None:
+        return None
+    if isinstance(d, (int, float)):
+        return float(d)
+    m = _DUR_RE.match(d.strip())
+    if not m or not (m.group(1) or m.group(2)):
+        raise CronError(f"invalid duration {d!r}")
+    return float(int(m.group(1) or 0) * 3600 + int(m.group(2) or 0) * 60)

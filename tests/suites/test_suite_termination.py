@@ -76,6 +76,32 @@ class TestEmptiness:
             clock.advance(60)
         assert len(op.kube.list("Node")) == n  # nothing disrupted
 
+    def test_blocking_budget_during_scheduled_time(self, op, clock):
+        """should not allow emptiness if the budget is fully blocking
+        during a scheduled time (emptiness_test.go:73): nodes='0' with
+        schedule+duration blocks only inside the window."""
+        from datetime import datetime, timezone
+
+        # pin the fake clock inside a 09:00+8h UTC window
+        clock.t = datetime(2026, 7, 31, 12, 0,
+                           tzinfo=timezone.utc).timestamp()
+        n = empty_node_cluster(op, clock, disruption=Disruption(
+            budgets=[DisruptionBudget(nodes="0", schedule="0 9 * * *",
+                                      duration="8h")]))
+        for _ in range(5):
+            op.run_until_settled()
+            clock.advance(60)
+        assert len(op.kube.list("Node")) == n  # blocked inside window
+        # jump past the window's close (17:00) — emptiness may proceed
+        clock.t = datetime(2026, 7, 31, 17, 30,
+                           tzinfo=timezone.utc).timestamp()
+        for _ in range(10):
+            op.run_until_settled()
+            clock.advance(60)
+            if not op.kube.list("Node"):
+                break
+        assert op.kube.list("Node") == []
+
     def test_budget_limits_disruption_rate(self, op, clock):
         """a count budget of 1 disrupts at most one node per round."""
         n = empty_node_cluster(op, clock, disruption=Disruption(

@@ -1,0 +1,103 @@
+"""Budget schedule engine (utils/cron.py): upstream cronjob syntax,
+naive UTC, the dom/dow either-matches quirk, and the active-window
+semantics budgets consume (karpenter.sh_nodepools.yaml:126-133)."""
+
+from datetime import datetime, timezone
+
+import pytest
+
+from karpenter_provider_aws_tpu.apis.objects import DisruptionBudget
+from karpenter_provider_aws_tpu.utils.cron import (Cron, CronError,
+                                                   parse_duration)
+
+
+def ts(y, mo, d, h=0, m=0):
+    return datetime(y, mo, d, h, m, tzinfo=timezone.utc).timestamp()
+
+
+class TestParse:
+    def test_shortcuts(self):
+        assert Cron("@daily").most_recent_fire(ts(2026, 7, 31, 13, 5)) \
+            == ts(2026, 7, 31)
+        assert Cron("@hourly").most_recent_fire(ts(2026, 7, 31, 13, 5)) \
+            == ts(2026, 7, 31, 13)
+        assert Cron("@weekly").most_recent_fire(ts(2026, 7, 31, 13, 5)) \
+            == ts(2026, 7, 26)  # Sunday
+        assert Cron("@monthly").most_recent_fire(ts(2026, 7, 31)) \
+            == ts(2026, 7, 1)
+        assert Cron("@yearly").most_recent_fire(ts(2026, 7, 31)) \
+            == ts(2026, 1, 1)
+
+    def test_steps_ranges_lists(self):
+        c = Cron("*/15 9-17 * * 1-5")
+        # Friday 2026-07-31 13:05 -> 13:00 is within window
+        assert c.most_recent_fire(ts(2026, 7, 31, 13, 5)) \
+            == ts(2026, 7, 31, 13, 0)
+        # Sunday morning -> falls back to Friday 17:45
+        assert c.most_recent_fire(ts(2026, 8, 2, 7, 0)) \
+            == ts(2026, 7, 31, 17, 45)
+        c2 = Cron("0 0,12 * * *")
+        assert c2.most_recent_fire(ts(2026, 7, 31, 11, 59)) \
+            == ts(2026, 7, 31, 0, 0)
+
+    def test_names_and_sunday_seven(self):
+        assert Cron("0 9 * * sun").most_recent_fire(
+            ts(2026, 7, 31)) == ts(2026, 7, 26, 9)
+        assert Cron("0 9 * * 7").most_recent_fire(
+            ts(2026, 7, 31)) == ts(2026, 7, 26, 9)
+        assert Cron("0 0 1 jan *").most_recent_fire(
+            ts(2026, 7, 31)) == ts(2026, 1, 1)
+
+    def test_dom_dow_either_quirk(self):
+        # both restricted: the 15th OR a Monday fires
+        c = Cron("0 0 15 * 1")
+        # 2026-07-31 is Friday; most recent = Mon Jul 27 (after the 15th)
+        assert c.most_recent_fire(ts(2026, 7, 31)) == ts(2026, 7, 27)
+
+    def test_rejects_garbage(self):
+        for bad in ("* * * *", "61 * * * *", "* 25 * * *", "a b c d e",
+                    "*/0 * * * *"):
+            with pytest.raises(CronError):
+                Cron(bad)
+
+    def test_durations(self):
+        assert parse_duration("8h") == 8 * 3600
+        assert parse_duration("30m") == 1800
+        assert parse_duration("1h30m") == 5400
+        assert parse_duration(90.0) == 90.0
+        with pytest.raises(CronError):
+            parse_duration("ten minutes")
+
+
+class TestBudgetWindow:
+    def test_active_within_window_only(self):
+        b = DisruptionBudget(nodes="0", schedule="0 9 * * *",
+                             duration="8h")
+        assert b.active(ts(2026, 7, 31, 9, 0))
+        assert b.active(ts(2026, 7, 31, 16, 59))
+        assert not b.active(ts(2026, 7, 31, 17, 0))  # window closed
+        assert not b.active(ts(2026, 7, 31, 8, 59))  # not yet open
+
+    def test_no_schedule_always_active(self):
+        assert DisruptionBudget(nodes="1").active(ts(2026, 1, 1))
+
+    def test_float_duration_seconds(self):
+        b = DisruptionBudget(nodes="0", schedule="@hourly",
+                             duration=600.0)
+        assert b.active(ts(2026, 7, 31, 13, 9))
+        assert not b.active(ts(2026, 7, 31, 13, 11))
+
+    def test_validation_rejects_bad_schedule(self):
+        from karpenter_provider_aws_tpu.apis.objects import (
+            Disruption, NodeClassRef, NodePool, NodePoolTemplate)
+        from karpenter_provider_aws_tpu.apis.requirements import \
+            Requirements
+        from karpenter_provider_aws_tpu.apis.validation import (
+            ValidationError, validate_nodepool)
+        np = NodePool("p", template=NodePoolTemplate(
+            node_class_ref=NodeClassRef("c"),
+            requirements=Requirements()),
+            disruption=Disruption(budgets=[DisruptionBudget(
+                nodes="0", schedule="not a cron", duration="1h")]))
+        with pytest.raises(ValidationError):
+            validate_nodepool(np)

@@ -7,7 +7,7 @@ import pytest
 from karpenter_provider_aws_tpu.apis import labels as L
 from karpenter_provider_aws_tpu.apis.objects import EC2NodeClass
 from karpenter_provider_aws_tpu.controllers.steady_state import (
-    DiscoveredCapacityController, NodeClassHashController,
+    DiscoveredCapacityController, StaticHashController,
     SSMInvalidationController, VersionController)
 from karpenter_provider_aws_tpu.fake.ec2 import FakeEC2
 from karpenter_provider_aws_tpu.fake.environment import make_pods
@@ -49,7 +49,7 @@ class TestSSMProvider:
         assert len(ssm.cached()) == 1
 
 
-class TestNodeClassHashController:
+class TestStaticHashController:
     def test_restamps_old_version(self):
         op = Operator()
         nc = EC2NodeClass("nc1")
@@ -63,14 +63,14 @@ class TestNodeClassHashController:
         claim.metadata.annotations[
             L.EC2NODECLASS_HASH_VERSION_ANNOTATION] = "v3"
         op.kube.create(claim)
-        assert NodeClassHashController(op.kube).reconcile() == 1
+        assert StaticHashController(op.kube).reconcile() == 1
         got = op.kube.get("NodeClaim", "c1")
         ann = got.metadata.annotations
         assert ann[L.EC2NODECLASS_HASH_ANNOTATION] == nc.hash()
         assert ann[L.EC2NODECLASS_HASH_VERSION_ANNOTATION] == \
             L.EC2NODECLASS_HASH_VERSION
         # second pass is a no-op
-        assert NodeClassHashController(op.kube).reconcile() == 0
+        assert StaticHashController(op.kube).reconcile() == 0
 
     def test_current_version_untouched(self):
         op = Operator()
@@ -85,7 +85,7 @@ class TestNodeClassHashController:
         claim.metadata.annotations[L.EC2NODECLASS_HASH_VERSION_ANNOTATION] = \
             L.EC2NODECLASS_HASH_VERSION
         op.kube.create(claim)
-        assert NodeClassHashController(op.kube).reconcile() == 0
+        assert StaticHashController(op.kube).reconcile() == 0
         assert op.kube.get("NodeClaim", "c2").metadata.annotations[
             L.EC2NODECLASS_HASH_ANNOTATION] == "keep"
 
