@@ -176,7 +176,8 @@ class Pod(KubeObject):
                  scheduling_group: str = "",
                  volume_claims: Sequence[str] = (),
                  ephemeral_volumes: Sequence[Tuple[str, str]] = (),
-                 priority_class_name: str = ""):
+                 priority_class_name: str = "",
+                 termination_grace_period_seconds: float = 30.0):
         # sort identity, set eagerly: canonical grouping sorts millions
         # of pods by this key per solve — an instance attribute lets the
         # hot sort use operator.attrgetter (C speed) instead of a
@@ -207,6 +208,12 @@ class Pod(KubeObject):
         #: system-node-critical / system-cluster-critical pods drain
         #: LAST (the terminator's drain order)
         self.priority_class_name = priority_class_name
+        #: k8s spec.terminationGracePeriodSeconds (default 30): on a
+        #: node with a terminationGracePeriod, a blocked pod is
+        #: force-deleted early enough to receive this full window
+        #: (karpenter.sh_nodepools.yaml:416)
+        self.termination_grace_period_seconds = \
+            termination_grace_period_seconds
 
     def apply_volume_constraints(self, reqs: "Requirements",
                                  n_volumes: int) -> None:

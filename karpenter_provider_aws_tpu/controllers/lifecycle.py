@@ -205,19 +205,32 @@ class Terminator:
         if not bound:
             return True
         tgp = claim.termination_grace_period
-        forced = tgp is not None and \
-            self.clock() - claim.metadata.deletion_timestamp >= tgp
-        if forced:
+        now = self.clock()
+        deadline = None if tgp is None \
+            else claim.metadata.deletion_timestamp + tgp
+        if deadline is not None and now >= deadline:
             victims = bound
         else:
-            evictable = [
-                p for p in bound
-                if p.metadata.annotations.get(
-                    L.DO_NOT_DISRUPT_ANNOTATION) != "true"]
-            if not evictable:
+            blocked, evictable = [], []
+            for p in bound:
+                (blocked if p.metadata.annotations.get(
+                    L.DO_NOT_DISRUPT_ANNOTATION) == "true"
+                 else evictable).append(p)
+            # preemptive deletion (karpenter.sh_nodepools.yaml:416): a
+            # blocked pod is force-deleted early enough that its own
+            # terminationGracePeriodSeconds still fits before the
+            # node's deadline. Deadline-driven, so it BYPASSES the
+            # drain-group order — waiting behind earlier groups would
+            # eat into the very window the preemption exists to protect
+            victims = [] if deadline is None else [
+                p for p in blocked
+                if now >= deadline - p.termination_grace_period_seconds]
+            if not evictable and not victims:
                 return False  # do-not-disrupt pods hold the node
-            first = min(_drain_group(p) for p in evictable)
-            victims = [p for p in evictable if _drain_group(p) == first]
+            if evictable:
+                first = min(_drain_group(p) for p in evictable)
+                victims += [p for p in evictable
+                            if _drain_group(p) == first]
         for p in victims:
             _release_pod(self.kube, p)
         if self.metrics is not None and victims:

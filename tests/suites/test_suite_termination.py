@@ -224,6 +224,65 @@ class TestOrderedDrain:
         assert self._bound(op, node) == [pod.metadata.name]
         assert op.kube.try_get("Node", node) is not None
 
+    def test_preemptive_deletion_honors_pod_grace_period(self, op, clock):
+        """'Karpenter will preemptively delete pods so their
+        terminationGracePeriodSeconds align with the node's
+        terminationGracePeriod' (karpenter.sh_nodepools.yaml:416): a
+        blocked pod with TGPS=120 on a TGP=300 node is force-deleted at
+        deadline-120, not at the deadline."""
+        from karpenter_provider_aws_tpu.apis.objects import Pod
+        mk_cluster(op, termination_grace_period=300)
+        for p in make_pods(1, cpu="500m", memory="1Gi", prefix="pre"):
+            op.kube.create(p)
+        op.run_until_settled()
+        node = op.kube.list("Node")[0].name
+        dnd = Pod("pre-dnd", node_name=node, phase="Running",
+                  termination_grace_period_seconds=120.0)
+        dnd.metadata.annotations["karpenter.sh/do-not-disrupt"] = "true"
+        op.kube.create(dnd)
+        claim = next(c for c in op.kube.list("NodeClaim")
+                     if c.node_name == node)
+        op.kube.delete("NodeClaim", claim.name)
+        op.step()
+        clock.advance(150)  # t=150 < 300-120: pod still protected
+        op.step()
+        assert "pre-dnd" in self._bound(op, node)
+        clock.advance(40)   # t=190 >= 180 = 300-120: preempted now
+        op.step()
+        assert "pre-dnd" not in self._bound(op, node)
+        op.run_until_settled()
+        assert op.kube.try_get("Node", node) is None
+
+    def test_preemption_bypasses_drain_group_order(self, op, clock):
+        """Preemptive deletion is deadline-driven: a blocked CRITICAL
+        pod whose preemption time arrives is deleted even while an
+        earlier drain group still holds pods — queueing behind group
+        order would eat the very grace window preemption protects."""
+        from karpenter_provider_aws_tpu.apis.objects import Pod
+        mk_cluster(op, termination_grace_period=300)
+        for p in make_pods(1, cpu="500m", memory="1Gi", prefix="byp"):
+            op.kube.create(p)
+        op.run_until_settled()
+        node = op.kube.list("Node")[0].name
+        hold0 = Pod("byp-hold0", node_name=node, phase="Running",
+                    termination_grace_period_seconds=10.0)  # due at 290
+        hold0.metadata.annotations["karpenter.sh/do-not-disrupt"] = "true"
+        crit = Pod("byp-crit", node_name=node, phase="Running",
+                   owner_kind="DaemonSet",
+                   priority_class_name="system-node-critical",
+                   termination_grace_period_seconds=120.0)  # due at 180
+        crit.metadata.annotations["karpenter.sh/do-not-disrupt"] = "true"
+        op.kube.create(hold0); op.kube.create(crit)
+        claim = next(c for c in op.kube.list("NodeClaim")
+                     if c.node_name == node)
+        op.kube.delete("NodeClaim", claim.name)
+        op.step()
+        clock.advance(200)  # past crit's 180 preempt point, before 290
+        op.step()
+        b = self._bound(op, node)
+        assert "byp-crit" not in b, b   # group-2 pod preempted on time
+        assert "byp-hold0" in b, b      # group-0 blocker still protected
+
     def test_tgp_force_drains_do_not_disrupt(self, op, clock):
         """should delete pod with do-not-disrupt when it reaches its
         terminationGracePeriodSeconds
