@@ -67,6 +67,9 @@ class FakeImage:
     deprecated: bool = False
     tags: Dict[str, str] = field(default_factory=dict)
     ssm_alias: str = ""            # e.g. "al2023@latest/amd64"
+    #: "self" (account-owned), "amazon" (EKS public), or an account id —
+    #: name-based discovery defaults to self+amazon (ami.go:112-116)
+    owner: str = "amazon"
 
 
 @dataclass
@@ -329,11 +332,14 @@ class FakeEC2:
 
     def describe_images(self, tag_filters: Mapping[str, str] = (),
                         ids: Sequence[str] = (),
-                        names: Sequence[str] = ()) -> List[FakeImage]:
+                        names: Sequence[str] = (),
+                        owners: Sequence[str] = ()) -> List[FakeImage]:
         with self._mu:
             out = []
             for img in self.images.values():
                 if names and img.name not in names:
+                    continue
+                if owners and img.owner not in owners:
                     continue
                 if _match(img.tags, tag_filters, img.id, ids):
                     out.append(img)

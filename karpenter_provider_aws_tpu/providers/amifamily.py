@@ -56,10 +56,17 @@ class AMIProvider:
                     if ami is not None:
                         amis[ami.id] = ami
             else:
+                # owner scoping (ami.go:106-122): explicit owner wins;
+                # name-based discovery defaults to self+amazon so
+                # cross-account AMIs need an explicit opt-in; tag/id
+                # terms carry no implicit owner restriction
+                owners = [term.owner] if term.owner else (
+                    ["self", "amazon"] if term.name else [])
                 for img in self.ec2.describe_images(
                         tag_filters=dict(term.tags),
                         ids=[term.id] if term.id else (),
-                        names=[term.name] if term.name else ()):
+                        names=[term.name] if term.name else (),
+                        owners=owners):
                     # deprecated AMIs stay launchable when explicitly
                     # selected; they are deprioritized below
                     # (ami.go:173-182,216-222)

@@ -11,10 +11,10 @@ from .conftest import mk_cluster
 
 
 def add_image(ec2, name, arch="amd64", creation_date=1_900_000_000.0,
-              tags=None, deprecated=False):
+              tags=None, deprecated=False, owner="amazon"):
     img = FakeImage(id=_new_id("ami"), name=name, arch=arch,
                     creation_date=creation_date, deprecated=deprecated,
-                    tags=dict(tags or {}))
+                    tags=dict(tags or {}), owner=owner)
     ec2.images[img.id] = img
     return img
 
@@ -49,6 +49,41 @@ class TestAMISelection:
             SelectorTerm.of({"team": "infra"})])
         insts = settle(op, nodeclass=nc)
         assert insts and all(i.image_id == img.id for i in insts)
+
+    def test_name_with_wrong_owner_finds_nothing(self, op, ec2):
+        """should support AMI Selector Terms for Name but fail with
+        incorrect owners (suite_test.go:107): an explicit owner that
+        doesn't hold the AMI resolves to nothing — the nodeclass never
+        goes Ready and no instance launches."""
+        add_image(ec2, "owned-ami-v1", owner="111122223333")
+        nc = EC2NodeClass("wrong-owner", ami_selector_terms=[
+            SelectorTerm(name="owned-ami-v1", owner="444455556666")])
+        insts = settle(op, nodeclass=nc)
+        assert not insts
+        got = op.kube.get("EC2NodeClass", "wrong-owner")
+        assert got.conditions["AMIsReady"].status == "False"
+
+    def test_name_default_owners_exclude_third_party(self, op, ec2):
+        """should support ami selector Name with default owners
+        (suite_test.go:126): without an owner, name discovery is scoped
+        to self+amazon — a third-party account's same-named AMI is NOT
+        discovered unless its owner is given explicitly
+        (ami.go:112-116)."""
+        mine = add_image(ec2, "shared-name", owner="self",
+                         creation_date=1_850_000_000.0)
+        add_image(ec2, "shared-name", owner="999988887777",
+                  creation_date=1_950_000_000.0)  # newer but 3rd-party
+        nc = EC2NodeClass("default-owners", ami_selector_terms=[
+            SelectorTerm(name="shared-name")])
+        insts = settle(op, nodeclass=nc)
+        assert insts and all(i.image_id == mine.id for i in insts)
+
+    def test_explicit_owner_opts_into_cross_account(self, op, ec2):
+        theirs = add_image(ec2, "xacct-ami", owner="999988887777")
+        nc = EC2NodeClass("xacct", ami_selector_terms=[
+            SelectorTerm(name="xacct-ami", owner="999988887777")])
+        insts = settle(op, nodeclass=nc)
+        assert insts and all(i.image_id == theirs.id for i in insts)
 
     def test_most_recent_ami_wins(self, op, ec2):
         """should use the most recent AMI when discovering multiple
