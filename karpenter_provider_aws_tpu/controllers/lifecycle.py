@@ -174,6 +174,63 @@ def _drain_group(pod) -> int:
     return (2 if critical else 0) + (1 if daemon else 0)
 
 
+class NodeRepairController:
+    """Node auto-repair — the consumer of CloudProvider.RepairPolicies
+    (cloudprovider.go:252-293): a node whose condition has matched a
+    policy's unhealthy status for longer than that policy's toleration
+    duration is force-terminated and replaced by the next solve round.
+    Repair is forceful: it bypasses budgets, do-not-disrupt, and PDBs
+    (a sick kubelet cannot evict anyway), modeled by zeroing the claim's
+    terminationGracePeriod so the terminator force-drains immediately."""
+
+    def __init__(self, kube: FakeKube, cloudprovider: CloudProvider,
+                 clock=time.time, metrics=None, recorder=None):
+        self.kube = kube
+        self.cloudprovider = cloudprovider
+        self.clock = clock
+        self.metrics = metrics
+        self.recorder = recorder
+
+    def reconcile(self) -> int:
+        policies = self.cloudprovider.repair_policies()
+        claims_by_node = {c.node_name: c
+                          for c in self.kube.list("NodeClaim")
+                          if c.node_name}
+        now = self.clock()
+        repaired = 0
+        for node in self.kube.list("Node"):
+            claim = claims_by_node.get(node.metadata.name)
+            if claim is None \
+                    or claim.metadata.deletion_timestamp is not None:
+                continue
+            for pol in policies:
+                cond = node.conditions.get(pol.condition_type)
+                if cond is None or cond.status != pol.condition_status:
+                    continue
+                if now - cond.last_transition < pol.toleration_duration:
+                    continue
+                claim.termination_grace_period = 0.0  # forceful drain
+                self.kube.update(claim)
+                self.kube.delete("NodeClaim", claim.name)
+                if self.metrics is not None:
+                    # reason-only labels, the family's documented shape
+                    # (docs/metrics.md; disruption.py emits it the same
+                    # way)
+                    self.metrics.inc(
+                        "karpenter_nodeclaims_disrupted_total",
+                        labels={"reason": "unhealthy"})
+                if self.recorder is not None:
+                    self.recorder.publish(
+                        "NodeClaim", claim.name, "Unhealthy",
+                        f"node {node.metadata.name} condition "
+                        f"{pol.condition_type}={pol.condition_status} "
+                        f"past its {pol.toleration_duration:.0f}s "
+                        "toleration; repairing", "Warning")
+                repaired += 1
+                break
+        return repaired
+
+
 class Terminator:
     """NodeClaim deletion: ordered drain (one group per reconcile, the
     four-group order above), do-not-disrupt pods block the drain until

@@ -132,6 +132,9 @@ class Daemon:
         reg("provisioner", op.provisioner.reconcile, FAST_LOOP)
         reg("nodeclaim.lifecycle", op.lifecycle.reconcile, FAST_LOOP)
         reg("nodeclaim.termination", op.terminator.reconcile, FAST_LOOP)
+        # node auto-repair: condition-toleration table from the
+        # cloudprovider (cloudprovider.go:252-293)
+        reg("node.repair", op.node_repair.reconcile, FAST_LOOP)
         if self.simulate_kubelet:
             reg("fake.kubelet", op.kubelet.tick, FAST_LOOP)
         # steady state (controllers.go:63-101 cadences)

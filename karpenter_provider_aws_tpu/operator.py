@@ -18,7 +18,8 @@ from typing import Optional
 from .cache.ttl import UnavailableOfferings
 from .cloudprovider.provider import CloudProvider
 from .controllers.disruption import DisruptionController
-from .controllers.lifecycle import NodeClaimLifecycle, Terminator
+from .controllers.lifecycle import (NodeClaimLifecycle,
+                                    NodeRepairController, Terminator)
 from .controllers.provisioning import Provisioner
 from .controllers.steady_state import (CatalogController,
                                        DiscoveredCapacityController,
@@ -192,6 +193,9 @@ class Operator:
                                             metrics=self.metrics)
         self.terminator = Terminator(self.kube, self.cloudprovider,
                                      clock=clock, metrics=self.metrics)
+        self.node_repair = NodeRepairController(
+            self.kube, self.cloudprovider, clock=clock,
+            metrics=self.metrics, recorder=self.recorder)
         self.nodeclass_status = NodeClassStatusController(
             self.kube, self.subnets, self.security_groups, self.amis,
             self.instance_profiles, clock=clock, metrics=self.metrics,
@@ -265,6 +269,7 @@ class Operator:
         out["interruption"] = self.interruption.reconcile()
         out["disrupted"] = (self.disruption.reconcile() is not None) \
             if disrupt else False
+        out["repaired"] = self.node_repair.reconcile()
         out["terminated"] = self.terminator.reconcile()
         prov = self.provisioner.reconcile()
         out["provisioned"] = len(prov.created_claims)

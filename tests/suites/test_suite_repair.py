@@ -1,0 +1,102 @@
+"""Node auto-repair suite (test/suites/integration/repair_policy_test.go):
+a node condition matching a RepairPolicy's unhealthy status past its
+toleration duration force-replaces the node — bypassing budgets and
+do-not-disrupt (repair is forceful)."""
+
+import pytest
+
+from karpenter_provider_aws_tpu.apis.objects import (Condition, Disruption,
+                                                     DisruptionBudget)
+from karpenter_provider_aws_tpu.fake.environment import make_pods
+from karpenter_provider_aws_tpu.operator import Operator
+
+from .conftest import mk_cluster
+
+
+class FakeClock:
+    def __init__(self, t=1_000_000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+@pytest.fixture
+def clock():
+    return FakeClock()
+
+
+@pytest.fixture
+def op(clock):
+    return Operator(clock=clock)
+
+
+def sick_cluster(op, clock, cond_type, cond_status, **cluster):
+    mk_cluster(op, **cluster)
+    for p in make_pods(1, cpu="500m", memory="1Gi", prefix="sick"):
+        op.kube.create(p)
+    op.run_until_settled()
+    node = op.kube.list("Node")[0]
+    node.conditions[cond_type] = Condition(
+        type=cond_type, status=cond_status, last_transition=clock.t)
+    op.kube.update(node)
+    return node.metadata.name
+
+
+@pytest.mark.parametrize("cond_type,cond_status,toleration", [
+    ("Ready", "False", 30 * 60),
+    ("Ready", "Unknown", 30 * 60),
+    ("AcceleratedHardwareReady", "False", 10 * 60),
+    ("StorageReady", "False", 30 * 60),
+    ("NetworkingReady", "False", 30 * 60),
+    ("KernelReady", "False", 30 * 60),
+])
+def test_unhealthy_condition_replaces_node(op, clock, cond_type,
+                                           cond_status, toleration):
+    """each policy row (repair_policy_test.go:77-108): the node is
+    replaced only after the condition outlives its toleration."""
+    name = sick_cluster(op, clock, cond_type, cond_status)
+    clock.advance(toleration / 2)
+    op.step()
+    assert op.kube.try_get("Node", name) is not None  # tolerated so far
+    clock.advance(toleration / 2 + 1)
+    for _ in range(10):
+        op.run_until_settled()
+        clock.advance(30)
+        if op.kube.try_get("Node", name) is None:
+            break
+    assert op.kube.try_get("Node", name) is None
+    # the workload landed on a replacement node
+    pods = [p for p in op.kube.list("Pod")
+            if p.metadata.name.startswith("sick")]
+    assert pods and all(p.node_name and p.node_name != name for p in pods)
+
+
+def test_repair_bypasses_budgets_and_do_not_disrupt(op, clock):
+    """repair is forceful: a nodes='0' budget and a do-not-disrupt pod
+    do not keep a dead node alive."""
+    name = sick_cluster(op, clock, "Ready", "False",
+                        disruption=Disruption(
+                            budgets=[DisruptionBudget(nodes="0")]))
+    for p in op.kube.list("Pod"):
+        p.metadata.annotations["karpenter.sh/do-not-disrupt"] = "true"
+        op.kube.update(p)
+    clock.advance(30 * 60 + 1)
+    for _ in range(10):
+        op.run_until_settled()
+        clock.advance(30)
+        if op.kube.try_get("Node", name) is None:
+            break
+    assert op.kube.try_get("Node", name) is None
+
+
+def test_healthy_conditions_never_repair(op, clock):
+    name = sick_cluster(op, clock, "StorageReady", "True")
+    clock.advance(3600 * 24)
+    for _ in range(5):
+        op.run_until_settled()
+        clock.advance(60)
+    assert op.kube.try_get("Node", name) is not None
