@@ -257,10 +257,22 @@ class DisruptionController:
                 continue  # pods are in flight toward this node
             pods = [p for p in pods_by_node.get(node.name, [])
                     if p.owner_kind != "DaemonSet"]
+            # a pod going Succeeded/Failed in place drops out of
+            # pods_by_node (terminal pods are excluded there), so a
+            # terminal transition registers as a membership change —
+            # a pod event (consolidation suite_test.go:130)
             pod_set = frozenset(p.full_name() for p in pods)
             prev = self._pod_epoch.get(claim.name)
+            if prev is None and claim.last_pod_event > 0:
+                # operator restart: resume from the anchor persisted in
+                # claim status (upstream's lastPodEventTime) instead of
+                # restarting every stabilization window from zero
+                prev = (pod_set, claim.last_pod_event)
+                self._pod_epoch[claim.name] = prev
             if prev is None or prev[0] != pod_set:
                 self._pod_epoch[claim.name] = (pod_set, now)
+                claim.last_pod_event = now  # durable (state-in-cluster)
+                self.kube.update(claim)
             blocked = ""
             # the annotation blocks disruption at every level: node,
             # claim, or any resident pod (core candidate filtering)
@@ -371,8 +383,8 @@ class DisruptionController:
     def _consolidatable_since(self, cand: Candidate) -> float:
         """When the node last changed pod-wise (consolidate_after anchor)."""
         epoch = self._pod_epoch.get(cand.name)
-        if epoch is not None:
-            return epoch[1]
+        if epoch is not None:  # always set by _build_candidates (the
+            return epoch[1]    # restart path seeds it from claim status)
         cond = cand.claim.conditions.get("Initialized")
         return cond.last_transition if cond else 0.0
 

@@ -150,6 +150,130 @@ class TestConsolidation:
         assert node.metadata.labels[L.ZONE] == pv_zone, \
             "pod consolidated away from its volume's zone"
 
+    def test_on_demand_consolidates_to_spot(self, op, clock):
+        """should consolidate on-demand nodes to spot (replace)
+        (suite_test.go:725): a pool pinned to on-demand provisions OD;
+        opening the pool to spot lets consolidation replace the node
+        with the cheaper spot offering."""
+        np, _ = mk_cluster(op, requirements=[
+            {"key": L.CAPACITY_TYPE, "operator": "In",
+             "values": ["on-demand"]},
+            {"key": L.INSTANCE_CPU, "operator": "In", "values": ["4"]}])
+        for p in make_pods(3, cpu="900m", memory="1Gi", prefix="ods"):
+            op.kube.create(p)
+        op.run_until_settled()
+        claims = op.kube.list("NodeClaim")
+        assert claims and all(
+            c.metadata.labels[L.CAPACITY_TYPE] == "on-demand"
+            for c in claims)
+        # open the pool to spot: the same capacity is cheaper there
+        from karpenter_provider_aws_tpu.apis.requirements import \
+            Requirements
+        np.template.requirements = Requirements.from_terms([
+            {"key": L.CAPACITY_TYPE, "operator": "In",
+             "values": ["spot", "on-demand"]},
+            {"key": L.INSTANCE_CPU, "operator": "In", "values": ["4"]}])
+        op.kube.update(np)
+        drive(op, clock, rounds=20)
+        claims = op.kube.list("NodeClaim")
+        assert claims and all(
+            c.metadata.labels[L.CAPACITY_TYPE] == "spot"
+            for c in claims), [
+                (c.name, c.metadata.labels[L.CAPACITY_TYPE])
+                for c in claims]
+        assert all(p.node_name for p in op.kube.list("Pod"))
+
+    def test_scheduled_budget_blocks_consolidation_in_window(self, op,
+                                                             clock):
+        """should not allow consolidation if the budget is fully
+        blocking during a scheduled time (suite_test.go:449): the cron
+        window gates consolidation exactly as it gates emptiness."""
+        from datetime import datetime, timezone
+        clock.t = datetime(2026, 7, 31, 10, 0,
+                           tzinfo=timezone.utc).timestamp()
+        mk_cluster(op, requirements=[
+            {"key": L.INSTANCE_CPU, "operator": "In", "values": ["4", "8"]}],
+            disruption=Disruption(budgets=[DisruptionBudget(
+                nodes="0", schedule="0 9 * * *", duration="8h")]))
+        for p in make_pods(12, cpu="900m", memory="1800Mi", prefix="sw"):
+            op.kube.create(p)
+        op.run_until_settled()
+        n_before = len(op.kube.list("Node"))
+        for p in op.kube.list("Pod")[:6]:
+            op.kube.delete("Pod", p.name, namespace=p.metadata.namespace)
+        drive(op, clock, rounds=5, dt=60)
+        assert len(op.kube.list("Node")) == n_before  # blocked in window
+        clock.t = datetime(2026, 7, 31, 17, 30,
+                           tzinfo=timezone.utc).timestamp()
+        drive(op, clock, rounds=15)
+        assert len(op.kube.list("Node")) < n_before
+
+    def test_pod_events_stamp_last_pod_event(self, op, clock):
+        """should update lastPodEventTime when pods are scheduled and
+        removed / go terminal (suite_test.go:77,130): every pod change
+        on a node stamps the claim's durable anchor, which restarts its
+        consolidateAfter stabilization window."""
+        mk_cluster(op, requirements=[
+            {"key": L.INSTANCE_CPU, "operator": "In", "values": ["4"]}],
+            disruption=Disruption(consolidate_after=600.0))
+        for p in make_pods(2, cpu="900m", memory="1Gi", prefix="ev"):
+            op.kube.create(p)
+        op.run_until_settled()
+        op.step()  # disruption pass stamps the initial epoch
+        before = {c.name: c.last_pod_event
+                  for c in op.kube.list("NodeClaim")}
+        assert all(v > 0 for v in before.values())
+        # scheduled: a new pod lands on a node -> that anchor advances
+        clock.advance(100)
+        ev2 = make_pods(1, cpu="100m", memory="128Mi", prefix="ev2")[0]
+        op.kube.create(ev2)
+        op.run_until_settled()
+        op.step()
+        claim = next(c for c in op.kube.list("NodeClaim")
+                     if c.node_name == ev2.node_name)
+        assert claim.name in before, "pod was expected on existing capacity"
+        assert claim.last_pod_event > before[claim.name]
+        t1 = claim.last_pod_event
+        # terminal: a pod finishing in place is a pod event too
+        clock.advance(100)
+        pod = next(p for p in op.kube.list("Pod")
+                   if p.node_name == claim.node_name)
+        pod.phase = "Succeeded"
+        op.kube.update(pod)
+        op.step()
+        assert claim.last_pod_event > t1
+        t2 = claim.last_pod_event
+        # removed
+        clock.advance(100)
+        pod2 = next(p for p in op.kube.list("Pod")
+                    if p.node_name == claim.node_name
+                    and p.phase == "Running")
+        op.kube.delete("Pod", pod2.name, namespace=pod2.metadata.namespace)
+        op.step()
+        assert claim.last_pod_event > t2
+
+    def test_anchor_survives_operator_restart(self, op, clock, ec2):
+        """the consolidateAfter anchor is state-in-cluster: a fresh
+        controller (operator restart) resumes from the claim's persisted
+        lastPodEventTime instead of resetting or consolidating early."""
+        from karpenter_provider_aws_tpu.controllers.disruption import \
+            DisruptionController
+        mk_cluster(op, disruption=Disruption(consolidate_after=600.0))
+        for p in make_pods(2, cpu="900m", memory="1Gi", prefix="rs"):
+            op.kube.create(p)
+        op.run_until_settled()
+        op.step()
+        claim = op.kube.list("NodeClaim")[0]
+        anchor = claim.last_pod_event
+        assert anchor > 0
+        clock.advance(200)
+        # a brand-new controller on the same cluster state — no memory
+        fresh = DisruptionController(
+            op.kube, op.state, op.cloudprovider, op.solver,
+            op.provisioner, clock=clock)
+        fresh.reconcile()
+        assert claim.last_pod_event == anchor  # resumed, not re-stamped
+
     def test_budget_gates_consolidation(self, op, clock):
         """a zero budget scoped to underutilized blocks consolidation."""
         mk_cluster(op, disruption=Disruption(budgets=[
