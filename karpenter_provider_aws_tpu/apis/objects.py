@@ -27,6 +27,14 @@ from .resources import ATTACHABLE_VOLUMES, Resources
 _uid_counter = itertools.count(1)
 
 
+def scaled_percent(pct: int, total: int, up: bool) -> int:
+    """Exact integer percent scaling (k8s GetScaledValueFromIntOrPercent
+    semantics — float math mis-rounds cases like 29% of 100). ``up``
+    picks the ceiling (minAvailable, budget nodes%), else the floor
+    (maxUnavailable)."""
+    return -((-pct * total) // 100) if up else (pct * total) // 100
+
+
 def _new_uid(prefix: str) -> str:
     return f"{prefix}-{next(_uid_counter):08x}"
 
@@ -309,10 +317,9 @@ class DisruptionBudget:
     def max_disruptions(self, total_nodes: int) -> int:
         s = self.nodes.strip()
         if s.endswith("%"):
-            pct = int(s[:-1])
             # ceiling: the default 10% budget must not freeze small
             # clusters (a 2-node pool still allows 1 disruption)
-            return -((-total_nodes * pct) // 100)
+            return scaled_percent(int(s[:-1]), total_nodes, up=True)
         return int(s)
 
 
@@ -450,6 +457,51 @@ class NodeClaim(KubeObject):
 # ---------------------------------------------------------------------------
 # Node
 # ---------------------------------------------------------------------------
+
+class PodDisruptionBudget(KubeObject):
+    """policy/v1 PodDisruptionBudget — the eviction gate Karpenter
+    honors in disruption decisions and during drain (a blocked PDB
+    holds a node like do-not-disrupt does; the claim's
+    terminationGracePeriod bypasses it, karpenter.sh_nodepools.yaml:411).
+    Exactly one of min_available / max_unavailable is set; values are
+    counts or percentages ("50%"). k8s rounding: minAvailable % rounds
+    UP, maxUnavailable % rounds DOWN (both conservative)."""
+
+    kind = "PodDisruptionBudget"
+
+    def __init__(self, name: str, selector: Mapping[str, str],
+                 min_available: "int | str | None" = None,
+                 max_unavailable: "int | str | None" = None,
+                 namespace: str = "default"):
+        if (min_available is None) == (max_unavailable is None):
+            raise ValueError(
+                "exactly one of minAvailable/maxUnavailable is required")
+        self.metadata = ObjectMeta(name=name, namespace=namespace)
+        self.selector = dict(selector)
+        self.min_available = min_available
+        self.max_unavailable = max_unavailable
+
+    def matches(self, pod) -> bool:
+        if pod.metadata.namespace != self.metadata.namespace:
+            return False
+        labels = pod.metadata.labels
+        return all(labels.get(k) == v for k, v in self.selector.items())
+
+    def disruptions_allowed(self, matching, healthy: int) -> int:
+        """How many more matching pods may be evicted right now."""
+        total = len(matching)
+        if self.max_unavailable is not None:
+            cap = self._resolve(self.max_unavailable, total, up=False)
+            return max(0, cap - (total - healthy))
+        floor = self._resolve(self.min_available, total, up=True)
+        return max(0, healthy - floor)
+
+    @staticmethod
+    def _resolve(v, total: int, up: bool) -> int:
+        if isinstance(v, str) and v.strip().endswith("%"):
+            return scaled_percent(int(v.strip()[:-1]), total, up=up)
+        return int(v)
+
 
 class Node(KubeObject):
     kind = "Node"

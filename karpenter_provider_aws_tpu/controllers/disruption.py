@@ -237,6 +237,8 @@ class DisruptionController:
         #: (in-flight) pod is invisible to pods_by_node, so the node would
         #: otherwise look empty and be consolidated out from under it
         nominated_nodes = self.state.nomination_targets()
+        from .pdb import blocking_pdb, pdb_state
+        pdbs = pdb_state(self.kube)
 
         out: List[Candidate] = []
         for claim in self.kube.list("NodeClaim"):
@@ -287,6 +289,11 @@ class DisruptionController:
                     if p.metadata.annotations.get(
                             DO_NOT_DISRUPT_ANNOTATION) == "true":
                         blocked = f"pod {p.full_name()} has do-not-disrupt"
+                        break
+                    bp = blocking_pdb(pdbs, p)
+                    if bp is not None:
+                        blocked = (f"pod {p.full_name()} blocked by "
+                                   f"pdb {bp.metadata.name}")
                         break
             itype = claim.metadata.labels.get(L.INSTANCE_TYPE, "")
             ct = claim.metadata.labels.get(L.CAPACITY_TYPE, "")

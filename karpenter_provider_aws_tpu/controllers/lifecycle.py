@@ -246,9 +246,11 @@ class Terminator:
         self.clock = clock
         self.metrics = metrics
 
-    def _drain_step(self, claim) -> bool:
+    def _drain_step(self, claim, pdbs) -> bool:
         """One drain round for a deleting claim's node. Returns True when
-        the node holds no more bound pods (drain complete)."""
+        the node holds no more bound pods (drain complete). ``pdbs`` is
+        the reconcile-wide allowance state — shared so one pass cannot
+        evict more covered pods than a budget allows ACROSS nodes."""
         bound = []
         for p in self.kube.list("Pod"):
             if p.node_name != claim.node_name:
@@ -282,12 +284,21 @@ class Terminator:
             victims = [] if deadline is None else [
                 p for p in blocked
                 if now >= deadline - p.termination_grace_period_seconds]
+            # PDB gate: pods covered by an exhausted budget wait, like
+            # do-not-disrupt; the TGP paths above/below bypass it
+            # (karpenter.sh_nodepools.yaml:411)
+            from .pdb import take_allowance
+            evictable = [p for p in evictable
+                         if all(a > 0 for pdb, a in pdbs
+                                if pdb.matches(p))]
             if not evictable and not victims:
-                return False  # do-not-disrupt pods hold the node
+                return False  # do-not-disrupt / blocked PDBs hold it
             if evictable:
                 first = min(_drain_group(p) for p in evictable)
-                victims += [p for p in evictable
-                            if _drain_group(p) == first]
+                for p in evictable:
+                    if _drain_group(p) == first \
+                            and take_allowance(pdbs, p):
+                        victims.append(p)
         for p in victims:
             _release_pod(self.kube, p)
         if self.metrics is not None and victims:
@@ -310,15 +321,19 @@ class Terminator:
             return True
 
     def reconcile(self) -> int:
+        from .pdb import pdb_state
         done = 0
+        pdbs = None  # computed once, on the first deleting claim
         for claim in self.kube.list("NodeClaim"):
             if claim.metadata.deletion_timestamp is None:
                 continue
+            if pdbs is None:
+                pdbs = pdb_state(self.kube)
             # 1) drain: ordered, do-not-disrupt-aware, TGP-forced. The
             #    instance probe runs only when the drain did not finish
             #    this round — a dead machine (spot reclaim, console
             #    terminate) makes the remaining drain moot
-            if claim.node_name and not self._drain_step(claim):
+            if claim.node_name and not self._drain_step(claim, pdbs):
                 if self._instance_gone(claim):
                     # pods on a dead machine are released, not evicted
                     # (the completion path below counts the drain)

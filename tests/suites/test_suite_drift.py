@@ -116,6 +116,45 @@ class TestDriftRoll:
         assert all(p.node_name for p in op.kube.list("Pod"))
 
 
+class TestDriftPDB:
+    def test_unhealthy_pdb_blocks_drift(self, op, clock):
+        """should not drift any nodes if their PodDisruptionBudgets are
+        unhealthy (suite_test.go:913): a PDB with zero allowance pins
+        the drifted node; healing the budget releases the roll."""
+        from karpenter_provider_aws_tpu.apis.objects import \
+            PodDisruptionBudget
+        mk_cluster(op)
+        pods = make_pods(2, cpu="500m", memory="1Gi", prefix="pdbd")
+        for p in pods:
+            p.metadata.labels["app"] = "guarded"
+            op.kube.create(p)
+        op.run_until_settled()
+        # minAvailable equal to the replica count: zero disruptions
+        op.kube.create(PodDisruptionBudget(
+            "guard", selector={"app": "guarded"}, min_available=2))
+        before = {c.name for c in op.kube.list("NodeClaim")}
+        roll_ami(op)
+        for _ in range(8):
+            op.run_until_settled()
+            clock.advance(120)
+        assert before <= {c.name for c in op.kube.list("NodeClaim")}, \
+            "drift rolled a node despite an exhausted PDB"
+        # heal: allow one disruption -> drift proceeds
+        pdb = op.kube.get("PodDisruptionBudget", "guard",
+                          namespace="default")
+        pdb.min_available = 1
+        op.kube.update(pdb)
+        for _ in range(20):
+            op.run_until_settled()
+            clock.advance(60)
+            after = {c.name for c in op.kube.list("NodeClaim")}
+            if after and not (after & before):
+                break
+        after = {c.name for c in op.kube.list("NodeClaim")}
+        assert after and not (after & before)
+        assert all(p.node_name for p in op.kube.list("Pod"))
+
+
 class TestDriftBudgets:
     """ref drift suite budget scenarios (suite_test.go:101-346): drift is
     a budgeted voluntary method — a fully-blocking budget pins drifted

@@ -310,6 +310,77 @@ class TestOrderedDrain:
         assert op.kube.try_get("Node", node) is None
 
 
+class TestPDBDrain:
+    def test_pdb_meters_drain_and_tgp_bypasses(self, op, clock):
+        """an exhausted PDB holds a deleting node's covered pods (like
+        do-not-disrupt); the claim's terminationGracePeriod bypasses
+        blocked PDBs (karpenter.sh_nodepools.yaml:411)."""
+        from karpenter_provider_aws_tpu.apis.objects import \
+            PodDisruptionBudget
+        mk_cluster(op, termination_grace_period=300)
+        pods = make_pods(2, cpu="500m", memory="1Gi", prefix="pg")
+        for p in pods:
+            p.metadata.labels["app"] = "held"
+            op.kube.create(p)
+        op.run_until_settled()
+        op.kube.create(PodDisruptionBudget(
+            "held", selector={"app": "held"}, min_available=2))
+        node = op.kube.list("Node")[0].name
+        held_here = [p for p in op.kube.list("Pod")
+                     if p.node_name == node]
+        assert held_here  # at least one covered pod on the victim
+        claim = next(c for c in op.kube.list("NodeClaim")
+                     if c.node_name == node)
+        op.kube.delete("NodeClaim", claim.name)
+        for _ in range(4):
+            op.step()
+        bound = [p.metadata.name for p in op.kube.list("Pod")
+                 if p.node_name == node
+                 and p.phase not in ("Succeeded", "Failed")]
+        assert bound, "PDB-covered pods were evicted while exhausted"
+        clock.advance(301)  # past the claim TGP: PDBs are bypassed
+        op.step()
+        op.run_until_settled()
+        assert op.kube.try_get("Node", node) is None
+        assert all(p.node_name for p in op.kube.list("Pod"))
+
+    def test_pdb_allowance_caps_one_round(self, op, clock):
+        """maxUnavailable: 1 — a drain round may evict at most one
+        covered pod; the rest wait for the next round's allowance."""
+        from karpenter_provider_aws_tpu.apis.objects import (
+            Pod, PodDisruptionBudget)
+        mk_cluster(op)
+        for p in make_pods(1, cpu="500m", memory="1Gi", prefix="cap"):
+            op.kube.create(p)
+        op.run_until_settled()
+        node = op.kube.list("Node")[0].name
+        for i in range(3):
+            extra = Pod(f"cap-extra-{i}", node_name=node, phase="Running",
+                        labels={"app": "metered"})
+            op.kube.create(extra)
+        op.kube.create(PodDisruptionBudget(
+            "meter", selector={"app": "metered"}, max_unavailable=1))
+        claim = next(c for c in op.kube.list("NodeClaim")
+                     if c.node_name == node)
+        op.kube.delete("NodeClaim", claim.name)
+
+        def covered_bound():
+            return sorted(p.metadata.name for p in op.kube.list("Pod")
+                          if p.node_name == node
+                          and p.metadata.labels.get("app") == "metered")
+
+        before = covered_bound()
+        assert len(before) == 3
+        op.step()  # evicts the uncovered pod + at most 1 covered
+        assert len(covered_bound()) >= 2
+        for _ in range(8):
+            op.step()
+            op.run_until_settled()  # evicted pods re-land -> allowance heals
+            if op.kube.try_get("Node", node) is None:
+                break
+        assert op.kube.try_get("Node", node) is None
+
+
 class TestNodeDeletion:
     def test_terminate_node_and_instance_on_deletion(self, op):
         """should terminate the node and the instance on deletion; pods

@@ -1,0 +1,113 @@
+"""PodDisruptionBudget math: k8s rounding (minAvailable % rounds up,
+maxUnavailable % rounds down) and allowance accounting."""
+
+import pytest
+
+from karpenter_provider_aws_tpu.apis.objects import Pod, PodDisruptionBudget
+
+
+def pods(n, label="a", bound=True):
+    out = []
+    for i in range(n):
+        out.append(Pod(f"p{i}", labels={"app": label},
+                       node_name="n0" if bound else "",
+                       phase="Running" if bound else "Pending"))
+    return out
+
+
+class TestRounding:
+    def test_min_available_percent_rounds_up(self):
+        pdb = PodDisruptionBudget("x", {"app": "a"}, min_available="50%")
+        # 5 pods -> floor is ceil(2.5)=3 -> allowed 2
+        assert pdb.disruptions_allowed(pods(5), healthy=5) == 2
+
+    def test_max_unavailable_percent_rounds_down(self):
+        pdb = PodDisruptionBudget("x", {"app": "a"}, max_unavailable="50%")
+        # 5 pods -> cap is floor(2.5)=2
+        assert pdb.disruptions_allowed(pods(5), healthy=5) == 2
+        assert pdb.disruptions_allowed(pods(5), healthy=4) == 1
+
+    def test_counts(self):
+        pdb = PodDisruptionBudget("x", {"app": "a"}, min_available=2)
+        assert pdb.disruptions_allowed(pods(3), healthy=3) == 1
+        assert pdb.disruptions_allowed(pods(3), healthy=2) == 0
+
+    def test_exactly_one_field_required(self):
+        with pytest.raises(ValueError):
+            PodDisruptionBudget("x", {"app": "a"})
+        with pytest.raises(ValueError):
+            PodDisruptionBudget("x", {"app": "a"}, min_available=1,
+                                max_unavailable=1)
+
+    def test_selector_and_namespace_scoping(self):
+        pdb = PodDisruptionBudget("x", {"app": "a"}, min_available=1)
+        assert pdb.matches(Pod("p", labels={"app": "a"}))
+        assert not pdb.matches(Pod("p", labels={"app": "b"}))
+        assert not pdb.matches(Pod("p", namespace="other",
+                                   labels={"app": "a"}))
+
+
+class TestExactRounding:
+    def test_float_trap_cases(self):
+        """binary-float scaling mis-rounds these (29/100 etc.); the
+        exact-integer helper must not."""
+        down = PodDisruptionBudget("x", {"app": "a"}, max_unavailable="29%")
+        assert down.disruptions_allowed(pods(100), healthy=100) == 29
+        up = PodDisruptionBudget("y", {"app": "a"}, min_available="7%")
+        # floor is exactly 7 -> allowed 93, not 92
+        assert up.disruptions_allowed(pods(100), healthy=100) == 93
+
+
+class TestCrossNodeAllowance:
+    def test_one_reconcile_respects_budget_across_nodes(self):
+        """maxUnavailable=1 covering pods on TWO deleting nodes: a
+        single terminator pass may evict only one of them (the
+        allowance state is shared across the reconcile, not rebuilt
+        per claim)."""
+        from karpenter_provider_aws_tpu.apis import labels as L
+        from karpenter_provider_aws_tpu.apis.objects import (
+            Disruption, EC2NodeClass, NodeClassRef, NodePool,
+            NodePoolTemplate)
+        from karpenter_provider_aws_tpu.apis.requirements import \
+            Requirements
+        from karpenter_provider_aws_tpu.fake.environment import make_pods
+        from karpenter_provider_aws_tpu.operator import Operator
+        op = Operator()
+        op.kube.create(EC2NodeClass("cls"))
+        op.kube.create(NodePool("p", template=NodePoolTemplate(
+            node_class_ref=NodeClassRef("cls"),
+            requirements=Requirements.from_terms([
+                {"key": L.INSTANCE_CPU, "operator": "In",
+                 "values": ["16"]}]))))
+        ps = make_pods(2, cpu="10", memory="12Gi", prefix="xn")
+        for p in ps:
+            p.metadata.labels["app"] = "xn"
+            op.kube.create(p)
+        op.run_until_settled()
+        claims = op.kube.list("NodeClaim")
+        assert len(claims) == 2  # big pods: one per node
+        op.kube.create(PodDisruptionBudget(
+            "xn", selector={"app": "xn"}, max_unavailable=1))
+        for c in claims:
+            op.kube.delete("NodeClaim", c.name)
+        op.terminator.reconcile()  # ONE pass
+        still_bound = [p for p in op.kube.list("Pod")
+                       if p.node_name and p.phase == "Running"]
+        assert len(still_bound) == 1, \
+            "both covered pods evicted in one pass against a budget of 1"
+
+
+class TestAllowanceAccounting:
+    def test_take_allowance_consumes_across_pdbs(self):
+        from karpenter_provider_aws_tpu.controllers.pdb import \
+            take_allowance
+        a = PodDisruptionBudget("a", {"app": "a"}, max_unavailable=1)
+        both = PodDisruptionBudget("b", {"tier": "web"}, max_unavailable=2)
+        p1 = Pod("p1", labels={"app": "a", "tier": "web"},
+                 node_name="n0", phase="Running")
+        p2 = Pod("p2", labels={"app": "a", "tier": "web"},
+                 node_name="n0", phase="Running")
+        state = [(a, 1), (both, 2)]
+        assert take_allowance(state, p1)      # consumes a:0, b:1
+        assert not take_allowance(state, p2)  # a exhausted; b untouched
+        assert state[0][1] == 0 and state[1][1] == 1
