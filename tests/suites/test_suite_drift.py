@@ -225,6 +225,61 @@ class TestDriftReplacementSafety:
     the drifted node must NOT be terminated (capacity is never destroyed
     ahead of its replacement)."""
 
+    def test_drifted_node_kept_while_replacement_uninitialized(
+            self, op, clock):
+        """should not disrupt a drifted node if the replacement node
+        registers but never initialized (suite_test.go:860): the roll
+        waits for INITIALIZED, not merely a joined node object."""
+        from karpenter_provider_aws_tpu.apis.objects import Node
+        mk_cluster(op)
+        for p in make_pods(2, cpu="225", memory="12Gi", prefix="uninit"):
+            op.kube.create(p)
+        op.run_until_settled()
+        before = {c.name for c in op.kube.list("NodeClaim")}
+        roll_ami(op)
+        op.kubelet.pause()
+        for _ in range(4):
+            op.step()
+            clock.advance(60)
+        # hand-join the replacements NOT-ready: they register but can
+        # never initialize
+        joined = []
+        for c in op.kube.list("NodeClaim"):
+            if c.name in before or not c.provider_id:
+                continue
+            node = Node(name=c.name, labels=dict(c.metadata.labels),
+                        capacity=c.capacity, allocatable=c.allocatable,
+                        provider_id=c.provider_id)
+            op.kube.create(node)
+            joined.append(node)
+        assert joined, "no replacement claims launched"
+        for _ in range(6):
+            op.step()
+            clock.advance(60)
+        regs = [c for c in op.kube.list("NodeClaim")
+                if c.name not in before]
+        assert any(c.registered for c in regs)
+        assert not any(c.initialized for c in regs)
+        live = {c.name for c in op.kube.list("NodeClaim")}
+        assert before <= live, \
+            "drifted node rolled before its replacement initialized"
+        assert all(p.node_name for p in op.kube.list("Pod"))
+        # ready flips -> initialization completes -> the fleet rolls;
+        # the kubelet resumes so later replacement waves can join too
+        for node in joined:
+            node.ready = True
+            op.kube.update(node)
+        op.kubelet.resume()
+        for _ in range(15):
+            op.run_until_settled()
+            clock.advance(60)
+            live = {c.name for c in op.kube.list("NodeClaim")}
+            if live and not (live & before):
+                break
+        live = {c.name for c in op.kube.list("NodeClaim")}
+        assert live and not (live & before)
+        assert all(p.node_name for p in op.kube.list("Pod"))
+
     def test_drifted_node_kept_while_replacement_never_registers(
             self, op, clock):
         mk_cluster(op)
