@@ -4,6 +4,7 @@ pour (ops/topo.py) must match the CPU oracle fingerprint-for-fingerprint
 (anti-)affinity, cross-group constraints, existing-node counter seeding,
 ScheduleAnyway recording, and randomized fuzz."""
 
+import os
 import random
 
 import pytest
@@ -247,6 +248,16 @@ class TestTopologyFuzz:
         assert_equivalent(env.snapshot(pods, pools), solvers)
 
 
+#: slow-tier seed count; KARPENTER_FUZZ_SEEDS widens the space for
+#: ad-hoc hunts (e.g. KARPENTER_FUZZ_SEEDS=200 pytest -m scale -k fuzz)
+#: without code changes. A malformed value must not kill collection of
+#: the whole module (the fast tier lives here too).
+try:
+    _EXTENDED_SEEDS = int(os.environ.get("KARPENTER_FUZZ_SEEDS", "24"))
+except ValueError:
+    _EXTENDED_SEEDS = 24
+
+
 @pytest.mark.scale
 class TestExtendedTopologyFuzz:
     """Slow-tier three-engine fuzz (oracle / host pour / device kernel)
@@ -254,7 +265,7 @@ class TestExtendedTopologyFuzz:
     kernel is the newest engine and earns the deepest adversarial
     coverage."""
 
-    @pytest.mark.parametrize("seed", range(24))
+    @pytest.mark.parametrize("seed", range(_EXTENDED_SEEDS))
     def test_three_engines_identical(self, env, seed):
         from karpenter_provider_aws_tpu.solver import route
         assert route.device_alive()
