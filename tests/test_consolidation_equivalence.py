@@ -3,6 +3,7 @@ answer deletion feasibility identically to the sequential oracle, and the
 disruption controller must make identical disruption decisions with either
 evaluator plugged in."""
 
+import os
 import random
 
 import pytest
@@ -25,6 +26,14 @@ from karpenter_provider_aws_tpu.solver.types import (ExistingNode,
                                                      SchedulingSnapshot)
 
 ZONES = ["us-west-2a", "us-west-2b", "us-west-2c"]
+
+#: trial counts for the random-equivalence loops; KARPENTER_FUZZ_TRIALS
+#: widens them for ad-hoc hunts (malformed values fall back rather than
+#: killing module collection)
+try:
+    _TRIALS = max(0, int(os.environ.get("KARPENTER_FUZZ_TRIALS", "0")))
+except ValueError:
+    _TRIALS = 0
 
 
 def random_snapshot(rng: random.Random) -> SchedulingSnapshot:
@@ -76,7 +85,7 @@ class TestEvaluatorEquivalence:
         rng = random.Random(42)
         oracle = ConsolidationEvaluator(CPUSolver())
         tpu = TPUConsolidationEvaluator(backend=backend)
-        for trial in range(12):
+        for trial in range(_TRIALS or 12):
             snaps = [random_snapshot(rng) for _ in range(rng.randint(1, 9))]
             want = oracle.deletions_feasible(snaps)
             got = tpu.deletions_feasible(snaps)
@@ -209,7 +218,7 @@ class TestReplacementPrescreen:
         cpu = CPUSolver()
         ev = TPUConsolidationEvaluator(backend="numpy")
         pruned = confirmed = 0
-        for _trial in range(10):
+        for _trial in range(_TRIALS or 10):
             base, nodes, node_pods = _replacement_base(rng, env)
             queries, oracles = [], []
             for i, node in enumerate(nodes):

@@ -253,7 +253,7 @@ class TestTopologyFuzz:
 #: without code changes. A malformed value must not kill collection of
 #: the whole module (the fast tier lives here too).
 try:
-    _EXTENDED_SEEDS = int(os.environ.get("KARPENTER_FUZZ_SEEDS", "24"))
+    _EXTENDED_SEEDS = max(0, int(os.environ.get("KARPENTER_FUZZ_SEEDS", "24")))
 except ValueError:
     _EXTENDED_SEEDS = 24
 
