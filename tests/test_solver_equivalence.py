@@ -2,6 +2,7 @@
 node decisions, BASELINE.json). Randomized property tests over pods x
 catalogs x pools; fingerprints must match exactly."""
 
+import os
 import random
 
 import pytest
@@ -453,6 +454,43 @@ class TestSlotGrowth:
         assert got.decision_fingerprint() == ref.decision_fingerprint()
 
 
+def _random_high_g_snapshot(env, rng):
+    """A randomized high-G workload: varied signature counts, uneven
+    pods-per-signature, and per-block selector/toleration diversity —
+    the adversarial space for the pruned kernel's compat-aware bound
+    pass (a false prune would show as a decision divergence; an
+    over-eager bail only as a host fallback)."""
+    pods = []
+    n_sigs = rng.randint(300, 1200)
+    fams = rng.sample(["m5", "c5", "r5", "m6i", "c6i"], rng.randint(1, 3))
+    for i in range(n_sigs):
+        sel = None
+        if rng.random() < 0.3:
+            sel = {L.INSTANCE_FAMILY: rng.choice(fams)}
+        tol = [Toleration(key="ded", operator="Exists")] \
+            if rng.random() < 0.1 else []
+        pods += make_pods(
+            rng.randint(1, 7),
+            cpu=f"{50 + (i % 500)}m",
+            memory=f"{128 + (i * 7) % 900}Mi",
+            prefix=f"rg{i:05d}",
+            node_selector=sel, tolerations=tol)
+    pool = env.nodepool(
+        f"rhg-{n_sigs}-{rng.randint(0, 1 << 30)}",
+        requirements=[{"key": L.INSTANCE_FAMILY, "operator": "In",
+                       "values": fams}])
+    return env.snapshot(pods, [pool])
+
+
+#: KARPENTER_FUZZ_SEEDS-style knob (clamped; malformed -> default;
+#: an explicit 0 genuinely skips the fuzz, matching the sibling knobs)
+try:
+    _PRUNED_SEEDS = max(0, int(os.environ.get(
+        "KARPENTER_PRUNED_FUZZ_SEEDS", "6")))
+except ValueError:
+    _PRUNED_SEEDS = 6
+
+
 def _high_g_snapshot(env, n_sigs=5000, per=1):
     """The shared high-G synthetic workload (one shape for the base- and
     pruned-kernel beyond-cap tests, so they cannot drift apart)."""
@@ -589,6 +627,36 @@ class TestPrunedDeviceKernel:
         assert counts["pruned"] >= 1, "pruned path never dispatched"
         ref = CPUSolver().solve(snap)
         assert got.decision_fingerprint() == ref.decision_fingerprint()
+
+    @pytest.mark.parametrize("seed", range(_PRUNED_SEEDS))
+    def test_pruned_fuzz_identical(self, env, seed):
+        """Randomized high-G shapes through the pruned kernel: decisions
+        must be oracle-identical whether the pruned kernel serves or
+        bails to the host twin (the bail path is equally load-bearing).
+        KARPENTER_PRUNED_FUZZ_SEEDS widens the space for ad-hoc hunts."""
+        from karpenter_provider_aws_tpu.solver import route
+        if not route.device_alive():
+            pytest.skip("no dev engine in this environment")
+        rng = random.Random(9000 + seed)
+        snap = _random_high_g_snapshot(env, rng)
+        t = TPUSolver(backend="jax")
+        t._dev_devices = lambda: 1
+        t.dev_max_groups = 64  # route these G counts onto the pruned path
+        stats = {"pruned": 0, "bails": 0}
+        orig_p = t._dispatch_pruned
+
+        def cp(buf, **st):
+            stats["pruned"] += 1
+            out = orig_p(buf, **st)
+            stats["bails"] += int(out[-1])
+            return out
+
+        t._dispatch_pruned = cp
+        got = t.solve(snap)
+        assert stats["pruned"] >= 1, "pruned path never dispatched"
+        ref = CPUSolver().solve(snap)
+        assert got.decision_fingerprint() == ref.decision_fingerprint(), \
+            f"seed {seed} diverged (bails={stats['bails']})"
 
     def test_bail_serves_host_identically(self, env):
         """With S forced to 1, any multi-slot fill trips the bail flag;
