@@ -185,7 +185,8 @@ class Pod(KubeObject):
                  volume_claims: Sequence[str] = (),
                  ephemeral_volumes: Sequence[Tuple[str, str]] = (),
                  priority_class_name: str = "",
-                 termination_grace_period_seconds: float = 30.0):
+                 termination_grace_period_seconds: float = 30.0,
+                 init_requests: Optional[Resources] = None):
         # sort identity, set eagerly: canonical grouping sorts millions
         # of pods by this key per solve — an instance attribute lets the
         # hot sort use operator.attrgetter (C speed) instead of a
@@ -222,6 +223,11 @@ class Pod(KubeObject):
         #: (karpenter.sh_nodepools.yaml:416)
         self.termination_grace_period_seconds = \
             termination_grace_period_seconds
+        #: largest single init container's requests; the k8s effective
+        #: pod request is max(init, sum(containers)) element-wise —
+        #: a heavy init step sizes the node even if steady state is
+        #: small (the reference's InitContainers right-sizing E2E)
+        self.init_requests = init_requests
 
     def apply_volume_constraints(self, reqs: "Requirements",
                                  n_volumes: int) -> None:
@@ -259,11 +265,15 @@ class Pod(KubeObject):
         return self._full_name
 
     def effective_requests(self) -> Resources:
-        """requests + the implicit 1-pod slot. Memoized (hot path)."""
+        """max(init, app) requests + the implicit 1-pod slot.
+        Memoized (hot path)."""
         cached = getattr(self, "_eff_requests", None)
         if cached is None:
-            cached = self.requests + Resources({"pods": 1}) \
-                if self.requests["pods"] == 0 else self.requests
+            base = self.requests
+            if self.init_requests is not None:
+                base = base.merge_max(self.init_requests)
+            cached = base + Resources({"pods": 1}) \
+                if base["pods"] == 0 else base
             nvol = getattr(self, "_volume_count", 0)
             if nvol:
                 cached = cached + Resources({ATTACHABLE_VOLUMES: nvol})

@@ -203,6 +203,29 @@ class TestPropagation:
         assert node.metadata.labels[L.NODEPOOL] == "prop"
         assert node.metadata.annotations.get("example.com/owner") == "sre"
 
+    def test_init_container_right_sizes_node(self, op):
+        """should provision a right-sized node when a pod has
+        InitContainers (mixed resources) (suite_test.go:597): the
+        effective request is max(init, app) element-wise — a heavy init
+        step sizes the node up even when steady state is small, and the
+        mix of dominant axes (init cpu-heavy, app memory-heavy) resolves
+        per axis."""
+        from karpenter_provider_aws_tpu.apis.objects import Pod
+        from karpenter_provider_aws_tpu.apis.resources import Resources
+        mk_cluster(op)
+        pod = Pod("initheavy",
+                  requests=Resources.parse({"cpu": "500m",
+                                            "memory": "6Gi"}),
+                  init_requests=Resources.parse({"cpu": "7",
+                                                 "memory": "1Gi"}))
+        op.kube.create(pod)
+        op.run_until_settled()
+        assert pod.node_name
+        node = op.kube.get("Node", pod.node_name)
+        # effective = (cpu 7, mem 6Gi): the node must hold BOTH maxima
+        assert node.allocatable["cpu"] >= 7000
+        assert node.allocatable["memory"] >= 6 * 1024 ** 3
+
     def test_naked_pod_and_deployment(self, op):
         """should provision a node for naked pods and deployment-owned
         pods alike."""
