@@ -182,26 +182,32 @@ class TestWindows:
         assert not op.kube.list("Pod")[0].node_name
 
 
-class TestPropagation:
-    def test_node_annotations_and_labels(self, op, ec2):
-        """should apply annotations/labels from the NodePool template to
-        the node."""
-        from karpenter_provider_aws_tpu.apis.objects import EC2NodeClass
-        nc = EC2NodeClass("prop-class")
-        op.kube.create(nc)
-        np = NodePool("prop", template=NodePoolTemplate(
-            node_class_ref=NodeClassRef("prop-class"),
-            requirements=Requirements.from_terms([]),
-            labels={"team": "ml"},
-            annotations={"example.com/owner": "sre"}))
-        op.kube.create(np)
-        for p in make_pods(1, cpu="500m", memory="1Gi", prefix="prop"):
+class TestSchedulingSemantics:
+    """Zone/zone-id requirement intersection and init-container
+    right-sizing (suite_test.go:597,631,658)."""
+
+    def test_overlapping_zone_and_zone_id(self, op):
+        """should provision a node for a pod with overlapping zone and
+        zone-id requirements (suite_test.go:631,658): a consistent
+        zone + zone-id pair resolves to that zone; a CONFLICTING pair
+        (each label naming a different AZ) is unsatisfiable."""
+        mk_cluster(op)
+        ok = make_pods(2, cpu="500m", memory="1Gi", prefix="zid",
+                       node_selector={L.ZONE: "us-west-2b",
+                                      L.ZONE_ID: "usw2-az2"})
+        for p in ok:
             op.kube.create(p)
+        bad = make_pods(1, cpu="500m", memory="1Gi", prefix="zidbad",
+                        node_selector={L.ZONE: "us-west-2a",
+                                       L.ZONE_ID: "usw2-az3"})[0]  # zone c
+        op.kube.create(bad)
         op.run_until_settled()
-        node = op.kube.list("Node")[0]
-        assert node.metadata.labels.get("team") == "ml"
-        assert node.metadata.labels[L.NODEPOOL] == "prop"
-        assert node.metadata.annotations.get("example.com/owner") == "sre"
+        for p in ok:
+            assert p.node_name
+            node = op.kube.get("Node", p.node_name)
+            assert node.metadata.labels[L.ZONE] == "us-west-2b"
+            assert node.metadata.labels[L.ZONE_ID] == "usw2-az2"
+        assert not bad.node_name  # contradictory pair never schedules
 
     def test_init_container_right_sizes_node(self, op):
         """should provision a right-sized node when a pod has
@@ -225,6 +231,28 @@ class TestPropagation:
         # effective = (cpu 7, mem 6Gi): the node must hold BOTH maxima
         assert node.allocatable["cpu"] >= 7000
         assert node.allocatable["memory"] >= 6 * 1024 ** 3
+
+
+class TestPropagation:
+    def test_node_annotations_and_labels(self, op, ec2):
+        """should apply annotations/labels from the NodePool template to
+        the node."""
+        from karpenter_provider_aws_tpu.apis.objects import EC2NodeClass
+        nc = EC2NodeClass("prop-class")
+        op.kube.create(nc)
+        np = NodePool("prop", template=NodePoolTemplate(
+            node_class_ref=NodeClassRef("prop-class"),
+            requirements=Requirements.from_terms([]),
+            labels={"team": "ml"},
+            annotations={"example.com/owner": "sre"}))
+        op.kube.create(np)
+        for p in make_pods(1, cpu="500m", memory="1Gi", prefix="prop"):
+            op.kube.create(p)
+        op.run_until_settled()
+        node = op.kube.list("Node")[0]
+        assert node.metadata.labels.get("team") == "ml"
+        assert node.metadata.labels[L.NODEPOOL] == "prop"
+        assert node.metadata.annotations.get("example.com/owner") == "sre"
 
     def test_naked_pod_and_deployment(self, op):
         """should provision a node for naked pods and deployment-owned
