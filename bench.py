@@ -261,6 +261,100 @@ def build_config7(env, n_pods, n_sigs=10_000):
     return env.snapshot(pods, [env.nodepool("bench-c7")])
 
 
+def build_batch_snapshots(env, batch=8, n_sigs=96, per=4):
+    """B independent run-heavy snapshots of ONE shape bucket for the
+    batched multi-solve (solver/tpu.py solve_batch): each snapshot has
+    n_sigs signatures striped over three family-disjoint pools (adjacent
+    groups admit disjoint pools, so the encoder's run detection fuses
+    them — ops/ffd_jax.py _solve_fused), and every snapshot pads to the
+    same statics tuple so all B ride one vmapped dispatch. The workload
+    models consolidation's candidate pre-screen: many small what-if
+    snapshots in hand at once."""
+    from karpenter_provider_aws_tpu.apis import labels as L
+    from karpenter_provider_aws_tpu.fake.environment import make_pods
+
+    fams = ["m5", "c5", "r5"]
+    pools = [env.nodepool(f"bench-batch-{f}", requirements=[
+        {"key": L.INSTANCE_FAMILY, "operator": "In", "values": [f]}])
+        for f in fams]
+    snaps = []
+    for b in range(batch):
+        pods = []
+        for i in range(n_sigs):
+            pods += make_pods(
+                per, cpu=f"{100 + (i * 7 + b * 31) % 400}m",
+                memory=f"{256 + (i * 13 + b * 57) % 700}Mi",
+                prefix=f"bt{b:02d}x{i:03d}",
+                node_selector={L.INSTANCE_FAMILY: fams[i % 3]})
+        snaps.append(env.snapshot(pods, pools))
+    return snaps
+
+
+def run_batch_bench(backend, batch=8, rounds=30):
+    """Batched multi-solve: B snapshots per device dispatch vs B
+    single device solves vs B host-twin solves. The dispatch overhead
+    (h2d, kernel launch, d2h sync) amortizes B-fold — the device-win
+    shape for small-solve fleets on a real accelerator (see
+    docs/solver-design.md 'Beating the host twin'). Caveat the numbers
+    honestly: on the CPU backend there is no dispatch-latency floor to
+    amortize, and vmap lowers the fuse cond to select (both branches
+    execute per lane), so batched > B x single there — read
+    amortization/device_wins only on a dispatch-bound dev_platform.
+
+    The device solvers are pinned to backend='jax': under 'auto' the
+    cost router would learn the host side mid-measurement and silently
+    swap engines out from under the timing loops (solve_batch itself
+    defers to the router's measured verdict in auto mode)."""
+    from karpenter_provider_aws_tpu.fake.environment import Environment
+    from karpenter_provider_aws_tpu.solver import CPUSolver
+    from karpenter_provider_aws_tpu.solver.route import (
+        dev_platform, device_alive)
+    from karpenter_provider_aws_tpu.solver.tpu import TPUSolver
+
+    rounds = min(rounds, 5)  # batched CPU-backend rounds are ~10s each
+    env = Environment()
+    snaps = build_batch_snapshots(env, batch=batch)
+    cpu = CPUSolver()
+    tpu = TPUSolver(backend="jax")
+    host = TPUSolver(backend="numpy")
+    device_alive()  # settle the async dev-engine probe: the warm
+    # solve_batch must actually batch, or the captured dispatch stats
+    # describe a host-twin fallback instead of the vmapped kernel
+    refs = [cpu.solve(s).decision_fingerprint() for s in snaps]
+    batched = tpu.solve_batch(snaps)          # warms the vmapped kernel
+    stats = dict(tpu.last_dispatch_stats)     # before singles overwrite
+    singles = [tpu.solve(s) for s in snaps]   # warms the single kernel
+    identical = (
+        [r.decision_fingerprint() for r in batched] == refs
+        and [r.decision_fingerprint() for r in singles] == refs)
+    cooldown(2.0)
+    baseline = calib_baseline()
+    t_batch, hot_b = guarded_rounds(
+        lambda: tpu.solve_batch(snaps), rounds, baseline)
+    t_single, hot_s = guarded_rounds(
+        lambda: [tpu.solve(s) for s in snaps], rounds, baseline)
+    t_host, hot_h = guarded_rounds(
+        lambda: [host.solve(s) for s in snaps], rounds, baseline)
+    pb, _ = _percentiles(t_batch)
+    ps, _ = _percentiles(t_single)
+    ph, _ = _percentiles(t_host)
+    return {
+        "config": "batch-solve", "batch": batch,
+        "pods_per_snapshot": len(snaps[0].pods),
+        "identical_decisions": identical,
+        "dev_platform": dev_platform(),
+        "batched_p50_ms": pb, "singles_p50_ms": ps, "host_p50_ms": ph,
+        "batched_per_solve_ms": round(pb / batch, 3),
+        "host_per_solve_ms": round(ph / batch, 3),
+        "amortization": round(ps / pb, 2) if pb else 0.0,
+        "device_wins": pb < ph,
+        "rounds": rounds,
+        "hot_rejected": hot_b + hot_s + hot_h,
+        "dispatch": stats,
+        "engine": _engine_report({"host": 0, "dev": 0}, tpu),
+    }
+
+
 def build_config5(env, n_pods):
     """Spot+OD price-capacity-optimized across weighted pools w/ limits."""
     from karpenter_provider_aws_tpu.apis import labels as L
@@ -382,15 +476,27 @@ def _count_engines(tpu):
     return counts
 
 
-def _engine_report(counts):
+def _engine_report(counts, tpu=None):
     from karpenter_provider_aws_tpu.solver.route import (dev_device_count,
                                                          dev_platform)
-    return {
+    rep = {
         "host_twin_solves": counts["host"],
         "device_solves": counts["dev"],
         "device_platform": dev_platform(),
         "device_count": dev_device_count(),
     }
+    if tpu is not None and getattr(tpu, "last_dispatch_stats", None):
+        # evidence from the LAST device dispatch (solver/tpu.py
+        # _record_dispatch): which kernel served, how many solves rode
+        # the dispatch (solve_batch vmap lane count), the scan trip
+        # count and the fused/sequential block split of the fused scan
+        st = tpu.last_dispatch_stats
+        rep.update(
+            kernel=st["kernel"], dispatch_batch=st["batch"],
+            fuse_width=st["fuse"], scan_steps=st["scan_steps"],
+            fused_blocks=st["fused_blocks"],
+            seq_blocks=st["seq_blocks"])
+    return rep
 
 
 def _phase_timed_dispatch(phases):
@@ -476,7 +582,7 @@ def run_solver_config(name, snap, backend, rounds):
         "rounds": rounds,
         "hot_rejected": hot_rejected,
         "calib_baseline_ms": round(baseline, 3),
-        "engine": _engine_report(counts),
+        "engine": _engine_report(counts, tpu),
         "decisions": ref.summary(),
     }
 
@@ -592,7 +698,7 @@ def run_config4(backend, rounds, n_nodes=200):
         "rounds": rounds,
         "hot_rejected": hot_rejected,
         "calib_baseline_ms": round(baseline, 3),
-        "engine": _engine_report({"host": -1, "dev": -1}),
+        "engine": _engine_report({"host": -1, "dev": -1}, tpu),
     }
 
 
@@ -846,11 +952,16 @@ def run_device_kernel_inner(pods, rounds):
             tpu.solve(snap)
             times.append((time.perf_counter() - t0) * 1000)
         p50, p99 = _percentiles(times)
-        return {"p50_ms": p50, "p99_ms": p99,
-                "identical_decisions": identical,
-                "device_solves": counts["dev"],
-                "host_solves": counts["host"],
-                "compile_s": round(compile_s, 1)}
+        out = {"p50_ms": p50, "p99_ms": p99,
+               "identical_decisions": identical,
+               "device_solves": counts["dev"],
+               "host_solves": counts["host"],
+               "compile_s": round(compile_s, 1)}
+        if getattr(tpu, "last_dispatch_stats", None):
+            # fused-scan evidence rides the record (kernel path, scan
+            # trip count, fused/seq block split, vmap batch width)
+            out["dispatch"] = dict(tpu.last_dispatch_stats)
+        return out
 
     def _total_timed(orig, phases):
         """Coarse device-boundary wall for dispatches whose placement
@@ -1027,6 +1138,11 @@ def main():
                     help="run a single config and print its row")
     ap.add_argument("--interruption", action="store_true",
                     help="run only the interruption throughput benchmark")
+    ap.add_argument("--batch-solve", action="store_true",
+                    help="bench the batched multi-solve (B snapshots per "
+                         "vmapped device dispatch vs B single solves)")
+    ap.add_argument("--batch", type=int, default=8,
+                    help="snapshots per dispatch for --batch-solve")
     ap.add_argument("--probe-device", action="store_true",
                     help="link-vs-kernel decomposition of the device path")
     ap.add_argument("--device-kernel", action="store_true",
@@ -1047,6 +1163,10 @@ def main():
 
     if args.interruption:
         print(json.dumps({"interruption": run_interruption_bench()}))
+        return
+    if args.batch_solve:
+        print(json.dumps(run_batch_bench(
+            args.backend, batch=args.batch, rounds=min(args.rounds, 30))))
         return
     if args.probe_device:
         run_device_probe(args.pods)

@@ -30,8 +30,9 @@ _uid_counter = itertools.count(1)
 def scaled_percent(pct: int, total: int, up: bool) -> int:
     """Exact integer percent scaling (k8s GetScaledValueFromIntOrPercent
     semantics — float math mis-rounds cases like 29% of 100). ``up``
-    picks the ceiling (minAvailable, budget nodes%), else the floor
-    (maxUnavailable)."""
+    picks the ceiling (minAvailable, maxUnavailable and budget nodes%
+    all resolve with roundUp=true in kube-controller-manager and core
+    karpenter), else the floor."""
     return -((-pct * total) // 100) if up else (pct * total) // 100
 
 
@@ -474,8 +475,9 @@ class PodDisruptionBudget(KubeObject):
     holds a node like do-not-disrupt does; the claim's
     terminationGracePeriod bypasses it, karpenter.sh_nodepools.yaml:411).
     Exactly one of min_available / max_unavailable is set; values are
-    counts or percentages ("50%"). k8s rounding: minAvailable % rounds
-    UP, maxUnavailable % rounds DOWN (both conservative)."""
+    counts or percentages ("50%"). k8s rounding: the disruption
+    controller resolves BOTH minAvailable % and maxUnavailable % with
+    GetScaledValueFromIntOrPercent(roundUp=true)."""
 
     kind = "PodDisruptionBudget"
 
@@ -501,7 +503,7 @@ class PodDisruptionBudget(KubeObject):
         """How many more matching pods may be evicted right now."""
         total = len(matching)
         if self.max_unavailable is not None:
-            cap = self._resolve(self.max_unavailable, total, up=False)
+            cap = self._resolve(self.max_unavailable, total, up=True)
             return max(0, cap - (total - healthy))
         floor = self._resolve(self.min_available, total, up=True)
         return max(0, healthy - floor)

@@ -270,33 +270,41 @@ class Terminator:
         if deadline is not None and now >= deadline:
             victims = bound
         else:
-            blocked, evictable = [], []
+            blocked, candidates = [], []
             for p in bound:
                 (blocked if p.metadata.annotations.get(
                     L.DO_NOT_DISRUPT_ANNOTATION) == "true"
-                 else evictable).append(p)
+                 else candidates).append(p)
             # preemptive deletion (karpenter.sh_nodepools.yaml:416): a
-            # blocked pod is force-deleted early enough that its own
-            # terminationGracePeriodSeconds still fits before the
+            # pod whose eviction is blocked — by do-not-disrupt OR by
+            # an exhausted PDB — is force-deleted early enough that its
+            # own terminationGracePeriodSeconds still fits before the
             # node's deadline. Deadline-driven, so it BYPASSES the
             # drain-group order — waiting behind earlier groups would
             # eat into the very window the preemption exists to protect
-            victims = [] if deadline is None else [
-                p for p in blocked
-                if now >= deadline - p.termination_grace_period_seconds]
-            # PDB gate: pods covered by an exhausted budget wait, like
-            # do-not-disrupt; the TGP paths above/below bypass it
-            # (karpenter.sh_nodepools.yaml:411)
-            from .pdb import take_allowance
-            evictable = [p for p in evictable
-                         if all(a > 0 for pdb, a in pdbs
-                                if pdb.matches(p))]
-            if not evictable and not victims:
-                return False  # do-not-disrupt / blocked PDBs hold it
-            if evictable:
-                first = min(_drain_group(p) for p in evictable)
-                for p in evictable:
+            from .pdb import blocking_pdb, take_allowance
+            victims = []
+            if deadline is not None:
+                victims += [
+                    p for p in blocked
+                    if now >= deadline - p.termination_grace_period_seconds]
+                victims += [
+                    p for p in candidates
+                    if blocking_pdb(pdbs, p) is not None
+                    and now >= deadline - p.termination_grace_period_seconds]
+            # drain order is decided over ALL non-do-not-disrupt bound
+            # pods, INCLUDING ones an exhausted PDB currently blocks
+            # (termination_test.go:56-61): a PDB-blocked group-0 pod
+            # holds later groups back — critical pods keep running —
+            # until its budget frees up or the TGP deadline forces it.
+            # Only the current group's PDB-allowed members are evicted
+            # this round (karpenter.sh_nodepools.yaml:411).
+            if candidates:
+                victim_ids = {id(p) for p in victims}
+                first = min(_drain_group(p) for p in candidates)
+                for p in candidates:
                     if _drain_group(p) == first \
+                            and id(p) not in victim_ids \
                             and take_allowance(pdbs, p):
                         victims.append(p)
         for p in victims:

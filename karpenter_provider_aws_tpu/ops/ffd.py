@@ -30,6 +30,13 @@ For group g with per-pod request vector R and n pods:
 Equivalence to the per-pod CPU oracle holds because the canonical pod order
 keeps groups contiguous (solver/cpu.py::pod_sort_key) and all the above
 counters are the closed forms of the oracle's per-pod loop.
+
+The device engine's FUSED scan (ops/ffd_jax.py ``_solve_fused``) changes
+none of this math: it only reorders the evaluation of fill phases across
+groups the encoder proves pairwise disjoint on both contention axes
+(admitted pools and compatible existing nodes — models/encoding.py
+``independent_runs``), which therefore commute. This host twin stays the
+per-group reference the fused kernel is fuzz-checked against.
 """
 
 from __future__ import annotations

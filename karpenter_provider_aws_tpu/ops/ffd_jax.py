@@ -14,9 +14,28 @@ compiles once and is reused across solve rounds while the catalog seqnum is
 stable — the same cache-warmness discipline the reference applies to its
 instance-type cache (instancetype.go:119-130).
 
-Exactness: all quantities are int64 (``jax_enable_x64``); comparisons and
-floor-divisions are bit-identical to the numpy engine, so decisions match
-the CPU oracle exactly.
+Exactness: resource quantities and headrooms are int64
+(``jax_enable_x64`` — BIG sentinels and byte-scale quantities overflow
+int32); comparisons and floor-divisions are bit-identical to the numpy
+engine, so decisions match the CPU oracle exactly. Bookkeeping outputs
+whose range is bounded by the POD COUNT (the per-slot ``takes``) are
+carried int32 on the wire — two lanes per int64 word — halving the
+dominant [G, N] d2h tensor without touching any decision-bearing
+comparison.
+
+Fused-group scan (``_solve_fused``): the encoder's run detection
+(models/encoding.py independent_runs) marks maximal runs of groups whose
+admit rows — and, when existing nodes are present, ex_compat rows — are
+pairwise disjoint. Disjoint groups cannot contend for any slot, any
+existing node, or any pool budget, so their fill phases (steps 1-4)
+commute: the kernel scans BLOCKS of F groups and, when a block lies
+inside one run, computes all F fill phases from the block-start carry in
+one vmapped pass and merges the disjoint deltas. New-node creation
+(step 5) stays sequential within the block either way — slot indices
+are ordinal in ``num_nodes``. Blocks that straddle runs unroll the F
+plain steps sequentially inside the block, so the scan trip count drops
+F-fold unconditionally; the vectorized branch additionally collapses
+the per-group latency chain on run-heavy snapshots.
 """
 
 from __future__ import annotations
@@ -208,8 +227,12 @@ def _solve(inp: KernelInputs, n_max: int, E: int, P: int,
     slot_idx = jnp.arange(N)
 
     def step(carry: Carry, xs):
-        return plain_group_step(inp, carry, xs, axis=axis, P=P, E=E, N=N,
-                                V=V, slot_idx=slot_idx, sum_only=sum_only)
+        new_carry, (take, n_rem) = plain_group_step(
+            inp, carry, xs, axis=axis, P=P, E=E, N=N,
+            V=V, slot_idx=slot_idx, sum_only=sum_only)
+        # takes ride the wire int32 (bounded by the pod count); the
+        # carry and leftover stay int64
+        return new_carry, (take.astype(jnp.int32), n_rem)
 
     xs = (inp.R, inp.n, inp.F, inp.agz, inp.agc, inp.admit, inp.daemon,
           inp.ex_compat)
@@ -217,13 +240,14 @@ def _solve(inp: KernelInputs, n_max: int, E: int, P: int,
     return takes, leftover, final
 
 
-def plain_group_step(inp: KernelInputs, carry: Carry, xs, *, axis, P, E, N,
-                     V, slot_idx, sum_only=False):
-    """One scan step of the closed-form (topology-free) group fill —
-    factored out so the topology kernel (ops/topo_jax.py) runs the same
-    math for its non-topology groups, sharing this single implementation
-    with the plain kernel."""
-    R, n, F, agz, agc, admit, daemon, ex_compat = xs
+def _fill_phase(inp: KernelInputs, carry: Carry, R, n, F, agz, agc, admit,
+                ex_compat, *, axis, P, E, N, V, sum_only):
+    """Steps 1-4 of one group fill, WITHOUT mutating the carry: returns
+    (take [N], n_rem, cand [N, T]). Factored out of plain_group_step so
+    the fused kernel can vmap it over a run of pairwise pool/existing-
+    disjoint groups from the same block-start carry — disjointness makes
+    every quantity read here (slot masks, existing headrooms, pool
+    budgets) identical to what the sequential execution would read."""
     T, D = inp.A.shape
     Z = inp.agz.shape[1]
     C = inp.agc.shape[1]
@@ -270,22 +294,58 @@ def plain_group_step(inp: KernelInputs, carry: Carry, xs, *, axis, P, E, N,
     cum = _cumsum(k) - k
     take = jnp.clip(n_rem - cum, 0, k)
     n_rem = n_rem - take.sum()
+    return take, n_rem, cand
 
+
+def plain_group_step(inp: KernelInputs, carry: Carry, xs, *, axis, P, E, N,
+                     V, slot_idx, sum_only=False):
+    """One scan step of the closed-form (topology-free) group fill —
+    factored out so the topology kernel (ops/topo_jax.py) runs the same
+    math for its non-topology groups, sharing this single implementation
+    with the plain kernel."""
+    R, n, F, agz, agc, admit, daemon, ex_compat = xs
+    take, n_rem, cand = _fill_phase(
+        inp, carry, R, n, F, agz, agc, admit, ex_compat,
+        axis=axis, P=P, E=E, N=N, V=V, sum_only=sum_only)
+
+    # ---- narrowing + pool accounting for the filled slots ---------
     used = carry.used + take[:, None] * R[None, :]
     filled_open = (take > 0) & (carry.pool >= 0)
     fit_all = (used[:, None, :] <= inp.A[None, :, :]).all(axis=-1)
     types = jnp.where(filled_open[:, None], cand & fit_all, carry.types)
     zones = jnp.where(filled_open[:, None], carry.zones & agz[None, :], carry.zones)
     ct = jnp.where(filled_open[:, None], carry.ct & agc[None, :], carry.ct)
+    pool_clipped = jnp.clip(carry.pool, 0, P - 1)
     take_by_pool = jax.ops.segment_sum(
         take, pool_clipped * (carry.pool >= 0) + (carry.pool < 0) * P,
         num_segments=P + 1)[:P]
-    pool_used = pool_used + take_by_pool[:, None] * R[None, :]
+    pool_used = carry.pool_used + take_by_pool[:, None] * R[None, :]
 
-    # ---- new nodes pool-by-pool (step 5) --------------------------
-    pool_arr = carry.pool
-    alive = carry.alive
-    num_nodes = carry.num_nodes
+    (take, used, types, zones, ct, pool_arr, alive, num_nodes, pool_used,
+     n_rem) = _new_nodes_phase(
+        inp, take, used, types, zones, ct, carry.pool, carry.alive,
+        carry.num_nodes, pool_used, n_rem, R, F, agz, agc, admit, daemon,
+        axis=axis, P=P, E=E, N=N, V=V, slot_idx=slot_idx,
+        sum_only=sum_only)
+
+    new_carry = Carry(used=used, types=types, zones=zones, ct=ct,
+                      pool=pool_arr, alive=alive, num_nodes=num_nodes,
+                      pool_used=pool_used)
+    return new_carry, (take, n_rem)
+
+
+def _new_nodes_phase(inp: KernelInputs, take, used, types, zones, ct,
+                     pool_arr, alive, num_nodes, pool_used, n_rem,
+                     R, F, agz, agc, admit, daemon, *, axis, P, E, N, V,
+                     slot_idx, sum_only):
+    """Step 5 of one group fill: open new nodes pool-by-pool. Operates on
+    explicit state arrays (not the Carry) so the fused kernel can run it
+    sequentially per group AFTER merging a whole run's fill phases —
+    new-node slot indices are ordinal in ``num_nodes`` and must be
+    allocated in group order regardless of how the fills were batched."""
+    T, D = inp.A.shape
+    Z = inp.agz.shape[1]
+    C = inp.agc.shape[1]
     for pi in range(P):
         agz_p = agz & inp.pool_agz[pi]
         agc_p = agc & inp.pool_agc[pi]
@@ -328,10 +388,143 @@ def plain_group_step(inp: KernelInputs, carry: Carry, xs, *, axis, P, E, N,
         pool_used = pool_used.at[pi].add(placed * R)
         n_rem = n_rem - placed
 
-    new_carry = Carry(used=used, types=types, zones=zones, ct=ct,
-                      pool=pool_arr, alive=alive, num_nodes=num_nodes,
-                      pool_used=pool_used)
-    return new_carry, (take, n_rem)
+    return (take, used, types, zones, ct, pool_arr, alive, num_nodes,
+            pool_used, n_rem)
+
+
+def _solve_fused(inp: KernelInputs, n_max: int, E: int, P: int, Fu: int,
+                 fuse: jax.Array, V: int = 0
+                 ) -> Tuple[jax.Array, jax.Array, Carry]:
+    """The F-wide block scan: same decisions as ``_solve``, G/Fu trips.
+
+    ``fuse`` [G] bool is the encoder/solver's ``same_run_as_prev`` flag
+    (models/encoding.py independent_runs ANDed with the solver's
+    existing-node walk): True at g proves group g's admit AND ex_compat
+    rows are disjoint from every row of the run containing g-1. A block
+    of Fu consecutive groups whose last Fu-1 flags are all True lies
+    inside ONE run, so its groups are pairwise disjoint and the block
+    takes the vectorized branch:
+
+    - all Fu fill phases run from the BLOCK-START carry via vmap. Exact,
+      because a group's fill reads only state its run-mates never write:
+      open slots belong to admitted pools (disjoint), existing rows to
+      compatible nodes (disjoint), pool budgets to admitted pools
+      (disjoint), and a run-mate's step-5 slots belong to ITS pools —
+      never admitted by this group;
+    - the disjoint fill deltas merge by sum (used, pool_used) and
+      masked select (types/zones/ct — at most one group fills a slot);
+    - step 5 unrolls sequentially over the block either way: new-node
+      slots are ordinal in num_nodes and later groups' budgets read
+      earlier groups' placements.
+
+    A block that straddles runs takes the sequential branch — Fu plain
+    steps unrolled inside one trip — so the scan's trip count (the
+    per-step dispatch/latency floor the roofline in
+    docs/solver-design.md measures) drops Fu-fold unconditionally.
+    The caller guarantees G % Fu == 0 (pow2 bucketing) and gates off
+    minValues floors and the mesh axis."""
+    T, D = inp.A.shape
+    Z = inp.agz.shape[1]
+    C = inp.agc.shape[1]
+    N = E + n_max
+    G = inp.R.shape[0]
+    B = G // Fu
+
+    carry0 = Carry(
+        used=jnp.zeros((N, D), jnp.int64).at[:E].set(inp.ex_used0),
+        types=jnp.zeros((N, T), bool),
+        zones=jnp.zeros((N, Z), bool),
+        ct=jnp.zeros((N, C), bool),
+        pool=jnp.full((N,), -1, jnp.int32).at[:E].set(-2),
+        alive=jnp.zeros((N,), bool).at[:E].set(True),
+        num_nodes=jnp.int32(0),
+        pool_used=inp.pool_used0,
+    )
+    slot_idx = jnp.arange(N)
+
+    xs = (inp.R, inp.n, inp.F, inp.agz, inp.agc, inp.admit, inp.daemon,
+          inp.ex_compat)
+    xs_b = tuple(x.reshape((B, Fu) + x.shape[1:]) for x in xs)
+    blk_indep = fuse.reshape(B, Fu)[:, 1:].all(axis=1)
+
+    def seq_block(args):
+        carry, xs_blk = args
+        takes, lefts = [], []
+        for i in range(Fu):
+            xs_i = tuple(x[i] for x in xs_blk)
+            carry, (tk, lf) = plain_group_step(
+                inp, carry, xs_i, axis=None, P=P, E=E, N=N, V=V,
+                slot_idx=slot_idx)
+            takes.append(tk)
+            lefts.append(lf)
+        return carry, (jnp.stack(takes), jnp.stack(lefts))
+
+    def vec_block(args):
+        carry, xs_blk = args
+        R, n, F, agz, agc, admit, daemon, ex_compat = xs_blk
+
+        def fill(R_, n_, F_, agz_, agc_, admit_, exc_):
+            return _fill_phase(inp, carry, R_, n_, F_, agz_, agc_,
+                               admit_, exc_, axis=None, P=P, E=E, N=N,
+                               V=V, sum_only=False)
+
+        take_f, n_rem_f, cand_f = jax.vmap(fill)(
+            R, n, F, agz, agc, admit, ex_compat)
+
+        # merge the pairwise-disjoint fill deltas
+        used = carry.used + (take_f[:, :, None] * R[:, None, :]).sum(axis=0)
+        filled_f = (take_f > 0) & (carry.pool >= 0)[None, :]
+        any_filled = filled_f.any(axis=0)
+        fit_all = (used[:, None, :] <= inp.A[None, :, :]).all(axis=-1)
+        # at most one group fills a slot, so OR selects ITS cand row;
+        # fit_all from the merged `used` is exact for that slot (the
+        # other groups contributed zero there)
+        cand_sel = (filled_f[:, :, None] & cand_f).any(axis=0)
+        types = jnp.where(any_filled[:, None], cand_sel & fit_all,
+                          carry.types)
+        agz_keep = jnp.where(filled_f[:, :, None], agz[:, None, :],
+                             True).all(axis=0)
+        zones = jnp.where(any_filled[:, None], carry.zones & agz_keep,
+                          carry.zones)
+        agc_keep = jnp.where(filled_f[:, :, None], agc[:, None, :],
+                             True).all(axis=0)
+        ct = jnp.where(any_filled[:, None], carry.ct & agc_keep, carry.ct)
+        pool_clipped = jnp.clip(carry.pool, 0, P - 1)
+        seg = pool_clipped * (carry.pool >= 0) + (carry.pool < 0) * P
+
+        def pool_delta(take_, R_):
+            tbp = jax.ops.segment_sum(take_, seg, num_segments=P + 1)[:P]
+            return tbp[:, None] * R_[None, :]
+
+        pool_used = carry.pool_used \
+            + jax.vmap(pool_delta)(take_f, R).sum(axis=0)
+
+        # step 5 sequentially per group: ordinal slot allocation
+        pool_arr, alive, num_nodes = carry.pool, carry.alive, carry.num_nodes
+        takes, lefts = [], []
+        for i in range(Fu):
+            (tk, used, types, zones, ct, pool_arr, alive, num_nodes,
+             pool_used, lf) = _new_nodes_phase(
+                inp, take_f[i], used, types, zones, ct, pool_arr, alive,
+                num_nodes, pool_used, n_rem_f[i], R[i], F[i], agz[i],
+                agc[i], admit[i], daemon[i], axis=None, P=P, E=E, N=N,
+                V=V, slot_idx=slot_idx, sum_only=False)
+            takes.append(tk)
+            lefts.append(lf)
+        new_carry = Carry(used=used, types=types, zones=zones, ct=ct,
+                          pool=pool_arr, alive=alive, num_nodes=num_nodes,
+                          pool_used=pool_used)
+        return new_carry, (jnp.stack(takes), jnp.stack(lefts))
+
+    def step(carry, xsb):
+        xs_blk, indep = xsb[:-1], xsb[-1]
+        carry2, (tk, lf) = jax.lax.cond(indep, vec_block, seq_block,
+                                        (carry, xs_blk))
+        return carry2, (tk.astype(jnp.int32), lf)
+
+    final, (takes_b, left_b) = jax.lax.scan(step, carry0,
+                                            xs_b + (blk_indep,))
+    return takes_b.reshape(G, N), left_b.reshape(G), final
 
 
 def _pool_budget_jax(limit: jax.Array, used: jax.Array, R: jax.Array) -> jax.Array:
@@ -556,8 +749,9 @@ def _solve_pruned(inp: KernelInputs, n_max: int, E: int, P: int, S: int):
     slot_idx = jnp.arange(N)
 
     def step(carry, xs):
-        return pruned_group_step(inp, carry, xs, P=P, E=E, N=N, S=S,
-                                 slot_idx=slot_idx)
+        new_carry, (take, n_rem) = pruned_group_step(
+            inp, carry, xs, P=P, E=E, N=N, S=S, slot_idx=slot_idx)
+        return new_carry, (take.astype(jnp.int32), n_rem)
 
     xs = (inp.R, inp.n, inp.F, inp.agz, inp.agc, inp.admit, inp.daemon,
           inp.ex_compat)
@@ -584,13 +778,17 @@ from .hostpack import (DEV_PRUNED_SLOTS,  # noqa: E402
 
 
 def _unpack_inputs(buf_i64: jax.Array, buf_bool: jax.Array,
-                   T, D, Z, C, G, E, P, K=0, M=0) -> KernelInputs:
-    vals = _split(buf_i64, _in_layout_i64(T, D, Z, C, G, E, P, K, M))
-    vals.update(_split(buf_bool, _in_layout_bool(T, D, Z, C, G, E, P, K, M)))
+                   T, D, Z, C, G, E, P, K=0, M=0, F=1):
+    """Returns (KernelInputs, fuse-or-None): the same_run_as_prev flags
+    ride the bool section only when the fused kernel is engaged (F>1)."""
+    vals = _split(buf_i64, _in_layout_i64(T, D, Z, C, G, E, P, K, M, F))
+    vals.update(_split(buf_bool,
+                       _in_layout_bool(T, D, Z, C, G, E, P, K, M, F)))
     if K == 0:
         for nm in ("mv_floor", "mv_pairs_t", "mv_pairs_v"):
             vals.pop(nm, None)
-    return KernelInputs(**vals)
+    fuse = vals.pop("fuse", None)
+    return KernelInputs(**vals), fuse
 
 
 # ---------------------------------------------------------------------------
@@ -621,22 +819,26 @@ def _words_to_bits(words: jax.Array, nbits: int) -> jax.Array:
     return bits.reshape(-1)[:nbits].astype(bool)
 
 
-@partial(jax.jit, static_argnames=("T", "D", "Z", "C", "G", "E", "P",
-                                   "K", "V", "M", "n_max"))
-def solve_scan_packed1(buf: jax.Array, *, T: int, D: int, Z: int, C: int,
-                       G: int, E: int, P: int, n_max: int,
-                       K: int = 0, V: int = 0, M: int = 0) -> jax.Array:
-    """One buffer in, one buffer out — a solve is a single round trip."""
-    n_i64 = _layout_sizes(_in_layout_i64(T, D, Z, C, G, E, P, K, M))
-    n_bits = _layout_sizes(_in_layout_bool(T, D, Z, C, G, E, P, K, M))
-    bool_flat = _words_to_bits(buf[n_i64:n_i64 + _nwords(n_bits)], n_bits)
-    inp = _unpack_inputs(buf[:n_i64], bool_flat, T, D, Z, C, G, E, P, K, M)
-    takes, leftover, carry = _solve(inp, n_max, E, P, V=V)
+def _i32_to_words(x: jax.Array) -> jax.Array:
+    """Device: int32-valued array -> int64 wire words, two lanes per
+    word, little-lane-first (hostpack.unpack_i32_words is the inverse)."""
+    v = x.reshape(-1).astype(jnp.int32)
+    if v.shape[0] % 2:
+        v = jnp.concatenate([v, jnp.zeros(1, jnp.int32)])
+    u = jax.lax.bitcast_convert_type(v, jnp.uint32).astype(jnp.uint64)
+    w = u[0::2] | (u[1::2] << jnp.uint64(32))
+    return jax.lax.bitcast_convert_type(w, jnp.int64)
+
+
+def _pack_solve_outputs(takes, leftover, carry) -> jax.Array:
+    """[i64 section | int32-packed takes | bitpacked bools] — the device
+    half of hostpack.out_layout's three-section contract."""
     out_i64 = jnp.concatenate([
-        takes.reshape(-1), leftover.reshape(-1),
+        leftover.reshape(-1).astype(jnp.int64),
         carry.used.reshape(-1), carry.pool.astype(jnp.int64),
         carry.num_nodes.reshape(1).astype(jnp.int64),
         carry.pool_used.reshape(-1)])
+    out_t32 = _i32_to_words(takes)
     out_bool = jnp.concatenate([
         carry.types.reshape(-1), carry.zones.reshape(-1),
         carry.ct.reshape(-1), carry.alive])
@@ -644,7 +846,51 @@ def solve_scan_packed1(buf: jax.Array, *, T: int, D: int, Z: int, C: int,
     pad = _nwords(nb) * 64 - nb
     out_words = _bits_to_words(jnp.concatenate(
         [out_bool, jnp.zeros(pad, bool)]))
-    return jnp.concatenate([out_i64, out_words])
+    return jnp.concatenate([out_i64, out_t32, out_words])
+
+
+def _packed1_body(buf: jax.Array, *, T, D, Z, C, G, E, P, n_max,
+                  K, V, M, F) -> jax.Array:
+    n_i64 = _layout_sizes(_in_layout_i64(T, D, Z, C, G, E, P, K, M, F))
+    n_bits = _layout_sizes(_in_layout_bool(T, D, Z, C, G, E, P, K, M, F))
+    bool_flat = _words_to_bits(buf[n_i64:n_i64 + _nwords(n_bits)], n_bits)
+    inp, fuse = _unpack_inputs(buf[:n_i64], bool_flat, T, D, Z, C, G, E,
+                               P, K, M, F)
+    if F > 1:
+        takes, leftover, carry = _solve_fused(inp, n_max, E, P, F, fuse,
+                                              V=V)
+    else:
+        takes, leftover, carry = _solve(inp, n_max, E, P, V=V)
+    return _pack_solve_outputs(takes, leftover, carry)
+
+
+@partial(jax.jit, static_argnames=("T", "D", "Z", "C", "G", "E", "P",
+                                   "K", "V", "M", "n_max", "F"))
+def solve_scan_packed1(buf: jax.Array, *, T: int, D: int, Z: int, C: int,
+                       G: int, E: int, P: int, n_max: int,
+                       K: int = 0, V: int = 0, M: int = 0,
+                       F: int = 1) -> jax.Array:
+    """One buffer in, one buffer out — a solve is a single round trip.
+    F > 1 engages the fused-group block scan (caller-gated: G % F == 0,
+    no minValues floors, single device)."""
+    return _packed1_body(buf, T=T, D=D, Z=Z, C=C, G=G, E=E, P=P,
+                         n_max=n_max, K=K, V=V, M=M, F=F)
+
+
+@partial(jax.jit, static_argnames=("T", "D", "Z", "C", "G", "E", "P",
+                                   "K", "V", "M", "n_max", "F"))
+def solve_scan_packed1_many(bufs: jax.Array, *, T: int, D: int, Z: int,
+                            C: int, G: int, E: int, P: int, n_max: int,
+                            K: int = 0, V: int = 0, M: int = 0,
+                            F: int = 1) -> jax.Array:
+    """B solves, ONE dispatch: vmap of the packed body over stacked
+    [B, W] buffers sharing one statics bucket. vmap-of-scan batches the
+    carry, so B snapshots cost G (or G/F) scan trips TOTAL — the
+    multi-solve amortization consolidation's pre-screen and the
+    sidecar's queued solves ride (solver/tpu.py solve_batch)."""
+    fn = partial(_packed1_body, T=T, D=D, Z=Z, C=C, G=G, E=E, P=P,
+                 n_max=n_max, K=K, V=V, M=M, F=F)
+    return jax.vmap(fn)(bufs)
 
 
 @partial(jax.jit, static_argnames=("T", "D", "Z", "C", "G", "E", "P",
@@ -659,19 +905,8 @@ def solve_scan_packed1_pruned(buf: jax.Array, *, T: int, D: int, Z: int,
     n_i64 = _layout_sizes(_in_layout_i64(T, D, Z, C, G, E, P, 0, 0))
     n_bits = _layout_sizes(_in_layout_bool(T, D, Z, C, G, E, P, 0, 0))
     bool_flat = _words_to_bits(buf[n_i64:n_i64 + _nwords(n_bits)], n_bits)
-    inp = _unpack_inputs(buf[:n_i64], bool_flat, T, D, Z, C, G, E, P, 0, 0)
+    inp, _ = _unpack_inputs(buf[:n_i64], bool_flat, T, D, Z, C, G, E, P,
+                            0, 0)
     takes, leftover, carry = _solve_pruned(inp, n_max, E, P, S)
-    out_i64 = jnp.concatenate([
-        takes.reshape(-1), leftover.reshape(-1),
-        carry.used.reshape(-1), carry.pool.astype(jnp.int64),
-        carry.num_nodes.reshape(1).astype(jnp.int64),
-        carry.pool_used.reshape(-1)])
-    out_bool = jnp.concatenate([
-        carry.types.reshape(-1), carry.zones.reshape(-1),
-        carry.ct.reshape(-1), carry.alive])
-    nb = out_bool.shape[0]
-    pad = _nwords(nb) * 64 - nb
-    out_words = _bits_to_words(jnp.concatenate(
-        [out_bool, jnp.zeros(pad, bool)]))
-    return jnp.concatenate([out_i64, out_words,
+    return jnp.concatenate([_pack_solve_outputs(takes, leftover, carry),
                             carry.bail.astype(jnp.int64).reshape(1)])

@@ -18,8 +18,19 @@ from ..native import pack_bits, unpack_bits
 #: statics order on the sidecar wire — shared by client and server. The
 #: minValues keys append AFTER n_max so a version-skewed old server still
 #: reads its 8 keys correctly (its buffer-size check then rejects K>0
-#: requests loudly instead of misparsing n_max)
-STATIC_KEYS = ("T", "D", "Z", "C", "G", "E", "P", "n_max", "K", "V", "M")
+#: requests loudly instead of misparsing n_max); the fusion factor F
+#: appends after M under the same discipline (an old server reads 11
+#: keys and rejects the 12-key request loudly, never misparses)
+STATIC_KEYS = ("T", "D", "Z", "C", "G", "E", "P", "n_max", "K", "V", "M",
+               "F")
+
+#: default fused-scan block width (groups batched per scan step when the
+#: encoder's run detection proves them pairwise pool/existing-disjoint) —
+#: the ONE source for the solver knob (solver/tpu.py dev_fuse) and the
+#: kernel signature default. 4 cuts the scan trip count 4x on run-heavy
+#: snapshots while keeping the step body (both cond branches trace F
+#: group fills) within the compile-time envelope of the base step.
+DEV_FUSE = 4
 
 #: default exact-slot budget per pruned-kernel step — the ONE source for
 #: the kernel signature default (ops/ffd_jax.py), the local solver knob
@@ -31,7 +42,7 @@ STATIC_KEYS = ("T", "D", "Z", "C", "G", "E", "P", "n_max", "K", "V", "M")
 DEV_PRUNED_SLOTS = 64
 
 
-def in_layout_i64(T, D, Z, C, G, E, P, K=0, M=0):
+def in_layout_i64(T, D, Z, C, G, E, P, K=0, M=0, F=1):
     """(name, shape) of every int64 input, in buffer order. K/M are the
     minValues key/pair counts (0 = feature absent, zero extra bytes)."""
     return [("A", (T, D)), ("R", (G, D)), ("n", (G,)),
@@ -41,21 +52,31 @@ def in_layout_i64(T, D, Z, C, G, E, P, K=0, M=0):
             ("mv_pairs_t", (K, M)), ("mv_pairs_v", (K, M))]
 
 
-def in_layout_bool(T, D, Z, C, G, E, P, K=0, M=0):
-    return [("avail_zc", (T, Z * C)), ("F", (G, T)), ("agz", (G, Z)),
+def in_layout_bool(T, D, Z, C, G, E, P, K=0, M=0, F=1):
+    base = [("avail_zc", (T, Z * C)), ("F", (G, T)), ("agz", (G, Z)),
             ("agc", (G, C)), ("admit", (G, P)),
             ("pool_types", (P, T)), ("pool_agz", (P, Z)),
             ("pool_agc", (P, C)), ("ex_compat", (G, E))]
+    if F > 1:
+        # same_run_as_prev flags (models/encoding.py independent_runs):
+        # data, not statics — only the block width F keys the compile
+        base.append(("fuse", (G,)))
+    return base
 
 
 def out_layout(T, D, Z, C, G, E, P, n_max):
-    """((i64 name, shape)…), ((bool name, shape)…) of the packed outputs."""
+    """((i64 name, shape)…), ((i32 name, shape)…), ((bool name, shape)…)
+    of the packed outputs. takes rides the int32 section: a single
+    slot's take is bounded by the pod count (< 2^31 by construction),
+    so two lanes pack per int64 wire word and the dominant [G, N]
+    output tensor halves on the d2h leg."""
     N = E + n_max
-    i64 = [("takes", (G, N)), ("leftover", (G,)), ("used", (N, D)),
+    i64 = [("leftover", (G,)), ("used", (N, D)),
            ("pool", (N,)), ("num_nodes", (1,)), ("pool_used", (P, D))]
+    i32 = [("takes", (G, N))]
     bl = [("types", (N, T)), ("zones", (N, Z)), ("ct", (N, C)),
           ("alive", (N,))]
-    return i64, bl
+    return i64, i32, bl
 
 
 def split(buf, layout) -> dict:
@@ -87,33 +108,67 @@ def nwords(nbits: int) -> int:
     return (nbits + 63) // 64
 
 
-def pack_inputs1(arrays: dict, T, D, Z, C, G, E, P, K=0, M=0) -> np.ndarray:
+def nwords32(nvals: int) -> int:
+    """int64 wire words needed for ``nvals`` int32 lanes (two per word)."""
+    return (nvals + 1) // 2
+
+
+def pack_i32_words(vals: np.ndarray) -> np.ndarray:
+    """Host: flat int32-valued array -> int64 wire words, two lanes per
+    word, little-lane-first — mirrors the device's bitcast packing
+    (ops/ffd_jax.py _i32_to_words) so no layout assumption crosses."""
+    v = np.asarray(vals).reshape(-1).astype(np.int64)
+    if v.size % 2:
+        v = np.concatenate([v, np.zeros(1, np.int64)])
+    u = (v & np.int64(0xFFFFFFFF)).view(np.uint64)
+    return (u[0::2] | (u[1::2] << np.uint64(32))).view(np.int64)
+
+
+def unpack_i32_words(words: np.ndarray, nvals: int) -> np.ndarray:
+    """Host: int64 wire words -> int64 array of the sign-extended int32
+    lanes (callers keep doing int64 math on the result)."""
+    u = np.ascontiguousarray(words).view(np.uint64)
+    lo = (u & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    hi = (u >> np.uint64(32)).astype(np.uint32)
+    out = np.empty(u.size * 2, dtype=np.int32)
+    out[0::2] = lo.view(np.int32)
+    out[1::2] = hi.view(np.int32)
+    return out[:nvals].astype(np.int64)
+
+
+def pack_inputs1(arrays: dict, T, D, Z, C, G, E, P, K=0, M=0,
+                 F=1) -> np.ndarray:
     """Host: all inputs -> ONE int64 buffer [i64 fields | bitpacked bools]."""
     empty = np.zeros(0, dtype=np.int64)
     i64 = np.concatenate([
         np.asarray(arrays.get(nm, empty)).reshape(-1).astype(np.int64)
-        for nm, _ in in_layout_i64(T, D, Z, C, G, E, P, K, M)])
+        for nm, _ in in_layout_i64(T, D, Z, C, G, E, P, K, M, F)])
     bl = np.concatenate([arrays[nm].reshape(-1).astype(bool)
-                         for nm, _ in in_layout_bool(T, D, Z, C, G, E, P, K, M)])
+                         for nm, _ in in_layout_bool(T, D, Z, C, G, E, P,
+                                                     K, M, F)])
     return np.concatenate([i64, pack_bits(bl)])
 
 
 def unpack_outputs1(buf, T, D, Z, C, G, E, P, n_max) -> dict:
     """Host: the single fetched buffer -> dict of arrays."""
-    li, lb = out_layout(T, D, Z, C, G, E, P, n_max)
+    li, l32, lb = out_layout(T, D, Z, C, G, E, P, n_max)
     n_i64 = layout_sizes(li)
+    n_32 = layout_sizes(l32)
+    w32 = nwords32(n_32)
     n_bits = layout_sizes(lb)
-    bool_flat = unpack_bits(np.ascontiguousarray(buf[n_i64:]), n_bits)
+    i32_flat = unpack_i32_words(buf[n_i64:n_i64 + w32], n_32)
+    bool_flat = unpack_bits(np.ascontiguousarray(buf[n_i64 + w32:]), n_bits)
     vals = split(buf[:n_i64], li)
+    vals.update(split(i32_flat, l32))
     vals.update(split(bool_flat, lb))
     return vals
 
 
-def unpack_inputs1(buf, T, D, Z, C, G, E, P, K=0, M=0) -> dict:
+def unpack_inputs1(buf, T, D, Z, C, G, E, P, K=0, M=0, F=1) -> dict:
     """Inverse of pack_inputs1 (the sidecar server's mesh path unpacks
     the wire buffer back into arrays to shard them over its local mesh)."""
-    li = in_layout_i64(T, D, Z, C, G, E, P, K, M)
-    lb = in_layout_bool(T, D, Z, C, G, E, P, K, M)
+    li = in_layout_i64(T, D, Z, C, G, E, P, K, M, F)
+    lb = in_layout_bool(T, D, Z, C, G, E, P, K, M, F)
     n_i64 = layout_sizes(li)
     bool_flat = unpack_bits(np.ascontiguousarray(buf[n_i64:]),
                             layout_sizes(lb))
@@ -125,10 +180,12 @@ def unpack_inputs1(buf, T, D, Z, C, G, E, P, K=0, M=0) -> dict:
 def pack_outputs1(arrays: dict, T, D, Z, C, G, E, P, n_max) -> np.ndarray:
     """Inverse of unpack_outputs1 (the server's mesh path re-packs the
     carry into the single wire buffer the client expects)."""
-    li, lb = out_layout(T, D, Z, C, G, E, P, n_max)
+    li, l32, lb = out_layout(T, D, Z, C, G, E, P, n_max)
     i64 = np.concatenate([
         np.asarray(arrays[nm]).reshape(-1).astype(np.int64)
         for nm, _ in li])
+    i32 = np.concatenate([
+        np.asarray(arrays[nm]).reshape(-1) for nm, _ in l32])
     bl = np.concatenate([np.asarray(arrays[nm]).reshape(-1).astype(bool)
                          for nm, _ in lb])
-    return np.concatenate([i64, pack_bits(bl)])
+    return np.concatenate([i64, pack_i32_words(i32), pack_bits(bl)])

@@ -35,8 +35,23 @@ from ..ops.ffd_jax import Carry, KernelInputs, _solve
 
 AXIS = "tp"
 
-#: mesh id -> detected sum_only verdict (solve_scan_sharded memoization)
+#: mesh fingerprint -> detected sum_only verdict (solve_scan_sharded
+#: memoization). Keyed by a STABLE mesh identity — platform, platform
+#: version and device ids — never by id(mesh): a garbage-collected mesh's
+#: recycled id() could otherwise serve a stale verdict to a different
+#: backend (e.g. a CPU test mesh inheriting a TPU mesh's sum_only=True).
 _SUM_ONLY_CACHE: dict = {}
+
+
+def _mesh_key(mesh: Mesh) -> tuple:
+    """Stable sum_only cache key: everything _needs_sum_only sniffs."""
+    try:
+        devs = tuple(sorted(d.id for d in mesh.devices.flat))
+        dev0 = mesh.devices.flat[0]
+        ver = getattr(dev0.client, "platform_version", "") or ""
+        return (dev0.platform, ver, devs)
+    except Exception:
+        return ("unknown", "", ())
 
 
 def _needs_sum_only(mesh: Mesh) -> bool:
@@ -190,12 +205,14 @@ def solve_scan_sharded(inp: KernelInputs, n_max: int, E: int, P: int,
     """Type-parallel solve over ``mesh``; same (takes, leftover, carry)
     contract as ops.ffd_jax.solve_scan, decisions identical."""
     if sum_only is None:
-        # detection is a property of the mesh: memoize so a steady-state
-        # control loop doesn't re-sniff and re-log once per solve
-        cached = _SUM_ONLY_CACHE.get(id(mesh))
+        # detection is a property of the mesh's backend: memoize so a
+        # steady-state control loop doesn't re-sniff and re-log once per
+        # solve (stable key — see _SUM_ONLY_CACHE)
+        key = _mesh_key(mesh)
+        cached = _SUM_ONLY_CACHE.get(key)
         if cached is None:
             cached = _needs_sum_only(mesh)
-            _SUM_ONLY_CACHE[id(mesh)] = cached
+            _SUM_ONLY_CACHE[key] = cached
         sum_only = cached
     n_shards = mesh.devices.size
     padded, T = _pad_types(inp, n_shards)

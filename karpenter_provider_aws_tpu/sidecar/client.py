@@ -118,6 +118,11 @@ class RemoteSolver(TPUSolver):
 
     name = "tpu-sidecar"
 
+    #: solve_batch's vmapped multi-solve is a LOCAL dispatch shape; the
+    #: sidecar wire ships one buffer per RPC, so batch items fall back
+    #: to the single-solve path here
+    supports_batch_kernel = False
+
     def __init__(self, address: str, n_max: int = 2048,
                  client: Optional[SolverClient] = None,
                  backend: str = "auto", token: Optional[str] = None,

@@ -15,7 +15,9 @@ schema; native/codec.cpp packs/parses it on both sides):
 
 - /karpenter.solver.v1.Solver/Solve
     request  arena: {"buf": int64[...] packed kernel inputs,
-                     "statics": int64[8] = T D Z C G E P n_max}
+                     "statics": int64[len(STATIC_KEYS)], see
+                     ops/hostpack.py — appends-only, older shorter
+                     vectors are padded server-side}
     response arena: {"out": int64[...] packed kernel outputs}
 - /karpenter.solver.v1.Solver/Info
     response arena: {"devices": int64[1], "x64": int64[1]}
@@ -58,7 +60,8 @@ _TOPO_DIM_MAX = dict(T=4096, D=64, C=8, G=1 << 13)
 #: and sane (an unbounded space would let any peer pin the CPU compiling
 #: and grow the compile cache without limit)
 _STATICS_MAX = dict(T=4096, D=64, Z=64, C=8, G=1 << 17, E=1 << 14,
-                    P=256, K=16, V=8192, M=1 << 16, n_max=1 << 14)
+                    P=256, K=16, V=8192, M=1 << 16, n_max=1 << 14,
+                    F=64)
 _MAX_SHAPE_CLASSES = 64
 
 
@@ -74,12 +77,16 @@ class _Handler:
 
         from ..ops.hostpack import (STATIC_KEYS, in_layout_bool,
                                     in_layout_i64, layout_sizes, nwords)
-        if len(statics) == len(STATIC_KEYS) - 3:
+        if len(statics) == len(STATIC_KEYS) - 4:
             # pre-minValues client (8 statics: T,D,Z,C,G,E,P,n_max): the
             # floors feature is simply absent — K=V=M=0 solves identically,
             # so a rolling upgrade with the server deployed first keeps
-            # serving old clients
-            statics = list(statics) + [0, 0, 0]
+            # serving old clients (which also predate fusion: F=1)
+            statics = list(statics) + [0, 0, 0, 1]
+        elif len(statics) == len(STATIC_KEYS) - 1:
+            # pre-fusion client (11 statics): its buffer carries no fuse
+            # flags and F=1 runs the unfused scan, identically
+            statics = list(statics) + [1]
         if len(statics) != len(STATIC_KEYS):
             context.abort(grpc.StatusCode.INVALID_ARGUMENT,
                           f"expected {len(STATIC_KEYS)} statics, "
@@ -96,7 +103,7 @@ class _Handler:
                               "too many distinct solve shape classes")
             self._shapes_seen.add(key)
         dims = {k: kv[k] for k in ("T", "D", "Z", "C", "G", "E", "P",
-                                   "K", "M")}
+                                   "K", "M", "F")}
         expect = layout_sizes(in_layout_i64(**dims)) \
             + nwords(layout_sizes(in_layout_bool(**dims)))
         if buf.size != expect:
@@ -137,7 +144,7 @@ class _Handler:
         # shape-class key carries S + a pruned marker, since every
         # distinct S compiles its own kernel and must spend a slot of
         # the compile-cache budget like any other shape class
-        kv = self._validate(statics[:-1] + [0, 0, 0], buf, context,
+        kv = self._validate(statics[:-1] + [0, 0, 0, 1], buf, context,
                             shape_tag=("pruned", S))
         dims = {k: kv[k] for k in ("T", "D", "Z", "C", "G", "E", "P",
                                    "n_max")}
@@ -167,8 +174,12 @@ class _Handler:
         from ..ops.hostpack import pack_outputs1, unpack_inputs1
         from ..parallel.mesh import dispatch_mesh
         dims = {k: kv[k] for k in ("T", "D", "Z", "C", "G", "E", "P",
-                                   "K", "M")}
+                                   "K", "M", "F")}
         arrays = unpack_inputs1(np.asarray(buf), **dims)
+        # a fusion-requesting client (F>1, single-device RemoteSolver)
+        # may still land on a mesh server: the flags are advisory — the
+        # mesh scan stays per-group and decides identically
+        arrays.pop("fuse", None)
         if kv["K"] == 0:
             for mk in ("mv_floor", "mv_pairs_t", "mv_pairs_v"):
                 arrays.pop(mk, None)

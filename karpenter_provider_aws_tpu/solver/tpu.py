@@ -16,7 +16,7 @@ is a hook (``_dispatch``) so the sidecar's RemoteSolver can ride gRPC.
 
 from __future__ import annotations
 
-from typing import Dict, List, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -114,8 +114,24 @@ class TPUSolver(Solver):
         #: sizing rationale in ops/hostpack.py); injected at the _run_jax
         #: dispatch site so the RemoteSolver override ships it on the
         #: SolvePruned wire too
-        from ..ops.hostpack import DEV_PRUNED_SLOTS
+        from ..ops.hostpack import DEV_FUSE, DEV_PRUNED_SLOTS
         self.dev_pruned_slots = DEV_PRUNED_SLOTS
+        #: fused-group block width of the base kernel (ops/ffd_jax.py
+        #: _solve_fused): groups the encoder proves pairwise pool/
+        #: existing-disjoint batch dev_fuse per scan step, cutting the
+        #: trip count dev_fuse-fold. 0/1 disables. Gated per solve on
+        #: no minValues floors and a single device (mesh and pruned
+        #: kernels keep their own scan shapes).
+        self.dev_fuse = DEV_FUSE
+        #: below this padded group count the UNFUSED kernel serves: tiny
+        #: scans sit on the dispatch latency floor, not the trip count,
+        #: and the fused step body costs ~2x dev_fuse the compile time —
+        #: not worth paying for single-group solves
+        self.dev_fuse_min_groups = 64
+        #: evidence from the LAST device dispatch (bench engine report):
+        #: kernel path, per-dispatch batch size, scan trip count and the
+        #: fused/sequential block split of the fused kernel
+        self.last_dispatch_stats: dict = {}
         # resolve the native fill at CONSTRUCTION, not mid-solve: the
         # binding's one-shot build attempt (repo convention, codec.py)
         # must never appear as a first-solve latency cliff, and running
@@ -425,6 +441,163 @@ class TPUSolver(Solver):
         d_buf = jnp.asarray(buf)
         return np.asarray(solve_scan_packed1_pruned(d_buf, **statics))
 
+    def _dispatch_many(self, bufs, **statics) -> np.ndarray:
+        """Run B packed solve buffers in ONE device dispatch
+        (ops/ffd_jax.py solve_scan_packed1_many = jit(vmap(body))):
+        the scan carry batches over B, so B solves of the same shape
+        bucket cost one sweep of scan trips plus one h2d/d2h round
+        trip. Local only — the sidecar wire ships one buffer per RPC."""
+        import jax.numpy as jnp
+
+        from ..ops.ffd_jax import solve_scan_packed1_many
+        d_bufs = jnp.asarray(np.stack(bufs))
+        return np.asarray(solve_scan_packed1_many(d_bufs, **statics))
+
+    @staticmethod
+    def _fused_block_count(fuse: np.ndarray, Fu: int) -> int:
+        """How many of the scan's Gp/Fu blocks take the vectorized
+        branch: every group in the block after the first carries the
+        same_run_as_prev flag."""
+        return int(fuse.reshape(-1, Fu)[:, 1:].all(axis=1).sum())
+
+    def _record_dispatch(self, kernel: str, batch: int, Gp: int, Fu: int,
+                         fuse=None, fused_blocks: int = 0) -> None:
+        """Evidence for the bench engine report (last_dispatch_stats):
+        which kernel served, how many solves rode the dispatch, the scan
+        trip count and the fused/sequential block split. For a batched
+        dispatch fused_blocks is the per-lane average — vmap lowers the
+        block cond to a select that runs both branches, so the split is
+        shape evidence there, not a cost model."""
+        steps = Gp // Fu if Fu > 1 else Gp
+        if fuse is not None and Fu > 1:
+            fused_blocks = self._fused_block_count(fuse, Fu)
+        self.last_dispatch_stats = dict(
+            kernel=kernel, batch=batch, fuse=Fu, scan_steps=steps,
+            fused_blocks=fused_blocks, seq_blocks=steps - fused_blocks)
+
+    # -- batched multi-solve -------------------------------------------
+    #: solve_batch's vmapped dispatch runs the kernel locally; the
+    #: sidecar's RemoteSolver turns this off (one buffer per RPC)
+    supports_batch_kernel = True
+
+    def solve_batch(self, snapshots) -> List[SolveResult]:
+        """Solve many independent snapshots, batching eligible ones B per
+        device dispatch (_dispatch_many). Decisions are EXACTLY
+        ``[self.solve(s) for s in snapshots]`` — ineligible items
+        (preference chains, topology terms, host-only shapes, minValues
+        floors, group counts past the base-kernel cap, a busy or absent
+        device engine) and items whose solve outgrows the slot bucket
+        transparently take the single-solve path. Intended for
+        consolidation's candidate pre-screen and the sidecar's queued
+        solves, where many snapshots are in hand at once."""
+        snapshots = list(snapshots)
+        results: List[Optional[SolveResult]] = [None] * len(snapshots)
+        buckets: Dict[Tuple, List] = {}
+        for i, snap in enumerate(snapshots):
+            item = self._prep_batch_item(snap)
+            if item is None:
+                results[i] = self.solve(snap)
+            else:
+                key = tuple(sorted(item["statics"].items()))
+                buckets.setdefault(key, []).append((i, item))
+        for key, items in buckets.items():
+            if len(items) < 2:
+                # nothing to amortize: the single path also keeps its
+                # n-bucket overflow retry
+                for i, _ in items:
+                    results[i] = self.solve(snapshots[i])
+                continue
+            statics = dict(key)
+            n_bucket = self._bucket
+            o = self._dispatch_many([it["buf"] for _, it in items],
+                                    n_max=n_bucket, **statics)
+            fb = [it["fused_blocks"] for _, it in items]
+            self._record_dispatch(
+                kernel=("fused" if statics["F"] > 1 else "base"),
+                batch=len(items), Gp=statics["G"], Fu=statics["F"],
+                fused_blocks=round(sum(fb) / len(fb)))
+            for (i, it), o_buf in zip(items, o):
+                res = self._finish_batch_item(it, o_buf, statics,
+                                              n_bucket)
+                # slot-bucket overflow: the single path re-solves with
+                # its 4x retry loop (and slot growth), identically
+                results[i] = res if res is not None \
+                    else self.solve(snapshots[i])
+        return results
+
+    def _prep_batch_item(self, snapshot: SchedulingSnapshot):
+        """Encode one snapshot for the batched dispatch, or None when it
+        must take the single-solve path. The gates mirror _solve_core's
+        plain device branch plus the preference wrapper's no-preference
+        short-circuit (solver/preferences.py), so a batched decision is
+        the single path's decision by construction."""
+        if self.backend == "numpy" or not self.supports_batch_kernel:
+            return None
+        if not snapshot.pods or self._dev_devices() > 1:
+            return None
+        from .route import dev_engine_usable
+        if not dev_engine_usable(self._router):
+            return None
+        from ..models.encoding import canonical_pod_groups
+        from .preferences import preference_count
+        groups = canonical_pod_groups(snapshot.pods)
+        if any(preference_count(plist[0]) for _sig, plist in groups):
+            return None  # relax rounds re-solve: single path owns them
+        enc = encode_snapshot(snapshot, pod_groups=groups)
+        if enc.topo_any or not enc.types or enc.mv_K:
+            return None
+        existing = sorted(snapshot.existing_nodes, key=lambda n: n.name)
+        if self.backend == "auto":
+            # honor measured cost: once the router has timed both sides
+            # of this shape bucket and the host twin wins, batching onto
+            # the device would pessimize what routed() already learned
+            st = self._router.snapshot().get(
+                self._bucket_key(enc, len(existing)))
+            if (st and st["host"] is not None and st["dev"] is not None
+                    and st["host"] <= st["dev"]):
+                return None
+        ex_alloc, ex_used, ex_compat = self._encode_existing(
+            enc, existing)
+        arrays, stt = self._prep_device_inputs(enc, ex_alloc, ex_used,
+                                               ex_compat, 1)
+        if stt["G"] > self.dev_max_groups:
+            return None  # the pruned kernel doesn't batch
+        from ..ops.hostpack import pack_inputs1
+        buf = pack_inputs1(arrays, stt["T"], stt["D"], stt["Z"],
+                           stt["C"], stt["G"], stt["E"], stt["P"],
+                           stt["K"], stt["M"], stt["F"])
+        fb = 0
+        if stt["F"] > 1:
+            fb = self._fused_block_count(arrays["fuse"], stt["F"])
+        return dict(enc=enc, existing=existing, buf=buf, statics=stt,
+                    D=enc.A.shape[1], E=ex_alloc.shape[0],
+                    fused_blocks=fb)
+
+    def _finish_batch_item(self, it, o_buf, statics, n_bucket):
+        """Unpack one slice of the batched output; None when the item
+        exhausted the slot bucket (caller re-solves on the single path).
+        The tail mirrors _run_jax's unpadding exactly."""
+        from ..ops.hostpack import unpack_outputs1
+        enc = it["enc"]
+        G, E, D = len(enc.groups), it["E"], it["D"]
+        Gp, Ep = statics["G"], statics["E"]
+        out = unpack_outputs1(np.ascontiguousarray(o_buf), statics["T"],
+                              statics["D"], statics["Z"], statics["C"],
+                              Gp, Ep, statics["P"], n_bucket)
+        if (out["leftover"].sum() > 0
+                and int(out["num_nodes"][0]) >= n_bucket):
+            return None
+        takes = out["takes"][:G]
+        takes = np.concatenate([takes[:, :E], takes[:, Ep:]], axis=1)
+        sm = _slotmap(E, Ep, Ep + n_bucket)
+        final = dict(
+            types=out["types"][sm], zones=out["zones"][sm],
+            ct=out["ct"][sm], pool=out["pool"][sm],
+            alive=out["alive"][sm], used=out["used"][sm][:, :D],
+            E=E)
+        return self._decode(enc, it["existing"], takes,
+                            out["leftover"][:G], final)
+
     def _dev_devices(self) -> int:
         """Device count of the dev engine (nonblocking, probed). >1 routes
         the type-parallel mesh solve; the sidecar's RemoteSolver pins this
@@ -645,8 +818,15 @@ class TPUSolver(Solver):
             E=0, run_log=run_log, zfix=out["zfix"])
         return takes[:G], leftover[:G], final
 
-    def _run_jax(self, enc, ex_alloc, ex_used, ex_compat):
-        from ..ops.hostpack import pack_inputs1, unpack_outputs1
+    def _prep_device_inputs(self, enc, ex_alloc, ex_used, ex_compat,
+                            ndev: int):
+        """Pad one snapshot's encoding into the kernel's shape buckets
+        and resolve its fused-scan plan. Returns ``(arrays, statics)``
+        where statics carries every pack/dispatch static EXCEPT n_max
+        (the caller's retry loop resolves the slot bucket per dispatch).
+        Shared by the single-solve path (_run_jax) and the batched
+        multi-solve (solve_batch) so the two can never pad — and hence
+        decide — differently."""
         T, D = enc.A.shape
         Z, C = len(enc.zones), enc.avail.shape[2]
         P = len(enc.pools)
@@ -712,10 +892,42 @@ class TPUSolver(Solver):
             arrays.update(mv_floor=mv_floor_p, mv_pairs_t=enc.mv_pairs_t,
                           mv_pairs_v=enc.mv_pairs_v)
 
+        # --- fused-scan plan (ops/ffd_jax.py _solve_fused) ---------------
+        # groups the encoder proves pairwise disjoint on BOTH contention
+        # axes — admitted pools and compatible existing nodes — fill in
+        # one scan step, Fu at a time. ANDing the two separate run walks
+        # is valid: a block inside one combined run is pairwise disjoint
+        # in each dimension. Gates mirror use_pruned's shape envelope:
+        # the mesh and pruned kernels keep their own scan shapes.
+        Fu = 1
+        if (ndev <= 1 and K == 0 and self.dev_fuse > 1
+                and Gp <= self.dev_max_groups
+                and Gp >= self.dev_fuse_min_groups):
+            from ..models.encoding import independent_runs
+            fuse = enc.fused_runs().copy()
+            if E:
+                fuse &= independent_runs(ex_compat)
+            # padded groups (n=0, all-False rows) are provable no-op
+            # steps: fusable with anything
+            fuse = np.concatenate([fuse, np.ones(Gp - G, dtype=bool)])
+            arrays["fuse"] = fuse
+            Fu = min(self.dev_fuse, Gp)  # both pow2, so Fu divides Gp
+        return arrays, dict(T=T, D=Dp, Z=Z, C=C, G=Gp, E=Ep, P=Pp,
+                            K=K, V=V, M=M, F=Fu)
+
+    def _run_jax(self, enc, ex_alloc, ex_used, ex_compat):
+        from ..ops.hostpack import pack_inputs1, unpack_outputs1
+        D = enc.A.shape[1]
+        G, E = len(enc.groups), ex_alloc.shape[0]
         ndev = self._dev_devices()
+        arrays, stt = self._prep_device_inputs(enc, ex_alloc, ex_used,
+                                               ex_compat, ndev)
+        T, Dp, Z, C = stt["T"], stt["D"], stt["Z"], stt["C"]
+        Gp, Ep, Pp = stt["G"], stt["E"], stt["P"]
+        K, V, M, Fu = stt["K"], stt["V"], stt["M"], stt["F"]
         buf = None
         if ndev <= 1:
-            buf = pack_inputs1(arrays, T, Dp, Z, C, Gp, Ep, Pp, K, M)
+            buf = pack_inputs1(arrays, T, Dp, Z, C, Gp, Ep, Pp, K, M, Fu)
 
         # --- bucketed new-node slots with overflow retry ------------------
         # Steady state needs far fewer than n_max slots; a small N keeps the
@@ -772,7 +984,7 @@ class TPUSolver(Solver):
             else:
                 o_buf = self._dispatch(buf, T=T, D=Dp, Z=Z, C=C, G=Gp,
                                        E=Ep, P=Pp, K=K, V=V, M=M,
-                                       n_max=n_bucket)
+                                       n_max=n_bucket, F=Fu)
                 out = unpack_outputs1(o_buf, T, Dp, Z, C, Gp, Ep, Pp,
                                       n_bucket)
             exhausted = (out["leftover"].sum() > 0
@@ -781,6 +993,12 @@ class TPUSolver(Solver):
                 break
             n_bucket = min(n_bucket * 4, self.n_max)
         self._bucket = n_bucket
+        self._record_dispatch(
+            kernel=("mesh" if ndev > 1 else
+                    "pruned" if use_pruned else
+                    "fused" if Fu > 1 else "base"),
+            batch=1, Gp=Gp, Fu=Fu,
+            fuse=arrays.get("fuse") if Fu > 1 else None)
 
         takes = out["takes"][:G]
         # slot axis: drop padded existing rows (E..Ep) — they are dead

@@ -93,6 +93,29 @@ def test_repair_bypasses_budgets_and_do_not_disrupt(op, clock):
     assert op.kube.try_get("Node", name) is None
 
 
+def test_repair_races_consolidation_on_same_node(op, clock):
+    """an unhealthy node that is simultaneously an emptiness/
+    consolidation candidate: the repair force-delete and the voluntary
+    disruption path race on the SAME claim. The node must be torn down
+    exactly once — no leaked instance, no replacement launched for a
+    node with no workload, no resurrected claim."""
+    name = sick_cluster(op, clock, "Ready", "False")
+    # drop the workload so emptiness consolidation wants the node too
+    for p in op.kube.list("Pod"):
+        op.kube.delete("Pod", p.name, namespace=p.metadata.namespace)
+    clock.advance(30 * 60 + 1)  # past the repair toleration
+    for _ in range(10):
+        op.run_until_settled()
+        clock.advance(30)
+        if op.kube.try_get("Node", name) is None:
+            break
+    assert op.kube.try_get("Node", name) is None
+    assert op.kube.list("NodeClaim") == []  # no claim leaked/replaced
+    assert op.ec2.instances  # the original instance existed...
+    assert all(i.state == "terminated"
+               for i in op.ec2.instances.values())  # ...and died once
+
+
 def test_healthy_conditions_never_repair(op, clock):
     name = sick_cluster(op, clock, "StorageReady", "True")
     clock.advance(3600 * 24)

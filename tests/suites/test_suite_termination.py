@@ -207,6 +207,42 @@ class TestOrderedDrain:
         op.run_until_settled()
         assert op.kube.try_get("Node", node) is None
 
+    def test_pdb_blocked_group0_holds_back_critical(self, op, clock):
+        """Drain-group order is decided over ALL non-do-not-disrupt
+        bound pods, including PDB-blocked ones: a group-0 (plain) pod
+        held by an exhausted PDB must keep the daemonset and critical
+        groups running — evicting later groups around a blocked first
+        group would invert the termination_test.go:56-61 order."""
+        from karpenter_provider_aws_tpu.apis.objects import \
+            PodDisruptionBudget
+        node, claim = self._doomed_node(op)
+        for p in op.kube.list("Pod"):
+            if p.metadata.name.startswith("plain"):
+                p.metadata.labels["app"] = "g0"
+                op.kube.update(p)
+        # minAvailable == count -> zero allowance while both run
+        op.kube.create(PodDisruptionBudget(
+            "g0", selector={"app": "g0"}, min_available=2))
+        op.kube.delete("NodeClaim", claim.name)
+        for _ in range(4):
+            op.step()
+        b = self._bound(op, node)
+        assert "ds-a" in b and "crit-a" in b and "crit-ds-a" in b, \
+            f"later drain groups evicted around a blocked group 0: {b}"
+        assert any(x.startswith("plain") for x in b)
+        # budget freed -> the drain resumes, still in group order
+        op.kube.delete("PodDisruptionBudget", "g0", namespace="default")
+        op.step()
+        b = self._bound(op, node)
+        assert not any(x.startswith("plain") for x in b)  # group 0 went
+        assert "crit-a" in b and "crit-ds-a" in b  # later groups waited
+        for _ in range(8):
+            op.step()
+            op.run_until_settled()
+            if op.kube.try_get("Node", node) is None:
+                break
+        assert op.kube.try_get("Node", node) is None
+
     def test_do_not_disrupt_pod_blocks_drain_without_tgp(self, op, clock):
         """A do-not-disrupt pod holds a deleting node indefinitely when
         no terminationGracePeriod is set."""

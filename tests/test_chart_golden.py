@@ -32,7 +32,8 @@ VALUE_SETS = {
     "overrides.yaml": ["settings.clusterName=golden-cluster",
                        "replicas=3",
                        "controller.solver=cpu",
-                       "settings.interruptionQueue=golden-q"],
+                       "settings.interruptionQueue=golden-q",
+                       "serviceMonitor.enabled=true"],
 }
 
 

@@ -1,5 +1,6 @@
-"""PodDisruptionBudget math: k8s rounding (minAvailable % rounds up,
-maxUnavailable % rounds down) and allowance accounting."""
+"""PodDisruptionBudget math: k8s rounding (both minAvailable % and
+maxUnavailable % resolve via GetScaledValueFromIntOrPercent with
+roundUp=true) and allowance accounting."""
 
 import pytest
 
@@ -21,11 +22,16 @@ class TestRounding:
         # 5 pods -> floor is ceil(2.5)=3 -> allowed 2
         assert pdb.disruptions_allowed(pods(5), healthy=5) == 2
 
-    def test_max_unavailable_percent_rounds_down(self):
+    def test_max_unavailable_percent_rounds_up(self):
+        # kube-controller-manager resolves maxUnavailable with
+        # GetScaledValueFromIntOrPercent(roundUp=true)
         pdb = PodDisruptionBudget("x", {"app": "a"}, max_unavailable="50%")
-        # 5 pods -> cap is floor(2.5)=2
-        assert pdb.disruptions_allowed(pods(5), healthy=5) == 2
-        assert pdb.disruptions_allowed(pods(5), healthy=4) == 1
+        # 5 pods -> cap is ceil(2.5)=3
+        assert pdb.disruptions_allowed(pods(5), healthy=5) == 3
+        assert pdb.disruptions_allowed(pods(5), healthy=4) == 2
+        # 30% of 10 is exact either way; 25% of 10 rounds 2.5 up to 3
+        q = PodDisruptionBudget("q", {"app": "a"}, max_unavailable="25%")
+        assert q.disruptions_allowed(pods(10), healthy=10) == 3
 
     def test_counts(self):
         pdb = PodDisruptionBudget("x", {"app": "a"}, min_available=2)
@@ -51,8 +57,8 @@ class TestExactRounding:
     def test_float_trap_cases(self):
         """binary-float scaling mis-rounds these (29/100 etc.); the
         exact-integer helper must not."""
-        down = PodDisruptionBudget("x", {"app": "a"}, max_unavailable="29%")
-        assert down.disruptions_allowed(pods(100), healthy=100) == 29
+        mu = PodDisruptionBudget("x", {"app": "a"}, max_unavailable="29%")
+        assert mu.disruptions_allowed(pods(100), healthy=100) == 29
         up = PodDisruptionBudget("y", {"app": "a"}, min_available="7%")
         # floor is exactly 7 -> allowed 93, not 92
         assert up.disruptions_allowed(pods(100), healthy=100) == 93
@@ -95,6 +101,64 @@ class TestCrossNodeAllowance:
                        if p.node_name and p.phase == "Running"]
         assert len(still_bound) == 1, \
             "both covered pods evicted in one pass against a budget of 1"
+
+
+class TestMultiPDBMidRoundExhaustion:
+    def test_narrow_pdb_exhausts_mid_drain_round(self):
+        """Two overlapping PDBs on one deleting node: a wide budget
+        (maxUnavailable=2) covering three pods and a narrow budget
+        (maxUnavailable=1) covering two of them. The drain round must
+        stop evicting narrow-covered pods the moment the narrow budget
+        exhausts MID-ROUND — oversubscribing it by evicting both of its
+        pods in one pass would defeat the budget."""
+        from karpenter_provider_aws_tpu.apis import labels as L
+        from karpenter_provider_aws_tpu.apis.objects import (
+            EC2NodeClass, NodeClassRef, NodePool, NodePoolTemplate)
+        from karpenter_provider_aws_tpu.apis.requirements import \
+            Requirements
+        from karpenter_provider_aws_tpu.fake.environment import make_pods
+        from karpenter_provider_aws_tpu.operator import Operator
+        op = Operator()
+        op.kube.create(EC2NodeClass("cls"))
+        op.kube.create(NodePool("p", template=NodePoolTemplate(
+            node_class_ref=NodeClassRef("cls"),
+            requirements=Requirements.from_terms([
+                {"key": L.INSTANCE_CPU, "operator": "In",
+                 "values": ["16"]}]))))
+        for p in make_pods(1, cpu="500m", memory="1Gi", prefix="seed"):
+            op.kube.create(p)
+        op.run_until_settled()
+        node = op.kube.list("Node")[0].name
+        for i, labels in enumerate([{"app": "w", "tier": "n"},
+                                    {"app": "w", "tier": "n"},
+                                    {"app": "w"}]):
+            op.kube.create(Pod(f"m{i}", node_name=node, phase="Running",
+                               labels=labels))
+        op.kube.create(PodDisruptionBudget(
+            "wide", selector={"app": "w"}, max_unavailable=2))
+        op.kube.create(PodDisruptionBudget(
+            "narrow", selector={"tier": "n"}, max_unavailable=1))
+        claim = next(c for c in op.kube.list("NodeClaim")
+                     if c.node_name == node)
+        op.kube.delete("NodeClaim", claim.name)
+        op.terminator.reconcile()  # ONE drain round
+        narrow_bound = [p for p in op.kube.list("Pod")
+                        if p.node_name == node and p.phase == "Running"
+                        and p.metadata.labels.get("tier") == "n"]
+        assert len(narrow_bound) == 1, \
+            "narrow budget (1) oversubscribed within a single round"
+        # the round kept draining OTHER pods past the exhausted narrow
+        # budget: the wide budget's second allowance went to m2
+        assert not any(p.node_name == node and p.phase == "Running"
+                       for p in op.kube.list("Pod")
+                       if p.metadata.name == "m2")
+        # later rounds heal (evicted pods re-land, allowances recompute)
+        for _ in range(10):
+            op.step()
+            op.run_until_settled()
+            if op.kube.try_get("Node", node) is None:
+                break
+        assert op.kube.try_get("Node", node) is None
 
 
 class TestAllowanceAccounting:

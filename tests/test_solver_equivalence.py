@@ -685,3 +685,267 @@ class TestPrunedDeviceKernel:
         # the S=1 selection cannot hold a multi-slot fill: the kernel
         # must have bailed at least once (else the test is vacuous)
         assert bails["n"] >= 1
+
+
+#: fused-run fuzz depth knob (same contract as the sibling seed knobs)
+try:
+    _FUSED_SEEDS = max(0, int(os.environ.get(
+        "KARPENTER_FUSED_FUZZ_SEEDS", "8")))
+except ValueError:
+    _FUSED_SEEDS = 8
+
+
+def _striped_snapshot(env, n_sigs=90, per=2, fams=("m5", "c5", "r5"),
+                      existing=()):
+    """Adjacent groups pinned to disjoint pool families: the encoder's
+    run detection (models/encoding.py independent_runs) proves them
+    pairwise disjoint, so the device scan fuses them dev_fuse at a
+    time."""
+    pods = []
+    for i in range(n_sigs):
+        pods += make_pods(per, cpu=f"{100 + (i * 7) % 400}m",
+                          memory=f"{256 + (i * 13) % 700}Mi",
+                          prefix=f"st{i:03d}",
+                          node_selector={L.INSTANCE_FAMILY:
+                                         fams[i % len(fams)]})
+    pools = [env.nodepool(f"stripe-{n_sigs}-{per}-{f}", requirements=[
+        {"key": L.INSTANCE_FAMILY, "operator": "In", "values": [f]}])
+        for f in fams]
+    return env.snapshot(pods, pools, existing_nodes=list(existing))
+
+
+class TestFusedKernel:
+    """The fused-group device scan (ops/ffd_jax.py _solve_fused):
+    independent-run groups batch dev_fuse per scan step. Decisions must
+    be bit-identical to the oracle — fusion only reorders fill phases
+    that provably commute."""
+
+    def _fused_solver(self, min_groups=64):
+        t = TPUSolver(backend="jax", n_max=192)
+        t._dev_devices = lambda: 1
+        t.dev_fuse_min_groups = min_groups
+        seen = {"F": 0, "n": 0}
+        orig = t._dispatch
+
+        def spy(buf, **st):
+            seen["F"] = max(seen["F"], st.get("F", 1))
+            seen["n"] += 1
+            return orig(buf, **st)
+
+        t._dispatch = spy
+        return t, seen
+
+    def test_striped_pools_ride_fused_kernel(self, env):
+        from karpenter_provider_aws_tpu.solver import route
+        if not route.device_alive():
+            pytest.skip("no dev engine in this environment")
+        snap = _striped_snapshot(env)
+        t, seen = self._fused_solver()
+        got = t.solve(snap)
+        assert seen["F"] > 1, "fused kernel never dispatched"
+        assert t.last_dispatch_stats["kernel"] == "fused"
+        assert t.last_dispatch_stats["fused_blocks"] > 0
+        ref = CPUSolver().solve(snap)
+        assert got.decision_fingerprint() == ref.decision_fingerprint()
+
+    def test_single_pool_has_no_runs_but_stays_exact(self, env):
+        """Every group admits the one pool, so no real group fuses:
+        every block containing a real group takes the sequential
+        branch. Pure pad-tail blocks (all-True pad flags) may still
+        fuse — that is free, not a correctness hazard — so the assert
+        pins the sequential-block count, not fused_blocks == 0."""
+        from karpenter_provider_aws_tpu.solver import route
+        if not route.device_alive():
+            pytest.skip("no dev engine in this environment")
+        pods = []
+        for i in range(70):
+            pods += make_pods(1, cpu=f"{100 + i}m", memory="256Mi",
+                              prefix=f"np{i:03d}")
+        snap = env.snapshot(pods, [env.nodepool("norun")])
+        t, seen = self._fused_solver()
+        got = t.solve(snap)
+        assert seen["F"] > 1
+        stats = t.last_dispatch_stats
+        assert stats["seq_blocks"] == -(-70 // stats["fuse"])
+        assert stats["fused_blocks"] == stats["scan_steps"] - stats["seq_blocks"]
+        ref = CPUSolver().solve(snap)
+        assert got.decision_fingerprint() == ref.decision_fingerprint()
+
+    def test_existing_nodes_constrain_runs(self, env):
+        """ex_compat is the second contention axis: groups sharing a
+        compatible existing node must NOT fuse even when their pools are
+        disjoint. Every toleration-free group here can land on the one
+        existing node, so runs must break on the existing axis — and
+        decisions must hold."""
+        from karpenter_provider_aws_tpu.solver import route
+        if not route.device_alive():
+            pytest.skip("no dev engine in this environment")
+        ex = ExistingNode(
+            name="ex-fused-0",
+            labels={L.ARCH: "amd64", L.OS: "linux",
+                    L.ZONE: env.ec2.zones[0].name},
+            allocatable=Resources.parse(
+                {"cpu": "16", "memory": "64Gi", "pods": 58}))
+        snap = _striped_snapshot(env, n_sigs=80, per=1, existing=[ex])
+        t, seen = self._fused_solver()
+        got = t.solve(snap)
+        assert seen["F"] > 1
+        ref = CPUSolver().solve(snap)
+        assert got.decision_fingerprint() == ref.decision_fingerprint()
+
+    @pytest.mark.parametrize("seed", range(_FUSED_SEEDS))
+    def test_fused_fuzz_identical(self, env, seed):
+        """Randomized run-heavy scenarios: disjoint-family stripes with
+        random widths, occasional shared fallback pools (which break
+        runs), pool limits, existing nodes and capacity pressure. The
+        solver is forced onto the fused kernel (min_groups=1) so every
+        seed exercises it regardless of group count."""
+        from karpenter_provider_aws_tpu.solver import route
+        if not route.device_alive():
+            pytest.skip("no dev engine in this environment")
+        rng = random.Random(31000 + seed)
+        fams = rng.sample(["m5", "c5", "r5", "m6i", "c6i"],
+                          rng.randint(2, 4))
+        pools = []
+        for f in fams:
+            limits = {"cpu": str(rng.randint(20, 200))} \
+                if rng.random() < 0.3 else None
+            pools.append(env.nodepool(
+                f"fz{seed}-{f}", limits=limits,
+                weight=rng.randint(0, 100), requirements=[
+                    {"key": L.INSTANCE_FAMILY, "operator": "In",
+                     "values": [f]}]))
+        if rng.random() < 0.4:  # a shared fallback pool breaks runs
+            pools.append(env.nodepool(f"fz{seed}-any"))
+        pods = []
+        for i in range(rng.randint(24, 120)):
+            sel = None
+            if rng.random() < 0.85:
+                sel = {L.INSTANCE_FAMILY: rng.choice(fams)}
+            pods += make_pods(
+                rng.randint(1, 5),
+                cpu=f"{rng.randint(50, 900)}m",
+                memory=f"{rng.randint(128, 2048)}Mi",
+                prefix=f"fz{seed}-{i:03d}", node_selector=sel)
+        existing = []
+        for e in range(rng.randint(0, 2)):
+            existing.append(ExistingNode(
+                name=f"fzex-{seed}-{e}",
+                labels={L.ARCH: "amd64", L.OS: "linux",
+                        L.ZONE: rng.choice(env.ec2.zones).name},
+                allocatable=Resources.parse({
+                    "cpu": str(rng.randint(4, 16)),
+                    "memory": f"{rng.randint(8, 64)}Gi", "pods": 58})))
+        snap = env.snapshot(pods, pools, existing_nodes=existing)
+        t, seen = self._fused_solver(min_groups=1)
+        got = t.solve(snap)
+        assert seen["F"] > 1, f"seed {seed}: fused kernel never ran"
+        ref = CPUSolver().solve(snap)
+        assert got.decision_fingerprint() == ref.decision_fingerprint(), \
+            f"seed {seed} diverged: {ref.summary()} vs {got.summary()}"
+
+    def test_i32_word_roundtrip(self):
+        """takes ride the int32 wire section two lanes per word; the
+        host packer and unpacker must be exact inverses at both parities
+        and at the lane extremes."""
+        import numpy as np
+
+        from karpenter_provider_aws_tpu.ops import hostpack as hp
+        rng = np.random.RandomState(9)
+        for n in (1, 2, 3, 8, 63, 64, 1001):
+            v = rng.randint(-2**31, 2**31 - 1, size=n).astype(np.int64)
+            v[0] = 2**31 - 1
+            if n > 1:
+                v[1] = -2**31
+            w = hp.pack_i32_words(v)
+            assert w.size == hp.nwords32(n)
+            assert (hp.unpack_i32_words(w, n) == v).all()
+
+    def test_independent_runs_walk(self):
+        """The greedy run walk: flags mark groups disjoint from the
+        ACCUMULATED mask of the current run, and a conflict restarts
+        the run at the conflicting group."""
+        import numpy as np
+
+        from karpenter_provider_aws_tpu.models.encoding import (
+            independent_runs)
+        rows = np.array([
+            [1, 0, 0],   # run a starts
+            [0, 1, 0],   # disjoint -> fuses
+            [0, 0, 1],   # disjoint -> fuses
+            [0, 1, 1],   # hits the accumulated mask -> new run
+            [1, 0, 0],   # disjoint from {1,2} -> fuses
+            [1, 0, 0],   # hits 0 -> new run
+        ], dtype=bool)
+        assert independent_runs(rows).tolist() == \
+            [False, True, True, False, True, False]
+        assert independent_runs(np.zeros((0, 3), bool)).size == 0
+        # all-False rows (padded groups) always fuse
+        pad = np.zeros((4, 3), dtype=bool)
+        assert independent_runs(pad).tolist() == [False, True, True, True]
+
+
+class TestBatchedMultiSolve:
+    """solve_batch: B eligible snapshots per vmapped device dispatch,
+    decisions exactly [solve(s) for s in snapshots]."""
+
+    def test_batch_matches_singles_and_oracle(self, env):
+        from karpenter_provider_aws_tpu.solver import route
+        if not route.device_alive():
+            pytest.skip("no dev engine in this environment")
+        snaps = []
+        for b in range(3):
+            pods = []
+            for i in range(80):
+                pods += make_pods(
+                    1, cpu=f"{100 + (i * 7 + b * 31) % 400}m",
+                    memory=f"{256 + (i * 13 + b * 57) % 700}Mi",
+                    prefix=f"bm{b}x{i:03d}",
+                    node_selector={L.INSTANCE_FAMILY:
+                                   ("m5", "c5", "r5")[i % 3]})
+            snaps.append(env.snapshot(pods, [
+                env.nodepool(f"bm-{f}", requirements=[
+                    {"key": L.INSTANCE_FAMILY, "operator": "In",
+                     "values": [f]}]) for f in ("m5", "c5", "r5")]))
+        t = TPUSolver(backend="jax", n_max=192)
+        t._dev_devices = lambda: 1
+        many = {"n": 0}
+        orig = t._dispatch_many
+
+        def spy(bufs, **st):
+            many["n"] += 1
+            many["B"] = len(bufs)
+            return orig(bufs, **st)
+
+        t._dispatch_many = spy
+        res = t.solve_batch(snaps)
+        assert many["n"] == 1 and many["B"] == 3, many
+        assert t.last_dispatch_stats["batch"] == 3
+        cpu = CPUSolver()
+        for s, r in zip(snaps, res):
+            assert r.decision_fingerprint() == \
+                cpu.solve(s).decision_fingerprint()
+
+    def test_ineligible_items_fall_back_to_single_path(self, env):
+        """A preference-bearing snapshot and an empty snapshot must take
+        the single-solve path (the relax loop cannot batch) while still
+        returning positionally-correct, oracle-identical results."""
+        from karpenter_provider_aws_tpu.apis.objects import (
+            TopologySpreadConstraint)
+        plain = env.snapshot(
+            make_pods(30, cpu="500m", memory="1Gi", prefix="pb"),
+            [env.nodepool("pb-pool")])
+        pref_pods = make_pods(
+            10, cpu="250m", memory="512Mi", prefix="pp", group="pp",
+            topology_spread=[TopologySpreadConstraint(
+                max_skew=1, topology_key=L.ZONE,
+                when_unsatisfiable="ScheduleAnyway", group="pp")])
+        pref = env.snapshot(pref_pods, [env.nodepool("pp-pool")])
+        empty = env.snapshot([], [env.nodepool("e-pool")])
+        t = TPUSolver(backend="jax", n_max=192)
+        t._dev_devices = lambda: 1
+        res = t.solve_batch([plain, pref, empty])
+        cpu = CPUSolver()
+        for s, r in zip([plain, pref, empty], res):
+            assert r.decision_fingerprint() == \
+                cpu.solve(s).decision_fingerprint()
