@@ -496,6 +496,11 @@ def _engine_report(counts, tpu=None):
             fuse_width=st["fuse"], scan_steps=st["scan_steps"],
             fused_blocks=st["fused_blocks"],
             seq_blocks=st["seq_blocks"])
+        # wire evidence (sidecar engines only): retry count + breaker
+        # state of the last RPC and which side actually served
+        for k in ("retries", "breaker_state", "served_by"):
+            if k in st:
+                rep[k] = st[k]
     return rep
 
 

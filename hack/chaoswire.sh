@@ -1,0 +1,22 @@
+#!/bin/sh
+# Seeded chaos sweep for the solver wire.
+#
+# Runs the fault-injection chaos tests (tests/test_faultwire.py, the
+# `slow`-marked seed matrix) across 10 fixed seeds. Each seed solves the
+# same snapshot sequence TWICE against a live sidecar with the injector
+# dropping the wire per its seeded schedule (UNAVAILABLE,
+# DEADLINE_EXCEEDED, latency spikes, truncated response arenas, mid-call
+# drops); the test fails if the two runs diverge in fault schedule or
+# decision fingerprints — i.e. on ANY nondeterministic outcome — or if
+# any solve misses its deadline budget or the CPU-oracle decisions.
+#
+# Tier-1 stays fast: these tests are excluded there by `-m 'not slow'`.
+#
+# Usage: sh hack/chaoswire.sh            # the full 10-seed sweep
+#        sh hack/chaoswire.sh -x -q     # extra pytest args pass through
+set -e
+cd "$(dirname "$0")/.."
+
+JAX_PLATFORMS=cpu exec python -m pytest \
+    "tests/test_faultwire.py::test_seed_sweep_is_deterministic" \
+    -m slow -q -p no:cacheprovider "$@"

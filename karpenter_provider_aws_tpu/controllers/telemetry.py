@@ -305,6 +305,20 @@ _EC2_OPS = ("describe_instance_types", "describe_instance_type_offerings",
             "describe_instances", "terminate_instances")
 
 
+def instrument_sidecar(solver, metrics) -> None:
+    """karpenter_solver_sidecar_* at the solver wire — attaches the
+    registry to a RemoteSolver's resilience policy (retry counts,
+    breaker transitions + state gauge, per-RPC outcomes) and to the
+    solver itself (degraded-solve counter). Call it where the operator
+    wires its other boundaries; safe no-op on a local solver without a
+    wire client."""
+    solver.metrics = metrics
+    policy = getattr(getattr(solver, "client", None), "policy", None)
+    if policy is not None:
+        policy.metrics = metrics
+        policy.emit_state()
+
+
 def instrument_ec2(ec2, metrics, clock=time.perf_counter) -> None:
     """aws_sdk_go_request_* at the cloud seam — the prometheusv2-wrapped
     AWS config of operator.go:110. One attempt per call here (the fake

@@ -3,6 +3,7 @@ from .catalog import (DEFAULT_REGION, DEFAULT_ZONES, FAMILIES,
                       catalog_by_name, spot_price)
 from .ec2 import (FakeEC2, FakeImage, FakeInstance, FakeLaunchTemplate,
                   FakeSecurityGroup, FakeSubnet)
+from .faultwire import FaultInjector, FaultPlan
 from .kube import Conflict, Event, FakeKube, NotFound
 
 __all__ = [
@@ -10,5 +11,5 @@ __all__ = [
     "ZoneInfo", "build_catalog", "catalog_by_name", "spot_price",
     "FakeEC2", "FakeImage", "FakeInstance", "FakeLaunchTemplate",
     "FakeSecurityGroup", "FakeSubnet", "FakeKube", "Event", "Conflict",
-    "NotFound",
+    "NotFound", "FaultInjector", "FaultPlan",
 ]

@@ -1,0 +1,187 @@
+"""Deterministic fault injection for the solver wire.
+
+:class:`FaultInjector` wraps a live ``SolverClient`` at the channel
+callable level — the four raw unary callables (``_solve``,
+``_solve_pruned``, ``_solve_topo``, ``_info``) are replaced with
+wrappers that consult a seeded :class:`FaultPlan` before (and after)
+each real wire call. Everything above the callables — the resilience
+policy, retries, breaker, arena decode — runs UNCHANGED, which is the
+point: chaos tests exercise the exact production path with the exact
+production error types (real ``grpc.RpcError`` subclasses carrying
+``code()``), not mocks of it.
+
+Injected fault kinds (per call, mutually exclusive):
+
+- ``unavailable``     — the RPC never reaches the server (UNAVAILABLE)
+- ``deadline``        — the call times out (DEADLINE_EXCEEDED)
+- ``latency``         — the call succeeds after an added delay
+- ``truncate``        — the server solved; the response arena arrives
+                        torn (the codec checksum catches it client-side)
+- ``drop``            — the server solved; the reply is lost mid-call
+                        (UNAVAILABLE *after* server work — the
+                        retry-a-duplicate case, safe because solves are
+                        pure)
+
+Determinism: faults are drawn from ``random.Random(seed)`` in call
+order. Keep every wire call on ONE thread (backend='jax' with the
+liveness verdict pre-resolved) and the same seed replays the same fault
+schedule — ``hack/chaoswire.sh`` fails CI on any divergence.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, List, Optional, Tuple
+
+#: fault kinds an injector can draw (order matters: it is the cumulative
+#: probability order used by FaultPlan.next)
+FAULT_KINDS = ("unavailable", "deadline", "latency", "truncate", "drop")
+
+
+def _injected_error(code, details: str):
+    """A real grpc.RpcError (the concrete class grpc itself raises would
+    need a live call object; RpcError + code()/details() is the contract
+    every handler in this repo reads)."""
+    import grpc
+
+    class _Err(grpc.RpcError):
+        def __init__(self):
+            super().__init__(details)
+            self._code = code
+            self._details = details
+
+        def code(self):
+            return self._code
+
+        def details(self):
+            return self._details
+
+    return _Err()
+
+
+class FaultPlan:
+    """Seeded per-call fault schedule.
+
+    Each wire call draws one uniform sample; the p_* probabilities
+    partition [0,1) in FAULT_KINDS order, remainder = clean call.
+    ``max_consecutive`` bounds runs of *delivery* failures (unavailable /
+    deadline / truncate / drop) so a finite retry budget always
+    eventually lands — the acceptance bar is "every solve completes",
+    which an adversarial infinite-failure schedule would (correctly,
+    but unhelpfully) violate through the host twin instead of the wire.
+    """
+
+    def __init__(self, seed: int, p_unavailable: float = 0.15,
+                 p_deadline: float = 0.1, p_latency: float = 0.1,
+                 p_truncate: float = 0.1, p_drop: float = 0.1,
+                 latency_ms: float = 20.0, max_consecutive: int = 2):
+        import random
+        self.seed = seed
+        self._rng = random.Random(seed)
+        self._p = (p_unavailable, p_deadline, p_latency, p_truncate,
+                   p_drop)
+        assert sum(self._p) <= 1.0
+        self.latency_ms = latency_ms
+        self.max_consecutive = max_consecutive
+        self._consecutive = 0
+
+    def next(self, call_index: int, rpc: str) -> Optional[str]:
+        """Draw the fault (or None) for this wire call. `call_index` and
+        `rpc` ride into the injector's event log; the draw itself is
+        purely sequential so the schedule is a function of the seed."""
+        u = self._rng.random()
+        acc = 0.0
+        kind = None
+        for k, p in zip(FAULT_KINDS, self._p):
+            acc += p
+            if u < acc:
+                kind = k
+                break
+        if kind in ("unavailable", "deadline", "truncate", "drop"):
+            if self._consecutive >= self.max_consecutive:
+                kind = None  # forced clean call: bound the failure run
+            else:
+                self._consecutive += 1
+        if kind in (None, "latency"):
+            self._consecutive = 0
+        return kind
+
+
+class FaultInjector:
+    """Wraps a SolverClient's channel callables with the plan's faults.
+
+    Usage::
+
+        client = SolverClient(server.address, policy=seeded_policy)
+        inj = FaultInjector(client, FaultPlan(seed=7)).install()
+        ... run solves; inj.log holds (call_index, rpc, fault) ...
+        inj.uninstall()
+
+    The event log is the determinism fingerprint: two runs with equal
+    seeds (and single-threaded wire traffic) must produce equal logs.
+    """
+
+    _WRAPPED = (("_solve", "Solve"), ("_solve_pruned", "SolvePruned"),
+                ("_solve_topo", "SolveTopo"), ("_info", "Info"))
+
+    def __init__(self, client, plan: FaultPlan,
+                 sleep: Callable[[float], None] = time.sleep):
+        self.client = client
+        self.plan = plan
+        self._sleep = sleep
+        self._mu = threading.Lock()
+        self._calls = 0
+        #: (call_index, rpc, fault-or-"ok") per wire call, in call order
+        self.log: List[Tuple[int, str, str]] = []
+        self._orig = {}
+
+    def _wrap(self, rpc: str, real):
+        def call(request, timeout=None, metadata=None):
+            import grpc
+            with self._mu:
+                idx = self._calls
+                self._calls += 1
+                fault = self.plan.next(idx, rpc)
+                self.log.append((idx, rpc, fault or "ok"))
+            if fault == "unavailable":
+                raise _injected_error(grpc.StatusCode.UNAVAILABLE,
+                                      "injected: connection refused")
+            if fault == "deadline":
+                raise _injected_error(grpc.StatusCode.DEADLINE_EXCEEDED,
+                                      "injected: deadline exceeded")
+            if fault == "latency":
+                self._sleep(self.plan.latency_ms / 1e3)
+                return real(request, timeout=timeout, metadata=metadata)
+            resp = real(request, timeout=timeout, metadata=metadata)
+            if fault == "truncate":
+                # the server did the work; the reply arrives torn — the
+                # arena checksum fails client-side and the policy
+                # retries (a malformed response is availability-class)
+                return resp[:max(1, len(resp) // 2)]
+            if fault == "drop":
+                # the server did the work; the reply is lost. The retry
+                # duplicates a solve — safe by construction (pure).
+                raise _injected_error(grpc.StatusCode.UNAVAILABLE,
+                                      "injected: connection reset mid-call")
+            return resp
+        return call
+
+    def install(self) -> "FaultInjector":
+        assert not self._orig, "already installed"
+        for attr, rpc in self._WRAPPED:
+            real = getattr(self.client, attr)
+            self._orig[attr] = real
+            setattr(self.client, attr, self._wrap(rpc, real))
+        return self
+
+    def uninstall(self) -> None:
+        for attr, real in self._orig.items():
+            setattr(self.client, attr, real)
+        self._orig = {}
+
+    def __enter__(self) -> "FaultInjector":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()

@@ -1,2 +1,4 @@
 from .client import RemoteSolver, SolverClient  # noqa: F401
+from .resilience import (CircuitBreaker, ResiliencePolicy,  # noqa: F401
+                         RetryPolicy, SidecarUnavailable)
 from .server import SolverServer, serve  # noqa: F401

@@ -7,6 +7,17 @@ is identical to the local TPU solver, so decisions are identical by
 construction). Topology-constrained snapshots ride the SolveTopo RPC
 (the same ops/topo_jax event kernel the local solver runs); snapshots
 outside its envelope fall back to the in-process host pour.
+
+Every RPC goes through ONE :class:`resilience.ResiliencePolicy`
+(per-call deadlines scaled by payload size, bounded retries with full
+jitter, a consecutive-failure circuit breaker). Availability failures
+surface as :class:`resilience.SidecarUnavailable` — never a raw
+``grpc.RpcError`` — and every ``RemoteSolver`` dispatch path degrades to
+the bit-identical host twin, so a flaky or dead sidecar costs latency,
+never correctness and never a crash. Peer *rejections* (auth,
+validation, capability) do re-raise as grpc errors from ``SolverClient``
+— callers that speak the wire directly need the real code — but
+``RemoteSolver`` converts those too before they can escape a solve.
 """
 
 from __future__ import annotations
@@ -16,7 +27,8 @@ from typing import Dict, Optional
 import numpy as np
 
 from ..native import arena_pack, arena_unpack
-from ..solver.tpu import TPUSolver
+from ..solver.tpu import DeviceDispatchFailed, TPUSolver
+from .resilience import ResiliencePolicy, SidecarUnavailable
 
 _SOLVE = "/karpenter.solver.v1.Solver/Solve"
 _SOLVE_TOPO = "/karpenter.solver.v1.Solver/SolveTopo"
@@ -31,14 +43,19 @@ _TOPO_BOOL_OUT = ("types", "zones", "ct", "alive", "bail")
 class SolverClient:
     def __init__(self, address: str, timeout: float = 30.0,
                  token: Optional[str] = None,
-                 root_cert: Optional[bytes] = None):
+                 root_cert: Optional[bytes] = None,
+                 policy: Optional[ResiliencePolicy] = None):
         """`token` rides as x-solver-token metadata on every call (the
         server rejects mismatches with UNAUTHENTICATED); `root_cert`
         (PEM) switches the channel to TLS — both optional, matching the
-        server's posture flags (sidecar/server.py serve())."""
+        server's posture flags (sidecar/server.py serve()). `timeout` is
+        the BASE deadline; the policy scales it by payload size per
+        call. `policy` defaults to a fresh ResiliencePolicy (retries +
+        circuit breaker) shared by all four RPCs of this client."""
         import grpc
         self.address = address
         self.timeout = timeout
+        self.policy = policy or ResiliencePolicy()
         self._md = (("x-solver-token", token),) if token else None
         opts = [("grpc.max_receive_message_length", 256 * 1024 * 1024),
                 ("grpc.max_send_message_length", 256 * 1024 * 1024)]
@@ -59,8 +76,14 @@ class SolverClient:
             "statics": np.array([statics.get(k, 0) for k in STATIC_KEYS],
                                 dtype=np.int64),
         })
-        resp = self._solve(req, timeout=self.timeout, metadata=self._md)
-        return np.array(arena_unpack(resp)["out"])  # own the memory
+
+        def attempt(deadline: float) -> np.ndarray:
+            resp = self._solve(req, timeout=deadline, metadata=self._md)
+            return np.array(arena_unpack(resp)["out"])  # own the memory
+
+        return self.policy.call(attempt, rpc="Solve",
+                                payload_bytes=len(req),
+                                base_deadline_s=self.timeout)
 
     def solve_pruned_buffer(self, buf: np.ndarray,
                             statics: Dict[str, int]) -> np.ndarray:
@@ -75,9 +98,15 @@ class SolverClient:
             "buf": np.ascontiguousarray(buf, dtype=np.int64),
             "statics": np.array(vec, dtype=np.int64),
         })
-        resp = self._solve_pruned(req, timeout=self.timeout,
-                                  metadata=self._md)
-        return np.array(arena_unpack(resp)["out"])
+
+        def attempt(deadline: float) -> np.ndarray:
+            resp = self._solve_pruned(req, timeout=deadline,
+                                      metadata=self._md)
+            return np.array(arena_unpack(resp)["out"])
+
+        return self.policy.call(attempt, rpc="SolvePruned",
+                                payload_bytes=len(req),
+                                base_deadline_s=self.timeout)
 
     def solve_topo(self, arrays: Dict[str, np.ndarray],
                    rows: Dict[str, np.ndarray],
@@ -91,17 +120,31 @@ class SolverClient:
             req[f"i_{k}"] = np.ascontiguousarray(v)
         for k, v in rows.items():
             req[f"t_{k}"] = np.ascontiguousarray(v)
-        resp = self._solve_topo(arena_pack(req), timeout=self.timeout,
-                                metadata=self._md)
-        out = {k: np.array(v) for k, v in arena_unpack(resp).items()}
-        for k in _TOPO_BOOL_OUT:
-            out[k] = out[k].view(bool)
-        return out
+        packed = arena_pack(req)
+
+        def attempt(deadline: float) -> Dict[str, np.ndarray]:
+            resp = self._solve_topo(packed, timeout=deadline,
+                                    metadata=self._md)
+            # full decode INSIDE the attempt: a truncated response arena
+            # (torn write, hostile peer) is a failed attempt, not a
+            # crash surfaced to the solve path
+            out = {k: np.array(v) for k, v in arena_unpack(resp).items()}
+            for k in _TOPO_BOOL_OUT:
+                out[k] = out[k].view(bool)
+            return out
+
+        return self.policy.call(attempt, rpc="SolveTopo",
+                                payload_bytes=len(packed),
+                                base_deadline_s=self.timeout)
 
     def info(self, timeout: Optional[float] = None) -> Dict[str, int]:
-        out = arena_unpack(self._info(b"", timeout=timeout or self.timeout,
-                                      metadata=self._md))
-        return {k: int(v[0]) for k, v in out.items()}
+        def attempt(deadline: float) -> Dict[str, int]:
+            out = arena_unpack(self._info(b"", timeout=deadline,
+                                          metadata=self._md))
+            return {k: int(v[0]) for k, v in out.items()}
+
+        return self.policy.call(attempt, rpc="Info",
+                                base_deadline_s=timeout or self.timeout)
 
     def close(self) -> None:
         self._channel.close()
@@ -114,7 +157,17 @@ class RemoteSolver(TPUSolver):
     host twin and the REMOTE device via the same router the in-process
     solver uses — the measured "device" cost now includes the gRPC hop,
     so deployments where the sidecar round trip dominates automatically
-    stay local, and ones with a fast fabric ride the device."""
+    stay local, and ones with a fast fabric ride the device.
+
+    Degradation contract: NO grpc.RpcError escapes any of the four RPC
+    paths. Solve maps failures to DeviceDispatchFailed (host twin),
+    SolvePruned to the synthetic bail word (host twin), SolveTopo to
+    TopoKernelBail (host pour), Info to a not-alive verdict. When the
+    client's circuit breaker opens, every router bucket's dev EWMA parks
+    at DEV_FAILED_MS and the liveness cache is marked failed, so solves
+    route host WITHOUT paying a wire attempt each; the background
+    refresh probe doubles as the half-open probe and restores dev
+    routing when it succeeds."""
 
     name = "tpu-sidecar"
 
@@ -126,7 +179,8 @@ class RemoteSolver(TPUSolver):
     def __init__(self, address: str, n_max: int = 2048,
                  client: Optional[SolverClient] = None,
                  backend: str = "auto", token: Optional[str] = None,
-                 root_cert: Optional[bytes] = None):
+                 root_cert: Optional[bytes] = None,
+                 policy: Optional[ResiliencePolicy] = None):
         """`token`/`root_cert` plumb straight into SolverClient — when the
         server runs with sidecar.token / TLS, the production consumer must
         be able to authenticate (defaults also read from
@@ -136,7 +190,8 @@ class RemoteSolver(TPUSolver):
             if token is None:
                 import os
                 token = os.environ.get("SOLVER_SIDECAR_TOKEN") or None
-            client = SolverClient(address, token=token, root_cert=root_cert)
+            client = SolverClient(address, token=token,
+                                  root_cert=root_cert, policy=policy)
         self.client = client
         #: SolvePruned is capability-gated: None until the first ping
         #: fetches the server's Info (an old server without the flag —
@@ -144,14 +199,79 @@ class RemoteSolver(TPUSolver):
         self._pruned_ok: "Optional[bool]" = None
         from ..solver.route import AliveCache
         self._router.alive = AliveCache(self._ping)
+        pol = getattr(self.client, "policy", None)
+        if pol is not None:
+            pol.breaker.on_transition.append(self._on_breaker_transition)
+
+    # -- breaker <-> router wiring --------------------------------------
+    def _on_breaker_transition(self, old: str, new: str) -> None:
+        from .resilience import CLOSED, OPEN
+        alive = self._router.alive
+        if new == OPEN:
+            # route every bucket to the host twin NOW — don't wait for
+            # each shape class to pay its own failed wire attempt
+            self._router.park_dev()
+            if alive is not None:
+                alive.mark_failed()
+        elif new == CLOSED and old != CLOSED:
+            # half-open probe succeeded: the peer is back; the refresh
+            # probe re-measures each bucket's dev EWMA from here
+            if alive is not None:
+                alive.mark_ok()
+
+    def _wire_evidence(self, served_by: str) -> dict:
+        """Dispatch-evidence fields for bench engine reports: retry
+        count and breaker state of the last wire call, and which engine
+        actually served (`sidecar` or `host-twin`)."""
+        pol = getattr(self.client, "policy", None)
+        last = getattr(pol, "last_call", None) or {}
+        self._wire_stats = dict(
+            retries=int(last.get("retries", 0)),
+            breaker_state=(pol.breaker.state if pol is not None
+                           else "closed"),
+            served_by=served_by)
+        return self._wire_stats
+
+    def _record_dispatch(self, *a, **kw) -> None:
+        super()._record_dispatch(*a, **kw)
+        self.last_dispatch_stats.update(
+            getattr(self, "_wire_stats", None)
+            or dict(retries=0, breaker_state="closed",
+                    served_by="sidecar"))
+
+    def _degraded(self, rpc: str) -> None:
+        if self.metrics is not None:
+            self.metrics.inc(
+                "karpenter_solver_sidecar_degraded_solves_total",
+                labels={"rpc": rpc})
+        # the host twin serves this solve; leave the evidence where the
+        # bench engine report reads it even though no kernel dispatched
+        self.last_dispatch_stats = dict(
+            kernel="host-twin", batch=1, fuse=1, scan_steps=0,
+            fused_blocks=0, seq_blocks=0, **self._wire_evidence("host-twin"))
 
     def _ping(self) -> bool:
         """Sidecar liveness = a short-deadline Info round trip (also
-        resolves the SolvePruned capability)."""
-        info = self.client.info(timeout=5.0)
-        self._pruned_ok = bool(info.get("pruned", 0)) \
-            and info["devices"] == 1
-        return info["devices"] >= 1
+        resolves the SolvePruned capability). Any failure — transport,
+        breaker-open, or a MALFORMED Info from a truncated/hostile peer
+        — is an explicit not-alive verdict, never an exception
+        poisoning the AliveCache probe path."""
+        import grpc
+        try:
+            info = self.client.info(timeout=5.0)
+        except (SidecarUnavailable, grpc.RpcError, ValueError, KeyError,
+                IndexError, TypeError):
+            return False
+        devices = info.get("devices")
+        if not isinstance(devices, int):
+            import logging
+            logging.getLogger(__name__).warning(
+                "sidecar Info response malformed (no 'devices' field); "
+                "treating the sidecar as not alive")
+            self._pruned_ok = False
+            return False
+        self._pruned_ok = bool(info.get("pruned", 0)) and devices == 1
+        return devices >= 1
 
     @property
     def supports_pruned_kernel(self) -> bool:
@@ -163,7 +283,32 @@ class RemoteSolver(TPUSolver):
         return 1
 
     def _dispatch(self, buf: np.ndarray, **statics) -> np.ndarray:
-        return self.client.solve_buffer(buf, statics)
+        """Base Solve over the wire. Availability failures (retries
+        exhausted, breaker open) AND peer rejections both map to
+        DeviceDispatchFailed: under backend='auto' the router parks the
+        bucket and serves host; backend='jax' catches it in _solve_core
+        — either way the bit-identical host twin serves, never a crash,
+        and no grpc.RpcError escapes this path."""
+        import grpc
+        try:
+            out = self.client.solve_buffer(buf, statics)
+        except SidecarUnavailable as e:
+            import logging
+            logging.getLogger(__name__).warning(
+                "Solve RPC failed (%s); serving from the host twin", e)
+            self._degraded("Solve")
+            raise DeviceDispatchFailed(str(e)) from e
+        except grpc.RpcError as e:
+            import logging
+            code = e.code() if hasattr(e, "code") else None
+            logging.getLogger(__name__).warning(
+                "Solve RPC rejected (%s); serving from the host twin",
+                code or e)
+            self._degraded("Solve")
+            raise DeviceDispatchFailed(
+                f"sidecar Solve rejected: {code or e}") from e
+        self._wire_evidence("sidecar")
+        return out
 
     def _dispatch_pruned(self, buf: np.ndarray, **statics) -> np.ndarray:
         """High-G solves ride SolvePruned. A peer that rejects or dies
@@ -172,7 +317,14 @@ class RemoteSolver(TPUSolver):
         twin serves, never a crash."""
         import grpc
         try:
-            return self.client.solve_pruned_buffer(buf, statics)
+            out = self.client.solve_pruned_buffer(buf, statics)
+        except SidecarUnavailable as e:
+            import logging
+            logging.getLogger(__name__).warning(
+                "SolvePruned RPC failed (%s); serving from the host twin",
+                e)
+            self._degraded("SolvePruned")
+            return np.ones(1, dtype=np.int64)  # bail word only
         except grpc.RpcError as e:
             import logging
             code = e.code() if hasattr(e, "code") else None
@@ -184,7 +336,10 @@ class RemoteSolver(TPUSolver):
                 # the peer cannot speak this RPC anymore (mesh restart,
                 # rollback): stop paying a doomed round trip per solve
                 self._pruned_ok = False
+            self._degraded("SolvePruned")
             return np.ones(1, dtype=np.int64)  # bail word only
+        self._wire_evidence("sidecar")
+        return out
 
     def _topo_lowerable(self, enc, tenc, existing) -> bool:
         """The local envelope plus the SERVER's SolveTopo bounds
@@ -210,10 +365,20 @@ class RemoteSolver(TPUSolver):
 
         from ..solver.tpu import TopoKernelBail
         try:
-            return self.client.solve_topo(arrays, rows, statics)
+            out = self.client.solve_topo(arrays, rows, statics)
+        except SidecarUnavailable as e:
+            import logging
+            logging.getLogger(__name__).warning(
+                "SolveTopo RPC failed (%s); serving from the host pour",
+                e)
+            self._degraded("SolveTopo")
+            raise TopoKernelBail(f"sidecar SolveTopo failed: {e}") from e
         except grpc.RpcError as e:
             import logging
             logging.getLogger(__name__).warning(
                 "SolveTopo RPC failed (%s); serving from the host pour",
                 e.code() if hasattr(e, "code") else e)
+            self._degraded("SolveTopo")
             raise TopoKernelBail(f"sidecar SolveTopo failed: {e}") from e
+        self._wire_evidence("sidecar")
+        return out

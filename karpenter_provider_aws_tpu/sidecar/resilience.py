@@ -1,0 +1,280 @@
+"""Resilience policy for the solver wire: retries, backoff, breaker.
+
+Every sidecar RPC (Solve / SolvePruned / SolveTopo / Info) runs through
+one :class:`ResiliencePolicy` owned by the ``SolverClient``. The policy
+is what makes the <200ms p99 target survive a flaky peer: solves are
+pure and the service is stateless per request (SURVEY §2.9), so a
+failed or even a *duplicated* RPC is always safe to retry — the only
+question is how long to keep trying before the bit-identical host twin
+serves instead.
+
+Three mechanisms, composed:
+
+- **Per-call deadlines scaled by payload size** — a 100MB arena on a
+  slow fabric legitimately needs longer than an Info ping; one flat
+  timeout either kills big solves or lets small ones hang.
+- **Bounded retries with exponential backoff + full jitter** — only on
+  UNAVAILABLE / DEADLINE_EXCEEDED (availability-class) and on a
+  malformed/truncated response arena (the codec checksum catches a
+  torn write; re-asking is free). Peer *rejections* (INVALID_ARGUMENT,
+  UNAUTHENTICATED, FAILED_PRECONDITION...) re-raise immediately: the
+  peer answered, retrying cannot change its mind.
+- **A consecutive-failure circuit breaker** — after ``threshold``
+  availability failures the breaker opens and every call fails fast
+  (no wire attempt) until ``cooldown_s`` elapses, then exactly one
+  half-open probe rides the wire; its success closes the breaker. A
+  dead sidecar must cost the provisioning loop nothing per solve, not
+  a connect timeout per solve.
+
+Failure surfaces as :class:`SidecarUnavailable` (a RuntimeError, never
+a ``grpc.RpcError``) so callers degrade to the host twin without
+depending on grpc types.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+#: breaker states (also the value order of the state gauge: the
+#: karpenter_solver_sidecar_breaker_state metric encodes closed=0,
+#: half-open=1, open=2)
+CLOSED = "closed"
+HALF_OPEN = "half-open"
+OPEN = "open"
+_STATE_GAUGE = {CLOSED: 0, HALF_OPEN: 1, OPEN: 2}
+
+#: response-decode failures treated as availability-class: a truncated
+#: or hostile response arena fails the codec checksum (ValueError) or
+#: is missing fields (KeyError/IndexError); the request was fine, so
+#: retrying is safe and the breaker should count the failure
+_MALFORMED_RESPONSE = (ValueError, KeyError, IndexError)
+
+
+class SidecarUnavailable(RuntimeError):
+    """The sidecar could not serve this call (retries exhausted, or the
+    breaker is open). Deliberately NOT a grpc.RpcError: the client
+    contract is that no grpc error type ever escapes the policy for an
+    availability failure — callers fall back to the host twin."""
+
+    def __init__(self, rpc: str, attempts: int,
+                 last_error: Optional[BaseException] = None,
+                 breaker_open: bool = False):
+        self.rpc = rpc
+        self.attempts = attempts
+        self.last_error = last_error
+        self.breaker_open = breaker_open
+        if breaker_open:
+            msg = f"{rpc}: circuit breaker open (failing fast)"
+        else:
+            msg = (f"{rpc}: sidecar unavailable after {attempts} "
+                   f"attempt(s): {last_error!r}")
+        super().__init__(msg)
+
+
+class CircuitBreaker:
+    """Consecutive-failure breaker with a single half-open probe.
+
+    closed --(threshold consecutive failures)--> open
+    open --(cooldown elapsed; one call admitted)--> half-open
+    half-open --(probe success)--> closed | --(probe failure)--> open
+
+    ``on_transition`` callbacks fire OUTSIDE the lock (they park router
+    EWMAs and emit metrics — both take their own locks)."""
+
+    def __init__(self, threshold: int = 5, cooldown_s: float = 15.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self.threshold = threshold
+        self.cooldown_s = cooldown_s
+        self._clock = clock
+        self._mu = threading.Lock()
+        self._state = CLOSED
+        self._fails = 0
+        self._opened_at = 0.0
+        self._probing = False
+        self.on_transition: List[Callable[[str, str], None]] = []
+
+    @property
+    def state(self) -> str:
+        with self._mu:
+            return self._state
+
+    def _set(self, new: str) -> Optional[tuple]:
+        old = self._state
+        if old == new:
+            return None
+        self._state = new
+        return (old, new)
+
+    def _fire(self, transition: Optional[tuple]) -> None:
+        if transition is None:
+            return
+        for cb in list(self.on_transition):
+            try:
+                cb(*transition)
+            except Exception:  # observers must never fail a solve
+                pass
+
+    def allow(self) -> bool:
+        """May a call ride the wire right now? Open->half-open happens
+        HERE: the first caller after the cooldown becomes the probe;
+        concurrent callers keep failing fast until it reports."""
+        with self._mu:
+            if self._state == CLOSED:
+                return True
+            if self._state == OPEN and not self._probing \
+                    and self._clock() - self._opened_at >= self.cooldown_s:
+                t = self._set(HALF_OPEN)
+                self._probing = True
+            else:
+                return False
+        self._fire(t)
+        return True
+
+    def record_success(self) -> None:
+        with self._mu:
+            self._fails = 0
+            self._probing = False
+            t = self._set(CLOSED)
+        self._fire(t)
+
+    def record_failure(self) -> None:
+        with self._mu:
+            self._fails += 1
+            self._probing = False
+            t = None
+            if self._state == HALF_OPEN or self._fails >= self.threshold:
+                t = self._set(OPEN)
+                self._opened_at = self._clock()
+        self._fire(t)
+
+
+class RetryPolicy:
+    """Bounded retries, exponential backoff, FULL jitter (sleep drawn
+    uniformly from [0, min(cap, base * 2^attempt)]) — the AWS
+    architecture-blog shape that decorrelates a retry herd. ``rng`` and
+    ``sleep`` are injectable so chaos tests are seeded and fast."""
+
+    def __init__(self, max_attempts: int = 3,
+                 backoff_base_s: float = 0.05,
+                 backoff_cap_s: float = 2.0,
+                 rng: Optional[random.Random] = None,
+                 sleep: Callable[[float], None] = time.sleep):
+        assert max_attempts >= 1
+        self.max_attempts = max_attempts
+        self.backoff_base_s = backoff_base_s
+        self.backoff_cap_s = backoff_cap_s
+        self.rng = rng or random.Random()
+        self.sleep = sleep
+
+    def backoff_s(self, attempt: int) -> float:
+        cap = min(self.backoff_cap_s,
+                  self.backoff_base_s * (2.0 ** attempt))
+        return self.rng.uniform(0.0, cap)
+
+
+class ResiliencePolicy:
+    """The one policy object every sidecar RPC goes through.
+
+    ``call`` runs ``attempt_fn(deadline_s)`` (the RPC *plus* its
+    response decode — a truncated arena is a failed attempt) under the
+    retry policy and breaker. Observability: per-call evidence in
+    ``last_call`` (bench engine reports read it) and, when ``metrics``
+    is attached (controllers/telemetry.py instrument_sidecar), the
+    karpenter_solver_sidecar_* series."""
+
+    def __init__(self, retry: Optional[RetryPolicy] = None,
+                 breaker: Optional[CircuitBreaker] = None,
+                 wire_bytes_per_s: float = 64 * 1024 * 1024,
+                 max_deadline_s: float = 120.0,
+                 metrics=None):
+        self.retry = retry or RetryPolicy()
+        self.breaker = breaker or CircuitBreaker()
+        self.wire_bytes_per_s = wire_bytes_per_s
+        self.max_deadline_s = max_deadline_s
+        self.metrics = metrics
+        #: evidence from the most recent call: rpc, retries,
+        #: breaker_state, ok (dispatch-evidence for bench reports)
+        self.last_call: Dict = {}
+        self.breaker.on_transition.append(self._emit_transition)
+
+    # -- deadlines ------------------------------------------------------
+    def deadline_for(self, payload_bytes: int, base_s: float) -> float:
+        """Per-call deadline scaled by arena payload size: the base
+        (the client's configured timeout) plus wire time for the
+        payload at the assumed fabric bandwidth, capped."""
+        extra = payload_bytes / self.wire_bytes_per_s if payload_bytes else 0.0
+        return min(self.max_deadline_s, base_s + extra)
+
+    # -- metrics --------------------------------------------------------
+    def _emit_transition(self, old: str, new: str) -> None:
+        m = self.metrics
+        if m is not None:
+            m.inc("karpenter_solver_sidecar_breaker_transitions_total",
+                  labels={"from": old, "to": new})
+            m.set_gauge("karpenter_solver_sidecar_breaker_state",
+                        _STATE_GAUGE[new])
+
+    def emit_state(self) -> None:
+        """Seed the breaker-state gauge (called when metrics attach, so
+        a scrape before the first transition still sees the series)."""
+        if self.metrics is not None:
+            self.metrics.set_gauge("karpenter_solver_sidecar_breaker_state",
+                                   _STATE_GAUGE[self.breaker.state])
+
+    def _record(self, rpc: str, retries: int, ok: bool,
+                outcome: str) -> None:
+        self.last_call = dict(rpc=rpc, retries=retries, ok=ok,
+                              breaker_state=self.breaker.state)
+        if self.metrics is not None:
+            self.metrics.inc("karpenter_solver_sidecar_rpc_total",
+                             labels={"rpc": rpc, "outcome": outcome})
+
+    # -- the guarded call ----------------------------------------------
+    def call(self, attempt_fn: Callable[[float], object], *, rpc: str,
+             payload_bytes: int = 0, base_deadline_s: float = 30.0):
+        import grpc
+        retryable = (grpc.StatusCode.UNAVAILABLE,
+                     grpc.StatusCode.DEADLINE_EXCEEDED)
+        if not self.breaker.allow():
+            self._record(rpc, 0, ok=False, outcome="breaker-open")
+            raise SidecarUnavailable(rpc, 0, breaker_open=True)
+        deadline = self.deadline_for(payload_bytes, base_deadline_s)
+        retries = 0
+        last: Optional[BaseException] = None
+        for attempt in range(self.retry.max_attempts):
+            try:
+                out = attempt_fn(deadline)
+            except grpc.RpcError as e:
+                code = e.code() if hasattr(e, "code") else None
+                if code not in retryable:
+                    # the peer ANSWERED (auth/validation/capability
+                    # rejection): reachable, so the breaker resets; the
+                    # caller sees the real grpc error and decides
+                    self.breaker.record_success()
+                    self._record(rpc, retries, ok=False,
+                                 outcome="rejected")
+                    raise
+                last = e
+                self.breaker.record_failure()
+            except _MALFORMED_RESPONSE as e:
+                last = e
+                self.breaker.record_failure()
+            else:
+                self.breaker.record_success()
+                self._record(rpc, retries, ok=True, outcome="ok")
+                return out
+            if attempt + 1 >= self.retry.max_attempts \
+                    or self.breaker.state == OPEN:
+                # out of budget, or this call's failures just opened the
+                # breaker — keeping at a dead peer is what it prevents
+                break
+            retries += 1
+            if self.metrics is not None:
+                self.metrics.inc("karpenter_solver_sidecar_retries_total",
+                                 labels={"rpc": rpc})
+            self.retry.sleep(self.retry.backoff_s(attempt))
+        self._record(rpc, retries, ok=False, outcome="unavailable")
+        raise SidecarUnavailable(rpc, retries + 1, last_error=last)

@@ -26,6 +26,7 @@ schema; native/codec.cpp packs/parses it on both sides):
 from __future__ import annotations
 
 import logging
+import threading
 from concurrent import futures
 from typing import Optional
 
@@ -66,10 +67,78 @@ _MAX_SHAPE_CLASSES = 64
 
 
 class _Handler:
-    """Method implementations (bytes in, bytes out)."""
+    """Method implementations (bytes in, bytes out).
+
+    The executor runs four worker threads, so every piece of
+    cross-request state is lock-protected: `_shapes_seen` (the
+    compile-cache budget), `_mesh_cache` (the mesh dispatch's compiled
+    kernels), and the in-flight counter graceful stop drains on."""
 
     def __init__(self):
         self._shapes_seen: set = set()
+        self._shape_mu = threading.Lock()
+        self._mesh_cache: dict = {}
+        self._mesh_mu = threading.Lock()
+        self._inflight = 0
+        self._inflight_cv = threading.Condition(threading.Lock())
+
+    # -- in-flight tracking (graceful stop) -----------------------------
+    def tracked(self, fn):
+        """Wrap a method handler so SolverServer.stop can drain: solves
+        already past the port must land before the process exits."""
+        def run(request, context):
+            with self._inflight_cv:
+                self._inflight += 1
+            try:
+                return fn(request, context)
+            finally:
+                with self._inflight_cv:
+                    self._inflight -= 1
+                    self._inflight_cv.notify_all()
+        return run
+
+    def drain(self, timeout: Optional[float]) -> bool:
+        """Block until no request is in flight (or timeout); returns
+        whether the handler is idle."""
+        with self._inflight_cv:
+            return self._inflight_cv.wait_for(
+                lambda: self._inflight == 0, timeout)
+
+    # -- request decode / shape admission -------------------------------
+    def _request_arrays(self, request: bytes, context, *required) -> dict:
+        """Decode the request arena, mapping ANY decode failure —
+        truncated bytes, bad checksum, missing fields — to
+        INVALID_ARGUMENT. Without this a malformed payload surfaces as
+        UNKNOWN, which retry policies rightly refuse to retry and
+        operators read as a server bug rather than a peer bug."""
+        import grpc
+        try:
+            arrays = arena_unpack(request)
+        except Exception as e:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                          f"malformed request arena: {e}")
+        for k in required:
+            if k not in arrays:
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                              f"request arena missing '{k}'")
+        return arrays
+
+    def _admit_shape(self, key, context) -> None:
+        """Spend (or re-use) a compile-cache shape-class slot under the
+        lock — four workers racing unsynchronized could both blow the
+        budget and corrupt the set."""
+        import grpc
+        with self._shape_mu:
+            if key in self._shapes_seen:
+                return
+            if len(self._shapes_seen) >= _MAX_SHAPE_CLASSES:
+                full = True
+            else:
+                self._shapes_seen.add(key)
+                full = False
+        if full:
+            context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED,
+                          "too many distinct solve shape classes")
 
     def _validate(self, statics, buf, context,
                   shape_tag=()) -> Optional[dict]:
@@ -96,12 +165,7 @@ class _Handler:
             if not (0 <= v <= _STATICS_MAX[k]):
                 context.abort(grpc.StatusCode.INVALID_ARGUMENT,
                               f"statics.{k}={v} out of bounds")
-        key = tuple(kv.values()) + tuple(shape_tag)
-        if key not in self._shapes_seen:
-            if len(self._shapes_seen) >= _MAX_SHAPE_CLASSES:
-                context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED,
-                              "too many distinct solve shape classes")
-            self._shapes_seen.add(key)
+        self._admit_shape(tuple(kv.values()) + tuple(shape_tag), context)
         dims = {k: kv[k] for k in ("T", "D", "Z", "C", "G", "E", "P",
                                    "K", "M", "F")}
         expect = layout_sizes(in_layout_i64(**dims)) \
@@ -129,7 +193,7 @@ class _Handler:
             context.abort(grpc.StatusCode.FAILED_PRECONDITION,
                           "pruned kernel is single-device; this server "
                           "runs a mesh")
-        arrays = arena_unpack(request)
+        arrays = self._request_arrays(request, context, "buf", "statics")
         buf = arrays["buf"]
         statics = [int(x) for x in arrays["statics"]]
         if len(statics) != len(PRUNED_STATIC_KEYS):
@@ -156,7 +220,7 @@ class _Handler:
         import jax.numpy as jnp
 
         from ..ops.ffd_jax import solve_scan_packed1
-        arrays = arena_unpack(request)
+        arrays = self._request_arrays(request, context, "buf", "statics")
         buf = arrays["buf"]
         kv = self._validate(arrays["statics"], buf, context)
         ndev = len(jax.devices())
@@ -183,9 +247,13 @@ class _Handler:
         if kv["K"] == 0:
             for mk in ("mv_floor", "mv_pairs_t", "mv_pairs_v"):
                 arrays.pop(mk, None)
-        cache = self.__dict__.setdefault("_mesh_cache", {})
-        out = dispatch_mesh(arrays, n_max=kv["n_max"], E=kv["E"],
-                            P=kv["P"], V=kv["V"], ndev=ndev, cache=cache)
+        # dispatch_mesh reads AND writes its compile cache; serialize
+        # mesh solves — they already contend for every device, so the
+        # lock costs nothing beyond what the hardware imposes
+        with self._mesh_mu:
+            out = dispatch_mesh(arrays, n_max=kv["n_max"], E=kv["E"],
+                                P=kv["P"], V=kv["V"], ndev=ndev,
+                                cache=self._mesh_cache)
         return pack_outputs1(out, kv["T"], kv["D"], kv["Z"], kv["C"],
                              kv["G"], kv["E"], kv["P"], kv["n_max"])
 
@@ -198,7 +266,7 @@ class _Handler:
         import grpc
 
         from ..ops.topo_jax import dispatch_topo
-        all_arrays = arena_unpack(request)
+        all_arrays = self._request_arrays(request, context)
         raw = all_arrays.get("statics")
         if raw is None or len(raw) != len(TOPO_STATIC_KEYS):
             context.abort(grpc.StatusCode.INVALID_ARGUMENT,
@@ -218,11 +286,7 @@ class _Handler:
         key = ("topo",) + tuple(kv.values()) + (
             arrays["A"].shape, arrays["avail_zc"].shape,
             arrays["R"].shape[0])
-        if key not in self._shapes_seen:
-            if len(self._shapes_seen) >= _MAX_SHAPE_CLASSES:
-                context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED,
-                              "too many distinct solve shape classes")
-            self._shapes_seen.add(key)
+        self._admit_shape(key, context)
         out = dispatch_topo(arrays, rows, kv)
         return arena_pack({k: np.asarray(v) for k, v in out.items()})
 
@@ -300,16 +364,20 @@ def _generic_handler(handler: _Handler):
 
     class Svc(grpc.GenericRpcHandler):
         def service(self, call_details):
+            # every method rides the in-flight tracker so graceful stop
+            # can drain solves already past the port
             if call_details.method == _SOLVE:
-                return grpc.unary_unary_rpc_method_handler(handler.solve)
+                return grpc.unary_unary_rpc_method_handler(
+                    handler.tracked(handler.solve))
             if call_details.method == _SOLVE_TOPO:
                 return grpc.unary_unary_rpc_method_handler(
-                    handler.solve_topo)
+                    handler.tracked(handler.solve_topo))
             if call_details.method == _SOLVE_PRUNED:
                 return grpc.unary_unary_rpc_method_handler(
-                    handler.solve_pruned)
+                    handler.tracked(handler.solve_pruned))
             if call_details.method == _INFO:
-                return grpc.unary_unary_rpc_method_handler(handler.info)
+                return grpc.unary_unary_rpc_method_handler(
+                    handler.tracked(handler.info))
             return None
 
     return Svc()
@@ -368,7 +436,9 @@ class SolverServer:
             interceptors=interceptors,
             options=[("grpc.max_receive_message_length", 256 * 1024 * 1024),
                      ("grpc.max_send_message_length", 256 * 1024 * 1024)])
-        self._server.add_generic_rpc_handlers((_generic_handler(_Handler()),))
+        self._handler = _Handler()
+        self._server.add_generic_rpc_handlers(
+            (_generic_handler(self._handler),))
         if tls_cert is not None and tls_key is not None:
             creds = grpc.ssl_server_credentials(((tls_key, tls_cert),))
             self.port = self._server.add_secure_port(
@@ -383,7 +453,16 @@ class SolverServer:
         return self
 
     def stop(self, grace: Optional[float] = 0.5) -> None:
-        self._server.stop(grace)
+        """Graceful stop: new RPCs are refused immediately (grpc stop
+        semantics), then in-flight solves get the grace window to LAND
+        before the hard cancel — a solve already past the port must not
+        be torn mid-kernel by a rolling restart."""
+        done = self._server.stop(grace)
+        drained = self._handler.drain(grace)
+        if not drained:
+            log.warning("sidecar stop: in-flight solves still running "
+                        "after %.1fs grace; cancelling", grace or 0.0)
+        done.wait(grace)
 
 
 def serve(address: str = "127.0.0.1", port: int = 50151,
@@ -394,8 +473,13 @@ def serve(address: str = "127.0.0.1", port: int = 50151,
     loopback-insecure (same-pod companion). Exposing it wider is an
     explicit operator decision — pass `token` (also SOLVER_SIDECAR_TOKEN
     env) for shared-secret auth and cert/key paths for a TLS listener."""
-    cert = open(tls_cert_file, "rb").read() if tls_cert_file else None
-    key = open(tls_key_file, "rb").read() if tls_key_file else None
+    cert = key = None
+    if tls_cert_file:
+        with open(tls_cert_file, "rb") as f:
+            cert = f.read()
+    if tls_key_file:
+        with open(tls_key_file, "rb") as f:
+            key = f.read()
     return SolverServer(address, port, token=token,
                         tls_cert=cert, tls_key=key).start()
 

@@ -52,8 +52,26 @@ class Router:
             st = self._stats.setdefault(
                 bucket, {"host": None, "dev": None, "n": 0})
             prev = st[side]
-            st[side] = ms if prev is None else \
-                (1.0 - ALPHA) * prev + ALPHA * ms
+            # parking (ms >= DEV_FAILED_MS) and UN-parking (first healthy
+            # observation after a park) are ABSOLUTE, not EWMA-blended: a
+            # blend of 1e12 with anything real stays effectively-parked
+            # for ~90 observations, so a recovered dev engine would never
+            # win routing back within a refresh cycle
+            if prev is None or ms >= DEV_FAILED_MS \
+                    or prev >= DEV_FAILED_MS:
+                st[side] = ms
+            else:
+                st[side] = (1.0 - ALPHA) * prev + ALPHA * ms
+
+    def park_dev(self, ms: float = None) -> None:
+        """Park the dev EWMA of EVERY bucket (circuit breaker opened: the
+        dev engine is down as a whole, not per shape class); the next
+        successful background probe un-parks per bucket via observe()."""
+        if ms is None:
+            ms = DEV_FAILED_MS
+        with self._mu:
+            for st in self._stats.values():
+                st["dev"] = ms
 
     def choose(self, bucket: Tuple):
         """"both" on first encounter, else ("host"|"dev", refresh_other)."""
@@ -88,17 +106,28 @@ class AliveCache:
         self._probe = probe
         self._recheck_s = recheck_s
         self._mu = threading.Lock()
+        self._cv = threading.Condition(self._mu)
+        self._probing = False
         self._verdict: Optional[bool] = None
         self._at = 0.0
         self._in_flight = threading.Event()
 
     def blocking(self) -> bool:
+        """At most ONE probe runs at a time: concurrent callers wait on
+        the in-flight probe's verdict instead of each launching their own
+        (the probe can be a 90s subprocess — a thundering herd of them
+        serializes behind the GIL and multiplies the stall)."""
         with self._mu:
-            if self._verdict is True:
-                return True
-            if self._verdict is False and \
-                    time.monotonic() - self._at < self._recheck_s:
-                return False
+            while True:
+                if self._verdict is True:
+                    return True
+                if self._verdict is False and \
+                        time.monotonic() - self._at < self._recheck_s:
+                    return False
+                if not self._probing:
+                    self._probing = True
+                    break
+                self._cv.wait()  # ride the in-flight probe's verdict
         try:
             verdict = bool(self._probe())
         except Exception:
@@ -106,7 +135,24 @@ class AliveCache:
         with self._mu:
             self._verdict = verdict
             self._at = time.monotonic()
+            self._probing = False
+            self._cv.notify_all()
             return verdict
+
+    def mark_failed(self) -> None:
+        """External evidence the engine is down (circuit breaker opened):
+        cache a False verdict now — expiring like any probed False, so
+        recovery is still noticed after recheck_s."""
+        with self._mu:
+            self._verdict = False
+            self._at = time.monotonic()
+
+    def mark_ok(self) -> None:
+        """External evidence the engine is healthy (half-open probe
+        succeeded): True is permanent, exactly like a probed True."""
+        with self._mu:
+            self._verdict = True
+            self._at = time.monotonic()
 
     def nonblocking(self) -> Optional[bool]:
         with self._mu:

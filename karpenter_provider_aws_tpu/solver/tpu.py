@@ -26,7 +26,7 @@ from ..apis.resources import Resources
 from ..models.encoding import SnapshotEncoding, encode_snapshot
 from ..ops import ffd
 from .cpu import CPUSolver
-from .route import Router, routed
+from .route import DEV_FAILED_MS, Router, routed
 from .types import (ExistingNode, NewNodeClaim, SchedulingSnapshot,
                     SolveResult, Solver)
 
@@ -39,6 +39,15 @@ def _slotmap(E: int, Ep: int, N: int) -> np.ndarray:
 class TopoKernelBail(RuntimeError):
     """The topology device kernel left its static event envelope for this
     snapshot; the caller must serve it from the host pour instead."""
+
+
+class DeviceDispatchFailed(RuntimeError):
+    """The device engine failed MID-DISPATCH (sidecar died mid-call,
+    retries exhausted, breaker open). The host twin is decision-identical
+    so the caller serves from it — under backend='auto' the router's
+    exception handling already does; backend='jax' catches this
+    explicitly so an explicit device request degrades instead of
+    crashing the solve."""
 
 
 def _runs_from_events(ev, gi: int):
@@ -299,8 +308,26 @@ class TPUSolver(Solver):
             # twin for this solve — never a hang, never silent
             from .route import dev_engine_usable
             if dev_engine_usable(self._router):
-                takes, leftover, final = self._run_jax(
-                    enc, ex_alloc, ex_used, ex_compat)
+                try:
+                    takes, leftover, final = self._run_jax(
+                        enc, ex_alloc, ex_used, ex_compat)
+                except DeviceDispatchFailed as e:
+                    # dev engine died mid-dispatch (sidecar gone, link
+                    # dropped): the bit-identical host twin serves, and
+                    # the parked EWMA keeps auto-routing off the device
+                    import logging
+                    logging.getLogger(__name__).warning(
+                        "device dispatch failed (%s); serving from the "
+                        "host twin", e)
+                    if self.metrics is not None:
+                        self.metrics.inc(
+                            "karpenter_solver_device_fallback_total",
+                            labels={"reason": "dispatch_failed"})
+                    self._router.observe(
+                        self._bucket_key(enc, ex_alloc.shape[0]),
+                        "dev", DEV_FAILED_MS)
+                    takes, leftover, final = self._run_numpy(
+                        enc, ex_alloc, ex_used, ex_compat)
             else:
                 import logging
                 logging.getLogger(__name__).warning(

@@ -73,3 +73,7 @@ def pytest_configure(config):
         "markers",
         "scale: 50k-pod / 500-node scale-envelope tests (the slow tier; "
         "`pytest -m 'not scale'` is the fast default path)")
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running chaos/seed-sweep tests excluded from tier-1 "
+        "(`pytest -m 'not slow'`); hack/chaoswire.sh runs them")

@@ -1,0 +1,226 @@
+"""Chaos tests: seeded fault injection against a REAL sidecar server.
+
+The acceptance bar of the resilience layer: with the injector dropping
+the wire on every schedule (UNAVAILABLE, DEADLINE_EXCEEDED, latency
+spikes, truncated response arenas, mid-call drops), every solve still
+completes, decisions are fingerprint-identical to the CPU oracle, no
+solve exceeds its deadline budget, and no grpc.RpcError escapes
+RemoteSolver. Determinism is part of the contract — same seed, same
+fault schedule, same decisions — and hack/chaoswire.sh sweeps the
+`slow`-marked seed matrix in CI.
+
+Determinism discipline: backend='jax' with the liveness verdict
+pre-resolved keeps every wire call on the calling thread, so the
+injector's seeded draws replay exactly (a background probe thread would
+steal draws nondeterministically).
+"""
+
+import random
+import time
+
+import pytest
+
+from karpenter_provider_aws_tpu.apis import labels as L
+from karpenter_provider_aws_tpu.apis.objects import TopologySpreadConstraint
+from karpenter_provider_aws_tpu.fake.environment import Environment, make_pods
+from karpenter_provider_aws_tpu.fake.faultwire import (FAULT_KINDS,
+                                                       FaultInjector,
+                                                       FaultPlan)
+from karpenter_provider_aws_tpu.sidecar import RemoteSolver, SolverServer
+from karpenter_provider_aws_tpu.sidecar.resilience import (CircuitBreaker,
+                                                           ResiliencePolicy,
+                                                           RetryPolicy)
+from karpenter_provider_aws_tpu.solver import CPUSolver
+
+#: the fixed CI seed matrix (hack/chaoswire.sh runs the slow sweep)
+CHAOS_SEEDS = (3, 7, 11, 17, 23, 31, 42, 57, 71, 97)
+
+
+@pytest.fixture(scope="module")
+def server():
+    s = SolverServer().start()
+    yield s
+    s.stop()
+
+
+@pytest.fixture(scope="module")
+def env():
+    return Environment()
+
+
+def _chaos_remote(address, seed):
+    """A RemoteSolver with a seeded, fast policy. max_attempts=4 with
+    the plan's max_consecutive=2 guarantees every policy.call lands by
+    its third attempt — the chaos contract is 'every solve completes',
+    exercised through the wire, not through an infinitely-dead peer."""
+    policy = ResiliencePolicy(
+        retry=RetryPolicy(max_attempts=4, backoff_base_s=0.001,
+                          backoff_cap_s=0.01,
+                          rng=random.Random(seed ^ 0x5EED)),
+        breaker=CircuitBreaker(threshold=50, cooldown_s=0.05))
+    remote = RemoteSolver(address, n_max=64, backend="jax", policy=policy)
+    # single-threaded wire traffic: resolve the liveness verdict up
+    # front so no background probe consumes injector draws
+    remote._router.alive.mark_ok()
+    return remote
+
+
+def _chaos_snapshots(env, tag, n_solves):
+    """Deterministic snapshot sequence: plain bin-packing plus a
+    topology-spread snapshot every third solve (exercises SolveTopo)."""
+    snaps = []
+    for i in range(n_solves):
+        pods = make_pods(8 + 2 * (i % 3), cpu="500m", memory="1Gi",
+                         prefix=f"{tag}n{i}")
+        if i % 3 == 2:
+            g = f"{tag}g{i}"
+            pods += make_pods(6, cpu="1", memory="2Gi",
+                              prefix=f"{tag}ts{i}", group=g,
+                              topology_spread=[TopologySpreadConstraint(
+                                  max_skew=1, topology_key=L.ZONE,
+                                  group=g)])
+        snaps.append(env.snapshot(pods, [env.nodepool(f"{tag}p{i}")]))
+    return snaps
+
+
+def _run_chaos(address, env, seed, n_solves=6, plan_kwargs=None,
+               snaps=None):
+    """One chaos run: returns (fingerprints, injector log). Pass the
+    same `snaps` to compare runs — make_pods names pods off a global
+    counter, so freshly built snapshots differ BY NAME run to run."""
+    remote = _chaos_remote(address, seed)
+    plan = FaultPlan(seed, **(plan_kwargs or {}))
+    oracle = CPUSolver()
+    budget_s = (remote.client.policy.retry.max_attempts
+                * remote.client.policy.deadline_for(0, remote.client.timeout)
+                + 2.0)
+    fps = []
+    if snaps is None:
+        snaps = _chaos_snapshots(env, f"cw{seed}", n_solves)
+    with FaultInjector(remote.client, plan) as inj:
+        for snap in snaps:
+            t0 = time.perf_counter()
+            r = remote.solve(snap)
+            wall = time.perf_counter() - t0
+            assert wall < budget_s, \
+                f"solve blew its deadline budget: {wall:.1f}s"
+            fp = r.decision_fingerprint()
+            assert fp == oracle.solve(snap).decision_fingerprint(), \
+                f"decisions diverged from the CPU oracle (seed {seed})"
+            fps.append(fp)
+        log = list(inj.log)
+    return fps, log
+
+
+class TestFaultPlan:
+    def test_schedule_is_seeded(self):
+        a = FaultPlan(9)
+        b = FaultPlan(9)
+        seq_a = [a.next(i, "Solve") for i in range(64)]
+        seq_b = [b.next(i, "Solve") for i in range(64)]
+        assert seq_a == seq_b
+        assert any(k is not None for k in seq_a)
+
+    def test_failure_runs_are_bounded(self):
+        plan = FaultPlan(1, p_unavailable=1.0, p_deadline=0, p_latency=0,
+                         p_truncate=0, p_drop=0, max_consecutive=2)
+        kinds = [plan.next(i, "Solve") for i in range(9)]
+        # every third call is forced clean: a finite retry budget lands
+        run = 0
+        for k in kinds:
+            if k == "unavailable":
+                run += 1
+                assert run <= 2
+            else:
+                run = 0
+        assert kinds.count(None) >= 3
+
+
+class TestChaosWire:
+    @pytest.mark.parametrize("kind", FAULT_KINDS)
+    def test_each_fault_kind_lands_identically(self, server, env, kind):
+        """Per fault kind at p=0.5: solves complete through the wire
+        and decisions match the oracle — the injected kind provably
+        appeared in the schedule."""
+        kwargs = {f"p_{k}": 0.0 for k in FAULT_KINDS}
+        kwargs[f"p_{kind}"] = 0.5
+        fps, log = _run_chaos(server.address, env, seed=13, n_solves=3,
+                              plan_kwargs=kwargs)
+        assert len(fps) == 3
+        assert any(f == kind for _, _, f in log), \
+            f"schedule never drew {kind}: {log}"
+
+    def test_mixed_chaos_deterministic_across_runs(self, server, env):
+        """Same seed, fresh client+policy: identical fault schedule and
+        identical decisions. The non-slow smoke of the seed sweep."""
+        snaps = _chaos_snapshots(env, "cw7", 6)
+        fps1, log1 = _run_chaos(server.address, env, seed=7, snaps=snaps)
+        fps2, log2 = _run_chaos(server.address, env, seed=7, snaps=snaps)
+        assert log1 == log2, "fault schedule was not deterministic"
+        assert fps1 == fps2
+        assert any(f != "ok" for _, _, f in log1)  # chaos actually ran
+
+    def test_no_rpc_error_escapes_any_path(self, server, env):
+        """All four RPC paths under a hostile wire (every call faulted
+        until the consecutive bound forces a clean one): no grpc.RpcError
+        escapes RemoteSolver."""
+        import grpc
+
+        import numpy as np
+        remote = _chaos_remote(server.address, seed=5)
+        plan = FaultPlan(5, p_unavailable=0.5, p_deadline=0.0,
+                         p_latency=0.0, p_truncate=0.5, p_drop=0.0,
+                         max_consecutive=3)
+        snap = _chaos_snapshots(env, "esc", 3)[2]  # the topo-bearing one
+        with FaultInjector(remote.client, plan):
+            try:
+                r = remote.solve(snap)  # Solve + SolveTopo paths
+                assert remote._ping() in (True, False)  # Info path
+                out = remote._dispatch_pruned(  # SolvePruned path
+                    np.zeros(4, dtype=np.int64),
+                    T=1, D=8, Z=1, C=3, G=1, E=0, P=1, n_max=4)
+            except grpc.RpcError as e:  # pragma: no cover - the bug
+                pytest.fail(f"grpc.RpcError escaped RemoteSolver: {e}")
+        assert r.decision_fingerprint() == \
+            CPUSolver().solve(snap).decision_fingerprint()
+        assert int(out[-1]) in (0, 1)
+
+    def test_provisioning_loop_survives_flapping_sidecar(self, server):
+        """The Operator's provisioning loop against a flapping sidecar:
+        every pending pod still lands on a node."""
+        from karpenter_provider_aws_tpu.apis.objects import (EC2NodeClass,
+                                                             NodeClassRef,
+                                                             NodePool,
+                                                             NodePoolTemplate)
+        from karpenter_provider_aws_tpu.apis.requirements import \
+            Requirements
+        from karpenter_provider_aws_tpu.fake.ec2 import FakeEC2
+        from karpenter_provider_aws_tpu.operator import Operator
+        remote = _chaos_remote(server.address, seed=29)
+        op = Operator(ec2=FakeEC2(), solver=remote)
+        nc = EC2NodeClass("chaos-class")
+        op.kube.create(nc)
+        op.kube.create(NodePool("chaos", template=NodePoolTemplate(
+            node_class_ref=NodeClassRef(nc.metadata.name),
+            requirements=Requirements.from_terms([]))))
+        for p in make_pods(24, cpu="500m", memory="1Gi", prefix="chaos"):
+            op.kube.create(p)
+        with FaultInjector(remote.client, FaultPlan(29)) as inj:
+            op.run_until_settled()
+            faults = sum(1 for _, _, f in inj.log if f != "ok")
+        pods = op.kube.list("Pod")
+        assert pods and all(p.node_name for p in pods), \
+            "pods left unscheduled behind a flapping sidecar"
+        assert faults >= 1  # the wire really flapped
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", CHAOS_SEEDS)
+def test_seed_sweep_is_deterministic(server, env, seed):
+    """The CI sweep (hack/chaoswire.sh): each fixed seed runs twice;
+    fault schedules and decision fingerprints must match exactly."""
+    snaps = _chaos_snapshots(env, f"cw{seed}", 6)
+    fps1, log1 = _run_chaos(server.address, env, seed, snaps=snaps)
+    fps2, log2 = _run_chaos(server.address, env, seed, snaps=snaps)
+    assert log1 == log2, f"seed {seed}: nondeterministic fault schedule"
+    assert fps1 == fps2, f"seed {seed}: nondeterministic decisions"

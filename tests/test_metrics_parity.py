@@ -273,6 +273,36 @@ def emitted():
     except Exception:
         pass
 
+    # sidecar resilience series: rpc outcomes, retries, breaker
+    # transitions/state, degraded solves — a RemoteSolver against a
+    # dead address with a fast seeded policy (instrument_sidecar wiring)
+    import random as _random
+
+    import numpy as _np
+
+    from karpenter_provider_aws_tpu.controllers.telemetry import \
+        instrument_sidecar
+    from karpenter_provider_aws_tpu.sidecar import RemoteSolver
+    from karpenter_provider_aws_tpu.sidecar.resilience import (
+        CircuitBreaker, ResiliencePolicy, RetryPolicy)
+    from karpenter_provider_aws_tpu.solver.tpu import DeviceDispatchFailed
+    sidecar = RemoteSolver(
+        "127.0.0.1:1", n_max=64, backend="jax",
+        policy=ResiliencePolicy(
+            retry=RetryPolicy(max_attempts=2, backoff_base_s=0.0,
+                              backoff_cap_s=0.0, rng=_random.Random(0),
+                              sleep=lambda s: None),
+            breaker=CircuitBreaker(threshold=2, cooldown_s=60.0)))
+    sidecar.client.timeout = 0.3
+    instrument_sidecar(sidecar, op.metrics)
+    for _ in range(2):  # 1st call: retry then breaker opens; 2nd: fast-fail
+        try:
+            sidecar._dispatch(_np.zeros(4, dtype=_np.int64), T=1, D=8,
+                              Z=1, C=3, G=1, E=0, P=1, K=0, V=0, M=0,
+                              n_max=4, F=1)
+        except DeviceDispatchFailed:
+            pass  # host twin would serve; degraded counter incremented
+
     # catalog membership + offering gauges at the current blacklist
     op.catalog_controller.refresh_gauges()
 

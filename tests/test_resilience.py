@@ -1,0 +1,410 @@
+"""Sidecar resilience layer: retry policy, circuit breaker, the guarded
+call path, AliveCache probe dedupe, and router park/recovery."""
+
+import random
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from karpenter_provider_aws_tpu.sidecar.resilience import (
+    CLOSED, HALF_OPEN, OPEN, CircuitBreaker, ResiliencePolicy, RetryPolicy,
+    SidecarUnavailable)
+from karpenter_provider_aws_tpu.solver.route import (DEV_FAILED_MS,
+                                                     AliveCache, Router)
+
+
+def _unavailable():
+    import grpc
+
+    from karpenter_provider_aws_tpu.fake.faultwire import _injected_error
+    return _injected_error(grpc.StatusCode.UNAVAILABLE, "test: down")
+
+
+def _rejected(code=None):
+    import grpc
+
+    from karpenter_provider_aws_tpu.fake.faultwire import _injected_error
+    return _injected_error(code or grpc.StatusCode.INVALID_ARGUMENT,
+                           "test: rejected")
+
+
+def _policy(max_attempts=3, threshold=5, cooldown_s=60.0, clock=None,
+            metrics=None):
+    return ResiliencePolicy(
+        retry=RetryPolicy(max_attempts=max_attempts, backoff_base_s=0.0,
+                          backoff_cap_s=0.0, rng=random.Random(0),
+                          sleep=lambda s: None),
+        breaker=CircuitBreaker(threshold=threshold, cooldown_s=cooldown_s,
+                               clock=clock or time.monotonic),
+        metrics=metrics)
+
+
+class TestRetryPolicy:
+    def test_full_jitter_is_seeded_and_bounded(self):
+        a = RetryPolicy(backoff_base_s=0.1, backoff_cap_s=1.0,
+                        rng=random.Random(42))
+        b = RetryPolicy(backoff_base_s=0.1, backoff_cap_s=1.0,
+                        rng=random.Random(42))
+        seq_a = [a.backoff_s(i) for i in range(8)]
+        seq_b = [b.backoff_s(i) for i in range(8)]
+        assert seq_a == seq_b  # same seed, same schedule
+        for i, s in enumerate(seq_a):
+            assert 0.0 <= s <= min(1.0, 0.1 * 2 ** i)
+        # the cap binds: late attempts never exceed it
+        assert all(s <= 1.0 for s in seq_a)
+
+    def test_deadline_scales_with_payload(self):
+        pol = ResiliencePolicy(wire_bytes_per_s=1e6, max_deadline_s=50.0)
+        assert pol.deadline_for(0, 10.0) == 10.0
+        assert pol.deadline_for(2_000_000, 10.0) == pytest.approx(12.0)
+        assert pol.deadline_for(10**9, 10.0) == 50.0  # capped
+
+
+class TestCircuitBreaker:
+    def test_state_machine_full_cycle(self):
+        now = [0.0]
+        br = CircuitBreaker(threshold=3, cooldown_s=10.0,
+                            clock=lambda: now[0])
+        seen = []
+        br.on_transition.append(lambda o, n: seen.append((o, n)))
+        assert br.state == CLOSED
+        for _ in range(2):
+            br.record_failure()
+        assert br.state == CLOSED  # below threshold
+        br.record_failure()
+        assert br.state == OPEN
+        assert br.allow() is False  # cooldown not elapsed: fail fast
+        now[0] = 11.0
+        assert br.allow() is True  # the half-open probe
+        assert br.state == HALF_OPEN
+        assert br.allow() is False  # ONE probe at a time
+        br.record_success()
+        assert br.state == CLOSED
+        assert br.allow() is True
+        assert seen == [(CLOSED, OPEN), (OPEN, HALF_OPEN),
+                        (HALF_OPEN, CLOSED)]
+
+    def test_half_open_failure_reopens(self):
+        now = [0.0]
+        br = CircuitBreaker(threshold=2, cooldown_s=5.0,
+                            clock=lambda: now[0])
+        br.record_failure()
+        br.record_failure()
+        now[0] = 6.0
+        assert br.allow()
+        assert br.state == HALF_OPEN
+        br.record_failure()
+        assert br.state == OPEN
+        assert br.allow() is False  # cooldown restarted at reopen
+        now[0] = 11.5
+        assert br.allow()
+
+    def test_success_resets_consecutive_count(self):
+        br = CircuitBreaker(threshold=3)
+        br.record_failure()
+        br.record_failure()
+        br.record_success()
+        br.record_failure()
+        br.record_failure()
+        assert br.state == CLOSED  # never 3 CONSECUTIVE failures
+
+    def test_transition_callback_errors_are_swallowed(self):
+        br = CircuitBreaker(threshold=1)
+
+        def boom(o, n):
+            raise RuntimeError("observer bug")
+
+        br.on_transition.append(boom)
+        br.record_failure()  # must not raise
+        assert br.state == OPEN
+
+
+class TestPolicyCall:
+    def test_retries_then_succeeds(self):
+        pol = _policy(max_attempts=3)
+        calls = {"n": 0}
+
+        def attempt(deadline):
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise _unavailable()
+            return "served"
+
+        assert pol.call(attempt, rpc="Solve") == "served"
+        assert calls["n"] == 3
+        assert pol.last_call["retries"] == 2
+        assert pol.last_call["ok"] is True
+
+    def test_exhausted_raises_sidecar_unavailable(self):
+        import grpc
+        pol = _policy(max_attempts=2)
+
+        def attempt(deadline):
+            raise _unavailable()
+
+        with pytest.raises(SidecarUnavailable) as ei:
+            pol.call(attempt, rpc="Solve")
+        assert not isinstance(ei.value, grpc.RpcError)
+        assert ei.value.attempts == 2
+
+    def test_rejection_reraises_without_retry(self):
+        import grpc
+        pol = _policy(max_attempts=3)
+        calls = {"n": 0}
+
+        def attempt(deadline):
+            calls["n"] += 1
+            raise _rejected()
+
+        with pytest.raises(grpc.RpcError) as ei:
+            pol.call(attempt, rpc="Solve")
+        assert ei.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+        assert calls["n"] == 1  # the peer answered; retrying is pointless
+        assert pol.breaker.state == CLOSED
+
+    def test_malformed_response_is_retried(self):
+        pol = _policy(max_attempts=3)
+        calls = {"n": 0}
+
+        def attempt(deadline):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise ValueError("arena checksum mismatch")
+            return "served"
+
+        assert pol.call(attempt, rpc="SolveTopo") == "served"
+        assert calls["n"] == 2
+
+    def test_breaker_open_fails_fast_without_wire_attempt(self):
+        pol = _policy(max_attempts=1, threshold=2)
+        for _ in range(2):
+            with pytest.raises(SidecarUnavailable):
+                pol.call(lambda d: (_ for _ in ()).throw(_unavailable()),
+                         rpc="Solve")
+        assert pol.breaker.state == OPEN
+        calls = {"n": 0}
+
+        def attempt(deadline):
+            calls["n"] += 1
+            return "served"
+
+        with pytest.raises(SidecarUnavailable) as ei:
+            pol.call(attempt, rpc="Solve")
+        assert ei.value.breaker_open is True
+        assert calls["n"] == 0  # no wire attempt while open
+
+    def test_open_mid_call_stops_the_retry_loop(self):
+        pol = _policy(max_attempts=5, threshold=2)
+        calls = {"n": 0}
+
+        def attempt(deadline):
+            calls["n"] += 1
+            raise _unavailable()
+
+        with pytest.raises(SidecarUnavailable):
+            pol.call(attempt, rpc="Solve")
+        # the 2nd failure opened the breaker; attempts 3..5 never ran
+        assert calls["n"] == 2
+
+    def test_half_open_probe_success_closes(self):
+        now = [0.0]
+        pol = _policy(max_attempts=1, threshold=1, cooldown_s=5.0,
+                      clock=lambda: now[0])
+        with pytest.raises(SidecarUnavailable):
+            pol.call(lambda d: (_ for _ in ()).throw(_unavailable()),
+                     rpc="Info")
+        assert pol.breaker.state == OPEN
+        now[0] = 6.0
+        assert pol.call(lambda d: "pong", rpc="Info") == "pong"
+        assert pol.breaker.state == CLOSED
+
+    def test_metrics_series_emitted(self):
+        from karpenter_provider_aws_tpu.utils.metrics import Metrics
+        m = Metrics()
+        now = [0.0]
+        pol = _policy(max_attempts=2, threshold=2, clock=lambda: now[0],
+                      metrics=m)
+        pol.emit_state()
+        assert m.gauge("karpenter_solver_sidecar_breaker_state") == 0
+        with pytest.raises(SidecarUnavailable):
+            pol.call(lambda d: (_ for _ in ()).throw(_unavailable()),
+                     rpc="Solve")
+        assert m.counter("karpenter_solver_sidecar_retries_total",
+                         labels={"rpc": "Solve"}) == 1
+        assert m.counter("karpenter_solver_sidecar_rpc_total",
+                         labels={"rpc": "Solve",
+                                 "outcome": "unavailable"}) == 1
+        assert m.counter(
+            "karpenter_solver_sidecar_breaker_transitions_total",
+            labels={"from": CLOSED, "to": OPEN}) == 1
+        assert m.gauge("karpenter_solver_sidecar_breaker_state") == 2
+
+
+class TestAliveCacheDedupe:
+    def test_concurrent_blocking_runs_one_probe(self):
+        """Satellite: the thundering herd — N concurrent blocking()
+        callers must share ONE probe run, not launch N."""
+        probes = {"n": 0}
+        gate = threading.Event()
+
+        def probe():
+            probes["n"] += 1
+            gate.wait(5.0)
+            return True
+
+        cache = AliveCache(probe)
+        verdicts = []
+
+        def worker():
+            verdicts.append(cache.blocking())
+
+        threads = [threading.Thread(target=worker) for _ in range(5)]
+        for t in threads:
+            t.start()
+        time.sleep(0.2)  # let every caller reach the wait
+        gate.set()
+        for t in threads:
+            t.join(10.0)
+        assert verdicts == [True] * 5
+        assert probes["n"] == 1
+
+    def test_false_verdict_expires_and_reprobes(self):
+        verdicts = iter([False, True])
+        cache = AliveCache(lambda: next(verdicts), recheck_s=0.05)
+        assert cache.blocking() is False
+        assert cache.blocking() is False  # cached within recheck window
+        time.sleep(0.06)
+        assert cache.blocking() is True
+
+    def test_mark_failed_and_mark_ok(self):
+        cache = AliveCache(lambda: True, recheck_s=30.0)
+        cache.mark_failed()
+        assert cache.nonblocking() is False  # no probe, external evidence
+        cache.mark_ok()
+        assert cache.nonblocking() is True
+        assert cache.blocking() is True
+
+
+class TestRouterParkRecovery:
+    def test_observe_parks_and_unparks_absolutely(self):
+        r = Router()
+        b = ("bucket",)
+        r.observe(b, "dev", 10.0)
+        r.observe(b, "dev", DEV_FAILED_MS)
+        assert r.snapshot()[b]["dev"] == DEV_FAILED_MS  # not blended
+        r.observe(b, "dev", 12.0)
+        assert r.snapshot()[b]["dev"] == 12.0  # recovery is immediate
+
+    def test_park_dev_parks_every_bucket(self):
+        r = Router()
+        for i in range(3):
+            r.observe((i,), "dev", 5.0)
+            r.observe((i,), "host", 9.0)
+        r.park_dev()
+        snap = r.snapshot()
+        for i in range(3):
+            assert snap[(i,)]["dev"] == DEV_FAILED_MS
+            assert snap[(i,)]["host"] == 9.0
+        assert r.choose((0,))[0] == "host"
+
+    def test_refresh_probe_restores_dev_within_one_cycle(self, monkeypatch):
+        """Satellite: after DEV_FAILED_MS parking, a healthy dev engine
+        must win routing back within one REFRESH_EVERY cycle via the
+        background refresh probe (the recovery half of the routing
+        story; the failure half is covered in test_solver_route)."""
+        from karpenter_provider_aws_tpu.solver import route
+        monkeypatch.setattr(route, "REFRESH_EVERY", 4)
+        r = Router()
+        r.alive = AliveCache(lambda: True)
+        assert r.alive.blocking()
+        b = ("shape",)
+        served = {"dev": 0, "host": 0}
+
+        def host_fn():
+            served["host"] += 1
+            time.sleep(0.005)  # the slow side: dev must win on merit
+            return "host"
+
+        def dev_fn():
+            served["dev"] += 1
+            return "dev"
+
+        r.observe(b, "host", 5.0)
+        r.observe(b, "dev", 1.0)
+        r.park_dev()  # breaker opened: dev EWMA parked
+        for _ in range(route.REFRESH_EVERY):
+            assert route.routed(r, b, host_fn, dev_fn) == "host"
+        # the REFRESH_EVERY-th solve kicked the background probe; it
+        # re-measures dev_fn and the absolute un-park restores routing
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if r.snapshot()[b]["dev"] < DEV_FAILED_MS:
+                break
+            time.sleep(0.01)
+        assert r.snapshot()[b]["dev"] < DEV_FAILED_MS, \
+            "refresh probe never un-parked the dev EWMA"
+        assert route.routed(r, b, host_fn, dev_fn) == "dev"
+
+
+class TestRemoteSolverDegradation:
+    def test_dispatch_converts_unavailable_to_device_dispatch_failed(self):
+        """The tentpole crash gap: base Solve against a dead address must
+        raise DeviceDispatchFailed (router/solve-core degrade), never a
+        grpc.RpcError."""
+        import grpc
+
+        from karpenter_provider_aws_tpu.sidecar import RemoteSolver
+        from karpenter_provider_aws_tpu.solver.tpu import \
+            DeviceDispatchFailed
+        remote = RemoteSolver("127.0.0.1:1", n_max=64,
+                              policy=_policy(max_attempts=2))
+        remote.client.timeout = 0.5
+        with pytest.raises(DeviceDispatchFailed) as ei:
+            remote._dispatch(np.zeros(4, dtype=np.int64),
+                             T=1, D=8, Z=1, C=3, G=1, E=0, P=1, K=0,
+                             V=0, M=0, n_max=4, F=1)
+        assert not isinstance(ei.value, grpc.RpcError)
+        assert remote.last_dispatch_stats["served_by"] == "host-twin"
+        assert remote.last_dispatch_stats["retries"] == 1
+
+    def test_breaker_open_parks_router_and_marks_not_alive(self):
+        from karpenter_provider_aws_tpu.sidecar import RemoteSolver
+        from karpenter_provider_aws_tpu.solver.tpu import \
+            DeviceDispatchFailed
+        remote = RemoteSolver("127.0.0.1:1", n_max=64,
+                              policy=_policy(max_attempts=1, threshold=2))
+        remote.client.timeout = 0.5
+        remote._router.alive.mark_ok()
+        remote._router.observe(("b",), "dev", 1.0)
+        for _ in range(2):
+            with pytest.raises(DeviceDispatchFailed):
+                remote._dispatch(np.zeros(4, dtype=np.int64),
+                                 T=1, D=8, Z=1, C=3, G=1, E=0, P=1,
+                                 K=0, V=0, M=0, n_max=4, F=1)
+        assert remote.client.policy.breaker.state == OPEN
+        assert remote._router.snapshot()[("b",)]["dev"] == DEV_FAILED_MS
+        assert remote._router.alive.nonblocking() is False
+
+    def test_ping_survives_malformed_info(self):
+        """Satellite: an Info response missing `devices` must be an
+        explicit not-alive verdict, not a KeyError out of the probe."""
+        from karpenter_provider_aws_tpu.sidecar import RemoteSolver
+
+        class WeirdClient:
+            def info(self, timeout=None):
+                return {}  # truncated/hostile peer: no 'devices'
+
+        remote = RemoteSolver.__new__(RemoteSolver)
+        remote.client = WeirdClient()
+        remote._pruned_ok = None
+        assert RemoteSolver._ping(remote) is False
+        assert remote._pruned_ok is False
+
+        class DeadClient:
+            def info(self, timeout=None):
+                raise SidecarUnavailable("Info", 3)
+
+        remote.client = DeadClient()
+        assert RemoteSolver._ping(remote) is False

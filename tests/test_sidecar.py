@@ -393,3 +393,124 @@ print('WIRE-OK')
             __import__("numpy").zeros(4, dtype="int64"),
             T=1, D=8, Z=1, C=3, G=1, E=0, P=1, n_max=4)
         assert int(out[-1]) == 1
+
+
+class TestServerHardening:
+    def test_malformed_arena_rejected_invalid_argument(self, server):
+        """Garbage request bytes must map to INVALID_ARGUMENT on every
+        RPC — not surface the codec exception as UNKNOWN (which retry
+        policies rightly refuse and operators read as a server bug)."""
+        import grpc
+        client = SolverClient(server.address)
+        for call in (client._solve, client._solve_topo):
+            with pytest.raises(grpc.RpcError) as ei:
+                call(b"\x00garbage-not-an-arena", timeout=10.0)
+            assert ei.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+        with pytest.raises(grpc.RpcError) as eip:
+            client._solve_pruned(b"\x00garbage-not-an-arena", timeout=10.0)
+        # a mesh server refuses SolvePruned BEFORE decoding the payload
+        # (capability gate precedes validation, by design)
+        assert eip.value.code() in (grpc.StatusCode.INVALID_ARGUMENT,
+                                    grpc.StatusCode.FAILED_PRECONDITION)
+        # a VALID arena missing required fields is a peer bug too
+        with pytest.raises(grpc.RpcError) as ei2:
+            client._solve(arena_pack({"nope": np.zeros(3, np.int64)}),
+                          timeout=10.0)
+        assert ei2.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+        assert client.info()["devices"] >= 1  # server alive throughout
+
+    def test_graceful_stop_drains_inflight_solve(self):
+        """A solve already past the port must LAND during stop's grace
+        window — stop refuses new RPCs immediately but drains in-flight
+        handlers before the hard cancel."""
+        import threading
+        import time as _time
+        srv = SolverServer().start()
+        release = threading.Event()
+        entered = threading.Event()
+        orig_info = srv._handler.info
+
+        def slow_info(request, context):
+            entered.set()
+            release.wait(10.0)
+            return orig_info(request, context)
+
+        srv._handler.info = slow_info
+        client = SolverClient(srv.address)
+        result = {}
+
+        def call():
+            result["info"] = client.info(timeout=30.0)
+
+        t = threading.Thread(target=call)
+        t.start()
+        assert entered.wait(10.0), "in-flight call never reached handler"
+
+        def finish():
+            _time.sleep(0.3)
+            release.set()
+
+        threading.Thread(target=finish).start()
+        srv.stop(grace=10.0)  # must wait for the in-flight call
+        t.join(10.0)
+        assert result.get("info", {}).get("devices", 0) >= 1, \
+            "in-flight solve was torn down by stop"
+
+    def test_shape_admission_is_thread_safe(self, server):
+        """Hammer _admit_shape from many threads: the budget must be
+        enforced exactly (no lost updates past _MAX_SHAPE_CLASSES)."""
+        import threading
+
+        from karpenter_provider_aws_tpu.sidecar.server import (
+            _MAX_SHAPE_CLASSES, _Handler)
+        h = _Handler()
+
+        class Ctx:
+            def abort(self, code, msg):
+                raise RuntimeError(msg)
+
+        errors = []
+
+        def worker(base):
+            for i in range(64):
+                try:
+                    h._admit_shape(("k", base, i), Ctx())
+                except RuntimeError:
+                    errors.append(1)
+
+        threads = [threading.Thread(target=worker, args=(b,))
+                   for b in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(h._shapes_seen) == _MAX_SHAPE_CLASSES
+        assert len(errors) == 8 * 64 - _MAX_SHAPE_CLASSES
+
+
+class TestServeTLS:
+    def test_serve_with_cert_files_starts_and_stops(self, tmp_path):
+        """Satellite regression: serve() used to leak the TLS cert/key
+        file handles. It must start a TLS listener from file paths,
+        serve a TLS client, and stop cleanly."""
+        import shutil
+        import subprocess
+        if shutil.which("openssl") is None:
+            pytest.skip("openssl binary not available")
+        cert = tmp_path / "tls.crt"
+        key = tmp_path / "tls.key"
+        subprocess.run(
+            ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+             "-keyout", str(key), "-out", str(cert), "-days", "1",
+             "-subj", "/CN=127.0.0.1",
+             "-addext", "subjectAltName=IP:127.0.0.1"],
+            check=True, capture_output=True, timeout=60)
+        from karpenter_provider_aws_tpu.sidecar import serve
+        srv = serve(port=0, tls_cert_file=str(cert),
+                    tls_key_file=str(key))
+        try:
+            client = SolverClient(srv.address,
+                                  root_cert=cert.read_bytes())
+            assert client.info(timeout=10.0)["devices"] >= 1
+        finally:
+            srv.stop()
