@@ -20,6 +20,13 @@ deflake:  ## Makefile:63-70 analog: randomized order, repeated until failure
 chart:  ## render + lint the deploy chart (no helm needed)
 	python hack/render_chart.py --validate
 
+chaos:  ## both seeded fault-injection sweeps (solver wire + cloud seam)
+	sh hack/chaoswire.sh
+	sh hack/chaoscloud.sh
+
+chaoscloud:  ## the 10-seed cloud-seam chaos sweep alone
+	sh hack/chaoscloud.sh
+
 benchmark:  ## the five BASELINE configs + interruption throughput
 	python bench.py --all --rounds 100
 	python bench.py --interruption
@@ -30,4 +37,4 @@ multichip:  ## dry-run the multi-device solve on 8 virtual CPU devices
 daemon:  ## run the operator against the in-memory cloud
 	python -m karpenter_provider_aws_tpu --cluster-name dev --metrics-port 8080
 
-.PHONY: test test-all scale deflake benchmark multichip daemon chart
+.PHONY: test test-all scale deflake benchmark multichip daemon chart chaos chaoscloud

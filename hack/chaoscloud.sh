@@ -1,0 +1,25 @@
+#!/bin/sh
+# Seeded chaos sweep for the cloud seam.
+#
+# Runs the cloud fault-injection chaos tests (tests/test_faultcloud.py,
+# the `slow`-marked seed matrix) across 10 fixed seeds. Each seed runs
+# the same provision -> interrupt -> reprovision scenario with the
+# injector (fake/faultcloud.py) perturbing every EC2/SQS call per its
+# seeded schedule: throttle storms (RequestLimitExceeded), link flaps
+# (ConnectionError), wedges (latency stalls), DescribeInstances lag
+# after CreateFleet (eventual consistency), partial-fleet launches
+# (instances lost in flight), and duplicated SQS deliveries
+# (at-least-once). The test fails if any seeded run diverges from the
+# fault-free terminal fingerprint, leaks an orphan instance, or loses
+# an interruption.
+#
+# Tier-1 stays fast: these tests are excluded there by `-m 'not slow'`.
+#
+# Usage: sh hack/chaoscloud.sh           # the full 10-seed sweep
+#        sh hack/chaoscloud.sh -x -q     # extra pytest args pass through
+set -e
+cd "$(dirname "$0")/.."
+
+JAX_PLATFORMS=cpu exec python -m pytest \
+    "tests/test_faultcloud.py::TestChaosConvergence::test_seed_sweep_converges" \
+    -m slow -q -p no:cacheprovider "$@"

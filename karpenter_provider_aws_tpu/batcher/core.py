@@ -68,6 +68,9 @@ class Batcher(Generic[T, U]):
         self._buckets: Dict[Hashable, _Bucket[T, U]] = {}
         self._wake = threading.Condition(self._mu)
         self._stopped = False
+        #: in-flight batch-exec threads; stop() joins them so a shutdown
+        #: never abandons callers blocked in add_sync
+        self._exec_threads: List[threading.Thread] = []
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
@@ -127,8 +130,12 @@ class Batcher(Generic[T, U]):
                 "karpenter_cloudprovider_batcher_batch_time_seconds",
                 max(0.0, self.clock() - bucket.opened),
                 labels={"batcher": self.name})
-        threading.Thread(target=self._execute, args=(requests, futures),
-                         daemon=True).start()
+        t = threading.Thread(target=self._execute, args=(requests, futures),
+                             daemon=True)
+        # caller holds self._mu (both flush paths do)
+        self._exec_threads = [x for x in self._exec_threads if x.is_alive()]
+        self._exec_threads.append(t)
+        t.start()
 
     def _execute(self, requests: List[T], futures: List["Future[U]"]) -> None:
         try:
@@ -137,18 +144,38 @@ class Batcher(Generic[T, U]):
                 raise RuntimeError(
                     f"batch exec returned {len(responses)} responses for "
                     f"{len(requests)} requests")
-            for fut, resp in zip(futures, responses):
-                fut.set_result(resp)
-        except Exception as e:  # fan the failure to every caller
+        except Exception as e:  # fan the failure to EVERY pending caller:
+            # a failing batch must never strand an add_sync on the 30s
+            # timeout backstop
             for fut in futures:
                 if not fut.done():
                     fut.set_exception(e)
+            return
+        for fut, resp in zip(futures, responses):
+            if not fut.done():  # a cancelled caller must not wedge the rest
+                fut.set_result(resp)
 
     def stop(self) -> None:
+        """Stop the loop. Queued buckets are DRAINED (the loop's last pass
+        flushes everything once ``_stopped`` is set) and in-flight batch
+        execs are joined, so every caller blocked in ``add_sync`` gets its
+        result or exception; anything still unresolved after the bounded
+        joins (a wedged exec_fn) is failed rather than stranded."""
         with self._mu:
             self._stopped = True
             self._wake.notify()
         self._thread.join(timeout=5)
+        with self._mu:
+            execs = list(self._exec_threads)
+        for t in execs:
+            t.join(timeout=5)
+        with self._mu:
+            leftovers = [b for _k, b in self._buckets.items()]
+            self._buckets.clear()
+        for b in leftovers:
+            for fut in b.futures:
+                if not fut.done():
+                    fut.set_exception(RuntimeError("batcher stopped"))
 
 
 # ---------------------------------------------------------------------------

@@ -16,7 +16,7 @@ from typing import Optional
 
 from ..apis import labels as L
 from ..apis.objects import NodeClaim
-from ..cloudprovider.provider import CloudProvider
+from ..cloudprovider.provider import CloudProvider, parse_instance_id
 from ..cloudprovider.types import (CloudProviderError,
                                    InsufficientCapacityError,
                                    NodeClaimNotFoundError)
@@ -26,6 +26,46 @@ from ..providers.instancetype import InstanceTypeProvider
 log = logging.getLogger(__name__)
 
 REGISTRATION_TTL = 15 * 60  # core: claims that never register are reaped
+
+#: eventual-consistency window after CreateFleet: an instance that
+#: DescribeInstances has never heard of within this window is "not yet
+#: converged", not gone — NotFound shortly after create is retryable
+#: (instance.go NotFound handling; the reference GC's NotFound grace).
+CREATION_GRACE_SECONDS = 90.0
+
+#: every state DescribeInstances knows — the raw-visibility probe must see
+#: terminated instances too (the default filter hides them)
+ALL_INSTANCE_STATES = ("pending", "running", "shutting-down", "stopped",
+                       "terminated")
+
+
+def creation_age(claim, now: float) -> float:
+    """Seconds since the claim's instance launched (Launched transition,
+    falling back to claim creation when the condition is missing)."""
+    cond = claim.conditions.get("Launched")
+    t0 = cond.last_transition if cond is not None else 0.0
+    if not t0:
+        t0 = claim.metadata.creation_timestamp
+    return now - t0
+
+
+def instance_visibility(cloudprovider, provider_id: str) -> str:
+    """What DescribeInstances across ALL states says about an instance:
+    ``live``, ``terminated``, or ``unknown`` (not visible at all).
+
+    The three-way split is what makes the grace window safe: a VISIBLY
+    terminated instance is dead and acted on immediately (external
+    terminate, spot reclaim), while an instance the API does not return
+    in ANY state may simply not have converged into DescribeInstances
+    yet — only that case earns the creation-grace benefit of the doubt."""
+    iid = parse_instance_id(provider_id)
+    insts = cloudprovider.instances.ec2.describe_instances(
+        ids=[iid], states=ALL_INSTANCE_STATES)
+    if not insts:
+        return "unknown"
+    if insts[0].state in ("terminated", "shutting-down"):
+        return "terminated"
+    return "live"
 
 
 def _release_pod(kube: FakeKube, pod) -> None:
@@ -57,13 +97,14 @@ def drain_node_pods(kube: FakeKube, node_name: str, metrics=None) -> None:
 class NodeClaimLifecycle:
     def __init__(self, kube: FakeKube, cloudprovider: CloudProvider,
                  instance_types: Optional[InstanceTypeProvider] = None,
-                 clock=time.time, recorder=None, metrics=None):
+                 clock=time.time, recorder=None, metrics=None, state=None):
         self.kube = kube
         self.cloudprovider = cloudprovider
         self.instance_types = instance_types
         self.clock = clock
         self.recorder = recorder
         self.metrics = metrics
+        self.state = state
 
     def _count(self, phase: str, claim) -> None:
         """karpenter_nodeclaims_{launched,registered,initialized}_total
@@ -158,6 +199,11 @@ class NodeClaimLifecycle:
         obj = self.kube.try_get("NodeClaim", claim.name)
         if obj is not None:
             self.kube.remove_finalizer(obj, "karpenter.sh/termination")
+        # release the pods nominated toward the dead claim NOW — a stale
+        # nomination hides them from pending_pods() for its whole TTL, so
+        # a failed launch would otherwise stall reprovisioning for 20s
+        if self.state is not None:
+            self.state.clear_nominations_to(claim.name)
 
 
 #: drain order of a doomed node's pods (termination_test.go:56-61):
@@ -326,7 +372,22 @@ class Terminator:
             self.cloudprovider.get(claim.provider_id)
             return False
         except NodeClaimNotFoundError:
-            return True
+            pass
+        # NotFound: distinguish dead from not-yet-visible. An instance
+        # invisible in ANY state within the creation-grace window may
+        # still be converging into DescribeInstances — treating it as
+        # gone would skip the ordered drain on a machine that is alive.
+        vis = instance_visibility(self.cloudprovider, claim.provider_id)
+        if vis == "live":
+            return False
+        if vis == "unknown" \
+                and creation_age(claim, self.clock()) < CREATION_GRACE_SECONDS:
+            if self.metrics is not None:
+                self.metrics.inc(
+                    "karpenter_cloud_eventual_consistency_grace_total",
+                    labels={"controller": "termination"})
+            return False
+        return True
 
     def reconcile(self) -> int:
         from .pdb import pdb_state

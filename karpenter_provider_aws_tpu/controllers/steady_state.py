@@ -190,9 +190,32 @@ class GarbageCollector:
                 reaped = sum(pool.map(reap, doomed))
         # also reap Node objects whose instance is gone
         live = {i.provider_id for i in self.cloudprovider.instances.list()}
+        # raw visibility across ALL states (the default describe filter
+        # hides "terminated"): a VISIBLY terminated instance is dead and
+        # reaped immediately, but one the API has never heard of may not
+        # have converged into DescribeInstances yet — young objects in
+        # that state get the eventual-consistency grace instead of a reap
+        # (chaos must never GC a node that is still materializing)
+        from .lifecycle import (ALL_INSTANCE_STATES, CREATION_GRACE_SECONDS,
+                                creation_age, drain_node_pods)
+        known = {i.provider_id for i in
+                 self.cloudprovider.instances.ec2.describe_instances(
+                     states=ALL_INSTANCE_STATES)}
+
+        def _grace(controller: str) -> None:
+            if self.metrics is not None:
+                self.metrics.inc(
+                    "karpenter_cloud_eventual_consistency_grace_total",
+                    labels={"controller": controller})
+
         for node in self.kube.list("Node"):
             if node.provider_id and node.provider_id not in live \
                     and not node.ready:
+                if node.provider_id not in known \
+                        and now - node.metadata.creation_timestamp \
+                        < CREATION_GRACE_SECONDS:
+                    _grace("gc-node")
+                    continue
                 self.kube.delete("Node", node.metadata.name)
         # ...and NodeClaims whose launched instance vanished behind the
         # cluster's back (the core nodeclaim GC direction: instance
@@ -200,7 +223,6 @@ class GarbageCollector:
         # Pods are drained by name regardless of whether the Node object
         # still exists — the node-reap loop above may have deleted it in
         # this same pass, and bound pods must never outlive their node.
-        from .lifecycle import drain_node_pods
         for claim in self.kube.list("NodeClaim"):
             if claim.metadata.deletion_timestamp is not None:
                 # already terminating: the Terminator owns its drain,
@@ -208,6 +230,12 @@ class GarbageCollector:
                 continue
             if claim.launched and claim.provider_id \
                     and claim.provider_id not in live:
+                if claim.provider_id not in known \
+                        and creation_age(claim, now) < CREATION_GRACE_SECONDS:
+                    # invisible (not terminated) + young: DescribeInstances
+                    # has not converged on this launch yet
+                    _grace("gc-nodeclaim")
+                    continue
                 if claim.node_name:
                     drain_node_pods(self.kube, claim.node_name,
                                     metrics=self.metrics)
@@ -266,13 +294,59 @@ class InterruptionController:
         #: handling instant (see _handle) — None in unit tests that only
         #: exercise message parsing
         self.ec2 = ec2
+        # at-least-once delivery state: SQS may deliver a message twice,
+        # out of order, or redeliver after a crash mid-handle. Actionable
+        # messages are keyed by (kind, instance_id); a key already handled
+        # (within DEDUPE_TTL) or currently in flight on another worker is
+        # acknowledged without re-handling, so a spot reclaim processed
+        # twice never double-terminates or double-counts a cordon.
+        import threading
+        self._dedupe_mu = threading.Lock()
+        self._handled_keys: Dict[tuple, float] = {}
+        self._inflight_keys: Set[tuple] = set()
 
     #: message-handling fan-out width (interruption/controller.go:116:
     #: workqueue.ParallelizeUntil(ctx, 10, ...))
     WORKERS = 10
 
+    #: how long a handled (kind, instance) key suppresses redeliveries —
+    #: comfortably past SQS's redrive horizon for the fake's timescales
+    DEDUPE_TTL = 600.0
+
+    def _dedupe_check(self, msg: InterruptionMessage) -> bool:
+        """True when this message is a duplicate to acknowledge-and-drop."""
+        if msg.kind not in ACTIONABLE_KINDS:
+            return False
+        key = (msg.kind, msg.instance_id)
+        now = self.clock()
+        with self._dedupe_mu:
+            done = self._handled_keys.get(key)
+            if done is not None and now - done < self.DEDUPE_TTL:
+                return True
+            if key in self._inflight_keys:
+                return True  # a concurrent worker owns this key's handling
+            self._inflight_keys.add(key)
+            return False
+
+    def _dedupe_commit(self, msg: InterruptionMessage, ok: bool) -> None:
+        """Mark the key handled only AFTER a successful handle — a crash
+        mid-handle leaves the message undeleted and the key unclaimed, so
+        the redelivery is processed (at-least-once, never at-most-once)."""
+        if msg.kind not in ACTIONABLE_KINDS:
+            return
+        key = (msg.kind, msg.instance_id)
+        with self._dedupe_mu:
+            self._inflight_keys.discard(key)
+            if ok:
+                self._handled_keys[key] = self.clock()
+                if len(self._handled_keys) > 4096:
+                    cutoff = self.clock() - self.DEDUPE_TTL
+                    self._handled_keys = {
+                        k: t for k, t in self._handled_keys.items()
+                        if t >= cutoff}
+
     def reconcile(self) -> Dict[str, int]:
-        stats = {"handled": 0, "cordoned": 0, "noop": 0}
+        stats = {"handled": 0, "cordoned": 0, "noop": 0, "deduped": 0}
         claims_by_instance = {}
         for c in self.kube.list("NodeClaim"):
             if c.provider_id:
@@ -280,9 +354,25 @@ class InterruptionController:
         from concurrent.futures import ThreadPoolExecutor
 
         def work(msg):
-            local = {"handled": 0, "cordoned": 0, "noop": 0}
+            local = {"handled": 0, "cordoned": 0, "noop": 0, "deduped": 0}
             t_recv = self.clock()
-            self._handle(msg, claims_by_instance, local)
+            if self._dedupe_check(msg):
+                self.sqs.delete(msg)
+                local["deduped"] += 1
+                if self.metrics is not None:
+                    self.metrics.inc(
+                        "karpenter_interruption_deduped_messages_total",
+                        labels={"message_type": msg.kind})
+                    self.metrics.inc(
+                        "karpenter_interruption_deleted_messages_total",
+                        labels={"message_type": msg.kind})
+                return local
+            try:
+                self._handle(msg, claims_by_instance, local)
+            except BaseException:
+                self._dedupe_commit(msg, ok=False)
+                raise
+            self._dedupe_commit(msg, ok=True)
             self.sqs.delete(msg)
             local["handled"] += 1
             if self.metrics is not None:

@@ -93,6 +93,10 @@ class Daemon:
         self.simulate_kubelet = simulate_kubelet
         self.lease: Optional[FileLease] = \
             FileLease(lease_path) if lease_path else None
+        if self.lease is not None:
+            # leadership loss must PAUSE reconciling, not just flip a
+            # flag: two active managers would double-provision
+            self.lease.on_lost.append(self._on_lease_lost)
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._http_thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
@@ -170,7 +174,27 @@ class Daemon:
 
     # ------------------------------------------------------------------
     def healthy(self) -> bool:
-        return self.manager.running
+        """Readiness: controllers running AND (when leader-elected) the
+        lease still held — a demoted replica reports 503 so traffic and
+        dashboards see the standby for what it is."""
+        if not self.manager.running:
+            return False
+        return self.lease is None or self.lease.held
+
+    def _on_lease_lost(self) -> None:
+        """Heartbeat observed another holder: stop reconciling NOW (the
+        new leader is already acting), flip /readyz to 503 via healthy(),
+        and rejoin the standby pool — blocking on re-acquire and resuming
+        the manager if leadership ever returns, without a restart."""
+        log.warning("leader lease lost; pausing controllers")
+        self.manager.stop()
+        threading.Thread(target=self._rejoin, daemon=True,
+                         name="lease-rejoin").start()
+
+    def _rejoin(self) -> None:
+        if self.lease.acquire(stop=self._stop) and not self._stop.is_set():
+            log.info("re-acquired leader lease as %s", self.lease.identity)
+            self.manager.start()
 
     def start(self) -> "Daemon":
         """Serve endpoints, wait for the lease (if any), start reconciling."""

@@ -112,29 +112,62 @@ class FakeInstance:
 
 class CallLog:
     """MockedFunction analog (fake/ec2api.go:48-68): capture calls, inject
-    one-shot errors, count successes."""
+    errors, count successes.
+
+    Thread-safe: batcher worker threads and the chaos harness hit the same
+    log concurrently, so the read-then-clear in ``maybe_raise`` runs under
+    a lock (two racing callers must never both consume — or both miss —
+    the same one-shot error).
+
+    ``error`` accepts three forms:
+
+    - an exception INSTANCE: raised once, then cleared (the classic
+      single-shot contract);
+    - a sequence/iterator of ``Exception | None``: consumed one entry per
+      call — ``None`` entries mean "this call succeeds", exhaustion means
+      no further faults (the chaos harness schedules storms this way);
+    - a callable returning ``Exception | None`` per call (an exception
+      CLASS is a callable too: setting ``error = ConnectionError`` makes
+      every call fail until cleared).
+    """
 
     def __init__(self):
+        self._mu = threading.Lock()
         self.calls: List[Any] = []
-        self.error: Optional[Exception] = None
+        self.error: Any = None
         self.output_override: Optional[Any] = None
 
     def record(self, inp: Any) -> None:
-        self.calls.append(inp)
+        with self._mu:
+            self.calls.append(inp)
 
     def maybe_raise(self) -> None:
-        if self.error is not None:
-            err, self.error = self.error, None
+        with self._mu:
+            src = self.error
+            if src is None:
+                return
+            if isinstance(src, BaseException):
+                self.error = None
+                err: Optional[BaseException] = src
+            elif callable(src):
+                err = src()
+            else:
+                it = src if hasattr(src, "__next__") else iter(src)
+                self.error = it
+                err = next(it, None)
+        if err is not None:
             raise err
 
     @property
     def called_times(self) -> int:
-        return len(self.calls)
+        with self._mu:
+            return len(self.calls)
 
     def reset(self) -> None:
-        self.calls.clear()
-        self.error = None
-        self.output_override = None
+        with self._mu:
+            self.calls.clear()
+            self.error = None
+            self.output_override = None
 
 
 class DryRunOperation(Exception):
@@ -248,6 +281,7 @@ class FakeEC2:
 
     # -- catalog APIs ------------------------------------------------------
     def describe_instance_types(self) -> List[InstanceTypeInfo]:
+        self._link_gate()
         with self._mu:
             self.describe_instance_types_log.record(None)
             self.describe_instance_types_log.maybe_raise()
@@ -260,6 +294,7 @@ class FakeEC2:
         families are absent from the last availability zone (mirrors
         real-world partial zonal rollout), local zones carry only the
         restricted LOCAL_ZONE_FAMILIES slice, plus any injected removals."""
+        self._link_gate()
         with self._mu:
             out = []
             last_az = next(
@@ -280,6 +315,7 @@ class FakeEC2:
     def describe_spot_price_history(self) -> List[Tuple[str, str, int]]:
         """(instance_type, zone, micro_usd) triples. Local zones publish no
         spot history (local zones are on-demand only)."""
+        self._link_gate()
         with self._mu:
             return [(i.name, z.name, spot_price(i, z.name))
                     for i in self.catalog for z in self.zones
@@ -308,12 +344,14 @@ class FakeEC2:
             return z, sn
 
     def on_demand_prices(self) -> Dict[str, int]:
+        self._link_gate()
         with self._mu:
             return {i.name: i.od_price for i in self.catalog}
 
     # -- network discovery -------------------------------------------------
     def describe_subnets(self, tag_filters: Mapping[str, str] = (),
                          ids: Sequence[str] = ()) -> List[FakeSubnet]:
+        self._link_gate()
         with self._mu:
             return [s for s in self.subnets.values()
                     if _match(s.tags, tag_filters, s.id, ids)]
@@ -321,6 +359,7 @@ class FakeEC2:
     def describe_security_groups(self, tag_filters: Mapping[str, str] = (),
                                  ids: Sequence[str] = (),
                                  names: Sequence[str] = ()) -> List[FakeSecurityGroup]:
+        self._link_gate()
         with self._mu:
             out = []
             for g in self.security_groups.values():
@@ -334,6 +373,7 @@ class FakeEC2:
                         ids: Sequence[str] = (),
                         names: Sequence[str] = (),
                         owners: Sequence[str] = ()) -> List[FakeImage]:
+        self._link_gate()
         with self._mu:
             out = []
             for img in self.images.values():
@@ -347,10 +387,12 @@ class FakeEC2:
 
     def eks_describe_cluster_version(self) -> str:
         """EKS DescribeCluster's cluster version (version.go source)."""
+        self._link_gate()
         with self._mu:
             return self.eks_cluster_version
 
     def ssm_get_parameter(self, path: str) -> str:
+        self._link_gate()
         self.ssm_get_parameter_log.record(path)
         with self._mu:
             if path not in self.ssm_parameters:
@@ -359,6 +401,7 @@ class FakeEC2:
 
     # -- launch templates --------------------------------------------------
     def create_launch_template(self, lt: FakeLaunchTemplate) -> FakeLaunchTemplate:
+        self._link_gate()
         with self._mu:
             self.create_launch_template_log.record(lt)
             self.create_launch_template_log.maybe_raise()
@@ -368,12 +411,14 @@ class FakeEC2:
             return lt
 
     def describe_launch_templates(self, names: Sequence[str] = ()) -> List[FakeLaunchTemplate]:
+        self._link_gate()
         with self._mu:
             if not names:
                 return list(self.launch_templates.values())
             return [self.launch_templates[n] for n in names if n in self.launch_templates]
 
     def delete_launch_templates(self, names: Sequence[str]) -> None:
+        self._link_gate()
         with self._mu:
             for n in names:
                 self.launch_templates.pop(n, None)
@@ -393,6 +438,7 @@ class FakeEC2:
         CreateFleet's price-capacity-optimized behavior the launcher relies on
         (instance.go:227-245, 357-363).
         """
+        self._link_gate()
         with self._mu:
             req = {"configs": launch_template_configs,
                    "target_capacity": target_capacity,
@@ -467,6 +513,7 @@ class FakeEC2:
                            states: Sequence[str] = ("pending", "running",
                                                     "shutting-down", "stopped")
                            ) -> List[FakeInstance]:
+        self._link_gate()
         with self._mu:
             self.describe_instances_log.record({"ids": list(ids), "filters": dict(tag_filters)})
             self.describe_instances_log.maybe_raise()
@@ -482,6 +529,7 @@ class FakeEC2:
             return out
 
     def terminate_instances(self, ids: Sequence[str]) -> List[str]:
+        self._link_gate()
         with self._mu:
             self.terminate_instances_log.record(list(ids))
             self.terminate_instances_log.maybe_raise()
@@ -494,6 +542,7 @@ class FakeEC2:
             return done
 
     def create_tags(self, ids: Sequence[str], tags: Mapping[str, str]) -> None:
+        self._link_gate()
         with self._mu:
             self.create_tags_log.record({"ids": list(ids), "tags": dict(tags)})
             self.create_tags_log.maybe_raise()
@@ -513,7 +562,8 @@ class FakeEC2:
             self.removed_offerings.clear()
             for log in (self.create_fleet_log, self.describe_instances_log,
                         self.terminate_instances_log, self.create_launch_template_log,
-                        self.create_tags_log, self.describe_instance_types_log):
+                        self.create_tags_log, self.describe_instance_types_log,
+                        self.ssm_get_parameter_log):
                 log.reset()
 
 

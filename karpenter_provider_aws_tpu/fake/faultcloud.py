@@ -1,0 +1,298 @@
+"""Deterministic fault injection for the cloud seam.
+
+The cloud-side sibling of :mod:`faultwire`: where that module tears the
+solver's gRPC channel, this one tears the EC2/SQS seam underneath the
+operator. :class:`CloudFaultInjector` wraps a :class:`FakeEC2`'s public
+API methods (and, optionally, the SQS provider's ``send``) with wrappers
+that consult a seeded :class:`CloudFaultPlan` before each real call.
+Everything above the wrapped methods — the :class:`ResilientCloud`
+retry/classification proxy, the batchers, the eventual-consistency grace
+in the controllers, the interruption dedupe — runs UNCHANGED, which is
+the point: chaos tests exercise the exact production resilience path
+with the exact production error shapes (``AWSError`` throttle codes,
+``ConnectionError`` link failures), not mocks of it.
+
+Injected fault kinds (per call, mutually exclusive):
+
+- ``throttle`` — the API sheds the request (``RequestLimitExceeded``,
+                 the retry policy's throttle class; storms of these are
+                 what the adaptive rate limiter exists for)
+- ``down``     — the request never reaches the endpoint
+                 (``ConnectionError`` — a DOWN link flap)
+- ``wedge``    — the request stalls briefly then succeeds (a bounded
+                 WEDGED link flap; the *unbounded* wedge is the boot
+                 preflight suite's job, not a convergence test's)
+- ``lag``      — create_fleet succeeded but the new instances are
+                 invisible to describe_instances for ``lag_s`` seconds
+                 (EC2's documented eventual consistency; without the
+                 creation-grace window GC would reap the materializing
+                 node)
+- ``partial``  — create_fleet under-delivers: the tail instance of the
+                 batch never launched (the caller sees an ICE-shaped
+                 deficit and reprovisions)
+- ``dup``      — an SQS send is delivered twice (at-least-once
+                 redelivery; the interruption dedupe must collapse it)
+
+Determinism: faults are drawn from ``random.Random(seed)`` in call
+order. The operator's batchers and GC pool are threaded, so the call
+ORDER — and therefore the injector log — is not reproducible across
+runs; the convergence contract is instead on the terminal state: every
+seeded run must settle to the fault-free run's cluster fingerprint with
+zero orphaned instances and zero lost interruptions
+(``hack/chaoscloud.sh`` sweeps seeds against exactly that bar).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..providers.awsretry import AWSError
+
+#: fault kinds an injector can draw (order matters: it is the cumulative
+#: probability order used by CloudFaultPlan.next)
+CLOUD_FAULT_KINDS = ("throttle", "down", "wedge", "lag", "partial", "dup")
+
+#: FakeEC2 methods the injector wraps — every operation the providers
+#: reach through the ResilientCloud proxy's guarded set that the fake
+#: actually serves during steady-state operation
+EC2_FAULT_OPS = (
+    "create_fleet",
+    "describe_instances",
+    "terminate_instances",
+    "create_tags",
+    "create_launch_template",
+    "describe_launch_templates",
+    "describe_subnets",
+    "describe_security_groups",
+    "describe_images",
+    "describe_instance_types",
+    "ssm_get_parameter",
+)
+
+
+class CloudFaultPlan:
+    """Seeded per-call fault schedule for the cloud seam.
+
+    Each cloud call draws one uniform sample; the p_* probabilities
+    partition [0,1) in CLOUD_FAULT_KINDS order, remainder = clean call.
+    Kinds that do not apply to the operation at hand (``lag``/``partial``
+    outside create_fleet, ``dup`` outside sqs.send, throttle/down/wedge
+    ON sqs.send) resolve to a clean call — the draw is still consumed so
+    the schedule stays a pure function of the seed and call order.
+
+    Two bounds keep an adversarial schedule from (correctly but
+    unhelpfully) violating the convergence bar:
+
+    - ``max_consecutive`` bounds runs of *delivery* failures
+      (throttle/down) below the retry policy's attempt budget, so a
+      retried call always eventually lands;
+    - ``max_faults`` caps the total number of injected faults, after
+      which the plan goes permanently clean — the chaos storm is finite,
+      so the settle loop's terminal state is the fault-free one.
+    """
+
+    def __init__(self, seed: int,
+                 p_throttle: float = 0.12,
+                 p_down: float = 0.08,
+                 p_wedge: float = 0.08,
+                 p_lag: float = 0.10,
+                 p_partial: float = 0.06,
+                 p_dup: float = 0.25,
+                 wedge_ms: float = 25.0,
+                 lag_s: float = 0.75,
+                 max_consecutive: int = 2,
+                 max_faults: int = 40):
+        import random
+        self.seed = seed
+        self._rng = random.Random(seed)
+        self._p = (p_throttle, p_down, p_wedge, p_lag, p_partial, p_dup)
+        assert sum(self._p) <= 1.0
+        self.wedge_ms = wedge_ms
+        self.lag_s = lag_s
+        self.max_consecutive = max_consecutive
+        self.max_faults = max_faults
+        self._consecutive = 0
+        self._faults = 0
+
+    def next(self, call_index: int, op: str) -> Optional[str]:
+        """Draw the fault (or None) for this cloud call. `call_index`
+        and `op` ride into the injector's event log; the draw itself is
+        purely sequential so the schedule is a function of the seed."""
+        u = self._rng.random()
+        if self._faults >= self.max_faults:
+            return None
+        acc = 0.0
+        kind = None
+        for k, p in zip(CLOUD_FAULT_KINDS, self._p):
+            acc += p
+            if u < acc:
+                kind = k
+                break
+        # remap kinds that do not apply to this operation to clean
+        if op == "sqs.send":
+            if kind != "dup":
+                kind = None
+        else:
+            if kind == "dup":
+                kind = None
+            if kind in ("lag", "partial") and op != "create_fleet":
+                kind = None
+        if kind in ("throttle", "down"):
+            if self._consecutive >= self.max_consecutive:
+                kind = None  # forced clean call: bound the failure run
+            else:
+                self._consecutive += 1
+        else:
+            self._consecutive = 0
+        if kind is not None:
+            self._faults += 1
+        return kind
+
+
+class CloudFaultInjector:
+    """Wraps a FakeEC2's API methods (and SQS send) with the plan's faults.
+
+    Usage::
+
+        op = Operator(...)
+        inj = CloudFaultInjector(op.ec2, sqs=op.sqs,
+                                 plan=CloudFaultPlan(seed=7)).install()
+        ... drive the cluster; inj.log holds (call_index, op, fault) ...
+        inj.uninstall()
+
+    Install AFTER the operator is built: the wrappers then sit between
+    the operator's instrumentation layer and the ResilientCloud proxy's
+    per-call ``getattr`` (proxy -> injector -> instrumentation -> fake),
+    so every injected fault travels the full production retry path.
+
+    Faults that fail delivery (throttle/down) are raised BEFORE the real
+    call — the fake's state never mutates on a failed request, so a
+    "failure" can never strand a half-created instance the controllers
+    cannot see. Orphans, if the grace/GC logic regressed, come from the
+    ``lag`` fault instead: the instance exists but describe hides it.
+    """
+
+    def __init__(self, ec2, sqs=None, plan: Optional[CloudFaultPlan] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 sleep: Callable[[float], None] = time.sleep):
+        self.ec2 = ec2
+        self.sqs = sqs
+        self.plan = plan if plan is not None else CloudFaultPlan(seed=0)
+        self._clock = clock
+        self._sleep = sleep
+        self._mu = threading.Lock()
+        self._calls = 0
+        #: (call_index, op, fault-or-"ok") per cloud call, in call order
+        self.log: List[Tuple[int, str, str]] = []
+        self._orig: Dict[str, Callable] = {}
+        self._orig_send: Optional[Callable] = None
+        #: instance id -> monotonic deadline before which describe_instances
+        #: pretends the instance does not exist (eventual consistency)
+        self._lagged: Dict[str, float] = {}
+        #: instances a ``partial`` fault erased from a fleet result
+        self.dropped_instances: List[str] = []
+        #: SQS messages the ``dup`` fault re-delivered
+        self.dup_sends = 0
+
+    # ------------------------------------------------------------------
+    def _draw(self, op: str) -> Optional[str]:
+        with self._mu:
+            idx = self._calls
+            self._calls += 1
+            fault = self.plan.next(idx, op)
+            self.log.append((idx, op, fault or "ok"))
+            return fault
+
+    def fault_counts(self) -> Dict[str, int]:
+        """Injected-fault histogram (diagnostics for sweep failures)."""
+        out: Dict[str, int] = {}
+        with self._mu:
+            for _idx, _op, fault in self.log:
+                out[fault] = out.get(fault, 0) + 1
+        return out
+
+    # ------------------------------------------------------------------
+    def _wrap_ec2(self, op: str, real: Callable) -> Callable:
+        def call(*args, **kwargs):
+            fault = self._draw(op)
+            if fault == "throttle":
+                raise AWSError("RequestLimitExceeded",
+                               "injected: request rate exceeded", status=503)
+            if fault == "down":
+                raise ConnectionError("injected: cloud endpoint unreachable")
+            if fault == "wedge":
+                self._sleep(self.plan.wedge_ms / 1e3)
+            out = real(*args, **kwargs)
+            if op == "create_fleet":
+                instances, errors = out
+                if fault == "partial" and instances:
+                    # the fleet under-delivered: the tail instance never
+                    # launched anywhere — erase it from the store too so
+                    # the caller's deficit is the only trace
+                    lost = instances.pop()
+                    self.ec2.instances.pop(lost.id, None)
+                    self.dropped_instances.append(lost.id)
+                if fault == "lag" and instances:
+                    deadline = self._clock() + self.plan.lag_s
+                    with self._mu:
+                        for inst in instances:
+                            self._lagged[inst.id] = deadline
+                return instances, errors
+            if op == "describe_instances":
+                return self._filter_lagged(out)
+            return out
+        return call
+
+    def _filter_lagged(self, instances):
+        now = self._clock()
+        with self._mu:
+            for iid in [i for i, t in self._lagged.items() if t <= now]:
+                del self._lagged[iid]
+            if not self._lagged:
+                return instances
+            hidden = set(self._lagged)
+        return [i for i in instances if i.id not in hidden]
+
+    def _wrap_sqs_send(self, real: Callable) -> Callable:
+        def send(message):
+            fault = self._draw("sqs.send")
+            real(message)
+            if fault == "dup":
+                # at-least-once redelivery: the same logical event lands
+                # twice (fresh receipt — real SQS redeliveries do too);
+                # the interruption dedupe must collapse it
+                import copy
+                with self._mu:
+                    self.dup_sends += 1
+                real(copy.copy(message))
+        return send
+
+    # ------------------------------------------------------------------
+    def install(self) -> "CloudFaultInjector":
+        assert not self._orig, "already installed"
+        for op in EC2_FAULT_OPS:
+            real = getattr(self.ec2, op)
+            self._orig[op] = real
+            setattr(self.ec2, op, self._wrap_ec2(op, real))
+        if self.sqs is not None:
+            self._orig_send = self.sqs.send
+            self.sqs.send = self._wrap_sqs_send(self._orig_send)
+        return self
+
+    def uninstall(self) -> None:
+        for op, real in self._orig.items():
+            setattr(self.ec2, op, real)
+        self._orig = {}
+        if self._orig_send is not None:
+            self.sqs.send = self._orig_send
+            self._orig_send = None
+        with self._mu:
+            self._lagged.clear()
+
+    def __enter__(self) -> "CloudFaultInjector":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()

@@ -224,6 +224,10 @@ class FileLease:
         self._hb: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self.metrics = metrics
+        #: callbacks fired (from the heartbeat thread) when a HELD lease
+        #: is observed lost — losing leadership must pause the holder's
+        #: controllers, not just flip a flag (split-brain guard)
+        self.on_lost: List[Callable[[], None]] = []
 
     def _set_master(self, held: bool) -> None:
         self._held = held
@@ -297,8 +301,18 @@ class FileLease:
             if cur is not None and cur.get("holder") == self.identity:
                 self._write()
             else:
-                # lost the lease; stop acting as leader
+                # lost the lease: demote FIRST (listeners observe
+                # held=False), notify, and exit this heartbeat — a
+                # re-acquire starts a fresh one, so a stale thread can
+                # never renew a lease another replica now owns
                 self._set_master(False)
+                for cb in list(self.on_lost):
+                    try:
+                        cb()
+                    except Exception:  # noqa: BLE001 - a listener crash
+                        # must not kill the demotion path
+                        log.exception("lease on_lost callback failed")
+                return
 
     def release(self) -> None:
         self._stop.set()

@@ -129,17 +129,28 @@ class Operator:
         self.metrics = Metrics()
         self.recorder = Recorder(clock=clock)
 
+        # cloud-API resilience seam (providers/awsretry.py): AWS-style
+        # error classification + bounded full-jitter retries + adaptive
+        # client-side rate limiting, wrapped around every EC2/SSM/EKS
+        # call site below (aws-sdk-go-v2 standard+adaptive retryer
+        # analog). The preflight above deliberately ran RAW: fail-fast
+        # on a dead seam must not be retried into a slow boot.
+        from .providers.awsretry import CloudRetryPolicy, ResilientCloud
+        self.cloud_retry = CloudRetryPolicy(metrics=self.metrics)
+        self.cloud = ResilientCloud(self.ec2, self.cloud_retry)
+        self.cloud_retry.emit_state()
+
         # providers (operator.go:139-186)
         self.unavailable_offerings = UnavailableOfferings()
         self.instance_types = InstanceTypeProvider(
             vm_memory_overhead_percent=self.options.vm_memory_overhead_percent,
             unavailable_offerings=self.unavailable_offerings,
             reserved_enis=self.options.reserved_enis)
-        self.pricing = PricingProvider(self.ec2)
-        self.subnets = SubnetProvider(self.ec2)
-        self.security_groups = SecurityGroupProvider(self.ec2)
-        self.ssm = SSMProvider(self.ec2)
-        self.amis = AMIProvider(self.ec2, ssm=self.ssm)
+        self.pricing = PricingProvider(self.cloud)
+        self.subnets = SubnetProvider(self.cloud)
+        self.security_groups = SecurityGroupProvider(self.cloud)
+        self.ssm = SSMProvider(self.cloud)
+        self.amis = AMIProvider(self.cloud, ssm=self.ssm)
         self.iam = FakeIAM()
         self.instance_profiles = InstanceProfileProvider(
             self.options.cluster_name, region=self.region, iam=self.iam)
@@ -155,13 +166,13 @@ class Operator:
         self.kube_dns_ip = (
             str(ipaddress.ip_network(svc_cidr)[10]) if svc_cidr else "")
         self.launch_templates = LaunchTemplateProvider(
-            self.ec2, self.amis, self.security_groups,
+            self.cloud, self.amis, self.security_groups,
             cluster_name=self.options.cluster_name,
             cluster_endpoint=self.options.cluster_endpoint,
             ca_bundle=self.options.cluster_ca_bundle,
             kube_dns_ip=self.kube_dns_ip)
         self.instances = InstanceProvider(
-            self.ec2, self.subnets, self.launch_templates,
+            self.cloud, self.subnets, self.launch_templates,
             self.unavailable_offerings,
             cluster_name=self.options.cluster_name, metrics=self.metrics)
 
@@ -190,7 +201,8 @@ class Operator:
         self.lifecycle = NodeClaimLifecycle(self.kube, self.cloudprovider,
                                             self.instance_types, clock=clock,
                                             recorder=self.recorder,
-                                            metrics=self.metrics)
+                                            metrics=self.metrics,
+                                            state=self.state)
         self.terminator = Terminator(self.kube, self.cloudprovider,
                                      clock=clock, metrics=self.metrics)
         self.node_repair = NodeRepairController(
@@ -207,9 +219,9 @@ class Operator:
         self.interruption = InterruptionController(
             self.kube, self.sqs, self.unavailable_offerings,
             metrics=self.metrics, clock=clock, recorder=self.recorder,
-            ec2=self.ec2)
+            ec2=self.cloud)
         self.catalog_controller = CatalogController(
-            self.ec2, self.instance_types, metrics=self.metrics,
+            self.cloud, self.instance_types, metrics=self.metrics,
             unavailable_offerings=self.unavailable_offerings,
             pricing=self.pricing)
         self.pricing_controller = PricingController(self.pricing)
@@ -217,9 +229,9 @@ class Operator:
         self.discovered_capacity = DiscoveredCapacityController(
             self.kube, self.instance_types)
         self.ssm_invalidation = SSMInvalidationController(
-            self.ec2, self.amis, ssm=self.ssm, clock=clock)
+            self.cloud, self.amis, ssm=self.ssm, clock=clock)
         self.version_controller = VersionController(
-            self.version, source=self.ec2.eks_describe_cluster_version,
+            self.version, source=self.cloud.eks_describe_cluster_version,
             clock=clock)
         self.disruption = DisruptionController(
             self.kube, self.state, self.cloudprovider, self.solver,

@@ -1,0 +1,384 @@
+"""AWS-style retry classification + adaptive client-side rate control
+for the cloud seam — the provider-half sibling of sidecar/resilience.py.
+
+The reference guards every SDK call with aws-sdk-go-v2's retryer
+(``retry.NewStandard`` wrapped by the operator's config, operator.go:110)
+and classifies provider errors through ``awserrors``: throttling
+(``RequestLimitExceeded`` et al) and transient transport/5xx failures
+are retried with exponential backoff + jitter under a client-side token
+bucket; ICE (``InsufficientInstanceCapacity``) is NEVER retried — it is
+a capacity signal that feeds ``UnavailableOfferings``; NotFound is an
+eventual-consistency signal the *controllers* interpret (a NodeClaim's
+instance invisible right after CreateFleet is "not yet converged", not
+gone); validation/auth rejections are terminal.
+
+Three composable pieces:
+
+- :func:`classify` — the error taxonomy. Works on :class:`AWSError`
+  (coded), on the fake cloud's native errors (``ConnectionError`` from a
+  DOWN link, ``KeyError("InvalidInstanceID.NotFound: ...")``), and on
+  anything carrying an AWS-shaped code string.
+- :class:`RetryQuota` + :class:`AdaptiveRateLimiter` — the two AWS
+  client-side token buckets. The quota is the standard retryer's retry
+  bucket (retries cost tokens, successes slowly refund them — sustained
+  failure sheds *retries*, first attempts always pass). The limiter is
+  the adaptive mode's send-rate bucket (multiplicative-decrease on
+  throttle, additive recovery — sustained throttling sheds *request
+  rate*).
+- :class:`CloudRetryPolicy` — bounded exponential backoff with FULL
+  jitter over retryable classes only, consulting both buckets, with
+  injectable ``rng`` / ``sleep`` / ``clock`` so chaos tests are seeded
+  and fast. :class:`ResilientCloud` wraps a cloud handle so every
+  EC2/SSM/EKS/pricing call site in providers/ and batcher/ rides the
+  policy without per-site plumbing.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Callable, Optional
+
+#: classification classes (the label values of the
+#: karpenter_cloud_retry_* series)
+THROTTLE = "throttle"
+TRANSIENT = "transient"
+ICE = "ice"
+NOT_FOUND = "not-found"
+TERMINAL = "terminal"
+
+#: the awserrors.IsThrottle / aws-sdk-go-v2 retry.ThrottleErrorCodes set
+THROTTLE_CODES = frozenset({
+    "RequestLimitExceeded", "Throttling", "ThrottlingException",
+    "ThrottledException", "RequestThrottled", "RequestThrottledException",
+    "TooManyRequestsException", "ProvisionedThroughputExceededException",
+    "TransactionInProgressException", "EC2ThrottledException", "SlowDown",
+    "PriorRequestNotComplete", "BandwidthLimitExceeded", "LimitExceededException",
+})
+
+#: transient service-side codes (retry.DefaultRetryableErrorCodes)
+TRANSIENT_CODES = frozenset({
+    "RequestTimeout", "RequestTimeoutException", "InternalError",
+    "InternalFailure", "ServiceUnavailable", "TransientError",
+})
+
+#: ICE-class codes (awserrors.go isUnfulfillableCapacity): capacity
+#: signals, never retried — they feed UnavailableOfferings
+ICE_CODES = frozenset({
+    "InsufficientInstanceCapacity", "MaxSpotInstanceCountExceeded",
+    "VcpuLimitExceeded", "UnfulfillableCapacity", "Unsupported",
+    "InsufficientFreeAddressesInSubnet",
+})
+
+
+class AWSError(Exception):
+    """A coded AWS API error (the smithy APIError shape: code + message
+    + HTTP status). The fault-injection harness raises these; real
+    adapters would translate botocore ClientErrors into them."""
+
+    def __init__(self, code: str, message: str = "", status: int = 0):
+        self.code = code
+        self.status = status
+        super().__init__(f"{code}: {message}" if message else code)
+
+
+def error_code(exc: BaseException) -> str:
+    """Best-effort AWS error code of ``exc``. Coded errors carry it;
+    the fake cloud's native errors embed it as the leading
+    ``Code: detail`` token (``KeyError("ParameterNotFound: /aws/...")``,
+    ``KeyError("InvalidInstanceID.NotFound: i-...")``)."""
+    code = getattr(exc, "code", "")
+    if isinstance(code, str) and code:
+        return code
+    msg = str(exc)
+    if isinstance(exc, KeyError):
+        msg = msg.strip("'\"")
+    head = msg.split(":", 1)[0].strip()
+    if head and " " not in head and head[:1].isalpha():
+        return head
+    return ""
+
+
+def classify(exc: BaseException) -> str:
+    """The AWS error taxonomy: throttle | transient | ice | not-found |
+    terminal. Only throttle and transient are retryable; ICE feeds
+    UnavailableOfferings (never retried); not-found is an
+    eventual-consistency signal for the controllers; everything else
+    (validation, auth) is terminal — the service answered, retrying
+    cannot change its mind."""
+    code = error_code(exc)
+    status = getattr(exc, "status", 0) or 0
+    if code in THROTTLE_CODES or status == 429:
+        return THROTTLE
+    if code in ICE_CODES:
+        return ICE
+    if code.endswith(".NotFound") or code.endswith(".NotFoundException") \
+            or code in ("ParameterNotFound", "ResourceNotFoundException"):
+        return NOT_FOUND
+    if code in TRANSIENT_CODES or 500 <= status < 600:
+        return TRANSIENT
+    if isinstance(exc, (ConnectionError, TimeoutError)):
+        return TRANSIENT
+    return TERMINAL
+
+
+def is_retryable(cls: str) -> bool:
+    return cls in (THROTTLE, TRANSIENT)
+
+
+class RetryQuota:
+    """The standard retryer's client-side retry token bucket
+    (aws-sdk-go-v2 retry/standard.go): a retry costs ``retry_cost``
+    tokens (``timeout_retry_cost`` for timeout-ish failures), a
+    successful call refunds ``refund``. When the bucket runs dry no
+    retries are attempted (first attempts always pass) — sustained
+    failure degrades to fail-fast instead of amplifying the storm."""
+
+    def __init__(self, capacity: float = 500.0, retry_cost: float = 5.0,
+                 timeout_retry_cost: float = 10.0, refund: float = 1.0):
+        self.capacity = capacity
+        self.retry_cost = retry_cost
+        self.timeout_retry_cost = timeout_retry_cost
+        self.refund = refund
+        self._mu = threading.Lock()
+        self._tokens = capacity
+
+    @property
+    def tokens(self) -> float:
+        with self._mu:
+            return self._tokens
+
+    def try_spend(self, timeout: bool = False) -> bool:
+        """Take the cost of one retry; False = bucket dry, do not retry."""
+        cost = self.timeout_retry_cost if timeout else self.retry_cost
+        with self._mu:
+            if self._tokens < cost:
+                return False
+            self._tokens -= cost
+            return True
+
+    def on_success(self) -> None:
+        with self._mu:
+            self._tokens = min(self.capacity, self._tokens + self.refund)
+
+
+class AdaptiveRateLimiter:
+    """The adaptive retry mode's send-rate token bucket: a throttled
+    response multiplicatively cuts the client's send rate; successes
+    recover it additively (AIMD). ``acquire`` returns the delay the
+    caller should sleep before sending — bounded by ``max_delay_s`` so
+    shedding never wedges a reconcile.
+
+    Like the SDK's adaptive mode, the limiter is DORMANT until the
+    first throttle response arms it — an API that has never throttled
+    us is never slowed down (a 2000-message interruption drain must run
+    at full tilt). Additive recovery back to ``max_rate`` disarms it
+    again, so a past storm stops taxing a healed seam."""
+
+    def __init__(self, rate: float = 50.0, burst: float = 20.0,
+                 min_rate: float = 1.0, max_rate: float = 200.0,
+                 increase: float = 1.0, decrease: float = 0.5,
+                 max_delay_s: float = 1.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self.min_rate = min_rate
+        self.max_rate = max_rate
+        self.increase = increase
+        self.decrease = decrease
+        self.burst = burst
+        self.max_delay_s = max_delay_s
+        self._clock = clock
+        self._mu = threading.Lock()
+        self._rate = rate
+        self._tokens = burst
+        self._last = clock()
+        self._engaged = False
+
+    @property
+    def rate(self) -> float:
+        with self._mu:
+            return self._rate
+
+    @property
+    def engaged(self) -> bool:
+        with self._mu:
+            return self._engaged
+
+    def _refill_locked(self, now: float) -> None:
+        self._tokens = min(self.burst,
+                           self._tokens + (now - self._last) * self._rate)
+        self._last = now
+
+    def acquire(self) -> float:
+        """Take one send token; returns seconds to sleep (0 when the
+        limiter is dormant or the bucket has headroom)."""
+        with self._mu:
+            if not self._engaged:
+                return 0.0
+            now = self._clock()
+            self._refill_locked(now)
+            self._tokens -= 1.0
+            if self._tokens >= 0.0:
+                return 0.0
+            return min(self.max_delay_s, -self._tokens / self._rate)
+
+    def on_throttle(self) -> None:
+        with self._mu:
+            if not self._engaged:
+                # arm with a full burst so the very next sends are not
+                # charged for time that passed while dormant
+                self._engaged = True
+                self._tokens = self.burst
+                self._last = self._clock()
+            self._rate = max(self.min_rate, self._rate * self.decrease)
+
+    def on_success(self) -> None:
+        with self._mu:
+            if not self._engaged:
+                return
+            self._rate = min(self.max_rate, self._rate + self.increase)
+            if self._rate >= self.max_rate:
+                self._engaged = False  # fully recovered: stop limiting
+
+
+class CloudRetryPolicy:
+    """Bounded exponential backoff with full jitter over the retryable
+    classes, under both client-side buckets. One policy instance guards
+    a whole cloud handle (see :class:`ResilientCloud`) and is safe to
+    share across batcher/GC/interruption worker threads."""
+
+    def __init__(self, max_attempts: int = 4,
+                 backoff_base_s: float = 0.02,
+                 backoff_cap_s: float = 1.0,
+                 throttle_backoff_base_s: float = 0.1,
+                 rng: Optional[random.Random] = None,
+                 sleep: Callable[[float], None] = time.sleep,
+                 quota: Optional[RetryQuota] = None,
+                 limiter: Optional[AdaptiveRateLimiter] = None,
+                 service: str = "EC2", metrics=None):
+        assert max_attempts >= 1
+        self.max_attempts = max_attempts
+        self.backoff_base_s = backoff_base_s
+        self.backoff_cap_s = backoff_cap_s
+        self.throttle_backoff_base_s = throttle_backoff_base_s
+        self.rng = rng or random.Random()
+        self._rng_mu = threading.Lock()
+        self.sleep = sleep
+        self.quota = quota or RetryQuota()
+        self.limiter = limiter or AdaptiveRateLimiter()
+        self.service = service
+        self.metrics = metrics
+
+    # -- observability --------------------------------------------------
+    def emit_state(self) -> None:
+        """Seed/refresh the bucket gauges so a scrape before the first
+        fault still sees the series."""
+        m = self.metrics
+        if m is not None:
+            lab = {"service": self.service}
+            m.set_gauge("karpenter_cloud_retry_token_bucket_tokens",
+                        self.quota.tokens, labels=lab)
+            m.set_gauge("karpenter_cloud_retry_send_rate",
+                        self.limiter.rate, labels=lab)
+
+    def backoff_s(self, attempt: int, cls: str) -> float:
+        """Full jitter: uniform in [0, min(cap, base * 2^attempt)];
+        throttling uses a larger base (the SDK's throttle backoff)."""
+        base = self.throttle_backoff_base_s if cls == THROTTLE \
+            else self.backoff_base_s
+        cap = min(self.backoff_cap_s, base * (2.0 ** attempt))
+        with self._rng_mu:
+            return self.rng.uniform(0.0, cap)
+
+    # -- the guarded call ----------------------------------------------
+    def call(self, fn: Callable, *args, operation: str = "", **kwargs):
+        """Run ``fn(*args, **kwargs)`` under the policy. Retries only
+        throttle/transient; ICE, not-found, and terminal errors re-raise
+        immediately (their meaning belongs to the caller)."""
+        m = self.metrics
+        lab = {"service": self.service, "operation": operation}
+        delay = self.limiter.acquire()
+        if delay > 0.0:
+            self.sleep(delay)
+        last: Optional[BaseException] = None
+        for attempt in range(self.max_attempts):
+            try:
+                out = fn(*args, **kwargs)
+            except Exception as e:  # noqa: BLE001 - classified below
+                cls = classify(e)
+                if m is not None:
+                    m.inc("karpenter_cloud_retry_errors_total",
+                          labels={**lab, "class": cls})
+                if cls == THROTTLE:
+                    self.limiter.on_throttle()
+                    if m is not None:
+                        m.inc("karpenter_cloud_retry_throttle_events_total",
+                              labels={"service": self.service})
+                if not is_retryable(cls):
+                    raise
+                last = e
+                if attempt + 1 >= self.max_attempts:
+                    break
+                if not self.quota.try_spend(
+                        timeout=isinstance(e, TimeoutError)):
+                    # retry bucket dry: shed the retry, fail fast — the
+                    # adaptive degradation under sustained failure
+                    break
+                if m is not None:
+                    m.inc("karpenter_cloud_retry_attempts_total",
+                          labels={**lab, "class": cls})
+                    m.inc("aws_sdk_go_request_retry_count", labels=lab)
+                self.sleep(self.backoff_s(attempt, cls))
+            else:
+                self.quota.on_success()
+                self.limiter.on_success()
+                if m is not None:
+                    self.emit_state()
+                return out
+        if m is not None:
+            m.inc("karpenter_cloud_retry_exhausted_total", labels=lab)
+            self.emit_state()
+        raise last
+
+
+#: cloud-handle methods the proxy guards — every EC2/SSM/EKS/pricing
+#: operation a provider or batcher calls (the boot-preflight seams
+#: imds_region / dry_run_describe_instance_types stay raw on purpose:
+#: preflight owns its own deadline semantics and must fail FAST)
+GUARDED_OPS = (
+    "describe_instance_types", "describe_instance_type_offerings",
+    "describe_spot_price_history", "on_demand_prices",
+    "describe_subnets", "describe_security_groups", "describe_images",
+    "create_launch_template", "describe_launch_templates",
+    "delete_launch_templates", "create_fleet", "describe_instances",
+    "terminate_instances", "create_tags", "ssm_get_parameter",
+    "eks_describe_cluster_version",
+)
+
+
+class ResilientCloud:
+    """Proxy over a cloud handle: every :data:`GUARDED_OPS` call runs
+    through the :class:`CloudRetryPolicy`; everything else (stores,
+    call logs, behavior-injection knobs) passes straight through, so
+    tests keep poking the raw fake while the control plane's call sites
+    all ride the policy. Method lookup happens per call — wrappers
+    installed later on the inner handle (telemetry instrumentation,
+    fault injectors) stay in the path."""
+
+    def __init__(self, inner, policy: Optional[CloudRetryPolicy] = None):
+        object.__setattr__(self, "inner", inner)
+        object.__setattr__(self, "policy", policy or CloudRetryPolicy())
+
+    def __getattr__(self, name):
+        if name in GUARDED_OPS:
+            policy = self.policy
+            inner = self.inner
+
+            def guarded(*args, _name=name, **kwargs):
+                return policy.call(getattr(inner, _name), *args,
+                                   operation=_name, **kwargs)
+            return guarded
+        return getattr(self.inner, name)
+
+    def __setattr__(self, name, value):
+        setattr(self.inner, name, value)

@@ -55,6 +55,15 @@ class ClusterState:
         with self._mu:
             self._nominations.pop(pod_full_name, None)
 
+    def clear_nominations_to(self, node_name: str) -> None:
+        """Release every pod nominated toward ``node_name`` — called when
+        the target claim dies before joining (failed launch), so its pods
+        reappear in pending_pods() immediately instead of after TTL."""
+        with self._mu:
+            self._nominations = {
+                pod: nom for pod, nom in self._nominations.items()
+                if nom.node_name != node_name}
+
     def nomination_targets(self) -> Set[str]:
         """Node/claim names with pods in flight toward them — such nodes are
         off-limits to disruption (core's nominated-node protection)."""

@@ -1,0 +1,293 @@
+"""providers/awsretry: classification taxonomy, the two client-side
+buckets, the jittered retry policy, and the ResilientCloud proxy."""
+
+import random
+
+import pytest
+
+from karpenter_provider_aws_tpu.providers.awsretry import (
+    ICE,
+    NOT_FOUND,
+    TERMINAL,
+    THROTTLE,
+    TRANSIENT,
+    AdaptiveRateLimiter,
+    AWSError,
+    CloudRetryPolicy,
+    GUARDED_OPS,
+    ResilientCloud,
+    RetryQuota,
+    classify,
+    error_code,
+    is_retryable)
+from karpenter_provider_aws_tpu.utils.metrics import Metrics
+
+
+class TestClassify:
+    @pytest.mark.parametrize("exc,expected", [
+        (AWSError("RequestLimitExceeded"), THROTTLE),
+        (AWSError("ThrottlingException"), THROTTLE),
+        (AWSError("EC2ThrottledException"), THROTTLE),
+        (AWSError("SomethingOdd", status=429), THROTTLE),
+        (AWSError("InsufficientInstanceCapacity"), ICE),
+        (AWSError("MaxSpotInstanceCountExceeded"), ICE),
+        (AWSError("VcpuLimitExceeded"), ICE),
+        (AWSError("UnfulfillableCapacity"), ICE),
+        (AWSError("InvalidInstanceID.NotFound"), NOT_FOUND),
+        (AWSError("InvalidLaunchTemplateName.NotFoundException"), NOT_FOUND),
+        (AWSError("ParameterNotFound"), NOT_FOUND),
+        (AWSError("ResourceNotFoundException"), NOT_FOUND),
+        (AWSError("InternalError"), TRANSIENT),
+        (AWSError("ServiceUnavailable"), TRANSIENT),
+        (AWSError("RequestTimeout"), TRANSIENT),
+        (AWSError("SomethingOdd", status=503), TRANSIENT),
+        (ConnectionError("link down"), TRANSIENT),
+        (TimeoutError("deadline"), TRANSIENT),
+        (AWSError("ValidationError"), TERMINAL),
+        (AWSError("UnauthorizedOperation"), TERMINAL),
+        (RuntimeError("boom"), TERMINAL),
+    ])
+    def test_taxonomy(self, exc, expected):
+        assert classify(exc) == expected
+
+    def test_fake_native_errors(self):
+        """The fake cloud's native error shapes classify without AWSError
+        wrapping — the proxy sees them as-is."""
+        assert classify(KeyError("ParameterNotFound: /aws/x")) == NOT_FOUND
+        assert classify(
+            KeyError("InvalidInstanceID.NotFound: i-123")) == NOT_FOUND
+        assert classify(KeyError("no such thing at all")) == TERMINAL
+
+    def test_only_throttle_and_transient_retry(self):
+        assert is_retryable(THROTTLE) and is_retryable(TRANSIENT)
+        assert not any(map(is_retryable, (ICE, NOT_FOUND, TERMINAL)))
+
+    def test_error_code_parsing(self):
+        assert error_code(AWSError("Throttling", "x")) == "Throttling"
+        assert error_code(KeyError("ParameterNotFound: /p")) == \
+            "ParameterNotFound"
+        assert error_code(ValueError("bad value somewhere")) == ""
+        assert error_code(ValueError("404: not a code")) == ""
+
+
+class TestRetryQuota:
+    def test_dry_bucket_sheds_retries(self):
+        q = RetryQuota(capacity=10.0, retry_cost=5.0)
+        assert q.try_spend() and q.try_spend()
+        assert not q.try_spend()  # dry: fail fast
+        q.on_success()
+        assert q.tokens == 1.0
+
+    def test_timeout_retries_cost_more(self):
+        q = RetryQuota(capacity=10.0, retry_cost=5.0, timeout_retry_cost=10.0)
+        assert q.try_spend(timeout=True)
+        assert not q.try_spend()
+
+    def test_refund_caps_at_capacity(self):
+        q = RetryQuota(capacity=5.0)
+        for _ in range(50):
+            q.on_success()
+        assert q.tokens == 5.0
+
+
+class TestAdaptiveRateLimiter:
+    def test_aimd(self):
+        lim = AdaptiveRateLimiter(rate=40.0, min_rate=1.0, max_rate=50.0)
+        lim.on_throttle()
+        assert lim.rate == 20.0
+        lim.on_throttle()
+        assert lim.rate == 10.0
+        for _ in range(100):
+            lim.on_success()
+        assert lim.rate == 50.0  # additive recovery, capped
+        for _ in range(100):
+            lim.on_throttle()
+        assert lim.rate == 1.0  # floored
+
+    def test_acquire_sheds_bounded_delay(self):
+        t = [0.0]
+        lim = AdaptiveRateLimiter(rate=20.0, burst=2.0, max_delay_s=1.0,
+                                  clock=lambda: t[0])
+        lim.on_throttle()  # the first throttle arms the limiter
+        assert lim.engaged and lim.rate == 10.0
+        assert lim.acquire() == 0.0
+        assert lim.acquire() == 0.0  # the armed burst
+        d = lim.acquire()  # bucket empty: delay, never a wedge
+        assert 0.0 < d <= 1.0
+        for _ in range(100):
+            assert lim.acquire() <= 1.0
+
+    def test_dormant_until_throttled_disarms_on_recovery(self):
+        # an API that never throttles us is never slowed down
+        lim = AdaptiveRateLimiter(rate=4.0, burst=1.0, max_rate=6.0)
+        for _ in range(50):
+            assert lim.acquire() == 0.0
+        lim.on_throttle()
+        assert lim.engaged
+        for _ in range(10):
+            lim.on_success()
+        assert not lim.engaged  # additive recovery hit max_rate
+        for _ in range(50):
+            assert lim.acquire() == 0.0
+
+
+def make_policy(**kw):
+    sleeps = []
+    kw.setdefault("rng", random.Random(7))
+    kw.setdefault("sleep", sleeps.append)
+    return CloudRetryPolicy(**kw), sleeps
+
+
+class _Flaky:
+    """Fails with the scripted exceptions, then returns 'ok'."""
+
+    def __init__(self, *failures):
+        self.failures = list(failures)
+        self.calls = 0
+
+    def __call__(self, *a, **kw):
+        self.calls += 1
+        if self.failures:
+            raise self.failures.pop(0)
+        return "ok"
+
+
+class TestCloudRetryPolicy:
+    def test_transient_retried_to_success(self):
+        policy, sleeps = make_policy(metrics=Metrics())
+        fn = _Flaky(ConnectionError("x"), AWSError("InternalError"))
+        assert policy.call(fn, operation="describe_instances") == "ok"
+        assert fn.calls == 3
+        assert all(0.0 <= s <= policy.backoff_cap_s for s in sleeps)
+        m = policy.metrics
+        assert m.counter("karpenter_cloud_retry_attempts_total",
+                         labels={"service": "EC2",
+                                 "operation": "describe_instances",
+                                 "class": TRANSIENT}) == 2
+        assert m.counter("aws_sdk_go_request_retry_count",
+                         labels={"service": "EC2",
+                                 "operation": "describe_instances"}) == 2
+
+    def test_throttle_cuts_send_rate(self):
+        policy, _ = make_policy(metrics=Metrics())
+        r0 = policy.limiter.rate
+        fn = _Flaky(AWSError("RequestLimitExceeded", status=503))
+        assert policy.call(fn, operation="create_fleet") == "ok"
+        # MD on the throttle, +increase on the final success
+        assert policy.limiter.rate == r0 * 0.5 + policy.limiter.increase
+        assert policy.metrics.counter(
+            "karpenter_cloud_retry_throttle_events_total",
+            labels={"service": "EC2"}) == 1
+
+    def test_exhaustion_raises_last_error(self):
+        policy, sleeps = make_policy(max_attempts=3, metrics=Metrics())
+        errs = [ConnectionError(f"e{i}") for i in range(5)]
+        fn = _Flaky(*errs)
+        with pytest.raises(ConnectionError) as ei:
+            policy.call(fn, operation="describe_instances")
+        assert fn.calls == 3
+        assert str(ei.value) == "e2"  # the LAST attempt's error
+        assert policy.metrics.counter(
+            "karpenter_cloud_retry_exhausted_total",
+            labels={"service": "EC2",
+                    "operation": "describe_instances"}) == 1
+
+    def test_ice_never_retried(self):
+        """The load-bearing invariant: ICE is a capacity signal for
+        UnavailableOfferings, not a transport hiccup."""
+        policy, _ = make_policy()
+        fn = _Flaky(AWSError("InsufficientInstanceCapacity"))
+        with pytest.raises(AWSError):
+            policy.call(fn, operation="create_fleet")
+        assert fn.calls == 1
+
+    def test_not_found_and_terminal_reraise_immediately(self):
+        for exc in (KeyError("InvalidInstanceID.NotFound: i-1"),
+                    AWSError("ValidationError"), RuntimeError("boom")):
+            policy, _ = make_policy()
+            fn = _Flaky(exc)
+            with pytest.raises(type(exc)):
+                policy.call(fn, operation="x")
+            assert fn.calls == 1
+
+    def test_dry_quota_sheds_retry(self):
+        policy, _ = make_policy(
+            quota=RetryQuota(capacity=5.0, retry_cost=5.0))
+        fn = _Flaky(ConnectionError("a"), ConnectionError("b"))
+        with pytest.raises(ConnectionError) as ei:
+            policy.call(fn, operation="x")
+        # one retry drained the bucket; the second was shed -> fail fast
+        assert fn.calls == 2
+        assert str(ei.value) == "b"
+
+    def test_backoff_full_jitter_seeded(self):
+        a, _ = make_policy(rng=random.Random(3))
+        b, _ = make_policy(rng=random.Random(3))
+        seq_a = [a.backoff_s(i, TRANSIENT) for i in range(4)]
+        seq_b = [b.backoff_s(i, TRANSIENT) for i in range(4)]
+        assert seq_a == seq_b  # seeded => reproducible
+        for i, s in enumerate(seq_a):
+            assert 0.0 <= s <= min(a.backoff_cap_s,
+                                   a.backoff_base_s * 2 ** i)
+        # throttling backs off from a larger base
+        assert a.throttle_backoff_base_s > a.backoff_base_s
+
+
+class _StubCloud:
+    def __init__(self):
+        self.describe_calls = 0
+        self.fail_first = 0
+        self.knob = "raw"
+
+    def describe_instances(self, *a, **kw):
+        self.describe_calls += 1
+        if self.fail_first > 0:
+            self.fail_first -= 1
+            raise AWSError("RequestLimitExceeded", status=503)
+        return ["inst"]
+
+    def imds_region(self):
+        raise ConnectionError("preflight must see this raw")
+
+
+class TestResilientCloud:
+    def test_guarded_op_retries(self):
+        inner = _StubCloud()
+        inner.fail_first = 2
+        cloud = ResilientCloud(inner, CloudRetryPolicy(
+            rng=random.Random(1), sleep=lambda _s: None))
+        assert cloud.describe_instances() == ["inst"]
+        assert inner.describe_calls == 3
+
+    def test_unguarded_passthrough(self):
+        cloud = ResilientCloud(_StubCloud(), CloudRetryPolicy(
+            sleep=lambda _s: None))
+        assert "imds_region" not in GUARDED_OPS
+        with pytest.raises(ConnectionError):
+            cloud.imds_region()  # preflight seam stays raw: fails FAST
+
+    def test_setattr_forwards_to_inner(self):
+        inner = _StubCloud()
+        cloud = ResilientCloud(inner, CloudRetryPolicy(
+            sleep=lambda _s: None))
+        cloud.knob = "tweaked"
+        assert inner.knob == "tweaked"
+
+    def test_late_wrappers_stay_in_path(self):
+        """Per-call method lookup: a fault injector installed on the
+        inner handle AFTER the proxy was built is still exercised."""
+        inner = _StubCloud()
+        cloud = ResilientCloud(inner, CloudRetryPolicy(
+            rng=random.Random(1), sleep=lambda _s: None))
+        assert cloud.describe_instances() == ["inst"]
+        real = inner.describe_instances
+        flips = {"n": 0}
+
+        def wrapped(*a, **kw):
+            if flips["n"] == 0:
+                flips["n"] += 1
+                raise ConnectionError("injected after proxy construction")
+            return real(*a, **kw)
+        inner.describe_instances = wrapped
+        assert cloud.describe_instances() == ["inst"]
+        assert flips["n"] == 1  # the injected fault rode the policy

@@ -1,0 +1,176 @@
+"""batcher/core: window mechanics, failure fan-out, and shutdown
+draining — a failing or stopping batch must never strand a caller on
+the add_sync timeout backstop (batcher.go:32-100 analog)."""
+
+import threading
+import time
+
+import pytest
+
+from karpenter_provider_aws_tpu.batcher.core import (
+    Batcher,
+    CreateFleetBatcher,
+    to_hashable)
+
+
+def make_batcher(exec_fn, **kw):
+    kw.setdefault("idle_timeout", 0.01)
+    kw.setdefault("max_timeout", 0.2)
+    return Batcher(exec_fn, **kw)
+
+
+class TestWindowMechanics:
+    def test_window_merges_and_fans_back_in_order(self):
+        batches = []
+
+        def run(reqs):
+            batches.append(list(reqs))
+            return [r * 10 for r in reqs]
+
+        b = make_batcher(run)
+        try:
+            futs = [b.add(i) for i in range(5)]
+            assert [f.result(timeout=2) for f in futs] == \
+                [0, 10, 20, 30, 40]
+            assert len(batches) == 1  # one window, one exec
+        finally:
+            b.stop()
+
+    def test_max_items_flushes_immediately(self):
+        b = make_batcher(lambda reqs: list(reqs), idle_timeout=10.0,
+                         max_timeout=10.0, max_items=3)
+        try:
+            futs = [b.add(i) for i in range(3)]
+            # flushed by count, not by either timeout
+            assert [f.result(timeout=2) for f in futs] == [0, 1, 2]
+        finally:
+            b.stop()
+
+    def test_hash_fn_separates_buckets(self):
+        batches = []
+
+        def run(reqs):
+            batches.append(sorted(reqs))
+            return list(reqs)
+
+        b = make_batcher(run, hash_fn=lambda r: r % 2)
+        try:
+            futs = [b.add(i) for i in range(4)]
+            for f in futs:
+                f.result(timeout=2)
+            assert sorted(map(tuple, batches)) == [(0, 2), (1, 3)]
+        finally:
+            b.stop()
+
+
+class TestFailureFanOut:
+    def test_exec_exception_fans_to_every_pending_future(self):
+        def run(_reqs):
+            raise ValueError("batch boom")
+
+        b = make_batcher(run)
+        try:
+            futs = [b.add(i) for i in range(4)]
+            for f in futs:
+                with pytest.raises(ValueError, match="batch boom"):
+                    f.result(timeout=2)  # fast failure, not the 30s backstop
+        finally:
+            b.stop()
+
+    def test_response_count_mismatch_fails_batch(self):
+        b = make_batcher(lambda reqs: [reqs[0]])  # short response list
+        try:
+            futs = [b.add(i) for i in range(3)]
+            for f in futs:
+                with pytest.raises(RuntimeError, match="1 responses for 3"):
+                    f.result(timeout=2)
+        finally:
+            b.stop()
+
+    def test_cancelled_caller_does_not_wedge_batch(self):
+        gate = threading.Event()
+
+        def run(reqs):
+            gate.wait(2)
+            return list(reqs)
+
+        b = make_batcher(run)
+        try:
+            futs = [b.add(i) for i in range(3)]
+            futs[1].cancel()
+            gate.set()
+            assert futs[0].result(timeout=2) == 0
+            assert futs[2].result(timeout=2) == 2
+        finally:
+            b.stop()
+
+
+class TestStop:
+    def test_stop_drains_queued_requests(self):
+        # a window that would never fire on its own: stop() must flush it
+        b = make_batcher(lambda reqs: [r + 100 for r in reqs],
+                         idle_timeout=60.0, max_timeout=60.0)
+        futs = [b.add(i) for i in range(3)]
+        b.stop()
+        assert [f.result(timeout=1) for f in futs] == [100, 101, 102]
+
+    def test_stop_fails_leftovers_not_strands(self):
+        # exec_fn wedges past the bounded join: callers get an exception
+        # instead of hanging on the add_sync backstop
+        started = threading.Event()
+
+        def wedge(reqs):
+            started.set()
+            time.sleep(0.3)
+            raise ValueError("late failure still fans out")
+
+        b = make_batcher(wedge)
+        futs = [b.add(i) for i in range(2)]
+        started.wait(2)
+        b.stop()  # joins the in-flight exec; its failure fans out
+        for f in futs:
+            with pytest.raises(ValueError):
+                f.result(timeout=1)
+
+    def test_add_after_stop_raises(self):
+        b = make_batcher(lambda reqs: list(reqs))
+        b.stop()
+        with pytest.raises(RuntimeError, match="stopped"):
+            b.add(1)
+
+
+class _DeficitEC2:
+    """create_fleet that fills only part of the request (partial ICE)."""
+
+    def __init__(self, grant: int):
+        self.grant = grant
+
+    def create_fleet(self, configs, target_capacity, capacity_type, tags):
+        errs = [{"code": "InsufficientInstanceCapacity",
+                 "message": "no capacity"}]
+        return [f"i-{n}" for n in range(min(self.grant,
+                                            target_capacity))], errs
+
+
+class TestCreateFleetBatcher:
+    def test_deficit_callers_get_none_plus_errors(self):
+        b = CreateFleetBatcher(ec2=_DeficitEC2(grant=2))
+        try:
+            req_shape = dict(
+                launch_template_configs=to_hashable(
+                    [{"launch_template_name": "lt",
+                      "overrides": [{"instance_type": "m5.large",
+                                     "zone": "us-west-2a"}]}]),
+                capacity_type="spot")
+            from karpenter_provider_aws_tpu.batcher.core import \
+                CreateFleetRequest
+            futs = [b.add(CreateFleetRequest(**req_shape))
+                    for _ in range(3)]
+            results = [f.result(timeout=2) for f in futs]
+        finally:
+            b.stop()
+        granted = [r for r in results if r[0] is not None]
+        deficit = [r for r in results if r[0] is None]
+        assert len(granted) == 2 and len(deficit) == 1
+        # the short-changed caller still sees WHY: the ICE error list
+        assert deficit[0][1][0]["code"] == "InsufficientInstanceCapacity"

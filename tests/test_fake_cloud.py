@@ -145,3 +145,66 @@ class TestFakeEC2:
         assert not ec2.insufficient_capacity_pools
         assert not ec2.launch_templates
         assert ec2.create_launch_template_log.called_times == 0
+
+
+class TestCallLog:
+    """The MockedFunction analog's three error forms + its concurrency
+    contract (batcher threads and the chaos harness share one log)."""
+
+    def test_sequence_error_form(self, ec2):
+        ec2.describe_instances_log.error = [
+            RuntimeError("a"), None, RuntimeError("b")]
+        with pytest.raises(RuntimeError, match="a"):
+            ec2.describe_instances()
+        assert ec2.describe_instances() == []   # the None slot
+        with pytest.raises(RuntimeError, match="b"):
+            ec2.describe_instances()
+        assert ec2.describe_instances() == []   # exhausted -> clean forever
+        assert ec2.describe_instances() == []
+
+    def test_callable_error_form(self, ec2):
+        # an exception CLASS is a callable: every call fails until cleared
+        ec2.describe_instances_log.error = ConnectionError
+        for _ in range(3):
+            with pytest.raises(ConnectionError):
+                ec2.describe_instances()
+        ec2.describe_instances_log.error = None
+        assert ec2.describe_instances() == []
+
+    def test_one_shot_consumed_exactly_once_across_threads(self, ec2):
+        import threading
+        ec2.describe_instances_log.error = RuntimeError("one-shot")
+        barrier = threading.Barrier(8)
+        raised = []
+
+        def hit():
+            barrier.wait()
+            try:
+                ec2.describe_instances()
+            except RuntimeError:
+                raised.append(1)
+
+        threads = [threading.Thread(target=hit) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        # exactly one racer consumed the error; none double-consumed it
+        assert len(raised) == 1
+        assert ec2.describe_instances_log.called_times == 8
+
+    def test_call_capture_is_thread_safe(self, ec2):
+        import threading
+        barrier = threading.Barrier(8)
+
+        def hit():
+            barrier.wait()
+            for _ in range(50):
+                ec2.describe_instances()
+
+        threads = [threading.Thread(target=hit) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert ec2.describe_instances_log.called_times == 400

@@ -1,0 +1,307 @@
+"""Cloud-seam chaos: seeded fault schedules against the full operator.
+
+Every test drives the REAL control plane (provisioner, lifecycle, GC,
+interruption, batchers) through the ResilientCloud retry proxy while a
+CloudFaultInjector tears the EC2/SQS seam underneath it on a seeded
+schedule. The convergence contract: every seeded run settles to the
+fault-free run's terminal cluster fingerprint, with zero orphaned
+instances, zero double-handled interruptions, and an empty queue.
+"""
+
+import pytest
+
+from karpenter_provider_aws_tpu.apis import labels as L
+from karpenter_provider_aws_tpu.apis.objects import (EC2NodeClass,
+                                                     NodeClassRef, NodePool,
+                                                     NodePoolTemplate)
+from karpenter_provider_aws_tpu.fake.environment import make_pods
+from karpenter_provider_aws_tpu.fake.faultcloud import (CloudFaultInjector,
+                                                        CloudFaultPlan)
+from karpenter_provider_aws_tpu.operator import Operator
+from karpenter_provider_aws_tpu.providers.awsretry import AWSError
+from karpenter_provider_aws_tpu.providers.sqs import InterruptionMessage
+
+N_PODS = 6
+N_INTERRUPTIONS = 2
+
+
+def mk_cluster(op):
+    op.kube.create(EC2NodeClass("chaos-class"))
+    op.kube.create(NodePool("chaos", template=NodePoolTemplate(
+        node_class_ref=NodeClassRef("chaos-class"))))
+
+
+def chaos_settle(op, rounds=40):
+    """Settle the cluster, riding through injected faults that escape the
+    retry budget (a reconcile aborted mid-flight is exactly what the
+    manager's panic isolation + cadence retry gives in production).
+    Quiescence alone is not convergence: a nominated pod waiting on a
+    describe-lagged instance leaves the step loop quiet, so require every
+    live pod bound and the queue drained before declaring settled."""
+    import time as _time
+    last = None
+    for _ in range(rounds):
+        try:
+            steps = op.run_until_settled(max_steps=12)
+        except (AWSError, ConnectionError, OSError) as e:
+            last = e
+            continue
+        converged = (steps < 12 and len(op.sqs) == 0 and
+                     all(p.node_name for p in op.kube.list("Pod")
+                         if p.phase not in ("Succeeded", "Failed")))
+        if converged:
+            return
+        _time.sleep(0.25)  # let lag windows / link flaps expire
+    raise AssertionError(f"cluster failed to settle under chaos "
+                         f"(last escaped fault: {last!r})")
+
+
+def cluster_fingerprint(op):
+    """Terminal-state fingerprint: the live capacity multiset + pod
+    bindings. Deliberately excludes instance/claim ids (global counters
+    differ across runs) and the injector log (threaded call order is not
+    reproducible) — convergence is about WHERE the cluster lands."""
+    capacity = tuple(sorted(
+        (i.instance_type, i.zone, i.capacity_type)
+        for i in op.ec2.describe_instances()))
+    pods = op.kube.list("Pod")
+    return capacity, (len(pods), sum(1 for p in pods if p.node_name))
+
+
+def assert_no_orphans(op):
+    claimed = {c.provider_id.split("/")[-1]
+               for c in op.kube.list("NodeClaim") if c.provider_id}
+    for inst in op.ec2.describe_instances():
+        assert inst.id in claimed, f"orphaned instance {inst.id}"
+
+
+def pick_victims(op, n):
+    """Deterministic interruption targets: sort claims by pool, not by
+    id/name, so the fault-free and chaos runs reclaim the same pools."""
+    claims = sorted(
+        (c for c in op.kube.list("NodeClaim") if c.provider_id),
+        key=lambda c: (c.metadata.labels.get(L.INSTANCE_TYPE, ""),
+                       c.metadata.labels.get(L.ZONE, ""),
+                       c.metadata.name))
+    return claims[:n]
+
+
+def run_scenario(plan=None):
+    """The canonical chaos scenario: provision a spot workload, settle,
+    reclaim N_INTERRUPTIONS instances, settle again. Returns (op, inj)
+    with the injector uninstalled (describe is unfiltered again)."""
+    op = Operator()
+    mk_cluster(op)
+    # zone-pinned pods: the wave needs an instance per zone, so the
+    # reclaim wave below has real victims in distinct pools
+    zones = ("us-west-2a", "us-west-2b", "us-west-2c")
+    for i in range(N_PODS):
+        for p in make_pods(1, cpu="3", memory="12Gi", prefix="chaos",
+                           node_selector={L.CAPACITY_TYPE: "spot",
+                                          L.ZONE: zones[i % len(zones)]}):
+            op.kube.create(p)
+    inj = None
+    if plan is not None:
+        inj = CloudFaultInjector(op.ec2, sqs=op.sqs, plan=plan).install()
+    try:
+        chaos_settle(op)
+        victims = pick_victims(op, N_INTERRUPTIONS)
+        victim_ids = [v.provider_id.split("/")[-1] for v in victims]
+        for vid in victim_ids:
+            op.sqs.send(InterruptionMessage(kind="spot_interruption",
+                                            instance_id=vid))
+        chaos_settle(op)
+    finally:
+        if inj is not None:
+            inj.uninstall()
+    # zero lost interruptions: every reclaimed instance really died and
+    # the queue fully drained
+    for vid in victim_ids:
+        assert op.ec2.instances[vid].state == "terminated"
+    assert len(op.sqs) == 0
+    assert victim_ids, "scenario produced no interruption victims"
+    op.chaos_victims = victim_ids
+    return op, inj
+
+
+def quiet_plan(**overrides):
+    """A plan with every probability zeroed except the overrides."""
+    base = dict(p_throttle=0.0, p_down=0.0, p_wedge=0.0,
+                p_lag=0.0, p_partial=0.0, p_dup=0.0)
+    base.update(overrides)
+    seed = base.pop("seed", 7)
+    return CloudFaultPlan(seed, **base)
+
+
+@pytest.fixture(scope="module")
+def baseline():
+    op, _ = run_scenario(None)
+    fp = cluster_fingerprint(op)
+    assert_no_orphans(op)
+    # the scenario itself must be deterministic before chaos means anything
+    op2, _ = run_scenario(None)
+    assert cluster_fingerprint(op2) == fp
+    return fp
+
+
+class TestChaosConvergence:
+    @pytest.mark.parametrize("seed", [1, 4])
+    def test_seeded_chaos_converges(self, baseline, seed):
+        op, inj = run_scenario(CloudFaultPlan(seed))
+        assert cluster_fingerprint(op) == baseline
+        assert_no_orphans(op)
+        # exactly-once effect per reclaim, no matter how many deliveries
+        assert op.metrics.counter(
+            "karpenter_interruption_received_messages_total",
+            labels={"message_type": "spot_interruption"}) == \
+            len(op.chaos_victims)
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("seed", list(range(10)))
+    def test_seed_sweep_converges(self, baseline, seed):
+        """hack/chaoscloud.sh's bar: every seed lands on the fault-free
+        fingerprint with a clean cloud account."""
+        op, inj = run_scenario(CloudFaultPlan(seed))
+        assert cluster_fingerprint(op) == baseline, \
+            f"seed {seed} diverged; faults={inj.fault_counts()}"
+        assert_no_orphans(op)
+        assert op.metrics.counter(
+            "karpenter_interruption_received_messages_total",
+            labels={"message_type": "spot_interruption"}) == \
+            len(op.chaos_victims)
+
+
+class TestThrottleStorm:
+    def test_storm_retries_through(self, baseline):
+        plan = quiet_plan(p_throttle=0.5, seed=3)
+        op, inj = run_scenario(plan)
+        assert cluster_fingerprint(op) == baseline
+        assert_no_orphans(op)
+        # the storm really hit the proxy: throttles were classified,
+        # counted, and retried through (the AIMD recovery means the
+        # send-rate gauge is back near its ceiling by settle time, so
+        # the counter — not the gauge — is the storm's footprint)
+        assert inj.fault_counts().get("throttle", 0) > 0
+        assert op.metrics.counter(
+            "karpenter_cloud_retry_throttle_events_total",
+            labels={"service": "EC2"}) > 0
+
+
+class TestDescribeLag:
+    def test_lag_is_grace_not_orphan(self):
+        """A fresh fleet invisible to DescribeInstances must ride the
+        creation-grace window — GC reaping it would strand the pod wave
+        in a launch/reap livelock."""
+        op = Operator()
+        mk_cluster(op)
+        for p in make_pods(2, cpu="500m", prefix="lag"):
+            op.kube.create(p)
+        plan = quiet_plan(p_lag=1.0, seed=11)
+        plan.lag_s = 3.0
+        with CloudFaultInjector(op.ec2, plan=plan):
+            op.step()  # launch: the new instances are now describe-hidden
+            claims = [c for c in op.kube.list("NodeClaim") if c.provider_id]
+            assert claims
+            op.gc.reconcile()  # inside the lag window
+            # grace held: nothing reaped, the window was counted
+            assert {c.metadata.name for c in op.kube.list("NodeClaim")} >= \
+                {c.metadata.name for c in claims}
+            assert op.metrics.counter(
+                "karpenter_cloud_eventual_consistency_grace_total",
+                labels={"controller": "gc-nodeclaim"}) > 0
+            chaos_settle(op)
+        assert all(p.node_name for p in op.kube.list("Pod"))
+        assert_no_orphans(op)
+
+
+class TestPartialFleet:
+    def test_deficit_reprovisions(self):
+        op = Operator()
+        mk_cluster(op)
+        # anti-affine pods so the wave needs several instances and the
+        # batcher issues one multi-capacity CreateFleet
+        for p in make_pods(3, cpu="3", memory="12Gi", prefix="partial"):
+            op.kube.create(p)
+        plan = quiet_plan(p_partial=1.0, seed=5)
+        plan.max_faults = 1
+        with CloudFaultInjector(op.ec2, plan=plan) as inj:
+            chaos_settle(op)
+            assert inj.dropped_instances, "the partial fault never fired"
+        assert all(p.node_name for p in op.kube.list("Pod"))
+        assert_no_orphans(op)
+        # the dropped instance left no trace in the cloud account
+        for iid in inj.dropped_instances:
+            assert iid not in op.ec2.instances
+
+
+class TestInterruptionDedupe:
+    def test_duplicate_delivery_handled_once(self):
+        op = Operator()
+        mk_cluster(op)
+        for p in make_pods(2, cpu="500m", prefix="dup",
+                           node_selector={L.CAPACITY_TYPE: "spot"}):
+            op.kube.create(p)
+        op.run_until_settled()
+        victim = pick_victims(op, 1)[0]
+        vid = victim.provider_id.split("/")[-1]
+        plan = quiet_plan(p_dup=1.0, seed=2)
+        with CloudFaultInjector(op.ec2, sqs=op.sqs, plan=plan) as inj:
+            op.sqs.send(InterruptionMessage(kind="spot_interruption",
+                                            instance_id=vid))
+            assert inj.dup_sends == 1 and len(op.sqs) == 2
+            chaos_settle(op)
+        # the reclaim happened exactly once; the redelivery was
+        # acknowledged and dropped, not re-handled
+        assert op.metrics.counter(
+            "karpenter_interruption_received_messages_total",
+            labels={"message_type": "spot_interruption"}) == 1
+        assert op.metrics.counter(
+            "karpenter_interruption_deduped_messages_total",
+            labels={"message_type": "spot_interruption"}) == 1
+        assert victim.name not in {c.name for c in op.kube.list("NodeClaim")}
+        assert op.ec2.instances[vid].state == "terminated"
+        assert all(p.node_name for p in op.kube.list("Pod"))
+        assert_no_orphans(op)
+
+
+class TestLinkFlaps:
+    def test_down_flaps_converge(self, baseline):
+        op, inj = run_scenario(quiet_plan(p_down=0.35, seed=9))
+        assert cluster_fingerprint(op) == baseline
+        assert_no_orphans(op)
+        assert inj.fault_counts().get("down", 0) > 0
+
+    def test_wedge_flaps_converge(self, baseline):
+        op, inj = run_scenario(quiet_plan(p_wedge=0.5, seed=13))
+        assert cluster_fingerprint(op) == baseline
+        assert_no_orphans(op)
+        assert inj.fault_counts().get("wedge", 0) > 0
+
+
+class TestPlanDeterminism:
+    def test_same_seed_same_schedule(self):
+        ops = ["describe_instances", "create_fleet", "sqs.send",
+               "terminate_instances"] * 25
+        a = [CloudFaultPlan(42).next(i, op) for i, op in enumerate(ops)]
+        b = [CloudFaultPlan(42).next(i, op) for i, op in enumerate(ops)]
+        assert a == b
+        assert any(k is not None for k in a)
+
+    def test_consecutive_delivery_failures_bounded(self):
+        plan = CloudFaultPlan(0, p_throttle=0.5, p_down=0.5, p_wedge=0.0,
+                              p_lag=0.0, p_partial=0.0, p_dup=0.0,
+                              max_consecutive=2, max_faults=10_000)
+        run = worst = 0
+        for i in range(500):
+            k = plan.next(i, "describe_instances")
+            run = run + 1 if k in ("throttle", "down") else 0
+            worst = max(worst, run)
+        assert worst == 2  # p=1.0 faulting always hits the bound
+
+    def test_fault_budget_exhausts(self):
+        plan = CloudFaultPlan(1, p_throttle=0.5, p_down=0.0, p_wedge=0.0,
+                              p_lag=0.0, p_partial=0.0, p_dup=0.0,
+                              max_faults=5)
+        kinds = [plan.next(i, "describe_instances") for i in range(400)]
+        assert sum(1 for k in kinds if k) == 5
+        assert all(k is None for k in kinds[-100:])

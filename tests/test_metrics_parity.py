@@ -87,11 +87,13 @@ def emitted():
         op.kube.create(p)
     op.run_until_settled(disrupt=False)
 
-    # interruption burst (received/deleted/queue-duration)
+    # interruption burst (received/deleted/queue-duration); sent twice —
+    # SQS is at-least-once, so the duplicate trips the dedupe counter
     claim = next(c for c in op.kube.list("NodeClaim") if c.provider_id)
-    op.sqs.send(InterruptionMessage(
-        kind="spot_interruption",
-        instance_id=claim.provider_id.rsplit("/", 1)[-1]))
+    for _ in range(2):
+        op.sqs.send(InterruptionMessage(
+            kind="spot_interruption",
+            instance_id=claim.provider_id.rsplit("/", 1)[-1]))
     op.interruption.reconcile()
     op.run_until_settled(disrupt=False)
 
@@ -263,6 +265,46 @@ def emitted():
     cpu_solver = op.solver
     cpu_solver.metrics = op.metrics
     cpu_solver.solve(op.provisioner.build_snapshot(relax_pods))
+
+    # cloud retry families: one throttled-then-ok call plus one that
+    # exhausts the attempt budget, through a seeded fast policy
+    import random as _rand
+
+    from karpenter_provider_aws_tpu.providers.awsretry import (
+        AWSError, CloudRetryPolicy)
+    rp = CloudRetryPolicy(rng=_rand.Random(0), sleep=lambda _s: None,
+                          metrics=op.metrics)
+    throttled = {"n": 0}
+
+    def flaky_cloud():
+        throttled["n"] += 1
+        if throttled["n"] == 1:
+            raise AWSError("RequestLimitExceeded", status=503)
+        return "ok"
+
+    def dead_cloud():
+        raise ConnectionError("link down")
+
+    rp.call(flaky_cloud, operation="describe_instances")
+    try:
+        rp.call(dead_cloud, operation="describe_instances")
+    except ConnectionError:
+        pass
+    rp.emit_state()
+
+    # eventual-consistency grace: a freshly launched claim whose
+    # instance DescribeInstances has not converged on yet — GC must
+    # count grace, not reap it
+    from karpenter_provider_aws_tpu.apis.objects import NodeClaim as _GNC
+    ghost = _GNC("parity-ghost", requirements=Requirements([]),
+                 node_class_ref=NodeClassRef("mx"))
+    ghost.set_condition("Launched", "True", now=clock())
+    ghost.provider_id = "aws:///us-west-2a/i-parity-ghost"
+    op.kube.create(ghost)
+    op.gc.reconcile()
+    assert op.kube.try_get("NodeClaim", "parity-ghost") is not None
+    ghost.metadata.finalizers.clear()
+    op.kube.delete("NodeClaim", "parity-ghost")
 
     # cloudprovider error taxonomy (decorated boundary)
     from karpenter_provider_aws_tpu.apis.objects import NodeClaim as NC
