@@ -27,9 +27,11 @@ chaos:  ## both seeded fault-injection sweeps (solver wire + cloud seam)
 chaoscloud:  ## the 10-seed cloud-seam chaos sweep alone
 	sh hack/chaoscloud.sh
 
-benchmark:  ## the five BASELINE configs + interruption throughput
+benchmark:  ## the five BASELINE configs + interruption + batch dispatch
 	python bench.py --all --rounds 100
 	python bench.py --interruption
+	python bench.py --batch-solve
+	python bench.py --sidecar-batch
 
 multichip:  ## dry-run the multi-device solve on 8 virtual CPU devices
 	python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"

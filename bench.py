@@ -352,7 +352,102 @@ def run_batch_bench(backend, batch=8, rounds=30):
         "hot_rejected": hot_b + hot_s + hot_h,
         "dispatch": stats,
         "engine": _engine_report({"host": 0, "dev": 0}, tpu),
+        "phases": _phase_report(tpu),
     }
+
+
+def run_sidecar_batch_bench(batch=8, rounds=30):
+    """The multi-arena wire: B single Solve round trips vs ONE
+    SolveBatch RPC against a loopback sidecar, plus server-side
+    coalescing evidence. Three claims, measured separately:
+
+    - frame amortization: one SolveBatch frame pays per-RPC overhead
+      (serialize, HTTP/2 frame, deadline bookkeeping, demux) once for B
+      solves — ``rpc_amortization`` is the B-singles / one-frame ratio;
+    - coalescing: B CONCURRENT single Solves against the server join
+      the adaptive window and ride one vmapped dispatch —
+      ``coalesce.max_batch > 1`` is the dispatch evidence the issue
+      asks for (bounded by the server's worker pool, default 4);
+    - per-phase split: encode/kernel/decode of a remote solve, where
+      kernel_ms IS the wire round trip (pack -> RPC -> unpack).
+
+    Loopback on one process means the 'kernel' side shares the CPU with
+    the client — read the ratios, not the absolute ms."""
+    import threading
+
+    from karpenter_provider_aws_tpu.fake.environment import Environment
+    from karpenter_provider_aws_tpu.sidecar.client import RemoteSolver
+    from karpenter_provider_aws_tpu.sidecar.server import SolverServer
+    from karpenter_provider_aws_tpu.utils.metrics import Metrics
+
+    rounds = min(rounds, 30)
+    env = Environment()
+    # small solves on purpose: per-RPC overhead is a constant, so the
+    # frame's amortization is only visible when the kernel doesn't
+    # drown it (the batch-solve config covers the big-solve shape)
+    snaps = build_batch_snapshots(env, batch=batch, n_sigs=24, per=2)
+    metrics = Metrics()
+    server = SolverServer(metrics=metrics).start()
+    try:
+        remote = RemoteSolver(server.address, backend="jax")
+        remote._router.alive.mark_ok()
+        if not remote._ping() or not remote.supports_batch_kernel:
+            raise SystemExit("loopback sidecar refused the batch "
+                             "capability (Info batch flag missing)")
+        items = [remote._prep_batch_item(s) for s in snaps]
+        if any(it is None for it in items):
+            raise SystemExit("snapshot shape fell off the batch path")
+        st = dict(items[0]["statics"], n_max=remote._bucket)
+        bufs = [it["buf"] for it in items]
+
+        # warm both wire paths, then prove the frame demuxes to exactly
+        # the bytes B sequential Solve RPCs produce
+        rows = remote.client.solve_batch_buffers(bufs, st)
+        singles = [remote.client.solve_buffer(b, st) for b in bufs]
+        identical = all(
+            rows[i].tobytes() == singles[i].tobytes()
+            for i in range(len(bufs)))
+
+        cooldown(2.0)
+        baseline = calib_baseline()
+        t_single, hot_s = guarded_rounds(
+            lambda: [remote.client.solve_buffer(b, st) for b in bufs],
+            rounds, baseline)
+        t_frame, hot_f = guarded_rounds(
+            lambda: remote.client.solve_batch_buffers(bufs, st),
+            rounds, baseline)
+        ps, _ = _percentiles(t_single)
+        pf, _ = _percentiles(t_frame)
+
+        # coalescing evidence: concurrent singles (sequential ones never
+        # queue, and the window correctly stays closed at depth 1)
+        def _fire(b):
+            remote.client.solve_buffer(b, st)
+        for _ in range(max(3, rounds // 5)):
+            threads = [threading.Thread(target=_fire, args=(b,))
+                       for b in bufs]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        coalesce = dict(server._handler._coalescer.stats)
+
+        remote.solve(snaps[0])  # phases: kernel_ms == wire round trip
+        return {
+            "config": "sidecar-batch", "batch": batch,
+            "pods_per_snapshot": len(snaps[0].pods),
+            "identical_rows": identical,
+            "singles_p50_ms": ps, "frame_p50_ms": pf,
+            "single_per_item_ms": round(ps / batch, 3),
+            "frame_per_item_ms": round(pf / batch, 3),
+            "rpc_amortization": round(ps / pf, 2) if pf else 0.0,
+            "rounds": rounds,
+            "hot_rejected": hot_s + hot_f,
+            "coalesce": coalesce,
+            "phases": _phase_report(remote),
+        }
+    finally:
+        server.stop(grace=1.0)
 
 
 def build_config5(env, n_pods):
@@ -504,6 +599,15 @@ def _engine_report(counts, tpu=None):
     return rep
 
 
+def _phase_report(solver) -> dict:
+    """The encode/kernel/decode wall split of the solver's LAST solve
+    (solver/tpu.py last_phase_stats) — measured, not asserted: the
+    design doc's claim that host encode dominates the headline is
+    checkable from every config row."""
+    st = getattr(solver, "last_phase_stats", None) or {}
+    return {k: round(v, 3) for k, v in st.items()}
+
+
 def _phase_timed_dispatch(phases):
     """A TPUSolver._dispatch replacement that splits each packed-kernel
     dispatch into explicitly-synced h2d / kernel / d2h phases, recording
@@ -588,6 +692,7 @@ def run_solver_config(name, snap, backend, rounds):
         "hot_rejected": hot_rejected,
         "calib_baseline_ms": round(baseline, 3),
         "engine": _engine_report(counts, tpu),
+        "phases": _phase_report(tpu),
         "decisions": ref.summary(),
     }
 
@@ -704,6 +809,7 @@ def run_config4(backend, rounds, n_nodes=200):
         "hot_rejected": hot_rejected,
         "calib_baseline_ms": round(baseline, 3),
         "engine": _engine_report({"host": -1, "dev": -1}, tpu),
+        "phases": _phase_report(tpu),
     }
 
 
@@ -1148,6 +1254,10 @@ def main():
                          "vmapped device dispatch vs B single solves)")
     ap.add_argument("--batch", type=int, default=8,
                     help="snapshots per dispatch for --batch-solve")
+    ap.add_argument("--sidecar-batch", action="store_true",
+                    help="bench the multi-arena wire: B Solve round "
+                         "trips vs one SolveBatch RPC on a loopback "
+                         "sidecar, plus coalescing evidence")
     ap.add_argument("--probe-device", action="store_true",
                     help="link-vs-kernel decomposition of the device path")
     ap.add_argument("--device-kernel", action="store_true",
@@ -1172,6 +1282,10 @@ def main():
     if args.batch_solve:
         print(json.dumps(run_batch_bench(
             args.backend, batch=args.batch, rounds=min(args.rounds, 30))))
+        return
+    if args.sidecar_batch:
+        print(json.dumps(run_sidecar_batch_bench(
+            batch=args.batch, rounds=min(args.rounds, 30))))
         return
     if args.probe_device:
         run_device_probe(args.pods)
@@ -1258,6 +1372,9 @@ def main():
         # device_solves/device_platform on its own, with no human
         # cross-referencing to BASELINE.md
         "engine": head["engine"],
+        # encode/kernel/decode wall split of the headline's last solve
+        # (per-config rows under "configs" each carry their own)
+        "phases": head.get("phases", {}),
     }
     if results:
         extra["configs"] = {str(k): v for k, v in sorted(results.items())}

@@ -19,4 +19,5 @@ cd "$(dirname "$0")/.."
 
 JAX_PLATFORMS=cpu exec python -m pytest \
     "tests/test_faultwire.py::test_seed_sweep_is_deterministic" \
+    "tests/test_faultwire.py::test_batch_seed_sweep_matches_oracle" \
     -m slow -q -p no:cacheprovider "$@"

@@ -1,8 +1,9 @@
 """Deterministic fault injection for the solver wire.
 
 :class:`FaultInjector` wraps a live ``SolverClient`` at the channel
-callable level — the four raw unary callables (``_solve``,
-``_solve_pruned``, ``_solve_topo``, ``_info``) are replaced with
+callable level — the five raw unary callables (``_solve``,
+``_solve_pruned``, ``_solve_topo``, ``_solve_batch``, ``_info``) are
+replaced with
 wrappers that consult a seeded :class:`FaultPlan` before (and after)
 each real wire call. Everything above the callables — the resilience
 policy, retries, breaker, arena decode — runs UNCHANGED, which is the
@@ -123,7 +124,8 @@ class FaultInjector:
     """
 
     _WRAPPED = (("_solve", "Solve"), ("_solve_pruned", "SolvePruned"),
-                ("_solve_topo", "SolveTopo"), ("_info", "Info"))
+                ("_solve_topo", "SolveTopo"),
+                ("_solve_batch", "SolveBatch"), ("_info", "Info"))
 
     def __init__(self, client, plan: FaultPlan,
                  sleep: Callable[[float], None] = time.sleep):

@@ -893,6 +893,18 @@ def solve_scan_packed1_many(bufs: jax.Array, *, T: int, D: int, Z: int,
     return jax.vmap(fn)(bufs)
 
 
+def _packed1_pruned_body(buf: jax.Array, *, T, D, Z, C, G, E, P, n_max,
+                         S) -> jax.Array:
+    n_i64 = _layout_sizes(_in_layout_i64(T, D, Z, C, G, E, P, 0, 0))
+    n_bits = _layout_sizes(_in_layout_bool(T, D, Z, C, G, E, P, 0, 0))
+    bool_flat = _words_to_bits(buf[n_i64:n_i64 + _nwords(n_bits)], n_bits)
+    inp, _ = _unpack_inputs(buf[:n_i64], bool_flat, T, D, Z, C, G, E, P,
+                            0, 0)
+    takes, leftover, carry = _solve_pruned(inp, n_max, E, P, S)
+    return jnp.concatenate([_pack_solve_outputs(takes, leftover, carry),
+                            carry.bail.astype(jnp.int64).reshape(1)])
+
+
 @partial(jax.jit, static_argnames=("T", "D", "Z", "C", "G", "E", "P",
                                    "n_max", "S"))
 def solve_scan_packed1_pruned(buf: jax.Array, *, T: int, D: int, Z: int,
@@ -902,11 +914,21 @@ def solve_scan_packed1_pruned(buf: jax.Array, *, T: int, D: int, Z: int,
     the base kernel, with ONE extra trailing int64: the bail flag (1 =
     pruning was insufficient; the caller must discard and re-solve on
     the host twin). minValues floors are out of scope (caller-gated)."""
-    n_i64 = _layout_sizes(_in_layout_i64(T, D, Z, C, G, E, P, 0, 0))
-    n_bits = _layout_sizes(_in_layout_bool(T, D, Z, C, G, E, P, 0, 0))
-    bool_flat = _words_to_bits(buf[n_i64:n_i64 + _nwords(n_bits)], n_bits)
-    inp, _ = _unpack_inputs(buf[:n_i64], bool_flat, T, D, Z, C, G, E, P,
-                            0, 0)
-    takes, leftover, carry = _solve_pruned(inp, n_max, E, P, S)
-    return jnp.concatenate([_pack_solve_outputs(takes, leftover, carry),
-                            carry.bail.astype(jnp.int64).reshape(1)])
+    return _packed1_pruned_body(buf, T=T, D=D, Z=Z, C=C, G=G, E=E, P=P,
+                                n_max=n_max, S=S)
+
+
+@partial(jax.jit, static_argnames=("T", "D", "Z", "C", "G", "E", "P",
+                                   "n_max", "S"))
+def solve_scan_packed1_pruned_many(bufs: jax.Array, *, T: int, D: int,
+                                   Z: int, C: int, G: int, E: int, P: int,
+                                   n_max: int,
+                                   S: int = DEV_PRUNED_SLOTS) -> jax.Array:
+    """B pruned solves, ONE dispatch — the vmapped twin of
+    solve_scan_packed1_pruned for the sidecar's coalescing window.
+    Each lane carries its OWN trailing bail flag, so a rider whose
+    pruning was insufficient degrades alone (its caller re-solves on
+    the host twin) without touching its batchmates."""
+    fn = partial(_packed1_pruned_body, T=T, D=D, Z=Z, C=C, G=G, E=E, P=P,
+                 n_max=n_max, S=S)
+    return jax.vmap(fn)(bufs)

@@ -189,3 +189,61 @@ def pack_outputs1(arrays: dict, T, D, Z, C, G, E, P, n_max) -> np.ndarray:
     bl = np.concatenate([np.asarray(arrays[nm]).reshape(-1).astype(bool)
                          for nm, _ in lb])
     return np.concatenate([i64, pack_i32_words(i32), pack_bits(bl)])
+
+
+#: frame header ceiling — a SolveBatch frame larger than this is a
+#: protocol violation, not a workload (consolidation's pre-screen and
+#: the preference relaxer cap out far below; the bound keeps a hostile
+#: header from sizing server allocations)
+BATCH_MAX_ITEMS = 64
+
+
+def pack_batch_frame(bufs, statics: dict) -> np.ndarray:
+    """B packed solve buffers sharing ONE statics bucket -> one int64
+    frame: [B | offsets[0..B] (cumulative words, offs[0]=0, offs[B]=
+    payload size) | statics vector (STATIC_KEYS order) | payload].
+    The offsets are redundant with the statics (every item of a shape
+    class has the same width) — they exist so the receiving side can
+    validate the frame BEFORE trusting the statics to size anything."""
+    B = len(bufs)
+    if not 1 <= B <= BATCH_MAX_ITEMS:
+        raise ValueError(f"batch size {B} outside [1, {BATCH_MAX_ITEMS}]")
+    flat = [np.asarray(b).reshape(-1).astype(np.int64) for b in bufs]
+    offs = np.zeros(B + 1, dtype=np.int64)
+    np.cumsum([b.size for b in flat], out=offs[1:])
+    svec = np.array([int(statics.get(k, 0)) for k in STATIC_KEYS],
+                    dtype=np.int64)
+    return np.concatenate([np.array([B], dtype=np.int64), offs, svec]
+                          + flat)
+
+
+def unpack_batch_frame(frame) -> tuple:
+    """Inverse of pack_batch_frame -> (statics dict, [item buffers]).
+    Raises ValueError on ANY malformation (truncated header, offsets
+    not monotone from zero, payload size mismatch) so the server can
+    reject before statics-derived sizing, and the client's resilience
+    layer can classify a truncated reply as retryable-malformed."""
+    frame = np.asarray(frame).reshape(-1)
+    if frame.dtype != np.int64:
+        raise ValueError(f"batch frame dtype {frame.dtype} != int64")
+    if frame.size < 1:
+        raise ValueError("batch frame empty")
+    B = int(frame[0])
+    if not 1 <= B <= BATCH_MAX_ITEMS:
+        raise ValueError(f"batch size {B} outside [1, {BATCH_MAX_ITEMS}]")
+    hdr = 1 + (B + 1) + len(STATIC_KEYS)
+    if frame.size < hdr:
+        raise ValueError(f"batch frame truncated: {frame.size} < header "
+                         f"{hdr}")
+    offs = frame[1:1 + B + 1]
+    if int(offs[0]) != 0 or np.any(np.diff(offs) <= 0):
+        raise ValueError("batch frame offsets not strictly increasing "
+                         "from zero")
+    payload = frame[hdr:]
+    if int(offs[B]) != payload.size:
+        raise ValueError(f"batch frame payload size {payload.size} != "
+                         f"declared {int(offs[B])}")
+    svec = frame[1 + B + 1:hdr]
+    statics = {k: int(svec[i]) for i, k in enumerate(STATIC_KEYS)}
+    bufs = [payload[int(offs[i]):int(offs[i + 1])] for i in range(B)]
+    return statics, bufs

@@ -33,6 +33,7 @@ from .resilience import ResiliencePolicy, SidecarUnavailable
 _SOLVE = "/karpenter.solver.v1.Solver/Solve"
 _SOLVE_TOPO = "/karpenter.solver.v1.Solver/SolveTopo"
 _SOLVE_PRUNED = "/karpenter.solver.v1.Solver/SolvePruned"
+_SOLVE_BATCH = "/karpenter.solver.v1.Solver/SolveBatch"
 _INFO = "/karpenter.solver.v1.Solver/Info"
 
 #: SolveTopo output fields that are booleans on the kernel side (the
@@ -67,6 +68,7 @@ class SolverClient:
         self._solve = self._channel.unary_unary(_SOLVE)
         self._solve_topo = self._channel.unary_unary(_SOLVE_TOPO)
         self._solve_pruned = self._channel.unary_unary(_SOLVE_PRUNED)
+        self._solve_batch = self._channel.unary_unary(_SOLVE_BATCH)
         self._info = self._channel.unary_unary(_INFO)
 
     def solve_buffer(self, buf: np.ndarray, statics: Dict[str, int]) -> np.ndarray:
@@ -82,6 +84,31 @@ class SolverClient:
             return np.array(arena_unpack(resp)["out"])  # own the memory
 
         return self.policy.call(attempt, rpc="Solve",
+                                payload_bytes=len(req),
+                                base_deadline_s=self.timeout)
+
+    def solve_batch_buffers(self, bufs, statics: Dict[str, int]) -> np.ndarray:
+        """B same-shape solves in ONE SolveBatch round trip (the batch
+        frame of ops/hostpack.py); returns the [B, out_size] reply rows.
+        The whole batch is ONE wire attempt to the resilience policy —
+        the breaker counts per RPC, not per batch item."""
+        from ..ops.hostpack import pack_batch_frame
+        req = arena_pack({"frame": pack_batch_frame(bufs, statics)})
+        B = len(bufs)
+
+        def attempt(deadline: float) -> np.ndarray:
+            resp = self._solve_batch(req, timeout=deadline,
+                                     metadata=self._md)
+            out = np.array(arena_unpack(resp)["out"])
+            # demux shape check INSIDE the attempt: a reply that lost
+            # its batch axis (truncated arena, hostile peer) is a failed
+            # attempt, not a crash surfaced to the solve path
+            if out.ndim != 2 or out.shape[0] != B:
+                raise ValueError(
+                    f"SolveBatch reply shape {out.shape} != ({B}, *)")
+            return out
+
+        return self.policy.call(attempt, rpc="SolveBatch",
                                 payload_bytes=len(req),
                                 base_deadline_s=self.timeout)
 
@@ -171,11 +198,6 @@ class RemoteSolver(TPUSolver):
 
     name = "tpu-sidecar"
 
-    #: solve_batch's vmapped multi-solve is a LOCAL dispatch shape; the
-    #: sidecar wire ships one buffer per RPC, so batch items fall back
-    #: to the single-solve path here
-    supports_batch_kernel = False
-
     def __init__(self, address: str, n_max: int = 2048,
                  client: Optional[SolverClient] = None,
                  backend: str = "auto", token: Optional[str] = None,
@@ -197,6 +219,10 @@ class RemoteSolver(TPUSolver):
         #: fetches the server's Info (an old server without the flag —
         #: or a mesh server — never receives the RPC)
         self._pruned_ok: "Optional[bool]" = None
+        #: SolveBatch rides the same gate (no devices==1 requirement:
+        #: the server serves it on a mesh too — jit(vmap) on the default
+        #: device decides identically)
+        self._batch_ok: "Optional[bool]" = None
         from ..solver.route import AliveCache
         self._router.alive = AliveCache(self._ping)
         pol = getattr(self.client, "policy", None)
@@ -269,13 +295,24 @@ class RemoteSolver(TPUSolver):
                 "sidecar Info response malformed (no 'devices' field); "
                 "treating the sidecar as not alive")
             self._pruned_ok = False
+            self._batch_ok = False
             return False
         self._pruned_ok = bool(info.get("pruned", 0)) and devices == 1
+        self._batch_ok = bool(info.get("batch", 0))
         return devices >= 1
 
     @property
     def supports_pruned_kernel(self) -> bool:
         return bool(self._pruned_ok)
+
+    @property
+    def supports_batch_kernel(self) -> bool:
+        """True once the server's Info advertised the SolveBatch
+        capability — solve_batch callers (consolidation's pre-screen,
+        the preference relaxer's re-solves) then ride ONE round trip
+        per shape bucket instead of B. An old server never sees the
+        RPC; its clients keep the single-solve path."""
+        return bool(self._batch_ok)
 
     def _dev_devices(self) -> int:
         """Always the packed wire dispatch: the SERVER owns the
@@ -307,6 +344,40 @@ class RemoteSolver(TPUSolver):
             self._degraded("Solve")
             raise DeviceDispatchFailed(
                 f"sidecar Solve rejected: {code or e}") from e
+        self._wire_evidence("sidecar")
+        return out
+
+    def _dispatch_many(self, bufs, **statics) -> np.ndarray:
+        """B same-shape buffers, ONE SolveBatch round trip — the wire
+        twin of the local vmapped multi-solve. Any failure (transport,
+        breaker, peer rejection) maps to DeviceDispatchFailed; the
+        caller (TPUSolver.solve_batch) then re-solves each item singly,
+        so one bad batch degrades per caller, never crashes, and costs
+        exactly one breaker attempt."""
+        import grpc
+        try:
+            out = self.client.solve_batch_buffers(bufs, statics)
+        except SidecarUnavailable as e:
+            import logging
+            logging.getLogger(__name__).warning(
+                "SolveBatch RPC failed (%s); re-solving the %d items "
+                "singly", e, len(bufs))
+            self._degraded("SolveBatch")
+            raise DeviceDispatchFailed(str(e)) from e
+        except grpc.RpcError as e:
+            import logging
+            code = e.code() if hasattr(e, "code") else None
+            logging.getLogger(__name__).warning(
+                "SolveBatch RPC rejected (%s); re-solving the %d items "
+                "singly", code or e, len(bufs))
+            if code in (grpc.StatusCode.FAILED_PRECONDITION,
+                        grpc.StatusCode.UNIMPLEMENTED):
+                # the peer cannot speak this RPC anymore (rollback):
+                # stop paying a doomed round trip per batch
+                self._batch_ok = False
+            self._degraded("SolveBatch")
+            raise DeviceDispatchFailed(
+                f"sidecar SolveBatch rejected: {code or e}") from e
         self._wire_evidence("sidecar")
         return out
 

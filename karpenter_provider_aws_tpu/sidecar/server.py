@@ -19,14 +19,21 @@ schema; native/codec.cpp packs/parses it on both sides):
                      ops/hostpack.py — appends-only, older shorter
                      vectors are padded server-side}
     response arena: {"out": int64[...] packed kernel outputs}
+- /karpenter.solver.v1.Solver/SolveBatch
+    request  arena: {"frame": int64[...] batch frame, see
+                     ops/hostpack.py pack_batch_frame — B same-shape
+                     solve buffers behind one header}
+    response arena: {"out": int64[B, out_size] — row i answers item i}
 - /karpenter.solver.v1.Solver/Info
     response arena: {"devices": int64[1], "x64": int64[1]}
 """
 
 from __future__ import annotations
 
+import collections
 import logging
 import threading
+import time
 from concurrent import futures
 from typing import Optional
 
@@ -39,6 +46,7 @@ log = logging.getLogger(__name__)
 _SOLVE = "/karpenter.solver.v1.Solver/Solve"
 _SOLVE_TOPO = "/karpenter.solver.v1.Solver/SolveTopo"
 _SOLVE_PRUNED = "/karpenter.solver.v1.Solver/SolvePruned"
+_SOLVE_BATCH = "/karpenter.solver.v1.Solver/SolveBatch"
 _INFO = "/karpenter.solver.v1.Solver/Info"
 
 #: SolvePruned statics vector order (the base-solve statics minus the
@@ -66,21 +74,177 @@ _STATICS_MAX = dict(T=4096, D=64, Z=64, C=8, G=1 << 17, E=1 << 14,
 _MAX_SHAPE_CLASSES = 64
 
 
+class _Pending:
+    """One request riding the coalescing window: its packed buffer, when
+    it arrived, how much of its client deadline it brought, and the slots
+    the dispatching leader fills before flipping `done`."""
+
+    __slots__ = ("buf", "arrival", "deadline_s", "out", "error", "done",
+                 "wait_ms")
+
+    def __init__(self, buf, arrival: float, deadline_s: Optional[float]):
+        self.buf = buf
+        self.arrival = arrival
+        self.deadline_s = deadline_s
+        self.out = None
+        self.error: Optional[BaseException] = None
+        self.done = False
+        self.wait_ms = 0.0
+
+
+class _Coalescer:
+    """Server-side adaptive coalescing: independent single-solve RPCs
+    whose statics hash to the same shape class ride ONE vmapped dispatch.
+
+    Discipline (the deadline-safety contract the tests pin):
+
+    - OFF at queue depth 1 — a lone request dispatches immediately, the
+      window never taxes an idle server.
+    - Batches form naturally while a dispatch is in flight: the per-key
+      busy flag serializes dispatches, so same-shape arrivals queue
+      behind the running kernel and the next leader takes them all
+      (continuous batching — no artificial delay needed to reach B > 1
+      under concurrent load).
+    - At depth >= 2 the leader may top up with ONE bounded wait sized
+      from the global inter-arrival EWMA, hard-capped at `max_window_s`
+      AND at every queued request's share of its own client deadline
+      (`arrival + deadline_frac * deadline`): no request ever waits past
+      its share of the deadline budget it brought.
+    - Per-caller demux and per-caller failure: the leader dispatches
+      outside the lock; a kernel failure lands on every rider as ITS OWN
+      error (each client then degrades to its host twin independently —
+      the batch never takes down a caller that could have been served
+      solo by its twin)."""
+
+    def __init__(self, metrics=None, max_batch: int = 64,
+                 deadline_frac: float = 0.25,
+                 max_window_s: float = 0.025):
+        self._cv = threading.Condition(threading.Lock())
+        self._queues: dict = {}
+        self._busy: set = set()
+        self._gap_ewma: Optional[float] = None
+        self._last_arrival: Optional[float] = None
+        self.metrics = metrics
+        self.max_batch = max_batch
+        self.deadline_frac = deadline_frac
+        self.max_window_s = max_window_s
+        #: evidence for the bench harness: max batch dispatched and
+        #: dispatch counts by mode (solo/batched)
+        self.stats = {"max_batch": 0, "dispatches": 0, "batched": 0}
+
+    def run(self, key, buf, deadline_s, dispatch_many, rpc: str):
+        """Join the shape-class queue and return THIS request's output
+        row. `dispatch_many([bufs]) -> [outs]` runs once per batch, on
+        the leader's thread, outside the lock."""
+        p = _Pending(buf, time.monotonic(), deadline_s)
+        batch = None
+        with self._cv:
+            if self._last_arrival is not None:
+                gap = p.arrival - self._last_arrival
+                self._gap_ewma = gap if self._gap_ewma is None \
+                    else 0.3 * gap + 0.7 * self._gap_ewma
+            self._last_arrival = p.arrival
+            q = self._queues.setdefault(key, collections.deque())
+            q.append(p)
+            self._cv.notify_all()
+            while not p.done:
+                if key not in self._busy and q and q[0] is p:
+                    batch = self._form_batch(key, q, rpc)
+                    self._busy.add(key)
+                    break
+                self._cv.wait(timeout=0.05)
+        if batch is not None:
+            err = None
+            outs = None
+            try:
+                outs = dispatch_many([x.buf for x in batch])
+            except Exception as e:  # kernel/transport failure: per-caller
+                err = e
+                if self.metrics is not None:
+                    self.metrics.inc(
+                        "karpenter_solver_sidecar_coalesce_demux_failures"
+                        "_total", len(batch), labels={"rpc": rpc})
+            with self._cv:
+                self._busy.discard(key)
+                for i, x in enumerate(batch):
+                    if err is not None:
+                        x.error = err
+                    else:
+                        x.out = outs[i]
+                    x.done = True
+                if not self._queues.get(key):
+                    self._queues.pop(key, None)
+                self._cv.notify_all()
+        if p.error is not None:
+            raise p.error
+        return p.out
+
+    def _form_batch(self, key, q, rpc: str):
+        """Lock held. Optionally top up (depth >= 2 only), then pop up
+        to max_batch pendings and record the coalesce evidence."""
+        if len(q) >= 2:
+            now = time.monotonic()
+            window = min(2.0 * (self._gap_ewma or 0.0), self.max_window_s)
+            for x in q:
+                if x.deadline_s is not None:
+                    share = x.arrival + self.deadline_frac * x.deadline_s
+                    window = min(window, share - now)
+            if window > 0:
+                self._cv.wait(timeout=window)
+        n = min(len(q), self.max_batch)
+        batch = [q.popleft() for _ in range(n)]
+        t = time.monotonic()
+        for x in batch:
+            x.wait_ms = (t - x.arrival) * 1e3
+        self.stats["dispatches"] += 1
+        self.stats["max_batch"] = max(self.stats["max_batch"], n)
+        if n > 1:
+            self.stats["batched"] += 1
+        if self.metrics is not None:
+            self.metrics.observe(
+                "karpenter_solver_sidecar_coalesce_batch_size", n,
+                labels={"rpc": rpc})
+            for x in batch:
+                self.metrics.observe(
+                    "karpenter_solver_sidecar_coalesce_wait_ms",
+                    x.wait_ms, labels={"rpc": rpc})
+            self.metrics.inc(
+                "karpenter_solver_sidecar_coalesce_dispatches_total",
+                labels={"rpc": rpc,
+                        "mode": "batched" if n > 1 else "solo"})
+        return batch
+
+
+def _deadline_s(context) -> Optional[float]:
+    """The client deadline this RPC brought, in seconds (None when the
+    peer set none) — the coalescer budgets its window from it."""
+    try:
+        t = context.time_remaining()
+    except Exception:
+        return None
+    if t is None or t <= 0:
+        return None
+    return float(t)
+
+
 class _Handler:
     """Method implementations (bytes in, bytes out).
 
     The executor runs four worker threads, so every piece of
     cross-request state is lock-protected: `_shapes_seen` (the
     compile-cache budget), `_mesh_cache` (the mesh dispatch's compiled
-    kernels), and the in-flight counter graceful stop drains on."""
+    kernels), the coalescer's queues, and the in-flight counter graceful
+    stop drains on."""
 
-    def __init__(self):
+    def __init__(self, metrics=None):
         self._shapes_seen: set = set()
         self._shape_mu = threading.Lock()
         self._mesh_cache: dict = {}
         self._mesh_mu = threading.Lock()
         self._inflight = 0
         self._inflight_cv = threading.Condition(threading.Lock())
+        self.metrics = metrics
+        self._coalescer = _Coalescer(metrics=metrics)
 
     # -- in-flight tracking (graceful stop) -----------------------------
     def tracked(self, fn):
@@ -212,8 +376,36 @@ class _Handler:
                             shape_tag=("pruned", S))
         dims = {k: kv[k] for k in ("T", "D", "Z", "C", "G", "E", "P",
                                    "n_max")}
-        o_buf = solve_scan_packed1_pruned(jnp.asarray(buf), S=S, **dims)
+
+        def dispatch_many(bufs):
+            if len(bufs) == 1:
+                return [np.asarray(solve_scan_packed1_pruned(
+                    jnp.asarray(bufs[0]), S=S, **dims))]
+            from ..ops.ffd_jax import solve_scan_packed1_pruned_many
+            stack = jnp.asarray(np.stack(bufs))
+            return list(np.asarray(solve_scan_packed1_pruned_many(
+                stack, S=S, **dims)))
+
+        key = ("pruned", S) + tuple(kv.values())
+        o_buf = self._dispatch_coalesced(key, np.asarray(buf), context,
+                                         dispatch_many, "SolvePruned")
         return arena_pack({"out": np.asarray(o_buf)})
+
+    def _dispatch_coalesced(self, key, buf, context, dispatch_many,
+                            rpc: str):
+        """Run a validated single-solve request through the coalescing
+        window. A batch dispatch failure lands on every rider as its OWN
+        INTERNAL abort — each client degrades to its host twin
+        independently; the request that caused a bad arena never reaches
+        this point (validation aborts INVALID_ARGUMENT before the queue
+        join, so a malformed caller has no blast radius)."""
+        import grpc
+        try:
+            return self._coalescer.run(key, buf, _deadline_s(context),
+                                       dispatch_many, rpc=rpc)
+        except Exception as e:
+            context.abort(grpc.StatusCode.INTERNAL,
+                          f"batched {rpc} dispatch failed: {e}")
 
     def solve(self, request: bytes, context) -> bytes:
         import jax
@@ -225,9 +417,64 @@ class _Handler:
         kv = self._validate(arrays["statics"], buf, context)
         ndev = len(jax.devices())
         if ndev > 1:
+            # the mesh dispatch shards ONE solve across every device —
+            # it is its own batching axis, so coalescing stays out
             return arena_pack({"out": self._solve_mesh(buf, kv, ndev)})
-        o_buf = solve_scan_packed1(jnp.asarray(buf), **kv)
+
+        def dispatch_many(bufs):
+            if len(bufs) == 1:
+                return [np.asarray(solve_scan_packed1(
+                    jnp.asarray(bufs[0]), **kv))]
+            from ..ops.ffd_jax import solve_scan_packed1_many
+            stack = jnp.asarray(np.stack(bufs))
+            return list(np.asarray(solve_scan_packed1_many(stack, **kv)))
+
+        key = ("solve",) + tuple(kv.values())
+        o_buf = self._dispatch_coalesced(key, np.asarray(buf), context,
+                                         dispatch_many, "Solve")
         return arena_pack({"out": np.asarray(o_buf)})
+
+    def solve_batch(self, request: bytes, context) -> bytes:
+        """B same-shape solves in ONE round trip: validate the batch
+        frame, dispatch the vmapped kernel once, reply with the stacked
+        [B, out_size] rows. Unlike SolvePruned this IS served on a mesh
+        server — jit(vmap) runs on the default device and decides
+        identically, so version skew never changes decisions."""
+        import grpc
+        import jax.numpy as jnp
+
+        from ..ops.ffd_jax import solve_scan_packed1, solve_scan_packed1_many
+        from ..ops.hostpack import STATIC_KEYS, unpack_batch_frame
+        arrays = self._request_arrays(request, context, "frame")
+        try:
+            statics, bufs = unpack_batch_frame(arrays["frame"])
+        except ValueError as e:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                          f"malformed batch frame: {e}")
+        B = len(bufs)
+        # every distinct B compiles its own vmapped kernel, so B joins
+        # the shape-class key and spends a compile-cache slot
+        kv = self._validate([statics[k] for k in STATIC_KEYS], bufs[0],
+                            context, shape_tag=("batch", B))
+        for i in range(1, B):
+            if bufs[i].size != bufs[0].size:
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                              f"batch item {i} size {bufs[i].size} != "
+                              f"item 0 size {bufs[0].size}")
+        if B == 1:
+            o = np.asarray(solve_scan_packed1(jnp.asarray(bufs[0]),
+                                              **kv))[None, :]
+        else:
+            stack = jnp.asarray(np.stack(bufs))
+            o = np.asarray(solve_scan_packed1_many(stack, **kv))
+        if self.metrics is not None:
+            self.metrics.observe(
+                "karpenter_solver_sidecar_coalesce_batch_size", B,
+                labels={"rpc": "SolveBatch"})
+            self.metrics.inc(
+                "karpenter_solver_sidecar_coalesce_dispatches_total",
+                labels={"rpc": "SolveBatch", "mode": "frame"})
+        return arena_pack({"out": o})
 
     def _solve_mesh(self, buf: np.ndarray, kv: dict,
                     ndev: int) -> np.ndarray:
@@ -356,6 +603,10 @@ class _Handler:
             # capability flag: clients gate SolvePruned on it, so an
             # old server (no flag) simply never receives the RPC
             "pruned": np.array([1], dtype=np.int64),
+            # same gating discipline for the multi-arena SolveBatch
+            # frame (served on mesh servers too — jit(vmap) runs on the
+            # default device and decides identically)
+            "batch": np.array([1], dtype=np.int64),
         })
 
 
@@ -375,6 +626,9 @@ def _generic_handler(handler: _Handler):
             if call_details.method == _SOLVE_PRUNED:
                 return grpc.unary_unary_rpc_method_handler(
                     handler.tracked(handler.solve_pruned))
+            if call_details.method == _SOLVE_BATCH:
+                return grpc.unary_unary_rpc_method_handler(
+                    handler.tracked(handler.solve_batch))
             if call_details.method == _INFO:
                 return grpc.unary_unary_rpc_method_handler(
                     handler.tracked(handler.info))
@@ -423,7 +677,7 @@ class SolverServer:
     def __init__(self, address: str = "127.0.0.1", port: int = 0,
                  max_workers: int = 4, token: Optional[str] = None,
                  tls_cert: Optional[bytes] = None,
-                 tls_key: Optional[bytes] = None):
+                 tls_key: Optional[bytes] = None, metrics=None):
         import grpc
         if (tls_cert is None) != (tls_key is None):
             # a security posture must fail CLOSED: half a TLS config is
@@ -436,7 +690,9 @@ class SolverServer:
             interceptors=interceptors,
             options=[("grpc.max_receive_message_length", 256 * 1024 * 1024),
                      ("grpc.max_send_message_length", 256 * 1024 * 1024)])
-        self._handler = _Handler()
+        # metrics: optional utils.metrics.Metrics registry; the coalesce
+        # families (docs/metrics.md) are emitted through it when present
+        self._handler = _Handler(metrics=metrics)
         self._server.add_generic_rpc_handlers(
             (_generic_handler(self._handler),))
         if tls_cert is not None and tls_key is not None:

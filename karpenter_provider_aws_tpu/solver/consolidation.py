@@ -29,6 +29,7 @@ bit-identical to the oracle's, so decisions never diverge
 
 from __future__ import annotations
 
+from collections import OrderedDict
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -135,8 +136,14 @@ class TPUConsolidationEvaluator(ConsolidationEvaluator):
         #: catalog-derived pre-screen tables, reused while the pools'
         #: resolved InstanceTypes lists are unchanged (instancetype
         #: provider returns the same cached list until a seqnum bump —
-        #: instancetype.go:119-130 discipline)
-        self._base_cache: Optional[Tuple[Tuple, dict]] = None
+        #: instancetype.go:119-130 discipline). A small LRU, not a
+        #: single entry: multi-nodepool reconciles interleave distinct
+        #: base snapshots and a one-slot cache would rebuild the tables
+        #: on every alternation. Values hold strong refs (_refs) to the
+        #: nodepools + type lists their key ids point at, so an id can
+        #: never be recycled while its entry lives.
+        self._base_cache: "OrderedDict[Tuple, dict]" = OrderedDict()
+        self._base_cache_cap = 4
 
     @property
     def metrics(self):
@@ -242,8 +249,10 @@ class TPUConsolidationEvaluator(ConsolidationEvaluator):
                              r.greater_than, r.less_than)
                             for r in spec.nodepool.scheduling_requirements()),
                       id(spec.instance_types)))
-        if self._base_cache is not None and self._base_cache[0] == key:
-            return self._base_cache[1]
+        hit = self._base_cache.get(key)
+        if hit is not None:
+            self._base_cache.move_to_end(key)
+            return hit
         types: List = []
         tpos: Dict[int, int] = {}
         pool_rows: List[List[int]] = []
@@ -274,7 +283,9 @@ class TPUConsolidationEvaluator(ConsolidationEvaluator):
                    alloc=alloc, price=price, tcompat={}, padmit={},
                    _refs=[(s.nodepool, s.instance_types)
                           for s in base.nodepools])
-        self._base_cache = (key, tab)
+        self._base_cache[key] = tab
+        while len(self._base_cache) > self._base_cache_cap:
+            self._base_cache.popitem(last=False)
         return tab
 
     def _prescreen_batch(self, base, queries) -> np.ndarray:

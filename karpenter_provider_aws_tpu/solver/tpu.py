@@ -141,6 +141,11 @@ class TPUSolver(Solver):
         #: kernel path, per-dispatch batch size, scan trip count and the
         #: fused/sequential block split of the fused kernel
         self.last_dispatch_stats: dict = {}
+        #: per-phase wall split of the LAST solve (bench evidence):
+        #: encode_ms (snapshot -> tensors, host side), kernel_ms (pack +
+        #: engine dispatch + unpack — the wire round trip for the
+        #: RemoteSolver), decode_ms (tensors -> SolveResult)
+        self.last_phase_stats: dict = {}
         # resolve the native fill at CONSTRUCTION, not mid-solve: the
         # binding's one-shot build attempt (repo convention, codec.py)
         # must never appear as a first-solve latency cliff, and running
@@ -219,6 +224,8 @@ class TPUSolver(Solver):
         if not snapshot.pods:
             return SolveResult(new_nodes=[], existing_assignments={},
                                unschedulable={})
+        import time as _time
+        _t0 = _time.perf_counter()
         enc = encode_snapshot(snapshot, pod_groups=pod_groups)
         # topology detection is per GROUP (~tens), not per pod (~50k): the
         # pod-group signature includes spread/affinity terms, so the group
@@ -242,6 +249,7 @@ class TPUSolver(Solver):
             if not tenc.supported:
                 return self._oracle_fallback(snapshot, "unsupported-topology")
             ex_alloc, ex_used, ex_compat = self._encode_existing(enc, existing)
+            _t_enc = _time.perf_counter()
 
             def host_pour():
                 return self._run_numpy(enc, ex_alloc, ex_used, ex_compat,
@@ -281,8 +289,12 @@ class TPUSolver(Solver):
                     lambda: self._run_jax_topo(enc, tenc))
             if self._grow_if_exhausted(snapshot, leftover, final):
                 return self._solve_core(snapshot, pod_groups=pod_groups)
-            return self._decode(enc, existing, takes, leftover, final)
+            _t_k = _time.perf_counter()
+            res = self._decode(enc, existing, takes, leftover, final)
+            self._set_phase_stats(_t0, _t_enc, _t_k)
+            return res
         ex_alloc, ex_used, ex_compat = self._encode_existing(enc, existing)
+        _t_enc = _time.perf_counter()
         if host_only or len(enc.groups) > self._dev_group_cap(enc):
             # zero-width type axis (host engines only), or beyond the
             # device group caps (base 4096, pruned 16384 — the G-axis
@@ -349,7 +361,24 @@ class TPUSolver(Solver):
                 lambda: self._run_jax(enc, ex_alloc, ex_used, ex_compat))
         if self._grow_if_exhausted(snapshot, leftover, final):
             return self._solve_core(snapshot, pod_groups=pod_groups)
-        return self._decode(enc, existing, takes, leftover, final)
+        _t_k = _time.perf_counter()
+        res = self._decode(enc, existing, takes, leftover, final)
+        self._set_phase_stats(_t0, _t_enc, _t_k)
+        return res
+
+    def _set_phase_stats(self, t0: float, t_enc: float,
+                         t_kernel: float) -> None:
+        """Record the encode/kernel/decode wall split of the solve that
+        just landed (kernel covers pack + dispatch + unpack — for the
+        RemoteSolver that is the wire round trip). Bench reads it next
+        to last_dispatch_stats; a grown re-solve records only its final
+        attempt, matching the headline the caller saw."""
+        import time as _time
+        now = _time.perf_counter()
+        self.last_phase_stats = dict(
+            encode_ms=(t_enc - t0) * 1e3,
+            kernel_ms=(t_kernel - t_enc) * 1e3,
+            decode_ms=(now - t_kernel) * 1e3)
 
     def _dev_group_cap(self, enc: SnapshotEncoding) -> int:
         """Effective device group cap for this snapshot: the pruned
@@ -473,7 +502,9 @@ class TPUSolver(Solver):
         (ops/ffd_jax.py solve_scan_packed1_many = jit(vmap(body))):
         the scan carry batches over B, so B solves of the same shape
         bucket cost one sweep of scan trips plus one h2d/d2h round
-        trip. Local only — the sidecar wire ships one buffer per RPC."""
+        trip. The sidecar's RemoteSolver overrides this with the
+        SolveBatch RPC — B buffers behind one batch frame, still one
+        round trip (docs/solver-design.md "Over the wire")."""
         import jax.numpy as jnp
 
         from ..ops.ffd_jax import solve_scan_packed1_many
@@ -536,8 +567,32 @@ class TPUSolver(Solver):
                 continue
             statics = dict(key)
             n_bucket = self._bucket
-            o = self._dispatch_many([it["buf"] for _, it in items],
-                                    n_max=n_bucket, **statics)
+            # batched dispatches get their OWN router bucket (the
+            # single-solve EWMAs must never absorb amortized-per-item
+            # timings — backend='auto' single solves would mis-route)
+            bkey = self._bucket_key(items[0][1]["enc"],
+                                    items[0][1]["E"]) + ("batch",)
+            import time as _time
+            _t0 = _time.perf_counter()
+            try:
+                o = self._dispatch_many([it["buf"] for _, it in items],
+                                        n_max=n_bucket, **statics)
+            except DeviceDispatchFailed as e:
+                # per-caller degradation: the batch died as ONE wire
+                # attempt (RemoteSolver) or one local dispatch; every
+                # item re-solves singly — each lands on its host twin
+                # independently, none crashes its caller
+                import logging
+                logging.getLogger(__name__).warning(
+                    "batched dispatch failed (%s); re-solving %d items "
+                    "on the single path", e, len(items))
+                self._router.observe(bkey, "dev", DEV_FAILED_MS)
+                for i, _ in items:
+                    results[i] = self.solve(snapshots[i])
+                continue
+            self._router.observe(
+                bkey, "dev",
+                (_time.perf_counter() - _t0) * 1e3 / len(items))
             fb = [it["fused_blocks"] for _, it in items]
             self._record_dispatch(
                 kernel=("fused" if statics["F"] > 1 else "base"),
@@ -575,13 +630,29 @@ class TPUSolver(Solver):
             return None
         existing = sorted(snapshot.existing_nodes, key=lambda n: n.name)
         if self.backend == "auto":
-            # honor measured cost: once the router has timed both sides
-            # of this shape bucket and the host twin wins, batching onto
-            # the device would pessimize what routed() already learned
-            st = self._router.snapshot().get(
-                self._bucket_key(enc, len(existing)))
-            if (st and st["host"] is not None and st["dev"] is not None
-                    and st["host"] <= st["dev"]):
+            # honor measured cost. Batched dispatches learn their OWN
+            # bucket (amortized per-item ms, keyed + ("batch",)): when
+            # it has evidence, compare the single-solve HOST cost
+            # against the BATCHED dev cost — a shape where the host
+            # beats a solo dispatch may still lose to an amortized one.
+            # Without batched evidence, fall back to the single bucket's
+            # verdict: a measured host win (the CPU no-win case of
+            # docs/solver-design.md) stays host-routed, never pessimized
+            snap_st = self._router.snapshot()
+            skey = self._bucket_key(enc, len(existing))
+            st = snap_st.get(skey)
+            bst = snap_st.get(skey + ("batch",))
+            host = st["host"] if st else None
+            bdev = bst.get("dev") if bst else None
+            if bdev is not None and bdev < DEV_FAILED_MS and host is not None:
+                if host <= bdev:
+                    return None
+            elif (st and host is not None and st["dev"] is not None
+                    and host <= st["dev"]):
+                # a parked batch bucket (dispatch died) falls through to
+                # the single bucket's verdict here — dev_engine_usable
+                # above already keeps a dead link out, so recovery
+                # re-measures instead of parking batching forever
                 return None
         ex_alloc, ex_used, ex_compat = self._encode_existing(
             enc, existing)

@@ -224,3 +224,123 @@ def test_seed_sweep_is_deterministic(server, env, seed):
     fps2, log2 = _run_chaos(server.address, env, seed, snaps=snaps)
     assert log1 == log2, f"seed {seed}: nondeterministic fault schedule"
     assert fps1 == fps2, f"seed {seed}: nondeterministic decisions"
+
+class TestBatchWireChaos:
+    """SolveBatch under the injector: the frame RPC degrades PER CALLER
+    (a faulted batch re-solves every item singly — no cross-caller
+    blast radius) and the capability gate keeps old servers frame-free."""
+
+    def _batch_snaps(self, env, tag, n=4):
+        pool = env.nodepool(f"{tag}pool")
+        return [env.snapshot(
+            make_pods(8, cpu=f"{200 + 30 * j}m", memory="1Gi",
+                      prefix=f"{tag}{j}"), [pool]) for j in range(n)]
+
+    @pytest.mark.parametrize("seed", (7, 23, 42))
+    def test_batch_chaos_every_caller_matches_oracle(self, server, env,
+                                                     seed):
+        """Truncate/drop/deadline mid-batch: every caller's decision is
+        fingerprint-identical to the CPU oracle and no grpc.RpcError
+        escapes — a faulted frame never takes down a rider."""
+        import grpc
+        remote = _chaos_remote(server.address, seed)
+        assert remote._ping()  # resolve capability BEFORE the injector
+        assert remote.supports_batch_kernel
+        remote._dev_devices = lambda: 1  # batch-eligible on this client
+        snaps = self._batch_snaps(env, f"bc{seed}")
+        oracle = CPUSolver()
+        refs = [oracle.solve(s).decision_fingerprint() for s in snaps]
+        plan = FaultPlan(seed, p_unavailable=0.3, p_deadline=0.1,
+                         p_latency=0.1, p_truncate=0.3, p_drop=0.2,
+                         max_consecutive=2)
+        with FaultInjector(remote.client, plan) as inj:
+            try:
+                res = remote.solve_batch(snaps)
+            except grpc.RpcError as e:  # pragma: no cover - the bug
+                pytest.fail(f"grpc.RpcError escaped solve_batch: {e}")
+        assert [r.decision_fingerprint() for r in res] == refs
+        assert any(f != "ok" for _, _, f in inj.log)  # chaos ran
+        assert any(rpc == "SolveBatch" for _, rpc, _ in inj.log), \
+            "the frame RPC never rode the chaos wire"
+
+    def test_batch_frame_failure_degrades_per_caller(self, server, env):
+        """The frame RPC failing TERMINALLY (every attempt) fails no
+        caller: each item re-solves singly — its own wire attempts, its
+        own host twin."""
+        import grpc
+
+        from karpenter_provider_aws_tpu.fake.faultwire import \
+            _injected_error
+        remote = _chaos_remote(server.address, seed=11)
+        assert remote._ping()
+        remote._dev_devices = lambda: 1
+
+        def always_down(*a, **k):
+            raise _injected_error(grpc.StatusCode.UNAVAILABLE,
+                                  "injected: frame path dead")
+
+        remote.client._solve_batch = always_down
+        snaps = self._batch_snaps(env, "deg")
+        res = remote.solve_batch(snaps)
+        oracle = CPUSolver()
+        assert [r.decision_fingerprint() for r in res] == \
+            [oracle.solve(s).decision_fingerprint() for s in snaps]
+
+    def test_old_server_never_receives_solve_batch(self, env):
+        """A server whose Info omits the batch flag (the pre-frame
+        build): the client takes the single path — ZERO SolveBatch
+        RPCs — and still matches the oracle."""
+        from karpenter_provider_aws_tpu.native import (arena_pack,
+                                                       arena_unpack)
+        srv = SolverServer().start()
+        try:
+            orig_info = srv._handler.info
+
+            def legacy_info(request, context):
+                d = arena_unpack(orig_info(request, context))
+                d.pop("batch", None)
+                return arena_pack(d)
+
+            srv._handler.info = legacy_info
+            remote = _chaos_remote(srv.address, seed=3)
+            assert remote._ping()
+            assert remote.supports_batch_kernel is False
+            remote._dev_devices = lambda: 1  # eligibility isn't the gate
+            frames = {"n": 0}
+            orig = remote.client._solve_batch
+
+            def counting(*a, **k):
+                frames["n"] += 1
+                return orig(*a, **k)
+
+            remote.client._solve_batch = counting
+            snaps = self._batch_snaps(env, "og")
+            res = remote.solve_batch(snaps)
+            oracle = CPUSolver()
+            assert [r.decision_fingerprint() for r in res] == \
+                [oracle.solve(s).decision_fingerprint() for s in snaps]
+            assert frames["n"] == 0, \
+                "old server received a SolveBatch frame"
+        finally:
+            srv.stop()
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", CHAOS_SEEDS)
+def test_batch_seed_sweep_matches_oracle(server, env, seed):
+    """The 10-seed SolveBatch sweep: under every fixed seed's fault
+    schedule, each batch caller lands fingerprint-identical to the CPU
+    oracle (per-caller degradation, no cross-caller blast radius)."""
+    remote = _chaos_remote(server.address, seed)
+    assert remote._ping()
+    assert remote.supports_batch_kernel
+    remote._dev_devices = lambda: 1
+    pool = env.nodepool(f"bs{seed}pool")
+    snaps = [env.snapshot(
+        make_pods(8, cpu=f"{200 + 30 * j}m", memory="1Gi",
+                  prefix=f"bs{seed}x{j}"), [pool]) for j in range(4)]
+    oracle = CPUSolver()
+    refs = [oracle.solve(s).decision_fingerprint() for s in snaps]
+    with FaultInjector(remote.client, FaultPlan(seed)) as inj:
+        res = remote.solve_batch(snaps)
+    assert [r.decision_fingerprint() for r in res] == refs, \
+        f"seed {seed}: a batch caller diverged from the oracle"

@@ -345,6 +345,22 @@ def emitted():
         except DeviceDispatchFailed:
             pass  # host twin would serve; degraded counter incremented
 
+    # server-side coalesce families: one solo dispatch (batch_size,
+    # wait_ms, dispatches_total) and one failed dispatch (demux
+    # failures land per caller) through the real coalescer
+    from karpenter_provider_aws_tpu.sidecar.server import _Coalescer
+    coal = _Coalescer(metrics=op.metrics)
+    assert coal.run(("mx",), 1, None, lambda bufs: list(bufs),
+                    "Solve") == 1
+
+    def _boom(bufs):
+        raise RuntimeError("parity: batch kernel failure")
+
+    try:
+        coal.run(("mx",), 2, None, _boom, "Solve")
+    except RuntimeError:
+        pass
+
     # catalog membership + offering gauges at the current blacklist
     op.catalog_controller.refresh_gauges()
 

@@ -514,3 +514,305 @@ class TestServeTLS:
             assert client.info(timeout=10.0)["devices"] >= 1
         finally:
             srv.stop()
+
+class TestSolveBatchWire:
+    """SolveBatch: the multi-arena frame RPC. Advertised via Info,
+    demuxes to exactly the bytes B sequential Solve RPCs produce,
+    rejects malformed frames, and the frame codec bounds B."""
+
+    def test_info_advertises_batch(self, server):
+        assert SolverClient(server.address).info().get("batch") == 1
+
+    def test_frame_codec_round_trip_and_rejection(self):
+        from karpenter_provider_aws_tpu.ops.hostpack import (
+            BATCH_MAX_ITEMS, STATIC_KEYS, pack_batch_frame,
+            unpack_batch_frame)
+        rng = np.random.RandomState(5)
+        bufs = [rng.randint(0, 99, size=n).astype(np.int64)
+                for n in (4, 9, 1)]
+        statics = {k: i + 1 for i, k in enumerate(STATIC_KEYS)}
+        st, out = unpack_batch_frame(pack_batch_frame(bufs, statics))
+        assert st == statics
+        assert len(out) == 3
+        assert all((a == b).all() for a, b in zip(out, bufs))
+        frame = pack_batch_frame(bufs, statics)
+        with pytest.raises(ValueError):
+            unpack_batch_frame(frame[:-2])            # torn payload
+        with pytest.raises(ValueError):
+            unpack_batch_frame(frame.astype(np.int32))  # wrong dtype
+        with pytest.raises(ValueError):
+            pack_batch_frame([], statics)             # empty batch
+        with pytest.raises(ValueError):
+            pack_batch_frame([bufs[0]] * (BATCH_MAX_ITEMS + 1), statics)
+
+    def _capture_items(self, env, n_snaps=4):
+        """B same-shape packed buffers captured from the real device
+        dispatch (TestStaticsCompat's pattern), plus their statics."""
+        from karpenter_provider_aws_tpu.ops.hostpack import STATIC_KEYS
+        from karpenter_provider_aws_tpu.solver.route import device_alive
+        assert device_alive()
+        captured = []
+
+        class _Capture(TPUSolver):
+            def _dev_devices(self):
+                return 1
+
+            def _dispatch(self, buf, **statics):
+                captured.append((buf.copy(), dict(statics)))
+                return super()._dispatch(buf, **statics)
+
+        pool = env.nodepool("sbwire")
+        bufs, st0 = [], None
+        for j in range(n_snaps):
+            snap = env.snapshot(
+                make_pods(12, cpu=f"{250 + 40 * j}m", memory="1Gi",
+                          prefix=f"sbw{j}"), [pool])
+            del captured[:]
+            _Capture(backend="jax", n_max=192).solve(snap)
+            assert captured, "packed dispatch never ran"
+            buf, st = captured[-1]
+            assert set(STATIC_KEYS) <= set(st)
+            if st0 is None:
+                st0 = st
+            assert st == st0, "snapshots fell into different shape classes"
+            bufs.append(np.ascontiguousarray(buf, dtype=np.int64))
+        return bufs, st0
+
+    def test_batch_frame_demuxes_to_sequential_solve_bytes(self, server,
+                                                           env):
+        """The acceptance equivalence: one SolveBatch frame returns rows
+        byte-identical to B sequential Solve RPCs over the same wire."""
+        bufs, st = self._capture_items(env)
+        client = SolverClient(server.address)
+        rows = client.solve_batch_buffers(bufs, st)
+        assert rows.shape[0] == len(bufs)
+        for row, buf in zip(rows, bufs):
+            single = client.solve_buffer(buf, st)
+            assert np.asarray(row).tobytes() == \
+                np.asarray(single).tobytes()
+
+    def test_malformed_batch_frame_invalid_argument(self, server):
+        import grpc
+
+        from karpenter_provider_aws_tpu.ops.hostpack import (
+            STATIC_KEYS, pack_batch_frame)
+        client = SolverClient(server.address)
+        with pytest.raises(grpc.RpcError) as ei:
+            client._solve_batch(b"\x00garbage-not-an-arena", timeout=10.0)
+        assert ei.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+        # a valid arena carrying a torn frame
+        frame = pack_batch_frame([np.arange(6, dtype=np.int64)],
+                                 {k: 1 for k in STATIC_KEYS})
+        with pytest.raises(grpc.RpcError) as ei2:
+            client._solve_batch(arena_pack({"frame": frame[:-1]}),
+                                timeout=10.0)
+        assert ei2.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+        assert "malformed batch frame" in ei2.value.details()
+        assert client.info()["devices"] >= 1  # server alive throughout
+
+    def test_remote_solve_batch_single_device_subprocess(self):
+        """End to end on a 1-device jax: RemoteSolver.solve_batch rides
+        ONE SolveBatch RPC, decisions match the CPU oracle, and the
+        frame demuxes byte-identically to B sequential Solve RPCs."""
+        import subprocess
+        import sys
+        code = """
+import sys
+sys.path.insert(0, %r)
+import jax
+jax.config.update('jax_platforms', 'cpu')
+import numpy as np
+from karpenter_provider_aws_tpu.sidecar.server import SolverServer
+from karpenter_provider_aws_tpu.sidecar.client import RemoteSolver
+from karpenter_provider_aws_tpu.fake.environment import Environment, make_pods
+from karpenter_provider_aws_tpu.solver import CPUSolver
+env = Environment()
+pool = env.nodepool('bsub')
+snaps = [env.snapshot(make_pods(10, cpu=f'{200+30*j}m', memory='1Gi',
+                                prefix=f'bs{j}'), [pool])
+         for j in range(4)]
+srv = SolverServer().start()
+remote = RemoteSolver(srv.address, backend='jax', n_max=192)
+remote._router.alive.mark_ok()
+assert remote._ping(), 'ping failed'
+assert remote.supports_batch_kernel, 'batch capability not advertised'
+calls = {'n': 0}
+orig = remote.client._solve_batch
+def counting(*a, **k):
+    calls['n'] += 1
+    return orig(*a, **k)
+remote.client._solve_batch = counting
+res = remote.solve_batch(snaps)
+oracle = CPUSolver()
+refs = [oracle.solve(s).decision_fingerprint() for s in snaps]
+assert [r.decision_fingerprint() for r in res] == refs, 'batch != oracle'
+assert calls['n'] == 1, f"expected ONE SolveBatch RPC, saw {calls['n']}"
+items = [remote._prep_batch_item(s) for s in snaps]
+assert all(it is not None for it in items)
+st = dict(items[0]['statics'], n_max=remote._bucket)
+bufs = [it['buf'] for it in items]
+rows = remote.client.solve_batch_buffers(bufs, st)
+for row, buf in zip(rows, bufs):
+    single = remote.client.solve_buffer(buf, st)
+    assert np.asarray(row).tobytes() == np.asarray(single).tobytes()
+srv.stop()
+print('BATCH-WIRE-OK')
+""" % (str(__import__("pathlib").Path(__file__).resolve().parents[1]),)
+        r = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, text=True, timeout=300,
+                           env={**__import__("os").environ,
+                                "JAX_PLATFORMS": "cpu",
+                                "XLA_FLAGS": ""})
+        assert "BATCH-WIRE-OK" in r.stdout, (r.stdout[-2000:],
+                                             r.stderr[-2000:])
+
+
+class TestCoalescer:
+    """The server-side coalescing discipline (deadline safety, per-
+    caller demux/failure, metrics emission parity) unit-tested against
+    a fake dispatcher."""
+
+    def test_depth_one_dispatches_solo_without_window(self):
+        import time as _t
+
+        from karpenter_provider_aws_tpu.sidecar.server import _Coalescer
+        from karpenter_provider_aws_tpu.utils.metrics import Metrics
+        m = Metrics()
+        c = _Coalescer(metrics=m, max_window_s=0.5)
+        c._gap_ewma = 10.0  # a naive window would wait the full cap
+        t0 = _t.perf_counter()
+        out = c.run(("k",), 3, None,
+                    lambda bufs: [b * 2 for b in bufs], "Solve")
+        wall = _t.perf_counter() - t0
+        assert out == 6
+        assert wall < 0.25, "a lone request paid a coalescing window"
+        assert c.stats == {"max_batch": 1, "dispatches": 1, "batched": 0}
+        assert m.counter(
+            "karpenter_solver_sidecar_coalesce_dispatches_total",
+            labels={"rpc": "Solve", "mode": "solo"}) == 1
+
+    def test_concurrent_same_shape_coalesces_with_demux(self):
+        import threading
+        import time as _t
+
+        from karpenter_provider_aws_tpu.sidecar.server import _Coalescer
+        from karpenter_provider_aws_tpu.utils.metrics import Metrics
+        m = Metrics()
+        c = _Coalescer(metrics=m)
+        calls = []
+
+        def dispatch_many(bufs):
+            calls.append(len(bufs))
+            _t.sleep(0.05)  # hold the key busy so followers queue
+            return [b + 100 for b in bufs]
+
+        results = {}
+
+        def worker(i):
+            results[i] = c.run(("shape",), i, None, dispatch_many,
+                               "Solve")
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert results == {i: i + 100 for i in range(8)}  # demux
+        assert c.stats["max_batch"] >= 2, "concurrent load never batched"
+        assert sum(calls) == 8
+        assert len(calls) == c.stats["dispatches"] < 8
+        # emission parity: one batch_size sample per dispatch, one
+        # wait_ms sample per caller, counter modes partition dispatches
+        bs = m.histograms.get(
+            ("karpenter_solver_sidecar_coalesce_batch_size",
+             (("rpc", "Solve"),)), [])
+        assert len(bs) == c.stats["dispatches"] and sum(bs) == 8
+        wm = m.histograms.get(
+            ("karpenter_solver_sidecar_coalesce_wait_ms",
+             (("rpc", "Solve"),)), [])
+        assert len(wm) == 8
+        solo = m.counter(
+            "karpenter_solver_sidecar_coalesce_dispatches_total",
+            labels={"rpc": "Solve", "mode": "solo"})
+        batched = m.counter(
+            "karpenter_solver_sidecar_coalesce_dispatches_total",
+            labels={"rpc": "Solve", "mode": "batched"})
+        assert solo + batched == c.stats["dispatches"]
+        assert batched == c.stats["batched"] >= 1
+
+    def test_window_capped_by_deadline_share(self):
+        """No request waits past arrival + deadline_frac * deadline:
+        with a 40ms client deadline already half-spent, the top-up wait
+        collapses to zero even when the EWMA asks for the 500ms cap."""
+        import threading
+        import time as _t
+
+        from karpenter_provider_aws_tpu.sidecar.server import _Coalescer
+        c = _Coalescer(max_window_s=0.5)
+        c._gap_ewma = 10.0
+        key = ("k",)
+        with c._cv:
+            c._busy.add(key)  # both requests queue behind a busy key
+        done = []
+        threads = [threading.Thread(
+            target=lambda i=i: done.append(
+                c.run(key, i, 0.04, lambda bufs: list(bufs), "Solve")))
+            for i in range(2)]
+        for t in threads:
+            t.start()
+        _t.sleep(0.05)
+        t0 = _t.perf_counter()
+        with c._cv:
+            c._busy.discard(key)
+            c._cv.notify_all()
+        for t in threads:
+            t.join()
+        wall = _t.perf_counter() - t0
+        assert sorted(done) == [0, 1]
+        assert c.stats["max_batch"] == 2  # the leader took both
+        assert wall < 0.3, \
+            f"deadline share did not cap the window ({wall:.3f}s)"
+
+    def test_kernel_failure_lands_on_every_rider(self):
+        import threading
+        import time as _t
+
+        from karpenter_provider_aws_tpu.sidecar.server import _Coalescer
+        from karpenter_provider_aws_tpu.utils.metrics import Metrics
+        m = Metrics()
+        c = _Coalescer(metrics=m)
+        key = ("k",)
+        with c._cv:
+            c._busy.add(key)  # queue all riders behind a busy key
+
+        def boom(bufs):
+            raise RuntimeError("kernel exploded")
+
+        errors = []
+
+        def worker(i):
+            try:
+                c.run(key, i, None, boom, "SolvePruned")
+            except RuntimeError as e:
+                errors.append((i, str(e)))
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(3)]
+        for t in threads:
+            t.start()
+        _t.sleep(0.05)
+        with c._cv:
+            c._busy.discard(key)
+            c._cv.notify_all()
+        for t in threads:
+            t.join()
+        assert sorted(i for i, _ in errors) == [0, 1, 2]
+        assert all("kernel exploded" in s for _, s in errors)
+        assert m.counter(
+            "karpenter_solver_sidecar_coalesce_demux_failures_total",
+            labels={"rpc": "SolvePruned"}) == 3
+        # the key is released: a later lone request still dispatches
+        assert c.run(key, 9, None,
+                     lambda bufs: [x * 2 for x in bufs],
+                     "SolvePruned") == 18
