@@ -27,11 +27,15 @@ chaos:  ## both seeded fault-injection sweeps (solver wire + cloud seam)
 chaoscloud:  ## the 10-seed cloud-seam chaos sweep alone
 	sh hack/chaoscloud.sh
 
+fuzz-delta:  ## 10-seed mutation-sequence fuzz of the incremental encoder
+	sh hack/fuzzdelta.sh
+
 benchmark:  ## the five BASELINE configs + interruption + batch dispatch
 	python bench.py --all --rounds 100
 	python bench.py --interruption
 	python bench.py --batch-solve
 	python bench.py --sidecar-batch
+	python bench.py --delta-solve
 
 multichip:  ## dry-run the multi-device solve on 8 virtual CPU devices
 	python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
@@ -39,4 +43,4 @@ multichip:  ## dry-run the multi-device solve on 8 virtual CPU devices
 daemon:  ## run the operator against the in-memory cloud
 	python -m karpenter_provider_aws_tpu --cluster-name dev --metrics-port 8080
 
-.PHONY: test test-all scale deflake benchmark multichip daemon chart chaos chaoscloud
+.PHONY: test test-all scale deflake benchmark multichip daemon chart chaos chaoscloud fuzz-delta

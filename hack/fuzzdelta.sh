@@ -1,0 +1,23 @@
+#!/bin/sh
+# Seeded mutation-sequence fuzz for the incremental delta encoder.
+#
+# Runs the `slow`-marked 10-seed matrix of tests/test_delta_encoding.py:
+# each seed replays a long randomized mutation sequence (add/remove/bind
+# pods, launch/terminate/retag nodes, pool in-use drift, forced
+# structural pool swaps every 10th step) through the resident-arena
+# encoder (models/delta.py) and asserts, at EVERY step, byte-equality of
+# every encoding array against a from-scratch encode_snapshot /
+# full_existing_encode oracle of the same snapshot — zero divergence
+# tolerated, including across the forced structural fallbacks.
+#
+# Tier-1 stays fast: it runs the same property on the 3-seed short
+# matrix; this sweep is the long-haul version.
+#
+# Usage: sh hack/fuzzdelta.sh            # the full 10-seed sweep
+#        sh hack/fuzzdelta.sh -x -q     # extra pytest args pass through
+set -e
+cd "$(dirname "$0")/.."
+
+JAX_PLATFORMS=cpu exec python -m pytest \
+    "tests/test_delta_encoding.py::TestDeltaFuzzParity::test_mutation_sequence_parity_slow" \
+    -m slow -q -p no:cacheprovider "$@"

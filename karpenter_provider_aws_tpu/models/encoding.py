@@ -487,7 +487,18 @@ class _RowBank:
     ``idx`` maps a scheduling signature to its row in the banks; warm
     encode assembly is then one fancy-index gather per tensor instead of
     a python loop of per-row copies. Banks double geometrically; rows are
-    immutable once written."""
+    immutable once written.
+
+    Lifetime contract with RESIDENT encodings (models/delta.py): every
+    per-group tensor an encoding carries is a fancy-index GATHER — a
+    copy — and ``g.masks`` holds the mask dict by reference, so neither
+    ``reset()`` (which clears ``idx``/``masks``/``size`` but keeps the
+    matrices and ``pins``, letting later adds overwrite rows from 0) nor
+    ``_grow()`` (which copies the filled prefix into doubled matrices,
+    preserving row order) can mutate an encoding that has already been
+    assembled. ``add()`` writes every bank column of its row, so a
+    recycled post-reset row can never leak a stale field. The regression
+    suite in tests/test_delta_encoding.py pins all three properties."""
 
     def __init__(self, T: int, Z: int, C: int, P: int, D: int, pins=()):
         self.idx: Dict[Tuple, int] = {}
@@ -585,6 +596,34 @@ def _encode_catalog(seen: Dict[Tuple[str, int], InstanceType],
     return enc
 
 
+def resource_vec(r: Resources, D: int, dpos: Mapping[str, int]) -> np.ndarray:
+    """[D] int64 of one ``Resources`` over the encoding's dim order."""
+    v = np.zeros(D, dtype=np.int64)
+    for k, q in r.items():
+        i = dpos.get(k)
+        if i is not None:
+            v[i] = q
+    return v
+
+
+def pool_dynamic_vecs(spec: NodePoolSpec, D: int, dpos: Mapping[str, int]):
+    """(limit_vec, in_use_vec) of one pool — the per-tick-DYNAMIC half of
+    ``PoolEncoding``: ``in_use`` moves every reconcile round and limits
+    can be edited, while everything else in the pool row is stable for
+    as long as the nodepool/catalog objects are. One derivation shared
+    by ``encode_snapshot`` and the incremental patcher (models/delta.py)
+    so the resident arena and a from-scratch encode can never disagree
+    on the pool tensors."""
+    limits = spec.nodepool.limits
+    lim_vec = None
+    if limits is not None:
+        lim_vec = np.full(D, -1, dtype=np.int64)
+        for k, q in limits.items():
+            if k in dpos:
+                lim_vec[dpos[k]] = q
+    return lim_vec, resource_vec(spec.in_use, D, dpos)
+
+
 def encode_snapshot(snapshot: SchedulingSnapshot,
                     pod_groups: Optional[List[Tuple[Tuple, List[Pod]]]] = None
                     ) -> SnapshotEncoding:
@@ -631,12 +670,7 @@ def encode_snapshot(snapshot: SchedulingSnapshot,
     dpos = {d: i for i, d in enumerate(dims)}
 
     def vec(r: Resources) -> np.ndarray:
-        v = np.zeros(len(dims), dtype=np.int64)
-        for k, q in r.items():
-            i = dpos.get(k)
-            if i is not None:
-                v[i] = q
-        return v
+        return resource_vec(r, len(dims), dpos)
 
     # --- catalog tensors (cached while the type objects are stable) ------
     cenc = _encode_catalog(
@@ -661,20 +695,14 @@ def encode_snapshot(snapshot: SchedulingSnapshot,
         # like the oracle's merged-requirement conflict check does
         for ki, mask in universe.group_masks(preqs).items():
             rows &= mask[type_val[:, ki]]
-        limits = spec.nodepool.limits
-        lim_vec = None
-        if limits is not None:
-            lim_vec = np.full(D, -1, dtype=np.int64)
-            for k, q in limits.items():
-                if k in dpos:
-                    lim_vec[dpos[k]] = q
+        lim_vec, iu_vec = pool_dynamic_vecs(spec, D, dpos)
         pools.append(PoolEncoding(
             index=pi, spec=spec, type_rows=rows,
             agz=_zone_allow(preqs, zones, zid_of),
             agc=_ct_allow(preqs),
             masks=universe.group_masks(preqs),
             limit_vec=lim_vec,
-            in_use_vec=vec(spec.in_use)))
+            in_use_vec=iu_vec))
     P = len(pools)
 
     # --- group tensors (signature-keyed row bank) ------------------------

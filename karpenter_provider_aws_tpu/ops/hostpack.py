@@ -139,6 +139,16 @@ def unpack_i32_words(words: np.ndarray, nvals: int) -> np.ndarray:
 def pack_inputs1(arrays: dict, T, D, Z, C, G, E, P, K=0, M=0,
                  F=1) -> np.ndarray:
     """Host: all inputs -> ONE int64 buffer [i64 fields | bitpacked bools]."""
+    return pack_inputs1_state(arrays, T, D, Z, C, G, E, P, K, M, F)[0]
+
+
+def pack_inputs1_state(arrays: dict, T, D, Z, C, G, E, P, K=0, M=0,
+                       F=1):
+    """``pack_inputs1`` that also returns the pre-bitpack bool plane, so
+    a caller can keep ``(buf, bool_flat)`` RESIDENT between solves and
+    patch dirty sections in place (``patch_inputs1``) instead of
+    re-packing the whole arena. The buffer is byte-identical to
+    ``pack_inputs1``'s (which delegates here)."""
     empty = np.zeros(0, dtype=np.int64)
     i64 = np.concatenate([
         np.asarray(arrays.get(nm, empty)).reshape(-1).astype(np.int64)
@@ -146,7 +156,53 @@ def pack_inputs1(arrays: dict, T, D, Z, C, G, E, P, K=0, M=0,
     bl = np.concatenate([arrays[nm].reshape(-1).astype(bool)
                          for nm, _ in in_layout_bool(T, D, Z, C, G, E, P,
                                                      K, M, F)])
-    return np.concatenate([i64, pack_bits(bl)])
+    return np.concatenate([i64, pack_bits(bl)]), bl
+
+
+def patch_inputs1(buf: np.ndarray, bool_flat: np.ndarray, arrays: dict,
+                  dirty_i64, dirty_bool, T, D, Z, C, G, E, P, K=0, M=0,
+                  F=1) -> None:
+    """Patch dirty fields of a RESIDENT packed arena in place.
+
+    ``(buf, bool_flat)`` must be the pair ``pack_inputs1_state``
+    returned for the SAME statics; ``arrays`` carries the new field
+    values (only the dirty names are read). i64 fields overwrite their
+    buffer words directly. Bool fields update the resident bool plane,
+    then re-bitpack only the words covering the field's bit range —
+    sections are not word-aligned, so the repack rounds out to the
+    enclosing words and re-reads the neighbours from the plane, which
+    is exactly why the plane must stay resident. The result is
+    byte-identical to a fresh pack of the same arrays by construction;
+    tests/test_delta_encoding.py fuzzes that equality over random dirty
+    subsets."""
+    lay64 = in_layout_i64(T, D, Z, C, G, E, P, K, M, F)
+    want64 = set(dirty_i64)
+    off = 0
+    for nm, shp in lay64:
+        sz = 1
+        for s in shp:
+            sz *= s
+        if nm in want64 and sz:
+            buf[off:off + sz] = \
+                np.asarray(arrays[nm]).reshape(-1).astype(np.int64)
+        off += sz
+    layb = in_layout_bool(T, D, Z, C, G, E, P, K, M, F)
+    nbits = layout_sizes(layb)
+    wantb = set(dirty_bool)
+    boff = 0
+    for nm, shp in layb:
+        sz = 1
+        for s in shp:
+            sz *= s
+        if nm in wantb and sz:
+            bool_flat[boff:boff + sz] = \
+                np.asarray(arrays[nm]).reshape(-1).astype(bool)
+            w0 = boff >> 6
+            end = min(((boff + sz + 63) >> 6) << 6, nbits)
+            words = pack_bits(np.ascontiguousarray(
+                bool_flat[w0 << 6:end]))
+            buf[off + w0:off + w0 + words.size] = words
+        boff += sz
 
 
 def unpack_outputs1(buf, T, D, Z, C, G, E, P, n_max) -> dict:

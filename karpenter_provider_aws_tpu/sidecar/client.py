@@ -194,7 +194,14 @@ class RemoteSolver(TPUSolver):
     at DEV_FAILED_MS and the liveness cache is marked failed, so solves
     route host WITHOUT paying a wire attempt each; the background
     refresh probe doubles as the half-open probe and restores dev
-    routing when it succeeds."""
+    routing when it succeeds.
+
+    Inherits the incremental encoder's resident packed arena
+    (models/delta.py + _run_jax's pack cache): on warm hit/rows ticks
+    the buffer shipped over the wire is the PATCHED resident arena —
+    no re-encode, no re-pack — while the RPC payload itself stays a
+    full arena (the wire protocol is stateless; the server never holds
+    client residency)."""
 
     name = "tpu-sidecar"
 

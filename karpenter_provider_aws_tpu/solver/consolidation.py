@@ -144,6 +144,12 @@ class TPUConsolidationEvaluator(ConsolidationEvaluator):
         #: never be recycled while its entry lives.
         self._base_cache: "OrderedDict[Tuple, dict]" = OrderedDict()
         self._base_cache_cap = 4
+        #: last-seen epoch of the inner solver's resident delta arena
+        #: (models/delta.py DeltaEncoder.epoch): an epoch bump means the
+        #: structural universe moved (new catalog/pool/daemon objects),
+        #: which is exactly when this identity-keyed cache must drop its
+        #: entries coherently with the resident encoding
+        self._base_epoch: Optional[int] = None
 
     @property
     def metrics(self):
@@ -242,6 +248,16 @@ class TPUConsolidationEvaluator(ConsolidationEvaluator):
         # (objects.py:322-329), and padmit rows depend on both — fold the
         # requirement tuples in explicitly or a requirements-only edit
         # would keep serving stale pool-admission rows
+        # arena coherence: when the inner solver's incremental encoder
+        # rebuilt its resident arena for a structural change, the same
+        # change invalidates these identity-keyed tables — refresh in
+        # lockstep so a delta-patched base never pre-screens a stale
+        # "cluster minus subset" re-solve
+        dep = getattr(self.solver, "_delta", None)
+        if dep is not None and dep.epoch != self._base_epoch:
+            if self._base_epoch is not None:
+                self._base_cache.clear()
+            self._base_epoch = dep.epoch
         key = tuple(
             x for spec in base.nodepools
             for x in (spec.nodepool.hash(),

@@ -1,0 +1,551 @@
+"""Delta-encoding parity: the resident arena vs the from-scratch oracle.
+
+The incremental encoder (models/delta.py) keeps the last solve's
+SnapshotEncoding resident and patches it per tick. Its acceptance bar is
+absolute: at EVERY step of a randomized mutation sequence (add/remove/
+bind pods, launch/terminate/retag nodes, pool in-use drift, forced
+structural pool swaps) the delta-encoded arena must be byte-identical —
+array for array — to ``encode_snapshot`` of the same snapshot, and full
+solves must stay fingerprint-identical to the CPU oracle. The packed
+device arena (ops/hostpack.py patch_inputs1) carries the same contract
+against a fresh ``pack_inputs1``.
+
+Fast seeds run in tier-1; hack/fuzzdelta.sh (``make fuzz-delta``) sweeps
+the 10-seed slow matrix.
+"""
+
+import collections
+import random
+
+import numpy as np
+import pytest
+
+from karpenter_provider_aws_tpu.apis.objects import Taint
+from karpenter_provider_aws_tpu.apis.resources import Resources
+from karpenter_provider_aws_tpu.fake.environment import Environment, make_pods
+from karpenter_provider_aws_tpu.models import encoding as encoding_mod
+from karpenter_provider_aws_tpu.models.delta import (DeltaEncoder,
+                                                     full_existing_encode,
+                                                     structural_key)
+from karpenter_provider_aws_tpu.models.encoding import (_RowBank,
+                                                        encode_snapshot)
+from karpenter_provider_aws_tpu.ops.hostpack import (in_layout_bool,
+                                                     in_layout_i64,
+                                                     pack_inputs1,
+                                                     pack_inputs1_state,
+                                                     patch_inputs1)
+from karpenter_provider_aws_tpu.solver import CPUSolver
+from karpenter_provider_aws_tpu.solver.consolidation import \
+    TPUConsolidationEvaluator
+from karpenter_provider_aws_tpu.solver.route import _device_alive
+from karpenter_provider_aws_tpu.solver.tpu import TPUSolver
+from karpenter_provider_aws_tpu.solver.types import ExistingNode
+
+#: fixed seed matrices — fast ones ride tier-1, the full sweep rides
+#: hack/fuzzdelta.sh (same discipline as the chaos suites)
+FUZZ_SEEDS_FAST = (3, 7, 11)
+FUZZ_SEEDS_SLOW = (3, 7, 11, 17, 23, 31, 42, 57, 71, 97)
+
+_ZONE_L = "topology.kubernetes.io/zone"
+_CT_L = "karpenter.sh/capacity-type"
+
+
+class _Sim:
+    """Seeded mutable cluster: the fuzz suite's mutation palette."""
+
+    def __init__(self, rng: random.Random):
+        self.rng = rng
+        self.env = Environment()
+        self.pools = [self.env.nodepool(f"fz-{i}", weight=i)
+                      for i in range(2)]
+        self.palette = [
+            dict(cpu="500m", memory="1Gi", group="small"),
+            dict(cpu="2", memory="4Gi", group="big"),
+            dict(cpu="250m", memory="512Mi", group="spot",
+                 node_selector={_CT_L: "spot"}),
+            dict(cpu="1", memory="2Gi", group="zoned",
+                 node_selector={_ZONE_L: "us-east-1a"}),
+        ]
+        self.pods = []
+        for _ in range(3):
+            self.pods += self._mk(rng.randint(3, 8))
+        self.nodes = []
+        self.in_use = {}
+        self._nn = 0
+
+    def _mk(self, n):
+        kw = dict(self.rng.choice(self.palette))
+        grp = kw.pop("group")
+        return make_pods(n, prefix=grp, group=grp, **kw)
+
+    def _node(self, labels=None):
+        self._nn += 1
+        lab = {_ZONE_L: "us-east-1a", _CT_L: "on-demand"}
+        lab.update(labels or {})
+        return ExistingNode(
+            name=f"fz-n-{self._nn:04d}", labels=lab,
+            allocatable=Resources.parse(
+                {"cpu": "8", "memory": "32Gi", "pods": "110"}),
+            used=Resources.parse(
+                {"cpu": str(self.rng.randint(0, 3)), "memory": "1Gi"}))
+
+    def mutate(self) -> str:
+        rng = self.rng
+        op = rng.choices(
+            ("add", "rm", "bind", "launch", "terminate", "retag",
+             "pool_inuse", "none"),
+            weights=(28, 18, 10, 12, 8, 8, 10, 6))[0]
+        if op == "add":
+            self.pods += self._mk(rng.randint(1, 6))
+        elif op == "rm" and self.pods:
+            k = min(len(self.pods), rng.randint(1, 4))
+            for _ in range(k):
+                self.pods.pop(rng.randrange(len(self.pods)))
+        elif op == "bind" and self.pods:
+            # pods leave pending and land as node 'used': the reconcile
+            # shape the delta path exists for
+            k = min(len(self.pods), rng.randint(1, 3))
+            del self.pods[:k]
+            if self.nodes:
+                i = rng.randrange(len(self.nodes))
+                n = self.nodes[i]
+                self.nodes[i] = ExistingNode(
+                    name=n.name, labels=dict(n.labels),
+                    allocatable=n.allocatable, taints=n.taints,
+                    used=n.used + Resources.parse({"cpu": "250m"}))
+        elif op == "launch":
+            self.nodes.append(self._node())
+        elif op == "terminate" and self.nodes:
+            self.nodes.pop(rng.randrange(len(self.nodes)))
+        elif op == "retag" and self.nodes:
+            i = rng.randrange(len(self.nodes))
+            n = self.nodes[i]
+            lab = dict(n.labels)
+            lab[_CT_L] = ("spot" if lab.get(_CT_L) == "on-demand"
+                          else "on-demand")
+            self.nodes[i] = ExistingNode(
+                name=n.name, labels=lab, allocatable=n.allocatable,
+                taints=n.taints, used=n.used)
+        elif op == "pool_inuse":
+            name = self.pools[rng.randrange(len(self.pools))][0] \
+                .metadata.name
+            self.in_use[name] = Resources.parse(
+                {"cpu": str(rng.randint(1, 40)), "memory": "4Gi"})
+        return op
+
+    def structural(self):
+        """Swap one pool for a freshly-built object: new nodepool + new
+        resolved catalog ids — the forced full-re-encode transition."""
+        i = self.rng.randrange(len(self.pools))
+        self.pools[i] = self.env.nodepool(
+            f"fz-{i}-gen{self._nn}-{self.rng.randint(0, 9999)}", weight=i)
+
+    def snapshot(self):
+        sn = self.env.snapshot(self.pods, self.pools,
+                               existing_nodes=list(self.nodes))
+        for spec in sn.nodepools:
+            iu = self.in_use.get(spec.nodepool.metadata.name)
+            if iu is not None:
+                spec.in_use = iu
+        return sn
+
+
+def _assert_arena_parity(enc, ex, sn, existing):
+    """Byte-equality of EVERY array the encoding carries vs a
+    from-scratch encode of the same snapshot."""
+    o = encode_snapshot(sn)
+    oex = full_existing_encode(o, existing)
+    assert enc.dims == o.dims
+    assert enc.zones == o.zones
+    assert enc.type_names == o.type_names
+    assert [g.sig for g in enc.groups] == [g.sig for g in o.groups]
+    assert [[p.name for p in g.pods] for g in enc.groups] == \
+        [[p.name for p in g.pods] for g in o.groups]
+    assert np.array_equal(enc.n, o.n)
+    for nm in ("type_val", "A", "avail", "price", "R", "F", "agz", "agc",
+               "admit", "daemon", "F_full"):
+        assert np.array_equal(getattr(enc, nm), getattr(o, nm)), nm
+    assert np.array_equal(enc.fused_runs(), o.fused_runs())
+    assert enc.topo_any == o.topo_any
+    assert enc.mv_keys == o.mv_keys and enc.mv_V == o.mv_V
+    for nm in ("mv_floor", "mv_pairs_t", "mv_pairs_v"):
+        a, b = getattr(enc, nm), getattr(o, nm)
+        assert (a is None) == (b is None), nm
+        if a is not None:
+            assert np.array_equal(a, b), nm
+    assert len(enc.pools) == len(o.pools)
+    for pe, po in zip(enc.pools, o.pools):
+        assert pe.index == po.index
+        assert pe.spec.nodepool is po.spec.nodepool
+        assert np.array_equal(pe.type_rows, po.type_rows)
+        assert np.array_equal(pe.agz, po.agz)
+        assert np.array_equal(pe.agc, po.agc)
+        assert (pe.limit_vec is None) == (po.limit_vec is None)
+        if pe.limit_vec is not None:
+            assert np.array_equal(pe.limit_vec, po.limit_vec)
+        assert np.array_equal(pe.in_use_vec, po.in_use_vec), \
+            pe.spec.nodepool.metadata.name
+    for a, b, nm in zip(ex, oex, ("ex_alloc", "ex_used", "ex_compat")):
+        assert np.array_equal(a, b), nm
+
+
+def _run_fuzz(seed: int, steps: int):
+    rng = random.Random(seed)
+    sim = _Sim(rng)
+    denc = DeltaEncoder()
+    tiers = collections.Counter()
+    for step in range(steps):
+        if step and step % 10 == 0:
+            sim.structural()
+        elif step % 7 == 3:
+            pass  # quiet tick: nothing moves — the memo-hit shape
+        else:
+            sim.mutate()
+        sn = sim.snapshot()
+        existing = sorted(sn.existing_nodes, key=lambda n: n.name)
+        enc, ex, d = denc.encode(sn, None, existing)
+        tiers[d.tier] += 1
+        if step and step % 10 == 0:
+            assert d.tier == "full", (seed, step)
+            assert d.reason.startswith("structural-"), d.reason
+        _assert_arena_parity(enc, ex, sn, existing)
+    # the sequence must actually exercise the warm tiers — a fuzz run
+    # that fell through to full every tick would prove nothing
+    assert tiers["rows"] + tiers["hit"] + tiers["groups"] > 0, tiers
+    return tiers
+
+
+class TestDeltaFuzzParity:
+    @pytest.mark.parametrize("seed", FUZZ_SEEDS_FAST)
+    def test_mutation_sequence_parity(self, seed):
+        _run_fuzz(seed, steps=25)
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("seed", FUZZ_SEEDS_SLOW)
+    def test_mutation_sequence_parity_slow(self, seed):
+        _run_fuzz(seed, steps=60)
+
+    def test_solver_fingerprints_across_churn(self):
+        """Full-solver parity: delta solver vs from-scratch solver vs
+        CPU oracle over a churn sequence (the reconcile-tick replay the
+        bench --delta-solve mode measures)."""
+        rng = random.Random(42)
+        sim = _Sim(rng)
+        s_delta = TPUSolver(backend="numpy")
+        s_full = TPUSolver(backend="numpy", incremental=False)
+        oracle = CPUSolver()
+        for step in range(12):
+            if step == 8:
+                sim.structural()
+            else:
+                sim.mutate()
+            sn = sim.snapshot()
+            f1 = s_delta.solve(sn).decision_fingerprint()
+            f2 = s_full.solve(sn).decision_fingerprint()
+            f3 = oracle.solve(sn).decision_fingerprint()
+            assert f1 == f2 == f3, step
+        assert s_delta._delta.epoch >= 1  # the structural tick landed
+
+
+class TestMemoFastPath:
+    def test_unchanged_snapshot_is_a_hit_with_marker(self):
+        env = Environment()
+        pool = env.nodepool("memo-pool")
+        pods = make_pods(30, cpu="500m", memory="1Gi", prefix="m",
+                         group="m")
+        s = TPUSolver(backend="numpy")
+        r1 = s.solve(env.snapshot(pods, [pool]))
+        assert s.last_phase_stats["cache"] == "full"
+        full_encode_ms = s.last_phase_stats["encode_ms"]
+        r2 = s.solve(env.snapshot(pods, [pool]))
+        assert s.last_phase_stats["cache"] == "hit"
+        assert s.last_phase_stats["patched_rows"] == 0
+        assert r1.decision_fingerprint() == r2.decision_fingerprint()
+        # encode on a hit is the diff walk alone — it must undercut the
+        # cold encode (loose bound: CI jitter)
+        assert s.last_phase_stats["encode_ms"] < max(full_encode_ms, 5.0)
+
+    def test_incremental_off_is_from_scratch_oracle(self):
+        env = Environment()
+        pool = env.nodepool("memo-off")
+        pods = make_pods(10, prefix="mo", group="mo")
+        s = TPUSolver(backend="numpy", incremental=False)
+        s.solve(env.snapshot(pods, [pool]))
+        s.solve(env.snapshot(pods, [pool]))
+        assert s._delta is None
+        assert "cache" not in s.last_phase_stats
+
+    def test_grow_retry_reencode_is_a_hit(self):
+        """The slot-growth re-solve re-enters _solve_core with the same
+        snapshot: the second encode must be served from residency."""
+        env = Environment()
+        pool = env.nodepool("grow-pool", requirements=[
+            {"key": "node.kubernetes.io/instance-type",
+             "operator": "In", "values": ["m5.large"]}])
+        pods = make_pods(8, cpu="1500m", memory="1Gi", prefix="g",
+                         group="g")
+        s = TPUSolver(backend="numpy", n_max=1)
+        r = s.solve(env.snapshot(pods, [pool]))
+        assert len(r.new_nodes) > 1  # growth actually happened
+        assert s._last_delta.tier == "hit"  # final (grown) attempt
+        assert r.decision_fingerprint() == \
+            CPUSolver().solve(env.snapshot(pods, [pool])) \
+            .decision_fingerprint()
+
+
+def _rand_arrays(rng, T, D, Z, C, G, E, P, K, M, F):
+    arrays = {}
+    for nm, shp in in_layout_i64(T, D, Z, C, G, E, P, K, M, F):
+        arrays[nm] = rng.randint(0, 1000, size=shp).astype(np.int64)
+    for nm, shp in in_layout_bool(T, D, Z, C, G, E, P, K, M, F):
+        arrays[nm] = rng.rand(*shp) < 0.5
+    return arrays
+
+
+class TestHostpackPatch:
+    SHAPES = [
+        (5, 8, 3, 3, 4, 2, 2, 0, 0, 1),
+        (7, 8, 2, 3, 8, 0, 4, 2, 5, 1),    # minValues, no existing
+        (6, 8, 3, 3, 16, 4, 2, 0, 0, 4),   # fused plan rides the wire
+        (3, 8, 1, 3, 2, 1, 1, 1, 2, 1),
+    ]
+
+    @pytest.mark.parametrize("shape", SHAPES)
+    def test_patch_matches_fresh_pack(self, shape):
+        """Random dirty subsets patched in place == fresh full pack,
+        byte for byte (the word-aligned bool repack is the tricky
+        part: sections share boundary words with their neighbours)."""
+        T, D, Z, C, G, E, P, K, M, F = shape
+        rng = np.random.RandomState(sum(shape))
+        arrays = _rand_arrays(rng, *shape)
+        buf, bflat = pack_inputs1_state(arrays, *shape)
+        assert np.array_equal(buf, pack_inputs1(arrays, *shape))
+        names64 = [nm for nm, shp in in_layout_i64(*shape)
+                   if int(np.prod(shp))]
+        namesb = [nm for nm, shp in in_layout_bool(*shape)
+                  if int(np.prod(shp))]
+        for _ in range(20):
+            d64 = [nm for nm in names64 if rng.rand() < 0.4]
+            db = [nm for nm in namesb if rng.rand() < 0.4]
+            fresh = _rand_arrays(rng, *shape)
+            for nm in d64 + db:
+                arrays[nm] = fresh[nm]
+            patch_inputs1(buf, bflat, arrays, d64, db, *shape)
+            assert np.array_equal(buf, pack_inputs1(arrays, *shape)), \
+                (d64, db)
+
+    def test_patch_noop_is_identity(self):
+        shape = self.SHAPES[0]
+        rng = np.random.RandomState(1)
+        arrays = _rand_arrays(rng, *shape)
+        buf, bflat = pack_inputs1_state(arrays, *shape)
+        before = buf.copy()
+        patch_inputs1(buf, bflat, arrays, [], [], *shape)
+        assert np.array_equal(buf, before)
+
+
+class TestPackedArenaWire:
+    def test_jax_pack_cache_reuses_and_patches(self):
+        """backend='jax' churn: the resident packed arena is reused
+        across ticks (same buffer object), patched sections stay
+        byte-identical to a fresh pack, and decisions stay fingerprint-
+        identical to the CPU oracle. This is the wire contract: the
+        RemoteSolver ships exactly this buffer."""
+        _device_alive.blocking()
+        env = Environment()
+        pool = env.nodepool("wire-pool")
+        pods = make_pods(70, cpu="500m", memory="1Gi", prefix="w",
+                         group="w")
+        s = TPUSolver(backend="jax")
+        s._dev_devices = lambda: 1  # single-device packed path
+        oracle = CPUSolver()
+        cur = list(pods)
+        buf_id = None
+        patched_ticks = 0
+        for tick in range(5):
+            if tick:
+                cur = cur[1:] + make_pods(
+                    2, cpu="500m", memory="1Gi", prefix=f"w{tick}",
+                    group="w")
+            sn = env.snapshot(cur, [pool])
+            r = s.solve(sn)
+            assert r.decision_fingerprint() == \
+                oracle.solve(sn).decision_fingerprint(), tick
+            pc = s._pack_cache
+            assert pc is not None
+            if buf_id is None:
+                buf_id = id(pc["buf"])
+            else:
+                assert id(pc["buf"]) == buf_id  # resident, never repacked
+                assert s._last_delta.tier == "rows"
+                patched_ticks += 1
+            # arena byte parity vs a from-scratch pad + pack
+            enc = s._delta._enc
+            ex = (s._delta._ex_alloc, s._delta._ex_used,
+                  s._delta._ex_compat)
+            arrays, stt = s._prep_device_inputs(enc, *ex, 1)
+            fresh = pack_inputs1(
+                arrays, stt["T"], stt["D"], stt["Z"], stt["C"],
+                stt["G"], stt["E"], stt["P"], stt["K"], stt["M"],
+                stt["F"])
+            assert np.array_equal(fresh, pc["buf"]), tick
+        assert patched_ticks >= 3
+        # a quiet tick reuses the buffer with zero patch work
+        r = s.solve(env.snapshot(cur, [pool]))
+        assert s._last_delta.tier == "hit"
+        assert id(s._pack_cache["buf"]) == buf_id
+
+    def test_stale_pack_cache_is_rebuilt_not_patched(self):
+        """A buffer lagging the encoder by >1 version (host-served
+        dirty solves in between) must be re-packed: patching can only
+        bridge the LAST delta."""
+        _device_alive.blocking()
+        env = Environment()
+        pool = env.nodepool("stale-pool")
+        pods = make_pods(50, cpu="500m", memory="1Gi", prefix="st",
+                         group="st")
+        s = TPUSolver(backend="jax")
+        s._dev_devices = lambda: 1
+        s.solve(env.snapshot(pods, [pool]))
+        pc = s._pack_cache
+        assert pc is not None
+        # simulate host-served dirty solves: age the recorded version
+        pc["version"] -= 2
+        cur = pods[1:] + make_pods(2, cpu="500m", memory="1Gi",
+                                   prefix="st2", group="st")
+        sn = env.snapshot(cur, [pool])
+        r = s.solve(sn)
+        assert s._pack_cache["version"] == s._delta.version
+        assert r.decision_fingerprint() == \
+            CPUSolver().solve(sn).decision_fingerprint()
+        enc = s._delta._enc
+        ex = (s._delta._ex_alloc, s._delta._ex_used, s._delta._ex_compat)
+        arrays, stt = s._prep_device_inputs(enc, *ex, 1)
+        fresh = pack_inputs1(
+            arrays, stt["T"], stt["D"], stt["Z"], stt["C"], stt["G"],
+            stt["E"], stt["P"], stt["K"], stt["M"], stt["F"])
+        assert np.array_equal(fresh, s._pack_cache["buf"])
+
+
+class TestRowBankResidency:
+    """Satellite audit: _RowBank.reset()/_grow() vs pins and resident
+    encodings (see the class docstring's lifetime contract)."""
+
+    def _row_args(self, i, T=3, Z=2, C=3, P=2, D=4):
+        return (np.full(D, i, np.int64), {}, np.ones(T, bool),
+                np.ones(Z, bool), np.ones(C, bool), np.zeros(P, bool),
+                np.full((P, D), i, np.int64), bool(i % 2))
+
+    def test_grow_preserves_rows_order_and_pins(self):
+        bank = _RowBank(T=3, Z=2, C=3, P=2, D=4, pins=("pin-a", "pin-b"))
+        for i in range(600):  # forces two geometric doublings past 256
+            bi = bank.add(("sig", i), *self._row_args(i))
+            assert bi == i
+        assert bank.pins == ("pin-a", "pin-b")
+        for i in range(600):
+            assert bank.idx[("sig", i)] == i
+            assert (bank.R[i] == i).all()
+            assert (bank.daemon[i] == i).all()
+            assert bool(bank.topo[i]) == bool(i % 2)
+
+    def test_reset_keeps_pins_and_matrices_and_gathered_copies(self):
+        bank = _RowBank(T=3, Z=2, C=3, P=2, D=4, pins=("pin",))
+        for i in range(10):
+            bank.add(("sig", i), *self._row_args(i))
+        gathered = bank.R[np.arange(10)]  # what an encoding would hold
+        snapshot_rows = gathered.copy()
+        bank.reset()
+        assert bank.pins == ("pin",)
+        assert bank.size == 0 and not bank.idx and not bank.masks
+        # post-reset adds overwrite from row 0 — gathers are copies, so
+        # a resident encoding's tensors cannot be corrupted
+        bank.add(("new", 0), *self._row_args(77))
+        assert (bank.R[0] == 77).all()
+        assert np.array_equal(gathered, snapshot_rows)
+
+    def test_cap_reset_between_encodes_keeps_parity(self, monkeypatch):
+        """Force the bank over _GROUP_ROW_CACHE_CAP so encode_snapshot
+        resets it mid-lifetime; resident encodings and follow-up delta
+        encodes must stay byte-identical to the oracle throughout."""
+        monkeypatch.setattr(encoding_mod, "_GROUP_ROW_CACHE_CAP", 4)
+        env = Environment()
+        pool = env.nodepool("bankcap-pool")
+        denc = DeltaEncoder()
+        groups = ["a", "b", "c", "d", "e", "f"]
+        pods = []
+        for g in groups:
+            pods += make_pods(2, cpu="500m", memory="1Gi", prefix=g,
+                              group=g)
+        sn1 = env.snapshot(pods, [pool])
+        enc1, ex1, _ = denc.encode(sn1, None, [])
+        r1 = enc1.R.copy()
+        # new sig set -> encode_snapshot rides the (now capped) bank
+        pods2 = pods + make_pods(2, cpu="2", memory="4Gi", prefix="g2",
+                                 group="g2")
+        sn2 = env.snapshot(pods2, [pool])
+        enc2, ex2, d2 = denc.encode(sn2, None, [])
+        assert d2.tier == "groups"
+        _assert_arena_parity(enc2, ex2, sn2, [])
+        assert np.array_equal(enc1.R, r1)  # resident copy untouched
+
+
+class TestConsolidationCoherence:
+    def test_structural_epoch_clears_base_cache(self):
+        env = Environment()
+        pool = env.nodepool("cons-pool")
+        pods = make_pods(12, cpu="500m", memory="1Gi", prefix="c",
+                         group="c")
+        ev = TPUConsolidationEvaluator(backend="numpy")
+        sn = env.snapshot(pods, [pool])
+        ev.solver.solve(sn)
+        t1 = ev._base_tables(sn)
+        assert len(ev._base_cache) == 1
+        assert ev._base_tables(sn) is t1  # warm hit
+        # same-structure solves must NOT clear the cache
+        ev.solver.solve(env.snapshot(pods[1:], [pool]))
+        assert ev._base_tables(sn) is t1
+        # structural change: new pool objects -> epoch bump -> coherent
+        # refresh of the identity-keyed tables
+        pool_b = env.nodepool("cons-pool-b")
+        sn_b = env.snapshot(pods, [pool_b])
+        epoch_before = ev.solver._delta.epoch
+        ev.solver.solve(sn_b)
+        assert ev.solver._delta.epoch == epoch_before + 1
+        t2 = ev._base_tables(sn_b)
+        assert t2 is not t1
+        assert len(ev._base_cache) == 1  # old entry dropped, not evicted
+
+
+class TestStructuralKey:
+    def test_zone_map_change_is_structural(self):
+        env = Environment()
+        pool = env.nodepool("zk-pool")
+        pods = make_pods(4, prefix="zk", group="zk")
+        sn1 = env.snapshot(pods, [pool])
+        sn2 = env.snapshot(pods, [pool])
+        assert structural_key(sn1) == structural_key(sn2)
+        sn2.zones = dict(sn2.zones, **{"us-east-1z": "use1-zz"})
+        assert structural_key(sn1) != structural_key(sn2)
+        denc = DeltaEncoder()
+        denc.encode(sn1, None, [])
+        _, _, d = denc.encode(sn2, None, [])
+        assert d.tier == "full" and d.reason == "structural-zones"
+
+    def test_taint_change_forces_full_reencode(self):
+        """A nodepool edit arrives as a NEW NodePool object (provider
+        discipline) — the delta path must fall back, and decisions must
+        track the new taints."""
+        env = Environment()
+        pool = env.nodepool("tk-pool")
+        pods = make_pods(6, prefix="tk", group="tk")
+        s = TPUSolver(backend="numpy")
+        r1 = s.solve(env.snapshot(pods, [pool]))
+        assert r1.new_nodes
+        tainted = env.nodepool(
+            "tk-pool", taints=[Taint("dedicated", "NoSchedule", "x")])
+        sn2 = env.snapshot(pods, [tainted])
+        r2 = s.solve(sn2)
+        assert s._last_delta.tier == "full"
+        assert s._last_delta.reason.startswith("structural-")
+        assert r2.decision_fingerprint() == \
+            CPUSolver().solve(sn2).decision_fingerprint()

@@ -361,6 +361,25 @@ def emitted():
     except RuntimeError:
         pass
 
+    # incremental-encoder tier census on one resident solver: cold full,
+    # memo hit, rows-tier patch (patched_rows histogram), then a
+    # structural pool swap (full + fallback)
+    from karpenter_provider_aws_tpu.fake.environment import \
+        Environment as _DeltaEnv
+    denv = _DeltaEnv()
+    dpool = denv.nodepool("parity-delta")
+    dsolver = TPUSolver(backend="numpy")
+    dsolver.metrics = op.metrics
+    dpods = make_pods(6, cpu="500m", memory="1Gi", prefix="pd",
+                      group="pd")
+    dsolver.solve(denv.snapshot(dpods, [dpool]))   # full {reason: cold}
+    dsolver.solve(denv.snapshot(dpods, [dpool]))   # delta {tier: hit}
+    churned = dpods[1:] + make_pods(1, cpu="500m", memory="1Gi",
+                                    prefix="pd-churn", group="pd")
+    dsolver.solve(denv.snapshot(churned, [dpool]))  # rows + patched_rows
+    dsolver.solve(denv.snapshot(
+        dpods, [denv.nodepool("parity-delta-b")]))  # structural fallback
+
     # catalog membership + offering gauges at the current blacklist
     op.catalog_controller.refresh_gauges()
 
