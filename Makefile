@@ -27,6 +27,9 @@ chaos:  ## both seeded fault-injection sweeps (solver wire + cloud seam)
 chaoscloud:  ## the 10-seed cloud-seam chaos sweep alone
 	sh hack/chaoscloud.sh
 
+chaos-tenant:  ## hostile-tenant isolation sweep (quiet tenant vs hammer)
+	sh hack/chaostenant.sh
+
 fuzz-delta:  ## 10-seed mutation-sequence fuzz of the incremental encoder
 	sh hack/fuzzdelta.sh
 
@@ -36,6 +39,7 @@ benchmark:  ## the five BASELINE configs + interruption + batch dispatch
 	python bench.py --batch-solve
 	python bench.py --sidecar-batch
 	python bench.py --delta-solve
+	python bench.py --tenant-mix
 
 multichip:  ## dry-run the multi-device solve on 8 virtual CPU devices
 	python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
@@ -43,4 +47,4 @@ multichip:  ## dry-run the multi-device solve on 8 virtual CPU devices
 daemon:  ## run the operator against the in-memory cloud
 	python -m karpenter_provider_aws_tpu --cluster-name dev --metrics-port 8080
 
-.PHONY: test test-all scale deflake benchmark multichip daemon chart chaos chaoscloud fuzz-delta
+.PHONY: test test-all scale deflake benchmark multichip daemon chart chaos chaoscloud chaos-tenant fuzz-delta

@@ -450,6 +450,147 @@ def run_sidecar_batch_bench(batch=8, rounds=30):
         server.stop(grace=1.0)
 
 
+def run_tenant_mix_bench(rounds=30, light_tenants=3, flood_threads=4):
+    """The multi-tenant fairness bench: ONE sidecar serving a heavy
+    tenant (flood_threads concurrent clients hammering nonstop, capped
+    by an admission quota) and N light tenants solving at a measured
+    cadence. Three claims:
+
+    - isolation: the DRR lanes bound what a light request can wait
+      behind — at most one in-service dispatch plus one turn per ACTIVE
+      LANE (the heavy tenant is one lane no matter how deep its
+      backlog). The checkable bound is therefore
+      (light_tenants + 2) * solo_p99 + window, with slack for the
+      shared loopback core — under FIFO the heavy backlog depth, not
+      the lane count, would multiply the light tenant's wait;
+    - quota enforcement: the heavy tenant's overrun is SHED with
+      RESOURCE_EXHAUSTED (counted per tenant), never queued;
+    - accounting: per-tenant admitted/shed counters partition the load.
+
+    Loopback on one process: read ratios, not absolute ms."""
+    import threading
+
+    from karpenter_provider_aws_tpu.fake.environment import Environment
+    from karpenter_provider_aws_tpu.sidecar.client import (RemoteSolver,
+                                                           SolverClient)
+    from karpenter_provider_aws_tpu.sidecar.resilience import (
+        ResiliencePolicy, RetryPolicy)
+    from karpenter_provider_aws_tpu.sidecar.server import SolverServer
+    from karpenter_provider_aws_tpu.tenancy.admission import TenantQuota
+    from karpenter_provider_aws_tpu.utils.metrics import Metrics
+
+    rounds = min(rounds, 40)
+    env = Environment()
+    metrics = Metrics()
+    # max_workers above the flood depth: fairness must be decided by the
+    # DRR queue in front of dispatch, not by grpc's worker pool starving
+    # the light tenants before they ever reach it
+    server = SolverServer(
+        metrics=metrics, max_workers=flood_threads + light_tenants + 4,
+        quotas={"heavy": TenantQuota(rate=20.0, burst=4,
+                                     max_inflight=2)},
+        compile_cache=False).start()
+    try:
+        remote = RemoteSolver(server.address, n_max=64, backend="jax")
+        remote._router.alive.mark_ok()
+        if not remote._ping():
+            raise SystemExit("loopback sidecar did not answer Info")
+        snaps = build_batch_snapshots(env, batch=1, n_sigs=8, per=2)
+        item = remote._prep_batch_item(snaps[0])
+        if item is None:
+            raise SystemExit("snapshot fell off the packed-buffer path")
+        st = dict(item["statics"], n_max=remote._bucket)
+        buf = item["buf"]
+
+        light = [SolverClient(server.address, tenant=f"light{i}")
+                 for i in range(light_tenants)]
+        light[0].solve_buffer(buf, st)  # warm the kernel once
+
+        cooldown(2.0)
+        baseline = calib_baseline()
+        solo_ms, hot_solo = guarded_rounds(
+            lambda: light[0].solve_buffer(buf, st), rounds, baseline)
+        solo_p50, solo_p99 = _percentiles(solo_ms)
+
+        stop = threading.Event()
+
+        def flood():
+            c = SolverClient(
+                server.address, tenant="heavy",
+                policy=ResiliencePolicy(retry=RetryPolicy(
+                    max_attempts=1, sleep=lambda s: None)))
+            while not stop.is_set():
+                try:
+                    c.solve_buffer(buf, st)
+                except Exception:
+                    # sheds ARE the adversarial mix; the brief pause
+                    # keeps a shed storm from busy-spinning the pinned
+                    # core the server kernels share
+                    time.sleep(0.02)
+
+        floods = [threading.Thread(target=flood, daemon=True)
+                  for _ in range(flood_threads)]
+        for t in floods:
+            t.start()
+        time.sleep(0.2)  # let the flood reach steady state
+        # untimed mixed warm-up: concurrent flood + light traffic makes
+        # the coalescer form batch sizes the solo phase never saw, and
+        # the first dispatch at each size JIT-compiles — pay that here,
+        # not inside a timed sample
+        for _ in range(3):
+            for c in light:
+                c.solve_buffer(buf, st)
+
+        mix_ms = {c: [] for c in range(light_tenants)}
+        for _ in range(rounds):
+            for ci, c in enumerate(light):
+                t0 = time.perf_counter()
+                c.solve_buffer(buf, st)
+                mix_ms[ci].append((time.perf_counter() - t0) * 1000)
+        stop.set()
+        for t in floods:
+            t.join(timeout=30)
+
+        window_ms = server._handler._coalescer.max_window_s * 1000
+        per_tenant = {}
+        worst_p99 = 0.0
+        for ci in mix_ms:
+            p50, p99 = _percentiles(mix_ms[ci])
+            per_tenant[f"light{ci}"] = {"p50_ms": p50, "p99_ms": p99}
+            worst_p99 = max(worst_p99, p99)
+
+        def _sum(name, **match):
+            return sum(v for (n, lbls), v in metrics.counters.items()
+                       if n == name
+                       and all(dict(lbls).get(k) == w
+                               for k, w in match.items()))
+
+        return {
+            "config": "tenant-mix",
+            "light_tenants": light_tenants,
+            "flood_threads": flood_threads,
+            "rounds": rounds, "hot_rejected": hot_solo,
+            "solo_p50_ms": solo_p50, "solo_p99_ms": solo_p99,
+            "mix_per_tenant": per_tenant,
+            "mix_worst_p99_ms": worst_p99,
+            "coalesce_window_ms": round(window_ms, 1),
+            # the isolation claim, as a checkable bit: a light request
+            # waits at most one turn per active lane (heavy is ONE
+            # lane), times 1.5 slack for the shared loopback core
+            "fair": worst_p99 <= (light_tenants + 2) * solo_p99 * 1.5
+            + window_ms,
+            "heavy_admitted": _sum(
+                "karpenter_solver_tenant_admitted_total", tenant="heavy"),
+            "heavy_shed": _sum(
+                "karpenter_solver_tenant_shed_total", tenant="heavy"),
+            "light_shed": sum(
+                _sum("karpenter_solver_tenant_shed_total",
+                     tenant=f"light{i}") for i in range(light_tenants)),
+        }
+    finally:
+        server.stop(grace=1.0)
+
+
 def run_delta_bench(backend="numpy", pods=5000, ticks=120, churn=0.01,
                     rounds_ignored=None):
     """Incremental-encoding replay: the reconcile-loop shape the delta
@@ -1367,6 +1508,11 @@ def main():
                     help="bench the multi-arena wire: B Solve round "
                          "trips vs one SolveBatch RPC on a loopback "
                          "sidecar, plus coalescing evidence")
+    ap.add_argument("--tenant-mix", action="store_true",
+                    help="multi-tenant fairness: a quota-capped heavy "
+                         "tenant floods a loopback sidecar while light "
+                         "tenants solve; reports per-tenant p99 and "
+                         "shed counts")
     ap.add_argument("--probe-device", action="store_true",
                     help="link-vs-kernel decomposition of the device path")
     ap.add_argument("--device-kernel", action="store_true",
@@ -1401,6 +1547,10 @@ def main():
     if args.sidecar_batch:
         print(json.dumps(run_sidecar_batch_bench(
             batch=args.batch, rounds=min(args.rounds, 30))))
+        return
+    if args.tenant_mix:
+        print(json.dumps(run_tenant_mix_bench(
+            rounds=min(args.rounds, 40))))
         return
     if args.probe_device:
         run_device_probe(args.pods)

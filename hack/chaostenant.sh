@@ -1,0 +1,21 @@
+#!/bin/sh
+# Hostile-tenant isolation sweep.
+#
+# Plays a TenantHammer (poison frames, 1ms-deadline storms, token-bucket
+# exhaustion bursts, all billed to one x-solver-tenant label) against a
+# live sidecar while a quiet tenant keeps solving. Two layers:
+#
+# - the single-seed deep test: byte-identical quiet-tenant decisions,
+#   bounded p99 under attack, sheds answered with RESOURCE_EXHAUSTED +
+#   an x-retry-after-ms hint over the real wire;
+# - the 5-seed sweep: decision integrity under every seeded attack
+#   schedule (the `slow`-marked matrix, excluded from tier-1).
+#
+# Usage: sh hack/chaostenant.sh           # deep test + seed sweep
+#        sh hack/chaostenant.sh -x -q    # extra pytest args pass through
+set -e
+cd "$(dirname "$0")/.."
+
+JAX_PLATFORMS=cpu exec python -m pytest \
+    "tests/test_faultwire.py::TestTwoTenantChaos" \
+    -q -p no:cacheprovider "$@"

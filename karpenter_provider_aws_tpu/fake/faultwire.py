@@ -27,6 +27,15 @@ Determinism: faults are drawn from ``random.Random(seed)`` in call
 order. Keep every wire call on ONE thread (backend='jax' with the
 liveness verdict pre-resolved) and the same seed replays the same fault
 schedule — ``hack/chaoswire.sh`` fails CI on any divergence.
+
+:class:`TenantHammer` is the multi-tenant counterpart: instead of
+faulting the wire between one client and the server, it plays a HOSTILE
+TENANT against a live server — poison frames (unparseable arenas),
+deadline storms (1ms client deadlines), and quota-exhaustion bursts,
+all billed to one ``x-solver-tenant`` label. The isolation contract
+(tests/test_faultwire.py, ``hack/chaostenant.sh``): a quiet tenant
+sharing the server keeps byte-identical decisions and a bounded p99
+while the hammer runs.
 """
 
 from __future__ import annotations
@@ -187,3 +196,94 @@ class FaultInjector:
 
     def __exit__(self, *exc) -> None:
         self.uninstall()
+
+
+#: attack kinds a TenantHammer cycles through (seeded draw order)
+ATTACK_KINDS = ("poison", "deadline", "burst")
+
+
+class TenantHammer:
+    """An adversarial tenant against a live sidecar server.
+
+    Three attack shapes, drawn seeded per iteration:
+
+    - ``poison``   — an unparseable request arena (server answers
+                     INVALID_ARGUMENT; the request still spends the
+                     tenant's admission token)
+    - ``deadline`` — a 1ms client deadline (the call dies client-side
+                     mid-flight; the server's handler still runs)
+    - ``burst``    — 5 back-to-back poison frames, the quota-exhaustion
+                     case: past the token-bucket burst the server sheds
+                     with RESOURCE_EXHAUSTED + a retry-after hint
+
+    Every call carries ``x-solver-tenant: <tenant>`` so the server's
+    admission layer bills the whole storm to this tenant. ``outcomes``
+    counts the grpc status codes observed (the test asserts the storm
+    really drew INVALID_ARGUMENT / DEADLINE_EXCEEDED /
+    RESOURCE_EXHAUSTED). Run inline with :meth:`run` or as a background
+    thread via :meth:`start` / :meth:`stop`.
+    """
+
+    def __init__(self, address: str, tenant: str = "hammer",
+                 seed: int = 0):
+        import random
+        self.address = address
+        self.tenant = tenant
+        self._rng = random.Random(seed)
+        self._mu = threading.Lock()
+        self.outcomes: dict = {}
+        self.attacks: List[str] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._channel = None
+
+    def _count(self, code: str) -> None:
+        with self._mu:
+            self.outcomes[code] = self.outcomes.get(code, 0) + 1
+
+    def _fire(self, timeout: float) -> None:
+        import grpc
+        try:
+            self._solve(b"\x00poison-frame", timeout=timeout,
+                        metadata=(("x-solver-tenant", self.tenant),))
+            self._count("OK")
+        except grpc.RpcError as e:
+            code = e.code() if hasattr(e, "code") else None
+            self._count(code.name if code is not None else "UNKNOWN")
+
+    def run(self, n_attacks: int = 30) -> dict:
+        """Fire `n_attacks` seeded attacks (or until stop() in thread
+        mode); returns the outcome counts."""
+        import grpc
+        if self._channel is None:
+            self._channel = grpc.insecure_channel(self.address)
+            self._solve = self._channel.unary_unary(
+                "/karpenter.solver.v1.Solver/Solve")
+        for _ in range(n_attacks):
+            if self._stop.is_set():
+                break
+            kind = self._rng.choice(ATTACK_KINDS)
+            self.attacks.append(kind)
+            if kind == "poison":
+                self._fire(timeout=5.0)
+            elif kind == "deadline":
+                self._fire(timeout=0.001)
+            else:  # burst: overrun the token bucket
+                for _ in range(5):
+                    self._fire(timeout=5.0)
+        return dict(self.outcomes)
+
+    def start(self, n_attacks: int = 10 ** 6) -> "TenantHammer":
+        self._thread = threading.Thread(
+            target=self.run, args=(n_attacks,), daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> dict:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+        if self._channel is not None:
+            self._channel.close()
+            self._channel = None
+        return dict(self.outcomes)

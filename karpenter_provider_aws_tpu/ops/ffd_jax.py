@@ -23,6 +23,30 @@ carried int32 on the wire — two lanes per int64 word — halving the
 dominant [G, N] d2h tensor without touching any decision-bearing
 comparison.
 
+Inert padding: the kernel guarantees that enlarging any static axis
+with neutral elements cannot change a decision, which is what lets the
+host packer pad G/E/n_max and the sidecar's bucketing layer
+(tenancy/bucketing.py) pad every bucketable axis to a shared shape
+class. The guarantee is structural, not incidental — every read path
+has a masking guard the neutral element hits:
+
+- a group with ``n=0`` and all-False masks scans through without
+  taking a slot or opening a node (the fill prefix-sum is 0 and the
+  new-node count is 0);
+- a type with ``A=0``/all-False availability never survives the
+  candidate mask, because eligibility ANDs F, avail_zc, agz, agc and
+  pool_types before any headroom compare;
+- a zero-allocatable existing row's headroom is floor(0/R) = 0 with
+  ``ex_compat=False`` masking it besides — a dead row is never chosen;
+- an all-zero ``R`` column contributes ``BIG`` (masked) to every
+  min-over-dims headroom, so new resource dims with no demand never
+  constrain a fit; pool budgets treat ``limit=-1`` as unlimited in
+  those columns.
+
+Any new read path added to the kernel must preserve these guards —
+tests/test_tenancy.py fuzzes bucket-padded solves against solo solves
+for byte-identical outputs, and will catch a violation.
+
 Fused-group scan (``_solve_fused``): the encoder's run detection
 (models/encoding.py independent_runs) marks maximal runs of groups whose
 admit rows — and, when existing nodes are present, ex_compat rows — are
@@ -62,8 +86,14 @@ _CACHE_DIR = _os.environ.get(
     _os.path.join(_os.path.dirname(_os.path.dirname(_os.path.dirname(
         _os.path.abspath(__file__)))), ".jax_cache"))
 try:
-    jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    # a DEFAULT, not a mandate: the sidecar server configures an
+    # explicit (possibly shared) cache dir at startup via
+    # tenancy/compilecache.py, and this module imports lazily at first
+    # solve — after that configuration, which must win
+    if jax.config.jax_compilation_cache_dir is None:
+        jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          0.5)
 except Exception:  # older jax without the knobs: in-memory cache only
     pass
 

@@ -136,6 +136,19 @@ def unpack_i32_words(words: np.ndarray, nvals: int) -> np.ndarray:
     return out[:nvals].astype(np.int64)
 
 
+def pad_to(a: np.ndarray, shape, fill=0) -> np.ndarray:
+    """Grow ``a`` to ``shape`` by appending ``fill`` along every axis
+    (never shrinks). The tenancy layer's bucketed-padding path
+    (tenancy/bucketing.py) builds its inert pad rows with this so the
+    pad geometry lives next to the layouts it must agree with."""
+    a = np.asarray(a)
+    if tuple(a.shape) == tuple(shape):
+        return a
+    out = np.full(shape, fill, dtype=a.dtype)
+    out[tuple(slice(0, s) for s in a.shape)] = a
+    return out
+
+
 def pack_inputs1(arrays: dict, T, D, Z, C, G, E, P, K=0, M=0,
                  F=1) -> np.ndarray:
     """Host: all inputs -> ONE int64 buffer [i64 fields | bitpacked bools]."""

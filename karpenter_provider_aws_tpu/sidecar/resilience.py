@@ -26,6 +26,14 @@ Three mechanisms, composed:
   dead sidecar must cost the provisioning loop nothing per solve, not
   a connect timeout per solve.
 
+RESOURCE_EXHAUSTED is its own class: the server's admission layer SHED
+the call (tenant over quota — sidecar/server.py _shed). The peer is
+healthy, so the breaker records success, and the retry sleeps for the
+server's x-retry-after-ms trailing-metadata hint instead of blind
+backoff; a tenant still over quota after the retry budget sees the
+real grpc error (callers degrade to the host twin, which no quota
+gates).
+
 Failure surfaces as :class:`SidecarUnavailable` (a RuntimeError, never
 a ``grpc.RpcError``) so callers degrade to the host twin without
 depending on grpc types.
@@ -233,6 +241,21 @@ class ResiliencePolicy:
                              labels={"rpc": rpc, "outcome": outcome})
 
     # -- the guarded call ----------------------------------------------
+    def _retry_after_s(self, err, attempt: int) -> float:
+        """The server's shed hint (x-retry-after-ms trailing metadata),
+        capped at the backoff cap; falls back to jittered backoff when
+        the peer sent no hint (old server, torn trailer)."""
+        from ..tenancy.admission import RETRY_AFTER_METADATA_KEY
+        try:
+            for item in err.trailing_metadata() or ():
+                k, v = item
+                if k == RETRY_AFTER_METADATA_KEY:
+                    return min(self.retry.backoff_cap_s,
+                               max(0.0, float(v) / 1000.0))
+        except Exception:
+            pass
+        return self.retry.backoff_s(attempt)
+
     def call(self, attempt_fn: Callable[[float], object], *, rpc: str,
              payload_bytes: int = 0, base_deadline_s: float = 30.0):
         import grpc
@@ -249,6 +272,22 @@ class ResiliencePolicy:
                 out = attempt_fn(deadline)
             except grpc.RpcError as e:
                 code = e.code() if hasattr(e, "code") else None
+                if code == grpc.StatusCode.RESOURCE_EXHAUSTED:
+                    # admission shed: the peer is HEALTHY (it answered,
+                    # fast) — never count it toward the breaker, and
+                    # wait the server's own hint before re-asking
+                    self.breaker.record_success()
+                    if attempt + 1 >= self.retry.max_attempts:
+                        self._record(rpc, retries, ok=False,
+                                     outcome="shed")
+                        raise
+                    retries += 1
+                    if self.metrics is not None:
+                        self.metrics.inc(
+                            "karpenter_solver_sidecar_retries_total",
+                            labels={"rpc": rpc})
+                    self.retry.sleep(self._retry_after_s(e, attempt))
+                    continue
                 if code not in retryable:
                     # the peer ANSWERED (auth/validation/capability
                     # rejection): reachable, so the breaker resets; the

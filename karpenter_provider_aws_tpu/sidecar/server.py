@@ -30,7 +30,6 @@ schema; native/codec.cpp packs/parses it on both sides):
 
 from __future__ import annotations
 
-import collections
 import logging
 import threading
 import time
@@ -40,6 +39,10 @@ from typing import Optional
 import numpy as np
 
 from ..native import arena_pack, arena_unpack
+from ..tenancy.admission import (DEFAULT_TENANT, RETRY_AFTER_METADATA_KEY,
+                                 ShapeClassTable, tenant_from_metadata)
+from ..tenancy.bucketing import bucket_statics, pad_arena, unpad_outputs
+from ..tenancy.fairness import FairQueue
 
 log = logging.getLogger(__name__)
 
@@ -80,9 +83,10 @@ class _Pending:
     the dispatching leader fills before flipping `done`."""
 
     __slots__ = ("buf", "arrival", "deadline_s", "out", "error", "done",
-                 "wait_ms")
+                 "wait_ms", "tenant")
 
-    def __init__(self, buf, arrival: float, deadline_s: Optional[float]):
+    def __init__(self, buf, arrival: float, deadline_s: Optional[float],
+                 tenant: str = DEFAULT_TENANT):
         self.buf = buf
         self.arrival = arrival
         self.deadline_s = deadline_s
@@ -90,6 +94,7 @@ class _Pending:
         self.error: Optional[BaseException] = None
         self.done = False
         self.wait_ms = 0.0
+        self.tenant = tenant
 
 
 class _Coalescer:
@@ -114,7 +119,12 @@ class _Coalescer:
       outside the lock; a kernel failure lands on every rider as ITS OWN
       error (each client then degrades to its host twin independently —
       the batch never takes down a caller that could have been served
-      solo by its twin)."""
+      solo by its twin).
+    - Fair order between tenants: each shape-class queue is a
+      deficit-round-robin FairQueue (tenancy/fairness.py) keyed by the
+      rider's tenant label — the leader is whoever heads the FAIR
+      order, and the batch drains lanes round-robin, so one chatty
+      tenant cannot keep anyone else out of a dispatch window."""
 
     def __init__(self, metrics=None, max_batch: int = 64,
                  deadline_frac: float = 0.25,
@@ -132,11 +142,14 @@ class _Coalescer:
         #: dispatch counts by mode (solo/batched)
         self.stats = {"max_batch": 0, "dispatches": 0, "batched": 0}
 
-    def run(self, key, buf, deadline_s, dispatch_many, rpc: str):
+    def run(self, key, buf, deadline_s, dispatch_many, rpc: str,
+            tenant: str = DEFAULT_TENANT):
         """Join the shape-class queue and return THIS request's output
         row. `dispatch_many([bufs]) -> [outs]` runs once per batch, on
-        the leader's thread, outside the lock."""
-        p = _Pending(buf, time.monotonic(), deadline_s)
+        the leader's thread, outside the lock. ``tenant`` picks the
+        fair-queue lane; the single-tenant case degenerates to the old
+        FIFO exactly."""
+        p = _Pending(buf, time.monotonic(), deadline_s, tenant)
         batch = None
         with self._cv:
             if self._last_arrival is not None:
@@ -144,11 +157,11 @@ class _Coalescer:
                 self._gap_ewma = gap if self._gap_ewma is None \
                     else 0.3 * gap + 0.7 * self._gap_ewma
             self._last_arrival = p.arrival
-            q = self._queues.setdefault(key, collections.deque())
-            q.append(p)
+            q = self._queues.setdefault(key, FairQueue())
+            q.push(p, tenant)
             self._cv.notify_all()
             while not p.done:
-                if key not in self._busy and q and q[0] is p:
+                if key not in self._busy and q.head() is p:
                     batch = self._form_batch(key, q, rpc)
                     self._busy.add(key)
                     break
@@ -181,7 +194,8 @@ class _Coalescer:
 
     def _form_batch(self, key, q, rpc: str):
         """Lock held. Optionally top up (depth >= 2 only), then pop up
-        to max_batch pendings and record the coalesce evidence."""
+        to max_batch pendings IN FAIR ORDER and record the coalesce
+        evidence."""
         if len(q) >= 2:
             now = time.monotonic()
             window = min(2.0 * (self._gap_ewma or 0.0), self.max_window_s)
@@ -192,7 +206,7 @@ class _Coalescer:
             if window > 0:
                 self._cv.wait(timeout=window)
         n = min(len(q), self.max_batch)
-        batch = [q.popleft() for _ in range(n)]
+        batch = [q.pop() for _ in range(n)]
         t = time.monotonic()
         for x in batch:
             x.wait_ms = (t - x.arrival) * 1e3
@@ -208,11 +222,23 @@ class _Coalescer:
                 self.metrics.observe(
                     "karpenter_solver_sidecar_coalesce_wait_ms",
                     x.wait_ms, labels={"rpc": rpc})
+                self.metrics.observe(
+                    "karpenter_solver_fair_queue_wait_ms",
+                    x.wait_ms, labels={"rpc": rpc, "tenant": x.tenant})
             self.metrics.inc(
                 "karpenter_solver_sidecar_coalesce_dispatches_total",
                 labels={"rpc": rpc,
                         "mode": "batched" if n > 1 else "solo"})
         return batch
+
+
+def _tenant(context) -> str:
+    """The tenant label this RPC carried (x-solver-tenant metadata), or
+    the anonymous default."""
+    try:
+        return tenant_from_metadata(context.invocation_metadata())
+    except Exception:
+        return DEFAULT_TENANT
 
 
 def _deadline_s(context) -> Optional[float]:
@@ -236,9 +262,17 @@ class _Handler:
     kernels), the coalescer's queues, and the in-flight counter graceful
     stop drains on."""
 
-    def __init__(self, metrics=None):
-        self._shapes_seen: set = set()
-        self._shape_mu = threading.Lock()
+    def __init__(self, metrics=None, admission=None, shape_table=None,
+                 bucketing: bool = True, compile_monitor=None):
+        #: the compile-cache budget — an LRU shape-class table that
+        #: still answers len()/in like the set it replaced
+        self._shapes_seen = shape_table if shape_table is not None \
+            else ShapeClassTable(capacity=_MAX_SHAPE_CLASSES,
+                                 metrics=metrics)
+        self._admission = admission
+        self._bucketing = bucketing
+        self._compile_monitor = compile_monitor
+        self.cache_dir = ""
         self._mesh_cache: dict = {}
         self._mesh_mu = threading.Lock()
         self._inflight = 0
@@ -247,10 +281,20 @@ class _Handler:
         self._coalescer = _Coalescer(metrics=metrics)
 
     # -- in-flight tracking (graceful stop) -----------------------------
-    def tracked(self, fn):
+    def tracked(self, fn, rpc: Optional[str] = None):
         """Wrap a method handler so SolverServer.stop can drain: solves
-        already past the port must land before the process exits."""
+        already past the port must land before the process exits. With
+        ``rpc`` set and an admission controller configured, the wrapper
+        is also the tenant gate: quota sheds answer RESOURCE_EXHAUSTED
+        with a retry-after hint BEFORE any decode work happens."""
         def run(request, context):
+            tenant = _tenant(context)
+            admitted = False
+            if rpc is not None and self._admission is not None:
+                ok, reason, after = self._admission.enter(tenant, rpc)
+                if not ok:
+                    self._shed(context, reason, after)
+                admitted = True
             with self._inflight_cv:
                 self._inflight += 1
             try:
@@ -259,7 +303,24 @@ class _Handler:
                 with self._inflight_cv:
                     self._inflight -= 1
                     self._inflight_cv.notify_all()
+                if admitted:
+                    self._admission.release(tenant)
         return run
+
+    def _shed(self, context, reason: str, after_s: float) -> None:
+        """Abort RESOURCE_EXHAUSTED with a machine-readable retry-after
+        hint (trailing metadata, ms). Inflight sheds hint a short fixed
+        backoff — a slot frees when any in-flight solve lands."""
+        import grpc
+        after_ms = max(1, int(after_s * 1000)) if after_s > 0 else 25
+        try:
+            context.set_trailing_metadata(
+                ((RETRY_AFTER_METADATA_KEY, str(after_ms)),))
+        except Exception:
+            pass
+        context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED,
+                      f"tenant quota exceeded ({reason}); "
+                      f"retry after {after_ms}ms")
 
     def drain(self, timeout: Optional[float]) -> bool:
         """Block until no request is in flight (or timeout); returns
@@ -287,25 +348,20 @@ class _Handler:
                               f"request arena missing '{k}'")
         return arrays
 
-    def _admit_shape(self, key, context) -> None:
-        """Spend (or re-use) a compile-cache shape-class slot under the
-        lock — four workers racing unsynchronized could both blow the
-        budget and corrupt the set."""
+    def _admit_shape(self, key, context,
+                     tenant: str = DEFAULT_TENANT) -> None:
+        """Spend (or re-use) a compile-cache shape-class slot. The table
+        serializes internally (four workers racing unsynchronized could
+        both blow the budget and corrupt it) and evicts LRU among slots
+        idle past its min-idle floor — tenant churn recycles slots
+        instead of wedging the server into permanent exhaustion."""
         import grpc
-        with self._shape_mu:
-            if key in self._shapes_seen:
-                return
-            if len(self._shapes_seen) >= _MAX_SHAPE_CLASSES:
-                full = True
-            else:
-                self._shapes_seen.add(key)
-                full = False
-        if full:
+        if not self._shapes_seen.admit(key, tenant):
             context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED,
                           "too many distinct solve shape classes")
 
-    def _validate(self, statics, buf, context,
-                  shape_tag=()) -> Optional[dict]:
+    def _validate(self, statics, buf, context, shape_tag=(),
+                  admit: bool = True) -> Optional[dict]:
         import grpc
 
         from ..ops.hostpack import (STATIC_KEYS, in_layout_bool,
@@ -329,7 +385,9 @@ class _Handler:
             if not (0 <= v <= _STATICS_MAX[k]):
                 context.abort(grpc.StatusCode.INVALID_ARGUMENT,
                               f"statics.{k}={v} out of bounds")
-        self._admit_shape(tuple(kv.values()) + tuple(shape_tag), context)
+        if admit:
+            self._admit_shape(tuple(kv.values()) + tuple(shape_tag),
+                              context, _tenant(context))
         dims = {k: kv[k] for k in ("T", "D", "Z", "C", "G", "E", "P",
                                    "K", "M", "F")}
         expect = layout_sizes(in_layout_i64(**dims)) \
@@ -371,11 +429,18 @@ class _Handler:
         # layout/bounds validation shares the base path (K=V=M=0); the
         # shape-class key carries S + a pruned marker, since every
         # distinct S compiles its own kernel and must spend a slot of
-        # the compile-cache budget like any other shape class
+        # the compile-cache budget like any other shape class. The
+        # admitted key is the BUCKET the request pads into — near-miss
+        # shapes share the slot, the kernel, and the dispatch.
         kv = self._validate(statics[:-1] + [0, 0, 0, 1], buf, context,
-                            shape_tag=("pruned", S))
-        dims = {k: kv[k] for k in ("T", "D", "Z", "C", "G", "E", "P",
-                                   "n_max")}
+                            shape_tag=("pruned", S), admit=False)
+        tenant = _tenant(context)
+        kvB = bucket_statics(kv) if self._bucketing else kv
+        self._admit_shape(tuple(kvB.values()) + ("pruned", S), context,
+                          tenant)
+        bufB = self._pad(np.asarray(buf), kv, kvB, context, "SolvePruned")
+        dims = {k: kvB[k] for k in ("T", "D", "Z", "C", "G", "E", "P",
+                                    "n_max")}
 
         def dispatch_many(bufs):
             if len(bufs) == 1:
@@ -386,13 +451,18 @@ class _Handler:
             return list(np.asarray(solve_scan_packed1_pruned_many(
                 stack, S=S, **dims)))
 
-        key = ("pruned", S) + tuple(kv.values())
-        o_buf = self._dispatch_coalesced(key, np.asarray(buf), context,
-                                         dispatch_many, "SolvePruned")
-        return arena_pack({"out": np.asarray(o_buf)})
+        key = ("pruned", S) + tuple(kvB.values())
+        o_buf = np.asarray(self._dispatch_coalesced(
+            key, bufB, context, dispatch_many, "SolvePruned", tenant))
+        if kvB != kv:
+            # the pruned wire rides ONE trailing bail word behind the
+            # packed outputs: slice around it, unpad, stitch it back
+            o_buf = np.concatenate(
+                [unpad_outputs(o_buf[:-1], kv, kvB), o_buf[-1:]])
+        return arena_pack({"out": o_buf})
 
     def _dispatch_coalesced(self, key, buf, context, dispatch_many,
-                            rpc: str):
+                            rpc: str, tenant: str = DEFAULT_TENANT):
         """Run a validated single-solve request through the coalescing
         window. A batch dispatch failure lands on every rider as its OWN
         INTERNAL abort — each client degrades to its host twin
@@ -402,10 +472,29 @@ class _Handler:
         import grpc
         try:
             return self._coalescer.run(key, buf, _deadline_s(context),
-                                       dispatch_many, rpc=rpc)
+                                       dispatch_many, rpc=rpc,
+                                       tenant=tenant)
         except Exception as e:
             context.abort(grpc.StatusCode.INTERNAL,
                           f"batched {rpc} dispatch failed: {e}")
+
+    def _pad(self, buf: np.ndarray, kv: dict, kvB: dict, context,
+             rpc: str) -> np.ndarray:
+        """Pad a validated arena up to its bucket shape (no-op on a
+        boundary shape). A pad failure is a server bug, not a peer bug —
+        the arena already validated against kv — so it aborts INTERNAL."""
+        import grpc
+        if kvB == kv:
+            return buf
+        try:
+            out = pad_arena(buf, kv, kvB)
+        except Exception as e:
+            context.abort(grpc.StatusCode.INTERNAL,
+                          f"bucket padding failed: {e}")
+        if self.metrics is not None:
+            self.metrics.inc("karpenter_solver_bucket_padded_total",
+                             labels={"rpc": rpc})
+        return out
 
     def solve(self, request: bytes, context) -> bytes:
         import jax
@@ -414,25 +503,32 @@ class _Handler:
         from ..ops.ffd_jax import solve_scan_packed1
         arrays = self._request_arrays(request, context, "buf", "statics")
         buf = arrays["buf"]
-        kv = self._validate(arrays["statics"], buf, context)
+        kv = self._validate(arrays["statics"], buf, context, admit=False)
+        tenant = _tenant(context)
         ndev = len(jax.devices())
         if ndev > 1:
             # the mesh dispatch shards ONE solve across every device —
-            # it is its own batching axis, so coalescing stays out
+            # it is its own batching axis, so coalescing (and bucket
+            # padding, which exists to widen batches) stays out
+            self._admit_shape(tuple(kv.values()), context, tenant)
             return arena_pack({"out": self._solve_mesh(buf, kv, ndev)})
+        kvB = bucket_statics(kv) if self._bucketing else kv
+        self._admit_shape(tuple(kvB.values()), context, tenant)
+        bufB = self._pad(np.asarray(buf), kv, kvB, context, "Solve")
 
         def dispatch_many(bufs):
             if len(bufs) == 1:
                 return [np.asarray(solve_scan_packed1(
-                    jnp.asarray(bufs[0]), **kv))]
+                    jnp.asarray(bufs[0]), **kvB))]
             from ..ops.ffd_jax import solve_scan_packed1_many
             stack = jnp.asarray(np.stack(bufs))
-            return list(np.asarray(solve_scan_packed1_many(stack, **kv)))
+            return list(np.asarray(solve_scan_packed1_many(stack, **kvB)))
 
-        key = ("solve",) + tuple(kv.values())
-        o_buf = self._dispatch_coalesced(key, np.asarray(buf), context,
-                                         dispatch_many, "Solve")
-        return arena_pack({"out": np.asarray(o_buf)})
+        key = ("solve",) + tuple(kvB.values())
+        o_buf = self._dispatch_coalesced(key, bufB, context,
+                                         dispatch_many, "Solve", tenant)
+        return arena_pack({"out": unpad_outputs(np.asarray(o_buf),
+                                                kv, kvB)})
 
     def solve_batch(self, request: bytes, context) -> bytes:
         """B same-shape solves in ONE round trip: validate the batch
@@ -533,7 +629,7 @@ class _Handler:
         key = ("topo",) + tuple(kv.values()) + (
             arrays["A"].shape, arrays["avail_zc"].shape,
             arrays["R"].shape[0])
-        self._admit_shape(key, context)
+        self._admit_shape(key, context, _tenant(context))
         out = dispatch_topo(arrays, rows, kv)
         return arena_pack({k: np.asarray(v) for k, v in out.items()})
 
@@ -597,6 +693,8 @@ class _Handler:
 
     def info(self, request: bytes, context) -> bytes:
         import jax
+        cc = self._compile_monitor.counts() if self._compile_monitor \
+            else {"hits": 0, "misses": 0}
         return arena_pack({
             "devices": np.array([len(jax.devices())], dtype=np.int64),
             "x64": np.array([1], dtype=np.int64),
@@ -607,6 +705,17 @@ class _Handler:
             # frame (served on mesh servers too — jit(vmap) runs on the
             # default device and decides identically)
             "batch": np.array([1], dtype=np.int64),
+            # tenancy surface: whether admission quotas are enforced,
+            # whether near-miss shapes ride bucketed padding, and the
+            # persistent compile cache's hit/miss counts since start —
+            # the warm-start acceptance check reads these two counters
+            "tenancy": np.array(
+                [1 if self._admission is not None else 0], dtype=np.int64),
+            "bucketed": np.array([1 if self._bucketing else 0],
+                                 dtype=np.int64),
+            "compile_cache_hits": np.array([cc["hits"]], dtype=np.int64),
+            "compile_cache_misses": np.array([cc["misses"]],
+                                             dtype=np.int64),
         })
 
 
@@ -617,18 +726,23 @@ def _generic_handler(handler: _Handler):
         def service(self, call_details):
             # every method rides the in-flight tracker so graceful stop
             # can drain solves already past the port
+            # solve RPCs name themselves to the tracker so the tenant
+            # admission gate runs; Info stays quota-exempt (it is the
+            # capability/health probe — shedding it would blind clients)
             if call_details.method == _SOLVE:
                 return grpc.unary_unary_rpc_method_handler(
-                    handler.tracked(handler.solve))
+                    handler.tracked(handler.solve, rpc="Solve"))
             if call_details.method == _SOLVE_TOPO:
                 return grpc.unary_unary_rpc_method_handler(
-                    handler.tracked(handler.solve_topo))
+                    handler.tracked(handler.solve_topo, rpc="SolveTopo"))
             if call_details.method == _SOLVE_PRUNED:
                 return grpc.unary_unary_rpc_method_handler(
-                    handler.tracked(handler.solve_pruned))
+                    handler.tracked(handler.solve_pruned,
+                                    rpc="SolvePruned"))
             if call_details.method == _SOLVE_BATCH:
                 return grpc.unary_unary_rpc_method_handler(
-                    handler.tracked(handler.solve_batch))
+                    handler.tracked(handler.solve_batch,
+                                    rpc="SolveBatch"))
             if call_details.method == _INFO:
                 return grpc.unary_unary_rpc_method_handler(
                     handler.tracked(handler.info))
@@ -677,7 +791,11 @@ class SolverServer:
     def __init__(self, address: str = "127.0.0.1", port: int = 0,
                  max_workers: int = 4, token: Optional[str] = None,
                  tls_cert: Optional[bytes] = None,
-                 tls_key: Optional[bytes] = None, metrics=None):
+                 tls_key: Optional[bytes] = None, metrics=None,
+                 quotas: Optional[dict] = None,
+                 default_quota=None, bucketing: bool = True,
+                 compile_cache: bool = True,
+                 compile_cache_dir: Optional[str] = None):
         import grpc
         if (tls_cert is None) != (tls_key is None):
             # a security posture must fail CLOSED: half a TLS config is
@@ -690,9 +808,30 @@ class SolverServer:
             interceptors=interceptors,
             options=[("grpc.max_receive_message_length", 256 * 1024 * 1024),
                      ("grpc.max_send_message_length", 256 * 1024 * 1024)])
+        # tenancy: quotas map tenant -> TenantQuota; default_quota
+        # covers unlisted tenants. Neither set (the default) keeps the
+        # permissive pre-tenancy posture — nothing sheds, nothing new
+        # to operate. Bucketed padding and the persistent compile cache
+        # are on by default; both have env escape hatches in serve().
+        admission = None
+        if quotas or default_quota is not None:
+            from ..tenancy.admission import AdmissionController
+            admission = AdmissionController(
+                quotas=quotas, default_quota=default_quota,
+                metrics=metrics)
+        monitor = None
+        cache_dir = ""
+        if compile_cache:
+            from ..tenancy.compilecache import (CompileCacheMonitor,
+                                                configure_compile_cache)
+            cache_dir = configure_compile_cache(compile_cache_dir)
+            monitor = CompileCacheMonitor(metrics=metrics)
         # metrics: optional utils.metrics.Metrics registry; the coalesce
         # families (docs/metrics.md) are emitted through it when present
-        self._handler = _Handler(metrics=metrics)
+        self._handler = _Handler(metrics=metrics, admission=admission,
+                                 bucketing=bucketing,
+                                 compile_monitor=monitor)
+        self._handler.cache_dir = cache_dir
         self._server.add_generic_rpc_handlers(
             (_generic_handler(self._handler),))
         if tls_cert is not None and tls_key is not None:
@@ -724,11 +863,19 @@ class SolverServer:
 def serve(address: str = "127.0.0.1", port: int = 50151,
           token: Optional[str] = None,
           tls_cert_file: Optional[str] = None,
-          tls_key_file: Optional[str] = None) -> SolverServer:
+          tls_key_file: Optional[str] = None,
+          quotas: Optional[dict] = None,
+          default_quota=None) -> SolverServer:
     """Production entry: start and return the sidecar server. Defaults to
     loopback-insecure (same-pod companion). Exposing it wider is an
     explicit operator decision — pass `token` (also SOLVER_SIDECAR_TOKEN
-    env) for shared-secret auth and cert/key paths for a TLS listener."""
+    env) for shared-secret auth and cert/key paths for a TLS listener.
+    Tenancy knobs ride the environment for the __main__ entry:
+    SOLVER_SIDECAR_BUCKETING=0 disables bucketed padding,
+    SOLVER_SIDECAR_COMPILE_CACHE=0 the persistent compile cache
+    (dir: KARPENTER_JAX_CACHE), SOLVER_SIDECAR_DEFAULT_QUOTA=
+    "rate,burst,inflight" a fleet-wide per-tenant quota."""
+    import os
     cert = key = None
     if tls_cert_file:
         with open(tls_cert_file, "rb") as f:
@@ -736,8 +883,23 @@ def serve(address: str = "127.0.0.1", port: int = 50151,
     if tls_key_file:
         with open(tls_key_file, "rb") as f:
             key = f.read()
-    return SolverServer(address, port, token=token,
-                        tls_cert=cert, tls_key=key).start()
+    if default_quota is None:
+        raw = os.environ.get("SOLVER_SIDECAR_DEFAULT_QUOTA")
+        if raw:
+            from ..tenancy.admission import TenantQuota
+            parts = [p.strip() for p in raw.split(",")]
+            default_quota = TenantQuota(
+                rate=float(parts[0]) if parts[0] else None,
+                burst=int(parts[1]) if len(parts) > 1 and parts[1]
+                else None,
+                max_inflight=int(parts[2]) if len(parts) > 2 and parts[2]
+                else None)
+    return SolverServer(
+        address, port, token=token, tls_cert=cert, tls_key=key,
+        quotas=quotas, default_quota=default_quota,
+        bucketing=os.environ.get("SOLVER_SIDECAR_BUCKETING", "1") != "0",
+        compile_cache=os.environ.get(
+            "SOLVER_SIDECAR_COMPILE_CACHE", "1") != "0").start()
 
 
 if __name__ == "__main__":  # pragma: no cover

@@ -1,0 +1,27 @@
+"""Multi-tenant serving layer for the solver sidecar.
+
+One sidecar pool serving many clusters needs what any multi-tenant
+service needs: identity (admission.py — x-solver-tenant metadata,
+token-bucket rate quotas, inflight caps, LRU shape-class slots), fair
+scheduling (fairness.py — deficit-round-robin lanes in front of the
+coalescer), shape amortization (bucketing.py — pad near-miss shapes up
+to a shared bucket so they ride one compiled kernel, byte-identically),
+and warm starts (compilecache.py — JAX's persistent compilation cache
+wired into server startup). sidecar/server.py composes all four; each
+piece is independently testable and jax-free except compilecache.
+"""
+
+from .admission import (DEFAULT_TENANT, RETRY_AFTER_METADATA_KEY,
+                        TENANT_METADATA_KEY, AdmissionController,
+                        ShapeClassTable, TenantQuota, TokenBucket,
+                        tenant_from_metadata)
+from .bucketing import (BUCKET_DIMS, bucket_dim, bucket_statics,
+                        pad_arena, unpad_outputs)
+from .fairness import FairQueue
+
+__all__ = [
+    "AdmissionController", "BUCKET_DIMS", "DEFAULT_TENANT", "FairQueue",
+    "RETRY_AFTER_METADATA_KEY", "ShapeClassTable", "TENANT_METADATA_KEY",
+    "TenantQuota", "TokenBucket", "bucket_dim", "bucket_statics",
+    "pad_arena", "tenant_from_metadata", "unpad_outputs",
+]

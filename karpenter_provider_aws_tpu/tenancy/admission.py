@@ -1,0 +1,236 @@
+"""Tenant identity, admission control and shape-class accounting for
+the multi-tenant sidecar.
+
+Tenants self-identify with ``x-solver-tenant`` request metadata (the
+shared-secret ``x-solver-token`` still gates the door; the tenant label
+only partitions capacity). A request passes three gates before it may
+queue for dispatch:
+
+1. a per-tenant token-bucket RATE quota (sustained rps + burst),
+2. a per-tenant concurrent-INFLIGHT cap,
+3. the shape-class table (one compiled-kernel slot per bucket, LRU).
+
+Shedding is explicit and cheap: the controller answers with a
+retry-after hint sized from the bucket's refill rate, the server maps
+it to RESOURCE_EXHAUSTED + ``x-retry-after-ms`` trailing metadata, and
+the client's resilience layer (sidecar/resilience.py) classifies the
+shed distinctly from a failure — it never trips the circuit breaker.
+
+Defaults are permissive (no quotas configured -> every tenant admits,
+exactly the pre-tenancy behavior); operators opt in per deployment
+(docs/multi-tenant.md).
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Callable, Optional
+
+#: tenant label used when a client sends no x-solver-tenant metadata —
+#: anonymous callers share one bucket, so a fleet of label-less clients
+#: is ONE tenant to the fairness and quota machinery
+DEFAULT_TENANT = "default"
+
+#: metadata key carrying the tenant label (client sets, server reads)
+TENANT_METADATA_KEY = "x-solver-tenant"
+
+#: trailing-metadata key carrying the shed retry-after hint, in ms
+RETRY_AFTER_METADATA_KEY = "x-retry-after-ms"
+
+
+class TenantQuota:
+    """Per-tenant limits. ``rate`` is sustained requests/second (None =
+    unlimited), ``burst`` the token-bucket depth, ``max_inflight`` the
+    concurrent-request cap (None = unlimited)."""
+
+    __slots__ = ("rate", "burst", "max_inflight")
+
+    def __init__(self, rate: Optional[float] = None,
+                 burst: Optional[int] = None,
+                 max_inflight: Optional[int] = None):
+        if rate is not None and rate <= 0:
+            raise ValueError(f"quota rate must be > 0, got {rate}")
+        if burst is not None and burst < 1:
+            raise ValueError(f"quota burst must be >= 1, got {burst}")
+        if max_inflight is not None and max_inflight < 1:
+            raise ValueError(
+                f"quota max_inflight must be >= 1, got {max_inflight}")
+        self.rate = rate
+        self.burst = burst if burst is not None else (
+            max(1, int(rate)) if rate is not None else None)
+        self.max_inflight = max_inflight
+
+
+class TokenBucket:
+    """Classic token bucket with an injectable clock (tests drive time
+    by hand). ``take`` returns (admitted, retry_after_s) — the hint is
+    how long until one token refills, 0.0 when admitted."""
+
+    def __init__(self, rate: float, burst: int,
+                 clock: Callable[[], float] = time.monotonic):
+        self.rate = float(rate)
+        self.burst = float(burst)
+        self._clock = clock
+        self._tokens = float(burst)
+        self._last = clock()
+
+    def take(self, n: float = 1.0):
+        now = self._clock()
+        self._tokens = min(self.burst,
+                           self._tokens + (now - self._last) * self.rate)
+        self._last = now
+        if self._tokens >= n:
+            self._tokens -= n
+            return True, 0.0
+        return False, (n - self._tokens) / self.rate
+
+
+class AdmissionController:
+    """The per-tenant gate in front of the dispatch path.
+
+    ``enter(tenant)`` -> (admitted, reason, retry_after_s); on admit the
+    caller MUST pair it with ``release(tenant)`` (try/finally in the
+    server handler). ``quotas`` maps tenant -> TenantQuota; tenants
+    without an entry fall back to ``default_quota`` (None = permissive:
+    admit everything, the pre-tenancy posture)."""
+
+    def __init__(self, quotas: Optional[dict] = None,
+                 default_quota: Optional[TenantQuota] = None,
+                 metrics=None,
+                 clock: Callable[[], float] = time.monotonic):
+        self._quotas = dict(quotas or {})
+        self._default = default_quota
+        self._buckets: dict = {}
+        self._inflight: dict = collections.defaultdict(int)
+        self._mu = threading.Lock()
+        self._clock = clock
+        self.metrics = metrics
+
+    def _quota(self, tenant: str) -> Optional[TenantQuota]:
+        return self._quotas.get(tenant, self._default)
+
+    def enter(self, tenant: str, rpc: str = ""):
+        """One admission decision. Shed reasons: "rate" (token bucket
+        empty) or "inflight" (concurrency cap reached)."""
+        q = self._quota(tenant)
+        with self._mu:
+            if q is not None and q.max_inflight is not None \
+                    and self._inflight[tenant] >= q.max_inflight:
+                self._count("shed", tenant, rpc, reason="inflight")
+                return False, "inflight", 0.0
+            if q is not None and q.rate is not None:
+                b = self._buckets.get(tenant)
+                if b is None or b.rate != q.rate or b.burst != q.burst:
+                    b = self._buckets[tenant] = TokenBucket(
+                        q.rate, q.burst, clock=self._clock)
+                ok, after = b.take()
+                if not ok:
+                    self._count("shed", tenant, rpc, reason="rate")
+                    return False, "rate", after
+            self._inflight[tenant] += 1
+            n = self._inflight[tenant]
+        self._count("admitted", tenant, rpc)
+        if self.metrics is not None:
+            self.metrics.set_gauge("karpenter_solver_tenant_inflight", n,
+                                   labels={"tenant": tenant})
+        return True, "", 0.0
+
+    def release(self, tenant: str) -> None:
+        with self._mu:
+            n = self._inflight[tenant] = max(
+                0, self._inflight[tenant] - 1)
+            if n == 0:
+                self._inflight.pop(tenant, None)
+        if self.metrics is not None:
+            self.metrics.set_gauge("karpenter_solver_tenant_inflight", n,
+                                   labels={"tenant": tenant})
+
+    def inflight(self, tenant: str) -> int:
+        with self._mu:
+            return self._inflight.get(tenant, 0)
+
+    def _count(self, what: str, tenant: str, rpc: str, reason=None):
+        if self.metrics is None:
+            return
+        labels = {"tenant": tenant, "rpc": rpc}
+        if reason is not None:
+            labels["reason"] = reason
+        self.metrics.inc(f"karpenter_solver_tenant_{what}_total",
+                         labels=labels)
+
+
+class ShapeClassTable:
+    """The compile-cache budget, multi-tenant edition.
+
+    Replaces the server's first-come-forever shape-class set: every
+    admitted bucket holds a slot keyed by last use, attributed to the
+    tenant that first admitted it. When the table is full, a NEW bucket
+    may evict the least-recently-used slot — but only one idle for at
+    least ``min_idle_s`` (an actively-hot kernel is never evicted under
+    churn; a table full of hot kernels still sheds, which is the budget
+    doing its job). Looks like a set to existing callers (len/in).
+    """
+
+    def __init__(self, capacity: int = 64, min_idle_s: float = 30.0,
+                 metrics=None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.capacity = capacity
+        self.min_idle_s = min_idle_s
+        self.metrics = metrics
+        self._clock = clock
+        self._mu = threading.Lock()
+        #: key -> [tenant, last_use]; insertion order is maintained by
+        #: re-inserting on touch, so iteration order IS the LRU order
+        self._entries: "collections.OrderedDict" = collections.OrderedDict()
+
+    def __len__(self) -> int:
+        with self._mu:
+            return len(self._entries)
+
+    def __contains__(self, key) -> bool:
+        with self._mu:
+            return key in self._entries
+
+    def admit(self, key, tenant: str = DEFAULT_TENANT) -> bool:
+        """Touch-or-admit ``key``; False means the table is full of
+        recently-used shape classes and the request must shed."""
+        now = self._clock()
+        with self._mu:
+            ent = self._entries.get(key)
+            if ent is not None:
+                ent[1] = now
+                self._entries.move_to_end(key)
+                return True
+            if len(self._entries) >= self.capacity:
+                lru_key = next(iter(self._entries))
+                lru = self._entries[lru_key]
+                if now - lru[1] < self.min_idle_s:
+                    return False
+                self._entries.pop(lru_key)
+                if self.metrics is not None:
+                    self.metrics.inc(
+                        "karpenter_solver_shape_class_evictions_total",
+                        labels={"tenant": lru[0]})
+            self._entries[key] = [tenant, now]
+            return True
+
+    def per_tenant(self) -> dict:
+        """tenant -> slots currently attributed to it (the slot
+        accounting the metrics surface)."""
+        with self._mu:
+            out: dict = collections.defaultdict(int)
+            for tenant, _ in self._entries.values():
+                out[tenant] += 1
+            return dict(out)
+
+
+def tenant_from_metadata(metadata) -> str:
+    """The tenant label an RPC carried (invocation metadata key/value
+    pairs), or DEFAULT_TENANT. Labels are clamped to 64 chars so a
+    hostile peer cannot mint unbounded metric label values."""
+    for k, v in metadata or ():
+        if k == TENANT_METADATA_KEY and v:
+            return str(v)[:64]
+    return DEFAULT_TENANT

@@ -1,0 +1,175 @@
+"""Bucketed-padding batching for the multi-tenant sidecar.
+
+The coalescer (sidecar/server.py) batches only requests whose statics
+hash to the SAME shape class — across a tenant population the near-miss
+shapes (one tenant has 37 types, another 41) never share a vmapped
+dispatch and each mints its own compiled kernel. This module generalizes
+the shape class to a BUCKET: every bucketable dimension rounds up to the
+next bucket boundary, the request arena is padded up to the bucket shape
+with provably inert rows, and the bucket's output buffer is sliced back
+to the caller's exact shape. Nearby tenants then ride one compiled
+kernel and one dispatch.
+
+The inertness contract (why padding cannot change a decision — see the
+"inert padding" note in ops/ffd_jax.py for the kernel-side view):
+
+- padded GROUPS have n=0 and all-False masks: their scan steps place
+  nothing and open nothing (the client already pads G this way);
+- padded TYPES have A=0, avail_zc=False and F=False for every group:
+  no candidate mask ever admits them;
+- padded ZONES / CAPACITY TYPES appear only as all-False columns of
+  agz/agc/pool_agz/pool_agc/avail_zc: every kernel read ANDs them away;
+- padded EXISTING rows have zero allocatable and ex_compat=False, so
+  their headroom is pinned to 0 (dead rows, same as the client's E pad);
+- padded POOLS admit nothing, offer no types and have all-zero limits;
+- padded RESOURCE dims have R=0 everywhere, which every headroom/budget
+  read guards on; live pools get limit=-1 (unlimited) in the new
+  columns exactly as the client's own D-padding does.
+
+Outputs demux byte-identically: the bucket solve's output arrays are
+sliced back to the request dims (dropping the dead existing rows
+[E, E_bucket) from the slot axis) and re-packed — fuzzed against solo
+solves in tests/test_tenancy.py across bucket boundaries.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..ops.hostpack import (pack_inputs1, pack_outputs1, pad_to,
+                            unpack_inputs1, unpack_outputs1)
+
+#: dims that may round up to a bucket boundary; everything else in the
+#: statics vector (n_max, K, V, M, F and the pruned S) stays exact and
+#: keys the bucket verbatim
+BUCKET_DIMS = ("T", "D", "Z", "C", "G", "E", "P")
+
+_DIM_KEYS = ("T", "D", "Z", "C", "G", "E", "P", "K", "M", "F")
+
+
+def _pow2(v: int) -> int:
+    return 1 << (v - 1).bit_length() if v > 0 else 0
+
+
+def _pow15(v: int) -> int:
+    """Next boundary in the {2^k, 1.5*2^k} ladder (1,2,3,4,6,8,12,...):
+    finer than plain pow2 so the padded waste on the widest axis (the
+    type catalog) stays under 50%."""
+    if v <= 2:
+        return max(v, 0)
+    p = _pow2(v)
+    mid = (p >> 1) + (p >> 2)
+    return mid if v <= mid else p
+
+
+def bucket_dim(name: str, v: int) -> int:
+    """Bucket boundary for one statics dim. G/E/P mirror the client's
+    own pow2 padding (idempotent for modern clients); T gets the finer
+    1.5-ladder because it is the widest axis; D keeps the client's
+    max(8, .) floor."""
+    if name == "T":
+        return _pow15(v)
+    if name == "D":
+        return max(8, _pow2(v))
+    if name == "E":
+        return _pow2(v)
+    if name in ("Z", "C", "G", "P"):
+        return max(1, _pow2(v)) if v else v
+    return v
+
+
+def bucket_statics(kv: dict) -> dict:
+    """The bucket a statics dict lands in: bucketable dims round up,
+    exact dims pass through. Returns a NEW dict in the same key order
+    (bucket keys feed the coalescer's shape-class hash)."""
+    return {k: bucket_dim(k, v) if k in BUCKET_DIMS else v
+            for k, v in kv.items()}
+
+
+def _dims(kv: dict) -> dict:
+    return {k: kv[k] for k in _DIM_KEYS}
+
+
+def pad_arena(buf: np.ndarray, kv: dict, kvB: dict) -> np.ndarray:
+    """Pad a validated request arena from its exact statics ``kv`` up to
+    the bucket statics ``kvB`` with inert rows (module docstring). The
+    input buffer is not modified; when the shape already sits on its
+    bucket boundary the original buffer is returned as-is."""
+    if all(kv[k] == kvB[k] for k in BUCKET_DIMS):
+        return np.asarray(buf)
+    v = unpack_inputs1(np.asarray(buf), **_dims(kv))
+    T, D, Z, C = kv["T"], kv["D"], kv["Z"], kv["C"]
+    G, E, P = kv["G"], kv["E"], kv["P"]
+    Tb, Db, Zb, Cb = kvB["T"], kvB["D"], kvB["Z"], kvB["C"]
+    Gb, Eb, Pb = kvB["G"], kvB["E"], kvB["P"]
+    K, M, F = kv["K"], kv["M"], kv["F"]
+    out = {
+        "A": pad_to(v["A"], (Tb, Db)),
+        "R": pad_to(v["R"], (Gb, Db)),
+        "n": pad_to(v["n"], (Gb,)),
+        "daemon": pad_to(v["daemon"], (Gb, Pb, Db)),
+        "pool_used0": pad_to(v["pool_used0"], (Pb, Db)),
+        "ex_alloc": pad_to(v["ex_alloc"], (Eb, Db)),
+        "ex_used0": pad_to(v["ex_used0"], (Eb, Db)),
+        "F": pad_to(v["F"], (Gb, Tb)),
+        "agz": pad_to(v["agz"], (Gb, Zb)),
+        "agc": pad_to(v["agc"], (Gb, Cb)),
+        "admit": pad_to(v["admit"], (Gb, Pb)),
+        "pool_types": pad_to(v["pool_types"], (Pb, Tb)),
+        "pool_agz": pad_to(v["pool_agz"], (Pb, Zb)),
+        "pool_agc": pad_to(v["pool_agc"], (Pb, Cb)),
+        "ex_compat": pad_to(v["ex_compat"], (Gb, Eb)),
+    }
+    # offerings ride flattened [T, Z*C]: pad in the unflattened view so
+    # the new zone/capacity-type columns land where the bucket's
+    # flattening expects them
+    av = pad_to(v["avail_zc"].reshape(T, Z, C), (Tb, Zb, Cb))
+    out["avail_zc"] = av.reshape(Tb, Zb * Cb)
+    # live pools get -1 (unlimited) in the new resource columns — the
+    # client's own D padding discipline; an appended 0 would flip the
+    # has-limit gate for limitless pools. Dead rows (client's P pad)
+    # stay all-zero; their limits are unreadable (admit=False).
+    pl = np.full((Pb, Db), -1, dtype=np.int64)
+    pl[:P, :D] = v["pool_limit"]
+    pl[P:, :] = 0
+    out["pool_limit"] = pl
+    if K:
+        out["mv_floor"] = pad_to(v["mv_floor"], (Pb, K))
+        out["mv_pairs_t"] = v["mv_pairs_t"]
+        out["mv_pairs_v"] = v["mv_pairs_v"]
+    if F > 1:
+        # padded groups are provable no-op steps, fusable with anything
+        # (same convention as the client's G pad)
+        out["fuse"] = pad_to(v["fuse"], (Gb,), fill=True)
+    return pack_inputs1(out, Tb, Db, Zb, Cb, Gb, Eb, Pb, K, M, F)
+
+
+def unpad_outputs(obuf: np.ndarray, kv: dict, kvB: dict) -> np.ndarray:
+    """Slice a bucket-shaped output buffer back to the request's exact
+    statics and re-pack — the inverse leg of pad_arena. Byte-identical
+    to what a solo solve at ``kv`` would have produced (the inertness
+    contract; fuzzed in tests/test_tenancy.py)."""
+    if all(kv[k] == kvB[k] for k in BUCKET_DIMS):
+        return np.asarray(obuf)
+    o = unpack_outputs1(np.asarray(obuf), kvB["T"], kvB["D"], kvB["Z"],
+                        kvB["C"], kvB["G"], kvB["E"], kvB["P"],
+                        kv["n_max"])
+    T, D, Z, C = kv["T"], kv["D"], kv["Z"], kv["C"]
+    G, E, P = kv["G"], kv["E"], kv["P"]
+    Eb, n_max = kvB["E"], kv["n_max"]
+    # slot axis: keep the caller's existing rows, drop the dead padded
+    # existing rows [E, Eb), keep the new-node section
+    keep = np.r_[0:E, Eb:Eb + n_max]
+    out = {
+        "leftover": o["leftover"][:G],
+        "used": o["used"][keep][:, :D],
+        "pool": o["pool"][keep],
+        "num_nodes": o["num_nodes"],
+        "pool_used": o["pool_used"][:P, :D],
+        "takes": o["takes"][:G][:, keep],
+        "types": o["types"][keep][:, :T],
+        "zones": o["zones"][keep][:, :Z],
+        "ct": o["ct"][keep][:, :C],
+        "alive": o["alive"][keep],
+    }
+    return pack_outputs1(out, T, D, Z, C, G, E, P, n_max)

@@ -1,0 +1,122 @@
+"""Persistent AOT compile cache for the sidecar server.
+
+A fresh server process (rolling restart, horizontal scale-out) pays a
+full XLA compile for every bucket its tenants touch — seconds per shape
+class on the serving path. JAX's persistent compilation cache persists
+compiled executables keyed by HLO hash; pointing every server replica
+at one directory means a known bucket's first solve on a NEW process is
+a disk read, not a compile.
+
+This module owns the wiring and the observability:
+
+- ``configure_compile_cache`` points JAX at a cache dir versioned by
+  jax/jaxlib (an executable compiled by one jaxlib is garbage to
+  another — versioned subdirs make rollbacks safe) and drops the
+  min-compile-time floor so EVERY kernel persists, not just slow ones.
+- ``CompileCacheMonitor`` counts cache hits/misses via jax.monitoring
+  events, surfaces them through utils.metrics counters and the Info
+  RPC (clients and the warm-start acceptance test read them there).
+
+Everything degrades to a no-op when jax is absent or predates the
+monitoring events — the sidecar must keep serving without the cache.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+
+log = logging.getLogger(__name__)
+
+#: jax.monitoring event names fired by jax's compilation-cache lookup
+_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+_MISS_EVENT = "/jax/compilation_cache/cache_misses"
+
+#: process-wide counts; jax.monitoring listeners cannot be unregistered,
+#: so ONE module-level listener feeds however many monitors exist
+_counts = {"hits": 0, "misses": 0}
+_counts_mu = threading.Lock()
+_monitors: list = []
+_listener_installed = False
+
+
+def _on_event(name, **kw):
+    if name == _HIT_EVENT:
+        kind = "hits"
+    elif name == _MISS_EVENT:
+        kind = "misses"
+    else:
+        return
+    with _counts_mu:
+        _counts[kind] += 1
+        monitors = list(_monitors)
+    for m in monitors:
+        m._record(kind)
+
+
+def _install_listener() -> bool:
+    global _listener_installed
+    if _listener_installed:
+        return True
+    try:
+        from jax import monitoring
+        monitoring.register_event_listener(_on_event)
+        _listener_installed = True
+        return True
+    except Exception as e:  # jax absent / api moved: serve without it
+        log.debug("compile-cache monitoring unavailable: %s", e)
+        return False
+
+
+def configure_compile_cache(cache_dir=None, min_compile_time_s=0.0) -> str:
+    """Point JAX's persistent compilation cache at a jax/jaxlib-
+    versioned subdir of ``cache_dir`` (default: $KARPENTER_JAX_CACHE or
+    .jax_cache next to the package) and return the resolved path ("" if
+    jax is unavailable). Idempotent; safe to call before or after
+    ops/ffd_jax.py's import-time setup — the last call wins as long as
+    nothing compiled yet, which is why the server calls this at
+    startup, before the first solve."""
+    try:
+        import jax
+        import jaxlib
+    except Exception:
+        return ""
+    if cache_dir is None:
+        cache_dir = os.environ.get("KARPENTER_JAX_CACHE") or os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            ".jax_cache")
+    path = os.path.join(
+        str(cache_dir), f"jax-{jax.__version__}-jaxlib-{jaxlib.__version__}")
+    try:
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          float(min_compile_time_s))
+    except Exception as e:  # older jax without the knobs: still serve
+        log.debug("persistent compile cache not configured: %s", e)
+        return ""
+    return path
+
+
+class CompileCacheMonitor:
+    """Hit/miss counts scoped to one consumer (the server handler):
+    deltas against the process-wide counters from the moment the
+    monitor was created, plus metric emission per event."""
+
+    def __init__(self, metrics=None):
+        self.metrics = metrics
+        self.enabled = _install_listener()
+        with _counts_mu:
+            self._base = dict(_counts)
+            _monitors.append(self)
+
+    def _record(self, kind: str) -> None:
+        if self.metrics is not None:
+            self.metrics.inc(
+                f"karpenter_solver_compile_cache_{kind}_total")
+
+    def counts(self) -> dict:
+        """{"hits": n, "misses": n} seen since this monitor started."""
+        with _counts_mu:
+            return {k: _counts[k] - self._base[k] for k in _counts}

@@ -324,6 +324,123 @@ class TestBatchWireChaos:
         finally:
             srv.stop()
 
+class TestTwoTenantChaos:
+    """Satellite: tenant isolation under adversarial load. A hostile
+    tenant hammers the SAME server (poison frames, deadline storms,
+    quota-exhaustion bursts) while a quiet tenant keeps solving — the
+    quiet tenant's fingerprints must be byte-identical to its solo
+    baseline and its p99 bounded by the solo p99 plus the coalescer
+    window (plus scheduler slack for a loaded CI box)."""
+
+    def _quiet_snaps(self, env, n=8):
+        pool = env.nodepool("ttq")
+        return [env.snapshot(
+            make_pods(6 + (j % 3), cpu="500m", memory="1Gi",
+                      prefix=f"ttq{j}"), [pool]) for j in range(n)]
+
+    def test_hostile_tenant_changes_nothing_for_the_quiet_one(self, env):
+        import grpc
+
+        from karpenter_provider_aws_tpu.fake.faultwire import TenantHammer
+        from karpenter_provider_aws_tpu.tenancy.admission import TenantQuota
+        srv = SolverServer(
+            quotas={"hammer": TenantQuota(rate=5.0, burst=2,
+                                          max_inflight=2)},
+            compile_cache=False).start()
+        try:
+            quiet = RemoteSolver(srv.address, n_max=64, backend="jax",
+                                 tenant="quiet")
+            quiet._router.alive.mark_ok()
+            snaps = self._quiet_snaps(env)
+            # warm pass resolves compiles; the timed solo pass is the
+            # baseline the under-attack pass is held to
+            for s in snaps:
+                quiet.solve(s)
+            solo_fps, solo_lat = [], []
+            for s in snaps:
+                t0 = time.perf_counter()
+                fp = quiet.solve(s).decision_fingerprint()
+                solo_lat.append(time.perf_counter() - t0)
+                solo_fps.append(fp)
+            hammer = TenantHammer(srv.address, tenant="hammer",
+                                  seed=17).start()
+            try:
+                atk_fps, atk_lat = [], []
+                for _ in range(2):
+                    for s in snaps:
+                        t0 = time.perf_counter()
+                        fp = quiet.solve(s).decision_fingerprint()
+                        atk_lat.append(time.perf_counter() - t0)
+                        atk_fps.append(fp)
+            finally:
+                outcomes = hammer.stop()
+            # the storm really ran: poison frames answered and the
+            # quota sheds billed to the hammer tenant
+            assert outcomes.get("INVALID_ARGUMENT", 0) >= 1, outcomes
+            assert outcomes.get("RESOURCE_EXHAUSTED", 0) >= 1, outcomes
+            assert set(hammer.attacks) >= {"poison", "burst"}
+            # isolation: byte-identical decisions for the quiet tenant
+            assert atk_fps == solo_fps * 2, \
+                "hostile tenant changed the quiet tenant's decisions"
+            # bounded p99: solo baseline + the coalescer window + slack
+            # for scheduler noise on a shared CI box
+            p99_solo = sorted(solo_lat)[-1]
+            p99_atk = sorted(atk_lat)[int(len(atk_lat) * 0.99)]
+            window = srv._handler._coalescer.max_window_s
+            assert p99_atk <= p99_solo + window + 0.75, \
+                (f"quiet tenant p99 {p99_atk:.3f}s blew past solo "
+                 f"{p99_solo:.3f}s + window {window:.3f}s")
+            # the shed carried the retry-after hint over the real wire
+            ch = grpc.insecure_channel(srv.address)
+            solve = ch.unary_unary("/karpenter.solver.v1.Solver/Solve")
+            md = (("x-solver-tenant", "hammer"),)
+            hint = None
+            for _ in range(4):
+                try:
+                    solve(b"\x00poison", metadata=md)
+                except grpc.RpcError as e:
+                    if e.code() == grpc.StatusCode.RESOURCE_EXHAUSTED:
+                        hint = dict(e.trailing_metadata() or ()).get(
+                            "x-retry-after-ms")
+                        break
+            ch.close()
+            assert hint is not None and int(hint) >= 1
+        finally:
+            srv.stop()
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("seed", CHAOS_SEEDS[:5])
+    def test_hammer_seed_sweep_keeps_decisions_identical(self, env, seed):
+        """hack/chaostenant.sh sweep: under every seed's attack schedule
+        the quiet tenant's decisions stay byte-identical to its solo
+        baseline. Latency bounds live in the single-seed test above;
+        this sweep is purely about decision integrity per schedule."""
+        from karpenter_provider_aws_tpu.fake.faultwire import TenantHammer
+        from karpenter_provider_aws_tpu.tenancy.admission import TenantQuota
+        srv = SolverServer(
+            quotas={"hammer": TenantQuota(rate=5.0, burst=2,
+                                          max_inflight=2)},
+            compile_cache=False).start()
+        try:
+            quiet = RemoteSolver(srv.address, n_max=64, backend="jax",
+                                 tenant="quiet")
+            quiet._router.alive.mark_ok()
+            snaps = self._quiet_snaps(env, n=6)
+            solo_fps = [quiet.solve(s).decision_fingerprint()
+                        for s in snaps]
+            hammer = TenantHammer(srv.address, tenant="hammer",
+                                  seed=seed).start()
+            try:
+                atk_fps = [quiet.solve(s).decision_fingerprint()
+                           for s in snaps]
+            finally:
+                outcomes = hammer.stop()
+            assert sum(outcomes.values()) >= 1, outcomes
+            assert atk_fps == solo_fps, \
+                f"seed {seed}: hostile tenant changed quiet decisions"
+        finally:
+            srv.stop()
+
 @pytest.mark.slow
 @pytest.mark.parametrize("seed", CHAOS_SEEDS)
 def test_batch_seed_sweep_matches_oracle(server, env, seed):

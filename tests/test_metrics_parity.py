@@ -50,7 +50,7 @@ class Clock:
 
 
 @pytest.fixture(scope="module")
-def emitted():
+def emitted(tmp_path_factory):
     """One composite run that touches every family, then the union of
     series names present in the registry."""
     clock = Clock()
@@ -360,6 +360,83 @@ def emitted():
         coal.run(("mx",), 2, None, _boom, "Solve")
     except RuntimeError:
         pass
+
+    # multi-tenant serving families: a live mini-sidecar with a quota'd
+    # tenant. One real solve drives admission, the fair-queue wait
+    # histogram, and bucket padding (D=2 pads to the D=8 floor) through
+    # the full wire path; a poison pair past the token bucket lands the
+    # shed counter; the compile-cache counters ride jax's monitoring
+    # events through the server's live listener; the shape-class LRU
+    # evicts under a capacity-1 table
+    import grpc as _grpc
+
+    from karpenter_provider_aws_tpu.ops.hostpack import pack_inputs1
+    from karpenter_provider_aws_tpu.sidecar.client import SolverClient
+    from karpenter_provider_aws_tpu.sidecar.server import SolverServer
+    from karpenter_provider_aws_tpu.tenancy.admission import (
+        ShapeClassTable, TenantQuota)
+    _rng = _np.random.default_rng(5)
+    _T, _D, _Z, _C, _G, _E, _P = 3, 2, 1, 1, 2, 0, 1
+    _arrays = dict(
+        A=_rng.integers(1, 9, size=(_T, _D)),
+        R=_rng.integers(0, 3, size=(_G, _D)),
+        n=_rng.integers(1, 4, size=(_G,)),
+        daemon=_np.zeros((_G, _P, _D), _np.int64),
+        pool_limit=_np.full((_P, _D), -1, _np.int64),
+        pool_used0=_np.zeros((_P, _D), _np.int64),
+        ex_alloc=_np.zeros((_E, _D), _np.int64),
+        ex_used0=_np.zeros((_E, _D), _np.int64),
+        avail_zc=_np.ones((_T, _Z * _C), bool),
+        F=_np.ones((_G, _T), bool),
+        agz=_np.ones((_G, _Z), bool),
+        agc=_np.ones((_G, _C), bool),
+        admit=_np.ones((_G, _P), bool),
+        pool_types=_np.ones((_P, _T), bool),
+        pool_agz=_np.ones((_P, _Z), bool),
+        pool_agc=_np.ones((_P, _C), bool),
+        ex_compat=_np.zeros((_G, _E), bool),
+    )
+    _buf = pack_inputs1(_arrays, _T, _D, _Z, _C, _G, _E, _P, 0, 0, 1)
+    _kv = dict(T=_T, D=_D, Z=_Z, C=_C, G=_G, E=_E, P=_P, n_max=8,
+               K=0, V=0, M=0, F=1)
+    _srv = SolverServer(
+        metrics=op.metrics,
+        quotas={"parity-greedy": TenantQuota(rate=0.001, burst=1)},
+        compile_cache=True,
+        compile_cache_dir=str(
+            tmp_path_factory.mktemp("parity-jitcache"))).start()
+    try:
+        SolverClient(_srv.address,
+                     tenant="parity-light").solve_buffer(_buf, _kv)
+        _ch = _grpc.insecure_channel(_srv.address)
+        _solve = _ch.unary_unary("/karpenter.solver.v1.Solver/Solve")
+        _md = (("x-solver-tenant", "parity-greedy"),)
+        for _ in range(2):  # 1st spends the burst token; 2nd is shed
+            try:
+                _solve(b"not-an-arena", metadata=_md)
+            except _grpc.RpcError:
+                pass
+        # hit/miss events through the real listener chain — whether the
+        # solve above compiled (miss) or rode an earlier test's jit
+        # cache (no event) depends on module order, so fire both
+        # deterministically via jax's own monitoring API
+        import jax.monitoring
+        jax.monitoring.record_event("/jax/compilation_cache/cache_hits")
+        jax.monitoring.record_event("/jax/compilation_cache/cache_misses")
+        _ch.close()
+        # the conftest forces 8 virtual devices, where the wire takes
+        # the mesh path and bucket padding stays out by design — drive
+        # the handler's pad step directly (D=2 pads to the D=8 floor)
+        from karpenter_provider_aws_tpu.tenancy.bucketing import \
+            bucket_statics
+        _srv._handler._pad(_np.asarray(_buf), _kv, bucket_statics(_kv),
+                           None, "Solve")
+    finally:
+        _srv.stop()
+    _shapes = ShapeClassTable(capacity=1, min_idle_s=0.0,
+                              metrics=op.metrics)
+    _shapes.admit(("s1",), tenant="parity-light")
+    _shapes.admit(("s2",), tenant="parity-light")
 
     # incremental-encoder tier census on one resident solver: cold full,
     # memo hit, rows-tier patch (patched_rows histogram), then a

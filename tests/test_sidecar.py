@@ -301,7 +301,7 @@ class TestSolvePrunedWire:
             def __init__(self):
                 self.vec = None
 
-            def solve_pruned_buffer(self, buf, statics):
+            def solve_pruned_buffer(self, buf, statics, cache_tag=None):
                 self.vec = [statics.get(k, 0) for k in PRUNED_STATIC_KEYS]
                 return np.ones(1, np.int64)  # bail word
 

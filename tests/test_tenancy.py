@@ -1,0 +1,684 @@
+"""Multi-tenant serving layer: admission quotas, LRU shape-class slots,
+deficit-round-robin fairness, bucketed-padding byte-identity, the shed
+leg of the resilience policy, request-bytes residency, and the
+persistent compile cache's warm start."""
+
+import threading
+import types
+
+import numpy as np
+import pytest
+
+from karpenter_provider_aws_tpu.tenancy.admission import (
+    DEFAULT_TENANT, RETRY_AFTER_METADATA_KEY, AdmissionController,
+    ShapeClassTable, TenantQuota, TokenBucket, tenant_from_metadata)
+from karpenter_provider_aws_tpu.tenancy.bucketing import (
+    bucket_dim, bucket_statics, pad_arena, unpad_outputs)
+from karpenter_provider_aws_tpu.tenancy.fairness import FairQueue
+from karpenter_provider_aws_tpu.utils.metrics import Metrics
+
+
+class Clock:
+    """Hand-driven monotonic clock for quota/LRU tests."""
+
+    def __init__(self, t: float = 100.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+# ---------------------------------------------------------------------------
+# token bucket + admission controller
+# ---------------------------------------------------------------------------
+
+class TestTokenBucket:
+    def test_burst_then_refill(self):
+        clk = Clock()
+        b = TokenBucket(rate=2.0, burst=3, clock=clk)
+        assert all(b.take()[0] for _ in range(3))
+        ok, after = b.take()
+        assert not ok and after == pytest.approx(0.5)
+        clk.advance(0.5)  # one token refills at 2 rps
+        assert b.take() == (True, 0.0)
+        assert b.take()[0] is False
+
+    def test_tokens_cap_at_burst(self):
+        clk = Clock()
+        b = TokenBucket(rate=10.0, burst=2, clock=clk)
+        clk.advance(60.0)  # a long idle period banks at most `burst`
+        assert b.take()[0] and b.take()[0]
+        assert not b.take()[0]
+
+
+class TestTenantQuota:
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            TenantQuota(rate=0)
+        with pytest.raises(ValueError):
+            TenantQuota(burst=0)
+        with pytest.raises(ValueError):
+            TenantQuota(max_inflight=0)
+
+    def test_burst_defaults_from_rate(self):
+        assert TenantQuota(rate=4.0).burst == 4
+        assert TenantQuota(rate=0.5).burst == 1
+        assert TenantQuota().burst is None
+
+
+class TestAdmissionController:
+    def test_permissive_without_quotas(self):
+        ctrl = AdmissionController()
+        for _ in range(100):
+            assert ctrl.enter("anyone")[0]
+
+    def test_rate_shed_and_recovery(self):
+        clk, m = Clock(), Metrics()
+        ctrl = AdmissionController(
+            default_quota=TenantQuota(rate=1.0, burst=2),
+            metrics=m, clock=clk)
+        assert ctrl.enter("a", rpc="Solve")[0]
+        ctrl.release("a")
+        assert ctrl.enter("a", rpc="Solve")[0]
+        ctrl.release("a")
+        ok, reason, after = ctrl.enter("a", rpc="Solve")
+        assert (ok, reason) == (False, "rate") and after > 0
+        clk.advance(after)
+        assert ctrl.enter("a", rpc="Solve")[0]
+        ctrl.release("a")
+        assert m.counter("karpenter_solver_tenant_admitted_total",
+                         labels={"tenant": "a", "rpc": "Solve"}) == 3
+        assert m.counter("karpenter_solver_tenant_shed_total",
+                         labels={"tenant": "a", "rpc": "Solve",
+                                 "reason": "rate"}) == 1
+
+    def test_inflight_cap(self):
+        m = Metrics()
+        ctrl = AdmissionController(
+            default_quota=TenantQuota(max_inflight=2), metrics=m)
+        assert ctrl.enter("a")[0] and ctrl.enter("a")[0]
+        ok, reason, after = ctrl.enter("a")
+        assert (ok, reason, after) == (False, "inflight", 0.0)
+        assert m.gauge("karpenter_solver_tenant_inflight",
+                       labels={"tenant": "a"}) == 2
+        ctrl.release("a")
+        assert ctrl.enter("a")[0]
+        assert ctrl.inflight("a") == 2
+
+    def test_tenants_are_isolated(self):
+        clk = Clock()
+        ctrl = AdmissionController(
+            default_quota=TenantQuota(rate=1.0, burst=1), clock=clk)
+        assert ctrl.enter("a")[0]
+        assert not ctrl.enter("a")[0]  # a's bucket is empty...
+        assert ctrl.enter("b")[0]      # ...b's is untouched
+
+    def test_per_tenant_quota_overrides_default(self):
+        ctrl = AdmissionController(
+            quotas={"vip": TenantQuota(max_inflight=5)},
+            default_quota=TenantQuota(max_inflight=1))
+        assert ctrl.enter("other")[0]
+        assert not ctrl.enter("other")[0]
+        for _ in range(5):
+            assert ctrl.enter("vip")[0]
+        assert not ctrl.enter("vip")[0]
+
+
+class TestTenantFromMetadata:
+    def test_default_when_absent(self):
+        assert tenant_from_metadata(None) == DEFAULT_TENANT
+        assert tenant_from_metadata(()) == DEFAULT_TENANT
+        assert tenant_from_metadata(
+            (("x-solver-token", "t"),)) == DEFAULT_TENANT
+
+    def test_reads_and_clamps(self):
+        assert tenant_from_metadata(
+            (("x-solver-tenant", "acme"),)) == "acme"
+        long = "x" * 500
+        assert tenant_from_metadata(
+            (("x-solver-tenant", long),)) == "x" * 64
+
+
+# ---------------------------------------------------------------------------
+# shape-class LRU (satellite: the 65th shape admits once one is idle)
+# ---------------------------------------------------------------------------
+
+class TestShapeClassTable:
+    def test_lru_eviction_admits_the_65th_shape(self):
+        clk, m = Clock(), Metrics()
+        table = ShapeClassTable(capacity=64, min_idle_s=30.0,
+                                metrics=m, clock=clk)
+        for i in range(64):
+            assert table.admit(("shape", i), "a")
+            clk.advance(0.01)
+        # every slot was used <30s ago: the table is hot, the 65th sheds
+        assert not table.admit(("shape", 64), "b")
+        assert len(table) == 64
+        # after the idle window the LRU slot (shape 0) may be reclaimed
+        clk.advance(31.0)
+        assert table.admit(("shape", 64), "b")
+        assert ("shape", 0) not in table
+        assert ("shape", 1) in table and ("shape", 64) in table
+        assert len(table) == 64
+        assert m.counter("karpenter_solver_shape_class_evictions_total",
+                         labels={"tenant": "a"}) == 1
+
+    def test_touch_refreshes_lru_order(self):
+        clk = Clock()
+        table = ShapeClassTable(capacity=3, min_idle_s=30.0, clock=clk)
+        for i in range(3):
+            table.admit(("s", i), "a")
+            clk.advance(1.0)
+        clk.advance(60.0)
+        assert table.admit(("s", 0), "a")  # touch: s0 becomes hottest
+        assert table.admit(("s", 3), "b")  # evicts s1, NOT s0
+        assert ("s", 0) in table and ("s", 1) not in table
+
+    def test_hot_table_never_evicts(self):
+        clk = Clock()
+        table = ShapeClassTable(capacity=2, min_idle_s=30.0, clock=clk)
+        table.admit(("s", 0), "a")
+        table.admit(("s", 1), "a")
+        for i in range(10):
+            clk.advance(1.0)
+            table.admit(("s", 0), "a")
+            table.admit(("s", 1), "a")
+            assert not table.admit(("s", 2 + i), "b")
+        assert len(table) == 2
+
+    def test_per_tenant_accounting(self):
+        table = ShapeClassTable(capacity=8)
+        table.admit("x", "a")
+        table.admit("y", "a")
+        table.admit("z", "b")
+        assert table.per_tenant() == {"a": 2, "b": 1}
+
+    def test_thread_safe_admission(self):
+        table = ShapeClassTable(capacity=16, min_idle_s=30.0)
+        results = []
+
+        def hammer(base):
+            for i in range(64):
+                results.append(table.admit(("t", base, i % 4), "a"))
+
+        threads = [threading.Thread(target=hammer, args=(b,))
+                   for b in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(table) == 16 and all(results)
+
+
+# ---------------------------------------------------------------------------
+# deficit-round-robin fair queue
+# ---------------------------------------------------------------------------
+
+class TestFairQueue:
+    def test_single_tenant_is_fifo(self):
+        q = FairQueue()
+        for i in range(5):
+            q.push(i, "only")
+        assert [q.pop() for _ in range(5)] == [0, 1, 2, 3, 4]
+        assert not q and q.pop() is None
+
+    def test_two_tenants_interleave(self):
+        q = FairQueue()
+        q.push("a1", "a")
+        q.push("a2", "a")
+        q.push("b1", "b")
+        q.push("b2", "b")
+        assert [q.pop() for _ in range(4)] == ["a1", "b1", "a2", "b2"]
+
+    def test_chatty_tenant_cannot_starve_sparse_one(self):
+        q = FairQueue()
+        for i in range(4):
+            q.push(f"a{i}", "chatty")
+        assert q.pop() == "a0"
+        q.push("b0", "sparse")
+        q.push("b1", "sparse")
+        got = [q.pop() for _ in range(5)]
+        # the sparse tenant drains at an equal share from the moment it
+        # has work, regardless of the chatty backlog ahead of it
+        assert got == ["a1", "b0", "a2", "b1", "a3"]
+
+    def test_head_is_stable_and_matches_pop(self):
+        q = FairQueue()
+        q.push("x", "a")
+        q.push("y", "b")
+        for _ in range(3):
+            assert q.head() == "x"  # peeking never advances the ring
+        assert q.pop() == "x"
+        assert q.head() == "y" and q.pop() == "y"
+        assert q.head() is None
+
+    def test_iteration_and_len(self):
+        q = FairQueue()
+        q.push(1, "a")
+        q.push(2, "b")
+        q.push(3, "a")
+        assert len(q) == 3
+        assert list(q) == [1, 3, 2]  # lane arrival order, FIFO per lane
+
+    def test_lane_retire_and_reuse(self):
+        q = FairQueue()
+        q.push("a1", "a")
+        q.push("b1", "b")
+        q.push("c1", "c")
+        assert [q.pop() for _ in range(3)] == ["a1", "b1", "c1"]
+        assert len(q._order) == 0  # drained lanes leave the ring
+        q.push("b2", "b")
+        assert q.pop() == "b2"
+
+
+# ---------------------------------------------------------------------------
+# bucketed padding
+# ---------------------------------------------------------------------------
+
+class TestBucketBoundaries:
+    def test_type_axis_rides_the_15_ladder(self):
+        got = [bucket_dim("T", v) for v in (1, 2, 3, 4, 5, 6, 7, 13, 16)]
+        assert got == [1, 2, 3, 4, 6, 6, 8, 16, 16]
+
+    def test_resource_axis_keeps_client_floor(self):
+        assert bucket_dim("D", 3) == 8
+        assert bucket_dim("D", 9) == 16
+
+    def test_pow2_axes(self):
+        assert bucket_dim("E", 0) == 0 and bucket_dim("E", 3) == 4
+        assert bucket_dim("G", 5) == 8 and bucket_dim("P", 1) == 1
+        assert bucket_dim("Z", 3) == 4 and bucket_dim("C", 3) == 4
+
+    def test_bucket_statics_keeps_exact_keys_and_order(self):
+        kv = dict(T=5, D=3, Z=1, C=3, G=5, E=3, P=3, n_max=7, K=2,
+                  V=16, M=3, F=1)
+        kvB = bucket_statics(kv)
+        assert list(kvB) == list(kv)
+        assert (kvB["n_max"], kvB["K"], kvB["V"], kvB["M"], kvB["F"]) \
+            == (7, 2, 16, 3, 1)
+        assert kvB["T"] == 6 and kvB["D"] == 8 and kvB["G"] == 8
+
+
+def _random_instance(rng, F=1):
+    """One random packed solve instance with odd (off-boundary) dims."""
+    from karpenter_provider_aws_tpu.ops.hostpack import pack_inputs1
+    T = int(rng.integers(1, 14))
+    D = int(rng.integers(1, 11))
+    Z = int(rng.integers(1, 5))
+    C = int(rng.integers(1, 4))
+    G = int(rng.integers(2, 10)) if F > 1 else int(rng.integers(1, 10))
+    E = int(rng.integers(0, 7))
+    P = int(rng.integers(1, 6))
+    n_max = int(rng.integers(4, 12))
+    K = int(rng.choice([0, 0, 2])) if F == 1 else 0
+    M = int(rng.integers(1, 4)) if K else 0
+    V = 16 if K else 0
+    A = rng.integers(0, 20, size=(T, D))
+    A[rng.random(T) < 0.2] = 0
+    ex_alloc = rng.integers(0, 25, size=(E, D))
+    arrays = dict(
+        A=A,
+        R=rng.integers(0, 4, size=(G, D)),
+        n=rng.integers(0, 9, size=(G,)),
+        daemon=rng.integers(0, 2, size=(G, P, D)),
+        pool_limit=np.where(rng.random((P, D)) < 0.5, -1,
+                            rng.integers(0, 60, size=(P, D))
+                            ).astype(np.int64),
+        pool_used0=rng.integers(0, 5, size=(P, D)),
+        ex_alloc=ex_alloc,
+        ex_used0=np.minimum(rng.integers(0, 25, size=(E, D)), ex_alloc),
+        avail_zc=(rng.random((T, Z, C)) < 0.7).reshape(T, Z * C),
+        F=rng.random((G, T)) < 0.6,
+        agz=rng.random((G, Z)) < 0.8,
+        agc=rng.random((G, C)) < 0.8,
+        admit=rng.random((G, P)) < 0.7,
+        pool_types=rng.random((P, T)) < 0.6,
+        pool_agz=rng.random((P, Z)) < 0.8,
+        pool_agc=rng.random((P, C)) < 0.8,
+        ex_compat=rng.random((G, E)) < 0.5,
+    )
+    if K:
+        arrays["mv_floor"] = rng.integers(0, 3, size=(P, K))
+        arrays["mv_pairs_t"] = rng.integers(0, T, size=(K, M))
+        arrays["mv_pairs_v"] = rng.integers(1, V, size=(K, M))
+    if F > 1:
+        arrays["fuse"] = rng.random(G) < 0.5
+    kv = dict(T=T, D=D, Z=Z, C=C, G=G, E=E, P=P, n_max=n_max,
+              K=K, V=V, M=M, F=F)
+    return kv, pack_inputs1(arrays, T, D, Z, C, G, E, P, K, M, F)
+
+
+def _assert_bucket_byte_identical(kv, buf):
+    import jax.numpy as jnp
+
+    from karpenter_provider_aws_tpu.ops.ffd_jax import solve_scan_packed1
+    kvB = bucket_statics(kv)
+    solo = np.asarray(solve_scan_packed1(jnp.asarray(buf), **kv))
+    bufB = pad_arena(buf, kv, kvB)
+    outB = np.asarray(solve_scan_packed1(jnp.asarray(bufB), **kvB))
+    got = unpad_outputs(outB, kv, kvB)
+    assert got.shape == solo.shape
+    assert np.array_equal(got, solo), f"bucket demux != solo for {kv}"
+
+
+class TestBucketedByteIdentity:
+    """The acceptance criterion: a bucket solve demuxes byte-identically
+    to the solo solve, fuzzed across bucket boundaries (padded T/D/Z/C/
+    G/E/P, minValues floors, fused plans)."""
+
+    def test_fuzz_across_boundaries(self):
+        rng = np.random.default_rng(0)
+        for _ in range(5):
+            kv, buf = _random_instance(rng)
+            _assert_bucket_byte_identical(kv, buf)
+
+    def test_fused_plan(self):
+        rng = np.random.default_rng(7)
+        kv, buf = _random_instance(rng, F=2)
+        _assert_bucket_byte_identical(kv, buf)
+
+    def test_on_boundary_shapes_skip_padding(self):
+        kv = dict(T=4, D=8, Z=2, C=2, G=4, E=2, P=2, n_max=8, K=0,
+                  V=0, M=0, F=1)
+        assert bucket_statics(kv) == kv  # already on every boundary
+        buf = np.arange(64, dtype=np.int64)
+        assert pad_arena(buf, kv, kv) is buf  # fast path: no copy
+        assert unpad_outputs(buf, kv, kv) is buf
+
+
+# ---------------------------------------------------------------------------
+# resilience: shed classification
+# ---------------------------------------------------------------------------
+
+def _shed_error(after_ms="40"):
+    import grpc
+
+    class _Shed(grpc.RpcError):
+        def code(self):
+            return grpc.StatusCode.RESOURCE_EXHAUSTED
+
+        def details(self):
+            return "tenant quota exceeded"
+
+        def trailing_metadata(self):
+            return ((RETRY_AFTER_METADATA_KEY, after_ms),)
+
+    return _Shed()
+
+
+class TestResilienceShed:
+    def test_shed_waits_the_server_hint_then_retries(self):
+        from karpenter_provider_aws_tpu.sidecar.resilience import (
+            ResiliencePolicy, RetryPolicy)
+        sleeps = []
+        pol = ResiliencePolicy(retry=RetryPolicy(
+            max_attempts=3, sleep=sleeps.append))
+        calls = {"n": 0}
+
+        def attempt(deadline):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise _shed_error("40")
+            return "served"
+
+        assert pol.call(attempt, rpc="Solve") == "served"
+        assert sleeps == [pytest.approx(0.04)]
+        assert pol.breaker.state == "closed"
+
+    def test_shed_never_trips_the_breaker(self):
+        import grpc
+
+        from karpenter_provider_aws_tpu.sidecar.resilience import (
+            CircuitBreaker, ResiliencePolicy, RetryPolicy)
+        m = Metrics()
+        pol = ResiliencePolicy(
+            retry=RetryPolicy(max_attempts=2, sleep=lambda s: None),
+            breaker=CircuitBreaker(threshold=2), metrics=m)
+
+        def always_shed(deadline):
+            raise _shed_error()
+
+        with pytest.raises(grpc.RpcError) as ei:
+            pol.call(always_shed, rpc="Solve")
+        assert ei.value.code() == grpc.StatusCode.RESOURCE_EXHAUSTED
+        # 2 attempts, both shed, threshold 2 — a failure-class error
+        # would have opened the breaker; a shed must not
+        assert pol.breaker.state == "closed"
+        assert pol.last_call["ok"] is False
+        assert m.counter("karpenter_solver_sidecar_rpc_total",
+                         labels={"rpc": "Solve", "outcome": "shed"}) == 1
+
+    def test_missing_hint_falls_back_to_backoff(self):
+        import grpc
+
+        from karpenter_provider_aws_tpu.sidecar.resilience import (
+            ResiliencePolicy, RetryPolicy)
+
+        class _Bare(grpc.RpcError):
+            def code(self):
+                return grpc.StatusCode.RESOURCE_EXHAUSTED
+
+        pol = ResiliencePolicy(retry=RetryPolicy(max_attempts=1))
+        assert 0.0 <= pol._retry_after_s(_Bare(), 0) \
+            <= pol.retry.backoff_cap_s
+
+
+# ---------------------------------------------------------------------------
+# request-bytes residency (satellite: no arena_pack per warm tick)
+# ---------------------------------------------------------------------------
+
+class TestRequestResidency:
+    def _client(self):
+        from karpenter_provider_aws_tpu.sidecar.client import SolverClient
+        c = SolverClient.__new__(SolverClient)  # no channel needed
+        c._req_cache = {}
+        c.req_cache_stats = {"hits": 0, "misses": 0}
+        return c
+
+    def test_same_tag_reuses_serialized_request(self):
+        c = self._client()
+        calls = []
+
+        def build():
+            calls.append(1)
+            return b"req-%d" % len(calls)
+
+        statics = (1, 2, 3)
+        r1 = c._request_bytes("Solve", (123, 7), statics, build)
+        r2 = c._request_bytes("Solve", (123, 7), statics, build)
+        assert r1 is r2 and len(calls) == 1
+        assert c.req_cache_stats == {"hits": 1, "misses": 1}
+
+    def test_version_bump_reserializes(self):
+        c = self._client()
+        calls = []
+
+        def build():
+            calls.append(1)
+            return b"req-%d" % len(calls)
+
+        c._request_bytes("Solve", (123, 7), (1,), build)
+        # a rows-tier delta patches the arena IN PLACE: same buffer id,
+        # bumped version — the bytes on the wire MUST be rebuilt
+        c._request_bytes("Solve", (123, 8), (1,), build)
+        assert len(calls) == 2
+
+    def test_no_tag_never_caches(self):
+        c = self._client()
+        calls = []
+
+        def build():
+            calls.append(1)
+            return b"x"
+
+        c._request_bytes("Solve", None, (1,), build)
+        c._request_bytes("Solve", None, (1,), build)
+        assert len(calls) == 2
+        assert c.req_cache_stats == {"hits": 0, "misses": 0}
+
+    def test_resident_tag_requires_pack_cache_identity(self):
+        from karpenter_provider_aws_tpu.sidecar.client import RemoteSolver
+        buf = np.zeros(4, dtype=np.int64)
+        ns = types.SimpleNamespace(_pack_cache=dict(buf=buf, version=3))
+        assert RemoteSolver._resident_tag(ns, buf) == (id(buf), 3)
+        assert RemoteSolver._resident_tag(ns, buf.copy()) is None
+        ns_cold = types.SimpleNamespace(_pack_cache=None)
+        assert RemoteSolver._resident_tag(ns_cold, buf) is None
+
+
+# ---------------------------------------------------------------------------
+# wire: admission shed + tenant isolation over real gRPC
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def quota_server():
+    from karpenter_provider_aws_tpu.sidecar.server import SolverServer
+    s = SolverServer(
+        quotas={"greedy": TenantQuota(rate=0.001, burst=1)},
+        compile_cache=False).start()
+    yield s
+    s.stop()
+
+
+class TestWireAdmission:
+    def _solve_stub(self, server):
+        import grpc
+        ch = grpc.insecure_channel(server.address)
+        return ch, ch.unary_unary("/karpenter.solver.v1.Solver/Solve")
+
+    def test_shed_carries_retry_after_metadata(self, quota_server):
+        import grpc
+        ch, solve = self._solve_stub(quota_server)
+        md = (("x-solver-tenant", "greedy"),)
+        # burst=1: the first call spends the token (and fails validation
+        # downstream — admission gates BEFORE the arena is parsed)
+        with pytest.raises(grpc.RpcError) as ei:
+            solve(b"not-an-arena", metadata=md)
+        assert ei.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+        with pytest.raises(grpc.RpcError) as ei2:
+            solve(b"not-an-arena", metadata=md)
+        assert ei2.value.code() == grpc.StatusCode.RESOURCE_EXHAUSTED
+        trailers = {k: v for k, v in
+                    (ei2.value.trailing_metadata() or ())}
+        assert int(trailers[RETRY_AFTER_METADATA_KEY]) >= 1
+        ch.close()
+
+    def test_other_tenants_unaffected_by_a_shed_tenant(self, quota_server):
+        import grpc
+
+        from karpenter_provider_aws_tpu.sidecar.client import SolverClient
+        ch, solve = self._solve_stub(quota_server)
+        greedy = (("x-solver-tenant", "greedy"),)
+        with pytest.raises(grpc.RpcError):
+            solve(b"not-an-arena", metadata=greedy)
+        with pytest.raises(grpc.RpcError) as shed:
+            solve(b"not-an-arena", metadata=greedy)
+        assert shed.value.code() == grpc.StatusCode.RESOURCE_EXHAUSTED
+        # an unlisted tenant has NO quota (permissive default): admitted
+        # straight through to validation, never shed
+        with pytest.raises(grpc.RpcError) as other:
+            solve(b"not-an-arena",
+                  metadata=(("x-solver-tenant", "quiet"),))
+        assert other.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+        # Info is quota-exempt: health checks survive a shed storm
+        assert SolverClient(quota_server.address).info()["tenancy"] == 1
+        ch.close()
+
+
+# ---------------------------------------------------------------------------
+# persistent compile cache: warm start across processes
+# ---------------------------------------------------------------------------
+
+_WARM_CHILD = """
+import sys
+sys.path.insert(0, %r)
+import jax
+jax.config.update('jax_platforms', 'cpu')
+import numpy as np
+from karpenter_provider_aws_tpu.ops.hostpack import pack_inputs1
+from karpenter_provider_aws_tpu.sidecar.server import SolverServer
+from karpenter_provider_aws_tpu.sidecar.client import SolverClient
+rng = np.random.default_rng(5)
+T, D, Z, C, G, E, P = 3, 2, 1, 1, 2, 0, 1
+arrays = dict(
+    A=rng.integers(1, 9, size=(T, D)),
+    R=rng.integers(0, 3, size=(G, D)),
+    n=rng.integers(1, 4, size=(G,)),
+    daemon=np.zeros((G, P, D), np.int64),
+    pool_limit=np.full((P, D), -1, np.int64),
+    pool_used0=np.zeros((P, D), np.int64),
+    ex_alloc=np.zeros((E, D), np.int64),
+    ex_used0=np.zeros((E, D), np.int64),
+    avail_zc=np.ones((T, Z * C), bool),
+    F=np.ones((G, T), bool),
+    agz=np.ones((G, Z), bool),
+    agc=np.ones((G, C), bool),
+    admit=np.ones((G, P), bool),
+    pool_types=np.ones((P, T), bool),
+    pool_agz=np.ones((P, Z), bool),
+    pool_agc=np.ones((P, C), bool),
+    ex_compat=np.zeros((G, E), bool),
+)
+buf = pack_inputs1(arrays, T, D, Z, C, G, E, P, 0, 0, 1)
+kv = dict(T=T, D=D, Z=Z, C=C, G=G, E=E, P=P, n_max=8, K=0, V=0, M=0, F=1)
+srv = SolverServer(compile_cache_dir=%r).start()
+cl = SolverClient(srv.address)
+out = cl.solve_buffer(buf, kv)
+info = cl.info()
+srv.stop()
+assert out.size > 1
+print('CACHE hits=%%d misses=%%d' %% (info['compile_cache_hits'],
+                                      info['compile_cache_misses']))
+"""
+
+
+class TestCompileCacheWarmStart:
+    def test_fresh_process_first_solve_hits_the_cache(self, tmp_path):
+        """The acceptance criterion: with a warm persistent cache dir, a
+        FRESH server process serves its first solve with zero compiles
+        (every lookup a cache hit), asserted via the Info counters."""
+        import os
+        import subprocess
+        import sys
+        repo = str(__import__("pathlib").Path(__file__).resolve().parents[1])
+        code = _WARM_CHILD % (repo, str(tmp_path / "jitcache"))
+        env = {**os.environ, "JAX_PLATFORMS": "cpu", "XLA_FLAGS": ""}
+
+        def run():
+            r = subprocess.run([sys.executable, "-c", code],
+                               capture_output=True, text=True,
+                               timeout=300, env=env)
+            assert "CACHE " in r.stdout, (r.stdout[-2000:],
+                                          r.stderr[-2000:])
+            line = [ln for ln in r.stdout.splitlines()
+                    if ln.startswith("CACHE ")][0]
+            parts = dict(p.split("=") for p in line.split()[1:])
+            return int(parts["hits"]), int(parts["misses"])
+
+        hits1, misses1 = run()   # cold dir: every compile is a miss
+        assert misses1 >= 1 and hits1 == 0
+        hits2, misses2 = run()   # warm dir, FRESH process: zero compiles
+        assert hits2 >= 1 and misses2 == 0
+
+    def test_monitor_counts_are_scoped(self):
+        from karpenter_provider_aws_tpu.tenancy import compilecache as cc
+        m1 = cc.CompileCacheMonitor()
+        cc._on_event("/jax/compilation_cache/cache_hits")
+        cc._on_event("/jax/compilation_cache/cache_misses")
+        m2 = cc.CompileCacheMonitor()
+        cc._on_event("/jax/compilation_cache/cache_hits")
+        assert m1.counts() == {"hits": 2, "misses": 1}
+        assert m2.counts() == {"hits": 1, "misses": 0}
+
+    def test_configure_returns_versioned_dir(self, tmp_path):
+        from karpenter_provider_aws_tpu.tenancy.compilecache import (
+            configure_compile_cache)
+        import jax
+        import jaxlib
+        path = configure_compile_cache(str(tmp_path / "cc"))
+        assert jax.__version__ in path and jaxlib.__version__ in path
+        import os
+        assert os.path.isdir(path)
