@@ -40,9 +40,10 @@ benchmark:  ## the five BASELINE configs + interruption + batch dispatch
 	python bench.py --sidecar-batch
 	python bench.py --delta-solve
 	python bench.py --tenant-mix
+	python bench.py --mesh-batch
 
-multichip:  ## dry-run the multi-device solve on 8 virtual CPU devices
-	python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+multichip:  ## multi-device solve: driver dryrun + mesh parity suites
+	sh hack/multichip.sh
 
 daemon:  ## run the operator against the in-memory cloud
 	python -m karpenter_provider_aws_tpu --cluster-name dev --metrics-port 8080

@@ -1388,10 +1388,103 @@ def run_device_kernel_inner(pods, rounds):
         return orig_mesh(arrays, ndev=mesh_ndev, **kw)
 
     tpu._dispatch_mesh = forced_mesh
-    host_fp = TPUSolver(backend="numpy").solve(snap).decision_fingerprint
-    sec = measure(tpu, snap, lambda: host_fp())
-    sec.update(ndev=mesh_ndev, section="mesh")
-    print(json.dumps(sec), flush=True)
+    try:
+        host_fp = TPUSolver(backend="numpy").solve(snap).decision_fingerprint
+        sec = measure(tpu, snap, lambda: host_fp())
+        sec.update(ndev=mesh_ndev, section="mesh")
+        print(json.dumps(sec), flush=True)
+    finally:
+        # restore the class-level dispatch: the instance overrides must
+        # not outlive the mesh section (a later user of this solver —
+        # or a partial capture after an exception here — would silently
+        # keep riding the forced mesh branch)
+        del tpu._dispatch_mesh
+        del tpu._dev_devices
+
+
+def run_mesh_batch_bench(batch=64, rounds=30):
+    """Batch-axis data parallelism evidence: B packed solve frames
+    dp-sharded over an 8-virtual-device CPU mesh (one vmapped dispatch,
+    B/n lanes per device, zero collectives) vs the same B lanes solved
+    sequentially on one device. Runs in a subprocess because the
+    virtual-device-count XLA flag is read once, at backend init."""
+    import os
+    import subprocess
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = \
+            (flags + " --xla_force_host_platform_device_count=8").strip()
+    cmd = [sys.executable, __file__, "--mesh-batch-inner",
+           "--batch", str(batch), "--rounds", str(rounds)]
+    proc = subprocess.run(cmd, capture_output=True, text=True,
+                          timeout=600, env=env)
+    if proc.returncode != 0:
+        return {"mesh_batch": {"ok": False,
+                               "stderr_tail": proc.stderr[-2000:]}}
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def run_mesh_batch_inner(batch, rounds):
+    """Subprocess body for --mesh-batch (the parent pins JAX_PLATFORMS=cpu
+    and the 8-virtual-device flag before this process imports jax).
+    Every sharded lane is byte-compared to its own single-device solve
+    before any timing is recorded."""
+    import numpy as np
+
+    import __graft_entry__ as ge
+    import jax
+
+    from karpenter_provider_aws_tpu.ops.ffd_jax import (
+        solve_scan_packed1, solve_scan_packed1_many)
+    from karpenter_provider_aws_tpu.ops.hostpack import pack_inputs1
+    from karpenter_provider_aws_tpu.parallel import shard_batch
+
+    ndev = len(jax.devices())
+    shp = dict(T=48, D=4, Z=4, C=3, G=8, E=0, P=1)
+    kv = dict(shp, n_max=64)
+    bufs = []
+    for i in range(batch):
+        arrays, _ = ge._example_arrays()
+        arrays["n"] = (arrays["n"] + i) % 50 + 1  # distinct lanes
+        bufs.append(pack_inputs1(arrays, **shp))
+    stack_np = np.stack(bufs)
+    cache: dict = {}
+    dstack, B = shard_batch(stack_np, ndev, cache)
+    outs = np.asarray(solve_scan_packed1_many(dstack, **kv))[:B]  # compile
+    d0 = jax.devices()[0]
+    dev_bufs = [jax.device_put(b, d0) for b in bufs]
+    for i, b in enumerate(dev_bufs):
+        one = np.asarray(solve_scan_packed1(b, **kv))
+        assert (outs[i] == one).all(), f"mesh-batch lane {i} diverged"
+
+    def timed(fn):
+        gc.collect()
+        times = []
+        for _ in range(rounds):
+            t0 = time.perf_counter()
+            fn()
+            times.append((time.perf_counter() - t0) * 1e3)
+        return _percentiles(times)
+
+    def sharded():  # end to end: host stack -> sharded place -> dispatch
+        ds, _ = shard_batch(stack_np, ndev, cache)
+        jax.block_until_ready(solve_scan_packed1_many(ds, **kv))
+
+    def sequential():  # pre-placed: sequential lanes pay no h2d here
+        for b in dev_bufs:
+            jax.block_until_ready(solve_scan_packed1(b, **kv))
+
+    sp50, sp99 = timed(sharded)
+    qp50, qp99 = timed(sequential)
+    print(json.dumps({"mesh_batch": {
+        "ok": True, "batch": B, "ndev": ndev, "identical_lanes": True,
+        "sharded_p50_ms": sp50, "sharded_p99_ms": sp99,
+        "sequential_p50_ms": qp50, "sequential_p99_ms": qp99,
+        "speedup_p50": round(qp50 / sp50, 2) if sp50 else 0.0,
+    }}), flush=True)
 
 
 def run_interruption_bench(counts=(100, 1000, 5000, 15000)):
@@ -1513,6 +1606,13 @@ def main():
                          "tenant floods a loopback sidecar while light "
                          "tenants solve; reports per-tenant p99 and "
                          "shed counts")
+    ap.add_argument("--mesh-batch", action="store_true",
+                    help="bench batch-axis data parallelism: B packed "
+                         "frames dp-sharded over an 8-virtual-device CPU "
+                         "mesh vs the same lanes sequentially on one "
+                         "device, with per-lane byte identity")
+    ap.add_argument("--mesh-batch-inner", action="store_true",
+                    help=argparse.SUPPRESS)  # subprocess body (env-pinned)
     ap.add_argument("--probe-device", action="store_true",
                     help="link-vs-kernel decomposition of the device path")
     ap.add_argument("--device-kernel", action="store_true",
@@ -1552,6 +1652,15 @@ def main():
         print(json.dumps(run_tenant_mix_bench(
             rounds=min(args.rounds, 40))))
         return
+    if args.mesh_batch_inner:
+        run_mesh_batch_inner(batch=args.batch, rounds=min(args.rounds, 30))
+        return
+    if args.mesh_batch:
+        print(json.dumps(run_mesh_batch_bench(
+            batch=args.batch if args.batch != ap.get_default("batch")
+            else 64,
+            rounds=min(args.rounds, 30))))
+        return
     if args.probe_device:
         run_device_probe(args.pods)
         return
@@ -1562,6 +1671,25 @@ def main():
         rec = run_device_kernel(args.pods, min(args.rounds, 50))
         print(json.dumps(rec))
         return
+
+    # 2-D mesh pod ceiling: the dp axis splits the slot-indexed carry
+    # that caps a replicated mesh near 50k pods, lifting the envelope to
+    # 500k. On a real multi-chip mesh the headline measures AT the new
+    # ceiling — the shape only the sharded carry can hold. Gated on the
+    # user leaving --pods at its default (an explicit --pods wins) and on
+    # an actually-alive multi-device backend (probe is deadline-guarded).
+    mesh_ceiling = False
+    if args.pods == ap.get_default("pods") and args.backend != "numpy":
+        try:
+            from karpenter_provider_aws_tpu.solver.route import (
+                dev_device_count, device_alive)
+            if device_alive() and dev_device_count() >= 2:
+                args.pods = 500_000
+                mesh_ceiling = True
+                print(f"mesh ceiling: {dev_device_count()} live devices, "
+                      f"headline at {args.pods} pods", file=sys.stderr)
+        except Exception as e:  # the ceiling probe must never fail a bench
+            print(f"mesh ceiling probe errored: {e}", file=sys.stderr)
 
     from karpenter_provider_aws_tpu.fake.environment import Environment
 
@@ -1641,6 +1769,9 @@ def main():
         # (per-config rows under "configs" each carry their own)
         "phases": head.get("phases", {}),
     }
+    if mesh_ceiling:
+        # the headline number above was measured AT the 2-D mesh ceiling
+        extra["mesh_ceiling_pods"] = args.pods
     if results:
         extra["configs"] = {str(k): v for k, v in sorted(results.items())}
     print(json.dumps({

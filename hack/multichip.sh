@@ -1,0 +1,26 @@
+#!/bin/sh
+# Multi-chip solve validation sweep.
+#
+# Two layers, both exactness-gated (sharded decisions must be
+# byte/fingerprint-identical to the single-device kernel):
+#
+# - the driver dryrun: the real sharded programs on an 8-virtual-device
+#   CPU mesh — 1-D type mesh (tiny + the 812-type catalog with minValues
+#   floors), the 2-D ("dp","tp") mesh at the 500,032-pod ceiling, and a
+#   B=16 batch of dp-sharded packed lanes vs their sequential solves;
+# - the mesh test suites: every dp x tp factorization, sum-only
+#   collectives, resident sharded arena lifecycle (full/patch/reuse),
+#   and the bucketed byte-identity fuzz through a live mesh server.
+#
+# Usage: sh hack/multichip.sh           # dryrun + mesh suites
+#        sh hack/multichip.sh -x -q    # extra pytest args pass through
+set -e
+cd "$(dirname "$0")/.."
+
+python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+JAX_PLATFORMS=cpu exec python -m pytest \
+    tests/test_mesh_solve.py \
+    "tests/test_delta_encoding.py::TestMeshResidentArena" \
+    "tests/test_tenancy.py::TestMeshBucketedByteIdentity" \
+    -q -p no:cacheprovider "$@"

@@ -81,6 +81,26 @@ class SnapshotDelta:
     ex_rows_dirty: bool = False    # ex_alloc/ex_used moved (or E changed)
     ex_compat_dirty: bool = False  # ex_compat moved (or E changed)
 
+    def dirty_fields(self) -> Tuple[List[str], List[str]]:
+        """The dirty flags as kernel-input field names, (int64 fields,
+        bool fields) in arena layout order — the single vocabulary shared
+        by the packed-arena patch (solver/tpu.py _patch_pack_cache) and
+        the mesh resident-arena patch (parallel/mesh.py _place_resident).
+        A field NOT listed is guaranteed byte-identical to the previous
+        encode, so its resident copy (packed section or sharded device
+        buffer) stays valid."""
+        d64: List[str] = []
+        db: List[str] = []
+        if self.n_dirty:
+            d64.append("n")
+        if self.pools_dirty:
+            d64 += ["pool_limit", "pool_used0"]
+        if self.ex_rows_dirty:
+            d64 += ["ex_alloc", "ex_used0"]
+        if self.ex_compat_dirty:
+            db.append("ex_compat")
+        return d64, db
+
 
 def structural_key(snapshot: SchedulingSnapshot) -> Tuple:
     """Identity key of everything that shapes the encoding's universe:

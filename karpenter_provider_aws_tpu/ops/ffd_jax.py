@@ -422,6 +422,182 @@ def _new_nodes_phase(inp: KernelInputs, take, used, types, zones, ct,
             pool_used, n_rem)
 
 
+# ---------------------------------------------------------------------------
+# 2-D sharded scan (pods/slot axis x type axis).
+#
+# The 1-D mesh (``_solve`` with ``axis=``) shards only the type dimension;
+# every device still materialises the full [N, ...] node state, which caps
+# one giant solve at ~50k pods of slot state per chip. The dp variant below
+# additionally shards the SLOT axis (slots grow with pods: N = E + n_max)
+# across a second mesh axis. Each device owns a contiguous run of Nl slots
+# identified by GLOBAL slot ids ``axis_index(dp) * Nl + arange(Nl)``; the
+# python-static ``[:E]`` updates of the replicated kernel become ``slots < E``
+# masks, and the two order-dependent reductions become distributed forms:
+#
+#   * exclusive prefix sums (pool budgets, greedy fill) = local exclusive
+#     cumsum + the all_gathered totals of earlier shards — exact because the
+#     global slot order IS the shard-major order of the ids above;
+#   * totals (pods placed, per-pool take accounting) = psum over dp.
+#
+# Everything else is elementwise per slot (or per [slot, type] cell) and
+# needs no communication. Scalars entering the new-node phase (cap, budget,
+# q, placed, num_nodes) are replicated across both axes, so the existing
+# ``_new_nodes_phase`` is reused VERBATIM with the global slot ids — the dp
+# kernel cannot drift from the replicated one in that phase. Slot padding
+# (to a multiple of the dp axis) is inert by the same argument as the type
+# padding: padded slots carry types=False/pool=-1 so they never win a fill,
+# and ``free_slots`` uses the TRUE N so new nodes never land there.
+# minValues floors are NOT supported here (callers gate K == 0 and fall
+# back to the 1-D type mesh — the floors' segment-max rides type shards and
+# is already exact there).
+# ---------------------------------------------------------------------------
+
+
+def _dp_prefix(x: jax.Array, axis: "str | None") -> jax.Array:
+    """Distributed EXCLUSIVE prefix sum of a dp-sharded [Nl] vector in
+    global slot order: local exclusive cumsum plus the summed totals of
+    the earlier shards (one small all_gather)."""
+    local = _cumsum(x) - x
+    if axis is None:
+        return local
+    tots = jax.lax.all_gather(x.sum(), axis)             # [ndp]
+    idx = jax.lax.axis_index(axis)
+    before = jnp.where(jnp.arange(tots.shape[0]) < idx, tots, 0).sum()
+    return local + before
+
+
+def _dp_sum(x: jax.Array, axis: "str | None") -> jax.Array:
+    """Global sum of a dp-sharded quantity (Sum all-reduce lowers on every
+    backend, including the sum-only interconnects _needs_sum_only guards)."""
+    return x if axis is None else jax.lax.psum(x, axis)
+
+
+def _fill_phase_dp(inp: KernelInputs, carry: Carry, R, n, F, agz, agc, admit,
+                   ex_compat, *, dp_axis, tp_axis, P, E, slots, sum_only):
+    """``_fill_phase`` on a dp slot shard: same steps 1-4, with the [:E]
+    existing-node block replaced by ``slots < E`` masking against the
+    slot-padded existing tables and the two prefix/total reductions in
+    their distributed forms. Returns (take [Nl], n_rem, cand [Nl, Tl])."""
+    Nl = slots.shape[0]
+    Z = inp.agz.shape[1]
+    C = inp.agc.shape[1]
+    n_rem = n
+
+    # ---- candidate types per open slot (steps 1-2) ----------------
+    zc = ((carry.zones & agz[None, :])[:, :, None]
+          & (carry.ct & agc[None, :])[:, None, :]).reshape(Nl, Z * C)
+    off_ok = (zc.astype(jnp.int32) @ inp.avail_zc.T.astype(jnp.int32)) > 0
+    pool_clipped = jnp.clip(carry.pool, 0, P - 1)
+    adm_open = jnp.where(carry.pool >= 0, admit[pool_clipped], False)
+    cand = carry.types & F[None, :] & off_ok & adm_open[:, None]
+
+    # ---- headroom (step 3) ---------------------------------------
+    hr_nt = _headroom_matrix(inp.A, carry.used, R)
+    k = jnp.where(cand, hr_nt, 0).max(axis=1)
+    k = _axis_max(k, tp_axis, sum_only)   # max over type shards
+    is_ex = slots < E
+    ex_ok = is_ex & carry.alive & ex_compat
+    k_ex = jnp.where(ex_ok, _headroom_vec(inp.ex_alloc, carry.used, R), 0)
+    k = jnp.where(is_ex, k_ex, k)
+
+    # pool limit budgets: cap per-pool prefix fills
+    pool_used = carry.pool_used
+    for pi in range(P):
+        has_limit = (inp.pool_limit[pi] >= 0).any()
+        budget = _pool_budget_jax(inp.pool_limit[pi], pool_used[pi], R)
+        rows = carry.pool == pi
+        kp = jnp.where(rows, k, 0)
+        cum = _dp_prefix(kp, dp_axis)
+        capped = jnp.clip(jnp.minimum(kp, budget - cum), 0, None)
+        k = jnp.where(rows & has_limit, capped, k)
+
+    # ---- greedy prefix fill (step 4) ------------------------------
+    cum = _dp_prefix(k, dp_axis)
+    take = jnp.clip(n_rem - cum, 0, k)
+    n_rem = n_rem - _dp_sum(take.sum(), dp_axis)
+    return take, n_rem, cand
+
+
+def dp_group_step(inp: KernelInputs, carry: Carry, xs, *, dp_axis, tp_axis,
+                  P, E, N, slots, sum_only=False):
+    """One scan step of the 2-D sharded fill: dp fill phase, elementwise
+    narrowing, psum'd pool accounting, then the shared new-nodes phase."""
+    R, n, F, agz, agc, admit, daemon, ex_compat = xs
+    take, n_rem, cand = _fill_phase_dp(
+        inp, carry, R, n, F, agz, agc, admit, ex_compat,
+        dp_axis=dp_axis, tp_axis=tp_axis, P=P, E=E, slots=slots,
+        sum_only=sum_only)
+
+    # ---- narrowing + pool accounting for the filled slots ---------
+    used = carry.used + take[:, None] * R[None, :]
+    filled_open = (take > 0) & (carry.pool >= 0)
+    fit_all = (used[:, None, :] <= inp.A[None, :, :]).all(axis=-1)
+    types = jnp.where(filled_open[:, None], cand & fit_all, carry.types)
+    zones = jnp.where(filled_open[:, None], carry.zones & agz[None, :], carry.zones)
+    ct = jnp.where(filled_open[:, None], carry.ct & agc[None, :], carry.ct)
+    pool_clipped = jnp.clip(carry.pool, 0, P - 1)
+    take_by_pool = jax.ops.segment_sum(
+        take, pool_clipped * (carry.pool >= 0) + (carry.pool < 0) * P,
+        num_segments=P + 1)[:P]
+    take_by_pool = _dp_sum(take_by_pool, dp_axis)
+    pool_used = carry.pool_used + take_by_pool[:, None] * R[None, :]
+
+    (take, used, types, zones, ct, pool_arr, alive, num_nodes, pool_used,
+     n_rem) = _new_nodes_phase(
+        inp, take, used, types, zones, ct, carry.pool, carry.alive,
+        carry.num_nodes, pool_used, n_rem, R, F, agz, agc, admit, daemon,
+        axis=tp_axis, P=P, E=E, N=N, V=0, slot_idx=slots,
+        sum_only=sum_only)
+
+    new_carry = Carry(used=used, types=types, zones=zones, ct=ct,
+                      pool=pool_arr, alive=alive, num_nodes=num_nodes,
+                      pool_used=pool_used)
+    return new_carry, (take, n_rem)
+
+
+def _solve_dp(inp: KernelInputs, n_max: int, E: int, P: int,
+              dp_axis: "str | None", tp_axis: "str | None",
+              sum_only: bool = False
+              ) -> Tuple[jax.Array, jax.Array, Carry]:
+    """The 2-D sharded scan body, run under shard_map over a ("dp","tp")
+    mesh: every input field is the LOCAL shard (types split over tp, slot
+    tables split over dp, the rest replicated). The caller (parallel/
+    mesh.py) pads the slot axis of ex_alloc/ex_used0/ex_compat to the full
+    padded slot range Np = ceil((E + n_max)/ndp)*ndp with inert zeros.
+    Requires inp.mv_floor is None (K == 0); see the section comment."""
+    Tl, D = inp.A.shape
+    Z = inp.agz.shape[1]
+    C = inp.agc.shape[1]
+    Nl = inp.ex_used0.shape[0]
+    N = E + n_max   # TRUE slot count; slots in [N, Nl*ndp) are inert pad
+
+    idx = jax.lax.axis_index(dp_axis) if dp_axis is not None else 0
+    slots = idx * Nl + jnp.arange(Nl)
+    is_ex = slots < E
+
+    carry0 = Carry(
+        used=jnp.where(is_ex[:, None], inp.ex_used0, jnp.int64(0)),
+        types=jnp.zeros((Nl, Tl), bool),
+        zones=jnp.zeros((Nl, Z), bool),
+        ct=jnp.zeros((Nl, C), bool),
+        pool=jnp.where(is_ex, -2, -1).astype(jnp.int32),
+        alive=is_ex,
+        num_nodes=jnp.int32(0),
+        pool_used=inp.pool_used0,
+    )
+
+    def step(carry: Carry, xs):
+        new_carry, (take, n_rem) = dp_group_step(
+            inp, carry, xs, dp_axis=dp_axis, tp_axis=tp_axis, P=P, E=E,
+            N=N, slots=slots, sum_only=sum_only)
+        return new_carry, (take.astype(jnp.int32), n_rem)
+
+    xs = (inp.R, inp.n, inp.F, inp.agz, inp.agc, inp.admit, inp.daemon,
+          inp.ex_compat)
+    final, (takes, leftover) = jax.lax.scan(step, carry0, xs)
+    return takes, leftover, final
+
+
 def _solve_fused(inp: KernelInputs, n_max: int, E: int, P: int, Fu: int,
                  fuse: jax.Array, V: int = 0
                  ) -> Tuple[jax.Array, jax.Array, Carry]:

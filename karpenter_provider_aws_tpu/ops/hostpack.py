@@ -174,7 +174,7 @@ def pack_inputs1_state(arrays: dict, T, D, Z, C, G, E, P, K=0, M=0,
 
 def patch_inputs1(buf: np.ndarray, bool_flat: np.ndarray, arrays: dict,
                   dirty_i64, dirty_bool, T, D, Z, C, G, E, P, K=0, M=0,
-                  F=1) -> None:
+                  F=1):
     """Patch dirty fields of a RESIDENT packed arena in place.
 
     ``(buf, bool_flat)`` must be the pair ``pack_inputs1_state``
@@ -187,7 +187,14 @@ def patch_inputs1(buf: np.ndarray, bool_flat: np.ndarray, arrays: dict,
     is exactly why the plane must stay resident. The result is
     byte-identical to a fresh pack of the same arrays by construction;
     tests/test_delta_encoding.py fuzzes that equality over random dirty
-    subsets."""
+    subsets.
+
+    Returns the list of ``(start, stop)`` int64-word sections of ``buf``
+    that were overwritten (bool sections reported word-rounded, exactly
+    as repacked), so callers shipping the arena over a wire or onto a
+    device can move only the touched bytes. Existing callers that
+    ignore the return value are unaffected."""
+    sections = []
     lay64 = in_layout_i64(T, D, Z, C, G, E, P, K, M, F)
     want64 = set(dirty_i64)
     off = 0
@@ -198,6 +205,7 @@ def patch_inputs1(buf: np.ndarray, bool_flat: np.ndarray, arrays: dict,
         if nm in want64 and sz:
             buf[off:off + sz] = \
                 np.asarray(arrays[nm]).reshape(-1).astype(np.int64)
+            sections.append((off, off + sz))
         off += sz
     layb = in_layout_bool(T, D, Z, C, G, E, P, K, M, F)
     nbits = layout_sizes(layb)
@@ -215,7 +223,9 @@ def patch_inputs1(buf: np.ndarray, bool_flat: np.ndarray, arrays: dict,
             words = pack_bits(np.ascontiguousarray(
                 bool_flat[w0 << 6:end]))
             buf[off + w0:off + w0 + words.size] = words
+            sections.append((off + w0, off + w0 + words.size))
         boff += sz
+    return sections
 
 
 def unpack_outputs1(buf, T, D, Z, C, G, E, P, n_max) -> dict:

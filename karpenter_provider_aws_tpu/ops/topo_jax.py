@@ -736,11 +736,15 @@ def dispatch_topo(arrays: dict, rows: dict, statics: dict,
 
     ``arrays``: KernelInputs fields (bool masks may arrive as uint8 off
     the wire); ``rows``: TopoGroupRows fields; ``statics``: Z/P/GZ/GH/
-    n_max/EVCAP/PMAX. ``cache`` (one bucket-retry loop's scope) reuses
-    the device-placed inputs across n_max escalations so a retry pays
-    only the kernel, not a re-upload. Output values may be jax arrays —
-    callers np.asarray exactly what they consume (bail/leftover checks
-    on retry iterations must not force the full event-log transfer)."""
+    n_max/EVCAP/PMAX. ``cache`` reuses the device-placed inputs — across
+    n_max escalations within one solve (a retry pays only the kernel,
+    not a re-upload), and, when the caller keeps the dict resident
+    (TPUSolver._topo_cache), across ticks. The ``inp`` and ``rows``
+    entries are independent: the solver patches ``inp`` fields in place
+    on rows-tier deltas and evicts only ``rows`` when the tenc-derived
+    block may have moved. Output values may be jax arrays — callers
+    np.asarray exactly what they consume (bail/leftover checks on retry
+    iterations must not force the full event-log transfer)."""
     import numpy as np
 
     def conv(v):
@@ -750,12 +754,17 @@ def dispatch_topo(arrays: dict, rows: dict, statics: dict,
         return jnp.asarray(a)
 
     if cache is not None and "inp" in cache:
-        inp, trows = cache["inp"], cache["rows"]
+        inp = cache["inp"]
     else:
         inp = KernelInputs(**{k: conv(v) for k, v in arrays.items()})
+        if cache is not None:
+            cache["inp"] = inp
+    if cache is not None and "rows" in cache:
+        trows = cache["rows"]
+    else:
         trows = TopoGroupRows(**{k: conv(v) for k, v in rows.items()})
         if cache is not None:
-            cache["inp"], cache["rows"] = inp, trows
+            cache["rows"] = trows
     cz0 = jnp.zeros((statics["GZ"], statics["Z"]), jnp.int64)
     ch0 = jnp.zeros((statics["GH"], statics["n_max"]), jnp.int64)
     takes, leftover, events, zfix, bail, carry = solve_scan_topo(

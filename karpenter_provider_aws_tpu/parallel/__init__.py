@@ -1,1 +1,8 @@
-from .mesh import solve_mesh, solve_scan_sharded  # noqa: F401
+from .mesh import (  # noqa: F401
+    dispatch_mesh,
+    shard_batch,
+    solve_mesh,
+    solve_mesh2,
+    solve_scan_sharded,
+    solve_scan_sharded2,
+)

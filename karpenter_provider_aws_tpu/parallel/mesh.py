@@ -1,21 +1,44 @@
-"""Multi-device solve: tensor parallelism over the instance-type axis.
+"""Multi-device solve: the mesh as the default execution substrate.
 
 The scaling-book recipe applied to this workload: pick a mesh, annotate
-shardings, let XLA insert collectives. The solve's wide axis is the
-instance-type catalog (~850 types at full EC2 scale); the sequential FFD
-carry is a few KB. So the mesh split is:
+shardings, let XLA insert collectives. Three sharded execution shapes:
 
-- type-sharded: ``A[T,D]``, ``avail_zc[T,ZC]``, ``F[G,T]``,
-  ``pool_types[P,T]`` and the per-node candidate masks ``types[N,T]``
-- replicated: the scan carry (used/zones/ct/pool/alive/pool_used), all
-  group tensors, existing-node state
-- collectives: two ``pmax`` reductions per scan step (open-slot headroom,
-  new-node capacity) riding ICI — the analog of the reference's
-  "single-threaded hot loop" parallelized across a chip's neighbors
+1. **Type-parallel (1-D ``("tp",)`` mesh)** — the solve's wide axis is
+   the instance-type catalog (~850 types at full EC2 scale); the
+   sequential FFD carry is a few KB. ``A[T,D]``, ``avail_zc[T,ZC]``,
+   ``F[G,T]``, ``pool_types[P,T]`` and the per-node candidate masks
+   ``types[N,T]`` shard over ``tp``; the scan carry, group tensors and
+   existing-node state stay replicated; two ``pmax`` reductions per scan
+   step ride ICI.
 
-Decisions are identical to the single-device kernel by construction: the
-pmax of per-shard maxima IS the global max, and everything downstream of
-the reductions is replicated arithmetic.
+2. **2-D pods x types (``("dp","tp")`` mesh)** — for one giant solve the
+   node-slot state (``used[N,D]``, ``types[N,T]``; N grows with the pod
+   count) additionally shards over ``dp`` (ops/ffd_jax._solve_dp): slot
+   tables split by global slot id, prefix sums become local-cumsum +
+   all_gathered shard totals, pool/pod accounting becomes ``psum``. This
+   lifts the one-solve ceiling from ~50k to 500k pods. Per scan step:
+   (1 + P) tp-pmax reductions, (P + 1) dp all_gathers (P pool-budget
+   prefixes + the greedy-fill prefix, each gathering ndp scalars) and 2
+   dp psums — all O(ndp) bytes, latency-dominated. minValues floors
+   (K > 0) fall back to shape 1, whose floor segment-max already shards
+   exactly over types.
+
+3. **Batch data-parallel (``shard_batch``)** — stacked ``[B, W]`` packed
+   arenas from SolveBatch frames / coalesced riders commit with
+   ``NamedSharding(P("dp", None))`` so the jit-of-vmap packed kernel
+   lands B/ndev independent lanes per chip with ZERO cross-device
+   collectives.
+
+Decisions are identical to the single-device kernel by construction in
+every shape: the pmax of per-shard maxima IS the global max, distributed
+prefixes reproduce the global slot order exactly, batch lanes are
+independent, and everything downstream of the reductions is replicated
+arithmetic.
+
+``dispatch_mesh`` additionally keeps a RESIDENT sharded arena per cache:
+on rows-tier delta ticks only the dirty fields are re-prepped and
+``device_put`` with their owning sharding; clean fields stay on-device
+(never a full re-distribute).
 
 Multi-chip hardware isn't reachable from this environment; tests validate
 on an 8-virtual-device CPU mesh (tests/conftest.py) and the driver
@@ -31,9 +54,10 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as PS
 
-from ..ops.ffd_jax import Carry, KernelInputs, _solve
+from ..ops.ffd_jax import Carry, KernelInputs, _solve, _solve_dp
 
 AXIS = "tp"
+AXIS_DP = "dp"
 
 #: mesh fingerprint -> detected sum_only verdict (solve_scan_sharded
 #: memoization). Keyed by a STABLE mesh identity — platform, platform
@@ -89,16 +113,93 @@ def _needs_sum_only(mesh: Mesh) -> bool:
     return val
 
 
+def _resolve_sum_only(mesh: Mesh) -> bool:
+    """Memoized _needs_sum_only: detection is a property of the mesh's
+    backend, so a steady-state control loop doesn't re-sniff and re-log
+    once per solve (stable key — see _SUM_ONLY_CACHE)."""
+    key = _mesh_key(mesh)
+    cached = _SUM_ONLY_CACHE.get(key)
+    if cached is None:
+        cached = _needs_sum_only(mesh)
+        _SUM_ONLY_CACHE[key] = cached
+    return cached
+
+
+def _pick_devices(n_devices: Optional[int]):
+    devices = jax.devices()
+    if n_devices is not None:
+        if len(devices) < n_devices:
+            devices = jax.devices("cpu")
+        devices = devices[:n_devices]
+    return devices
+
+
 def solve_mesh(n_devices: Optional[int] = None,
                devices=None) -> Mesh:
     """A 1-D mesh over the type-parallel axis."""
     if devices is None:
-        devices = jax.devices()
-        if n_devices is not None:
-            if len(devices) < n_devices:
-                devices = jax.devices("cpu")
-            devices = devices[:n_devices]
+        devices = _pick_devices(n_devices)
     return Mesh(np.asarray(devices), axis_names=(AXIS,))
+
+
+def _default_dp(ndev: int) -> int:
+    """dp-axis extent for a 2-D mesh over ``ndev`` devices. KARP_MESH_DP
+    overrides (must divide the device count); default is the largest
+    power-of-two divisor with dp <= tp — the type catalog is the
+    reliably-wide axis, so it keeps the wider split. 8 devices -> 2x4;
+    2 devices -> 1x2 (degenerates to the pure type mesh)."""
+    import logging
+    import os
+
+    env = os.environ.get("KARP_MESH_DP")
+    if env:
+        try:
+            v = int(env)
+        except ValueError:
+            v = 0
+        if v >= 1 and ndev % v == 0:
+            return v
+        logging.getLogger(__name__).warning(
+            "KARP_MESH_DP=%r invalid for %d devices; using default",
+            env, ndev)
+    dp = 1
+    while ndev % (dp * 2) == 0 and (dp * 2) ** 2 <= ndev:
+        dp *= 2
+    return dp
+
+
+DP2_MIN_SLOTS = 2048
+
+
+def _dp2_min_slots() -> int:
+    """Slot-count floor below which dispatch_mesh keeps the 1-D type
+    mesh even when a dp factor is available. The 2-D kernel exists to
+    split a slot-indexed carry too big to replicate (the 500k-pod
+    envelope, slot axes in the thousands); under ~2k slots its extra
+    per-step collectives and its much larger compiled program are pure
+    overhead. KARP_MESH_DP2_MIN_SLOTS overrides (0 forces dp2 on)."""
+    import os
+
+    env = os.environ.get("KARP_MESH_DP2_MIN_SLOTS")
+    if env:
+        try:
+            return int(env)
+        except ValueError:
+            pass
+    return DP2_MIN_SLOTS
+
+
+def solve_mesh2(n_devices: Optional[int] = None, devices=None,
+                dp: Optional[int] = None) -> Mesh:
+    """A 2-D ``("dp","tp")`` mesh: node-slot (pods) axis x type axis."""
+    if devices is None:
+        devices = _pick_devices(n_devices)
+    ndev = len(devices)
+    ndp = dp if dp is not None else _default_dp(ndev)
+    if ndp < 1 or ndev % ndp:
+        raise ValueError(f"dp={ndp} does not divide {ndev} devices")
+    return Mesh(np.asarray(devices).reshape(ndp, ndev // ndp),
+                axis_names=(AXIS_DP, AXIS))
 
 
 def _pad_types(inp: KernelInputs, n_shards: int) -> Tuple[KernelInputs, int]:
@@ -143,16 +244,30 @@ def _input_specs(has_mv: bool) -> KernelInputs:
         mv_pairs_v=repl if has_mv else None)
 
 
-@partial(jax.jit,
-         static_argnames=("n_max", "E", "P", "V", "mesh", "sum_only"))
-def _solve_sharded(inp: KernelInputs, n_max: int, E: int, P: int,
-                   mesh: Mesh, V: int = 0, sum_only: bool = False):
+def _input_specs2() -> KernelInputs:
+    """Partition specs for the 2-D kernel: types over ``tp``, the slot-
+    indexed existing tables over ``dp``, the rest replicated. minValues
+    arrays are absent by construction (callers gate K == 0)."""
+    repl = PS()
+    return KernelInputs(
+        A=PS(AXIS, None), avail_zc=PS(AXIS, None),
+        R=repl, n=repl, F=PS(None, AXIS), agz=repl, agc=repl,
+        admit=repl, daemon=repl,
+        pool_types=PS(None, AXIS), pool_agz=repl, pool_agc=repl,
+        pool_limit=repl, pool_used0=repl,
+        ex_alloc=PS(AXIS_DP, None), ex_used0=PS(AXIS_DP, None),
+        ex_compat=PS(None, AXIS_DP),
+        mv_floor=None, mv_pairs_t=None, mv_pairs_v=None)
+
+
+def _shard_map():
+    """The shard_map entry point across jax versions, replication checker
+    disabled (it can't see through pmax-into-replicated arithmetic; the
+    kwarg name varies by version)."""
     try:
         from jax import shard_map as _smap
 
-        def shard_map(f, mesh, in_specs, out_specs):
-            # the replication checker can't see through lax.pmax-into-
-            # replicated-arithmetic; disable it (API name varies by version)
+        def wrap(f, mesh, in_specs, out_specs):
             try:
                 return _smap(f, mesh=mesh, in_specs=in_specs,
                              out_specs=out_specs, check_vma=False)
@@ -162,10 +277,17 @@ def _solve_sharded(inp: KernelInputs, n_max: int, E: int, P: int,
     except ImportError:  # older jax
         from jax.experimental.shard_map import shard_map as _esmap
 
-        def shard_map(f, mesh, in_specs, out_specs):
+        def wrap(f, mesh, in_specs, out_specs):
             return _esmap(f, mesh=mesh, in_specs=in_specs,
                           out_specs=out_specs, check_rep=False)
+    return wrap
 
+
+@partial(jax.jit,
+         static_argnames=("n_max", "E", "P", "V", "mesh", "sum_only"))
+def _solve_sharded(inp: KernelInputs, n_max: int, E: int, P: int,
+                   mesh: Mesh, V: int = 0, sum_only: bool = False):
+    shard_map = _shard_map()
     repl = PS()
     in_specs = _input_specs(inp.mv_floor is not None)
     out_specs = (repl, repl, Carry(
@@ -177,25 +299,231 @@ def _solve_sharded(inp: KernelInputs, n_max: int, E: int, P: int,
     return fn(inp)
 
 
+@partial(jax.jit, static_argnames=("n_max", "E", "P", "mesh", "sum_only"))
+def _solve_sharded2(inp: KernelInputs, n_max: int, E: int, P: int,
+                    mesh: Mesh, sum_only: bool = False):
+    shard_map = _shard_map()
+    repl = PS()
+    in_specs = _input_specs2()
+    out_specs = (PS(None, AXIS_DP), repl, Carry(
+        used=PS(AXIS_DP, None), types=PS(AXIS_DP, AXIS),
+        zones=PS(AXIS_DP, None), ct=PS(AXIS_DP, None),
+        pool=PS(AXIS_DP), alive=PS(AXIS_DP), num_nodes=repl,
+        pool_used=repl))
+    fn = shard_map(partial(_solve_dp, n_max=n_max, E=E, P=P,
+                           dp_axis=AXIS_DP, tp_axis=AXIS,
+                           sum_only=sum_only),
+                   mesh=mesh, in_specs=(in_specs,), out_specs=out_specs)
+    return fn(inp)
+
+
+def _pad_slots(inp: KernelInputs, E: int, n_max: int, ndp: int
+               ) -> Tuple[KernelInputs, int]:
+    """Pad the slot axis of the existing-node tables to the full padded
+    slot range Np = ceil(N/ndp)*ndp (host-side numpy). The dp kernel
+    indexes these tables by slot row, so they must span every slot; rows
+    beyond E are inert (zero allocatable, compat False) and the kernel's
+    free_slots math uses the TRUE N, so padded slots never open."""
+    N = E + n_max
+    Np = ((N + ndp - 1) // ndp) * ndp
+
+    def grow0(a):
+        a = np.asarray(a)
+        out = np.zeros((Np,) + a.shape[1:], a.dtype)
+        out[:a.shape[0]] = a
+        return out
+
+    ex_compat = np.asarray(inp.ex_compat)
+    grown = np.zeros(ex_compat.shape[:1] + (Np,), np.bool_)
+    grown[:, :ex_compat.shape[1]] = ex_compat
+    return inp._replace(ex_alloc=grow0(inp.ex_alloc),
+                        ex_used0=grow0(inp.ex_used0),
+                        ex_compat=grown), N
+
+
+def solve_scan_sharded2(inp: KernelInputs, n_max: int, E: int, P: int,
+                        mesh: Mesh, sum_only: Optional[bool] = None
+                        ) -> Tuple[jax.Array, jax.Array, Carry]:
+    """2-D pods x types solve over ``mesh``; same (takes, leftover,
+    carry) contract as ops.ffd_jax.solve_scan, decisions identical.
+    Requires K == 0 (no minValues floors — use solve_scan_sharded)."""
+    if inp.mv_floor is not None:
+        raise ValueError("2-D mesh solve does not take minValues floors; "
+                         "use the 1-D type mesh (solve_scan_sharded)")
+    if sum_only is None:
+        sum_only = _resolve_sum_only(mesh)
+    ndp = mesh.shape[AXIS_DP]
+    ntp = mesh.shape[AXIS]
+    padded, T = _pad_types(inp, ntp)
+    padded, N = _pad_slots(padded, E, n_max, ndp)
+    specs = _input_specs2()
+    padded = KernelInputs(*[
+        None if x is None
+        else jax.device_put(np.asarray(x), NamedSharding(mesh, s))
+        for x, s in zip(padded, specs)])
+    takes, leftover, carry = _solve_sharded2(padded, n_max, E, P, mesh,
+                                             sum_only=sum_only)
+    takes = takes[:, :N]
+    carry = carry._replace(
+        used=carry.used[:N], types=carry.types[:N, :T],
+        zones=carry.zones[:N], ct=carry.ct[:N],
+        pool=carry.pool[:N], alive=carry.alive[:N])
+    return takes, leftover, carry
+
+
+def shard_batch(stack: np.ndarray, ndev: int, cache: dict
+                ) -> Tuple[jax.Array, int]:
+    """Distribute a stacked [B, W] packed-solve batch across devices: pad
+    B up to a device multiple by repeating the last row (lanes of the
+    vmapped packed kernel are independent, so pad lanes are inert —
+    callers slice results [:B]) and commit with NamedSharding(P("dp",
+    None)) so the jit partitions the batch with zero cross-device
+    collectives. Returns (device stack [Bp, W], B)."""
+    mesh = cache.get("batch_mesh")
+    if mesh is None or mesh.devices.size != ndev:
+        mesh = cache["batch_mesh"] = Mesh(
+            np.asarray(_pick_devices(ndev)), axis_names=(AXIS_DP,))
+    stack = np.asarray(stack)
+    B = stack.shape[0]
+    Bp = ((B + ndev - 1) // ndev) * ndev
+    if Bp != B:
+        stack = np.concatenate(
+            [stack, np.repeat(stack[-1:], Bp - B, axis=0)], axis=0)
+    return jax.device_put(stack, NamedSharding(mesh, PS(AXIS_DP, None))), B
+
+
+def _prep_field(name: str, a, Tp: int, Np: Optional[int]) -> np.ndarray:
+    """Host-side per-field prep for mesh placement: pad the type axis to
+    the tp-shard multiple (inert types) and, for the 2-D kernel (Np set),
+    the slot axis of the existing tables to Np (inert slots).
+    Deterministic given the shape statics, so a dirty field of a resident
+    arena can be re-prepped and re-placed alone."""
+    a = np.asarray(a)
+
+    def grow(arr, ax, size):
+        if arr.shape[ax] == size:
+            return arr
+        shape = list(arr.shape)
+        shape[ax] = size - arr.shape[ax]
+        return np.concatenate([arr, np.zeros(shape, arr.dtype)], axis=ax)
+
+    if name in ("A", "avail_zc"):
+        return grow(a, 0, Tp)
+    if name in ("F", "pool_types"):
+        return grow(a, 1, Tp)
+    if Np is not None:
+        if name in ("ex_alloc", "ex_used0"):
+            return grow(a, 0, Np)
+        if name == "ex_compat":
+            return grow(a, 1, Np)
+    return a
+
+
+def _place_resident(arrays: dict, mesh: Mesh, specs: KernelInputs,
+                    kern: str, Tp: int, Np: Optional[int], statics_key,
+                    cache: dict, dirty, metrics) -> KernelInputs:
+    """Build the device-resident KernelInputs for a mesh dispatch.
+
+    ``dirty=None`` means the caller makes no claim about the host arrays
+    (stateless request, fresh prep, retry at a grown bucket): full
+    placement. A list means the caller guarantees every field NOT listed
+    is unchanged since the previous dispatch against this cache — only
+    the listed fields are re-prepped and ``device_put`` with the owning
+    sharding; everything else reuses the resident sharded buffers, so a
+    rows-tier tick moves O(dirty) bytes host-to-device instead of the
+    whole arena. The guarantee is only honored when the resident key
+    (kernel, mesh, statics, field shapes) matches exactly."""
+    fields = [f for f in KernelInputs._fields if arrays.get(f) is not None]
+    key = (statics_key, Tp, Np,
+           tuple((f, tuple(np.asarray(arrays[f]).shape)) for f in fields))
+    res = cache.get("resident")
+    if dirty is not None and res is not None and res["key"] == key:
+        mode = "patch" if dirty else "reuse"
+        dev = res["dev"]
+        placed = [f for f in dirty if f in fields]
+        for f in placed:
+            dev[f] = jax.device_put(
+                _prep_field(f, arrays[f], Tp, Np),
+                NamedSharding(mesh, getattr(specs, f)))
+    else:
+        mode = "full"
+        dev = {}
+        for f in fields:
+            dev[f] = jax.device_put(
+                _prep_field(f, arrays[f], Tp, Np),
+                NamedSharding(mesh, getattr(specs, f)))
+        cache["resident"] = {"key": key, "dev": dev}
+        placed = list(fields)
+    cache["last_placement"] = {"mode": mode, "kernel": kern,
+                               "fields": list(placed)}
+    if metrics is not None:
+        metrics.inc("karpenter_solver_mesh_dispatch_total",
+                    labels={"kernel": kern})
+        metrics.inc("karpenter_solver_mesh_resident_total",
+                    labels={"mode": mode})
+    return KernelInputs(**dev)
+
+
 def dispatch_mesh(arrays: dict, *, n_max: int, E: int, P: int, V: int,
-                  ndev: int, cache: dict) -> dict:
+                  ndev: int, cache: dict, dirty=None,
+                  metrics=None) -> dict:
     """The one mesh-dispatch implementation shared by the local solver
     (TPUSolver._dispatch_mesh) and the sidecar server: build/reuse the
-    mesh (cache key: device count), run the type-parallel solve, and
-    return the carry as the same dict shape as hostpack.unpack_outputs1
-    — so the two paths can never drift apart."""
-    mesh = cache.get("mesh")
-    if mesh is None or mesh.devices.size != ndev:
-        mesh = cache["mesh"] = solve_mesh(ndev)
-    takes, leftover, carry = solve_scan_sharded(
-        KernelInputs(**arrays), n_max=n_max, E=E, P=P, mesh=mesh, V=V)
+    mesh (cache key: device count), pick the kernel (2-D pods x types
+    when the dp factor is > 1, the slot axis is big enough to be worth
+    splitting — see _dp2_min_slots — and there are no minValues floors,
+    else the 1-D type mesh), keep the sharded arena resident across
+    ticks (see _place_resident), run the solve, and return the carry as
+    the same dict shape as hostpack.unpack_outputs1 — so the two paths
+    can never drift apart."""
+    has_mv = arrays.get("mv_floor") is not None
+    N = E + n_max
+    ndp = 1 if (has_mv or N < _dp2_min_slots()) else _default_dp(ndev)
+    if ndp > 1:
+        kern = "dp2"
+        mesh = cache.get("mesh2")
+        if mesh is None or mesh.devices.size != ndev:
+            mesh = cache["mesh2"] = solve_mesh2(ndev)
+        ndp = mesh.shape[AXIS_DP]
+        ntp = mesh.shape[AXIS]
+        specs = _input_specs2()
+        Np = ((N + ndp - 1) // ndp) * ndp
+    else:
+        kern = "tp"
+        mesh = cache.get("mesh")
+        if mesh is None or mesh.devices.size != ndev:
+            mesh = cache["mesh"] = solve_mesh(ndev)
+        ntp = ndev
+        specs = _input_specs(has_mv)
+        Np = None
+    sum_only = _resolve_sum_only(mesh)
+    T = int(np.asarray(arrays["A"]).shape[0])
+    Tp = ((T + ntp - 1) // ntp) * ntp
+    inp = _place_resident(arrays, mesh, specs, kern, Tp, Np,
+                          (kern, _mesh_key(mesh), n_max, E, P, V),
+                          cache, dirty, metrics)
+    if kern == "dp2":
+        takes, leftover, carry = _solve_sharded2(
+            inp, n_max, E, P, mesh, sum_only=sum_only)
+    else:
+        takes, leftover, carry = _solve_sharded(
+            inp, n_max, E, P, mesh, V=V, sum_only=sum_only)
+    carry = Carry(*[np.asarray(x) for x in carry])
+    # strip the inert type padding — and, on dp2, the inert slot padding
+    takes = np.asarray(takes)
+    if kern == "dp2":
+        takes = takes[:, :N]
+        carry = carry._replace(
+            used=carry.used[:N], types=carry.types[:N],
+            zones=carry.zones[:N], ct=carry.ct[:N],
+            pool=carry.pool[:N], alive=carry.alive[:N])
     return dict(
-        takes=np.asarray(takes), leftover=np.asarray(leftover),
+        takes=takes, leftover=np.asarray(leftover),
         num_nodes=np.asarray([carry.num_nodes]),
-        used=np.asarray(carry.used), pool=np.asarray(carry.pool),
-        pool_used=np.asarray(carry.pool_used),
-        types=np.asarray(carry.types), zones=np.asarray(carry.zones),
-        ct=np.asarray(carry.ct), alive=np.asarray(carry.alive))
+        used=carry.used, pool=carry.pool,
+        pool_used=carry.pool_used,
+        types=carry.types[:, :T], zones=carry.zones,
+        ct=carry.ct, alive=carry.alive)
 
 
 def solve_scan_sharded(inp: KernelInputs, n_max: int, E: int, P: int,
@@ -205,15 +533,7 @@ def solve_scan_sharded(inp: KernelInputs, n_max: int, E: int, P: int,
     """Type-parallel solve over ``mesh``; same (takes, leftover, carry)
     contract as ops.ffd_jax.solve_scan, decisions identical."""
     if sum_only is None:
-        # detection is a property of the mesh's backend: memoize so a
-        # steady-state control loop doesn't re-sniff and re-log once per
-        # solve (stable key — see _SUM_ONLY_CACHE)
-        key = _mesh_key(mesh)
-        cached = _SUM_ONLY_CACHE.get(key)
-        if cached is None:
-            cached = _needs_sum_only(mesh)
-            _SUM_ONLY_CACHE[key] = cached
-        sum_only = cached
+        sum_only = _resolve_sum_only(mesh)
     n_shards = mesh.devices.size
     padded, T = _pad_types(inp, n_shards)
     # explicit placement onto the mesh per spec — never the default device

@@ -506,25 +506,31 @@ class _Handler:
         kv = self._validate(arrays["statics"], buf, context, admit=False)
         tenant = _tenant(context)
         ndev = len(jax.devices())
-        if ndev > 1:
-            # the mesh dispatch shards ONE solve across every device —
-            # it is its own batching axis, so coalescing (and bucket
-            # padding, which exists to widen batches) stays out
-            self._admit_shape(tuple(kv.values()), context, tenant)
-            return arena_pack({"out": self._solve_mesh(buf, kv, ndev)})
         kvB = bucket_statics(kv) if self._bucketing else kv
         self._admit_shape(tuple(kvB.values()), context, tenant)
         bufB = self._pad(np.asarray(buf), kv, kvB, context, "Solve")
 
-        def dispatch_many(bufs):
-            if len(bufs) == 1:
-                return [np.asarray(solve_scan_packed1(
-                    jnp.asarray(bufs[0]), **kvB))]
-            from ..ops.ffd_jax import solve_scan_packed1_many
-            stack = jnp.asarray(np.stack(bufs))
-            return list(np.asarray(solve_scan_packed1_many(stack, **kvB)))
+        if ndev > 1:
+            # mesh server: a lone request shards its ONE solve across
+            # every device (2-D pods x types when the shape allows);
+            # coalesced riders instead land as dp-sharded lanes of the
+            # batched kernel, B/ndev per chip. Both demux byte-identical
+            # to the single-device kernel, so the wire can't tell.
+            def dispatch_many(bufs):
+                if len(bufs) == 1:
+                    return [self._solve_mesh(bufs[0], kvB, ndev)]
+                return list(self._solve_batch_sharded(
+                    np.stack(bufs), kvB, ndev, rpc="Solve"))
+        else:
+            def dispatch_many(bufs):
+                if len(bufs) == 1:
+                    return [np.asarray(solve_scan_packed1(
+                        jnp.asarray(bufs[0]), **kvB))]
+                from ..ops.ffd_jax import solve_scan_packed1_many
+                stack = jnp.asarray(np.stack(bufs))
+                return list(np.asarray(solve_scan_packed1_many(stack, **kvB)))
 
-        key = ("solve",) + tuple(kvB.values())
+        key = ("solve", ndev) + tuple(kvB.values())
         o_buf = self._dispatch_coalesced(key, bufB, context,
                                          dispatch_many, "Solve", tenant)
         return arena_pack({"out": unpad_outputs(np.asarray(o_buf),
@@ -537,6 +543,7 @@ class _Handler:
         server — jit(vmap) runs on the default device and decides
         identically, so version skew never changes decisions."""
         import grpc
+        import jax
         import jax.numpy as jnp
 
         from ..ops.ffd_jax import solve_scan_packed1, solve_scan_packed1_many
@@ -557,9 +564,12 @@ class _Handler:
                 context.abort(grpc.StatusCode.INVALID_ARGUMENT,
                               f"batch item {i} size {bufs[i].size} != "
                               f"item 0 size {bufs[0].size}")
+        ndev = len(jax.devices())
         if B == 1:
             o = np.asarray(solve_scan_packed1(jnp.asarray(bufs[0]),
                                               **kv))[None, :]
+        elif ndev > 1:
+            o = self._solve_batch_sharded(np.stack(bufs), kv, ndev)
         else:
             stack = jnp.asarray(np.stack(bufs))
             o = np.asarray(solve_scan_packed1_many(stack, **kv))
@@ -571,6 +581,23 @@ class _Handler:
                 "karpenter_solver_sidecar_coalesce_dispatches_total",
                 labels={"rpc": "SolveBatch", "mode": "frame"})
         return arena_pack({"out": o})
+
+    def _solve_batch_sharded(self, stack: np.ndarray, kv: dict, ndev: int,
+                             rpc: str = "SolveBatch") -> np.ndarray:
+        """Run a stacked [B, W] batch with the B axis dp-sharded across
+        the server's devices (parallel/mesh.py shard_batch): B/ndev
+        independent lanes per chip, zero cross-device collectives,
+        results byte-identical to the single-device vmapped kernel."""
+        from ..ops.ffd_jax import solve_scan_packed1_many
+        from ..parallel.mesh import shard_batch
+        B = stack.shape[0]
+        with self._mesh_mu:
+            d_stack, _ = shard_batch(stack, ndev, self._mesh_cache)
+        out = np.asarray(solve_scan_packed1_many(d_stack, **kv))[:B]
+        if self.metrics is not None:
+            self.metrics.inc("karpenter_solver_mesh_batch_lanes_total",
+                             B, labels={"rpc": rpc})
+        return out
 
     def _solve_mesh(self, buf: np.ndarray, kv: dict,
                     ndev: int) -> np.ndarray:
@@ -596,7 +623,8 @@ class _Handler:
         with self._mesh_mu:
             out = dispatch_mesh(arrays, n_max=kv["n_max"], E=kv["E"],
                                 P=kv["P"], V=kv["V"], ndev=ndev,
-                                cache=self._mesh_cache)
+                                cache=self._mesh_cache,
+                                metrics=self.metrics)
         return pack_outputs1(out, kv["T"], kv["D"], kv["Z"], kv["C"],
                              kv["G"], kv["E"], kv["P"], kv["n_max"])
 

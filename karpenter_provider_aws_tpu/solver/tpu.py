@@ -518,12 +518,22 @@ class TPUSolver(Solver):
         (ops/ffd_jax.py solve_scan_packed1_many = jit(vmap(body))):
         the scan carry batches over B, so B solves of the same shape
         bucket cost one sweep of scan trips plus one h2d/d2h round
-        trip. The sidecar's RemoteSolver overrides this with the
-        SolveBatch RPC — B buffers behind one batch frame, still one
-        round trip (docs/solver-design.md "Over the wire")."""
+        trip. On a multi-device engine the stacked [B, W] arena commits
+        dp-sharded (parallel/mesh.py shard_batch) so the lanes land
+        B/ndev per chip with zero cross-device collectives — lanes are
+        independent, so results are byte-identical either way. The
+        sidecar's RemoteSolver overrides this with the SolveBatch RPC —
+        B buffers behind one batch frame, still one round trip
+        (docs/solver-design.md "Over the wire")."""
         import jax.numpy as jnp
 
         from ..ops.ffd_jax import solve_scan_packed1_many
+        ndev = self._dev_devices()
+        if ndev > 1:
+            from ..parallel.mesh import shard_batch
+            cache = self.__dict__.setdefault("_mesh_cache", {})
+            d_bufs, B = shard_batch(np.stack(bufs), ndev, cache)
+            return np.asarray(solve_scan_packed1_many(d_bufs, **statics))[:B]
         d_bufs = jnp.asarray(np.stack(bufs))
         return np.asarray(solve_scan_packed1_many(d_bufs, **statics))
 
@@ -631,7 +641,7 @@ class TPUSolver(Solver):
         the single path's decision by construction."""
         if self.backend == "numpy" or not self.supports_batch_kernel:
             return None
-        if not snapshot.pods or self._dev_devices() > 1:
+        if not snapshot.pods:
             return None
         from .route import dev_engine_usable
         if not dev_engine_usable(self._router):
@@ -720,15 +730,20 @@ class TPUSolver(Solver):
         return dev_device_count()
 
     def _dispatch_mesh(self, arrays: dict, *, T, D, Z, C, G, E, P, K, V, M,
-                       n_max: int, ndev: int) -> dict:
+                       n_max: int, ndev: int, dirty=None) -> dict:
         """The multi-device solve: catalog/candidate tensors sharded over
-        the type axis, carry replicated, pmax collectives across the mesh
-        (parallel/mesh.py dispatch_mesh — shared with the sidecar server).
-        Same outputs as unpack_outputs1."""
+        the type axis (and node-slot state over a second dp axis when the
+        device count factors and there are no minValues floors), carry
+        replicated, collectives across the mesh (parallel/mesh.py
+        dispatch_mesh — shared with the sidecar server). ``dirty`` is the
+        pack cache's field-level delta claim: a list keeps the sharded
+        arena resident and re-places only those fields; None re-places
+        everything. Same outputs as unpack_outputs1."""
         from ..parallel.mesh import dispatch_mesh
         cache = self.__dict__.setdefault("_mesh_cache", {})
         return dispatch_mesh(arrays, n_max=n_max, E=E, P=P, V=V,
-                             ndev=ndev, cache=cache)
+                             ndev=ndev, cache=cache, dirty=dirty,
+                             metrics=self.metrics)
 
     # -- topology device path ------------------------------------------
     #: static event-loop bounds of the device pour (ops/topo_jax.py);
@@ -816,11 +831,47 @@ class TPUSolver(Solver):
         from ..ops.topo_jax import dispatch_topo
         return dispatch_topo(arrays, rows, statics, cache=cache)
 
+    def _patch_topo_cache(self, tc, enc, d) -> List[str]:
+        """Rows-tier patch of the resident topo base arrays (the analog
+        of _patch_pack_cache for the topology pour). The topo device
+        path always runs with E == 0, so of the delta's dirty-field
+        vocabulary only pod counts and pool tables can apply; the
+        zero-width ex tables are inert by construction. Returns the
+        patched field names so the caller can refresh exactly those
+        fields of the resident device placement."""
+        arrays = tc["arrays"]
+        G = len(enc.groups)
+        D = len(enc.dims)
+        dirty64, dirtyb = d.dirty_fields()
+        fields = [f for f in dirty64 + dirtyb
+                  if f in ("n", "pool_limit", "pool_used0")]
+        if "n" in fields:
+            arrays["n"][:G] = enc.n
+        if "pool_limit" in fields:
+            pl, pu = arrays["pool_limit"], arrays["pool_used0"]
+            for p in enc.pools:
+                lim = p.limit_vec if p.limit_vec is not None \
+                    else np.full(D, -1, dtype=np.int64)
+                pl[p.index, :D] = lim
+                pl[p.index, D:] = -1
+                pu[p.index, :D] = p.in_use_vec
+        return fields
+
     def _run_jax_topo(self, enc, tenc):
         """The device pour: same decisions as _run_numpy's topology path,
         served by ops/topo_jax.solve_scan_topo via _dispatch_topo.
         Raises TopoKernelBail when the snapshot leaves the kernel's
-        event envelope."""
+        event envelope.
+
+        Residency: the base arrays (pool tables + padded group rows) and
+        their device placement persist across ticks in ``_topo_cache``
+        under the same staleness rules as _run_jax's pack cache (same
+        encoding object, hit/rows tier, version lag <= 1); a rows-tier
+        tick patches only the dirty fields host-side and re-places just
+        those fields on device. The topology rows (skews, membership)
+        derive from ``tenc``, which is rebuilt per snapshot — they are
+        re-placed on any non-hit tick and only the device copy is reused
+        on a quiet (hit-tier) tick."""
         T, D = enc.A.shape
         Z, C = len(enc.zones), enc.avail.shape[2]
         P = len(enc.pools)
@@ -829,41 +880,78 @@ class TPUSolver(Solver):
         Pp = max(1, 1 << (P - 1).bit_length())
         Dp = max(8, D)
 
+        d = self._last_delta
+        dver = self._delta.version if self._delta is not None else None
+        tc = getattr(self, "_topo_cache", None)
+        arrays = None
+        conv_cache: dict = {}
+        if (tc is not None and d is not None and dver is not None
+                and d.tier in ("hit", "rows") and tc["enc"] is enc
+                and tc["stt"] == (T, Z, C, Gp, Pp, Dp)
+                and tc["version"] in (dver, dver - 1)):
+            arrays = tc["arrays"]
+            conv_cache = tc["conv"]
+            if tc["version"] != dver:
+                fields = self._patch_topo_cache(tc, enc, d)
+                if fields and "inp" in conv_cache:
+                    import jax.numpy as jnp
+                    conv_cache["inp"] = conv_cache["inp"]._replace(
+                        **{f: jnp.asarray(arrays[f]) for f in fields})
+                tc["version"] = dver
+                tc["mode"] = "patch"
+                tc["fields"] = fields
+            else:
+                tc["mode"] = "reuse"
+                tc["fields"] = []
+            if d.tier != "hit":
+                # tenc-derived rows may have moved: force a fresh device
+                # placement of the rows block (base inputs stay resident)
+                conv_cache.pop("rows", None)
+
         def padG(a):
             return np.pad(a, [(0, Gp - G)] + [(0, 0)] * (a.ndim - 1))
 
         def padD(a):
             return np.pad(a, [(0, 0)] * (a.ndim - 1) + [(0, Dp - D)])
 
-        arrays = dict(
-            A=padD(enc.A),
-            avail_zc=enc.avail.reshape(T, Z * C),
-            R=padG(padD(enc.R)), n=padG(enc.n), F=padG(enc.F),
-            agz=padG(enc.agz), agc=padG(enc.agc),
-            admit=np.pad(padG(enc.admit), [(0, 0), (0, Pp - P)]),
-            daemon=np.pad(padG(padD(enc.daemon)),
-                          [(0, 0), (0, Pp - P), (0, 0)]),
-            ex_alloc=np.zeros((0, Dp), np.int64),
-            ex_used0=np.zeros((0, Dp), np.int64),
-            ex_compat=np.zeros((Gp, 0), bool),
-        )
-        pool_types = np.zeros((Pp, T), bool)
-        pool_agz = np.zeros((Pp, Z), bool)
-        pool_agc = np.zeros((Pp, C), bool)
-        pool_limit = np.zeros((Pp, Dp), np.int64)
-        pool_used0 = np.zeros((Pp, Dp), np.int64)
-        for p in enc.pools:
-            pool_types[p.index] = p.type_rows
-            pool_agz[p.index] = p.agz
-            pool_agc[p.index] = p.agc
-            lim = p.limit_vec if p.limit_vec is not None \
-                else np.full(D, -1, dtype=np.int64)
-            pool_limit[p.index, :D] = lim
-            pool_limit[p.index, D:] = -1
-            pool_used0[p.index, :D] = p.in_use_vec
-        arrays.update(pool_types=pool_types, pool_agz=pool_agz,
-                      pool_agc=pool_agc, pool_limit=pool_limit,
-                      pool_used0=pool_used0)
+        if arrays is None:
+            arrays = dict(
+                A=padD(enc.A),
+                avail_zc=enc.avail.reshape(T, Z * C),
+                R=padG(padD(enc.R)), n=padG(enc.n), F=padG(enc.F),
+                agz=padG(enc.agz), agc=padG(enc.agc),
+                admit=np.pad(padG(enc.admit), [(0, 0), (0, Pp - P)]),
+                daemon=np.pad(padG(padD(enc.daemon)),
+                              [(0, 0), (0, Pp - P), (0, 0)]),
+                ex_alloc=np.zeros((0, Dp), np.int64),
+                ex_used0=np.zeros((0, Dp), np.int64),
+                ex_compat=np.zeros((Gp, 0), bool),
+            )
+            pool_types = np.zeros((Pp, T), bool)
+            pool_agz = np.zeros((Pp, Z), bool)
+            pool_agc = np.zeros((Pp, C), bool)
+            pool_limit = np.zeros((Pp, Dp), np.int64)
+            pool_used0 = np.zeros((Pp, Dp), np.int64)
+            for p in enc.pools:
+                pool_types[p.index] = p.type_rows
+                pool_agz[p.index] = p.agz
+                pool_agc[p.index] = p.agc
+                lim = p.limit_vec if p.limit_vec is not None \
+                    else np.full(D, -1, dtype=np.int64)
+                pool_limit[p.index, :D] = lim
+                pool_limit[p.index, D:] = -1
+                pool_used0[p.index, :D] = p.in_use_vec
+            arrays.update(pool_types=pool_types, pool_agz=pool_agz,
+                          pool_agc=pool_agc, pool_limit=pool_limit,
+                          pool_used0=pool_used0)
+            conv_cache = {}
+            if dver is not None:
+                self._topo_cache = dict(
+                    enc=enc, arrays=arrays, stt=(T, Z, C, Gp, Pp, Dp),
+                    conv=conv_cache, version=dver, mode="full",
+                    fields=None)
+            else:
+                self._topo_cache = None
 
         rows, GZ, GH = self._topo_rows(enc, tenc)
         GZp = max(1, 1 << (GZ - 1).bit_length())
@@ -895,7 +983,6 @@ class TPUSolver(Solver):
                             constant_values=-1),
         )
         n_bucket = self._bucket
-        conv_cache: dict = {}  # reuse device-placed inputs across retries
         while True:
             out = self._dispatch_topo(arrays, topo_rows, dict(
                 Z=Z, P=Pp, GZ=GZp, GH=GHp, n_max=n_bucket,
@@ -1030,13 +1117,17 @@ class TPUSolver(Solver):
                             K=K, V=V, M=M, F=Fu)
 
     def _patch_pack_cache(self, pc, enc, ex_alloc, ex_used, ex_compat,
-                          d) -> None:
+                          d) -> List[str]:
         """Bring the resident padded arrays + packed arena up to the
-        current delta: re-pad only the dirty fields and patch their
-        buffer sections in place (ops/hostpack.py patch_inputs1).
-        Only fields a ``rows``-tier delta can move are handled — every
-        signature/structure-derived field is untouched by contract.
-        Byte-parity with a fresh pack is fuzzed in
+        current delta: re-pad only the dirty fields (the delta's
+        dirty_fields() vocabulary) and, when a packed wire buffer is
+        resident (single-device entries), patch its dirty sections in
+        place (ops/hostpack.py patch_inputs1). Mesh entries keep
+        arrays-only residency (buf=None) — the returned dirty-field list
+        drives the sharded device-arena patch instead (parallel/mesh.py
+        _place_resident). Only fields a ``rows``-tier delta can move are
+        handled — every signature/structure-derived field is untouched
+        by contract. Byte-parity with a fresh pack is fuzzed in
         tests/test_delta_encoding.py."""
         from ..ops.hostpack import patch_inputs1
         arrays, stt = pc["arrays"], pc["stt"]
@@ -1045,11 +1136,10 @@ class TPUSolver(Solver):
         K, M, Fu = stt["K"], stt["M"], stt["F"]
         D = len(enc.dims)
         G, E = len(enc.groups), ex_alloc.shape[0]
-        dirty64, dirtyb = [], []
-        if d.n_dirty:
+        dirty64, dirtyb = d.dirty_fields()
+        if "n" in dirty64:
             arrays["n"][:G] = enc.n
-            dirty64.append("n")
-        if d.pools_dirty:
+        if "pool_limit" in dirty64:
             pl, pu = arrays["pool_limit"], arrays["pool_used0"]
             for p in enc.pools:
                 lim = p.limit_vec if p.limit_vec is not None \
@@ -1057,21 +1147,18 @@ class TPUSolver(Solver):
                 pl[p.index, :D] = lim
                 pl[p.index, D:] = -1
                 pu[p.index, :D] = p.in_use_vec
-            dirty64 += ["pool_limit", "pool_used0"]
-        if d.ex_rows_dirty:
+        if "ex_alloc" in dirty64:
             ap, up = arrays["ex_alloc"], arrays["ex_used0"]
             ap[:] = 0
             up[:] = 0
             if E:
                 ap[:E, :D] = ex_alloc
                 up[:E, :D] = ex_used
-            dirty64 += ["ex_alloc", "ex_used0"]
-        if d.ex_compat_dirty:
+        if "ex_compat" in dirtyb:
             cp = arrays["ex_compat"]
             cp[:] = False
             if E:
                 cp[:G, :E] = ex_compat
-            dirtyb.append("ex_compat")
             if "fuse" in arrays:
                 # the fused-scan plan ANDs the admit runs (unchanged in
                 # a rows-tier delta) with the existing-compat runs —
@@ -1083,9 +1170,10 @@ class TPUSolver(Solver):
                 arrays["fuse"][:] = np.concatenate(
                     [fuse, np.ones(Gp - G, dtype=bool)])
                 dirtyb.append("fuse")
-        if dirty64 or dirtyb:
+        if (dirty64 or dirtyb) and pc["buf"] is not None:
             patch_inputs1(pc["buf"], pc["bflat"], arrays, dirty64,
                           dirtyb, T, Dp, Z, C, Gp, Ep, Pp, K, M, Fu)
+        return dirty64 + dirtyb
 
     def _run_jax(self, enc, ex_alloc, ex_used, ex_compat):
         from ..ops.hostpack import pack_inputs1_state, unpack_outputs1
@@ -1105,16 +1193,18 @@ class TPUSolver(Solver):
         dver = self._delta.version if self._delta is not None else None
         pc = self._pack_cache
         arrays = stt = buf = None
+        mesh_dirty = None  # advisory for the mesh resident arena
         if (pc is not None and d is not None and dver is not None
-                and ndev <= 1 and d.tier in ("hit", "rows")
+                and d.tier in ("hit", "rows")
                 and pc["enc"] is enc and pc["ndev"] == ndev
                 and pc["stt"]["E"] == (1 << (E - 1).bit_length()
                                        if E else 0)
                 and pc["version"] in (dver, dver - 1)):
             arrays, stt, buf = pc["arrays"], pc["stt"], pc["buf"]
+            mesh_dirty = []
             if pc["version"] != dver:
-                self._patch_pack_cache(pc, enc, ex_alloc, ex_used,
-                                       ex_compat, d)
+                mesh_dirty = self._patch_pack_cache(pc, enc, ex_alloc,
+                                                    ex_used, ex_compat, d)
                 pc["version"] = dver
         if arrays is None:
             arrays, stt = self._prep_device_inputs(enc, ex_alloc, ex_used,
@@ -1128,6 +1218,16 @@ class TPUSolver(Solver):
             if dver is not None:
                 self._pack_cache = dict(enc=enc, arrays=arrays, stt=stt,
                                         buf=buf, bflat=bflat, ndev=ndev,
+                                        version=dver)
+            else:
+                self._pack_cache = None
+        elif ndev > 1 and mesh_dirty is None:
+            # mesh entries keep arrays-only residency: the wire buffer is
+            # never packed (the sharded arena lives on-device, placed and
+            # patched per shard by parallel/mesh.py _place_resident)
+            if dver is not None:
+                self._pack_cache = dict(enc=enc, arrays=arrays, stt=stt,
+                                        buf=None, bflat=None, ndev=ndev,
                                         version=dver)
             else:
                 self._pack_cache = None
@@ -1161,7 +1261,8 @@ class TPUSolver(Solver):
             if ndev > 1:
                 out = self._dispatch_mesh(
                     arrays, T=T, D=Dp, Z=Z, C=C, G=Gp, E=Ep, P=Pp,
-                    K=K, V=V, M=M, n_max=n_bucket, ndev=ndev)
+                    K=K, V=V, M=M, n_max=n_bucket, ndev=ndev,
+                    dirty=mesh_dirty)
             elif use_pruned:
                 # S resolved HERE, the call site both the local and the
                 # RemoteSolver dispatch share — so the sidecar wire

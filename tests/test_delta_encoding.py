@@ -427,6 +427,172 @@ class TestPackedArenaWire:
         assert np.array_equal(fresh, s._pack_cache["buf"])
 
 
+class TestMeshResidentArena:
+    """Mesh twin of TestPackedArenaWire: on a multi-device mesh the pack
+    cache keeps the SHARDED device arena resident (buf stays None — the
+    wire buffer is never packed), patches only the dirty fields per
+    shard on rows-tier ticks, and rebuilds in full when stale. Decisions
+    stay fingerprint-identical to the CPU oracle throughout."""
+
+    def test_mesh_resident_patch_reuse_lifecycle(self):
+        _device_alive.blocking()
+        import jax
+        assert len(jax.devices()) >= 8
+        env = Environment()
+        pool = env.nodepool("mesh-wire-pool")
+        pods = make_pods(70, cpu="500m", memory="1Gi", prefix="mw",
+                         group="mw")
+        s = TPUSolver(backend="jax")
+        assert s._dev_devices() > 1
+        oracle = CPUSolver()
+        cur = list(pods)
+        modes = []
+        for tick in range(4):
+            if tick:
+                cur = cur[1:] + make_pods(
+                    2, cpu="500m", memory="1Gi", prefix=f"mw{tick}",
+                    group="mw")
+            sn = env.snapshot(cur, [pool])
+            r = s.solve(sn)
+            assert r.decision_fingerprint() == \
+                oracle.solve(sn).decision_fingerprint(), tick
+            pc = s._pack_cache
+            assert pc is not None and pc["buf"] is None, tick
+            modes.append(s._mesh_cache["last_placement"])
+        assert modes[0]["mode"] == "full"
+        for lp in modes[1:]:
+            assert lp["mode"] == "patch", modes
+            assert lp["fields"] == ["n"], modes  # pod churn only
+        # quiet tick: zero placement work
+        s.solve(env.snapshot(cur, [pool]))
+        assert s._last_delta.tier == "hit"
+        assert s._mesh_cache["last_placement"]["mode"] == "reuse"
+
+    def test_stale_mesh_arena_is_rebuilt_not_patched(self):
+        """A resident sharded arena lagging the encoder by >1 version
+        must be fully re-placed — patching only bridges the LAST delta
+        (same staleness law as the packed-wire cache)."""
+        _device_alive.blocking()
+        env = Environment()
+        pool = env.nodepool("mesh-stale-pool")
+        pods = make_pods(50, cpu="500m", memory="1Gi", prefix="ms",
+                         group="ms")
+        s = TPUSolver(backend="jax")
+        assert s._dev_devices() > 1
+        s.solve(env.snapshot(pods, [pool]))
+        assert s._pack_cache is not None
+        s._pack_cache["version"] -= 2
+        cur = pods[1:] + make_pods(2, cpu="500m", memory="1Gi",
+                                   prefix="ms2", group="ms")
+        sn = env.snapshot(cur, [pool])
+        r = s.solve(sn)
+        assert s._mesh_cache["last_placement"]["mode"] == "full"
+        assert s._pack_cache["version"] == s._delta.version
+        assert r.decision_fingerprint() == \
+            CPUSolver().solve(sn).decision_fingerprint()
+
+
+class TestTopoResidency:
+    """The topology pour's resident base arrays (solver/tpu.py
+    _topo_cache): pool tables + padded group rows persist across ticks
+    under the pack cache's staleness rules; tenc-derived rows re-place
+    every non-quiet tick."""
+
+    def _spread(self):
+        from karpenter_provider_aws_tpu.apis import labels as L
+        from karpenter_provider_aws_tpu.apis.objects import \
+            TopologySpreadConstraint
+        return [TopologySpreadConstraint(max_skew=1, topology_key=L.ZONE)]
+
+    def test_topo_cache_patch_reuse_lifecycle(self):
+        _device_alive.blocking()
+        env = Environment()
+        pool = env.nodepool("topo-res-pool")
+        sp = self._spread()
+        pods = make_pods(30, cpu="1", memory="2Gi", prefix="tr",
+                         group="tr", topology_spread=sp)
+        s = TPUSolver(backend="jax", n_max=192)
+        s._dev_devices = lambda: 1
+        oracle = CPUSolver()
+        cur = list(pods)
+        modes = []
+        for tick in range(4):
+            if tick:
+                cur = cur[1:] + make_pods(
+                    2, cpu="1", memory="2Gi", prefix=f"tr{tick}",
+                    group="tr", topology_spread=sp)
+            sn = env.snapshot(cur, [pool])
+            r = s.solve(sn)
+            assert r.decision_fingerprint() == \
+                oracle.solve(sn).decision_fingerprint(), tick
+            tc = s._topo_cache
+            assert tc is not None, tick
+            modes.append((tc["mode"], tc["fields"]))
+        assert modes[0] == ("full", None)
+        assert all(m == ("patch", ["n"]) for m in modes[1:]), modes
+        # quiet tick: resident device inputs reused as-is
+        prev_inp = s._topo_cache["conv"]["inp"]
+        s.solve(env.snapshot(cur, [pool]))
+        assert s._last_delta.tier == "hit"
+        assert s._topo_cache["mode"] == "reuse"
+        assert s._topo_cache["conv"]["inp"] is prev_inp
+        # staleness: version lag > 1 forces a full rebuild, still exact
+        s._topo_cache["version"] -= 2
+        cur = cur[1:] + make_pods(2, cpu="1", memory="2Gi", prefix="trs",
+                                  group="tr", topology_spread=sp)
+        sn = env.snapshot(cur, [pool])
+        r = s.solve(sn)
+        assert s._topo_cache["mode"] == "full"
+        assert r.decision_fingerprint() == \
+            CPUSolver().solve(sn).decision_fingerprint()
+
+
+class TestPrunedResidency:
+    """The pruned dispatch path rides the SAME resident packed arena as
+    the base path — rows-tier ticks must reuse (and patch) the identical
+    buffer object, never repack."""
+
+    def test_pruned_dispatch_reuses_resident_buf(self):
+        _device_alive.blocking()
+        env = Environment()
+        pool = env.nodepool("pruned-res-pool")
+        pods = []
+        for g in range(6):  # 6 signatures -> Gp = 8 past the cap below
+            pods += make_pods(10, cpu="500m", memory="1Gi",
+                              prefix=f"pr{g}", group=f"pr{g}")
+        s = TPUSolver(backend="jax")
+        s._dev_devices = lambda: 1
+        s.dev_max_groups = 4  # Gp=8 > 4: route onto the pruned kernel
+        pruned_calls = []
+        orig = s._dispatch_pruned
+
+        def spy(buf, **kw):
+            pruned_calls.append(id(buf))
+            return orig(buf, **kw)
+
+        s._dispatch_pruned = spy
+        oracle = CPUSolver()
+        cur = list(pods)
+        buf_id = None
+        for tick in range(3):
+            if tick:
+                cur = cur[1:] + make_pods(
+                    2, cpu="500m", memory="1Gi", prefix=f"prx{tick}",
+                    group="pr0")
+            sn = env.snapshot(cur, [pool])
+            r = s.solve(sn)
+            assert r.decision_fingerprint() == \
+                oracle.solve(sn).decision_fingerprint(), tick
+            pc = s._pack_cache
+            assert pc is not None and pc["buf"] is not None
+            if buf_id is None:
+                buf_id = id(pc["buf"])
+            else:
+                assert id(pc["buf"]) == buf_id, "arena was repacked"
+        assert pruned_calls, "pruned kernel never dispatched"
+        assert all(b == buf_id for b in pruned_calls[-2:])
+
+
 class TestRowBankResidency:
     """Satellite audit: _RowBank.reset()/_grow() vs pins and resident
     encodings (see the class docstring's lifetime contract)."""

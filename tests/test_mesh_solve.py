@@ -1,9 +1,42 @@
-"""Type-parallel sharded solve on the virtual 8-device CPU mesh:
-decisions (takes/leftover) and final carry must exactly match the
-single-device kernel."""
+"""Sharded solves on the virtual 8-device CPU mesh: the 1-D type-parallel
+mesh, the 2-D pods x types mesh, and batch-axis data parallelism must all
+exactly match the single-device kernel — decisions (takes/leftover) and
+final carry, bit for bit."""
 
 import numpy as np
 import pytest
+
+
+def _rand_inputs(seed, T, D, Z, C, G, E, P):
+    """Seeded random KernelInputs at an arbitrary shape (the fixture
+    below covers one shape; the 2-D fuzz sweeps several)."""
+    import jax.numpy as jnp
+
+    from karpenter_provider_aws_tpu.ops.ffd_jax import KernelInputs
+    rng = np.random.RandomState(seed)
+    lim = np.where(rng.rand(P, D) < 0.5,
+                   rng.randint(1 << 6, 1 << 12, size=(P, D)),
+                   -1).astype(np.int64)
+    return KernelInputs(
+        A=jnp.asarray(rng.randint(1, 1 << 16, size=(T, D)).astype(np.int64)),
+        avail_zc=jnp.asarray(rng.rand(T, Z * C) < 0.8),
+        R=jnp.asarray(rng.randint(1, 1 << 8, size=(G, D)).astype(np.int64)),
+        n=jnp.asarray(rng.randint(1, 40, size=(G,)).astype(np.int64)),
+        F=jnp.asarray(rng.rand(G, T) < 0.7),
+        agz=jnp.asarray(np.ones((G, Z), bool)),
+        agc=jnp.asarray(np.ones((G, C), bool)),
+        admit=jnp.asarray(rng.rand(G, P) < 0.9),
+        daemon=jnp.asarray(np.zeros((G, P, D), np.int64)),
+        pool_types=jnp.asarray(rng.rand(P, T) < 0.9),
+        pool_agz=jnp.asarray(np.ones((P, Z), bool)),
+        pool_agc=jnp.asarray(np.ones((P, C), bool)),
+        pool_limit=jnp.asarray(lim),
+        pool_used0=jnp.asarray(np.zeros((P, D), np.int64)),
+        ex_alloc=jnp.asarray(
+            rng.randint(1 << 10, 1 << 16, size=(E, D)).astype(np.int64)),
+        ex_used0=jnp.asarray(np.zeros((E, D), np.int64)),
+        ex_compat=jnp.asarray(rng.rand(G, E) < 0.5),
+    )
 
 
 @pytest.fixture(scope="module")
@@ -123,6 +156,156 @@ def test_uneven_type_count_pads(inputs):
     assert carry.types.shape[1] == inp.A.shape[0]  # padding stripped
     assert int(np.asarray(takes).sum()) + int(np.asarray(leftover).sum()) \
         == int(np.asarray(inp.n).sum())
+
+
+class TestMesh2D:
+    """2-D pods x types mesh (parallel/mesh.solve_mesh2 +
+    solve_scan_sharded2): the slot axis shards over ``dp`` while the
+    type axis shards over ``tp`` — every factorization of the 8 virtual
+    devices must reproduce the single-device kernel bit for bit."""
+
+    def _assert_matches(self, inp, statics, dp, sum_only=None):
+        from karpenter_provider_aws_tpu.ops.ffd_jax import solve_scan
+        from karpenter_provider_aws_tpu.parallel import (
+            solve_mesh2, solve_scan_sharded2)
+        mesh = solve_mesh2(8, dp=dp)
+        t1, l1, c1 = solve_scan(inp, **statics)
+        t2, l2, c2 = solve_scan_sharded2(inp, mesh=mesh, sum_only=sum_only,
+                                         **statics)
+        assert (np.asarray(t1) == np.asarray(t2)).all()
+        assert (np.asarray(l1) == np.asarray(l2)).all()
+        for name in Carry_fields():
+            a, b = getattr(c1, name), getattr(c2, name)
+            assert (np.asarray(a) == np.asarray(b)).all(), name
+
+    @pytest.mark.parametrize("dp", [1, 2, 4, 8])
+    def test_every_factorization_matches_single_device(self, inputs, dp):
+        """dp x tp in {1x8, 2x4, 4x2, 8x1}; T=45 and N=66 are both
+        indivisible by every shard count, so type AND slot padding are
+        live in each case."""
+        inp, statics = inputs
+        self._assert_matches(inp, statics, dp)
+
+    def test_sum_only_collectives_identical(self, inputs):
+        """The axon backend's Sum-only all-reduce constraint holds on
+        the 2-D mesh too: dp reductions are all_gather/psum already, tp
+        pmax falls back to the gather emulation — still exact."""
+        inp, statics = inputs
+        self._assert_matches(inp, statics, 2, sum_only=True)
+
+    def test_minvalues_floors_rejected(self, inputs):
+        """minValues floors couple slots globally per scan step; the 2-D
+        kernel refuses them loudly (the dispatcher routes mv snapshots
+        onto the 1-D type mesh instead)."""
+        import jax.numpy as jnp
+
+        from karpenter_provider_aws_tpu.parallel import (
+            solve_mesh2, solve_scan_sharded2)
+        inp, statics = inputs
+        P = statics["P"]
+        T = int(inp.A.shape[0])
+        inp = inp._replace(
+            mv_floor=jnp.asarray(np.ones((P, 1), np.int64)),
+            mv_pairs_t=jnp.asarray(np.arange(T, dtype=np.int64)[None, :]),
+            mv_pairs_v=jnp.asarray(np.zeros((1, T), np.int64)))
+        with pytest.raises(ValueError, match="minValues"):
+            solve_scan_sharded2(inp, mesh=solve_mesh2(8, dp=2), **statics)
+
+    def test_default_dp_factorization(self, monkeypatch):
+        from karpenter_provider_aws_tpu.parallel.mesh import _default_dp
+        monkeypatch.delenv("KARP_MESH_DP", raising=False)
+        assert _default_dp(1) == 1
+        assert _default_dp(2) == 1   # degenerate: stay 1-D type mesh
+        assert _default_dp(4) == 2
+        assert _default_dp(8) == 2   # 2 x 4
+        assert _default_dp(16) == 4  # 4 x 4
+        monkeypatch.setenv("KARP_MESH_DP", "4")
+        assert _default_dp(8) == 4
+        monkeypatch.setenv("KARP_MESH_DP", "3")  # does not divide 8
+        assert _default_dp(8) == 2  # falls back to the default, loudly
+
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_fuzz_shapes(self, seed):
+        """Random inputs at shapes exercising E=0, uneven T/G, and pool
+        limits, across two factorizations."""
+        shapes = [
+            dict(T=45, D=4, Z=3, C=2, G=12, E=2, P=2, n_max=64),
+            dict(T=17, D=4, Z=2, C=2, G=7, E=0, P=1, n_max=33),
+            dict(T=101, D=4, Z=3, C=2, G=20, E=5, P=3, n_max=50),
+        ]
+        shp = dict(shapes[seed % len(shapes)])
+        n_max = shp.pop("n_max")
+        inp = _rand_inputs(seed * 31 + 7, **shp)
+        statics = dict(n_max=n_max, E=shp["E"], P=shp["P"])
+        for dp in (2, 8):
+            self._assert_matches(inp, statics, dp)
+
+
+class TestBatchShard:
+    """Batch-axis data parallelism (parallel/mesh.shard_batch): stacked
+    [B, W] packed buffers land B/ndev lanes per device; jit-of-vmap is
+    lane-independent so the demux must be byte-identical to the
+    sequential per-item solves."""
+
+    def _bufs(self, B, T=12, D=4, Z=2, C=2, G=6, E=0, P=1):
+        from karpenter_provider_aws_tpu.ops.hostpack import pack_inputs1
+        bufs = []
+        for i in range(B):
+            inp = _rand_inputs(100 + i, T, D, Z, C, G, E, P)
+            arrays = {k: np.asarray(v) for k, v in inp._asdict().items()
+                      if v is not None}
+            bufs.append(pack_inputs1(arrays, T, D, Z, C, G, E, P))
+        statics = dict(T=T, D=D, Z=Z, C=C, G=G, E=E, P=P, n_max=16)
+        return np.stack(bufs), statics
+
+    @pytest.mark.parametrize("B", [16, 5])
+    def test_byte_identical_to_sequential(self, B):
+        """B=16 shards evenly over 8 devices; B=5 exercises the
+        pad-to-multiple (repeat-last-row) path and the [:B] demux."""
+        import jax
+
+        from karpenter_provider_aws_tpu.ops.ffd_jax import (
+            solve_scan_packed1, solve_scan_packed1_many)
+        from karpenter_provider_aws_tpu.parallel import shard_batch
+        stack, statics = self._bufs(B)
+        cache = {}
+        d_stack, b = shard_batch(stack, len(jax.devices()), cache)
+        assert b == B
+        assert d_stack.shape[0] % len(jax.devices()) == 0
+        got = np.asarray(solve_scan_packed1_many(d_stack, **statics))[:B]
+        for i in range(B):
+            want = np.asarray(solve_scan_packed1(
+                np.asarray(stack[i]), **statics))
+            assert (got[i] == want).all(), i
+        # the mesh is cached: a second call reuses it
+        assert "batch_mesh" in cache
+        d2, _ = shard_batch(stack, len(jax.devices()), cache)
+        assert d2.shape == d_stack.shape
+
+
+class TestDispatchKernelChoice:
+    """dispatch_mesh engages the 2-D pods x types kernel only when the
+    slot axis is worth splitting (KARP_MESH_DP2_MIN_SLOTS floor, default
+    2048): the dp2 program's extra collectives and far larger compile
+    are pure overhead on small arenas, so those keep the 1-D type mesh.
+    Either way the outputs are identical."""
+
+    def test_slot_floor_gates_dp2(self, monkeypatch):
+        from karpenter_provider_aws_tpu.parallel.mesh import dispatch_mesh
+        inp = _rand_inputs(5, T=21, D=4, Z=2, C=2, G=6, E=2, P=2)
+        arrays = {k: np.asarray(v) for k, v in inp._asdict().items()
+                  if v is not None}
+        kw = dict(n_max=24, E=2, P=2, V=0, ndev=8)
+        monkeypatch.delenv("KARP_MESH_DP2_MIN_SLOTS", raising=False)
+        c1: dict = {}
+        small = dispatch_mesh(arrays, cache=c1, **kw)
+        assert c1["last_placement"]["kernel"] == "tp"  # 26 slots < floor
+        monkeypatch.setenv("KARP_MESH_DP2_MIN_SLOTS", "0")
+        c2: dict = {}
+        forced = dispatch_mesh(arrays, cache=c2, **kw)
+        assert c2["last_placement"]["kernel"] == "dp2"
+        for k in small:
+            assert (np.asarray(small[k]) == np.asarray(forced[k])).all(), k
 
 
 class TestProductionWiring:

@@ -406,8 +406,11 @@ def emitted(tmp_path_factory):
         compile_cache_dir=str(
             tmp_path_factory.mktemp("parity-jitcache"))).start()
     try:
-        SolverClient(_srv.address,
-                     tenant="parity-light").solve_buffer(_buf, _kv)
+        _cl = SolverClient(_srv.address, tenant="parity-light")
+        _cl.solve_buffer(_buf, _kv)
+        # a 2-arena SolveBatch frame on the 8-device mesh rides
+        # shard_batch: the batch-lanes counter rises by B
+        _cl.solve_batch_buffers([_buf, _buf], _kv)
         _ch = _grpc.insecure_channel(_srv.address)
         _solve = _ch.unary_unary("/karpenter.solver.v1.Solver/Solve")
         _md = (("x-solver-tenant", "parity-greedy"),)
@@ -424,9 +427,10 @@ def emitted(tmp_path_factory):
         jax.monitoring.record_event("/jax/compilation_cache/cache_hits")
         jax.monitoring.record_event("/jax/compilation_cache/cache_misses")
         _ch.close()
-        # the conftest forces 8 virtual devices, where the wire takes
-        # the mesh path and bucket padding stays out by design — drive
-        # the handler's pad step directly (D=2 pads to the D=8 floor)
+        # the conftest forces 8 virtual devices, where Solve rides the
+        # bucketed mesh path (D=2 pads to the D=8 floor on the wire
+        # itself); the direct pad call keeps the counter deterministic
+        # regardless of routing
         from karpenter_provider_aws_tpu.tenancy.bucketing import \
             bucket_statics
         _srv._handler._pad(_np.asarray(_buf), _kv, bucket_statics(_kv),

@@ -591,6 +591,48 @@ class TestSolveBatchWire:
             assert np.asarray(row).tobytes() == \
                 np.asarray(single).tobytes()
 
+    def test_full_frame_64_lanes_mesh_demux_byte_identical(self, server):
+        """A FULL frame (B = BATCH_MAX_ITEMS = 64) on the 8-device mesh
+        server: the batch rides shard_batch (8 lanes per device, zero
+        collectives) and must demux byte-identically to 64 sequential
+        Solve RPCs — seeded fuzz over the lane contents."""
+        import jax
+
+        from karpenter_provider_aws_tpu.ops.hostpack import (
+            BATCH_MAX_ITEMS, pack_inputs1)
+        assert len(jax.devices()) >= 8
+        T, D, Z, C, G, E, P = 12, 4, 2, 2, 6, 0, 1
+        st = dict(T=T, D=D, Z=Z, C=C, G=G, E=E, P=P, n_max=16,
+                  K=0, V=0, M=0, F=1)
+        bufs = []
+        for i in range(BATCH_MAX_ITEMS):
+            rng = np.random.RandomState(9000 + i)
+            arrays = dict(
+                A=rng.randint(1, 1 << 16, size=(T, D)).astype(np.int64),
+                avail_zc=rng.rand(T, Z * C) < 0.8,
+                R=rng.randint(1, 1 << 8, size=(G, D)).astype(np.int64),
+                n=rng.randint(1, 12, size=(G,)).astype(np.int64),
+                F=rng.rand(G, T) < 0.7,
+                agz=np.ones((G, Z), bool), agc=np.ones((G, C), bool),
+                admit=np.ones((G, P), bool),
+                daemon=np.zeros((G, P, D), np.int64),
+                pool_types=rng.rand(P, T) < 0.9,
+                pool_agz=np.ones((P, Z), bool),
+                pool_agc=np.ones((P, C), bool),
+                pool_limit=np.full((P, D), -1, np.int64),
+                pool_used0=np.zeros((P, D), np.int64),
+                ex_alloc=np.zeros((E, D), np.int64),
+                ex_used0=np.zeros((E, D), np.int64),
+                ex_compat=np.zeros((G, E), bool))
+            bufs.append(pack_inputs1(arrays, T, D, Z, C, G, E, P))
+        client = SolverClient(server.address)
+        rows = client.solve_batch_buffers(bufs, st)
+        assert rows.shape[0] == BATCH_MAX_ITEMS
+        for i, (row, buf) in enumerate(zip(rows, bufs)):
+            single = client.solve_buffer(buf, st)
+            assert np.asarray(row).tobytes() == \
+                np.asarray(single).tobytes(), i
+
     def test_malformed_batch_frame_invalid_argument(self, server):
         import grpc
 
