@@ -33,6 +33,9 @@ chaos-tenant:  ## hostile-tenant isolation sweep (quiet tenant vs hammer)
 fuzz-delta:  ## 10-seed mutation-sequence fuzz of the incremental encoder
 	sh hack/fuzzdelta.sh
 
+fuzz-consolidate:  ## seeded device-vs-oracle consolidation parity sweep
+	sh hack/fuzzconsolidate.sh
+
 benchmark:  ## the five BASELINE configs + interruption + batch dispatch
 	python bench.py --all --rounds 100
 	python bench.py --interruption
@@ -41,6 +44,13 @@ benchmark:  ## the five BASELINE configs + interruption + batch dispatch
 	python bench.py --delta-solve
 	python bench.py --tenant-mix
 	python bench.py --mesh-batch
+	python bench.py --consolidate-solve --consolidate-nodes 240 --rounds 5
+
+consolidate-evidence:  ## full 1000-node fleet: 2000 lanes, ONE dispatch/round
+	# a 1000-node round is a single stacked subset dispatch regardless of
+	# fleet size; the host-CPU twin serializes the 2048 lanes (~minutes),
+	# a real device amortizes them — run this variant on accelerator hosts
+	python bench.py --consolidate-solve --rounds 3
 
 multichip:  ## multi-device solve: driver dryrun + mesh parity suites
 	sh hack/multichip.sh
@@ -48,4 +58,4 @@ multichip:  ## multi-device solve: driver dryrun + mesh parity suites
 daemon:  ## run the operator against the in-memory cloud
 	python -m karpenter_provider_aws_tpu --cluster-name dev --metrics-port 8080
 
-.PHONY: test test-all scale deflake benchmark multichip daemon chart chaos chaoscloud chaos-tenant fuzz-delta
+.PHONY: test test-all scale deflake benchmark consolidate-evidence multichip daemon chart chaos chaoscloud chaos-tenant fuzz-delta fuzz-consolidate

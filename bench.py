@@ -1008,6 +1008,110 @@ def _c4_decide_sequential(solver, base, cands):
     return ("none", "", "")
 
 
+def _cs_decide_device(ev, base, cands, queries):
+    """_single_consolidation's device branch at fleet scale: ONE stacked
+    subset dispatch answers every deletion lane and every replacement
+    lane; only the winning candidate pays the authoritative simulate
+    that mints the launch spec."""
+    n = len(cands)
+    verdicts = ev.subset_solve(base, queries)
+    if verdicts is None:
+        return ("fallback", "", "")
+    for c, v in zip(cands, verdicts[:n]):
+        if v.feasible and v.n_new == 0:
+            return ("delete", c[0], "")
+    for c, v in zip(cands, verdicts[n:]):
+        if not (v.feasible and v.n_new == 1):
+            continue
+        res = ev.solver.solve(_c4_replacement_snapshot(base, c))
+        if res.unschedulable or len(res.new_nodes) != 1:
+            continue
+        return ("replace", c[0], res.decision_fingerprint())
+    return ("none", "", "")
+
+
+def run_consolidate_solve(backend, rounds, n_nodes=1000):
+    """Whole-fleet replacement search as one dense tensor program: every
+    node of a 1000-node cluster gets a deletion lane AND a price-capped
+    replacement lane in a single stacked dispatch (2000 lanes), vs the
+    sequential host oracle's one-simulate-per-candidate loop. The report
+    carries the dispatch count per round — the tentpole claim is that a
+    1000-node round is a handful of dispatches, not thousands of host
+    round trips — and identical_decisions against the oracle."""
+    from karpenter_provider_aws_tpu.controllers.disruption import \
+        ReplacementQuery
+    from karpenter_provider_aws_tpu.fake.environment import Environment
+    from karpenter_provider_aws_tpu.solver import CPUSolver
+    from karpenter_provider_aws_tpu.solver.consolidation import \
+        TPUConsolidationEvaluator
+
+    env = Environment()
+    base, cands = build_config4(env, n_nodes=n_nodes)
+    queries = (
+        [ReplacementQuery(pods=c[1], gone=c[2], price_cap=0)
+         for c in cands]
+        + [ReplacementQuery(pods=c[1], gone=c[2], price_cap=c[3])
+           for c in cands])
+    ev = TPUConsolidationEvaluator(backend=backend)
+    tpu = ev.solver
+    cpu = CPUSolver()
+
+    dispatches = {"n": 0, "stats": {}}
+    inner_dispatch = tpu.dispatch_subsets
+
+    def counted(*a, **k):
+        dispatches["n"] += 1
+        out = inner_dispatch(*a, **k)
+        # the authoritative simulate after the verdict walk overwrites
+        # last_dispatch_stats; keep the subset dispatch's own evidence
+        dispatches["stats"] = dict(tpu.last_dispatch_stats)
+        return out
+    tpu.dispatch_subsets = counted
+
+    if backend != "numpy":
+        # resolve the engine probe BEFORE the identity check: the first
+        # evaluator call under a pending probe host-falls-back by design
+        from karpenter_provider_aws_tpu.solver import route
+        route.device_alive()
+    cooldown(2.0)
+    baseline = calib_baseline()
+    t0 = time.perf_counter()
+    ref = _c4_decide_sequential(cpu, base, cands)
+    cpu_ms = (time.perf_counter() - t0) * 1000
+    got = _cs_decide_device(ev, base, cands, queries)  # warm jit
+    identical = got == ref
+    if backend != "numpy":
+        if route.device_alive():
+            _cs_decide_device(ev, base, cands, queries)
+            _cs_decide_device(ev, base, cands, queries)
+    per_round = dispatches["n"]
+    dispatches["n"] = 0
+    gc.collect()
+    gc.freeze()
+    cooldown(min(20.0, max(2.0, cpu_ms / 1000.0)))
+    times, hot_rejected = guarded_rounds(
+        lambda: _cs_decide_device(ev, base, cands, queries),
+        rounds, baseline)
+    p50, p99 = _percentiles(times)
+    per_round = dispatches["n"] / max(1, len(times)) \
+        if times else float(per_round)
+    return {
+        "config": "consolidate-solve", "p50_ms": p50, "p99_ms": p99,
+        "cpu_oracle_ms": round(cpu_ms, 1),
+        "speedup": round(cpu_ms / p99, 2) if p99 else 0.0,
+        "identical_decisions": identical,
+        "n_nodes": n_nodes, "lanes": len(queries),
+        "subset_dispatches_per_round": round(per_round, 2),
+        "subset_dispatch": dispatches["stats"],
+        "decision": f"{ref[0]} {ref[1]}",
+        "rounds": rounds,
+        "hot_rejected": hot_rejected,
+        "calib_baseline_ms": round(baseline, 3),
+        "engine": _engine_report({"host": -1, "dev": -1}, tpu),
+        "phases": _phase_report(tpu),
+    }
+
+
 def run_config4(backend, rounds, n_nodes=200):
     from karpenter_provider_aws_tpu.controllers.disruption import \
         ReplacementQuery
@@ -1597,6 +1701,13 @@ def main():
                          "per-tick fingerprint identity")
     ap.add_argument("--ticks", type=int, default=120,
                     help="reconcile ticks for --delta-solve")
+    ap.add_argument("--consolidate-solve", action="store_true",
+                    help="whole-fleet consolidation search: a 1000-node "
+                         "cluster's deletion + replacement lanes in ONE "
+                         "stacked subset dispatch vs the sequential "
+                         "host oracle, with decision identity")
+    ap.add_argument("--consolidate-nodes", type=int, default=1000,
+                    help="fleet size for --consolidate-solve")
     ap.add_argument("--sidecar-batch", action="store_true",
                     help="bench the multi-arena wire: B Solve round "
                          "trips vs one SolveBatch RPC on a loopback "
@@ -1643,6 +1754,12 @@ def main():
         print(json.dumps(run_delta_bench(
             backend=backend, pods=min(args.pods, 10_000),
             ticks=args.ticks)))
+        return
+    if args.consolidate_solve:
+        backend = "jax" if args.backend == "auto" else args.backend
+        print(json.dumps(run_consolidate_solve(
+            backend, rounds=min(args.rounds, 20),
+            n_nodes=args.consolidate_nodes)))
         return
     if args.sidecar_batch:
         print(json.dumps(run_sidecar_batch_bench(

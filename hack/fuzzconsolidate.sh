@@ -1,0 +1,29 @@
+#!/bin/sh
+# Seeded device-vs-oracle consolidation parity sweep.
+#
+# Runs the `slow`-marked 8-seed matrix of
+# tests/test_consolidation_device.py: each seed builds a random cluster
+# (random pools / pod sizes / counts), settles it, completes a random
+# half of the pods, injects spot-interruption traffic through the
+# faultcloud injector with at-least-once SQS redelivery (p_dup=1.0 —
+# the only fault kind whose call-order determinism survives a threaded
+# operator), then runs 8 disruption reconciles twice — once with the
+# sequential host oracle, once with the device-native whole-fleet
+# subset search — and asserts the decision traces are BYTE-identical:
+# same reason, same candidates in the same order, same replacement
+# launch specs field for field, same terminal node set. Zero divergence
+# tolerated.
+#
+# Tier-1 stays fast: it runs the same parity property on a 3-seed
+# matrix plus targeted prefix edge cases (equal-price ties, PDB-blocked
+# mid-prefix, in-flight replacement racing a new round); this sweep is
+# the long-haul version with chaos traffic.
+#
+# Usage: sh hack/fuzzconsolidate.sh        # the full 8-seed sweep
+#        sh hack/fuzzconsolidate.sh -x -q  # extra pytest args pass through
+set -e
+cd "$(dirname "$0")/.."
+
+JAX_PLATFORMS=cpu exec python -m pytest \
+    "tests/test_consolidation_device.py::TestFuzzSweep" \
+    -m slow -q -p no:cacheprovider "$@"

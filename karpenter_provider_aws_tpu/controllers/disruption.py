@@ -121,6 +121,23 @@ class ReplacementQuery:
     price_cap: int
 
 
+@dataclass(frozen=True)
+class SubsetVerdict:
+    """One lane's answer from the device-native whole-fleet search
+    (TPUConsolidationEvaluator.subset_solve): the EXACT outcome of the
+    FFD re-solve of "cluster minus this subset" under the query's price
+    cap. ``feasible`` (every pod absorbed) and ``n_new`` are decision
+    gates — exact by the masking argument in docs/solver-design.md — so
+    the controller walks the same candidates the sequential oracle
+    would; ``flex``/``min_price``/``savings`` are the on-device
+    spot-aware cost-delta evidence for the winning lane."""
+    feasible: bool
+    n_new: int
+    flex: int = 0
+    min_price: int = 0
+    savings: int = 0
+
+
 class ConsolidationEvaluator:
     """Answers "can these pods be absorbed by existing capacity alone?" for a
     batch of deletion candidates. The base implementation runs the solver
@@ -148,6 +165,21 @@ class ConsolidationEvaluator:
         implementation prunes nothing — the controller then behaves
         exactly like the sequential oracle."""
         return [True] * len(queries)
+
+    def subset_solve(
+            self, base: SchedulingSnapshot,
+            queries: Sequence[ReplacementQuery],
+    ) -> Optional[List[SubsetVerdict]]:
+        """Whole-fleet device search: EXACT per-query verdicts for one
+        stacked batch of "cluster minus subset" re-solves — unlike the
+        prescreen, both False AND True are proofs, so the controller can
+        replace its per-candidate solve loop with a walk over the
+        verdicts (the authoritative simulate still mints the winning
+        Command's launch specs). Returns None when the device path is
+        unavailable or ineligible; the controller then falls back to the
+        sequential oracle unchanged. The base implementation is
+        host-only and always defers."""
+        return None
 
 
 class DisruptionController:
@@ -436,6 +468,27 @@ class DisruptionController:
                  if self._budget_allows([c], REASON_UNDERUTILIZED)]
         if not cands:
             return None
+        # device-native whole-fleet search: ONE stacked dispatch answers
+        # every deletion check (price_cap=0 lanes admit no replacement
+        # type, so feasible ⟺ the survivors absorb everything) and every
+        # single-node replacement query exactly. The verdict gates are
+        # exact, so the first-accept walk below visits the same
+        # candidates in the same order as the sequential oracle
+        verdicts = self.evaluator.subset_solve(
+            self._round_base,
+            [self._query([c], 0) for c in cands]
+            + [self._query([c], c.price) for c in cands])
+        if verdicts is not None:
+            for cand, v in zip(cands, verdicts[:len(cands)]):
+                if v.feasible and v.n_new == 0:
+                    return Command(REASON_UNDERUTILIZED, [cand])
+            for cand, v in zip(cands, verdicts[len(cands):]):
+                if not (v.feasible and v.n_new == 1):
+                    continue
+                cmd = self._check_single(cand)
+                if cmd is not None:
+                    return cmd
+            return None
         # batched pre-screen: deletion feasibility for every candidate at once
         delete_ok = self.evaluator.deletions_feasible(
             [self._snapshot([c], price_cap=0) for c in cands])
@@ -450,13 +503,24 @@ class DisruptionController:
         for cand, m in zip(cands, maybe):
             if not m:
                 continue
-            result = self._simulate([cand], price_cap=cand.price)
-            if result is None or len(result.new_nodes) != 1:
-                continue
-            if not self._spot_flexibility_ok([cand], result.new_nodes[0]):
-                continue
-            return Command(REASON_UNDERUTILIZED, [cand], result.new_nodes)
+            cmd = self._check_single(cand)
+            if cmd is not None:
+                return cmd
         return None
+
+    def _check_single(self, cand: Candidate) -> Optional[Command]:
+        """The authoritative single-candidate replacement check, shared
+        by the sequential walk and the device-search replay: simulate at
+        the candidate's price cap, require exactly one new node plus the
+        spot-flexibility floor, and mint the Command from the simulate's
+        launch specs — device-path decisions are bit-identical to the
+        oracle's by construction, not by re-derivation."""
+        result = self._simulate([cand], price_cap=cand.price)
+        if result is None or len(result.new_nodes) != 1:
+            return None
+        if not self._spot_flexibility_ok([cand], result.new_nodes[0]):
+            return None
+        return Command(REASON_UNDERUTILIZED, [cand], result.new_nodes)
 
     def _query(self, cands: List[Candidate],
                price_cap: int) -> ReplacementQuery:
@@ -495,8 +559,20 @@ class DisruptionController:
             if k >= 2:
                 prefix_queries.append(ReplacementQuery(
                     pods=pods_acc, gone=gone_acc, price_cap=price_acc))
-        maybe = self.evaluator.replacements_prescreen(
+        # device-native whole-fleet search: every prefix the binary
+        # search can visit re-solves in ONE stacked dispatch, and the
+        # verdict gate (feasible with ≤1 new node) is EXACT — it matches
+        # _try_prefix's simulate outcome, so the binary-search trajectory
+        # is identical to the oracle's and only surviving prefixes pay
+        # for the authoritative simulate (which still applies the
+        # all-spot-prefix rule and mints the launch specs)
+        verdicts = self.evaluator.subset_solve(
             self._round_base, prefix_queries)
+        if verdicts is not None:
+            maybe = [v.feasible and v.n_new <= 1 for v in verdicts]
+        else:
+            maybe = self.evaluator.replacements_prescreen(
+                self._round_base, prefix_queries)
 
         # binary-search the largest workable ascending-cost prefix
         # (core firstNConsolidationOption)

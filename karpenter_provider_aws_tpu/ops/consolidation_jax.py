@@ -27,11 +27,14 @@ decisions match the oracle exactly
 
 from __future__ import annotations
 
+from functools import partial
 
 import jax
 import jax.numpy as jnp
 
 jax.config.update("jax_enable_x64", True)
+
+from .ffd_jax import KernelInputs, _solve  # noqa: E402 (after x64 flag)
 
 BIG = jnp.int64(1) << 60
 
@@ -161,3 +164,101 @@ def deletions_feasible_dense(ex_alloc: jax.Array,   # [B, E, D] int64
         return (leftover == 0).all()
 
     return jax.vmap(one_candidate)(ex_alloc, ex_used0, ex_compat, R, n)
+
+
+#: subset_solve_kernel summary columns, one row per candidate subset
+SUBSET_OUT_COLS = ("leftover", "num_nodes", "flex", "min_price", "savings")
+
+
+@partial(jax.jit, static_argnames=("n_max", "E", "P"))
+def subset_solve_kernel(
+        # ---- shared union arena (one copy for the whole batch) --------
+        A: jax.Array,            # [T, D] int64 catalog allocatable
+        avail_zc: jax.Array,     # [T, Z*C] bool offering availability
+        tprice: jax.Array,       # [T] int64 cheapest available price
+        #                          (BIG when the type has no offering)
+        R_tab: jax.Array,        # [G, D] int64 per union group row
+        n_tab: jax.Array,        # [G] int64 (unused by lanes; keeps the
+        #                          table set = KernelInputs group fields)
+        F_tab: jax.Array,        # [G, T] bool
+        agz_tab: jax.Array,      # [G, Z] bool
+        agc_tab: jax.Array,      # [G, C] bool
+        admit_tab: jax.Array,    # [G, P] bool
+        daemon_tab: jax.Array,   # [G, P, D] int64
+        excompat_tab: jax.Array,  # [G, E] bool
+        pool_types: jax.Array,   # [P, T] bool
+        pool_agz: jax.Array,     # [P, Z] bool
+        pool_agc: jax.Array,     # [P, C] bool
+        pool_limit: jax.Array,   # [P, D] int64
+        pool_used0: jax.Array,   # [P, D] int64
+        ex_alloc: jax.Array,     # [E, D] int64
+        ex_used0: jax.Array,     # [E, D] int64
+        # ---- per-candidate-subset lanes -------------------------------
+        gid: jax.Array,          # [B, Gq] int32 -> union group rows
+        n: jax.Array,            # [B, Gq] int64 pod count (0 = padding)
+        dead: jax.Array,         # [B, E] bool: node is in the subset
+        keep: jax.Array,         # [B, T] bool: type under the price cap
+        removed_price: jax.Array,  # [B] int64 price of the deleted subset
+        *, n_max: int, E: int, P: int) -> jax.Array:  # [B, 5] int64
+    """Whole-fleet replacement search: one FFD re-solve of "cluster minus
+    subset" per lane, vmapped over the subset axis.
+
+    Every lane is a GATHERED, MASKED view of one shared union arena — the
+    per-lane payload is O(Gq + E + T) index/mask words, never O(E*D)
+    tensors, so a 1000-node round ships one node table, not a thousand.
+    Masking is exactly removal for the scan (the exactness argument in
+    docs/solver-design.md "Device-native consolidation"):
+
+    - a dead existing node has ``ex_compat`` False everywhere, so its
+      headroom row is forced to 0 and the greedy prefix fill skips it —
+      identical to the row being absent;
+    - a type over the price cap has its ``avail_zc`` row and F columns
+      cleared, so it is never a fill candidate and never minted —
+      identical to the price-filtered catalog the host oracle solves;
+    - union-arena group rows / dims / pools a lane doesn't reference are
+      inert (n=0 rows are no-op scan steps, extra dims carry R=0 and
+      daemon=0, a fully type-masked pool can never open a node).
+
+    Per-lane output is a 5-word summary (SUBSET_OUT_COLS): total leftover
+    pods, new nodes opened, the minted node's surviving type flexibility
+    and cheapest price, and the spot-aware cost delta
+    ``removed_price - min_price`` (when exactly one node was minted) —
+    the on-device objective the controller argmin/selects on without a
+    host round trip per candidate."""
+    # module-level import (not in-function): importing ffd_jax while this
+    # kernel is being traced would create its module constants as tracers
+    del n_tab  # lanes carry their own counts
+
+    def lane(gids, nb, dd, kp, rp):
+        inp = KernelInputs(
+            A=A,
+            avail_zc=avail_zc & kp[:, None],
+            R=R_tab[gids],
+            n=nb,
+            F=F_tab[gids] & kp[None, :],
+            agz=agz_tab[gids],
+            agc=agc_tab[gids],
+            admit=admit_tab[gids],
+            daemon=daemon_tab[gids],
+            pool_types=pool_types,
+            pool_agz=pool_agz,
+            pool_agc=pool_agc,
+            pool_limit=pool_limit,
+            pool_used0=pool_used0,
+            ex_alloc=jnp.where(dd[:, None], 0, ex_alloc),
+            ex_used0=jnp.where(dd[:, None], 0, ex_used0),
+            ex_compat=excompat_tab[gids] & ~dd[None, :],
+        )
+        _takes, leftover, final = _solve(inp, n_max, E, P)
+        nn = final.num_nodes.astype(jnp.int64)
+        # evidence for the winning lane: the FIRST minted slot's narrowed
+        # type mask — its surviving flexibility (spot floor evidence) and
+        # cheapest price (the replacement's cost)
+        t0 = final.types[E] & kp
+        minted = nn > 0
+        flex = jnp.where(minted, t0.sum(), 0).astype(jnp.int64)
+        min_price = jnp.where(minted, jnp.where(t0, tprice, BIG).min(), 0)
+        savings = rp - jnp.where(nn == 1, min_price, 0)
+        return jnp.stack([leftover.sum(), nn, flex, min_price, savings])
+
+    return jax.vmap(lane)(gid, n, dead, keep, removed_price)

@@ -48,7 +48,7 @@ dry-runs ``__graft_entry__.dryrun_multichip``.
 from __future__ import annotations
 
 from functools import partial
-from typing import Optional, Tuple
+from typing import Dict, Optional, Tuple
 
 import jax
 import numpy as np
@@ -392,6 +392,33 @@ def shard_batch(stack: np.ndarray, ndev: int, cache: dict
     return jax.device_put(stack, NamedSharding(mesh, PS(AXIS_DP, None))), B
 
 
+def shard_lanes(stacks: Dict[str, np.ndarray], ndev: int, cache: dict
+                ) -> Tuple[Dict[str, jax.Array], int]:
+    """shard_batch for a DICT of per-lane stacks sharing a leading batch
+    axis (the consolidation subset search: gid/n/dead/keep/price lanes):
+    pad B up to a device multiple by repeating each stack's last row
+    (lanes are independent, so pad lanes are inert — callers slice
+    results [:B]) and commit every stack dp-sharded on the leading axis
+    with trailing axes replicated. The shared union-arena tensors stay
+    host-side and replicate at trace time. Returns (device dict, B)."""
+    mesh = cache.get("batch_mesh")
+    if mesh is None or mesh.devices.size != ndev:
+        mesh = cache["batch_mesh"] = Mesh(
+            np.asarray(_pick_devices(ndev)), axis_names=(AXIS_DP,))
+    first = np.asarray(next(iter(stacks.values())))
+    B = first.shape[0]
+    Bp = ((B + ndev - 1) // ndev) * ndev
+    out = {}
+    for k, a in stacks.items():
+        a = np.asarray(a)
+        if Bp != B:
+            a = np.concatenate(
+                [a, np.repeat(a[-1:], Bp - B, axis=0)], axis=0)
+        spec = PS(AXIS_DP, *([None] * (a.ndim - 1)))
+        out[k] = jax.device_put(a, NamedSharding(mesh, spec))
+    return out, B
+
+
 def _prep_field(name: str, a, Tp: int, Np: Optional[int]) -> np.ndarray:
     """Host-side per-field prep for mesh placement: pad the type axis to
     the tp-shard multiple (inert types) and, for the 2-D kernel (Np set),
@@ -453,6 +480,11 @@ def _place_resident(arrays: dict, mesh: Mesh, specs: KernelInputs,
                 _prep_field(f, arrays[f], Tp, Np),
                 NamedSharding(mesh, getattr(specs, f)))
         cache["resident"] = {"key": key, "dev": dev}
+        # full placements are a structural edge for identity-keyed caches
+        # derived from the resident arena (consolidation _base_tables):
+        # the generation rides TPUSolver.arena_epoch() so a mesh re-place
+        # invalidates exactly like a packed-buffer structural rebuild
+        cache["resident_gen"] = cache.get("resident_gen", 0) + 1
         placed = list(fields)
     cache["last_placement"] = {"mode": mode, "kernel": kern,
                                "fields": list(placed)}

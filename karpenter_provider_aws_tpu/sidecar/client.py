@@ -34,6 +34,7 @@ _SOLVE = "/karpenter.solver.v1.Solver/Solve"
 _SOLVE_TOPO = "/karpenter.solver.v1.Solver/SolveTopo"
 _SOLVE_PRUNED = "/karpenter.solver.v1.Solver/SolvePruned"
 _SOLVE_BATCH = "/karpenter.solver.v1.Solver/SolveBatch"
+_SOLVE_SUBSETS = "/karpenter.solver.v1.Solver/SolveSubsets"
 _INFO = "/karpenter.solver.v1.Solver/Info"
 
 #: SolveTopo output fields that are booleans on the kernel side (the
@@ -85,6 +86,7 @@ class SolverClient:
         self._solve_topo = self._channel.unary_unary(_SOLVE_TOPO)
         self._solve_pruned = self._channel.unary_unary(_SOLVE_PRUNED)
         self._solve_batch = self._channel.unary_unary(_SOLVE_BATCH)
+        self._solve_subsets = self._channel.unary_unary(_SOLVE_SUBSETS)
         self._info = self._channel.unary_unary(_INFO)
 
     def _request_bytes(self, rpc: str, cache_tag, statics_key, build):
@@ -213,6 +215,40 @@ class SolverClient:
                                 payload_bytes=len(packed),
                                 base_deadline_s=self.timeout)
 
+    def solve_subsets(self, arrays: Dict[str, np.ndarray],
+                      lanes: Dict[str, np.ndarray],
+                      tprice: np.ndarray,
+                      statics: Dict[str, int]) -> np.ndarray:
+        """Whole-fleet consolidation subset search over the wire: ONE
+        union arena ('i_*') + the per-lane stacks ('q_*') in one round
+        trip; returns the [B, 5] SUBSET_OUT_COLS summary rows."""
+        from .server import SUBSET_STATIC_KEYS
+        req = {"statics": np.array(
+            [statics[k] for k in SUBSET_STATIC_KEYS], dtype=np.int64),
+            "tprice": np.ascontiguousarray(tprice, dtype=np.int64)}
+        for k, v in arrays.items():
+            req[f"i_{k}"] = np.ascontiguousarray(v)
+        for k, v in lanes.items():
+            req[f"q_{k}"] = np.ascontiguousarray(v)
+        packed = arena_pack(req)
+        B = int(np.asarray(lanes["gid"]).shape[0])
+
+        def attempt(deadline: float) -> np.ndarray:
+            resp = self._solve_subsets(packed, timeout=deadline,
+                                       metadata=self._md)
+            out = np.array(arena_unpack(resp)["out"])
+            # demux shape check INSIDE the attempt (same discipline as
+            # SolveBatch): a reply that lost its lane axis is a failed
+            # attempt, not a crash surfaced to the consolidation round
+            if out.ndim != 2 or out.shape[0] != B or out.shape[1] != 5:
+                raise ValueError(
+                    f"SolveSubsets reply shape {out.shape} != ({B}, 5)")
+            return out
+
+        return self.policy.call(attempt, rpc="SolveSubsets",
+                                payload_bytes=len(packed),
+                                base_deadline_s=self.timeout)
+
     def info(self, timeout: Optional[float] = None) -> Dict[str, int]:
         def attempt(deadline: float) -> Dict[str, int]:
             out = arena_unpack(self._info(b"", timeout=deadline,
@@ -285,6 +321,9 @@ class RemoteSolver(TPUSolver):
         #: the server serves it on a mesh too — jit(vmap) on the default
         #: device decides identically)
         self._batch_ok: "Optional[bool]" = None
+        #: SolveSubsets (whole-fleet consolidation search) rides the
+        #: same gate: the evaluator host-falls-back until the flag is up
+        self._subsets_ok: "Optional[bool]" = None
         from ..solver.route import AliveCache
         self._router.alive = AliveCache(self._ping)
         pol = getattr(self.client, "policy", None)
@@ -358,9 +397,11 @@ class RemoteSolver(TPUSolver):
                 "treating the sidecar as not alive")
             self._pruned_ok = False
             self._batch_ok = False
+            self._subsets_ok = False
             return False
         self._pruned_ok = bool(info.get("pruned", 0)) and devices == 1
         self._batch_ok = bool(info.get("batch", 0))
+        self._subsets_ok = bool(info.get("subsets", 0))
         return devices >= 1
 
     @property
@@ -376,10 +417,63 @@ class RemoteSolver(TPUSolver):
         RPC; its clients keep the single-solve path."""
         return bool(self._batch_ok)
 
+    @property
+    def supports_subset_kernel(self) -> bool:
+        """True once the server's Info advertised the SolveSubsets
+        capability — the consolidation evaluator's whole-fleet search
+        then rides ONE round trip per round. An old server never sees
+        the RPC; its clients keep the sequential oracle."""
+        return bool(self._subsets_ok)
+
     def _dev_devices(self) -> int:
         """Always the packed wire dispatch: the SERVER owns the
         mesh-vs-single decision for its local devices (server.py solve)."""
         return 1
+
+    def dispatch_subsets(self, arrays, *, tprice, gid, n, dead, keep,
+                         removed_price, n_max: int, E: int,
+                         P: int) -> Optional[np.ndarray]:
+        """Whole-fleet consolidation subset batch over the wire (ONE
+        SolveSubsets round trip). Any failure — transport, breaker,
+        peer rejection — returns None: the evaluator then answers the
+        round from the sequential oracle (bit-identical by contract),
+        never a crash. FAILED_PRECONDITION / UNIMPLEMENTED additionally
+        drop the capability flag so a rolled-back peer stops paying a
+        doomed round trip per reconcile."""
+        import grpc
+        arena = {k: arrays[k] for k in (
+            "A", "avail_zc", "R", "n", "F", "agz", "agc", "admit",
+            "daemon", "pool_types", "pool_agz", "pool_agc", "pool_limit",
+            "pool_used0", "ex_alloc", "ex_used0", "ex_compat")}
+        wire_lanes = {"gid": gid, "n": n, "dead": dead, "keep": keep,
+                      "price": removed_price}
+        try:
+            out = self.client.solve_subsets(
+                arena, wire_lanes, tprice,
+                dict(n_max=n_max, E=E, P=P))
+        except SidecarUnavailable as e:
+            import logging
+            logging.getLogger(__name__).warning(
+                "SolveSubsets RPC failed (%s); consolidation round on "
+                "the sequential oracle", e)
+            self._degraded("SolveSubsets")
+            return None
+        except grpc.RpcError as e:
+            import logging
+            code = e.code() if hasattr(e, "code") else None
+            logging.getLogger(__name__).warning(
+                "SolveSubsets RPC rejected (%s); consolidation round on "
+                "the sequential oracle", code or e)
+            if code in (grpc.StatusCode.FAILED_PRECONDITION,
+                        grpc.StatusCode.UNIMPLEMENTED):
+                self._subsets_ok = False
+            self._degraded("SolveSubsets")
+            return None
+        self._wire_evidence("sidecar")
+        self._record_dispatch(kernel="subset",
+                              batch=int(np.asarray(gid).shape[0]),
+                              Gp=int(np.asarray(gid).shape[1]), Fu=1)
+        return out
 
     def _resident_tag(self, buf: np.ndarray):
         """Request-residency tag for this dispatch, or None. Only the

@@ -50,6 +50,7 @@ _SOLVE = "/karpenter.solver.v1.Solver/Solve"
 _SOLVE_TOPO = "/karpenter.solver.v1.Solver/SolveTopo"
 _SOLVE_PRUNED = "/karpenter.solver.v1.Solver/SolvePruned"
 _SOLVE_BATCH = "/karpenter.solver.v1.Solver/SolveBatch"
+_SOLVE_SUBSETS = "/karpenter.solver.v1.Solver/SolveSubsets"
 _INFO = "/karpenter.solver.v1.Solver/Info"
 
 #: SolvePruned statics vector order (the base-solve statics minus the
@@ -65,6 +66,14 @@ _TOPO_STATICS_MAX = dict(Z=64, P=256, GZ=1 << 12, GH=1 << 12,
 #: derived-dimension bounds for SolveTopo arrays (same rationale as
 #: _STATICS_MAX: every distinct shape class compiles a kernel)
 _TOPO_DIM_MAX = dict(T=4096, D=64, C=8, G=1 << 13)
+
+#: SolveSubsets statics vector order (the subset kernel's jit statics;
+#: every other dimension derives from array shapes and is validated)
+SUBSET_STATIC_KEYS = ("n_max", "E", "P")
+#: lane-stack bounds for SolveSubsets (B lanes per round; Gq gathered
+#: group rows per lane) — same compile-cache-defense rationale
+_SUBSET_B_MAX = 4096
+_SUBSET_GQ_MAX = 1 << 13
 
 
 #: bounds on request statics — every distinct tuple compiles a kernel that
@@ -719,6 +728,124 @@ class _Handler:
                 if got[name].dtype not in ok_dtypes[kind]:
                     fail(f"{name} dtype {got[name].dtype} not allowed")
 
+    def solve_subsets(self, request: bytes, context) -> bytes:
+        """Whole-fleet consolidation subset search over the wire: 'i_*'
+        arrays are the shared union-arena KernelInputs fields (ONE arena
+        for every lane — the payload does not scale with the candidate
+        count), 'q_*' arrays are the per-lane index/mask stacks,
+        'tprice' the per-type cheapest prices, 'statics' the
+        SUBSET_STATIC_KEYS vector. The shared
+        ops/consolidation_jax.subset_solve_kernel implementation serves
+        both this RPC and the local solver, so the two paths cannot
+        drift; the reply is the [B, 5] SUBSET_OUT_COLS summary."""
+        import grpc
+
+        import jax.numpy as jnp
+
+        from ..ops.consolidation_jax import subset_solve_kernel
+        all_arrays = self._request_arrays(request, context)
+        raw = all_arrays.get("statics")
+        if raw is None or len(raw) != len(SUBSET_STATIC_KEYS):
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                          f"expected {len(SUBSET_STATIC_KEYS)} "
+                          "subset statics")
+        kv = dict(zip(SUBSET_STATIC_KEYS, (int(x) for x in raw)))
+        arrays = {k[2:]: v for k, v in all_arrays.items()
+                  if k.startswith("i_")}
+        lanes = {k[2:]: v for k, v in all_arrays.items()
+                 if k.startswith("q_")}
+        tprice = all_arrays.get("tprice")
+        self._validate_subsets(arrays, lanes, tprice, kv, context)
+        key = ("subsets",) + tuple(kv.values()) + (
+            arrays["A"].shape, arrays["avail_zc"].shape,
+            arrays["R"].shape[0], tuple(lanes["gid"].shape))
+        self._admit_shape(key, context, _tenant(context))
+
+        def b(a):  # uint8 wire bools -> kernel bool
+            return jnp.asarray(np.asarray(a, dtype=bool))
+
+        out = subset_solve_kernel(
+            jnp.asarray(arrays["A"]), b(arrays["avail_zc"]),
+            jnp.asarray(tprice),
+            jnp.asarray(arrays["R"]), jnp.asarray(arrays["n"]),
+            b(arrays["F"]), b(arrays["agz"]), b(arrays["agc"]),
+            b(arrays["admit"]), jnp.asarray(arrays["daemon"]),
+            b(arrays["ex_compat"]), b(arrays["pool_types"]),
+            b(arrays["pool_agz"]), b(arrays["pool_agc"]),
+            jnp.asarray(arrays["pool_limit"]),
+            jnp.asarray(arrays["pool_used0"]),
+            jnp.asarray(arrays["ex_alloc"]),
+            jnp.asarray(arrays["ex_used0"]),
+            jnp.asarray(lanes["gid"]), jnp.asarray(lanes["n"]),
+            b(lanes["dead"]), b(lanes["keep"]),
+            jnp.asarray(lanes["price"]),
+            n_max=kv["n_max"], E=kv["E"], P=kv["P"])
+        return arena_pack({"out": np.asarray(out)})
+
+    def _validate_subsets(self, arrays, lanes, tprice, kv,
+                          context) -> None:
+        """Every array shape must agree with the dims the request
+        implies (same defense as _validate_topo): no shape-shifting the
+        kernel into unbounded compiles or out-of-bounds gathers."""
+        import grpc
+
+        def fail(msg):
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, msg)
+
+        try:
+            T, D = arrays["A"].shape
+            G = arrays["R"].shape[0]
+            Z = arrays["agz"].shape[1]
+            C = arrays["agc"].shape[1]
+            ZC = arrays["avail_zc"].shape[1]
+            B, Gq = lanes["gid"].shape
+        except (KeyError, ValueError, IndexError, AttributeError):
+            fail("missing/odd core arrays (A, R, agz, agc, gid)")
+        E, P, n_max = kv["E"], kv["P"], kv["n_max"]
+        dims = dict(T=T, D=D, Z=Z, C=C, G=G, E=E, P=P, n_max=n_max)
+        for name, val in dims.items():
+            lo = 0 if name == "E" else 1
+            if not (lo <= val <= _STATICS_MAX[name]):
+                fail(f"dim {name} out of bounds")
+        if not (1 <= B <= _SUBSET_B_MAX):
+            fail("dim B out of bounds")
+        if not (1 <= Gq <= _SUBSET_GQ_MAX):
+            fail("dim Gq out of bounds")
+        if ZC != Z * C:
+            fail("avail_zc width != Z*C")
+        expect_i = dict(
+            A=((T, D), "i"), avail_zc=((T, ZC), "b"),
+            R=((G, D), "i"), n=((G,), "i"), F=((G, T), "b"),
+            agz=((G, Z), "b"), agc=((G, C), "b"), admit=((G, P), "b"),
+            daemon=((G, P, D), "i"),
+            pool_types=((P, T), "b"), pool_agz=((P, Z), "b"),
+            pool_agc=((P, C), "b"), pool_limit=((P, D), "i"),
+            pool_used0=((P, D), "i"),
+            ex_alloc=((E, D), "i"), ex_used0=((E, D), "i"),
+            ex_compat=((G, E), "b"))
+        expect_q = dict(
+            gid=((B, Gq), "i32"), n=((B, Gq), "i"),
+            dead=((B, E), "b"), keep=((B, T), "b"), price=((B,), "i"))
+        ok_dtypes = {"i": (np.dtype(np.int64),),
+                     "b": (np.dtype(bool), np.dtype(np.uint8)),
+                     "i32": (np.dtype(np.int32),)}
+        for table, got in ((expect_i, arrays), (expect_q, lanes)):
+            if set(table) != set(got):
+                fail(f"array set mismatch: {sorted(set(table) ^ set(got))}")
+            for name, (shape, kind) in table.items():
+                if tuple(got[name].shape) != shape:
+                    fail(f"{name} shape {got[name].shape} != {shape}")
+                if got[name].dtype not in ok_dtypes[kind]:
+                    fail(f"{name} dtype {got[name].dtype} not allowed")
+        if tprice is None or tuple(tprice.shape) != (T,) \
+                or tprice.dtype != np.dtype(np.int64):
+            fail("tprice must be int64 [T]")
+        # gather safety: jax clamps out-of-range indices, and a clamped
+        # row is a wrong answer, not an error — reject it at the door
+        if int(np.asarray(lanes["gid"]).max(initial=0)) >= G \
+                or int(np.asarray(lanes["gid"]).min(initial=0)) < 0:
+            fail("gid out of range")
+
     def info(self, request: bytes, context) -> bytes:
         import jax
         cc = self._compile_monitor.counts() if self._compile_monitor \
@@ -733,6 +860,8 @@ class _Handler:
             # frame (served on mesh servers too — jit(vmap) runs on the
             # default device and decides identically)
             "batch": np.array([1], dtype=np.int64),
+            # whole-fleet consolidation subset search (SolveSubsets)
+            "subsets": np.array([1], dtype=np.int64),
             # tenancy surface: whether admission quotas are enforced,
             # whether near-miss shapes ride bucketed padding, and the
             # persistent compile cache's hit/miss counts since start —
@@ -771,6 +900,10 @@ def _generic_handler(handler: _Handler):
                 return grpc.unary_unary_rpc_method_handler(
                     handler.tracked(handler.solve_batch,
                                     rpc="SolveBatch"))
+            if call_details.method == _SOLVE_SUBSETS:
+                return grpc.unary_unary_rpc_method_handler(
+                    handler.tracked(handler.solve_subsets,
+                                    rpc="SolveSubsets"))
             if call_details.method == _INFO:
                 return grpc.unary_unary_rpc_method_handler(
                     handler.tracked(handler.info))

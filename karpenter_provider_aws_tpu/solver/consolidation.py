@@ -34,7 +34,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ..controllers.disruption import ConsolidationEvaluator
+from ..controllers.disruption import ConsolidationEvaluator, SubsetVerdict
+from ..models.delta import DeltaEncoder
 from ..models.encoding import canonical_pod_groups
 from ..solver.types import ExistingNode
 
@@ -144,12 +145,24 @@ class TPUConsolidationEvaluator(ConsolidationEvaluator):
         #: never be recycled while its entry lives.
         self._base_cache: "OrderedDict[Tuple, dict]" = OrderedDict()
         self._base_cache_cap = 4
-        #: last-seen epoch of the inner solver's resident delta arena
-        #: (models/delta.py DeltaEncoder.epoch): an epoch bump means the
-        #: structural universe moved (new catalog/pool/daemon objects),
-        #: which is exactly when this identity-keyed cache must drop its
-        #: entries coherently with the resident encoding
-        self._base_epoch: Optional[int] = None
+        #: last-seen arena-coherence token of the inner solver
+        #: (TPUSolver.arena_epoch(): delta epoch + mesh resident
+        #: generation; bare DeltaEncoder.epoch for older solvers): a
+        #: token move means the structural universe moved (new
+        #: catalog/pool/daemon objects, or a from-scratch mesh
+        #: re-placement), which is exactly when this identity-keyed
+        #: cache must drop its entries coherently with the resident
+        #: encoding
+        self._base_epoch = None
+        #: resident union-arena encoder for the whole-fleet subset
+        #: search (subset_solve): one DeltaEncoder so successive rounds
+        #: against a stable cluster pay delta patches, not re-encodes
+        self._sub_delta = DeltaEncoder()
+        #: (enc, version) -> padded device arrays + statics from the last
+        #: subset round; reused verbatim while the encoder's version is
+        #: unchanged (the version bumps whenever any returned array
+        #: differs, models/delta.py)
+        self._sub_prep: Optional[Tuple] = None
 
     @property
     def metrics(self):
@@ -239,6 +252,163 @@ class TPUConsolidationEvaluator(ConsolidationEvaluator):
                 out[i] = bool(ok)
         return out  # type: ignore[return-value]
 
+    # ------------------------------------------------------------------
+    # whole-fleet device search (subset lanes over one union arena)
+    # ------------------------------------------------------------------
+    #: slot bucket for subset lanes: every gate the controller consumes
+    #: (feasible, n_new in {0, 1}) is decided exactly at two new-node
+    #: slots — a re-solve needing more than 2 nodes either strands pods
+    #: (leftover > 0) or mints a second node, and both read as "gate
+    #: false", which is exactly the oracle's skip
+    SUBSET_N_MAX = 2
+
+    def subset_solve(self, base, queries):
+        """EXACT per-query verdicts for the whole-fleet replacement
+        search (controllers.disruption ConsolidationEvaluator contract):
+        encode ONE union arena over the base cluster plus every queried
+        pod — riding the resident delta encoder, so a stable cluster
+        pays patches, not re-encodes — and answer every lane (deletion
+        checks at price_cap=0 and ≤1-cheaper-replacement prefixes alike)
+        with ONE subset_solve_kernel dispatch. Each lane is a gathered,
+        masked view of the union arena: per-query group rows select the
+        pending pods, dead-node masks delete the subset, keep masks
+        price-filter the catalog. Any eligibility miss returns None
+        (karpenter_solver_consolidation_host_fallback_total says why)
+        and the controller runs the sequential oracle unchanged."""
+        if not queries:
+            return []
+        if self.backend == "numpy":
+            return self._subset_fallback("numpy_backend")
+        if not getattr(self.solver, "supports_subset_kernel", False):
+            return self._subset_fallback("no_subset_kernel")
+        from .route import dev_engine_usable
+        if not dev_engine_usable(self._router):
+            return self._subset_fallback("device_unavailable")
+        for q in queries:
+            for p in q.pods:
+                if p.topology_spread or p.pod_affinity:
+                    # the pour/event kernels have no subset-lane shape;
+                    # the oracle's per-candidate solves still serve
+                    # topology candidates from the tensor engine
+                    return self._subset_fallback("topology")
+        # union pod set: every queried pod once (prefix queries overlap)
+        union_pods: List = []
+        seen = set()
+        for q in queries:
+            for p in q.pods:
+                if id(p) not in seen:
+                    seen.add(id(p))
+                    union_pods.append(p)
+        if not union_pods:
+            return self._subset_fallback("no_pods")
+        pod_groups = canonical_pod_groups(union_pods)
+        from .preferences import preference_count
+        if any(preference_count(plist[0]) for _sig, plist in pod_groups):
+            # preference relaxation is an outer host loop (solver/tpu.py
+            # discipline): a subset lane cannot iterate it
+            return self._subset_fallback("preferences")
+        try:
+            return self._subset_dispatch(base, queries, union_pods,
+                                         pod_groups)
+        except Exception:
+            import logging
+            logging.getLogger(__name__).warning(
+                "subset dispatch failed; consolidation round on the "
+                "sequential oracle", exc_info=True)
+            return self._subset_fallback("dispatch_error")
+
+    def _subset_fallback(self, reason: str):
+        if self.metrics is not None:
+            self.metrics.inc(
+                "karpenter_solver_consolidation_host_fallback_total",
+                labels={"reason": reason})
+        return None
+
+    def _subset_dispatch(self, base, queries, union_pods, pod_groups):
+        solver = self.solver
+        existing = sorted(base.existing_nodes, key=lambda n: n.name)
+        union = SchedulingSnapshot(
+            pods=union_pods, nodepools=base.nodepools,
+            existing_nodes=existing,
+            daemon_overheads=base.daemon_overheads, zones=base.zones)
+        self._sub_delta.metrics = self.metrics
+        enc, (ex_alloc, ex_used, ex_compat), _d = \
+            self._sub_delta.encode(union, pod_groups, existing)
+        if enc.topo_any:
+            return self._subset_fallback("topology")
+        if not enc.types:
+            return self._subset_fallback("no_types")
+        if enc.mv_K:
+            # minValues floors couple lanes to pool-level argmin state;
+            # the oracle's per-prefix simulate handles them exactly
+            return self._subset_fallback("minvalues")
+        if len(enc.groups) > getattr(solver, "dev_max_groups", 4096):
+            return self._subset_fallback("group_cap")
+
+        # padded union arena, reused verbatim while the encoder's
+        # version is unchanged (a version bump means some returned
+        # array moved — models/delta.py); ndev=2 skips the fuse plan
+        # (subset lanes scan unfused, like every vmapped batch)
+        ver = self._sub_delta.version
+        if (self._sub_prep is not None and self._sub_prep[0] is enc
+                and self._sub_prep[1] == ver):
+            arrays, stt, tprice = self._sub_prep[2:]
+        else:
+            arrays, stt = solver._prep_device_inputs(
+                enc, ex_alloc, ex_used, ex_compat, 2)
+            tprice = np.full(len(enc.types), np.int64(1) << 60,
+                             dtype=np.int64)
+            for ti, it in enumerate(enc.types):
+                p = it.cheapest_price()
+                if p is not None:
+                    tprice[ti] = p
+            self._sub_prep = (enc, ver, arrays, stt, tprice)
+
+        # lane stacks: gid/n gather each query's groups out of the union
+        # tables (canonical_group_order is restriction-stable, so the
+        # gathered rows ARE the query's own canonical encoding order)
+        sig_row = {g.sig: g.index for g in enc.groups}
+        npos = {n.name: i for i, n in enumerate(existing)}
+        B = len(queries)
+        per_rows = [[(sig_row[s], len(plist))
+                     for s, plist in canonical_pod_groups(q.pods)]
+                    for q in queries]
+        Gq = _pow2(max((len(r) for r in per_rows), default=1))
+        Bp = _pow2(B)
+        E, T = stt["E"], len(enc.types)
+        gid = np.zeros((Bp, Gq), dtype=np.int32)
+        nq = np.zeros((Bp, Gq), dtype=np.int64)
+        dead = np.zeros((Bp, E), dtype=bool)
+        keep = np.zeros((Bp, T), dtype=bool)
+        rprice = np.zeros(Bp, dtype=np.int64)
+        for b, q in enumerate(queries):
+            for j, (si, cnt) in enumerate(per_rows[b]):
+                gid[b, j] = si
+                nq[b, j] = cnt
+            for name in q.gone:
+                ei = npos.get(name)  # claim names are not node rows
+                if ei is not None:
+                    dead[b, ei] = True
+            # mirror _snapshot's price filter: strictly cheaper, priced
+            keep[b] = tprice < q.price_cap
+            rprice[b] = q.price_cap
+        out = solver.dispatch_subsets(
+            arrays, tprice=tprice, gid=gid, n=nq, dead=dead, keep=keep,
+            removed_price=rprice, n_max=self.SUBSET_N_MAX,
+            E=E, P=stt["P"])
+        if out is None:  # remote capability/availability degrade
+            return self._subset_fallback("dispatch_degraded")
+        if self.metrics is not None:
+            self.metrics.inc(
+                "karpenter_solver_consolidation_subset_batch_total")
+            self.metrics.inc(
+                "karpenter_solver_consolidation_device_rounds_total")
+        out = np.asarray(out)
+        return [SubsetVerdict(feasible=int(r[0]) == 0, n_new=int(r[1]),
+                              flex=int(r[2]), min_price=int(r[3]),
+                              savings=int(r[4]))
+                for r in out[:B]]
+
     def _base_tables(self, base) -> dict:
         """Catalog-derived tables (unique types, dense allocatable,
         cheapest prices, lazily-filled per-profile compat rows). Cached on
@@ -249,15 +419,24 @@ class TPUConsolidationEvaluator(ConsolidationEvaluator):
         # requirement tuples in explicitly or a requirements-only edit
         # would keep serving stale pool-admission rows
         # arena coherence: when the inner solver's incremental encoder
-        # rebuilt its resident arena for a structural change, the same
-        # change invalidates these identity-keyed tables — refresh in
-        # lockstep so a delta-patched base never pre-screens a stale
-        # "cluster minus subset" re-solve
-        dep = getattr(self.solver, "_delta", None)
-        if dep is not None and dep.epoch != self._base_epoch:
+        # rebuilt its resident arena for a structural change — OR the
+        # mesh engine re-placed its resident sharded arena from scratch
+        # (a mesh-patched tick whose key rolled; parallel/mesh.py bumps
+        # resident_gen on every full placement) — the same change
+        # invalidates these identity-keyed tables. The compound
+        # TPUSolver.arena_epoch() token covers both edges; refresh in
+        # lockstep so a delta- or mesh-patched base never pre-screens a
+        # stale "cluster minus subset" re-solve
+        ae = getattr(self.solver, "arena_epoch", None)
+        if ae is not None:
+            tok = ae()
+        else:
+            dep = getattr(self.solver, "_delta", None)
+            tok = dep.epoch if dep is not None else None
+        if tok is not None and tok != self._base_epoch:
             if self._base_epoch is not None:
                 self._base_cache.clear()
-            self._base_epoch = dep.epoch
+            self._base_epoch = tok
         key = tuple(
             x for spec in base.nodepools
             for x in (spec.nodepool.hash(),

@@ -559,6 +559,75 @@ class TPUSolver(Solver):
             kernel=kernel, batch=batch, fuse=Fu, scan_steps=steps,
             fused_blocks=fused_blocks, seq_blocks=steps - fused_blocks)
 
+    # -- whole-fleet consolidation search ------------------------------
+    #: the consolidation evaluator's subset search dispatches locally;
+    #: the sidecar's RemoteSolver resolves this from the Info capability
+    #: flag and routes through the SolveSubsets RPC instead
+    supports_subset_kernel = True
+
+    def arena_epoch(self):
+        """Compound coherence token for identity-keyed caches derived
+        from this solver's resident arenas (consolidation _base_tables):
+        the incremental encoder's structural epoch (models/delta.py)
+        PLUS the mesh resident arena's full-placement generation
+        (parallel/mesh.py _place_resident). A mesh tick that re-placed
+        the sharded arena from scratch is the same invalidation edge as
+        a packed-buffer structural rebuild and must invalidate derived
+        caches even when the delta epoch did not move
+        (tests/test_consolidation_device.py regression)."""
+        dep = self._delta.epoch if self._delta is not None else None
+        mc = self.__dict__.get("_mesh_cache") or {}
+        return (dep, mc.get("resident_gen", 0))
+
+    def dispatch_subsets(self, arrays: dict, *, tprice, gid, n, dead,
+                         keep, removed_price, n_max: int, E: int,
+                         P: int) -> np.ndarray:
+        """Run one whole-fleet consolidation subset batch on the device:
+        the shared union-arena tensors (one _prep_device_inputs arena for
+        the whole round) plus per-lane index/mask stacks, ONE dispatch
+        for every lane (ops/consolidation_jax.subset_solve_kernel). On a
+        multi-device engine the lane stacks commit dp-sharded
+        (parallel/mesh.py shard_lanes) with the union arena replicated —
+        lanes are independent, so results are byte-identical to the
+        single-device kernel. Returns the [B, 5] SUBSET_OUT_COLS
+        summary. The sidecar's RemoteSolver overrides this with the
+        SolveSubsets RPC."""
+        import jax.numpy as jnp
+
+        from ..ops.consolidation_jax import subset_solve_kernel
+        lanes = dict(gid=gid, n=n, dead=dead, keep=keep,
+                     removed_price=removed_price)
+        B = int(np.asarray(gid).shape[0])
+        ndev = self._dev_devices()
+        if ndev > 1:
+            from ..parallel.mesh import shard_lanes
+            cache = self.__dict__.setdefault("_mesh_cache", {})
+            lanes, B = shard_lanes(lanes, ndev, cache)
+        else:
+            lanes = {k: jnp.asarray(np.asarray(v))
+                     for k, v in lanes.items()}
+        out = np.asarray(subset_solve_kernel(
+            jnp.asarray(arrays["A"]), jnp.asarray(arrays["avail_zc"]),
+            jnp.asarray(np.asarray(tprice)),
+            jnp.asarray(arrays["R"]), jnp.asarray(arrays["n"]),
+            jnp.asarray(arrays["F"]), jnp.asarray(arrays["agz"]),
+            jnp.asarray(arrays["agc"]), jnp.asarray(arrays["admit"]),
+            jnp.asarray(arrays["daemon"]),
+            jnp.asarray(arrays["ex_compat"]),
+            jnp.asarray(arrays["pool_types"]),
+            jnp.asarray(arrays["pool_agz"]),
+            jnp.asarray(arrays["pool_agc"]),
+            jnp.asarray(arrays["pool_limit"]),
+            jnp.asarray(arrays["pool_used0"]),
+            jnp.asarray(arrays["ex_alloc"]),
+            jnp.asarray(arrays["ex_used0"]),
+            lanes["gid"], lanes["n"], lanes["dead"], lanes["keep"],
+            lanes["removed_price"],
+            n_max=n_max, E=E, P=P))[:B]
+        self._record_dispatch(kernel="subset", batch=B,
+                              Gp=int(np.asarray(gid).shape[1]), Fu=1)
+        return out
+
     # -- batched multi-solve -------------------------------------------
     #: solve_batch's vmapped dispatch runs the kernel locally; the
     #: sidecar's RemoteSolver turns this off (one buffer per RPC)

@@ -1,0 +1,462 @@
+"""Device-native whole-fleet consolidation: parity and coherence.
+
+Two contracts pinned here:
+
+1. **Bit-identical decisions** — the subset-lane device search
+   (TPUConsolidationEvaluator.subset_solve + the controller's verdict
+   walk) must produce byte-identical Commands to the sequential host
+   oracle on every reconcile round: same reason, same candidates in the
+   same order, same replacement launch specs field for field. The fuzz
+   harness runs each seeded scenario twice — oracle evaluator vs device
+   evaluator — over random cluster churn plus interruption traffic from
+   fake/faultcloud.py, and diffs the full decision traces. The tier-1
+   cases keep a few seeds; the slow sweep (hack/fuzzconsolidate.sh,
+   `make fuzz-consolidate`) widens them.
+
+2. **Arena-epoch coherence** (PR 8 regression) — a mesh tick that
+   re-placed the resident sharded arena from scratch must invalidate
+   consolidation's identity-keyed _base_cache exactly like a
+   packed-buffer structural rebuild: parallel/mesh.py bumps
+   ``resident_gen`` on every full placement, TPUSolver.arena_epoch()
+   compounds it with the delta epoch, and _base_tables refreshes on
+   token movement.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from karpenter_provider_aws_tpu.apis import labels as L
+from karpenter_provider_aws_tpu.apis.objects import (EC2NodeClass,
+                                                     NodeClassRef, NodePool,
+                                                     NodePoolTemplate)
+from karpenter_provider_aws_tpu.apis.requirements import Requirements
+from karpenter_provider_aws_tpu.fake.environment import make_pods
+from karpenter_provider_aws_tpu.fake.faultcloud import (CloudFaultInjector,
+                                                        CloudFaultPlan)
+from karpenter_provider_aws_tpu.operator import Operator
+from karpenter_provider_aws_tpu.providers.sqs import InterruptionMessage
+from karpenter_provider_aws_tpu.solver.consolidation import \
+    TPUConsolidationEvaluator
+
+ROUNDS = 8
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def command_fingerprint(cmd):
+    """Byte-level serialization of a disruption Command: every field the
+    executor acts on, including the replacement launch specs. Two runs
+    that differ anywhere here did NOT make the same decision."""
+    if cmd is None:
+        return None
+    return (
+        cmd.reason,
+        tuple((c.name, c.instance_type, c.price) for c in cmd.candidates),
+        tuple((n.nodepool,
+               tuple(sorted(repr(r) for r in n.requirements)),
+               tuple(sorted(n.pod_names)),
+               tuple(n.instance_type_names),
+               tuple(sorted(n.requests.items())),
+               tuple(sorted((t.key, t.value, t.effect) for t in n.taints)))
+              for n in cmd.replacements),
+    )
+
+
+def _mk_operator(evaluator):
+    import itertools
+
+    from karpenter_provider_aws_tpu.controllers import provisioning as prov
+    from karpenter_provider_aws_tpu.fake import environment as fenv
+
+    # reset the process-global name sequences so the oracle run and the
+    # device run mint identical pod / NodeClaim names — the fingerprints
+    # are byte-level, so name skew would read as (fake) divergence
+    from karpenter_provider_aws_tpu.fake import ec2 as fec2
+    fenv._pod_counter = itertools.count()
+    prov._claim_seq = itertools.count(1)
+    fec2._id_counter = itertools.count(1)
+    clock = FakeClock()
+    op = Operator(clock=clock, consolidation_evaluator=evaluator)
+    op.kube.create(EC2NodeClass("fz-class"))
+    return op, clock
+
+
+_CPU_MENUS = (["4", "16"], ["2", "8"], ["4", "8", "16"], ["2", "4", "16"])
+
+
+def run_fuzz_scenario(seed, evaluator, interruptions=0, dup_faults=False):
+    """One seeded churn scenario: random pools + pods, settle, randomly
+    complete pods, optionally reclaim spot instances (at-least-once
+    delivery when dup_faults — the faultcloud injector redelivers every
+    SQS send), then ROUNDS consolidation reconciles. All randomness
+    comes from `seed`, so two runs differing only in `evaluator` see
+    identical cluster states round for round."""
+    rng = random.Random(seed)
+    op, clock = _mk_operator(evaluator)
+    for pi in range(rng.randint(1, 2)):
+        op.kube.create(NodePool(f"fz{pi}", template=NodePoolTemplate(
+            node_class_ref=NodeClassRef("fz-class"),
+            requirements=Requirements.from_terms(
+                [{"key": L.INSTANCE_CPU, "operator": "In",
+                  "values": rng.choice(_CPU_MENUS)}]))))
+    for b in range(rng.randint(2, 4)):
+        for p in make_pods(rng.randint(2, 6),
+                           cpu=rng.choice(["500m", "1", "2900m"]),
+                           memory=rng.choice(["1Gi", "3Gi"]),
+                           prefix=f"fz{b}"):
+            op.kube.create(p)
+    op.run_until_settled(disrupt=False)
+    # churn: a random half of the pods complete (name order is the
+    # deterministic iteration order)
+    for p in sorted(op.kube.list("Pod"), key=lambda x: x.metadata.name):
+        if rng.random() < 0.5:
+            p.phase = "Succeeded"
+            op.kube.update(p)
+    inj = None
+    if dup_faults:
+        # faultcloud's at-least-once redelivery: every interruption send
+        # is delivered twice; the dedupe must keep decisions identical
+        inj = CloudFaultInjector(
+            op.ec2, sqs=op.sqs,
+            plan=CloudFaultPlan(seed, p_throttle=0.0, p_down=0.0,
+                                p_wedge=0.0, p_lag=0.0, p_partial=0.0,
+                                p_dup=1.0)).install()
+    try:
+        if interruptions:
+            claims = sorted(
+                (c for c in op.kube.list("NodeClaim") if c.provider_id),
+                key=lambda c: c.metadata.name)
+            for c in claims[:interruptions]:
+                op.sqs.send(InterruptionMessage(
+                    kind="spot_interruption",
+                    instance_id=c.provider_id.split("/")[-1]))
+        trace = []
+        for _ in range(ROUNDS):
+            cmd = op.disruption.reconcile()
+            trace.append(command_fingerprint(cmd))
+            op.run_until_settled()
+            clock.t += 30
+    finally:
+        if inj is not None:
+            inj.uninstall()
+    nodes = tuple(sorted(n.metadata.labels.get(L.INSTANCE_TYPE, "")
+                         for n in op.kube.list("Node")))
+    return trace, nodes, op
+
+
+def _metric(op, name, **labels):
+    return op.metrics.counter(name, labels=labels or None)
+
+
+def _assert_parity(seed, interruptions=0, dup_faults=False):
+    trace_o, nodes_o, _ = run_fuzz_scenario(
+        seed, None, interruptions, dup_faults)
+    ev = TPUConsolidationEvaluator(backend="jax")
+    trace_d, nodes_d, op = run_fuzz_scenario(
+        seed, ev, interruptions, dup_faults)
+    assert trace_d == trace_o, f"seed {seed} diverged"
+    assert nodes_d == nodes_o, f"seed {seed} terminal nodes diverged"
+    return trace_d, op
+
+
+class TestFuzzParity:
+    """Device-search Commands byte-identical to the sequential oracle."""
+
+    @pytest.mark.parametrize("seed", [0, 3, 11])
+    def test_churn_parity(self, seed):
+        trace, op = _assert_parity(seed)
+        # at least one seed consolidates something; all must stay exact
+        if any(trace):
+            assert any(fp for fp in trace)
+
+    def test_device_path_engages(self):
+        """The parity above is vacuous if the device run silently
+        host-fell-back every round — require the subset kernel to have
+        actually answered rounds (and count its dispatches)."""
+        ev = TPUConsolidationEvaluator(backend="jax")
+        from karpenter_provider_aws_tpu.solver.route import device_alive
+        assert device_alive()  # resolve the probe before the first round
+        _trace, _nodes, op = run_fuzz_scenario(3, ev)
+        rounds = _metric(
+            op, "karpenter_solver_consolidation_device_rounds_total")
+        batches = _metric(
+            op, "karpenter_solver_consolidation_subset_batch_total")
+        assert rounds > 0, "subset search never engaged"
+        assert batches >= rounds
+        assert ev.solver.last_dispatch_stats["kernel"] == "subset"
+
+    @pytest.mark.parametrize("seed", [2, 9])
+    def test_interruption_parity(self, seed):
+        _assert_parity(seed, interruptions=1)
+
+
+@pytest.mark.slow
+class TestFuzzSweep:
+    """hack/fuzzconsolidate.sh's bar: a wide seed sweep with churn plus
+    duplicated interruption traffic, byte-identical every round."""
+
+    @pytest.mark.parametrize("seed", list(range(8)))
+    def test_seed_sweep(self, seed):
+        _assert_parity(seed, interruptions=seed % 3, dup_faults=True)
+
+
+def _settled_equal_price_cluster(evaluator):
+    """Three same-priced 4-cpu nodes, each left with one 2-cpu pod after
+    its filler completes. No single node's pod fits elsewhere (1820m
+    free), no pair merge is cheaper (two 4s: 51020 < one 8: 53803), but
+    every prefix of the equal-price triple is feasible on device (the
+    merged pods fit one cheaper 8-cpu node) yet every one must be
+    REJECTED: pairs and the triple trip the spot->spot multi-replacement
+    block, singles fail both deletion (2500m > 1320m free) and the spot
+    flexibility floor. The correct answer is NO command — a device lane
+    that over-reports a tied prefix turns this into a wrong disruption."""
+    op, clock = _mk_operator(evaluator)
+    op.kube.create(NodePool("ties", template=NodePoolTemplate(
+        node_class_ref=NodeClassRef("fz-class"),
+        requirements=Requirements.from_terms(
+            [{"key": L.INSTANCE_CPU, "operator": "In",
+              "values": ["2", "4", "8"]}]))))
+    # one provisioning wave per pair: 1300m + 2500m = 3800m fills an
+    # a1.xlarge (3820m allocatable) to within 20m, so the next wave
+    # can't reuse it and each pair gets its own equal-price node. The
+    # 2500m survivor is too big for every 2-cpu type (~1900m), so no
+    # single-node replacement undercuts the triple merge
+    for i in range(3):
+        for p in make_pods(1, cpu="1300m", memory="1Gi",
+                           prefix=f"filler{i}"):
+            op.kube.create(p)
+        for p in make_pods(1, cpu="2500m", memory="1Gi",
+                           prefix=f"small{i}"):
+            op.kube.create(p)
+        op.run_until_settled(disrupt=False)
+    for p in op.kube.list("Pod"):
+        if p.metadata.name.startswith("filler"):
+            p.phase = "Succeeded"
+            op.kube.update(p)
+    return op, clock
+
+
+def _settled_deletable_pair(evaluator):
+    """Two same-priced 4-cpu nodes each left with one small pod; either
+    small fits the other node's free space, so single-node deletion has
+    a genuine equal-price choice to break."""
+    op, clock = _mk_operator(evaluator)
+    op.kube.create(NodePool("ties", template=NodePoolTemplate(
+        node_class_ref=NodeClassRef("fz-class"),
+        requirements=Requirements.from_terms(
+            [{"key": L.INSTANCE_CPU, "operator": "In",
+              "values": ["4"]}]))))
+    for i in range(2):
+        for p in make_pods(1, cpu="3300m", memory="1Gi",
+                           prefix=f"filler{i}"):
+            op.kube.create(p)
+        for p in make_pods(1, cpu="500m", memory="256Mi",
+                           prefix=f"small{i}"):
+            op.kube.create(p)
+        op.run_until_settled(disrupt=False)
+    for p in op.kube.list("Pod"):
+        if p.metadata.name.startswith("filler"):
+            p.phase = "Succeeded"
+            op.kube.update(p)
+    return op, clock
+
+
+def _trace(op, clock, rounds=6):
+    out = []
+    for _ in range(rounds):
+        cmd = op.disruption.reconcile()
+        out.append(command_fingerprint(cmd))
+        op.run_until_settled()
+        clock.t += 30
+    return out
+
+
+class TestPrefixEdgeCases:
+    """Ascending-cost-prefix edges: the device verdict gate must match
+    the oracle's binary-search trajectory on ties, PDB blocks, and
+    in-flight races — pinned by trace equality on targeted scenarios."""
+
+    def test_equal_price_ties_reject_parity(self):
+        """3-way tie where every tempting prefix must be rejected: the
+        exact no-op, with proof the device lanes actually evaluated it."""
+        from karpenter_provider_aws_tpu.solver.route import device_alive
+        assert device_alive()
+        op_o, ck_o = _settled_equal_price_cluster(None)
+        t_o = _trace(op_o, ck_o)
+        op_d, ck_d = _settled_equal_price_cluster(
+            TPUConsolidationEvaluator(backend="jax"))
+        t_d = _trace(op_d, ck_d)
+        assert t_d == t_o
+        assert t_o == [None] * len(t_o), t_o
+        assert _metric(
+            op_d,
+            "karpenter_solver_consolidation_device_rounds_total") > 0
+
+    def test_equal_price_ties_deterministic_break(self):
+        """Two same-priced nodes, either deletable — the tie must break
+        the same way (first in candidate order) on both paths."""
+        t_d = _trace(*_settled_deletable_pair(
+            TPUConsolidationEvaluator(backend="jax")))
+        t_o = _trace(*_settled_deletable_pair(None))
+        assert t_d == t_o
+        deletions = [fp for fp in t_o if fp]
+        assert deletions and len(deletions[0][1]) == 1
+        assert deletions[0][1][0][0] == "ties-00001", deletions
+
+    def test_pdb_blocked_mid_prefix(self):
+        """A PDB with zero eviction budget on the FIRST tied node's pod
+        knocks it out of the ascending-cost order mid-prefix; device and
+        oracle must both fall through to deleting the second node."""
+        from karpenter_provider_aws_tpu.apis.objects import \
+            PodDisruptionBudget
+
+        def scenario(evaluator):
+            op, clock = _settled_deletable_pair(evaluator)
+            # pin the pod living on the would-be winner (ties-00001)
+            victim = next(p for p in op.kube.list("Pod")
+                          if p.phase not in ("Succeeded", "Failed")
+                          and p.node_name == "ties-00001")
+            victim.metadata.labels["pdb-pin"] = "yes"
+            op.kube.update(victim)
+            op.kube.create(PodDisruptionBudget(
+                "pin", selector={"pdb-pin": "yes"}, max_unavailable=0))
+            return _trace(op, clock)
+
+        t_d = scenario(TPUConsolidationEvaluator(backend="jax"))
+        t_o = scenario(None)
+        assert t_d == t_o
+        deletions = [fp for fp in t_o if fp]
+        # the unblocked twin is chosen instead of the PDB'd winner
+        assert deletions and deletions[0][1][0][0] == "ties-00002", t_o
+
+    def test_in_flight_replacement_races_new_round(self):
+        """A replacement Command in flight (replacement node not yet
+        registered) must budget-block the next round identically in
+        both paths: reconcile twice WITHOUT settling in between."""
+
+        def scenario(evaluator):
+            op, clock = _mk_operator(evaluator)
+            op.kube.create(NodePool("race", template=NodePoolTemplate(
+                node_class_ref=NodeClassRef("fz-class"),
+                requirements=Requirements.from_terms(
+                    [{"key": L.INSTANCE_CPU, "operator": "In",
+                      "values": ["4", "16"]}]))))
+            for p in make_pods(5, cpu="2900m", memory="1Gi", prefix="rc"):
+                op.kube.create(p)
+            op.run_until_settled(disrupt=False)
+            for p in sorted(op.kube.list("Pod"),
+                            key=lambda x: x.metadata.name)[1:]:
+                p.phase = "Succeeded"
+                op.kube.update(p)
+            first = op.disruption.reconcile()
+            # race: a new round while the replacement is still pending
+            racing = [command_fingerprint(op.disruption.reconcile())
+                      for _ in range(2)]
+            op.run_until_settled()
+            clock.t += 30
+            after = _trace(op, clock, rounds=3)
+            return (command_fingerprint(first), racing, after)
+
+        t_d = scenario(TPUConsolidationEvaluator(backend="jax"))
+        t_o = scenario(None)
+        assert t_d == t_o
+        assert t_d[0] is not None and t_d[0][2], \
+            "scenario never launched a replacement"
+        # the in-flight replacement blocks the racing rounds
+        assert t_d[1] == [None, None]
+
+
+class TestArenaEpochCoherence:
+    """PR 8 regression: mesh-resident full placements are a structural
+    cache-invalidation edge, exactly like a delta-epoch bump."""
+
+    def _mesh_args(self, seed=5):
+        from tests.test_mesh_solve import _rand_inputs
+        inp = _rand_inputs(seed, T=21, D=4, Z=2, C=2, G=6, E=2, P=2)
+        arrays = {k: np.asarray(v) for k, v in inp._asdict().items()
+                  if v is not None}
+        return arrays, dict(n_max=24, E=2, P=2, V=0, ndev=8)
+
+    def test_resident_gen_tracks_full_placements(self):
+        """Forced dirty transitions: None (full) bumps the generation;
+        [] (reuse) and ["n"] (patch) must NOT."""
+        from karpenter_provider_aws_tpu.parallel.mesh import dispatch_mesh
+        arrays, kw = self._mesh_args()
+        cache: dict = {}
+        dispatch_mesh(arrays, cache=cache, dirty=None, **kw)
+        assert cache["last_placement"]["mode"] == "full"
+        assert cache["resident_gen"] == 1
+        dispatch_mesh(arrays, cache=cache, dirty=[], **kw)
+        assert cache["last_placement"]["mode"] == "reuse"
+        assert cache["resident_gen"] == 1
+        arrays["n"] = arrays["n"] + 1
+        dispatch_mesh(arrays, cache=cache, dirty=["n"], **kw)
+        assert cache["last_placement"]["mode"] == "patch"
+        assert cache["resident_gen"] == 1
+        dispatch_mesh(arrays, cache=cache, dirty=None, **kw)
+        assert cache["last_placement"]["mode"] == "full"
+        assert cache["resident_gen"] == 2
+
+    def test_arena_epoch_compounds_mesh_generation(self):
+        from karpenter_provider_aws_tpu.solver.tpu import TPUSolver
+        s = TPUSolver(backend="jax")
+        tok0 = s.arena_epoch()
+        s.__dict__.setdefault("_mesh_cache", {})["resident_gen"] = 1
+        tok1 = s.arena_epoch()
+        assert tok1 != tok0
+        assert tok1[0] == tok0[0]  # the delta epoch itself did not move
+
+    def test_base_tables_drop_on_mesh_replacement(self):
+        """A resident_gen bump (mesh-patched tick that re-placed the
+        arena) must clear _base_cache exactly like a delta epoch bump —
+        and an unchanged token must keep serving the cached entry."""
+        from karpenter_provider_aws_tpu.fake.environment import Environment
+        env = Environment()
+        base = env.snapshot(make_pods(2, cpu="1", memory="1Gi"),
+                            [env.nodepool("coh")])
+        ev = TPUConsolidationEvaluator(backend="jax")
+        tab1 = ev._base_tables(base)
+        assert ev._base_tables(base) is tab1  # steady token: cache hit
+        mc = ev.solver.__dict__.setdefault("_mesh_cache", {})
+        mc["resident_gen"] = mc.get("resident_gen", 0) + 1
+        tab2 = ev._base_tables(base)
+        assert tab2 is not tab1, \
+            "mesh full placement did not invalidate _base_cache"
+        assert ev._base_tables(base) is tab2
+
+
+class TestSubsetKernelInvariants:
+    """Decode invariants the controller's verdict gates lean on."""
+
+    def test_num_nodes_matches_decoded_new_nodes(self):
+        """For eligible rounds, the lane summary's num_nodes gate must
+        equal len(result.new_nodes) of the authoritative simulate — the
+        single-replacement scenario pins the n_new == 1 edge."""
+        ev = TPUConsolidationEvaluator(backend="jax")
+        from karpenter_provider_aws_tpu.solver.route import device_alive
+        assert device_alive()
+        op, clock = _mk_operator(ev)
+        op.kube.create(NodePool("inv", template=NodePoolTemplate(
+            node_class_ref=NodeClassRef("fz-class"),
+            requirements=Requirements.from_terms(
+                [{"key": L.INSTANCE_CPU, "operator": "In",
+                  "values": ["4", "16"]}]))))
+        for p in make_pods(5, cpu="2900m", memory="1Gi", prefix="inv"):
+            op.kube.create(p)
+        op.run_until_settled(disrupt=False)
+        for p in sorted(op.kube.list("Pod"),
+                        key=lambda x: x.metadata.name)[1:]:
+            p.phase = "Succeeded"
+            op.kube.update(p)
+        cmd = op.disruption.reconcile()
+        assert cmd is not None and len(cmd.replacements) == 1
+        assert _metric(
+            op, "karpenter_solver_consolidation_device_rounds_total") > 0

@@ -461,6 +461,25 @@ def emitted(tmp_path_factory):
     dsolver.solve(denv.snapshot(
         dpods, [denv.nodepool("parity-delta-b")]))  # structural fallback
 
+    # device-native consolidation families: one whole-fleet subset
+    # dispatch on the live cluster (subset_batch + device_rounds), then
+    # a numpy-backend evaluator refusing the same round (host_fallback)
+    from karpenter_provider_aws_tpu.controllers.disruption import \
+        ReplacementQuery
+    from karpenter_provider_aws_tpu.solver.consolidation import \
+        TPUConsolidationEvaluator
+    from karpenter_provider_aws_tpu.solver.route import device_alive
+    assert device_alive()  # resolve the probe before the first round
+    cev = TPUConsolidationEvaluator(backend="jax")
+    cev.metrics = op.metrics
+    cbase = op.provisioner.build_snapshot([])
+    cq = ReplacementQuery(pods=make_pods(1, cpu="100m", prefix="csub"),
+                          gone=set(), price_cap=0)
+    assert cev.subset_solve(cbase, [cq]) is not None
+    cev_np = TPUConsolidationEvaluator(backend="numpy")
+    cev_np.metrics = op.metrics
+    assert cev_np.subset_solve(cbase, [cq]) is None
+
     # catalog membership + offering gauges at the current blacklist
     op.catalog_controller.refresh_gauges()
 
