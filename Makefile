@@ -30,6 +30,9 @@ chaoscloud:  ## the 10-seed cloud-seam chaos sweep alone
 chaos-tenant:  ## hostile-tenant isolation sweep (quiet tenant vs hammer)
 	sh hack/chaostenant.sh
 
+chaos-patch:  ## 10-seed delta-wire chaos sweep (SolvePatch degradations)
+	sh hack/chaospatch.sh
+
 fuzz-delta:  ## 10-seed mutation-sequence fuzz of the incremental encoder
 	sh hack/fuzzdelta.sh
 
@@ -42,6 +45,7 @@ benchmark:  ## the five BASELINE configs + interruption + batch dispatch
 	python bench.py --batch-solve
 	python bench.py --sidecar-batch
 	python bench.py --delta-solve
+	python bench.py --patch-wire
 	python bench.py --tenant-mix
 	python bench.py --mesh-batch
 	python bench.py --consolidate-solve --consolidate-nodes 240 --rounds 5
@@ -58,4 +62,4 @@ multichip:  ## multi-device solve: driver dryrun + mesh parity suites
 daemon:  ## run the operator against the in-memory cloud
 	python -m karpenter_provider_aws_tpu --cluster-name dev --metrics-port 8080
 
-.PHONY: test test-all scale deflake benchmark consolidate-evidence multichip daemon chart chaos chaoscloud chaos-tenant fuzz-delta fuzz-consolidate
+.PHONY: test test-all scale deflake benchmark consolidate-evidence multichip daemon chart chaos chaoscloud chaos-tenant chaos-patch fuzz-delta fuzz-consolidate

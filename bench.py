@@ -691,6 +691,183 @@ def run_delta_bench(backend="numpy", pods=5000, ticks=120, churn=0.01,
     }
 
 
+def run_patch_wire_bench(pods=2000, ticks=60, churn=0.01):
+    """The delta wire end to end: replay 1%-churn reconcile ticks over a
+    LOOPBACK sidecar twice — once on the patch path (SolvePatch: resident
+    server arena + dirty sections) and once full-frame (patch capability
+    masked) — with per-tick fingerprint identity between the two. The
+    headline is ``wire_reduction``: warm-tick request bytes full/patch
+    (the >=10x acceptance bar at 1% churn). Then the pipelined tick:
+    the same churn process replayed sequentially vs through TickPipeline
+    (encode of tick N+1 overlapped with the in-flight RPC of tick N),
+    segment-vs-segment on equal-shape segments.
+
+    Loopback caveat: client, server, and kernel share one CPU — read
+    the byte ratio and the overlap, not the absolute ms."""
+    import collections
+    import random
+
+    from karpenter_provider_aws_tpu.apis import labels as L
+    from karpenter_provider_aws_tpu.fake.environment import (Environment,
+                                                             make_pods)
+    from karpenter_provider_aws_tpu.sidecar.client import (RemoteSolver,
+                                                           TickPipeline)
+    from karpenter_provider_aws_tpu.sidecar.server import SolverServer
+    from karpenter_provider_aws_tpu.utils.metrics import Metrics
+
+    env = Environment()
+    pool = env.nodepool("bench-patch")
+    groups = []
+    for i in range(50):
+        sel = None
+        if i % 10 == 8:
+            sel = {L.CAPACITY_TYPE: "spot"}
+        elif i % 10 == 9:
+            sel = {L.ARCH: "arm64"}
+        groups.append(dict(cpu=f"{100 + (i * 7) % 400}m",
+                           memory=f"{256 + (i * 13) % 700}Mi",
+                           group=f"pw{i:03d}", node_selector=sel))
+
+    def mk(n, gi):
+        kw = dict(groups[gi % len(groups)])
+        g = kw.pop("group")
+        return make_pods(n, prefix=g, group=g, **kw)
+
+    cur = []
+    for gi in range(len(groups)):
+        cur += mk(pods // len(groups), gi)
+    rng = random.Random(17)
+    k = max(1, int(len(cur) * churn))
+
+    def next_snap(tick):
+        if tick:
+            for _ in range(k):
+                cur.pop(rng.randrange(len(cur)))
+            cur.extend(mk(k, rng.randrange(len(groups))))
+        return env.snapshot(list(cur), [pool])
+
+    # the whole replay is materialized up front so every phase (warm
+    # byte measurement, sequential segment, pipelined segment) sees the
+    # same churn process
+    n_seg = max(8, ticks // 3)
+    snaps = [next_snap(t) for t in range(ticks + 2 * n_seg)]
+
+    def wire_counter(client, attrs):
+        counts = {"bytes": 0, "calls": collections.Counter()}
+        for attr in attrs:
+            real = getattr(client, attr)
+
+            def wrap(real=real, attr=attr):
+                def call(request, timeout=None, metadata=None):
+                    counts["bytes"] += len(request)
+                    counts["calls"][attr] += 1
+                    return real(request, timeout=timeout,
+                                metadata=metadata)
+                return call
+
+            setattr(client, attr, wrap())
+        return counts
+
+    metrics = Metrics()
+    server = SolverServer().start()
+    try:
+        patch = RemoteSolver(server.address, backend="jax")
+        patch.metrics = metrics
+        patch._router.alive.mark_ok()
+        if not patch._ping() or not patch._patch_ok:
+            raise SystemExit("loopback sidecar refused the patch "
+                             "capability (Info patch flag missing)")
+        full = RemoteSolver(server.address, backend="jax")
+        full._router.alive.mark_ok()
+        full._ping()
+        full._patch_ok = False  # the full-frame control arm
+
+        pc = wire_counter(patch.client, ("_solve", "_solve_patch"))
+        fc = wire_counter(full.client, ("_solve",))
+
+        # cold solves (compile + prime) outside the measurement, then
+        # the long-running-server GC posture
+        patch.solve(snaps[0])
+        full.solve(snaps[0])
+        gc.collect()
+        gc.freeze()
+        cooldown(2.0)
+        baseline = calib_baseline()
+
+        pc["bytes"] = fc["bytes"] = 0
+        t_patch, t_full = [], []
+        identical = True
+        for snap in snaps[1:ticks]:
+            t0 = time.perf_counter()
+            fp_p = patch.solve(snap).decision_fingerprint()
+            t_patch.append((time.perf_counter() - t0) * 1e3)
+            t0 = time.perf_counter()
+            fp_f = full.solve(snap).decision_fingerprint()
+            t_full.append((time.perf_counter() - t0) * 1e3)
+            identical = identical and fp_p == fp_f
+        warm = ticks - 1
+        patch_bytes, full_bytes = pc["bytes"], fc["bytes"]
+        pp50, pp99 = _percentiles(t_patch)
+        fp50, fp99 = _percentiles(t_full)
+
+        # pipelined vs sequential on equal-shape segments of the SAME
+        # churn process (re-replaying one segment would hit the clean
+        # tier the second time and flatter whichever side went second)
+        seg_seq = snaps[ticks:ticks + n_seg]
+        seg_pipe = snaps[ticks + n_seg:ticks + 2 * n_seg]
+        phases = collections.defaultdict(float)
+        t0 = time.perf_counter()
+        fps_seq = [patch.solve(s).decision_fingerprint() for s in seg_seq]
+        seq_wall_ms = (time.perf_counter() - t0) * 1e3
+        for key in ("encode_ms", "kernel_ms", "decode_ms"):
+            phases[key] = patch.last_phase_stats.get(key, 0.0)
+        pipe = TickPipeline(patch, metrics=metrics)
+        try:
+            t0 = time.perf_counter()
+            futs = [pipe.submit(s) for s in seg_pipe]
+            fps_pipe = [f.result().decision_fingerprint() for f in futs]
+            pipe_wall_ms = (time.perf_counter() - t0) * 1e3
+        finally:
+            pipe.close()
+        # both segments oracle-checked through the full-frame arm
+        identical = identical and fps_seq == [
+            full.solve(s).decision_fingerprint() for s in seg_seq]
+        identical = identical and fps_pipe == [
+            full.solve(s).decision_fingerprint() for s in seg_pipe]
+
+        overlap_ms = 0.0
+        rendered = metrics.render()
+        for line in rendered.splitlines():
+            if line.startswith("karpenter_solver_pipeline_overlap_ms_sum"):
+                overlap_ms = float(line.rsplit(" ", 1)[1])
+        return {
+            "config": "patch-wire", "pods": pods, "warm_ticks": warm,
+            "churn_per_tick": k,
+            "identical_decisions": identical,
+            "full_wire_bytes": full_bytes,
+            "patch_wire_bytes": patch_bytes,
+            "full_bytes_per_tick": round(full_bytes / warm),
+            "patch_bytes_per_tick": round(patch_bytes / warm),
+            "wire_reduction": (round(full_bytes / patch_bytes, 1)
+                               if patch_bytes else 0.0),
+            "patch_rpc_calls": dict(pc["calls"]),
+            "patch_tick_p50_ms": pp50, "patch_tick_p99_ms": pp99,
+            "full_tick_p50_ms": fp50, "full_tick_p99_ms": fp99,
+            "pipeline_ticks": n_seg,
+            "sequential_wall_ms": round(seq_wall_ms, 1),
+            "pipelined_wall_ms": round(pipe_wall_ms, 1),
+            "pipeline_speedup": (round(seq_wall_ms / pipe_wall_ms, 2)
+                                 if pipe_wall_ms else 0.0),
+            "pipeline_overlap_ms_total": round(overlap_ms, 1),
+            "last_tick_phase_split_ms": {kk: round(vv, 2)
+                                         for kk, vv in phases.items()},
+            "calib_baseline_ms": round(baseline, 3),
+            "phases": _phase_report(patch),
+        }
+    finally:
+        server.stop(grace=1.0)
+
+
 def build_config5(env, n_pods):
     """Spot+OD price-capacity-optimized across weighted pools w/ limits."""
     from karpenter_provider_aws_tpu.apis import labels as L
@@ -1701,6 +1878,11 @@ def main():
                          "per-tick fingerprint identity")
     ap.add_argument("--ticks", type=int, default=120,
                     help="reconcile ticks for --delta-solve")
+    ap.add_argument("--patch-wire", action="store_true",
+                    help="replay 1%%-churn ticks over a loopback sidecar "
+                         "on the delta wire vs full frames: bytes on "
+                         "wire, warm p50/p99 both ways, pipelined vs "
+                         "sequential tick latency")
     ap.add_argument("--consolidate-solve", action="store_true",
                     help="whole-fleet consolidation search: a 1000-node "
                          "cluster's deletion + replacement lanes in ONE "
@@ -1754,6 +1936,10 @@ def main():
         print(json.dumps(run_delta_bench(
             backend=backend, pods=min(args.pods, 10_000),
             ticks=args.ticks)))
+        return
+    if args.patch_wire:
+        print(json.dumps(run_patch_wire_bench(
+            pods=min(args.pods, 2000), ticks=min(args.ticks, 60))))
         return
     if args.consolidate_solve:
         backend = "jax" if args.backend == "auto" else args.backend

@@ -1,0 +1,25 @@
+#!/bin/sh
+# Seeded chaos sweep for the DELTA WIRE (SolvePatch).
+#
+# Runs the patch-path fault-injection tests (tests/test_faultwire.py,
+# the `slow`-marked seed matrix) across 10 fixed seeds. Each seed
+# replays the same warm churn-tick sequence TWICE against a live
+# sidecar with the injector tearing the patch wire per its seeded
+# schedule — truncated patch replies, replies dropped AFTER the server
+# applied the sections (the duplicate-apply case), and injected stale
+# residency (FAILED_PRECONDITION) — plus the baseline transport faults.
+# The test fails if the two runs diverge in fault schedule or decision
+# fingerprints, or if ANY tick's decisions diverge from the CPU oracle:
+# every degradation must land as at most one full Solve, byte-identical
+# by construction.
+#
+# Tier-1 stays fast: these tests are excluded there by `-m 'not slow'`.
+#
+# Usage: sh hack/chaospatch.sh           # the full 10-seed sweep
+#        sh hack/chaospatch.sh -x -q    # extra pytest args pass through
+set -e
+cd "$(dirname "$0")/.."
+
+JAX_PLATFORMS=cpu exec python -m pytest \
+    "tests/test_faultwire.py::test_patch_seed_sweep_matches_oracle" \
+    -m slow -q -p no:cacheprovider "$@"

@@ -22,6 +22,13 @@ Injected fault kinds (per call, mutually exclusive):
                         (UNAVAILABLE *after* server work — the
                         retry-a-duplicate case, safe because solves are
                         pure)
+- ``stale``           — SolvePatch only: the server pretends its
+                        resident arena moved (FAILED_PRECONDITION,
+                        "stale arena version") — the client must serve
+                        the tick with ONE full Solve and re-prime. On
+                        every other RPC the draw is a clean call, so
+                        adding ``p_stale`` never perturbs a full-frame
+                        schedule.
 
 Determinism: faults are drawn from ``random.Random(seed)`` in call
 order. Keep every wire call on ONE thread (backend='jax' with the
@@ -45,8 +52,10 @@ import time
 from typing import Callable, List, Optional, Tuple
 
 #: fault kinds an injector can draw (order matters: it is the cumulative
-#: probability order used by FaultPlan.next)
-FAULT_KINDS = ("unavailable", "deadline", "latency", "truncate", "drop")
+#: probability order used by FaultPlan.next — "stale" is appended LAST
+#: with a 0.0 default so existing seeds' draw schedules are unchanged)
+FAULT_KINDS = ("unavailable", "deadline", "latency", "truncate", "drop",
+               "stale")
 
 
 def _injected_error(code, details: str):
@@ -85,12 +94,13 @@ class FaultPlan:
     def __init__(self, seed: int, p_unavailable: float = 0.15,
                  p_deadline: float = 0.1, p_latency: float = 0.1,
                  p_truncate: float = 0.1, p_drop: float = 0.1,
+                 p_stale: float = 0.0,
                  latency_ms: float = 20.0, max_consecutive: int = 2):
         import random
         self.seed = seed
         self._rng = random.Random(seed)
         self._p = (p_unavailable, p_deadline, p_latency, p_truncate,
-                   p_drop)
+                   p_drop, p_stale)
         assert sum(self._p) <= 1.0
         self.latency_ms = latency_ms
         self.max_consecutive = max_consecutive
@@ -108,12 +118,18 @@ class FaultPlan:
             if u < acc:
                 kind = k
                 break
+        if kind == "stale" and rpc != "SolvePatch":
+            # only the delta wire has a residency precondition to
+            # violate — anywhere else the draw is a clean call
+            kind = None
         if kind in ("unavailable", "deadline", "truncate", "drop"):
             if self._consecutive >= self.max_consecutive:
                 kind = None  # forced clean call: bound the failure run
             else:
                 self._consecutive += 1
-        if kind in (None, "latency"):
+        if kind in (None, "latency", "stale"):
+            # stale is rejection-class: the peer answered, definitively
+            # — it doesn't extend a delivery-failure run
             self._consecutive = 0
         return kind
 
@@ -134,7 +150,8 @@ class FaultInjector:
 
     _WRAPPED = (("_solve", "Solve"), ("_solve_pruned", "SolvePruned"),
                 ("_solve_topo", "SolveTopo"),
-                ("_solve_batch", "SolveBatch"), ("_info", "Info"))
+                ("_solve_batch", "SolveBatch"),
+                ("_solve_patch", "SolvePatch"), ("_info", "Info"))
 
     def __init__(self, client, plan: FaultPlan,
                  sleep: Callable[[float], None] = time.sleep):
@@ -161,6 +178,13 @@ class FaultInjector:
             if fault == "deadline":
                 raise _injected_error(grpc.StatusCode.DEADLINE_EXCEEDED,
                                       "injected: deadline exceeded")
+            if fault == "stale":
+                # the request never reaches the real handler: the server
+                # "lost" this client's residency (restart, eviction,
+                # version race) — the client must full-frame this tick
+                raise _injected_error(
+                    grpc.StatusCode.FAILED_PRECONDITION,
+                    "injected: stale arena version")
             if fault == "latency":
                 self._sleep(self.plan.latency_ms / 1e3)
                 return real(request, timeout=timeout, metadata=metadata)

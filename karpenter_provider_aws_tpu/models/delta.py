@@ -216,6 +216,12 @@ class DeltaEncoder:
         self.metrics = None
 
     # -- public entry --------------------------------------------------
+    def state_token(self) -> Tuple[int, int]:
+        """(epoch, version) snapshot — the coherence key the delta wire
+        and speculative pre-encode compare against: equal tokens mean
+        the encoder's arrays are exactly the ones a caller captured."""
+        return (self.epoch, self.version)
+
     def encode(self, snapshot: SchedulingSnapshot, pod_groups,
                existing: Sequence[ExistingNode]):
         """(enc, (ex_alloc, ex_used, ex_compat), SnapshotDelta) for this

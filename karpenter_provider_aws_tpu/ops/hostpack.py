@@ -296,6 +296,99 @@ def pack_batch_frame(bufs, statics: dict) -> np.ndarray:
                           + flat)
 
 
+#: patch-frame section ceiling — patch_inputs1 emits at most one section
+#: per arena field (~21 at the full layout), and a prime ships exactly
+#: one; anything larger is a protocol violation, not a workload (the
+#: bound keeps a hostile header from sizing server-side loops)
+PATCH_MAX_SECTIONS = 64
+
+#: words before the section table in a patch frame:
+#: [token | epoch0 | epoch1 | base_version | new_version | S] + statics
+PATCH_HEADER_WORDS = 6 + len(STATIC_KEYS)
+
+
+def pack_patch_frame(sections, payloads, statics: dict, *, token: int,
+                     epoch, base_version: int,
+                     new_version: int) -> np.ndarray:
+    """Dirty arena sections -> one int64 SolvePatch frame:
+    ``[token | epoch0 | epoch1 | base_version | new_version | S
+    | statics (STATIC_KEYS order) | sections (start, stop) x S
+    | payload words]``.
+
+    ``token`` names the client arena instance (so two clients of one
+    tenant never alias a resident arena), ``epoch`` is the solver's
+    ``arena_epoch()`` pair, and ``base_version`` is the version the
+    server's resident copy must currently hold (-1 = prime: exactly one
+    full-coverage section establishes or overwrites residency).
+    ``payloads`` carries one int64 array per section, section order.
+    An EMPTY section list is the clean resend: the server re-solves its
+    resident arena as-is — zero payload words on the wire."""
+    S = len(sections)
+    if S > PATCH_MAX_SECTIONS:
+        raise ValueError(f"patch sections {S} > {PATCH_MAX_SECTIONS}")
+    if S != len(payloads):
+        raise ValueError(f"{S} sections but {len(payloads)} payloads")
+    hdr = np.array([int(token), int(epoch[0]), int(epoch[1]),
+                    int(base_version), int(new_version), S],
+                   dtype=np.int64)
+    svec = np.array([int(statics.get(k, 0)) for k in STATIC_KEYS],
+                    dtype=np.int64)
+    sec = np.array([w for se in sections for w in se],
+                   dtype=np.int64).reshape(-1)
+    flat = [np.asarray(p).reshape(-1).astype(np.int64) for p in payloads]
+    for (s0, s1), p in zip(sections, flat):
+        if p.size != s1 - s0:
+            raise ValueError(f"payload size {p.size} != section "
+                             f"[{s0}, {s1})")
+    return np.concatenate([hdr, svec, sec] + flat)
+
+
+def unpack_patch_frame(frame) -> tuple:
+    """Inverse of pack_patch_frame -> (header dict, statics vector,
+    [(start, stop)], [payload arrays]). Raises ValueError on ANY
+    malformation (truncated header, section count out of bounds,
+    sections not strictly increasing and disjoint, payload size
+    mismatch) so the server rejects BEFORE statics-derived sizing and a
+    chaos-torn frame can never alias a valid patch."""
+    frame = np.asarray(frame).reshape(-1)
+    if frame.dtype != np.int64:
+        raise ValueError(f"patch frame dtype {frame.dtype} != int64")
+    if frame.size < PATCH_HEADER_WORDS:
+        raise ValueError(f"patch frame truncated: {frame.size} < header "
+                         f"{PATCH_HEADER_WORDS}")
+    hdr = dict(token=int(frame[0]), epoch=(int(frame[1]), int(frame[2])),
+               base_version=int(frame[3]), new_version=int(frame[4]))
+    S = int(frame[5])
+    if not 0 <= S <= PATCH_MAX_SECTIONS:
+        raise ValueError(f"patch sections {S} outside "
+                         f"[0, {PATCH_MAX_SECTIONS}]")
+    svec = frame[6:PATCH_HEADER_WORDS]
+    body = frame[PATCH_HEADER_WORDS:]
+    if body.size < 2 * S:
+        raise ValueError(f"patch frame truncated: {body.size} words "
+                         f"< {2 * S} section words")
+    sections = []
+    prev_stop = 0
+    for i in range(S):
+        s0, s1 = int(body[2 * i]), int(body[2 * i + 1])
+        if s0 < prev_stop or s1 <= s0:
+            raise ValueError("patch sections not strictly increasing "
+                             "and disjoint")
+        sections.append((s0, s1))
+        prev_stop = s1
+    payload = body[2 * S:]
+    want = sum(s1 - s0 for s0, s1 in sections)
+    if payload.size != want:
+        raise ValueError(f"patch payload size {payload.size} != "
+                         f"declared {want}")
+    payloads = []
+    off = 0
+    for s0, s1 in sections:
+        payloads.append(payload[off:off + (s1 - s0)])
+        off += s1 - s0
+    return hdr, svec, sections, payloads
+
+
 def unpack_batch_frame(frame) -> tuple:
     """Inverse of pack_batch_frame -> (statics dict, [item buffers]).
     Raises ValueError on ANY malformation (truncated header, offsets

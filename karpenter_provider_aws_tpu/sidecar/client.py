@@ -22,6 +22,9 @@ validation, capability) do re-raise as grpc errors from ``SolverClient``
 
 from __future__ import annotations
 
+import itertools
+import os
+import threading
 from typing import Dict, Optional
 
 import numpy as np
@@ -35,7 +38,13 @@ _SOLVE_TOPO = "/karpenter.solver.v1.Solver/SolveTopo"
 _SOLVE_PRUNED = "/karpenter.solver.v1.Solver/SolvePruned"
 _SOLVE_BATCH = "/karpenter.solver.v1.Solver/SolveBatch"
 _SOLVE_SUBSETS = "/karpenter.solver.v1.Solver/SolveSubsets"
+_SOLVE_PATCH = "/karpenter.solver.v1.Solver/SolvePatch"
 _INFO = "/karpenter.solver.v1.Solver/Info"
+
+#: client arena tokens: each RemoteSolver mints one so the server can
+#: tell two clients behind the same tenant label apart (pid-mixed so a
+#: restarted control plane never aliases its predecessor's arenas)
+_PATCH_TOKEN_SEQ = itertools.count(1)
 
 #: SolveTopo output fields that are booleans on the kernel side (the
 #: arena wire carries them as uint8; decode expects real bool masks)
@@ -87,6 +96,7 @@ class SolverClient:
         self._solve_pruned = self._channel.unary_unary(_SOLVE_PRUNED)
         self._solve_batch = self._channel.unary_unary(_SOLVE_BATCH)
         self._solve_subsets = self._channel.unary_unary(_SOLVE_SUBSETS)
+        self._solve_patch = self._channel.unary_unary(_SOLVE_PATCH)
         self._info = self._channel.unary_unary(_INFO)
 
     def _request_bytes(self, rpc: str, cache_tag, statics_key, build):
@@ -249,6 +259,28 @@ class SolverClient:
                                 payload_bytes=len(packed),
                                 base_deadline_s=self.timeout)
 
+    def solve_patch_buffer(self, frame: np.ndarray) -> Dict:
+        """The delta wire: ship a pre-built patch frame (see
+        ops/hostpack.py pack_patch_frame) and return {"out", "resident",
+        "version", "wire_bytes"}. The caller builds the frame — it owns
+        the resident pack-cache the sections slice from — so this method
+        stays stateless like every other SolverClient call."""
+        req = arena_pack(
+            {"frame": np.ascontiguousarray(frame, dtype=np.int64)})
+
+        def attempt(deadline: float) -> Dict:
+            resp = self._solve_patch(req, timeout=deadline,
+                                     metadata=self._md)
+            out = arena_unpack(resp)
+            return {"out": np.array(out["out"]),
+                    "resident": int(np.asarray(out["resident"])[0]),
+                    "version": int(np.asarray(out["version"])[0]),
+                    "wire_bytes": len(req)}
+
+        return self.policy.call(attempt, rpc="SolvePatch",
+                                payload_bytes=len(req),
+                                base_deadline_s=self.timeout)
+
     def info(self, timeout: Optional[float] = None) -> Dict[str, int]:
         def attempt(deadline: float) -> Dict[str, int]:
             out = arena_unpack(self._info(b"", timeout=deadline,
@@ -324,6 +356,22 @@ class RemoteSolver(TPUSolver):
         #: SolveSubsets (whole-fleet consolidation search) rides the
         #: same gate: the evaluator host-falls-back until the flag is up
         self._subsets_ok: "Optional[bool]" = None
+        #: SolvePatch (delta wire) rides the same gate
+        self._patch_ok: "Optional[bool]" = None
+        #: what the SERVER holds resident for this client, or None:
+        #: {"shape", "epoch", "version"} — the patch-frame state machine
+        #: compares it against the local pack cache to pick prime /
+        #: delta / clean-resend (sections=[]); any doubt clears it and
+        #: the next dispatch re-primes
+        self._patch_srv: "Optional[dict]" = None
+        self._patch_token = (os.getpid() << 20) ^ next(_PATCH_TOKEN_SEQ)
+        #: serializes encoder/pack-cache access between the tick
+        #: pipeline's background prepare and any synchronous solve
+        self._enc_lock = threading.RLock()
+        #: one speculative (snapshot, prepare-future) slot — armed by
+        #: speculate(), consumed or discarded by the next solve()/submit
+        self._spec = None
+        self._spec_pool = None
         from ..solver.route import AliveCache
         self._router.alive = AliveCache(self._ping)
         pol = getattr(self.client, "policy", None)
@@ -341,10 +389,19 @@ class RemoteSolver(TPUSolver):
             if alive is not None:
                 alive.mark_failed()
         elif new == CLOSED and old != CLOSED:
-            # half-open probe succeeded: the peer is back; the refresh
-            # probe re-measures each bucket's dev EWMA from here
-            if alive is not None:
-                alive.mark_ok()
+            # half-open probe succeeded at the TRANSPORT level — but the
+            # peer that came back may be a different build than the one
+            # that died (rolling restart, rollback), so the capability
+            # flags resolved at the original ping may now be lies that
+            # would turn every gated dispatch into an UNIMPLEMENTED
+            # round trip. Re-resolve them with a real Info RPC and let
+            # ITS verdict drive the liveness cache, instead of blessing
+            # the stale flags with a permanent mark_ok.
+            if self._ping():
+                if alive is not None:
+                    alive.mark_ok()
+            elif alive is not None:
+                alive.mark_failed()
 
     def _wire_evidence(self, served_by: str) -> dict:
         """Dispatch-evidence fields for bench engine reports: retry
@@ -398,10 +455,16 @@ class RemoteSolver(TPUSolver):
             self._pruned_ok = False
             self._batch_ok = False
             self._subsets_ok = False
+            self._patch_ok = False
+            self._patch_srv = None
             return False
         self._pruned_ok = bool(info.get("pruned", 0)) and devices == 1
         self._batch_ok = bool(info.get("batch", 0))
         self._subsets_ok = bool(info.get("subsets", 0))
+        self._patch_ok = bool(info.get("patch", 0))
+        # whatever server answered, our resident arena (if any) lived in
+        # the PREVIOUS process — re-prime rather than patch into a void
+        self._patch_srv = None
         return devices >= 1
 
     @property
@@ -481,20 +544,155 @@ class RemoteSolver(TPUSolver):
         incremental encoder's patch version pin exactly when the BYTES
         last shipped are still the bytes to ship — a rows-tier delta
         patches the buffer IN PLACE (same object, new version), so the
-        version in the tag is what forces re-serialization then."""
+        version in the tag is what forces re-serialization then. The
+        arena epoch rides too: a structural rebuild frees the old
+        buffer, and id() values recycle — (id, version) alone could
+        alias a NEW arena onto a dead tag and re-send stale bytes."""
         pc = getattr(self, "_pack_cache", None)
         if pc is not None and buf is pc.get("buf"):
-            return (id(buf), pc.get("version"))
+            return (id(buf), pc.get("version"), tuple(self.arena_epoch()))
         return None
 
+    # -- delta wire (SolvePatch) ----------------------------------------
+    def _patch_plan(self, buf: np.ndarray, statics: Dict[str, int]):
+        """Decide how this dispatch rides the delta wire, or None (full
+        Solve). Compares the local resident pack cache against what the
+        server holds for this client and picks, cheapest first:
+
+        - "clean": server is at our version — header-only resend
+        - "delta": server is one recorded transition behind — ship the
+          dirty (start, stop) sections patch_inputs1 just overwrote
+        - "prime": anything else — ship the whole arena once to
+          (re)establish residency; warm ticks then ride deltas
+
+        Returns {"frame", "kind", "version", "shape", "epoch",
+        "payload_words"}."""
+        if not self._patch_ok:
+            return None
+        pc = getattr(self, "_pack_cache", None)
+        if pc is None or pc.get("buf") is None or buf is not pc["buf"]:
+            return None
+        epoch = self.arena_epoch()
+        if epoch[0] is None:
+            return None
+        from ..ops.hostpack import PATCH_MAX_SECTIONS, pack_patch_frame
+        from .server import PATCH_LAYOUT_KEYS
+        shape = tuple(int(statics.get(k, 0)) for k in PATCH_LAYOUT_KEYS)
+        ver = int(pc.get("version") or 0)
+        srv = self._patch_srv
+        kind, base, spans = "prime", -1, None
+        if srv is not None and srv["shape"] == shape \
+                and srv["epoch"] == epoch:
+            if srv["version"] == ver:
+                # grow-loop redispatch / re-solve of the same tick: the
+                # resident copy is already exactly this buffer
+                kind, base, spans = "clean", ver, []
+            else:
+                sec = pc.get("sections")
+                if sec is not None and sec.get("base") == srv["version"] \
+                        and sec.get("to") == ver \
+                        and len(sec.get("spans") or []) \
+                        <= PATCH_MAX_SECTIONS:
+                    kind, base = "delta", srv["version"]
+                    spans = list(sec.get("spans") or [])
+        if spans is None:
+            kind, base = "prime", -1
+            spans = [(0, int(buf.size))]
+        payloads = [np.array(buf[s0:s1], copy=True) for s0, s1 in spans]
+        frame = pack_patch_frame(spans, payloads, statics,
+                                 token=self._patch_token, epoch=epoch,
+                                 base_version=base, new_version=ver)
+        # optimistic residency prediction: the pipelined prepare of tick
+        # N+1 runs while tick N's RPC is still in flight, so it must
+        # plan against where the server WILL be, not where it was — a
+        # wrong prediction (tick N failed) is caught by the server's
+        # version check and costs one full Solve, never a stale solve
+        self._patch_srv = dict(shape=shape, epoch=epoch, version=ver)
+        return dict(frame=frame, kind=kind, version=ver, shape=shape,
+                    epoch=epoch,
+                    payload_words=sum(s1 - s0 for s0, s1 in spans))
+
+    def _patch_fallback(self, reason: str) -> None:
+        if self.metrics is not None:
+            self.metrics.inc("karpenter_solver_wire_fallback_total",
+                             labels={"reason": reason})
+
+    def _dispatch_patch(self, plan: dict) -> Optional[np.ndarray]:
+        """One SolvePatch attempt. Returns the output buffer, or None
+        when the peer rejected the patch — the caller then serves this
+        tick with ONE full Solve (never a second patch). Transport
+        failure raises DeviceDispatchFailed like the full-frame path:
+        the host twin serves, no extra wire attempt against a peer the
+        policy just declared unavailable."""
+        import grpc
+        try:
+            reply = self.client.solve_patch_buffer(plan["frame"])
+        except SidecarUnavailable as e:
+            import logging
+            logging.getLogger(__name__).warning(
+                "SolvePatch RPC failed (%s); serving from the host twin",
+                e)
+            self._patch_srv = None
+            self._patch_fallback("transport")
+            self._degraded("SolvePatch")
+            raise DeviceDispatchFailed(str(e)) from e
+        except grpc.RpcError as e:
+            import logging
+            code = e.code() if hasattr(e, "code") else None
+            try:
+                details = (e.details() or "") if hasattr(e, "details") \
+                    else ""
+            except Exception:
+                details = ""
+            self._patch_srv = None
+            if code == grpc.StatusCode.UNIMPLEMENTED:
+                # the peer cannot speak this RPC anymore (rollback):
+                # stop paying a doomed round trip per tick
+                self._patch_ok = False
+                reason = "unimplemented"
+            elif code == grpc.StatusCode.FAILED_PRECONDITION:
+                reason = "stale_version" if "stale" in details \
+                    else "no_resident"
+            else:
+                reason = "rejected"
+            logging.getLogger(__name__).warning(
+                "SolvePatch %s rejected (%s: %s); this tick rides one "
+                "full Solve", plan["kind"], code or e, reason)
+            self._patch_fallback(reason)
+            return None
+        if self.metrics is not None:
+            self.metrics.inc("karpenter_solver_wire_patch_total",
+                             labels={"kind": plan["kind"]})
+            self.metrics.inc("karpenter_solver_wire_patch_bytes",
+                             value=float(reply["wire_bytes"]))
+        # resident=0: the server solved but would not hold the arena
+        # (table full of hot arenas) — keep full-framing, no error
+        # noise. On success, never REGRESS the prediction: the pipelined
+        # prepare may already have advanced _patch_srv past this tick.
+        if not reply["resident"]:
+            self._patch_srv = None
+        elif self._patch_srv is None:
+            self._patch_srv = dict(shape=plan["shape"],
+                                   epoch=plan["epoch"],
+                                   version=plan["version"])
+        self._wire_evidence("sidecar")
+        return reply["out"]
+
     def _dispatch(self, buf: np.ndarray, **statics) -> np.ndarray:
-        """Base Solve over the wire. Availability failures (retries
-        exhausted, breaker open) AND peer rejections both map to
+        """Base Solve over the wire — via the delta wire (SolvePatch)
+        when the server holds this client's arena resident, the full
+        frame otherwise. Availability failures (retries exhausted,
+        breaker open) AND peer rejections both map to
         DeviceDispatchFailed: under backend='auto' the router parks the
         bucket and serves host; backend='jax' catches it in _solve_core
         — either way the bit-identical host twin serves, never a crash,
         and no grpc.RpcError escapes this path."""
         import grpc
+        plan = self._patch_plan(buf, statics)
+        if plan is not None:
+            out = self._dispatch_patch(plan)
+            if out is not None:
+                return out
         try:
             out = self.client.solve_buffer(
                 buf, statics, cache_tag=self._resident_tag(buf))
@@ -582,6 +780,158 @@ class RemoteSolver(TPUSolver):
         self._wire_evidence("sidecar")
         return out
 
+    # -- pipelined ticks ------------------------------------------------
+    def speculate(self, snapshot) -> None:
+        """Start the delta-encode/pack walk for ``snapshot`` on the
+        background serializer thread NOW (the batcher window just
+        opened) instead of when solve() is called (the window closed).
+        solve() consumes the speculation only when handed the SAME
+        snapshot object with the encoder untouched in between —
+        anything else discards it and re-encodes, so speculation can
+        produce a wasted encode, never a stale solve."""
+        from concurrent.futures import ThreadPoolExecutor
+        if self._spec_pool is None:
+            self._spec_pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="tick-prep")
+        self._spec = (snapshot,
+                      self._spec_pool.submit(self._prepare_tick, snapshot))
+
+    def solve(self, snapshot):
+        spec, self._spec = self._spec, None
+        if spec is not None:
+            prep = spec[1].result()
+            if spec[0] is snapshot and not prep.get("monolithic") \
+                    and self._delta is not None \
+                    and self._delta.state_token() == prep["etoken"]:
+                return self._dispatch_prepared(prep)
+            # stale speculation (different snapshot, or the encoder
+            # moved underneath it): its planned patch frame never
+            # shipped, so the residency prediction points into a
+            # version hole — drop it and re-prime on the next dispatch
+            # instead of paying a guaranteed stale-version round trip
+            if not prep.get("monolithic"):
+                self._patch_srv = None
+        with self._enc_lock:
+            return super().solve(snapshot)
+
+    def _prepare_tick(self, snapshot) -> dict:
+        """Stage 1 of the pipelined tick: everything UP TO the wire —
+        delta encode, resident-arena patch, request planning — run under
+        the encoder lock on the serializer thread. Returns a prepared
+        dict whose contents are safe to dispatch while the NEXT tick's
+        prepare mutates the encoder: payloads and the arena are copied,
+        and the per-group pod lists are captured before a rows-tier
+        delta can replace them. Ineligible snapshots (topology, host-
+        only, over the device group cap, non-jax backend) return a
+        monolithic marker — the dispatch stage then runs the ordinary
+        solve under the lock."""
+        import time as _time
+        with self._enc_lock:
+            t0 = _time.perf_counter()
+            mono = {"monolithic": True, "snapshot": snapshot}
+            if not snapshot.pods or self._delta is None \
+                    or self.backend != "jax":
+                return mono
+            from ..solver.route import dev_engine_usable
+            if not dev_engine_usable(self._router):
+                return mono
+            existing = sorted(snapshot.existing_nodes,
+                              key=lambda n: n.name)
+            self._delta.metrics = self.metrics
+            enc, (ex_alloc, ex_used, ex_compat), delta = \
+                self._delta.encode(snapshot, None, existing)
+            self._last_delta = delta
+            if enc.topo_any or not enc.types \
+                    or len(enc.groups) > self._dev_group_cap(enc):
+                return mono
+            arrays, stt, buf, _ = self._arena_for(
+                enc, ex_alloc, ex_used, ex_compat, 1)
+            if buf is None:
+                return mono
+            if stt["G"] > self.dev_max_groups:
+                return mono  # pruned territory: the monolithic path owns it
+            statics = dict(T=stt["T"], D=stt["D"], Z=stt["Z"],
+                           C=stt["C"], G=stt["G"], E=stt["E"],
+                           P=stt["P"], K=stt["K"], V=stt["V"],
+                           M=stt["M"], n_max=self._bucket, F=stt["F"])
+            plan = self._patch_plan(buf, statics)
+            fuse = arrays.get("fuse")
+            prep = dict(
+                snapshot=snapshot, enc=enc, existing=existing,
+                pods_by_group=[g.pods for g in enc.groups],
+                G=len(enc.groups), E=ex_alloc.shape[0],
+                D=enc.A.shape[1], stt=dict(stt), statics=statics,
+                n_bucket=self._bucket,
+                # the dispatch stage's fallback full Solve must ship the
+                # bytes of THIS version — the resident buffer itself gets
+                # patched in place by the next prepare
+                buf_snap=np.array(buf, copy=True), plan=plan,
+                fuse=(np.array(fuse, copy=True)
+                      if fuse is not None else None),
+                tier=delta.tier, patched_rows=delta.patched_rows,
+                etoken=self._delta.state_token(),
+                encode_ms=(_time.perf_counter() - t0) * 1e3)
+            return prep
+
+    def _dispatch_prepared(self, prep: dict):
+        """Stage 2 of the pipelined tick: the wire round trip + decode,
+        off the encoder lock — free to overlap with the next tick's
+        prepare. Every failure path (patch rejected AND full Solve
+        failed, slot exhaustion) re-enters the monolithic solve under
+        the lock: the incremental encoder re-serves the same snapshot
+        from its resident state, so the retry costs a hit-tier encode,
+        and decisions stay oracle-identical by the encoder contract."""
+        import time as _time
+        if prep.get("monolithic"):
+            with self._enc_lock:
+                return super().solve(prep["snapshot"])
+        from ..ops.hostpack import unpack_outputs1
+        from ..solver.tpu import _slotmap
+        stt, statics = prep["stt"], prep["statics"]
+        n_bucket = prep["n_bucket"]
+        G, E, D = prep["G"], prep["E"], prep["D"]
+        T, Dp, Z, C = stt["T"], stt["D"], stt["Z"], stt["C"]
+        Gp, Ep, Pp = stt["G"], stt["E"], stt["P"]
+        Fu = stt["F"]
+        t_rpc = _time.perf_counter()
+        try:
+            o_buf = None
+            if prep["plan"] is not None:
+                o_buf = self._dispatch_patch(prep["plan"])
+            if o_buf is None:
+                o_buf = self._dispatch(prep["buf_snap"], **statics)
+            out = unpack_outputs1(o_buf, T, Dp, Z, C, Gp, Ep, Pp,
+                                  n_bucket)
+            if out["leftover"].sum() > 0 \
+                    and int(out["num_nodes"][0]) >= n_bucket:
+                # slot exhaustion: the monolithic path owns the grow
+                # loop (and the n_max reset discipline around it)
+                raise DeviceDispatchFailed("pipelined tick exhausted "
+                                           "new-node slots")
+        except DeviceDispatchFailed:
+            with self._enc_lock:
+                return super().solve(prep["snapshot"])
+        t_dec = _time.perf_counter()
+        self._record_dispatch(
+            kernel=("fused" if Fu > 1 else "base"), batch=1, Gp=Gp,
+            Fu=Fu, fuse=prep["fuse"] if Fu > 1 else None)
+        takes = out["takes"][:G]
+        takes = np.concatenate([takes[:, :E], takes[:, Ep:]], axis=1)
+        sm = _slotmap(E, Ep, Ep + n_bucket)
+        final = dict(
+            types=out["types"][sm], zones=out["zones"][sm],
+            ct=out["ct"][sm], pool=out["pool"][sm],
+            alive=out["alive"][sm], used=out["used"][sm][:, :D], E=E)
+        res = self._decode(prep["enc"], prep["existing"], takes,
+                           out["leftover"][:G], final,
+                           pods_by_group=prep["pods_by_group"])
+        self.last_phase_stats = dict(
+            encode_ms=prep["encode_ms"],
+            kernel_ms=(t_dec - t_rpc) * 1e3,
+            decode_ms=(_time.perf_counter() - t_dec) * 1e3,
+            cache=prep["tier"], patched_rows=prep["patched_rows"])
+        return res
+
     def _topo_lowerable(self, enc, tenc, existing) -> bool:
         """The local envelope plus the SERVER's SolveTopo bounds
         (sidecar/server.py _TOPO_STATICS_MAX): a snapshot the server
@@ -623,3 +973,103 @@ class RemoteSolver(TPUSolver):
             raise TopoKernelBail(f"sidecar SolveTopo failed: {e}") from e
         self._wire_evidence("sidecar")
         return out
+
+
+class TickPipeline:
+    """Double-buffered tick pipeline over a :class:`RemoteSolver`.
+
+    ``submit(snapshot)`` returns a Future; while tick N's RPC is in
+    flight on the dispatch thread, tick N+1's delta-encode/pack runs on
+    the serializer thread — the encode hides behind the wire round trip
+    instead of adding to it. Depth is bounded at two outstanding ticks
+    (one in flight + one preparing): a third submit blocks on the oldest
+    result, so a slow sidecar backpressures the control plane instead of
+    growing an unbounded queue. Breaker/retry/degradation semantics are
+    untouched — the ResiliencePolicy wraps each RPC attempt exactly as
+    in the synchronous path; the pipeline only changes WHEN the encode
+    work happens, never what rides the wire or how failures degrade.
+
+    ``speculate(snapshot)`` arms the solver's speculative prepare (see
+    RemoteSolver.speculate); the next submit of the SAME snapshot object
+    consumes it."""
+
+    #: outstanding ticks (in-flight RPC + preparing) before submit blocks
+    MAX_DEPTH = 2
+
+    def __init__(self, solver: RemoteSolver, metrics=None):
+        import collections
+        from concurrent.futures import ThreadPoolExecutor
+        self.solver = solver
+        self.metrics = metrics if metrics is not None else solver.metrics
+        self._prep_pool = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="tick-prep")
+        self._rpc_pool = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="tick-rpc")
+        self._inflight = collections.deque()
+
+    def speculate(self, snapshot) -> None:
+        self.solver.speculate(snapshot)
+
+    def _gauge_depth(self) -> None:
+        if self.metrics is not None:
+            self.metrics.set_gauge("karpenter_solver_pipeline_depth",
+                                   float(len(self._inflight)))
+
+    def submit(self, snapshot):
+        """Enqueue one tick; returns a Future[SolveResult]."""
+        while len(self._inflight) >= self.MAX_DEPTH:
+            self._inflight.popleft().result()
+        spec, self.solver._spec = self.solver._spec, None
+        if spec is not None and spec[0] is snapshot:
+            prep_f = spec[1]
+        else:
+            if spec is not None:
+                # discarded speculation: its planned frame never ships,
+                # so drop the residency prediction and re-prime (see
+                # RemoteSolver.solve)
+                stale = spec[1].result()
+                if not stale.get("monolithic"):
+                    self.solver._patch_srv = None
+            prep_f = self._prep_pool.submit(self.solver._prepare_tick,
+                                            snapshot)
+        fut = self._rpc_pool.submit(self._run, prep_f)
+        self._inflight.append(fut)
+        self._gauge_depth()
+        fut.add_done_callback(lambda f: self._done(f))
+        return fut
+
+    def _done(self, fut) -> None:
+        try:
+            self._inflight.remove(fut)
+        except ValueError:
+            pass
+        self._gauge_depth()
+
+    def _run(self, prep_f):
+        import time as _time
+        t0 = _time.perf_counter()
+        prep = prep_f.result()
+        waited_ms = (_time.perf_counter() - t0) * 1e3
+        res = self.solver._dispatch_prepared(prep)
+        if self.metrics is not None and not prep.get("monolithic"):
+            # how much encode wall actually hid behind the previous
+            # tick's RPC: the dispatch thread waited `waited_ms` for the
+            # prepare it consumed; the rest of the encode overlapped
+            self.metrics.observe(
+                "karpenter_solver_pipeline_overlap_ms",
+                max(0.0, prep["encode_ms"] - waited_ms))
+        return res
+
+    def solve(self, snapshot):
+        """Synchronous convenience: submit and wait."""
+        return self.submit(snapshot).result()
+
+    def drain(self) -> None:
+        """Wait for every outstanding tick to land."""
+        while self._inflight:
+            self._inflight.popleft().result()
+
+    def close(self) -> None:
+        self.drain()
+        self._prep_pool.shutdown(wait=True)
+        self._rpc_pool.shutdown(wait=True)

@@ -40,7 +40,8 @@ import numpy as np
 
 from ..native import arena_pack, arena_unpack
 from ..tenancy.admission import (DEFAULT_TENANT, RETRY_AFTER_METADATA_KEY,
-                                 ShapeClassTable, tenant_from_metadata)
+                                 PatchArenaTable, ShapeClassTable,
+                                 tenant_from_metadata)
 from ..tenancy.bucketing import bucket_statics, pad_arena, unpad_outputs
 from ..tenancy.fairness import FairQueue
 
@@ -51,7 +52,17 @@ _SOLVE_TOPO = "/karpenter.solver.v1.Solver/SolveTopo"
 _SOLVE_PRUNED = "/karpenter.solver.v1.Solver/SolvePruned"
 _SOLVE_BATCH = "/karpenter.solver.v1.Solver/SolveBatch"
 _SOLVE_SUBSETS = "/karpenter.solver.v1.Solver/SolveSubsets"
+_SOLVE_PATCH = "/karpenter.solver.v1.Solver/SolvePatch"
 _INFO = "/karpenter.solver.v1.Solver/Info"
+
+#: arena dimensions that determine the packed-input LAYOUT — the delta
+#: wire's shape-class key. n_max and V are jit statics but layout-inert,
+#: so a resident arena survives n_max growth (the client's grow loop
+#: redispatches the same buffer with a bigger bucket).
+PATCH_LAYOUT_KEYS = ("T", "D", "Z", "C", "G", "E", "P", "K", "M", "F")
+#: resident patch-arena budget (each slot holds a full packed arena, so
+#: the table is tighter than the shape-class table)
+_MAX_PATCH_ARENAS = 32
 
 #: SolvePruned statics vector order (the base-solve statics minus the
 #: minValues triple — out of the pruned kernel's scope — plus S, the
@@ -272,11 +283,16 @@ class _Handler:
     stop drains on."""
 
     def __init__(self, metrics=None, admission=None, shape_table=None,
-                 bucketing: bool = True, compile_monitor=None):
+                 bucketing: bool = True, compile_monitor=None,
+                 patch_arenas=None):
         #: the compile-cache budget — an LRU shape-class table that
         #: still answers len()/in like the set it replaced
         self._shapes_seen = shape_table if shape_table is not None \
             else ShapeClassTable(capacity=_MAX_SHAPE_CLASSES,
+                                 metrics=metrics)
+        #: server-resident arenas for the delta wire (SolvePatch)
+        self._patch_arenas = patch_arenas if patch_arenas is not None \
+            else PatchArenaTable(capacity=_MAX_PATCH_ARENAS,
                                  metrics=metrics)
         self._admission = admission
         self._bucketing = bucketing
@@ -369,8 +385,11 @@ class _Handler:
             context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED,
                           "too many distinct solve shape classes")
 
-    def _validate(self, statics, buf, context, shape_tag=(),
-                  admit: bool = True) -> Optional[dict]:
+    def _validate_statics(self, statics, context):
+        """The statics half of :meth:`_validate` — bounds-check and
+        normalize the statics vector without a buffer in hand (the
+        patch path validates section bounds against the layout size
+        before any resident bytes exist). Returns (kv, expect)."""
         import grpc
 
         from ..ops.hostpack import (STATIC_KEYS, in_layout_bool,
@@ -394,13 +413,19 @@ class _Handler:
             if not (0 <= v <= _STATICS_MAX[k]):
                 context.abort(grpc.StatusCode.INVALID_ARGUMENT,
                               f"statics.{k}={v} out of bounds")
-        if admit:
-            self._admit_shape(tuple(kv.values()) + tuple(shape_tag),
-                              context, _tenant(context))
         dims = {k: kv[k] for k in ("T", "D", "Z", "C", "G", "E", "P",
                                    "K", "M", "F")}
         expect = layout_sizes(in_layout_i64(**dims)) \
             + nwords(layout_sizes(in_layout_bool(**dims)))
+        return kv, expect
+
+    def _validate(self, statics, buf, context, shape_tag=(),
+                  admit: bool = True) -> Optional[dict]:
+        import grpc
+        kv, expect = self._validate_statics(statics, context)
+        if admit:
+            self._admit_shape(tuple(kv.values()) + tuple(shape_tag),
+                              context, _tenant(context))
         if buf.size != expect:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT,
                           f"buf size {buf.size} != layout size {expect}")
@@ -506,18 +531,27 @@ class _Handler:
         return out
 
     def solve(self, request: bytes, context) -> bytes:
+        arrays = self._request_arrays(request, context, "buf", "statics")
+        buf = arrays["buf"]
+        kv = self._validate(arrays["statics"], buf, context, admit=False)
+        o_buf = self._solve_validated(np.asarray(buf), kv, context,
+                                      _tenant(context), "Solve")
+        return arena_pack({"out": o_buf})
+
+    def _solve_validated(self, buf: np.ndarray, kv: dict, context,
+                         tenant: str, rpc: str) -> np.ndarray:
+        """The base-solve dispatch tail — bucket, admit, pad, coalesce,
+        unpad — shared by Solve and SolvePatch so a patched resident
+        arena takes EXACTLY the full-frame path from here on (the byte-
+        identity argument for the delta wire rests on this sharing)."""
         import jax
         import jax.numpy as jnp
 
         from ..ops.ffd_jax import solve_scan_packed1
-        arrays = self._request_arrays(request, context, "buf", "statics")
-        buf = arrays["buf"]
-        kv = self._validate(arrays["statics"], buf, context, admit=False)
-        tenant = _tenant(context)
         ndev = len(jax.devices())
         kvB = bucket_statics(kv) if self._bucketing else kv
         self._admit_shape(tuple(kvB.values()), context, tenant)
-        bufB = self._pad(np.asarray(buf), kv, kvB, context, "Solve")
+        bufB = self._pad(buf, kv, kvB, context, rpc)
 
         if ndev > 1:
             # mesh server: a lone request shards its ONE solve across
@@ -541,9 +575,64 @@ class _Handler:
 
         key = ("solve", ndev) + tuple(kvB.values())
         o_buf = self._dispatch_coalesced(key, bufB, context,
-                                         dispatch_many, "Solve", tenant)
-        return arena_pack({"out": unpad_outputs(np.asarray(o_buf),
-                                                kv, kvB)})
+                                         dispatch_many, rpc, tenant)
+        return unpad_outputs(np.asarray(o_buf), kv, kvB)
+
+    def solve_patch(self, request: bytes, context) -> bytes:
+        """The delta wire: apply dirty word sections against the
+        server-resident arena for (tenant, layout shape, client token,
+        arena epoch), then run the base-solve tail on the patched
+        buffer. Three frame kinds share the wire format:
+
+        - prime (base_version < 0): one full-coverage section installs
+          (or replaces) the resident arena
+        - delta: disjoint ascending sections advance base -> new version
+        - clean resend (no sections): re-solve the resident arena as-is
+
+        Any miss or version skew aborts FAILED_PRECONDITION and the
+        client degrades to ONE full Solve; a rejected prime (table full
+        of hot arenas) still solves, replying resident=0 so the client
+        keeps full-framing without error noise."""
+        import grpc
+
+        from ..ops.hostpack import unpack_patch_frame
+        arrays = self._request_arrays(request, context, "frame")
+        try:
+            hdr, svec, sections, payloads = unpack_patch_frame(
+                np.asarray(arrays["frame"]))
+        except ValueError as e:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                          f"malformed patch frame: {e}")
+        kv, expect = self._validate_statics(svec, context)
+        if sections and sections[-1][1] > expect:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                          f"patch section beyond arena "
+                          f"({sections[-1][1]} > {expect})")
+        tenant = _tenant(context)
+        akey = (tenant, tuple(kv[k] for k in PATCH_LAYOUT_KEYS),
+                hdr["token"], hdr["epoch"])
+        if hdr["base_version"] < 0:
+            if len(sections) != 1 or sections[0] != (0, expect):
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                              "prime frame must cover the whole arena")
+            buf = np.asarray(payloads[0])
+            resident = self._patch_arenas.prime(
+                akey, buf, hdr["new_version"], tenant)
+        else:
+            buf, reason = self._patch_arenas.apply(
+                akey, sections, payloads, hdr["base_version"],
+                hdr["new_version"])
+            if buf is None:
+                context.abort(grpc.StatusCode.FAILED_PRECONDITION,
+                              "no resident arena" if reason ==
+                              "no_resident" else "stale arena version")
+            resident = True
+        o_buf = self._solve_validated(buf, kv, context, tenant,
+                                      "SolvePatch")
+        return arena_pack({
+            "out": o_buf,
+            "resident": np.array([1 if resident else 0], dtype=np.int64),
+            "version": np.array([hdr["new_version"]], dtype=np.int64)})
 
     def solve_batch(self, request: bytes, context) -> bytes:
         """B same-shape solves in ONE round trip: validate the batch
@@ -862,6 +951,9 @@ class _Handler:
             "batch": np.array([1], dtype=np.int64),
             # whole-fleet consolidation subset search (SolveSubsets)
             "subsets": np.array([1], dtype=np.int64),
+            # delta wire: dirty-section patches against a server-
+            # resident arena (SolvePatch) — same gating discipline
+            "patch": np.array([1], dtype=np.int64),
             # tenancy surface: whether admission quotas are enforced,
             # whether near-miss shapes ride bucketed padding, and the
             # persistent compile cache's hit/miss counts since start —
@@ -904,6 +996,10 @@ def _generic_handler(handler: _Handler):
                 return grpc.unary_unary_rpc_method_handler(
                     handler.tracked(handler.solve_subsets,
                                     rpc="SolveSubsets"))
+            if call_details.method == _SOLVE_PATCH:
+                return grpc.unary_unary_rpc_method_handler(
+                    handler.tracked(handler.solve_patch,
+                                    rpc="SolvePatch"))
             if call_details.method == _INFO:
                 return grpc.unary_unary_rpc_method_handler(
                     handler.tracked(handler.info))

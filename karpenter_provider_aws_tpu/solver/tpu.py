@@ -1239,25 +1239,31 @@ class TPUSolver(Solver):
                 arrays["fuse"][:] = np.concatenate(
                     [fuse, np.ones(Gp - G, dtype=bool)])
                 dirtyb.append("fuse")
+        spans = []
         if (dirty64 or dirtyb) and pc["buf"] is not None:
-            patch_inputs1(pc["buf"], pc["bflat"], arrays, dirty64,
-                          dirtyb, T, Dp, Z, C, Gp, Ep, Pp, K, M, Fu)
+            spans = patch_inputs1(pc["buf"], pc["bflat"], arrays, dirty64,
+                                  dirtyb, T, Dp, Z, C, Gp, Ep, Pp, K, M,
+                                  Fu)
+        # the (start, stop) word sections just overwritten — the delta
+        # wire's payload source: the RemoteSolver ships exactly these
+        # sections over SolvePatch instead of the whole arena
+        pc["spans"] = spans
         return dirty64 + dirtyb
 
-    def _run_jax(self, enc, ex_alloc, ex_used, ex_compat):
-        from ..ops.hostpack import pack_inputs1_state, unpack_outputs1
-        D = enc.A.shape[1]
-        G, E = len(enc.groups), ex_alloc.shape[0]
-        ndev = self._dev_devices()
-        # --- resident packed arena (patched-arena wire path) -------------
-        # When the delta tier proves the shape class unchanged (same
-        # resident encoding object, same padded E bucket), the previous
-        # solve's padded arrays + packed buffer are reused: clean solves
-        # ship the very same buffer (the RemoteSolver then re-sends it
-        # without re-packing), dirty ones patch only the dirty sections
-        # (ops/hostpack.py patch_inputs1). Versioning guards host-served
-        # solves in between: a buffer lagging the encoder by more than
-        # one version is re-packed, never patched.
+    def _arena_for(self, enc, ex_alloc, ex_used, ex_compat, ndev):
+        """Resident packed arena (patched-arena wire path), extracted
+        from _run_jax so the pipelined tick's prepare stage shares it.
+        When the delta tier proves the shape class unchanged (same
+        resident encoding object, same padded E bucket), the previous
+        solve's padded arrays + packed buffer are reused: clean solves
+        ship the very same buffer (the RemoteSolver then re-sends it
+        without re-packing), dirty ones patch only the dirty sections
+        (ops/hostpack.py patch_inputs1). Versioning guards host-served
+        solves in between: a buffer lagging the encoder by more than
+        one version is re-packed, never patched. Returns
+        (arrays, stt, buf, mesh_dirty)."""
+        from ..ops.hostpack import pack_inputs1_state
+        E = ex_alloc.shape[0]
         d = self._last_delta
         dver = self._delta.version if self._delta is not None else None
         pc = self._pack_cache
@@ -1272,22 +1278,29 @@ class TPUSolver(Solver):
             arrays, stt, buf = pc["arrays"], pc["stt"], pc["buf"]
             mesh_dirty = []
             if pc["version"] != dver:
+                prev = pc["version"]
                 mesh_dirty = self._patch_pack_cache(pc, enc, ex_alloc,
                                                     ex_used, ex_compat, d)
                 pc["version"] = dver
+                # the version transition these spans carry across —
+                # the delta wire ships them only when the server's
+                # resident copy sits exactly at `base`
+                pc["sections"] = dict(base=prev, to=dver,
+                                      spans=pc.pop("spans", []))
         if arrays is None:
             arrays, stt = self._prep_device_inputs(enc, ex_alloc, ex_used,
                                                    ex_compat, ndev)
+        Gp = stt["G"]
         T, Dp, Z, C = stt["T"], stt["D"], stt["Z"], stt["C"]
-        Gp, Ep, Pp = stt["G"], stt["E"], stt["P"]
-        K, V, M, Fu = stt["K"], stt["V"], stt["M"], stt["F"]
+        Ep, Pp = stt["E"], stt["P"]
+        K, M, Fu = stt["K"], stt["M"], stt["F"]
         if buf is None and ndev <= 1:
             buf, bflat = pack_inputs1_state(arrays, T, Dp, Z, C, Gp, Ep,
                                             Pp, K, M, Fu)
             if dver is not None:
                 self._pack_cache = dict(enc=enc, arrays=arrays, stt=stt,
                                         buf=buf, bflat=bflat, ndev=ndev,
-                                        version=dver)
+                                        version=dver, sections=None)
             else:
                 self._pack_cache = None
         elif ndev > 1 and mesh_dirty is None:
@@ -1297,9 +1310,21 @@ class TPUSolver(Solver):
             if dver is not None:
                 self._pack_cache = dict(enc=enc, arrays=arrays, stt=stt,
                                         buf=None, bflat=None, ndev=ndev,
-                                        version=dver)
+                                        version=dver, sections=None)
             else:
                 self._pack_cache = None
+        return arrays, stt, buf, mesh_dirty
+
+    def _run_jax(self, enc, ex_alloc, ex_used, ex_compat):
+        from ..ops.hostpack import unpack_outputs1
+        D = enc.A.shape[1]
+        G, E = len(enc.groups), ex_alloc.shape[0]
+        ndev = self._dev_devices()
+        arrays, stt, buf, mesh_dirty = self._arena_for(
+            enc, ex_alloc, ex_used, ex_compat, ndev)
+        T, Dp, Z, C = stt["T"], stt["D"], stt["Z"], stt["C"]
+        Gp, Ep, Pp = stt["G"], stt["E"], stt["P"]
+        K, V, M, Fu = stt["K"], stt["V"], stt["M"], stt["F"]
 
         # --- bucketed new-node slots with overflow retry ------------------
         # Steady state needs far fewer than n_max slots; a small N keeps the
@@ -1388,8 +1413,15 @@ class TPUSolver(Solver):
     def _decode(self, enc: SnapshotEncoding,
                 existing: Sequence[ExistingNode],
                 takes: np.ndarray, leftover: np.ndarray,
-                final: dict) -> SolveResult:
+                final: dict, pods_by_group=None) -> SolveResult:
         E = final["E"]
+        # pods_by_group: the per-group pod LISTS this solve encoded —
+        # the pipelined tick captures them at prepare time because a
+        # rows-tier delta REPLACES g.pods for the next tick while this
+        # tick's RPC is still in flight. None (every synchronous caller)
+        # reads the live lists, which are the same objects then.
+        gpods = pods_by_group if pods_by_group is not None \
+            else [g.pods for g in enc.groups]
         assignments: Dict[str, str] = {}
         unschedulable: Dict[str, str] = {}
         #: slot -> list of pods (in canonical order)
@@ -1411,7 +1443,7 @@ class TPUSolver(Solver):
                                      c_arr.tolist()):
                 if gi != cur_g:
                     cur_g, off = gi, 0
-                chunk = groups[gi].pods[off:off + cnt]
+                chunk = gpods[gi][off:off + cnt]
                 off += cnt
                 if slot < E:
                     nm = existing[slot].name
@@ -1426,8 +1458,8 @@ class TPUSolver(Solver):
                         sp.extend(chunk)
                         slot_groups[slot].append(gi)
             for gi in np.nonzero(leftover)[0]:
-                g = groups[int(gi)]
-                for p in g.pods[len(g.pods) - int(leftover[gi]):]:
+                gp = gpods[int(gi)]
+                for p in gp[len(gp) - int(leftover[gi]):]:
                     unschedulable[p.full_name()] = \
                         "no capacity in any nodepool"
             return self._decode_nodes(enc, assignments, unschedulable,
@@ -1440,6 +1472,7 @@ class TPUSolver(Solver):
         bounds = np.searchsorted(gnz, np.arange(len(enc.groups) + 1))
         for g in enc.groups:
             off = 0
+            gp = gpods[g.index]
             # topology pours stripe pods across slots; replay their
             # placement order. Plain fills are slot-order chunks.
             placement = run_log.get(g.index)
@@ -1466,20 +1499,20 @@ class TPUSolver(Solver):
                     pos = 0
                     for slot, ln in pattern:
                         if ln == 1:
-                            chunk = g.pods[off + pos:off + d_n * k:d_n]
+                            chunk = gp[off + pos:off + d_n * k:d_n]
                         else:
                             chunk = []
                             for p_i in range(k):
                                 base = off + pos + p_i * d_n
-                                chunk.extend(g.pods[base:base + ln])
+                                chunk.extend(gp[base:base + ln])
                         place(slot, chunk)
                         pos += ln
                     off += d_n * k
                     continue
                 slot, cnt = entry
-                place(slot, g.pods[off:off + cnt])
+                place(slot, gp[off:off + cnt])
                 off += cnt
-            for p in g.pods[off:]:  # leftovers — could not be scheduled
+            for p in gp[off:]:  # leftovers — could not be scheduled
                 unschedulable[p.full_name()] = "no capacity in any nodepool"
         return self._decode_nodes(enc, assignments, unschedulable,
                                   slot_pods, slot_groups, final)

@@ -28,6 +28,8 @@ import threading
 import time
 from typing import Callable, Optional
 
+import numpy as np
+
 #: tenant label used when a client sends no x-solver-tenant metadata —
 #: anonymous callers share one bucket, so a fleet of label-less clients
 #: is ONE tenant to the fairness and quota machinery
@@ -222,6 +224,119 @@ class ShapeClassTable:
         with self._mu:
             out: dict = collections.defaultdict(int)
             for tenant, _ in self._entries.values():
+                out[tenant] += 1
+            return dict(out)
+
+
+class PatchArenaTable:
+    """Server-resident arenas for the delta wire (``SolvePatch``).
+
+    Each entry is a full packed input arena plus the delta version it
+    reflects, keyed by (tenant, shape-class, client token, arena epoch).
+    Same budget shape as :class:`ShapeClassTable`: bounded capacity,
+    LRU eviction attributed to the admitting tenant, and an actively-hot
+    arena is never evicted (``min_idle_s``) — but arenas additionally
+    age out after ``ttl_s`` so a departed client's buffers don't pin
+    memory forever. Misses/evictions are not errors: the client's next
+    patch gets FAILED_PRECONDITION and degrades to one full Solve.
+    """
+
+    def __init__(self, capacity: int = 32, min_idle_s: float = 5.0,
+                 ttl_s: float = 600.0, metrics=None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.capacity = capacity
+        self.min_idle_s = min_idle_s
+        self.ttl_s = ttl_s
+        self.metrics = metrics
+        self._clock = clock
+        self._mu = threading.Lock()
+        #: key -> [tenant, last_use, buf, version]; iteration order is
+        #: the LRU order (re-inserted on touch, like ShapeClassTable)
+        self._entries: "collections.OrderedDict" = collections.OrderedDict()
+
+    def __len__(self) -> int:
+        with self._mu:
+            return len(self._entries)
+
+    def _evict_locked(self, now: float) -> bool:
+        """Drop expired entries; then, if still full, the LRU entry —
+        unless it is hot. True if a slot is free afterwards."""
+        for k in [k for k, e in self._entries.items()
+                  if now - e[1] >= self.ttl_s]:
+            self._drop_locked(k, "ttl")
+        if len(self._entries) < self.capacity:
+            return True
+        lru_key = next(iter(self._entries))
+        if now - self._entries[lru_key][1] < self.min_idle_s:
+            return False
+        self._drop_locked(lru_key, "lru")
+        return True
+
+    def _drop_locked(self, key, reason: str):
+        tenant = self._entries.pop(key)[0]
+        if self.metrics is not None:
+            self.metrics.inc(
+                "karpenter_solver_wire_resident_evictions_total",
+                labels={"tenant": tenant, "reason": reason})
+
+    def prime(self, key, buf, version: int,
+              tenant: str = DEFAULT_TENANT) -> bool:
+        """Install (or replace) the resident arena for ``key``. False
+        means the table is full of hot arenas and the client should keep
+        using the full-frame path."""
+        now = self._clock()
+        with self._mu:
+            if key not in self._entries and not self._evict_locked(now):
+                return False
+            self._entries[key] = [tenant, now, np.array(buf, copy=True),
+                                  int(version)]
+            self._entries.move_to_end(key)
+            return True
+
+    def apply(self, key, sections, payloads, base_version: int,
+              new_version: int):
+        """Patch the resident arena in place and return a COPY of the
+        patched buffer (the caller dispatches the copy, so a concurrent
+        patch can never mutate an in-flight solve's input).
+
+        Returns (buf, reason): buf is None when the patch cannot be
+        applied — reason is "no_resident" (miss/evicted) or
+        "stale_version" (the resident arena is not at base_version).
+        An empty section list is a clean resend: the resident buffer is
+        re-solved as-is (header-only wire cost).
+        """
+        now = self._clock()
+        with self._mu:
+            ent = self._entries.get(key)
+            if ent is None:
+                return None, "no_resident"
+            if now - ent[1] >= self.ttl_s:
+                # aged out: same verdict as an eviction between ticks
+                self._drop_locked(key, "ttl")
+                return None, "no_resident"
+            if base_version >= 0 and ent[3] != base_version:
+                self._drop_locked(key, "stale")
+                return None, "stale_version"
+            buf = ent[2]
+            for (s0, s1), pl in zip(sections, payloads):
+                if s1 > buf.size:
+                    self._drop_locked(key, "stale")
+                    return None, "stale_version"
+                buf[s0:s1] = pl
+            ent[1] = now
+            ent[3] = int(new_version)
+            self._entries.move_to_end(key)
+            return np.array(buf, copy=True), None
+
+    def version_of(self, key):
+        with self._mu:
+            ent = self._entries.get(key)
+            return None if ent is None else ent[3]
+
+    def per_tenant(self) -> dict:
+        with self._mu:
+            out: dict = collections.defaultdict(int)
+            for tenant, _, _, _ in self._entries.values():
                 out[tenant] += 1
             return dict(out)
 

@@ -112,6 +112,59 @@ def _run_chaos(address, env, seed, n_solves=6, plan_kwargs=None,
     return fps, log
 
 
+def _patch_churn_snaps(env, tag, n_ticks, churn=2, seed=0):
+    """Warm-tick fixture for the delta wire: ONE stable pool, a stable
+    population of pod groups, `churn` pods swapped per tick — the regime
+    where SolvePatch carries the traffic (a prime then deltas)."""
+    pool = env.nodepool(f"{tag}pool")
+    sigs = [dict(cpu=f"{100 + (i * 7) % 400}m",
+                 memory=f"{256 + (i * 13) % 700}Mi",
+                 group=f"{tag}g{i:03d}") for i in range(10)]
+    rng = random.Random(seed)
+
+    def mk(gi):
+        return make_pods(1, cpu=sigs[gi]["cpu"],
+                         memory=sigs[gi]["memory"],
+                         prefix=sigs[gi]["group"],
+                         group=sigs[gi]["group"])
+
+    cur = []
+    for gi in range(len(sigs)):
+        for _ in range(3):
+            cur.extend(mk(gi))
+    snaps = [env.snapshot(list(cur), [pool])]
+    for _ in range(n_ticks - 1):
+        for _ in range(churn):
+            cur.pop(rng.randrange(len(cur)))
+            cur.extend(mk(rng.randrange(len(sigs))))
+        snaps.append(env.snapshot(list(cur), [pool]))
+    return snaps
+
+
+def _run_patch_chaos(address, env, seed, n_ticks=8, plan_kwargs=None,
+                     snaps=None):
+    """One chaos replay on the DELTA WIRE: warm churn ticks against a
+    patch-capable server, every tick fingerprint-checked against the
+    oracle. Capability is resolved BEFORE the injector installs so the
+    Info round trip doesn't consume a draw."""
+    remote = _chaos_remote(address, seed)
+    assert remote._ping() and remote._patch_ok
+    plan = FaultPlan(seed, **(plan_kwargs or {}))
+    oracle = CPUSolver()
+    if snaps is None:
+        snaps = _patch_churn_snaps(env, f"pc{seed}", n_ticks, seed=seed)
+    fps = []
+    with FaultInjector(remote.client, plan) as inj:
+        for snap in snaps:
+            fp = remote.solve(snap).decision_fingerprint()
+            assert fp == oracle.solve(snap).decision_fingerprint(), \
+                f"patch-path decisions diverged from the oracle " \
+                f"(seed {seed})"
+            fps.append(fp)
+        log = list(inj.log)
+    return fps, log
+
+
 class TestFaultPlan:
     def test_schedule_is_seeded(self):
         a = FaultPlan(9)
@@ -144,9 +197,16 @@ class TestChaosWire:
         appeared in the schedule."""
         kwargs = {f"p_{k}": 0.0 for k in FAULT_KINDS}
         kwargs[f"p_{kind}"] = 0.5
-        fps, log = _run_chaos(server.address, env, seed=13, n_solves=3,
-                              plan_kwargs=kwargs)
-        assert len(fps) == 3
+        if kind == "stale":
+            # stale only exists on the delta wire: replay warm churn
+            # ticks so SolvePatch carries the traffic
+            fps, log = _run_patch_chaos(server.address, env, seed=13,
+                                        n_ticks=4, plan_kwargs=kwargs)
+            assert len(fps) == 4
+        else:
+            fps, log = _run_chaos(server.address, env, seed=13,
+                                  n_solves=3, plan_kwargs=kwargs)
+            assert len(fps) == 3
         assert any(f == kind for _, _, f in log), \
             f"schedule never drew {kind}: {log}"
 
@@ -461,3 +521,69 @@ def test_batch_seed_sweep_matches_oracle(server, env, seed):
         res = remote.solve_batch(snaps)
     assert [r.decision_fingerprint() for r in res] == refs, \
         f"seed {seed}: a batch caller diverged from the oracle"
+
+
+class TestPatchWireChaos:
+    """Tentpole chaos bar for the delta wire: every patch-path
+    degradation — torn reply, reply lost after the server applied the
+    sections, injected stale residency — lands as AT MOST one full
+    Solve with decisions fingerprint-identical to the CPU oracle."""
+
+    def test_mixed_patch_chaos_is_deterministic_and_exact(self, server,
+                                                          env):
+        kwargs = dict(p_unavailable=0.1, p_deadline=0.05, p_latency=0.1,
+                      p_truncate=0.15, p_drop=0.1, p_stale=0.25)
+        snaps = _patch_churn_snaps(env, "pcx", 8, seed=3)
+        fps1, log1 = _run_patch_chaos(server.address, env, seed=7,
+                                      plan_kwargs=kwargs, snaps=snaps)
+        fps2, log2 = _run_patch_chaos(server.address, env, seed=7,
+                                      plan_kwargs=kwargs, snaps=snaps)
+        assert log1 == log2, "patch fault schedule was not deterministic"
+        assert fps1 == fps2
+        assert any(rpc == "SolvePatch" for _, rpc, _ in log1), \
+            "the delta wire never carried a tick"
+        assert any(f != "ok" for _, _, f in log1)  # chaos actually ran
+
+    def test_duplicate_patch_after_drop_cannot_double_apply(self, server,
+                                                            env):
+        """`drop` on SolvePatch is the nastiest case: the server APPLIED
+        the sections, then the reply died. The policy's retry re-sends
+        the same frame — the server's version check refuses the
+        duplicate (stale) and the tick degrades to one full Solve. A
+        delta is never applied twice; decisions stay oracle-identical
+        (asserted inside the runner)."""
+        kwargs = {f"p_{k}": 0.0 for k in FAULT_KINDS}
+        kwargs["p_drop"] = 0.5
+        fps, log = _run_patch_chaos(server.address, env, seed=23,
+                                    n_ticks=6, plan_kwargs=kwargs)
+        assert any(rpc == "SolvePatch" and f == "drop"
+                   for _, rpc, f in log), \
+            "the schedule never dropped a SolvePatch reply"
+
+    def test_truncated_patch_reply_degrades_cleanly(self, server, env):
+        """A torn SolvePatch reply fails the arena decode client-side;
+        the retry hits the already-advanced resident version and the
+        tick full-frames — fingerprints unchanged."""
+        kwargs = {f"p_{k}": 0.0 for k in FAULT_KINDS}
+        kwargs["p_truncate"] = 0.5
+        fps, log = _run_patch_chaos(server.address, env, seed=31,
+                                    n_ticks=6, plan_kwargs=kwargs)
+        assert any(rpc == "SolvePatch" and f == "truncate"
+                   for _, rpc, f in log)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", CHAOS_SEEDS)
+def test_patch_seed_sweep_matches_oracle(server, env, seed):
+    """The CI sweep (hack/chaospatch.sh): mixed chaos on the delta wire
+    under each fixed seed, twice — identical fault schedules, identical
+    decisions, every tick oracle-checked inside the runner."""
+    kwargs = dict(p_unavailable=0.1, p_deadline=0.05, p_latency=0.1,
+                  p_truncate=0.15, p_drop=0.1, p_stale=0.2)
+    snaps = _patch_churn_snaps(env, f"ps{seed}", 8, seed=seed)
+    fps1, log1 = _run_patch_chaos(server.address, env, seed,
+                                  plan_kwargs=kwargs, snaps=snaps)
+    fps2, log2 = _run_patch_chaos(server.address, env, seed,
+                                  plan_kwargs=kwargs, snaps=snaps)
+    assert log1 == log2, f"seed {seed}: nondeterministic patch schedule"
+    assert fps1 == fps2, f"seed {seed}: nondeterministic decisions"

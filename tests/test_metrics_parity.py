@@ -461,6 +461,43 @@ def emitted(tmp_path_factory):
     dsolver.solve(denv.snapshot(
         dpods, [denv.nodepool("parity-delta-b")]))  # structural fallback
 
+    # delta-wire + pipelined-tick families: a live sidecar holding a
+    # resident patch arena. Tick 0 primes, tick 1 ships a delta (patch
+    # total/bytes); a server-side version perturbation makes tick 2's
+    # delta stale — the server drops the resident (eviction{stale}) and
+    # the client degrades to one full Solve (fallback{stale_version});
+    # two pipelined ticks land the depth gauge + overlap histogram
+    from karpenter_provider_aws_tpu.sidecar.client import TickPipeline
+    penv = _DeltaEnv()
+    ppool = penv.nodepool("parity-patch")
+    ppods = make_pods(9, cpu="500m", memory="1Gi", prefix="pw",
+                      group="pw")
+
+    def _ptick(i):
+        pods = ppods[i:] + make_pods(i, cpu="500m", memory="1Gi",
+                                     prefix=f"pw-c{i}", group="pw")
+        return penv.snapshot(pods, [ppool])
+
+    _psrv = SolverServer(metrics=op.metrics).start()
+    try:
+        premote = RemoteSolver(_psrv.address, n_max=64, backend="jax")
+        premote.metrics = op.metrics
+        premote._router.alive.mark_ok()
+        assert premote._ping()
+        premote.solve(_ptick(0))            # patch {kind: prime}
+        premote.solve(_ptick(1))            # patch {kind: delta} + bytes
+        for _ent in _psrv._handler._patch_arenas._entries.values():
+            _ent[3] += 7                    # server-side version skew
+        premote.solve(_ptick(2))  # eviction{stale} + fallback{stale_version}
+        pipe = TickPipeline(premote, metrics=op.metrics)
+        try:
+            pipe.submit(_ptick(3)).result()  # depth gauge + overlap
+            pipe.submit(_ptick(4)).result()
+        finally:
+            pipe.close()
+    finally:
+        _psrv.stop()
+
     # device-native consolidation families: one whole-fleet subset
     # dispatch on the live cluster (subset_batch + device_rounds), then
     # a numpy-backend evaluator refusing the same round (host_fallback)

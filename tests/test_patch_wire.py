@@ -1,0 +1,655 @@
+"""Delta wire (SolvePatch) + pipelined tick tests.
+
+The tentpole contract: warm ticks ship only the dirty (start, stop)
+word sections the incremental packer just overwrote, against a
+server-resident arena — and EVERY reply is byte-identical to the full
+Solve path by construction, because the server's patch handler feeds
+the reassembled arena into the exact same validated dispatch tail.
+Anything that breaks residency (eviction, version skew, restart,
+malformed frame) degrades to ONE full Solve, fingerprint-identical to
+the CPU oracle. These tests pin that contract from the codec up
+through the pipelined controller path.
+"""
+
+import random
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from karpenter_provider_aws_tpu.fake.environment import Environment, make_pods
+from karpenter_provider_aws_tpu.ops.hostpack import (PATCH_HEADER_WORDS,
+                                                     PATCH_MAX_SECTIONS,
+                                                     STATIC_KEYS,
+                                                     pack_patch_frame,
+                                                     unpack_patch_frame)
+from karpenter_provider_aws_tpu.sidecar import RemoteSolver, SolverServer
+from karpenter_provider_aws_tpu.sidecar.client import TickPipeline
+from karpenter_provider_aws_tpu.solver import CPUSolver
+from karpenter_provider_aws_tpu.tenancy.admission import PatchArenaTable
+from karpenter_provider_aws_tpu.utils.metrics import Metrics
+
+
+@pytest.fixture(scope="module")
+def env():
+    return Environment()
+
+
+@pytest.fixture()
+def server():
+    s = SolverServer().start()
+    yield s
+    s.stop()
+
+
+def _remote(address, **kw):
+    r = RemoteSolver(address, n_max=64, backend="jax", **kw)
+    r._router.alive.mark_ok()
+    assert r._ping()
+    return r
+
+
+_SIG_SEQ = [0]
+
+
+def _churn_snaps(env, n_ticks, churn=2, seed=17, prefix=None):
+    """Warm-tick replay fixture: a stable population of pod groups with
+    `churn` pods swapped per tick — the regime where the incremental
+    packer's dirty sections are a tiny fraction of the arena."""
+    if prefix is None:
+        _SIG_SEQ[0] += 1
+        prefix = f"pw{_SIG_SEQ[0]}"
+    pool = env.nodepool(prefix)
+    sigs = [dict(cpu=f"{100 + (i * 7) % 400}m",
+                 memory=f"{256 + (i * 13) % 700}Mi",
+                 group=f"{prefix}g{i:03d}") for i in range(12)]
+    rng = random.Random(seed)
+
+    def mk(gi):
+        return make_pods(1, cpu=sigs[gi]["cpu"], memory=sigs[gi]["memory"],
+                         prefix=sigs[gi]["group"], group=sigs[gi]["group"])
+
+    cur = []
+    for gi in range(len(sigs)):
+        for _ in range(3):
+            cur.extend(mk(gi))
+    snaps = [env.snapshot(list(cur), [pool])]
+    for _ in range(n_ticks - 1):
+        for _ in range(churn):
+            cur.pop(rng.randrange(len(cur)))
+            cur.extend(mk(rng.randrange(len(sigs))))
+        snaps.append(env.snapshot(list(cur), [pool]))
+    return snaps
+
+
+def _fingerprints(results):
+    return [r.decision_fingerprint() for r in results]
+
+
+def _oracle_prints(snaps):
+    oracle = CPUSolver()
+    return [oracle.solve(s).decision_fingerprint() for s in snaps]
+
+
+# ---------------------------------------------------------------------------
+# codec
+
+
+class TestPatchFrameCodec:
+    def _statics(self):
+        return {k: i + 1 for i, k in enumerate(STATIC_KEYS)}
+
+    def test_round_trip(self):
+        spans = [(0, 4), (10, 13)]
+        payloads = [np.arange(4, dtype=np.int64),
+                    np.arange(3, dtype=np.int64) + 100]
+        frame = pack_patch_frame(spans, payloads, self._statics(),
+                                 token=77, epoch=(3, 1), base_version=5,
+                                 new_version=6)
+        hdr, svec, sections, outp = unpack_patch_frame(frame)
+        assert hdr == dict(token=77, epoch=(3, 1), base_version=5,
+                           new_version=6)
+        assert list(svec) == [self._statics()[k] for k in STATIC_KEYS]
+        assert sections == spans
+        for a, b in zip(outp, payloads):
+            assert np.array_equal(a, b)
+
+    def test_header_only_clean_resend(self):
+        frame = pack_patch_frame([], [], self._statics(), token=1,
+                                 epoch=(0, 0), base_version=2,
+                                 new_version=2)
+        assert frame.size == PATCH_HEADER_WORDS
+        hdr, _, sections, payloads = unpack_patch_frame(frame)
+        assert sections == [] and payloads == []
+
+    @pytest.mark.parametrize("mutate", [
+        lambda f: f[:PATCH_HEADER_WORDS - 1],           # truncated header
+        lambda f: f[:-1],                               # truncated payload
+        lambda f: np.concatenate([f, f[-1:]]),          # trailing garbage
+        lambda f: f.astype(np.float64),                 # wrong dtype
+    ])
+    def test_malformed_frames_raise(self, mutate):
+        frame = pack_patch_frame([(0, 4)], [np.arange(4, dtype=np.int64)],
+                                 self._statics(), token=1, epoch=(0, 0),
+                                 base_version=-1, new_version=0)
+        with pytest.raises(ValueError):
+            unpack_patch_frame(mutate(frame))
+
+    def test_section_count_and_order_guards(self):
+        f = pack_patch_frame([(0, 2)], [np.zeros(2, dtype=np.int64)],
+                             self._statics(), token=1, epoch=(0, 0),
+                             base_version=0, new_version=1)
+        bad_s = np.array(f, copy=True)
+        bad_s[5] = PATCH_MAX_SECTIONS + 1
+        with pytest.raises(ValueError):
+            unpack_patch_frame(bad_s)
+        # overlapping / non-ascending sections
+        g = pack_patch_frame([(0, 2), (4, 6)],
+                             [np.zeros(2, dtype=np.int64)] * 2,
+                             self._statics(), token=1, epoch=(0, 0),
+                             base_version=0, new_version=1)
+        h = PATCH_HEADER_WORDS
+        bad_o = np.array(g, copy=True)
+        bad_o[h:h + 4] = [4, 6, 0, 2]
+        with pytest.raises(ValueError):
+            unpack_patch_frame(bad_o)
+
+    def test_too_many_sections_rejected_at_pack(self):
+        spans = [(i * 2, i * 2 + 1) for i in range(PATCH_MAX_SECTIONS + 1)]
+        pays = [np.zeros(1, dtype=np.int64) for _ in spans]
+        with pytest.raises(ValueError):
+            pack_patch_frame(spans, pays, self._statics(), token=1,
+                             epoch=(0, 0), base_version=0, new_version=1)
+
+
+# ---------------------------------------------------------------------------
+# server-resident arena table
+
+
+class TestPatchArenaTable:
+    def test_prime_apply_version_walk(self):
+        t = PatchArenaTable(capacity=4)
+        buf = np.arange(16, dtype=np.int64)
+        assert t.prime("k", buf, 1, "default")
+        got, err = t.apply("k", [(2, 5)],
+                           [np.array([-1, -2, -3], dtype=np.int64)], 1, 2)
+        assert err is None
+        want = np.arange(16, dtype=np.int64)
+        want[2:5] = [-1, -2, -3]
+        assert np.array_equal(got, want)
+        assert t.version_of("k") == 2
+        # the returned buffer is a COPY: later patches can't mutate it
+        t.apply("k", [(0, 1)], [np.array([99], dtype=np.int64)], 2, 3)
+        assert got[0] == 0
+
+    def test_stale_version_drops_entry(self):
+        m = Metrics()
+        t = PatchArenaTable(capacity=4, metrics=m)
+        t.prime("k", np.zeros(8, dtype=np.int64), 5, "tenA")
+        got, err = t.apply("k", [], [], 4, 6)  # server is at 5, not 4
+        assert got is None and err == "stale_version"
+        # entry dropped: the next apply is a clean miss, not a loop
+        got, err = t.apply("k", [], [], 5, 6)
+        assert got is None and err == "no_resident"
+        text = m.render()
+        assert "karpenter_solver_wire_resident_evictions_total" in text
+        assert 'reason="stale"' in text and 'tenant="tenA"' in text
+
+    def test_lru_eviction_spares_hot_arenas(self):
+        now = [0.0]
+        m = Metrics()
+        t = PatchArenaTable(capacity=2, min_idle_s=5.0, ttl_s=600.0,
+                            metrics=m, clock=lambda: now[0])
+        t.prime("a", np.zeros(4, dtype=np.int64), 1, "t1")
+        t.prime("b", np.zeros(4, dtype=np.int64), 1, "t2")
+        # both hot (idle < min_idle_s): a third prime is REFUSED, not
+        # an eviction of someone's in-flight arena
+        assert not t.prime("c", np.zeros(4, dtype=np.int64), 1, "t3")
+        now[0] = 10.0
+        t.apply("b", [], [], 1, 1)  # touch b
+        assert t.prime("c", np.zeros(4, dtype=np.int64), 1, "t3")
+        assert t.version_of("a") is None  # LRU victim
+        assert t.version_of("b") == 1
+        assert 'reason="lru"' in m.render()
+
+    def test_ttl_expiry(self):
+        now = [0.0]
+        m = Metrics()
+        t = PatchArenaTable(capacity=4, ttl_s=60.0, metrics=m,
+                            clock=lambda: now[0])
+        t.prime("k", np.zeros(4, dtype=np.int64), 1, "t1")
+        now[0] = 61.0
+        got, err = t.apply("k", [], [], 1, 1)
+        assert got is None and err == "no_resident"
+        assert 'reason="ttl"' in m.render()
+
+    def test_out_of_bounds_section_is_stale(self):
+        t = PatchArenaTable(capacity=2)
+        t.prime("k", np.zeros(4, dtype=np.int64), 1, "t1")
+        got, err = t.apply("k", [(2, 9)],
+                           [np.zeros(7, dtype=np.int64)], 1, 2)
+        assert got is None and err == "stale_version"
+        assert t.version_of("k") is None
+
+
+# ---------------------------------------------------------------------------
+# loopback wire parity
+
+
+class TestPatchWireParity:
+    def test_warm_ticks_ride_deltas_fingerprint_identical(self, env,
+                                                          server):
+        snaps = _churn_snaps(env, 10, seed=17)
+        remote = _remote(server.address)
+        m = Metrics()
+        remote.metrics = m
+        prints = _fingerprints([remote.solve(s) for s in snaps])
+        assert prints == _oracle_prints(snaps)
+        text = m.render()
+        assert 'karpenter_solver_wire_patch_total{kind="prime"} 1' in text
+        # every warm tick rode the delta wire
+        assert 'kind="delta"' in text
+        assert "karpenter_solver_wire_fallback_total" not in text
+
+    def test_eviction_mid_replay_degrades_to_one_full_solve(self, env,
+                                                            server):
+        snaps = _churn_snaps(env, 6, seed=23)
+        remote = _remote(server.address)
+        m = Metrics()
+        remote.metrics = m
+        res = []
+        for i, s in enumerate(snaps):
+            if i == 3:  # server loses the arena between ticks
+                server._handler._patch_arenas._entries.clear()
+            res.append(remote.solve(s))
+        assert _fingerprints(res) == _oracle_prints(snaps)
+        text = m.render()
+        assert 'reason="no_resident"' in text
+        # residency re-established: a second prime follows the fallback
+        assert 'kind="prime"} 2' in text
+
+    def test_version_skew_degrades_to_one_full_solve(self, env, server):
+        snaps = _churn_snaps(env, 6, seed=31)
+        remote = _remote(server.address)
+        m = Metrics()
+        remote.metrics = m
+        res = []
+        for i, s in enumerate(snaps):
+            if i == 3:
+                # the SERVER's resident version drifts (as a lost reply
+                # or a concurrent writer would leave it): the client's
+                # delta no longer applies — FAILED_PRECONDITION, one
+                # full Solve, re-prime
+                for ent in \
+                        server._handler._patch_arenas._entries.values():
+                    ent[3] += 7
+            res.append(remote.solve(s))
+        assert _fingerprints(res) == _oracle_prints(snaps)
+        assert 'reason="stale_version"' in m.render()
+
+    def test_patch_disabled_without_capability_flag(self, env):
+        """A server whose Info omits the patch flag never receives
+        SolvePatch — the client full-frames every tick."""
+        from karpenter_provider_aws_tpu.native import arena_pack, arena_unpack
+        srv = SolverServer().start()
+        try:
+            orig_info = srv._handler.info
+
+            def legacy_info(request, context):
+                d = arena_unpack(orig_info(request, context))
+                d.pop("patch", None)
+                return arena_pack(d)
+
+            srv._handler.info = legacy_info
+            remote = _remote(srv.address)
+            assert remote._patch_ok is False
+            calls = {"n": 0}
+            orig = remote.client._solve_patch
+
+            def counting(*a, **k):
+                calls["n"] += 1
+                return orig(*a, **k)
+
+            remote.client._solve_patch = counting
+            snaps = _churn_snaps(env, 4, seed=3)
+            prints = _fingerprints([remote.solve(s) for s in snaps])
+            assert prints == _oracle_prints(snaps)
+            assert calls["n"] == 0, "legacy server received SolvePatch"
+        finally:
+            srv.stop()
+
+    def test_tenant_isolation_of_resident_arenas(self, env, server):
+        """Two tenants with identical shapes: each gets its own resident
+        arena (keyed by tenant + token), neither sees the other's
+        bytes, both match the oracle."""
+        snaps_a = _churn_snaps(env, 4, seed=7)
+        snaps_b = _churn_snaps(env, 4, seed=11)
+        ra = _remote(server.address, tenant="alpha")
+        rb = _remote(server.address, tenant="beta")
+        res_a, res_b = [], []
+        for sa, sb in zip(snaps_a, snaps_b):
+            res_a.append(ra.solve(sa))
+            res_b.append(rb.solve(sb))
+        assert _fingerprints(res_a) == _oracle_prints(snaps_a)
+        assert _fingerprints(res_b) == _oracle_prints(snaps_b)
+        tenants = {k[0] for k in
+                   server._handler._patch_arenas._entries}
+        assert {"alpha", "beta"} <= tenants
+
+
+# ---------------------------------------------------------------------------
+# satellite 1: request-residency tag invalidation
+
+
+class TestResidentTag:
+    def test_tag_changes_when_version_moves(self, env, server):
+        snaps = _churn_snaps(env, 3, seed=41)
+        remote = _remote(server.address)
+        tags = []
+        for s in snaps:
+            remote.solve(s)
+            pc = remote._pack_cache
+            tags.append(remote._resident_tag(pc["buf"]))
+        # same arena object across warm ticks, but the tag must move
+        # with the version — identical tags would let the wire cache
+        # serve stale bytes
+        assert len({t for t in tags if t is not None}) == len(
+            [t for t in tags if t is not None])
+
+    def test_tag_includes_epoch(self, env, server):
+        snaps = _churn_snaps(env, 2, seed=43)
+        remote = _remote(server.address)
+        remote.solve(snaps[0])
+        pc = remote._pack_cache
+        tag = remote._resident_tag(pc["buf"])
+        assert tag is not None and tag[2] == tuple(remote.arena_epoch())
+
+    def test_foreign_buffer_gets_no_tag(self, env, server):
+        snaps = _churn_snaps(env, 2, seed=47)
+        remote = _remote(server.address)
+        remote.solve(snaps[0])
+        assert remote._resident_tag(
+            np.zeros(8, dtype=np.int64)) is None
+
+
+# ---------------------------------------------------------------------------
+# satellite 2: capability re-ping on breaker recovery
+
+
+class TestCapabilityRePing:
+    def test_downgraded_server_refreshes_flags_on_half_open_close(
+            self, env):
+        """A rolling restart replaces the sidecar with a build that no
+        longer speaks SolvePatch/SolveBatch. When the breaker's
+        half-open probe closes the circuit, the client must re-resolve
+        the capability flags from the NEW peer — stale True flags would
+        turn every gated dispatch into an UNIMPLEMENTED round trip."""
+        from karpenter_provider_aws_tpu.native import arena_pack, arena_unpack
+        from karpenter_provider_aws_tpu.sidecar.resilience import (
+            CircuitBreaker, ResiliencePolicy, RetryPolicy)
+        srv = SolverServer().start()
+        try:
+            policy = ResiliencePolicy(
+                retry=RetryPolicy(max_attempts=2, backoff_base_s=0.001,
+                                  backoff_cap_s=0.01),
+                breaker=CircuitBreaker(threshold=3, cooldown_s=0.02))
+            remote = _remote(srv.address, policy=policy)
+            assert remote._patch_ok and remote._batch_ok
+            # the "restart": same address, downgraded capabilities
+            orig_info = srv._handler.info
+
+            def downgraded_info(request, context):
+                d = arena_unpack(orig_info(request, context))
+                d.pop("patch", None)
+                d.pop("batch", None)
+                d.pop("subsets", None)
+                return arena_pack(d)
+
+            srv._handler.info = downgraded_info
+            # drive the breaker OPEN, then let the cooldown elapse and a
+            # success close it — the transition hook must re-ping
+            br = policy.breaker
+            for _ in range(3):
+                br.record_failure()
+            assert br.state == "open"
+            time.sleep(0.03)
+            assert br.allow()  # half-open probe admitted
+            br.record_success()  # transport-level probe succeeded
+            assert br.state == "closed"
+            assert remote._patch_ok is False
+            assert remote._batch_ok is False
+            assert remote._subsets_ok is False
+            assert remote._patch_srv is None
+            # and the downgraded peer never receives a doomed SolvePatch
+            calls = {"n": 0}
+            orig = remote.client._solve_patch
+
+            def counting(*a, **k):
+                calls["n"] += 1
+                return orig(*a, **k)
+
+            remote.client._solve_patch = counting
+            snaps = _churn_snaps(env, 3, seed=13)
+            prints = _fingerprints([remote.solve(s) for s in snaps])
+            assert prints == _oracle_prints(snaps)
+            assert calls["n"] == 0
+        finally:
+            srv.stop()
+
+    def test_recovered_server_with_same_build_keeps_flags(self, env):
+        from karpenter_provider_aws_tpu.sidecar.resilience import (
+            CircuitBreaker, ResiliencePolicy, RetryPolicy)
+        srv = SolverServer().start()
+        try:
+            policy = ResiliencePolicy(
+                retry=RetryPolicy(max_attempts=2, backoff_base_s=0.001,
+                                  backoff_cap_s=0.01),
+                breaker=CircuitBreaker(threshold=3, cooldown_s=0.02))
+            remote = _remote(srv.address, policy=policy)
+            br = policy.breaker
+            for _ in range(3):
+                br.record_failure()
+            time.sleep(0.03)
+            assert br.allow()
+            br.record_success()
+            assert remote._patch_ok is True
+            # residency died with the "old process" — re-prime, don't
+            # patch into a void
+            assert remote._patch_srv is None
+        finally:
+            srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# pipelined ticks
+
+
+class TestTickPipeline:
+    def test_pipelined_replay_matches_oracle(self, env, server):
+        snaps = _churn_snaps(env, 8, seed=29)
+        remote = _remote(server.address)
+        m = Metrics()
+        remote.metrics = m
+        pipe = TickPipeline(remote, metrics=m)
+        try:
+            futs = [pipe.submit(s) for s in snaps]
+            prints = _fingerprints([f.result() for f in futs])
+        finally:
+            pipe.close()
+        assert prints == _oracle_prints(snaps)
+        text = m.render()
+        assert "karpenter_solver_pipeline_depth" in text
+        assert "karpenter_solver_pipeline_overlap_ms" in text
+        # warm ticks still ride the delta wire when pipelined
+        assert 'kind="delta"' in text
+
+    def test_depth_is_bounded(self, env, server):
+        snaps = _churn_snaps(env, 6, seed=37)
+        remote = _remote(server.address)
+        pipe = TickPipeline(remote)
+        seen = []
+        orig = pipe._gauge_depth
+
+        def watch():
+            seen.append(len(pipe._inflight))
+            orig()
+
+        pipe._gauge_depth = watch
+        try:
+            futs = [pipe.submit(s) for s in snaps]
+            [f.result() for f in futs]
+        finally:
+            pipe.close()
+        assert max(seen) <= TickPipeline.MAX_DEPTH
+
+    def test_speculation_consumed_on_same_snapshot(self, env, server):
+        snaps = _churn_snaps(env, 4, seed=53)
+        remote = _remote(server.address)
+        for s in snaps[:-1]:
+            remote.solve(s)
+        remote.speculate(snaps[-1])
+        spec_future = remote._spec[1]
+        res = remote.solve(snaps[-1])
+        assert remote._spec is None
+        assert spec_future.done()
+        oracle = CPUSolver()
+        assert res.decision_fingerprint() == \
+            oracle.solve(snaps[-1]).decision_fingerprint()
+
+    def test_discarded_speculation_never_yields_stale_solve(self, env,
+                                                            server):
+        """Speculate on snapshot A, solve snapshot B: the speculation
+        must be discarded (its pods are not B's pods) and B's solve
+        must match B's oracle."""
+        snaps = _churn_snaps(env, 5, seed=59)
+        remote = _remote(server.address)
+        for s in snaps[:3]:
+            remote.solve(s)
+        remote.speculate(snaps[3])
+        res = remote.solve(snaps[4])  # different snapshot object
+        oracle = CPUSolver()
+        assert res.decision_fingerprint() == \
+            oracle.solve(snaps[4]).decision_fingerprint()
+
+    def test_pipeline_under_transport_failure_degrades(self, env, server):
+        """Kill the wire mid-replay: pipelined ticks fall back to the
+        monolithic path (host twin) and stay oracle-identical."""
+        import grpc
+
+        from karpenter_provider_aws_tpu.fake.faultwire import _injected_error
+        snaps = _churn_snaps(env, 5, seed=61)
+        remote = _remote(server.address)
+        pipe = TickPipeline(remote)
+
+        def down(*a, **k):
+            raise _injected_error(grpc.StatusCode.UNAVAILABLE,
+                                  "injected: wire dead")
+
+        try:
+            a = pipe.submit(snaps[0]).result()
+            remote.client._solve = down
+            remote.client._solve_patch = down
+            rest = [pipe.submit(s) for s in snaps[1:]]
+            res = [a] + [f.result() for f in rest]
+        finally:
+            pipe.close()
+        assert _fingerprints(res) == _oracle_prints(snaps)
+
+
+# ---------------------------------------------------------------------------
+# controller: speculative pre-encode inside the batch window
+
+
+class TestProvisionerSpeculation:
+    def _provisioner(self, env, solver, window):
+        from karpenter_provider_aws_tpu.controllers.provisioning import \
+            Provisioner
+        from karpenter_provider_aws_tpu.state.cluster import ClusterState
+
+        class Cloud:  # only get_instance_types is on the reconcile path
+            def get_instance_types(self_, np_obj):
+                nc = env.kube.get("EC2NodeClass",
+                                  np_obj.template.node_class_ref.name)
+                return env.instance_types.list(nc)
+
+        state = ClusterState(env.kube)
+        return Provisioner(env.kube, state, Cloud(), solver,
+                           batch_window_s=window)
+
+    def test_window_triggers_speculation_and_consumes_it(self, env):
+        class Recorder(CPUSolver):
+            def __init__(self):
+                super().__init__()
+                self.speculated = []
+                self.solved = []
+
+            def speculate(self, snapshot):
+                self.speculated.append(snapshot)
+
+            def solve(self, snapshot):
+                self.solved.append(snapshot)
+                return super().solve(snapshot)
+
+        env2 = Environment()
+        np_, nc = env2.nodepool("spec")
+        env2.kube.create(nc)
+        env2.kube.create(np_)
+        for p in make_pods(4, cpu="500m", memory="1Gi", prefix="specp"):
+            env2.kube.create(p)
+        solver = Recorder()
+        prov = self._provisioner(env2, solver, window=0.01)
+        result = prov.reconcile()
+        assert result.created_claims
+        assert len(solver.speculated) == 1
+        # pod set unchanged across the window: the SAME snapshot object
+        # flows into solve, so an identity-keyed speculation is consumed
+        assert solver.solved[-1] is solver.speculated[-1]
+
+    def test_straggler_rebuilds_snapshot(self, env):
+        class Recorder(CPUSolver):
+            speculated = None
+
+            def speculate(self, snapshot):
+                self.speculated = snapshot
+
+        env2 = Environment()
+        np_, nc = env2.nodepool("strag")
+        env2.kube.create(nc)
+        env2.kube.create(np_)
+        for p in make_pods(2, cpu="500m", memory="1Gi", prefix="stragp"):
+            env2.kube.create(p)
+        solver = Recorder()
+        prov = self._provisioner(env2, solver, window=0.05)
+        late = make_pods(1, cpu="500m", memory="1Gi", prefix="stragl")[0]
+
+        def add_late():
+            time.sleep(0.01)
+            env2.kube.create(late)
+
+        t = threading.Thread(target=add_late)
+        t.start()
+        result = prov.reconcile()
+        t.join()
+        # the straggler made this round's solve (3 pods placed), and the
+        # snapshot the solver saw is NOT the speculated one
+        assert len(result.nominated) == 3
+        assert solver.speculated is not None
+
+    def test_zero_window_never_speculates(self, env):
+        class Recorder(CPUSolver):
+            called = False
+
+            def speculate(self, snapshot):
+                self.called = True
+
+        env2 = Environment()
+        np_, nc = env2.nodepool("zw")
+        env2.kube.create(nc)
+        env2.kube.create(np_)
+        for p in make_pods(2, cpu="500m", memory="1Gi", prefix="zwp"):
+            env2.kube.create(p)
+        solver = Recorder()
+        prov = self._provisioner(env2, solver, window=0.0)
+        prov.reconcile()
+        assert solver.called is False

@@ -565,10 +565,17 @@ class TestRequestResidency:
     def test_resident_tag_requires_pack_cache_identity(self):
         from karpenter_provider_aws_tpu.sidecar.client import RemoteSolver
         buf = np.zeros(4, dtype=np.int64)
-        ns = types.SimpleNamespace(_pack_cache=dict(buf=buf, version=3))
-        assert RemoteSolver._resident_tag(ns, buf) == (id(buf), 3)
+        ns = types.SimpleNamespace(_pack_cache=dict(buf=buf, version=3),
+                                   arena_epoch=lambda: (0, 0))
+        assert RemoteSolver._resident_tag(ns, buf) == (id(buf), 3, (0, 0))
         assert RemoteSolver._resident_tag(ns, buf.copy()) is None
-        ns_cold = types.SimpleNamespace(_pack_cache=None)
+        # a structural rebuild frees the old arena and id() values
+        # recycle — the epoch in the tag keeps a NEW arena from
+        # aliasing onto a dead tag's serialized bytes
+        ns.arena_epoch = lambda: (1, 0)
+        assert RemoteSolver._resident_tag(ns, buf) == (id(buf), 3, (1, 0))
+        ns_cold = types.SimpleNamespace(_pack_cache=None,
+                                        arena_epoch=lambda: (0, 0))
         assert RemoteSolver._resident_tag(ns_cold, buf) is None
 
 
