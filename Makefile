@@ -3,8 +3,18 @@
 
 PYTEST ?= python -m pytest
 
-test:  ## fast tier: everything but the scale envelopes (<~3min)
+test: native-try  ## fast tier: everything but the scale envelopes (<~3min)
 	$(PYTEST) tests/ -x -q -m "not scale"
+
+native:  ## build the native host libraries (codec, fastfill, deltawalk)
+	$(MAKE) -C native all
+
+native-try:  ## best-effort native build: missing toolchain is NOT an error
+	-@$(MAKE) -C native all 2>/dev/null || \
+	  echo "native build unavailable (no toolchain?); numpy twins serve"
+
+aot-prime:  ## pre-build the XLA:CPU AOT store for THIS host's ISA
+	python hack/aotprime.py
 
 test-all:  ## every suite including the scale tier
 	$(PYTEST) tests/ -x -q
@@ -39,8 +49,9 @@ fuzz-delta:  ## 10-seed mutation-sequence fuzz of the incremental encoder
 fuzz-consolidate:  ## seeded device-vs-oracle consolidation parity sweep
 	sh hack/fuzzconsolidate.sh
 
-benchmark:  ## the five BASELINE configs + interruption + batch dispatch
+benchmark: native-try  ## the five BASELINE configs + interruption + batch dispatch
 	python bench.py --all --rounds 100
+	python bench.py --warm-tick
 	python bench.py --interruption
 	python bench.py --batch-solve
 	python bench.py --sidecar-batch
@@ -62,4 +73,4 @@ multichip:  ## multi-device solve: driver dryrun + mesh parity suites
 daemon:  ## run the operator against the in-memory cloud
 	python -m karpenter_provider_aws_tpu --cluster-name dev --metrics-port 8080
 
-.PHONY: test test-all scale deflake benchmark consolidate-evidence multichip daemon chart chaos chaoscloud chaos-tenant chaos-patch fuzz-delta fuzz-consolidate
+.PHONY: test test-all scale deflake benchmark consolidate-evidence multichip daemon chart chaos chaoscloud chaos-tenant chaos-patch fuzz-delta fuzz-consolidate native native-try aot-prime

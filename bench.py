@@ -691,6 +691,256 @@ def run_delta_bench(backend="numpy", pods=5000, ticks=120, churn=0.01,
     }
 
 
+def build_warm_cluster(pods=50_000, pending_frac=0.01, seed=23):
+    """Steady-state cluster for the warm tick: all but ``pending_frac``
+    of the ``pods`` are BOUND — they exist only as existing-node
+    ``used`` — and the pending slice churns tick to tick on a STABLE
+    signature set (a deployment's pods come and go; its shape does
+    not), which keeps the replay on the rows tier. Returns
+    ``(snapshot, tick)`` closures: ``snapshot()`` builds the current
+    snapshot (fresh ExistingNode objects every call, exactly like
+    state/cluster.py's reconcile), ``tick()`` advances the churn —
+    pending pods cycle and a few binds land on node ``used``.
+
+    Shared by ``--warm-tick`` and hack/aotprime.py so the AOT-primed
+    shape class is EXACTLY the class the warm tick dispatches."""
+    from karpenter_provider_aws_tpu.apis import labels as L
+    from karpenter_provider_aws_tpu.apis.resources import Resources
+    from karpenter_provider_aws_tpu.fake.environment import (Environment,
+                                                             make_pods)
+    from karpenter_provider_aws_tpu.solver.types import (
+        ExistingNode, NodePoolSpec, SchedulingSnapshot)
+
+    import itertools
+    import random
+
+    from karpenter_provider_aws_tpu.fake import environment as fake_env
+    # deterministic pod names across arms and processes: the fixture
+    # counter is module-global, and fingerprint identity compares names
+    fake_env._pod_counter = itertools.count()
+
+    env = Environment()
+    np_obj, nc = env.nodepool("bench-warm")
+    # family-pinned pool (the common production posture): the type axis
+    # carries one family's sizes, not the whole 800-type region catalog —
+    # the warm-tick roofline is the steady-state loop's shape, and a
+    # steady-state pool has long since resolved what it launches
+    spec = NodePoolSpec(
+        nodepool=np_obj,
+        instance_types=[it for it in env.instance_types.list(nc)
+                        if it.name.startswith("m5.")])
+    zones = ["us-west-2a", "us-west-2b", "us-west-2c"]
+    rng = random.Random(seed)
+
+    n_pending = max(25, int(pods * pending_frac))
+    n_bound = pods - n_pending
+    # dense steady-state posture: m5.16xlarge (64 vCPU / 247Gi alloc /
+    # 737 max pods), CPU-bound at ~480 of these 120m pods per node
+    per_node = 480
+    E = max(1, (n_bound + per_node - 1) // per_node)
+
+    # ~25 stable deployment shapes for the pending slice
+    sigs = []
+    for i in range(25):
+        sel = {L.CAPACITY_TYPE: "spot"} if i % 8 == 7 else None
+        sigs.append(dict(cpu=f"{150 + (i * 37) % 500}m",
+                         memory=f"{256 + (i * 61) % 900}Mi",
+                         group=f"warm{i:02d}", node_selector=sel))
+    serial = [0]
+
+    def mk(n, gi):
+        kw = dict(sigs[gi % len(sigs)])
+        g = kw.pop("group")
+        serial[0] += 1
+        return make_pods(n, prefix=f"{g}-r{serial[0]}", group=g, **kw)
+
+    #: pending as (signature index, pod) so churn can replace a pod
+    #: with a same-signature successor — a deployment's pods cycle,
+    #: its shape does not, and no group ever empties out
+    pend = []
+    for gi in range(len(sigs)):
+        pend.extend((gi, p) for p in mk(n_pending // len(sigs) or 1, gi))
+
+    # bound pods never materialize as objects — only as used vectors
+    # (what the scheduler snapshot actually carries for them)
+    alloc = Resources.parse(
+        {"cpu": "63770m", "memory": "241591Mi", "pods": "737"})
+    used = []
+    for i in range(E):
+        n_on = min(per_node, n_bound - i * per_node)
+        used.append(Resources.parse(
+            {"cpu": f"{n_on * 120}m", "memory": f"{n_on * 420}Mi",
+             "pods": str(n_on)}))
+
+    counts = [0] * len(sigs)
+    for gi, _ in pend:
+        counts[gi] += 1
+
+    def snapshot():
+        snap = env.snapshot([p for _, p in pend], [(np_obj, nc)])
+        snap.nodepools = [spec]
+        snap.existing_nodes = [
+            ExistingNode(
+                name=f"warm-node-{i:04d}",
+                labels={L.ZONE: zones[i % 3], L.ARCH: "amd64",
+                        L.CAPACITY_TYPE: "on-demand",
+                        L.INSTANCE_TYPE: "m5.16xlarge",
+                        L.INSTANCE_FAMILY: "m5"},
+                allocatable=alloc, used=used[i])
+            for i in range(E)]
+        return snap
+
+    bump = Resources.parse({"cpu": "120m", "memory": "420Mi"})
+
+    def tick(churned=None):
+        # pods cycle within their deployment: same shape, same count,
+        # fresh names — a pure membership change on the rows tier
+        k = churned if churned is not None else max(1, n_pending // 5)
+        for _ in range(k):
+            j = rng.randrange(len(pend))
+            gi, _ = pend[j]
+            pend[j] = (gi, mk(1, gi)[0])
+        # one deployment scales down a pod, another scales up: n[i]
+        # moves on exactly two rows, the signature set does not
+        donor = max(range(len(sigs)), key=lambda g: counts[g])
+        recip = min(range(len(sigs)), key=lambda g: counts[g])
+        if donor != recip and counts[donor] > 1:
+            for j, (gi, _) in enumerate(pend):
+                if gi == donor:
+                    pend.pop(j)
+                    break
+            pend.append((recip, mk(1, recip)[0]))
+            counts[donor] -= 1
+            counts[recip] += 1
+        # a few binds land: node used moves, ex_used goes dirty — the
+        # existing-row diff walk earns its keep every tick
+        for _ in range(4):
+            i = rng.randrange(E)
+            used[i] = used[i] + bump
+        return k
+
+    return snapshot, tick
+
+
+def run_warm_tick_bench(pods=50_000, ticks=60, churn=0.01,
+                        backend="jax"):
+    """The ROADMAP item-3 headline: end-to-end warm-tick latency
+    (encode -> patch -> wire -> solve -> decode) at 50k pods / 1% churn
+    in steady state, native deltawalk vs the pure-Python twins, with
+    per-phase split and per-tick decision identity against a
+    from-scratch oracle. "wire" is the SolvePatch frame assembly from
+    the resident arena (the client's _patch_plan cost); the RPC itself
+    is the loopback-measured --patch-wire bench's subject."""
+    from karpenter_provider_aws_tpu.native import deltawalk
+    from karpenter_provider_aws_tpu.ops.hostpack import \
+        pack_patch_frame_from
+    from karpenter_provider_aws_tpu.solver.tpu import TPUSolver
+
+    arms = {}
+    fingerprints = {}
+    identical = True
+    # long enough for the slot-bucket shrink (8-solve window) to settle
+    # and its one recompile at the narrow width to land pre-measurement
+    warmup = 12
+    try:
+        for arm in ("native", "python"):
+            deltawalk.force(arm == "native" and deltawalk.available())
+            snapshot, tick = build_warm_cluster(pods=pods,
+                                                pending_frac=churn)
+            solver = TPUSolver(backend=backend)
+            oracle = TPUSolver(backend="numpy", incremental=False) \
+                if arm == "native" else None
+
+            patch_ms = [0.0]
+            orig_patch = solver._patch_pack_cache
+
+            def timed_patch(*a, _o=orig_patch, _t=patch_ms, **k):
+                t0 = time.perf_counter()
+                out = _o(*a, **k)
+                _t[0] += (time.perf_counter() - t0) * 1000
+                return out
+
+            solver._patch_pack_cache = timed_patch
+
+            solver.solve(snapshot())  # cold: full encode + jit compile
+            gc.collect()
+            gc.freeze()
+            cooldown(2.0)
+
+            totals, phases = [], {k: [] for k in
+                                  ("encode", "patch", "wire", "solve",
+                                   "decode")}
+            tiers = {}
+            fps = []
+            base_counts = dict(deltawalk.counter_snapshot())
+            for t in range(ticks + warmup):
+                tick()
+                snap = snapshot()
+                patch_ms[0] = 0.0
+                t0 = time.perf_counter()
+                res = solver.solve(snap)
+                wall = (time.perf_counter() - t0) * 1000
+                ps = solver.last_phase_stats
+                # wire: assemble the delta frame exactly as the
+                # RemoteSolver's _patch_plan would, straight from the
+                # resident arena
+                wire = 0.0
+                pc = getattr(solver, "_pack_cache", None)
+                sec = (pc or {}).get("sections")
+                if pc and sec and sec.get("spans") is not None:
+                    ep = solver.arena_epoch()
+                    ep = ep if ep[0] is not None else (0, 0)
+                    t1 = time.perf_counter()
+                    pack_patch_frame_from(
+                        pc["buf"], sec["spans"], pc["stt"], token=1,
+                        epoch=ep, base_version=sec["base"],
+                        new_version=sec["to"])
+                    wire = (time.perf_counter() - t1) * 1000
+                if t < warmup:
+                    continue
+                totals.append(wall + wire)
+                phases["encode"].append(ps.get("encode_ms", 0.0))
+                phases["patch"].append(patch_ms[0])
+                phases["wire"].append(wire)
+                phases["solve"].append(ps.get("kernel_ms", 0.0))
+                phases["decode"].append(ps.get("decode_ms", 0.0))
+                tiers[ps.get("cache")] = tiers.get(ps.get("cache"), 0) + 1
+                fp = res.decision_fingerprint()
+                fps.append(fp)
+                if oracle is not None and t < warmup + 3:
+                    # oracle spot-check: from-scratch encode, host twin
+                    identical = identical and \
+                        fp == oracle.solve(snap).decision_fingerprint()
+            gc.unfreeze()
+            p50, p99 = _percentiles(totals)
+            eng = deltawalk.counter_snapshot()
+            arms[arm] = {
+                "p50_ms": p50, "p99_ms": p99,
+                "phases_p50_ms": {k: _percentiles(v)[0]
+                                  for k, v in phases.items()},
+                "tiers": tiers,
+                "native_engaged": {
+                    c: eng.get(("engaged", c), 0)
+                    - base_counts.get(("engaged", c), 0)
+                    for c in ("deltawalk", "patch", "frame")},
+            }
+            fingerprints[arm] = fps
+    finally:
+        deltawalk.force(None)
+    identical = identical and \
+        fingerprints["native"] == fingerprints["python"]
+    return {
+        "config": "warm-tick", "pods": pods, "ticks": ticks,
+        "churn_per_tick": max(1, int(pods * churn) // 5),
+        "backend": backend,
+        "native_level": deltawalk.level(),
+        "identical_decisions": identical,
+        "native": arms["native"], "python": arms["python"],
+        "target_p99_ms": 10.0,
+        "target_met": arms["native"]["p99_ms"] < 10.0,
+    }
+
+
 def run_patch_wire_bench(pods=2000, ticks=60, churn=0.01):
     """The delta wire end to end: replay 1%-churn reconcile ticks over a
     LOOPBACK sidecar twice — once on the patch path (SolvePatch: resident
@@ -1878,6 +2128,12 @@ def main():
                          "per-tick fingerprint identity")
     ap.add_argument("--ticks", type=int, default=120,
                     help="reconcile ticks for --delta-solve")
+    ap.add_argument("--warm-tick", action="store_true",
+                    help="steady-state warm tick at 50k pods / 1%% "
+                         "churn: end-to-end encode->patch->wire->solve"
+                         "->decode p50/p99, native deltawalk vs "
+                         "pure-Python twins, per-phase split, decision "
+                         "identity (ROADMAP item 3)")
     ap.add_argument("--patch-wire", action="store_true",
                     help="replay 1%%-churn ticks over a loopback sidecar "
                          "on the delta wire vs full frames: bytes on "
@@ -1936,6 +2192,12 @@ def main():
         print(json.dumps(run_delta_bench(
             backend=backend, pods=min(args.pods, 10_000),
             ticks=args.ticks)))
+        return
+    if args.warm_tick:
+        backend = "jax" if args.backend == "auto" else args.backend
+        print(json.dumps(run_warm_tick_bench(
+            pods=args.pods, ticks=min(args.ticks, 120),
+            backend=backend)))
         return
     if args.patch_wire:
         print(json.dumps(run_patch_wire_bench(

@@ -1,0 +1,82 @@
+#!/usr/bin/env python
+"""Pre-build the XLA:CPU AOT executable store for this host.
+
+Run once per image build (or per host-ISA fingerprint change):
+
+    python hack/aotprime.py [--cache-dir DIR] [--pods N] [--ticks K]
+
+The script pins the XLA CPU ISA to what this host actually has
+(tenancy/compilecache.pin_host_isa — MUST happen before the jax
+backend initializes), activates the AOT store in record mode, and
+replays a representative steady-state warm tick (bench.py's
+build_warm_cluster, the SAME builder the --warm-tick bench and the
+acceptance test use, so the primed shape classes are exactly the
+classes a serving sidecar dispatches). Every (kernel, statics, shape)
+class the replay dispatches is lowered, compiled and persisted under
+``<cache-dir>/aot-<host fingerprint>``.
+
+A sidecar started afterwards with SOLVER_SIDECAR_AOT=1 (the default)
+preloads that store and serves its FIRST solve with zero tracing and
+zero XLA compilation — no warm-up tax, no first-tick latency cliff.
+
+The replay runs enough ticks for the solver's slot-bucket shrink to
+settle (8-solve window), so both the cold 256-slot kernel and the
+steady-state narrow kernel get recorded.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--cache-dir", default=None,
+                    help="compile-cache root (default: the repo-local "
+                         ".jax_compile_cache the sidecar also uses)")
+    ap.add_argument("--pods", type=int, default=50_000)
+    ap.add_argument("--ticks", type=int, default=12,
+                    help="warm ticks to replay (>= 9 lets the slot "
+                         "bucket settle at its steady-state width)")
+    args = ap.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    from karpenter_provider_aws_tpu.tenancy.compilecache import (
+        activate_aot, aot_counts, configure_compile_cache,
+        host_isa_fingerprint, pin_host_isa)
+
+    tier = pin_host_isa()
+    cache_dir = configure_compile_cache(args.cache_dir)
+    store = activate_aot(record=True, root=args.cache_dir)
+    print(f"host fingerprint {host_isa_fingerprint()}"
+          f" (isa pin: {tier or 'operator-set'})")
+    print(f"compile cache: {cache_dir}")
+    print(f"aot store:     {store.path}")
+
+    import bench
+    from karpenter_provider_aws_tpu.solver.tpu import TPUSolver
+
+    from karpenter_provider_aws_tpu.solver.route import device_alive
+    device_alive()  # resolve the liveness probe so every solve dispatches
+
+    snapshot, tick = bench.build_warm_cluster(pods=args.pods)
+    solver = TPUSolver(backend="jax")
+    solver.solve(snapshot())  # cold: full encode, records the wide kernel
+    for _ in range(args.ticks):
+        tick()
+        solver.solve(snapshot())
+    counts = aot_counts()
+    n = store.preload()
+    print(f"recorded {counts['recorded']} executable(s); "
+          f"{n} resident in {store.path}")
+    return 0 if counts["recorded"] > 0 or n > 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -12,12 +12,33 @@
 #   collectives, resident sharded arena lifecycle (full/patch/reuse),
 #   and the bucketed byte-identity fuzz through a live mesh server.
 #
+# The dryrun log is additionally screened for the cpu_aot_loader ISA
+# feature-mismatch warning ("... is not supported on the host machine"):
+# it means a compiled executable carried a CPU feature this host can't
+# verify — exactly what tenancy/compilecache.py's host-ISA pin and
+# fingerprinted cache dirs exist to prevent (regression ref: the r05
+# multichip log).
+#
 # Usage: sh hack/multichip.sh           # dryrun + mesh suites
 #        sh hack/multichip.sh -x -q    # extra pytest args pass through
 set -e
 cd "$(dirname "$0")/.."
 
-python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+DRYRUN_LOG="$(mktemp)"
+trap 'rm -f "$DRYRUN_LOG"' EXIT
+
+# capture-then-print (not tee): a pipeline would mask the dryrun's
+# exit status in POSIX sh
+python -c "import __graft_entry__ as g; g.dryrun_multichip(8)" \
+    >"$DRYRUN_LOG" 2>&1 || { cat "$DRYRUN_LOG"; exit 1; }
+cat "$DRYRUN_LOG"
+
+if grep -q "is not supported on the host machine" "$DRYRUN_LOG"; then
+    echo "FAIL: cpu_aot_loader ISA feature mismatch in dryrun log" >&2
+    echo "      (compiled executable crossed an ISA boundary; see" >&2
+    echo "      tenancy/compilecache.py pin_host_isa)" >&2
+    exit 1
+fi
 
 JAX_PLATFORMS=cpu exec python -m pytest \
     tests/test_mesh_solve.py \

@@ -54,6 +54,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..native import deltawalk as _dw
 from ..solver.types import ExistingNode, SchedulingSnapshot
 from .encoding import (SnapshotEncoding, canonical_pod_groups,
                        encode_snapshot, pool_dynamic_vecs)
@@ -341,7 +342,15 @@ class DeltaEncoder:
                 d.n_dirty = True
         # pool dynamic vectors: recomputed every tick (in_use sits
         # outside the object-identity staleness contract) through the
-        # SAME derivation encode_snapshot uses, then diffed
+        # SAME derivation encode_snapshot uses, then diffed. The diff
+        # and the patch are ONE native pass (compare + copy-where-
+        # different) when the deltawalk library serves — the resident
+        # vector keeps its identity, so nothing downstream re-alloates.
+        use_native = _dw.enabled()
+        if use_native:
+            _dw.record_engaged("deltawalk")
+        else:
+            _dw.record_fallback(_dw.fallback_reason())
         dpos = self._dpos
         D = len(enc.dims)
         ordered = sorted(
@@ -349,8 +358,13 @@ class DeltaEncoder:
             key=lambda s: (-s.nodepool.weight, s.nodepool.metadata.name))
         for pe, spec in zip(enc.pools, ordered):
             lim, iu = pool_dynamic_vecs(spec, D, dpos)
-            if not np.array_equal(iu, pe.in_use_vec):
-                pe.in_use_vec = iu
+            moved = _dw.diff_patch_i64(pe.in_use_vec, iu) \
+                if use_native else None
+            if moved is None:
+                if not np.array_equal(iu, pe.in_use_vec):
+                    pe.in_use_vec = iu
+                    d.pools_dirty = True
+            elif moved:
                 d.pools_dirty = True
             if (lim is None) != (pe.limit_vec is None) or (
                     lim is not None
@@ -381,10 +395,23 @@ class DeltaEncoder:
     # -- existing-node residency ---------------------------------------
     def _patch_existing(self, enc, existing, d: SnapshotDelta):
         ex_alloc, ex_used = _ex_rows(enc, existing)
-        if not (np.array_equal(ex_alloc, self._ex_alloc)
-                and np.array_equal(ex_used, self._ex_used)):
+        moved = None
+        if _dw.enabled():
+            # one native pass: diff against the RESIDENT tables and
+            # patch them where they differ, preserving their identity
+            # (the packed-arena cache repacks straight from them)
+            ra = _dw.diff_patch_i64(self._ex_alloc, ex_alloc)
+            ru = _dw.diff_patch_i64(self._ex_used, ex_used) \
+                if ra is not None else None
+            if ru is not None:
+                moved = bool(ra or ru)
+        if moved is None:
+            if not (np.array_equal(ex_alloc, self._ex_alloc)
+                    and np.array_equal(ex_used, self._ex_used)):
+                d.ex_rows_dirty = True
+            self._ex_alloc, self._ex_used = ex_alloc, ex_used
+        elif moved:
             d.ex_rows_dirty = True
-        self._ex_alloc, self._ex_used = ex_alloc, ex_used
         names = [n.name for n in existing]
         tok = self._ex_tok
         if names == self._ex_names:

@@ -1,2 +1,3 @@
 from .codec import (arena_pack, arena_unpack, native_available,  # noqa: F401
                     pack_bits, unpack_bits)
+from . import deltawalk  # noqa: F401

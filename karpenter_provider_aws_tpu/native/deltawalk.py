@@ -1,0 +1,271 @@
+"""ctypes binding for the native warm-tick hot path
+(native/deltawalk.cpp): SIMD diff-and-patch over resident encoding
+arrays, word-aligned bool-bitfield patching for the packed arena, and
+zero-copy SolvePatch frame assembly.
+
+Three-tier fallback ladder, every rung byte-exact to the next:
+
+- AVX2 lanes when the HOST cpu reports them (runtime dispatch inside
+  the library — the binary stays runnable on any x86-64),
+- scalar C when it doesn't,
+- the pure-numpy twins in models/delta.py / ops/hostpack.py when the
+  library is absent or the runtime flag disables it.
+
+Runtime flag: ``KARPENTER_NATIVE_DELTAWALK=0`` forces the numpy twins
+(the byte-exact oracles the fuzz suite diffs against); tests can also
+pin either way with ``force()``. Callers consult ``enabled()`` per
+operation and report the outcome through ``record_engaged`` /
+``record_fallback`` so the
+``karpenter_solver_native_{engaged,fallback}_total`` metric families
+(docs/metrics.md) always name which tier actually served — a "native"
+deployment silently running pure Python is a perf cliff, not an error,
+and the metrics are how it surfaces.
+
+Build with ``make -C native`` (the wrapper also attempts one silent
+build on first import when g++ is available)."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from ._build import build_and_load
+
+_I64P = ctypes.POINTER(ctypes.c_int64)
+_U8P = ctypes.POINTER(ctypes.c_uint8)
+
+#: exported contract version this wrapper was written against; a .so
+#: reporting anything else is refused (stale-library ABI mismatch is
+#: silent memory corruption, not an error ctypes could raise)
+_ABI = 1
+
+
+def _load() -> "ctypes.CDLL | None":
+    lib = build_and_load("libkarpdeltawalk.so", "deltawalk.cpp")
+    if lib is None:
+        return None
+    try:
+        lib.karp_dw_abi.restype = ctypes.c_int64
+        if int(lib.karp_dw_abi()) != _ABI:
+            return None
+    except Exception:
+        return None
+    lib.karp_dw_level.restype = ctypes.c_int64
+    lib.karp_dw_diff_patch_i64.restype = ctypes.c_int64
+    lib.karp_dw_diff_patch_i64.argtypes = [_I64P, _I64P, ctypes.c_int64]
+    lib.karp_dw_diff_patch_u8.restype = ctypes.c_int64
+    lib.karp_dw_diff_patch_u8.argtypes = [_U8P, _U8P, ctypes.c_int64]
+    lib.karp_dw_pack_bits.restype = None
+    lib.karp_dw_pack_bits.argtypes = [_U8P, ctypes.c_int64, _I64P]
+    lib.karp_dw_patch_bits.restype = ctypes.c_int64
+    lib.karp_dw_patch_bits.argtypes = [_I64P, _U8P, _U8P,
+                                       ctypes.c_int64, ctypes.c_int64,
+                                       ctypes.c_int64, _I64P]
+    lib.karp_dw_frame_gather.restype = ctypes.c_int64
+    lib.karp_dw_frame_gather.argtypes = [_I64P, ctypes.c_int64,
+                                         _I64P, ctypes.c_int64,
+                                         _I64P, ctypes.c_int64,
+                                         _I64P, ctypes.c_int64]
+    return lib
+
+
+_LIB = _load()
+
+#: test hook: force(True/False) pins enabled() regardless of env/lib;
+#: force(None) restores the runtime decision
+_FORCED: Optional[bool] = None
+
+
+def available() -> bool:
+    return _LIB is not None
+
+
+def enabled() -> bool:
+    """Whether the native path serves this call. Consulted PER
+    OPERATION (env lookup is ~100ns) so tests and the bench can flip
+    the oracle twin on without re-importing anything."""
+    if _FORCED is not None:
+        return _FORCED and _LIB is not None
+    if _LIB is None:
+        return False
+    return os.environ.get("KARPENTER_NATIVE_DELTAWALK", "1").lower() \
+        not in ("0", "false", "off")
+
+
+def force(value: Optional[bool]) -> None:
+    global _FORCED
+    _FORCED = value
+
+
+def level() -> str:
+    """Which rung of the ladder serves: "avx2", "scalar", or ""
+    (library absent). Bench reports and docs cite this so a "native"
+    number always names its tier."""
+    if _LIB is None:
+        return ""
+    return "avx2" if int(_LIB.karp_dw_level()) == 2 else "scalar"
+
+
+def fallback_reason() -> str:
+    """Why enabled() is False right now (metrics label vocabulary):
+    "disabled" (flag/force), "unavailable" (library absent)."""
+    if _LIB is None:
+        return "unavailable"
+    return "disabled"
+
+
+# ---------------------------------------------------------------------------
+# engagement accounting (karpenter_solver_native_* metric families)
+# ---------------------------------------------------------------------------
+
+#: module-level tallies — always on, so the bench and the
+#: toolchain-absent tests can read engagement without a registry
+counters: Dict[Tuple[str, str], int] = {}
+_counters_mu = threading.Lock()
+#: one optional metrics registry (utils.metrics.Metrics); module-global
+#: with last-attach-wins, the same discipline as the compile-cache
+#: monitor's process-wide listener (tenancy/compilecache.py)
+_metrics = None
+
+
+def attach_metrics(metrics) -> None:
+    """Route engagement counts into a Metrics registry. One registry at
+    a time, last attach wins (pass None to detach): the sidecar server
+    and the local solver attach theirs at construction."""
+    global _metrics
+    _metrics = metrics
+
+
+def record_engaged(component: str) -> None:
+    with _counters_mu:
+        counters[("engaged", component)] = \
+            counters.get(("engaged", component), 0) + 1
+        m = _metrics
+    if m is not None:
+        m.inc("karpenter_solver_native_engaged_total",
+              labels={"component": component})
+
+
+def record_fallback(reason: str) -> None:
+    with _counters_mu:
+        counters[("fallback", reason)] = \
+            counters.get(("fallback", reason), 0) + 1
+        m = _metrics
+    if m is not None:
+        m.inc("karpenter_solver_native_fallback_total",
+              labels={"reason": reason})
+
+
+def counter_snapshot() -> Dict[Tuple[str, str], int]:
+    with _counters_mu:
+        return dict(counters)
+
+
+# ---------------------------------------------------------------------------
+# operations
+# ---------------------------------------------------------------------------
+
+def _writable_i64(a: np.ndarray) -> bool:
+    return (a.dtype == np.int64 and a.flags["C_CONTIGUOUS"]
+            and a.flags["WRITEABLE"])
+
+
+def diff_patch_i64(dst: np.ndarray, src: np.ndarray) -> Optional[bool]:
+    """Compare ``src`` against ``dst`` and copy it over ``dst`` where
+    they differ, ONE pass. Returns True iff anything differed (the
+    caller's dirty flag), or None when the pair doesn't qualify for the
+    native path (caller must run the numpy twin). ``dst`` is mutated in
+    place — it must be a C-contiguous writable int64 array of ``src``'s
+    shape."""
+    if _LIB is None or not _writable_i64(dst) \
+            or dst.shape != src.shape:
+        return None
+    src = np.ascontiguousarray(src, dtype=np.int64)
+    return bool(_LIB.karp_dw_diff_patch_i64(
+        dst.ctypes.data_as(_I64P), src.ctypes.data_as(_I64P),
+        ctypes.c_int64(dst.size)))
+
+
+def diff_patch_u8(dst: np.ndarray, src: np.ndarray) -> Optional[bool]:
+    """``diff_patch_i64`` for bool/uint8 planes."""
+    if _LIB is None or dst.dtype.itemsize != 1 \
+            or not dst.flags["C_CONTIGUOUS"] \
+            or not dst.flags["WRITEABLE"] or dst.shape != src.shape:
+        return None
+    src = np.ascontiguousarray(src)
+    if src.dtype.itemsize != 1:
+        src = np.ascontiguousarray(src, dtype=bool)
+    return bool(_LIB.karp_dw_diff_patch_u8(
+        dst.ctypes.data_as(_U8P), src.ctypes.data_as(_U8P),
+        ctypes.c_int64(dst.size)))
+
+
+def pack_bits(bits: np.ndarray) -> np.ndarray:
+    """0/1 plane -> little-endian u64 words viewed int64 — the AVX2
+    movemask formulation of native/codec.cpp's scalar karp_pack_bits
+    (byte-identical output). Raises if the library is absent; callers
+    gate on enabled()."""
+    bits = np.ascontiguousarray(np.asarray(bits).reshape(-1), dtype=bool)
+    nw = (bits.size + 63) // 64
+    words = np.zeros(nw, dtype=np.int64)
+    _LIB.karp_dw_pack_bits(
+        bits.view(np.uint8).ctypes.data_as(_U8P),
+        ctypes.c_int64(bits.size), words.ctypes.data_as(_I64P))
+    return words
+
+
+def patch_bits(words: np.ndarray, plane: np.ndarray,
+               fresh: Optional[np.ndarray],
+               bit_off: int) -> Optional[Tuple[int, int]]:
+    """The patch_inputs1 bool-section rewrite: copy ``fresh`` into
+    ``plane[bit_off:bit_off+len(fresh)]`` and re-bitpack the covering
+    words of ``words`` (the bool region of the packed arena) straight
+    from the resident plane. Returns the rewritten ``(first_word,
+    word_count)`` span, or None when the buffers don't qualify (caller
+    runs the numpy twin). ``fresh=None`` means the plane is already
+    current — repack only."""
+    if _LIB is None or not _writable_i64(words) \
+            or plane.dtype != np.bool_ \
+            or not plane.flags["C_CONTIGUOUS"] \
+            or not plane.flags["WRITEABLE"]:
+        return None
+    nbits = plane.size - bit_off if fresh is None else int(fresh.size)
+    if fresh is not None:
+        fresh = np.ascontiguousarray(fresh.reshape(-1), dtype=bool)
+    w0 = np.zeros(1, dtype=np.int64)
+    n = int(_LIB.karp_dw_patch_bits(
+        words.ctypes.data_as(_I64P),
+        plane.view(np.uint8).ctypes.data_as(_U8P),
+        fresh.view(np.uint8).ctypes.data_as(_U8P)
+        if fresh is not None else None,
+        ctypes.c_int64(int(bit_off)), ctypes.c_int64(nbits),
+        ctypes.c_int64(plane.size), w0.ctypes.data_as(_I64P)))
+    if n < 0:
+        return None
+    return int(w0[0]), n
+
+
+def frame_gather(dst: np.ndarray, hdr: np.ndarray, sections,
+                 base: np.ndarray) -> bool:
+    """Assemble a SolvePatch frame into the preallocated ``dst``:
+    [hdr | (start,stop) x S | base[s0:s1] words...] in one native pass,
+    payload gathered straight from the resident pack buffer. Returns
+    False when the buffers don't qualify or a section is out of bounds
+    (caller runs the numpy twin / raises)."""
+    if _LIB is None or not _writable_i64(dst):
+        return False
+    base = np.ascontiguousarray(base, dtype=np.int64)
+    hdr = np.ascontiguousarray(hdr, dtype=np.int64)
+    sec = np.ascontiguousarray(
+        np.asarray([w for se in sections for w in se],
+                   dtype=np.int64))
+    n = int(_LIB.karp_dw_frame_gather(
+        dst.ctypes.data_as(_I64P), ctypes.c_int64(dst.size),
+        hdr.ctypes.data_as(_I64P), ctypes.c_int64(hdr.size),
+        sec.ctypes.data_as(_I64P), ctypes.c_int64(len(sections)),
+        base.ctypes.data_as(_I64P), ctypes.c_int64(base.size)))
+    return n == dst.size

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from ..native import deltawalk as _dw
 from ..native import pack_bits, unpack_bits
 
 
@@ -169,7 +170,8 @@ def pack_inputs1_state(arrays: dict, T, D, Z, C, G, E, P, K=0, M=0,
     bl = np.concatenate([arrays[nm].reshape(-1).astype(bool)
                          for nm, _ in in_layout_bool(T, D, Z, C, G, E, P,
                                                      K, M, F)])
-    return np.concatenate([i64, pack_bits(bl)]), bl
+    packer = _dw.pack_bits if _dw.enabled() else pack_bits
+    return np.concatenate([i64, packer(bl)]), bl
 
 
 def patch_inputs1(buf: np.ndarray, bool_flat: np.ndarray, arrays: dict,
@@ -194,6 +196,11 @@ def patch_inputs1(buf: np.ndarray, bool_flat: np.ndarray, arrays: dict,
     as repacked), so callers shipping the arena over a wire or onto a
     device can move only the touched bytes. Existing callers that
     ignore the return value are unaffected."""
+    use_native = _dw.enabled()
+    if use_native:
+        _dw.record_engaged("patch")
+    else:
+        _dw.record_fallback(_dw.fallback_reason())
     sections = []
     lay64 = in_layout_i64(T, D, Z, C, G, E, P, K, M, F)
     want64 = set(dirty_i64)
@@ -216,14 +223,22 @@ def patch_inputs1(buf: np.ndarray, bool_flat: np.ndarray, arrays: dict,
         for s in shp:
             sz *= s
         if nm in wantb and sz:
-            bool_flat[boff:boff + sz] = \
-                np.asarray(arrays[nm]).reshape(-1).astype(bool)
-            w0 = boff >> 6
-            end = min(((boff + sz + 63) >> 6) << 6, nbits)
-            words = pack_bits(np.ascontiguousarray(
-                bool_flat[w0 << 6:end]))
-            buf[off + w0:off + w0 + words.size] = words
-            sections.append((off + w0, off + w0 + words.size))
+            fresh = np.asarray(arrays[nm]).reshape(-1)
+            span = _dw.patch_bits(buf[off:], bool_flat, fresh, boff) \
+                if use_native else None
+            if span is not None:
+                # native: fresh bits landed in the plane and the
+                # covering words were repacked straight from it
+                w0, nw = span
+                sections.append((off + w0, off + w0 + nw))
+            else:
+                bool_flat[boff:boff + sz] = fresh.astype(bool)
+                w0 = boff >> 6
+                end = min(((boff + sz + 63) >> 6) << 6, nbits)
+                words = pack_bits(np.ascontiguousarray(
+                    bool_flat[w0 << 6:end]))
+                buf[off + w0:off + w0 + words.size] = words
+                sections.append((off + w0, off + w0 + words.size))
         boff += sz
     return sections
 
@@ -341,6 +356,50 @@ def pack_patch_frame(sections, payloads, statics: dict, *, token: int,
             raise ValueError(f"payload size {p.size} != section "
                              f"[{s0}, {s1})")
     return np.concatenate([hdr, svec, sec] + flat)
+
+
+def pack_patch_frame_from(buf, sections, statics: dict, *, token: int,
+                          epoch, base_version: int,
+                          new_version: int) -> np.ndarray:
+    """``pack_patch_frame`` fed straight from the RESIDENT pack buffer:
+    the payload for section ``(s0, s1)`` is ``buf[s0:s1]``, gathered
+    into ONE preallocated frame (native ``frame_gather`` when the
+    deltawalk library serves, numpy slice-assign otherwise — byte-
+    identical either way, and to ``pack_patch_frame`` fed copies of the
+    same slices). This removes the per-tick payload-copy +
+    ``np.concatenate`` chain from the wire hot path: the resident arena
+    is touched exactly once, at its dirty words."""
+    S = len(sections)
+    if S > PATCH_MAX_SECTIONS:
+        raise ValueError(f"patch sections {S} > {PATCH_MAX_SECTIONS}")
+    buf = np.asarray(buf).reshape(-1)
+    hdr = np.empty(PATCH_HEADER_WORDS, dtype=np.int64)
+    hdr[0] = int(token)
+    hdr[1], hdr[2] = int(epoch[0]), int(epoch[1])
+    hdr[3], hdr[4], hdr[5] = int(base_version), int(new_version), S
+    for i, k in enumerate(STATIC_KEYS):
+        hdr[6 + i] = int(statics.get(k, 0))
+    total = PATCH_HEADER_WORDS + 2 * S
+    for s0, s1 in sections:
+        if not 0 <= s0 <= s1 <= buf.size:
+            raise ValueError(f"section [{s0}, {s1}) outside resident "
+                             f"buffer [0, {buf.size})")
+        total += s1 - s0
+    frame = np.empty(total, dtype=np.int64)
+    if _dw.enabled() and _dw.frame_gather(frame, hdr, sections, buf):
+        _dw.record_engaged("frame")
+        return frame
+    if not _dw.enabled():
+        _dw.record_fallback(_dw.fallback_reason())
+    frame[:PATCH_HEADER_WORDS] = hdr
+    off = PATCH_HEADER_WORDS
+    for s0, s1 in sections:
+        frame[off], frame[off + 1] = s0, s1
+        off += 2
+    for s0, s1 in sections:
+        frame[off:off + s1 - s0] = buf[s0:s1]
+        off += s1 - s0
+    return frame
 
 
 def unpack_patch_frame(frame) -> tuple:

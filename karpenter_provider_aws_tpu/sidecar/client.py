@@ -575,7 +575,8 @@ class RemoteSolver(TPUSolver):
         epoch = self.arena_epoch()
         if epoch[0] is None:
             return None
-        from ..ops.hostpack import PATCH_MAX_SECTIONS, pack_patch_frame
+        from ..ops.hostpack import (PATCH_MAX_SECTIONS,
+                                    pack_patch_frame_from)
         from .server import PATCH_LAYOUT_KEYS
         shape = tuple(int(statics.get(k, 0)) for k in PATCH_LAYOUT_KEYS)
         ver = int(pc.get("version") or 0)
@@ -598,10 +599,13 @@ class RemoteSolver(TPUSolver):
         if spans is None:
             kind, base = "prime", -1
             spans = [(0, int(buf.size))]
-        payloads = [np.array(buf[s0:s1], copy=True) for s0, s1 in spans]
-        frame = pack_patch_frame(spans, payloads, statics,
-                                 token=self._patch_token, epoch=epoch,
-                                 base_version=base, new_version=ver)
+        # zero-copy assembly: payload words flow from the resident pack
+        # buffer straight into the preallocated frame — no per-section
+        # copies, no concatenate chain (ops/hostpack.py)
+        frame = pack_patch_frame_from(buf, spans, statics,
+                                      token=self._patch_token,
+                                      epoch=epoch, base_version=base,
+                                      new_version=ver)
         # optimistic residency prediction: the pipelined prepare of tick
         # N+1 runs while tick N's RPC is still in flight, so it must
         # plan against where the server WILL be, not where it was — a

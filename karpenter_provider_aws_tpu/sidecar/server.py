@@ -1052,7 +1052,8 @@ class SolverServer:
                  quotas: Optional[dict] = None,
                  default_quota=None, bucketing: bool = True,
                  compile_cache: bool = True,
-                 compile_cache_dir: Optional[str] = None):
+                 compile_cache_dir: Optional[str] = None,
+                 aot_cache: bool = True, aot_record: bool = False):
         import grpc
         if (tls_cert is None) != (tls_key is None):
             # a security posture must fail CLOSED: half a TLS config is
@@ -1080,11 +1081,37 @@ class SolverServer:
         cache_dir = ""
         if compile_cache:
             from ..tenancy.compilecache import (CompileCacheMonitor,
-                                                configure_compile_cache)
+                                                configure_compile_cache,
+                                                pin_host_isa)
+            # before any jax backend touch: XLA:CPU codegen stays within
+            # what THIS host's CPUID can verify, so no cache entry ever
+            # carries an unverifiable machine feature (the cpu_aot_loader
+            # mismatch warning from the MULTICHIP r05 log)
+            pin_host_isa()
             cache_dir = configure_compile_cache(compile_cache_dir)
             monitor = CompileCacheMonitor(metrics=metrics)
+        if aot_cache:
+            from ..tenancy.compilecache import activate_aot
+            store = activate_aot(record=aot_record,
+                                 root=compile_cache_dir, metrics=metrics)
+            n = store.preload()
+            if n:
+                log.info("aot store: %d executable(s) resident from %s",
+                         n, store.path)
+            # kick the device-liveness probe NOW (nonblocking): the
+            # store is consulted on the dev dispatch path only, and a
+            # probe still pending at the first RPC would send that
+            # solve to the host twin — the exact cold-start latency
+            # the primed store exists to eliminate
+            from ..solver.route import device_alive_nonblocking
+            device_alive_nonblocking()
         # metrics: optional utils.metrics.Metrics registry; the coalesce
         # families (docs/metrics.md) are emitted through it when present
+        if metrics is not None:
+            # native host-twin engagement (deltawalk/patch/frame) rides
+            # the same registry — last attach wins, one per process
+            from ..native import deltawalk as _dwalk
+            _dwalk.attach_metrics(metrics)
         self._handler = _Handler(metrics=metrics, admission=admission,
                                  bucketing=bucketing,
                                  compile_monitor=monitor)
@@ -1130,7 +1157,9 @@ def serve(address: str = "127.0.0.1", port: int = 50151,
     Tenancy knobs ride the environment for the __main__ entry:
     SOLVER_SIDECAR_BUCKETING=0 disables bucketed padding,
     SOLVER_SIDECAR_COMPILE_CACHE=0 the persistent compile cache
-    (dir: KARPENTER_JAX_CACHE), SOLVER_SIDECAR_DEFAULT_QUOTA=
+    (dir: KARPENTER_JAX_CACHE), SOLVER_SIDECAR_AOT=0 the AOT executable
+    store (primed via `make aot-prime`; SOLVER_SIDECAR_AOT_RECORD=1
+    records cold shape classes in-process), SOLVER_SIDECAR_DEFAULT_QUOTA=
     "rate,burst,inflight" a fleet-wide per-tenant quota."""
     import os
     cert = key = None
@@ -1156,7 +1185,10 @@ def serve(address: str = "127.0.0.1", port: int = 50151,
         quotas=quotas, default_quota=default_quota,
         bucketing=os.environ.get("SOLVER_SIDECAR_BUCKETING", "1") != "0",
         compile_cache=os.environ.get(
-            "SOLVER_SIDECAR_COMPILE_CACHE", "1") != "0").start()
+            "SOLVER_SIDECAR_COMPILE_CACHE", "1") != "0",
+        aot_cache=os.environ.get("SOLVER_SIDECAR_AOT", "1") != "0",
+        aot_record=os.environ.get(
+            "SOLVER_SIDECAR_AOT_RECORD", "0") == "1").start()
 
 
 if __name__ == "__main__":  # pragma: no cover

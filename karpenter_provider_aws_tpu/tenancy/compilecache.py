@@ -10,9 +10,24 @@ a disk read, not a compile.
 This module owns the wiring and the observability:
 
 - ``configure_compile_cache`` points JAX at a cache dir versioned by
-  jax/jaxlib (an executable compiled by one jaxlib is garbage to
-  another — versioned subdirs make rollbacks safe) and drops the
-  min-compile-time floor so EVERY kernel persists, not just slow ones.
+  jax/jaxlib AND the host ISA fingerprint (an executable compiled by
+  one jaxlib is garbage to another, and one compiled for a different
+  CPU feature set is a SIGILL waiting to fire — the MULTICHIP r05 log
+  caught exactly that as a ``cpu_aot_loader`` "+prefer-no-gather is not
+  supported on the host machine" warning from a cross-machine cache
+  entry) and drops the min-compile-time floor so EVERY kernel persists,
+  not just slow ones.
+- ``pin_host_isa`` pins XLA:CPU code generation to the executing
+  host's ISA tier via ``--xla_cpu_max_isa`` so cache entries never
+  carry feature requirements the host can't verify. Call it BEFORE the
+  first jax backend touch (the flag is read at backend init).
+- ``AOTStore`` + ``aot_kernel`` go one step further than the HLO-keyed
+  persistent cache: serialized COMPILED executables keyed by (kernel,
+  statics, arg shape), primed offline by ``hack/aotprime.py`` /
+  ``make aot-prime``. A cold process that finds its shape class in the
+  store serves its first solve with zero tracing and zero XLA compile —
+  ``deserialize_and_load`` relinks the executable without ever entering
+  the compilation path.
 - ``CompileCacheMonitor`` counts cache hits/misses via jax.monitoring
   events, surfaces them through utils.metrics counters and the Info
   RPC (clients and the warm-start acceptance test read them there).
@@ -23,8 +38,11 @@ monitoring events — the sidecar must keep serving without the cache.
 
 from __future__ import annotations
 
+import hashlib
+import json
 import logging
 import os
+import platform
 import threading
 
 log = logging.getLogger(__name__)
@@ -69,11 +87,78 @@ def _install_listener() -> bool:
         return False
 
 
+def _cpu_flags() -> set:
+    """The host CPU's feature-flag set (/proc/cpuinfo; empty elsewhere —
+    the fingerprint then keys on machine + versions alone)."""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith(("flags", "Features")):
+                    return set(line.split(":", 1)[1].split())
+    except Exception:
+        pass
+    return set()
+
+
+def host_isa_fingerprint() -> str:
+    """Short stable hash of everything that makes a compiled CPU
+    executable host-specific: machine arch, jax/jaxlib versions, and
+    the CPU feature-flag set. Two hosts sharing a fingerprint can share
+    compiled artifacts; two hosts differing in ANY feature flag get
+    separate cache dirs — which is the whole fix for the cpu_aot_loader
+    feature-mismatch warning (a cache entry never crosses an ISA
+    boundary again)."""
+    try:
+        import jax
+        import jaxlib
+        vers = f"{jax.__version__}|{jaxlib.__version__}"
+    except Exception:
+        vers = "nojax"
+    blob = "|".join([platform.machine(), vers,
+                     ",".join(sorted(_cpu_flags()))])
+    return hashlib.sha256(blob.encode()).hexdigest()[:12]
+
+
+#: CPUID flag -> --xla_cpu_max_isa tier, best first. The pin says "emit
+#: nothing ABOVE what the host verifiably has": XLA then never tags the
+#: executable with pseudo-features a later host (or this one, after a
+#: cache copy) can't check against CPUID.
+_ISA_TIERS = (("avx512f", "AVX512"), ("avx2", "AVX2"),
+              ("sse4_2", "SSE4_2"))
+
+
+def pin_host_isa() -> str:
+    """Pin XLA:CPU codegen to the executing host's ISA tier via
+    XLA_FLAGS (--xla_cpu_max_isa). Returns the tier pinned ("" when the
+    host reports none of the known tiers, or a pin is already present —
+    an operator's explicit flag wins). MUST run before the first jax
+    backend touch to take effect; calling late is harmless (the flag
+    just isn't re-read)."""
+    cur = os.environ.get("XLA_FLAGS", "")
+    if "--xla_cpu_max_isa" in cur:
+        return ""
+    flags = _cpu_flags()
+    for flag, isa in _ISA_TIERS:
+        if flag in flags:
+            os.environ["XLA_FLAGS"] = \
+                (cur + " " if cur else "") + f"--xla_cpu_max_isa={isa}"
+            return isa
+    return ""
+
+
+def _cache_root(cache_dir=None) -> str:
+    if cache_dir is None:
+        cache_dir = os.environ.get("KARPENTER_JAX_CACHE") or os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            ".jax_cache")
+    return str(cache_dir)
+
+
 def configure_compile_cache(cache_dir=None, min_compile_time_s=0.0) -> str:
-    """Point JAX's persistent compilation cache at a jax/jaxlib-
-    versioned subdir of ``cache_dir`` (default: $KARPENTER_JAX_CACHE or
-    .jax_cache next to the package) and return the resolved path ("" if
-    jax is unavailable). Idempotent; safe to call before or after
+    """Point JAX's persistent compilation cache at a jax/jaxlib/ISA-
+    fingerprinted subdir of ``cache_dir`` (default: $KARPENTER_JAX_CACHE
+    or .jax_cache next to the package) and return the resolved path (""
+    if jax is unavailable). Idempotent; safe to call before or after
     ops/ffd_jax.py's import-time setup — the last call wins as long as
     nothing compiled yet, which is why the server calls this at
     startup, before the first solve."""
@@ -82,12 +167,10 @@ def configure_compile_cache(cache_dir=None, min_compile_time_s=0.0) -> str:
         import jaxlib
     except Exception:
         return ""
-    if cache_dir is None:
-        cache_dir = os.environ.get("KARPENTER_JAX_CACHE") or os.path.join(
-            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-            ".jax_cache")
     path = os.path.join(
-        str(cache_dir), f"jax-{jax.__version__}-jaxlib-{jaxlib.__version__}")
+        _cache_root(cache_dir),
+        f"jax-{jax.__version__}-jaxlib-{jaxlib.__version__}"
+        f"-{platform.machine()}-{host_isa_fingerprint()}")
     try:
         os.makedirs(path, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", path)
@@ -120,3 +203,180 @@ class CompileCacheMonitor:
         """{"hits": n, "misses": n} seen since this monitor started."""
         with _counts_mu:
             return {k: _counts[k] - self._base[k] for k in _counts}
+
+
+# ---------------------------------------------------------------------------
+# deliberate AOT executable store
+# ---------------------------------------------------------------------------
+
+class AOTStore:
+    """Serialized COMPILED executables on disk, keyed by (kernel name,
+    statics, arg shape/dtype) inside a directory keyed by the host ISA
+    fingerprint. Loading is ``deserialize_and_load`` — a relink, never
+    a compile — so a primed store turns a cold process's first solve
+    into a dict hit. The directory is only ever read by a host with the
+    SAME fingerprint; priming and serving on different machines land in
+    different dirs and simply miss (cold, correct) instead of warning
+    about unverifiable machine features."""
+
+    def __init__(self, root=None, metrics=None):
+        self.metrics = metrics
+        self.path = os.path.join(_cache_root(root),
+                                 f"aot-{host_isa_fingerprint()}")
+        os.makedirs(self.path, exist_ok=True)
+        self._mem: dict = {}
+        self._mu = threading.Lock()
+
+    @staticmethod
+    def entry_key(name: str, statics: dict, shape, dtype) -> str:
+        blob = json.dumps([name, sorted((k, int(v))
+                                        for k, v in statics.items()),
+                           [int(s) for s in shape], str(dtype)])
+        return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+    def _file(self, name: str, key: str) -> str:
+        return os.path.join(self.path, f"{name}-{key}.aot")
+
+    def load(self, name: str, statics: dict, shape, dtype):
+        """The ready executable for this call, or None (cold)."""
+        key = self.entry_key(name, statics, shape, dtype)
+        with self._mu:
+            exe = self._mem.get(key)
+        if exe is not None:
+            return exe
+        fp = self._file(name, key)
+        if not os.path.exists(fp):
+            return None
+        exe = self._relink(fp)
+        if exe is not None:
+            with self._mu:
+                self._mem[key] = exe
+        return exe
+
+    def save(self, name: str, statics: dict, shape, dtype,
+             compiled) -> bool:
+        """Persist a compiled executable (atomic: temp + rename, so a
+        concurrent reader never sees a torn entry)."""
+        try:
+            import pickle
+
+            from jax.experimental.serialize_executable import serialize
+            payload = pickle.dumps(serialize(compiled))
+        except Exception as e:
+            log.debug("aot serialize failed for %s: %s", name, e)
+            return False
+        key = self.entry_key(name, statics, shape, dtype)
+        fp = self._file(name, key)
+        tmp = f"{fp}.{os.getpid()}.tmp"
+        with open(tmp, "wb") as f:
+            f.write(payload)
+        os.replace(tmp, fp)
+        with self._mu:
+            self._mem[key] = compiled
+        return True
+
+    def _relink(self, fp: str):
+        try:
+            import pickle
+
+            from jax.experimental.serialize_executable import \
+                deserialize_and_load
+            with open(fp, "rb") as f:
+                blob = f.read()
+            return deserialize_and_load(*pickle.loads(blob))
+        except Exception as e:
+            # a stale/corrupt entry degrades to a compile, never an
+            # error on the serving path
+            log.warning("aot entry %s unusable (%s); will recompile",
+                        os.path.basename(fp), e)
+            return None
+
+    def preload(self) -> int:
+        """Relink every entry into memory NOW (startup), so the first
+        solve pays a dict lookup instead of a disk read + relink.
+        Returns the number of executables resident."""
+        n = 0
+        try:
+            names = sorted(os.listdir(self.path))
+        except OSError:
+            return 0
+        for fn in names:
+            if not fn.endswith(".aot"):
+                continue
+            key = fn[:-4].rsplit("-", 1)[-1]
+            with self._mu:
+                if key in self._mem:
+                    n += 1
+                    continue
+            exe = self._relink(os.path.join(self.path, fn))
+            if exe is not None:
+                with self._mu:
+                    self._mem[key] = exe
+                n += 1
+        return n
+
+
+#: process-wide active store + record flag (the dispatch hook must cost
+#: one attribute read when AOT is off — it sits on the solve hot path)
+_aot_store: "AOTStore | None" = None
+_aot_record = False
+_aot_counts = {"served": 0, "cold": 0, "recorded": 0}
+
+
+def activate_aot(store: "AOTStore | None" = None, record: bool = False,
+                 root=None, metrics=None) -> AOTStore:
+    """Install the process-wide AOT store consulted by the solver's
+    dispatch sites (solver/tpu.py). ``record=True`` additionally
+    compiles-and-persists every shape class the process dispatches —
+    the mode hack/aotprime.py runs in; serving replicas run with it
+    off so an unexpected shape degrades to a normal jit compile."""
+    global _aot_store, _aot_record
+    _aot_store = store if store is not None else AOTStore(
+        root=root, metrics=metrics)
+    _aot_record = bool(record)
+    return _aot_store
+
+
+def deactivate_aot() -> None:
+    global _aot_store, _aot_record
+    _aot_store, _aot_record = None, False
+
+
+def aot_counts() -> dict:
+    """{"served", "cold", "recorded"} since process start (served =
+    dispatches answered by a stored executable, cold = store active but
+    shape class absent, recorded = executables persisted in record
+    mode)."""
+    with _counts_mu:
+        return dict(_aot_counts)
+
+
+def aot_kernel(name: str, fn, arg, statics: dict):
+    """Dispatch-site hook: the ready executable for ``fn(arg,
+    **statics)`` from the active store, or None (take the jit path).
+    In record mode a cold shape class is lowered, compiled, persisted
+    and then served — so one representative solve primes the store for
+    every future process on this fingerprint."""
+    store = _aot_store
+    if store is None:
+        return None
+    shape, dtype = tuple(arg.shape), str(arg.dtype)
+    exe = store.load(name, statics, shape, dtype)
+    kind = "served"
+    if exe is None and _aot_record:
+        try:
+            exe = fn.lower(arg, **statics).compile()
+        except Exception as e:
+            log.debug("aot record compile failed for %s: %s", name, e)
+            exe = None
+        if exe is not None:
+            store.save(name, statics, shape, dtype, exe)
+            kind = "recorded"
+    if exe is None:
+        kind = "cold"
+    with _counts_mu:
+        _aot_counts[kind] += 1
+    if store.metrics is not None:
+        store.metrics.inc("karpenter_solver_aot_dispatch_total",
+                          labels={"outcome": kind, "kernel": name})
+    return exe

@@ -1,0 +1,255 @@
+// Native warm-tick hot path: SIMD delta walk, resident-arena bit
+// patching, and zero-copy SolvePatch frame assembly.
+//
+// The rows-tier warm tick (models/delta.py + ops/hostpack.py) is a set
+// of tight integer loops over resident encoding arrays:
+//
+//   - diff-and-patch: compare a freshly derived array against the
+//     resident copy and bring the resident copy up to date in the SAME
+//     pass (karp_dw_diff_patch_i64 / _u8). The numpy twin pays two full
+//     passes (array_equal, then assignment); here an AVX2 lane compare
+//     stores only the vectors that actually differ.
+//   - bool-bitfield patching: rewrite a dirty bit range of the packed
+//     arena's bool plane and re-bitpack ONLY the covering 64-bit words
+//     (karp_dw_patch_bits) — the packed-arena patch in
+//     ops/hostpack.py::patch_inputs1.
+//   - bitpacking: 0/1 byte plane -> little-endian u64 words
+//     (karp_dw_pack_bits), the movemask formulation: 32 bool bytes
+//     collapse to 32 bits per AVX2 op vs one bit per scalar trip.
+//   - frame gather: header + (start,stop) sections + payload words
+//     written into ONE preallocated frame buffer straight from the
+//     resident pack buffer (karp_dw_frame_gather) — no intermediate
+//     concatenate/copy chain (ops/hostpack.py::pack_patch_frame_from).
+//
+// Dispatch ladder: AVX2 when the HOST cpu reports it (runtime
+// __builtin_cpu_supports check — the binary stays runnable on any
+// x86-64), scalar otherwise, and the pure-numpy twins in Python when
+// the library is absent entirely. Every path is byte-exact to the
+// numpy oracle; tests/test_native_deltawalk.py fuzzes that equality.
+//
+// Build: make -C native (libkarpdeltawalk.so; the Python wrapper also
+// attempts one silent build on first import when g++ is available).
+
+#include <cstdint>
+#include <cstring>
+
+#if (defined(__x86_64__) || defined(__i386__)) && defined(__GNUC__)
+#define KARP_DW_X86 1
+#include <immintrin.h>
+#endif
+
+extern "C" {
+
+// ---------------------------------------------------------------------
+// dispatch
+// ---------------------------------------------------------------------
+
+static int dw_avx2_ok() {
+#ifdef KARP_DW_X86
+    static int ok = -1;
+    if (ok < 0) ok = __builtin_cpu_supports("avx2") ? 1 : 0;
+    return ok;
+#else
+    return 0;
+#endif
+}
+
+// ABI version: the ctypes wrapper refuses to drive a library whose
+// exported contract it does not know (a stale .so is silent memory
+// corruption, not an error ctypes could raise).
+int64_t karp_dw_abi(void) { return 1; }
+
+// 2 = AVX2 lanes engaged, 0 = scalar. Surfaced through metrics and the
+// bench report so a "native" number always names its tier.
+int64_t karp_dw_level(void) { return dw_avx2_ok() ? 2 : 0; }
+
+// ---------------------------------------------------------------------
+// diff-and-patch (the delta walk's inner loop)
+// ---------------------------------------------------------------------
+
+#ifdef KARP_DW_X86
+__attribute__((target("avx2")))
+static int64_t diff_patch_i64_avx2(int64_t* dst, const int64_t* src,
+                                   int64_t n) {
+    int64_t i = 0, diff = 0;
+    for (; i + 4 <= n; i += 4) {
+        __m256i a = _mm256_loadu_si256((const __m256i*)(dst + i));
+        __m256i b = _mm256_loadu_si256((const __m256i*)(src + i));
+        if (_mm256_movemask_epi8(_mm256_cmpeq_epi64(a, b)) != -1) {
+            _mm256_storeu_si256((__m256i*)(dst + i), b);
+            diff = 1;
+        }
+    }
+    for (; i < n; i++)
+        if (dst[i] != src[i]) { dst[i] = src[i]; diff = 1; }
+    return diff;
+}
+
+__attribute__((target("avx2")))
+static int64_t diff_patch_u8_avx2(uint8_t* dst, const uint8_t* src,
+                                  int64_t n) {
+    int64_t i = 0, diff = 0;
+    for (; i + 32 <= n; i += 32) {
+        __m256i a = _mm256_loadu_si256((const __m256i*)(dst + i));
+        __m256i b = _mm256_loadu_si256((const __m256i*)(src + i));
+        if (_mm256_movemask_epi8(_mm256_cmpeq_epi8(a, b)) != -1) {
+            _mm256_storeu_si256((__m256i*)(dst + i), b);
+            diff = 1;
+        }
+    }
+    for (; i < n; i++)
+        if (dst[i] != src[i]) { dst[i] = src[i]; diff = 1; }
+    return diff;
+}
+#endif
+
+static int64_t diff_patch_i64_scalar(int64_t* dst, const int64_t* src,
+                                     int64_t n) {
+    if (memcmp(dst, src, (size_t)n * 8) == 0) return 0;
+    memcpy(dst, src, (size_t)n * 8);
+    return 1;
+}
+
+static int64_t diff_patch_u8_scalar(uint8_t* dst, const uint8_t* src,
+                                    int64_t n) {
+    if (memcmp(dst, src, (size_t)n) == 0) return 0;
+    memcpy(dst, src, (size_t)n);
+    return 1;
+}
+
+// Compare src against dst and copy src over dst where they differ, in
+// one pass. Returns 1 iff anything differed (the caller's dirty flag).
+int64_t karp_dw_diff_patch_i64(int64_t* dst, const int64_t* src,
+                               int64_t n) {
+#ifdef KARP_DW_X86
+    if (dw_avx2_ok()) return diff_patch_i64_avx2(dst, src, n);
+#endif
+    return diff_patch_i64_scalar(dst, src, n);
+}
+
+int64_t karp_dw_diff_patch_u8(uint8_t* dst, const uint8_t* src,
+                              int64_t n) {
+#ifdef KARP_DW_X86
+    if (dw_avx2_ok()) return diff_patch_u8_avx2(dst, src, n);
+#endif
+    return diff_patch_u8_scalar(dst, src, n);
+}
+
+// ---------------------------------------------------------------------
+// bitpacking
+// ---------------------------------------------------------------------
+
+static void pack_word_scalar(const uint8_t* bits, int64_t nbits,
+                             int64_t* word) {
+    uint64_t w = 0;
+    for (int64_t i = 0; i < nbits; i++)
+        if (bits[i]) w |= (1ULL << i);
+    memcpy(word, &w, 8);
+}
+
+#ifdef KARP_DW_X86
+__attribute__((target("avx2")))
+static void pack_bits_avx2(const uint8_t* bits, int64_t nbits,
+                           int64_t* words) {
+    int64_t full = nbits >> 6;  // words with all 64 bits present
+    __m256i zero = _mm256_setzero_si256();
+    for (int64_t w = 0; w < full; w++) {
+        __m256i lo = _mm256_loadu_si256((const __m256i*)(bits + w * 64));
+        __m256i hi = _mm256_loadu_si256(
+            (const __m256i*)(bits + w * 64 + 32));
+        // any nonzero byte is a set bit: ~movemask(byte == 0)
+        uint32_t mlo = ~(uint32_t)_mm256_movemask_epi8(
+            _mm256_cmpeq_epi8(lo, zero));
+        uint32_t mhi = ~(uint32_t)_mm256_movemask_epi8(
+            _mm256_cmpeq_epi8(hi, zero));
+        uint64_t word = ((uint64_t)mhi << 32) | mlo;
+        memcpy(words + w, &word, 8);
+    }
+    if (nbits & 63)
+        pack_word_scalar(bits + full * 64, nbits & 63, words + full);
+}
+#endif
+
+static void pack_bits_scalar(const uint8_t* bits, int64_t nbits,
+                             int64_t* words) {
+    int64_t full = nbits >> 6;
+    for (int64_t w = 0; w < full; w++)
+        pack_word_scalar(bits + w * 64, 64, words + w);
+    if (nbits & 63)
+        pack_word_scalar(bits + full * 64, nbits & 63, words + full);
+}
+
+// 0/1 byte plane -> little-endian u64 words (ceil(nbits/64) of them;
+// the trailing partial word is zero-padded). Byte-identical to
+// codec.cpp's karp_pack_bits and numpy packbits(bitorder="little").
+void karp_dw_pack_bits(const uint8_t* bits, int64_t nbits,
+                       int64_t* words) {
+#ifdef KARP_DW_X86
+    if (dw_avx2_ok()) { pack_bits_avx2(bits, nbits, words); return; }
+#endif
+    pack_bits_scalar(bits, nbits, words);
+}
+
+// The patch_inputs1 bool-section rewrite: copy ``fresh`` (0/1 bytes,
+// may be NULL when the plane is already current) into
+// plane[bit_off : bit_off+nbits], then re-bitpack the covering words —
+// sections are NOT word-aligned, so the repack rounds out to the
+// enclosing words and re-reads the neighbouring bits from the resident
+// plane (exactly the numpy twin's semantics). ``total_bits`` bounds the
+// plane; ``words`` points at the bool region of the packed arena.
+// Returns the number of words rewritten; *w0_out is the first word.
+int64_t karp_dw_patch_bits(int64_t* words, uint8_t* plane,
+                           const uint8_t* fresh, int64_t bit_off,
+                           int64_t nbits, int64_t total_bits,
+                           int64_t* w0_out) {
+    if (bit_off < 0 || nbits < 0 || bit_off + nbits > total_bits)
+        return -1;
+    if (fresh != NULL && nbits)
+        memcpy(plane + bit_off, fresh, (size_t)nbits);
+    int64_t w0 = bit_off >> 6;
+    int64_t bend = ((bit_off + nbits + 63) >> 6) << 6;
+    if (bend > total_bits) bend = total_bits;
+    int64_t span = bend - (w0 << 6);
+    karp_dw_pack_bits(plane + (w0 << 6), span, words + w0);
+    *w0_out = w0;
+    return (span + 63) >> 6;
+}
+
+// ---------------------------------------------------------------------
+// zero-copy SolvePatch frame assembly
+// ---------------------------------------------------------------------
+
+// Write [hdr | (start,stop) x S | base[s0:s1] words ...] into one
+// preallocated frame. ``hdr`` carries the header AND statics words
+// (PATCH_HEADER_WORDS of them — the layout lives in ops/hostpack.py;
+// this routine only moves words). Sections must lie inside ``base``;
+// returns total words written, or -1 on any bounds violation (the
+// caller then raises instead of shipping a torn frame).
+int64_t karp_dw_frame_gather(int64_t* dst, int64_t dst_cap,
+                             const int64_t* hdr, int64_t hdr_n,
+                             const int64_t* sections, int64_t S,
+                             const int64_t* base, int64_t base_n) {
+    if (hdr_n < 0 || S < 0) return -1;
+    int64_t total = hdr_n + 2 * S;
+    for (int64_t i = 0; i < S; i++) {
+        int64_t s0 = sections[2 * i], s1 = sections[2 * i + 1];
+        if (s0 < 0 || s1 < s0 || s1 > base_n) return -1;
+        total += s1 - s0;
+    }
+    if (total > dst_cap) return -1;
+    memcpy(dst, hdr, (size_t)hdr_n * 8);
+    int64_t* w = dst + hdr_n;
+    for (int64_t i = 0; i < S; i++) {
+        w[0] = sections[2 * i];
+        w[1] = sections[2 * i + 1];
+        w += 2;
+    }
+    for (int64_t i = 0; i < S; i++) {
+        int64_t s0 = sections[2 * i], s1 = sections[2 * i + 1];
+        memcpy(w, base + s0, (size_t)(s1 - s0) * 8);
+        w += s1 - s0;
+    }
+    return total;
+}
+
+}  // extern "C"

@@ -461,6 +461,16 @@ def emitted(tmp_path_factory):
     dsolver.solve(denv.snapshot(
         dpods, [denv.nodepool("parity-delta-b")]))  # structural fallback
 
+    # native host-twin families: the rows-tier patch above engages the
+    # native walk when libkarpdeltawalk is built, and records a
+    # fallback otherwise — which family fires is a build-environment
+    # fact, so (like the compile-cache hit/miss pair) fire both
+    # deterministically through the real recorders
+    from karpenter_provider_aws_tpu.native import deltawalk as _dw
+    _dw.attach_metrics(op.metrics)
+    _dw.record_engaged("patch")
+    _dw.record_fallback("unavailable")
+
     # delta-wire + pipelined-tick families: a live sidecar holding a
     # resident patch arena. Tick 0 primes, tick 1 ships a delta (patch
     # total/bytes); a server-side version perturbation makes tick 2's
@@ -516,6 +526,24 @@ def emitted(tmp_path_factory):
     cev_np = TPUConsolidationEvaluator(backend="numpy")
     cev_np.metrics = op.metrics
     assert cev_np.subset_solve(cbase, [cq]) is None
+
+    # AOT-store dispatch family: the conftest's 8 virtual devices route
+    # in-process solves through the mesh path, which carries no AOT
+    # hook (the store is a single-device cold-start feature), so —
+    # like the direct _pad call above — drive the dispatch-site hook
+    # itself with a real packed arena. The store is active and empty,
+    # so the outcome label is cold; served/recorded ride the same
+    # series name
+    from karpenter_provider_aws_tpu.ops.ffd_jax import solve_scan_packed1
+    from karpenter_provider_aws_tpu.tenancy.compilecache import (
+        activate_aot, aot_kernel, deactivate_aot)
+    activate_aot(root=str(tmp_path_factory.mktemp("parity-aot")),
+                 metrics=op.metrics)
+    try:
+        assert aot_kernel("solve_scan_packed1", solve_scan_packed1,
+                          _np.asarray(_buf), dict(_kv)) is None
+    finally:
+        deactivate_aot()
 
     # catalog membership + offering gauges at the current blacklist
     op.catalog_controller.refresh_gauges()

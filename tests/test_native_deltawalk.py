@@ -1,0 +1,457 @@
+"""Native deltawalk (native/deltawalk.cpp) vs its pure-numpy twins.
+
+The ladder's contract is BYTE equality at every rung: the AVX2/scalar
+library, the numpy twins in models/delta.py / ops/hostpack.py, and the
+from-scratch oracle must be indistinguishable in output — the native
+path is a latency feature, never a decision input. Seeded fuzz drives
+each primitive against its twin, the packed-arena patch against a
+fresh pack, and the full mutation-vocabulary churn (test_delta_
+encoding._Sim) forced-on vs forced-off.
+
+The engagement-accounting tests pin the observability contract:
+``karpenter_solver_native_engaged_total{component}`` /
+``..._fallback_total{reason}`` (docs/metrics.md) and the module
+counters move in lockstep, and a toolchain-absent install degrades
+with identical fingerprints — loudly, via the fallback family.
+"""
+
+import itertools
+import random
+
+import numpy as np
+import pytest
+
+from karpenter_provider_aws_tpu.fake import environment as fake_env
+from karpenter_provider_aws_tpu.native import deltawalk
+from karpenter_provider_aws_tpu.native import pack_bits as codec_pack_bits
+from karpenter_provider_aws_tpu.ops.hostpack import (PATCH_HEADER_WORDS,
+                                                     in_layout_bool,
+                                                     in_layout_i64,
+                                                     pack_inputs1,
+                                                     pack_inputs1_state,
+                                                     pack_patch_frame,
+                                                     pack_patch_frame_from,
+                                                     patch_inputs1,
+                                                     unpack_patch_frame)
+from karpenter_provider_aws_tpu.utils.metrics import Metrics
+
+needs_lib = pytest.mark.skipif(not deltawalk.available(),
+                               reason="deltawalk library absent")
+
+
+@pytest.fixture
+def forced_native():
+    deltawalk.force(True)
+    yield
+    deltawalk.force(None)
+
+
+@pytest.fixture
+def forced_python():
+    deltawalk.force(False)
+    yield
+    deltawalk.force(None)
+
+
+def _counters():
+    return dict(deltawalk.counter_snapshot())
+
+
+# ---------------------------------------------------------------------------
+# primitive fuzz: every exported op vs its numpy oracle
+# ---------------------------------------------------------------------------
+
+@needs_lib
+class TestPrimitiveParity:
+    def test_reports_a_simd_level(self):
+        assert deltawalk.level() in ("avx2", "scalar")
+
+    @pytest.mark.parametrize("seed", (3, 7, 11))
+    def test_diff_patch_i64_fuzz(self, seed):
+        rng = np.random.RandomState(seed)
+        for _ in range(60):
+            n = int(rng.randint(0, 500))
+            dst = rng.randint(0, 50, size=n).astype(np.int64)
+            src = dst.copy()
+            differs = bool(n) and rng.rand() < 0.7
+            if differs:
+                k = rng.randint(1, max(2, n // 3))
+                idx = rng.choice(n, size=min(k, n), replace=False)
+                src[idx] += rng.randint(1, 9, size=idx.size)
+            moved = deltawalk.diff_patch_i64(dst, src)
+            assert moved is not None
+            assert moved == differs
+            assert np.array_equal(dst, src)
+
+    def test_diff_patch_i64_first_and_last_element(self):
+        for pos in (0, 63, 64, 255):
+            dst = np.zeros(256, dtype=np.int64)
+            src = dst.copy()
+            src[pos] = 1
+            assert deltawalk.diff_patch_i64(dst, src) is True
+            assert np.array_equal(dst, src)
+
+    def test_diff_patch_i64_rejects_unqualified(self):
+        base = np.zeros(16, dtype=np.int64)
+        assert deltawalk.diff_patch_i64(base[::2],
+                                        np.zeros(8, np.int64)) is None
+        assert deltawalk.diff_patch_i64(
+            base, np.zeros(8, dtype=np.int64)) is None
+        ro = np.zeros(16, dtype=np.int64)
+        ro.setflags(write=False)
+        assert deltawalk.diff_patch_i64(ro, base) is None
+
+    @pytest.mark.parametrize("seed", (3, 7, 11))
+    def test_diff_patch_u8_fuzz(self, seed):
+        rng = np.random.RandomState(seed)
+        for _ in range(60):
+            n = int(rng.randint(0, 400))
+            dst = (rng.rand(n) < 0.5)
+            src = dst.copy()
+            differs = bool(n) and rng.rand() < 0.7
+            if differs:
+                i = rng.randint(n)
+                src[i] = ~src[i]
+            moved = deltawalk.diff_patch_u8(dst, src)
+            assert moved is not None
+            assert moved == differs
+            assert np.array_equal(dst, src)
+
+    @pytest.mark.parametrize(
+        "n", (0, 1, 7, 63, 64, 65, 127, 128, 129, 1000, 4096))
+    def test_pack_bits_byte_identical_to_codec(self, n):
+        rng = np.random.RandomState(n or 1)
+        bits = rng.rand(n) < 0.5
+        assert np.array_equal(deltawalk.pack_bits(bits),
+                              codec_pack_bits(bits))
+
+    @pytest.mark.parametrize("seed", (3, 7, 11))
+    def test_patch_bits_fuzz(self, seed):
+        rng = np.random.RandomState(seed)
+        for _ in range(60):
+            nbits = int(rng.randint(1, 700))
+            plane = rng.rand(nbits) < 0.5
+            words = codec_pack_bits(plane).copy()
+            bit_off = int(rng.randint(0, nbits))
+            blen = int(rng.randint(0, nbits - bit_off + 1))
+            fresh = rng.rand(blen) < 0.5
+            span = deltawalk.patch_bits(words, plane, fresh, bit_off)
+            assert span is not None
+            w0, nw = span
+            # oracle: splice + full repack
+            plane[bit_off:bit_off + blen] = fresh  # mutated in place too
+            oracle = codec_pack_bits(plane)
+            assert np.array_equal(words, oracle), (bit_off, blen)
+            # the reported span covers every word the splice touches
+            lo, hi = bit_off // 64, (max(bit_off + blen - 1, bit_off)
+                                     // 64) + 1
+            if blen:
+                assert w0 <= lo and w0 + nw >= min(hi, oracle.size)
+
+    def test_patch_bits_out_of_bounds_is_refused(self):
+        plane = np.zeros(100, dtype=bool)
+        words = codec_pack_bits(plane).copy()
+        before = words.copy()
+        fresh = np.ones(40, dtype=bool)
+        assert deltawalk.patch_bits(words, plane, fresh, 70) is None
+        assert np.array_equal(words, before)
+
+    @pytest.mark.parametrize("seed", (3, 7, 11))
+    def test_frame_gather_fuzz(self, seed):
+        rng = np.random.RandomState(seed)
+        for _ in range(40):
+            base = rng.randint(0, 1000, size=rng.randint(1, 400)) \
+                .astype(np.int64)
+            hdr = rng.randint(0, 9, size=rng.randint(1, 30)) \
+                .astype(np.int64)
+            sections = []
+            for _ in range(rng.randint(0, 6)):
+                s0 = int(rng.randint(0, base.size + 1))
+                s1 = int(rng.randint(s0, base.size + 1))
+                sections.append((s0, s1))
+            total = hdr.size + 2 * len(sections) + \
+                sum(s1 - s0 for s0, s1 in sections)
+            dst = np.full(total, -7, dtype=np.int64)
+            assert deltawalk.frame_gather(dst, hdr, sections, base)
+            parts = [hdr,
+                     np.array([w for se in sections for w in se],
+                              dtype=np.int64)]
+            parts += [base[s0:s1] for s0, s1 in sections]
+            assert np.array_equal(dst, np.concatenate(parts))
+
+    def test_frame_gather_bounds_and_size_refused(self):
+        base = np.arange(10, dtype=np.int64)
+        hdr = np.zeros(3, dtype=np.int64)
+        good = [(2, 5)]
+        dst = np.zeros(3 + 2 + 3, dtype=np.int64)
+        assert deltawalk.frame_gather(dst, hdr, [(2, 11)], base) is False
+        assert deltawalk.frame_gather(
+            np.zeros(4, dtype=np.int64), hdr, good, base) is False
+
+
+# ---------------------------------------------------------------------------
+# packed-arena patch: native arm vs twin arm, byte for byte
+# ---------------------------------------------------------------------------
+
+def _rand_arrays(rng, *shape):
+    arrays = {}
+    for nm, shp in in_layout_i64(*shape):
+        arrays[nm] = rng.randint(0, 1000, size=shp).astype(np.int64)
+    for nm, shp in in_layout_bool(*shape):
+        arrays[nm] = rng.rand(*shp) < 0.5
+    return arrays
+
+
+@needs_lib
+class TestPatchInputs1Parity:
+    SHAPES = [
+        (5, 8, 3, 3, 4, 2, 2, 0, 0, 1),
+        (7, 8, 2, 3, 8, 0, 4, 2, 5, 1),
+        (6, 8, 3, 3, 16, 4, 2, 0, 0, 4),
+    ]
+
+    @pytest.mark.parametrize("shape", SHAPES)
+    def test_native_patch_equals_twin_and_fresh_pack(self, shape):
+        """Replay the SAME dirty sequence through both arms from the
+        same start state: buffers must match each other byte for byte
+        at every step, and match a from-scratch pack — and each arm's
+        reported wire sections must reproduce the buffer when applied
+        to the stale previous copy (the server-side contract)."""
+        names64 = [nm for nm, shp in in_layout_i64(*shape)
+                   if int(np.prod(shp))]
+        namesb = [nm for nm, shp in in_layout_bool(*shape)
+                  if int(np.prod(shp))]
+        arm_bufs = {}
+        for arm in (True, False):
+            deltawalk.force(arm)
+            try:
+                rng = np.random.RandomState(sum(shape))
+                arrays = _rand_arrays(rng, *shape)
+                buf, bflat = pack_inputs1_state(arrays, *shape)
+                steps = [buf.copy()]
+                for _ in range(15):
+                    d64 = [nm for nm in names64 if rng.rand() < 0.4]
+                    db = [nm for nm in namesb if rng.rand() < 0.4]
+                    fresh = _rand_arrays(rng, *shape)
+                    for nm in d64 + db:
+                        arrays[nm] = fresh[nm]
+                    stale = buf.copy()
+                    sections = patch_inputs1(buf, bflat, arrays, d64,
+                                             db, *shape)
+                    assert np.array_equal(
+                        buf, pack_inputs1(arrays, *shape)), (arm, d64, db)
+                    applied = stale
+                    for s0, s1 in sections:
+                        applied[s0:s1] = buf[s0:s1]
+                    assert np.array_equal(applied, buf), (arm, d64, db)
+                    steps.append(buf.copy())
+                arm_bufs[arm] = steps
+            finally:
+                deltawalk.force(None)
+        for a, b in zip(arm_bufs[True], arm_bufs[False]):
+            assert np.array_equal(a, b)
+
+    def test_patch_records_engagement_at_entry(self, forced_native):
+        shape = self.SHAPES[0]
+        rng = np.random.RandomState(2)
+        arrays = _rand_arrays(rng, *shape)
+        buf, bflat = pack_inputs1_state(arrays, *shape)
+        base = _counters()
+        patch_inputs1(buf, bflat, arrays, [], [], *shape)
+        now = _counters()
+        assert now.get(("engaged", "patch"), 0) == \
+            base.get(("engaged", "patch"), 0) + 1
+
+
+@needs_lib
+class TestPatchFrameParity:
+    def test_frame_from_resident_equals_copying_packer(self,
+                                                       forced_native):
+        rng = np.random.RandomState(5)
+        buf = rng.randint(0, 999, size=4000).astype(np.int64)
+        sections = [(0, 64), (128, 131), (1000, 2000), (3999, 4000)]
+        statics = {"T": 5, "D": 8, "G": 4, "E": 2}
+        kw = dict(statics=statics, token=3, epoch=(1, 2),
+                  base_version=7, new_version=8)
+        native = pack_patch_frame_from(buf, sections, **kw)
+        deltawalk.force(False)
+        twin = pack_patch_frame_from(buf, sections, **kw)
+        legacy = pack_patch_frame(
+            sections, [buf[s0:s1].copy() for s0, s1 in sections], **kw)
+        assert np.array_equal(native, twin)
+        assert np.array_equal(native, legacy)
+        hdr, svec, secs, payloads = unpack_patch_frame(native)
+        assert hdr["token"] == 3 and secs == sections
+        for (s0, s1), p in zip(secs, payloads):
+            assert np.array_equal(p, buf[s0:s1])
+
+    def test_empty_section_list_is_the_clean_resend(self, forced_native):
+        buf = np.arange(50, dtype=np.int64)
+        fr = pack_patch_frame_from(buf, [], statics={}, token=1,
+                                   epoch=(0, 0), base_version=3,
+                                   new_version=3)
+        assert fr.size == PATCH_HEADER_WORDS
+        _, _, secs, payloads = unpack_patch_frame(fr)
+        assert secs == [] and payloads == []
+
+    def test_section_outside_buffer_raises(self, forced_native):
+        buf = np.arange(10, dtype=np.int64)
+        with pytest.raises(ValueError):
+            pack_patch_frame_from(buf, [(5, 11)], statics={}, token=1,
+                                  epoch=(0, 0), base_version=0,
+                                  new_version=1)
+
+
+# ---------------------------------------------------------------------------
+# full mutation-vocabulary churn: forced-on vs forced-off
+# ---------------------------------------------------------------------------
+
+class TestChurnFingerprintParity:
+    @needs_lib
+    @pytest.mark.parametrize("seed", (7, 42))
+    def test_forced_arms_decide_identically(self, seed):
+        import test_delta_encoding as tde
+        from karpenter_provider_aws_tpu.solver.tpu import TPUSolver
+        fps = {}
+        for arm in (True, False):
+            deltawalk.force(arm)
+            try:
+                # identical pod names across arms: the fixture counter
+                # is module-global and fingerprints carry names
+                fake_env._pod_counter = itertools.count()
+                rng = random.Random(seed)
+                sim = tde._Sim(rng)
+                solver = TPUSolver(backend="numpy")
+                seq = []
+                for step in range(14):
+                    if step == 9:
+                        sim.structural()
+                    else:
+                        sim.mutate()
+                    sn = sim.snapshot()
+                    existing = sorted(sn.existing_nodes,
+                                      key=lambda n: n.name)
+                    seq.append(
+                        solver.solve(sn).decision_fingerprint())
+                    # arena parity against the from-scratch oracle on
+                    # top of cross-arm identity
+                    enc = solver._delta._enc
+                    ex = (solver._delta._ex_alloc,
+                          solver._delta._ex_used,
+                          solver._delta._ex_compat)
+                    tde._assert_arena_parity(enc, ex, sn, existing)
+                fps[arm] = seq
+            finally:
+                deltawalk.force(None)
+        assert fps[True] == fps[False]
+
+    def test_toolchain_absent_degrades_identically(self, monkeypatch):
+        """Library gone (no compiler, failed build): enabled() is
+        False, the fallback family says "unavailable", and decisions
+        match the native arm's bit for bit."""
+        import test_delta_encoding as tde
+        from karpenter_provider_aws_tpu.solver.tpu import TPUSolver
+
+        def run():
+            fake_env._pod_counter = itertools.count()
+            rng = random.Random(23)
+            sim = tde._Sim(rng)
+            solver = TPUSolver(backend="numpy")
+            out = []
+            for step in range(8):
+                sim.mutate()
+                out.append(
+                    solver.solve(sim.snapshot()).decision_fingerprint())
+            return out
+
+        base = run()  # whatever the default rung is
+        monkeypatch.setattr(deltawalk, "_LIB", None)
+        assert deltawalk.available() is False
+        assert deltawalk.enabled() is False
+        assert deltawalk.fallback_reason() == "unavailable"
+        c0 = _counters()
+        absent = run()
+        c1 = _counters()
+        assert absent == base
+        assert c1.get(("fallback", "unavailable"), 0) > \
+            c0.get(("fallback", "unavailable"), 0)
+
+
+# ---------------------------------------------------------------------------
+# engagement accounting: module counters and the metric families agree
+# ---------------------------------------------------------------------------
+
+class TestEngagementMetrics:
+    def _arena(self):
+        shape = (5, 8, 3, 3, 4, 2, 2, 0, 0, 1)
+        rng = np.random.RandomState(9)
+        arrays = _rand_arrays(rng, *shape)
+        buf, bflat = pack_inputs1_state(arrays, *shape)
+        return shape, arrays, buf, bflat
+
+    @needs_lib
+    def test_engaged_family_parity(self, forced_native):
+        m = Metrics()
+        deltawalk.attach_metrics(m)
+        try:
+            shape, arrays, buf, bflat = self._arena()
+            base = _counters()
+            patch_inputs1(buf, bflat, arrays, [], [], *shape)
+            pack_patch_frame_from(buf, [(0, 4)], statics={}, token=1,
+                                  epoch=(0, 0), base_version=0,
+                                  new_version=1)
+            now = _counters()
+            for comp in ("patch", "frame"):
+                delta = now.get(("engaged", comp), 0) \
+                    - base.get(("engaged", comp), 0)
+                assert delta == 1, comp
+                assert m.counter(
+                    "karpenter_solver_native_engaged_total",
+                    labels={"component": comp}) == delta
+            assert m.counter(
+                "karpenter_solver_native_fallback_total",
+                labels={"reason": "disabled"}) == 0
+        finally:
+            deltawalk.attach_metrics(None)
+
+    def test_fallback_family_parity(self, forced_python):
+        m = Metrics()
+        deltawalk.attach_metrics(m)
+        try:
+            shape, arrays, buf, bflat = self._arena()
+            reason = deltawalk.fallback_reason()
+            base = _counters()
+            patch_inputs1(buf, bflat, arrays, [], [], *shape)
+            pack_patch_frame_from(buf, [(0, 4)], statics={}, token=1,
+                                  epoch=(0, 0), base_version=0,
+                                  new_version=1)
+            now = _counters()
+            delta = now.get(("fallback", reason), 0) \
+                - base.get(("fallback", reason), 0)
+            assert delta == 2
+            assert m.counter(
+                "karpenter_solver_native_fallback_total",
+                labels={"reason": reason}) == delta
+            assert m.counter(
+                "karpenter_solver_native_engaged_total",
+                labels={"component": "patch"}) == 0
+        finally:
+            deltawalk.attach_metrics(None)
+
+    @needs_lib
+    def test_deltawalk_component_engages_on_pool_walk(self,
+                                                     forced_native):
+        import test_delta_encoding as tde
+        from karpenter_provider_aws_tpu.models.delta import DeltaEncoder
+        rng = random.Random(11)
+        sim = tde._Sim(rng)
+        denc = DeltaEncoder()
+        base = _counters()
+        for _ in range(6):
+            sim.mutate()
+            sn = sim.snapshot()
+            existing = sorted(sn.existing_nodes, key=lambda n: n.name)
+            denc.encode(sn, None, existing)
+        now = _counters()
+        assert now.get(("engaged", "deltawalk"), 0) > \
+            base.get(("engaged", "deltawalk"), 0)
