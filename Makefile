@@ -43,6 +43,9 @@ chaos-tenant:  ## hostile-tenant isolation sweep (quiet tenant vs hammer)
 chaos-patch:  ## 10-seed delta-wire chaos sweep (SolvePatch degradations)
 	sh hack/chaospatch.sh
 
+chaos-fleet:  ## seeded fleet chaos sweep (kill/flap/roll replicas)
+	sh hack/chaosfleet.sh
+
 fuzz-delta:  ## 10-seed mutation-sequence fuzz of the incremental encoder
 	sh hack/fuzzdelta.sh
 
@@ -59,6 +62,7 @@ benchmark: native-try  ## the five BASELINE configs + interruption + batch dispa
 	python bench.py --patch-wire
 	python bench.py --tenant-mix
 	python bench.py --mesh-batch
+	python bench.py --fleet
 	python bench.py --consolidate-solve --consolidate-nodes 240 --rounds 5
 
 consolidate-evidence:  ## full 1000-node fleet: 2000 lanes, ONE dispatch/round
@@ -73,4 +77,4 @@ multichip:  ## multi-device solve: driver dryrun + mesh parity suites
 daemon:  ## run the operator against the in-memory cloud
 	python -m karpenter_provider_aws_tpu --cluster-name dev --metrics-port 8080
 
-.PHONY: test test-all scale deflake benchmark consolidate-evidence multichip daemon chart chaos chaoscloud chaos-tenant chaos-patch fuzz-delta fuzz-consolidate native native-try aot-prime
+.PHONY: test test-all scale deflake benchmark consolidate-evidence multichip daemon chart chaos chaoscloud chaos-tenant chaos-patch chaos-fleet fuzz-delta fuzz-consolidate native native-try aot-prime

@@ -1118,6 +1118,196 @@ def run_patch_wire_bench(pods=2000, ticks=60, churn=0.01):
         server.stop(grace=1.0)
 
 
+def run_fleet_bench(ticks=14, tenants=3, n_max=64, seed=29):
+    """The horizontal solver fleet (fleet/): scale the SAME multi-tenant
+    warm-tick workload across 1 -> 2 -> 4 loopback replicas sharing ONE
+    compile-cache/AOT directory (the chart's shared-volume layout).
+
+    Per replica count: per-tenant warm p50/p99, routed counts by reason
+    (affinity/failover/rebalance), re-prime count, how many distinct
+    replicas each tenant's steady-state ticks touched (shape-affine
+    pinning: 1), and per-tick fingerprint identity against the CPU
+    oracle. The 4-replica phase kills the busiest replica mid-run so the
+    failover/re-prime columns carry real numbers.
+
+    Then the scale-out proof: a FRESH PROCESS replica is started against
+    the already-warm shared cache dir and serves the same shape classes;
+    its Info counters must show compile_cache_misses == 0 — the
+    scale-out replica deserializes every XLA executable instead of
+    compiling (the acceptance bar for the shared-cache stanza).
+
+    Loopback caveat: all replicas share one CPU, so read the routing/
+    cache evidence and the per-tenant identity, not absolute ms."""
+    import collections
+    import os
+    import random
+    import shutil
+    import subprocess
+    import tempfile
+
+    from karpenter_provider_aws_tpu.fake.environment import (Environment,
+                                                             make_pods)
+    from karpenter_provider_aws_tpu.fleet import FleetMembership, FleetSolver
+    from karpenter_provider_aws_tpu.sidecar.client import RemoteSolver
+    from karpenter_provider_aws_tpu.sidecar.server import SolverServer
+    from karpenter_provider_aws_tpu.solver import CPUSolver
+    from karpenter_provider_aws_tpu.utils.metrics import Metrics
+
+    env = Environment()
+    oracle = CPUSolver()
+
+    def churn_snaps(prefix, groups=8):
+        pool = env.nodepool(prefix)
+        sigs = [dict(cpu=f"{100 + (i * 7) % 400}m",
+                     memory=f"{256 + (i * 13) % 700}Mi",
+                     group=f"{prefix}g{i:03d}") for i in range(groups)]
+        rng = random.Random(seed)
+
+        def mk(gi):
+            return make_pods(1, cpu=sigs[gi]["cpu"],
+                             memory=sigs[gi]["memory"],
+                             prefix=sigs[gi]["group"],
+                             group=sigs[gi]["group"])
+
+        cur = []
+        for gi in range(len(sigs)):
+            for _ in range(2):
+                cur.extend(mk(gi))
+        snaps = [env.snapshot(list(cur), [pool])]
+        for _ in range(ticks - 1):
+            for _ in range(2):
+                cur.pop(rng.randrange(len(cur)))
+                cur.extend(mk(rng.randrange(len(sigs))))
+            snaps.append(env.snapshot(list(cur), [pool]))
+        return snaps
+
+    cache_dir = tempfile.mkdtemp(prefix="fleet-shared-cache-")
+    results = {}
+    all_identical = True
+    last_snaps = None
+    try:
+        for n in (1, 2, 4):
+            metrics = Metrics()
+            servers = [SolverServer(metrics=metrics,
+                                    compile_cache_dir=cache_dir).start()
+                       for _ in range(n)]
+            addrs = [s.address for s in servers]
+            solvers, snaps_by_t, oracle_by_t = [], {}, {}
+            for t in range(tenants):
+                name = f"tenant-{t}"
+                sol = FleetSolver(membership=FleetMembership(addrs),
+                                  n_max=n_max, backend="jax",
+                                  tenant=name, metrics=metrics)
+                sol._router.alive.mark_ok()
+                solvers.append(sol)
+                snaps_by_t[name] = churn_snaps(f"fl{n}t{t}")
+                oracle_by_t[name] = [
+                    oracle.solve(s).decision_fingerprint()
+                    for s in snaps_by_t[name]]
+            last_snaps = snaps_by_t
+            kill_at = ticks // 2 if n == 4 else None
+            times = collections.defaultdict(list)
+            pinned = collections.defaultdict(set)
+            identical = True
+            try:
+                # tick 0 is the cold prime (compile + arena prime),
+                # outside the measurement
+                for t, sol in enumerate(solvers):
+                    fp = sol.solve(
+                        snaps_by_t[sol.tenant][0]).decision_fingerprint()
+                    identical = identical and \
+                        fp == oracle_by_t[sol.tenant][0]
+                for i in range(1, ticks):
+                    if kill_at is not None and i == kill_at:
+                        victim = solvers[0]._bound
+                        next(s for s in servers
+                             if s.address == victim).stop()
+                    for sol in solvers:
+                        t0 = time.perf_counter()
+                        fp = sol.solve(snaps_by_t[sol.tenant][i]) \
+                            .decision_fingerprint()
+                        times[sol.tenant].append(
+                            (time.perf_counter() - t0) * 1e3)
+                        identical = identical and \
+                            fp == oracle_by_t[sol.tenant][i]
+                        if kill_at is None or i < kill_at:
+                            pinned[sol.tenant].add(sol._bound)
+            finally:
+                for sol in solvers:
+                    sol.close()
+                for s in servers:
+                    try:
+                        s.stop()
+                    except Exception:
+                        pass
+            routed = collections.Counter()
+            for (nm, lbl), v in metrics.counters.items():
+                if nm == "karpenter_solver_fleet_routed_total":
+                    routed[dict(lbl)["reason"]] += int(v)
+            all_identical = all_identical and identical
+            per_tenant = {}
+            for tn, ts in sorted(times.items()):
+                p50, p99 = _percentiles(ts)
+                per_tenant[tn] = {"p50_ms": p50, "p99_ms": p99}
+            results[str(n)] = {
+                "identical_decisions": identical,
+                "per_tenant": per_tenant,
+                "routed": dict(routed),
+                "reprimes": metrics.counter(
+                    "karpenter_solver_fleet_reprimes_total"),
+                "steady_state_replicas_per_tenant": max(
+                    (len(v) for v in pinned.values()), default=0),
+                "killed_replica_at_tick": kill_at,
+            }
+
+        # -- scale-out proof: fresh process, warm shared cache ----------
+        code = (
+            "import time\n"
+            "from karpenter_provider_aws_tpu.sidecar.server import "
+            "SolverServer\n"
+            "s = SolverServer(compile_cache_dir=%r).start()\n"
+            "print(s.address, flush=True)\n"
+            "time.sleep(300)\n" % cache_dir)
+        sub_env = dict(os.environ, JAX_PLATFORMS="cpu",
+                       PYTHONPATH=os.getcwd() + os.pathsep +
+                       os.environ.get("PYTHONPATH", ""))
+        proc = subprocess.Popen([sys.executable, "-c", code],
+                                stdout=subprocess.PIPE,
+                                stderr=subprocess.DEVNULL,
+                                env=sub_env, text=True)
+        cold = {}
+        try:
+            addr = proc.stdout.readline().strip()
+            remote = RemoteSolver(addr, n_max=n_max, backend="jax")
+            remote._router.alive.mark_ok()
+            remote._ping()
+            any_t = sorted(last_snaps)[0]
+            for snap in last_snaps[any_t][:3]:
+                fp = remote.solve(snap).decision_fingerprint()
+                all_identical = all_identical and \
+                    fp == oracle.solve(snap).decision_fingerprint()
+            info = remote.client.info()
+            cold = {
+                "compile_cache_hits": info.get("compile_cache_hits", 0),
+                "compile_cache_misses": info.get(
+                    "compile_cache_misses", -1),
+                "zero_xla_compiles": info.get(
+                    "compile_cache_misses", -1) == 0,
+            }
+        finally:
+            proc.kill()
+            proc.wait()
+    finally:
+        shutil.rmtree(cache_dir, ignore_errors=True)
+
+    return {
+        "config": "fleet", "ticks": ticks, "tenants": tenants,
+        "identical_decisions": all_identical,
+        "replicas": results,
+        "scale_out_cold_start": cold,
+    }
+
+
 def build_config5(env, n_pods):
     """Spot+OD price-capacity-optimized across weighted pools w/ limits."""
     from karpenter_provider_aws_tpu.apis import labels as L
@@ -2139,6 +2329,13 @@ def main():
                          "on the delta wire vs full frames: bytes on "
                          "wire, warm p50/p99 both ways, pipelined vs "
                          "sequential tick latency")
+    ap.add_argument("--fleet", action="store_true",
+                    help="horizontal solver fleet: the same multi-"
+                         "tenant warm-tick workload across 1/2/4 "
+                         "loopback replicas sharing one compile-cache "
+                         "dir — per-tenant p99, routed/failover/"
+                         "re-prime counts, shape-affine pinning, and "
+                         "the zero-XLA-compile scale-out proof")
     ap.add_argument("--consolidate-solve", action="store_true",
                     help="whole-fleet consolidation search: a 1000-node "
                          "cluster's deletion + replacement lanes in ONE "
@@ -2202,6 +2399,10 @@ def main():
     if args.patch_wire:
         print(json.dumps(run_patch_wire_bench(
             pods=min(args.pods, 2000), ticks=min(args.ticks, 60))))
+        return
+    if args.fleet:
+        print(json.dumps(run_fleet_bench(
+            ticks=min(args.ticks, 14))))
         return
     if args.consolidate_solve:
         backend = "jax" if args.backend == "auto" else args.backend

@@ -1,0 +1,26 @@
+#!/bin/sh
+# Seeded chaos sweep for the SOLVER FLEET (fleet/).
+#
+# Runs the fleet fault tests (tests/test_fleet.py, the `slow`-marked
+# seed matrix) across the fixed seeds. Each seed replays the same warm
+# churn-tick sequence against a 3-replica loopback fleet while a seeded
+# FleetChaosPlan (fake/faultwire.py) disrupts it — killing the bound
+# replica mid-patch-stream, flapping the membership (remove the owner,
+# add it back later), and rolling replicas to a build without the
+# `patch` capability. The test fails if ANY tick's decisions diverge
+# from the CPU oracle, if a tick's wall time is unbounded (a hung
+# failover), or if the re-prime accounting breaks: every counted
+# re-prime must correspond to a binding move, and a kill/flap that
+# lands while a patch stream is live must cost exactly one full Solve
+# (karpenter_solver_fleet_reprimes_total).
+#
+# Tier-1 stays fast: these tests are excluded there by `-m 'not slow'`.
+#
+# Usage: sh hack/chaosfleet.sh           # the full seed sweep
+#        sh hack/chaosfleet.sh -x -q    # extra pytest args pass through
+set -e
+cd "$(dirname "$0")/.."
+
+JAX_PLATFORMS=cpu exec python -m pytest \
+    "tests/test_fleet.py::test_fleet_chaos_sweep" \
+    -m slow -q -p no:cacheprovider "$@"

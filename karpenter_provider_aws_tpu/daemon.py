@@ -82,9 +82,11 @@ class Daemon:
                  lease_path: str = "",
                  solver: str = "cpu",
                  sidecar_address: str = "",
+                 fleet_endpoints: str = "",
                  simulate_kubelet: bool = True):
         if operator is None:
-            sv, ev = self._build_solver(solver, sidecar_address)
+            sv, ev = self._build_solver(solver, sidecar_address,
+                                        fleet_endpoints)
             operator = Operator(options=options, solver=sv,
                                consolidation_evaluator=ev)
         self.operator = operator
@@ -103,16 +105,25 @@ class Daemon:
         self._register_controllers()
 
     @staticmethod
-    def _build_solver(name: str, sidecar_address: str = ""):
+    def _build_solver(name: str, sidecar_address: str = "",
+                      fleet_endpoints: str = ""):
         """(solver, consolidation evaluator) for --solver cpu|tpu.
 
         A sidecar address upgrades the tpu solver to RemoteSolver: the
         packed/topology dispatches ride the chart's companion container
         (gRPC), cost-routed against the in-process host twin; the
         consolidation evaluator stays local (its prescreen kernels are
-        latency-sensitive batched calls on host state)."""
+        latency-sensitive batched calls on host state). A fleet endpoint
+        list upgrades it further to FleetSolver: N replicas behind the
+        shape-affine ring (fleet/, docs/fleet.md) — the chart sets this
+        when sidecar.fleetEndpoints names the headless-Service DNS."""
         if name == "tpu":
             from .solver.consolidation import TPUConsolidationEvaluator
+            if fleet_endpoints:
+                from .fleet import FleetSolver
+                eps = [e.strip() for e in fleet_endpoints.split(",")
+                       if e.strip()]
+                return FleetSolver(eps), TPUConsolidationEvaluator()
             if sidecar_address:
                 from .sidecar.client import RemoteSolver
                 return (RemoteSolver(sidecar_address),
@@ -121,10 +132,11 @@ class Daemon:
             # auto = per-shape cost routing between the device kernel
             # and the bit-identical host twin (solver/route.py)
             return TPUSolver(backend="auto"), TPUConsolidationEvaluator()
-        if sidecar_address:
+        if sidecar_address or fleet_endpoints:
             import logging
             logging.getLogger(__name__).warning(
-                "--solver-sidecar-address is ignored with --solver cpu")
+                "--solver-sidecar-address/--solver-fleet-endpoints are "
+                "ignored with --solver cpu")
         from .solver.cpu import CPUSolver
         return CPUSolver(), None
 
@@ -263,6 +275,14 @@ def main(argv=None) -> int:
                              "--solver tpu, device dispatches ride the "
                              "gRPC companion (the chart sets this when "
                              "sidecar.enabled)")
+    parser.add_argument("--solver-fleet-endpoints", default="",
+                        help="comma-separated solver replica endpoints; "
+                             "with --solver tpu, dispatches route per "
+                             "(tenant, shape-class) over the replica "
+                             "fleet (docs/fleet.md; the chart sets this "
+                             "when sidecar.fleetEndpoints is set). "
+                             "Takes precedence over "
+                             "--solver-sidecar-address")
     parser.add_argument("--log-level", default="INFO")
     import sys as _sys
     if argv is None:
@@ -275,7 +295,8 @@ def main(argv=None) -> int:
     try:
         daemon = Daemon(options=options, metrics_port=ns.metrics_port,
                         lease_path=ns.leader_elect_lease, solver=ns.solver,
-                        sidecar_address=ns.solver_sidecar_address)
+                        sidecar_address=ns.solver_sidecar_address,
+                        fleet_endpoints=ns.solver_fleet_endpoints)
     except PreflightError as e:
         # fail-fast boot contract (operator.go:111-115,218-227 analog):
         # a dead/wedged cloud seam must exit with a clear error in

@@ -222,6 +222,104 @@ class FaultInjector:
         self.uninstall()
 
 
+# ---------------------------------------------------------------------------
+# fleet chaos
+
+
+#: Info capability flag -> the handler attribute a "legacy build"
+#: would not have (downgrade_server wires both sides of the lie)
+_CAP_HANDLERS = {"patch": "solve_patch", "batch": "solve_batch",
+                 "subsets": "solve_subsets", "pruned": "solve_pruned"}
+
+
+def downgrade_server(server, drop=("patch",)):
+    """Roll a live in-process :class:`SolverServer` to a build without
+    the ``drop`` capabilities — BOTH halves of the lie: its Info stops
+    advertising the flags, and the corresponding RPCs answer
+    UNIMPLEMENTED like a binary that never linked them (a client that
+    ships a gated frame anyway gets the real legacy-peer experience,
+    which is exactly what the no-SolvePatch-after-failover regression
+    asserts). Returns a zero-argument restore function."""
+    import grpc
+
+    from ..native import arena_pack, arena_unpack
+    handler = server._handler
+    saved = {"info": handler.info}
+    orig_info = handler.info
+
+    def legacy_info(request, context):
+        d = arena_unpack(orig_info(request, context))
+        for flag in drop:
+            d.pop(flag, None)
+        return arena_pack(d)
+
+    handler.info = legacy_info
+    for flag in drop:
+        attr = _CAP_HANDLERS.get(flag)
+        if attr is None or not hasattr(handler, attr):
+            continue
+        saved[attr] = getattr(handler, attr)
+
+        def unimplemented(request, context, _rpc=attr):
+            context.abort(grpc.StatusCode.UNIMPLEMENTED,
+                          f"{_rpc}: unimplemented in this build")
+
+        setattr(handler, attr, unimplemented)
+
+    def restore():
+        for attr, real in saved.items():
+            setattr(handler, attr, real)
+
+    return restore
+
+
+#: membership actions a FleetChaosPlan can draw per tick, in cumulative-
+#: probability order (the order is ABI for seeded schedules — append
+#: only). "kill" stops the bound owner mid-patch-stream; "flap" removes
+#: a replica from membership and re-adds it a few ticks later; "roll"
+#: downgrades a replica to a legacy build (no `patch`), "unroll"
+#: restores it.
+FLEET_ACTIONS = ("kill", "revive", "flap", "roll")
+
+
+class FleetChaosPlan:
+    """Seeded per-tick fleet-membership schedule.
+
+    Pure schedule, no side effects: :meth:`next` draws the action for
+    one tick; the TEST applies it (stopping servers, flapping the
+    membership, rolling builds) so every mutation is visible in the
+    test body. ``min_gap`` forces quiet ticks between disruptions —
+    the p99 bound in the acceptance criteria is per-tick, and a
+    schedule allowed to kill every tick would measure only the
+    degradation path, not recovery."""
+
+    def __init__(self, seed: int, p_kill: float = 0.10,
+                 p_revive: float = 0.35, p_flap: float = 0.10,
+                 p_roll: float = 0.08, min_gap: int = 2):
+        import random
+        self.seed = seed
+        self._rng = random.Random(seed)
+        self._p = (p_kill, p_revive, p_flap, p_roll)
+        self.min_gap = min_gap
+        self._since = min_gap  # first tick may act
+        self.log: List[Tuple[int, str]] = []
+
+    def next(self, tick: int) -> Optional[str]:
+        u = self._rng.random()
+        acc = 0.0
+        kind = None
+        for k, p in zip(FLEET_ACTIONS, self._p):
+            acc += p
+            if u < acc:
+                kind = k
+                break
+        if kind is not None and self._since < self.min_gap:
+            kind = None  # cool-down: let the fleet re-prime first
+        self._since = 0 if kind is not None else self._since + 1
+        self.log.append((tick, kind or "none"))
+        return kind
+
+
 #: attack kinds a TenantHammer cycles through (seeded draw order)
 ATTACK_KINDS = ("poison", "deadline", "burst")
 

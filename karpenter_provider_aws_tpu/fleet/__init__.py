@@ -1,0 +1,26 @@
+"""Horizontal solver fleet: N sidecar replicas as one logical solver.
+
+- :mod:`.membership` — the replica registry (static endpoint config,
+  per-replica health + circuit breakers + capability flags);
+- :mod:`.ring` — rendezvous-hash affinity on (tenant, shape-class)
+  with a deterministic failover order;
+- :mod:`.fleetclient` — the :class:`FleetSolver` facade that follows
+  the ring, re-primes the patch stream on every binding move, and
+  keeps the single-sidecar degradation contract (host twin serves,
+  decisions stay oracle-identical).
+
+See docs/fleet.md for topology, affinity/failover semantics, the
+shared compile-cache layout, and the re-prime cost model.
+"""
+
+from .fleetclient import (AFFINITY, FAILOVER, REBALANCE, FleetSolver,
+                          loopback_fleet)
+from .membership import (ENDPOINTS_ENV, FleetMembership, Replica,
+                         endpoints_from_env)
+from .ring import owner, owner_order, shape_class
+
+__all__ = [
+    "FleetSolver", "FleetMembership", "Replica", "loopback_fleet",
+    "owner", "owner_order", "shape_class", "endpoints_from_env",
+    "ENDPOINTS_ENV", "AFFINITY", "FAILOVER", "REBALANCE",
+]

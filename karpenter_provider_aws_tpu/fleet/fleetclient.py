@@ -1,0 +1,236 @@
+"""``FleetSolver``: N solver sidecars behind one Solver facade.
+
+A :class:`~..sidecar.client.RemoteSolver` whose wire binding follows
+the rendezvous ring (fleet/ring.py) over a replica registry
+(fleet/membership.py). Every dispatch resolves the (tenant,
+shape-class) owner first; steady state that owner never changes, so a
+tenant's warm ticks — hot kernels, bucketed shapes, server-resident
+patch arena — stay pinned to one replica and ride deltas exactly as
+against a single sidecar.
+
+When the binding DOES move (owner parked → failover; membership
+changed → rebalance), the patch stream is deliberately broken: the
+rebind clears the endpoint-scoped state (capability flags + residency
+prediction, sidecar/client.py bind_client) so the next dispatch rides
+PR 10's ``no_resident`` path — ONE full Solve that re-primes the new
+owner, never a stale delta. ``karpenter_solver_fleet_reprimes_total``
+counts exactly those broken streams, which is what makes the fleet
+chaos suite's "each residency break costs one full Solve" assertion
+checkable from metrics alone.
+
+Degradation is unchanged from the single-endpoint contract: a dead
+pick costs that solve a wire attempt and the bit-identical host twin
+serves it; the replica's breaker (its OWN — membership gives each
+replica a policy) parks only its router evidence, and the next solve
+fails over along the deterministic ring order.
+
+Shared warmth: replicas started with the SAME ``compile_cache_dir``
+(chart: the shared compile-cache volume) share one persistent XLA
+cache and AOT store, so a scale-out replica's first solve of a shape
+any replica has seen deserializes instead of compiling —
+``loopback_fleet`` below wires that layout for tests and bench.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+from ..sidecar.client import RemoteSolver
+from .membership import FleetMembership
+from .ring import owner_order, shape_class
+
+#: routed_total reasons (the label is closed-vocabulary; docs/metrics.md)
+AFFINITY = "affinity"
+FAILOVER = "failover"
+REBALANCE = "rebalance"
+
+
+class FleetSolver(RemoteSolver):
+    """RemoteSolver over a replica fleet with shape-affine routing."""
+
+    name = "tpu-fleet"
+
+    def __init__(self, endpoints: Optional[List[str]] = None,
+                 n_max: int = 2048, backend: str = "auto",
+                 token: Optional[str] = None,
+                 root_cert: Optional[bytes] = None,
+                 tenant: Optional[str] = None,
+                 membership: Optional[FleetMembership] = None,
+                 metrics=None, **membership_kw):
+        if membership is None:
+            membership = FleetMembership(
+                endpoints, token=token, root_cert=root_cert,
+                tenant=tenant, metrics=metrics, **membership_kw)
+        addrs = membership.addresses()
+        if not addrs:
+            raise ValueError("FleetSolver needs at least one endpoint "
+                             "(arg, SOLVER_FLEET_ENDPOINTS, or "
+                             "SOLVER_SIDECAR_ADDRESS)")
+        first = membership.get(addrs[0])
+        super().__init__(first.address, n_max=n_max, client=first.client,
+                         backend=backend)
+        self.metrics = metrics
+        self.tenant = tenant or "default"
+        self._fleet = membership
+        membership.metrics = membership.metrics or metrics
+        membership.router = self._router
+        membership._gauge()
+        self._bound: str = first.address
+        self._bound_reason: str = AFFINITY
+        #: True once a SolvePatch landed on the current binding — i.e.
+        #: the bound replica actually holds our arena resident. A rebind
+        #: that breaks an active stream is a residency break: count it.
+        self._stream_active = False
+        #: False until the first dispatch consults the ring: the move
+        #: OFF the arbitrary constructor binding onto the ring owner is
+        #: the affinity placement itself, not a rebalance
+        self._ring_seen = False
+
+    # -- routing ---------------------------------------------------------
+    def _count_routed(self, replica: str, reason: str) -> None:
+        if self.metrics is not None:
+            self.metrics.inc("karpenter_solver_fleet_routed_total",
+                             labels={"replica": replica,
+                                     "reason": reason})
+
+    def _rebind(self, address: str, reason: str) -> None:
+        """Move the wire binding. The handoff is deliberate and paid in
+        the open: endpoint-scoped state cleared, one Info ping against
+        the new owner (capability resolution doubles as the health
+        verdict), and — when the old binding carried a live patch
+        stream — one counted re-prime that the next dispatch will pay
+        as a full Solve."""
+        t0 = time.perf_counter()
+        rep = self._fleet.get(address)
+        broke_stream = self._stream_active or self._patch_srv is not None
+        self._stream_active = False
+        ok = self.bind_client(rep.client)
+        rep.healthy = ok
+        rep.last_ping_s = time.monotonic()
+        self._bound = address
+        self._bound_reason = reason
+        if self.metrics is not None:
+            self.metrics.observe("karpenter_solver_fleet_handoff_ms",
+                                 (time.perf_counter() - t0) * 1e3)
+            if broke_stream:
+                self.metrics.inc("karpenter_solver_fleet_reprimes_total")
+
+    def _ensure_owner(self, statics: Dict[str, int]) -> None:
+        """Resolve the (tenant, shape-class) owner and rebind if it is
+        not the current peer. Called at the top of every dispatch —
+        cheap (one blake2b per replica) relative to a wire round trip."""
+        fleet = self._fleet
+        addrs = fleet.addresses()
+        if not addrs:
+            # membership flapped to empty: keep the current binding —
+            # its failures degrade to the host twin like any dead peer
+            self._count_routed(self._bound, self._bound_reason)
+            return
+        order = owner_order(addrs, self.tenant, shape_class(statics))
+        candidate = next((ep for ep in order if fleet.routable(ep)),
+                         None)
+        if candidate is None:
+            # the whole fleet is parked: stay put; breakers half-open on
+            # their own cooldown and the host twin serves meanwhile
+            self._count_routed(self._bound, self._bound_reason)
+            return
+        if candidate == self._bound and self._bound in addrs:
+            if self._caps_at is None:
+                # first dispatch under this binding: resolve the peer's
+                # capabilities now so warm ticks enter the delta wire
+                # (a plain RemoteSolver gets this from its alive probe)
+                self._ping()
+            if candidate == order[0]:
+                self._bound_reason = AFFINITY
+            self._ring_seen = True
+            self._count_routed(self._bound, self._bound_reason)
+            return
+        prev = self._bound
+        if not self._ring_seen:
+            # the very first ring consult: this IS the affinity
+            # placement, whatever the constructor happened to bind
+            reason = AFFINITY
+        elif prev in addrs and not fleet.routable(prev):
+            reason = FAILOVER
+        else:
+            # planned movement: the ring changed under us (join/leave),
+            # the true owner recovered, or this shape class simply
+            # hashes elsewhere than the last one
+            reason = REBALANCE
+        self._ring_seen = True
+        self._rebind(candidate, reason)
+        self._count_routed(candidate, reason)
+
+    # -- dispatch choke points -------------------------------------------
+    def _dispatch(self, buf, **statics):
+        self._ensure_owner(statics)
+        return super()._dispatch(buf, **statics)
+
+    def _dispatch_many(self, bufs, **statics):
+        self._ensure_owner(statics)
+        return super()._dispatch_many(bufs, **statics)
+
+    def _dispatch_pruned(self, buf, **statics):
+        self._ensure_owner(statics)
+        return super()._dispatch_pruned(buf, **statics)
+
+    def _dispatch_topo(self, arrays, rows, statics, cache=None):
+        self._ensure_owner(statics)
+        return super()._dispatch_topo(arrays, rows, statics, cache=cache)
+
+    def dispatch_subsets(self, arrays, **kw):
+        self._ensure_owner({k: kw[k] for k in ("n_max", "E", "P")
+                            if k in kw})
+        return super().dispatch_subsets(arrays, **kw)
+
+    def _on_breaker_transition(self, old: str, new: str) -> None:
+        """The bound replica's breaker opened: park ITS router evidence
+        and fail over — the FLEET is alive as long as any replica is
+        routable, so the liveness cache only goes dark when the last
+        one parks (the single-endpoint contract marks it failed
+        immediately; here that would blind the solve path to the
+        healthy peers for a whole recheck window)."""
+        from ..sidecar.resilience import OPEN
+        if new == OPEN:
+            ep = self._router.endpoint
+            if ep is not None:
+                self._router.park_dev(endpoint=ep)
+            others = [a for a in self._fleet.addresses()
+                      if a != self._bound and self._fleet.routable(a)]
+            if not others and self._router.alive is not None:
+                self._router.alive.mark_failed()
+            return
+        super()._on_breaker_transition(old, new)
+
+    def _dispatch_patch(self, plan: dict):
+        out = super()._dispatch_patch(plan)
+        if out is not None:
+            self._stream_active = True
+        return out
+
+    def close(self) -> None:
+        self._fleet.close()
+
+
+def loopback_fleet(n: int, *, compile_cache_dir: Optional[str] = None,
+                   metrics=None, tenant: Optional[str] = None,
+                   backend: str = "jax", n_max: int = 2048,
+                   server_kw: Optional[dict] = None,
+                   **solver_kw):
+    """N in-process replicas sharing ONE compile-cache/AOT directory
+    (the chart's shared-volume layout, minus the pod boundary) behind a
+    FleetSolver — the harness tests/bench drive. Returns
+    ``(servers, solver)``; the caller owns shutdown (``solver.close()``
+    then ``srv.stop()`` each)."""
+    from ..sidecar.server import SolverServer
+    servers = []
+    kw = dict(server_kw or {})
+    if compile_cache_dir is not None:
+        kw.setdefault("compile_cache_dir", compile_cache_dir)
+    for _ in range(n):
+        servers.append(SolverServer(metrics=metrics, **kw).start())
+    solver = FleetSolver([s.address for s in servers], n_max=n_max,
+                         backend=backend, tenant=tenant,
+                         metrics=metrics, **solver_kw)
+    return servers, solver

@@ -1,0 +1,192 @@
+"""Replica registry for the solver fleet.
+
+One :class:`FleetMembership` holds the fleet's wire state: a
+:class:`SolverClient` per replica (each with its OWN
+:class:`~..sidecar.resilience.ResiliencePolicy` — one replica's
+consecutive failures must trip one replica's breaker, never the
+fleet's), per-replica health from the existing Info ping, and the
+capability flags that ping resolved (``patch``/``batch``/``subsets``/
+``pruned``). The membership list itself is static config — a comma-
+separated endpoint list from flags or ``SOLVER_FLEET_ENDPOINTS`` — by
+design: per-replica addressing comes from the chart's headless Service
+(stable DNS names per ordinal), so the Helm values ARE the membership
+and no discovery protocol is needed. ``add``/``remove`` exist for the
+control plane that re-renders config (and for chaos tests to flap).
+
+Health semantics mirror the single-sidecar posture: a replica is
+ROUTABLE unless there is positive evidence against it — its breaker is
+open, or its last Info ping failed. Unknown (never pinged) counts
+routable: the bind-time ping resolves it, and a dead pick degrades that
+one solve to the bit-identical host twin exactly like today's single
+endpoint, never a crash.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from collections import OrderedDict
+from typing import Callable, Dict, List, Optional
+
+from ..sidecar.client import SolverClient
+from ..sidecar.resilience import OPEN, ResiliencePolicy
+
+#: comma-separated replica endpoints, e.g.
+#: "solver-0.solver:50151,solver-1.solver:50151"
+ENDPOINTS_ENV = "SOLVER_FLEET_ENDPOINTS"
+
+#: Info flags worth caching per replica (the fleet router consults
+#: ``patch`` before expecting a delta stream to survive a failover)
+_CAP_FLAGS = ("pruned", "batch", "subsets", "patch", "tenancy",
+              "bucketed")
+
+
+class Replica:
+    """One fleet member: its client (own channel, own policy/breaker),
+    the last health verdict, and the capabilities its Info advertised."""
+
+    def __init__(self, address: str, client: SolverClient):
+        self.address = address
+        self.client = client
+        #: None = never probed (routable), True/False = last verdict
+        self.healthy: Optional[bool] = None
+        self.caps: Dict[str, bool] = {}
+        self.last_ping_s: float = 0.0
+
+    @property
+    def policy(self) -> ResiliencePolicy:
+        return self.client.policy
+
+    @property
+    def parked(self) -> bool:
+        return self.policy.breaker.state == OPEN
+
+
+class FleetMembership:
+    def __init__(self, endpoints: Optional[List[str]] = None, *,
+                 token: Optional[str] = None,
+                 root_cert: Optional[bytes] = None,
+                 tenant: Optional[str] = None,
+                 policy_factory: Optional[
+                     Callable[[str], ResiliencePolicy]] = None,
+                 clients: Optional[Dict[str, SolverClient]] = None,
+                 metrics=None):
+        """``clients`` lets tests hand in pre-built (fault-wrapped)
+        SolverClients per address; anything not covered is constructed
+        here with its own fresh policy (``policy_factory(address)``
+        when given — chaos tests use it to seed small breakers)."""
+        if endpoints is None:
+            endpoints = endpoints_from_env()
+        self._token = token
+        self._root_cert = root_cert
+        self._tenant = tenant
+        self._policy_factory = policy_factory
+        self.metrics = metrics
+        #: set by FleetSolver so a replica's breaker parks only ITS
+        #: router evidence (solver/route.py park_dev(endpoint=...))
+        self.router = None
+        self._replicas: "OrderedDict[str, Replica]" = OrderedDict()
+        for ep in endpoints:
+            self.add(ep, client=(clients or {}).get(ep))
+        self._gauge()
+
+    # -- config ----------------------------------------------------------
+    def _build_client(self, address: str) -> SolverClient:
+        policy = self._policy_factory(address) \
+            if self._policy_factory is not None else None
+        return SolverClient(address, token=self._token,
+                            root_cert=self._root_cert, policy=policy,
+                            tenant=self._tenant)
+
+    def _gauge(self) -> None:
+        if self.metrics is not None:
+            self.metrics.set_gauge("karpenter_solver_fleet_replicas",
+                                   float(len(self._replicas)))
+
+    # -- membership ------------------------------------------------------
+    def addresses(self) -> List[str]:
+        return list(self._replicas)
+
+    def get(self, address: str) -> Replica:
+        return self._replicas[address]
+
+    def add(self, address: str,
+            client: Optional[SolverClient] = None) -> Replica:
+        if address in self._replicas:
+            return self._replicas[address]
+        rep = Replica(address, client or self._build_client(address))
+        self._replicas[address] = rep
+
+        def _on_breaker(old: str, new: str, rep=rep) -> None:
+            from ..sidecar.resilience import CLOSED
+            if new == OPEN:
+                rep.healthy = False
+                if self.router is not None:
+                    self.router.park_dev(endpoint=rep.address)
+            elif new == CLOSED and old != CLOSED:
+                # transport recovered; capabilities may have changed
+                # across the restart — unknown until the next bind pings
+                rep.healthy = None
+
+        rep.policy.breaker.on_transition.append(_on_breaker)
+        self._gauge()
+        return rep
+
+    def remove(self, address: str) -> None:
+        """Drop a replica from the membership (config re-render, chaos
+        flap). Its router evidence is forgotten so the aggregate
+        fallback never averages in a peer that left; the client stays
+        open — the caller that handed it in owns its lifecycle."""
+        rep = self._replicas.pop(address, None)
+        if rep is None:
+            return
+        if self.router is not None:
+            self.router.forget_endpoint(address)
+        self._gauge()
+
+    # -- health ----------------------------------------------------------
+    def routable(self, address: str) -> bool:
+        rep = self._replicas.get(address)
+        if rep is None:
+            return False
+        return not rep.parked and rep.healthy is not False
+
+    def alive(self) -> List[str]:
+        return [a for a in self._replicas if self.routable(a)]
+
+    def probe(self, address: str, timeout: float = 5.0) -> bool:
+        """One Info round trip against a replica: records health AND
+        the capability flags. Any failure is a False verdict, never an
+        exception (same contract as RemoteSolver._ping)."""
+        rep = self._replicas[address]
+        try:
+            info = rep.client.info(timeout=timeout)
+            devices = info.get("devices")
+            ok = isinstance(devices, int) and devices >= 1
+        except Exception:
+            info, ok = {}, False
+        rep.healthy = ok
+        rep.last_ping_s = time.monotonic()
+        if ok:
+            rep.caps = {k: bool(info.get(k, 0)) for k in _CAP_FLAGS}
+        return ok
+
+    def close(self) -> None:
+        for rep in self._replicas.values():
+            try:
+                rep.client.close()
+            except Exception:
+                pass
+
+
+def endpoints_from_env() -> List[str]:
+    """Helm-friendly config: SOLVER_FLEET_ENDPOINTS is the comma-
+    separated per-replica list (the headless Service's stable DNS
+    names); a single-sidecar deployment that only sets
+    SOLVER_SIDECAR_ADDRESS is a fleet of one."""
+    raw = os.environ.get(ENDPOINTS_ENV, "")
+    eps = [e.strip() for e in raw.split(",") if e.strip()]
+    if eps:
+        return eps
+    single = os.environ.get("SOLVER_SIDECAR_ADDRESS", "").strip()
+    return [single] if single else []

@@ -1,0 +1,62 @@
+"""Rendezvous-hash (HRW) routing on (tenant, shape-class).
+
+The fleet's whole value rests on affinity: a tenant's hot XLA kernels,
+its bucketed shapes, and its server-resident patch arena all live on the
+replica that served its last tick. Rendezvous hashing gives exactly the
+placement properties that stack needs:
+
+- deterministic: every client computes the same owner from the same
+  membership list — no coordination, no shared state, no leader;
+- minimal disruption: adding/removing one replica re-homes only the
+  keys that hashed to it (a mod-N ring would re-home nearly all of
+  them, breaking every tenant's patch stream on every scale event);
+- a TOTAL preference order per key, not just a winner: when the owner
+  is parked, every client agrees on the SAME next replica, so failover
+  re-primes once fleet-wide instead of scattering a tenant's arena
+  across whichever replica each client happened to pick.
+
+Scores come from blake2b (hashlib), never Python ``hash()``:
+PYTHONHASHSEED makes ``hash()`` differ per process, and two control
+planes disagreeing on ownership is precisely the split-brain this
+module exists to prevent.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Dict, Iterable, List, Optional, Tuple
+
+
+def shape_class(statics: Dict[str, int]) -> Tuple[int, ...]:
+    """The affinity key's shape half: the padded statics tuple that also
+    keys the XLA compile cache and the server's resident-arena table
+    (PATCH_LAYOUT_KEYS). Two solves in the same shape class share a
+    compiled kernel and a patch arena — the router must keep them on
+    one replica; two classes may land anywhere."""
+    from ..sidecar.server import PATCH_LAYOUT_KEYS
+    return tuple(int(statics.get(k, 0)) for k in PATCH_LAYOUT_KEYS)
+
+
+def _score(endpoint: str, key: bytes) -> int:
+    h = hashlib.blake2b(digest_size=8)
+    h.update(endpoint.encode("utf-8", "surrogatepass"))
+    h.update(b"\x00")
+    h.update(key)
+    return int.from_bytes(h.digest(), "big")
+
+
+def owner_order(endpoints: Iterable[str], tenant: Optional[str],
+                shape: Tuple[int, ...]) -> List[str]:
+    """Full HRW ranking of ``endpoints`` for (tenant, shape-class):
+    element 0 is the affinity owner, the rest the deterministic
+    failover order. Ties (astronomically unlikely at 64 bits) break on
+    the endpoint string so the order is total either way."""
+    key = repr((tenant or "default", tuple(shape))).encode()
+    return sorted(endpoints,
+                  key=lambda ep: (_score(ep, key), ep), reverse=True)
+
+
+def owner(endpoints: Iterable[str], tenant: Optional[str],
+          shape: Tuple[int, ...]) -> Optional[str]:
+    order = owner_order(endpoints, tenant, shape)
+    return order[0] if order else None

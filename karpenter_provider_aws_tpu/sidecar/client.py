@@ -336,7 +336,6 @@ class RemoteSolver(TPUSolver):
         shared sidecar pool's admission/fair-scheduling layer."""
         super().__init__(backend=backend, n_max=n_max)
         if client is None:
-            import os
             if token is None:
                 token = os.environ.get("SOLVER_SIDECAR_TOKEN") or None
             if tenant is None:
@@ -365,6 +364,12 @@ class RemoteSolver(TPUSolver):
         #: the next dispatch re-primes
         self._patch_srv: "Optional[dict]" = None
         self._patch_token = (os.getpid() << 20) ^ next(_PATCH_TOKEN_SEQ)
+        #: binding generation: bumped by every bind_client(). Capability
+        #: flags and the residency prediction are evidence about ONE
+        #: peer — _caps_at records which binding earned them, so a
+        #: re-route can never silently apply them to the new replica
+        self._bind_gen = 0
+        self._caps_at: "Optional[tuple]" = None
         #: serializes encoder/pack-cache access between the tick
         #: pipeline's background prepare and any synchronous solve
         self._enc_lock = threading.RLock()
@@ -374,9 +379,55 @@ class RemoteSolver(TPUSolver):
         self._spec_pool = None
         from ..solver.route import AliveCache
         self._router.alive = AliveCache(self._ping)
+        #: router dev evidence is keyed by the endpoint serving it — a
+        #: re-route must never inherit the old peer's latency verdicts
+        self._router.endpoint = getattr(self.client, "address", None)
         pol = getattr(self.client, "policy", None)
         if pol is not None:
             pol.breaker.on_transition.append(self._on_breaker_transition)
+
+    # -- endpoint binding ------------------------------------------------
+    def _endpoint_id(self) -> tuple:
+        """Identity of the CURRENT wire binding. The generation counter
+        (not id(client)) disambiguates: a freed client's id() recycles,
+        and two replicas can even share an address through a proxy."""
+        return (getattr(self, "_bind_gen", 0),
+                getattr(self.client, "address", None))
+
+    def _caps_current(self) -> bool:
+        return self._caps_at == self._endpoint_id()
+
+    def bind_client(self, client: SolverClient) -> bool:
+        """Swap the wire peer (fleet failover/rebalance, or an explicit
+        re-route). ALL endpoint-scoped state dies with the old binding —
+        capability flags, the server-residency prediction, any armed
+        speculation, and the serialized-request residency the OLD
+        channel held — then one Info ping resolves the new peer's
+        capabilities. Returns that ping's liveness verdict. The old
+        client is left open: the caller (fleet membership) owns its
+        lifecycle and may bind back to it later."""
+        self._bind_gen += 1
+        self.client = client
+        self._pruned_ok = None
+        self._batch_ok = None
+        self._subsets_ok = None
+        self._patch_ok = None
+        self._patch_srv = None
+        self._caps_at = None
+        self._spec = None
+        self._router.endpoint = getattr(client, "address", None)
+        pol = getattr(client, "policy", None)
+        if pol is not None and self._on_breaker_transition \
+                not in pol.breaker.on_transition:
+            pol.breaker.on_transition.append(self._on_breaker_transition)
+        alive = self._router.alive
+        if self._ping():
+            if alive is not None:
+                alive.mark_ok()
+            return True
+        if alive is not None:
+            alive.mark_failed()
+        return False
 
     # -- breaker <-> router wiring --------------------------------------
     def _on_breaker_transition(self, old: str, new: str) -> None:
@@ -384,8 +435,13 @@ class RemoteSolver(TPUSolver):
         alive = self._router.alive
         if new == OPEN:
             # route every bucket to the host twin NOW — don't wait for
-            # each shape class to pay its own failed wire attempt
-            self._router.park_dev()
+            # each shape class to pay its own failed wire attempt. Under
+            # an endpoint binding only THAT peer's evidence parks: the
+            # rest of a fleet keeps the verdicts it earned
+            if self._router.endpoint is None:
+                self._router.park_dev()
+            else:
+                self._router.park_dev(endpoint=self._router.endpoint)
             if alive is not None:
                 alive.mark_failed()
         elif new == CLOSED and old != CLOSED:
@@ -457,11 +513,14 @@ class RemoteSolver(TPUSolver):
             self._subsets_ok = False
             self._patch_ok = False
             self._patch_srv = None
+            self._caps_at = self._endpoint_id()
             return False
         self._pruned_ok = bool(info.get("pruned", 0)) and devices == 1
         self._batch_ok = bool(info.get("batch", 0))
         self._subsets_ok = bool(info.get("subsets", 0))
         self._patch_ok = bool(info.get("patch", 0))
+        # the flags are evidence about THIS binding's peer only
+        self._caps_at = self._endpoint_id()
         # whatever server answered, our resident arena (if any) lived in
         # the PREVIOUS process — re-prime rather than patch into a void
         self._patch_srv = None
@@ -469,7 +528,7 @@ class RemoteSolver(TPUSolver):
 
     @property
     def supports_pruned_kernel(self) -> bool:
-        return bool(self._pruned_ok)
+        return bool(self._pruned_ok) and self._caps_current()
 
     @property
     def supports_batch_kernel(self) -> bool:
@@ -478,7 +537,7 @@ class RemoteSolver(TPUSolver):
         the preference relaxer's re-solves) then ride ONE round trip
         per shape bucket instead of B. An old server never sees the
         RPC; its clients keep the single-solve path."""
-        return bool(self._batch_ok)
+        return bool(self._batch_ok) and self._caps_current()
 
     @property
     def supports_subset_kernel(self) -> bool:
@@ -486,7 +545,7 @@ class RemoteSolver(TPUSolver):
         capability — the consolidation evaluator's whole-fleet search
         then rides ONE round trip per round. An old server never sees
         the RPC; its clients keep the sequential oracle."""
-        return bool(self._subsets_ok)
+        return bool(self._subsets_ok) and self._caps_current()
 
     def _dev_devices(self) -> int:
         """Always the packed wire dispatch: the SERVER owns the
@@ -566,8 +625,8 @@ class RemoteSolver(TPUSolver):
           (re)establish residency; warm ticks then ride deltas
 
         Returns {"frame", "kind", "version", "shape", "epoch",
-        "payload_words"}."""
-        if not self._patch_ok:
+        "payload_words", "endpoint"}."""
+        if not self._patch_ok or not self._caps_current():
             return None
         pc = getattr(self, "_pack_cache", None)
         if pc is None or pc.get("buf") is None or buf is not pc["buf"]:
@@ -613,7 +672,7 @@ class RemoteSolver(TPUSolver):
         # version check and costs one full Solve, never a stale solve
         self._patch_srv = dict(shape=shape, epoch=epoch, version=ver)
         return dict(frame=frame, kind=kind, version=ver, shape=shape,
-                    epoch=epoch,
+                    epoch=epoch, endpoint=self._endpoint_id(),
                     payload_words=sum(s1 - s0 for s0, s1 in spans))
 
     def _patch_fallback(self, reason: str) -> None:
@@ -629,6 +688,16 @@ class RemoteSolver(TPUSolver):
         the host twin serves, no extra wire attempt against a peer the
         policy just declared unavailable."""
         import grpc
+        if plan.get("endpoint") is not None \
+                and plan["endpoint"] != self._endpoint_id():
+            # planned against a peer we no longer talk to (failover or
+            # rebalance landed between prepare and dispatch): a patch
+            # frame must NEVER cross a re-route — the new replica holds
+            # nothing resident (and may not even speak the RPC). This
+            # tick rides one full Solve and the next plan re-primes.
+            self._patch_srv = None
+            self._patch_fallback("no_resident")
+            return None
         try:
             reply = self.client.solve_patch_buffer(plan["frame"])
         except SidecarUnavailable as e:

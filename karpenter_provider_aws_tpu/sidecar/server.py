@@ -1195,7 +1195,13 @@ if __name__ == "__main__":  # pragma: no cover
     import os
     import time
     logging.basicConfig(level=logging.INFO)
-    s = serve(token=os.environ.get("SOLVER_SIDECAR_TOKEN") or None,
+    # fleet replicas (chart: sidecar.replicaCount, a StatefulSet behind
+    # a headless Service) listen beyond loopback — an explicit env
+    # opt-in, same posture as token/TLS
+    s = serve(address=os.environ.get("SOLVER_SIDECAR_LISTEN",
+                                     "127.0.0.1"),
+              port=int(os.environ.get("SOLVER_SIDECAR_PORT", "50151")),
+              token=os.environ.get("SOLVER_SIDECAR_TOKEN") or None,
               tls_cert_file=os.environ.get("SOLVER_SIDECAR_TLS_CERT") or None,
               tls_key_file=os.environ.get("SOLVER_SIDECAR_TLS_KEY") or None)
     while True:

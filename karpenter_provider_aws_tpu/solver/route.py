@@ -36,10 +36,25 @@ ALPHA = 0.3          # EWMA weight of the newest observation
 REFRESH_EVERY = 512
 
 
+#: sentinel distinguishing park_dev() (park everything — the legacy
+#: whole-engine verdict) from park_dev(endpoint=None) (park the
+#: anonymous single-endpoint evidence only)
+_ALL_ENDPOINTS = object()
+
+
 class Router:
     def __init__(self, metrics=None, name: str = "solver"):
         self._mu = threading.Lock()
         self._stats: Dict[Tuple, Dict] = {}
+        #: per-(endpoint, bucket) dev evidence. The host twin is local
+        #: and shared, but "the dev engine" is a specific peer once a
+        #: fleet is in play: one slow or parked replica must never
+        #: poison the verdict the other replicas earned.
+        self._dev: Dict[Tuple, float] = {}
+        #: current endpoint context (fleet sets this on rebind). None =
+        #: the legacy single-endpoint mode: dev evidence lives in
+        #: _stats[bucket]["dev"] exactly as before.
+        self.endpoint: Optional[str] = None
         self.metrics = metrics
         self.name = name
         #: dev-engine liveness cache; None = the shared local-device
@@ -47,31 +62,74 @@ class Router:
         #: the gRPC peer, not local jax).
         self.alive: Optional["AliveCache"] = None
 
+    @staticmethod
+    def _blend(prev: Optional[float], ms: float) -> float:
+        # parking (ms >= DEV_FAILED_MS) and UN-parking (first healthy
+        # observation after a park) are ABSOLUTE, not EWMA-blended: a
+        # blend of 1e12 with anything real stays effectively-parked
+        # for ~90 observations, so a recovered dev engine would never
+        # win routing back within a refresh cycle
+        if prev is None or ms >= DEV_FAILED_MS or prev >= DEV_FAILED_MS:
+            return ms
+        return (1.0 - ALPHA) * prev + ALPHA * ms
+
     def observe(self, bucket: Tuple, side: str, ms: float) -> None:
         with self._mu:
             st = self._stats.setdefault(
                 bucket, {"host": None, "dev": None, "n": 0})
-            prev = st[side]
-            # parking (ms >= DEV_FAILED_MS) and UN-parking (first healthy
-            # observation after a park) are ABSOLUTE, not EWMA-blended: a
-            # blend of 1e12 with anything real stays effectively-parked
-            # for ~90 observations, so a recovered dev engine would never
-            # win routing back within a refresh cycle
-            if prev is None or ms >= DEV_FAILED_MS \
-                    or prev >= DEV_FAILED_MS:
-                st[side] = ms
-            else:
-                st[side] = (1.0 - ALPHA) * prev + ALPHA * ms
+            if side == "dev" and self.endpoint is not None:
+                key = (self.endpoint, bucket)
+                self._dev[key] = self._blend(self._dev.get(key), ms)
+                return
+            st[side] = self._blend(st[side], ms)
 
-    def park_dev(self, ms: float = None) -> None:
-        """Park the dev EWMA of EVERY bucket (circuit breaker opened: the
-        dev engine is down as a whole, not per shape class); the next
-        successful background probe un-parks per bucket via observe()."""
+    def _dev_of(self, bucket: Tuple, st: Dict) -> Optional[float]:
+        """Effective dev estimate for the CURRENT endpoint (lock held).
+
+        Own evidence wins; a replica with no history for this bucket
+        falls back to the aggregate of the other replicas' non-parked
+        estimates (a fresh scale-out replica inherits the fleet's
+        measured cost instead of re-calibrating every shape), and only
+        then to the legacy anonymous store."""
+        if self.endpoint is not None:
+            own = self._dev.get((self.endpoint, bucket))
+            if own is not None:
+                return own
+            peers = [v for (ep, b), v in self._dev.items()
+                     if b == bucket and v < DEV_FAILED_MS]
+            if peers:
+                return sum(peers) / len(peers)
+        return st["dev"]
+
+    def park_dev(self, ms: float = None, endpoint=_ALL_ENDPOINTS) -> None:
+        """Park dev EWMAs (circuit breaker opened); the next successful
+        background probe un-parks per bucket via observe().
+
+        No ``endpoint`` argument parks EVERY bucket of EVERY endpoint —
+        the dev engine is down as a whole. With ``endpoint=`` only that
+        replica's evidence is parked: the rest of the fleet keeps its
+        earned verdicts."""
         if ms is None:
             ms = DEV_FAILED_MS
         with self._mu:
-            for st in self._stats.values():
-                st["dev"] = ms
+            if endpoint is _ALL_ENDPOINTS:
+                for st in self._stats.values():
+                    st["dev"] = ms
+                for key in self._dev:
+                    self._dev[key] = ms
+                return
+            for bucket in self._stats:
+                self._dev[(endpoint, bucket)] = ms
+            for key in list(self._dev):
+                if key[0] == endpoint:
+                    self._dev[key] = ms
+
+    def forget_endpoint(self, endpoint: str) -> None:
+        """Drop a removed replica's evidence so the aggregate fallback
+        never averages in a peer that left the membership."""
+        with self._mu:
+            for key in [k for k in self._dev if k[0] == endpoint]:
+                del self._dev[key]
 
     def choose(self, bucket: Tuple):
         """"both" on first encounter, else ("host"|"dev", refresh_other)."""
@@ -79,14 +137,22 @@ class Router:
             st = self._stats.setdefault(
                 bucket, {"host": None, "dev": None, "n": 0})
             st["n"] += 1
-            if st["host"] is None or st["dev"] is None:
+            dev = self._dev_of(bucket, st)
+            if st["host"] is None or dev is None:
                 return "both"
-            side = "host" if st["host"] <= st["dev"] else "dev"
+            side = "host" if st["host"] <= dev else "dev"
             return side, (st["n"] % REFRESH_EVERY == 0)
 
     def snapshot(self) -> Dict[Tuple, Dict]:
+        """Per-bucket stats with ``dev`` resolved for the CURRENT
+        endpoint context (same shape as always: {bucket: {host,dev,n}})."""
         with self._mu:
-            return {k: dict(v) for k, v in self._stats.items()}
+            out = {}
+            for k, v in self._stats.items():
+                d = dict(v)
+                d["dev"] = self._dev_of(k, v)
+                out[k] = d
+            return out
 
 
 #: EWMA assigned to a device side that raised: effectively routes every

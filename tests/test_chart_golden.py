@@ -34,6 +34,16 @@ VALUE_SETS = {
                        "controller.solver=cpu",
                        "settings.interruptionQueue=golden-q",
                        "serviceMonitor.enabled=true"],
+    # the horizontal solver fleet (docs/fleet.md): a solver StatefulSet
+    # behind the headless Service with the shared compile-cache volume.
+    # One endpoint only — helm's --set splits on commas, so the
+    # multi-replica endpoint list is a values-file thing, not a --set
+    # thing; the template path is identical either way.
+    "fleet.yaml": ["settings.clusterName=golden-cluster",
+                   "sidecar.replicaCount=2",
+                   "sidecar.fleetEndpoints=solver-0.solver.karpenter:50151",
+                   "sidecar.sharedCache.enabled=true",
+                   "sidecar.token=golden-token"],
 }
 
 

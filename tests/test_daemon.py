@@ -193,6 +193,46 @@ class TestDaemon:
                 d.shutdown()
             server.stop(0)
 
+    def test_daemon_provisions_through_fleet(self):
+        """--solver-fleet-endpoints (chart: sidecar.fleetEndpoints) builds
+        a FleetSolver over the replica list — and takes precedence over
+        --solver-sidecar-address when both are set."""
+        from karpenter_provider_aws_tpu.apis.objects import (
+            EC2NodeClass, NodeClassRef, NodePool, NodePoolTemplate)
+        from karpenter_provider_aws_tpu.fleet import FleetSolver
+        from karpenter_provider_aws_tpu.sidecar.server import SolverServer
+        servers = [SolverServer().start() for _ in range(2)]
+        d = None
+        try:
+            eps = ",".join(s.address for s in servers)
+            d = Daemon(metrics_port=0, solver="tpu",
+                       sidecar_address="127.0.0.1:1",   # must be ignored
+                       fleet_endpoints=eps)
+            assert isinstance(d.operator.solver, FleetSolver)
+            assert sorted(d.operator.solver._fleet.addresses()) == \
+                sorted(s.address for s in servers)
+            d.start()
+            op = d.operator
+            op.kube.create(EC2NodeClass("fl-class"))
+            op.kube.create(NodePool("fl-pool", template=NodePoolTemplate(
+                node_class_ref=NodeClassRef("fl-class"))))
+            for p in make_pods(15, cpu="500m", memory="1Gi", prefix="fl"):
+                op.kube.create(p)
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                pods = op.kube.list("Pod")
+                if pods and all(p.node_name for p in pods):
+                    break
+                time.sleep(0.25)
+            pods = op.kube.list("Pod")
+            assert pods and all(p.node_name for p in pods), \
+                "fleet-backed daemon did not schedule pods"
+        finally:
+            if d is not None:
+                d.shutdown()
+            for s in servers:
+                s.stop(0)
+
     def test_leader_election_gates_controllers(self, tmp_path):
         path = str(tmp_path / "lease")
         holder = FileLease(path, identity="other", ttl=30.0)

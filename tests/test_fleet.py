@@ -1,0 +1,677 @@
+"""Horizontal solver fleet: shape-affine routing + chaos.
+
+The fleet contract, from the top of docs/fleet.md:
+
+- AFFINITY: a (tenant, shape-class) key routes to ONE replica via
+  rendezvous hashing — deterministic fleet-wide, minimal movement on
+  membership change — so warm ticks keep their hot kernels, bucketed
+  shapes, and server-resident patch arena on one peer.
+- FAILOVER: the ring gives a total preference order; a parked replica's
+  keys move to the SAME next peer for every client.
+- RE-PRIME: any binding move deliberately breaks the patch stream
+  (endpoint-scoped state clears) so the next tick rides PR 10's
+  no_resident path — ONE full Solve, never a stale delta —
+  and karpenter_solver_fleet_reprimes_total counts exactly those.
+- DEGRADATION: unchanged — a dead pick costs a wire attempt; the
+  bit-identical host twin serves; decisions stay oracle-identical
+  through every kill/flap/roll this file throws at the fleet.
+"""
+
+import random
+import time
+
+import numpy as np
+import pytest
+
+from karpenter_provider_aws_tpu.fake.environment import Environment, make_pods
+from karpenter_provider_aws_tpu.fake.faultwire import (FleetChaosPlan,
+                                                       downgrade_server)
+from karpenter_provider_aws_tpu.fleet import (FleetMembership, FleetSolver,
+                                              loopback_fleet, owner_order,
+                                              shape_class)
+from karpenter_provider_aws_tpu.sidecar import (RemoteSolver, SolverClient,
+                                                SolverServer)
+from karpenter_provider_aws_tpu.sidecar.resilience import (OPEN,
+                                                           CircuitBreaker,
+                                                           ResiliencePolicy,
+                                                           RetryPolicy)
+from karpenter_provider_aws_tpu.solver import CPUSolver
+from karpenter_provider_aws_tpu.solver.route import DEV_FAILED_MS, Router
+from karpenter_provider_aws_tpu.tenancy.admission import PatchArenaTable
+from karpenter_provider_aws_tpu.utils.metrics import Metrics
+
+
+@pytest.fixture(scope="module")
+def env():
+    return Environment()
+
+
+_SIG_SEQ = [0]
+
+
+def _churn_snaps(env, n_ticks, churn=2, seed=17, prefix=None, groups=8):
+    """Warm-tick replay: stable pod-group population, `churn` swaps per
+    tick — the delta-wire regime (same fixture family as
+    tests/test_patch_wire.py)."""
+    if prefix is None:
+        _SIG_SEQ[0] += 1
+        prefix = f"ft{_SIG_SEQ[0]}"
+    pool = env.nodepool(prefix)
+    sigs = [dict(cpu=f"{100 + (i * 7) % 400}m",
+                 memory=f"{256 + (i * 13) % 700}Mi",
+                 group=f"{prefix}g{i:03d}") for i in range(groups)]
+    rng = random.Random(seed)
+
+    def mk(gi):
+        return make_pods(1, cpu=sigs[gi]["cpu"], memory=sigs[gi]["memory"],
+                         prefix=sigs[gi]["group"], group=sigs[gi]["group"])
+
+    cur = []
+    for gi in range(len(sigs)):
+        for _ in range(2):
+            cur.extend(mk(gi))
+    snaps = [env.snapshot(list(cur), [pool])]
+    for _ in range(n_ticks - 1):
+        for _ in range(churn):
+            cur.pop(rng.randrange(len(cur)))
+            cur.extend(mk(rng.randrange(len(sigs))))
+        snaps.append(env.snapshot(list(cur), [pool]))
+    return snaps
+
+
+def _oracle_prints(snaps):
+    oracle = CPUSolver()
+    return [oracle.solve(s).decision_fingerprint() for s in snaps]
+
+
+def _policy_factory(max_attempts=2, threshold=2, cooldown_s=60.0):
+    def pf(address):
+        return ResiliencePolicy(
+            retry=RetryPolicy(max_attempts=max_attempts,
+                              sleep=lambda s: None),
+            breaker=CircuitBreaker(threshold=threshold,
+                                   cooldown_s=cooldown_s))
+    return pf
+
+
+def _fleet(n, metrics=None, tenant="t1", seed_policy=True, **kw):
+    servers = [SolverServer(metrics=metrics).start() for _ in range(n)]
+    ms = FleetMembership(
+        [s.address for s in servers],
+        policy_factory=_policy_factory() if seed_policy else None)
+    solver = FleetSolver(membership=ms, n_max=64, backend="jax",
+                         tenant=tenant, metrics=metrics, **kw)
+    for a in ms.addresses():
+        ms.get(a).client.timeout = 5.0
+    solver._router.alive.mark_ok()
+    return servers, solver
+
+
+def _stop_all(servers, solver):
+    solver.close()
+    for s in servers:
+        try:
+            s.stop()
+        except Exception:
+            pass
+
+
+def _count(metrics, name, **labels):
+    total = 0.0
+    for (n, lbl), v in metrics.counters.items():
+        if n == name and all(dict(lbl).get(k) == want
+                             for k, want in labels.items()):
+            total += v
+    return total
+
+
+# ---------------------------------------------------------------------------
+# ring
+
+
+class TestRing:
+    def test_order_is_deterministic_and_total(self):
+        eps = [f"replica-{i}:50151" for i in range(5)]
+        for tenant in ("a", "b", None):
+            for shape in ((1, 2, 3), (4,) * 10):
+                o1 = owner_order(eps, tenant, shape)
+                o2 = owner_order(list(reversed(eps)), tenant, shape)
+                assert o1 == o2  # input order never matters
+                assert sorted(o1) == sorted(eps)  # total order
+
+    def test_minimal_disruption_on_leave(self):
+        """Removing one replica re-homes ONLY the keys it owned; every
+        other key keeps its owner AND its full failover order — the
+        HRW property the patch arenas' survival depends on."""
+        eps = [f"r{i}:1" for i in range(4)]
+        keys = [("t%d" % (i % 5), (i, i * 7 % 13, 3)) for i in range(60)]
+        gone = eps[2]
+        for tenant, shape in keys:
+            before = owner_order(eps, tenant, shape)
+            after = owner_order([e for e in eps if e != gone],
+                                tenant, shape)
+            assert after == [e for e in before if e != gone]
+
+    def test_spread_across_replicas(self):
+        eps = [f"r{i}:1" for i in range(4)]
+        owners = {owner_order(eps, f"tenant-{i}", (8, 16, 4))[0]
+                  for i in range(40)}
+        assert len(owners) >= 3  # 40 tenants land on >=3 of 4 replicas
+
+    def test_shape_class_is_patch_layout(self):
+        from karpenter_provider_aws_tpu.sidecar.server import \
+            PATCH_LAYOUT_KEYS
+        st = {k: i + 1 for i, k in enumerate(PATCH_LAYOUT_KEYS)}
+        st["unrelated"] = 99
+        assert shape_class(st) == tuple(
+            st[k] for k in PATCH_LAYOUT_KEYS)
+
+
+# ---------------------------------------------------------------------------
+# per-endpoint router evidence (satellite: the shared-verdict poisoning fix)
+
+
+class TestRouterPerEndpoint:
+    def test_slow_replica_does_not_poison_peer_verdict(self):
+        r = Router()
+        b = ("shape",)
+        r.endpoint = "fast:1"
+        r.observe(b, "host", 50.0)
+        r.observe(b, "dev", 1.0)
+        r.endpoint = "slow:1"
+        for _ in range(10):
+            r.observe(b, "dev", 5000.0)
+        assert r.choose(b)[0] == "host"  # slow replica routes host
+        r.endpoint = "fast:1"
+        assert r.snapshot()[b]["dev"] == 1.0  # untouched by the peer
+        assert r.choose(b)[0] == "dev"
+
+    def test_park_endpoint_leaves_peers_routed(self):
+        r = Router()
+        b = ("shape",)
+        for ep in ("a:1", "b:1"):
+            r.endpoint = ep
+            r.observe(b, "dev", 2.0)
+        r.park_dev(endpoint="a:1")
+        r.endpoint = "a:1"
+        assert r.snapshot()[b]["dev"] == DEV_FAILED_MS
+        r.endpoint = "b:1"
+        assert r.snapshot()[b]["dev"] == 2.0
+
+    def test_fresh_endpoint_inherits_aggregate(self):
+        """A scale-out replica with no history starts from the fleet's
+        non-parked mean instead of re-calibrating (and a parked peer is
+        excluded from that mean)."""
+        r = Router()
+        b = ("shape",)
+        r.endpoint = "a:1"
+        r.observe(b, "dev", 10.0)
+        r.endpoint = "b:1"
+        r.observe(b, "dev", 30.0)
+        r.park_dev(endpoint="b:1")
+        r.endpoint = "new:1"
+        assert r.snapshot()[b]["dev"] == 10.0  # a's evidence only
+
+    def test_forget_endpoint_drops_evidence(self):
+        r = Router()
+        b = ("shape",)
+        r.endpoint = "a:1"
+        r.observe(b, "dev", 10.0)
+        r.forget_endpoint("a:1")
+        r.endpoint = "new:1"
+        assert r.snapshot()[b]["dev"] is None
+
+    def test_legacy_single_endpoint_untouched(self):
+        """endpoint=None keeps the exact pre-fleet semantics (pinned
+        separately by tests/test_resilience.py park/unpark tests)."""
+        r = Router()
+        b = ("b",)
+        r.observe(b, "dev", 10.0)
+        r.observe(b, "host", 20.0)
+        assert r.choose(b)[0] == "dev"
+        r.park_dev()
+        assert r.snapshot()[b]["dev"] == DEV_FAILED_MS
+
+
+# ---------------------------------------------------------------------------
+# membership
+
+
+class TestMembership:
+    def test_env_config(self, monkeypatch):
+        from karpenter_provider_aws_tpu.fleet import endpoints_from_env
+        monkeypatch.setenv("SOLVER_FLEET_ENDPOINTS",
+                           "s-0.solver:50151, s-1.solver:50151")
+        assert endpoints_from_env() == ["s-0.solver:50151",
+                                        "s-1.solver:50151"]
+        monkeypatch.setenv("SOLVER_FLEET_ENDPOINTS", "")
+        monkeypatch.setenv("SOLVER_SIDECAR_ADDRESS", "one:50151")
+        assert endpoints_from_env() == ["one:50151"]
+
+    def test_breaker_open_parks_only_that_replica(self):
+        ms = FleetMembership(["a:1", "b:1"],
+                             policy_factory=_policy_factory(threshold=1))
+        router = Router()
+        ms.router = router
+        b = ("shape",)
+        for ep in ("a:1", "b:1"):
+            router.endpoint = ep
+            router.observe(b, "dev", 2.0)
+        pol = ms.get("a:1").policy
+        with pytest.raises(Exception):
+            pol.call(lambda d: (_ for _ in ()).throw(
+                _unavailable()), rpc="Solve")
+        assert pol.breaker.state == OPEN
+        assert not ms.routable("a:1")
+        assert ms.routable("b:1")
+        router.endpoint = "a:1"
+        assert router.snapshot()[b]["dev"] == DEV_FAILED_MS
+        router.endpoint = "b:1"
+        assert router.snapshot()[b]["dev"] == 2.0
+        ms.close()
+
+    def test_probe_records_health_and_caps(self):
+        srv = SolverServer().start()
+        ms = FleetMembership([srv.address, "127.0.0.1:1"],
+                             policy_factory=_policy_factory(threshold=50))
+        try:
+            assert ms.probe(srv.address) is True
+            assert ms.get(srv.address).caps.get("patch") is True
+            assert ms.probe("127.0.0.1:1", timeout=0.5) is False
+            assert not ms.routable("127.0.0.1:1")
+            assert ms.alive() == [srv.address]
+        finally:
+            ms.close()
+            srv.stop()
+
+    def test_replicas_gauge_follows_membership(self):
+        m = Metrics()
+        ms = FleetMembership(["a:1", "b:1"], metrics=m,
+                             policy_factory=_policy_factory())
+        assert m.gauge("karpenter_solver_fleet_replicas") == 2.0
+        ms.remove("a:1")
+        assert m.gauge("karpenter_solver_fleet_replicas") == 1.0
+        ms.add("c:1")
+        assert m.gauge("karpenter_solver_fleet_replicas") == 2.0
+        ms.close()
+
+
+def _unavailable():
+    from karpenter_provider_aws_tpu.fake.faultwire import _injected_error
+    import grpc
+    return _injected_error(grpc.StatusCode.UNAVAILABLE, "test: down")
+
+
+# ---------------------------------------------------------------------------
+# endpoint-tied capabilities (satellite regression: no SolvePatch frame
+# may ever ship to a legacy replica after failover)
+
+
+class TestEndpointCaps:
+    def test_bind_client_clears_endpoint_state(self):
+        srv = SolverServer().start()
+        try:
+            remote = RemoteSolver(srv.address, n_max=64, backend="jax")
+            remote._router.alive.mark_ok()
+            assert remote._ping()
+            assert remote.supports_batch_kernel
+            remote._patch_srv = dict(shape=(1,), epoch=(0, 0), version=3)
+            old_gen = remote._bind_gen
+            assert remote.bind_client(SolverClient(srv.address))
+            assert remote._bind_gen == old_gen + 1
+            assert remote._patch_srv is None  # residency prediction died
+            assert remote.supports_batch_kernel  # re-resolved by the ping
+        finally:
+            srv.stop()
+
+    def test_stale_caps_never_apply_across_rebind(self):
+        """Flags resolved under binding N must read False under binding
+        N+1 until ITS ping lands — even if the attribute survives."""
+        srv = SolverServer().start()
+        try:
+            remote = RemoteSolver(srv.address, n_max=64, backend="jax")
+            remote._router.alive.mark_ok()
+            assert remote._ping()
+            assert remote.supports_subset_kernel
+            # simulate a re-route that somehow skipped the flag clear:
+            remote._bind_gen += 1
+            assert not remote.supports_subset_kernel
+            assert not remote.supports_batch_kernel
+            assert remote._patch_plan(np.zeros(4, dtype=np.int64),
+                                      {}) is None
+        finally:
+            srv.stop()
+
+    def test_failover_to_legacy_ships_no_patch_frame(self, env):
+        """THE regression: warm patch stream against a patch-capable
+        replica, then failover to a legacy build — zero SolvePatch
+        frames may reach the legacy peer, decisions stay oracle-
+        identical, and the flags re-resolve to the legacy truth."""
+        modern = SolverServer().start()
+        legacy = SolverServer().start()
+        restore = downgrade_server(legacy, drop=("patch",))
+        arrivals = {"patch": 0}
+        # downgrade_server already swapped solve_patch for the
+        # UNIMPLEMENTED shim; count around THAT so any arrival at all
+        # is visible even though it would be rejected
+        shim = legacy._handler.solve_patch
+
+        def counting_shim(request, context):
+            arrivals["patch"] += 1
+            return shim(request, context)
+        legacy._handler.solve_patch = counting_shim
+        try:
+            m = Metrics()
+            remote = RemoteSolver(modern.address, n_max=64,
+                                  backend="jax")
+            remote.metrics = m
+            remote._router.alive.mark_ok()
+            assert remote._ping()
+            snaps = _churn_snaps(env, 8)
+            oracle = _oracle_prints(snaps)
+            got = [remote.solve(s).decision_fingerprint()
+                   for s in snaps[:4]]
+            assert _count(m, "karpenter_solver_wire_patch_total") > 0
+            # failover: rebind onto the legacy replica
+            assert remote.bind_client(SolverClient(legacy.address))
+            assert not remote._patch_ok  # legacy Info has no flag
+            got += [remote.solve(s).decision_fingerprint()
+                    for s in snaps[4:]]
+            assert got == oracle
+            assert arrivals["patch"] == 0
+        finally:
+            restore()
+            modern.stop()
+            legacy.stop()
+
+
+# ---------------------------------------------------------------------------
+# FleetSolver behavior
+
+
+class TestFleetSteady:
+    def test_warm_ticks_stay_on_one_replica_and_ride_deltas(self, env):
+        m = Metrics()
+        servers, solver = _fleet(2, metrics=m)
+        try:
+            snaps = _churn_snaps(env, 8)
+            got = [solver.solve(s).decision_fingerprint() for s in snaps]
+            assert got == _oracle_prints(snaps)
+            # warm ticks pinned: once bound, every dispatch is affinity
+            # on ONE replica
+            per_replica = {}
+            for (n, lbl), v in m.counters.items():
+                if n == "karpenter_solver_fleet_routed_total":
+                    per_replica.setdefault(
+                        dict(lbl)["replica"], 0)
+                    per_replica[dict(lbl)["replica"]] += v
+            assert per_replica.get(solver._bound, 0) >= len(snaps) - 1
+            # and they ride the delta wire, not full frames
+            assert _count(m, "karpenter_solver_wire_patch_total",
+                          kind="delta") > 0
+            assert _count(
+                m, "karpenter_solver_fleet_reprimes_total") == 0
+        finally:
+            _stop_all(servers, solver)
+
+    def test_two_tenants_can_land_on_distinct_replicas(self, env):
+        """The load-spreading half of affinity: tenants hash
+        independently, so SOME tenant pair splits across a 2-fleet.
+        (Seeded fixture: these two do.)"""
+        servers = [SolverServer().start() for _ in range(2)]
+        addrs = [s.address for s in servers]
+        shape = (1, 2, 3, 4, 5, 6, 7, 8, 9, 10)
+        owners = {owner_order(addrs, f"tenant-{i}", shape)[0]
+                  for i in range(16)}
+        for s in servers:
+            s.stop()
+        assert owners == set(addrs)
+
+
+class TestFleetKill:
+    def test_kill_mid_patch_stream(self, env):
+        """Kill the bound owner mid-stream: the killed tick degrades to
+        the host twin (fingerprint-identical), the next binds the ring's
+        next replica, exactly ONE re-prime is counted, and the delta
+        stream resumes on the new owner."""
+        m = Metrics()
+        servers, solver = _fleet(2, metrics=m)
+        try:
+            snaps = _churn_snaps(env, 12)
+            oracle = _oracle_prints(snaps)
+            got = [solver.solve(s).decision_fingerprint()
+                   for s in snaps[:6]]
+            assert _count(m, "karpenter_solver_wire_patch_total",
+                          kind="delta") > 0
+            bound = solver._bound
+            for s in servers:
+                if s.address == bound:
+                    s.stop()
+            got += [solver.solve(s).decision_fingerprint()
+                    for s in snaps[6:]]
+            assert got == oracle
+            assert solver._bound != bound
+            assert _count(
+                m, "karpenter_solver_fleet_reprimes_total") == 1.0
+            assert _count(m, "karpenter_solver_fleet_routed_total",
+                          reason="failover") > 0
+            # the break cost exactly one full Solve: one transport
+            # fallback on the dying patch, then the new owner was
+            # re-primed and deltas resumed
+            assert _count(m, "karpenter_solver_wire_fallback_total",
+                          reason="transport") == 1.0
+            hist = m.histograms.get(
+                ("karpenter_solver_fleet_handoff_ms", ()))
+            assert hist and len(hist) >= 1
+        finally:
+            _stop_all(servers, solver)
+
+
+class TestFleetFlap:
+    def test_membership_flap_rebalances_and_reprimes(self, env):
+        """Flap the bound owner OUT of membership (config re-render) and
+        back IN: both moves are planned rebalances, each breaking the
+        stream costs one counted re-prime, decisions never diverge."""
+        m = Metrics()
+        servers, solver = _fleet(2, metrics=m)
+        ms = solver._fleet
+        try:
+            snaps = _churn_snaps(env, 14)
+            oracle = _oracle_prints(snaps)
+            got = [solver.solve(s).decision_fingerprint()
+                   for s in snaps[:5]]
+            home = solver._bound
+            rep = ms.get(home)
+            ms.remove(home)
+            got += [solver.solve(s).decision_fingerprint()
+                    for s in snaps[5:10]]
+            assert solver._bound != home
+            assert _count(m, "karpenter_solver_fleet_routed_total",
+                          reason="rebalance") > 0
+            reprimes_mid = _count(
+                m, "karpenter_solver_fleet_reprimes_total")
+            assert reprimes_mid == 1.0
+            ms.add(home, client=rep.client)  # flap back in
+            got += [solver.solve(s).decision_fingerprint()
+                    for s in snaps[10:]]
+            assert solver._bound == home  # the ring owner reclaims
+            assert got == oracle
+            assert _count(
+                m, "karpenter_solver_fleet_reprimes_total") == 2.0
+        finally:
+            _stop_all(servers, solver)
+
+
+class TestFleetRoll:
+    def test_roll_owner_to_legacy_build(self, env):
+        """Roll the bound owner to a build without `patch` mid-stream:
+        the first patch after the roll is answered UNIMPLEMENTED, the
+        tick rides one full Solve, the flag clears, and NO further
+        SolvePatch frame ships — while decisions stay oracle-identical."""
+        m = Metrics()
+        servers, solver = _fleet(2, metrics=m)
+        try:
+            snaps = _churn_snaps(env, 12)
+            oracle = _oracle_prints(snaps)
+            got = [solver.solve(s).decision_fingerprint()
+                   for s in snaps[:6]]
+            owner_srv = next(s for s in servers
+                             if s.address == solver._bound)
+            restore = downgrade_server(owner_srv, drop=("patch",))
+            arrivals = {"n": 0}
+            shim = owner_srv._handler.solve_patch
+
+            def counting(request, context):
+                arrivals["n"] += 1
+                return shim(request, context)
+            owner_srv._handler.solve_patch = counting
+            got += [solver.solve(s).decision_fingerprint()
+                    for s in snaps[6:]]
+            assert got == oracle
+            # exactly the one in-flight patch hit the rolled build;
+            # after its UNIMPLEMENTED verdict the gate closed for good
+            assert arrivals["n"] == 1
+            assert not solver._patch_ok
+            assert _count(m, "karpenter_solver_wire_fallback_total",
+                          reason="unimplemented") == 1.0
+            restore()
+        finally:
+            _stop_all(servers, solver)
+
+
+# ---------------------------------------------------------------------------
+# PatchArenaTable two-replica isolation (satellite: tenancy/admission.py)
+
+
+class TestPatchArenaTwoReplica:
+    KEY_A = ("tenant-a", (1, 2, 3), 7, (0, 0))
+    KEY_B = ("tenant-b", (1, 2, 3), 9, (0, 0))
+
+    def test_arenas_never_cross_replicas(self):
+        """Each replica process owns its own table: residency primed on
+        replica 1 is invisible to replica 2 (the client's re-prime on
+        failover is CORRECT behavior, not an optimization gap)."""
+        t1, t2 = PatchArenaTable(), PatchArenaTable()
+        buf = np.arange(16, dtype=np.int64)
+        assert t1.prime(self.KEY_A, buf, version=4, tenant="tenant-a")
+        assert t1.version_of(self.KEY_A) == 4
+        assert t2.version_of(self.KEY_A) is None  # never crossed
+        assert len(t2) == 0
+
+    def test_eviction_attribution_per_tenant_per_replica(self):
+        """Evictions bill the admitting tenant ON THE REPLICA that
+        evicted — replica 2's registry never sees replica 1's churn."""
+        clock = [0.0]
+        m1, m2 = Metrics(), Metrics()
+        t1 = PatchArenaTable(capacity=2, min_idle_s=0.0, metrics=m1,
+                             clock=lambda: clock[0])
+        t2 = PatchArenaTable(capacity=2, min_idle_s=0.0, metrics=m2,
+                             clock=lambda: clock[0])
+        buf = np.arange(8, dtype=np.int64)
+        assert t1.prime(self.KEY_A, buf, version=1, tenant="tenant-a")
+        clock[0] += 1.0
+        assert t1.prime(self.KEY_B, buf, version=1, tenant="tenant-b")
+        clock[0] += 1.0
+        # replica 1 overflows: the LRU entry (tenant-a's) is evicted
+        # and billed to tenant-a on m1
+        assert t1.prime(("tenant-c", (9,), 1, (0, 0)), buf, version=1,
+                        tenant="tenant-c")
+        assert _count(m1,
+                      "karpenter_solver_wire_resident_evictions_total",
+                      tenant="tenant-a", reason="lru") == 1.0
+        assert _count(m2,
+                      "karpenter_solver_wire_resident_evictions_total"
+                      ) == 0.0
+        # replica 2 still has capacity for the same tenants
+        assert t2.prime(self.KEY_A, buf, version=1, tenant="tenant-a")
+        assert t2.version_of(self.KEY_A) == 1
+        assert t1.version_of(self.KEY_A) is None  # evicted there
+
+
+# ---------------------------------------------------------------------------
+# the seeded multi-replica chaos sweep (slow tier; hack/chaosfleet.sh)
+
+
+CHAOS_SEEDS = (3, 7, 11, 17, 23)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", CHAOS_SEEDS)
+def test_fleet_chaos_sweep(env, seed):
+    """Seeded kill/flap/roll sweep over a 3-replica fleet: every tick's
+    decision lands fingerprint-identical to the CPU oracle, per-tick
+    wall time stays bounded, and every counted re-prime corresponds to
+    a binding move that broke an active stream (never more than the
+    disruptions the schedule applied)."""
+    m = Metrics()
+    servers, solver = _fleet(3, metrics=m)
+    ms = solver._fleet
+    plan = FleetChaosPlan(seed)
+    killed, flapped, rolled = [], [], {}
+    moves = revives = stream_moves = 0  # stream_moves: a kill/flap
+    # that lands while a patch stream is live MUST cost one re-prime
+    try:
+        snaps = _churn_snaps(env, 24, seed=seed)
+        oracle = _oracle_prints(snaps)
+        tick_ms = []
+        for i, snap in enumerate(snaps):
+            action = plan.next(i)
+            if action == "kill" and len(killed) < len(servers) - 1:
+                srv = next((s for s in servers
+                            if s.address == solver._bound
+                            and s.address not in killed), None)
+                if srv is not None:
+                    if solver._stream_active \
+                            or solver._patch_srv is not None:
+                        stream_moves += 1
+                    srv.stop()
+                    killed.append(srv.address)
+                    moves += 1
+            elif action == "revive":
+                if flapped:
+                    addr, rep = flapped.pop()
+                    ms.add(addr, client=rep.client)
+                    revives += 1  # the ring owner may reclaim its keys
+                elif rolled:
+                    addr, restore = rolled.popitem()
+                    restore()
+            elif action == "flap" and len(ms.addresses()) > 1:
+                addr = solver._bound
+                if addr not in killed and addr in ms.addresses():
+                    if solver._stream_active \
+                            or solver._patch_srv is not None:
+                        stream_moves += 1
+                    rep = ms.get(addr)
+                    ms.remove(addr)
+                    flapped.append((addr, rep))
+                    moves += 1
+            elif action == "roll":
+                # rolls degrade a replica's BUILD, not the binding: a
+                # rolled owner costs one unimplemented fallback, never
+                # a re-prime
+                live = [s for s in servers
+                        if s.address not in killed
+                        and s.address not in rolled]
+                if live:
+                    srv = live[0]
+                    rolled[srv.address] = downgrade_server(
+                        srv, drop=("patch",))
+            t0 = time.perf_counter()
+            got = solver.solve(snap).decision_fingerprint()
+            tick_ms.append((time.perf_counter() - t0) * 1e3)
+            assert got == oracle[i], \
+                f"seed {seed} tick {i} diverged after {action}"
+        reprimes = _count(m, "karpenter_solver_fleet_reprimes_total")
+        # every counted re-prime must correspond to a binding move:
+        # a kill/flap moves the stream off the owner, a revive may move
+        # it back; +1 slack for the initial ring placement
+        assert reprimes <= moves + revives + 1
+        if stream_moves and len(killed) < len(servers):
+            assert reprimes >= 1
+        tick_ms.sort()
+        p99 = tick_ms[int(0.99 * (len(tick_ms) - 1))]
+        # generous CI bound: the point is no unbounded stall (a hung
+        # failover would sit on a 5s deadline * retries)
+        assert p99 < 30_000, f"seed {seed} p99 {p99:.0f}ms unbounded"
+    finally:
+        _stop_all(servers, solver)

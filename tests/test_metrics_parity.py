@@ -508,6 +508,36 @@ def emitted(tmp_path_factory):
     finally:
         _psrv.stop()
 
+    # solver-fleet families: a 2-replica loopback fleet — the replica
+    # gauge + affinity routing on warm ticks, then a membership flap
+    # moves the binding off a live patch stream: one rebalance route,
+    # one handoff sample, one counted re-prime
+    from karpenter_provider_aws_tpu.fleet import (FleetMembership,
+                                                  FleetSolver)
+    _fsrvs = [SolverServer(metrics=op.metrics).start() for _ in range(2)]
+    try:
+        _fms = FleetMembership([s.address for s in _fsrvs],
+                               metrics=op.metrics)
+        _fsolver = FleetSolver(membership=_fms, n_max=64, backend="jax",
+                               tenant="parity-fleet", metrics=op.metrics)
+        _fsolver._router.alive.mark_ok()
+        _fenv = _DeltaEnv()
+        _fpool = _fenv.nodepool("parity-fleet")
+        _fpods = make_pods(6, cpu="500m", memory="1Gi", prefix="pf",
+                           group="pf")
+        _fsolver.solve(_fenv.snapshot(_fpods, [_fpool]))  # routed{affinity}
+        _fsolver.solve(_fenv.snapshot(_fpods, [_fpool]))  # stream live
+        _fms.remove(_fsolver._bound)                      # flap owner out
+        _fsolver.solve(_fenv.snapshot(
+            _fpods, [_fpool]))  # routed{rebalance} + handoff + re-prime
+        _fsolver.close()
+    finally:
+        for _s in _fsrvs:
+            try:
+                _s.stop()
+            except Exception:
+                pass
+
     # device-native consolidation families: one whole-fleet subset
     # dispatch on the live cluster (subset_batch + device_rounds), then
     # a numpy-backend evaluator refusing the same round (host_fallback)
