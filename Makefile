@@ -62,6 +62,7 @@ benchmark: native-try  ## the five BASELINE configs + interruption + batch dispa
 	python bench.py --patch-wire
 	python bench.py --tenant-mix
 	python bench.py --mesh-batch
+	python bench.py --multihost --rounds 5
 	python bench.py --fleet
 	python bench.py --consolidate-solve --consolidate-nodes 240 --rounds 5
 
@@ -74,7 +75,10 @@ consolidate-evidence:  ## full 1000-node fleet: 2000 lanes, ONE dispatch/round
 multichip:  ## multi-device solve: driver dryrun + mesh parity suites
 	sh hack/multichip.sh
 
+multihost:  ## multi-PROCESS distributed mesh: 1M-pod ceiling + chaos + suite
+	sh hack/multihost.sh
+
 daemon:  ## run the operator against the in-memory cloud
 	python -m karpenter_provider_aws_tpu --cluster-name dev --metrics-port 8080
 
-.PHONY: test test-all scale deflake benchmark consolidate-evidence multichip daemon chart chaos chaoscloud chaos-tenant chaos-patch chaos-fleet fuzz-delta fuzz-consolidate native native-try aot-prime
+.PHONY: test test-all scale deflake benchmark consolidate-evidence multichip multihost daemon chart chaos chaoscloud chaos-tenant chaos-patch chaos-fleet fuzz-delta fuzz-consolidate native native-try aot-prime

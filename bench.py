@@ -2208,6 +2208,92 @@ def run_mesh_batch_inner(batch, rounds):
     }}), flush=True)
 
 
+def run_multihost_bench(rounds=5):
+    """Cross-process distributed mesh evidence: the SAME dp x tp solve
+    on one process x 8 devices vs two processes x 16 devices
+    (parallel/distmesh.py), identical decisions both arms, with the
+    distributed arm's per-tick commit/solve/gather split and the
+    analytic cross-process collective bill. Runs in a subprocess
+    because the virtual-device-count XLA flag is read once, at backend
+    init (and the distributed arm spawns its own worker processes)."""
+    import os
+    import subprocess
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = \
+            (flags + " --xla_force_host_platform_device_count=8").strip()
+    cmd = [sys.executable, __file__, "--multihost-inner",
+           "--rounds", str(rounds)]
+    proc = subprocess.run(cmd, capture_output=True, text=True,
+                          timeout=1200, env=env)
+    if proc.returncode != 0:
+        return {"multihost": {"ok": False,
+                              "stderr_tail": proc.stderr[-2000:]}}
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def run_multihost_inner(rounds):
+    """Subprocess body for --multihost. Both arms run the seeded tick
+    workload (full placement, then `rounds` dirty-field patch ticks)
+    at a dp2-engaged shape; per-tick fingerprints must agree across
+    arms before any number is reported."""
+    from karpenter_provider_aws_tpu.fleet.meshgroup import MeshGroup
+    from karpenter_provider_aws_tpu.parallel import distmesh
+
+    # N = E + n_max = 2112 slots: past the dp2 floor, so BOTH arms run
+    # the same 2-D kernel — the comparison is mesh topology, not kernel
+    shape = dict(G=16, T=96, n_max=2048, E=64, P=2, Z=3, C=2, D=4,
+                 pods_per_group=480)
+    seed = 11
+    dirty = list(distmesh.DIRTY_FIELDS)
+
+    def arm(workers):
+        mg = MeshGroup(workers=workers, local_devices=8).start()
+        try:
+            if workers > 0:
+                assert mg.alive(), "distributed arm failed to form"
+            t0 = time.perf_counter()
+            r0 = mg.solve_seeded(shape, seed=seed, tick=0)
+            full_s = time.perf_counter() - t0
+            fps = [r0["fingerprint"]]
+            ticks, timing = [], {}
+            for t in range(1, rounds + 1):
+                t0 = time.perf_counter()
+                r = mg.solve_seeded(shape, seed=seed, tick=t,
+                                    dirty=dirty)
+                ticks.append((time.perf_counter() - t0) * 1e3)
+                assert r["mode"] == "patch", r["mode"]
+                fps.append(r["fingerprint"])
+                timing = r.get("timing") or timing
+            ndev = (mg.mesh_info or {}).get("ndev", 8)
+            dp = (mg.mesh_info or {}).get("dp")
+            p50, p99 = _percentiles(ticks)
+            return {"processes": workers + 1, "ndev": ndev, "dp": dp,
+                    "full_s": round(full_s, 2),
+                    "patch_p50_ms": p50, "patch_p99_ms": p99,
+                    "timing": {k: round(v, 4)
+                               for k, v in timing.items()}}, fps
+        finally:
+            mg.stop()
+
+    local, fps1 = arm(0)
+    dist, fps2 = arm(1)
+    bill = distmesh.collective_bill(shape["P"], dist["dp"] or 4, 2,
+                                    shape["G"])
+    print(json.dumps({"multihost": {
+        "ok": True, "rounds": rounds, "shape_pods":
+            int(shape["G"] * shape["pods_per_group"]),
+        "identical_decisions": fps1 == fps2,
+        "p1x8": local, "p2x16": dist,
+        "cross_process_per_step": bill["cross_process_per_step"],
+        "cross_process_total": bill["cross_process_total"],
+    }}), flush=True)
+    assert fps1 == fps2, "arms diverged"
+
+
 def run_interruption_bench(counts=(100, 1000, 5000, 15000)):
     """Messages/Second at the reference benchmark's message counts
     (interruption_benchmark_test.go:58-157): N claims with instances, N
@@ -2359,6 +2445,14 @@ def main():
                          "device, with per-lane byte identity")
     ap.add_argument("--mesh-batch-inner", action="store_true",
                     help=argparse.SUPPRESS)  # subprocess body (env-pinned)
+    ap.add_argument("--multihost", action="store_true",
+                    help="bench the cross-process distributed mesh: one "
+                         "process x 8 devices vs two processes x 16 "
+                         "devices on the same dp2 solve, identical "
+                         "decisions both arms, with the cross-process "
+                         "collective split")
+    ap.add_argument("--multihost-inner", action="store_true",
+                    help=argparse.SUPPRESS)  # subprocess body (env-pinned)
     ap.add_argument("--probe-device", action="store_true",
                     help="link-vs-kernel decomposition of the device path")
     ap.add_argument("--device-kernel", action="store_true",
@@ -2420,6 +2514,13 @@ def main():
         return
     if args.mesh_batch_inner:
         run_mesh_batch_inner(batch=args.batch, rounds=min(args.rounds, 30))
+        return
+    if args.multihost_inner:
+        run_multihost_inner(rounds=min(args.rounds, 10))
+        return
+    if args.multihost:
+        print(json.dumps(run_multihost_bench(
+            rounds=min(args.rounds, 10))))
         return
     if args.mesh_batch:
         print(json.dumps(run_mesh_batch_bench(

@@ -10,7 +10,10 @@
 #   B=16 batch of dp-sharded packed lanes vs their sequential solves;
 # - the mesh test suites: every dp x tp factorization, sum-only
 #   collectives, resident sharded arena lifecycle (full/patch/reuse),
-#   and the bucketed byte-identity fuzz through a live mesh server.
+#   and the bucketed byte-identity fuzz through a live mesh server;
+# - the distmesh dryrun: the cross-PROCESS dp x tp mesh (2 OS
+#   processes joined by jax.distributed) solving the seeded tick
+#   workload fingerprint-identical to the oracle (hack/multihost.py).
 #
 # The dryrun log is additionally screened for the cpu_aot_loader ISA
 # feature-mismatch warning ("... is not supported on the host machine"):
@@ -39,6 +42,19 @@ if grep -q "is not supported on the host machine" "$DRYRUN_LOG"; then
     echo "      tenancy/compilecache.py pin_host_isa)" >&2
     exit 1
 fi
+
+# cross-PROCESS dryrun: the distributed dp x tp mesh (2 real OS
+# processes x 8 virtual devices) over the same tick workload — the
+# deeper sweep (chaos + 1M-pod ceiling) lives in hack/multihost.sh
+DISTMESH_LOG="$(mktemp)"
+trap 'rm -f "$DRYRUN_LOG" "$DISTMESH_LOG"' EXIT
+JAX_PLATFORMS=cpu python hack/multihost.py --scenario smoke \
+    >"$DISTMESH_LOG" 2>&1 || { cat "$DISTMESH_LOG"; exit 1; }
+cat "$DISTMESH_LOG"
+grep -q "MULTIHOST smoke OK" "$DISTMESH_LOG" || {
+    echo "FAIL: distmesh dryrun exited 0 without MULTIHOST smoke OK" >&2
+    exit 1
+}
 
 JAX_PLATFORMS=cpu exec python -m pytest \
     tests/test_mesh_solve.py \

@@ -1,0 +1,222 @@
+#!/usr/bin/env python
+"""Multi-host distributed mesh driver: prove the cross-process dp x tp
+solver (parallel/distmesh.py + fleet/meshgroup.py) end to end on one
+machine, with every process a real OS subprocess over virtual CPU
+devices.
+
+Scenarios (all exactness-gated against the single-process CPU oracle):
+
+- smoke:   a 2-process mesh runs the full -> patch -> patch tick
+  sequence of the deterministic workload, every tick's fingerprint
+  identical to the oracle, plus SolveBatch lanes routed across the
+  group and demuxed byte-identical to sequential local solves;
+- chaos:   a worker is killed between ticks; the group must degrade to
+  the single-process mesh and spend EXACTLY ONE full Solve before
+  patch ticks resume (the PR 10 taxonomy), decisions still
+  oracle-identical throughout;
+- ceiling: the >=1M-pod x 812-type solve — ~2x the 500,032-pod
+  single-process ceiling (hack/multichip.sh) — on a 2-process mesh, no
+  process ever materializing the full arena, fingerprint identical to
+  the oracle, with the measured cross-process collective bill per scan
+  step printed next to the analytic one.
+
+Exit code 0 = every scenario clean.
+Usage: python hack/multihost.py [--scenario smoke|chaos|ceiling|all]
+                                [--workers N] [--local-devices K]
+"""
+
+import argparse
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+SMOKE_SHAPE = dict(G=6, T=11, n_max=64, E=24, P=2, Z=3, C=2, D=4,
+                   pods_per_group=17)
+# 64 groups x >=15,626 pods = >=1,000,064 pods over the 812-type
+# catalog: >=2x the 500,032-pod single-process ceiling. n_max=4096
+# slots shard over dp so no process commits more than Np/nproc rows.
+CEILING_SHAPE = dict(G=64, T=812, n_max=4096, E=128, P=1, Z=3, C=2,
+                     D=4, pods_per_group=15626)
+SEED = 7
+
+
+def _group(args, metrics):
+    from karpenter_provider_aws_tpu.fleet.meshgroup import MeshGroup
+    mg = MeshGroup(workers=args.workers,
+                   local_devices=args.local_devices,
+                   metrics=metrics).start()
+    if not mg.alive():
+        raise SystemExit("FAIL: mesh group did not form")
+    return mg
+
+
+def _solve_and_check(mg, shape, tick, dirty, want_mode):
+    r = mg.solve_seeded(shape, seed=SEED, tick=tick, dirty=dirty)
+    o = mg.solve_oracle(shape, seed=SEED, tick=tick)
+    assert r["mode"] == want_mode, (tick, r["mode"], want_mode)
+    assert r["fingerprint"] == o["fingerprint"], \
+        f"tick {tick}: distributed fp {r['fingerprint'][:16]} != " \
+        f"oracle {o['fingerprint'][:16]}"
+    return r
+
+
+def scenario_smoke(args):
+    from karpenter_provider_aws_tpu.ops.ffd_jax import solve_scan_packed1
+    from karpenter_provider_aws_tpu.ops.hostpack import pack_inputs1
+    from karpenter_provider_aws_tpu.parallel import distmesh
+
+    metrics = _metrics()
+    mg = _group(args, metrics)
+    try:
+        print(f"MULTIHOST smoke: mesh {mg.mesh_info}", flush=True)
+        _solve_and_check(mg, SMOKE_SHAPE, 0, None, "full")
+        for t in (1, 2, 3):
+            r = _solve_and_check(mg, SMOKE_SHAPE, t,
+                                 list(distmesh.DIRTY_FIELDS), "patch")
+            print(f"MULTIHOST smoke: tick {t} patch ok "
+                  f"({r['wall_s']:.2f}s)", flush=True)
+
+        # SolveBatch lanes across the group, demuxed against the
+        # sequential local solves of the SAME packed buffers
+        s = SMOKE_SHAPE
+        dims = {k: s[k] for k in ("T", "D", "Z", "C", "G", "E", "P")}
+        lanes = []
+        for i in range(5):
+            arrays, _ = distmesh.tick_arrays(s, seed=100 + i, tick=0)
+            lanes.append(pack_inputs1(
+                {k: np.asarray(v) for k, v in arrays.items()}, **dims))
+        stack = np.stack(lanes)
+        kv = dict(dims, n_max=s["n_max"])
+        got = mg.solve_batch(stack, kv)
+        assert got is not None, "batch routing failed on a live group"
+        for i in range(stack.shape[0]):
+            want = np.asarray(solve_scan_packed1(np.asarray(stack[i]),
+                                                 **kv))
+            assert (got[i] == want).all(), f"lane {i} diverged"
+        print(f"MULTIHOST smoke: {stack.shape[0]} batch lanes routed "
+              f"across {args.workers + 1} processes, byte-identical",
+              flush=True)
+    finally:
+        mg.stop()
+    print("MULTIHOST smoke OK", flush=True)
+
+
+def scenario_chaos(args):
+    metrics = _metrics()
+    mg = _group(args, metrics)
+    try:
+        _solve_and_check(mg, SMOKE_SHAPE, 0, None, "full")
+        r = _solve_and_check(mg, SMOKE_SHAPE, 1, ["n", "ex_used0"],
+                             "patch")
+        assert r["distributed"], "expected the distributed path"
+
+        # kill a worker between ticks: the next dispatch must catch it
+        # at the liveness poll, collapse the group, and spend exactly
+        # one full Solve before patches resume
+        mg._procs[-1].kill()
+        mg._procs[-1].wait(timeout=10)
+        r2 = _solve_and_check(mg, SMOKE_SHAPE, 2, ["n", "ex_used0"],
+                              "full")
+        assert not r2["distributed"], "degraded solve must be local"
+        r3 = _solve_and_check(mg, SMOKE_SHAPE, 3, ["n", "ex_used0"],
+                              "patch")
+        assert not r3["distributed"]
+        assert not mg.alive()
+        lost = metrics.counter(
+            "karpenter_solver_distmesh_degraded_total",
+            labels={"reason": "worker_lost"})
+        assert lost == 1, f"degraded_total{{worker_lost}}={lost}"
+        assert metrics.gauge("karpenter_solver_distmesh_processes") == 1
+        assert mg.solve_batch(np.zeros((1, 4), np.uint32), {}) is None, \
+            "degraded group must refuse batch routing"
+    finally:
+        mg.stop()
+    print("MULTIHOST chaos OK: worker loss degraded to the local mesh "
+          "with exactly one full Solve, decisions oracle-identical",
+          flush=True)
+
+
+def scenario_ceiling(args):
+    from karpenter_provider_aws_tpu.parallel import distmesh
+
+    metrics = _metrics()
+    mg = _group(args, metrics)
+    try:
+        nproc = args.workers + 1
+        info = mg.mesh_info
+        print(f"MULTIHOST ceiling: mesh {info}", flush=True)
+
+        t0 = time.perf_counter()
+        r0 = mg.solve_seeded(CEILING_SHAPE, seed=SEED, tick=0)
+        full_s = time.perf_counter() - t0
+        assert r0["mode"] == "full" and r0["distributed"]
+
+        t0 = time.perf_counter()
+        r1 = mg.solve_seeded(CEILING_SHAPE, seed=SEED, tick=1,
+                             dirty=list(distmesh.DIRTY_FIELDS))
+        patch_s = time.perf_counter() - t0
+        assert r1["mode"] == "patch"
+
+        t0 = time.perf_counter()
+        o0 = mg.solve_oracle(CEILING_SHAPE, seed=SEED, tick=0)
+        oracle_s = time.perf_counter() - t0
+        assert r0["fingerprint"] == o0["fingerprint"], \
+            "ceiling tick 0 diverged from the CPU oracle"
+        o1 = mg.solve_oracle(CEILING_SHAPE, seed=SEED, tick=1)
+        assert r1["fingerprint"] == o1["fingerprint"], \
+            "ceiling patch tick diverged from the CPU oracle"
+
+        arrays, _ = distmesh.tick_arrays(CEILING_SHAPE, SEED, 0)
+        pods = int(np.asarray(arrays["n"]).sum())
+        assert pods >= 2 * 500_032, pods
+
+        bill = distmesh.collective_bill(
+            CEILING_SHAPE["P"], info["dp"], nproc, CEILING_SHAPE["G"])
+        tm = r1["timing"]
+        print(f"MULTIHOST ceiling OK: pods={pods} "
+              f"types={CEILING_SHAPE['T']} procs={nproc} "
+              f"dp={info['dp']} tp={info['tp']} "
+              f"full={full_s:.1f}s patch={patch_s:.1f}s "
+              f"oracle={oracle_s:.1f}s "
+              f"fingerprint={r0['fingerprint'][:16]}", flush=True)
+        print(f"MULTIHOST ceiling bill: "
+              f"{bill['cross_process_per_step']} cross-process "
+              f"collectives/step x {bill['steps']} steps "
+              f"(tp_pmax={bill['per_step']['tp_pmax']} stays "
+              f"intra-process), {bill['bytes_per_dp_collective']}B "
+              f"per dp collective; measured patch-tick split: "
+              f"commit={tm.get('commit_s', 0):.2f}s "
+              f"solve={tm.get('solve_s', 0):.2f}s "
+              f"gather={tm.get('gather_s', 0):.2f}s", flush=True)
+    finally:
+        mg.stop()
+
+
+def _metrics():
+    from karpenter_provider_aws_tpu.utils.metrics import Metrics
+    return Metrics()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--scenario", default="all",
+                    choices=["smoke", "chaos", "ceiling", "all"])
+    ap.add_argument("--workers", type=int, default=1,
+                    help="extra processes beyond the coordinator rank")
+    ap.add_argument("--local-devices", type=int, default=8)
+    args = ap.parse_args()
+    run = {"smoke": [scenario_smoke], "chaos": [scenario_chaos],
+           "ceiling": [scenario_ceiling],
+           "all": [scenario_smoke, scenario_chaos, scenario_ceiling]}
+    for fn in run[args.scenario]:
+        fn(args)
+    print("MULTIHOST PASS", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,36 @@
+#!/bin/sh
+# Multi-host distributed mesh validation sweep.
+#
+# Two layers, both exactness-gated (cross-process decisions must be
+# fingerprint-identical to the single-process CPU oracle):
+#
+# - the driver (hack/multihost.py): real OS subprocesses joined into
+#   one jax.distributed dp x tp mesh over virtual CPU devices — the
+#   full -> patch tick sequence, SolveBatch lanes routed across the
+#   group, worker-kill chaos (degrade + exactly one full Solve), and
+#   the >=1M-pod x 812-type ceiling (~2x the single-process 500,032-pod
+#   ceiling) with the measured cross-process collective bill;
+# - the distmesh test suite: slab generation parity, commit geometry,
+#   wire framing, coordinator degradation taxonomy, and the 2-process
+#   subprocess smoke.
+#
+# Usage: sh hack/multihost.sh           # driver + test suite
+#        sh hack/multihost.sh -x -q    # extra pytest args pass through
+set -e
+cd "$(dirname "$0")/.."
+
+DRIVER_LOG="$(mktemp)"
+trap 'rm -f "$DRIVER_LOG"' EXIT
+
+# capture-then-print (not tee): a pipeline would mask the driver's
+# exit status in POSIX sh
+JAX_PLATFORMS=cpu python hack/multihost.py --scenario all \
+    >"$DRIVER_LOG" 2>&1 || { cat "$DRIVER_LOG"; exit 1; }
+cat "$DRIVER_LOG"
+
+grep -q "MULTIHOST PASS" "$DRIVER_LOG" || {
+    echo "FAIL: driver exited 0 without MULTIHOST PASS" >&2; exit 1; }
+
+JAX_PLATFORMS=cpu exec python -m pytest \
+    tests/test_distmesh.py \
+    -q -p no:cacheprovider "$@"

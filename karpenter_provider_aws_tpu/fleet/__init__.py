@@ -7,7 +7,11 @@
 - :mod:`.fleetclient` — the :class:`FleetSolver` facade that follows
   the ring, re-primes the patch stream on every binding move, and
   keeps the single-sidecar degradation contract (host twin serves,
-  decisions stay oracle-identical).
+  decisions stay oracle-identical);
+- :mod:`.meshgroup` — the coordinator role that forms worker
+  processes into ONE logical distributed dp x tp solver (the vertical
+  tier: one solve spanning processes, vs the horizontal tier's many
+  solves across replicas).
 
 See docs/fleet.md for topology, affinity/failover semantics, the
 shared compile-cache layout, and the re-prime cost model.
@@ -17,10 +21,12 @@ from .fleetclient import (AFFINITY, FAILOVER, REBALANCE, FleetSolver,
                           loopback_fleet)
 from .membership import (ENDPOINTS_ENV, FleetMembership, Replica,
                          endpoints_from_env)
+from .meshgroup import MeshGroup
 from .ring import owner, owner_order, shape_class
 
 __all__ = [
-    "FleetSolver", "FleetMembership", "Replica", "loopback_fleet",
-    "owner", "owner_order", "shape_class", "endpoints_from_env",
-    "ENDPOINTS_ENV", "AFFINITY", "FAILOVER", "REBALANCE",
+    "FleetSolver", "FleetMembership", "MeshGroup", "Replica",
+    "loopback_fleet", "owner", "owner_order", "shape_class",
+    "endpoints_from_env", "ENDPOINTS_ENV", "AFFINITY", "FAILOVER",
+    "REBALANCE",
 ]

@@ -36,9 +36,11 @@ from ..sidecar.resilience import OPEN, ResiliencePolicy
 ENDPOINTS_ENV = "SOLVER_FLEET_ENDPOINTS"
 
 #: Info flags worth caching per replica (the fleet router consults
-#: ``patch`` before expecting a delta stream to survive a failover)
+#: ``patch`` before expecting a delta stream to survive a failover;
+#: ``mesh_group`` marks a replica that fronts a multi-process
+#: distributed mesh — fleet/meshgroup.py)
 _CAP_FLAGS = ("pruned", "batch", "subsets", "patch", "tenancy",
-              "bucketed")
+              "bucketed", "mesh_group")
 
 
 class Replica:

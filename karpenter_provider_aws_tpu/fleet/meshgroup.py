@@ -1,0 +1,409 @@
+"""MeshGroup: worker sidecars formed into ONE logical distributed
+solver.
+
+The fleet's horizontal tier (membership/ring/fleetclient) scales
+INDEPENDENT solves across replicas; this module scales ONE solve
+across processes. A MeshGroup coordinator spawns (or, via the chart's
+worker StatefulSet, is joined by) worker processes, forms them into a
+single ``jax.distributed`` dp x tp mesh (parallel/distmesh.py), and
+then routes work over a loopback control protocol:
+
+- ``solve_seeded`` / ``solve_frame`` — one 2-D solve whose slot axis
+  spans every process, each worker committing only its dp slab;
+- ``solve_batch`` — SolveBatch lanes split round the processes, each
+  worker running its lanes on its LOCAL devices (lanes are
+  independent: zero collectives, linear scale-out).
+
+Degradation keeps the PR 10 taxonomy: a lost worker makes the whole
+distributed mesh unusable (a collective with a dead peer hangs, it
+does not fail), so the coordinator kills the remaining workers, falls
+back to the single-process mesh over its own devices, and forces
+EXACTLY ONE full Solve (``dirty=None`` placement) before patch ticks
+resume — lost residency is re-established once, then deltas flow
+again. Decisions are identical in every mode by construction; the
+fingerprint checks in hack/multihost.py prove it end to end.
+
+Metrics (docs/metrics.md "Distributed mesh"):
+``karpenter_solver_distmesh_processes`` gauge,
+``karpenter_solver_distmesh_dispatch_total{mode}``,
+``karpenter_solver_distmesh_patch_total{mode}`` (worker-side),
+``karpenter_solver_distmesh_degraded_total{reason}``.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import socket
+import subprocess
+import sys
+from typing import Dict, Optional
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+#: worker spawn/handshake deadline (cold python + jax import)
+_HELLO_TIMEOUT_S = 120.0
+#: per-command reply deadline: covers first-solve compile of the 2-D
+#: kernel at ceiling shapes on virtual CPU devices
+_REPLY_TIMEOUT_S = 900.0
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class MeshGroup:
+    """Coordinator for one distributed solver (module docstring).
+
+    ``workers`` is the number of EXTRA processes beyond the
+    coordinator-side rank-0 worker; ``workers=0`` is the degenerate
+    local mode (no subprocesses — dispatch goes straight to the
+    single-process mesh), which is also what every degradation
+    converges to."""
+
+    def __init__(self, workers: int, local_devices: int = 8,
+                 metrics=None, python: Optional[str] = None):
+        self.workers = max(0, int(workers))
+        self.local_devices = int(local_devices)
+        self.metrics = metrics
+        self._python = python or sys.executable
+        self._procs: list = []
+        self._socks: Dict[int, socket.socket] = {}
+        self._degraded = False
+        self._degrade_pending_full = False
+        self._local_cache: dict = {}
+        self.mesh_info: Optional[dict] = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "MeshGroup":
+        """Spawn rank 0..workers, collect hellos, form the jax mesh.
+        Any failure here degrades instead of raising: a solver that
+        cannot form its group still serves from the local mesh."""
+        if self.workers <= 0:
+            self._gauge_processes(1)
+            return self
+        try:
+            self._start_distributed()
+        except Exception:
+            log.exception("mesh group formation failed; degrading to "
+                          "the single-process mesh")
+            self.degrade(reason="spawn_failed")
+        return self
+
+    def _start_distributed(self) -> None:
+        nproc = self.workers + 1
+        jax_port = _free_port()
+        listener = socket.socket()
+        listener.bind(("127.0.0.1", 0))
+        listener.listen(nproc)
+        listener.settimeout(_HELLO_TIMEOUT_S)
+        control = f"127.0.0.1:{listener.getsockname()[1]}"
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = ("--xla_force_host_platform_device_count="
+                            f"{self.local_devices}")
+        env["PYTHONPATH"] = repo_root + os.pathsep + \
+            env.get("PYTHONPATH", "")
+        # KARP_DISTMESH_WORKER_LOGS=1 inherits worker stderr (debug)
+        sink = None if os.environ.get("KARP_DISTMESH_WORKER_LOGS") \
+            else subprocess.DEVNULL
+        for i in range(nproc):
+            self._procs.append(subprocess.Popen(
+                [self._python, "-m",
+                 "karpenter_provider_aws_tpu.parallel.distmesh",
+                 "--worker", "--control", control, "--proc-id", str(i)],
+                cwd=repo_root, env=env,
+                stdout=sink, stderr=sink))
+        try:
+            for _ in range(nproc):
+                conn, _addr = listener.accept()
+                conn.settimeout(_REPLY_TIMEOUT_S)
+                msg, _ = self._distmesh()._recv_msg(conn)
+                self._socks[int(msg["hello"])] = conn
+        finally:
+            listener.close()
+        infos = self._broadcast(lambda pid: ({
+            "cmd": "mesh", "coordinator": f"127.0.0.1:{jax_port}",
+            "num_processes": nproc, "process_id": pid,
+            "local_devices": self.local_devices}, None))
+        self.mesh_info = infos[0][0]
+        self._gauge_processes(nproc)
+        log.info("mesh group up: %d processes, %d devices, dp=%d tp=%d",
+                 nproc, self.mesh_info["ndev"], self.mesh_info["dp"],
+                 self.mesh_info["tp"])
+
+    def stop(self) -> None:
+        for pid, sock in list(self._socks.items()):
+            try:
+                self._distmesh()._send_msg(sock, {"cmd": "halt"})
+                sock.close()
+            except Exception:
+                pass
+        self._socks.clear()
+        for p in self._procs:
+            try:
+                p.wait(timeout=10)
+            except Exception:
+                p.kill()
+        self._procs = []
+
+    def alive(self) -> bool:
+        """True while the distributed mesh is usable: every worker
+        process running and its control socket open."""
+        return (bool(self._socks) and not self._degraded
+                and all(p.poll() is None for p in self._procs))
+
+    def degrade(self, reason: str = "worker_lost") -> None:
+        """Collapse to the single-process mesh (PR 10 taxonomy): kill
+        every worker — survivors would hang at their next collective
+        waiting on the dead peer — and arm the one-full-Solve flag so
+        the next dispatch re-establishes residency from scratch."""
+        if self._degraded:
+            return
+        self._degraded = True
+        self._degrade_pending_full = True
+        for p in self._procs:
+            try:
+                p.kill()
+            except Exception:
+                pass
+        for sock in self._socks.values():
+            try:
+                sock.close()
+            except Exception:
+                pass
+        self._socks.clear()
+        self._gauge_processes(1)
+        if self.metrics is not None:
+            self.metrics.inc("karpenter_solver_distmesh_degraded_total",
+                             labels={"reason": reason})
+        log.warning("mesh group degraded (%s): serving from the "
+                    "single-process mesh; next solve is a full "
+                    "placement", reason)
+
+    # -- plumbing ----------------------------------------------------------
+
+    @staticmethod
+    def _distmesh():
+        from ..parallel import distmesh
+        return distmesh
+
+    def _gauge_processes(self, n: int) -> None:
+        if self.metrics is not None:
+            self.metrics.set_gauge("karpenter_solver_distmesh_processes",
+                                   n)
+
+    def _check(self) -> bool:
+        """Poll worker liveness BEFORE dispatching: a dead peer must be
+        caught here, where degrading is cheap, not inside a collective,
+        where it is a hang."""
+        if self._degraded or not self._socks:
+            return False
+        if any(p.poll() is not None for p in self._procs):
+            self.degrade(reason="worker_lost")
+            return False
+        return True
+
+    def _broadcast(self, make_msg):
+        """Send make_msg(pid) to every worker, then collect every
+        reply (send-all-then-recv-all: the SPMD solve only completes
+        once every process has entered it). Any transport error or
+        worker-reported failure degrades the group."""
+        dm = self._distmesh()
+        try:
+            for pid in sorted(self._socks):
+                msg, arrays = make_msg(pid)
+                dm._send_msg(self._socks[pid], msg, arrays)
+            replies = {}
+            for pid in sorted(self._socks):
+                reply, arrays = dm._recv_msg(self._socks[pid])
+                if reply is None or not reply.get("ok"):
+                    err = (reply or {}).get("error", "socket closed")
+                    raise RuntimeError(f"worker {pid}: {err}")
+                replies[pid] = (reply, arrays)
+        except Exception:
+            self.degrade(reason="worker_lost")
+            raise
+        return [replies[pid] for pid in sorted(replies)]
+
+    # -- dispatch surfaces -------------------------------------------------
+
+    def _dirty_for_local(self, dirty):
+        """The one-full-Solve taxonomy: the first local dispatch after
+        a degrade ignores the caller's dirty list (residency was lost
+        with the workers), every later one honors it."""
+        if self._degrade_pending_full:
+            self._degrade_pending_full = False
+            return None
+        return dirty
+
+    def _solve_local(self, arrays, statics, dirty, mode_label):
+        from ..parallel.mesh import _pick_devices, dispatch_mesh
+        ndev = len(_pick_devices())
+        out = dispatch_mesh(arrays, n_max=statics["n_max"],
+                            E=statics["E"], P=statics["P"], V=0,
+                            ndev=ndev, cache=self._local_cache,
+                            dirty=self._dirty_for_local(dirty),
+                            metrics=self.metrics)
+        if self.metrics is not None:
+            self.metrics.inc("karpenter_solver_distmesh_dispatch_total",
+                             labels={"mode": mode_label})
+        return {"out": out,
+                "fingerprint":
+                    self._distmesh().result_fingerprint(out),
+                "mode":
+                    self._local_cache["last_placement"]["mode"],
+                "distributed": False}
+
+    def solve_seeded(self, shape: dict, seed: int, tick: int,
+                     dirty=None, want_arrays: bool = False) -> dict:
+        """One distributed solve of the deterministic tick workload
+        (distmesh.tick_arrays): each worker regenerates its own slab —
+        nothing bulk crosses the control wire. Falls back to the local
+        mesh (full arrays, one process) when degraded."""
+        statics = {k: shape[k] for k in ("n_max", "E", "P")}
+        if not self._check():
+            arrays, _ = self._distmesh().tick_arrays(shape, seed, tick)
+            return self._solve_local(
+                arrays, statics, dirty,
+                "degraded" if self._degraded else "local")
+        try:
+            replies = self._broadcast(lambda pid: ({
+                "cmd": "solve_seeded", "shape": shape, "seed": seed,
+                "tick": tick, "dirty": dirty,
+                "want_arrays": want_arrays and pid == 0}, None))
+        except Exception:
+            arrays, _ = self._distmesh().tick_arrays(shape, seed, tick)
+            return self._solve_local(arrays, statics, dirty, "degraded")
+        return self._collect(replies, "seeded", want_arrays)
+
+    def solve_frame(self, arrays: dict, statics: dict,
+                    dirty=None, want_arrays: bool = False) -> dict:
+        """One distributed solve of caller-supplied arrays (the sidecar
+        path — the frame already arrived whole over gRPC): slot tables
+        are sliced per worker so each process still commits only its
+        slab."""
+        if not self._check():
+            return self._solve_local(
+                arrays, statics, dirty,
+                "degraded" if self._degraded else "local")
+        dm = self._distmesh()
+        nproc = self.workers + 1
+        dp = self.mesh_info["dp"]
+        N = statics["E"] + statics["n_max"]
+        Np = ((N + dp - 1) // dp) * dp
+
+        def pad0(a, rows):
+            a = np.asarray(a)
+            out = np.zeros((rows,) + a.shape[1:], a.dtype)
+            out[:a.shape[0]] = a
+            return out
+
+        ex_alloc = pad0(arrays["ex_alloc"], Np)
+        ex_used0 = pad0(arrays["ex_used0"], Np)
+        compat = np.asarray(arrays["ex_compat"])
+        ex_compat = np.zeros(compat.shape[:1] + (Np,), compat.dtype)
+        ex_compat[:, :compat.shape[1]] = compat
+        repl = {k: np.asarray(v) for k, v in arrays.items()
+                if k not in ("ex_alloc", "ex_used0", "ex_compat")
+                and v is not None}
+
+        def frame_for(pid):
+            lo, hi = dm.local_slot_rows(Np, nproc, pid)
+            payload = dict(repl)
+            payload["ex_alloc"] = ex_alloc[lo:hi]
+            payload["ex_used0"] = ex_used0[lo:hi]
+            payload["ex_compat"] = ex_compat[:, lo:hi]
+            slabs = {
+                "ex_alloc": [lo, hi, 0, [Np, ex_alloc.shape[1]]],
+                "ex_used0": [lo, hi, 0, [Np, ex_used0.shape[1]]],
+                "ex_compat": [lo, hi, 1, [ex_compat.shape[0], Np]],
+            }
+            msg = {"cmd": "solve_frame", "dirty": dirty,
+                   "want_arrays": want_arrays and pid == 0,
+                   "slabs": slabs}
+            msg.update({k: int(v) for k, v in statics.items()})
+            return msg, payload
+
+        try:
+            replies = self._broadcast(frame_for)
+        except Exception:
+            return self._solve_local(arrays, statics, dirty, "degraded")
+        return self._collect(replies, "frame", want_arrays)
+
+    def _collect(self, replies, mode_label, want_arrays):
+        fps = {r["fingerprint"] for r, _ in replies}
+        if len(fps) != 1:
+            # processes disagreeing on a replicated output is a
+            # correctness emergency, not a retry case
+            self.degrade(reason="fingerprint_split")
+            raise RuntimeError(
+                f"cross-process fingerprint mismatch: {sorted(fps)}")
+        r0, arrays0 = replies[0]
+        if self.metrics is not None:
+            self.metrics.inc("karpenter_solver_distmesh_dispatch_total",
+                             labels={"mode": mode_label})
+        return {"out": arrays0 if want_arrays else None,
+                "fingerprint": r0["fingerprint"], "mode": r0["mode"],
+                "timing": r0.get("timing", {}),
+                "wall_s": r0.get("wall_s"), "distributed": True}
+
+    def solve_batch(self, stack: np.ndarray, kv: dict
+                    ) -> Optional[np.ndarray]:
+        """Route SolveBatch lanes across the group: contiguous lane
+        spans per process, each solved on that worker's local devices,
+        reassembled in order. Returns None when the group cannot serve
+        (degraded / routing error) — the caller keeps its local path."""
+        if not self._check():
+            return None
+        stack = np.asarray(stack)
+        B = stack.shape[0]
+        nproc = self.workers + 1
+        spans = []
+        base, extra = divmod(B, nproc)
+        at = 0
+        for pid in range(nproc):
+            take = base + (1 if pid < extra else 0)
+            spans.append((at, at + take))
+            at += take
+
+        def batch_for(pid):
+            lo, hi = spans[pid]
+            if hi == lo:  # empty span still needs a round trip: the
+                # broadcast protocol is strict send-all/recv-all
+                lo, hi = 0, 1
+            return ({"cmd": "solve_batch",
+                     "kv": {k: int(v) for k, v in kv.items()}},
+                    {"stack": stack[lo:hi]})
+
+        try:
+            replies = self._broadcast(batch_for)
+        except Exception:
+            return None
+        parts = []
+        for pid, (_, arrays) in enumerate(replies):
+            lo, hi = spans[pid]
+            parts.append(arrays["out"][:hi - lo])
+        if self.metrics is not None:
+            self.metrics.inc("karpenter_solver_distmesh_dispatch_total",
+                             labels={"mode": "batch"})
+        return np.concatenate(parts, axis=0)
+
+    def solve_oracle(self, shape: dict, seed: int, tick: int,
+                     want_arrays: bool = False) -> dict:
+        """The fingerprint baseline, computed in THIS process on one
+        device via the shared dispatch (distmesh.oracle_out)."""
+        dm = self._distmesh()
+        arrays, statics = dm.tick_arrays(shape, seed, tick)
+        out = dm.oracle_out(arrays, **statics)
+        return {"out": out if want_arrays else None,
+                "fingerprint": dm.result_fingerprint(out)}

@@ -69,6 +69,7 @@ from typing import NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 jax.config.update("jax_enable_x64", True)
 
@@ -97,7 +98,12 @@ try:
 except Exception:  # older jax without the knobs: in-memory cache only
     pass
 
-BIG = jnp.int64(1) << 60
+# a host scalar, NOT jnp: a module-level jnp computation initializes
+# the jax backend at import time, which forecloses everything that must
+# run first in a worker process — jax.distributed.initialize (the
+# multi-process mesh, parallel/distmesh.py), platform pins, device-count
+# flags. np.int64 binds into traced code with the identical int64 value.
+BIG = np.int64(1) << 60
 
 
 def _axis_max(x: jax.Array, axis: "str | None", sum_only: bool) -> jax.Array:

@@ -1,6 +1,7 @@
 from .mesh import (  # noqa: F401
     dispatch_mesh,
     shard_batch,
+    shard_lanes,
     solve_mesh,
     solve_mesh2,
     solve_scan_sharded,

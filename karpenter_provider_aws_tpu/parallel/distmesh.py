@@ -1,0 +1,692 @@
+"""Multi-host distributed mesh: cross-process dp x tp solving.
+
+The distributed tier over parallel/mesh.py: N OS processes (in CI, N
+subprocesses x ``XLA_FLAGS=--xla_force_host_platform_device_count=K``
+virtual CPU devices; on real hardware, one process per host) form ONE
+logical 2-D ``("dp","tp")`` mesh via ``jax.distributed.initialize``.
+The pods (slot) axis shards across processes — each host owns only its
+dp rows of the slot-indexed tables and commits them with
+``jax.make_array_from_single_device_arrays``, so no host ever
+materializes the full arena. The compiled program is the SAME
+``_solve_sharded2`` shard_map kernel PR 8 runs on one process; only the
+mesh underneath changes, so decisions stay identical by construction.
+
+Device ordering is the load-bearing subtlety: device ids are NOT
+sequential across processes (a 2-process CPU run hands out ids like
+0..7 and 131072..131079), so the global mesh orders devices
+PROCESS-MAJOR — ``sorted(jax.devices(), key=(process_index, id))`` —
+and dp is constrained to a multiple of the process count. Together
+those make every dp row live inside one process, which means:
+
+- each process's addressable shard of a slot-sharded table is one
+  contiguous run of global slot rows (``local_slot_rows``), and
+- the per-scan-step collective bill (docs/solver-design.md) splits
+  cleanly: the (1+P) tp-axis pmax reductions stay intra-process, while
+  the (P+1) dp all_gathers and 2 dp psums cross process boundaries —
+  (P+3) cross-host collectives per scan step, each O(dp) scalars,
+  latency-dominated and constant in the slot count.
+
+Control plane (the ``fleet.meshgroup`` coordinator) rides a separate
+loopback TCP protocol (length-prefixed JSON header + npz payload):
+workers run :func:`run_worker` loops; the SPMD data plane is jax's own
+distributed runtime. Two input modes keep "no full arena on any host"
+honest: ``solve_seeded`` regenerates each host's slab from (seed,
+tick) — zero bulk bytes on the wire — and ``solve_frame`` ships each
+worker only its slab slices.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import logging
+import os
+import socket
+import struct
+import time
+from typing import Dict, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as PS
+
+from .mesh import (AXIS, AXIS_DP, _default_dp, _input_specs2, _out_dict,
+                   _prep_field, _resolve_sum_only, _solve_sharded2)
+from ..ops.ffd_jax import KernelInputs
+
+log = logging.getLogger(__name__)
+
+#: env contract (chart: deploy/chart/templates/solver-mesh-workers.yaml)
+COORDINATOR_ENV = "SOLVER_DISTMESH_COORDINATOR"
+PROCESSES_ENV = "SOLVER_DISTMESH_PROCESSES"
+PROCESS_ID_ENV = "SOLVER_DISTMESH_PROCESS_ID"
+LOCAL_DEVICES_ENV = "SOLVER_DISTMESH_LOCAL_DEVICES"
+WORKERS_ENV = "SOLVER_DISTMESH_WORKERS"
+
+#: the fields a warm tick rewrites (fleet ticks mutate demand and the
+#: existing nodes' usage; catalog/feasibility stay resident on-device)
+DIRTY_FIELDS = ("n", "ex_used0")
+
+
+class DistConfig(NamedTuple):
+    """One process's identity in the distributed job."""
+    coordinator: str       # "host:port" of jax's coordinator service
+    num_processes: int
+    process_id: int
+    #: virtual CPU devices per process (CI/localhost mode); None means
+    #: use the real local backend untouched
+    local_devices: Optional[int] = None
+
+
+def config_from_env() -> Optional[DistConfig]:
+    """DistConfig from the chart's env contract, or None when unset.
+    The process id falls back to the StatefulSet ordinal parsed from
+    POD_NAME (+1: the coordinator sidecar is process 0, worker ordinal
+    i is process i+1)."""
+    coord = os.environ.get(COORDINATOR_ENV)
+    if not coord:
+        return None
+    nproc = int(os.environ.get(PROCESSES_ENV) or
+                (int(os.environ.get(WORKERS_ENV, "0")) + 1))
+    pid_env = os.environ.get(PROCESS_ID_ENV)
+    if pid_env is not None:
+        pid = int(pid_env)
+    else:
+        pod = os.environ.get("POD_NAME", "")
+        tail = pod.rsplit("-", 1)[-1]
+        pid = int(tail) + 1 if tail.isdigit() else 0
+    local = os.environ.get(LOCAL_DEVICES_ENV)
+    return DistConfig(coord, nproc, pid,
+                      int(local) if local else None)
+
+
+_INITIALIZED: Optional[DistConfig] = None
+
+
+def init_process(cfg: DistConfig) -> None:
+    """Join the distributed job (idempotent per process). In virtual-
+    device mode the device-count flag and the CPU platform pin must land
+    before the first backend init (read once, at client creation), and
+    cross-process CPU collectives need the gloo implementation — the
+    default shared-memory transport cannot cross process boundaries."""
+    global _INITIALIZED
+    if _INITIALIZED is not None:
+        if _INITIALIZED != cfg:
+            raise RuntimeError(
+                f"distmesh already initialized as {_INITIALIZED}, "
+                f"refusing re-init as {cfg}")
+        return
+    if cfg.local_devices:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count"
+                f"={cfg.local_devices}").strip()
+        jax.config.update("jax_platforms", "cpu")
+        if cfg.num_processes > 1:
+            # gloo only under a real distributed job: the gloo factory
+            # requires the distributed client, so a single-process
+            # backend init with it configured fails outright
+            jax.config.update("jax_cpu_collectives_implementation",
+                              "gloo")
+    if cfg.num_processes > 1:
+        jax.distributed.initialize(coordinator_address=cfg.coordinator,
+                                   num_processes=cfg.num_processes,
+                                   process_id=cfg.process_id)
+    _INITIALIZED = cfg
+    log.info("distmesh: process %d/%d joined (coordinator %s)",
+             cfg.process_id, cfg.num_processes, cfg.coordinator)
+
+
+def global_devices():
+    """Every device in the job, PROCESS-MAJOR. Never rely on raw device
+    ids for ordering — they are backend-assigned and non-sequential
+    across processes (module docstring)."""
+    return sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
+
+
+def dist_dp(ndev: int, nproc: int) -> int:
+    """dp extent for the distributed 2-D mesh: a multiple of the
+    process count (so every dp row lives inside one process — the
+    contiguous-slab + intra-process-tp-pmax invariant) that divides the
+    device count. Default: nproc x the single-process default for the
+    per-process device share. KARP_DIST_DP overrides when valid."""
+    env = os.environ.get("KARP_DIST_DP")
+    if env:
+        try:
+            v = int(env)
+        except ValueError:
+            v = 0
+        if v >= nproc and v % nproc == 0 and ndev % v == 0:
+            return v
+        log.warning("KARP_DIST_DP=%r invalid for %d devices / %d "
+                    "processes; using default", env, ndev, nproc)
+    if ndev % nproc:
+        raise ValueError(
+            f"{ndev} devices do not split evenly over {nproc} processes")
+    return nproc * _default_dp(ndev // nproc)
+
+
+def dist_mesh2(devices=None, dp: Optional[int] = None) -> Mesh:
+    """The global 2-D ``("dp","tp")`` mesh over every process's
+    devices, process-major so dp rows are process-contiguous."""
+    if devices is None:
+        devices = global_devices()
+    ndev = len(devices)
+    if dp is None:
+        dp = dist_dp(ndev, jax.process_count())
+    if dp < 1 or ndev % dp:
+        raise ValueError(f"dp={dp} does not divide {ndev} devices")
+    return Mesh(np.asarray(devices).reshape(dp, ndev // dp),
+                axis_names=(AXIS_DP, AXIS))
+
+
+def local_slot_rows(Np: int, nproc: int, pid: int) -> Tuple[int, int]:
+    """The contiguous run [lo, hi) of PADDED global slot rows process
+    ``pid`` owns. Holds because dp is a multiple of nproc, Np a
+    multiple of dp, and the mesh is process-major."""
+    if Np % nproc:
+        raise ValueError(f"Np={Np} not a multiple of nproc={nproc}")
+    rows = Np // nproc
+    return pid * rows, (pid + 1) * rows
+
+
+def slab_rows(n_max: int, E: int, mesh: Mesh) -> Tuple[int, int, int]:
+    """(Np, lo, hi) for this process's slab of the solve's slot axis:
+    Np is the dp-padded slot range (parallel/mesh._pad_slots), [lo, hi)
+    the rows this process commits."""
+    ndp = mesh.shape[AXIS_DP]
+    N = E + n_max
+    Np = ((N + ndp - 1) // ndp) * ndp
+    lo, hi = local_slot_rows(Np, jax.process_count(), jax.process_index())
+    return Np, lo, hi
+
+
+class LocalSlab(NamedTuple):
+    """A host-local slab of a globally slot-sharded array: rows
+    [lo, hi) along ``axis`` of a logical array of ``global_shape``.
+    The slab already spans the PADDED slot range (rows past the true
+    table are zeros), so commit needs no further prep."""
+    array: np.ndarray
+    lo: int
+    hi: int
+    axis: int
+    global_shape: Tuple[int, ...]
+
+
+def commit_global(x, mesh: Mesh, spec: PS):
+    """Commit one logical array onto the global mesh from per-process
+    pieces: slice out each ADDRESSABLE device's shard, device_put it
+    locally, and assemble with make_array_from_single_device_arrays —
+    the multi-process construction where plain device_put would demand
+    the (unaddressable) remote devices. Accepts a full ndarray or a
+    LocalSlab; a slab is remapped from global to slab-local rows and
+    refuses indices outside this host's ownership (which would mean the
+    mesh/slab geometry drifted)."""
+    sh = NamedSharding(mesh, spec)
+    if isinstance(x, LocalSlab):
+        gshape = tuple(int(s) for s in x.global_shape)
+        arr = np.asarray(x.array)
+        idx_map = sh.addressable_devices_indices_map(gshape)
+        shards, devs = [], []
+        for d, idx in idx_map.items():
+            idx = list(idx)
+            sl = idx[x.axis]
+            start = sl.start or 0
+            stop = gshape[x.axis] if sl.stop is None else sl.stop
+            if start < x.lo or stop > x.hi:
+                raise ValueError(
+                    f"device {d.id} wants global rows [{start},{stop}) "
+                    f"outside local slab [{x.lo},{x.hi})")
+            idx[x.axis] = slice(start - x.lo, stop - x.lo)
+            shards.append(jax.device_put(arr[tuple(idx)], d))
+            devs.append(d)
+        return jax.make_array_from_single_device_arrays(
+            gshape, sh, shards)
+    arr = np.asarray(x)
+    idx_map = sh.addressable_devices_indices_map(arr.shape)
+    shards = [jax.device_put(arr[idx], d) for d, idx in idx_map.items()]
+    return jax.make_array_from_single_device_arrays(
+        arr.shape, sh, shards)
+
+
+def collective_bill(P: int, dp: int, nproc: int, G: int) -> dict:
+    """The analytic per-scan-step collective bill for the distributed
+    2-D kernel (docs/solver-design.md), split at the process boundary.
+    tp-axis pmax reductions stay intra-process (dp rows are process-
+    contiguous); every dp-axis collective crosses hosts when nproc>1.
+    Each dp collective moves O(dp) scalars — latency, not bandwidth."""
+    cross = (P + 1) + 2 if nproc > 1 else 0
+    return {
+        "steps": G,
+        "per_step": {"tp_pmax": 1 + P, "dp_all_gather": P + 1,
+                     "dp_psum": 2},
+        "cross_process_per_step": cross,
+        "cross_process_total": cross * G,
+        "bytes_per_dp_collective": 8 * dp,
+    }
+
+
+# -- the deterministic tick harness ----------------------------------------
+
+_M64 = (1 << 64) - 1
+
+
+def _hash_u64(x):
+    """splitmix64 over uint64 (vectorized): the slab-parity generator
+    primitive — value at global index i depends only on i and the
+    stream key, so generating rows [lo, hi) equals slicing a full
+    generation. Counter-based by construction (unlike a seeded RNG
+    stream, which would force every host to draw the whole arena)."""
+    with np.errstate(over="ignore"):
+        x = (np.asarray(x, np.uint64) + np.uint64(0x9E3779B97F4A7C15)) \
+            & np.uint64(_M64)
+        z = x
+        z = ((z ^ (z >> np.uint64(30))) *
+             np.uint64(0xBF58476D1CE4E5B9)) & np.uint64(_M64)
+        z = ((z ^ (z >> np.uint64(27))) *
+             np.uint64(0x94D049BB133111EB)) & np.uint64(_M64)
+        return z ^ (z >> np.uint64(31))
+
+
+def _field_key(seed: int, tick: int, field: str) -> int:
+    """Stream key per field. Only DIRTY_FIELDS mix the tick in: every
+    other field must be bit-identical across ticks or the dirty-list
+    patch contract (parallel/mesh._place_resident) would be a lie."""
+    t = tick if field in DIRTY_FIELDS else 0
+    h = hashlib.sha256(f"{seed}:{t}:{field}".encode()).digest()
+    return int.from_bytes(h[:8], "big")
+
+
+def _gen(seed, tick, field, rows, cols, lo=0):
+    """uint64 grid for global rows [lo, lo+rows) x cols of ``field``."""
+    key = np.uint64(_field_key(seed, tick, field))
+    idx = (np.arange(lo, lo + rows, dtype=np.uint64)[:, None] *
+           np.uint64(max(cols, 1)) +
+           np.arange(cols, dtype=np.uint64)[None, :])
+    with np.errstate(over="ignore"):
+        return _hash_u64(idx + key)
+
+
+def tick_arrays(shape: Dict[str, int], seed: int, tick: int,
+                slab: Optional[Tuple[int, int, int]] = None
+                ) -> Tuple[dict, dict]:
+    """The deterministic multi-host workload: (arrays, statics) for one
+    tick of a fleet whose demand (``n``) and existing-node usage
+    (``ex_used0``) move every tick while the catalog stays put —
+    exactly the DIRTY_FIELDS patch shape. With ``slab=(lo, hi, Np)``
+    the slot-sharded tables come back as LocalSlab covering only
+    global rows [lo, hi) of the PADDED slot range — the whole-arena
+    arrays are never built on any single host. shape keys: G, T, n_max,
+    E, P, Z, C, D, pods_per_group."""
+    G, T = shape["G"], shape["T"]
+    n_max, E, P = shape["n_max"], shape["E"], shape["P"]
+    Z, C, D = shape["Z"], shape["C"], shape["D"]
+    ppg = shape["pods_per_group"]
+
+    def g(field, rows, cols):
+        return _gen(seed, tick, field, rows, cols)
+
+    arrays = dict(
+        A=(1 + g("A", T, D) % np.uint64(1 << 20)).astype(np.int64),
+        avail_zc=(g("avail_zc", T, Z * C) % np.uint64(100)) <
+        np.uint64(80),
+        R=(1 + g("R", G, D) % np.uint64(1 << 8)).astype(np.int64),
+        n=(np.uint64(ppg) + g("n", G, 1)[:, 0] %
+           np.uint64(5)).astype(np.int64),
+        F=(g("F", G, T) % np.uint64(100)) < np.uint64(70),
+        agz=np.ones((G, Z), bool), agc=np.ones((G, C), bool),
+        admit=np.ones((G, P), bool),
+        daemon=np.zeros((G, P, D), np.int64),
+        pool_types=np.ones((P, T), bool),
+        pool_agz=np.ones((P, Z), bool),
+        pool_agc=np.ones((P, C), bool),
+        pool_limit=np.full((P, D), -1, np.int64),
+        pool_used0=np.zeros((P, D), np.int64),
+    )
+
+    def slot_rows(field, lo, hi, cols, vmax, dtype):
+        """Rows [lo, hi) of a slot table: true rows [0, E) carry data,
+        rows past E are the inert padding _pad_slots would add."""
+        out = np.zeros((hi - lo, cols), dtype)
+        top = min(hi, E)
+        if top > lo:
+            vals = _gen(seed, tick, field, top - lo, cols, lo=lo)
+            out[:top - lo] = (vals % np.uint64(vmax)).astype(dtype)
+        return out
+
+    if slab is None:
+        arrays["ex_alloc"] = 1 + slot_rows("ex_alloc", 0, E, D,
+                                           1 << 10, np.int64)
+        arrays["ex_used0"] = slot_rows("ex_used0", 0, E, D, 4, np.int64)
+        # slot-major grid (transposed into [G, E]) so a column slab of
+        # the full table equals the slab-mode generation bit-for-bit
+        arrays["ex_compat"] = (
+            (_gen(seed, tick, "ex_compat", E, G) %
+             np.uint64(100) < np.uint64(60)).T) if E else \
+            np.zeros((G, 0), bool)
+    else:
+        lo, hi, Np = slab
+        alloc = slot_rows("ex_alloc", lo, hi, D, 1 << 10, np.int64)
+        alloc[:max(0, min(hi, E) - lo)] += 1
+        arrays["ex_alloc"] = LocalSlab(alloc, lo, hi, 0, (Np, D))
+        arrays["ex_used0"] = LocalSlab(
+            slot_rows("ex_used0", lo, hi, D, 4, np.int64),
+            lo, hi, 0, (Np, D))
+        compat = np.zeros((G, hi - lo), bool)
+        top = min(hi, E)
+        if top > lo:
+            # ex_compat is [G, slots]: hash on the slot-major grid so
+            # column lo..hi of the full table equals this slab
+            grid = _gen(seed, tick, "ex_compat", top - lo, G, lo=lo)
+            compat[:, :top - lo] = (grid % np.uint64(100) <
+                                    np.uint64(60)).T
+        arrays["ex_compat"] = LocalSlab(compat, lo, hi, 1, (G, Np))
+    return arrays, dict(n_max=n_max, E=E, P=P)
+
+
+def oracle_out(arrays: dict, *, n_max: int, E: int, P: int) -> dict:
+    """The single-process CPU oracle: the SAME shared dispatch the
+    local solver uses (parallel/mesh.dispatch_mesh) pinned to one
+    device — the fingerprint baseline every distributed solve must
+    match bit-for-bit."""
+    from .mesh import dispatch_mesh
+    return dispatch_mesh(arrays, n_max=n_max, E=E, P=P, V=0, ndev=1,
+                         cache={})
+
+
+def result_fingerprint(out: dict) -> str:
+    """sha256 over every output tensor's name/dtype/shape/bytes — the
+    cross-process and cross-arm decision-identity check."""
+    h = hashlib.sha256()
+    for k in sorted(out):
+        a = np.ascontiguousarray(np.asarray(out[k]))
+        h.update(f"{k}:{a.dtype}:{a.shape}:".encode())
+        h.update(a.tobytes())
+    return h.hexdigest()
+
+
+# -- the distributed dispatch ----------------------------------------------
+
+def dispatch_dist(arrays: dict, *, n_max: int, E: int, P: int,
+                  mesh: Mesh, cache: dict, dirty=None,
+                  metrics=None) -> dict:
+    """dispatch_mesh's distributed twin: always the 2-D dp x tp kernel
+    (a distributed mesh exists precisely because the slot axis outgrew
+    one process), inputs committed per-process via commit_global, the
+    sharded arena RESIDENT across ticks with the same dirty-list patch
+    contract as _place_resident, outputs assembled with
+    process_allgather. Slot-sharded fields may arrive as LocalSlab (the
+    no-full-arena path); everything else is host-replicated numpy.
+    Requires K == 0 (minValues floors stay on the 1-D type mesh)."""
+    from jax.experimental import multihost_utils as mhu
+
+    if arrays.get("mv_floor") is not None:
+        raise ValueError("distributed mesh solve does not take "
+                         "minValues floors")
+    ndp = mesh.shape[AXIS_DP]
+    ntp = mesh.shape[AXIS]
+    N = E + n_max
+    Np = ((N + ndp - 1) // ndp) * ndp
+    specs = _input_specs2()
+    fields = [f for f in KernelInputs._fields
+              if arrays.get(f) is not None]
+    T = int(np.asarray(
+        arrays["A"].array if isinstance(arrays["A"], LocalSlab)
+        else arrays["A"]).shape[0])
+    Tp = ((T + ntp - 1) // ntp) * ntp
+
+    def shape_of(v):
+        return tuple(v.global_shape) if isinstance(v, LocalSlab) \
+            else tuple(np.asarray(v).shape)
+
+    def commit(f):
+        v = arrays[f]
+        if isinstance(v, LocalSlab):
+            return commit_global(v, mesh, getattr(specs, f))
+        return commit_global(_prep_field(f, v, Tp, Np), mesh,
+                             getattr(specs, f))
+
+    key = ("dist2", n_max, E, P, ndp, ntp, Tp, Np,
+           tuple((f, shape_of(arrays[f])) for f in fields))
+    res = cache.get("resident")
+    t0 = time.perf_counter()
+    if dirty is not None and res is not None and res["key"] == key:
+        mode = "patch" if dirty else "reuse"
+        dev = res["dev"]
+        placed = [f for f in dirty if f in fields]
+        for f in placed:
+            dev[f] = commit(f)
+    else:
+        mode = "full"
+        dev = {f: commit(f) for f in fields}
+        cache["resident"] = {"key": key, "dev": dev}
+        cache["resident_gen"] = cache.get("resident_gen", 0) + 1
+        placed = list(fields)
+    commit_s = time.perf_counter() - t0
+    cache["last_placement"] = {"mode": mode, "kernel": "dist2",
+                               "fields": list(placed)}
+    if metrics is not None:
+        metrics.set_gauge("karpenter_solver_distmesh_processes",
+                          jax.process_count())
+        metrics.inc("karpenter_solver_distmesh_patch_total",
+                    labels={"mode": mode})
+
+    inp = KernelInputs(**dev)
+    t0 = time.perf_counter()
+    takes, leftover, carry = _solve_sharded2(
+        inp, n_max, E, P, mesh, sum_only=_resolve_sum_only(mesh))
+    jax.block_until_ready(takes)
+    solve_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    if jax.process_count() == 1:
+        takes = np.asarray(takes)
+        leftover = np.asarray(leftover)
+        carry = carry._replace(**{f: np.asarray(getattr(carry, f))
+                                  for f in carry._fields})
+    else:
+        # ONE resharding program gathers every output: collective order
+        # is fixed inside a single executable on every process, where a
+        # launch-per-output gather leaves N small programs whose gloo
+        # ops can interleave across the tick boundary (observed as a
+        # preamble-size enforce failure on the recycled slot)
+        repl = NamedSharding(mesh, PS())
+        gathered = jax.jit(lambda xs: xs, out_shardings=repl)(
+            (takes, leftover) + tuple(carry))
+        host = [np.asarray(x.addressable_data(0)) for x in gathered]
+        takes, leftover = host[0], host[1]
+        carry = type(carry)(*host[2:])
+        # tick barrier: gloo TCP is FIFO per pair, so once every
+        # process has seen every other's barrier message, no collective
+        # bytes from THIS tick are still in flight to collide with the
+        # next tick's receive slots
+        seq = cache["tick_seq"] = cache.get("tick_seq", 0) + 1
+        mhu.sync_global_devices(f"distmesh:tick:{seq}")
+    gather_s = time.perf_counter() - t0
+    cache["last_timing"] = {"commit_s": commit_s, "solve_s": solve_s,
+                            "gather_s": gather_s}
+    return _out_dict(takes, leftover, carry, T, N=N)
+
+
+# -- worker control plane --------------------------------------------------
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed mid-message")
+        buf += chunk
+    return buf
+
+
+def _pack_arrays(arrays: Dict[str, np.ndarray]) -> bytes:
+    bio = io.BytesIO()
+    np.savez(bio, **{k: np.asarray(v) for k, v in arrays.items()})
+    return bio.getvalue()
+
+
+def _unpack_arrays(payload: bytes) -> Dict[str, np.ndarray]:
+    with np.load(io.BytesIO(payload), allow_pickle=False) as z:
+        return {k: z[k] for k in z.files}
+
+
+def _send_msg(sock: socket.socket, msg: dict,
+              arrays: Optional[Dict[str, np.ndarray]] = None) -> None:
+    """One frame: !II (header len, payload len) + JSON header + npz
+    payload. Loopback wire — framing over compression."""
+    payload = _pack_arrays(arrays) if arrays else b""
+    head = json.dumps(msg).encode()
+    sock.sendall(struct.pack("!II", len(head), len(payload)))
+    sock.sendall(head)
+    if payload:
+        sock.sendall(payload)
+
+
+def _recv_msg(sock: socket.socket
+              ) -> Tuple[Optional[dict], Dict[str, np.ndarray]]:
+    """Inverse of _send_msg; (None, {}) on orderly close."""
+    try:
+        raw = _recv_exact(sock, 8)
+    except ConnectionError:
+        return None, {}
+    hl, pl = struct.unpack("!II", raw)
+    msg = json.loads(_recv_exact(sock, hl).decode())
+    arrays = _unpack_arrays(_recv_exact(sock, pl)) if pl else {}
+    return msg, arrays
+
+
+def _slabs_from_frame(msg: dict, arrays: Dict[str, np.ndarray]) -> dict:
+    """Rebuild LocalSlab fields a solve_frame message shipped: the
+    header carries {field: [lo, hi, axis, global_shape]}."""
+    out = dict(arrays)
+    for f, (lo, hi, axis, gshape) in (msg.get("slabs") or {}).items():
+        out[f] = LocalSlab(arrays[f], int(lo), int(hi), int(axis),
+                           tuple(int(s) for s in gshape))
+    return out
+
+
+def run_worker(control: str, proc_id: int) -> None:
+    """One mesh-group process: connect to the coordinator's control
+    socket, then serve commands until halt/close. jax.distributed work
+    only starts at the 'mesh' command, so the same loop also serves the
+    single-process oracle role. Exits via os._exit — after a peer dies
+    the distributed runtime's destructors can hang in collectives, and
+    the coordinator owns lifecycle anyway."""
+    host, _, port = control.rpartition(":")
+    sock = socket.create_connection((host, int(port)))
+    _send_msg(sock, {"hello": proc_id})
+    mesh: Optional[Mesh] = None
+    cache: dict = {}
+    batch_cache: dict = {}
+    code = 0
+    while True:
+        msg, arrays = _recv_msg(sock)
+        if msg is None or msg.get("cmd") == "halt":
+            break
+        try:
+            reply, rarrays = _worker_cmd(msg, arrays, proc_id, cache,
+                                         batch_cache)
+            if reply.get("_mesh_built"):
+                mesh = reply.pop("_mesh_built")
+                cache["mesh"] = mesh
+            _send_msg(sock, reply, rarrays)
+        except Exception as e:  # report, don't die: coordinator decides
+            log.exception("worker %d: command %r failed", proc_id,
+                          msg.get("cmd"))
+            try:
+                _send_msg(sock, {"ok": False, "error": repr(e)})
+            except Exception:
+                code = 1
+                break
+    os._exit(code)
+
+
+def _worker_cmd(msg: dict, arrays: Dict[str, np.ndarray], proc_id: int,
+                cache: dict, batch_cache: dict
+                ) -> Tuple[dict, Optional[Dict[str, np.ndarray]]]:
+    cmd = msg["cmd"]
+    if cmd == "mesh":
+        cfg = DistConfig(msg["coordinator"], int(msg["num_processes"]),
+                         int(msg["process_id"]),
+                         msg.get("local_devices"))
+        init_process(cfg)
+        mesh = dist_mesh2()
+        return {"ok": True, "_mesh_built": mesh,
+                "ndev": int(mesh.devices.size),
+                "dp": int(mesh.shape[AXIS_DP]),
+                "tp": int(mesh.shape[AXIS]),
+                "process_index": int(jax.process_index())}, None
+
+    if cmd in ("solve_seeded", "solve_frame"):
+        mesh = cache.get("mesh")
+        if mesh is None:
+            raise RuntimeError("mesh not initialized (send 'mesh' first)")
+        if cmd == "solve_seeded":
+            shape = msg["shape"]
+            Np, lo, hi = slab_rows(shape["n_max"], shape["E"], mesh)
+            inp, statics = tick_arrays(shape, int(msg["seed"]),
+                                       int(msg["tick"]), slab=(lo, hi, Np))
+        else:
+            inp = _slabs_from_frame(msg, arrays)
+            statics = {k: int(msg[k]) for k in ("n_max", "E", "P")}
+        t0 = time.perf_counter()
+        out = dispatch_dist(inp, mesh=mesh, cache=cache,
+                            dirty=msg.get("dirty"), **statics)
+        wall = time.perf_counter() - t0
+        reply = {"ok": True, "fingerprint": result_fingerprint(out),
+                 "wall_s": wall,
+                 "mode": cache["last_placement"]["mode"],
+                 "timing": cache.get("last_timing", {})}
+        want = bool(msg.get("want_arrays")) and proc_id == 0
+        return reply, (out if want else None)
+
+    if cmd == "solve_batch":
+        # routed SolveBatch lanes: independent vmapped solves over THIS
+        # process's local devices — no collectives, so no global mesh
+        from ..ops.ffd_jax import solve_scan_packed1_many
+        from .mesh import shard_batch
+        kv = {k: int(v) for k, v in msg["kv"].items()}
+        stack = arrays["stack"]
+        ndev = len(jax.local_devices())
+        d_stack, B = shard_batch(stack, ndev, batch_cache)
+        out = np.asarray(solve_scan_packed1_many(d_stack, **kv))[:B]
+        return {"ok": True, "lanes": int(B)}, {"out": out}
+
+    if cmd == "solve_oracle":
+        shape = msg["shape"]
+        inp, statics = tick_arrays(shape, int(msg["seed"]),
+                                   int(msg["tick"]))
+        out = oracle_out(inp, **statics)
+        reply = {"ok": True, "fingerprint": result_fingerprint(out)}
+        return reply, (out if msg.get("want_arrays") else None)
+
+    raise ValueError(f"unknown command {cmd!r}")
+
+
+def main(argv=None) -> None:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="distmesh worker process (fleet/meshgroup.py "
+                    "spawns these; not a user-facing CLI)")
+    ap.add_argument("--worker", action="store_true", required=True)
+    ap.add_argument("--control", required=True,
+                    help="host:port of the coordinator control socket")
+    ap.add_argument("--proc-id", type=int, default=None,
+                    help="process id; defaults to the POD_NAME "
+                         "StatefulSet ordinal + 1 (chart contract), "
+                         "else 0")
+    args = ap.parse_args(argv)
+    pid = args.proc_id
+    if pid is None:
+        tail = os.environ.get("POD_NAME", "").rsplit("-", 1)[-1]
+        pid = int(tail) + 1 if tail.isdigit() else 0
+    run_worker(args.control, pid)
+
+
+if __name__ == "__main__":
+    main()

@@ -125,11 +125,42 @@ def _resolve_sum_only(mesh: Mesh) -> bool:
     return cached
 
 
-def _pick_devices(n_devices: Optional[int]):
-    devices = jax.devices()
+def _pick_devices(n_devices: Optional[int] = None,
+                  force_host: bool = False):
+    """The one device-selection helper (shared with __graft_entry__ —
+    previously a diverged duplicate).
+
+    Default mode returns the default backend's LOCAL devices truncated
+    to ``n_devices``, falling back to host CPU devices when the backend
+    has fewer than requested. Local, not global: under a
+    ``jax.distributed`` job ``jax.devices()`` spans every process, and
+    every caller of this helper builds a single-process mesh — the
+    distributed tier (parallel/distmesh.py) owns its own process-major
+    global ordering.
+
+    ``force_host=True`` is the dryrun/driver discipline: select
+    ``n_devices`` VIRTUAL host devices without touching any accelerator
+    backend — the device-count flag and the platform pin must both land
+    before the first backend init (they are read once, at client
+    creation), and initializing a default (TPU/tunnel) backend can
+    block for minutes or die on a broken runtime."""
+    if force_host:
+        import os
+
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count"
+                f"={n_devices}").strip()
+        jax.config.update("jax_platforms", "cpu")  # beats site hooks
+        avail = jax.local_devices(backend="cpu")
+        assert len(avail) >= n_devices, \
+            f"need {n_devices} devices, have {len(avail)}"
+        return avail[:n_devices]
+    devices = jax.local_devices()
     if n_devices is not None:
         if len(devices) < n_devices:
-            devices = jax.devices("cpu")
+            devices = jax.local_devices(backend="cpu")
         devices = devices[:n_devices]
     return devices
 
@@ -177,13 +208,15 @@ def _dp2_min_slots() -> int:
     split a slot-indexed carry too big to replicate (the 500k-pod
     envelope, slot axes in the thousands); under ~2k slots its extra
     per-step collectives and its much larger compiled program are pure
-    overhead. KARP_MESH_DP2_MIN_SLOTS overrides (0 forces dp2 on)."""
+    overhead. KARP_MESH_DP2_MIN_SLOTS overrides; 0 forces dp2 on, and
+    negatives clamp to 0 (every real slot count beats a negative floor,
+    so they mean "force on" too — not a crash, not a silent default)."""
     import os
 
     env = os.environ.get("KARP_MESH_DP2_MIN_SLOTS")
     if env:
         try:
-            return int(env)
+            return max(0, int(env))
         except ValueError:
             pass
     return DP2_MIN_SLOTS
@@ -371,40 +404,31 @@ def solve_scan_sharded2(inp: KernelInputs, n_max: int, E: int, P: int,
     return takes, leftover, carry
 
 
-def shard_batch(stack: np.ndarray, ndev: int, cache: dict
-                ) -> Tuple[jax.Array, int]:
-    """Distribute a stacked [B, W] packed-solve batch across devices: pad
-    B up to a device multiple by repeating the last row (lanes of the
-    vmapped packed kernel are independent, so pad lanes are inert —
-    callers slice results [:B]) and commit with NamedSharding(P("dp",
-    None)) so the jit partitions the batch with zero cross-device
-    collectives. Returns (device stack [Bp, W], B)."""
+def _batch_mesh(ndev: int, cache: dict) -> Mesh:
+    """The cached 1-D batch-dp mesh for shard_batch/shard_lanes. Keyed
+    on the DEVICE IDS, not just the count: a changed device set at the
+    same count (backend re-init, a distmesh degrade swapping which local
+    devices back the solver) must rebuild — a count-only key silently
+    reuses a mesh over devices that may no longer exist."""
+    ids = tuple(d.id for d in _pick_devices(ndev))
     mesh = cache.get("batch_mesh")
-    if mesh is None or mesh.devices.size != ndev:
+    if mesh is None or cache.get("batch_mesh_ids") != ids:
         mesh = cache["batch_mesh"] = Mesh(
             np.asarray(_pick_devices(ndev)), axis_names=(AXIS_DP,))
-    stack = np.asarray(stack)
-    B = stack.shape[0]
-    Bp = ((B + ndev - 1) // ndev) * ndev
-    if Bp != B:
-        stack = np.concatenate(
-            [stack, np.repeat(stack[-1:], Bp - B, axis=0)], axis=0)
-    return jax.device_put(stack, NamedSharding(mesh, PS(AXIS_DP, None))), B
+        cache["batch_mesh_ids"] = ids
+    return mesh
 
 
-def shard_lanes(stacks: Dict[str, np.ndarray], ndev: int, cache: dict
-                ) -> Tuple[Dict[str, jax.Array], int]:
-    """shard_batch for a DICT of per-lane stacks sharing a leading batch
-    axis (the consolidation subset search: gid/n/dead/keep/price lanes):
-    pad B up to a device multiple by repeating each stack's last row
-    (lanes are independent, so pad lanes are inert — callers slice
-    results [:B]) and commit every stack dp-sharded on the leading axis
-    with trailing axes replicated. The shared union-arena tensors stay
-    host-side and replicate at trace time. Returns (device dict, B)."""
-    mesh = cache.get("batch_mesh")
-    if mesh is None or mesh.devices.size != ndev:
-        mesh = cache["batch_mesh"] = Mesh(
-            np.asarray(_pick_devices(ndev)), axis_names=(AXIS_DP,))
+def _shard_stacks(stacks: Dict[str, np.ndarray], ndev: int, cache: dict
+                  ) -> Tuple[Dict[str, jax.Array], int]:
+    """The one pad-to-device-multiple + device_put loop behind
+    shard_batch and shard_lanes (previously duplicated): pad the shared
+    leading batch axis B up to a device multiple by repeating each
+    stack's last row (lanes of the vmapped kernels are independent, so
+    pad lanes are inert — callers slice results [:B]) and commit every
+    stack dp-sharded on the leading axis with trailing axes replicated.
+    Returns (device dict, B)."""
+    mesh = _batch_mesh(ndev, cache)
     first = np.asarray(next(iter(stacks.values())))
     B = first.shape[0]
     Bp = ((B + ndev - 1) // ndev) * ndev
@@ -417,6 +441,25 @@ def shard_lanes(stacks: Dict[str, np.ndarray], ndev: int, cache: dict
         spec = PS(AXIS_DP, *([None] * (a.ndim - 1)))
         out[k] = jax.device_put(a, NamedSharding(mesh, spec))
     return out, B
+
+
+def shard_batch(stack: np.ndarray, ndev: int, cache: dict
+                ) -> Tuple[jax.Array, int]:
+    """Distribute a stacked [B, W] packed-solve batch across devices
+    with NamedSharding(P("dp", None)) so the jit partitions the batch
+    with zero cross-device collectives. Returns (device stack [Bp, W],
+    B). Padding/commit semantics: _shard_stacks."""
+    out, B = _shard_stacks({"stack": stack}, ndev, cache)
+    return out["stack"], B
+
+
+def shard_lanes(stacks: Dict[str, np.ndarray], ndev: int, cache: dict
+                ) -> Tuple[Dict[str, jax.Array], int]:
+    """shard_batch for a DICT of per-lane stacks sharing a leading batch
+    axis (the consolidation subset search: gid/n/dead/keep/price lanes).
+    The shared union-arena tensors stay host-side and replicate at trace
+    time. Padding/commit semantics: _shard_stacks."""
+    return _shard_stacks(stacks, ndev, cache)
 
 
 def _prep_field(name: str, a, Tp: int, Np: Optional[int]) -> np.ndarray:
@@ -540,10 +583,20 @@ def dispatch_mesh(arrays: dict, *, n_max: int, E: int, P: int, V: int,
     else:
         takes, leftover, carry = _solve_sharded(
             inp, n_max, E, P, mesh, V=V, sum_only=sum_only)
+    return _out_dict(takes, leftover, carry, T,
+                     N=N if kern == "dp2" else None)
+
+
+def _out_dict(takes, leftover, carry: Carry, T: int,
+              N: Optional[int] = None) -> dict:
+    """Assemble the solve outputs into the hostpack.unpack_outputs1 dict
+    shape shared by every dispatch surface (local mesh, sidecar,
+    distmesh, oracles) — one place strips the inert type padding and,
+    when ``N`` is given (slot-sharded kernels), the inert slot padding,
+    so the surfaces can never drift apart."""
     carry = Carry(*[np.asarray(x) for x in carry])
-    # strip the inert type padding — and, on dp2, the inert slot padding
     takes = np.asarray(takes)
-    if kern == "dp2":
+    if N is not None:
         takes = takes[:, :N]
         carry = carry._replace(
             used=carry.used[:N], types=carry.types[:N],

@@ -284,7 +284,7 @@ class _Handler:
 
     def __init__(self, metrics=None, admission=None, shape_table=None,
                  bucketing: bool = True, compile_monitor=None,
-                 patch_arenas=None):
+                 patch_arenas=None, mesh_group=None):
         #: the compile-cache budget — an LRU shape-class table that
         #: still answers len()/in like the set it replaced
         self._shapes_seen = shape_table if shape_table is not None \
@@ -297,6 +297,11 @@ class _Handler:
         self._admission = admission
         self._bucketing = bucketing
         self._compile_monitor = compile_monitor
+        #: optional fleet.meshgroup.MeshGroup — a multi-process
+        #: distributed mesh behind this server; solve paths route
+        #: through it while alive and keep their local twin as the
+        #: always-correct fallback
+        self._mesh_group = mesh_group
         self.cache_dir = ""
         self._mesh_cache: dict = {}
         self._mesh_mu = threading.Lock()
@@ -689,6 +694,21 @@ class _Handler:
         from ..ops.ffd_jax import solve_scan_packed1_many
         from ..parallel.mesh import shard_batch
         B = stack.shape[0]
+        if self._mesh_group is not None and self._mesh_group.alive():
+            # distributed group: lanes fan out across processes, each
+            # solved on that worker's local devices (linear scale-out,
+            # zero collectives). None/raise keeps the local path — the
+            # group degrades itself, decisions are identical either way
+            try:
+                out = self._mesh_group.solve_batch(stack, kv)
+            except Exception:
+                out = None
+            if out is not None:
+                if self.metrics is not None:
+                    self.metrics.inc(
+                        "karpenter_solver_mesh_batch_lanes_total",
+                        B, labels={"rpc": rpc})
+                return np.asarray(out)[:B]
         with self._mesh_mu:
             d_stack, _ = shard_batch(stack, ndev, self._mesh_cache)
         out = np.asarray(solve_scan_packed1_many(d_stack, **kv))[:B]
@@ -715,6 +735,25 @@ class _Handler:
         if kv["K"] == 0:
             for mk in ("mv_floor", "mv_pairs_t", "mv_pairs_v"):
                 arrays.pop(mk, None)
+        # distributed group first: the 2-D solve's slot axis spans every
+        # process, each worker committing only its dp slab (frame mode —
+        # the arena arrived whole over gRPC, so the coordinator slices).
+        # dist is dp2-only: minValues floors (K>0) and flex lanes (V>0)
+        # stay on the local 1-D type mesh
+        if (self._mesh_group is not None and self._mesh_group.alive()
+                and kv["K"] == 0 and kv["V"] == 0):
+            try:
+                with self._mesh_mu:
+                    r = self._mesh_group.solve_frame(
+                        arrays, {k: kv[k] for k in ("n_max", "E", "P")},
+                        want_arrays=True)
+                if r.get("out"):
+                    return pack_outputs1(
+                        r["out"], kv["T"], kv["D"], kv["Z"], kv["C"],
+                        kv["G"], kv["E"], kv["P"], kv["n_max"])
+            except Exception:
+                log.exception("mesh group solve failed; serving from "
+                              "the local mesh")
         # dispatch_mesh reads AND writes its compile cache; serialize
         # mesh solves — they already contend for every device, so the
         # lock costs nothing beyond what the hardware imposes
@@ -962,6 +1001,13 @@ class _Handler:
                 [1 if self._admission is not None else 0], dtype=np.int64),
             "bucketed": np.array([1 if self._bucketing else 0],
                                  dtype=np.int64),
+            # multi-process distributed mesh behind this server
+            # (fleet/meshgroup.py); drops to 0 on degrade, so fleet
+            # membership sees the capability change on its next probe
+            "mesh_group": np.array(
+                [1 if (self._mesh_group is not None
+                       and self._mesh_group.alive()) else 0],
+                dtype=np.int64),
             "compile_cache_hits": np.array([cc["hits"]], dtype=np.int64),
             "compile_cache_misses": np.array([cc["misses"]],
                                              dtype=np.int64),
@@ -1053,7 +1099,8 @@ class SolverServer:
                  default_quota=None, bucketing: bool = True,
                  compile_cache: bool = True,
                  compile_cache_dir: Optional[str] = None,
-                 aot_cache: bool = True, aot_record: bool = False):
+                 aot_cache: bool = True, aot_record: bool = False,
+                 mesh_workers: Optional[int] = None):
         import grpc
         if (tls_cert is None) != (tls_key is None):
             # a security posture must fail CLOSED: half a TLS config is
@@ -1112,9 +1159,30 @@ class SolverServer:
             # the same registry — last attach wins, one per process
             from ..native import deltawalk as _dwalk
             _dwalk.attach_metrics(metrics)
+        # distributed mesh group: SOLVER_DISTMESH_WORKERS extra worker
+        # processes joined into one logical dp x tp solver (explicit
+        # arg wins over env). Formed BEFORE the first RPC so clients
+        # never observe the capability flapping on at runtime
+        self._mesh_group = None
+        if mesh_workers is None:
+            import os as _os
+
+            from ..parallel.distmesh import (LOCAL_DEVICES_ENV,
+                                             WORKERS_ENV)
+            mesh_workers = int(_os.environ.get(WORKERS_ENV, "0") or 0)
+            mesh_local = int(_os.environ.get(LOCAL_DEVICES_ENV, "8")
+                             or 8)
+        else:
+            mesh_local = 8
+        if mesh_workers > 0:
+            from ..fleet.meshgroup import MeshGroup
+            self._mesh_group = MeshGroup(
+                workers=mesh_workers, local_devices=mesh_local,
+                metrics=metrics).start()
         self._handler = _Handler(metrics=metrics, admission=admission,
                                  bucketing=bucketing,
-                                 compile_monitor=monitor)
+                                 compile_monitor=monitor,
+                                 mesh_group=self._mesh_group)
         self._handler.cache_dir = cache_dir
         self._server.add_generic_rpc_handlers(
             (_generic_handler(self._handler),))
@@ -1142,6 +1210,8 @@ class SolverServer:
             log.warning("sidecar stop: in-flight solves still running "
                         "after %.1fs grace; cancelling", grace or 0.0)
         done.wait(grace)
+        if self._mesh_group is not None:
+            self._mesh_group.stop()
 
 
 def serve(address: str = "127.0.0.1", port: int = 50151,

@@ -44,6 +44,14 @@ VALUE_SETS = {
                    "sidecar.fleetEndpoints=solver-0.solver.karpenter:50151",
                    "sidecar.sharedCache.enabled=true",
                    "sidecar.token=golden-token"],
+    # the distributed mesh group (parallel/distmesh.py): the solver
+    # StatefulSet grows the SOLVER_DISTMESH_* coordinator contract and
+    # a worker StatefulSet + headless Service joins ordinals i as
+    # processes i+1 of ONE cross-process dp x tp mesh.
+    "mesh.yaml": ["settings.clusterName=golden-cluster",
+                  "sidecar.replicaCount=1",
+                  "sidecar.mesh.workers=2",
+                  "sidecar.token=golden-token"],
 }
 
 

@@ -1,0 +1,375 @@
+"""Cross-process distributed mesh (parallel/distmesh.py) and its
+coordinator (fleet/meshgroup.py).
+
+Fast tier: config/geometry/workload/wire units and the single-process
+twins of the distributed paths (dispatch_dist on an in-process 2-D
+mesh, MeshGroup local mode, the degradation taxonomy) — everything
+that doesn't need a second OS process. The `slow` tier spawns REAL
+worker subprocesses joined by jax.distributed and pins the
+cross-process solve fingerprint-identical to the CPU oracle, including
+a mid-stream worker kill (`make multihost` runs the larger driver
+sweep on top: 1M-pod ceiling, batch routing, chaos)."""
+
+import os
+import socket
+
+import numpy as np
+import pytest
+
+import jax
+
+from karpenter_provider_aws_tpu.fleet.meshgroup import MeshGroup
+from karpenter_provider_aws_tpu.parallel import distmesh
+from karpenter_provider_aws_tpu.parallel.distmesh import (
+    COORDINATOR_ENV, DIRTY_FIELDS, LOCAL_DEVICES_ENV, PROCESS_ID_ENV,
+    PROCESSES_ENV, WORKERS_ENV, LocalSlab, collective_bill,
+    commit_global, config_from_env, dist_dp, dist_mesh2, dispatch_dist,
+    local_slot_rows, oracle_out, result_fingerprint, tick_arrays)
+from karpenter_provider_aws_tpu.utils.metrics import Metrics
+
+SHAPE = dict(G=6, T=11, n_max=64, E=24, P=2, Z=3, C=2, D=4,
+             pods_per_group=17)
+
+
+class TestConfigFromEnv:
+    def test_unset_is_none(self, monkeypatch):
+        monkeypatch.delenv(COORDINATOR_ENV, raising=False)
+        assert config_from_env() is None
+
+    def test_explicit_contract(self, monkeypatch):
+        monkeypatch.setenv(COORDINATOR_ENV, "10.0.0.1:52021")
+        monkeypatch.setenv(PROCESSES_ENV, "3")
+        monkeypatch.setenv(PROCESS_ID_ENV, "2")
+        monkeypatch.setenv(LOCAL_DEVICES_ENV, "4")
+        cfg = config_from_env()
+        assert cfg == ("10.0.0.1:52021", 3, 2, 4)
+
+    def test_workers_env_derives_process_count(self, monkeypatch):
+        """The chart never templates arithmetic: processes = workers+1
+        is derived here, at runtime."""
+        monkeypatch.setenv(COORDINATOR_ENV, "solver-0.solver:52021")
+        monkeypatch.delenv(PROCESSES_ENV, raising=False)
+        monkeypatch.setenv(WORKERS_ENV, "2")
+        monkeypatch.delenv(PROCESS_ID_ENV, raising=False)
+        monkeypatch.setenv("POD_NAME", "solver-mesh-1")
+        monkeypatch.delenv(LOCAL_DEVICES_ENV, raising=False)
+        cfg = config_from_env()
+        assert cfg.num_processes == 3
+        # StatefulSet ordinal 1 -> process 2 (the coordinator is 0)
+        assert cfg.process_id == 2
+        assert cfg.local_devices is None
+
+    def test_non_ordinal_pod_name_is_process_zero(self, monkeypatch):
+        monkeypatch.setenv(COORDINATOR_ENV, "c:1")
+        monkeypatch.setenv(WORKERS_ENV, "1")
+        monkeypatch.delenv(PROCESS_ID_ENV, raising=False)
+        monkeypatch.setenv("POD_NAME", "controller-abc")
+        assert config_from_env().process_id == 0
+
+
+class TestMeshGeometry:
+    def test_dist_dp_is_process_multiple(self, monkeypatch):
+        monkeypatch.delenv("KARP_DIST_DP", raising=False)
+        # nproc x _default_dp(per-process share): 8dev/1proc -> 2,
+        # 16dev/2proc -> 2 x _default_dp(8) = 4
+        assert dist_dp(8, 1) == 2
+        assert dist_dp(16, 2) == 4
+        assert dist_dp(16, 2) % 2 == 0
+
+    def test_dist_dp_uneven_devices_raise(self, monkeypatch):
+        monkeypatch.delenv("KARP_DIST_DP", raising=False)
+        with pytest.raises(ValueError):
+            dist_dp(9, 2)
+
+    def test_dist_dp_env_override(self, monkeypatch):
+        monkeypatch.setenv("KARP_DIST_DP", "8")
+        assert dist_dp(16, 2) == 8
+        # invalid overrides fall back: not a divisor / below nproc /
+        # not a process multiple
+        monkeypatch.setenv("KARP_DIST_DP", "6")
+        assert dist_dp(16, 2) == 4
+        monkeypatch.setenv("KARP_DIST_DP", "1")
+        assert dist_dp(16, 2) == 4
+        monkeypatch.setenv("KARP_DIST_DP", "3")
+        assert dist_dp(16, 4) == 8
+
+    def test_local_slot_rows_contiguous_partition(self):
+        rows = [local_slot_rows(96, 3, pid) for pid in range(3)]
+        assert rows == [(0, 32), (32, 64), (64, 96)]
+        with pytest.raises(ValueError):
+            local_slot_rows(97, 3, 0)
+
+    def test_dist_mesh2_process_major(self, monkeypatch):
+        monkeypatch.delenv("KARP_DIST_DP", raising=False)
+        mesh = dist_mesh2()
+        assert mesh.axis_names == ("dp", "tp")
+        assert mesh.devices.size == len(jax.devices())
+
+    def test_collective_bill_splits_at_process_boundary(self):
+        one = collective_bill(P=2, dp=4, nproc=1, G=10)
+        two = collective_bill(P=2, dp=4, nproc=2, G=10)
+        # identical per-step program; only the process boundary moves
+        assert one["per_step"] == two["per_step"]
+        assert one["cross_process_per_step"] == 0
+        assert two["cross_process_per_step"] == 2 + 3  # (P+1) + 2
+        assert two["cross_process_total"] == 50
+        assert two["bytes_per_dp_collective"] == 32
+
+
+class TestTickArrays:
+    def test_slab_parity_with_full_generation(self):
+        """Generating rows [lo, hi) must equal slicing the full
+        generation — the property that lets every host build only its
+        slab while all hosts agree on the logical arena."""
+        full, statics = tick_arrays(SHAPE, seed=5, tick=3)
+        E, D = SHAPE["E"], SHAPE["D"]
+        Np = 96
+        for lo, hi in ((0, 48), (48, 96)):
+            slabbed, st2 = tick_arrays(SHAPE, seed=5, tick=3,
+                                       slab=(lo, hi, Np))
+            assert st2 == statics
+            a = slabbed["ex_alloc"]
+            assert isinstance(a, LocalSlab)
+            assert (a.lo, a.hi, a.axis, a.global_shape) == \
+                (lo, hi, 0, (Np, D))
+            top = min(hi, E)
+            assert np.array_equal(a.array[:max(0, top - lo)],
+                                  full["ex_alloc"][lo:top])
+            assert (a.array[max(0, top - lo):] == 0).all()
+            c = slabbed["ex_compat"]
+            assert c.axis == 1 and c.global_shape == (SHAPE["G"], Np)
+            assert np.array_equal(c.array[:, :max(0, top - lo)],
+                                  full["ex_compat"][:, lo:top])
+            # replicated fields are identical either mode
+            assert np.array_equal(slabbed["n"], full["n"])
+
+    def test_dirty_contract_across_ticks(self):
+        """Only DIRTY_FIELDS may move between ticks: the resident-arena
+        patch path re-places exactly those, so any other field drifting
+        would silently desynchronize the on-device arena."""
+        t0, _ = tick_arrays(SHAPE, seed=5, tick=0)
+        t1, _ = tick_arrays(SHAPE, seed=5, tick=1)
+        changed = {k for k in t0
+                   if not np.array_equal(np.asarray(t0[k]),
+                                         np.asarray(t1[k]))}
+        assert changed == set(DIRTY_FIELDS)
+
+
+class TestWire:
+    def test_roundtrip_with_arrays(self):
+        a, b = socket.socketpair()
+        try:
+            arrays = {"x": np.arange(6).reshape(2, 3),
+                      "m": np.array([True, False])}
+            distmesh._send_msg(a, {"cmd": "t", "k": 1}, arrays)
+            msg, got = distmesh._recv_msg(b)
+            assert msg == {"cmd": "t", "k": 1}
+            assert set(got) == {"x", "m"}
+            assert np.array_equal(got["x"], arrays["x"])
+            assert got["m"].dtype == np.bool_
+        finally:
+            a.close()
+            b.close()
+
+    def test_headers_only_and_orderly_close(self):
+        a, b = socket.socketpair()
+        try:
+            distmesh._send_msg(a, {"cmd": "halt"})
+            msg, got = distmesh._recv_msg(b)
+            assert msg == {"cmd": "halt"} and got == {}
+            a.close()
+            assert distmesh._recv_msg(b) == (None, {})
+        finally:
+            b.close()
+
+
+class TestCommitGlobal:
+    def test_slab_commit_equals_full_commit(self):
+        from jax.sharding import PartitionSpec as PS
+        mesh = dist_mesh2()
+        ndp = mesh.shape["dp"]
+        Np, D = 8 * ndp, 3
+        full = np.arange(Np * D, dtype=np.int64).reshape(Np, D)
+        spec = PS("dp", None)
+        want = np.asarray(commit_global(full, mesh, spec))
+        # single process owns every row, so the whole-range slab is the
+        # degenerate (but geometry-exercising) case
+        got = commit_global(LocalSlab(full, 0, Np, 0, (Np, D)),
+                            mesh, spec)
+        assert np.array_equal(np.asarray(got), want)
+
+    def test_slab_outside_ownership_refuses(self):
+        from jax.sharding import PartitionSpec as PS
+        mesh = dist_mesh2()
+        Np, D = 8 * mesh.shape["dp"], 3
+        half = Np // 2
+        slab = LocalSlab(np.zeros((half, D), np.int64), 0, half, 0,
+                         (Np, D))
+        with pytest.raises(ValueError, match="outside local slab"):
+            commit_global(slab, mesh, PS("dp", None))
+
+
+class TestDispatchDistSingleProcess:
+    """dispatch_dist on an in-process 2-D mesh: the same code path the
+    workers run, minus the cross-process collectives (process_count=1),
+    so modes/fingerprints/rejections are all checkable in the fast
+    tier."""
+
+    def _arrays(self, tick):
+        return tick_arrays(SHAPE, seed=9, tick=tick)
+
+    def test_full_patch_reuse_and_oracle_parity(self):
+        mesh = dist_mesh2()
+        cache = {}
+        metrics = Metrics()
+        arrays, statics = self._arrays(0)
+        out0 = dispatch_dist(arrays, mesh=mesh, cache=cache,
+                             metrics=metrics, **statics)
+        assert cache["last_placement"]["mode"] == "full"
+        assert result_fingerprint(out0) == \
+            result_fingerprint(oracle_out(self._arrays(0)[0],
+                                          **statics))
+        arrays1, _ = self._arrays(1)
+        out1 = dispatch_dist(arrays1, mesh=mesh, cache=cache,
+                             dirty=list(DIRTY_FIELDS), **statics)
+        assert cache["last_placement"]["mode"] == "patch"
+        assert sorted(cache["last_placement"]["fields"]) == \
+            sorted(DIRTY_FIELDS)
+        assert result_fingerprint(out1) == \
+            result_fingerprint(oracle_out(self._arrays(1)[0],
+                                          **statics))
+        dispatch_dist(arrays1, mesh=mesh, cache=cache, dirty=[],
+                      **statics)
+        assert cache["last_placement"]["mode"] == "reuse"
+        assert "commit_s" in cache["last_timing"]
+        assert metrics.gauge(
+            "karpenter_solver_distmesh_processes") == 1
+        assert metrics.counter("karpenter_solver_distmesh_patch_total",
+                               labels={"mode": "full"}) == 1
+
+    def test_minvalues_floors_rejected(self):
+        arrays, statics = self._arrays(0)
+        arrays = dict(arrays, mv_floor=np.zeros(3, np.int64))
+        with pytest.raises(ValueError, match="minValues"):
+            dispatch_dist(arrays, mesh=dist_mesh2(), cache={},
+                          **statics)
+
+
+class TestMeshGroupLocalMode:
+    def test_workers_zero_serves_locally(self):
+        metrics = Metrics()
+        mg = MeshGroup(workers=0, metrics=metrics).start()
+        try:
+            assert not mg.alive()  # no distributed mesh, by design
+            r0 = mg.solve_seeded(SHAPE, seed=4, tick=0)
+            assert r0["mode"] == "full" and not r0["distributed"]
+            o = mg.solve_oracle(SHAPE, seed=4, tick=0)
+            assert r0["fingerprint"] == o["fingerprint"]
+            r1 = mg.solve_seeded(SHAPE, seed=4, tick=1,
+                                 dirty=list(DIRTY_FIELDS))
+            assert r1["mode"] == "patch"
+            assert metrics.counter(
+                "karpenter_solver_distmesh_dispatch_total",
+                labels={"mode": "local"}) == 2
+            assert metrics.gauge(
+                "karpenter_solver_distmesh_processes") == 1
+        finally:
+            mg.stop()
+
+    def test_degrade_taxonomy_exactly_one_full(self):
+        """After a degrade the FIRST dispatch ignores the caller's
+        dirty list (residency died with the workers) and every later
+        one honors it — exactly one full Solve."""
+        metrics = Metrics()
+        mg = MeshGroup(workers=0, metrics=metrics).start()
+        try:
+            mg.solve_seeded(SHAPE, seed=4, tick=0)
+            mg.degrade(reason="worker_lost")
+            r = mg.solve_seeded(SHAPE, seed=4, tick=1,
+                                dirty=list(DIRTY_FIELDS))
+            assert r["mode"] == "full"
+            r2 = mg.solve_seeded(SHAPE, seed=4, tick=2,
+                                 dirty=list(DIRTY_FIELDS))
+            assert r2["mode"] == "patch"
+            for tick, rr in ((1, r), (2, r2)):
+                o = mg.solve_oracle(SHAPE, seed=4, tick=tick)
+                assert rr["fingerprint"] == o["fingerprint"]
+            assert metrics.counter(
+                "karpenter_solver_distmesh_degraded_total",
+                labels={"reason": "worker_lost"}) == 1
+            # degrading twice must not double-count or re-arm
+            mg.degrade(reason="worker_lost")
+            assert metrics.counter(
+                "karpenter_solver_distmesh_degraded_total",
+                labels={"reason": "worker_lost"}) == 1
+            assert mg.solve_batch(np.zeros((1, 4), np.uint32),
+                                  {}) is None
+        finally:
+            mg.stop()
+
+    def test_spawn_failure_degrades_not_raises(self):
+        metrics = Metrics()
+        mg = MeshGroup(workers=1, metrics=metrics,
+                       python="/nonexistent/python").start()
+        try:
+            assert not mg.alive()
+            assert metrics.counter(
+                "karpenter_solver_distmesh_degraded_total",
+                labels={"reason": "spawn_failed"}) == 1
+            # a solver that cannot form its group still serves
+            r = mg.solve_seeded(SHAPE, seed=4, tick=0)
+            o = mg.solve_oracle(SHAPE, seed=4, tick=0)
+            assert r["fingerprint"] == o["fingerprint"]
+        finally:
+            mg.stop()
+
+
+def test_membership_advertises_mesh_group_capability():
+    from karpenter_provider_aws_tpu.fleet.membership import _CAP_FLAGS
+    assert "mesh_group" in _CAP_FLAGS
+
+
+@pytest.mark.slow
+class TestTwoProcessMesh:
+    """REAL cross-process solving: worker subprocesses joined by
+    jax.distributed over gloo, exercised through the coordinator."""
+
+    @pytest.fixture()
+    def group(self):
+        mg = MeshGroup(workers=1, local_devices=4,
+                       metrics=Metrics()).start()
+        if not mg.alive():
+            pytest.skip("2-process mesh failed to form on this host")
+        yield mg
+        mg.stop()
+
+    def test_distributed_solve_matches_oracle(self, group):
+        info = group.mesh_info
+        assert info["ndev"] == 8 and info["dp"] % 2 == 0
+        r0 = group.solve_seeded(SHAPE, seed=7, tick=0)
+        assert r0["distributed"] and r0["mode"] == "full"
+        o0 = group.solve_oracle(SHAPE, seed=7, tick=0)
+        assert r0["fingerprint"] == o0["fingerprint"]
+        r1 = group.solve_seeded(SHAPE, seed=7, tick=1,
+                                dirty=list(DIRTY_FIELDS))
+        assert r1["mode"] == "patch"
+        o1 = group.solve_oracle(SHAPE, seed=7, tick=1)
+        assert r1["fingerprint"] == o1["fingerprint"]
+        assert set(r1["timing"]) == {"commit_s", "solve_s", "gather_s"}
+
+    def test_worker_kill_degrades_with_one_full_solve(self, group):
+        group.solve_seeded(SHAPE, seed=7, tick=0)
+        group._procs[-1].kill()
+        group._procs[-1].wait(timeout=10)
+        r = group.solve_seeded(SHAPE, seed=7, tick=1,
+                               dirty=list(DIRTY_FIELDS))
+        assert not r["distributed"] and r["mode"] == "full"
+        assert not group.alive()
+        r2 = group.solve_seeded(SHAPE, seed=7, tick=2,
+                                dirty=list(DIRTY_FIELDS))
+        assert r2["mode"] == "patch"
+        o2 = group.solve_oracle(SHAPE, seed=7, tick=2)
+        assert r2["fingerprint"] == o2["fingerprint"]
+        assert group.metrics.counter(
+            "karpenter_solver_distmesh_degraded_total",
+            labels={"reason": "worker_lost"}) == 1

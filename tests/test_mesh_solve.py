@@ -308,6 +308,92 @@ class TestDispatchKernelChoice:
             assert (np.asarray(small[k]) == np.asarray(forced[k])).all(), k
 
 
+class TestSharedHelpers:
+    """The device-pick and batch-shard plumbing shared across entry
+    points (satellites of the distributed-mesh PR): one `_pick_devices`
+    for mesh.py and __graft_entry__, one `_shard_stacks` pad+commit
+    loop behind shard_batch/shard_lanes, and a batch mesh keyed on
+    device IDENTITY, not device count."""
+
+    def test_pick_devices_shared_with_graft_entry(self):
+        import __graft_entry__ as ge
+
+        from karpenter_provider_aws_tpu.parallel.mesh import \
+            _pick_devices
+        assert ge._pick_devices(4) == _pick_devices(4, force_host=True)
+        assert len(_pick_devices(3)) == 3
+        assert len(_pick_devices()) == 8  # conftest's virtual mesh
+
+    def test_shard_stacks_parity_batch_vs_lanes(self):
+        from karpenter_provider_aws_tpu.parallel import (shard_batch,
+                                                         shard_lanes)
+        stack = np.arange(5 * 7, dtype=np.uint32).reshape(5, 7)
+        other = np.arange(5 * 2, dtype=np.int64).reshape(5, 2)
+        d1, B1 = shard_batch(stack, 8, {})
+        d2, B2 = shard_lanes({"stack": stack, "other": other}, 8, {})
+        assert B1 == B2 == 5
+        assert np.array_equal(np.asarray(d1), np.asarray(d2["stack"]))
+        # both ride the one pad loop: repeat-last-row up to the device
+        # multiple, on EVERY stack of the dict
+        assert d1.shape[0] == 8
+        assert np.array_equal(np.asarray(d1)[5:],
+                              np.repeat(stack[-1:], 3, axis=0))
+        assert np.array_equal(np.asarray(d2["other"])[5:],
+                              np.repeat(other[-1:], 3, axis=0))
+
+    def test_batch_mesh_rekeys_on_device_ids(self):
+        """THE regression: the cache used to key on device COUNT only,
+        so a changed device set at the same count (backend re-init, a
+        distmesh degrade swapping which local devices back the solver)
+        silently reused a mesh over stale devices."""
+        from karpenter_provider_aws_tpu.parallel.mesh import _batch_mesh
+        cache: dict = {}
+        m1 = _batch_mesh(4, cache)
+        live_ids = cache["batch_mesh_ids"]
+        # JAX interns Mesh objects (same devices+axes -> same object), so
+        # rebuild-vs-cached is observed via sentinels planted in the
+        # cache, not Mesh identity.
+        sentinel = object()
+        cache["batch_mesh"] = sentinel
+        assert _batch_mesh(4, cache) is sentinel  # same ids -> cached
+        cache["batch_mesh_ids"] = ("stale",) * 4  # same COUNT, other ids
+        m2 = _batch_mesh(4, cache)
+        assert m2 is not sentinel  # count-only key would return stale mesh
+        assert m2 == m1
+        assert cache["batch_mesh_ids"] == live_ids == \
+            tuple(d.id for d in m2.devices.flat)
+
+    @pytest.mark.parametrize("env,want", [
+        (None, 2048), ("", 2048),      # unset/empty -> default floor
+        ("abc", 2048),                 # unparsable -> default, no crash
+        ("300", 300),
+        ("0", 0),                      # 0 forces dp2 on
+        ("-5", 0),                     # negatives clamp to force-on
+    ])
+    def test_dp2_min_slots_parsing(self, monkeypatch, env, want):
+        from karpenter_provider_aws_tpu.parallel.mesh import \
+            _dp2_min_slots
+        if env is None:
+            monkeypatch.delenv("KARP_MESH_DP2_MIN_SLOTS", raising=False)
+        else:
+            monkeypatch.setenv("KARP_MESH_DP2_MIN_SLOTS", env)
+        assert _dp2_min_slots() == want
+
+    def test_negative_floor_forces_dp2(self, monkeypatch):
+        """A negative floor must behave exactly like 0 at the dispatch
+        site: every real slot count clears it, so dp2 engages."""
+        from karpenter_provider_aws_tpu.parallel.mesh import \
+            dispatch_mesh
+        inp = _rand_inputs(5, T=21, D=4, Z=2, C=2, G=6, E=2, P=2)
+        arrays = {k: np.asarray(v) for k, v in inp._asdict().items()
+                  if v is not None}
+        monkeypatch.setenv("KARP_MESH_DP2_MIN_SLOTS", "-1")
+        cache: dict = {}
+        dispatch_mesh(arrays, n_max=24, E=2, P=2, V=0, ndev=8,
+                      cache=cache)
+        assert cache["last_placement"]["kernel"] == "dp2"
+
+
 class TestProductionWiring:
     """VERDICT r2 weak item: the mesh must be reachable from the PUBLIC
     solver API, not only from tests — TPUSolver routes its device engine

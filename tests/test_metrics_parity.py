@@ -557,6 +557,23 @@ def emitted(tmp_path_factory):
     cev_np.metrics = op.metrics
     assert cev_np.subset_solve(cbase, [cq]) is None
 
+    # distributed mesh-group families: the coordinator emits the
+    # dispatch + degrade taxonomy in local mode (workers=0 — no
+    # subprocesses in the parity run); the worker-side patch counter
+    # comes from driving dispatch_dist itself on a single-process 2-D
+    # mesh over the conftest's virtual devices
+    from karpenter_provider_aws_tpu.fleet.meshgroup import MeshGroup
+    from karpenter_provider_aws_tpu.parallel import distmesh
+    _mshape = dict(G=4, T=7, n_max=32, E=8, P=1, Z=2, C=2, D=4,
+                   pods_per_group=5)
+    _mg = MeshGroup(workers=0, metrics=op.metrics).start()
+    _mg.solve_seeded(_mshape, seed=3, tick=0)  # dispatch_total{local}
+    _mg.degrade(reason="worker_lost")          # degraded_total + gauge
+    _marrays, _mstatics = distmesh.tick_arrays(_mshape, 3, 0)
+    distmesh.dispatch_dist(_marrays, mesh=distmesh.dist_mesh2(),
+                           cache={}, metrics=op.metrics,
+                           **_mstatics)        # patch_total{full}
+
     # AOT-store dispatch family: the conftest's 8 virtual devices route
     # in-process solves through the mesh path, which carries no AOT
     # hook (the store is a single-device cold-start feature), so —

@@ -76,6 +76,9 @@ class TestSidecar:
         info = client.info()
         assert info["devices"] >= 1
         assert info["x64"] == 1
+        # the mesh_group capability is advertised (0 here: no worker
+        # processes configured) so fleet membership can read it
+        assert info.get("mesh_group") == 0
 
     def test_remote_decisions_identical(self, server, env):
         pods = (make_pods(120, cpu="500m", memory="1Gi", prefix="rs")
