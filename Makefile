@@ -46,6 +46,9 @@ chaos-patch:  ## 10-seed delta-wire chaos sweep (SolvePatch degradations)
 chaos-fleet:  ## seeded fleet chaos sweep (kill/flap/roll replicas)
 	sh hack/chaosfleet.sh
 
+chaos-heal:  ## seeded self-heal storm (kill/wedge workers, supervised regroup)
+	sh hack/chaosheal.sh
+
 fuzz-delta:  ## 10-seed mutation-sequence fuzz of the incremental encoder
 	sh hack/fuzzdelta.sh
 
@@ -81,4 +84,4 @@ multihost:  ## multi-PROCESS distributed mesh: 1M-pod ceiling + chaos + suite
 daemon:  ## run the operator against the in-memory cloud
 	python -m karpenter_provider_aws_tpu --cluster-name dev --metrics-port 8080
 
-.PHONY: test test-all scale deflake benchmark consolidate-evidence multichip multihost daemon chart chaos chaoscloud chaos-tenant chaos-patch chaos-fleet fuzz-delta fuzz-consolidate native native-try aot-prime
+.PHONY: test test-all scale deflake benchmark consolidate-evidence multichip multihost daemon chart chaos chaoscloud chaos-tenant chaos-patch chaos-fleet chaos-heal fuzz-delta fuzz-consolidate native native-try aot-prime

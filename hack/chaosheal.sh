@@ -1,0 +1,27 @@
+#!/bin/sh
+# Seeded self-healing storm for the DISTRIBUTED MESH GROUP (fleet/meshgroup.py).
+#
+# Runs the self-heal storm tests (tests/test_selfheal.py, the
+# `slow`-marked seed matrix) across the fixed seeds. Each seed drives a
+# live coordinator+worker mesh group through repeated residency breaks —
+# killing a worker process mid-stream, wedging one with an injected
+# in-collective sleep so the reply-deadline watchdog fires — and then
+# waits for the supervised regroup: reap, respawn, epoch-fenced mesh
+# re-formation, canary gate, one full-Solve re-prime. The test fails if
+# ANY tick's decisions diverge from the CPU oracle (degraded ticks
+# included — the local path must be bit-identical), if a regroup does
+# not land within the bounded tick budget, or if the full-Solve
+# accounting breaks: fulls == residency breaks + the startup prime,
+# with karpenter_solver_distmesh_recovered_total{reason} matching the
+# original degrade reason for every recovery.
+#
+# Tier-1 stays fast: these tests are excluded there by `-m 'not slow'`.
+#
+# Usage: sh hack/chaosheal.sh           # the full seed sweep
+#        sh hack/chaosheal.sh -x -q    # extra pytest args pass through
+set -e
+cd "$(dirname "$0")/.."
+
+JAX_PLATFORMS=cpu exec python -m pytest \
+    "tests/test_selfheal.py::test_selfheal_storm" \
+    -m slow -q -p no:cacheprovider "$@"

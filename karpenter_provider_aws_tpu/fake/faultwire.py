@@ -51,6 +51,8 @@ import threading
 import time
 from typing import Callable, List, Optional, Tuple
 
+import numpy as np
+
 #: fault kinds an injector can draw (order matters: it is the cumulative
 #: probability order used by FaultPlan.next — "stale" is appended LAST
 #: with a 0.0 default so existing seeds' draw schedules are unchanged)
@@ -269,6 +271,36 @@ def downgrade_server(server, drop=("patch",)):
     def restore():
         for attr, real in saved.items():
             setattr(handler, attr, real)
+
+    return restore
+
+
+def corrupt_server(server):
+    """Make a live in-process :class:`SolverServer` return WELL-FORMED
+    but WRONG decisions: Solve replies still parse cleanly (same arena
+    framing, same shapes/dtypes, checksum recomputed over the lie) but
+    the decision rows are perturbed. This is the failure class only a
+    canary fingerprint catches — transport is healthy, Info answers
+    truthfully, breakers never trip — and what the fleet quarantine
+    gate (fleet/membership.py probe) must catch. Returns a
+    zero-argument restore function."""
+    from ..native import arena_pack, arena_unpack
+    handler = server._handler
+    real = handler.solve
+
+    def lying(request, context):
+        d = arena_unpack(real(request, context))
+        out = np.array(d["out"])
+        if out.size:
+            flat = out.reshape(-1)
+            flat[0] = flat[0] + 1  # plausible, parseable, wrong
+        d["out"] = out
+        return arena_pack(d)
+
+    handler.solve = lying
+
+    def restore():
+        handler.solve = real
 
     return restore
 

@@ -11,22 +11,30 @@
 - :mod:`.meshgroup` — the coordinator role that forms worker
   processes into ONE logical distributed dp x tp solver (the vertical
   tier: one solve spanning processes, vs the horizontal tier's many
-  solves across replicas).
+  solves across replicas), with supervised self-healing regroup;
+- :mod:`.canary` — the tiny seeded solve that gates (re-)admission:
+  a replica or regrouped mesh serves traffic only after its canary
+  decisions byte-match the local oracle.
 
 See docs/fleet.md for topology, affinity/failover semantics, the
 shared compile-cache layout, and the re-prime cost model.
 """
 
+from .canary import CANARY_SEED, CANARY_SHAPE, MESH_CANARY_SHAPE, run_canary
 from .fleetclient import (AFFINITY, FAILOVER, REBALANCE, FleetSolver,
                           loopback_fleet)
-from .membership import (ENDPOINTS_ENV, FleetMembership, Replica,
-                         endpoints_from_env)
-from .meshgroup import MeshGroup
+from .membership import (ENDPOINTS_ENV, PROBE_TIMEOUT_ENV, FleetMembership,
+                         Replica, endpoints_from_env, probe_timeout_s)
+from .meshgroup import (HELLO_TIMEOUT_ENV, REPLY_TIMEOUT_ENV, MeshGroup,
+                        hello_timeout_s, reply_timeout_s)
 from .ring import owner, owner_order, shape_class
 
 __all__ = [
     "FleetSolver", "FleetMembership", "MeshGroup", "Replica",
     "loopback_fleet", "owner", "owner_order", "shape_class",
     "endpoints_from_env", "ENDPOINTS_ENV", "AFFINITY", "FAILOVER",
-    "REBALANCE",
+    "REBALANCE", "run_canary", "CANARY_SHAPE", "MESH_CANARY_SHAPE",
+    "CANARY_SEED", "PROBE_TIMEOUT_ENV", "probe_timeout_s",
+    "HELLO_TIMEOUT_ENV", "REPLY_TIMEOUT_ENV", "hello_timeout_s",
+    "reply_timeout_s",
 ]

@@ -1,0 +1,91 @@
+"""The seeded canary solve: correctness-gated (re-)admission.
+
+A replica that answers Info is not necessarily a replica that still
+SOLVES — a wedged accelerator runtime, a corrupted compile cache, or a
+half-rolled build can keep the control plane green while returning
+wrong-but-well-formed decisions. The fleet's admission gate closes
+that gap with one tiny deterministic solve, byte-compared against the
+local CPU oracle (decision identity across arms is the repo-wide wire
+invariant, so ANY divergence is disqualifying):
+
+- ``run_canary(client)`` drives the wire path (``solve_buffer``)
+  against a live :class:`~..sidecar.client.SolverClient`; used by
+  ``FleetMembership.probe`` before a replica re-enters rotation and by
+  ``FleetSolver`` before the binding moves onto a peer.
+- ``MESH_CANARY_SHAPE``/``CANARY_SEED`` parameterize the mesh-group
+  variant (``MeshGroup._canary_group``): the same workload solved
+  through a freshly regrouped ``jax.distributed`` mesh, fingerprinted
+  against the oracle before the group serves traffic.
+
+The workload is ``distmesh.tick_arrays`` — the deterministic seeded
+generator the chaos harnesses already trust — packed through the
+production ``pack_inputs1`` arena, so the canary exercises the real
+codec, bucketing, and kernel path, not a mock.
+
+Verdicts are three-valued: True (byte-identical — admit), False
+(well-formed but divergent — QUARANTINE, see docs/troubleshooting.md),
+None (transport/malformed failure — unhealthy, retry later; transport
+flakiness is not evidence of wrong decisions).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+#: tiny wire-canary workload: big enough to exercise slot/type/zone
+#: packing, small enough that its one-time compile is negligible
+CANARY_SHAPE = dict(G=2, T=5, n_max=8, E=4, P=1, Z=2, C=2, D=4,
+                    pods_per_group=3)
+#: the mesh-group variant pads its slot axis over dp ranks, so give it
+#: a slightly wider one than the wire canary
+MESH_CANARY_SHAPE = dict(G=2, T=5, n_max=16, E=4, P=1, Z=2, C=2, D=4,
+                         pods_per_group=3)
+CANARY_SEED = 1303
+
+_cache: dict = {}
+
+
+def canary_request() -> Tuple[np.ndarray, dict]:
+    """The packed canary arena + its statics, built once per process."""
+    if "req" not in _cache:
+        from ..ops.hostpack import pack_inputs1
+        from ..parallel.distmesh import tick_arrays
+        s = CANARY_SHAPE
+        arrays, _ = tick_arrays(s, CANARY_SEED, 0)
+        dims = {k: int(s[k]) for k in ("T", "D", "Z", "C", "G", "E",
+                                       "P")}
+        buf = np.asarray(pack_inputs1(
+            {k: np.asarray(v) for k, v in arrays.items()}, **dims))
+        _cache["req"] = (buf, dict(dims, n_max=int(s["n_max"]), K=0,
+                                   V=0, M=0, F=1))
+    return _cache["req"]
+
+
+def expected_rows() -> np.ndarray:
+    """The local oracle's answer to the canary, built once per
+    process — the byte baseline every admitted replica must match."""
+    if "want" not in _cache:
+        from ..ops.ffd_jax import solve_scan_packed1
+        buf, st = canary_request()
+        kv = {k: st[k] for k in ("T", "D", "Z", "C", "G", "E", "P",
+                                 "n_max")}
+        _cache["want"] = np.asarray(solve_scan_packed1(buf, **kv))
+    return _cache["want"]
+
+
+def run_canary(client) -> Optional[bool]:
+    """One canary solve over the wire. True = byte-identical to the
+    oracle; False = well-formed but divergent (quarantine the
+    replica); None = transport or malformed-reply failure (unhealthy,
+    not evidence of wrong decisions)."""
+    buf, st = canary_request()
+    want = expected_rows()
+    try:
+        got = np.asarray(client.solve_buffer(buf, dict(st)))
+    except Exception:
+        return None
+    if got.shape != want.shape or got.dtype != want.dtype:
+        return False
+    return bool(got.tobytes() == want.tobytes())

@@ -86,6 +86,10 @@ class FleetSolver(RemoteSolver):
         #: OFF the arbitrary constructor binding onto the ring owner is
         #: the affinity placement itself, not a rebalance
         self._ring_seen = False
+        #: False until the current binding passed a canary-gated probe
+        #: (fleet/membership.py): the constructor binds blind, so the
+        #: first owner resolution must admit even a non-moving binding
+        self._admitted = False
 
     # -- routing ---------------------------------------------------------
     def _count_routed(self, replica: str, reason: str) -> None:
@@ -128,11 +132,36 @@ class FleetSolver(RemoteSolver):
             self._count_routed(self._bound, self._bound_reason)
             return
         order = owner_order(addrs, self.tenant, shape_class(statics))
-        candidate = next((ep for ep in order if fleet.routable(ep)),
-                         None)
+        candidate = None
+        for ep in order:
+            if not fleet.routable(ep):
+                continue
+            if ep == self._bound and self._admitted:
+                candidate = ep
+                break
+            # canary-gated (re-)admission: before the binding lands on
+            # a peer it must answer Info AND return oracle-identical
+            # canary decisions (fleet/canary.py). A failed verdict
+            # records unhealthy/quarantined and the ring walks on; the
+            # admitted steady state pays nothing extra
+            if fleet.probe(ep):
+                if ep == self._bound:
+                    self._admitted = True
+                candidate = ep
+                break
         if candidate is None:
             # the whole fleet is parked: stay put; breakers half-open on
-            # their own cooldown and the host twin serves meanwhile
+            # their own cooldown and the host twin serves meanwhile. A
+            # QUARANTINED binding is stricter than parked: its wire
+            # replies still parse — staying put would SERVE the wrong
+            # decisions — so the liveness cache goes dark and the
+            # bit-identical host twin takes every solve until a canary
+            # re-admits someone
+            rep = fleet._replicas.get(self._bound)
+            if rep is not None and rep.quarantined \
+                    and self._router.alive is not None:
+                self._router.alive.mark_failed()
+                self._admitted = False
             self._count_routed(self._bound, self._bound_reason)
             return
         if candidate == self._bound and self._bound in addrs:
@@ -160,6 +189,7 @@ class FleetSolver(RemoteSolver):
             reason = REBALANCE
         self._ring_seen = True
         self._rebind(candidate, reason)
+        self._admitted = True
         self._count_routed(candidate, reason)
 
     # -- dispatch choke points -------------------------------------------

@@ -18,15 +18,29 @@ ROUTABLE unless there is positive evidence against it — its breaker is
 open, or its last Info ping failed. Unknown (never pinged) counts
 routable: the bind-time ping resolves it, and a dead pick degrades that
 one solve to the bit-identical host twin exactly like today's single
-endpoint, never a crash.
+endpoint, never a crash. Two refinements harden re-admission:
+
+- a failed probe verdict AGES OUT after ``_UNHEALTHY_RECHECK_S`` — a
+  transient blip must not remove a replica forever; the next owner
+  resolution re-probes it for a fresh (canary-gated) verdict;
+- ``probe`` is canary-gated (fleet/canary.py): after Info answers, a
+  tiny seeded solve is byte-compared against the local oracle. A
+  replica returning wrong-but-well-formed decisions is QUARANTINED —
+  never routable, no aging out — until a later probe passes the canary
+  or the control plane re-renders membership (remove/add). Quarantines
+  count ``karpenter_solver_fleet_quarantined_total{replica}``; runbook
+  entry in docs/troubleshooting.md.
 """
 
 from __future__ import annotations
 
+import logging
 import os
 import time
 from collections import OrderedDict
 from typing import Callable, Dict, List, Optional
+
+log = logging.getLogger(__name__)
 
 from ..sidecar.client import SolverClient
 from ..sidecar.resilience import OPEN, ResiliencePolicy
@@ -34,6 +48,27 @@ from ..sidecar.resilience import OPEN, ResiliencePolicy
 #: comma-separated replica endpoints, e.g.
 #: "solver-0.solver:50151,solver-1.solver:50151"
 ENDPOINTS_ENV = "SOLVER_FLEET_ENDPOINTS"
+
+#: probe Info deadline override (seconds); parse-validated like
+#: KARP_MESH_DP2_MIN_SLOTS — unset/garbage/non-positive -> default
+PROBE_TIMEOUT_ENV = "KARP_FLEET_PROBE_TIMEOUT_S"
+_PROBE_TIMEOUT_S = 5.0
+
+#: how long a failed probe verdict disqualifies a replica before the
+#: next owner resolution may re-probe it
+_UNHEALTHY_RECHECK_S = 30.0
+
+
+def probe_timeout_s() -> float:
+    env = os.environ.get(PROBE_TIMEOUT_ENV)
+    if env:
+        try:
+            v = float(env)
+            if v > 0:
+                return v
+        except ValueError:
+            pass
+    return _PROBE_TIMEOUT_S
 
 #: Info flags worth caching per replica (the fleet router consults
 #: ``patch`` before expecting a delta stream to survive a failover;
@@ -52,6 +87,11 @@ class Replica:
         self.client = client
         #: None = never probed (routable), True/False = last verdict
         self.healthy: Optional[bool] = None
+        #: True once a probe's canary came back well-formed but
+        #: oracle-divergent: the replica answers the control plane but
+        #: solves WRONG — never routable, and unlike plain
+        #: unhealthiness this never ages out on its own
+        self.quarantined: bool = False
         self.caps: Dict[str, bool] = {}
         self.last_ping_s: float = 0.0
 
@@ -151,22 +191,56 @@ class FleetMembership:
         rep = self._replicas.get(address)
         if rep is None:
             return False
-        return not rep.parked and rep.healthy is not False
+        if rep.quarantined or rep.parked:
+            return False
+        if rep.healthy is False:
+            # failed verdicts age out: a probe blip must not remove a
+            # replica forever — past the recheck window the next owner
+            # resolution re-probes it (canary-gated) for a fresh call
+            return (time.monotonic() - rep.last_ping_s
+                    >= _UNHEALTHY_RECHECK_S)
+        return True
 
     def alive(self) -> List[str]:
         return [a for a in self._replicas if self.routable(a)]
 
-    def probe(self, address: str, timeout: float = 5.0) -> bool:
-        """One Info round trip against a replica: records health AND
-        the capability flags. Any failure is a False verdict, never an
-        exception (same contract as RemoteSolver._ping)."""
+    def probe(self, address: str, timeout: Optional[float] = None,
+              canary: bool = True) -> bool:
+        """One Info round trip + (by default) the seeded canary solve
+        against a replica: records health, the capability flags, and
+        the correctness verdict. Any failure is a False verdict, never
+        an exception (same contract as RemoteSolver._ping). A
+        well-formed but oracle-divergent canary reply quarantines the
+        replica (module docstring); a passing one clears an existing
+        quarantine — re-admission is earned, not timed out."""
         rep = self._replicas[address]
+        if timeout is None:
+            timeout = probe_timeout_s()
         try:
             info = rep.client.info(timeout=timeout)
             devices = info.get("devices")
             ok = isinstance(devices, int) and devices >= 1
         except Exception:
             info, ok = {}, False
+        if ok and canary:
+            from .canary import run_canary
+            verdict = run_canary(rep.client)
+            if verdict is False:
+                if not rep.quarantined:
+                    log.error("replica %s QUARANTINED: canary solve "
+                              "returned well-formed but oracle-"
+                              "divergent decisions (see "
+                              "docs/troubleshooting.md)", address)
+                    if self.metrics is not None:
+                        self.metrics.inc(
+                            "karpenter_solver_fleet_quarantined_total",
+                            labels={"replica": address})
+                rep.quarantined = True
+                ok = False
+            elif verdict is None:
+                ok = False
+            else:
+                rep.quarantined = False
         rep.healthy = ok
         rep.last_ping_s = time.monotonic()
         if ok:

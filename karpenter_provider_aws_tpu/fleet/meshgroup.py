@@ -23,11 +23,27 @@ resume — lost residency is re-established once, then deltas flow
 again. Decisions are identical in every mode by construction; the
 fingerprint checks in hack/multihost.py prove it end to end.
 
+Degradation is no longer terminal: a supervisor rides the dispatch
+path (``_check``) and, after a degrade, reaps the dead group and
+re-forms it with bounded exponential backoff — capped attempts, then
+stay-degraded. A re-formed group serves traffic only after a seeded
+canary solve fingerprints identical to the local CPU oracle
+(canary-gated re-admission), and every (re)formation bumps a mesh
+``epoch`` carried in every control frame and echoed in every worker
+reply, so a zombie worker's late bytes from a prior epoch are
+rejected, never merged. Workers are fresh processes, so the first
+distributed solve after a regroup is naturally a full placement — the
+one full Solve the residency break costs, same taxonomy as the
+degrade itself. See docs/fleet.md "Recovery taxonomy".
+
 Metrics (docs/metrics.md "Distributed mesh"):
 ``karpenter_solver_distmesh_processes`` gauge,
 ``karpenter_solver_distmesh_dispatch_total{mode}``,
 ``karpenter_solver_distmesh_patch_total{mode}`` (worker-side),
-``karpenter_solver_distmesh_degraded_total{reason}``.
+``karpenter_solver_distmesh_degraded_total{reason}``,
+``karpenter_solver_distmesh_recovered_total{reason}``,
+``karpenter_solver_distmesh_regroup_ms``,
+``karpenter_solver_distmesh_stale_rejected_total``.
 """
 
 from __future__ import annotations
@@ -37,6 +53,8 @@ import os
 import socket
 import subprocess
 import sys
+import threading
+import time
 from typing import Dict, Optional
 
 import numpy as np
@@ -46,8 +64,56 @@ log = logging.getLogger(__name__)
 #: worker spawn/handshake deadline (cold python + jax import)
 _HELLO_TIMEOUT_S = 120.0
 #: per-command reply deadline: covers first-solve compile of the 2-D
-#: kernel at ceiling shapes on virtual CPU devices
+#: kernel at ceiling shapes on virtual CPU devices. Doubles as the
+#: wedge watchdog: a worker whose socket stays open but whose solve
+#: never returns trips this per-reply deadline instead of stalling
+#: every subsequent tick.
 _REPLY_TIMEOUT_S = 900.0
+
+HELLO_TIMEOUT_ENV = "KARP_DISTMESH_HELLO_TIMEOUT_S"
+REPLY_TIMEOUT_ENV = "KARP_DISTMESH_REPLY_TIMEOUT_S"
+REGROUP_ATTEMPTS_ENV = "KARP_DISTMESH_REGROUP_ATTEMPTS"
+REGROUP_BACKOFF_ENV = "KARP_DISTMESH_REGROUP_BACKOFF_S"
+
+#: supervised regroup defaults: first attempt after the base backoff,
+#: doubling per failure up to the cap, then stay-degraded for good
+_REGROUP_ATTEMPTS = 3
+_REGROUP_BACKOFF_S = 30.0
+_REGROUP_BACKOFF_CAP_S = 300.0
+
+#: bounded formation retries when the jax coordinator port raced
+#: (_free_port TOCTOU: the port is bound, closed, and rebound later
+#: inside worker 0 — a collision surfaces as a bind error in the
+#: worker's mesh reply, not here)
+_FORMATION_TRIES = 3
+_PORT_RETRY_MARKERS = ("address already in use", "errno 98",
+                       "eaddrinuse", "failed to bind")
+
+#: how many frames to discard per worker while hunting the
+#: current-epoch reply before declaring the socket poisoned
+_STALE_REREADS = 4
+
+
+def _env_float(name: str, default: float) -> float:
+    """KARP_MESH_DP2_MIN_SLOTS-style parse validation: unset, garbage,
+    or non-positive values fall back to the default, never a crash."""
+    env = os.environ.get(name)
+    if env:
+        try:
+            v = float(env)
+            if v > 0:
+                return v
+        except ValueError:
+            pass
+    return default
+
+
+def hello_timeout_s() -> float:
+    return _env_float(HELLO_TIMEOUT_ENV, _HELLO_TIMEOUT_S)
+
+
+def reply_timeout_s() -> float:
+    return _env_float(REPLY_TIMEOUT_ENV, _REPLY_TIMEOUT_S)
 
 
 def _free_port() -> int:
@@ -68,42 +134,95 @@ class MeshGroup:
     converges to."""
 
     def __init__(self, workers: int, local_devices: int = 8,
-                 metrics=None, python: Optional[str] = None):
+                 metrics=None, python: Optional[str] = None,
+                 hello_timeout_s: Optional[float] = None,
+                 reply_timeout_s: Optional[float] = None,
+                 regroup_attempts: Optional[int] = None,
+                 regroup_backoff_s: Optional[float] = None):
         self.workers = max(0, int(workers))
         self.local_devices = int(local_devices)
         self.metrics = metrics
         self._python = python or sys.executable
+        self.hello_timeout_s = float(hello_timeout_s) \
+            if hello_timeout_s is not None else _env_float(
+                HELLO_TIMEOUT_ENV, _HELLO_TIMEOUT_S)
+        self.reply_timeout_s = float(reply_timeout_s) \
+            if reply_timeout_s is not None else _env_float(
+                REPLY_TIMEOUT_ENV, _REPLY_TIMEOUT_S)
+        self.regroup_attempts = int(regroup_attempts) \
+            if regroup_attempts is not None else int(_env_float(
+                REGROUP_ATTEMPTS_ENV, _REGROUP_ATTEMPTS))
+        self.regroup_backoff_s = float(regroup_backoff_s) \
+            if regroup_backoff_s is not None else _env_float(
+                REGROUP_BACKOFF_ENV, _REGROUP_BACKOFF_S)
         self._procs: list = []
         self._socks: Dict[int, socket.socket] = {}
         self._degraded = False
         self._degrade_pending_full = False
         self._local_cache: dict = {}
         self.mesh_info: Optional[dict] = None
+        #: mesh epoch: bumped at every (re)formation attempt, carried
+        #: in every control frame, echoed in every worker reply — the
+        #: fence that keeps a prior group's zombie bytes out
+        self.epoch = 0
+        self._degrade_reason: Optional[str] = None
+        self._degraded_at: Optional[float] = None
+        #: monotonic deadline of the next supervised regroup attempt;
+        #: None = no regroup pending (healthy, stopped, or given up)
+        self._regroup_at: Optional[float] = None
+        self._regroup_attempt = 0
+        self._regroup_lock = threading.Lock()
+        self._closed = False
 
     # -- lifecycle ---------------------------------------------------------
 
     def start(self) -> "MeshGroup":
         """Spawn rank 0..workers, collect hellos, form the jax mesh.
         Any failure here degrades instead of raising: a solver that
-        cannot form its group still serves from the local mesh."""
+        cannot form its group still serves from the local mesh (and
+        the supervisor keeps retrying formation with backoff)."""
         if self.workers <= 0:
             self._gauge_processes(1)
             return self
         try:
-            self._start_distributed()
+            self._form()
         except Exception:
             log.exception("mesh group formation failed; degrading to "
                           "the single-process mesh")
             self.degrade(reason="spawn_failed")
         return self
 
+    def _form(self) -> None:
+        """One group formation with bounded retry on coordinator-port
+        bind collisions (the _free_port TOCTOU): the jax port is
+        picked here but bound later inside worker 0, so a raced port
+        surfaces as a bind failure in the mesh reply — retried with a
+        fresh port instead of landing in spawn_failed forever."""
+        last: Optional[Exception] = None
+        for attempt in range(_FORMATION_TRIES):
+            try:
+                self._start_distributed()
+                return
+            except Exception as e:
+                self._teardown_attempt()
+                last = e
+                text = repr(e).lower()
+                if not any(m in text for m in _PORT_RETRY_MARKERS):
+                    raise
+                log.warning("mesh formation attempt %d raced the "
+                            "coordinator port (%s); retrying with a "
+                            "fresh one", attempt + 1, e)
+        assert last is not None
+        raise last
+
     def _start_distributed(self) -> None:
+        self.epoch += 1
         nproc = self.workers + 1
         jax_port = _free_port()
         listener = socket.socket()
         listener.bind(("127.0.0.1", 0))
         listener.listen(nproc)
-        listener.settimeout(_HELLO_TIMEOUT_S)
+        listener.settimeout(self.hello_timeout_s)
         control = f"127.0.0.1:{listener.getsockname()[1]}"
         repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
             os.path.abspath(__file__))))
@@ -126,7 +245,7 @@ class MeshGroup:
         try:
             for _ in range(nproc):
                 conn, _addr = listener.accept()
-                conn.settimeout(_REPLY_TIMEOUT_S)
+                conn.settimeout(self.reply_timeout_s)
                 msg, _ = self._distmesh()._recv_msg(conn)
                 self._socks[int(msg["hello"])] = conn
         finally:
@@ -134,14 +253,17 @@ class MeshGroup:
         infos = self._broadcast(lambda pid: ({
             "cmd": "mesh", "coordinator": f"127.0.0.1:{jax_port}",
             "num_processes": nproc, "process_id": pid,
-            "local_devices": self.local_devices}, None))
+            "local_devices": self.local_devices}, None),
+            degrade_on_error=False)
         self.mesh_info = infos[0][0]
         self._gauge_processes(nproc)
-        log.info("mesh group up: %d processes, %d devices, dp=%d tp=%d",
-                 nproc, self.mesh_info["ndev"], self.mesh_info["dp"],
-                 self.mesh_info["tp"])
+        log.info("mesh group up: %d processes, %d devices, dp=%d "
+                 "tp=%d, epoch=%d", nproc, self.mesh_info["ndev"],
+                 self.mesh_info["dp"], self.mesh_info["tp"], self.epoch)
 
     def stop(self) -> None:
+        self._closed = True
+        self._regroup_at = None
         for pid, sock in list(self._socks.items()):
             try:
                 self._distmesh()._send_msg(sock, {"cmd": "halt"})
@@ -149,12 +271,31 @@ class MeshGroup:
             except Exception:
                 pass
         self._socks.clear()
-        for p in self._procs:
-            try:
-                p.wait(timeout=10)
-            except Exception:
-                p.kill()
+        # one shared deadline for the whole set: an N-worker shutdown
+        # is bounded by ONE grace window, not N serial waits
+        self._reap(self._procs, timeout=10.0)
         self._procs = []
+
+    @staticmethod
+    def _reap(procs, timeout: float = 10.0) -> None:
+        """Wait for every process under ONE shared deadline, then
+        escalate the stragglers to kill() and collect them — no
+        zombies, no unbounded shutdown."""
+        deadline = time.monotonic() + timeout
+        pending = [p for p in procs if p.poll() is None]
+        while pending and time.monotonic() < deadline:
+            time.sleep(0.02)
+            pending = [p for p in pending if p.poll() is None]
+        for p in pending:
+            try:
+                p.kill()
+            except Exception:
+                pass
+        for p in pending:
+            try:
+                p.wait(timeout=5.0)
+            except Exception:
+                pass
 
     def alive(self) -> bool:
         """True while the distributed mesh is usable: every worker
@@ -164,13 +305,16 @@ class MeshGroup:
 
     def degrade(self, reason: str = "worker_lost") -> None:
         """Collapse to the single-process mesh (PR 10 taxonomy): kill
-        every worker — survivors would hang at their next collective
-        waiting on the dead peer — and arm the one-full-Solve flag so
-        the next dispatch re-establishes residency from scratch."""
+        AND reap every worker — survivors would hang at their next
+        collective waiting on the dead peer — arm the one-full-Solve
+        flag so the next dispatch re-establishes residency from
+        scratch, and schedule the supervised regroup."""
         if self._degraded:
             return
         self._degraded = True
         self._degrade_pending_full = True
+        self._degrade_reason = reason
+        self._degraded_at = time.monotonic()
         for p in self._procs:
             try:
                 p.kill()
@@ -182,13 +326,26 @@ class MeshGroup:
             except Exception:
                 pass
         self._socks.clear()
+        self._reap(self._procs, timeout=5.0)
+        self._procs = []
+        self.mesh_info = None
         self._gauge_processes(1)
         if self.metrics is not None:
             self.metrics.inc("karpenter_solver_distmesh_degraded_total",
                              labels={"reason": reason})
-        log.warning("mesh group degraded (%s): serving from the "
-                    "single-process mesh; next solve is a full "
-                    "placement", reason)
+        self._regroup_attempt = 0
+        if (self.workers > 0 and not self._closed
+                and self.regroup_attempts > 0):
+            self._regroup_at = time.monotonic() + self.regroup_backoff_s
+            log.warning("mesh group degraded (%s): serving from the "
+                        "single-process mesh; next solve is a full "
+                        "placement, regroup scheduled in %.1fs",
+                        reason, self.regroup_backoff_s)
+        else:
+            self._regroup_at = None
+            log.warning("mesh group degraded (%s): serving from the "
+                        "single-process mesh; next solve is a full "
+                        "placement", reason)
 
     # -- plumbing ----------------------------------------------------------
 
@@ -205,33 +362,173 @@ class MeshGroup:
     def _check(self) -> bool:
         """Poll worker liveness BEFORE dispatching: a dead peer must be
         caught here, where degrading is cheap, not inside a collective,
-        where it is a hang."""
-        if self._degraded or not self._socks:
+        where it is a hang. While degraded, this is also the supervisor
+        tick that attempts the scheduled regroup."""
+        if self._degraded:
+            if not self._maybe_regroup():
+                return False
+        if not self._socks:
             return False
         if any(p.poll() is not None for p in self._procs):
             self.degrade(reason="worker_lost")
             return False
         return True
 
-    def _broadcast(self, make_msg):
+    # -- supervised regroup ------------------------------------------------
+
+    def _teardown_attempt(self) -> None:
+        """Reap one failed formation/regroup attempt's processes and
+        sockets WITHOUT touching the degradation state — the caller
+        decides whether to retry, reschedule, or give up."""
+        for sock in self._socks.values():
+            try:
+                sock.close()
+            except Exception:
+                pass
+        self._socks.clear()
+        for p in self._procs:
+            try:
+                p.kill()
+            except Exception:
+                pass
+        self._reap(self._procs, timeout=5.0)
+        self._procs = []
+        self.mesh_info = None
+
+    def heal_async(self) -> None:
+        """Sidecar wiring: kick the supervised regroup WITHOUT
+        blocking the caller — Info and solve RPCs must not stall
+        behind a worker respawn. No-op unless a regroup is due; the
+        non-blocking lock in ``_maybe_regroup`` keeps concurrent kicks
+        from double-forming."""
+        if (self._regroup_at is None or self._closed
+                or time.monotonic() < self._regroup_at):
+            return
+        threading.Thread(target=self._maybe_regroup,
+                         name="meshgroup-regroup", daemon=True).start()
+
+    def _maybe_regroup(self) -> bool:
+        """One supervisor tick: if the scheduled regroup deadline has
+        passed, re-form the group and canary-gate it. Returns True
+        when the group recovered (the caller may dispatch distributed
+        again). Failed attempts back off exponentially; after
+        ``regroup_attempts`` failures the group stays degraded."""
+        if (self._regroup_at is None or self._closed
+                or self.workers <= 0
+                or time.monotonic() < self._regroup_at):
+            return False
+        if not self._regroup_lock.acquire(blocking=False):
+            return False
+        try:
+            return self._regroup_once()
+        finally:
+            self._regroup_lock.release()
+
+    def _regroup_once(self) -> bool:
+        self._regroup_attempt += 1
+        attempt = self._regroup_attempt
+        try:
+            self._form()
+            if not self._canary_group():
+                raise RuntimeError("regroup canary diverged from the "
+                                   "local oracle")
+        except Exception as e:
+            self._teardown_attempt()
+            self._gauge_processes(1)
+            if attempt >= self.regroup_attempts:
+                self._regroup_at = None
+                log.error("mesh regroup attempt %d/%d failed (%s); "
+                          "staying degraded", attempt,
+                          self.regroup_attempts, e)
+            else:
+                delay = min(self.regroup_backoff_s * (2 ** attempt),
+                            _REGROUP_BACKOFF_CAP_S)
+                self._regroup_at = time.monotonic() + delay
+                log.warning("mesh regroup attempt %d/%d failed (%s); "
+                            "next attempt in %.1fs", attempt,
+                            self.regroup_attempts, e, delay)
+            return False
+        reason = self._degrade_reason or "unknown"
+        outage_s = time.monotonic() - (self._degraded_at
+                                       or time.monotonic())
+        self._degraded = False
+        self._degrade_reason = None
+        self._degraded_at = None
+        self._regroup_at = None
+        self._regroup_attempt = 0
+        if self.metrics is not None:
+            self.metrics.inc(
+                "karpenter_solver_distmesh_recovered_total",
+                labels={"reason": reason})
+            self.metrics.observe(
+                "karpenter_solver_distmesh_regroup_ms", outage_s * 1e3)
+        log.info("mesh group recovered from %s after %.1fs (attempt "
+                 "%d, epoch %d): canary fingerprint matches the local "
+                 "oracle; distributed dispatch resumes", reason,
+                 outage_s, attempt, self.epoch)
+        return True
+
+    def _canary_group(self) -> bool:
+        """Canary-gated re-admission for the JUST-FORMED group: one
+        tiny seeded solve through every worker (a throwaway cache on
+        their side — production residency is untouched), fingerprint-
+        checked against the local CPU oracle. A group that answers the
+        control plane but solves wrong never serves traffic."""
+        from .canary import CANARY_SEED, MESH_CANARY_SHAPE
+        replies = self._broadcast(lambda pid: ({
+            "cmd": "canary", "shape": MESH_CANARY_SHAPE,
+            "seed": CANARY_SEED, "tick": 0}, None),
+            degrade_on_error=False)
+        fps = {r["fingerprint"] for r, _ in replies}
+        want = self.solve_oracle(MESH_CANARY_SHAPE, seed=CANARY_SEED,
+                                 tick=0)["fingerprint"]
+        return fps == {want}
+
+    def _broadcast(self, make_msg, degrade_on_error: bool = True):
         """Send make_msg(pid) to every worker, then collect every
         reply (send-all-then-recv-all: the SPMD solve only completes
-        once every process has entered it). Any transport error or
-        worker-reported failure degrades the group."""
+        once every process has entered it). Every outgoing frame
+        carries the mesh epoch and every reply must echo it — a
+        zombie's late bytes from a prior epoch are discarded, never
+        merged. A reply-deadline timeout is the wedge signature
+        (socket alive, solve never returns) and degrades as
+        ``worker_wedged``; any other transport error or
+        worker-reported failure degrades as ``worker_lost``."""
         dm = self._distmesh()
         try:
             for pid in sorted(self._socks):
                 msg, arrays = make_msg(pid)
+                msg.setdefault("epoch", self.epoch)
                 dm._send_msg(self._socks[pid], msg, arrays)
             replies = {}
             for pid in sorted(self._socks):
-                reply, arrays = dm._recv_msg(self._socks[pid])
+                for _ in range(_STALE_REREADS):
+                    reply, arrays = dm._recv_msg(self._socks[pid])
+                    ep = None if reply is None else reply.get("epoch")
+                    if ep is None or int(ep) == self.epoch:
+                        break
+                    log.warning("worker %d: rejected stale reply from "
+                                "mesh epoch %s (current %d)", pid, ep,
+                                self.epoch)
+                    if self.metrics is not None:
+                        self.metrics.inc(
+                            "karpenter_solver_distmesh_"
+                            "stale_rejected_total")
+                else:
+                    raise RuntimeError(
+                        f"worker {pid}: nothing but stale-epoch "
+                        f"replies after {_STALE_REREADS} frames")
                 if reply is None or not reply.get("ok"):
                     err = (reply or {}).get("error", "socket closed")
                     raise RuntimeError(f"worker {pid}: {err}")
                 replies[pid] = (reply, arrays)
+        except socket.timeout:
+            if degrade_on_error:
+                self.degrade(reason="worker_wedged")
+            raise
         except Exception:
-            self.degrade(reason="worker_lost")
+            if degrade_on_error:
+                self.degrade(reason="worker_lost")
             raise
         return [replies[pid] for pid in sorted(replies)]
 

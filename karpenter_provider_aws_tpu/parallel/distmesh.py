@@ -593,12 +593,20 @@ def run_worker(control: str, proc_id: int) -> None:
             if reply.get("_mesh_built"):
                 mesh = reply.pop("_mesh_built")
                 cache["mesh"] = mesh
+            # epoch fencing: every reply echoes the REQUEST's mesh
+            # epoch, so the coordinator can reject late bytes from a
+            # prior group formation (fleet/meshgroup.py _broadcast)
+            if "epoch" in msg:
+                reply.setdefault("epoch", msg["epoch"])
             _send_msg(sock, reply, rarrays)
         except Exception as e:  # report, don't die: coordinator decides
             log.exception("worker %d: command %r failed", proc_id,
                           msg.get("cmd"))
             try:
-                _send_msg(sock, {"ok": False, "error": repr(e)})
+                err = {"ok": False, "error": repr(e)}
+                if "epoch" in msg:
+                    err["epoch"] = msg["epoch"]
+                _send_msg(sock, err)
             except Exception:
                 code = 1
                 break
@@ -663,6 +671,30 @@ def _worker_cmd(msg: dict, arrays: Dict[str, np.ndarray], proc_id: int,
         out = oracle_out(inp, **statics)
         reply = {"ok": True, "fingerprint": result_fingerprint(out)}
         return reply, (out if msg.get("want_arrays") else None)
+
+    if cmd == "canary":
+        # canary-gated re-admission (fleet/meshgroup.py): solve the
+        # tiny seeded workload into a THROWAWAY cache — proving the
+        # freshly formed mesh still solves correctly must not disturb
+        # production residency or its patch contract
+        mesh = cache.get("mesh")
+        if mesh is None:
+            raise RuntimeError("mesh not initialized (send 'mesh' first)")
+        shape = msg["shape"]
+        Np, lo, hi = slab_rows(shape["n_max"], shape["E"], mesh)
+        inp, statics = tick_arrays(shape, int(msg["seed"]),
+                                   int(msg["tick"]), slab=(lo, hi, Np))
+        out = dispatch_dist(inp, mesh=mesh, cache={}, **statics)
+        return {"ok": True,
+                "fingerprint": result_fingerprint(out)}, None
+
+    if cmd == "sleep":
+        # chaos-harness wedge injection (tests/test_selfheal.py): hold
+        # the reply hostage for a bounded window so the coordinator's
+        # per-reply watchdog can be exercised without a real stuck
+        # collective
+        time.sleep(float(msg["s"]))
+        return {"ok": True}, None
 
     raise ValueError(f"unknown command {cmd!r}")
 

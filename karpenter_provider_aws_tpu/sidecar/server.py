@@ -685,6 +685,19 @@ class _Handler:
                 labels={"rpc": "SolveBatch", "mode": "frame"})
         return arena_pack({"out": o})
 
+    def _mesh_alive(self) -> bool:
+        """The mesh-group gate every serving path consults. Doubles as
+        the supervisor's wiring into the request plane: while the
+        group sits degraded, each consult kicks the scheduled regroup
+        (fleet/meshgroup.py heal_async — a no-op until the backoff
+        deadline, and never blocking this RPC)."""
+        if self._mesh_group is None:
+            return False
+        alive = self._mesh_group.alive()
+        if not alive:
+            self._mesh_group.heal_async()
+        return alive
+
     def _solve_batch_sharded(self, stack: np.ndarray, kv: dict, ndev: int,
                              rpc: str = "SolveBatch") -> np.ndarray:
         """Run a stacked [B, W] batch with the B axis dp-sharded across
@@ -694,7 +707,7 @@ class _Handler:
         from ..ops.ffd_jax import solve_scan_packed1_many
         from ..parallel.mesh import shard_batch
         B = stack.shape[0]
-        if self._mesh_group is not None and self._mesh_group.alive():
+        if self._mesh_alive():
             # distributed group: lanes fan out across processes, each
             # solved on that worker's local devices (linear scale-out,
             # zero collectives). None/raise keeps the local path — the
@@ -740,8 +753,7 @@ class _Handler:
         # the arena arrived whole over gRPC, so the coordinator slices).
         # dist is dp2-only: minValues floors (K>0) and flex lanes (V>0)
         # stay on the local 1-D type mesh
-        if (self._mesh_group is not None and self._mesh_group.alive()
-                and kv["K"] == 0 and kv["V"] == 0):
+        if self._mesh_alive() and kv["K"] == 0 and kv["V"] == 0:
             try:
                 with self._mesh_mu:
                     r = self._mesh_group.solve_frame(
@@ -1002,11 +1014,17 @@ class _Handler:
             "bucketed": np.array([1 if self._bucketing else 0],
                                  dtype=np.int64),
             # multi-process distributed mesh behind this server
-            # (fleet/meshgroup.py); drops to 0 on degrade, so fleet
-            # membership sees the capability change on its next probe
-            "mesh_group": np.array(
-                [1 if (self._mesh_group is not None
-                       and self._mesh_group.alive()) else 0],
+            # (fleet/meshgroup.py); drops to 0 on degrade and returns
+            # to 1 after a supervised regroup, so fleet membership sees
+            # the capability change on its next probe — the Info
+            # consult itself kicks a due regroup (_mesh_alive)
+            "mesh_group": np.array([1 if self._mesh_alive() else 0],
+                                   dtype=np.int64),
+            # the group's formation epoch (0 = no group): operators can
+            # watch it step to count regroups from Info alone
+            "mesh_epoch": np.array(
+                [self._mesh_group.epoch
+                 if self._mesh_group is not None else 0],
                 dtype=np.int64),
             "compile_cache_hits": np.array([cc["hits"]], dtype=np.int64),
             "compile_cache_misses": np.array([cc["misses"]],

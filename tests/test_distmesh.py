@@ -324,6 +324,48 @@ class TestMeshGroupLocalMode:
             mg.stop()
 
 
+class TestFingerprintSplit:
+    def test_split_degrades_once_and_local_serves(self):
+        """Processes disagreeing on a replicated output is a
+        correctness emergency: the collect path degrades
+        (fingerprint_split), raises, and — exactly like any other
+        degrade — the local twin serves with the one-full-Solve
+        taxonomy while the supervisor schedules a regroup."""
+        metrics = Metrics()
+        mg = MeshGroup(workers=1, metrics=metrics)
+        replies = [({"fingerprint": "aaaa", "mode": "full"}, None),
+                   ({"fingerprint": "bbbb", "mode": "full"}, None)]
+        with pytest.raises(RuntimeError, match="fingerprint mismatch"):
+            mg._collect(replies, "seeded", False)
+        assert mg._degraded
+        assert metrics.counter(
+            "karpenter_solver_distmesh_degraded_total",
+            labels={"reason": "fingerprint_split"}) == 1
+        assert mg._regroup_at is not None  # supervised regroup armed
+        r = mg.solve_seeded(SHAPE, seed=4, tick=0,
+                            dirty=list(DIRTY_FIELDS))
+        assert r["mode"] == "full" and not r["distributed"]
+        o = mg.solve_oracle(SHAPE, seed=4, tick=0)
+        assert r["fingerprint"] == o["fingerprint"]
+        # degrading again (e.g. the raise's caller falling back) must
+        # not double-count or re-arm a fresh backoff
+        mg.degrade(reason="fingerprint_split")
+        assert metrics.counter(
+            "karpenter_solver_distmesh_degraded_total",
+            labels={"reason": "fingerprint_split"}) == 1
+        mg.stop()
+
+    def test_agreeing_fingerprints_do_not_degrade(self):
+        metrics = Metrics()
+        mg = MeshGroup(workers=1, metrics=metrics)
+        replies = [({"fingerprint": "cccc", "mode": "patch"}, None),
+                   ({"fingerprint": "cccc", "mode": "patch"}, None)]
+        r = mg._collect(replies, "seeded", False)
+        assert r["fingerprint"] == "cccc" and r["distributed"]
+        assert not mg._degraded
+        mg.stop()
+
+
 def test_membership_advertises_mesh_group_capability():
     from karpenter_provider_aws_tpu.fleet.membership import _CAP_FLAGS
     assert "mesh_group" in _CAP_FLAGS

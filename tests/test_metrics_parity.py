@@ -574,6 +574,44 @@ def emitted(tmp_path_factory):
                            cache={}, metrics=op.metrics,
                            **_mstatics)        # patch_total{full}
 
+    # self-healing families (PR 17): recovered_total + regroup_ms from
+    # a stubbed supervised regroup (no subprocesses in the parity run),
+    # stale_rejected_total from a forged prior-epoch reply over a
+    # socketpair, and the fleet quarantine counter from a corrupt
+    # replica failing its canary probe
+    import socket as _socket
+    _hmg = MeshGroup(workers=1, metrics=op.metrics,
+                     regroup_backoff_s=0.0, regroup_attempts=1)
+    _hmg.degrade(reason="worker_lost")
+
+    def _parity_form(_m=_hmg):
+        _m.epoch += 1
+        _pa, _pb = _socket.socketpair()
+        _pa.settimeout(2.0)
+        _m._socks = {0: _pa}
+        _m._parity_peer = _pb
+    _hmg._form = _parity_form
+    _hmg._canary_group = lambda: True
+    assert _hmg._maybe_regroup()  # recovered_total + regroup_ms
+    distmesh._send_msg(_hmg._parity_peer,
+                       {"ok": True, "epoch": _hmg.epoch - 1})
+    distmesh._send_msg(_hmg._parity_peer,
+                       {"ok": True, "epoch": _hmg.epoch})
+    _hmg._broadcast(lambda pid: ({"cmd": "noop"}, None))  # stale_rejected
+    _hmg.stop()
+    _hmg._parity_peer.close()
+
+    from karpenter_provider_aws_tpu.fake.faultwire import corrupt_server
+    _qsrv = SolverServer(metrics=op.metrics).start()
+    try:
+        _qrestore = corrupt_server(_qsrv)
+        _qms = FleetMembership([_qsrv.address], metrics=op.metrics)
+        assert _qms.probe(_qsrv.address) is False  # quarantined_total
+        _qrestore()
+        _qms.close()
+    finally:
+        _qsrv.stop()
+
     # AOT-store dispatch family: the conftest's 8 virtual devices route
     # in-process solves through the mesh path, which carries no AOT
     # hook (the store is a single-device cold-start feature), so —

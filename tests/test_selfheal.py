@@ -1,0 +1,710 @@
+"""Self-healing distributed solver (PR 17): supervised mesh regroup,
+epoch-fenced membership, wedge watchdog, and canary-gated re-admission.
+
+Fast tier: the coordinator-side machinery driven through socketpairs
+and stubbed formation — epoch fencing in ``_broadcast``, the per-reply
+wedge watchdog, the supervised regroup's backoff/cap/stay-degraded
+ladder, the _free_port TOCTOU retry, the env-configurable timeouts,
+and the fleet quarantine gate (a corrupt replica answers the control
+plane but solves WRONG; only the canary fingerprint catches it).
+
+The ``slow`` tier spawns REAL worker subprocesses and drives a
+kill/hang/regroup storm end to end: every tick fingerprint-identical
+to the CPU oracle, recovery within a bounded budget, and exactly one
+full Solve per residency break (hack/chaosheal.sh sweeps the seeds).
+"""
+
+import socket
+import time
+
+import numpy as np
+import pytest
+
+from karpenter_provider_aws_tpu.fake.environment import Environment, make_pods
+from karpenter_provider_aws_tpu.fake.faultwire import corrupt_server
+from karpenter_provider_aws_tpu.fleet import (CANARY_SEED,
+                                              MESH_CANARY_SHAPE,
+                                              FleetMembership, FleetSolver,
+                                              run_canary)
+from karpenter_provider_aws_tpu.fleet import meshgroup as meshgroup_mod
+from karpenter_provider_aws_tpu.fleet import membership as membership_mod
+from karpenter_provider_aws_tpu.fleet.meshgroup import (
+    HELLO_TIMEOUT_ENV, REGROUP_ATTEMPTS_ENV, REGROUP_BACKOFF_ENV,
+    REPLY_TIMEOUT_ENV, MeshGroup, hello_timeout_s, reply_timeout_s)
+from karpenter_provider_aws_tpu.fleet.membership import (PROBE_TIMEOUT_ENV,
+                                                         probe_timeout_s)
+from karpenter_provider_aws_tpu.parallel import distmesh
+from karpenter_provider_aws_tpu.parallel.distmesh import DIRTY_FIELDS
+from karpenter_provider_aws_tpu.sidecar import SolverClient, SolverServer
+from karpenter_provider_aws_tpu.sidecar.resilience import (CircuitBreaker,
+                                                           ResiliencePolicy,
+                                                           RetryPolicy)
+from karpenter_provider_aws_tpu.solver import CPUSolver
+from karpenter_provider_aws_tpu.utils.metrics import Metrics
+
+#: small enough for fast local solves, wide enough to be a real arena
+SHAPE = dict(G=4, T=7, n_max=32, E=12, P=2, Z=2, C=2, D=4,
+             pods_per_group=9)
+
+
+def _count(metrics, name, **labels):
+    total = 0.0
+    for (n, lbl), v in metrics.counters.items():
+        if n == name and all(dict(lbl).get(k) == want
+                             for k, want in labels.items()):
+            total += v
+    return total
+
+
+def _policy_factory(threshold=50):
+    def pf(address):
+        return ResiliencePolicy(
+            retry=RetryPolicy(max_attempts=2, sleep=lambda s: None),
+            breaker=CircuitBreaker(threshold=threshold, cooldown_s=60.0))
+    return pf
+
+
+def _wired_group(metrics=None, epoch=5, timeout=2.0, **kw):
+    """A MeshGroup whose one 'worker' is OUR end of a socketpair: the
+    test plays the worker by pre-writing reply frames."""
+    mg = MeshGroup(workers=1, metrics=metrics, **kw)
+    mg.epoch = epoch
+    a, b = socket.socketpair()
+    a.settimeout(timeout)
+    mg._socks = {0: a}
+    return mg, b
+
+
+def _close(mg, peer):
+    for s in list(mg._socks.values()) + [peer]:
+        try:
+            s.close()
+        except Exception:
+            pass
+    mg._socks.clear()
+
+
+# ---------------------------------------------------------------------------
+# epoch fencing
+
+
+class TestEpochFence:
+    def test_frames_carry_epoch_and_stale_replies_are_skipped(self):
+        m = Metrics()
+        mg, peer = _wired_group(metrics=m, epoch=5)
+        try:
+            distmesh._send_msg(peer, {"ok": True, "epoch": 4,
+                                      "fingerprint": "stale"})
+            distmesh._send_msg(peer, {"ok": True, "epoch": 5,
+                                      "fingerprint": "fresh"})
+            replies = mg._broadcast(lambda pid: ({"cmd": "noop"}, None))
+            assert replies[0][0]["fingerprint"] == "fresh"
+            assert _count(
+                m, "karpenter_solver_distmesh_stale_rejected_total") == 1
+            # the outgoing frame was stamped with the current epoch
+            sent, _ = distmesh._recv_msg(peer)
+            assert sent["cmd"] == "noop" and sent["epoch"] == 5
+        finally:
+            _close(mg, peer)
+
+    def test_epochless_reply_is_treated_as_current(self):
+        """Back-compat: a worker build that predates the fence replies
+        without the key — accepted, never spun on."""
+        m = Metrics()
+        mg, peer = _wired_group(metrics=m, epoch=7)
+        try:
+            distmesh._send_msg(peer, {"ok": True, "fingerprint": "f"})
+            replies = mg._broadcast(lambda pid: ({"cmd": "noop"}, None))
+            assert replies[0][0]["fingerprint"] == "f"
+            assert _count(
+                m, "karpenter_solver_distmesh_stale_rejected_total") == 0
+        finally:
+            _close(mg, peer)
+
+    def test_stale_flood_poisons_the_socket_and_degrades(self):
+        """A worker that answers NOTHING but prior-epoch bytes is a
+        zombie: bounded re-reads, then the broadcast fails and the
+        group degrades (worker_lost) rather than merging the past."""
+        m = Metrics()
+        mg, peer = _wired_group(metrics=m, epoch=9)
+        try:
+            for _ in range(meshgroup_mod._STALE_REREADS + 1):
+                distmesh._send_msg(peer, {"ok": True, "epoch": 3})
+            with pytest.raises(RuntimeError, match="stale-epoch"):
+                mg._broadcast(lambda pid: ({"cmd": "noop"}, None))
+            assert mg._degraded
+            assert _count(m, "karpenter_solver_distmesh_degraded_total",
+                          reason="worker_lost") == 1
+            assert _count(
+                m, "karpenter_solver_distmesh_stale_rejected_total") \
+                == meshgroup_mod._STALE_REREADS
+        finally:
+            _close(mg, peer)
+
+    def test_formation_bumps_epoch(self):
+        mg = MeshGroup(workers=1)
+        before = mg.epoch
+
+        def fake_start():
+            mg.epoch += 1  # the real _start_distributed's first act
+        mg._start_distributed = fake_start
+        mg._form()
+        assert mg.epoch == before + 1
+
+
+# ---------------------------------------------------------------------------
+# wedge watchdog
+
+
+class TestWedgeWatchdog:
+    def test_silent_worker_trips_reply_deadline(self):
+        """Socket open, reply never comes: the per-reply deadline fires,
+        the group degrades as worker_wedged, a regroup is scheduled, and
+        the local twin serves oracle-identical with the one-full-Solve
+        taxonomy."""
+        m = Metrics()
+        mg, peer = _wired_group(metrics=m, epoch=2, timeout=0.2)
+        try:
+            with pytest.raises(socket.timeout):
+                mg._broadcast(lambda pid: ({"cmd": "noop"}, None))
+            assert mg._degraded
+            assert _count(m, "karpenter_solver_distmesh_degraded_total",
+                          reason="worker_wedged") == 1
+            assert mg._regroup_at is not None  # supervisor armed
+            r = mg.solve_seeded(SHAPE, seed=4, tick=0,
+                                dirty=list(DIRTY_FIELDS))
+            assert r["mode"] == "full" and not r["distributed"]
+            r2 = mg.solve_seeded(SHAPE, seed=4, tick=1,
+                                 dirty=list(DIRTY_FIELDS))
+            assert r2["mode"] == "patch"
+            for tick, rr in ((0, r), (1, r2)):
+                o = mg.solve_oracle(SHAPE, seed=4, tick=tick)
+                assert rr["fingerprint"] == o["fingerprint"]
+        finally:
+            _close(mg, peer)
+
+    def test_timeout_during_formation_does_not_degrade(self):
+        """degrade_on_error=False: a wedge during a formation attempt
+        belongs to _form's retry logic, not the degrade taxonomy."""
+        m = Metrics()
+        mg, peer = _wired_group(metrics=m, epoch=2, timeout=0.2)
+        try:
+            with pytest.raises(socket.timeout):
+                mg._broadcast(lambda pid: ({"cmd": "noop"}, None),
+                              degrade_on_error=False)
+            assert not mg._degraded
+            assert _count(
+                m, "karpenter_solver_distmesh_degraded_total") == 0
+        finally:
+            _close(mg, peer)
+
+
+# ---------------------------------------------------------------------------
+# supervised regroup
+
+
+def _stub_formed(mg):
+    """Instance-level formation stub: 'spawn' a socketpair worker so a
+    recovered group is alive() without subprocesses."""
+    def fake_form():
+        mg.epoch += 1
+        a, b = socket.socketpair()
+        mg._socks = {0: a}
+        mg._stub_peer = b
+    return fake_form
+
+
+class TestRegroupSupervisor:
+    def _mg(self, m, **kw):
+        kw.setdefault("regroup_backoff_s", 0.01)
+        kw.setdefault("regroup_attempts", 3)
+        return MeshGroup(workers=1, metrics=m, **kw)
+
+    def test_successful_regroup_clears_degraded_state(self):
+        m = Metrics()
+        mg = self._mg(m)
+        mg.degrade(reason="worker_lost")
+        assert mg._regroup_at is not None
+        mg._form = _stub_formed(mg)
+        mg._canary_group = lambda: True
+        epoch0 = mg.epoch
+        time.sleep(0.02)
+        assert mg._maybe_regroup() is True
+        assert not mg._degraded and mg.alive()
+        assert mg.epoch == epoch0 + 1
+        assert mg._regroup_at is None and mg._regroup_attempt == 0
+        # recovery is attributed to the ORIGINAL degrade reason
+        assert _count(m, "karpenter_solver_distmesh_recovered_total",
+                      reason="worker_lost") == 1
+        hist = m.histograms.get(
+            ("karpenter_solver_distmesh_regroup_ms", ()))
+        assert hist and len(hist) == 1
+        _close(mg, mg._stub_peer)
+
+    def test_not_due_yet_is_a_noop(self):
+        m = Metrics()
+        mg = self._mg(m, regroup_backoff_s=60.0)
+        mg.degrade(reason="worker_lost")
+        mg._form = _stub_formed(mg)
+        mg._canary_group = lambda: True
+        assert mg._maybe_regroup() is False
+        assert mg._degraded
+
+    def test_capped_attempts_then_stay_degraded(self):
+        m = Metrics()
+        mg = self._mg(m, regroup_attempts=2)
+
+        def always_fails():
+            raise RuntimeError("formation exploded")
+        mg._form = always_fails
+        mg.degrade(reason="worker_lost")
+        time.sleep(0.02)
+        assert mg._maybe_regroup() is False
+        assert mg._degraded and mg._regroup_at is not None  # rescheduled
+        time.sleep(0.05)  # past the doubled backoff (0.01 * 2^1)
+        assert mg._maybe_regroup() is False
+        assert mg._regroup_at is None  # attempts exhausted: for good
+        assert mg._maybe_regroup() is False  # and stays a no-op
+        assert mg._degraded
+        assert _count(
+            m, "karpenter_solver_distmesh_recovered_total") == 0
+        # the degraded local twin still serves, oracle-identical
+        r = mg.solve_seeded(SHAPE, seed=4, tick=0)
+        o = mg.solve_oracle(SHAPE, seed=4, tick=0)
+        assert r["fingerprint"] == o["fingerprint"]
+
+    def test_divergent_canary_blocks_readmission(self):
+        """A group that re-forms but solves WRONG never serves: the
+        canary gate fails the attempt, the teardown reaps it, and the
+        group keeps serving from the local twin."""
+        m = Metrics()
+        mg = self._mg(m, regroup_attempts=1)
+        mg._form = _stub_formed(mg)
+        mg._canary_group = lambda: False
+        mg.degrade(reason="worker_lost")
+        time.sleep(0.02)
+        assert mg._maybe_regroup() is False
+        assert mg._degraded and not mg._socks  # attempt torn down
+        assert _count(
+            m, "karpenter_solver_distmesh_recovered_total") == 0
+
+    def test_heal_async_regroups_off_thread(self):
+        """The sidecar wiring (_mesh_alive): a due regroup kicked
+        without blocking the caller."""
+        m = Metrics()
+        mg = self._mg(m)
+        mg._form = _stub_formed(mg)
+        mg._canary_group = lambda: True
+        mg.heal_async()  # healthy: no regroup pending, no thread
+        assert mg._regroup_at is None
+        mg.degrade(reason="worker_lost")
+        time.sleep(0.02)
+        mg.heal_async()
+        deadline = time.monotonic() + 5.0
+        while mg._degraded and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert not mg._degraded and mg.alive()
+        assert _count(m, "karpenter_solver_distmesh_recovered_total",
+                      reason="worker_lost") == 1
+        _close(mg, mg._stub_peer)
+
+    def test_stop_cancels_the_scheduled_regroup(self):
+        m = Metrics()
+        mg = self._mg(m)
+        mg._form = _stub_formed(mg)
+        mg._canary_group = lambda: True
+        mg.degrade(reason="worker_lost")
+        mg.stop()
+        assert mg._regroup_at is None
+        time.sleep(0.02)
+        assert mg._maybe_regroup() is False
+        assert mg._degraded  # stopped, not resurrected
+
+    def test_local_mode_never_schedules_regroup(self):
+        mg = MeshGroup(workers=0, metrics=Metrics()).start()
+        mg.degrade(reason="worker_lost")
+        assert mg._regroup_at is None
+        mg.stop()
+
+
+# ---------------------------------------------------------------------------
+# _free_port TOCTOU: bounded formation retry on bind collisions
+
+
+class TestPortRetry:
+    def test_raced_port_is_retried_with_a_fresh_one(self):
+        m = Metrics()
+        mg = MeshGroup(workers=1, metrics=m)
+        calls = []
+
+        def flaky_start():
+            calls.append(1)
+            if len(calls) < 3:
+                raise OSError("[Errno 98] Address already in use")
+            _stub_formed(mg)()
+        mg._start_distributed = flaky_start
+        mg.start()
+        assert len(calls) == 3
+        assert not mg._degraded and mg.alive()
+        assert _count(
+            m, "karpenter_solver_distmesh_degraded_total") == 0
+        _close(mg, mg._stub_peer)
+
+    def test_non_port_error_fails_fast(self):
+        m = Metrics()
+        mg = MeshGroup(workers=1, metrics=m)
+        calls = []
+
+        def bad_start():
+            calls.append(1)
+            raise RuntimeError("worker exploded")
+        mg._start_distributed = bad_start
+        mg.start()
+        assert len(calls) == 1  # no retry: not a port race
+        assert mg._degraded
+        assert _count(m, "karpenter_solver_distmesh_degraded_total",
+                      reason="spawn_failed") == 1
+        mg.stop()
+
+    def test_exhausted_retries_degrade_spawn_failed(self):
+        m = Metrics()
+        mg = MeshGroup(workers=1, metrics=m)
+        calls = []
+
+        def always_races():
+            calls.append(1)
+            raise OSError("[Errno 98] Address already in use")
+        mg._start_distributed = always_races
+        mg.start()
+        assert len(calls) == meshgroup_mod._FORMATION_TRIES
+        assert mg._degraded
+        assert _count(m, "karpenter_solver_distmesh_degraded_total",
+                      reason="spawn_failed") == 1
+        mg.stop()
+
+
+# ---------------------------------------------------------------------------
+# env-configurable timeouts (KARP_MESH_DP2_MIN_SLOTS parse pattern)
+
+
+class TestEnvTimeouts:
+    @pytest.mark.parametrize("env,fn,default", [
+        (HELLO_TIMEOUT_ENV, hello_timeout_s,
+         meshgroup_mod._HELLO_TIMEOUT_S),
+        (REPLY_TIMEOUT_ENV, reply_timeout_s,
+         meshgroup_mod._REPLY_TIMEOUT_S),
+        (PROBE_TIMEOUT_ENV, probe_timeout_s,
+         membership_mod._PROBE_TIMEOUT_S),
+    ])
+    def test_parse_validation(self, monkeypatch, env, fn, default):
+        monkeypatch.delenv(env, raising=False)
+        assert fn() == default
+        monkeypatch.setenv(env, "7.5")
+        assert fn() == 7.5
+        for bad in ("garbage", "0", "-3", ""):
+            monkeypatch.setenv(env, bad)
+            assert fn() == default
+
+    def test_meshgroup_picks_up_env_and_args_win(self, monkeypatch):
+        monkeypatch.setenv(HELLO_TIMEOUT_ENV, "9")
+        monkeypatch.setenv(REPLY_TIMEOUT_ENV, "11")
+        mg = MeshGroup(workers=0)
+        assert mg.hello_timeout_s == 9.0
+        assert mg.reply_timeout_s == 11.0
+        mg2 = MeshGroup(workers=0, hello_timeout_s=3.0,
+                        reply_timeout_s=4.0)
+        assert mg2.hello_timeout_s == 3.0
+        assert mg2.reply_timeout_s == 4.0
+
+    def test_regroup_knobs_from_env(self, monkeypatch):
+        monkeypatch.setenv(REGROUP_ATTEMPTS_ENV, "5")
+        monkeypatch.setenv(REGROUP_BACKOFF_ENV, "0.5")
+        mg = MeshGroup(workers=0)
+        assert mg.regroup_attempts == 5
+        assert mg.regroup_backoff_s == 0.5
+        monkeypatch.setenv(REGROUP_ATTEMPTS_ENV, "junk")
+        monkeypatch.setenv(REGROUP_BACKOFF_ENV, "-1")
+        mg2 = MeshGroup(workers=0)
+        assert mg2.regroup_attempts == meshgroup_mod._REGROUP_ATTEMPTS
+        assert mg2.regroup_backoff_s == meshgroup_mod._REGROUP_BACKOFF_S
+
+    def test_probe_honors_env_timeout(self, monkeypatch):
+        """An unreachable replica with a tiny env deadline fails fast
+        instead of sitting on the default."""
+        monkeypatch.setenv(PROBE_TIMEOUT_ENV, "0.3")
+        ms = FleetMembership(["127.0.0.1:1"],
+                             policy_factory=_policy_factory())
+        try:
+            t0 = time.perf_counter()
+            assert ms.probe("127.0.0.1:1") is False
+            assert time.perf_counter() - t0 < 3.0
+        finally:
+            ms.close()
+
+
+# ---------------------------------------------------------------------------
+# wire canary + fleet quarantine
+
+
+class TestWireCanary:
+    def test_three_valued_verdict(self):
+        srv = SolverServer().start()
+        client = SolverClient(srv.address)
+        try:
+            assert run_canary(client) is True
+            restore = corrupt_server(srv)
+            assert run_canary(client) is False  # wrong-but-well-formed
+            restore()
+            assert run_canary(client) is True
+        finally:
+            client.close()
+            srv.stop()
+        dead = SolverClient("127.0.0.1:1")
+        dead.timeout = 0.5
+        try:
+            assert run_canary(dead) is None  # transport, not evidence
+        finally:
+            dead.close()
+
+
+class TestFleetQuarantine:
+    def test_probe_quarantines_and_canary_readmits(self):
+        m = Metrics()
+        srv = SolverServer(metrics=m).start()
+        ms = FleetMembership([srv.address], metrics=m,
+                             policy_factory=_policy_factory())
+        try:
+            assert ms.probe(srv.address) is True
+            restore = corrupt_server(srv)
+            assert ms.probe(srv.address) is False
+            rep = ms.get(srv.address)
+            assert rep.quarantined and not ms.routable(srv.address)
+            # sticky: the unhealthy-recheck aging does NOT apply —
+            # wrong decisions never age back into rotation
+            rep.last_ping_s = time.monotonic() - 3600.0
+            assert not ms.routable(srv.address)
+            # counted once per transition, not once per probe
+            assert ms.probe(srv.address) is False
+            assert _count(
+                m, "karpenter_solver_fleet_quarantined_total",
+                replica=srv.address) == 1
+            # re-admission is earned: a passing canary clears it
+            restore()
+            assert ms.probe(srv.address) is True
+            assert not rep.quarantined and ms.routable(srv.address)
+        finally:
+            ms.close()
+            srv.stop()
+
+    def _snaps(self, n, prefix):
+        env = Environment()
+        pool = env.nodepool(prefix)
+        base = make_pods(6, cpu="500m", memory="1Gi", prefix=prefix,
+                         group=prefix)
+        snaps = []
+        for i in range(n):
+            pods = base[i:] + make_pods(i, cpu="500m", memory="1Gi",
+                                        prefix=f"{prefix}-c{i}",
+                                        group=prefix)
+            snaps.append(env.snapshot(pods, [pool]))
+        return snaps
+
+    def test_quarantined_replica_is_never_routed(self):
+        """THE acceptance case: one replica of two solves wrong. The
+        ring walks past it, every decision stays oracle-identical, and
+        not a single solve routes to the quarantined peer."""
+        m = Metrics()
+        servers = [SolverServer(metrics=m).start() for _ in range(2)]
+        bad, good = servers[0], servers[1]
+        restore = corrupt_server(bad)
+        ms = FleetMembership([s.address for s in servers], metrics=m,
+                             policy_factory=_policy_factory())
+        solver = FleetSolver(membership=ms, n_max=64, backend="jax",
+                             tenant="t-selfheal", metrics=m)
+        solver._router.alive.mark_ok()
+        try:
+            assert ms.probe(bad.address) is False  # quarantined
+            snaps = self._snaps(5, "shq")
+            oracle = [CPUSolver().solve(s).decision_fingerprint()
+                      for s in snaps]
+            got = [solver.solve(s).decision_fingerprint()
+                   for s in snaps]
+            assert got == oracle
+            assert solver._bound == good.address
+            assert _count(m, "karpenter_solver_fleet_routed_total",
+                          replica=bad.address) == 0
+        finally:
+            restore()
+            solver.close()
+            for s in servers:
+                s.stop()
+
+    def test_fully_quarantined_fleet_goes_dark_not_wrong(self):
+        """Every replica quarantined: staying put would SERVE the wrong
+        decisions (the wire still parses!), so the liveness cache goes
+        dark and the bit-identical host twin takes every solve."""
+        m = Metrics()
+        srv = SolverServer(metrics=m).start()
+        restore = corrupt_server(srv)
+        ms = FleetMembership([srv.address], metrics=m,
+                             policy_factory=_policy_factory())
+        solver = FleetSolver(membership=ms, n_max=64, backend="jax",
+                             tenant="t-dark", metrics=m)
+        solver._router.alive.mark_ok()
+        try:
+            snaps = self._snaps(3, "shd")
+            oracle = [CPUSolver().solve(s).decision_fingerprint()
+                      for s in snaps]
+            got = [solver.solve(s).decision_fingerprint()
+                   for s in snaps]
+            assert got == oracle  # never the corrupt replica's lie
+            assert ms.get(srv.address).quarantined
+            assert solver._router.alive.nonblocking() is False
+        finally:
+            restore()
+            solver.close()
+            srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# the mesh-group canary command (worker side, in-process)
+
+
+class TestMeshCanaryCmd:
+    def test_canary_matches_oracle_and_spares_residency(self):
+        cache = {"mesh": distmesh.dist_mesh2()}
+        # prime production residency first: the canary must not touch it
+        arrays, statics = distmesh.tick_arrays(SHAPE, 3, 0)
+        distmesh.dispatch_dist(arrays, mesh=cache["mesh"], cache=cache,
+                               **statics)
+        placed = dict(cache["last_placement"])
+        reply, rarrays = distmesh._worker_cmd(
+            {"cmd": "canary", "shape": MESH_CANARY_SHAPE,
+             "seed": CANARY_SEED, "tick": 0}, {}, 0, cache, {})
+        assert reply["ok"] and rarrays is None
+        want = MeshGroup(workers=0).solve_oracle(
+            MESH_CANARY_SHAPE, seed=CANARY_SEED, tick=0)["fingerprint"]
+        assert reply["fingerprint"] == want
+        assert cache["last_placement"] == placed  # throwaway cache
+
+    def test_canary_requires_mesh(self):
+        with pytest.raises(RuntimeError, match="mesh not initialized"):
+            distmesh._worker_cmd(
+                {"cmd": "canary", "shape": MESH_CANARY_SHAPE,
+                 "seed": CANARY_SEED, "tick": 0}, {}, 0, {}, {})
+
+    def test_sleep_cmd_holds_then_acks(self):
+        t0 = time.perf_counter()
+        reply, _ = distmesh._worker_cmd({"cmd": "sleep", "s": 0.05},
+                                        {}, 0, {}, {})
+        assert reply["ok"] and time.perf_counter() - t0 >= 0.05
+
+
+# ---------------------------------------------------------------------------
+# the kill/hang/regroup storm (slow tier; hack/chaosheal.sh)
+
+
+STORM_SEEDS = (5, 19)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", STORM_SEEDS)
+def test_selfheal_storm(seed):
+    """REAL worker subprocesses through a kill, a supervised regroup, a
+    wedge (a worker that sleeps through its reply deadline), and a
+    second regroup: every tick fingerprint-identical to the CPU oracle,
+    recovery within a bounded budget, and exactly one full Solve per
+    residency break (the PR 10 invariant, now spanning recoveries)."""
+    m = Metrics()
+    mg = MeshGroup(workers=1, local_devices=4, metrics=m,
+                   regroup_backoff_s=0.25, regroup_attempts=5,
+                   reply_timeout_s=180.0).start()
+    if not mg.alive():
+        mg.stop()
+        pytest.skip("2-process mesh failed to form on this host")
+    state = {"tick": 0, "fulls": 0}
+
+    def solve_tick(dirty):
+        r = mg.solve_seeded(SHAPE, seed=seed, tick=state["tick"],
+                            dirty=dirty)
+        o = mg.solve_oracle(SHAPE, seed=seed, tick=state["tick"])
+        assert r["fingerprint"] == o["fingerprint"], \
+            f"seed {seed} tick {state['tick']} diverged"
+        if r["mode"] == "full":
+            state["fulls"] += 1
+        state["tick"] += 1
+        return r
+
+    def await_regroup(budget_s=120.0):
+        deadline = time.monotonic() + budget_s
+        while time.monotonic() < deadline:
+            r = solve_tick(list(DIRTY_FIELDS))
+            if r["distributed"]:
+                return r
+            time.sleep(0.05)
+        pytest.fail(f"seed {seed}: regroup exceeded the "
+                    f"{budget_s:.0f}s budget")
+
+    breaks = 0
+    try:
+        r = solve_tick(None)
+        assert r["distributed"] and r["mode"] == "full"  # startup prime
+        assert solve_tick(list(DIRTY_FIELDS))["mode"] == "patch"
+
+        # -- kill: distributed residency dies with the worker
+        mg._procs[-1].kill()
+        mg._procs[-1].wait(timeout=10)
+        breaks += 1
+        r = solve_tick(list(DIRTY_FIELDS))
+        assert not r["distributed"] and r["mode"] == "full"
+        assert _count(m, "karpenter_solver_distmesh_degraded_total",
+                      reason="worker_lost") == 1
+
+        # -- supervised regroup: fresh workers, one full, then deltas.
+        # The storm backoff is short enough that the regroup may land on
+        # the very next tick; until it does, degraded ticks ride the
+        # local delta stream
+        breaks += 1
+        r = solve_tick(list(DIRTY_FIELDS))
+        if not r["distributed"]:
+            assert r["mode"] == "patch"
+            r = await_regroup()
+        assert r["mode"] == "full"
+        assert _count(m, "karpenter_solver_distmesh_recovered_total",
+                      reason="worker_lost") == 1
+        epoch_after_first = mg.epoch
+        r = solve_tick(list(DIRTY_FIELDS))
+        assert r["distributed"] and r["mode"] == "patch"
+
+        # -- wedge: a worker sleeps through its reply deadline while
+        # the other blocks in the collective waiting on it
+        distmesh._send_msg(mg._socks[1],
+                           {"cmd": "sleep", "s": 30.0,
+                            "epoch": mg.epoch})
+        for sock in mg._socks.values():
+            sock.settimeout(3.0)
+        breaks += 1
+        r = solve_tick(list(DIRTY_FIELDS))
+        assert not r["distributed"] and r["mode"] == "full"
+        assert _count(m, "karpenter_solver_distmesh_degraded_total",
+                      reason="worker_wedged") == 1
+
+        # -- second supervised regroup, attributed to the wedge
+        r = await_regroup()
+        breaks += 1
+        assert r["mode"] == "full"
+        assert _count(m, "karpenter_solver_distmesh_recovered_total",
+                      reason="worker_wedged") == 1
+        assert mg.epoch > epoch_after_first  # every formation fences
+        assert solve_tick(list(DIRTY_FIELDS))["mode"] == "patch"
+
+        # the books: one full per residency break, plus the startup
+        # prime — and nothing else
+        assert state["fulls"] == breaks + 1
+        # no stale bytes were ever merged (clean kills: sockets died
+        # with their epoch)
+        hist = m.histograms.get(
+            ("karpenter_solver_distmesh_regroup_ms", ()))
+        assert hist and len(hist) == 2
+    finally:
+        mg.stop()
