@@ -55,6 +55,9 @@ fuzz-delta:  ## 10-seed mutation-sequence fuzz of the incremental encoder
 fuzz-consolidate:  ## seeded device-vs-oracle consolidation parity sweep
 	sh hack/fuzzconsolidate.sh
 
+sim:  ## endurance replay: 24 virtual hours + chaos in <=10 min wall
+	sh hack/sim.sh
+
 benchmark: native-try  ## the five BASELINE configs + interruption + batch dispatch
 	python bench.py --all --rounds 100
 	python bench.py --warm-tick
@@ -84,4 +87,4 @@ multihost:  ## multi-PROCESS distributed mesh: 1M-pod ceiling + chaos + suite
 daemon:  ## run the operator against the in-memory cloud
 	python -m karpenter_provider_aws_tpu --cluster-name dev --metrics-port 8080
 
-.PHONY: test test-all scale deflake benchmark consolidate-evidence multichip multihost daemon chart chaos chaoscloud chaos-tenant chaos-patch chaos-fleet chaos-heal fuzz-delta fuzz-consolidate native native-try aot-prime
+.PHONY: test test-all scale deflake benchmark consolidate-evidence multichip multihost daemon chart chaos chaoscloud chaos-tenant chaos-patch chaos-fleet chaos-heal fuzz-delta fuzz-consolidate native native-try aot-prime sim

@@ -711,13 +711,13 @@ def build_warm_cluster(pods=50_000, pending_frac=0.01, seed=23):
     from karpenter_provider_aws_tpu.solver.types import (
         ExistingNode, NodePoolSpec, SchedulingSnapshot)
 
-    import itertools
     import random
 
-    from karpenter_provider_aws_tpu.fake import environment as fake_env
+    from karpenter_provider_aws_tpu.fake.environment import \
+        reset_pod_counter
     # deterministic pod names across arms and processes: the fixture
     # counter is module-global, and fingerprint identity compares names
-    fake_env._pod_counter = itertools.count()
+    reset_pod_counter()
 
     env = Environment()
     np_obj, nc = env.nodepool("bench-warm")

@@ -1,0 +1,18 @@
+#!/bin/sh
+# Endurance simulator: replay 24 virtual hours of cluster life (all
+# trace regimes, composed chaos, continuous invariant audit) against
+# the real Operator + loopback sidecar, in minutes of wall time.
+#
+# The wall budget is enforced: the replay must fit in 10 minutes or
+# the run FAILS (the virtual-time contract — a day that cannot replay
+# quickly is a day nobody will replay at all). Writes SIM_r01.json
+# (seed, stream sha256, terminal fingerprint, per-regime solve p99,
+# violations); exit 0 iff the auditor recorded none.
+#
+# Usage: sh hack/sim.sh                   # seed 1, 24h, SIM_r01.json
+#        sh hack/sim.sh --seed 7 --hours 6 --out /tmp/sim.json
+set -e
+cd "$(dirname "$0")/.."
+
+JAX_PLATFORMS=cpu exec timeout -k 10 600 python -m \
+    karpenter_provider_aws_tpu.sim --out SIM_r01.json "$@"

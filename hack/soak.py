@@ -1,6 +1,6 @@
 #!/usr/bin/env python
 """Soak harness (test/hack/soak analog): churn the operator loop for a
-wall-clock budget and assert the system stays clean.
+wall-clock budget and check the system stays clean.
 
 Each iteration randomly (seeded) creates deployments, deletes pods,
 injects ICE pools and spot interruptions, and rolls AMIs — then lets the
@@ -10,6 +10,11 @@ cluster settle and checks invariants:
 - no stranded pods (bound pod => its Node exists and is Ready)
 - no NodeClaim stuck mid-lifecycle for more than one settle
 - object counts bounded (no monotonic leak of claims/nodes/LTs)
+
+The checks are the endurance simulator's auditor (sim/audit.py) —
+violation-COLLECTING, not bare ``assert`` (which ``python -O`` strips
+silently: a soak that cannot fail). One shared catalog means the soak
+and the simulator cannot drift.
 
 Exit code 0 = clean soak. Usage: python hack/soak.py --minutes 3
 """
@@ -23,21 +28,19 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+class SoakFailure(Exception):
+    """Raised when the auditor reports violations; carries them all."""
+
+    def __init__(self, violations):
+        super().__init__("; ".join(str(v) for v in violations))
+        self.violations = list(violations)
+
+
 def check_invariants(op, log):
-    claims = {c.provider_id for c in op.kube.list("NodeClaim")
-              if c.provider_id}
-    running = [i for i in op.ec2.instances.values() if i.state == "running"]
-    orphans = [i.id for i in running if i.provider_id not in claims]
-    assert not orphans, f"orphaned instances: {orphans} ({log})"
-
-    nodes = {n.name: n for n in op.kube.list("Node")}
-    for p in op.kube.list("Pod"):
-        if p.node_name:
-            assert p.node_name in nodes, \
-                f"pod {p.name} bound to missing node {p.node_name} ({log})"
-
-    for c in op.kube.list("NodeClaim"):
-        assert c.launched, f"claim {c.name} never launched ({log})"
+    from karpenter_provider_aws_tpu.sim.audit import check_cluster
+    violations = check_cluster(op, context=log)
+    if violations:
+        raise SoakFailure(violations)
 
 
 def main():
@@ -57,8 +60,10 @@ def main():
     from karpenter_provider_aws_tpu.providers.sqs import \
         InterruptionMessage
 
+    from karpenter_provider_aws_tpu.sim.audit import LeakMonitor
     rng = random.Random(args.seed)
     op = Operator()
+    leaks = LeakMonitor()
     op.kube.create(EC2NodeClass("soak-class"))
     op.kube.create(NodePool("default", template=NodePoolTemplate(
         node_class_ref=NodeClassRef("soak-class"))))
@@ -122,15 +127,20 @@ def main():
         try:
             op.run_until_settled(max_steps=30)
             check_invariants(op, f"iteration {it}")
+            leak_violations = leaks.check(op, context=f"iteration {it}")
+            if leak_violations:
+                raise SoakFailure(leak_violations)
         except Exception as e:
             # the CI artifact must exist precisely when the soak FAILS —
-            # for ANY failure mode, not just invariant assertions
+            # for ANY failure mode, not just invariant violations
             if args.out:
                 import json
+                doc = {"clean": False, "iterations": it,
+                       "failure": f"{type(e).__name__}: {e}"}
+                if isinstance(e, SoakFailure):
+                    doc["violations"] = [str(v) for v in e.violations]
                 with open(args.out, "w") as f:
-                    json.dump({"clean": False, "iterations": it,
-                               "failure": f"{type(e).__name__}: {e}"},
-                              f, indent=1)
+                    json.dump(doc, f, indent=1)
             raise
 
     pods = op.kube.list("Pod")

@@ -15,7 +15,6 @@ Tuning constants from the reference:
 from __future__ import annotations
 
 import threading
-import time
 from concurrent.futures import Future
 from dataclasses import dataclass, field
 from typing import (
@@ -28,6 +27,8 @@ from typing import (
     Sequence,
     Tuple,
     TypeVar)
+
+from ..sim.clock import as_clock
 
 T = TypeVar("T")  # request
 U = TypeVar("U")  # response
@@ -55,14 +56,19 @@ class Batcher(Generic[T, U]):
                  max_timeout: float = 1.0,
                  max_items: int = 500,
                  hash_fn: Optional[Callable[[T], Hashable]] = None,
-                 clock: Callable[[], float] = time.monotonic,
+                 clock=None,
                  metrics=None):
         self.exec_fn = exec_fn
         self.idle_timeout = idle_timeout
         self.max_timeout = max_timeout
         self.max_items = max_items
         self.hash_fn = hash_fn or (lambda _: 0)
-        self.clock = clock
+        #: the clock seam (sim/clock.py): reads AND the loop's window
+        #: wait go through it, so a VirtualClock can deschedule the
+        #: flush timer onto its event queue; a bare callable keeps the
+        #: legacy reads-only seam (waits stay real)
+        self._clockobj = as_clock(clock)
+        self.clock = self._clockobj.monotonic
         self.metrics = metrics
         self._mu = threading.Lock()
         self._buckets: Dict[Hashable, _Bucket[T, U]] = {}
@@ -116,8 +122,9 @@ class Batcher(Generic[T, U]):
                 for key, b in due:
                     self._flush_locked(key, b)
                 if not due:
-                    self._wake.wait(timeout=None if deadline is None
-                                    else max(0.001, deadline - now))
+                    self._clockobj.cond_wait(
+                        self._wake, timeout=None if deadline is None
+                        else max(0.001, deadline - now))
 
     def _flush_locked(self, key: Hashable, bucket: _Bucket) -> None:
         self._buckets.pop(key, None)
@@ -202,8 +209,7 @@ class CreateFleetBatcher(Batcher):
 
     name = "create_fleet"
 
-    def __init__(self, ec2, clock: Callable[[], float] = time.monotonic,
-                 metrics=None):
+    def __init__(self, ec2, clock=None, metrics=None):
         self.ec2 = ec2
         super().__init__(self._run, idle_timeout=0.035, max_timeout=1.0,
                          max_items=1000, hash_fn=lambda r: r, clock=clock,
@@ -231,8 +237,7 @@ class DescribeInstancesBatcher(Batcher):
 
     name = "describe_instances"
 
-    def __init__(self, ec2, clock: Callable[[], float] = time.monotonic,
-                 metrics=None):
+    def __init__(self, ec2, clock=None, metrics=None):
         self.ec2 = ec2
         super().__init__(self._run, idle_timeout=0.100, max_timeout=1.0,
                          max_items=500, hash_fn=lambda r: 0, clock=clock,
@@ -246,8 +251,7 @@ class DescribeInstancesBatcher(Batcher):
 class TerminateInstancesBatcher(Batcher):
     name = "terminate_instances"
 
-    def __init__(self, ec2, clock: Callable[[], float] = time.monotonic,
-                 metrics=None):
+    def __init__(self, ec2, clock=None, metrics=None):
         self.ec2 = ec2
         super().__init__(self._run, idle_timeout=0.100, max_timeout=1.0,
                          max_items=500, hash_fn=lambda r: 0, clock=clock,

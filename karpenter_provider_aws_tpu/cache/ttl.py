@@ -9,8 +9,9 @@ provider's cache key (a blacklist change must invalidate resolved catalogs).
 from __future__ import annotations
 
 import threading
-import time
-from typing import Any, Callable, Dict, Hashable, Optional, Tuple
+from typing import Any, Dict, Hashable, Optional, Tuple
+
+from ..sim.clock import monotonic_of
 
 # cache.go:19-55
 DEFAULT_TTL = 60.0
@@ -23,12 +24,13 @@ DISCOVERED_CAPACITY_TTL = 60 * 24 * 3600.0
 
 
 class TTLCache:
-    """A thread-safe TTL cache with injectable clock (tests control time)."""
+    """A thread-safe TTL cache with injectable clock (tests and the
+    endurance simulator control time): ``clock`` is a bare ``()->float``
+    callable or a :class:`~..sim.clock.Clock`."""
 
-    def __init__(self, ttl: float = DEFAULT_TTL,
-                 clock: Optional[Callable[[], float]] = None):
+    def __init__(self, ttl: float = DEFAULT_TTL, clock=None):
         self.ttl = ttl
-        self._clock = clock or time.monotonic
+        self._clock = monotonic_of(clock)
         self._mu = threading.RLock()
         self._data: Dict[Hashable, Tuple[float, Any]] = {}
 
@@ -82,7 +84,7 @@ class UnavailableOfferings:
     participation.
     """
 
-    def __init__(self, clock: Optional[Callable[[], float]] = None,
+    def __init__(self, clock=None,
                  ttl: float = UNAVAILABLE_OFFERINGS_TTL):
         self._cache = TTLCache(ttl=ttl, clock=clock)
         self._mu = threading.Lock()

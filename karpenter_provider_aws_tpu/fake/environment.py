@@ -20,6 +20,15 @@ from .kube import FakeKube
 _pod_counter = itertools.count()
 
 
+def reset_pod_counter(start: int = 0) -> None:
+    """Restart the global pod-name counter. Seeded runs that compare
+    pod names across arms/processes (bench cross-arm identity, the
+    endurance simulator's byte-identical traces) call this instead of
+    reaching into the private counter."""
+    global _pod_counter
+    _pod_counter = itertools.count(start)
+
+
 class Environment:
     """FakeEC2 + FakeKube + instancetype provider, hydrated."""
 

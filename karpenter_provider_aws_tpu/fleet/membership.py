@@ -112,13 +112,17 @@ class FleetMembership:
                  policy_factory: Optional[
                      Callable[[str], ResiliencePolicy]] = None,
                  clients: Optional[Dict[str, SolverClient]] = None,
-                 metrics=None):
+                 metrics=None, clock=None):
         """``clients`` lets tests hand in pre-built (fault-wrapped)
         SolverClients per address; anything not covered is constructed
         here with its own fresh policy (``policy_factory(address)``
         when given — chaos tests use it to seed small breakers)."""
+        from ..sim.clock import monotonic_of
         if endpoints is None:
             endpoints = endpoints_from_env()
+        #: probe-verdict aging reads through the clock seam so the
+        #: endurance simulator can age out failed verdicts virtually
+        self._clock = monotonic_of(clock)
         self._token = token
         self._root_cert = root_cert
         self._tenant = tenant
@@ -197,7 +201,7 @@ class FleetMembership:
             # failed verdicts age out: a probe blip must not remove a
             # replica forever — past the recheck window the next owner
             # resolution re-probes it (canary-gated) for a fresh call
-            return (time.monotonic() - rep.last_ping_s
+            return (self._clock() - rep.last_ping_s
                     >= _UNHEALTHY_RECHECK_S)
         return True
 
@@ -242,7 +246,7 @@ class FleetMembership:
             else:
                 rep.quarantined = False
         rep.healthy = ok
-        rep.last_ping_s = time.monotonic()
+        rep.last_ping_s = self._clock()
         if ok:
             rep.caps = {k: bool(info.get(k, 0)) for k in _CAP_FLAGS}
         return ok

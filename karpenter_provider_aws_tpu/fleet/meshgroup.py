@@ -138,7 +138,14 @@ class MeshGroup:
                  hello_timeout_s: Optional[float] = None,
                  reply_timeout_s: Optional[float] = None,
                  regroup_attempts: Optional[int] = None,
-                 regroup_backoff_s: Optional[float] = None):
+                 regroup_backoff_s: Optional[float] = None,
+                 clock=None):
+        from ..sim.clock import monotonic_of
+        #: the clock seam governs ONLY the regroup scheduling timers
+        #: (degrade timestamps, backoff deadlines, outage accounting);
+        #: _reap and socket timeouts stay wall-clock — they bound real
+        #: OS processes and sockets, which do not run on virtual time
+        self._clock = monotonic_of(clock)
         self.workers = max(0, int(workers))
         self.local_devices = int(local_devices)
         self.metrics = metrics
@@ -314,7 +321,7 @@ class MeshGroup:
         self._degraded = True
         self._degrade_pending_full = True
         self._degrade_reason = reason
-        self._degraded_at = time.monotonic()
+        self._degraded_at = self._clock()
         for p in self._procs:
             try:
                 p.kill()
@@ -336,7 +343,7 @@ class MeshGroup:
         self._regroup_attempt = 0
         if (self.workers > 0 and not self._closed
                 and self.regroup_attempts > 0):
-            self._regroup_at = time.monotonic() + self.regroup_backoff_s
+            self._regroup_at = self._clock() + self.regroup_backoff_s
             log.warning("mesh group degraded (%s): serving from the "
                         "single-process mesh; next solve is a full "
                         "placement, regroup scheduled in %.1fs",
@@ -402,7 +409,7 @@ class MeshGroup:
         non-blocking lock in ``_maybe_regroup`` keeps concurrent kicks
         from double-forming."""
         if (self._regroup_at is None or self._closed
-                or time.monotonic() < self._regroup_at):
+                or self._clock() < self._regroup_at):
             return
         threading.Thread(target=self._maybe_regroup,
                          name="meshgroup-regroup", daemon=True).start()
@@ -415,7 +422,7 @@ class MeshGroup:
         ``regroup_attempts`` failures the group stays degraded."""
         if (self._regroup_at is None or self._closed
                 or self.workers <= 0
-                or time.monotonic() < self._regroup_at):
+                or self._clock() < self._regroup_at):
             return False
         if not self._regroup_lock.acquire(blocking=False):
             return False
@@ -443,14 +450,14 @@ class MeshGroup:
             else:
                 delay = min(self.regroup_backoff_s * (2 ** attempt),
                             _REGROUP_BACKOFF_CAP_S)
-                self._regroup_at = time.monotonic() + delay
+                self._regroup_at = self._clock() + delay
                 log.warning("mesh regroup attempt %d/%d failed (%s); "
                             "next attempt in %.1fs", attempt,
                             self.regroup_attempts, e, delay)
             return False
         reason = self._degrade_reason or "unknown"
-        outage_s = time.monotonic() - (self._degraded_at
-                                       or time.monotonic())
+        now = self._clock()
+        outage_s = now - (self._degraded_at or now)
         self._degraded = False
         self._degrade_reason = None
         self._degraded_at = None

@@ -141,11 +141,14 @@ class Operator:
         self.cloud_retry.emit_state()
 
         # providers (operator.go:139-186)
-        self.unavailable_offerings = UnavailableOfferings()
+        # the operator clock reaches the TTL layers too: a virtual-time
+        # endurance run must age the ICE blacklist and catalog caches
+        # on the same timeline as the GC/interruption grace windows
+        self.unavailable_offerings = UnavailableOfferings(clock=clock)
         self.instance_types = InstanceTypeProvider(
             vm_memory_overhead_percent=self.options.vm_memory_overhead_percent,
             unavailable_offerings=self.unavailable_offerings,
-            reserved_enis=self.options.reserved_enis)
+            reserved_enis=self.options.reserved_enis, clock=clock)
         self.pricing = PricingProvider(self.cloud)
         self.subnets = SubnetProvider(self.cloud)
         self.security_groups = SecurityGroupProvider(self.cloud)

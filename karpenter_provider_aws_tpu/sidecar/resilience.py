@@ -46,6 +46,8 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
+from ..sim.clock import as_clock, monotonic_of
+
 #: breaker states (also the value order of the state gauge: the
 #: karpenter_solver_sidecar_breaker_state metric encodes closed=0,
 #: half-open=1, open=2)
@@ -93,10 +95,10 @@ class CircuitBreaker:
     EWMAs and emit metrics — both take their own locks)."""
 
     def __init__(self, threshold: int = 5, cooldown_s: float = 15.0,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock=None):
         self.threshold = threshold
         self.cooldown_s = cooldown_s
-        self._clock = clock
+        self._clock = monotonic_of(clock)
         self._mu = threading.Lock()
         self._state = CLOSED
         self._fails = 0
@@ -169,13 +171,16 @@ class RetryPolicy:
                  backoff_base_s: float = 0.05,
                  backoff_cap_s: float = 2.0,
                  rng: Optional[random.Random] = None,
-                 sleep: Callable[[float], None] = time.sleep):
+                 sleep: Optional[Callable[[float], None]] = None,
+                 clock=None):
         assert max_attempts >= 1
         self.max_attempts = max_attempts
         self.backoff_base_s = backoff_base_s
         self.backoff_cap_s = backoff_cap_s
         self.rng = rng or random.Random()
-        self.sleep = sleep
+        # an explicit sleep wins (chaos tests inject recorders); else the
+        # clock seam, so a VirtualClock deschedules backoff sleeps
+        self.sleep = sleep if sleep is not None else as_clock(clock).sleep
 
     def backoff_s(self, attempt: int) -> float:
         cap = min(self.backoff_cap_s,
@@ -197,9 +202,9 @@ class ResiliencePolicy:
                  breaker: Optional[CircuitBreaker] = None,
                  wire_bytes_per_s: float = 64 * 1024 * 1024,
                  max_deadline_s: float = 120.0,
-                 metrics=None):
-        self.retry = retry or RetryPolicy()
-        self.breaker = breaker or CircuitBreaker()
+                 metrics=None, clock=None):
+        self.retry = retry or RetryPolicy(clock=clock)
+        self.breaker = breaker or CircuitBreaker(clock=clock)
         self.wire_bytes_per_s = wire_bytes_per_s
         self.max_deadline_s = max_deadline_s
         self.metrics = metrics

@@ -39,6 +39,7 @@ from typing import Optional
 import numpy as np
 
 from ..native import arena_pack, arena_unpack
+from ..sim.clock import as_clock
 from ..tenancy.admission import (DEFAULT_TENANT, RETRY_AFTER_METADATA_KEY,
                                  PatchArenaTable, ShapeClassTable,
                                  tenant_from_metadata)
@@ -148,7 +149,8 @@ class _Coalescer:
 
     def __init__(self, metrics=None, max_batch: int = 64,
                  deadline_frac: float = 0.25,
-                 max_window_s: float = 0.025):
+                 max_window_s: float = 0.025, clock=None):
+        self._clock = as_clock(clock)
         self._cv = threading.Condition(threading.Lock())
         self._queues: dict = {}
         self._busy: set = set()
@@ -169,7 +171,7 @@ class _Coalescer:
         the leader's thread, outside the lock. ``tenant`` picks the
         fair-queue lane; the single-tenant case degenerates to the old
         FIFO exactly."""
-        p = _Pending(buf, time.monotonic(), deadline_s, tenant)
+        p = _Pending(buf, self._clock.monotonic(), deadline_s, tenant)
         batch = None
         with self._cv:
             if self._last_arrival is not None:
@@ -185,7 +187,7 @@ class _Coalescer:
                     batch = self._form_batch(key, q, rpc)
                     self._busy.add(key)
                     break
-                self._cv.wait(timeout=0.05)
+                self._clock.cond_wait(self._cv, timeout=0.05)
         if batch is not None:
             err = None
             outs = None
@@ -217,17 +219,17 @@ class _Coalescer:
         to max_batch pendings IN FAIR ORDER and record the coalesce
         evidence."""
         if len(q) >= 2:
-            now = time.monotonic()
+            now = self._clock.monotonic()
             window = min(2.0 * (self._gap_ewma or 0.0), self.max_window_s)
             for x in q:
                 if x.deadline_s is not None:
                     share = x.arrival + self.deadline_frac * x.deadline_s
                     window = min(window, share - now)
             if window > 0:
-                self._cv.wait(timeout=window)
+                self._clock.cond_wait(self._cv, timeout=window)
         n = min(len(q), self.max_batch)
         batch = [q.pop() for _ in range(n)]
-        t = time.monotonic()
+        t = self._clock.monotonic()
         for x in batch:
             x.wait_ms = (t - x.arrival) * 1e3
         self.stats["dispatches"] += 1
@@ -284,16 +286,16 @@ class _Handler:
 
     def __init__(self, metrics=None, admission=None, shape_table=None,
                  bucketing: bool = True, compile_monitor=None,
-                 patch_arenas=None, mesh_group=None):
+                 patch_arenas=None, mesh_group=None, clock=None):
         #: the compile-cache budget — an LRU shape-class table that
         #: still answers len()/in like the set it replaced
         self._shapes_seen = shape_table if shape_table is not None \
             else ShapeClassTable(capacity=_MAX_SHAPE_CLASSES,
-                                 metrics=metrics)
+                                 metrics=metrics, clock=clock)
         #: server-resident arenas for the delta wire (SolvePatch)
         self._patch_arenas = patch_arenas if patch_arenas is not None \
             else PatchArenaTable(capacity=_MAX_PATCH_ARENAS,
-                                 metrics=metrics)
+                                 metrics=metrics, clock=clock)
         self._admission = admission
         self._bucketing = bucketing
         self._compile_monitor = compile_monitor
@@ -308,7 +310,7 @@ class _Handler:
         self._inflight = 0
         self._inflight_cv = threading.Condition(threading.Lock())
         self.metrics = metrics
-        self._coalescer = _Coalescer(metrics=metrics)
+        self._coalescer = _Coalescer(metrics=metrics, clock=clock)
 
     # -- in-flight tracking (graceful stop) -----------------------------
     def tracked(self, fn, rpc: Optional[str] = None):
@@ -1118,7 +1120,7 @@ class SolverServer:
                  compile_cache: bool = True,
                  compile_cache_dir: Optional[str] = None,
                  aot_cache: bool = True, aot_record: bool = False,
-                 mesh_workers: Optional[int] = None):
+                 mesh_workers: Optional[int] = None, clock=None):
         import grpc
         if (tls_cert is None) != (tls_key is None):
             # a security posture must fail CLOSED: half a TLS config is
@@ -1141,7 +1143,7 @@ class SolverServer:
             from ..tenancy.admission import AdmissionController
             admission = AdmissionController(
                 quotas=quotas, default_quota=default_quota,
-                metrics=metrics)
+                metrics=metrics, clock=clock)
         monitor = None
         cache_dir = ""
         if compile_cache:
@@ -1200,7 +1202,8 @@ class SolverServer:
         self._handler = _Handler(metrics=metrics, admission=admission,
                                  bucketing=bucketing,
                                  compile_monitor=monitor,
-                                 mesh_group=self._mesh_group)
+                                 mesh_group=self._mesh_group,
+                                 clock=clock)
         self._handler.cache_dir = cache_dir
         self._server.add_generic_rpc_handlers(
             (_generic_handler(self._handler),))

@@ -1,0 +1,30 @@
+"""Endurance simulator: virtual-time day-long trace replay against the
+real stack, with composed chaos and a continuous invariant auditor.
+
+Four pieces (docs/simulator.md):
+
+- :mod:`.clock` — the Clock seam. Every timer in the serving stack
+  (batcher windows, TTL caches, resilience backoff, admission buckets,
+  coalescer waits, fleet probe aging, meshgroup regroup timers) reads
+  time through an injectable :class:`~.clock.Clock`; the default stays
+  the real clock (zero behavior change, tier-1 proves it), while
+  :class:`~.clock.VirtualClock` lets a simulated day run in minutes.
+- :mod:`.traces` — seeded day-long trace generators (diurnal ramp,
+  flash crowd, spot-reclaim storm, batch waves, multi-tenant solve
+  mix) emitting one totally-ordered, byte-stable event stream.
+- :mod:`.chaos` — a chaos scheduler composing the existing injectors
+  (faultwire, faultcloud, TenantHammer) onto the trace timeline from
+  the same seed, with deliberate overlap windows.
+- :mod:`.driver` + :mod:`.audit` — the replay engine driving the real
+  Operator under the virtual clock, and the continuously-running
+  invariant auditor (shared with hack/soak.py).
+
+This package deliberately imports nothing heavy at import time: the
+clock seam is consumed by low-level modules (cache/ttl.py,
+batcher/core.py) that must not pull jax or grpc.
+"""
+
+from .clock import Clock, RealClock, VirtualClock, as_clock, monotonic_of
+
+__all__ = ["Clock", "RealClock", "VirtualClock", "as_clock",
+           "monotonic_of"]

@@ -1,0 +1,52 @@
+"""CLI for the endurance simulator: ``python -m
+karpenter_provider_aws_tpu.sim --hours 24 --out SIM_r01.json``.
+
+Exit code 0 iff the auditor recorded no violations."""
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="karpenter_provider_aws_tpu.sim",
+        description="virtual-time endurance replay (docs/simulator.md)")
+    ap.add_argument("--seed", type=int, default=1)
+    ap.add_argument("--hours", type=float, default=24.0,
+                    help="virtual duration (default: one day)")
+    ap.add_argument("--regimes", default="",
+                    help="comma-separated subset (default: all)")
+    ap.add_argument("--scale", type=float, default=1.0)
+    ap.add_argument("--no-chaos", action="store_true")
+    ap.add_argument("--no-wire", action="store_true",
+                    help="skip the loopback sidecar (no grpc)")
+    ap.add_argument("--audit-every", type=int, default=40)
+    ap.add_argument("--out", default="",
+                    help="write the JSON report artifact here")
+    args = ap.parse_args(argv)
+
+    from .driver import EnduranceSim
+    sim = EnduranceSim(
+        seed=args.seed, duration_s=args.hours * 3600.0,
+        regimes=[r for r in args.regimes.split(",") if r] or None,
+        scale=args.scale, chaos=not args.no_chaos,
+        wire=False if args.no_wire else None,
+        audit_every=args.audit_every, out=args.out or None)
+    report = sim.run()
+    print(json.dumps({k: v for k, v in report.items()
+                      if k != "events_by_kind"}, indent=1))
+    if not report["clean"]:
+        print(f"SIM FAILED: {len(report['violations'])} violation(s)",
+              file=sys.stderr)
+        return 1
+    print(f"sim clean: {report['events_total']} events, "
+          f"{report['solves']} solves, {report['chaos_windows']} chaos "
+          f"windows ({report['chaos_overlaps']} overlapped), "
+          f"{report['wall_s']}s wall for "
+          f"{report['virtual_duration_s'] / 3600:.1f}h virtual")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,265 @@
+"""The continuous invariant auditor.
+
+Every check COLLECTS violations instead of asserting (bare ``assert``
+is stripped under ``python -O`` — the hack/soak.py lesson; soak now
+imports these same checks so the two harnesses cannot drift). The
+driver runs the cluster checks at every audit tick and the full
+catalog at terminus; any surviving :class:`Violation` fails the run.
+
+Invariant catalog (docs/simulator.md):
+
+- **Cluster conservation** — no orphaned cloud instances, no pod bound
+  to a missing node, no NodeClaim that never launched, SQS drained.
+- **Accounting identities** — per tenant, offered == admitted + shed
+  (client-observed offers vs the server's admission counters);
+  ``recovered_total{reason}`` never exceeds ``degraded_total{reason}``;
+  wire fallback reasons stay within the documented taxonomy.
+- **Resource-leak bounds** — threads and fds within a slack of the
+  run's own baseline; shape-class/patch-arena tables within capacity;
+  fake-cloud object counts bounded (no monotonic leak of launch
+  templates or zombie instances).
+- **Solve SLO** — per-regime p99 of tenant solve latency under the SLO
+  table (docs/simulator.md; generous CPU-CI defaults, post-warmup).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence
+
+__all__ = ["Violation", "check_cluster", "check_accounting",
+           "check_slo", "LeakMonitor", "DEFAULT_SLO_P99_MS"]
+
+#: per-regime solve p99 SLO in ms (CPU CI bar, post-warmup; the SLO
+#: table in docs/simulator.md). Regimes without an entry use "default".
+DEFAULT_SLO_P99_MS = {
+    "default": 2000.0,
+    "tenant_mix": 2000.0,
+}
+
+
+@dataclass(frozen=True)
+class Violation:
+    check: str
+    detail: str
+
+    def __str__(self) -> str:
+        return f"[{self.check}] {self.detail}"
+
+
+# -- cluster conservation ---------------------------------------------------
+
+def check_cluster(op, context: str = "") -> List[Violation]:
+    """The soak invariants, violation-collecting: run against a settled
+    Operator. ``context`` tags each violation with where in the run it
+    surfaced (iteration / virtual timestamp)."""
+    v: List[Violation] = []
+    tag = f" ({context})" if context else ""
+
+    claims = {c.provider_id for c in op.kube.list("NodeClaim")
+              if c.provider_id}
+    orphans = [i.id for i in op.ec2.instances.values()
+               if i.state == "running" and i.provider_id not in claims]
+    if orphans:
+        v.append(Violation("orphaned-instances",
+                           f"running instances with no NodeClaim: "
+                           f"{sorted(orphans)}{tag}"))
+
+    nodes = {n.name for n in op.kube.list("Node")}
+    stranded = [p.name for p in op.kube.list("Pod")
+                if p.node_name and p.node_name not in nodes]
+    if stranded:
+        v.append(Violation("pod-missing-node",
+                           f"pods bound to missing nodes: "
+                           f"{sorted(stranded)}{tag}"))
+
+    stuck = [c.name for c in op.kube.list("NodeClaim") if not c.launched]
+    if stuck:
+        v.append(Violation("claim-never-launched",
+                           f"NodeClaims never launched: "
+                           f"{sorted(stuck)}{tag}"))
+
+    if len(op.sqs):
+        v.append(Violation("queue-not-drained",
+                           f"{len(op.sqs)} interruption message(s) left "
+                           f"on the queue{tag}"))
+    return v
+
+
+# -- accounting identities --------------------------------------------------
+
+def _sum_counter(metrics, name: str, **match) -> float:
+    total = 0.0
+    for (n, labels), val in metrics.counters.items():
+        if n != name:
+            continue
+        d = dict(labels)
+        if all(d.get(k) == v for k, v in match.items()):
+            total += val
+    return total
+
+
+def check_accounting(metrics, offered_by_tenant: Optional[Dict[str, int]]
+                     = None, context: str = "") -> List[Violation]:
+    """Metric accounting identities over one registry.
+
+    ``offered_by_tenant`` is the CLIENT side of the admission ledger
+    (solve attempts the driver actually put on the wire, per tenant);
+    the server's admitted+shed must partition it exactly. Passing None
+    skips the partition check (no wire traffic ran)."""
+    v: List[Violation] = []
+    tag = f" ({context})" if context else ""
+
+    if offered_by_tenant:
+        for tenant, offered in sorted(offered_by_tenant.items()):
+            admitted = _sum_counter(
+                metrics, "karpenter_solver_tenant_admitted_total",
+                tenant=tenant)
+            shed = _sum_counter(
+                metrics, "karpenter_solver_tenant_shed_total",
+                tenant=tenant)
+            if int(admitted + shed) != int(offered):
+                v.append(Violation(
+                    "admission-partition",
+                    f"tenant {tenant}: offered={offered} != "
+                    f"admitted={int(admitted)} + shed={int(shed)}{tag}"))
+
+    # recovery never outruns degradation, per reason
+    reasons = {dict(labels).get("reason")
+               for (n, labels) in metrics.counters
+               if n in ("karpenter_solver_distmesh_degraded_total",
+                        "karpenter_solver_distmesh_recovered_total")}
+    for reason in sorted(r for r in reasons if r):
+        deg = _sum_counter(metrics,
+                           "karpenter_solver_distmesh_degraded_total",
+                           reason=reason)
+        rec = _sum_counter(metrics,
+                           "karpenter_solver_distmesh_recovered_total",
+                           reason=reason)
+        if rec > deg:
+            v.append(Violation(
+                "recovery-exceeds-degrades",
+                f"recovered_total{{reason={reason}}}={int(rec)} > "
+                f"degraded_total={int(deg)}{tag}"))
+
+    # the wire fallback taxonomy is closed (docs/metrics.md)
+    known = {"no_resident", "stale_version", "unimplemented",
+             "rejected", "transport"}
+    for (n, labels) in metrics.counters:
+        if n == "karpenter_solver_wire_fallback_total":
+            reason = dict(labels).get("reason")
+            if reason not in known:
+                v.append(Violation(
+                    "unknown-fallback-reason",
+                    f"wire fallback reason {reason!r} outside the "
+                    f"documented taxonomy{tag}"))
+    return v
+
+
+# -- solve SLO --------------------------------------------------------------
+
+def _p99(samples: Sequence[float]) -> float:
+    xs = sorted(samples)
+    return xs[min(len(xs) - 1, int(0.99 * len(xs)))]
+
+
+def check_slo(latencies_by_regime: Dict[str, List[float]],
+              slo_p99_ms: Optional[Dict[str, float]] = None,
+              context: str = "") -> List[Violation]:
+    """Per-regime p99 against the SLO table (latencies in seconds)."""
+    slo = dict(DEFAULT_SLO_P99_MS)
+    slo.update(slo_p99_ms or {})
+    v: List[Violation] = []
+    tag = f" ({context})" if context else ""
+    for regime, lats in sorted(latencies_by_regime.items()):
+        if not lats:
+            continue
+        p99_ms = _p99(lats) * 1e3
+        bound = slo.get(regime, slo["default"])
+        if p99_ms > bound:
+            v.append(Violation(
+                "solve-slo",
+                f"regime {regime}: solve p99 {p99_ms:.0f}ms > SLO "
+                f"{bound:.0f}ms over {len(lats)} solves{tag}"))
+    return v
+
+
+# -- resource-leak bounds ---------------------------------------------------
+
+def _fd_count() -> Optional[int]:
+    try:
+        return len(os.listdir("/proc/self/fd"))
+    except OSError:
+        return None  # non-procfs platform: the fd bound is skipped
+
+
+class LeakMonitor:
+    """Baseline-relative leak bounds over the whole run.
+
+    Construct BEFORE the run starts (captures the thread/fd baseline),
+    then ``check`` at audit ticks and terminus. Slacks absorb the
+    legitimate steady-state workers (batcher loops, grpc pollers, the
+    solve worker) — what must not happen is unbounded growth."""
+
+    def __init__(self, thread_slack: int = 32, fd_slack: int = 64,
+                 max_launch_templates: int = 512,
+                 max_instances: int = 2048):
+        self.base_threads = threading.active_count()
+        self.base_fds = _fd_count()
+        self.thread_slack = thread_slack
+        self.fd_slack = fd_slack
+        self.max_launch_templates = max_launch_templates
+        self.max_instances = max_instances
+
+    def check(self, op=None, handler=None,
+              context: str = "") -> List[Violation]:
+        """``handler`` is the sidecar's _Handler (its shape-class and
+        patch-arena tables carry hard capacities to hold)."""
+        v: List[Violation] = []
+        tag = f" ({context})" if context else ""
+
+        n = threading.active_count()
+        if n > self.base_threads + self.thread_slack:
+            v.append(Violation(
+                "thread-leak",
+                f"{n} live threads (baseline {self.base_threads} + "
+                f"slack {self.thread_slack}){tag}"))
+
+        fds = _fd_count()
+        if fds is not None and self.base_fds is not None \
+                and fds > self.base_fds + self.fd_slack:
+            v.append(Violation(
+                "fd-leak",
+                f"{fds} open fds (baseline {self.base_fds} + slack "
+                f"{self.fd_slack}){tag}"))
+
+        if op is not None:
+            lts = len(op.ec2.launch_templates)
+            if lts > self.max_launch_templates:
+                v.append(Violation(
+                    "launch-template-leak",
+                    f"{lts} launch templates (bound "
+                    f"{self.max_launch_templates}){tag}"))
+            insts = len(op.ec2.instances)
+            if insts > self.max_instances:
+                v.append(Violation(
+                    "instance-object-leak",
+                    f"{insts} fake-cloud instance objects (bound "
+                    f"{self.max_instances}){tag}"))
+
+        if handler is not None:
+            st = handler._shapes_seen
+            if len(st) > st.capacity:
+                v.append(Violation(
+                    "shape-table-overflow",
+                    f"shape-class table at {len(st)} > capacity "
+                    f"{st.capacity}{tag}"))
+            pa = handler._patch_arenas
+            if len(pa) > pa.capacity:
+                v.append(Violation(
+                    "arena-table-overflow",
+                    f"patch-arena table at {len(pa)} > capacity "
+                    f"{pa.capacity}{tag}"))
+        return v

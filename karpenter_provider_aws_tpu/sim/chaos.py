@@ -1,0 +1,131 @@
+"""Chaos composition onto the trace timeline.
+
+The existing injectors each tear ONE seam for the length of a test:
+faultwire wraps a SolverClient, faultcloud wraps the EC2/SQS seam,
+TenantHammer storms the admission layer. Production failure is
+*overlapping*: a cloud storm lands while the wire is already flaky and
+an adversarial tenant is mid-burst. This module schedules those
+injectors as WINDOWS on the same virtual timeline the trace runs on,
+drawn from the same seed — including deliberately overlapped pairs
+(docs/simulator.md's composition grammar).
+
+A window is pure data; the driver engages/disengages the real injector
+when virtual time crosses its bounds. Window kinds:
+
+- ``cloud``      — a CloudFaultInjector storm (throttle/down/wedge/
+                   lag/partial/dup) on the operator's EC2+SQS seam.
+- ``wire``       — a FaultInjector (unavailable/deadline/latency/
+                   truncate/drop/stale) on the tenant solve client.
+- ``hammer``     — a TenantHammer thread against the live sidecar.
+- ``arena_wipe`` — the server's resident patch arenas dropped mid-
+                   stream (compile-cache/residency wipe: every tenant's
+                   next delta tick must degrade to one full Solve and
+                   re-prime).
+
+Every plan parameter is bounded the way the chaos tests bound them
+(finite ``max_faults``, ``max_consecutive`` under the client's retry
+budget) so a composed schedule stresses recovery without making
+convergence impossible.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["ChaosWindow", "CHAOS_KINDS", "schedule"]
+
+CHAOS_KINDS: Tuple[str, ...] = ("cloud", "wire", "hammer", "arena_wipe")
+
+_SALT = 0xC405
+
+
+@dataclass(frozen=True)
+class ChaosWindow:
+    """One scheduled injector engagement: [t0, t1) on the virtual
+    timeline. ``params`` feed the injector's plan constructor; the
+    ``overlaps`` flag marks windows the scheduler DELIBERATELY laid on
+    top of another (fault-during-recovery coverage — the audit report
+    counts them so a run can prove composition actually happened)."""
+
+    t0: float
+    t1: float
+    kind: str
+    params: Dict = field(default_factory=dict)
+    overlaps: bool = False
+
+    def encode(self) -> bytes:
+        return json.dumps(
+            {"t0": round(self.t0, 3), "t1": round(self.t1, 3),
+             "kind": self.kind, "params": self.params,
+             "overlaps": self.overlaps},
+            sort_keys=True, separators=(",", ":")).encode()
+
+
+def schedule(seed: int, duration_s: float,
+             kinds: Optional[Sequence[str]] = None) -> List[ChaosWindow]:
+    """The composed chaos schedule for one run: per enabled kind, a few
+    seeded windows spread over the day, plus forced OVERLAP pairs — a
+    wire window opened inside every cloud window's second half, and an
+    arena wipe dropped inside a hammer window when both are enabled.
+    Deterministic for equal (seed, duration, kinds)."""
+    kinds = list(kinds if kinds is not None else CHAOS_KINDS)
+    unknown = set(kinds) - set(CHAOS_KINDS)
+    if unknown:
+        raise ValueError(f"unknown chaos kinds: {sorted(unknown)}")
+    rng = random.Random((seed & 0xFFFFFFFF) ^ _SALT)
+    duration_s = float(duration_s)
+    out: List[ChaosWindow] = []
+
+    def win(frac_lo, frac_hi, min_s, max_s):
+        t0 = rng.uniform(frac_lo, frac_hi) * duration_s
+        return t0, min(duration_s, t0 + rng.uniform(min_s, max_s))
+
+    if "cloud" in kinds:
+        for _ in range(max(1, int(duration_s // 28800))):
+            t0, t1 = win(0.1, 0.8, 300.0, 1200.0)
+            out.append(ChaosWindow(t0, t1, "cloud", {
+                "seed": rng.randrange(1 << 16),
+                "p_throttle": 0.10, "p_down": 0.06, "p_wedge": 0.06,
+                "p_lag": 0.08, "p_partial": 0.05, "p_dup": 0.20,
+                "max_consecutive": 2, "max_faults": 30}))
+            if "wire" in kinds:
+                # the forced overlap: the wire goes flaky while the
+                # cloud storm is still mid-flight (fault-during-
+                # recovery, the regime no single-seam test reaches)
+                mid = t0 + (t1 - t0) * 0.5
+                out.append(ChaosWindow(
+                    mid, min(duration_s, t1 + (t1 - t0) * 0.5), "wire",
+                    {"seed": rng.randrange(1 << 16),
+                     "p_unavailable": 0.12, "p_deadline": 0.08,
+                     "p_latency": 0.10, "p_truncate": 0.08,
+                     "p_drop": 0.08, "p_stale": 0.05,
+                     "max_consecutive": 2}, overlaps=True))
+    if "wire" in kinds:
+        for _ in range(max(1, int(duration_s // 43200))):
+            t0, t1 = win(0.05, 0.9, 600.0, 1800.0)
+            out.append(ChaosWindow(t0, t1, "wire", {
+                "seed": rng.randrange(1 << 16),
+                "p_unavailable": 0.15, "p_deadline": 0.10,
+                "p_latency": 0.10, "p_truncate": 0.10, "p_drop": 0.10,
+                "p_stale": 0.05, "max_consecutive": 2}))
+    if "hammer" in kinds:
+        for i in range(max(1, int(duration_s // 43200))):
+            t0, t1 = win(0.2, 0.85, 300.0, 900.0)
+            out.append(ChaosWindow(t0, t1, "hammer", {
+                "seed": rng.randrange(1 << 16),
+                "tenant": f"hammer{i}"}))
+            if "arena_wipe" in kinds:
+                # wipe the resident arenas mid-hammer: the delta wire
+                # re-primes while admission is under adversarial load
+                t = t0 + (t1 - t0) * rng.uniform(0.3, 0.7)
+                out.append(ChaosWindow(t, t, "arena_wipe", {},
+                                       overlaps=True))
+    if "arena_wipe" in kinds:
+        t = rng.uniform(0.3, 0.9) * duration_s
+        out.append(ChaosWindow(t, t, "arena_wipe", {}))
+    out.sort(key=lambda w: (w.t0, w.t1, w.kind,
+                            json.dumps(w.params, sort_keys=True)))
+    return out

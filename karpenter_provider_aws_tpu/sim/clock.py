@@ -1,0 +1,294 @@
+"""The Clock seam: every timer in the stack, behind one injectable
+protocol.
+
+Why a seam and not more bare callables: half the stack already took a
+``clock: Callable[[], float]`` (token buckets, TTL caches, breakers),
+but *waiting* still went straight to the OS — ``time.sleep`` in retry
+backoff, ``Condition.wait(timeout)`` in the batcher loop and the
+coalescer top-up window. A 24h scenario could therefore only run in
+24h, and timer-interaction bugs (backoff racing TTL expiry racing a
+meshgroup regroup) were untestable. The seam adds the two missing
+verbs — ``sleep`` and ``cond_wait`` — so a :class:`VirtualClock` can
+deschedule a waiter onto its event queue and wake it when simulated
+time passes the deadline, in zero wall time.
+
+Three implementations:
+
+- :class:`RealClock` — the default everywhere. ``monotonic``/``time``/
+  ``sleep`` delegate to :mod:`time`, ``cond_wait`` to
+  ``Condition.wait``: byte-for-byte the pre-seam behavior (tier-1 and
+  the RealClock parity tests in tests/test_sim.py pin this).
+- :class:`CallableClock` — adapts the legacy bare-callable seam. Reads
+  come from the callable; waits stay REAL, exactly what every existing
+  hand-driven test clock relied on.
+- :class:`VirtualClock` — simulated time. Reads return the simulated
+  instant; ``sleep(s)`` parks the calling thread on the clock's waiter
+  heap until ``advance()`` moves time past its deadline; ``cond_wait``
+  registers a one-shot virtual timeout that ``advance()`` converts
+  into a ``notify_all`` on the waiter's own condition (callers already
+  loop on their predicate, so a virtual timeout behaves exactly like a
+  real ``Condition.wait`` timing out). ``warp_wall`` shifts the wall
+  clock relative to the monotonic clock, for testing wall-warp
+  behavior (NTP step, suspended VM).
+
+Lock discipline in :class:`VirtualClock`: ``cond_wait`` acquires the
+clock lock while HOLDING the caller's condition lock, so ``advance``
+must never take a condition lock while holding the clock lock — due
+conditions are collected under the clock lock, notified after
+releasing it.
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+import time
+from typing import Callable, List, Optional, Tuple
+
+__all__ = ["Clock", "RealClock", "CallableClock", "VirtualClock",
+           "REAL_CLOCK", "as_clock", "monotonic_of"]
+
+
+class Clock:
+    """The protocol (and the real implementation — subclasses override).
+
+    - ``monotonic()`` — suspend-free interval time (``time.monotonic``).
+    - ``time()`` — wall time (``time.time``).
+    - ``sleep(s)`` — block the calling thread for ``s`` seconds.
+    - ``cond_wait(cond, timeout)`` — wait on an externally-owned
+      ``threading.Condition`` whose lock the caller holds; returns
+      False on timeout (the ``Condition.wait`` contract).
+    """
+
+    name = "real"
+
+    def monotonic(self) -> float:
+        return time.monotonic()
+
+    def time(self) -> float:
+        return time.time()
+
+    def sleep(self, seconds: float) -> None:
+        if seconds > 0:
+            time.sleep(seconds)
+
+    def cond_wait(self, cond: threading.Condition,
+                  timeout: Optional[float] = None) -> bool:
+        return cond.wait(timeout)
+
+
+RealClock = Clock  # the explicit name docs and tests use
+
+#: the shared default — components that receive no clock use this
+REAL_CLOCK = Clock()
+
+
+class CallableClock(Clock):
+    """Adapter for the legacy bare-callable clock seam: reads come from
+    the callable (a hand-driven test clock), waits stay real — the
+    exact semantics every pre-seam caller of ``clock=lambda: t`` got."""
+
+    name = "callable"
+
+    def __init__(self, fn: Callable[[], float]):
+        self._fn = fn
+
+    def monotonic(self) -> float:
+        return float(self._fn())
+
+    def time(self) -> float:
+        return float(self._fn())
+
+
+class VirtualClock(Clock):
+    """Simulated time. Single writer (the driver calling ``advance``),
+    any number of reader/waiter threads.
+
+    ``advance_to`` moves time forward in deadline order: each sleeper
+    whose deadline is reached is woken AT its deadline — its FIRST
+    clock read after waking returns exactly ``deadline``, never a later
+    instant, even though the advancer may already have hopped on (the
+    wake pins the deadline per-thread; the read consumes the pin). So
+    timer boundary behavior is exact — a 30s regroup backoff fires at
+    +30s, not +30s plus scheduler jitter — regardless of how the OS
+    interleaves the advancer with the woken thread. ``advance_to`` also
+    rendezvouses with each woken sleeper (the sleeper acknowledges from
+    inside ``sleep`` before returning) so by the time ``advance_to``
+    returns every due ``sleep`` call has returned. Registered
+    ``cond_wait`` timeouts are one-shot: firing notifies the waiter's
+    condition; a waiter that already woke for another reason just
+    absorbs a spurious notify (every caller loops on its predicate).
+    """
+
+    name = "virtual"
+
+    def __init__(self, start: float = 0.0,
+                 epoch: float = 1_700_000_000.0):
+        self._mu = threading.Condition(threading.Lock())
+        self._now = float(start)
+        self._wall_offset = float(epoch)
+        #: heap of (deadline, seq, Event, thread-id) — parked ``sleep``
+        #: callers
+        self._sleepers: List[Tuple[float, int, threading.Event, int]] = []
+        #: one-shot (deadline, seq, Condition) virtual timeouts
+        self._cond_timeouts: List[Tuple[float, int, threading.Condition]] = []
+        self._seq = 0
+        #: thread-id -> deadline: a woken sleeper's first read returns
+        #: exactly its deadline (consumed by the read)
+        self._pins = {}
+        #: woken sleepers that have not yet acknowledged from ``sleep``
+        self._acks_due = 0
+
+    # -- reads ----------------------------------------------------------
+    def monotonic(self) -> float:
+        with self._mu:
+            pinned = self._pins.pop(threading.get_ident(), None)
+            return self._now if pinned is None else pinned
+
+    def time(self) -> float:
+        with self._mu:
+            pinned = self._pins.pop(threading.get_ident(), None)
+            return self._wall_offset + \
+                (self._now if pinned is None else pinned)
+
+    def warp_wall(self, delta_s: float) -> None:
+        """Shift wall time relative to monotonic time (NTP step /
+        suspended-VM simulation). Monotonic readers are unaffected."""
+        with self._mu:
+            self._wall_offset += float(delta_s)
+
+    # -- waits ----------------------------------------------------------
+    def sleep(self, seconds: float) -> None:
+        if seconds <= 0:
+            return
+        ev = threading.Event()
+        with self._mu:
+            self._seq += 1
+            heapq.heappush(self._sleepers,
+                           (self._now + seconds, self._seq, ev,
+                            threading.get_ident()))
+            self._mu.notify_all()  # an advancer waiting in wait_for_waiters
+        ev.wait()  # descheduled: woken only by advance()
+        with self._mu:
+            self._acks_due -= 1
+            self._mu.notify_all()  # the advancer's rendezvous
+
+    def cond_wait(self, cond: threading.Condition,
+                  timeout: Optional[float] = None) -> bool:
+        # Caller holds cond's lock (the Condition.wait contract).
+        if timeout is None:
+            return cond.wait()
+        if timeout <= 0:
+            return cond.wait(0)
+        with self._mu:
+            self._seq += 1
+            heapq.heappush(self._cond_timeouts,
+                           (self._now + timeout, self._seq, cond))
+            deadline = self._now + timeout
+            self._mu.notify_all()
+        cond.wait()  # a real notify or the virtual timeout wakes us
+        with self._mu:
+            # the Condition.wait contract: False iff the timeout passed
+            return self._now < deadline
+
+    # -- the driver side ------------------------------------------------
+    def pending_deadline(self) -> Optional[float]:
+        """Earliest registered waiter deadline (sleepers and cond
+        timeouts), or None — the driver uses it to run waiters dry."""
+        with self._mu:
+            cands = []
+            if self._sleepers:
+                cands.append(self._sleepers[0][0])
+            if self._cond_timeouts:
+                cands.append(self._cond_timeouts[0][0])
+            return min(cands) if cands else None
+
+    def wait_for_waiters(self, n: int = 1, timeout_s: float = 5.0) -> bool:
+        """Block (real time) until >= ``n`` waiters are registered —
+        the regression tests' rendezvous with a worker thread about to
+        be descheduled."""
+        deadline = time.monotonic() + timeout_s
+        with self._mu:
+            while (len(self._sleepers) + len(self._cond_timeouts)) < n:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return False
+                self._mu.wait(left)
+            return True
+
+    def advance(self, dt: float) -> None:
+        self.advance_to(self.monotonic() + dt)
+
+    def advance_to(self, target: float) -> None:
+        """Move simulated time to ``target``, waking every waiter whose
+        deadline is reached, in deadline order, AT its deadline."""
+        target = float(target)
+        while True:
+            to_wake: List[threading.Event] = []
+            to_notify: List[threading.Condition] = []
+            with self._mu:
+                if target <= self._now:
+                    return
+                stop = target
+                if self._sleepers and self._sleepers[0][0] < stop:
+                    stop = self._sleepers[0][0]
+                if self._cond_timeouts and self._cond_timeouts[0][0] < stop:
+                    stop = self._cond_timeouts[0][0]
+                self._now = max(self._now, stop)
+                while self._sleepers and self._sleepers[0][0] <= self._now:
+                    deadline, _, ev, tid = heapq.heappop(self._sleepers)
+                    self._pins[tid] = deadline
+                    self._acks_due += 1
+                    to_wake.append(ev)
+                while (self._cond_timeouts
+                       and self._cond_timeouts[0][0] <= self._now):
+                    _, _, cond = heapq.heappop(self._cond_timeouts)
+                    to_notify.append(cond)
+            for ev in to_wake:
+                ev.set()
+            for cond in to_notify:
+                # never taken while holding the clock lock (docstring)
+                with cond:
+                    cond.notify_all()
+            if to_wake:
+                # rendezvous: every woken sleeper acks from inside
+                # sleep() before the next hop (bounded, real time)
+                ack_by = time.monotonic() + 5.0
+                with self._mu:
+                    while self._acks_due > 0:
+                        left = ack_by - time.monotonic()
+                        if left <= 0:
+                            break
+                        self._mu.wait(left)
+            if stop >= target:
+                return
+
+
+def as_clock(clock) -> Clock:
+    """Coerce any accepted clock form to a :class:`Clock`:
+
+    - None -> the shared real clock,
+    - a Clock -> itself,
+    - a bare ``() -> float`` callable -> :class:`CallableClock`
+      (legacy test seam: reads virtual, waits real).
+    """
+    if clock is None:
+        return REAL_CLOCK
+    if isinstance(clock, Clock):
+        return clock
+    if callable(clock):
+        return CallableClock(clock)
+    raise TypeError(f"not a clock: {clock!r}")
+
+
+def monotonic_of(clock) -> Callable[[], float]:
+    """The cheap read-only coercion for components that only ever READ
+    time: None -> time.monotonic, Clock -> its bound monotonic, a bare
+    callable -> itself (zero wrapping on the legacy seam)."""
+    if clock is None:
+        return time.monotonic
+    if isinstance(clock, Clock):
+        return clock.monotonic
+    if callable(clock):
+        return clock
+    raise TypeError(f"not a clock: {clock!r}")

@@ -1,0 +1,558 @@
+"""The endurance replay engine.
+
+``EnduranceSim`` runs a seeded day-long trace (sim/traces.py) against
+the REAL stack — the full Operator reconcile loop over the fake cloud,
+plus live tenant solve traffic through a loopback sidecar — under
+composed chaos (sim/chaos.py), with the invariant auditor
+(sim/audit.py) running continuously.
+
+Time is split across two clocks, deliberately:
+
+- The **control plane** runs on a :class:`~.clock.VirtualClock`: the
+  Operator's grace windows, TTL caches, and the ICE blacklist age on
+  the virtual timeline, so a 24h trace of diurnal ramps and reclaim
+  storms replays in minutes of wall time.
+- The **wire** (sidecar server, resilience backoff, coalescer windows)
+  stays on the real clock: solve RPCs are real work on real threads,
+  and their latency is the thing the per-regime SLO audits. Descheduling
+  the wire onto virtual time would deadlock the single driver thread
+  against its own batchers — the clock seam supports it for unit tests
+  (tests/test_sim.py), but the replay measures the wire for real.
+
+Determinism: the trace stream is bytes-identical per seed
+(traces.encode), pod names are counter-reset so identical across
+processes, interruption victims are picked by sorted pool labels (the
+faultcloud pattern), and the terminal cluster fingerprint hashes the
+capacity multiset — never object ids. Chaos storms are finite by
+construction, so the post-chaos settle converges to the fault-free
+terminus.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import queue
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+from . import audit as audit_mod
+from . import chaos as chaos_mod
+from . import traces as traces_mod
+from .clock import VirtualClock
+
+__all__ = ["EnduranceSim", "cluster_fingerprint", "emit_event",
+           "emit_violation", "emit_regime"]
+
+
+# -- metric emitters (test_metrics_parity.py drives these directly) ---------
+
+def emit_event(metrics, event) -> None:
+    if metrics is not None:
+        metrics.inc("karpenter_sim_events_total",
+                    labels={"regime": event.regime, "kind": event.kind})
+
+
+def emit_violation(metrics, violation) -> None:
+    if metrics is not None:
+        metrics.inc("karpenter_sim_violations_total",
+                    labels={"check": violation.check})
+
+
+def emit_regime(metrics, regime: str, active: bool) -> None:
+    if metrics is not None:
+        metrics.set_gauge("karpenter_sim_regime", 1.0 if active else 0.0,
+                          labels={"regime": regime})
+
+
+def cluster_fingerprint(op) -> str:
+    """sha256 over the terminal capacity multiset + pod binding counts
+    (the faultcloud fingerprint, canonically encoded — no ids, no
+    ``hash()``, so it compares across processes)."""
+    capacity = sorted(
+        (i.instance_type, i.zone, i.capacity_type)
+        for i in op.ec2.instances.values() if i.state == "running")
+    pods = op.kube.list("Pod")
+    doc = {"capacity": capacity, "pods": len(pods),
+           "bound": sum(1 for p in pods if p.node_name)}
+    return hashlib.sha256(json.dumps(
+        doc, sort_keys=True, separators=(",", ":")).encode()).hexdigest()
+
+
+class _SolveWorker:
+    """One background thread draining tenant solve jobs against the
+    loopback sidecar — solve traffic runs CONCURRENTLY with the control
+    plane, but the wire itself stays single-threaded so seeded fault
+    draws land in a reproducible order."""
+
+    def __init__(self, solve_fn, oracle_fn):
+        self._solve = solve_fn
+        self._oracle = oracle_fn
+        self._q: "queue.Queue" = queue.Queue()
+        self._mu = threading.Lock()
+        self.latencies: Dict[str, List[float]] = {}
+        self.mismatches: List[str] = []
+        self.errors: List[str] = []
+        self.solves = 0
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="sim-solve-worker")
+        self._thread.start()
+
+    def submit(self, snap, regime: str, tag: str, timed: bool = True):
+        self._q.put((snap, regime, tag, timed))
+
+    def drain(self) -> None:
+        self._q.join()
+
+    def stop(self) -> None:
+        self._q.put(None)
+        self._q.join()
+        self._thread.join(timeout=30)
+
+    def _run(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                self._q.task_done()
+                return
+            snap, regime, tag, timed = item
+            try:
+                t0 = time.perf_counter()
+                fp = self._solve(snap)
+                dt = time.perf_counter() - t0
+                ref = self._oracle(snap)
+                with self._mu:
+                    self.solves += 1
+                    if timed:
+                        self.latencies.setdefault(regime, []).append(dt)
+                    if fp != ref:
+                        self.mismatches.append(tag)
+            except Exception as e:  # a solve must NEVER fail (host twin)
+                with self._mu:
+                    self.errors.append(f"{tag}: {type(e).__name__}: {e}")
+            finally:
+                self._q.task_done()
+
+
+class EnduranceSim:
+    """One replay run. ``run()`` returns the report dict (also written
+    to ``out`` when given — the SIM_r01.json artifact)."""
+
+    def __init__(self, seed: int = 7, duration_s: float = 86400.0,
+                 regimes: Optional[Sequence[str]] = None,
+                 scale: float = 1.0, chaos: bool = True,
+                 chaos_kinds: Optional[Sequence[str]] = None,
+                 wire: Optional[bool] = None,
+                 audit_every: int = 25,
+                 slo_p99_ms: Optional[Dict[str, float]] = None,
+                 out: Optional[str] = None):
+        self.seed = int(seed)
+        self.duration_s = float(duration_s)
+        self.regimes = list(regimes if regimes is not None
+                            else traces_mod.REGIMES)
+        self.scale = float(scale)
+        self.chaos = chaos
+        self.chaos_kinds = chaos_kinds
+        self.wire = wire
+        self.audit_every = max(1, int(audit_every))
+        self.slo_p99_ms = slo_p99_ms
+        self.out = out
+        self.violations: List[audit_mod.Violation] = []
+
+    # -- wire availability ------------------------------------------------
+    @staticmethod
+    def _grpc_available() -> bool:
+        try:
+            import grpc  # noqa: F401
+            return True
+        except Exception:
+            return False
+
+    # -- event application -------------------------------------------------
+    def _apply(self, op, evt) -> None:
+        from ..apis import labels as L
+        from ..apis.objects import TopologySpreadConstraint
+        from ..fake.environment import make_pods
+        from ..providers.sqs import InterruptionMessage
+        p = evt.payload
+        if evt.kind == "create_pods":
+            kw = {}
+            if p.get("spread"):
+                g = p["prefix"]
+                kw = dict(group=g, topology_spread=[
+                    TopologySpreadConstraint(max_skew=1,
+                                             topology_key=L.ZONE,
+                                             group=g)])
+            for pod in make_pods(p["count"], cpu=p["cpu"],
+                                 memory=p["memory"], prefix=p["prefix"],
+                                 **kw):
+                op.kube.create(pod)
+        elif evt.kind == "delete_pods":
+            pods = sorted((x for x in op.kube.list("Pod")
+                           if x.name.startswith(p["match"])),
+                          key=lambda x: x.name)
+            n = int(len(pods) * p["fraction"])
+            for pod in pods[:n]:
+                op.kube.delete("Pod", pod.name,
+                               namespace=pod.metadata.namespace)
+        elif evt.kind == "spot_interrupt":
+            claims = sorted(
+                (c for c in op.kube.list("NodeClaim") if c.provider_id),
+                key=lambda c: (c.metadata.labels.get(L.INSTANCE_TYPE, ""),
+                               c.metadata.labels.get(L.ZONE, ""),
+                               c.metadata.name))
+            for c in claims[:p["count"]]:
+                op.sqs.send(InterruptionMessage(
+                    kind="spot_interruption",
+                    instance_id=c.provider_id.split("/")[-1]))
+        elif evt.kind == "ice_pool":
+            cat = op.ec2.catalog
+            t = cat[p["type_idx"] % len(cat)].name
+            z = op.ec2.zones[p["zone_idx"] % len(op.ec2.zones)].name
+            op.ec2.insufficient_capacity_pools.add(
+                (t, z, p["capacity_type"]))
+        elif evt.kind == "solve":
+            self._apply_solve(evt)
+        else:
+            raise ValueError(f"unknown trace event kind {evt.kind!r}")
+
+    def _apply_solve(self, evt) -> None:
+        """One warm tick for ``evt.payload['tenant']``: swap the churned
+        pod groups, snapshot, hand the solve to the worker."""
+        from ..fake.environment import make_pods
+        tenant = evt.payload["tenant"]
+        st = self._tenant_state.get(tenant)
+        if st is None:
+            pool = self._solve_env.nodepool(f"sim-{tenant}")
+            sigs = [dict(cpu=f"{100 + (i * 7) % 400}m",
+                         memory=f"{256 + (i * 13) % 700}Mi",
+                         group=f"sim{tenant}g{i:03d}") for i in range(10)]
+            cur = []
+            for gi in range(len(sigs)):
+                cur.extend(make_pods(
+                    2, cpu=sigs[gi]["cpu"], memory=sigs[gi]["memory"],
+                    prefix=sigs[gi]["group"], group=sigs[gi]["group"]))
+            st = self._tenant_state[tenant] = {
+                "pool": pool, "sigs": sigs, "cur": cur}
+            # one untimed warmup solve per tenant: jit compilation of a
+            # fresh shape class is a one-off cost, not regime latency
+            snap = self._solve_env.snapshot(list(cur), [pool])
+            self._worker.submit(snap, evt.regime,
+                                f"warmup:{tenant}", timed=False)
+        sigs, cur = st["sigs"], st["cur"]
+        for gi in evt.payload["churn"]:
+            gi = gi % len(sigs)
+            if cur:
+                cur.pop(0)
+            cur.extend(make_pods(
+                1, cpu=sigs[gi]["cpu"], memory=sigs[gi]["memory"],
+                prefix=sigs[gi]["group"], group=sigs[gi]["group"]))
+        snap = self._solve_env.snapshot(list(cur), [st["pool"]])
+        self._worker.submit(snap, evt.regime,
+                            f"solve:{tenant}:{evt.seq}")
+
+    # -- chaos -------------------------------------------------------------
+    def _engage(self, op, w) -> None:
+        if w.kind == "cloud":
+            from ..fake.faultcloud import (CloudFaultInjector,
+                                           CloudFaultPlan)
+            params = dict(w.params)
+            inj = CloudFaultInjector(
+                op.ec2, sqs=op.sqs,
+                plan=CloudFaultPlan(params.pop("seed"), **params))
+            inj.install()
+            self._active[id(w)] = ("cloud", inj)
+        elif w.kind == "wire":
+            if self._remote is None:
+                return
+            from ..fake.faultwire import FaultInjector, FaultPlan
+            params = dict(w.params)
+            inj = FaultInjector(self._remote.client,
+                                FaultPlan(params.pop("seed"), **params))
+            # never re-wrap mid-flight: the worker queue is drained by
+            # the caller before any window boundary
+            inj.install()
+            self._active[id(w)] = ("wire", inj)
+        elif w.kind == "hammer":
+            if self._server is None:
+                return
+            from ..fake.faultwire import TenantHammer
+            h = TenantHammer(self._server.address,
+                             tenant=w.params["tenant"],
+                             seed=w.params["seed"]).start(n_attacks=200)
+            self._active[id(w)] = ("hammer", h)
+        elif w.kind == "arena_wipe":
+            if self._server is not None:
+                self._server._handler._patch_arenas.clear()
+
+    def _disengage(self, key) -> None:
+        kind, obj = self._active.pop(key)
+        if kind in ("cloud", "wire"):
+            obj.uninstall()
+        elif kind == "hammer":
+            obj.stop()
+
+    def _chaos_tick(self, op, now: float, drain) -> None:
+        """Cross every window boundary <= now: engage opens,
+        disengage closes. ``drain`` flushes in-flight wire traffic
+        before the client's channel callables are (un)wrapped."""
+        for w in self._windows:
+            key = id(w)
+            if key in self._done:
+                continue
+            if key not in self._active and w.t0 <= now:
+                drain()
+                self._engage(op, w)
+                if w.t0 == w.t1:  # instantaneous (arena_wipe)
+                    self._active.pop(key, None)
+                    self._done.add(key)
+            elif key in self._active and w.t1 <= now:
+                drain()
+                self._disengage(key)
+                self._done.add(key)
+
+    # -- settling ----------------------------------------------------------
+    @staticmethod
+    def _settle(op, rounds: int = 6) -> bool:
+        """Settle under possible chaos: a reconcile aborted by an
+        escaped fault is retried (manager panic isolation in
+        production). True when the cluster genuinely converged."""
+        from ..providers.awsretry import AWSError
+        for _ in range(rounds):
+            try:
+                steps = op.run_until_settled(max_steps=12)
+            except (AWSError, ConnectionError, OSError):
+                continue
+            if steps < 12 and len(op.sqs) == 0 and all(
+                    p.node_name for p in op.kube.list("Pod")
+                    if p.phase not in ("Succeeded", "Failed")):
+                return True
+            time.sleep(0.05)  # real wait: let wedge/lag windows expire
+        return False
+
+    def _record(self, violations) -> None:
+        for v in violations:
+            self.violations.append(v)
+            emit_violation(self._metrics, v)
+
+    # -- the run -----------------------------------------------------------
+    def run(self) -> dict:
+        from ..apis.objects import (EC2NodeClass, NodeClassRef, NodePool,
+                                    NodePoolTemplate)
+        from ..fake.environment import Environment, reset_pod_counter
+        from ..operator import Operator
+
+        t_wall = time.perf_counter()
+        reset_pod_counter()
+        vclock = VirtualClock()
+        self.vclock = vclock
+        op = Operator(clock=vclock.time)
+        self._metrics = op.metrics
+        # The cloud batchers read VIRTUAL time but wait REAL time (the
+        # CallableClock contract), so their coalescing windows — tuned
+        # to amortize real AWS round trips — would each cost the replay
+        # 100ms of wall for nothing (virtual time is frozen while the
+        # driver blocks in add_sync). Keep the batching semantics, flush
+        # almost immediately.
+        for b in (op.instances.create_fleet, op.instances.describe,
+                  op.instances.terminate_batcher):
+            b.idle_timeout = 0.002
+            b.max_timeout = 0.01
+        op.kube.create(EC2NodeClass("sim-class"))
+        op.kube.create(NodePool("sim", template=NodePoolTemplate(
+            node_class_ref=NodeClassRef("sim-class"))))
+
+        events = traces_mod.generate(self.seed, self.duration_s,
+                                     regimes=self.regimes,
+                                     scale=self.scale)
+        stream_sha = traces_mod.stream_digest(events)
+        self._windows = chaos_mod.schedule(
+            self.seed, self.duration_s,
+            kinds=self.chaos_kinds) if self.chaos else []
+        self._active: dict = {}
+        self._done: set = set()
+
+        # wire: loopback sidecar + one RemoteSolver for tenant traffic
+        use_wire = self._grpc_available() if self.wire is None \
+            else bool(self.wire)
+        self._server = self._remote = self._metrics_wire = None
+        offered = {}
+        if use_wire:
+            offered = self._start_wire()
+        else:
+            from ..solver import CPUSolver
+            local = CPUSolver()
+            self._worker = _SolveWorker(
+                lambda s: local.solve(s).decision_fingerprint(),
+                lambda s: local.solve(s).decision_fingerprint())
+        self._solve_env = Environment()
+        self._tenant_state: dict = {}
+        leaks = audit_mod.LeakMonitor()
+
+        for r in self.regimes:
+            emit_regime(self._metrics, r, True)
+        self._metrics.inc("karpenter_sim_violations_total", 0.0,
+                          labels={"check": "none"})
+
+        kinds_count: Dict[str, int] = {}
+        audits = converged_audits = 0
+        try:
+            for i, evt in enumerate(events):
+                vclock.advance_to(evt.t)
+                self._chaos_tick(op, evt.t, drain=self._worker.drain)
+                self._apply(op, evt)
+                emit_event(self._metrics, evt)
+                kinds_count[evt.kind] = kinds_count.get(evt.kind, 0) + 1
+                try:
+                    op.step()
+                except Exception:
+                    pass  # an escaped injected fault aborts one round
+                if (i + 1) % self.audit_every == 0:
+                    audits += 1
+                    if self._settle(op, rounds=4):
+                        converged_audits += 1
+                        self._record(audit_mod.check_cluster(
+                            op, context=f"t={evt.t:.0f}s"))
+                    self._record(leaks.check(
+                        op, handler=getattr(self._server, "_handler",
+                                            None),
+                        context=f"t={evt.t:.0f}s"))
+
+            # terminus: all chaos off, drain, settle HARD, full audit
+            self._worker.drain()
+            for key in list(self._active):
+                self._disengage(key)
+                self._done.add(key)
+            vclock.advance_to(self.duration_s)
+            if not self._settle(op, rounds=40):
+                self._record([audit_mod.Violation(
+                    "no-convergence",
+                    "cluster failed to settle after chaos end")])
+            else:
+                self._record(audit_mod.check_cluster(op,
+                                                     context="terminus"))
+            self._worker.stop()
+            for tag in self._worker.mismatches:
+                self._record([audit_mod.Violation(
+                    "oracle-divergence",
+                    f"solve diverged from the CPU oracle: {tag}")])
+            for err in self._worker.errors:
+                self._record([audit_mod.Violation("solve-failed", err)])
+            self._record(audit_mod.check_accounting(
+                self._metrics_wire or self._metrics,
+                offered_by_tenant={t: c.count for t, c in offered.items()}
+                if offered else None, context="terminus"))
+            self._record(audit_mod.check_slo(
+                self._worker.latencies, slo_p99_ms=self.slo_p99_ms,
+                context="terminus"))
+            self._record(leaks.check(
+                op, handler=getattr(self._server, "_handler", None),
+                context="terminus"))
+            fingerprint = cluster_fingerprint(op)
+        finally:
+            for key in list(self._active):
+                try:
+                    self._disengage(key)
+                except Exception:
+                    pass
+            if self._remote is not None:
+                self._remote.client.close()
+            if self._server is not None:
+                self._server.stop()
+            for r in self.regimes:
+                emit_regime(self._metrics, r, False)
+
+        report = {
+            "seed": self.seed,
+            "virtual_duration_s": self.duration_s,
+            "wall_s": round(time.perf_counter() - t_wall, 2),
+            "regimes": list(self.regimes),
+            "events_total": len(events),
+            "events_by_kind": dict(sorted(kinds_count.items())),
+            "stream_sha256": stream_sha,
+            "chaos_windows": len(self._windows),
+            "chaos_overlaps": sum(1 for w in self._windows if w.overlaps),
+            "wire": use_wire,
+            "solves": self._worker.solves,
+            "solve_p99_ms": {
+                r: round(sorted(ls)[min(len(ls) - 1,
+                                        int(0.99 * len(ls)))] * 1e3, 1)
+                for r, ls in self._worker.latencies.items() if ls},
+            "audits": audits,
+            "converged_audits": converged_audits,
+            "terminal_fingerprint": fingerprint,
+            "violations": [str(v) for v in self.violations],
+            "clean": not self.violations,
+        }
+        if self.out:
+            with open(self.out, "w") as f:
+                json.dump(report, f, indent=1, sort_keys=True)
+        return report
+
+    # -- wire plumbing -----------------------------------------------------
+    def _start_wire(self) -> dict:
+        """Start the loopback sidecar + the tenant RemoteSolver, and
+        install the per-tenant OFFER counters underneath any fault
+        injector: a call counts as offered exactly when it actually
+        reaches the server (admission enter()s once per such RPC), so
+        admitted + shed == offered holds to the unit."""
+        import random as _random
+
+        from ..sidecar import RemoteSolver, SolverServer
+        from ..sidecar.resilience import (CircuitBreaker, ResiliencePolicy,
+                                          RetryPolicy)
+        from ..solver import CPUSolver
+        from ..tenancy.admission import TenantQuota
+        from ..utils.metrics import Metrics
+
+        # the wire's own metrics registry: tenant admitted/shed and the
+        # wire families accumulate here, audited at terminus
+        self._metrics_wire = Metrics()
+        self._server = SolverServer(
+            metrics=self._metrics_wire,
+            default_quota=TenantQuota(rate=200.0, burst=100,
+                                      max_inflight=16)).start()
+
+        policy = ResiliencePolicy(
+            retry=RetryPolicy(max_attempts=4, backoff_base_s=0.001,
+                              backoff_cap_s=0.01,
+                              rng=_random.Random(self.seed ^ 0x5EED)),
+            breaker=CircuitBreaker(threshold=50, cooldown_s=0.05))
+        self._remote = RemoteSolver(self._server.address, n_max=64,
+                                    backend="jax", policy=policy,
+                                    tenant=traces_mod.TENANTS[0])
+        self._remote._router.alive.mark_ok()
+
+        class _Count:
+            __slots__ = ("count",)
+
+            def __init__(self):
+                self.count = 0
+
+        offered: Dict[str, "_Count"] = {}
+        client = self._remote.client
+        for attr in ("_solve", "_solve_pruned", "_solve_topo",
+                     "_solve_batch", "_solve_subsets", "_solve_patch"):
+            real = getattr(client, attr)
+
+            def shim(request, timeout=None, metadata=None, _real=real):
+                tenant = "default"
+                for k, v in (metadata or ()):
+                    if k == "x-solver-tenant":
+                        tenant = v
+                offered.setdefault(tenant, _Count()).count += 1
+                return _real(request, timeout=timeout, metadata=metadata)
+
+            setattr(client, attr, shim)
+
+        oracle = CPUSolver()
+
+        def solve_remote(snap):
+            return self._remote.solve(snap).decision_fingerprint()
+
+        def solve_oracle(snap):
+            return oracle.solve(snap).decision_fingerprint()
+
+        self._worker = _SolveWorker(solve_remote, solve_oracle)
+        return offered

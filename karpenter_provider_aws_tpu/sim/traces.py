@@ -1,0 +1,220 @@
+"""Seeded day-long trace generators.
+
+A trace is one totally-ordered stream of :class:`TraceEvent` — what the
+cluster is ASKED to do over a (virtual) day — produced by composing
+independent regime generators (docs/simulator.md):
+
+- ``diurnal``      — a sinusoidal arrival ramp: daytime scale-ups,
+                     nighttime scale-downs (the classic web-serving day).
+- ``flash_crowd``  — a handful of sudden large spikes, mostly drained
+                     again after a short hold (launch-event traffic).
+- ``spot_storm``   — clustered spot-reclaim storms: bursts of
+                     interruption messages plus ICE'd pools (the
+                     KubePACS reclaim regime, PAPERS.md).
+- ``batch_waves``  — periodic batch-job waves: a large topology-spread
+                     group lands, runs for a window, then leaves whole.
+- ``tenant_mix``   — multi-tenant solve traffic against the sidecar:
+                     warm churn ticks per tenant (the delta-wire regime)
+                     interleaved across the day.
+
+Determinism is the contract: every generator draws ONLY from its own
+``random.Random(seed ^ salt)``, event payloads are plain JSON values,
+and the merged stream is canonically ordered and canonically encoded —
+``encode(events)`` is bytes-identical for equal seeds across processes
+(PYTHONHASHSEED-independent; pinned by tests/test_sim.py's subprocess
+test). Applying an event is the driver's job (sim/driver.py); payloads
+therefore carry *instructions* (counts, fractions, indices), never
+object references.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import random
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["TraceEvent", "REGIMES", "generate", "encode",
+           "stream_digest"]
+
+#: regime name -> generator salt (xor'd into the seed so regimes draw
+#: from independent, stable streams — adding a regime never perturbs
+#: the others' schedules)
+_SALTS = {
+    "diurnal": 0x1D1B,
+    "flash_crowd": 0xF1A5,
+    "spot_storm": 0x5707,
+    "batch_waves": 0xBA7C,
+    "tenant_mix": 0x7E4A,
+}
+
+REGIMES: Tuple[str, ...] = tuple(_SALTS)
+
+#: solve tenants the tenant_mix regime cycles through
+TENANTS = ("team-a", "team-b", "team-c")
+
+
+@dataclass(frozen=True)
+class TraceEvent:
+    """One instruction on the trace timeline.
+
+    ``t`` is virtual seconds from trace start; ``seq`` the global order
+    tiebreaker assigned at merge; ``kind`` one of ``create_pods`` /
+    ``delete_pods`` / ``spot_interrupt`` / ``ice_pool`` / ``solve``."""
+
+    t: float
+    seq: int
+    regime: str
+    kind: str
+    payload: Dict = field(default_factory=dict)
+
+    def encode(self) -> bytes:
+        return json.dumps(
+            {"t": round(self.t, 3), "seq": self.seq,
+             "regime": self.regime, "kind": self.kind,
+             "payload": self.payload},
+            sort_keys=True, separators=(",", ":")).encode()
+
+
+def _rng(seed: int, regime: str) -> random.Random:
+    return random.Random((seed & 0xFFFFFFFF) ^ _SALTS[regime])
+
+
+# -- regime generators ------------------------------------------------------
+# Each returns [(t, kind, payload)] drawn only from its own rng.
+
+def _diurnal(rng: random.Random, duration_s: float, scale: float):
+    out = []
+    step = 600.0
+    t = step
+    while t < duration_s:
+        # arrival intensity over the day: trough at t=0, peak mid-day
+        phase = (t % 86400.0) / 86400.0
+        intensity = 0.5 * (1.0 - math.cos(2 * math.pi * phase))
+        n = int(round((2 + 10 * intensity) * scale))
+        if intensity >= 0.25 or not out:
+            out.append((t, "create_pods", {
+                "count": max(1, n), "cpu": rng.choice(["250m", "500m", "1"]),
+                "memory": "1Gi", "prefix": f"diurnal{int(t):07d}"}))
+        else:
+            out.append((t, "delete_pods", {
+                "fraction": round(rng.uniform(0.2, 0.5), 2),
+                "match": "diurnal"}))
+        t += step
+    return out
+
+
+def _flash_crowd(rng: random.Random, duration_s: float, scale: float):
+    out = []
+    crowds = max(1, int(duration_s // 21600))  # ~one per 6h
+    for c in range(crowds):
+        t = rng.uniform(0.1, 0.9) * duration_s
+        n = int(round(rng.randint(20, 40) * scale))
+        hold = rng.uniform(600.0, 1800.0)
+        out.append((t, "create_pods", {
+            "count": max(2, n), "cpu": "500m", "memory": "1Gi",
+            "prefix": f"flash{c:02d}", "spread": True}))
+        if t + hold < duration_s:
+            out.append((t + hold, "delete_pods", {
+                "fraction": 0.9, "match": f"flash{c:02d}"}))
+    return out
+
+
+def _spot_storm(rng: random.Random, duration_s: float, scale: float):
+    out = []
+    storms = max(1, int(duration_s // 28800))  # ~one per 8h
+    for s in range(storms):
+        t0 = rng.uniform(0.15, 0.85) * duration_s
+        # the storm opens with an ICE'd pool (capacity really is gone),
+        # then reclaims land in a tight burst
+        out.append((t0, "ice_pool", {
+            "type_idx": rng.randrange(64), "zone_idx": rng.randrange(8),
+            "capacity_type": "spot"}))
+        for k in range(rng.randint(2, 4)):
+            out.append((t0 + 30.0 * (k + 1), "spot_interrupt", {
+                "count": max(1, int(round(rng.randint(1, 2) * scale)))}))
+    return out
+
+
+def _batch_waves(rng: random.Random, duration_s: float, scale: float):
+    out = []
+    period = 7200.0
+    w = 0
+    t = period * rng.uniform(0.5, 1.0)
+    while t < duration_s:
+        n = int(round(rng.randint(8, 16) * scale))
+        dur = rng.uniform(1800.0, 3600.0)
+        out.append((t, "create_pods", {
+            "count": max(2, n), "cpu": "1", "memory": "2Gi",
+            "prefix": f"wave{w:03d}", "spread": True}))
+        if t + dur < duration_s:
+            out.append((t + dur, "delete_pods", {
+                "fraction": 1.0, "match": f"wave{w:03d}"}))
+        w += 1
+        t += period
+    return out
+
+
+def _tenant_mix(rng: random.Random, duration_s: float, scale: float):
+    out = []
+    step = 300.0
+    t = step * 0.5
+    i = 0
+    while t < duration_s:
+        tenant = TENANTS[i % len(TENANTS)]
+        out.append((t, "solve", {
+            "tenant": tenant,
+            # churn instruction for the per-tenant warm-tick state:
+            # which pod-group signatures to swap this tick
+            "churn": [rng.randrange(10) for _ in range(2)]}))
+        i += 1
+        t += step
+    return out
+
+
+_GENERATORS = {
+    "diurnal": _diurnal,
+    "flash_crowd": _flash_crowd,
+    "spot_storm": _spot_storm,
+    "batch_waves": _batch_waves,
+    "tenant_mix": _tenant_mix,
+}
+assert set(_GENERATORS) == set(_SALTS)
+
+
+# -- composition ------------------------------------------------------------
+
+def generate(seed: int, duration_s: float,
+             regimes: Optional[Sequence[str]] = None,
+             scale: float = 1.0) -> List[TraceEvent]:
+    """The composed trace: every regime's events merged into one
+    totally-ordered stream. Ordering is canonical — (t, regime, kind,
+    payload-json) — so ``seq`` is a pure function of the seed and the
+    stream is reproducible across processes."""
+    regimes = list(regimes if regimes is not None else REGIMES)
+    unknown = set(regimes) - set(_GENERATORS)
+    if unknown:
+        raise ValueError(f"unknown regimes: {sorted(unknown)}")
+    raw = []
+    for name in sorted(regimes):
+        for (t, kind, payload) in _GENERATORS[name](
+                _rng(seed, name), float(duration_s), scale):
+            raw.append((round(float(t), 3), name, kind, payload))
+    raw.sort(key=lambda e: (e[0], e[1], e[2],
+                            json.dumps(e[3], sort_keys=True)))
+    return [TraceEvent(t=t, seq=i, regime=r, kind=k, payload=p)
+            for i, (t, r, k, p) in enumerate(raw)]
+
+
+def encode(events: Sequence[TraceEvent]) -> bytes:
+    """Canonical byte encoding of the stream — the determinism
+    fingerprintable artifact (one JSON object per line)."""
+    return b"\n".join(e.encode() for e in events) + b"\n"
+
+
+def stream_digest(events: Sequence[TraceEvent]) -> str:
+    """sha256 of the canonical encoding (never ``hash()`` — that is
+    PYTHONHASHSEED-dependent and would break the subprocess test)."""
+    import hashlib
+    return hashlib.sha256(encode(events)).hexdigest()

@@ -30,6 +30,8 @@ from typing import Callable, Optional
 
 import numpy as np
 
+from ..sim.clock import monotonic_of
+
 #: tenant label used when a client sends no x-solver-tenant metadata —
 #: anonymous callers share one bucket, so a fleet of label-less clients
 #: is ONE tenant to the fairness and quota machinery
@@ -70,13 +72,12 @@ class TokenBucket:
     by hand). ``take`` returns (admitted, retry_after_s) — the hint is
     how long until one token refills, 0.0 when admitted."""
 
-    def __init__(self, rate: float, burst: int,
-                 clock: Callable[[], float] = time.monotonic):
+    def __init__(self, rate: float, burst: int, clock=None):
         self.rate = float(rate)
         self.burst = float(burst)
-        self._clock = clock
+        self._clock = monotonic_of(clock)
         self._tokens = float(burst)
-        self._last = clock()
+        self._last = self._clock()
 
     def take(self, n: float = 1.0):
         now = self._clock()
@@ -100,14 +101,13 @@ class AdmissionController:
 
     def __init__(self, quotas: Optional[dict] = None,
                  default_quota: Optional[TenantQuota] = None,
-                 metrics=None,
-                 clock: Callable[[], float] = time.monotonic):
+                 metrics=None, clock=None):
         self._quotas = dict(quotas or {})
         self._default = default_quota
         self._buckets: dict = {}
         self._inflight: dict = collections.defaultdict(int)
         self._mu = threading.Lock()
-        self._clock = clock
+        self._clock = monotonic_of(clock)
         self.metrics = metrics
 
     def _quota(self, tenant: str) -> Optional[TenantQuota]:
@@ -176,12 +176,11 @@ class ShapeClassTable:
     """
 
     def __init__(self, capacity: int = 64, min_idle_s: float = 30.0,
-                 metrics=None,
-                 clock: Callable[[], float] = time.monotonic):
+                 metrics=None, clock=None):
         self.capacity = capacity
         self.min_idle_s = min_idle_s
         self.metrics = metrics
-        self._clock = clock
+        self._clock = monotonic_of(clock)
         self._mu = threading.Lock()
         #: key -> [tenant, last_use]; insertion order is maintained by
         #: re-inserting on touch, so iteration order IS the LRU order
@@ -242,13 +241,12 @@ class PatchArenaTable:
     """
 
     def __init__(self, capacity: int = 32, min_idle_s: float = 5.0,
-                 ttl_s: float = 600.0, metrics=None,
-                 clock: Callable[[], float] = time.monotonic):
+                 ttl_s: float = 600.0, metrics=None, clock=None):
         self.capacity = capacity
         self.min_idle_s = min_idle_s
         self.ttl_s = ttl_s
         self.metrics = metrics
-        self._clock = clock
+        self._clock = monotonic_of(clock)
         self._mu = threading.Lock()
         #: key -> [tenant, last_use, buf, version]; iteration order is
         #: the LRU order (re-inserted on touch, like ShapeClassTable)
@@ -327,6 +325,15 @@ class PatchArenaTable:
             ent[3] = int(new_version)
             self._entries.move_to_end(key)
             return np.array(buf, copy=True), None
+
+    def clear(self) -> None:
+        """Drop every resident arena (chaos: a server restart /
+        compile-cache wipe mid-stream). Each tenant's next patch gets
+        FAILED_PRECONDITION and degrades to one full Solve — the
+        documented ``no_resident`` path, now forced at will."""
+        with self._mu:
+            for k in list(self._entries):
+                self._drop_locked(k, "wipe")
 
     def version_of(self, key):
         with self._mu:

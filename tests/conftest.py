@@ -77,3 +77,24 @@ def pytest_configure(config):
         "markers",
         "slow: long-running chaos/seed-sweep tests excluded from tier-1 "
         "(`pytest -m 'not slow'`); hack/chaoswire.sh runs them")
+    config.addinivalue_line(
+        "markers",
+        "sim: endurance-simulator replays (tests/test_sim.py). The "
+        "10-virtual-minute smoke rides tier-1; the day-long replay is "
+        "additionally marked slow (`make sim` / the nightly soak run "
+        "it via hack/sim.sh)")
+
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def fresh_pod_counter():
+    """Deterministic pod names for fingerprint-identity tests: restart
+    the module-global fixture counter before the test (and after, so a
+    test that follows in the same process isn't offset by this one)."""
+    from karpenter_provider_aws_tpu.fake.environment import \
+        reset_pod_counter
+    reset_pod_counter()
+    yield
+    reset_pod_counter()

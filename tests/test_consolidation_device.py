@@ -80,7 +80,7 @@ def _mk_operator(evaluator):
     # device run mint identical pod / NodeClaim names — the fingerprints
     # are byte-level, so name skew would read as (fake) divergence
     from karpenter_provider_aws_tpu.fake import ec2 as fec2
-    fenv._pod_counter = itertools.count()
+    fenv.reset_pod_counter()
     prov._claim_seq = itertools.count(1)
     fec2._id_counter = itertools.count(1)
     clock = FakeClock()

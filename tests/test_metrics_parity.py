@@ -630,6 +630,18 @@ def emitted(tmp_path_factory):
     finally:
         deactivate_aot()
 
+    # endurance-simulator families: drive the REAL emitters from
+    # sim/driver.py (the ones EnduranceSim.run calls) with synthetic
+    # data — emission parity without replaying a trace here
+    from karpenter_provider_aws_tpu.sim import audit as _sim_audit
+    from karpenter_provider_aws_tpu.sim import driver as _sim_driver
+    from karpenter_provider_aws_tpu.sim import traces as _sim_traces
+    _sim_evt = _sim_traces.generate(3, 1800.0, regimes=["diurnal"])[0]
+    _sim_driver.emit_event(op.metrics, _sim_evt)
+    _sim_driver.emit_violation(op.metrics, _sim_audit.Violation(
+        "parity", "synthetic"))
+    _sim_driver.emit_regime(op.metrics, "diurnal", True)
+
     # catalog membership + offering gauges at the current blacklist
     op.catalog_controller.refresh_gauges()
 

@@ -15,7 +15,6 @@ counters move in lockstep, and a toolchain-absent install degrades
 with identical fingerprints — loudly, via the fallback family.
 """
 
-import itertools
 import random
 
 import numpy as np
@@ -318,7 +317,7 @@ class TestChurnFingerprintParity:
             try:
                 # identical pod names across arms: the fixture counter
                 # is module-global and fingerprints carry names
-                fake_env._pod_counter = itertools.count()
+                fake_env.reset_pod_counter()
                 rng = random.Random(seed)
                 sim = tde._Sim(rng)
                 solver = TPUSolver(backend="numpy")
@@ -353,7 +352,7 @@ class TestChurnFingerprintParity:
         from karpenter_provider_aws_tpu.solver.tpu import TPUSolver
 
         def run():
-            fake_env._pod_counter = itertools.count()
+            fake_env.reset_pod_counter()
             rng = random.Random(23)
             sim = tde._Sim(rng)
             solver = TPUSolver(backend="numpy")
