@@ -55,6 +55,9 @@ fuzz-delta:  ## 10-seed mutation-sequence fuzz of the incremental encoder
 fuzz-consolidate:  ## seeded device-vs-oracle consolidation parity sweep
 	sh hack/fuzzconsolidate.sh
 
+fuzz-preempt:  ## seeded device-vs-oracle preemption parity sweep
+	sh hack/fuzzpreempt.sh
+
 sim:  ## endurance replay: 24 virtual hours + chaos in <=10 min wall
 	sh hack/sim.sh
 
@@ -71,6 +74,7 @@ benchmark: native-try  ## the five BASELINE configs + interruption + batch dispa
 	python bench.py --multihost --rounds 5
 	python bench.py --fleet
 	python bench.py --consolidate-solve --consolidate-nodes 240 --rounds 5
+	python bench.py --preempt-solve --rounds 5
 
 consolidate-evidence:  ## full 1000-node fleet: 2000 lanes, ONE dispatch/round
 	# a 1000-node round is a single stacked subset dispatch regardless of
@@ -87,4 +91,4 @@ multihost:  ## multi-PROCESS distributed mesh: 1M-pod ceiling + chaos + suite
 daemon:  ## run the operator against the in-memory cloud
 	python -m karpenter_provider_aws_tpu --cluster-name dev --metrics-port 8080
 
-.PHONY: test test-all scale deflake benchmark consolidate-evidence multichip multihost daemon chart chaos chaoscloud chaos-tenant chaos-patch chaos-fleet chaos-heal fuzz-delta fuzz-consolidate native native-try aot-prime sim
+.PHONY: test test-all scale deflake benchmark consolidate-evidence multichip multihost daemon chart chaos chaoscloud chaos-tenant chaos-patch chaos-fleet chaos-heal fuzz-delta fuzz-consolidate fuzz-preempt native native-try aot-prime sim

@@ -1729,6 +1729,184 @@ def run_consolidate_solve(backend, rounds, n_nodes=1000):
     }
 
 
+def _ps_build_cluster(n_nodes=10, per_node=6, n_high=8):
+    """Priority-flood cluster: ``n_nodes`` m5.xlarge-class nodes packed
+    with low-priority filler, NodePool limits frozen at current usage so
+    new capacity is structurally impossible, then a high-priority wave
+    that can only land by evicting filler."""
+    from karpenter_provider_aws_tpu.apis import labels as L
+    from karpenter_provider_aws_tpu.apis.objects import (EC2NodeClass,
+                                                         NodeClassRef,
+                                                         NodePool,
+                                                         NodePoolTemplate,
+                                                         PriorityClass)
+    from karpenter_provider_aws_tpu.apis.requirements import Requirements
+    from karpenter_provider_aws_tpu.apis.resources import Resources
+    from karpenter_provider_aws_tpu.fake.environment import make_pods
+    from karpenter_provider_aws_tpu.operator import Operator
+
+    op = Operator()
+    nc = EC2NodeClass("bench-class")
+    op.kube.create(nc)
+    pool = NodePool("bench-pool", template=NodePoolTemplate(
+        node_class_ref=NodeClassRef(nc.metadata.name),
+        requirements=Requirements.from_terms([
+            {"key": L.INSTANCE_TYPE, "operator": "In",
+             "values": ["m5.xlarge"]}])))
+    op.kube.create(pool)
+    low = make_pods(n_nodes * per_node, cpu="500m", prefix="low")
+    for p in low:
+        op.kube.create(p)
+    op.run_until_settled()
+    # freeze the pool at current usage: zero headroom for new nodes
+    used = Resources()
+    for c in op.kube.list("NodeClaim"):
+        used = used + (c.capacity if not c.capacity.is_zero()
+                       else c.resources_requested)
+    pool.limits = used
+    op.kube.update(pool)
+    op.kube.create(PriorityClass("bench-high", value=1000))
+    high = make_pods(n_high, cpu="1", prefix="hi")
+    for p in high:
+        p.priority_class_name = "bench-high"
+        op.kube.create(p)
+    prov = op.provisioner
+    pods = op.state.pending_pods()
+    snapshot = prov.build_snapshot(pods)
+    solved = prov.solver.solve(snapshot)
+    unschedulable = list(solved.unschedulable)
+    return op, snapshot, unschedulable
+
+
+def _ps_victim_order(snapshot, unschedulable, state):
+    """The planner's deterministic eligibility walk, re-derived so the
+    sequential oracle searches the SAME prefix order — the arms must
+    differ only in how a prefix's feasibility is decided."""
+    from karpenter_provider_aws_tpu.apis.objects import is_critical
+    from karpenter_provider_aws_tpu.controllers.pdb import (pdb_state,
+                                                            take_allowance)
+    from karpenter_provider_aws_tpu.scheduling.preempt import (
+        MAX_LANES, victim_sort_key)
+
+    blocked = set(unschedulable)
+    demand = sorted(
+        (p for p in snapshot.pods
+         if p.full_name() in blocked and getattr(p, "priority", 0) > 0
+         and getattr(p, "preemption_policy", "") != "Never"
+         and not (p.topology_spread or p.pod_affinity)),
+        key=lambda p: p.full_name())
+    floor = min(getattr(p, "priority", 0) for p in demand)
+    npos = {n.name for n in snapshot.existing_nodes}
+    candidates = []
+    for node_name, pods in state.bound_pods_by_node().items():
+        if node_name not in npos:
+            continue
+        for pod in pods:
+            if not pod.node_name or pod.owner_kind == "DaemonSet" \
+                    or is_critical(pod):
+                continue
+            if getattr(pod, "priority", 0) >= floor:
+                continue
+            candidates.append(pod)
+    candidates.sort(key=victim_sort_key)
+    pdbs = pdb_state(state.kube)
+    victims = [p for p in candidates if take_allowance(pdbs, p)]
+    return demand, victims[:MAX_LANES]
+
+
+def _ps_decide_sequential(cpu, snapshot, demand, victims):
+    """The host oracle: walk prefixes one at a time, each feasibility
+    decided by an authoritative full solve of the demand against
+    existing nodes with the prefix's usage refunded — feasible iff every
+    demand pod lands on existing capacity with zero new nodes."""
+    from karpenter_provider_aws_tpu.apis.resources import Resources
+    from karpenter_provider_aws_tpu.solver.types import (ExistingNode,
+                                                         SchedulingSnapshot)
+
+    freed_by_node = {}
+    solves = 0
+    for b, victim in enumerate(victims):
+        freed_by_node[victim.node_name] = (
+            freed_by_node.get(victim.node_name, Resources())
+            + victim.effective_requests())
+        nodes = [ExistingNode(
+            name=n.name, labels=n.labels, allocatable=n.allocatable,
+            taints=n.taints,
+            used=(n.used - freed_by_node.get(n.name, Resources()))
+            .clamp_nonnegative(),
+            pod_groups=n.pod_groups, nodepool=n.nodepool,
+            instance_type=n.instance_type)
+            for n in snapshot.existing_nodes]
+        sn = SchedulingSnapshot(
+            pods=list(demand), nodepools=snapshot.nodepools,
+            existing_nodes=nodes,
+            daemon_overheads=snapshot.daemon_overheads,
+            zones=snapshot.zones,
+            priority_classes=snapshot.priority_classes)
+        res = cpu.solve(sn)
+        solves += 1
+        if not res.unschedulable and not res.new_nodes:
+            return tuple(v.full_name()
+                         for v in victims[:b + 1]), solves
+    return (), solves
+
+
+def run_preempt_solve(backend, rounds, n_nodes=10, per_node=6):
+    """The in-solve preemption search as one dense lane batch: every
+    candidate victim prefix of a priority-flooded cluster evaluated in a
+    single device dispatch, vs the sequential host oracle's one-full-
+    solve-per-prefix walk. identical_decisions compares the chosen
+    victim prefix (names, in eviction order) across arms."""
+    from karpenter_provider_aws_tpu.scheduling import PreemptionPlanner
+    from karpenter_provider_aws_tpu.solver import CPUSolver
+    from karpenter_provider_aws_tpu.solver.tpu import TPUSolver
+
+    op, snapshot, unschedulable = _ps_build_cluster(
+        n_nodes=n_nodes, per_node=per_node)
+    demand, victims = _ps_victim_order(snapshot, unschedulable, op.state)
+    solver = TPUSolver(backend="jax" if backend == "auto" else backend)
+    planner = PreemptionPlanner(solver=solver)
+    cpu = CPUSolver()
+
+    if backend != "numpy":
+        from karpenter_provider_aws_tpu.solver import route
+        route.device_alive()
+    cooldown(2.0)
+    baseline = calib_baseline()
+    t0 = time.perf_counter()
+    ref, oracle_solves = _ps_decide_sequential(cpu, snapshot, demand,
+                                               victims)
+    cpu_ms = (time.perf_counter() - t0) * 1000
+    verdict = planner.plan(snapshot, unschedulable, op.state)  # warm jit
+    got = tuple(p.full_name() for p in verdict.victims)
+    identical = got == ref
+    if backend != "numpy":
+        planner.plan(snapshot, unschedulable, op.state)
+        planner.plan(snapshot, unschedulable, op.state)
+    gc.collect()
+    gc.freeze()
+    cooldown(min(20.0, max(2.0, cpu_ms / 1000.0)))
+    times, hot_rejected = guarded_rounds(
+        lambda: planner.plan(snapshot, unschedulable, op.state),
+        rounds, baseline)
+    p50, p99 = _percentiles(times)
+    return {
+        "config": "preempt-solve", "p50_ms": p50, "p99_ms": p99,
+        "cpu_oracle_ms": round(cpu_ms, 1),
+        "cpu_oracle_solves": oracle_solves,
+        "speedup": round(cpu_ms / p99, 2) if p99 else 0.0,
+        "identical_decisions": identical,
+        "n_nodes": n_nodes, "lanes": verdict.lanes,
+        "victims": len(ref), "demand": len(demand),
+        "verdict_backend": verdict.backend,
+        "rounds": rounds,
+        "hot_rejected": hot_rejected,
+        "calib_baseline_ms": round(baseline, 3),
+        "engine": _engine_report({"host": -1, "dev": -1}, solver),
+        "phases": _phase_report(solver),
+    }
+
+
 def run_config4(backend, rounds, n_nodes=200):
     from karpenter_provider_aws_tpu.controllers.disruption import \
         ReplacementQuery
@@ -2429,6 +2607,12 @@ def main():
                          "host oracle, with decision identity")
     ap.add_argument("--consolidate-nodes", type=int, default=1000,
                     help="fleet size for --consolidate-solve")
+    ap.add_argument("--preempt-solve", action="store_true",
+                    help="in-solve preemption search: every victim "
+                         "prefix of a priority-flooded cluster in ONE "
+                         "stacked lane dispatch vs the sequential "
+                         "one-solve-per-prefix host oracle, with "
+                         "chosen-victim-prefix identity")
     ap.add_argument("--sidecar-batch", action="store_true",
                     help="bench the multi-arena wire: B Solve round "
                          "trips vs one SolveBatch RPC on a loopback "
@@ -2503,6 +2687,10 @@ def main():
         print(json.dumps(run_consolidate_solve(
             backend, rounds=min(args.rounds, 20),
             n_nodes=args.consolidate_nodes)))
+        return
+    if args.preempt_solve:
+        print(json.dumps(run_preempt_solve(
+            args.backend, rounds=min(args.rounds, 20))))
         return
     if args.sidecar_batch:
         print(json.dumps(run_sidecar_batch_bench(

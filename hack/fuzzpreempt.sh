@@ -1,0 +1,27 @@
+#!/bin/sh
+# Seeded device-vs-oracle preemption parity sweep.
+#
+# Runs the `slow`-marked 10-seed matrix of tests/test_preempt.py: each
+# seed builds a random mixed-priority cluster (random pools, filler
+# waves across priority tiers, PDB-covered pods with random budgets,
+# preemptionPolicy=Never pods, equal-priority ties by construction),
+# settles it, freezes NodePool limits at current usage so new nodes are
+# impossible, floods a high-priority wave, then runs the provisioning
+# rounds twice — once with the preemption planner on its numpy oracle
+# twin, once on the device lane kernel — and asserts the decision
+# traces are BYTE-identical: same verdict, same victim prefix in the
+# same order, same applied PreemptCommand, same nominations and
+# terminal pod bindings. Zero divergence tolerated.
+#
+# Tier-1 stays fast: it runs the same parity property on 3 seeds plus
+# targeted gate cases (PDB-exhausted, Never-policy demand, critical
+# never-victims, deterministic ties); this sweep is the wide version.
+#
+# Usage: sh hack/fuzzpreempt.sh        # the full 10-seed sweep
+#        sh hack/fuzzpreempt.sh -x -q  # extra pytest args pass through
+set -e
+cd "$(dirname "$0")/.."
+
+JAX_PLATFORMS=cpu exec python -m pytest \
+    "tests/test_preempt.py::TestFuzzSweep" \
+    -m slow -q -p no:cacheprovider "$@"

@@ -60,6 +60,7 @@ def main():
     from karpenter_provider_aws_tpu.providers.sqs import \
         InterruptionMessage
 
+    from karpenter_provider_aws_tpu.apis.objects import PriorityClass
     from karpenter_provider_aws_tpu.sim.audit import LeakMonitor
     rng = random.Random(args.seed)
     op = Operator()
@@ -67,6 +68,11 @@ def main():
     op.kube.create(EC2NodeClass("soak-class"))
     op.kube.create(NodePool("default", template=NodePoolTemplate(
         node_class_ref=NodeClassRef("soak-class"))))
+    # the priority axis rides the soak: batch-tier floods + critical
+    # chasers keep the resolution path and the preemption planner warm
+    op.kube.create(PriorityClass("soak-batch", value=10))
+    op.kube.create(PriorityClass("system-cluster-critical",
+                                 value=2_000_000_000))
 
     deadline = time.monotonic() + args.minutes * 60
     it = 0
@@ -94,6 +100,11 @@ def main():
                     PodAffinityTerm(topology_key=L.ZONE,
                                     group=f"soak{it:04d}", anti=True,
                                     required=False)])
+            priority_class = None
+            critical_chaser = False
+            if 0.45 <= shape < 0.58:  # priority surge (preempt paths)
+                priority_class = "soak-batch"
+                critical_chaser = True
             ephemeral = None
             if 0.33 <= shape < 0.45:  # volume churn (storage paths)
                 from karpenter_provider_aws_tpu.apis.objects import \
@@ -105,7 +116,15 @@ def main():
                                prefix=f"soak{it:04d}", **kw):
                 if ephemeral:
                     p.ephemeral_volumes = list(ephemeral)
+                if priority_class:
+                    p.priority_class_name = priority_class
                 op.kube.create(p)
+            if critical_chaser:
+                for p in make_pods(rng.randint(1, 3), cpu="1",
+                                   memory="2Gi",
+                                   prefix=f"soakcrit{it:04d}"):
+                    p.priority_class_name = "system-cluster-critical"
+                    op.kube.create(p)
         elif action < 0.75:  # scale down
             pods = op.kube.list("Pod")
             for p in rng.sample(pods, min(len(pods), rng.randint(5, 40))):
@@ -154,6 +173,10 @@ def main():
             1 for i in op.ec2.instances.values() if i.state == "running"),
         "nodeclaims": len(op.kube.list("NodeClaim")),
         "launch_templates": len(op.ec2.launch_templates),
+        "preempt_verdicts": {
+            dict(labels).get("verdict", ""): int(val)
+            for (name, labels), val in op.metrics.counters.items()
+            if name == "karpenter_solver_preempt_verdicts_total"},
         "clean": True,
     }
     print(f"soak clean: {it} iterations, "

@@ -229,6 +229,15 @@ class Pod(KubeObject):
         #: a heavy init step sizes the node even if steady state is
         #: small (the reference's InitContainers right-sizing E2E)
         self.init_requests = init_requests
+        #: resolved scheduling priority (spec.priority). Filled by
+        #: resolve_pod_priorities from the cluster's PriorityClass
+        #: objects; stays 0 when no PriorityClass exists so priority-
+        #: free clusters keep byte-identical signatures and solver
+        #: fingerprints (the feature is invisible until opted into).
+        self.priority = 0
+        #: resolved preemptionPolicy ("" = PreemptLowerPriority default;
+        #: "Never" pods never trigger eviction of others)
+        self.preemption_policy = ""
 
     def apply_volume_constraints(self, reqs: "Requirements",
                                  n_volumes: int) -> None:
@@ -284,6 +293,72 @@ class Pod(KubeObject):
     def is_pending_unscheduled(self) -> bool:
         return self.phase == "Pending" and not self.node_name \
             and self.metadata.deletion_timestamp is None
+
+
+# ---------------------------------------------------------------------------
+# PriorityClass
+# ---------------------------------------------------------------------------
+
+#: the two built-in system classes; their pods drain LAST (lifecycle
+#: drain ordering) and are never preemption victims. THE membership
+#: list — lifecycle re-exports it so both consumers share one tuple.
+CRITICAL_PRIORITY_CLASSES = ("system-cluster-critical",
+                             "system-node-critical")
+
+
+def is_critical(pod: "Pod") -> bool:
+    """Shared critical-pod gate: lifecycle drain ordering and the
+    preemption never-victim filter both route through here so the two
+    paths cannot drift (satellite contract)."""
+    return pod.priority_class_name in CRITICAL_PRIORITY_CLASSES
+
+
+class PriorityClass(KubeObject):
+    """scheduling.k8s.io/v1 PriorityClass: a named integer priority.
+
+    Only explicitly-created objects participate — there are no implicit
+    built-in values, so a cluster with zero PriorityClass objects
+    resolves every pod to priority 0 and the whole priority axis stays
+    wire-invisible (Q=0, identical fingerprints)."""
+    kind = "PriorityClass"
+
+    def __init__(self, name: str, value: int,
+                 global_default: bool = False,
+                 preemption_policy: str = "PreemptLowerPriority"):
+        self.metadata = ObjectMeta(name=name, namespace="")
+        self.value = int(value)
+        self.global_default = bool(global_default)
+        #: "PreemptLowerPriority" (default) or "Never"
+        self.preemption_policy = preemption_policy
+
+
+def resolve_pod_priorities(pods: Sequence["Pod"],
+                           priority_classes: Sequence[PriorityClass]) \
+        -> None:
+    """Resolve each pod's spec.priority from the PriorityClass table
+    (admission-controller semantics: named class wins, else the
+    globalDefault class, else 0). Mutates pod.priority /
+    pod.preemption_policy in place and invalidates scheduling memos on
+    change — priority is part of the group signature once nonzero.
+
+    With an empty table this is a no-op for already-zero pods (the
+    common path), keeping priority-free clusters cache-warm and
+    fingerprint-identical."""
+    by_name = {pc.metadata.name: pc for pc in priority_classes}
+    default = None
+    for pc in priority_classes:
+        if pc.global_default and (default is None
+                                  or pc.value > default.value):
+            default = pc
+    for pod in pods:
+        pc = by_name.get(pod.priority_class_name) or default
+        prio = pc.value if pc is not None else 0
+        policy = "" if pc is None \
+            else ("Never" if pc.preemption_policy == "Never" else "")
+        if pod.priority != prio or pod.preemption_policy != policy:
+            pod.priority = prio
+            pod.preemption_policy = policy
+            invalidate_scheduling_caches(pod)
 
 
 # ---------------------------------------------------------------------------

@@ -15,7 +15,8 @@ import time
 from typing import Optional
 
 from ..apis import labels as L
-from ..apis.objects import NodeClaim
+from ..apis.objects import (CRITICAL_PRIORITY_CLASSES,  # noqa: F401 re-export
+                            NodeClaim, is_critical)
 from ..cloudprovider.provider import CloudProvider, parse_instance_id
 from ..cloudprovider.types import (CloudProviderError,
                                    InsufficientCapacityError,
@@ -206,18 +207,16 @@ class NodeClaimLifecycle:
             self.state.clear_nominations_to(claim.name)
 
 
-#: drain order of a doomed node's pods (termination_test.go:56-61):
-#: non-critical non-daemonset → non-critical daemonset → critical
-#: non-daemonset → critical daemonset; a group must be fully gone before
-#: the next one is evicted
-CRITICAL_PRIORITY_CLASSES = ("system-cluster-critical",
-                             "system-node-critical")
-
-
+# drain order of a doomed node's pods (termination_test.go:56-61):
+# non-critical non-daemonset → non-critical daemonset → critical
+# non-daemonset → critical daemonset; a group must be fully gone before
+# the next one is evicted. Criticality is the SAME predicate the
+# preemption planner's never-victim gate uses (apis/objects.py
+# is_critical); CRITICAL_PRIORITY_CLASSES stays re-exported from this
+# module for older imports.
 def _drain_group(pod) -> int:
-    critical = pod.priority_class_name in CRITICAL_PRIORITY_CLASSES
     daemon = pod.owner_kind == "DaemonSet"
-    return (2 if critical else 0) + (1 if daemon else 0)
+    return (2 if is_critical(pod) else 0) + (1 if daemon else 0)
 
 
 class NodeRepairController:

@@ -81,6 +81,7 @@ class SnapshotDelta:
     pools_dirty: bool = False      # pool limit/in-use vectors moved
     ex_rows_dirty: bool = False    # ex_alloc/ex_used moved (or E changed)
     ex_compat_dirty: bool = False  # ex_compat moved (or E changed)
+    prio_dirty: bool = False       # enc.prio moved (group priorities)
 
     def dirty_fields(self) -> Tuple[List[str], List[str]]:
         """The dirty flags as kernel-input field names, (int64 fields,
@@ -98,6 +99,8 @@ class SnapshotDelta:
             d64 += ["pool_limit", "pool_used0"]
         if self.ex_rows_dirty:
             d64 += ["ex_alloc", "ex_used0"]
+        if self.prio_dirty:
+            d64.append("prio")
         if self.ex_compat_dirty:
             db.append("ex_compat")
         return d64, db
@@ -115,12 +118,21 @@ def structural_key(snapshot: SchedulingSnapshot) -> Tuple:
               for spec in snapshot.nodepools),
         tuple(id(d) for d in snapshot.daemon_overheads),
         tuple(sorted(snapshot.zones.items())),
+        # PriorityClass CONTENT (not identity): a value edit or a new
+        # class changes every resolved pod priority without changing any
+        # pool/daemon object — a stale resident arena would keep serving
+        # old priorities
+        tuple(sorted(
+            (pc.metadata.name, pc.value, pc.global_default,
+             pc.preemption_policy)
+            for pc in getattr(snapshot, "priority_classes", ()))),
     )
 
 
 def _skey_diff(old: Tuple, new: Tuple) -> str:
-    for part, name in zip(range(3), ("pools", "daemons", "zones")):
-        if old[part] != new[part]:
+    for part, name in zip(range(4),
+                          ("pools", "daemons", "zones", "priority")):
+        if part < len(old) and part < len(new) and old[part] != new[part]:
             return name
     return "pools"
 
@@ -252,7 +264,8 @@ class DeltaEncoder:
         self.version += 1
         d = SnapshotDelta(tier="full", reason=reason, n_dirty=True,
                           pools_dirty=True, ex_rows_dirty=True,
-                          ex_compat_dirty=True)
+                          ex_compat_dirty=True,
+                          prio_dirty=enc.prio is not None)
         self.last_delta = d
         m = self.metrics
         if m is not None:
@@ -306,7 +319,8 @@ class DeltaEncoder:
         d = SnapshotDelta(tier="groups", patched_rows=new_rows,
                           groups_changed=abs(G - len(old_row)) or 1,
                           n_dirty=True, pools_dirty=True,
-                          ex_rows_dirty=True, ex_compat_dirty=True)
+                          ex_rows_dirty=True, ex_compat_dirty=True,
+                          prio_dirty=enc.prio is not None)
         self.last_delta = d
         if self.metrics is not None:
             self.metrics.inc("karpenter_solver_encode_delta_total",

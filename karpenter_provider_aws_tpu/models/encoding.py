@@ -214,6 +214,12 @@ class SnapshotEncoding:
     F_full: Optional[np.ndarray] = None
     #: [G] bool — lazy cache of independent_runs(admit); see fused_runs()
     fuse_prev: Optional[np.ndarray] = None
+    #: [G] int64 resolved scheduling priority per group (None when every
+    #: pod is priority 0 — the wire then stays Q=0 / prio-free). The
+    #: kernel's DECISIONS never read it (canonical order already encodes
+    #: priority); it feeds per-tier leftover reporting and the
+    #: preemption search's demand selection
+    prio: Optional[np.ndarray] = None
 
     def fused_runs(self) -> np.ndarray:
         """[G] bool ``same_run_as_prev`` over the ADMIT axis: True at g
@@ -335,8 +341,9 @@ def canonical_pod_groups(pods: Sequence[Pod]) -> List[Tuple[Tuple, List[Pod]]]:
     Equivalent to ``sorted(pods, key=pod_sort_key)`` followed by dedup —
     but O(n) grouping plus small sorts instead of one n·log(n) sort with
     expensive tuple keys (the 50k-pod sort dominated encode time). Valid
-    because pod_sort_key = (-cpu, -mem, sig_digest, ns, name): all members
-    of a group share the first three components, so sorting groups by the
+    because pod_sort_key = (-prio, -cpu, -mem, sig_digest, ns, name): all
+    members of a group share the leading components (priority is part of
+    the signature when nonzero), so sorting groups by the
     representative's key prefix and members by (ns, name) reproduces the
     exact canonical order.
     """
@@ -393,7 +400,8 @@ def canonical_pod_groups(pods: Sequence[Pod]) -> List[Tuple[Tuple, List[Pod]]]:
             for pos, sid in misses:
                 rep = entries[pos][2][0]
                 r = rep.effective_requests()
-                key = (-r["cpu"], -r["memory"], pod_sig_digest(rep))
+                key = (-getattr(rep, "priority", 0), -r["cpu"],
+                       -r["memory"], pod_sig_digest(rep))
                 entries[pos] = (key, entries[pos][1], entries[pos][2])
                 computed.append((sid, key))
             with _SIG_MU:
@@ -422,7 +430,7 @@ def canonical_pod_groups(pods: Sequence[Pod]) -> List[Tuple[Tuple, List[Pod]]]:
 def canonical_group_order(
         raw: List[Tuple[Tuple, List[Pod]]]) -> List[Tuple[Tuple, List[Pod]]]:
     """Order (sig, members) groups canonically — by the representative's
-    (-cpu, -mem, sig-digest) FFD key — merging duplicate signatures
+    (-prio, -cpu, -mem, sig-digest) FFD key — merging duplicate signatures
     (member lists must each already be (ns, name)-sorted). Shared by the
     full grouping above and the preference wrapper's group-level
     reassembly, so both produce the oracle's exact processing order."""
@@ -442,8 +450,8 @@ def canonical_group_order(
     for sig, plist in by_sig.items():
         rep = plist[0]
         r = rep.effective_requests()
-        entries.append(((-r["cpu"], -r["memory"], pod_sig_digest(rep)),
-                        sig, plist))
+        entries.append(((-getattr(rep, "priority", 0), -r["cpu"],
+                         -r["memory"], pod_sig_digest(rep)), sig, plist))
     entries.sort(key=lambda e: e[0])
     return [(sig, plist) for _, sig, plist in entries]
 
@@ -793,6 +801,16 @@ def encode_snapshot(snapshot: SchedulingSnapshot,
     mv_keys, mv_V, mv_floor, mv_pairs_t, mv_pairs_v = \
         _encode_min_values(pools, types, P)
 
+    # per-group resolved priority: None while every pod is priority 0 so
+    # priority-free snapshots stay wire-identical (statics Q=0, no prio
+    # section). Priority is part of the signature when nonzero, so the
+    # representative speaks for the whole group.
+    prio = None
+    if any(getattr(g.pods[0], "priority", 0) for g in groups):
+        prio = np.zeros(G, dtype=np.int64)
+        for g in groups:
+            prio[g.index] = getattr(g.pods[0], "priority", 0)
+
     return SnapshotEncoding(
         universe=universe, dims=dims, zones=zones, zone_ids=zid_of,
         types=types, type_names=cenc.type_names,
@@ -801,7 +819,7 @@ def encode_snapshot(snapshot: SchedulingSnapshot,
         pools=pools, admit=admit, daemon=daemon,
         mv_keys=mv_keys, mv_V=mv_V, mv_floor=mv_floor,
         mv_pairs_t=mv_pairs_t, mv_pairs_v=mv_pairs_v,
-        topo_any=topo_any, F_full=F_full)
+        topo_any=topo_any, F_full=F_full, prio=prio)
 
 
 def _encode_min_values(pools: List[PoolEncoding],

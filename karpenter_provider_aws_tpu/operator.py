@@ -198,9 +198,16 @@ class Operator:
         if consolidation_evaluator is not None \
                 and hasattr(consolidation_evaluator, "metrics"):
             consolidation_evaluator.metrics = self.metrics
+        # preemption search rides the SAME solver instance: a TPU-backed
+        # operator evaluates victim sets on the device, a CPU one on the
+        # planner's bit-identical numpy twin
+        from .scheduling import PreemptionPlanner
+        self.preempt_planner = PreemptionPlanner(solver=self.solver,
+                                                 metrics=self.metrics)
         self.provisioner = Provisioner(self.kube, self.state,
                                        self.cloudprovider, self.solver,
-                                       metrics=self.metrics, clock=clock)
+                                       metrics=self.metrics, clock=clock,
+                                       preempt_planner=self.preempt_planner)
         self.lifecycle = NodeClaimLifecycle(self.kube, self.cloudprovider,
                                             self.instance_types, clock=clock,
                                             recorder=self.recorder,

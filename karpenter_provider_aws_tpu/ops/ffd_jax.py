@@ -990,15 +990,20 @@ from .hostpack import (DEV_PRUNED_SLOTS,  # noqa: E402
 
 
 def _unpack_inputs(buf_i64: jax.Array, buf_bool: jax.Array,
-                   T, D, Z, C, G, E, P, K=0, M=0, F=1):
+                   T, D, Z, C, G, E, P, K=0, M=0, F=1, Q=0):
     """Returns (KernelInputs, fuse-or-None): the same_run_as_prev flags
-    ride the bool section only when the fused kernel is engaged (F>1)."""
-    vals = _split(buf_i64, _in_layout_i64(T, D, Z, C, G, E, P, K, M, F))
+    ride the bool section only when the fused kernel is engaged (F>1).
+    The Q>0 priority vector is dropped here on purpose: the base solve's
+    decisions are priority-blind (canonical group order already encodes
+    priority), so per-tier reporting reads the [G] leftover output
+    against the host's own prio copy (tier_leftovers)."""
+    vals = _split(buf_i64, _in_layout_i64(T, D, Z, C, G, E, P, K, M, F, Q))
     vals.update(_split(buf_bool,
-                       _in_layout_bool(T, D, Z, C, G, E, P, K, M, F)))
+                       _in_layout_bool(T, D, Z, C, G, E, P, K, M, F, Q)))
     if K == 0:
         for nm in ("mv_floor", "mv_pairs_t", "mv_pairs_v"):
             vals.pop(nm, None)
+    vals.pop("prio", None)
     fuse = vals.pop("fuse", None)
     return KernelInputs(**vals), fuse
 
@@ -1062,12 +1067,13 @@ def _pack_solve_outputs(takes, leftover, carry) -> jax.Array:
 
 
 def _packed1_body(buf: jax.Array, *, T, D, Z, C, G, E, P, n_max,
-                  K, V, M, F) -> jax.Array:
-    n_i64 = _layout_sizes(_in_layout_i64(T, D, Z, C, G, E, P, K, M, F))
-    n_bits = _layout_sizes(_in_layout_bool(T, D, Z, C, G, E, P, K, M, F))
+                  K, V, M, F, Q=0) -> jax.Array:
+    n_i64 = _layout_sizes(_in_layout_i64(T, D, Z, C, G, E, P, K, M, F, Q))
+    n_bits = _layout_sizes(_in_layout_bool(T, D, Z, C, G, E, P, K, M, F,
+                                           Q))
     bool_flat = _words_to_bits(buf[n_i64:n_i64 + _nwords(n_bits)], n_bits)
     inp, fuse = _unpack_inputs(buf[:n_i64], bool_flat, T, D, Z, C, G, E,
-                               P, K, M, F)
+                               P, K, M, F, Q)
     if F > 1:
         takes, leftover, carry = _solve_fused(inp, n_max, E, P, F, fuse,
                                               V=V)
@@ -1077,31 +1083,33 @@ def _packed1_body(buf: jax.Array, *, T, D, Z, C, G, E, P, n_max,
 
 
 @partial(jax.jit, static_argnames=("T", "D", "Z", "C", "G", "E", "P",
-                                   "K", "V", "M", "n_max", "F"))
+                                   "K", "V", "M", "n_max", "F", "Q"))
 def solve_scan_packed1(buf: jax.Array, *, T: int, D: int, Z: int, C: int,
                        G: int, E: int, P: int, n_max: int,
                        K: int = 0, V: int = 0, M: int = 0,
-                       F: int = 1) -> jax.Array:
+                       F: int = 1, Q: int = 0) -> jax.Array:
     """One buffer in, one buffer out — a solve is a single round trip.
     F > 1 engages the fused-group block scan (caller-gated: G % F == 0,
-    no minValues floors, single device)."""
+    no minValues floors, single device). Q > 0 means the arena carries
+    the per-group priority vector (layout only — decisions are
+    priority-blind; canonical order encodes priority)."""
     return _packed1_body(buf, T=T, D=D, Z=Z, C=C, G=G, E=E, P=P,
-                         n_max=n_max, K=K, V=V, M=M, F=F)
+                         n_max=n_max, K=K, V=V, M=M, F=F, Q=Q)
 
 
 @partial(jax.jit, static_argnames=("T", "D", "Z", "C", "G", "E", "P",
-                                   "K", "V", "M", "n_max", "F"))
+                                   "K", "V", "M", "n_max", "F", "Q"))
 def solve_scan_packed1_many(bufs: jax.Array, *, T: int, D: int, Z: int,
                             C: int, G: int, E: int, P: int, n_max: int,
                             K: int = 0, V: int = 0, M: int = 0,
-                            F: int = 1) -> jax.Array:
+                            F: int = 1, Q: int = 0) -> jax.Array:
     """B solves, ONE dispatch: vmap of the packed body over stacked
     [B, W] buffers sharing one statics bucket. vmap-of-scan batches the
     carry, so B snapshots cost G (or G/F) scan trips TOTAL — the
     multi-solve amortization consolidation's pre-screen and the
     sidecar's queued solves ride (solver/tpu.py solve_batch)."""
     fn = partial(_packed1_body, T=T, D=D, Z=Z, C=C, G=G, E=E, P=P,
-                 n_max=n_max, K=K, V=V, M=M, F=F)
+                 n_max=n_max, K=K, V=V, M=M, F=F, Q=Q)
     return jax.vmap(fn)(bufs)
 
 

@@ -21,9 +21,12 @@ from ..native import pack_bits, unpack_bits
 #: reads its 8 keys correctly (its buffer-size check then rejects K>0
 #: requests loudly instead of misparsing n_max); the fusion factor F
 #: appends after M under the same discipline (an old server reads 11
-#: keys and rejects the 12-key request loudly, never misparses)
+#: keys and rejects the 12-key request loudly, never misparses); the
+#: priority-tier count Q appends last, version-gated the same way
+#: (Q=0 = priority axis absent, zero extra bytes — an old 12-key
+#: server rejects a Q>0 request loudly, never misparses)
 STATIC_KEYS = ("T", "D", "Z", "C", "G", "E", "P", "n_max", "K", "V", "M",
-               "F")
+               "F", "Q")
 
 #: default fused-scan block width (groups batched per scan step when the
 #: encoder's run detection proves them pairwise pool/existing-disjoint) —
@@ -43,17 +46,25 @@ DEV_FUSE = 4
 DEV_PRUNED_SLOTS = 64
 
 
-def in_layout_i64(T, D, Z, C, G, E, P, K=0, M=0, F=1):
+def in_layout_i64(T, D, Z, C, G, E, P, K=0, M=0, F=1, Q=0):
     """(name, shape) of every int64 input, in buffer order. K/M are the
-    minValues key/pair counts (0 = feature absent, zero extra bytes)."""
-    return [("A", (T, D)), ("R", (G, D)), ("n", (G,)),
-            ("daemon", (G, P, D)), ("pool_limit", (P, D)),
-            ("pool_used0", (P, D)), ("ex_alloc", (E, D)),
-            ("ex_used0", (E, D)), ("mv_floor", (P, K)),
-            ("mv_pairs_t", (K, M)), ("mv_pairs_v", (K, M))]
+    minValues key/pair counts (0 = feature absent, zero extra bytes);
+    Q is the priority-tier count gating the per-group priority vector
+    under the same zero-when-absent discipline."""
+    lay = [("A", (T, D)), ("R", (G, D)), ("n", (G,)),
+           ("daemon", (G, P, D)), ("pool_limit", (P, D)),
+           ("pool_used0", (P, D)), ("ex_alloc", (E, D)),
+           ("ex_used0", (E, D)), ("mv_floor", (P, K)),
+           ("mv_pairs_t", (K, M)), ("mv_pairs_v", (K, M))]
+    if Q:
+        # resolved per-group priority: data for per-tier reporting and
+        # the preemption search — the base solve's decisions never read
+        # it (canonical order already encodes priority)
+        lay.append(("prio", (G,)))
+    return lay
 
 
-def in_layout_bool(T, D, Z, C, G, E, P, K=0, M=0, F=1):
+def in_layout_bool(T, D, Z, C, G, E, P, K=0, M=0, F=1, Q=0):
     base = [("avail_zc", (T, Z * C)), ("F", (G, T)), ("agz", (G, Z)),
             ("agc", (G, C)), ("admit", (G, P)),
             ("pool_types", (P, T)), ("pool_agz", (P, Z)),
@@ -151,13 +162,13 @@ def pad_to(a: np.ndarray, shape, fill=0) -> np.ndarray:
 
 
 def pack_inputs1(arrays: dict, T, D, Z, C, G, E, P, K=0, M=0,
-                 F=1) -> np.ndarray:
+                 F=1, Q=0) -> np.ndarray:
     """Host: all inputs -> ONE int64 buffer [i64 fields | bitpacked bools]."""
-    return pack_inputs1_state(arrays, T, D, Z, C, G, E, P, K, M, F)[0]
+    return pack_inputs1_state(arrays, T, D, Z, C, G, E, P, K, M, F, Q)[0]
 
 
 def pack_inputs1_state(arrays: dict, T, D, Z, C, G, E, P, K=0, M=0,
-                       F=1):
+                       F=1, Q=0):
     """``pack_inputs1`` that also returns the pre-bitpack bool plane, so
     a caller can keep ``(buf, bool_flat)`` RESIDENT between solves and
     patch dirty sections in place (``patch_inputs1``) instead of
@@ -166,17 +177,17 @@ def pack_inputs1_state(arrays: dict, T, D, Z, C, G, E, P, K=0, M=0,
     empty = np.zeros(0, dtype=np.int64)
     i64 = np.concatenate([
         np.asarray(arrays.get(nm, empty)).reshape(-1).astype(np.int64)
-        for nm, _ in in_layout_i64(T, D, Z, C, G, E, P, K, M, F)])
+        for nm, _ in in_layout_i64(T, D, Z, C, G, E, P, K, M, F, Q)])
     bl = np.concatenate([arrays[nm].reshape(-1).astype(bool)
                          for nm, _ in in_layout_bool(T, D, Z, C, G, E, P,
-                                                     K, M, F)])
+                                                     K, M, F, Q)])
     packer = _dw.pack_bits if _dw.enabled() else pack_bits
     return np.concatenate([i64, packer(bl)]), bl
 
 
 def patch_inputs1(buf: np.ndarray, bool_flat: np.ndarray, arrays: dict,
                   dirty_i64, dirty_bool, T, D, Z, C, G, E, P, K=0, M=0,
-                  F=1):
+                  F=1, Q=0):
     """Patch dirty fields of a RESIDENT packed arena in place.
 
     ``(buf, bool_flat)`` must be the pair ``pack_inputs1_state``
@@ -202,7 +213,7 @@ def patch_inputs1(buf: np.ndarray, bool_flat: np.ndarray, arrays: dict,
     else:
         _dw.record_fallback(_dw.fallback_reason())
     sections = []
-    lay64 = in_layout_i64(T, D, Z, C, G, E, P, K, M, F)
+    lay64 = in_layout_i64(T, D, Z, C, G, E, P, K, M, F, Q)
     want64 = set(dirty_i64)
     off = 0
     for nm, shp in lay64:
@@ -214,7 +225,7 @@ def patch_inputs1(buf: np.ndarray, bool_flat: np.ndarray, arrays: dict,
                 np.asarray(arrays[nm]).reshape(-1).astype(np.int64)
             sections.append((off, off + sz))
         off += sz
-    layb = in_layout_bool(T, D, Z, C, G, E, P, K, M, F)
+    layb = in_layout_bool(T, D, Z, C, G, E, P, K, M, F, Q)
     nbits = layout_sizes(layb)
     wantb = set(dirty_bool)
     boff = 0
@@ -243,6 +254,22 @@ def patch_inputs1(buf: np.ndarray, bool_flat: np.ndarray, arrays: dict,
     return sections
 
 
+def tier_leftovers(leftover: np.ndarray, prio) -> dict:
+    """Per-priority-tier unschedulable pod counts from the solve's [G]
+    leftover output and the encoding's per-group priority vector (None =
+    priority axis disabled -> single tier 0). Host-side reporting: the
+    kernels never read priority (canonical order encodes it), so this is
+    THE per-tier view both the device and CPU paths share."""
+    left = np.asarray(leftover).reshape(-1)
+    if prio is None:
+        return {0: int(left.sum())}
+    pr = np.asarray(prio).reshape(-1)[:left.size]
+    out: dict = {}
+    for tier in np.unique(pr):
+        out[int(tier)] = int(left[: pr.size][pr == tier].sum())
+    return out
+
+
 def unpack_outputs1(buf, T, D, Z, C, G, E, P, n_max) -> dict:
     """Host: the single fetched buffer -> dict of arrays."""
     li, l32, lb = out_layout(T, D, Z, C, G, E, P, n_max)
@@ -258,11 +285,11 @@ def unpack_outputs1(buf, T, D, Z, C, G, E, P, n_max) -> dict:
     return vals
 
 
-def unpack_inputs1(buf, T, D, Z, C, G, E, P, K=0, M=0, F=1) -> dict:
+def unpack_inputs1(buf, T, D, Z, C, G, E, P, K=0, M=0, F=1, Q=0) -> dict:
     """Inverse of pack_inputs1 (the sidecar server's mesh path unpacks
     the wire buffer back into arrays to shard them over its local mesh)."""
-    li = in_layout_i64(T, D, Z, C, G, E, P, K, M, F)
-    lb = in_layout_bool(T, D, Z, C, G, E, P, K, M, F)
+    li = in_layout_i64(T, D, Z, C, G, E, P, K, M, F, Q)
+    lb = in_layout_bool(T, D, Z, C, G, E, P, K, M, F, Q)
     n_i64 = layout_sizes(li)
     bool_flat = unpack_bits(np.ascontiguousarray(buf[n_i64:]),
                             layout_sizes(lb))

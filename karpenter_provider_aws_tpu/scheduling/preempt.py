@@ -1,0 +1,293 @@
+"""Preemption planner: cheapest victim set that schedules the blocked
+high-priority demand onto existing capacity — or a proof none exists.
+
+The kube-scheduler's preemption loop (pkg/scheduler/framework/preemption)
+picks victims per pod, node by node. Scoped to the capacity the
+autoscaler already owns, the question batches: candidate victim sets are
+PREFIXES of one deterministic ascending (priority, cost, namespace,
+name) victim order, and every prefix is evaluated in ONE device call
+(scheduling/preempt_jax.py) — the ``subset_solve_kernel`` lane recipe
+with usage refunded into the arena instead of nodes masked out of it.
+The first feasible prefix is the cheapest: it evicts the fewest,
+lowest-priority, smallest pods.
+
+Exactness discipline (the same contract as consolidation's oracle):
+``_lanes_numpy`` is the bit-identical numpy twin of the kernel; every
+routing fallback — numpy backend, no device engine, a failed dispatch —
+lands there, never on different semantics. Verdict-and-command byte
+identity across backends is fuzz-enforced (tests/test_preempt.py,
+``make fuzz-preempt``).
+
+Hard gates (never victims, never over-promise):
+
+- daemonset pods and ``is_critical`` pods are never victims;
+- victims must rank strictly below the LOWEST blocked demand priority;
+- PDB allowances are consumed cumulatively in victim order — a pod
+  whose eviction would breach a budget is skipped, and everything the
+  chosen prefix evicts fits the budgets by construction;
+- demand pods with ``preemptionPolicy: Never`` never trigger a search;
+- demand pods carrying required topology constraints are excluded (the
+  greedy fill cannot honor spread, so a verdict including them could
+  evict victims without scheduling the pod).
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..apis.objects import Pod, is_critical
+from ..models.delta import full_existing_encode
+from ..models.encoding import encode_snapshot
+from ..solver.types import SchedulingSnapshot
+
+log = logging.getLogger(__name__)
+
+#: candidate-prefix cap per search — one device lane each. Deeper
+#: preemption (65+ victims in one round) is out of scope by design; the
+#: truncation is logged, never silent, and the next reconcile retries
+#: with the survivors.
+MAX_LANES = 64
+
+_BIG = np.int64(1) << np.int64(60)
+
+
+@dataclass(frozen=True)
+class PreemptCommand:
+    """The canonical applied form of a feasible verdict — what the
+    provisioner executes, and the byte string the cross-backend fuzz
+    compares. Evictions keep victim order (= eviction order); demand is
+    name-sorted (the solve decides placement, not the command)."""
+    #: (namespace, name, node_name) per victim, in eviction order
+    evictions: Tuple[Tuple[str, str, str], ...]
+    #: full names of the demand pods the evictions unblock
+    demand: Tuple[str, ...]
+
+    def to_bytes(self) -> bytes:
+        return repr((self.evictions, self.demand)).encode("utf-8")
+
+
+@dataclass
+class PreemptionVerdict:
+    feasible: bool
+    #: chosen victim prefix (empty unless feasible)
+    victims: Tuple[Pod, ...] = ()
+    #: demand pods the search ran for
+    demand: Tuple[Pod, ...] = ()
+    #: candidate prefixes evaluated
+    lanes: int = 0
+    #: per-lane leftover demand pods (device/host parity evidence)
+    leftovers: Tuple[int, ...] = ()
+    #: "device" | "host" | "none"
+    backend: str = "none"
+    #: why the search was skipped / fell back (empty when it ran clean)
+    reason: str = ""
+    command: Optional[PreemptCommand] = None
+
+
+def victim_sort_key(pod: Pod) -> Tuple:
+    """Ascending eviction preference: lowest priority first, then the
+    smallest footprint (cheapest disruption), then name — equal-priority
+    ties are deterministic by construction."""
+    r = pod.effective_requests()
+    return (getattr(pod, "priority", 0), r.get("cpu", 0),
+            r.get("memory", 0), pod.metadata.namespace, pod.metadata.name)
+
+
+def _lanes_numpy(ex_alloc: np.ndarray, ex_used0: np.ndarray,
+                 ex_compat: np.ndarray, R: np.ndarray, n: np.ndarray,
+                 freed: np.ndarray) -> np.ndarray:
+    """Numpy twin of ``preempt_solve_kernel`` — bit-identical lane
+    semantics (same headroom/prefix-fill arithmetic, same clamps)."""
+    B = freed.shape[0]
+    out = np.zeros(B, dtype=np.int64)
+    for b in range(B):
+        used = np.maximum(ex_used0 - freed[b], 0)
+        total = np.int64(0)
+        for g in range(R.shape[0]):
+            Rg, ng, cg = R[g], n[g], ex_compat[g]
+            Rsafe = np.where(Rg > 0, Rg, 1)
+            q = (ex_alloc - used) // Rsafe[None, :]
+            q = np.where((Rg > 0)[None, :], q, _BIG)
+            k = np.clip(q.min(axis=-1), 0, _BIG)
+            k = np.where(cg, k, 0)
+            cum = np.cumsum(k) - k
+            take = np.clip(ng - cum, 0, k)
+            used = used + take[:, None] * Rg[None, :]
+            total += ng - take.sum()
+        out[b] = total
+    return out
+
+
+class PreemptionPlanner:
+    """One search per provisioning round, consulted AFTER the base solve
+    leaves priority-bearing pods unschedulable and BEFORE the controller
+    gives up on them. Owns no kube writes — it returns a verdict; the
+    provisioner applies it (evict, re-solve, nominate, requeue)."""
+
+    def __init__(self, solver=None, backend: str = "auto", metrics=None):
+        assert backend in ("auto", "jax", "numpy")
+        if solver is None:
+            from ..solver.tpu import TPUSolver
+            solver = TPUSolver(backend=backend)
+        self.solver = solver
+        self.backend = backend
+        #: optional metrics registry; the operator injects its own
+        self.metrics = metrics
+        self.max_lanes = MAX_LANES
+
+    def _inc(self, name: str, value: float = 1.0, **labels) -> None:
+        if self.metrics is not None:
+            self.metrics.inc(name, value=value, labels=labels or None)
+
+    def _skip(self, reason: str, demand: Tuple[Pod, ...] = ()) \
+            -> PreemptionVerdict:
+        self._inc("karpenter_solver_preempt_verdicts_total",
+                  verdict="skipped")
+        return PreemptionVerdict(feasible=False, demand=demand,
+                                 reason=reason)
+
+    # ------------------------------------------------------------------
+    def plan(self, snapshot: SchedulingSnapshot,
+             unschedulable: Sequence[str], state) -> PreemptionVerdict:
+        """``unschedulable``: full names the base solve could not place.
+        ``state``: the ClusterState (bound pods + PDB universe)."""
+        # lazy: controllers/__init__ imports the provisioner, which
+        # imports this package — a module-level import would cycle
+        from ..controllers.pdb import pdb_state, take_allowance
+
+        blocked = set(unschedulable)
+        demand: List[Pod] = []
+        for pod in snapshot.pods:
+            if pod.full_name() not in blocked:
+                continue
+            if getattr(pod, "priority", 0) <= 0:
+                continue
+            if getattr(pod, "preemption_policy", "") == "Never":
+                continue
+            if pod.topology_spread or pod.pod_affinity:
+                log.info("preempt: %s excluded from demand (required "
+                         "topology constraints)", pod.full_name())
+                continue
+            demand.append(pod)
+        if not demand:
+            return self._skip("no eligible demand")
+        demand.sort(key=lambda p: p.full_name())
+        floor = min(getattr(p, "priority", 0) for p in demand)
+
+        existing = list(snapshot.existing_nodes)
+        npos = {node.name: ei for ei, node in enumerate(existing)}
+        if not npos:
+            return self._skip("no existing nodes", tuple(demand))
+
+        bound = state.bound_pods_by_node()
+        candidates: List[Pod] = []
+        for node_name, pods in bound.items():
+            if node_name not in npos:
+                continue
+            for pod in pods:
+                if not pod.node_name:
+                    continue  # nominated, not bound: nothing to evict
+                if pod.owner_kind == "DaemonSet" or is_critical(pod):
+                    continue
+                if getattr(pod, "priority", 0) >= floor:
+                    continue
+                candidates.append(pod)
+        candidates.sort(key=victim_sort_key)
+
+        # cumulative PDB budgets, consumed in victim order: the chosen
+        # prefix can never over-draw a budget
+        pdbs = pdb_state(state.kube)
+        victims = [p for p in candidates if take_allowance(pdbs, p)]
+        if not victims:
+            return self._skip("no eligible victims", tuple(demand))
+        if len(victims) > self.max_lanes:
+            log.info("preempt: victim list truncated to %d lanes "
+                     "(%d candidates dropped)", self.max_lanes,
+                     len(victims) - self.max_lanes)
+            victims = victims[:self.max_lanes]
+
+        # one demand-only encoding shares the base solver's derivation
+        # (canonical group order, existing tables) with both twins
+        demand_snap = SchedulingSnapshot(
+            pods=demand, nodepools=snapshot.nodepools,
+            existing_nodes=existing,
+            daemon_overheads=snapshot.daemon_overheads,
+            zones=snapshot.zones,
+            priority_classes=getattr(snapshot, "priority_classes", ()))
+        enc = encode_snapshot(demand_snap)
+        ex_alloc, ex_used, ex_compat = full_existing_encode(enc, existing)
+
+        dpos = {d: i for i, d in enumerate(enc.dims)}
+        B = len(victims)
+        freed = np.zeros((B, len(existing), len(enc.dims)), dtype=np.int64)
+        refund = np.zeros_like(freed[0])
+        for b, pod in enumerate(victims):
+            ei = npos[pod.node_name]
+            for key, qty in pod.effective_requests().items():
+                di = dpos.get(key)
+                if di is not None:
+                    refund[ei, di] += qty
+            freed[b] = refund
+
+        leftovers, backend_used, reason = self._evaluate(
+            ex_alloc, ex_used, ex_compat, enc.R, enc.n, freed)
+
+        chosen: Tuple[Pod, ...] = ()
+        for b in range(B):
+            if leftovers[b] == 0:
+                chosen = tuple(victims[:b + 1])
+                break
+        feasible = bool(chosen)
+        self._inc("karpenter_solver_preempt_verdicts_total",
+                  verdict="feasible" if feasible else "infeasible")
+        command = None
+        if feasible:
+            self._inc("karpenter_solver_preempt_victims_total",
+                      value=float(len(chosen)))
+            command = PreemptCommand(
+                evictions=tuple((p.metadata.namespace, p.metadata.name,
+                                 p.node_name) for p in chosen),
+                demand=tuple(p.full_name() for p in demand))
+        return PreemptionVerdict(
+            feasible=feasible, victims=chosen, demand=tuple(demand),
+            lanes=B, leftovers=tuple(int(v) for v in leftovers),
+            backend=backend_used, reason=reason, command=command)
+
+    # ------------------------------------------------------------------
+    def _evaluate(self, ex_alloc, ex_used, ex_compat, R, n, freed):
+        """Route the lane batch: device kernel when the solver carries
+        one and its engine answers, else the numpy twin — identical
+        verdicts by contract, and every fallback is counted."""
+        def host():
+            return _lanes_numpy(ex_alloc, ex_used, ex_compat, R, n, freed)
+
+        if self.backend == "numpy":
+            return host(), "host", ""
+        if not getattr(self.solver, "supports_preempt_kernel", False):
+            # CPU solver / remote peer without the capability: the twin
+            # IS the engine here, not a degradation — no fallback counter
+            return host(), "host", ""
+        router = getattr(self.solver, "_router", None)
+        if router is not None:
+            from ..solver.route import dev_engine_usable
+            if not dev_engine_usable(router):
+                log.warning("preempt: dev engine unavailable; lanes on "
+                            "the host twin")
+                self._inc("karpenter_solver_preempt_host_fallback_total",
+                          reason="device_unavailable")
+                return host(), "host", "device_unavailable"
+        try:
+            out = self.solver.dispatch_preempt(
+                ex_alloc=ex_alloc, ex_used=ex_used, ex_compat=ex_compat,
+                R=R, n=n, freed=freed)
+        except Exception as e:  # DeviceDispatchFailed or raw XLA error
+            log.warning("preempt: device dispatch failed (%s); lanes on "
+                        "the host twin", e)
+            self._inc("karpenter_solver_preempt_host_fallback_total",
+                      reason="dispatch_failed")
+            return host(), "host", "dispatch_failed"
+        return out, "device", ""

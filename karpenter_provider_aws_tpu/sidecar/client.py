@@ -547,6 +547,14 @@ class RemoteSolver(TPUSolver):
         the RPC; its clients keep the sequential oracle."""
         return bool(self._subsets_ok) and self._caps_current()
 
+    @property
+    def supports_preempt_kernel(self) -> bool:
+        """No Preempt RPC: the preemption planner's lane batch is tiny
+        (≤64 lanes over shared tables) and its numpy twin is
+        bit-identical by contract, so remote callers keep the host
+        path rather than pay a wire round trip per search."""
+        return False
+
     def _dev_devices(self) -> int:
         """Always the packed wire dispatch: the SERVER owns the
         mesh-vs-single decision for its local devices (server.py solve)."""
@@ -926,7 +934,8 @@ class RemoteSolver(TPUSolver):
             statics = dict(T=stt["T"], D=stt["D"], Z=stt["Z"],
                            C=stt["C"], G=stt["G"], E=stt["E"],
                            P=stt["P"], K=stt["K"], V=stt["V"],
-                           M=stt["M"], n_max=self._bucket, F=stt["F"])
+                           M=stt["M"], n_max=self._bucket, F=stt["F"],
+                           Q=stt.get("Q", 0))
             plan = self._patch_plan(buf, statics)
             fuse = arrays.get("fuse")
             prep = dict(

@@ -60,7 +60,8 @@ _INFO = "/karpenter.solver.v1.Solver/Info"
 #: wire's shape-class key. n_max and V are jit statics but layout-inert,
 #: so a resident arena survives n_max growth (the client's grow loop
 #: redispatches the same buffer with a bigger bucket).
-PATCH_LAYOUT_KEYS = ("T", "D", "Z", "C", "G", "E", "P", "K", "M", "F")
+PATCH_LAYOUT_KEYS = ("T", "D", "Z", "C", "G", "E", "P", "K", "M", "F",
+                     "Q")
 #: resident patch-arena budget (each slot holds a full packed arena, so
 #: the table is tighter than the shape-class table)
 _MAX_PATCH_ARENAS = 32
@@ -94,7 +95,7 @@ _SUBSET_GQ_MAX = 1 << 13
 #: and grow the compile cache without limit)
 _STATICS_MAX = dict(T=4096, D=64, Z=64, C=8, G=1 << 17, E=1 << 14,
                     P=256, K=16, V=8192, M=1 << 16, n_max=1 << 14,
-                    F=64)
+                    F=64, Q=1)
 _MAX_SHAPE_CLASSES = 64
 
 
@@ -401,16 +402,26 @@ class _Handler:
 
         from ..ops.hostpack import (STATIC_KEYS, in_layout_bool,
                                     in_layout_i64, layout_sizes, nwords)
-        if len(statics) == len(STATIC_KEYS) - 4:
+        # version-skew padding by ABSOLUTE client vintage (the key count
+        # each generation shipped), never len(STATIC_KEYS)-relative —
+        # relative arithmetic silently re-aims at the wrong vintage every
+        # time a key appends
+        if len(statics) == 8:
             # pre-minValues client (8 statics: T,D,Z,C,G,E,P,n_max): the
             # floors feature is simply absent — K=V=M=0 solves identically,
             # so a rolling upgrade with the server deployed first keeps
-            # serving old clients (which also predate fusion: F=1)
-            statics = list(statics) + [0, 0, 0, 1]
-        elif len(statics) == len(STATIC_KEYS) - 1:
+            # serving old clients (which also predate fusion and
+            # priority: F=1, Q=0)
+            statics = list(statics) + [0, 0, 0, 1, 0]
+        elif len(statics) == 11:
             # pre-fusion client (11 statics): its buffer carries no fuse
-            # flags and F=1 runs the unfused scan, identically
-            statics = list(statics) + [1]
+            # flags and F=1 runs the unfused scan, identically (Q=0:
+            # also pre-priority)
+            statics = list(statics) + [1, 0]
+        elif len(statics) == 12:
+            # pre-priority client (12 statics): the priority arena
+            # section is absent — Q=0 solves identically
+            statics = list(statics) + [0]
         if len(statics) != len(STATIC_KEYS):
             context.abort(grpc.StatusCode.INVALID_ARGUMENT,
                           f"expected {len(STATIC_KEYS)} statics, "
@@ -741,12 +752,15 @@ class _Handler:
         from ..ops.hostpack import pack_outputs1, unpack_inputs1
         from ..parallel.mesh import dispatch_mesh
         dims = {k: kv[k] for k in ("T", "D", "Z", "C", "G", "E", "P",
-                                   "K", "M", "F")}
+                                   "K", "M", "F", "Q")}
         arrays = unpack_inputs1(np.asarray(buf), **dims)
         # a fusion-requesting client (F>1, single-device RemoteSolver)
         # may still land on a mesh server: the flags are advisory — the
-        # mesh scan stays per-group and decides identically
+        # mesh scan stays per-group and decides identically. Likewise
+        # the priority vector (Q=1): decisions are priority-blind, the
+        # mesh arena walk stays Q-free
         arrays.pop("fuse", None)
+        arrays.pop("prio", None)
         if kv["K"] == 0:
             for mk in ("mv_floor", "mv_pairs_t", "mv_pairs_v"):
                 arrays.pop(mk, None)

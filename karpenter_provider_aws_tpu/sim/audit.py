@@ -30,7 +30,8 @@ from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence
 
 __all__ = ["Violation", "check_cluster", "check_accounting",
-           "check_slo", "LeakMonitor", "DEFAULT_SLO_P99_MS"]
+           "check_slo", "check_priority_slo", "LeakMonitor",
+           "DEFAULT_SLO_P99_MS", "CRITICAL_BIND_SLO_P99_S"]
 
 #: per-regime solve p99 SLO in ms (CPU CI bar, post-warmup; the SLO
 #: table in docs/simulator.md). Regimes without an entry use "default".
@@ -38,6 +39,13 @@ DEFAULT_SLO_P99_MS = {
     "default": 2000.0,
     "tenant_mix": 2000.0,
 }
+
+#: critical-tier scheduling SLO in VIRTUAL seconds: p99 of creation-to-
+#: bind latency for the priority_surge regime's critical waves. The
+#: driver harvests bind times after every reconcile step, so the bound
+#: covers real control-plane rounds (launch, register, bind), not audit
+#: cadence.
+CRITICAL_BIND_SLO_P99_S = 1800.0
 
 
 @dataclass(frozen=True)
@@ -183,6 +191,30 @@ def check_slo(latencies_by_regime: Dict[str, List[float]],
                 "solve-slo",
                 f"regime {regime}: solve p99 {p99_ms:.0f}ms > SLO "
                 f"{bound:.0f}ms over {len(lats)} solves{tag}"))
+    return v
+
+
+def check_priority_slo(latencies_s: Sequence[float], unbound: int = 0,
+                       bound_s: Optional[float] = None,
+                       context: str = "") -> List[Violation]:
+    """The critical-tier scheduling SLO (virtual-time latencies from
+    pod creation to bind). Two ways to violate: the p99 misses the
+    bound, or a critical pod never bound at all — starvation is not a
+    latency number."""
+    bound = CRITICAL_BIND_SLO_P99_S if bound_s is None else bound_s
+    v: List[Violation] = []
+    tag = f" ({context})" if context else ""
+    if unbound:
+        v.append(Violation(
+            "critical-pod-unbound",
+            f"{unbound} critical pod(s) never bound{tag}"))
+    if latencies_s:
+        p99 = _p99(list(latencies_s))
+        if p99 > bound:
+            v.append(Violation(
+                "critical-bind-slo",
+                f"critical-tier bind p99 {p99:.0f}s > SLO {bound:.0f}s "
+                f"over {len(latencies_s)} pods{tag}"))
     return v
 
 

@@ -172,7 +172,8 @@ class EnduranceSim:
     # -- event application -------------------------------------------------
     def _apply(self, op, evt) -> None:
         from ..apis import labels as L
-        from ..apis.objects import TopologySpreadConstraint
+        from ..apis.objects import (PriorityClass,
+                                    TopologySpreadConstraint)
         from ..fake.environment import make_pods
         from ..providers.sqs import InterruptionMessage
         p = evt.payload
@@ -187,7 +188,17 @@ class EnduranceSim:
             for pod in make_pods(p["count"], cpu=p["cpu"],
                                  memory=p["memory"], prefix=p["prefix"],
                                  **kw):
+                if p.get("priority_class"):
+                    pod.priority_class_name = p["priority_class"]
                 op.kube.create(pod)
+                if p.get("critical"):
+                    # watch creation-to-bind latency on the virtual
+                    # timeline — the critical-tier SLO input
+                    self._prio_watch[pod.full_name()] = evt.t
+        elif evt.kind == "create_priority_class":
+            if op.kube.try_get("PriorityClass", p["name"]) is None:
+                op.kube.create(PriorityClass(p["name"],
+                                             value=p["value"]))
         elif evt.kind == "delete_pods":
             pods = sorted((x for x in op.kube.list("Pod")
                            if x.name.startswith(p["match"])),
@@ -251,6 +262,18 @@ class EnduranceSim:
         snap = self._solve_env.snapshot(list(cur), [st["pool"]])
         self._worker.submit(snap, evt.regime,
                             f"solve:{tenant}:{evt.seq}")
+
+    def _harvest_prio(self, op, now: float) -> None:
+        """Record creation-to-bind virtual latency for watched critical
+        pods; called after every reconcile step so the sample reflects
+        control-plane rounds, not audit cadence."""
+        if not self._prio_watch:
+            return
+        for pod in op.kube.list("Pod"):
+            name = pod.full_name()
+            if name in self._prio_watch and pod.node_name:
+                self._prio_latencies.append(
+                    now - self._prio_watch.pop(name))
 
     # -- chaos -------------------------------------------------------------
     def _engage(self, op, w) -> None:
@@ -388,6 +411,8 @@ class EnduranceSim:
                 lambda s: local.solve(s).decision_fingerprint())
         self._solve_env = Environment()
         self._tenant_state: dict = {}
+        self._prio_watch: Dict[str, float] = {}
+        self._prio_latencies: List[float] = []
         leaks = audit_mod.LeakMonitor()
 
         for r in self.regimes:
@@ -408,9 +433,11 @@ class EnduranceSim:
                     op.step()
                 except Exception:
                     pass  # an escaped injected fault aborts one round
+                self._harvest_prio(op, evt.t)
                 if (i + 1) % self.audit_every == 0:
                     audits += 1
                     if self._settle(op, rounds=4):
+                        self._harvest_prio(op, evt.t)
                         converged_audits += 1
                         self._record(audit_mod.check_cluster(
                             op, context=f"t={evt.t:.0f}s"))
@@ -445,6 +472,10 @@ class EnduranceSim:
                 if offered else None, context="terminus"))
             self._record(audit_mod.check_slo(
                 self._worker.latencies, slo_p99_ms=self.slo_p99_ms,
+                context="terminus"))
+            self._harvest_prio(op, self.duration_s)
+            self._record(audit_mod.check_priority_slo(
+                self._prio_latencies, unbound=len(self._prio_watch),
                 context="terminus"))
             self._record(leaks.check(
                 op, handler=getattr(self._server, "_handler", None),
@@ -481,6 +512,12 @@ class EnduranceSim:
                 for r, ls in self._worker.latencies.items() if ls},
             "audits": audits,
             "converged_audits": converged_audits,
+            "critical_binds": len(self._prio_latencies),
+            "critical_bind_p99_s": round(sorted(
+                self._prio_latencies)[min(len(self._prio_latencies) - 1,
+                                          int(0.99 * len(
+                                              self._prio_latencies)))],
+                1) if self._prio_latencies else None,
             "terminal_fingerprint": fingerprint,
             "violations": [str(v) for v in self.violations],
             "clean": not self.violations,

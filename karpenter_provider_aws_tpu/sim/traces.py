@@ -16,6 +16,12 @@ independent regime generators (docs/simulator.md):
 - ``tenant_mix``   — multi-tenant solve traffic against the sidecar:
                      warm churn ticks per tenant (the delta-wire regime)
                      interleaved across the day.
+- ``priority_surge`` — a low-priority batch flood followed minutes
+                     later by a critical-pod wave: the priority-
+                     resolution path end to end (PriorityClass objects,
+                     per-pod resolution, the prio-aware solve), with the
+                     critical tier's creation-to-bind latency audited
+                     against its own SLO (sim/audit.py).
 
 Determinism is the contract: every generator draws ONLY from its own
 ``random.Random(seed ^ salt)``, event payloads are plain JSON values,
@@ -47,6 +53,7 @@ _SALTS = {
     "spot_storm": 0x5707,
     "batch_waves": 0xBA7C,
     "tenant_mix": 0x7E4A,
+    "priority_surge": 0x9517,
 }
 
 REGIMES: Tuple[str, ...] = tuple(_SALTS)
@@ -61,7 +68,8 @@ class TraceEvent:
 
     ``t`` is virtual seconds from trace start; ``seq`` the global order
     tiebreaker assigned at merge; ``kind`` one of ``create_pods`` /
-    ``delete_pods`` / ``spot_interrupt`` / ``ice_pool`` / ``solve``."""
+    ``delete_pods`` / ``spot_interrupt`` / ``ice_pool`` / ``solve`` /
+    ``create_priority_class``."""
 
     t: float
     seq: int
@@ -173,12 +181,45 @@ def _tenant_mix(rng: random.Random, duration_s: float, scale: float):
     return out
 
 
+def _priority_surge(rng: random.Random, duration_s: float, scale: float):
+    out = []
+    # the class table lands up front (idempotent on the driver side):
+    # the batch tier, and a value for the critical names so resolution
+    # ranks them above everything the flood creates
+    out.append((1.0, "create_priority_class",
+                {"name": "sim-batch", "value": 10}))
+    out.append((1.0, "create_priority_class",
+                {"name": "system-cluster-critical",
+                 "value": 2_000_000_000}))
+    surges = max(1, int(duration_s // 28800))  # ~one per 8h
+    for s in range(surges):
+        t = rng.uniform(0.2, 0.8) * duration_s
+        n_low = int(round(rng.randint(18, 30) * scale))
+        out.append((t, "create_pods", {
+            "count": max(2, n_low), "cpu": "500m", "memory": "1Gi",
+            "prefix": f"psurge{s:02d}bulk",
+            "priority_class": "sim-batch"}))
+        # the critical wave lands while the flood is still provisioning
+        n_crit = max(1, int(round(rng.randint(3, 6) * scale)))
+        out.append((t + rng.uniform(60.0, 240.0), "create_pods", {
+            "count": n_crit, "cpu": "1", "memory": "2Gi",
+            "prefix": f"psurge{s:02d}crit",
+            "priority_class": "system-cluster-critical",
+            "critical": True}))
+        t_end = t + rng.uniform(3600.0, 7200.0)
+        if t_end < duration_s:
+            out.append((t_end, "delete_pods", {
+                "fraction": 0.8, "match": f"psurge{s:02d}bulk"}))
+    return out
+
+
 _GENERATORS = {
     "diurnal": _diurnal,
     "flash_crowd": _flash_crowd,
     "spot_storm": _spot_storm,
     "batch_waves": _batch_waves,
     "tenant_mix": _tenant_mix,
+    "priority_surge": _priority_surge,
 }
 assert set(_GENERATORS) == set(_SALTS)
 

@@ -68,12 +68,17 @@ def pod_sig_digest(pod: Pod) -> str:
 
 def pod_sort_key(pod: Pod) -> Tuple:
     """Canonical FFD order, shared verbatim by CPU and TPU solvers:
-    descending (cpu, memory), then *pod-group signature digest* so identical
-    pods are contiguous within a size class (group-batched processing is then
-    exactly per-pod FFD), then namespace/name."""
+    descending resolved priority first (higher-priority pods pack before
+    any lower tier can claim capacity — Kubernetes scheduling-queue
+    semantics as a *packing order*, restriction-stable for subset
+    gathers), then descending (cpu, memory), then *pod-group signature
+    digest* so identical pods are contiguous within a size class
+    (group-batched processing is then exactly per-pod FFD), then
+    namespace/name. Priority is 0 unless PriorityClass objects exist,
+    so priority-free clusters keep the historical order bit-for-bit."""
     r = pod.effective_requests()
-    return (-r["cpu"], -r["memory"], pod_sig_digest(pod),
-            pod.metadata.namespace, pod.metadata.name)
+    return (-getattr(pod, "priority", 0), -r["cpu"], -r["memory"],
+            pod_sig_digest(pod), pod.metadata.namespace, pod.metadata.name)
 
 
 def pod_group_signature(pod: Pod) -> Tuple:
@@ -95,6 +100,12 @@ def pod_group_signature(pod: Pod) -> Tuple:
         # match (each PVC pins its own zone)
         tuple(r for r in (getattr(pod, "_volume_reqs", None) or ())),
     )
+    # resolved priority splits groups ONLY when nonzero: appended (never
+    # inserted — positional consumers index sig[0..7]) so priority-free
+    # clusters keep byte-identical signatures, digests, and fingerprints
+    prio = getattr(pod, "priority", 0)
+    if prio:
+        pod._sig_cache = sig = sig + (("priority", prio),)
     return sig
 
 

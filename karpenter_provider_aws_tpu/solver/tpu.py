@@ -456,7 +456,8 @@ class TPUSolver(Solver):
         Ep = 1 << (E - 1).bit_length() if E else 0
         Pp = max(1, 1 << (len(enc.pools) - 1).bit_length())
         return (T, max(8, len(enc.dims)), len(enc.zones), Gp, Ep, Pp,
-                enc.mv_K, self._dev_devices())
+                enc.mv_K, 1 if enc.prio is not None else 0,
+                self._dev_devices())
 
     # ------------------------------------------------------------------
     def _encode_existing(self, enc: SnapshotEncoding,
@@ -670,6 +671,33 @@ class TPUSolver(Solver):
                               Gp=int(np.asarray(gid).shape[1]), Fu=1)
         return out
 
+    # -- preemption victim-set search ----------------------------------
+    #: the preemption planner's lane batch dispatches locally; the
+    #: sidecar's RemoteSolver turns this off (no Preempt RPC — the
+    #: planner's numpy twin is bit-identical by contract)
+    supports_preempt_kernel = True
+
+    def dispatch_preempt(self, *, ex_alloc, ex_used, ex_compat, R, n,
+                         freed) -> np.ndarray:
+        """Run one preemption victim-set batch on the device: shared
+        demand/node tables plus the per-lane ``freed`` refund stack, ONE
+        dispatch for every candidate prefix
+        (scheduling/preempt_jax.preempt_solve_kernel). Returns the [B]
+        leftover-demand vector the planner picks its prefix from."""
+        import jax.numpy as jnp
+
+        from ..scheduling.preempt_jax import preempt_solve_kernel
+        out = np.asarray(preempt_solve_kernel(
+            jnp.asarray(np.asarray(ex_alloc)),
+            jnp.asarray(np.asarray(ex_used)),
+            jnp.asarray(np.asarray(ex_compat)),
+            jnp.asarray(np.asarray(R)), jnp.asarray(np.asarray(n)),
+            jnp.asarray(np.asarray(freed))))
+        self._record_dispatch(kernel="preempt",
+                              batch=int(np.asarray(freed).shape[0]),
+                              Gp=int(np.asarray(R).shape[0]), Fu=1)
+        return out
+
     # -- batched multi-solve -------------------------------------------
     #: solve_batch's vmapped dispatch runs the kernel locally; the
     #: sidecar's RemoteSolver turns this off (one buffer per RPC)
@@ -800,7 +828,7 @@ class TPUSolver(Solver):
         from ..ops.hostpack import pack_inputs1
         buf = pack_inputs1(arrays, stt["T"], stt["D"], stt["Z"],
                            stt["C"], stt["G"], stt["E"], stt["P"],
-                           stt["K"], stt["M"], stt["F"])
+                           stt["K"], stt["M"], stt["F"], stt["Q"])
         fb = 0
         if stt["F"] > 1:
             fb = self._fused_block_count(arrays["fuse"], stt["F"])
@@ -1204,6 +1232,16 @@ class TPUSolver(Solver):
             arrays.update(mv_floor=mv_floor_p, mv_pairs_t=enc.mv_pairs_t,
                           mv_pairs_v=enc.mv_pairs_v)
 
+        # priority vector (Q=1 gates the arena section; padded groups
+        # are inert at priority 0). Single-device only: the mesh path
+        # stays Q-free — decisions are priority-blind (canonical order
+        # encodes priority), so stripping the section changes nothing,
+        # and the sharded resident-arena walk keeps its layout.
+        Q = 0
+        if enc.prio is not None and ndev <= 1:
+            Q = 1
+            arrays["prio"] = padG(enc.prio)
+
         # --- fused-scan plan (ops/ffd_jax.py _solve_fused) ---------------
         # groups the encoder proves pairwise disjoint on BOTH contention
         # axes — admitted pools and compatible existing nodes — fill in
@@ -1225,7 +1263,7 @@ class TPUSolver(Solver):
             arrays["fuse"] = fuse
             Fu = min(self.dev_fuse, Gp)  # both pow2, so Fu divides Gp
         return arrays, dict(T=T, D=Dp, Z=Z, C=C, G=Gp, E=Ep, P=Pp,
-                            K=K, V=V, M=M, F=Fu)
+                            K=K, V=V, M=M, F=Fu, Q=Q)
 
     def _patch_pack_cache(self, pc, enc, ex_alloc, ex_used, ex_compat,
                           d) -> List[str]:
@@ -1245,9 +1283,19 @@ class TPUSolver(Solver):
         T, Dp, Z, C = stt["T"], stt["D"], stt["Z"], stt["C"]
         Gp, Ep, Pp = stt["G"], stt["E"], stt["P"]
         K, M, Fu = stt["K"], stt["M"], stt["F"]
+        Q = stt.get("Q", 0)
         D = len(enc.dims)
         G, E = len(enc.groups), ex_alloc.shape[0]
         dirty64, dirtyb = d.dirty_fields()
+        if "prio" in dirty64:
+            # defensive: a rows-tier delta provably cannot move prio
+            # (priority is part of the signature), but the vocabulary
+            # covers it so a future tier that does is patched, not
+            # silently stale
+            if Q and enc.prio is not None:
+                arrays["prio"][:G] = enc.prio
+            else:
+                dirty64 = [f for f in dirty64 if f != "prio"]
         if "n" in dirty64:
             arrays["n"][:G] = enc.n
         if "pool_limit" in dirty64:
@@ -1285,7 +1333,7 @@ class TPUSolver(Solver):
         if (dirty64 or dirtyb) and pc["buf"] is not None:
             spans = patch_inputs1(pc["buf"], pc["bflat"], arrays, dirty64,
                                   dirtyb, T, Dp, Z, C, Gp, Ep, Pp, K, M,
-                                  Fu)
+                                  Fu, Q)
         # the (start, stop) word sections just overwritten — the delta
         # wire's payload source: the RemoteSolver ships exactly these
         # sections over SolvePatch instead of the whole arena
@@ -1338,7 +1386,7 @@ class TPUSolver(Solver):
         K, M, Fu = stt["K"], stt["M"], stt["F"]
         if buf is None and ndev <= 1:
             buf, bflat = pack_inputs1_state(arrays, T, Dp, Z, C, Gp, Ep,
-                                            Pp, K, M, Fu)
+                                            Pp, K, M, Fu, stt.get("Q", 0))
             if dver is not None:
                 self._pack_cache = dict(enc=enc, arrays=arrays, stt=stt,
                                         buf=buf, bflat=bflat, ndev=ndev,
@@ -1367,6 +1415,7 @@ class TPUSolver(Solver):
         T, Dp, Z, C = stt["T"], stt["D"], stt["Z"], stt["C"]
         Gp, Ep, Pp = stt["G"], stt["E"], stt["P"]
         K, V, M, Fu = stt["K"], stt["V"], stt["M"], stt["F"]
+        Q = stt.get("Q", 0)
 
         # --- bucketed new-node slots with overflow retry ------------------
         # Steady state needs far fewer than n_max slots; a small N keeps the
@@ -1375,9 +1424,24 @@ class TPUSolver(Solver):
         # invariant to N once N is large enough: spare slots never fill).
         # beyond the base kernel's group cap the PRUNED kernel serves
         # (bound pass + S-slot exact; ops/ffd_jax.py) — eligible only
-        # locally, single-device, without minValues floors
+        # locally, single-device, without minValues floors or the
+        # priority arena section (its body hardcodes the Q=0 layout)
         use_pruned = (self.supports_pruned_kernel and ndev <= 1
-                      and K == 0 and Gp > self.dev_max_groups)
+                      and K == 0 and Q == 0 and Gp > self.dev_max_groups)
+        if Q and Gp > self.dev_max_groups:
+            # priority-carrying arenas past the base cap: the host twin
+            # serves (same decisions; the pruned buffer walk cannot
+            # carry the Q section) — never silently
+            import logging
+            logging.getLogger(__name__).info(
+                "padded group count %d exceeds the base kernel cap %d "
+                "with a priority arena; serving from the host twin",
+                Gp, self.dev_max_groups)
+            if self.metrics is not None:
+                self.metrics.inc(
+                    "karpenter_solver_device_fallback_total",
+                    labels={"reason": "group_cap"})
+            return self._run_numpy(enc, ex_alloc, ex_used, ex_compat)
         if ndev > 1 and Gp > self.dev_max_groups:
             # the routing gate probed the device count nonblockingly and
             # may have allowed the pruned cap before the probe resolved
@@ -1424,7 +1488,7 @@ class TPUSolver(Solver):
             else:
                 o_buf = self._dispatch(buf, T=T, D=Dp, Z=Z, C=C, G=Gp,
                                        E=Ep, P=Pp, K=K, V=V, M=M,
-                                       n_max=n_bucket, F=Fu)
+                                       n_max=n_bucket, F=Fu, Q=Q)
                 out = unpack_outputs1(o_buf, T, Dp, Z, C, Gp, Ep, Pp,
                                       n_bucket)
             exhausted = (out["leftover"].sum() > 0
@@ -1458,6 +1522,13 @@ class TPUSolver(Solver):
                 takes: np.ndarray, leftover: np.ndarray,
                 final: dict, pods_by_group=None) -> SolveResult:
         E = final["E"]
+        # per-priority-tier leftover report: host-side bookkeeping off
+        # the solve's [G] leftover vector ({0: total} when the snapshot
+        # carries no priorities) — the sim auditor and the preemption
+        # planner read which tiers the solve starved
+        from ..ops.hostpack import tier_leftovers
+        self.last_tier_leftovers = tier_leftovers(
+            np.asarray(leftover), enc.prio)
         # pods_by_group: the per-group pod LISTS this solve encoded —
         # the pipelined tick captures them at prepare time because a
         # rows-tier delta REPLACES g.pods for the next tick while this

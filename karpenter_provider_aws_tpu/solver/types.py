@@ -72,6 +72,11 @@ class SchedulingSnapshot:
     daemon_overheads: Sequence[DaemonOverhead] = ()
     #: zone -> zone_id for topology bookkeeping
     zones: Mapping[str, str] = field(default_factory=dict)
+    #: PriorityClass objects in effect when the snapshot was built; the
+    #: pods' .priority attrs are already resolved against this table.
+    #: Folded into the delta encoder's structural key (value changes
+    #: must force a full re-encode) and read by the preemption planner.
+    priority_classes: Sequence = ()
 
 
 @dataclass

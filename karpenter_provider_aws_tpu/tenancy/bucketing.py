@@ -44,7 +44,7 @@ from ..ops.hostpack import (pack_inputs1, pack_outputs1, pad_to,
 #: keys the bucket verbatim
 BUCKET_DIMS = ("T", "D", "Z", "C", "G", "E", "P")
 
-_DIM_KEYS = ("T", "D", "Z", "C", "G", "E", "P", "K", "M", "F")
+_DIM_KEYS = ("T", "D", "Z", "C", "G", "E", "P", "K", "M", "F", "Q")
 
 
 def _pow2(v: int) -> int:
@@ -87,7 +87,9 @@ def bucket_statics(kv: dict) -> dict:
 
 
 def _dims(kv: dict) -> dict:
-    return {k: kv[k] for k in _DIM_KEYS}
+    # Q is absent from pre-priority statics dicts (old clients, padded
+    # wire vectors); default 0 = priority section absent
+    return {k: kv.get(k, 0) if k == "Q" else kv[k] for k in _DIM_KEYS}
 
 
 def pad_arena(buf: np.ndarray, kv: dict, kvB: dict) -> np.ndarray:
@@ -103,6 +105,7 @@ def pad_arena(buf: np.ndarray, kv: dict, kvB: dict) -> np.ndarray:
     Tb, Db, Zb, Cb = kvB["T"], kvB["D"], kvB["Z"], kvB["C"]
     Gb, Eb, Pb = kvB["G"], kvB["E"], kvB["P"]
     K, M, F = kv["K"], kv["M"], kv["F"]
+    Q = kv.get("Q", 0)
     out = {
         "A": pad_to(v["A"], (Tb, Db)),
         "R": pad_to(v["R"], (Gb, Db)),
@@ -141,7 +144,10 @@ def pad_arena(buf: np.ndarray, kv: dict, kvB: dict) -> np.ndarray:
         # padded groups are provable no-op steps, fusable with anything
         # (same convention as the client's G pad)
         out["fuse"] = pad_to(v["fuse"], (Gb,), fill=True)
-    return pack_inputs1(out, Tb, Db, Zb, Cb, Gb, Eb, Pb, K, M, F)
+    if Q:
+        # padded groups are inert (n=0): priority 0 is fine for them
+        out["prio"] = pad_to(v["prio"], (Gb,))
+    return pack_inputs1(out, Tb, Db, Zb, Cb, Gb, Eb, Pb, K, M, F, Q)
 
 
 def unpad_outputs(obuf: np.ndarray, kv: dict, kvB: dict) -> np.ndarray:

@@ -20,7 +20,7 @@ import random
 import numpy as np
 import pytest
 
-from karpenter_provider_aws_tpu.apis.objects import Taint
+from karpenter_provider_aws_tpu.apis.objects import PriorityClass, Taint
 from karpenter_provider_aws_tpu.apis.resources import Resources
 from karpenter_provider_aws_tpu.fake.environment import Environment, make_pods
 from karpenter_provider_aws_tpu.models import encoding as encoding_mod
@@ -696,6 +696,31 @@ class TestStructuralKey:
         denc.encode(sn1, None, [])
         _, _, d = denc.encode(sn2, None, [])
         assert d.tier == "full" and d.reason == "structural-zones"
+
+    def test_priority_class_value_change_is_structural(self):
+        """Editing a PriorityClass value re-resolves EVERY pod priority
+        without touching a single pool/daemon object — the resident
+        arena's prio section would silently keep serving the old values
+        unless the change bumps the structural key."""
+        env = Environment()
+        pool = env.nodepool("pk-pool")
+        pods = make_pods(4, prefix="pk", group="pk")
+        sn1 = env.snapshot(pods, [pool])
+        sn2 = env.snapshot(pods, [pool])
+        sn1.priority_classes = (PriorityClass("bulk", value=5),)
+        sn2.priority_classes = (PriorityClass("bulk", value=5),)
+        assert structural_key(sn1) == structural_key(sn2)
+        sn2.priority_classes = (PriorityClass("bulk", value=500),)
+        assert structural_key(sn1) != structural_key(sn2)
+        denc = DeltaEncoder()
+        denc.encode(sn1, None, [])
+        _, _, d = denc.encode(sn2, None, [])
+        assert d.tier == "full" and d.reason == "structural-priority"
+        # an unchanged class set must NOT force the full path
+        sn3 = env.snapshot(pods, [pool])
+        sn3.priority_classes = (PriorityClass("bulk", value=500),)
+        _, _, d3 = denc.encode(sn3, None, [])
+        assert d3.tier != "full"
 
     def test_taint_change_forces_full_reencode(self):
         """A nodepool edit arrives as a NEW NodePool object (provider

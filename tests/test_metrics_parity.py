@@ -557,6 +557,44 @@ def emitted(tmp_path_factory):
     cev_np.metrics = op.metrics
     assert cev_np.subset_solve(cbase, [cq]) is None
 
+    # priority-preemption families: a planner over a frozen-capacity
+    # mini cluster — one feasible verdict (verdicts_total{feasible} +
+    # victims_total), one empty-demand skip (verdicts_total{skipped}),
+    # and the same plan routed through a dead device engine for
+    # host_fallback_total{device_unavailable}
+    from karpenter_provider_aws_tpu.apis.objects import PriorityClass
+    from karpenter_provider_aws_tpu.scheduling import PreemptionPlanner
+    pop = Operator()
+    pop.kube.create(EC2NodeClass("ppre-class"))
+    pop.kube.create(NodePool("ppre-pool", template=NodePoolTemplate(
+        node_class_ref=NodeClassRef("ppre-class"),
+        requirements=Requirements.from_terms(
+            [{"key": L.INSTANCE_CPU, "operator": "In", "values": ["4"]}]))))
+    for p in make_pods(6, cpu="500m", prefix="ppre-low"):
+        pop.kube.create(p)
+    pop.run_until_settled(disrupt=False)
+    pused = Resources()
+    for c in pop.kube.list("NodeClaim"):
+        pused = pused + (c.capacity if not c.capacity.is_zero()
+                         else c.resources_requested)
+    ppool_obj = pop.kube.get("NodePool", "ppre-pool")
+    ppool_obj.limits = pused
+    pop.kube.update(ppool_obj)
+    pop.kube.create(PriorityClass("ppre-high", value=1000))
+    phi = make_pods(1, cpu="1", prefix="ppre-hi")[0]
+    phi.priority_class_name = "ppre-high"
+    pop.kube.create(phi)
+    psnap = pop.provisioner.build_snapshot(pop.state.pending_pods())
+    psolved = pop.provisioner.solver.solve(psnap)
+    pplanner = PreemptionPlanner(solver=TPUSolver(backend="numpy"),
+                                 metrics=op.metrics)
+    assert pplanner.plan(psnap, list(psolved.unschedulable),
+                         pop.state).feasible  # feasible + victims_total
+    pplanner.plan(psnap, [], pop.state)       # skipped
+    pdead = PreemptionPlanner(solver=dead, metrics=op.metrics)
+    pdead.plan(psnap, list(psolved.unschedulable),
+               pop.state)  # host_fallback{device_unavailable}
+
     # distributed mesh-group families: the coordinator emits the
     # dispatch + degrade taxonomy in local mode (workers=0 — no
     # subprocesses in the parity run); the worker-side patch counter

@@ -1,0 +1,383 @@
+"""Priority-aware preemption: parity, gates, and the priority-off
+fingerprint identity.
+
+Three contracts pinned here:
+
+1. **Verdict-and-Command byte identity** — the device lane search
+   (scheduling/preempt_jax.preempt_solve_kernel via
+   TPUSolver.dispatch_preempt) must produce byte-identical
+   PreemptCommands to the planner's numpy oracle twin on every seeded
+   scenario: same victims in the same order, same demand, same applied
+   evictions/nominations. Tier-1 keeps a few seeds plus targeted edge
+   cases (PDB-blocked victims, preemptionPolicy=Never demand,
+   equal-priority ties); the slow sweep (hack/fuzzpreempt.sh,
+   `make fuzz-preempt`) widens them.
+
+2. **Hard gates** — daemonset/critical pods are never victims, victims
+   rank strictly below the lowest blocked demand priority, PDB
+   allowances are consumed cumulatively, Never-policy demand never
+   triggers a search.
+
+3. **Priority-off identity** — with no PriorityClass objects the
+   encoding carries no priority section (``enc.prio is None``, wire
+   Q=0) and solver decisions are fingerprint-identical to a build that
+   never resolved priorities at all.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from karpenter_provider_aws_tpu.apis import labels as L
+from karpenter_provider_aws_tpu.apis.objects import (EC2NodeClass,
+                                                     NodeClassRef, NodePool,
+                                                     NodePoolTemplate,
+                                                     PodDisruptionBudget,
+                                                     PriorityClass,
+                                                     resolve_pod_priorities)
+from karpenter_provider_aws_tpu.apis.requirements import Requirements
+from karpenter_provider_aws_tpu.apis.resources import Resources
+from karpenter_provider_aws_tpu.fake.environment import (Environment,
+                                                         make_pods,
+                                                         reset_pod_counter)
+from karpenter_provider_aws_tpu.models.encoding import encode_snapshot
+from karpenter_provider_aws_tpu.operator import Operator
+from karpenter_provider_aws_tpu.scheduling import PreemptionPlanner
+from karpenter_provider_aws_tpu.scheduling.preempt import _lanes_numpy
+from karpenter_provider_aws_tpu.solver.cpu import CPUSolver
+from karpenter_provider_aws_tpu.solver.tpu import TPUSolver
+
+ROUNDS = 2
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def _mk_operator(backend):
+    """Operator with the base solve on the CPU oracle (identical in both
+    arms) and the preemption planner on the requested backend."""
+    import itertools
+
+    from karpenter_provider_aws_tpu.controllers import provisioning as prov
+    from karpenter_provider_aws_tpu.fake import ec2 as fec2
+    from karpenter_provider_aws_tpu.fake import environment as fenv
+    fenv.reset_pod_counter()
+    prov._claim_seq = itertools.count(1)
+    fec2._id_counter = itertools.count(1)
+    clock = FakeClock()
+    op = Operator(clock=clock)
+    solver = TPUSolver(backend="jax") if backend == "jax" else None
+    planner = PreemptionPlanner(solver=solver, backend=backend,
+                                metrics=op.metrics)
+    op.preempt_planner = planner
+    op.provisioner.preempt_planner = planner
+    op.kube.create(EC2NodeClass("pz-class"))
+    return op, clock, planner
+
+
+_CPU_MENUS = (["4", "16"], ["2", "8"], ["4", "8", "16"])
+
+
+def verdict_fingerprint(v):
+    if v is None:
+        return None
+    # backend and fallback reason are deliberately NOT part of the
+    # fingerprint: the two arms may route differently, their DECISIONS
+    # may not. Skip reasons (no demand / no victims) are backend-free
+    # and stay comparable via feasible+lanes+victims.
+    return (v.feasible, v.lanes, v.leftovers,
+            tuple(p.full_name() for p in v.victims),
+            tuple(p.full_name() for p in v.demand),
+            v.command.to_bytes() if v.command is not None else None)
+
+
+def run_preempt_scenario(seed, backend):
+    """One seeded mixed-priority churn scenario. All randomness comes
+    from `seed`, so two runs differing only in the planner backend see
+    identical cluster states round for round."""
+    rng = random.Random(seed)
+    op, clock, planner = _mk_operator(backend)
+    op.kube.create(PriorityClass("bulk", value=rng.randint(1, 5)))
+    op.kube.create(PriorityClass("high", value=1000))
+    op.kube.create(PriorityClass("sacred", value=900,
+                                 preemption_policy="Never"))
+    for pi in range(rng.randint(1, 2)):
+        op.kube.create(NodePool(f"pz{pi}", template=NodePoolTemplate(
+            node_class_ref=NodeClassRef("pz-class"),
+            requirements=Requirements.from_terms(
+                [{"key": L.INSTANCE_CPU, "operator": "In",
+                  "values": rng.choice(_CPU_MENUS)}]))))
+    # low-tier filler waves: mixed priorities 0/bulk, some PDB-covered
+    for b in range(rng.randint(2, 3)):
+        for p in make_pods(rng.randint(2, 5),
+                           cpu=rng.choice(["500m", "1", "1500m"]),
+                           memory=rng.choice(["1Gi", "2Gi"]),
+                           prefix=f"lo{b}"):
+            if rng.random() < 0.4:
+                p.priority_class_name = "bulk"
+            if rng.random() < 0.4:
+                p.metadata.labels["guarded"] = "yes"
+            op.kube.create(p)
+    if rng.random() < 0.8:
+        op.kube.create(PodDisruptionBudget(
+            "guard", {"guarded": "yes"},
+            max_unavailable=rng.choice([0, 1])))
+    op.run_until_settled(disrupt=False)
+    # freeze capacity at current usage: new nodes become impossible, so
+    # high-priority arrivals must preempt or stay pending
+    for np_ in op.kube.list("NodePool"):
+        np_.limits = op.state.nodepool_usage().get(np_.name, Resources())
+        op.kube.update(np_)
+    wave = make_pods(rng.randint(1, 2), cpu=rng.choice(["1", "2"]),
+                     prefix="hi")
+    for p in wave:
+        p.priority_class_name = "high"
+        op.kube.create(p)
+    if rng.random() < 0.5:
+        nv = make_pods(1, cpu="1", prefix="nv")[0]
+        nv.priority_class_name = "sacred"
+        op.kube.create(nv)
+    trace = []
+    for _ in range(ROUNDS):
+        res = op.provisioner.reconcile()
+        trace.append((tuple(sorted(res.unschedulable)),
+                      tuple(sorted(res.nominated.items())),
+                      tuple(sorted(res.preempted.items())),
+                      verdict_fingerprint(res.preempt)))
+        op.run_until_settled(disrupt=False)
+        clock.t += 30
+    bound = tuple(sorted((p.full_name(), p.node_name)
+                         for p in op.kube.list("Pod") if p.node_name))
+    return trace, bound, op, planner
+
+
+def _strip_backend(trace):
+    return trace  # fingerprints exclude the backend field by design
+
+
+def _assert_parity(seed):
+    from karpenter_provider_aws_tpu.solver.route import device_alive
+    device_alive()  # resolve the async probe so the jax arm engages
+    trace_h, bound_h, _op, _pl = run_preempt_scenario(seed, "numpy")
+    trace_d, bound_d, op, planner = run_preempt_scenario(seed, "jax")
+    assert trace_d == trace_h, f"seed {seed} diverged"
+    assert bound_d == bound_h, f"seed {seed} terminal bindings diverged"
+    return trace_d, op, planner
+
+
+class TestPlannerParity:
+    """Device verdicts and applied Commands byte-identical to the numpy
+    oracle twin."""
+
+    @pytest.mark.parametrize("seed", [0, 3, 11])
+    def test_churn_parity(self, seed):
+        _assert_parity(seed)
+
+    def test_device_path_engages(self):
+        """The parity above is vacuous if the jax arm silently fell back
+        to the host twin — require the preempt kernel to have answered."""
+        from karpenter_provider_aws_tpu.solver.route import device_alive
+        assert device_alive()
+        engaged = False
+        for seed in (0, 3, 11, 5):
+            trace, op, planner = _assert_parity(seed)
+            ran = [fp for fp in trace if fp[3] is not None
+                   and fp[3][1] > 0]  # lanes evaluated
+            if ran:
+                assert planner.solver.last_dispatch_stats["kernel"] == \
+                    "preempt"
+                assert res_backend(trace, op) == "device"
+                engaged = True
+                break
+        assert engaged, "no seed exercised the lane search"
+
+
+def res_backend(trace, op):
+    """The backend the LAST ran search used (verdict fingerprints are
+    backend-free; the live verdict object holds it)."""
+    # the operator's provisioner stashed the verdict on its last result;
+    # walk the planner's metrics instead: zero host_fallback and a
+    # nonzero verdict counter means the device answered
+    fb = sum(v for (name, _lk), v in op.metrics.counters.items()
+             if name == "karpenter_solver_preempt_host_fallback_total")
+    return "device" if fb == 0 else "host"
+
+
+class TestGates:
+    def _cluster(self, planner_backend="numpy", pdb=None, never=False,
+                 critical_victims=False):
+        op, clock, planner = _mk_operator(planner_backend)
+        op.kube.create(PriorityClass("high", value=1000))
+        op.kube.create(NodePool("pz0", template=NodePoolTemplate(
+            node_class_ref=NodeClassRef("pz-class"),
+            requirements=Requirements.from_terms(
+                [{"key": L.INSTANCE_CPU, "operator": "In",
+                  "values": ["4"]}]))))
+        low = make_pods(6, cpu="500m", prefix="low")
+        for p in low:
+            if critical_victims:
+                p.priority_class_name = "system-cluster-critical"
+            if pdb is not None:
+                p.metadata.labels["app"] = "guarded"
+            op.kube.create(p)
+        if pdb is not None:
+            op.kube.create(PodDisruptionBudget(
+                "guard", {"app": "guarded"}, max_unavailable=pdb))
+        op.run_until_settled(disrupt=False)
+        for np_ in op.kube.list("NodePool"):
+            np_.limits = op.state.nodepool_usage().get(
+                np_.name, Resources())
+            op.kube.update(np_)
+        hi = make_pods(1, cpu="1", prefix="hi")[0]
+        hi.priority_class_name = "sacred" if never else "high"
+        if never:
+            op.kube.create(PriorityClass("sacred", value=900,
+                                         preemption_policy="Never"))
+        op.kube.create(hi)
+        return op, low, hi
+
+    def test_preempts_and_requeues(self):
+        op, low, hi = self._cluster()
+        res = op.provisioner.reconcile()
+        assert res.preempt is not None and res.preempt.feasible
+        assert hi.full_name() in res.nominated
+        assert res.preempted
+        # victims requeue at their own priority: pending again, unbound
+        victims = [p for p in low if p.full_name() in res.preempted]
+        assert victims and all(not p.node_name and p.phase == "Pending"
+                               for p in victims)
+        assert all(p.full_name() in
+                   {q.full_name() for q in op.state.pending_pods()}
+                   for p in victims)
+
+    def test_equal_priority_ties_deterministic(self):
+        """Identical victims: the lexicographically-first pod is chosen,
+        every run."""
+        names = set()
+        for _ in range(3):
+            op, low, hi = self._cluster()
+            res = op.provisioner.reconcile()
+            assert res.preempt.feasible
+            names.add(tuple(sorted(res.preempted)))
+        assert len(names) == 1
+        assert list(names)[0] == (min(p.full_name() for p in low),)
+
+    def test_pdb_exhausted_blocks_all_victims(self):
+        op, low, hi = self._cluster(pdb=0)
+        res = op.provisioner.reconcile()
+        assert res.preempt is not None and not res.preempt.feasible
+        assert res.preempt.reason == "no eligible victims"
+        assert not res.preempted
+        assert hi.full_name() in res.unschedulable
+
+    def test_pdb_allowance_caps_victims(self):
+        """maxUnavailable=1: at most one guarded pod may be evicted even
+        when the demand would prefer more."""
+        op, low, hi = self._cluster(pdb=1)
+        res = op.provisioner.reconcile()
+        if res.preempt.feasible:
+            assert len(res.preempted) <= 1
+
+    def test_never_policy_demand_skips_search(self):
+        op, low, hi = self._cluster(never=True)
+        res = op.provisioner.reconcile()
+        assert res.preempt is not None and not res.preempt.feasible
+        assert res.preempt.reason == "no eligible demand"
+        assert not res.preempted
+        assert hi.full_name() in res.unschedulable
+
+    def test_critical_pods_never_victims(self):
+        op, low, hi = self._cluster(critical_victims=True)
+        res = op.provisioner.reconcile()
+        assert res.preempt is not None and not res.preempt.feasible
+        assert not res.preempted
+        assert all(p.node_name for p in low)
+
+
+class TestKernelTwinParity:
+    """Direct kernel-vs-numpy-twin equality on random tables."""
+
+    @pytest.mark.parametrize("seed", [0, 1, 2, 7])
+    def test_random_tables(self, seed):
+        from karpenter_provider_aws_tpu.scheduling.preempt_jax import \
+            preempt_solve_kernel
+        rng = np.random.RandomState(seed)
+        E, D, G, B = (rng.randint(1, 6), rng.randint(1, 4),
+                      rng.randint(1, 5), rng.randint(1, 9))
+        ex_alloc = rng.randint(0, 16, size=(E, D)).astype(np.int64)
+        ex_used = rng.randint(0, 16, size=(E, D)).astype(np.int64)
+        ex_compat = rng.rand(G, E) < 0.7
+        R = rng.randint(0, 5, size=(G, D)).astype(np.int64)
+        n = rng.randint(0, 6, size=G).astype(np.int64)
+        freed = rng.randint(0, 8, size=(B, E, D)).astype(np.int64)
+        host = _lanes_numpy(ex_alloc, ex_used, ex_compat, R, n, freed)
+        dev = np.asarray(preempt_solve_kernel(
+            ex_alloc, ex_used, ex_compat, R, n, freed))
+        np.testing.assert_array_equal(host, dev)
+
+
+class TestPriorityDisabledIdentity:
+    """Acceptance gate: a run with no PriorityClass objects is
+    fingerprint-identical to a build that never resolved priorities."""
+
+    def _snap(self, env):
+        np_, nc = env.nodepool("idp", requirements=[
+            {"key": L.INSTANCE_CPU, "operator": "In", "values": ["4", "8"]}])
+        pods = (make_pods(7, cpu="700m", prefix="ida")
+                + make_pods(5, cpu="1500m", memory="3Gi", prefix="idb"))
+        return env.snapshot(pods, [(np_, nc)]), pods
+
+    def test_no_priorityclass_is_q_free_and_identical(self):
+        reset_pod_counter()
+        env = Environment()
+        snap_a, pods_a = self._snap(env)
+        base_cpu = CPUSolver().solve(snap_a).decision_fingerprint()
+        base_tpu = TPUSolver(backend="numpy").solve(
+            snap_a).decision_fingerprint()
+
+        reset_pod_counter()
+        env2 = Environment()
+        snap_b, pods_b = self._snap(env2)
+        resolve_pod_priorities(pods_b, [])  # the provisioner's resolve
+        enc = encode_snapshot(snap_b)
+        assert enc.prio is None  # wire stays Q=0 / prio-free
+        assert CPUSolver().solve(snap_b).decision_fingerprint() == base_cpu
+        assert TPUSolver(backend="numpy").solve(
+            snap_b).decision_fingerprint() == base_tpu
+
+    def test_priority_changes_group_order_not_membership(self):
+        """Priorities reorder the canonical solve order (higher first)
+        without disturbing grouping."""
+        reset_pod_counter()
+        env = Environment()
+        snap, pods = self._snap(env)
+        resolve_pod_priorities(
+            pods, [PriorityClass("boost", value=50)])
+        for p in pods:
+            if p.metadata.name.startswith("idb"):
+                p.priority_class_name = "boost"
+        resolve_pod_priorities(
+            pods, [PriorityClass("boost", value=50)])
+        enc = encode_snapshot(snap)
+        assert enc.prio is not None
+        # boosted groups come first in canonical order
+        first = enc.groups[0].pods[0]
+        assert first.metadata.name.startswith("idb")
+        assert enc.prio[0] == 50
+
+
+@pytest.mark.slow
+class TestFuzzSweep:
+    """hack/fuzzpreempt.sh's bar: a wide seed sweep of mixed-priority
+    churn with PDB-blocked victims, Never-policy pods and equal-priority
+    ties — verdicts and applied Commands byte-identical every round."""
+
+    @pytest.mark.parametrize("seed", list(range(10)))
+    def test_seed_sweep(self, seed):
+        _assert_parity(seed)
