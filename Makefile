@@ -52,6 +52,9 @@ chaos-heal:  ## seeded self-heal storm (kill/wedge workers, supervised regroup)
 fuzz-delta:  ## 10-seed mutation-sequence fuzz of the incremental encoder
 	sh hack/fuzzdelta.sh
 
+fuzz-suffix:  ## 10-seed churn fuzz + kernel byte-parity sweep of the incremental solve
+	sh hack/fuzzsuffix.sh
+
 fuzz-consolidate:  ## seeded device-vs-oracle consolidation parity sweep
 	sh hack/fuzzconsolidate.sh
 
@@ -91,4 +94,4 @@ multihost:  ## multi-PROCESS distributed mesh: 1M-pod ceiling + chaos + suite
 daemon:  ## run the operator against the in-memory cloud
 	python -m karpenter_provider_aws_tpu --cluster-name dev --metrics-port 8080
 
-.PHONY: test test-all scale deflake benchmark consolidate-evidence multichip multihost daemon chart chaos chaoscloud chaos-tenant chaos-patch chaos-fleet chaos-heal fuzz-delta fuzz-consolidate fuzz-preempt native native-try aot-prime sim
+.PHONY: test test-all scale deflake benchmark consolidate-evidence multichip multihost daemon chart chaos chaoscloud chaos-tenant chaos-patch chaos-fleet chaos-heal fuzz-delta fuzz-suffix fuzz-consolidate fuzz-preempt native native-try aot-prime sim

@@ -22,6 +22,14 @@ zero XLA compilation — no warm-up tax, no first-tick latency cliff.
 The replay runs enough ticks for the solver's slot-bucket shrink to
 settle (8-solve window), so both the cold 256-slot kernel and the
 steady-state narrow kernel get recorded.
+
+The incremental solve rides along for free: the replay's full solves
+dispatch the checkpoint-recording kernel, and bank adoption eagerly
+compiles EVERY suffix bucket of the ladder (solver/tpu.py
+_prime_suffix), so the store ends up holding one
+``solve_scan_suffix`` executable per (statics, SUF) class — a fresh
+replica's first warm tick serves its suffix with zero tracing too.
+The per-kernel breakdown printed at the end is the evidence.
 """
 
 from __future__ import annotations
@@ -49,9 +57,12 @@ def main() -> int:
 
     from karpenter_provider_aws_tpu.tenancy.compilecache import (
         activate_aot, aot_counts, configure_compile_cache,
-        host_isa_fingerprint, pin_host_isa)
+        host_isa_fingerprint, pin_cpu_singlethread, pin_host_isa)
 
     tier = pin_host_isa()
+    # record under the serving thread config (single-thread XLA:CPU —
+    # the warm-tick path pins the same way; see pin_cpu_singlethread)
+    pin_cpu_singlethread()
     cache_dir = configure_compile_cache(args.cache_dir)
     store = activate_aot(record=True, root=args.cache_dir)
     print(f"host fingerprint {host_isa_fingerprint()}"
@@ -75,6 +86,13 @@ def main() -> int:
     n = store.preload()
     print(f"recorded {counts['recorded']} executable(s); "
           f"{n} resident in {store.path}")
+    by_kernel: dict = {}
+    for fn in sorted(os.listdir(store.path)):
+        if fn.endswith(".aot"):
+            nm = fn[:-4].rsplit("-", 1)[0]
+            by_kernel[nm] = by_kernel.get(nm, 0) + 1
+    for nm, c in sorted(by_kernel.items()):
+        print(f"  {nm}: {c} shape class(es)")
     return 0 if counts["recorded"] > 0 or n > 0 else 1
 
 

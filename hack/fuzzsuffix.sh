@@ -1,0 +1,31 @@
+#!/bin/sh
+# Seeded churn fuzz for the incremental (suffix) solve.
+#
+# Runs the `slow`-marked matrix of tests/test_incremental_solve.py:
+#
+# - a 10-seed randomized churn sweep: each seed replays a weighted
+#   mutation palette (random-group churn, last-group-only churn,
+#   frontier-0 churn, node rebinds, structural new-signature joins)
+#   through a bank-holding TPUSolver and asserts, at EVERY tick, that
+#   the decision fingerprint equals a from-scratch CPU-oracle solve of
+#   the same snapshot — zero divergence tolerated, whichever mix of
+#   suffix-served and full-re-record ticks the sequence produces (each
+#   seed must serve at least one suffix tick, so the sweep can never
+#   green-wash by full-solving everything);
+# - the exhaustive kernel byte-parity sweep: every (checkpoint row,
+#   suffix bucket, live bound) combination of randomized packed arenas
+#   reproduces solve_scan_packed1 byte-for-byte — takes/leftover over
+#   the scanned window, every carry-derived output field, and the
+#   spliced checkpoint bank itself.
+#
+# Tier-1 stays fast: it runs the planning/frontier unit matrix and the
+# staleness-edge regressions; this sweep is the long-haul version.
+#
+# Usage: sh hack/fuzzsuffix.sh           # the full slow matrix
+#        sh hack/fuzzsuffix.sh -x -q     # extra pytest args pass through
+set -e
+cd "$(dirname "$0")/.."
+
+JAX_PLATFORMS=cpu exec python -m pytest \
+    "tests/test_incremental_solve.py" \
+    -m slow -q -p no:cacheprovider "$@"

@@ -82,6 +82,15 @@ class SnapshotDelta:
     ex_rows_dirty: bool = False    # ex_alloc/ex_used moved (or E changed)
     ex_compat_dirty: bool = False  # ex_compat moved (or E changed)
     prio_dirty: bool = False       # enc.prio moved (group priorities)
+    #: minimum canonical group index whose ROW moved this encode (count,
+    #: membership, or priority) — the incremental-solve resume bound: the
+    #: scan carry entering group i depends only on groups < i, so a
+    #: checkpointed solve may resume at or below this index. 0 (resume
+    #: from scratch = the existing full solve) whenever anything OUTSIDE
+    #: the group axis moved (pools, existing rows/compat, node set) or
+    #: the tier is not rows — the conservative fallback IS the oracle.
+    #: G (nothing moved) is possible on hit-tier encodes.
+    dirty_frontier: int = 0
 
     def dirty_fields(self) -> Tuple[List[str], List[str]]:
         """The dirty flags as kernel-input field names, (int64 fields,
@@ -334,7 +343,7 @@ class DeltaEncoder:
         derived tensor is already correct. Patch what can move: pod
         membership/counts, pool dynamic vectors, existing-node tables."""
         enc = self._enc
-        d = SnapshotDelta(tier="hit")
+        d = SnapshotDelta(tier="hit", dirty_frontier=len(pod_groups))
         n = enc.n
         for i, (_sig, plist) in enumerate(pod_groups):
             g = enc.groups[i]
@@ -347,6 +356,11 @@ class DeltaEncoder:
                 # the identity fast path stays warm next tick
                 g.pods = plist
                 continue
+            # the loop ascends canonical order, so the FIRST dirty group
+            # is the min — membership churn counts even when the count
+            # is unchanged (conservative: the row's bytes may not move,
+            # the frontier still drops)
+            d.dirty_frontier = min(d.dirty_frontier, i)
             d.groups_changed += 1
             d.pods_added += max(0, len(plist) - len(old))
             d.pods_removed += max(0, len(old) - len(plist))
@@ -389,6 +403,12 @@ class DeltaEncoder:
         self._patch_existing(enc, existing, d)
         d.patched_rows = (d.groups_changed + d.nodes_added
                           + d.nodes_changed)
+        if (d.pools_dirty or d.ex_rows_dirty or d.ex_compat_dirty
+                or d.nodes_added or d.nodes_removed or d.nodes_changed):
+            # node-side dirtiness feeds the scan's initial carry (pool
+            # vectors, existing rows) or every step (compat): no prefix
+            # checkpoint survives it
+            d.dirty_frontier = 0
         if (d.groups_changed or d.n_dirty or d.pools_dirty
                 or d.ex_rows_dirty or d.ex_compat_dirty
                 or d.nodes_added or d.nodes_removed or d.nodes_changed):

@@ -116,6 +116,45 @@ class NodeState:
         return st
 
 
+def snapshot_state(st: NodeState) -> dict:
+    """Checkpoint of everything a group fill mutates — the host twin of
+    the device kernel's carry bank (solver/incremental.py). ``ex_alloc``
+    / ``ex_compat`` are read-only inputs and deliberately not captured:
+    any tick on which they move invalidates every checkpoint (dirty
+    frontier 0) before a restore could alias them. ``full_for`` and
+    ``cap_hint`` ARE captured — both are monotone caches whose state at
+    group *i* depends on the fill history, and a resumed suffix must
+    probe exactly what the from-scratch solve would have."""
+    return dict(
+        used=st.used.copy(), types=st.types.copy(),
+        zones=st.zones.copy(), ct=st.ct.copy(), pool=st.pool.copy(),
+        alive=st.alive.copy(), num_nodes=st.num_nodes,
+        pool_used=st.pool_used.copy(),
+        full_for={k: v.copy() for k, v in st.full_for.items()},
+        cap_hint=None if st.cap_hint is None else st.cap_hint.copy())
+
+
+def restore_state(st: NodeState, snap: dict) -> None:
+    """Rewind ``st`` to a ``snapshot_state`` checkpoint, leaving the
+    checkpoint pristine for future restores."""
+    st.used[:] = snap["used"]
+    st.types[:] = snap["types"]
+    st.zones[:] = snap["zones"]
+    st.ct[:] = snap["ct"]
+    st.pool[:] = snap["pool"]
+    st.alive[:] = snap["alive"]
+    st.num_nodes = snap["num_nodes"]
+    st.pool_used[:] = snap["pool_used"]
+    st.full_for = {k: v.copy() for k, v in snap["full_for"].items()}
+    if snap["cap_hint"] is None:
+        st.cap_hint = None
+    else:
+        if st.cap_hint is None:
+            st.cap_hint = snap["cap_hint"].copy()
+        else:
+            st.cap_hint[:] = snap["cap_hint"]
+
+
 def _headroom(A_eff: np.ndarray, used: np.ndarray, R: np.ndarray) -> np.ndarray:
     """min_d floor((A_eff - used)/R) over dims with R>0; shapes broadcast.
     Result clipped at 0."""

@@ -277,13 +277,18 @@ def _solve(inp: KernelInputs, n_max: int, E: int, P: int,
 
 
 def _fill_phase(inp: KernelInputs, carry: Carry, R, n, F, agz, agc, admit,
-                ex_compat, *, axis, P, E, N, V, sum_only):
+                ex_compat, *, axis, P, E, N, V, sum_only,
+                pool_clipped=None):
     """Steps 1-4 of one group fill, WITHOUT mutating the carry: returns
     (take [N], n_rem, cand [N, T]). Factored out of plain_group_step so
     the fused kernel can vmap it over a run of pairwise pool/existing-
     disjoint groups from the same block-start carry — disjointness makes
     every quantity read here (slot masks, existing headrooms, pool
-    budgets) identical to what the sequential execution would read."""
+    budgets) identical to what the sequential execution would read.
+
+    ``pool_clipped`` is the precomputed ``clip(carry.pool, 0, P-1)``
+    when the caller already needs it for its own pool accounting (the
+    plain step does) — one clip per step instead of two."""
     T, D = inp.A.shape
     Z = inp.agz.shape[1]
     C = inp.agc.shape[1]
@@ -293,7 +298,8 @@ def _fill_phase(inp: KernelInputs, carry: Carry, R, n, F, agz, agc, admit,
     zc = ((carry.zones & agz[None, :])[:, :, None]
           & (carry.ct & agc[None, :])[:, None, :]).reshape(N, Z * C)
     off_ok = (zc.astype(jnp.int32) @ inp.avail_zc.T.astype(jnp.int32)) > 0
-    pool_clipped = jnp.clip(carry.pool, 0, P - 1)
+    if pool_clipped is None:
+        pool_clipped = jnp.clip(carry.pool, 0, P - 1)
     adm_open = jnp.where(carry.pool >= 0, admit[pool_clipped], False)
     cand = carry.types & F[None, :] & off_ok & adm_open[:, None]
 
@@ -340,9 +346,11 @@ def plain_group_step(inp: KernelInputs, carry: Carry, xs, *, axis, P, E, N,
     math for its non-topology groups, sharing this single implementation
     with the plain kernel."""
     R, n, F, agz, agc, admit, daemon, ex_compat = xs
+    pool_clipped = jnp.clip(carry.pool, 0, P - 1)
     take, n_rem, cand = _fill_phase(
         inp, carry, R, n, F, agz, agc, admit, ex_compat,
-        axis=axis, P=P, E=E, N=N, V=V, sum_only=sum_only)
+        axis=axis, P=P, E=E, N=N, V=V, sum_only=sum_only,
+        pool_clipped=pool_clipped)
 
     # ---- narrowing + pool accounting for the filled slots ---------
     used = carry.used + take[:, None] * R[None, :]
@@ -351,7 +359,6 @@ def plain_group_step(inp: KernelInputs, carry: Carry, xs, *, axis, P, E, N,
     types = jnp.where(filled_open[:, None], cand & fit_all, carry.types)
     zones = jnp.where(filled_open[:, None], carry.zones & agz[None, :], carry.zones)
     ct = jnp.where(filled_open[:, None], carry.ct & agc[None, :], carry.ct)
-    pool_clipped = jnp.clip(carry.pool, 0, P - 1)
     take_by_pool = jax.ops.segment_sum(
         take, pool_clipped * (carry.pool >= 0) + (carry.pool < 0) * P,
         num_segments=P + 1)[:P]
@@ -674,11 +681,15 @@ def _solve_fused(inp: KernelInputs, n_max: int, E: int, P: int, Fu: int,
     def vec_block(args):
         carry, xs_blk = args
         R, n, F, agz, agc, admit, daemon, ex_compat = xs_blk
+        # one clip for the whole block: the vmapped fills and the pool
+        # accounting below all read the same block-start carry
+        pool_clipped = jnp.clip(carry.pool, 0, P - 1)
 
         def fill(R_, n_, F_, agz_, agc_, admit_, exc_):
             return _fill_phase(inp, carry, R_, n_, F_, agz_, agc_,
                                admit_, exc_, axis=None, P=P, E=E, N=N,
-                               V=V, sum_only=False)
+                               V=V, sum_only=False,
+                               pool_clipped=pool_clipped)
 
         take_f, n_rem_f, cand_f = jax.vmap(fill)(
             R, n, F, agz, agc, admit, ex_compat)
@@ -701,7 +712,6 @@ def _solve_fused(inp: KernelInputs, n_max: int, E: int, P: int, Fu: int,
         agc_keep = jnp.where(filled_f[:, :, None], agc[:, None, :],
                              True).all(axis=0)
         ct = jnp.where(any_filled[:, None], carry.ct & agc_keep, carry.ct)
-        pool_clipped = jnp.clip(carry.pool, 0, P - 1)
         seg = pool_clipped * (carry.pool >= 0) + (carry.pool < 0) * P
 
         def pool_delta(take_, R_):
@@ -737,6 +747,105 @@ def _solve_fused(inp: KernelInputs, n_max: int, E: int, P: int, Fu: int,
     final, (takes_b, left_b) = jax.lax.scan(step, carry0,
                                             xs_b + (blk_indep,))
     return takes_b.reshape(G, N), left_b.reshape(G), final
+
+
+# ---------------------------------------------------------------------------
+# Checkpointed scan + suffix-only re-solve.
+#
+# The carry entering group i is a pure function of groups < i (the scan
+# order IS the restriction-stable canonical order), so a tick whose dirty
+# rows all sit at or past a frontier index f can resume from a saved
+# carry at a checkpoint <= f and re-scan only the suffix. The chunked
+# kernel below scans G in G/CK chunks of CK plain steps and emits each
+# chunk's ENTRY carry as one row of a [G/CK, ...] checkpoint bank — the
+# same step math as ``_solve`` applied in the same order, so outputs are
+# bit-identical (all decision arithmetic is integer-exact).
+#
+# The suffix kernel restores a caller-provided checkpoint carry and scans
+# only the last SUF*CK groups, re-emitting the suffix's own mini bank so
+# the resident bank stays fully fresh after every warm tick. SUF is a
+# STATIC rounded up the tenancy/bucketing.py {2^k, 1.5*2^k} ladder by the
+# dispatcher, so warm frontiers land on a handful of compiled shape
+# classes instead of one per frontier. Exactness of the splice (suffix
+# takes over the resident prefix takes) is by construction: the prefix
+# groups' inputs are unchanged, so their recorded outputs and the
+# checkpoint carry are exactly what a from-scratch solve would recompute.
+# ---------------------------------------------------------------------------
+
+
+def _make_carry0(inp: KernelInputs, N: int, E: int) -> Carry:
+    """The scan's initial node state — shared verbatim by the base,
+    fused and checkpointed kernels (ex_used0 rides the carry, which is
+    why existing-row dirtiness invalidates every checkpoint)."""
+    T, D = inp.A.shape
+    Z = inp.agz.shape[1]
+    C = inp.agc.shape[1]
+    return Carry(
+        used=jnp.zeros((N, D), jnp.int64).at[:E].set(inp.ex_used0),
+        types=jnp.zeros((N, T), bool),
+        zones=jnp.zeros((N, Z), bool),
+        ct=jnp.zeros((N, C), bool),
+        pool=jnp.full((N,), -1, jnp.int32).at[:E].set(-2),
+        alive=jnp.zeros((N,), bool).at[:E].set(True),
+        num_nodes=jnp.int32(0),
+        pool_used=inp.pool_used0,
+    )
+
+
+def _chunked_scan(inp: KernelInputs, carry0: Carry, xs, *, P, E, N, V,
+                  CK: int):
+    """Scan ``xs`` (any group count divisible by CK) from ``carry0`` in
+    chunks of CK plain steps, emitting each chunk's entry carry. Returns
+    (takes [Gs, N] int32, leftover [Gs], final carry, bank) where
+    ``bank`` is a Carry of [Gs/CK, ...] stacked entry states
+    (bank[j] = carry entering group j*CK of this scan)."""
+    Gs = xs[0].shape[0]
+    NC = Gs // CK
+    slot_idx = jnp.arange(N)
+    xs_c = tuple(x.reshape((NC, CK) + x.shape[1:]) for x in xs)
+
+    def chunk(carry, xs_blk):
+        entry = carry
+        takes, lefts = [], []
+        for i in range(CK):
+            xs_i = tuple(x[i] for x in xs_blk)
+            carry, (tk, lf) = plain_group_step(
+                inp, carry, xs_i, axis=None, P=P, E=E, N=N, V=V,
+                slot_idx=slot_idx)
+            takes.append(tk.astype(jnp.int32))
+            lefts.append(lf)
+        return carry, (jnp.stack(takes), jnp.stack(lefts), entry)
+
+    final, (takes_c, left_c, bank) = jax.lax.scan(chunk, carry0, xs_c)
+    return (takes_c.reshape(Gs, N), left_c.reshape(Gs), final, bank)
+
+
+def _solve_ckpt(inp: KernelInputs, n_max: int, E: int, P: int, V: int,
+                CK: int):
+    """Full solve that additionally records the checkpoint bank.
+    Decisions are bit-identical to ``_solve`` (same steps, same order);
+    the caller guarantees G % CK == 0 (both pow2-bucketed)."""
+    N = E + n_max
+    xs = (inp.R, inp.n, inp.F, inp.agz, inp.agc, inp.admit, inp.daemon,
+          inp.ex_compat)
+    return _chunked_scan(inp, _make_carry0(inp, N, E), xs,
+                         P=P, E=E, N=N, V=V, CK=CK)
+
+
+def _solve_suffix(inp: KernelInputs, ck: Carry, n_max: int, E: int,
+                  P: int, V: int, CK: int, SUF: int, GL: int):
+    """Resume from checkpoint carry ``ck`` (the state entering group
+    GL - SUF*CK) and scan only the SUF*CK groups up to the live bound
+    ``GL`` (the chunk-aligned end of the non-empty groups: every group
+    past it has n == 0 and is a carry no-op, so skipping it changes no
+    output byte), re-emitting the suffix's own mini checkpoint bank.
+    Returns (takes [SUF*CK, N], leftover [SUF*CK], final carry, mini
+    bank [SUF, ...])."""
+    N = E + n_max
+    s0 = GL - SUF * CK
+    xs = tuple(x[s0:GL] for x in (inp.R, inp.n, inp.F, inp.agz, inp.agc,
+                                  inp.admit, inp.daemon, inp.ex_compat))
+    return _chunked_scan(inp, ck, xs, P=P, E=E, N=N, V=V, CK=CK)
 
 
 def _pool_budget_jax(limit: jax.Array, used: jax.Array, R: jax.Array) -> jax.Array:
@@ -1152,3 +1261,93 @@ def solve_scan_packed1_pruned_many(bufs: jax.Array, *, T: int, D: int,
     fn = partial(_packed1_pruned_body, T=T, D=D, Z=Z, C=C, G=G, E=E, P=P,
                  n_max=n_max, S=S)
     return jax.vmap(fn)(bufs)
+
+
+def _packed1_ckpt_body(buf: jax.Array, *, T, D, Z, C, G, E, P, n_max,
+                       K, V, M, Q=0, CK=4):
+    n_i64 = _layout_sizes(_in_layout_i64(T, D, Z, C, G, E, P, K, M, 1, Q))
+    n_bits = _layout_sizes(_in_layout_bool(T, D, Z, C, G, E, P, K, M, 1,
+                                           Q))
+    bool_flat = _words_to_bits(buf[n_i64:n_i64 + _nwords(n_bits)], n_bits)
+    inp, _ = _unpack_inputs(buf[:n_i64], bool_flat, T, D, Z, C, G, E, P,
+                            K, M, 1, Q)
+    takes, leftover, carry, bank = _solve_ckpt(inp, n_max, E, P, V, CK)
+    return _pack_solve_outputs(takes, leftover, carry), bank
+
+
+@partial(jax.jit, static_argnames=("T", "D", "Z", "C", "G", "E", "P",
+                                   "K", "V", "M", "n_max", "Q", "CK"))
+def solve_scan_packed1_ckpt(buf: jax.Array, *, T: int, D: int, Z: int,
+                            C: int, G: int, E: int, P: int, n_max: int,
+                            K: int = 0, V: int = 0, M: int = 0,
+                            Q: int = 0, CK: int = 4
+                            ) -> Tuple[jax.Array, Carry]:
+    """The checkpoint-recording full solve behind the single-buffer
+    wire: the packed output buffer PLUS a device-resident [G/CK, ...]
+    checkpoint bank (carry entering every CK-th group). The bank never
+    crosses the wire — the dispatcher keeps it on device and feeds one
+    entry back into ``solve_scan_suffix`` on warm ticks. Unfused only
+    (caller-gated F == 1): the fused block scan has no per-group carry
+    sequence to checkpoint."""
+    return _packed1_ckpt_body(buf, T=T, D=D, Z=Z, C=C, G=G, E=E, P=P,
+                              n_max=n_max, K=K, V=V, M=M, Q=Q, CK=CK)
+
+
+def _packed1_suffix_body(buf: jax.Array, bank: Carry, *, T, D, Z, C, G,
+                         E, P, n_max, K, V, M, Q=0, CK=4, SUF=1,
+                         GL=None):
+    n_i64 = _layout_sizes(_in_layout_i64(T, D, Z, C, G, E, P, K, M, 1, Q))
+    n_bits = _layout_sizes(_in_layout_bool(T, D, Z, C, G, E, P, K, M, 1,
+                                           Q))
+    bool_flat = _words_to_bits(buf[n_i64:n_i64 + _nwords(n_bits)], n_bits)
+    inp, _ = _unpack_inputs(buf[:n_i64], bool_flat, T, D, Z, C, G, E, P,
+                            K, M, 1, Q)
+    if GL is None:
+        GL = G
+    jr = GL // CK - SUF  # static: resume chunk index
+    ck = jax.tree_util.tree_map(lambda a: a[jr], bank)
+    takes_s, left_s, carry, mini = _solve_suffix(inp, ck, n_max, E, P, V,
+                                                 CK, SUF, GL)
+    pad_chunks = G // CK - GL // CK
+    if pad_chunks:
+        # chunks past the live bound are all-empty groups: their entry
+        # carries equal the final carry (empty groups are carry
+        # no-ops), so the bank splice stays byte-identical to a full
+        # re-record without ever scanning them
+        mini = jax.tree_util.tree_map(
+            lambda m, c: jnp.concatenate(
+                [m, jnp.broadcast_to(c[None], (pad_chunks,) + c.shape)]),
+            mini, carry)
+    new_bank = jax.tree_util.tree_map(lambda f, m: f.at[jr:].set(m),
+                                      bank, mini)
+    return _pack_solve_outputs(takes_s, left_s, carry), new_bank
+
+
+@partial(jax.jit, static_argnames=("T", "D", "Z", "C", "G", "E", "P",
+                                   "K", "V", "M", "n_max", "Q", "CK",
+                                   "SUF", "GL"))
+def solve_scan_suffix(buf: jax.Array, bank: Carry, *, T: int, D: int,
+                      Z: int, C: int, G: int, E: int, P: int, n_max: int,
+                      K: int = 0, V: int = 0, M: int = 0, Q: int = 0,
+                      CK: int = 4, SUF: int = 1, GL: int = None
+                      ) -> Tuple[jax.Array, Carry]:
+    """Suffix-only re-solve: select the checkpoint entering group
+    GL - SUF*CK out of the resident [G/CK, ...] ``bank`` and scan only
+    the SUF*CK groups below the live bound ``GL`` of the SAME packed
+    arena the full solve consumes (GL, chunk-aligned, bounds the
+    non-empty groups: everything past it is padding or an emptied
+    group, a carry no-op the scan can skip without changing a byte —
+    solver/incremental.py ``live_bound``; None means G). The output
+    buffer is the standard three-section layout with the group axis
+    sized SUF*CK (hostpack.unpack_outputs1 with G=SUF*CK) covering
+    groups [GL - SUF*CK, GL); the second output is the UPDATED bank
+    (the suffix's own mini checkpoints spliced over the stale tail,
+    pad chunks re-stamped with the final carry), ready to be adopted
+    as-is for the next tick. Checkpoint select and bank splice both
+    live inside the jit: a warm tick costs ONE dispatch, not a flurry
+    of per-leaf eager gathers. SUF is bucketed by the dispatcher
+    (solver/incremental.py) so warm frontiers never trigger
+    recompiles."""
+    return _packed1_suffix_body(buf, bank, T=T, D=D, Z=Z, C=C, G=G, E=E,
+                                P=P, n_max=n_max, K=K, V=V, M=M, Q=Q,
+                                CK=CK, SUF=SUF, GL=GL)

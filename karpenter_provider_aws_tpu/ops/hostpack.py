@@ -221,9 +221,18 @@ def patch_inputs1(buf: np.ndarray, bool_flat: np.ndarray, arrays: dict,
         for s in shp:
             sz *= s
         if nm in want64 and sz:
-            buf[off:off + sz] = \
-                np.asarray(arrays[nm]).reshape(-1).astype(np.int64)
-            sections.append((off, off + sz))
+            fresh = np.asarray(arrays[nm]).reshape(-1).astype(np.int64)
+            hit = np.nonzero(buf[off:off + sz] != fresh)[0]
+            if hit.size:
+                # narrow to the changed word RUN (one span per field
+                # keeps the section count bounded): the delta wire then
+                # ships only moved words, and the server-side dirty
+                # frontier (frontier_from_sections) resolves to the
+                # first moved GROUP instead of the field start — whole-
+                # field sections would pin every frontier at 0
+                w0, w1 = int(hit[0]), int(hit[-1]) + 1
+                buf[off + w0:off + w1] = fresh[w0:w1]
+                sections.append((off + w0, off + w1))
         off += sz
     layb = in_layout_bool(T, D, Z, C, G, E, P, K, M, F, Q)
     nbits = layout_sizes(layb)
@@ -252,6 +261,67 @@ def patch_inputs1(buf: np.ndarray, bool_flat: np.ndarray, arrays: dict,
                 sections.append((off + w0, off + w0 + words.size))
         boff += sz
     return sections
+
+
+#: arena fields whose leading axis is the canonical GROUP axis — the
+#: only fields a dirty section can touch while still permitting a
+#: suffix-only re-solve past its group index. Everything else (catalog,
+#: pool vectors, existing-node tables) feeds the scan's INITIAL carry
+#: or every step, so touching it forces frontier 0 (full solve).
+GROUP_MAJOR_FIELDS = frozenset(
+    ("R", "n", "daemon", "prio", "F", "agz", "agc", "admit", "ex_compat",
+     "fuse"))
+
+
+def frontier_from_sections(sections, T, D, Z, C, G, E, P, K=0, M=0,
+                           F=1, Q=0) -> int:
+    """Minimum canonical group index the patched ``(start, stop)``
+    int64-word sections of a resident arena can influence — the
+    server-side dirty frontier of the SolvePatch wire (the client-side
+    twin is models/delta.py ``SnapshotDelta.dirty_frontier``, computed
+    semantically; this one is computed purely from the wire layout so
+    the delta wire and the incremental solve compose without a new
+    RPC). Returns G for an empty section list (clean resend) and 0 as
+    soon as any section overlaps a non-group-major field. Bool sections
+    arrive word-rounded from ``patch_inputs1``; rounding can only widen
+    a section, hence only LOWER the result — conservative, never
+    stale."""
+    lay64 = in_layout_i64(T, D, Z, C, G, E, P, K, M, F, Q)
+    layb = in_layout_bool(T, D, Z, C, G, E, P, K, M, F, Q)
+    n_i64 = layout_sizes(lay64)
+    # every field as (start_bit, stop_bit, per-group stride in bits, or
+    # None for non-group fields) in one combined bit space: i64 word w
+    # spans bits [w*64, w*64+64)
+    fields = []
+    off = 0
+    for nm, shp in lay64:
+        sz = 1
+        for s in shp:
+            sz *= s
+        stride = (sz // G) * 64 if nm in GROUP_MAJOR_FIELDS and G else None
+        fields.append((off * 64, (off + sz) * 64, stride))
+        off += sz
+    boff = n_i64 * 64
+    for nm, shp in layb:
+        sz = 1
+        for s in shp:
+            sz *= s
+        stride = sz // G if nm in GROUP_MAJOR_FIELDS and G else None
+        fields.append((boff, boff + sz, stride))
+        boff += sz
+    frontier = G
+    for s0, s1 in sections:
+        b0, b1 = s0 * 64, s1 * 64
+        for f0, f1, stride in fields:
+            lo, hi = max(b0, f0), min(b1, f1)
+            if lo >= hi:
+                continue
+            if stride is None or stride == 0:
+                return 0
+            frontier = min(frontier, (lo - f0) // stride)
+            if frontier == 0:
+                return 0
+    return frontier
 
 
 def tier_leftovers(leftover: np.ndarray, prio) -> dict:

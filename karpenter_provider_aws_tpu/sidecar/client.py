@@ -531,6 +531,16 @@ class RemoteSolver(TPUSolver):
         return bool(self._pruned_ok) and self._caps_current()
 
     @property
+    def supports_ckpt_kernel(self) -> bool:
+        """Always False: the checkpoint bank must live NEXT TO the
+        kernel that replays it, and for a remote solver that is the
+        sidecar — server.py keeps a per-arena bank and serves the
+        suffix re-solve off the SolvePatch wire's own dirty sections,
+        so a client-side bank would only duplicate state that can go
+        stale across the wire."""
+        return False
+
+    @property
     def supports_batch_kernel(self) -> bool:
         """True once the server's Info advertised the SolveBatch
         capability — solve_batch callers (consolidation's pre-screen,

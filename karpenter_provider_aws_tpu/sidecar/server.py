@@ -65,6 +65,10 @@ PATCH_LAYOUT_KEYS = ("T", "D", "Z", "C", "G", "E", "P", "K", "M", "F",
 #: resident patch-arena budget (each slot holds a full packed arena, so
 #: the table is tighter than the shape-class table)
 _MAX_PATCH_ARENAS = 32
+#: resident suffix-bank budget for the incremental SolvePatch serve —
+#: each slot holds a device checkpoint bank PLUS the last full output,
+#: heavier than a patch arena, so the table is tighter still
+_MAX_SUFFIX_BANKS = 8
 
 #: SolvePruned statics vector order (the base-solve statics minus the
 #: minValues triple — out of the pruned kernel's scope — plus S, the
@@ -308,6 +312,12 @@ class _Handler:
         self.cache_dir = ""
         self._mesh_cache: dict = {}
         self._mesh_mu = threading.Lock()
+        #: akey -> checkpoint bank for the incremental SolvePatch serve
+        #: (insertion-ordered dict; oldest slot evicts at capacity).
+        #: Same-akey accesses are serialized by the patch wire's version
+        #: ordering; the lock only guards cross-akey insert/evict races.
+        self._suffix_banks: dict = {}
+        self._suffix_mu = threading.Lock()
         self._inflight = 0
         self._inflight_cv = threading.Condition(threading.Lock())
         self.metrics = metrics
@@ -557,11 +567,19 @@ class _Handler:
         return arena_pack({"out": o_buf})
 
     def _solve_validated(self, buf: np.ndarray, kv: dict, context,
-                         tenant: str, rpc: str) -> np.ndarray:
+                         tenant: str, rpc: str,
+                         inc: Optional[dict] = None) -> np.ndarray:
         """The base-solve dispatch tail — bucket, admit, pad, coalesce,
         unpad — shared by Solve and SolvePatch so a patched resident
         arena takes EXACTLY the full-frame path from here on (the byte-
-        identity argument for the delta wire rests on this sharing)."""
+        identity argument for the delta wire rests on this sharing).
+
+        ``inc`` (SolvePatch only) carries the arena key, the patch's
+        dirty frontier, and the version pair: single-device servers then
+        try the incremental serve — a suffix-only re-solve against the
+        resident checkpoint bank, byte-identical by construction — and
+        fall back to this shared path whenever the shape is outside the
+        incremental kernel's envelope."""
         import jax
         import jax.numpy as jnp
 
@@ -570,6 +588,21 @@ class _Handler:
         kvB = bucket_statics(kv) if self._bucketing else kv
         self._admit_shape(tuple(kvB.values()), context, tenant)
         bufB = self._pad(buf, kv, kvB, context, rpc)
+
+        if inc is not None and ndev <= 1:
+            try:
+                o_inc = self._solve_incremental(bufB, kvB, inc)
+            except Exception:
+                # never let the incremental path take down a request the
+                # shared path can serve; the bank may be mid-splice, so
+                # drop it rather than risk a stale suffix later
+                log.exception("incremental SolvePatch serve failed; "
+                              "falling back to the shared dispatch")
+                with self._suffix_mu:
+                    self._suffix_banks.pop(inc["akey"], None)
+                o_inc = None
+            if o_inc is not None:
+                return unpad_outputs(np.asarray(o_inc), kv, kvB)
 
         if ndev > 1:
             # mesh server: a lone request shards its ONE solve across
@@ -596,6 +629,93 @@ class _Handler:
                                          dispatch_many, rpc, tenant)
         return unpad_outputs(np.asarray(o_buf), kv, kvB)
 
+    def _solve_incremental(self, bufB: np.ndarray, kvB: dict,
+                           inc: dict) -> Optional[np.ndarray]:
+        """Serve a SolvePatch tick from the server-resident checkpoint
+        bank. When the frame's dirty frontier allows it, restore the
+        deepest checkpoint at/below the frontier and re-scan only the
+        suffix, splicing the suffix rows over the resident full output
+        (``takes``/``leftover`` are the only group-axis outputs; every
+        other field IS the final carry and comes from the suffix).
+        Otherwise run the checkpointed full kernel and adopt a fresh
+        bank. Returns the bucketed output buffer, or None when the
+        shape is outside the incremental kernel's envelope (caller
+        falls back to the shared coalesced path).
+
+        Bank validity is version equality: a slot serves only while its
+        version matches the frame's ``base_version`` — a prime, an
+        interleaved full Solve, or client-side n_max growth all skew the
+        pair and force a recorded full solve, never a stale suffix."""
+        import jax.numpy as jnp
+
+        from ..ops.ffd_jax import solve_scan_packed1_ckpt, solve_scan_suffix
+        from ..ops.hostpack import pack_outputs1, unpack_outputs1
+        from ..solver.incremental import (CKPT_CHUNK, ckpt_eligible,
+                                          live_bound, suffix_plan)
+        GpB = kvB["G"]
+        if not ckpt_eligible(GpB, Fu=kvB.get("F", 1)):
+            return None
+        CK = CKPT_CHUNK
+        gl = live_bound(bufB, T=kvB["T"], D=kvB["D"], G=GpB, CK=CK)
+        statics = {k: v for k, v in kvB.items() if k != "F"}
+        dims = {k: kvB[k] for k in ("T", "D", "Z", "C", "G", "E", "P",
+                                    "n_max")}
+        akey = inc["akey"]
+        with self._suffix_mu:
+            bank = self._suffix_banks.get(akey)
+        reason = None
+        if inc["base_version"] < 0 or bank is None:
+            reason = "cold"
+        elif bank["kvB"] != kvB or bank["GL"] != gl or gl <= 0:
+            # akey pins the layout, so only the layout-inert statics
+            # can differ here (n_max growth after slot exhaustion, or
+            # the live bound moving under a patched tail group)
+            reason = "bucket"
+        elif bank["version"] != inc["base_version"]:
+            reason = "version_lag"
+        elif inc["frontier"] <= 0:
+            reason = "frontier"
+        if reason is None:
+            jr, SUF = suffix_plan(min(inc["frontier"], GpB), GpB, CK,
+                                  GL=gl)
+            s0 = jr * CK
+            sb, new_bank = solve_scan_suffix(jnp.asarray(bufB),
+                                             bank["bank"], CK=CK,
+                                             SUF=SUF, GL=gl, **statics)
+            sv = unpack_outputs1(np.asarray(sb), **{**dims, "G": SUF * CK})
+            vals = bank["vals"]
+            for nm in list(vals):
+                if nm in ("takes", "leftover"):
+                    vals[nm][s0:gl] = sv[nm]
+                else:
+                    vals[nm] = sv[nm]
+            bank["bank"] = new_bank
+            bank["version"] = inc["new_version"]
+            if self.metrics is not None:
+                self.metrics.inc("karpenter_solver_solve_suffix_total",
+                                 labels={"reason": "patch"})
+                self.metrics.observe(
+                    "karpenter_solver_solve_suffix_groups",
+                    float(SUF * CK))
+            return pack_outputs1(vals, **dims)
+        ob, devbank = solve_scan_packed1_ckpt(jnp.asarray(bufB), CK=CK,
+                                              **statics)
+        o_buf = np.asarray(ob)
+        # unpack a COPY: the resident vals are spliced in place on later
+        # suffix ticks and must never alias the buffer already returned
+        vals = unpack_outputs1(o_buf.copy(), **dims)
+        with self._suffix_mu:
+            self._suffix_banks.pop(akey, None)
+            while len(self._suffix_banks) >= _MAX_SUFFIX_BANKS:
+                self._suffix_banks.pop(next(iter(self._suffix_banks)))
+            self._suffix_banks[akey] = dict(kvB=dict(kvB), GL=gl,
+                                            version=inc["new_version"],
+                                            bank=devbank, vals=vals)
+        if self.metrics is not None:
+            self.metrics.inc("karpenter_solver_solve_full_total",
+                             labels={"reason": reason})
+        return o_buf
+
     def solve_patch(self, request: bytes, context) -> bytes:
         """The delta wire: apply dirty word sections against the
         server-resident arena for (tenant, layout shape, client token,
@@ -613,7 +733,7 @@ class _Handler:
         keeps full-framing without error noise."""
         import grpc
 
-        from ..ops.hostpack import unpack_patch_frame
+        from ..ops.hostpack import frontier_from_sections, unpack_patch_frame
         arrays = self._request_arrays(request, context, "frame")
         try:
             hdr, svec, sections, payloads = unpack_patch_frame(
@@ -636,6 +756,7 @@ class _Handler:
             buf = np.asarray(payloads[0])
             resident = self._patch_arenas.prime(
                 akey, buf, hdr["new_version"], tenant)
+            frontier = 0
         else:
             buf, reason = self._patch_arenas.apply(
                 akey, sections, payloads, hdr["base_version"],
@@ -645,8 +766,21 @@ class _Handler:
                               "no resident arena" if reason ==
                               "no_resident" else "stale arena version")
             resident = True
+            # the server-side dirty frontier, recovered purely from the
+            # patched word sections (no new wire field): the incremental
+            # serve may resume the scan from the deepest checkpoint at
+            # or below it. Empty sections (clean resend) -> G.
+            frontier = frontier_from_sections(
+                sections, **{k: kv[k] for k in ("T", "D", "Z", "C", "G",
+                                                "E", "P", "K", "M", "F",
+                                                "Q")})
+        # a rejected prime keeps the client full-framing, so a bank
+        # recorded for it could never be reused — skip the serve
+        inc = dict(akey=akey, frontier=frontier,
+                   base_version=hdr["base_version"],
+                   new_version=hdr["new_version"]) if resident else None
         o_buf = self._solve_validated(buf, kv, context, tenant,
-                                      "SolvePatch")
+                                      "SolvePatch", inc=inc)
         return arena_pack({
             "out": o_buf,
             "resident": np.array([1 if resident else 0], dtype=np.int64),

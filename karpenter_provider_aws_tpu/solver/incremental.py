@@ -1,0 +1,114 @@
+"""Incremental-solve policy: when a checkpointed scan may serve, and
+which static suffix bucket a dirty frontier resolves to.
+
+The FFD ``lax.scan`` carry entering group *i* is a pure function of
+groups ``< i`` in the restriction-stable canonical order
+(models/encoding.py), so a tick whose dirty rows all sit at or past a
+frontier index can restore the deepest checkpoint at or below the
+frontier and re-scan only the suffix — byte-identical to the
+from-scratch solve by construction (the suffix scans the SAME padded
+arena rows through the SAME step function from the SAME carry the full
+solve would have reached). This module centralizes the three decisions
+every dispatch site (solver/tpu.py, sidecar/server.py, and the numpy
+host twin) must make identically:
+
+- ``ckpt_eligible``: which shape classes record checkpoints at all.
+  The checkpointed kernel is the UNFUSED single-device scan — the
+  fused/pruned/mesh kernels keep their own scan shapes, and their
+  envelopes (huge G, multi-device) are exactly where a per-chunk
+  checkpoint bank would be carry-width-expensive anyway.
+- ``suffix_plan``: frontier -> (resume chunk, static suffix length).
+  Suffix lengths round UP a static bucket ladder (the tenancy
+  T-ladder: pow2 with a 1.5x midpoint, ``tenancy/bucketing.py``) so a
+  warm frontier wobbling a few groups never triggers a recompile —
+  at most ``O(log G)`` suffix shape classes exist per arena shape.
+  Rounding up only ever resumes EARLIER (deeper prefix re-scanned),
+  which is always exact.
+- ``suffix_buckets``: every suffix length a shape class can produce —
+  the prime set hack/aotprime.py records and solver warmup compiles.
+
+Bank *validity* is intentionally not decided here: it is a token
+equality (delta epoch + the encoder version the bank's arena
+reflected) owned by the dispatch sites, because the client solver and
+the sidecar server track versions on different wires (DeltaEncoder
+state token vs patch-frame base_version).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+from ..tenancy.bucketing import _pow15
+
+#: checkpoint stride: one carry snapshot every CKPT_CHUNK groups. The
+#: bank costs (G / CKPT_CHUNK) carry copies of device memory per solve
+#: — still small next to the takes table — and the wasted prefix
+#: re-scan below the frontier is at most CKPT_CHUNK - 1 groups. Per-
+#: group scan cost is dispatch-bound on CPU (~0.14ms/group at the 50k
+#: shape), so a stride of 2 buys the warm tick one-to-two fewer groups
+#: than 4 did and that is the difference between meeting the 1.5ms
+#: suffix budget and missing it.
+CKPT_CHUNK = 2
+
+#: largest padded group count that records checkpoints. Past this the
+#: bank's [G/CK, N, ...] carry stack stops being small next to the
+#: arena, and the big-G envelopes belong to the pruned kernel anyway
+#: (which is ckpt-ineligible by shape).
+CKPT_MAX_GROUPS = 512
+
+
+def ckpt_eligible(Gp: int, *, ndev: int = 1, use_pruned: bool = False,
+                  Fu: int = 1, CK: int = CKPT_CHUNK) -> bool:
+    """May this dispatch record/consume a checkpoint bank? Purely a
+    shape/engine gate — bank freshness is the caller's token check."""
+    return (ndev <= 1 and not use_pruned and Fu <= 1
+            and Gp >= 2 * CK and Gp <= CKPT_MAX_GROUPS
+            and Gp % CK == 0)
+
+
+def suffix_plan(frontier: int, Gp: int, CK: int = CKPT_CHUNK,
+                GL: int = None) -> Tuple[int, int]:
+    """``(resume_chunk, SUF)`` for a dirty frontier against a Gp-group
+    arena whose live bound is GL (chunk-aligned end of the non-empty
+    groups, ``live_bound``; None means Gp): the suffix scans chunks
+    ``[resume_chunk, GL/CK)`` — i.e. groups ``[resume_chunk*CK, GL)``
+    — from the bank's entry carry at ``resume_chunk``. Groups past GL
+    are empty, hence carry no-ops the scan skips for free. SUF is the
+    bucketed chunk count (static: one compiled suffix kernel per
+    value). Invariants: ``resume_chunk * CK <= frontier`` (never skips
+    a dirty row — dirty rows are non-empty, so frontier < GL) and
+    ``SUF >= 1`` (even a clean tick re-scans one chunk — cheaper than
+    special-casing an empty suffix into a separate code path)."""
+    GLC = (GL if GL is not None else Gp) // CK
+    j = min(max(frontier, 0) // CK, GLC - 1)
+    SUF = min(_pow15(GLC - j), GLC)
+    return GLC - SUF, SUF
+
+
+def suffix_buckets(Gp: int, CK: int = CKPT_CHUNK,
+                   GL: int = None) -> Tuple[int, ...]:
+    """Every SUF value ``suffix_plan`` can emit for this arena shape,
+    ascending — the compile/prime set (aotprime + solver warmup)."""
+    GLC = (GL if GL is not None else Gp) // CK
+    return tuple(sorted({min(_pow15(GLC - j), GLC) for j in range(GLC)}))
+
+
+def live_bound(buf, *, T: int, D: int, G: int,
+               CK: int = CKPT_CHUNK) -> int:
+    """Chunk-aligned bound of the non-empty groups of a packed arena:
+    the smallest multiple of CK covering every group with n > 0 (the
+    ``n`` vector sits at word ``T*D + G*D`` of the i64 section —
+    ops/hostpack.py in_layout_i64). Groups at or past the bound are
+    padding (or emptied rows), and an empty group is a carry no-op —
+    the FFD step places min(n, ...) = 0 pods and opens ceil(0/cap) = 0
+    nodes — so a suffix scan may stop there with byte-identical
+    outputs. Returns 0 for an all-empty arena (no dirty group can
+    exist, so no suffix is ever planned against it)."""
+    off = T * D + G * D
+    n = np.asarray(buf[off:off + G])
+    nz = np.nonzero(n)[0]
+    if not nz.size:
+        return 0
+    return -(-(int(nz[-1]) + 1) // CK) * CK

@@ -146,6 +146,30 @@ def pin_host_isa() -> str:
     return ""
 
 
+def pin_cpu_singlethread() -> bool:
+    """Pin the XLA:CPU intra-op pool to ONE thread via XLA_FLAGS.
+
+    The warm-tick serving kernels (suffix re-solves, small full solves)
+    are dispatch-bound: their per-op tensors are a few KB, so Eigen's
+    multi-thread fan-out buys nothing at the median and contributes the
+    entire latency tail — a straggling worker wakeup turns a 1.2ms
+    suffix into a 4ms one (measured at the 50k warm-tick shape; single-
+    thread cut the p99 tail ~2.5x with an unchanged p50). Serving
+    deployments that only dispatch small per-tick kernels should pin;
+    batch/mesh deployments crunching big arenas should not. Returns
+    False without touching anything when an operator already configured
+    threading (their flag wins). MUST run before the first jax backend
+    touch to take effect."""
+    cur = os.environ.get("XLA_FLAGS", "")
+    if ("multi_thread_eigen" in cur
+            or "intra_op_parallelism_threads" in cur):
+        return False
+    os.environ["XLA_FLAGS"] = \
+        (cur + " " if cur else "") + \
+        "--xla_cpu_multi_thread_eigen=false intra_op_parallelism_threads=1"
+    return True
+
+
 def _cache_root(cache_dir=None) -> str:
     if cache_dir is None:
         cache_dir = os.environ.get("KARPENTER_JAX_CACHE") or os.path.join(
@@ -342,6 +366,13 @@ def deactivate_aot() -> None:
     _aot_store, _aot_record = None, False
 
 
+def aot_recording() -> bool:
+    """True while ``activate_aot(record=True)`` is in effect — prime
+    runs that should eagerly compile whole shape-class ladders
+    (solver/tpu.py _prime_suffix) key off this."""
+    return _aot_record
+
+
 def aot_counts() -> dict:
     """{"served", "cold", "recorded"} since process start (served =
     dispatches answered by a stored executable, cold = store active but
@@ -366,6 +397,40 @@ def aot_kernel(name: str, fn, arg, statics: dict):
     if exe is None and _aot_record:
         try:
             exe = fn.lower(arg, **statics).compile()
+        except Exception as e:
+            log.debug("aot record compile failed for %s: %s", name, e)
+            exe = None
+        if exe is not None:
+            store.save(name, statics, shape, dtype, exe)
+            kind = "recorded"
+    if exe is None:
+        kind = "cold"
+    with _counts_mu:
+        _aot_counts[kind] += 1
+    if store.metrics is not None:
+        store.metrics.inc("karpenter_solver_aot_dispatch_total",
+                          labels={"outcome": kind, "kernel": name})
+    return exe
+
+
+def aot_kernel_n(name: str, fn, args, statics: dict):
+    """``aot_kernel`` for kernels taking operands beyond the packed
+    buffer (the suffix kernel's checkpoint carry pytree). The store key
+    stays (name, statics, first-operand shape/dtype): every extra
+    operand's shape is a pure function of the statics (carry fields are
+    sized by T/D/Z/C/E/P/n_max), so the key is still complete. Record
+    mode lowers with ALL operands; the returned executable is called
+    with the same full operand tuple."""
+    store = _aot_store
+    if store is None:
+        return None
+    arg0 = args[0]
+    shape, dtype = tuple(arg0.shape), str(arg0.dtype)
+    exe = store.load(name, statics, shape, dtype)
+    kind = "served"
+    if exe is None and _aot_record:
+        try:
+            exe = fn.lower(*args, **statics).compile()
         except Exception as e:
             log.debug("aot record compile failed for %s: %s", name, e)
             exe = None

@@ -1,0 +1,523 @@
+"""Incremental solve: checkpointed scan-prefix reuse and suffix-only
+re-solve (solver/incremental.py, ops/ffd_jax.py solve_scan_suffix,
+solver/tpu.py _try_suffix).
+
+Three layers, one contract — a suffix-served tick is byte-identical to
+the from-scratch solve:
+
+- planning (no jax): suffix_plan / suffix_buckets / ckpt_eligible /
+  live_bound edges, and the server-side frontier recovered purely from
+  patched word sections (ops/hostpack.frontier_from_sections).
+- delta semantics (no jax): SnapshotDelta.dirty_frontier is the min
+  canonical group index whose row moved; any node/pool/existing-row
+  dirtiness pins it to 0 (those feed the scan's initial carry).
+- staleness edges (jax): structural epoch bump, bucket regrow, version
+  lag > 1 (a host-served tick), and a mid-stream fleet rebind each
+  force a checkpoint-rebuilding full solve — never a stale suffix —
+  and every tick stays fingerprint-identical to the CPU oracle.
+
+The slow matrix (``make fuzz-suffix`` / hack/fuzzsuffix.sh) sweeps 10
+seeds of randomized churn, including frontier == 0 and last-group-only
+ticks, plus the exhaustive kernel byte-parity sweep over every
+(checkpoint row, suffix bucket) pair.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from karpenter_provider_aws_tpu.apis.resources import Resources
+from karpenter_provider_aws_tpu.fake.environment import Environment, make_pods
+from karpenter_provider_aws_tpu.models.delta import DeltaEncoder
+from karpenter_provider_aws_tpu.ops.hostpack import (frontier_from_sections,
+                                                     in_layout_i64,
+                                                     layout_sizes)
+from karpenter_provider_aws_tpu.solver import CPUSolver
+from karpenter_provider_aws_tpu.solver.incremental import (CKPT_CHUNK,
+                                                           ckpt_eligible,
+                                                           live_bound,
+                                                           suffix_buckets,
+                                                           suffix_plan)
+from karpenter_provider_aws_tpu.solver.tpu import TPUSolver
+from karpenter_provider_aws_tpu.solver.types import ExistingNode
+from karpenter_provider_aws_tpu.utils.metrics import Metrics
+
+CK = CKPT_CHUNK
+
+FUZZ_SEEDS_SLOW = (3, 7, 11, 17, 23, 31, 42, 57, 71, 97)
+
+_ZONE_L = "topology.kubernetes.io/zone"
+_CT_L = "karpenter.sh/capacity-type"
+
+
+# ---------------------------------------------------------------------------
+# planning (no jax)
+
+class TestPlanning:
+    def test_ckpt_eligible_gates(self):
+        assert ckpt_eligible(4 * CK)
+        assert not ckpt_eligible(4 * CK, ndev=2)          # mesh engine
+        assert not ckpt_eligible(4 * CK, use_pruned=True)  # pruned engine
+        assert not ckpt_eligible(4 * CK, Fu=2)            # fused scan
+        assert not ckpt_eligible(CK)                      # too small
+        assert not ckpt_eligible(4 * CK + 1)              # not CK-aligned
+        assert not ckpt_eligible(1024)                    # past the cap
+
+    def test_suffix_plan_invariants(self):
+        for Gp in (4 * CK, 8 * CK, 16 * CK):
+            NC = Gp // CK
+            for frontier in range(Gp + 1):
+                jr, SUF = suffix_plan(frontier, Gp)
+                assert SUF >= 1
+                assert jr + SUF == NC          # the scan reaches the end
+                assert jr * CK <= min(frontier, Gp - 1)  # no dirty row skipped
+                assert SUF in suffix_buckets(Gp)
+
+    def test_suffix_plan_live_bound(self):
+        Gp = 16 * CK
+        GL = 7 * CK
+        for frontier in range(GL):
+            jr, SUF = suffix_plan(frontier, Gp, GL=GL)
+            assert jr + SUF == GL // CK        # the scan stops at GL
+            assert jr * CK <= frontier
+        # frontier at/past GL still yields a valid (clamped) plan
+        jr, SUF = suffix_plan(GL + 3, Gp, GL=GL)
+        assert jr + SUF == GL // CK and SUF >= 1
+
+    def test_suffix_buckets_ladder(self):
+        for Gp in (4 * CK, 8 * CK, 32 * CK):
+            buckets = suffix_buckets(Gp)
+            NC = Gp // CK
+            assert buckets == tuple(sorted(buckets))
+            assert buckets[-1] == NC           # frontier 0 -> full depth
+            assert all(1 <= b <= NC for b in buckets)
+            # the pow-1.5 ladder is O(log G), the whole point of bucketing
+            assert len(buckets) <= 2 * NC.bit_length() + 2
+
+    def test_live_bound(self):
+        T, D, G = 3, 4, 8
+        off = T * D + G * D
+        buf = np.zeros(off + G, dtype=np.int64)
+        assert live_bound(buf, T=T, D=D, G=G) == 0     # all-empty arena
+        buf[off + 4] = 2                               # last live group: 4
+        gl = live_bound(buf, T=T, D=D, G=G)
+        assert gl % CK == 0 and gl >= 5
+        buf[off + G - 1] = 1
+        assert live_bound(buf, T=T, D=D, G=G) == G     # fully live
+
+
+class TestFrontierFromSections:
+    KV = dict(T=5, D=8, Z=2, C=2, G=16, E=3, P=2)
+
+    def _offsets(self):
+        kv = self.KV
+        lay = in_layout_i64(kv["T"], kv["D"], kv["Z"], kv["C"], kv["G"],
+                            kv["E"], kv["P"], 0, 0, 1, 0)
+        off, out = 0, {}
+        for nm, shp in lay:
+            sz = 1
+            for s in shp:
+                sz *= s
+            out[nm] = (off, off + sz)
+            off += sz
+        return out
+
+    def test_empty_sections_are_clean(self):
+        assert frontier_from_sections([], **self.KV) == self.KV["G"]
+
+    def test_group_major_words_map_to_their_group(self):
+        kv, offs = self.KV, self._offsets()
+        n0 = offs["n"][0]
+        assert frontier_from_sections([(n0 + 5, n0 + 6)], **kv) == 5
+        r0 = offs["R"][0]
+        w = r0 + 3 * kv["D"]  # first word of R row 3
+        assert frontier_from_sections([(w, w + kv["D"])], **kv) == 3
+        # min across several sections wins
+        assert frontier_from_sections(
+            [(n0 + 9, n0 + 10), (w, w + 1)], **kv) == 3
+
+    def test_non_group_fields_force_full(self):
+        kv, offs = self.KV, self._offsets()
+        a0 = offs["A"][0]
+        assert frontier_from_sections([(a0 + 2, a0 + 3)], **kv) == 0
+        p0 = offs["pool_limit"][0]
+        assert frontier_from_sections([(p0, p0 + 1)], **kv) == 0
+        e0 = offs["ex_used0"][0]
+        assert frontier_from_sections([(e0, e0 + 1)], **kv) == 0
+        # one clean-looking section + one carry-feeding section -> 0
+        n0 = offs["n"][0]
+        assert frontier_from_sections(
+            [(n0 + 12, n0 + 13), (a0, a0 + 1)], **kv) == 0
+
+    def test_bool_sections_round_conservatively(self):
+        kv = self.KV
+        lay = in_layout_i64(kv["T"], kv["D"], kv["Z"], kv["C"], kv["G"],
+                            kv["E"], kv["P"], 0, 0, 1, 0)
+        n_i64 = layout_sizes(lay)
+        # the first bool word covers avail_zc (a non-group field):
+        # touching it must force frontier 0
+        assert frontier_from_sections([(n_i64, n_i64 + 1)], **kv) == 0
+
+
+# ---------------------------------------------------------------------------
+# delta semantics (no jax)
+
+def _decreasing_cpu_cluster(n_groups=8, per_group=3, prefix="inc"):
+    """Pod groups whose cpu strictly DECREASES with the build index, so
+    the canonical order (-cpu major) makes canonical position == index:
+    churning group k must yield dirty_frontier == k exactly."""
+    env = Environment()
+    pool = env.nodepool(prefix)
+    sigs = [dict(cpu=f"{900 - 100 * i}m", memory=f"{512 + 64 * i}Mi",
+                 group=f"{prefix}g{i}") for i in range(n_groups)]
+
+    def mk(gi, n=1):
+        return make_pods(n, cpu=sigs[gi]["cpu"], memory=sigs[gi]["memory"],
+                         prefix=sigs[gi]["group"], group=sigs[gi]["group"])
+
+    pods = {gi: mk(gi, per_group) for gi in range(n_groups)}
+
+    def snap(existing=()):
+        # iterate the dict's keys, not range(n_groups): tests add NEW
+        # groups (structural transitions) by inserting fresh keys
+        flat = [p for gi in sorted(pods) for p in pods[gi]]
+        return env.snapshot(flat, [pool], existing_nodes=list(existing))
+
+    return env, sigs, pods, mk, snap
+
+
+def _node(name, cpu_used="500m"):
+    return ExistingNode(
+        name=name,
+        labels={_ZONE_L: "us-east-1a", _CT_L: "on-demand"},
+        allocatable=Resources.parse(
+            {"cpu": "8", "memory": "32Gi", "pods": "110"}),
+        used=Resources.parse({"cpu": cpu_used, "memory": "1Gi"}))
+
+
+class TestDirtyFrontier:
+    def test_churned_group_sets_frontier_to_its_index(self):
+        _, _, pods, mk, snap = _decreasing_cpu_cluster()
+        denc = DeltaEncoder()
+        for k in (5, 2, 7):
+            denc.encode(snap(), None, [])
+            pods[k][0] = mk(k)[0]       # swap one pod: membership churn
+            _, _, d = denc.encode(snap(), None, [])
+            assert d.tier == "rows"
+            assert d.dirty_frontier == k
+
+    def test_quiet_tick_frontier_is_group_count(self):
+        _, _, _, _, snap = _decreasing_cpu_cluster(n_groups=6)
+        denc = DeltaEncoder()
+        denc.encode(snap(), None, [])
+        _, _, d = denc.encode(snap(), None, [])
+        assert d.tier == "hit"
+        assert d.dirty_frontier == 6
+
+    def test_node_dirtiness_forces_frontier_zero(self):
+        _, _, pods, mk, snap = _decreasing_cpu_cluster()
+        denc = DeltaEncoder()
+        denc.encode(snap(), None, [])
+        # a launched node feeds ex rows -> initial carry: frontier 0
+        # even though pod churn alone would have said 6
+        pods[6][0] = mk(6)[0]
+        n1 = _node("inc-n-1")
+        _, _, d = denc.encode(snap([n1]), None, [n1])
+        assert d.dirty_frontier == 0
+
+    def test_rebind_used_bump_forces_frontier_zero(self):
+        _, _, _, _, snap = _decreasing_cpu_cluster()
+        denc = DeltaEncoder()
+        n1 = _node("inc-n-1")
+        denc.encode(snap([n1]), None, [n1])
+        n2 = _node("inc-n-1", cpu_used="2")   # same node, bound pods
+        _, _, d = denc.encode(snap([n2]), None, [n2])
+        assert d.tier == "rows"
+        assert d.dirty_frontier == 0
+
+
+# ---------------------------------------------------------------------------
+# staleness edges (jax; every tick fingerprint-checked vs the oracle)
+
+def _oracle_print(snap):
+    return CPUSolver().solve(snap).decision_fingerprint()
+
+
+def _device_or_skip():
+    from karpenter_provider_aws_tpu.solver import route
+    if not route.device_alive():
+        pytest.skip("no dev engine in this environment")
+
+
+def _jax_solver():
+    s = TPUSolver(backend="jax")
+    # conftest forces 8 virtual CPU devices; the mesh route is
+    # ckpt-ineligible, so pin the single-device packed path under test
+    s._dev_devices = lambda: 1
+    return s
+
+
+def _solve_checked(solver, snap):
+    """One solve, fingerprint-checked against the from-scratch CPU
+    oracle; returns the dispatch-mode marker ('full' or
+    'suffix@<bucket>')."""
+    res = solver.solve(snap)
+    assert res.decision_fingerprint() == _oracle_print(snap)
+    return solver.last_phase_stats.get("solve", "full")
+
+
+class TestCheckpointStaleness:
+    def test_staleness_edges_force_full_then_suffix_resumes(self):
+        _device_or_skip()
+        from karpenter_provider_aws_tpu.solver import route
+        env, sigs, pods, mk, snap = _decreasing_cpu_cluster(
+            n_groups=8, per_group=4, prefix="stale")
+        nodes = [_node("stale-n-1"), _node("stale-n-2")]
+        solver = _jax_solver()
+        solver.metrics = Metrics()
+        oracle_nodes = list(nodes)
+
+        def tick():
+            return snap(oracle_nodes)
+
+        # cold adopt: full solve records the bank
+        assert _solve_checked(solver, tick()) == "full"
+
+        # let the slot bucket settle (the 8-solve shrink window walks
+        # 256 -> 16 on a cluster this small) BEFORE probing the edges:
+        # each shrink step changes the kernel shape class and would
+        # alias its own re-record full into an edge that expects a
+        # suffix
+        for _ in range(24):
+            if solver._bucket == 16:
+                break
+            pods[7][0] = mk(7)[0]
+            _solve_checked(solver, tick())
+        assert solver._bucket == 16
+        pods[7][0] = mk(7)[0]
+        _solve_checked(solver, tick())  # re-record at the settled bucket
+
+        # warm churn in a deep group -> suffix, correct resume depth
+        pods[6][0] = mk(6)[0]
+        mode = _solve_checked(solver, tick())
+        assert mode.startswith("suffix@"), mode
+        assert solver.last_dispatch_stats["resume_group"] <= 6
+
+        # structural transition (a NEW signature joins) -> epoch bump:
+        # the bank must NOT serve, and the next warm tick re-adopts
+        sigs.append(dict(cpu="150m", memory="128Mi", group="stalegX"))
+        pods[8] = make_pods(2, cpu="150m", memory="128Mi",
+                            prefix="stalegX", group="stalegX")
+        env2 = snap(oracle_nodes)
+        assert _solve_checked(solver, env2) == "full"
+        pods[8][0] = make_pods(1, cpu="150m", memory="128Mi",
+                               prefix="stalegX", group="stalegX")[0]
+        assert _solve_checked(solver, tick()).startswith("suffix@")
+
+        # a tick served by the host twin (device probe forced dead)
+        # does NOT strand the bank: routing happens before the
+        # incremental encode, so the encoder never observes the
+        # intermediate state and the next device delta SPANS both
+        # ticks — the suffix stays exact (the fingerprint check is
+        # the proof)
+        orig = route.dev_engine_usable
+        route.dev_engine_usable = lambda *a, **k: False
+        try:
+            pods[5][0] = mk(5)[0]
+            _solve_checked(solver, tick())
+        finally:
+            route.dev_engine_usable = orig
+        pods[5][1] = mk(5)[0]
+        mode = _solve_checked(solver, tick())
+        assert mode.startswith("suffix@"), mode
+        assert solver.last_dispatch_stats["resume_group"] <= 5
+
+        # version lag proper: a bank whose token trails the arena by
+        # MORE than the current delta (a dropped/unobserved tick) must
+        # not serve — rewind the token one version and the next rows
+        # tick full-solves, then suffixes resume
+        bk = solver._ckpt_bank
+        bk["token"] = (bk["token"][0], bk["token"][1] - 1)
+        pods[5][1] = mk(5)[0]
+        assert _solve_checked(solver, tick()) == "full"
+        pods[7][0] = mk(7)[0]
+        assert _solve_checked(solver, tick()).startswith("suffix@")
+
+        # mid-stream fleet rebind: a node's used bump dirties the
+        # initial carry -> frontier 0 -> full re-record, then resume
+        oracle_nodes[0] = _node("stale-n-1", cpu_used="3")
+        assert _solve_checked(solver, tick()) == "full"
+        pods[6][1] = mk(6)[0]
+        assert _solve_checked(solver, tick()).startswith("suffix@")
+
+        # the metric families carry the streak's evidence
+        rendered = solver.metrics.render()
+        assert "karpenter_solver_solve_suffix_total" in rendered
+        assert "karpenter_solver_solve_full_total" in rendered
+        assert "karpenter_solver_solve_suffix_groups" in rendered
+
+    def test_bucket_shrink_and_regrow_rebuild_bank(self):
+        _device_or_skip()
+        _, _, pods, mk, snap = _decreasing_cpu_cluster(
+            n_groups=8, per_group=2, prefix="grow")
+        solver = _jax_solver()
+        solver.metrics = Metrics()
+        assert _solve_checked(solver, snap()) == "full"
+        pods[7][0] = mk(7)[0]
+        assert _solve_checked(solver, snap()).startswith("suffix@")
+
+        # slot-bucket SHRINK (the 8-solve settle window stepping the
+        # 256 cold bucket down the 16/64/256 ladder): each step changes
+        # the kernel shape class, so the step tick must re-record — and
+        # the streak resumes at the narrow bucket
+        shrunk = False
+        for t in range(24):
+            if solver._bucket == 16:
+                shrunk = True
+                break
+            pods[7][0] = mk(7)[0]
+            mode = _solve_checked(solver, snap())
+            assert mode == "full" or mode.startswith("suffix@")
+        assert shrunk, f"bucket never settled: {solver._bucket}"
+        # the first tick at the settled bucket re-records (the bank was
+        # keyed to the wide shape class) — the one after serves suffix
+        pods[7][0] = mk(7)[0]
+        assert _solve_checked(solver, snap()) == "full"
+        pods[7][0] = mk(7)[0]
+        assert _solve_checked(solver, snap()).startswith("suffix@")
+
+        # slot exhaustion: a burst in the CHEAPEST (last) group floods
+        # past the narrow bucket — the suffix serves first, overflows,
+        # and the grown retry lands as a bank-rebuilding full (reason
+        # "exhausted"); the streak resumes at the wider bucket
+        # sized past the narrow bucket's absolute capacity: 16 slots of
+        # the biggest offering (192 cpu -> 960 of these 200m pods) hold
+        # 15360 — 25k forces leftover at 16 slots, ~26 nodes at 64
+        pods[7] = pods[7] + mk(7, 25000)
+        assert _solve_checked(solver, snap()) == "full"
+        assert solver._bucket > 16
+        pods[7][0] = mk(7)[0]
+        assert _solve_checked(solver, snap()).startswith("suffix@")
+        rendered = solver.metrics.render()
+        assert 'karpenter_solver_solve_full_total{reason="exhausted"}' \
+            in rendered
+
+
+# ---------------------------------------------------------------------------
+# slow sweeps: hack/fuzzsuffix.sh (make fuzz-suffix)
+
+def _fuzz_suffix(seed: int, ticks: int = 12):
+    env, sigs, pods, mk, snap = _decreasing_cpu_cluster(
+        n_groups=8, per_group=3, prefix=f"fz{seed}")
+    nodes = [_node(f"fz{seed}-n-1")]
+    solver = _jax_solver()
+    rng = random.Random(seed)
+    suffix_ticks = 0
+    # first two mutations pinned: last-group-only churn then a random
+    # churn — the frontier regimes the suffix exists for
+    forced = ["last", "rand"]
+    for t in range(ticks):
+        op = forced.pop(0) if forced else rng.choices(
+            ("rand", "last", "zero", "bind", "structural"),
+            weights=(60, 15, 10, 10, 5))[0]
+        if op == "rand":
+            k = rng.randrange(len(pods))
+            pods[k][rng.randrange(len(pods[k]))] = mk(k)[0]
+        elif op == "last":
+            k = max(pods)
+            pods[k][0] = mk(k)[0]
+        elif op == "zero":
+            pods[0][0] = mk(0)[0]          # frontier == 0 group churn
+        elif op == "bind":
+            nodes[0] = _node(nodes[0].name,
+                             cpu_used=f"{rng.randint(1, 4)}")
+        elif op == "structural":
+            gi = len(pods)
+            grp = f"fz{seed}gX{t}"
+            # register the sig so later "rand" churn can hit the new
+            # group through mk() like any other
+            sigs.append(dict(cpu=f"{80 + t}m", memory="128Mi", group=grp))
+            pods[gi] = mk(gi)
+        sn = snap(nodes)
+        res = solver.solve(sn)
+        assert res.decision_fingerprint() == _oracle_print(sn), \
+            (seed, t, op)
+        if str(solver.last_phase_stats.get("solve", "")).startswith(
+                "suffix@"):
+            suffix_ticks += 1
+    assert suffix_ticks >= 1, seed
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", FUZZ_SEEDS_SLOW)
+def test_fuzz_suffix_byte_equality(seed):
+    _device_or_skip()
+    _fuzz_suffix(seed)
+
+
+@pytest.mark.slow
+def test_kernel_suffix_byte_parity_exhaustive():
+    """Every (checkpoint row, suffix bucket, live bound) combination of
+    a randomized packed arena reproduces the full solve byte-for-byte:
+    takes/leftover rows over the scanned window, every carry-derived
+    output field, and the spliced bank itself."""
+    import jax
+    from karpenter_provider_aws_tpu.ops.ffd_jax import (
+        solve_scan_packed1, solve_scan_packed1_ckpt, solve_scan_suffix)
+    from karpenter_provider_aws_tpu.ops.hostpack import (pack_inputs1,
+                                                         unpack_outputs1)
+    rng = np.random.default_rng(11)
+
+    def instance(G, E, P, T=5, D=8, Z=2, C=2, n_max=8, live=None):
+        ex_alloc = rng.integers(0, 25, size=(E, D))
+        n = rng.integers(1, 9, size=(G,))
+        if live is not None:
+            n[live:] = 0
+        arrays = dict(
+            A=rng.integers(0, 20, size=(T, D)),
+            R=rng.integers(0, 4, size=(G, D)), n=n,
+            daemon=rng.integers(0, 2, size=(G, P, D)),
+            pool_limit=np.where(
+                rng.random((P, D)) < 0.5, -1,
+                rng.integers(0, 60, size=(P, D))).astype(np.int64),
+            pool_used0=rng.integers(0, 5, size=(P, D)),
+            ex_alloc=ex_alloc,
+            ex_used0=np.minimum(rng.integers(0, 25, size=(E, D)),
+                                ex_alloc),
+            avail_zc=(rng.random((T, Z, C)) < 0.7).reshape(T, Z * C),
+            F=rng.random((G, T)) < 0.6,
+            agz=rng.random((G, Z)) < 0.8,
+            agc=rng.random((G, C)) < 0.8,
+            admit=rng.random((G, P)) < 0.7,
+            pool_types=rng.random((P, T)) < 0.6,
+            pool_agz=rng.random((P, Z)) < 0.8,
+            pool_agc=rng.random((P, C)) < 0.8,
+            ex_compat=rng.random((G, E)) < 0.5)
+        kv = dict(T=T, D=D, Z=Z, C=C, G=G, E=E, P=P, n_max=n_max)
+        return kv, pack_inputs1(arrays, T, D, Z, C, G, E, P)
+
+    for G, live in ((4 * CK, None), (8 * CK, 5 * CK + 1), (8 * CK, 3)):
+        E, P = int(rng.integers(0, 5)), int(rng.integers(1, 4))
+        kv, buf = instance(G, E, P, live=live)
+        gl = live_bound(buf, T=kv["T"], D=kv["D"], G=G)
+        ref = np.asarray(solve_scan_packed1(buf, **kv))
+        rv = unpack_outputs1(ref.copy(), **kv)
+        full, bank = solve_scan_packed1_ckpt(buf, CK=CK, **kv)
+        assert np.array_equal(np.asarray(full), ref)
+        for SUF in range(1, max(gl // CK, 1) + 1):
+            sb, nb = solve_scan_suffix(buf, bank, CK=CK, SUF=SUF,
+                                       GL=gl or None, **kv)
+            sv = unpack_outputs1(np.asarray(sb), **{**kv, "G": SUF * CK})
+            s0 = (gl or G) - SUF * CK
+            assert np.array_equal(sv["takes"],
+                                  rv["takes"][s0:s0 + SUF * CK])
+            assert np.array_equal(sv["leftover"],
+                                  rv["leftover"][s0:s0 + SUF * CK])
+            for nm in ("used", "pool", "num_nodes", "pool_used",
+                       "types", "zones", "ct", "alive"):
+                assert np.array_equal(sv[nm], rv[nm]), (G, SUF, nm)
+            for f, m in zip(jax.tree_util.tree_leaves(bank),
+                            jax.tree_util.tree_leaves(nb)):
+                assert np.array_equal(np.asarray(f), np.asarray(m)), \
+                    (G, SUF, "bank drift on a clean arena")

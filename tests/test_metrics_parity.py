@@ -255,6 +255,29 @@ def emitted(tmp_path_factory):
         nodepools=op.provisioner.build_snapshot([]).nodepools,
         existing_nodes=[]))
 
+    # incremental solve: a cold solve records the checkpoint bank
+    # (solve_full_total, reason "cold"), a deep-group churn is served
+    # as a suffix re-scan (solve_suffix_total + suffix_groups)
+    from karpenter_provider_aws_tpu.solver import route as _route
+    _route.device_alive()  # resolve the probe so the solve dispatches
+    inc_s = TPUSolver(backend="jax")
+    inc_s.metrics = op.metrics
+    inc_s._dev_devices = lambda: 1  # the virtual mesh is ckpt-ineligible
+    _inps = op.provisioner.build_snapshot([]).nodepools
+    _ipods = {k: make_pods(2, cpu=f"{900 - 100 * k}m", memory="512Mi",
+                           prefix=f"incp{k}", group=f"incpg{k}")
+              for k in range(8)}
+
+    def _isnap():
+        return SchedulingSnapshot(
+            pods=[p for k in sorted(_ipods) for p in _ipods[k]],
+            nodepools=_inps, existing_nodes=[])
+
+    inc_s.solve(_isnap())
+    _ipods[7][0] = make_pods(1, cpu="200m", memory="512Mi",
+                             prefix="incp7x", group="incpg7")[0]
+    inc_s.solve(_isnap())
+
     # preference relaxation: soft zone anti-affinity that cannot hold
     # when hardened (more pods than zones)
     from karpenter_provider_aws_tpu.apis.objects import PodAffinityTerm
